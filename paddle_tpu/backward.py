@@ -1,0 +1,279 @@
+"""Graph-level reverse-mode autodiff: append gradient OPS to the program.
+
+Reference parity: ``python/paddle/fluid/backward.py:469 append_backward``,
+``:685 calc_gradient``, ``:135 _addup_repetitive_outputs_``. Like the
+reference, gradients are real operators appended to the block (inspectable,
+pruneable, transpilable, role-tagged Backward); unlike the reference's
+per-op C++ GradOpDescMakers, the default grad op's *kernel* is synthesized
+by differentiating the forward lowering with jax.vjp at compile time
+(core/op_registry.ensure_auto_grad_op) — XLA CSE folds the recomputed
+forward, so the emitted step program matches a hand-written backward.
+"""
+
+from paddle_tpu import framework
+from paddle_tpu.core import op_registry
+from paddle_tpu.framework import OpRole, Parameter, Variable, grad_var_name
+
+
+def _collect_no_grad(block, no_grad_set):
+    s = set(no_grad_set or ())
+    s = {v.name if isinstance(v, Variable) else v for v in s}
+    for v in block.vars.values():
+        if v.stop_gradient:
+            s.add(v.name)
+    return s
+
+
+class _GradAccumulator(object):
+    """Tracks per-var gradient contributions; sums duplicates
+    (_addup_repetitive_outputs_ parity)."""
+
+    def __init__(self, block):
+        self.block = block
+        self.contribs = {}  # fwd var name -> [grad var names]
+
+    def add(self, var_name, grad_name):
+        self.contribs.setdefault(var_name, []).append(grad_name)
+
+    def alloc_name(self, var_name, reserved):
+        """Allocate a distinct grad name per contribution. ``reserved``
+        tracks allocations within the current op, so a var feeding two
+        input slots (x-x, self-attention matmul(x,x)) gets two names that
+        finalize() then sums — instead of one name silently overwritten."""
+        n = len(self.contribs.get(var_name, [])) + reserved.get(var_name, 0)
+        reserved[var_name] = reserved.get(var_name, 0) + 1
+        if n == 0:
+            return grad_var_name(var_name)
+        return "%s@RENAME_%d" % (grad_var_name(var_name), n)
+
+    def finalize(self, var_name):
+        """Return the (possibly summed) grad var name for var_name."""
+        names = self.contribs.get(var_name)
+        if not names:
+            return None
+        if len(names) == 1:
+            return names[0]
+        total = grad_var_name(var_name)
+        fwd = self.block._find_var_recursive(var_name)
+        self._make_grad_var(total, fwd)
+        self.block.append_op(
+            type="sum",
+            inputs={"X": list(names)},
+            outputs={"Out": [total]},
+            attrs={framework.OP_ROLE_ATTR_NAME: OpRole.Backward},
+        )
+        self.contribs[var_name] = [total]
+        return total
+
+    def _make_grad_var(self, grad_name, fwd_var):
+        if not self.block.has_var(grad_name):
+            self.block.create_var(
+                name=grad_name,
+                shape=None if fwd_var is None else fwd_var.shape,
+                dtype="float32" if fwd_var is None else fwd_var.dtype,
+                stop_gradient=True,
+            )
+
+
+def _append_grad_ops_for(block, op, acc, no_grad):
+    """Append the grad op(s) for one forward op; record contributions."""
+    opdef = op_registry.get_op_def(op.type)
+    if opdef.grad is None:
+        return
+
+    # Incoming gradients for each output slot.
+    out_grads = {}
+    any_grad = False
+    for slot in opdef.output_slots():
+        gs = []
+        for name in op.output(slot):
+            g = acc.finalize(name) if name else None
+            gs.append(g)
+            if g is not None:
+                any_grad = True
+        out_grads[slot] = gs
+    if not any_grad:
+        return
+
+    # Wanted input gradients.
+    wanted = {}
+    reserved = {}
+    for slot in opdef.input_slots():
+        if slot in opdef.no_grad_inputs:
+            continue
+        names = []
+        want_any = False
+        for name in op.input(slot):
+            v = block._find_var_recursive(name) if name else None
+            skip = (
+                not name
+                or name in no_grad
+                or v is None
+                or (v is not None and v.stop_gradient)
+                or (isinstance(v, Parameter) and not v.trainable)
+            )
+            if skip:
+                names.append("")
+            else:
+                gname = acc.alloc_name(name, reserved)
+                names.append(gname)
+                want_any = True
+        if want_any:
+            wanted[slot] = names
+    if not wanted:
+        return
+
+    if callable(opdef.grad):
+        specs = opdef.grad(
+            op,
+            {s: [g for g in gs] for s, gs in out_grads.items()},
+            wanted,
+        )
+        new_ops = []
+        for spec in specs:
+            attrs = dict(spec.get("attrs", {}))
+            attrs[framework.OP_ROLE_ATTR_NAME] = OpRole.Backward
+            attrs.setdefault("__rng_id__", op.attrs.get("__rng_id__"))
+            new_ops.append(
+                (spec["type"], spec.get("inputs", {}), spec.get("outputs", {}), attrs)
+            )
+    else:
+        op_registry.ensure_auto_grad_op(op.type)
+        g_inputs = {}
+        for slot in opdef.input_slots():
+            if op.input(slot):
+                g_inputs[slot] = list(op.input(slot))
+        for slot in opdef.output_slots():
+            if op.output(slot):
+                g_inputs[slot] = list(op.output(slot))
+            gs = out_grads.get(slot, [])
+            if any(g is not None for g in gs):
+                g_inputs[slot + "@GRAD"] = [g or "" for g in gs]
+        g_outputs = {s + "@GRAD": names for s, names in wanted.items()}
+        attrs = dict(op.attrs)
+        attrs[framework.OP_ROLE_ATTR_NAME] = OpRole.Backward
+        new_ops = [(op.type + "_grad", g_inputs, g_outputs, attrs)]
+
+    for g_type, g_ins, g_outs, g_attrs in new_ops:
+        # Create grad vars before appending (shape mirrors forward var).
+        for slot, names in g_outs.items():
+            for i, gname in enumerate(names):
+                if not gname:
+                    continue
+                base = gname.split("@GRAD")[0]
+                fwd_var = block._find_var_recursive(base)
+                acc._make_grad_var(gname, fwd_var)
+        block.append_op(type=g_type, inputs=g_ins, outputs=g_outs, attrs=g_attrs)
+
+    # Record contributions.
+    for slot, names in wanted.items():
+        for name, gname in zip(op.input(slot), names):
+            if gname:
+                acc.add(name, gname)
+
+
+def _backward_pass(block, target_vars, target_grads, no_grad_set, stop_at_ops=None):
+    """Shared reverse walk. target_vars: list of Variables with initial
+    grads (target_grads: list of var names). Returns the accumulator."""
+    no_grad = _collect_no_grad(block, no_grad_set)
+    acc = _GradAccumulator(block)
+    for v, g in zip(target_vars, target_grads):
+        acc.add(v.name, g)
+
+    fwd_ops = list(block.ops)
+    target_names = {v.name for v in target_vars}
+    # Find position of the last op producing any target (usually the loss op).
+    last = len(fwd_ops) - 1
+    for i in range(len(fwd_ops) - 1, -1, -1):
+        if target_names & set(fwd_ops[i].output_arg_names()):
+            last = i
+            break
+    for op in reversed(fwd_ops[: last + 1]):
+        _append_grad_ops_for(block, op, acc, no_grad)
+    return acc
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    """Append backward ops computing d(loss)/d(param) for every trainable
+    parameter; returns [(param, grad_var)] (backward.py:469 parity)."""
+    assert isinstance(loss, Variable)
+    program = loss.block.program
+    block = program.global_block()
+
+    loss_grad = grad_var_name(loss.name)
+    block.create_var(
+        name=loss_grad, shape=loss.shape or (1,), dtype=loss.dtype, stop_gradient=True
+    )
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={
+            "shape": list(loss.shape or (1,)),
+            "dtype": loss.dtype,
+            "value": 1.0,
+            framework.OP_ROLE_ATTR_NAME: OpRole.Backward | OpRole.Loss,
+        },
+    )
+
+    acc = _backward_pass(block, [loss], [loss_grad], no_grad_set)
+
+    if parameter_list is not None:
+        params = [
+            block.var(p) if isinstance(p, str) else p for p in parameter_list
+        ]
+    else:
+        params = [p for p in block.all_parameters() if p.trainable]
+
+    params_and_grads = []
+    for p in params:
+        gname = acc.finalize(p.name)
+        if gname is None:
+            continue
+        gvar = block._find_var_recursive(gname)
+        if gvar is not None and gvar.shape is None:
+            gvar.shape = p.shape
+            gvar.dtype = p.dtype
+        params_and_grads.append((p, gvar))
+    return params_and_grads
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Gradients of targets w.r.t. inputs (backward.py:685 parity)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    block = targets[0].block
+    program = block.program
+
+    grad_names = []
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    for t, tg in zip(targets, target_gradients):
+        if tg is None:
+            gname = grad_var_name(t.name)
+            block.create_var(
+                name=gname, shape=t.shape, dtype=t.dtype, stop_gradient=True
+            )
+            block.append_op(
+                type="fill_constant",
+                outputs={"Out": [gname]},
+                attrs={
+                    "shape": list(t.shape or (1,)),
+                    "dtype": t.dtype,
+                    "value": 1.0,
+                    framework.OP_ROLE_ATTR_NAME: OpRole.Backward,
+                },
+            )
+            grad_names.append(gname)
+        else:
+            grad_names.append(tg.name)
+
+    acc = _backward_pass(block, list(targets), grad_names, no_grad_set)
+
+    result = []
+    for inp in inputs:
+        gname = acc.finalize(inp.name)
+        if gname is None:
+            result.append(None)
+        else:
+            result.append(block._find_var_recursive(gname))
+    return result

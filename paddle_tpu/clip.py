@@ -1,0 +1,149 @@
+"""Gradient clipping (python/paddle/fluid/clip.py parity):
+GradientClipByValue / ByNorm / ByGlobalNorm appended as graph ops."""
+
+import copy
+
+from paddle_tpu import framework, layers
+
+__all__ = [
+    "ErrorClipByValue",
+    "GradientClipByValue",
+    "GradientClipByNorm",
+    "GradientClipByGlobalNorm",
+    "set_gradient_clip",
+    "append_gradient_clip_ops",
+]
+
+
+class BaseErrorClipAttr(object):
+    pass
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+
+class BaseGradientClipAttr(object):
+    def _process_context(self, context, param, grad):
+        pass
+
+    def _create_operators(self, param, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def _create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+    def _create_operators(self, param, grad):
+        block = grad.block
+        new_grad = block.create_var(
+            name=grad.name + "@CLIP", dtype=grad.dtype, shape=grad.shape
+        )
+        block.append_op(
+            type="clip",
+            inputs={"X": [grad.name]},
+            outputs={"Out": [new_grad.name]},
+            attrs={"min": self.min, "max": self.max},
+        )
+        return param, new_grad
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _create_operators(self, param, grad):
+        block = grad.block
+        new_grad = block.create_var(
+            name=grad.name + "@CLIP", dtype=grad.dtype, shape=grad.shape
+        )
+        block.append_op(
+            type="clip_by_norm",
+            inputs={"X": [grad.name]},
+            outputs={"Out": [new_grad.name]},
+            attrs={"max_norm": self.clip_norm},
+        )
+        return param, new_grad
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _process_context(self, context, param, grad):
+        if self.group_name not in context:
+            context[self.group_name] = []
+            context[self.group_name + "_clip_value"] = self.clip_norm
+        elif context[self.group_name + "_clip_value"] != self.clip_norm:
+            raise ValueError("all parameters' clip_norm in a group must agree")
+        sq = layers.reduce_sum(layers.square(grad))
+        context[self.group_name].append(sq)
+        self.context = context
+
+    def _create_operators(self, param, grad):
+        group = self.context[self.group_name]
+        if not isinstance(group, framework.Variable):
+            group_norm = layers.sqrt(layers.sums(group))
+            clip_var = layers.fill_constant(
+                shape=[1], dtype=group_norm.dtype, value=self.clip_norm
+            )
+            # scale = clip / max(norm, clip)
+            group_scale = layers.elementwise_div(
+                clip_var, layers.elementwise_max(clip_var, group_norm)
+            )
+            self.context[self.group_name] = group_scale
+        scale_var = self.context[self.group_name]
+        new_grad = layers.elementwise_mul(grad, scale_var)
+        return param, new_grad
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    program = program or framework.default_main_program()
+    if param_list is None:
+        param_list = program.global_block().all_parameters()
+    param_list = [
+        program.global_block().var(p) if isinstance(p, str) else p
+        for p in param_list
+    ]
+    for param in param_list:
+        param.gradient_clip_attr = copy.deepcopy(clip)
+
+
+def error_clip_callback(block, context):
+    pass
+
+
+def append_gradient_clip_ops(param_grad):
+    context = {}
+    for p, g in param_grad:
+        if g is None:
+            continue
+        clip_attr = getattr(p, "gradient_clip_attr", None)
+        if clip_attr is None:
+            clip_attr = NullGradientClipAttr()
+        with p.block.program._optimized_guard([p, g]):
+            clip_attr._process_context(context=context, param=p, grad=g)
+
+    res = []
+    for p, g in param_grad:
+        if g is None:
+            res.append((p, g))
+            continue
+        clip_attr = getattr(p, "gradient_clip_attr", None)
+        if clip_attr is None:
+            clip_attr = NullGradientClipAttr()
+        with p.block.program._optimized_guard([p, g]):
+            res.append(clip_attr._create_operators(param=p, grad=g))
+    return res

@@ -1,0 +1,10 @@
+"""Engine core: the role played by ``paddle/fluid/pybind`` + C++ framework in
+the reference (paddle/fluid/framework/), rebuilt on JAX/XLA.
+
+Submodules:
+  - types: Place / VarType / dtype mapping
+  - scope: hierarchical name->Variable symbol table (scope.h:41 parity)
+  - op_registry: operator schema + JAX lowering registry (op_registry.h parity)
+  - lowering: block -> JAX function tracer (the Executor's compiler)
+  - lod: host-side LoDTensor (lod_tensor.h:110 parity)
+"""

@@ -1,0 +1,103 @@
+"""Host-side LoDTensor: ndarray + Level-of-Detail ragged-sequence index.
+
+Reference parity: ``paddle/fluid/framework/lod_tensor.h:110``. LoD is a list
+of offset vectors describing nested variable-length sequences, e.g.
+``[[0, 2, 5]]`` = two sequences of lengths 2 and 3 packed along axis 0.
+
+TPU-first stance: XLA needs static shapes, so the *device* representation
+of ragged data is dense padded + lengths/segment-ids (see layers/sequence
+lowerings); LoDTensor remains the host API so Fluid-style feeding of
+variable-length data keeps working, with conversion at the feed boundary.
+"""
+
+import numpy as np
+
+
+class LoDTensor(object):
+    def __init__(self, array=None, lod=None):
+        self._array = None if array is None else np.asarray(array)
+        self._lod = [list(level) for level in (lod or [])]
+
+    # -- reference API surface (pybind.cc Tensor/LoDTensor bindings) --------
+    def set(self, array, place=None):
+        self._array = np.asarray(array)
+
+    def set_lod(self, lod):
+        self._lod = [list(level) for level in lod]
+
+    def lod(self):
+        return [list(level) for level in self._lod]
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._lod:
+            return True
+        prev_len = None
+        for level in self._lod:
+            if len(level) < 2 or level[0] != 0:
+                return False
+            if any(b > a for a, b in zip(level[1:], level[:-1])):
+                return False
+            if prev_len is not None and level[-1] != prev_len:
+                pass  # nested levels index into the next level's entries
+            prev_len = len(level) - 1
+        return self._lod[-1][-1] == (0 if self._array is None else self._array.shape[0])
+
+    def recursive_sequence_lengths(self):
+        return [
+            [b - a for a, b in zip(level[:-1], level[1:])] for level in self._lod
+        ]
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lod = [list(np.cumsum([0] + list(level))) for level in lengths]
+
+    def shape(self):
+        return () if self._array is None else tuple(self._array.shape)
+
+    def numpy(self):
+        return self._array
+
+    def __array__(self, dtype=None):
+        a = self._array
+        return a if dtype is None else a.astype(dtype)
+
+    # -- ragged <-> dense conversion (device boundary) ----------------------
+    def to_padded(self, pad_value=0.0, max_len=None):
+        """Innermost-level split -> (padded [num_seq, max_len, ...], lengths)."""
+        if not self._lod:
+            raise ValueError("tensor has no LoD")
+        offsets = self._lod[-1]
+        lengths = np.array(
+            [b - a for a, b in zip(offsets[:-1], offsets[1:])], dtype=np.int32
+        )
+        ml = int(max_len or (lengths.max() if len(lengths) else 0))
+        trailing = self._array.shape[1:]
+        out = np.full((len(lengths), ml) + trailing, pad_value, self._array.dtype)
+        for i, (a, b) in enumerate(zip(offsets[:-1], offsets[1:])):
+            n = min(b - a, ml)
+            out[i, :n] = self._array[a : a + n]
+        return out, lengths
+
+    @staticmethod
+    def from_padded(padded, lengths):
+        padded = np.asarray(padded)
+        lengths = np.asarray(lengths).astype(np.int64)
+        pieces = [padded[i, : int(n)] for i, n in enumerate(lengths)]
+        flat = (
+            np.concatenate(pieces, axis=0)
+            if pieces
+            else np.zeros((0,) + padded.shape[2:], padded.dtype)
+        )
+        return LoDTensor(flat, [list(np.cumsum([0] + list(lengths)))])
+
+    def __repr__(self):
+        return "LoDTensor(shape=%s, lod=%s)" % (self.shape(), self._lod)
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """fluid.create_lod_tensor parity (python/paddle/fluid/lod_tensor.py)."""
+    if isinstance(data, LoDTensor):
+        t = LoDTensor(data.numpy())
+    else:
+        t = LoDTensor(np.asarray(data))
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    return t

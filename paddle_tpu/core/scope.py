@@ -1,0 +1,77 @@
+"""Hierarchical Scope: name -> runtime value symbol table.
+
+Reference parity: ``paddle/fluid/framework/scope.h:41`` and
+``variable.h:26``. A Variable here is a thin type-erased holder whose value
+is a ``jax.Array`` (device tensor), a host ``LoDTensor``, or any Python
+object (rank tables, reader state...). Child scopes serve RNN iterations and
+per-device local scopes in the ParallelExecutor.
+"""
+
+
+class ScopeVariable(object):
+    __slots__ = ("name", "value", "lod")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = None
+        self.lod = None  # optional LoD metadata attached to a device array
+
+    def get_tensor(self):
+        return self.value
+
+    def set(self, value, lod=None):
+        self.value = value
+        if lod is not None:
+            self.lod = lod
+
+
+class Scope(object):
+    def __init__(self, parent=None):
+        self._vars = {}
+        self._parent = parent
+        self._kids = []
+
+    # -- scope.h API surface ------------------------------------------------
+    def var(self, name):
+        """Find-or-create in this scope (Scope::Var)."""
+        v = self._vars.get(name)
+        if v is None:
+            v = ScopeVariable(name)
+            self._vars[name] = v
+        return v
+
+    def find_var(self, name):
+        """Search this scope then ancestors (Scope::FindVar)."""
+        scope = self
+        while scope is not None:
+            v = scope._vars.get(name)
+            if v is not None:
+                return v
+            scope = scope._parent
+        return None
+
+    def erase(self, names):
+        for n in names:
+            self._vars.pop(n, None)
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    # -- convenience --------------------------------------------------------
+    def set_value(self, name, value, lod=None):
+        self.var(name).set(value, lod=lod)
+
+    def get_value(self, name):
+        v = self.find_var(name)
+        return None if v is None else v.value
+
+    def has(self, name):
+        return self.find_var(name) is not None

@@ -1,0 +1,125 @@
+"""Device places and variable types.
+
+Reference parity: ``paddle/fluid/platform/place.h:25,36,51`` (Place variant)
+and ``paddle/fluid/framework/framework.proto:105`` (VarType). On TPU the
+device runtime is owned by JAX/PJRT, so a Place resolves to a ``jax.Device``
+instead of carrying CUDA stream state.
+"""
+
+import numpy as np
+
+
+class Place(object):
+    """Base device tag. Resolves lazily to a jax.Device."""
+
+    _kind = None  # platform preference, e.g. "tpu" / "cpu"
+
+    def __init__(self, device_id=0):
+        self.device_id = int(device_id)
+
+    def jax_device(self):
+        import jax
+
+        devices = jax.devices()
+        if self._kind is not None:
+            matching = [d for d in devices if self._kind in d.platform.lower()]
+            if matching:
+                devices = matching
+        return devices[self.device_id % len(devices)]
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+
+class TPUPlace(Place):
+    """The TPU device tag — the ``CUDAPlace`` analog (place.h:36). Falls back
+    to the default JAX backend when no TPU platform is present (e.g. unit
+    tests on the virtual CPU mesh)."""
+
+    _kind = "tpu"
+
+    def jax_device(self):
+        import jax
+
+        devices = jax.devices()
+        non_cpu = [d for d in devices if d.platform.lower() != "cpu"]
+        pool = non_cpu if non_cpu else devices
+        return pool[self.device_id % len(pool)]
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+
+class VarType(object):
+    """Variable type tags (framework.proto:105 VarType.Type)."""
+
+    LOD_TENSOR = "lod_tensor"
+    SELECTED_ROWS = "selected_rows"
+    STEP_SCOPES = "step_scopes"
+    LOD_RANK_TABLE = "lod_rank_table"
+    LOD_TENSOR_ARRAY = "lod_tensor_array"
+    READER = "reader"
+    RAW = "raw"
+    # scalar data types live on Variable.dtype as canonical numpy names
+
+
+_DTYPE_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "bf16": "bfloat16",
+    "int": "int32",
+    "long": "int64",
+    "bool_": "bool",
+}
+
+_SUPPORTED = (
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "int8",
+    "uint8",
+    "int16",
+    "int32",
+    "int64",
+    "bool",
+)
+
+
+def canonical_dtype(dtype):
+    """Normalize any dtype spec (str/np.dtype/jnp dtype) to a canonical name."""
+    if dtype is None:
+        return "float32"
+    if hasattr(dtype, "name"):
+        name = dtype.name
+    else:
+        name = str(dtype)
+    name = _DTYPE_ALIASES.get(name, name)
+    if name not in _SUPPORTED:
+        raise ValueError("unsupported dtype %r" % (dtype,))
+    return name
+
+
+def np_dtype(dtype):
+    name = canonical_dtype(dtype)
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def is_float_dtype(dtype):
+    return canonical_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+def core_version():
+    return "paddle_tpu-core-0.1"

@@ -1,0 +1,97 @@
+"""DataFeeder: convert minibatch sample lists -> feed dict of arrays.
+
+Reference parity: python/paddle/fluid/data_feeder.py — converts python/numpy
+minibatch data into LoDTensors per feed var; here, lod_level>0 slots become
+dense padded arrays + the LoD kept on a host LoDTensor (converted at the
+executor feed boundary; SURVEY.md §5.7 bucketing note).
+"""
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.core.lod import LoDTensor
+from paddle_tpu.core.types import np_dtype
+
+
+class DataToLoDTensorConverter(object):
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = shape
+        self.dtype = np_dtype(dtype)
+        self.data = []
+        self.lod = [[] for _ in range(lod_level)]
+
+    def feed(self, data):
+        self._feed_impl_(data, self.lod, self.lod_level)
+
+    def _feed_impl_(self, data, lod, lod_level):
+        if lod_level == 0:
+            self.data.append(data)
+        else:
+            lod[0].append(len(data))
+            for each_data in data:
+                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+
+    def done(self):
+        if self.lod_level == 0:
+            arr = np.array(self.data, dtype=self.dtype)
+            # Reshape samples to the declared per-sample shape when static.
+            sample_shape = [int(d) for d in self.shape[1:]] if self.shape else []
+            if sample_shape and all(d >= 0 for d in sample_shape):
+                arr = arr.reshape([len(self.data)] + sample_shape)
+            return LoDTensor(arr)
+        flat = [np.asarray(x, dtype=self.dtype) for x in self.data]
+        arr = (
+            np.concatenate([f.reshape(-1, *f.shape[1:]) if f.ndim else f.reshape(1)
+                            for f in flat])
+            if flat
+            else np.zeros((0,), self.dtype)
+        )
+        # build offsets from recursive lengths
+        t = LoDTensor(arr)
+        t.set_recursive_sequence_lengths(self.lod)
+        return t
+
+
+class DataFeeder(object):
+    def __init__(self, feed_list, place, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        program = program or framework.default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, framework.Variable):
+                raise TypeError("feed_list should contain Variables or names")
+            self.feed_dtypes.append(each_var.dtype)
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            shape = each_var.shape or ()
+            self.feed_shapes.append([d for d in shape if d >= 0] and list(shape))
+        self.place = place
+
+    def feed(self, iterable):
+        converters = [
+            DataToLoDTensorConverter(
+                self.place,
+                lod_level=self.feed_lod_level[i],
+                shape=self.feed_shapes[i],
+                dtype=self.feed_dtypes[i],
+            )
+            for i in range(len(self.feed_names))
+        ]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                "sample has %d slots, feeder expects %d"
+                % (len(each_sample), len(converters))
+            )
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        ret_dict = {}
+        for each_name, each_converter in zip(self.feed_names, converters):
+            t = each_converter.done()
+            ret_dict[each_name] = t if t.lod() else t.numpy()
+        return ret_dict

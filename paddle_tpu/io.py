@@ -1,0 +1,191 @@
+"""Model persistence: save/load vars, params, persistables, inference model.
+
+Reference parity: python/paddle/fluid/io.py (save/load_vars :107, params
+:204, persistables :252, save_inference_model :544, load_inference_model
+:669). Storage format: one .npy per var (or a combined .npz) + a pickled
+program for inference models; sharded-checkpoint of GSPMD-sharded vars goes
+through the same path (arrays gathered host-side).
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.framework import Parameter, Program, Variable
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+    "get_inference_program",
+]
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _scope_of(executor, scope):
+    from paddle_tpu.executor import global_scope
+
+    return scope or global_scope()
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None, scope=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate(v)]
+    scope = _scope_of(executor, scope)
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        bundle = {}
+        for v in vars:
+            val = scope.get_value(v.name)
+            if val is not None:
+                bundle[v.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, filename), **bundle)
+        return
+    for v in vars:
+        val = scope.get_value(v.name)
+        if val is None:
+            continue
+        np.save(os.path.join(dirname, v.name.replace("/", "__")), np.asarray(val))
+
+
+def save_params(executor, dirname, main_program=None, filename=None, scope=None):
+    return save_vars(
+        executor, dirname, main_program, predicate=is_parameter,
+        filename=filename, scope=scope,
+    )
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return save_vars(
+        executor, dirname, main_program, predicate=is_persistable,
+        filename=filename, scope=scope,
+    )
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None, scope=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate(v)]
+    scope = _scope_of(executor, scope)
+    if filename is not None:
+        bundle = np.load(os.path.join(dirname, filename), allow_pickle=False)
+        for v in vars:
+            if v.name in bundle:
+                scope.set_value(v.name, bundle[v.name])
+        return
+    for v in vars:
+        path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
+        if os.path.exists(path):
+            scope.set_value(v.name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None, filename=None, scope=None):
+    return load_vars(
+        executor, dirname, main_program, predicate=is_parameter,
+        filename=filename, scope=scope,
+    )
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return load_vars(
+        executor, dirname, main_program, predicate=is_persistable,
+        filename=filename, scope=scope,
+    )
+
+
+def prune_program(program, feed_names, fetch_names):
+    """Backward slice from fetches (framework/prune.cc capability)."""
+    pruned = program.clone()
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        out_names = set(op.output_arg_names())
+        if out_names & needed:
+            keep.append(op)
+            for n in op.input_arg_names():
+                needed.add(n)
+    keep.reverse()
+    block.ops = keep
+    return pruned
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names,
+    target_vars,
+    executor,
+    main_program=None,
+    model_filename=None,
+    params_filename=None,
+    scope=None,
+):
+    """Prune to the inference slice + serialize program + params
+    (io.py:544 parity; storage = pickled program IR)."""
+    main_program = main_program or framework.default_main_program()
+    target_names = [
+        v.name if isinstance(v, Variable) else str(v) for v in target_vars
+    ]
+    inference_program = main_program.clone(for_test=True)
+    inference_program = prune_program(
+        inference_program, feeded_var_names, target_names
+    )
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "program": inference_program,
+        "feed_names": list(feeded_var_names),
+        "fetch_names": target_names,
+    }
+    with open(os.path.join(dirname, model_filename or "__model__"), "wb") as f:
+        pickle.dump(meta, f)
+    save_persistables(
+        executor, dirname, inference_program, filename=params_filename,
+        scope=scope,
+    )
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, scope=None):
+    with open(os.path.join(dirname, model_filename or "__model__"), "rb") as f:
+        meta = pickle.load(f)
+    program = meta["program"]
+    load_persistables(
+        executor, dirname, program, filename=params_filename, scope=scope
+    )
+    fetch_vars = [
+        program.global_block()._find_var_recursive(n)
+        for n in meta["fetch_names"]
+    ]
+    return program, meta["feed_names"], fetch_vars
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or framework.default_main_program()
+    program = main_program.clone(for_test=True)
+    targets = [
+        v.name if isinstance(v, Variable) else str(v) for v in target_vars
+    ]
+    data_names = [
+        v.name for v in program.list_vars() if getattr(v, "is_data", False)
+    ]
+    return prune_program(program, data_names, targets)

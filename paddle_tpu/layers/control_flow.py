@@ -1,0 +1,108 @@
+"""Control-flow layers (layers/control_flow.py parity — 1987 LoC in ref).
+
+First wave: comparison layers, increment, array ops. While/StaticRNN/
+DynamicRNN arrive with the sequence wave (lowered to lax.scan /
+lax.while_loop via sub-blocks).
+"""
+
+from paddle_tpu import framework
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "equal",
+    "not_equal",
+    "increment",
+    "is_empty",
+    "array_write",
+    "array_read",
+    "array_length",
+    "create_array",
+]
+
+
+def _compare(op_type):
+    def fn(x, y, cond=None, **kwargs):
+        helper = LayerHelper(op_type)
+        if cond is None:
+            cond = helper.create_variable_for_type_inference("bool")
+        helper.append_op(
+            type=op_type,
+            inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [cond]},
+        )
+        return cond
+
+    fn.__name__ = op_type
+    return fn
+
+
+less_than = _compare("less_than")
+less_equal = _compare("less_equal")
+greater_than = _compare("greater_than")
+greater_equal = _compare("greater_equal")
+equal = _compare("equal")
+not_equal = _compare("not_equal")
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="increment",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"step": float(value)},
+    )
+    return out
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool",
+                                                         stop_gradient=True)
+    helper.append_op(type="is_empty", inputs={"X": [x]}, outputs={"Out": [cond]})
+    return cond
+
+
+# -- LoDTensorArray facade (host-managed; scan-based RNNs do not need it, it
+#    exists for API parity with array_read/array_write user code) -----------
+
+
+def create_array(dtype):
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.types import VarType
+
+    helper = LayerHelper("array")
+    return helper.block.create_var(
+        name=unique_name.generate("array"),
+        type=VarType.LOD_TENSOR_ARRAY,
+        dtype=dtype,
+        shape=None,
+    )
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError(
+        "tensor-array ops land with the DynamicRNN/scan wave; use "
+        "layers.StaticRNN or the dense sequence layers instead"
+    )
+
+
+def array_read(array, i):
+    raise NotImplementedError(
+        "tensor-array ops land with the DynamicRNN/scan wave"
+    )
+
+
+def array_length(array):
+    raise NotImplementedError(
+        "tensor-array ops land with the DynamicRNN/scan wave"
+    )

@@ -1,0 +1,138 @@
+"""Data layers + in-graph reader pipeline front-end.
+
+Reference parity: python/paddle/fluid/layers/io.py (data(), py_reader,
+double_buffer...). The TPU pipeline: py_reader exposes a host-side
+blocking queue (paddle_tpu/reader/queue.py) that the executor drains and
+feeds; device-side double-buffering is the executor's async dispatch (XLA
+runs ahead while the host prepares the next batch), so decorators are
+capability-preserving wrappers instead of graph reader ops.
+"""
+
+from paddle_tpu import framework
+from paddle_tpu.core.types import VarType
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = ["data", "py_reader", "double_buffer", "read_file", "batch", "shuffle"]
+
+
+def data(name, shape, dtype="float32", lod_level=0, type=VarType.LOD_TENSOR,
+         append_batch_size=True, stop_gradient=True):
+    """Declare an input variable (layers/io.py data parity). With
+    append_batch_size, a leading -1 batch dim is added as in Fluid."""
+    helper = LayerHelper("data", name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.block.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        type=type,
+        stop_gradient=stop_gradient,
+        is_data=True,
+    )
+
+
+class PyReader(object):
+    """Host queue + feed-var bundle returned by py_reader."""
+
+    def __init__(self, feed_vars, capacity, use_double_buffer=True):
+        from paddle_tpu.reader.queue import BlockingQueue
+
+        self.feed_vars = feed_vars
+        self.queue = BlockingQueue(capacity)
+        self._decorated = None
+        self._thread = None
+        self.use_double_buffer = use_double_buffer
+
+    def decorate_paddle_reader(self, reader):
+        self._decorated = reader
+
+    decorate_sample_list_generator = decorate_paddle_reader
+    decorate_batch_generator = decorate_paddle_reader
+
+    def decorate_tensor_provider(self, reader):
+        self._decorated = reader
+
+    def start(self):
+        import threading
+
+        if self._decorated is None:
+            raise RuntimeError("no reader decorated onto py_reader")
+        self.queue.reopen()
+
+        def _worker():
+            try:
+                for item in self._decorated():
+                    if not self.queue.push(item):
+                        return
+                self.queue.close()
+            except Exception:
+                self.queue.close()
+                raise
+
+        self._thread = threading.Thread(target=_worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self.queue.kill()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def next_feed(self):
+        """Pop one batch -> feed dict; raises EOFException at end."""
+        item = self.queue.pop()
+        if item is None:
+            from paddle_tpu.reader.queue import EOFException
+
+            raise EOFException()
+        if isinstance(item, dict):
+            return item
+        return {v.name: arr for v, arr in zip(self.feed_vars, item)}
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Create feed vars + a host blocking-queue reader
+    (create_py_reader_op.cc + LoDTensorBlockingQueue capability)."""
+    from paddle_tpu import unique_name
+
+    lod_levels = lod_levels or [0] * len(shapes)
+    feed_vars = []
+    for i, (shape, dtype, lod) in enumerate(zip(shapes, dtypes, lod_levels)):
+        feed_vars.append(
+            data(
+                name=unique_name.generate((name or "py_reader") + "_slot%d" % i),
+                shape=list(shape)[1:],
+                dtype=dtype,
+                lod_level=lod,
+                append_batch_size=True,
+            )
+        )
+    return PyReader(feed_vars, capacity, use_double_buffer)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Device prefetch decorator: on TPU the executor overlaps host feed
+    with device compute via async dispatch; kept for API parity."""
+    return reader
+
+
+def read_file(reader):
+    if isinstance(reader, PyReader):
+        return reader.feed_vars
+    return reader
+
+
+def batch(reader, batch_size):
+    from paddle_tpu.reader import decorator
+
+    return decorator.batch(reader, batch_size)
+
+
+def shuffle(reader, buffer_size):
+    from paddle_tpu.reader import decorator
+
+    return decorator.shuffle(reader, buffer_size)

@@ -1,0 +1,152 @@
+"""LR schedules as in-graph ops over a global step counter.
+
+Reference parity: python/paddle/fluid/layers/learning_rate_scheduler.py
+(noam/exponential/natural_exp/inverse_time/polynomial/piecewise/cosine).
+The schedule is computed from a persistable @LR_DECAY_COUNTER@ var
+incremented each step — all inside the compiled step program.
+"""
+
+import math
+
+from paddle_tpu import framework
+from paddle_tpu import initializer as init_mod
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.layers import tensor, ops
+from paddle_tpu.layers import nn
+
+__all__ = [
+    "exponential_decay",
+    "natural_exp_decay",
+    "inverse_time_decay",
+    "polynomial_decay",
+    "piecewise_decay",
+    "noam_decay",
+    "cosine_decay",
+    "append_LARS",
+]
+
+_DECAY_COUNTER = "@LR_DECAY_COUNTER@"
+
+
+def _global_step_counter(counter_name=None, begin=0, step=1):
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or _DECAY_COUNTER
+    counter = helper.create_global_variable(
+        name=name, shape=[1], dtype="float32", persistable=True,
+        initializer=init_mod.ConstantInitializer(float(begin - step)),
+    )
+    helper.main_program.global_block().append_op(
+        type="increment",
+        inputs={"X": [counter.name]},
+        outputs={"Out": [counter.name]},
+        attrs={"step": float(step), framework.OP_ROLE_ATTR_NAME:
+               framework.OpRole.LRSched},
+    )
+    return counter
+
+
+def noam_decay(d_model, warmup_steps):
+    with framework.default_main_program()._lr_schedule_guard():
+        step = _global_step_counter()
+        a = step ** -0.5
+        b = step * (warmup_steps ** -1.5)
+        lr = (d_model ** -0.5) * nn.elementwise_min(a, b)
+        return lr
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    with framework.default_main_program()._lr_schedule_guard():
+        step = _global_step_counter()
+        div = step / float(decay_steps)
+        if staircase:
+            div = ops.floor(div)
+        return learning_rate * (decay_rate ** div)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    with framework.default_main_program()._lr_schedule_guard():
+        step = _global_step_counter()
+        div = step / float(decay_steps)
+        if staircase:
+            div = ops.floor(div)
+        return learning_rate * ops.exp(-1.0 * decay_rate * div)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    with framework.default_main_program()._lr_schedule_guard():
+        step = _global_step_counter()
+        div = step / float(decay_steps)
+        if staircase:
+            div = ops.floor(div)
+        denom = div * decay_rate + 1.0
+        return nn.elementwise_div(
+            tensor.fill_constant([1], "float32", learning_rate), denom
+        )
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    with framework.default_main_program()._lr_schedule_guard():
+        step = _global_step_counter()
+        if cycle:
+            div_res = ops.ceil(step / float(decay_steps))
+            ones = tensor.fill_constant([1], "float32", 1.0)
+            div_res = nn.elementwise_max(div_res, ones)
+            decay_steps_var = div_res * float(decay_steps)
+            frac = step / decay_steps_var
+        else:
+            capped = nn.elementwise_min(
+                step, tensor.fill_constant([1], "float32", float(decay_steps))
+            )
+            frac = capped * (1.0 / float(decay_steps))
+        # (1 - frac)^power
+        base = nn.elementwise_sub(
+            tensor.fill_constant([1], "float32", 1.0), frac
+        )
+        powed = nn.elementwise_pow(
+            base, tensor.fill_constant([1], "float32", power)
+        )
+        return powed * (learning_rate - end_learning_rate) + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    """Piecewise constant: computed with nested where via compare ops."""
+    assert len(boundaries) + 1 == len(values)
+    with framework.default_main_program()._lr_schedule_guard():
+        step = _global_step_counter()
+        lr = tensor.fill_constant([1], "float32", values[-1])
+        # Build from the last interval backwards: where(step < b_i, v_i, lr)
+        for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+            from paddle_tpu.layers.control_flow import less_than
+
+            cond = less_than(step, tensor.fill_constant([1], "float32", float(b)))
+            v_var = tensor.fill_constant([1], "float32", v)
+            lr = _where(cond, v_var, lr)
+        return lr
+
+
+def _where(cond, a, b):
+    from paddle_tpu.layers.nn import elementwise_add, elementwise_mul, elementwise_sub
+
+    cond_f = tensor.cast(cond, a.dtype)
+    one = tensor.fill_constant([1], a.dtype, 1.0)
+    return elementwise_add(
+        elementwise_mul(a, cond_f), elementwise_mul(b, elementwise_sub(one, cond_f))
+    )
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    with framework.default_main_program()._lr_schedule_guard():
+        step = _global_step_counter()
+        epoch = ops.floor(step / float(step_each_epoch))
+        return (
+            learning_rate
+            * (ops.cos(epoch * (math.pi / float(epochs))) + 1.0)
+            / 2.0
+        )
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    raise NotImplementedError(
+        "use optimizer.LarsMomentumOptimizer (lars_momentum op) instead"
+    )

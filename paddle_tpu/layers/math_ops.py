@@ -1,0 +1,40 @@
+"""Helpers shared by layers + Variable operator sugar."""
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.layer_helper import LayerHelper
+
+
+def to_variable_like(value, ref):
+    """Wrap a python scalar/ndarray as a fill_constant/assign_value var."""
+    from paddle_tpu.layers import tensor as tensor_layers
+
+    if isinstance(value, framework.Variable):
+        return value
+    arr = np.asarray(value)
+    if arr.ndim == 0:
+        return tensor_layers.fill_constant(
+            shape=[1], dtype=ref.dtype, value=float(arr)
+        )
+    return tensor_layers.assign_numpy(arr.astype(ref.dtype))
+
+
+def elementwise_binary(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, act=act, name=name)
+    y = to_variable_like(y, x)
+    x = to_variable_like(x, y)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type=op_type,
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"axis": axis},
+    )
+    return helper.append_activation(out)
+
+
+def elementwise_binary_reversed(op_type, var, other, axis=-1):
+    """other <op> var, for __rsub__/__rtruediv__/__rpow__."""
+    other = to_variable_like(other, var)
+    return elementwise_binary(op_type, other, var, axis=axis)

@@ -1,0 +1,64 @@
+"""Metric layers: accuracy, auc (layers/metric_op.py parity)."""
+
+from paddle_tpu import initializer as init_mod
+from paddle_tpu import unique_name
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from paddle_tpu.layers.nn import topk
+
+    helper = LayerHelper("accuracy")
+    topk_out, topk_indices = topk(input, k=k)
+    acc_out = helper.create_variable_for_type_inference("float32",
+                                                        stop_gradient=True)
+    if correct is None:
+        correct = helper.create_variable_for_type_inference("int32",
+                                                            stop_gradient=True)
+    if total is None:
+        total = helper.create_variable_for_type_inference("int32",
+                                                          stop_gradient=True)
+    helper.append_op(
+        type="accuracy",
+        inputs={"Out": [topk_out], "Indices": [topk_indices], "Label": [label]},
+        outputs={"Accuracy": [acc_out], "Correct": [correct], "Total": [total]},
+    )
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1, slide_steps=1):
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_global_variable(
+        name=unique_name.generate("auc.stat_pos"),
+        shape=[num_thresholds],
+        dtype="int64",
+        persistable=True,
+        initializer=init_mod.ConstantInitializer(0),
+    )
+    stat_neg = helper.create_global_variable(
+        name=unique_name.generate("auc.stat_neg"),
+        shape=[num_thresholds],
+        dtype="int64",
+        persistable=True,
+        initializer=init_mod.ConstantInitializer(0),
+    )
+    auc_out = helper.create_variable_for_type_inference("float32",
+                                                        stop_gradient=True)
+    helper.append_op(
+        type="auc",
+        inputs={
+            "Predict": [input],
+            "Label": [label],
+            "StatPos": [stat_pos],
+            "StatNeg": [stat_neg],
+        },
+        outputs={
+            "AUC": [auc_out],
+            "StatPosOut": [stat_pos],
+            "StatNegOut": [stat_neg],
+        },
+        attrs={"curve": curve, "num_thresholds": num_thresholds},
+    )
+    return auc_out, [stat_pos, stat_neg]

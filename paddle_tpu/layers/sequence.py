@@ -1,0 +1,70 @@
+"""Sequence layers over the dense [batch, max_len, ...] + length repr.
+
+Reference parity: the sequence_* layer family in layers/nn.py (LoD-based in
+the reference; masked-dense on TPU, per SURVEY.md §5.7).
+"""
+
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_pool",
+    "sequence_softmax",
+    "sequence_reverse",
+    "sequence_mask",
+    "sequence_first_step",
+    "sequence_last_step",
+]
+
+
+def _seq_op(op_type, x, length, out_slot, attrs=None, extra_outputs=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    if length is not None:
+        inputs["Length"] = [length]
+    outputs = {out_slot: [out]}
+    for slot in extra_outputs or []:
+        outputs[slot] = [
+            helper.create_variable_for_type_inference("int32", stop_gradient=True)
+        ]
+    helper.append_op(type=op_type, inputs=inputs, outputs=outputs, attrs=attrs or {})
+    return out
+
+
+def sequence_pool(input, pool_type, length=None):
+    return _seq_op(
+        "sequence_pool",
+        input,
+        length,
+        "Out",
+        attrs={"pooltype": pool_type.upper()},
+        extra_outputs=["MaxIndex"],
+    )
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_softmax(input, length=None, use_cudnn=False, name=None):
+    return _seq_op("sequence_softmax", input, length, "Out")
+
+
+def sequence_reverse(x, length=None, name=None):
+    return _seq_op("sequence_reverse", x, length, "Y")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(
+        type="sequence_mask",
+        inputs={"X": [x]},
+        outputs={"Y": [out]},
+        attrs={"maxlen": maxlen if maxlen is not None else -1, "out_dtype": dtype},
+    )
+    return out

@@ -1,0 +1,20 @@
+"""Operator definitions: schema + XLA lowering per op family.
+
+Reference parity: ``paddle/fluid/operators/`` (~748 files). Importing this
+package registers every op with the registry; the kernel body of each op is
+a JAX/XLA lowering (and Pallas for hand-tuned hot paths) instead of
+CPU/CUDA kernels.
+"""
+
+from paddle_tpu.ops import math_ops  # noqa: F401
+from paddle_tpu.ops import tensor_ops  # noqa: F401
+from paddle_tpu.ops import activation_ops  # noqa: F401
+from paddle_tpu.ops import random_ops  # noqa: F401
+from paddle_tpu.ops import loss_ops  # noqa: F401
+from paddle_tpu.ops import nn_ops  # noqa: F401
+from paddle_tpu.ops import optimizer_ops  # noqa: F401
+from paddle_tpu.ops import control_flow_ops  # noqa: F401
+from paddle_tpu.ops import sequence_ops  # noqa: F401
+from paddle_tpu.ops import metric_ops  # noqa: F401
+from paddle_tpu.ops import io_ops  # noqa: F401
+from paddle_tpu.ops import detection_ops  # noqa: F401

@@ -1,0 +1,229 @@
+"""Activation family — ~20 ops from paddle/fluid/operators/activation_op.cc,
+plus softmax (softmax_op.cc). All map 1:1 onto XLA elementwise HLO, which
+fuses them into adjacent matmuls/convs (no hand kernels needed on TPU).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+
+def _unary(fn, **extra_attrs):
+    def lower(ctx, ins, attrs):
+        return fn(ins["X"][0], attrs) if extra_attrs else fn(ins["X"][0])
+
+    return lower
+
+
+_SIMPLE = {
+    "sigmoid": jax.nn.sigmoid,
+    "logsigmoid": jax.nn.log_sigmoid,
+    "exp": jnp.exp,
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "tanh_shrink": lambda x: x - jnp.tanh(x),
+    "sqrt": jnp.sqrt,
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "cos": jnp.cos,
+    "sin": jnp.sin,
+    "round": jnp.round,
+    "reciprocal": jnp.reciprocal,
+    "log": jnp.log,
+    "square": jnp.square,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "sign": jnp.sign,
+    "gelu": jax.nn.gelu,
+}
+
+for _name, _fn in _SIMPLE.items():
+    register_op(
+        _name,
+        inputs=["X"],
+        outputs=["Out"],
+        lower=_unary(_fn),
+        grad=None if _name in ("ceil", "floor", "round", "sign") else "auto",
+    )
+
+register_op(
+    "relu6",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"threshold": 6.0},
+    lower=lambda ctx, ins, attrs: jnp.clip(
+        ins["X"][0], 0.0, attrs.get("threshold", 6.0)
+    ),
+)
+
+register_op(
+    "leaky_relu",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"alpha": 0.02},
+    lower=lambda ctx, ins, attrs: jax.nn.leaky_relu(
+        ins["X"][0], attrs.get("alpha", 0.02)
+    ),
+)
+
+register_op(
+    "elu",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"alpha": 1.0},
+    lower=lambda ctx, ins, attrs: jax.nn.elu(ins["X"][0], attrs.get("alpha", 1.0)),
+)
+
+register_op(
+    "pow",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"factor": 1.0},
+    lower=lambda ctx, ins, attrs: jnp.power(
+        ins["X"][0], jnp.asarray(attrs.get("factor", 1.0), ins["X"][0].dtype)
+    ),
+)
+
+register_op(
+    "stanh",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"scale_a": 2.0 / 3.0, "scale_b": 1.7159},
+    lower=lambda ctx, ins, attrs: attrs.get("scale_b", 1.7159)
+    * jnp.tanh(ins["X"][0] * attrs.get("scale_a", 2.0 / 3.0)),
+)
+
+register_op(
+    "hard_sigmoid",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"slope": 0.2, "offset": 0.5},
+    lower=lambda ctx, ins, attrs: jnp.clip(
+        ins["X"][0] * attrs.get("slope", 0.2) + attrs.get("offset", 0.5), 0.0, 1.0
+    ),
+)
+
+register_op(
+    "thresholded_relu",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"threshold": 1.0},
+    lower=lambda ctx, ins, attrs: jnp.where(
+        ins["X"][0] > attrs.get("threshold", 1.0),
+        ins["X"][0],
+        jnp.zeros((), ins["X"][0].dtype),
+    ),
+)
+
+register_op(
+    "soft_relu",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"threshold": 40.0},
+    lower=lambda ctx, ins, attrs: jnp.log(
+        1.0 + jnp.exp(jnp.clip(ins["X"][0], -attrs["threshold"], attrs["threshold"]))
+    ),
+)
+
+register_op(
+    "brelu",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"t_min": 0.0, "t_max": 24.0},
+    lower=lambda ctx, ins, attrs: jnp.clip(
+        ins["X"][0], attrs.get("t_min", 0.0), attrs.get("t_max", 24.0)
+    ),
+)
+
+register_op(
+    "swish",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"beta": 1.0},
+    lower=lambda ctx, ins, attrs: ins["X"][0]
+    * jax.nn.sigmoid(attrs.get("beta", 1.0) * ins["X"][0]),
+)
+
+register_op(
+    "prelu",
+    inputs=["X", "Alpha"],
+    outputs=["Out"],
+    attrs={"mode": "all"},
+    lower=lambda ctx, ins, attrs: jnp.where(
+        ins["X"][0] >= 0,
+        ins["X"][0],
+        ins["X"][0] * jnp.reshape(ins["Alpha"][0], _prelu_shape(ins, attrs)),
+    ),
+)
+
+
+def _prelu_shape(ins, attrs):
+    x = ins["X"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "all":
+        return (1,) * jnp.ndim(x)
+    if mode == "channel":
+        return (1, -1) + (1,) * (jnp.ndim(x) - 2)
+    return jnp.shape(x)
+
+
+register_op(
+    "softmax",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={},
+    lower=lambda ctx, ins, attrs: jax.nn.softmax(ins["X"][0], axis=-1),
+)
+
+register_op(
+    "log_softmax",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"axis": -1},
+    lower=lambda ctx, ins, attrs: jax.nn.log_softmax(
+        ins["X"][0], axis=attrs.get("axis", -1)
+    ),
+)
+
+register_op(
+    "softshrink",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"lambda": 0.5},
+    lower=lambda ctx, ins, attrs: jnp.sign(ins["X"][0])
+    * jnp.maximum(jnp.abs(ins["X"][0]) - attrs.get("lambda", 0.5), 0.0),
+)
+
+register_op(
+    "hard_shrink",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"threshold": 0.5},
+    lower=lambda ctx, ins, attrs: jnp.where(
+        jnp.abs(ins["X"][0]) > attrs.get("threshold", 0.5),
+        ins["X"][0],
+        jnp.zeros((), ins["X"][0].dtype),
+    ),
+)
+
+register_op(
+    "rsqrt",
+    inputs=["X"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jax.lax.rsqrt(ins["X"][0]),
+)
+
+register_op(
+    "maxout",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"groups": 1},
+    lower=lambda ctx, ins, attrs: _maxout(ins["X"][0], attrs.get("groups", 1)),
+)
+
+
+def _maxout(x, groups):
+    n, c, h, w = jnp.shape(x)
+    return jnp.max(jnp.reshape(x, (n, c // groups, groups, h, w)), axis=2)

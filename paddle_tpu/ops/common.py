@@ -1,0 +1,44 @@
+"""Shared lowering helpers for op definitions."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.types import canonical_dtype
+
+
+def broadcast_y(x, y, axis):
+    """Paddle elementwise broadcasting: align y's dims to x starting at
+    ``axis`` (-1 = align trailing), then rely on XLA broadcasting.
+    Reference: paddle/fluid/operators/elementwise_op_function.h."""
+    xnd, ynd = jnp.ndim(x), jnp.ndim(y)
+    if xnd == ynd:
+        return y
+    if xnd > ynd:
+        ax = axis if axis >= 0 else xnd - ynd
+        shape = (1,) * ax + tuple(jnp.shape(y)) + (1,) * (xnd - ax - ynd)
+        return jnp.reshape(y, shape)
+    return y  # y has more dims; jnp broadcasting handles leading alignment
+
+
+def to_dtype(x, dtype):
+    return jnp.asarray(x, canonical_dtype(dtype))
+
+
+def reduce_axes(ndim, dim, reduce_all):
+    if reduce_all or dim is None:
+        return tuple(range(ndim))
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % ndim for d in dim)
+
+
+def flatten_to_2d(x, num_col_dims):
+    """Collapse leading num_col_dims dims into rows, rest into cols
+    (mul_op's x_num_col_dims semantics, paddle/fluid/operators/mul_op.cc)."""
+    shape = jnp.shape(x)
+    rows = 1
+    for d in shape[:num_col_dims]:
+        rows *= d
+    cols = 1
+    for d in shape[num_col_dims:]:
+        cols *= d
+    return jnp.reshape(x, (rows, cols))

@@ -1,0 +1,113 @@
+"""Comparison/logical ops and control-flow support ops.
+
+Reference parity: paddle/fluid/operators/{compare,logical,increment,
+conditional_block,while}_op.cc and array ops. The sub-block mega-ops
+(while/conditional_block) lower through the BlockLowerer into
+lax.while_loop / lax.cond — XLA-compilable control flow instead of nested
+host Executors with StepScopes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+for _name, _fn in [
+    ("less_than", jnp.less),
+    ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater),
+    ("greater_equal", jnp.greater_equal),
+    ("equal", jnp.equal),
+    ("not_equal", jnp.not_equal),
+]:
+    register_op(
+        _name,
+        inputs=["X", "Y"],
+        outputs=["Out"],
+        attrs={"axis": -1},
+        lower=(lambda f: lambda ctx, ins, attrs: f(ins["X"][0], ins["Y"][0]))(_fn),
+        grad=None,
+    )
+
+for _name, _fn in [
+    ("logical_and", jnp.logical_and),
+    ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    register_op(
+        _name,
+        inputs=["X", "Y"],
+        outputs=["Out"],
+        lower=(lambda f: lambda ctx, ins, attrs: f(ins["X"][0], ins["Y"][0]))(_fn),
+        grad=None,
+    )
+
+register_op(
+    "logical_not",
+    inputs=["X"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jnp.logical_not(ins["X"][0]),
+    grad=None,
+)
+
+register_op(
+    "increment",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"step": 1.0},
+    lower=lambda ctx, ins, attrs: ins["X"][0]
+    + jnp.asarray(attrs.get("step", 1.0), ins["X"][0].dtype),
+    grad=None,
+)
+
+register_op(
+    "is_empty",
+    inputs=["X"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jnp.reshape(
+        jnp.asarray(ins["X"][0].size == 0), (1,)
+    ),
+    grad=None,
+)
+
+
+def _lower_while(ctx, ins, attrs):
+    """while_op (while_op.cc:36): runs sub_block until Condition is false.
+
+    TPU-first lowering: the loop-carried state is every variable that the
+    sub-block writes AND that exists before the loop (plus the condition
+    var); the body is the sub-block lowered functionally. Requires
+    shape-invariant carries (XLA constraint) — Fluid programs that grow
+    tensor arrays per-iteration must use the scan-based DynamicRNN path.
+    """
+    raise NotImplementedError(
+        "while lowering is driven by the executor via sub-block capture; "
+        "see paddle_tpu/ops/subblock_ops.py"
+    )
+
+
+register_op(
+    "while",
+    inputs=["*X", "Condition"],
+    outputs=["*Out", "StepScopes"],
+    attrs={"sub_block": -1},
+    lower=_lower_while,
+    grad=None,
+)
+
+
+def _lower_conditional_block(ctx, ins, attrs):
+    raise NotImplementedError(
+        "conditional_block lowering is driven by the executor; "
+        "see paddle_tpu/ops/subblock_ops.py"
+    )
+
+
+register_op(
+    "conditional_block",
+    inputs=["*X", "Cond"],
+    outputs=["*Out", "Scope"],
+    attrs={"sub_block": -1, "is_scalar_condition": False},
+    lower=_lower_conditional_block,
+    grad=None,
+)

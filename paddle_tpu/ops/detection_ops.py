@@ -1,0 +1,151 @@
+"""Detection ops (SSD machinery): prior_box, box_coder, iou_similarity...
+
+Reference parity: paddle/fluid/operators/detection/ (~20 ops). First wave
+covers the SSD-loss building blocks; NMS-style data-dependent ops use
+fixed-size top-k formulations (XLA static shapes).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.op_registry import register_op
+
+
+def _lower_prior_box(ctx, ins, attrs):
+    feat, image = ins["Input"][0], ins["Image"][0]
+    min_sizes = attrs["min_sizes"]
+    max_sizes = attrs.get("max_sizes", [])
+    aspect_ratios = list(attrs.get("aspect_ratios", [1.0]))
+    flip = attrs.get("flip", False)
+    clip = attrs.get("clip", False)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    offset = attrs.get("offset", 0.5)
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = attrs.get("step_w", 0.0) or iw / fw
+    step_h = attrs.get("step_h", 0.0) or ih / fh
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    boxes = []
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            for k, ms in enumerate(min_sizes):
+                for ar in ars:
+                    bw = ms * np.sqrt(ar) / 2.0
+                    bh = ms / np.sqrt(ar) / 2.0
+                    boxes.append(
+                        [(cx - bw) / iw, (cy - bh) / ih, (cx + bw) / iw, (cy + bh) / ih]
+                    )
+                if k < len(max_sizes):
+                    s = np.sqrt(ms * max_sizes[k]) / 2.0
+                    boxes.append(
+                        [(cx - s) / iw, (cy - s) / ih, (cx + s) / iw, (cy + s) / ih]
+                    )
+    arr = np.asarray(boxes, np.float32)
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    num_priors = arr.shape[0] // (fh * fw)
+    out = jnp.asarray(arr.reshape(fh, fw, num_priors, 4))
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (fh, fw, num_priors, 4)
+    )
+    return {"Boxes": out, "Variances": var}
+
+
+register_op(
+    "prior_box",
+    inputs=["Input", "Image"],
+    outputs=["Boxes", "Variances"],
+    attrs={
+        "min_sizes": [],
+        "max_sizes": [],
+        "aspect_ratios": [1.0],
+        "variances": [0.1, 0.1, 0.2, 0.2],
+        "flip": False,
+        "clip": False,
+        "step_w": 0.0,
+        "step_h": 0.0,
+        "offset": 0.5,
+    },
+    lower=_lower_prior_box,
+    grad=None,
+)
+
+
+def _iou(a, b):
+    """a: [N,4], b: [M,4] -> [N,M] IoU (xmin,ymin,xmax,ymax)."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+register_op(
+    "iou_similarity",
+    inputs=["X", "Y"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: _iou(ins["X"][0], ins["Y"][0]),
+    grad=None,
+)
+
+
+def _lower_box_coder(ctx, ins, attrs):
+    prior = ins["PriorBox"][0]  # [M, 4]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if pvar is None:
+        pvar = jnp.ones((jnp.shape(prior)[0], 4), prior.dtype)
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        out = jnp.stack(
+            [
+                (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1],
+                jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+                / pvar[None, :, 2],
+                jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+                / pvar[None, :, 3],
+            ],
+            axis=-1,
+        )
+        return out
+    # decode: target [N, M, 4]
+    t = target
+    dcx = pvar[None, :, 0] * t[..., 0] * pw[None, :] + pcx[None, :]
+    dcy = pvar[None, :, 1] * t[..., 1] * ph[None, :] + pcy[None, :]
+    dw = jnp.exp(pvar[None, :, 2] * t[..., 2]) * pw[None, :]
+    dh = jnp.exp(pvar[None, :, 3] * t[..., 3]) * ph[None, :]
+    return jnp.stack(
+        [dcx - dw / 2, dcy - dh / 2, dcx + dw / 2, dcy + dh / 2], axis=-1
+    )
+
+
+register_op(
+    "box_coder",
+    inputs=["PriorBox", "PriorBoxVar", "TargetBox"],
+    outputs=["OutputBox"],
+    attrs={"code_type": "encode_center_size", "box_normalized": True},
+    lower=_lower_box_coder,
+    grad=None,
+)

@@ -1,0 +1,49 @@
+"""IO/persistence/debug ops: print, assign_value. save/load are implemented
+host-side in paddle_tpu.io (graph save/load ops have no device work to do —
+the reference's save_op.cc serializes from the scope, which here is the
+executor writing scope arrays to disk).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.core.types import canonical_dtype
+
+register_op(
+    "assign_value",
+    inputs=[],
+    outputs=["Out"],
+    attrs={"shape": [], "dtype": "float32", "values": []},
+    lower=lambda ctx, ins, attrs: jnp.asarray(
+        np.asarray(attrs["values"], canonical_dtype(attrs.get("dtype"))).reshape(
+            attrs["shape"]
+        )
+    ),
+    grad=None,
+)
+
+
+def _lower_print(ctx, ins, attrs):
+    x = ins["In"][0]
+    message = attrs.get("message", "")
+    jax.debug.print(message + " {x}", x=x)
+    return x
+
+
+register_op(
+    "print",
+    inputs=["In"],
+    outputs=["Out"],
+    attrs={
+        "first_n": -1,
+        "message": "",
+        "print_tensor_name": True,
+        "print_tensor_type": True,
+        "print_tensor_shape": True,
+        "print_tensor_lod": True,
+        "print_phase": "BOTH",
+    },
+    lower=_lower_print,
+)

@@ -1,0 +1,245 @@
+"""Dense math ops: mul/matmul/elementwise/reduce/scale/sum/...
+
+Reference parity: paddle/fluid/operators/{mul,matmul,elementwise_*,reduce_*,
+scale,sum,clip,cumsum,...}_op.cc — each lowered to XLA instead of
+cuBLAS/Eigen kernels. Matmuls run in the input dtype (bf16 stays bf16 on
+the MXU with float32 accumulation via XLA's default precision).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.ops.common import broadcast_y, flatten_to_2d, reduce_axes, to_dtype
+
+
+def _lower_mul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    x2 = flatten_to_2d(x, xn)
+    y2 = flatten_to_2d(y, yn)
+    out = x2 @ y2
+    out_shape = tuple(jnp.shape(x)[:xn]) + tuple(jnp.shape(y)[yn:])
+    return jnp.reshape(out, out_shape)
+
+
+register_op(
+    "mul",
+    inputs=["X", "Y"],
+    outputs=["Out"],
+    attrs={"x_num_col_dims": 1, "y_num_col_dims": 1},
+    lower=_lower_mul,
+)
+
+
+def _lower_matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if jnp.ndim(x) > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if jnp.ndim(y) > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return out
+
+
+register_op(
+    "matmul",
+    inputs=["X", "Y"],
+    outputs=["Out"],
+    attrs={"transpose_X": False, "transpose_Y": False, "alpha": 1.0},
+    lower=_lower_matmul,
+)
+
+
+def _elementwise(fn):
+    def lower(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = broadcast_y(x, y, attrs.get("axis", -1))
+        return fn(x, y)
+
+    return lower
+
+
+for _name, _fn in [
+    ("elementwise_add", jnp.add),
+    ("elementwise_sub", jnp.subtract),
+    ("elementwise_mul", jnp.multiply),
+    ("elementwise_div", jnp.divide),
+    ("elementwise_max", jnp.maximum),
+    ("elementwise_min", jnp.minimum),
+    ("elementwise_pow", jnp.power),
+    ("elementwise_mod", jnp.mod),
+    ("elementwise_floordiv", jnp.floor_divide),
+]:
+    register_op(
+        _name,
+        inputs=["X", "Y"],
+        outputs=["Out"],
+        attrs={"axis": -1},
+        lower=_elementwise(_fn),
+    )
+
+
+register_op(
+    "sum",
+    inputs=["*X"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: sum(ins["X"][1:], ins["X"][0]),
+)
+
+register_op(
+    "scale",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"scale": 1.0, "bias": 0.0, "bias_after_scale": True},
+    lower=lambda ctx, ins, attrs: (
+        ins["X"][0] * jnp.asarray(attrs.get("scale", 1.0), ins["X"][0].dtype)
+        + jnp.asarray(attrs.get("bias", 0.0), ins["X"][0].dtype)
+        if attrs.get("bias_after_scale", True)
+        else (ins["X"][0] + jnp.asarray(attrs.get("bias", 0.0), ins["X"][0].dtype))
+        * jnp.asarray(attrs.get("scale", 1.0), ins["X"][0].dtype)
+    ),
+)
+
+register_op(
+    "mean",
+    inputs=["X"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jnp.reshape(jnp.mean(ins["X"][0]), (1,)),
+)
+
+
+def _reduce(fn):
+    def lower(ctx, ins, attrs):
+        x = ins["X"][0]
+        axes = reduce_axes(
+            jnp.ndim(x), attrs.get("dim", [0]), attrs.get("reduce_all", False)
+        )
+        out = fn(x, axis=axes, keepdims=attrs.get("keep_dim", False))
+        if jnp.ndim(out) == 0:
+            out = jnp.reshape(out, (1,))
+        return out
+
+    return lower
+
+
+for _name, _fn in [
+    ("reduce_sum", jnp.sum),
+    ("reduce_mean", jnp.mean),
+    ("reduce_max", jnp.max),
+    ("reduce_min", jnp.min),
+    ("reduce_prod", jnp.prod),
+]:
+    register_op(
+        _name,
+        inputs=["X"],
+        outputs=["Out"],
+        attrs={"dim": [0], "keep_dim": False, "reduce_all": False},
+        lower=_reduce(_fn),
+    )
+
+register_op(
+    "clip",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"min": 0.0, "max": 0.0},
+    lower=lambda ctx, ins, attrs: jnp.clip(
+        ins["X"][0],
+        jnp.asarray(attrs["min"], ins["X"][0].dtype),
+        jnp.asarray(attrs["max"], ins["X"][0].dtype),
+    ),
+)
+
+register_op(
+    "clip_by_norm",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"max_norm": 1.0},
+    lower=lambda ctx, ins, attrs: _clip_by_norm(ins["X"][0], attrs["max_norm"]),
+)
+
+
+def _clip_by_norm(x, max_norm):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    max_norm = jnp.asarray(max_norm, x.dtype)
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+register_op(
+    "cumsum",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"axis": -1, "exclusive": False, "reverse": False},
+    lower=lambda ctx, ins, attrs: _cumsum(ins["X"][0], attrs),
+)
+
+
+def _cumsum(x, attrs):
+    axis = attrs.get("axis", -1)
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    return out
+
+
+register_op(
+    "l2_normalize",
+    inputs=["X"],
+    outputs=["Out", "Norm"],
+    attrs={"axis": -1, "epsilon": 1e-10},
+    lower=lambda ctx, ins, attrs: _l2_normalize(ins["X"][0], attrs),
+    intermediate_outputs=("Norm",),
+)
+
+
+def _l2_normalize(x, attrs):
+    axis = attrs.get("axis", -1)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-10), x.dtype)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return x / norm, norm
+
+
+register_op(
+    "norm",
+    inputs=["X"],
+    outputs=["Out", "Norm"],
+    attrs={"axis": 1, "epsilon": 1e-10},
+    lower=lambda ctx, ins, attrs: _l2_normalize(ins["X"][0], attrs),
+    intermediate_outputs=("Norm",),
+)
+
+
+def _lower_isfinite(ctx, ins, attrs):
+    flat = [jnp.all(jnp.isfinite(x)) for x in ins["X"]]
+    return jnp.reshape(jnp.stack(flat).all(), (1,))
+
+
+register_op("isfinite", inputs=["*X"], outputs=["Out"], lower=_lower_isfinite, grad=None)
+
+register_op(
+    "isinf",
+    inputs=["X"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jnp.reshape(
+        jnp.any(jnp.isinf(ins["X"][0])), (1,)
+    ),
+    grad=None,
+)
+
+register_op(
+    "isnan",
+    inputs=["X"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jnp.reshape(
+        jnp.any(jnp.isnan(ins["X"][0])), (1,)
+    ),
+    grad=None,
+)

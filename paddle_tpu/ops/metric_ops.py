@@ -1,0 +1,73 @@
+"""In-graph metric ops: accuracy, auc, precision/recall.
+
+Reference parity: paddle/fluid/operators/{accuracy,auc}_op.cc.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+
+def _lower_accuracy(ctx, ins, attrs):
+    indices, label = ins["Indices"][0], ins["Label"][0]
+    if jnp.ndim(label) > 1 and jnp.shape(label)[-1] == 1:
+        label = jnp.squeeze(label, -1)
+    hit = jnp.any(indices == label[:, None].astype(indices.dtype), axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.asarray(jnp.shape(indices)[0], jnp.int32)
+    acc = correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {
+        "Accuracy": jnp.reshape(acc, (1,)),
+        "Correct": jnp.reshape(correct, (1,)),
+        "Total": jnp.reshape(total, (1,)),
+    }
+
+
+register_op(
+    "accuracy",
+    inputs=["Out", "Indices", "Label"],
+    outputs=["Accuracy", "Correct", "Total"],
+    lower=_lower_accuracy,
+    grad=None,
+)
+
+
+def _lower_auc(ctx, ins, attrs):
+    """Streaming AUC via threshold-bucket confusion counts, matching
+    auc_op.cc: stat inputs are accumulated into stat outputs (bound to the
+    same persistable vars by layers.auc)."""
+    preds, label = ins["Predict"][0], ins["Label"][0]
+    num_thresholds = attrs.get("num_thresholds", 200)
+    pos_prob = preds[:, 1] if jnp.ndim(preds) == 2 else jnp.reshape(preds, (-1,))
+    lbl = jnp.reshape(label, (-1,)).astype(jnp.bool_)
+    bucket = jnp.clip(
+        (pos_prob * num_thresholds).astype(jnp.int32), 0, num_thresholds - 1
+    )
+    onehot = jnp.zeros((num_thresholds,), jnp.int64)
+    pos_hist = onehot.at[bucket].add(lbl.astype(jnp.int64))
+    neg_hist = onehot.at[bucket].add((~lbl).astype(jnp.int64))
+    stat_pos = ins["StatPos"][0] + pos_hist
+    stat_neg = ins["StatNeg"][0] + neg_hist
+    # AUC from histogram: sweep thresholds high->low.
+    tp = jnp.cumsum(stat_pos[::-1])[::-1].astype(jnp.float64)
+    fp = jnp.cumsum(stat_neg[::-1])[::-1].astype(jnp.float64)
+    tot_pos = jnp.maximum(tp[0], 1.0)
+    tot_neg = jnp.maximum(fp[0], 1.0)
+    tpr = tp / tot_pos
+    fpr = fp / tot_neg
+    auc = jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
+    return {
+        "AUC": jnp.reshape(auc.astype(jnp.float32), (1,)),
+        "StatPosOut": stat_pos,
+        "StatNegOut": stat_neg,
+    }
+
+
+register_op(
+    "auc",
+    inputs=["Predict", "Label", "StatPos", "StatNeg"],
+    outputs=["AUC", "StatPosOut", "StatNegOut"],
+    attrs={"curve": "ROC", "num_thresholds": 200},
+    lower=_lower_auc,
+    grad=None,
+)

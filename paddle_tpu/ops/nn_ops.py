@@ -1,0 +1,423 @@
+"""NN structural ops: conv, pooling, normalization.
+
+Reference parity: paddle/fluid/operators/{conv,conv_transpose,pool,
+batch_norm,layer_norm,lrn,group_norm}_op.cc(+cudnn variants). On TPU these
+lower to XLA convolution/reduce-window HLOs which tile onto the MXU; cuDNN
+algorithm selection has no analog (XLA autotunes).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.op_registry import register_op
+
+_CONV_DN = ("NCHW", "OIHW", "NCHW")
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _lower_conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(p, p) for p in paddings],
+        rhs_dilation=dilations,
+        dimension_numbers=_CONV_DN,
+        feature_group_count=groups,
+    )
+    return out
+
+
+register_op(
+    "conv2d",
+    inputs=["Input", "Filter"],
+    outputs=["Output"],
+    attrs={
+        "strides": [1, 1],
+        "paddings": [0, 0],
+        "dilations": [1, 1],
+        "groups": 1,
+        "use_cudnn": False,
+        "data_format": "NCHW",
+    },
+    lower=_lower_conv2d,
+)
+
+
+def _lower_depthwise_conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    # Paddle depthwise: groups == in_channels, filter [C*mult, 1, kh, kw].
+    a = dict(attrs)
+    a["groups"] = jnp.shape(x)[1]
+    return _lower_conv2d(ctx, ins, a)
+
+
+register_op(
+    "depthwise_conv2d",
+    inputs=["Input", "Filter"],
+    outputs=["Output"],
+    attrs={
+        "strides": [1, 1],
+        "paddings": [0, 0],
+        "dilations": [1, 1],
+        "groups": 1,
+        "data_format": "NCHW",
+    },
+    lower=_lower_depthwise_conv2d,
+)
+
+
+def _lower_conv3d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    paddings = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    dilations = _pair(attrs.get("dilations", [1, 1, 1]), 3)
+    return jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(p, p) for p in paddings],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1),
+    )
+
+
+register_op(
+    "conv3d",
+    inputs=["Input", "Filter"],
+    outputs=["Output"],
+    attrs={
+        "strides": [1, 1, 1],
+        "paddings": [0, 0, 0],
+        "dilations": [1, 1, 1],
+        "groups": 1,
+    },
+    lower=_lower_conv3d,
+)
+
+
+def _lower_conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    # Paddle filter layout for transpose conv: [in_c, out_c/groups, kh, kw].
+    # Gradient-of-conv formulation: lhs-dilate input by stride.
+    kh = (jnp.shape(w)[2] - 1) * dilations[0] + 1
+    kw = (jnp.shape(w)[3] - 1) * dilations[1] + 1
+    pad_h = kh - 1 - paddings[0]
+    pad_w = kw - 1 - paddings[1]
+    w_flip = jnp.flip(w, axis=(2, 3))
+    w_t = jnp.swapaxes(w_flip, 0, 1)  # -> [out_c, in_c, kh, kw]
+    if groups > 1:
+        # regroup: [in_c, oc/g, ...] -> per-group transpose
+        ic, ocg = jnp.shape(w)[0], jnp.shape(w)[1]
+        wg = jnp.reshape(w_flip, (groups, ic // groups, ocg) + tuple(jnp.shape(w)[2:]))
+        wg = jnp.swapaxes(wg, 1, 2)
+        w_t = jnp.reshape(wg, (groups * ocg, ic // groups) + tuple(jnp.shape(w)[2:]))
+    return jax.lax.conv_general_dilated(
+        x,
+        w_t,
+        window_strides=(1, 1),
+        padding=[(pad_h, pad_h), (pad_w, pad_w)],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=_CONV_DN,
+        feature_group_count=groups,
+    )
+
+
+register_op(
+    "conv2d_transpose",
+    inputs=["Input", "Filter"],
+    outputs=["Output"],
+    attrs={
+        "strides": [1, 1],
+        "paddings": [0, 0],
+        "dilations": [1, 1],
+        "groups": 1,
+    },
+    lower=_lower_conv2d_transpose,
+)
+
+
+def _pool2d_core(x, attrs):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    global_pool = attrs.get("global_pooling", False)
+    if global_pool:
+        axis = (2, 3)
+        if ptype == "max":
+            return jnp.max(x, axis=axis, keepdims=True)
+        return jnp.mean(x, axis=axis, keepdims=True)
+    window = (1, 1) + tuple(ksize)
+    strides4 = (1, 1) + tuple(strides)
+    pads = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
+    if attrs.get("ceil_mode", False):
+        # pad extra on the high side so ceil-division window count fits
+        extra = []
+        for i in range(2):
+            size = jnp.shape(x)[2 + i]
+            k, s, p = ksize[i], strides[i], paddings[i]
+            out_ceil = -(-(size + 2 * p - k) // s) + 1
+            needed = (out_ceil - 1) * s + k - (size + 2 * p)
+            extra.append(max(0, int(needed)))
+        pads = [(0, 0), (0, 0)] + [
+            (paddings[i], paddings[i] + extra[i]) for i in range(2)
+        ]
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(
+            x, jnp.asarray(init, x.dtype), jax.lax.max, window, strides4, pads
+        )
+    # avg pooling: exclusive=True divides by actual (unpadded) window size.
+    ones = jnp.ones_like(x)
+    summed = jax.lax.reduce_window(
+        x, jnp.asarray(0, x.dtype), jax.lax.add, window, strides4, pads
+    )
+    if attrs.get("exclusive", True):
+        counts = jax.lax.reduce_window(
+            ones, jnp.asarray(0, x.dtype), jax.lax.add, window, strides4, pads
+        )
+    else:
+        counts = jnp.asarray(float(np.prod(ksize)), x.dtype)
+    return summed / counts
+
+
+register_op(
+    "pool2d",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={
+        "pooling_type": "max",
+        "ksize": [2, 2],
+        "strides": [1, 1],
+        "paddings": [0, 0],
+        "global_pooling": False,
+        "exclusive": True,
+        "ceil_mode": False,
+        "adaptive": False,
+        "use_cudnn": False,
+    },
+    lower=lambda ctx, ins, attrs: _pool2d_core(ins["X"][0], attrs),
+)
+
+
+def _lower_batch_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean_in, var_in = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    is_test = ctx.is_test or attrs.get("is_test", False)
+    ch_axis = 1 if layout == "NCHW" else jnp.ndim(x) - 1
+    reduce_ax = tuple(i for i in range(jnp.ndim(x)) if i != ch_axis)
+    bshape = tuple(
+        jnp.shape(x)[ch_axis] if i == ch_axis else 1 for i in range(jnp.ndim(x))
+    )
+
+    if is_test or attrs.get("use_global_stats", False):
+        mean, var = mean_in, var_in
+        saved_mean, saved_var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+    else:
+        cdtype = jnp.float32 if x.dtype != jnp.float64 else jnp.float64
+        xc = x.astype(cdtype)
+        mean = jnp.mean(xc, axis=reduce_ax)
+        var = jnp.mean(jnp.square(xc), axis=reduce_ax) - jnp.square(mean)
+        mean_out = mean_in * momentum + mean.astype(mean_in.dtype) * (1 - momentum)
+        var_out = var_in * momentum + var.astype(var_in.dtype) * (1 - momentum)
+        saved_mean, saved_var = mean, var
+    inv_std = jax.lax.rsqrt(var.astype(x.dtype) + jnp.asarray(eps, x.dtype))
+    y = (x - jnp.reshape(mean.astype(x.dtype), bshape)) * jnp.reshape(
+        inv_std * scale, bshape
+    ) + jnp.reshape(bias, bshape)
+    return {
+        "Y": y,
+        "MeanOut": mean_out,
+        "VarianceOut": var_out,
+        "SavedMean": saved_mean,
+        "SavedVariance": saved_var,
+    }
+
+
+register_op(
+    "batch_norm",
+    inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    attrs={
+        "epsilon": 1e-5,
+        "momentum": 0.9,
+        "is_test": False,
+        "data_layout": "NCHW",
+        "use_global_stats": False,
+    },
+    lower=_lower_batch_norm,
+    no_grad_inputs=("Mean", "Variance"),
+    intermediate_outputs=("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
+)
+
+
+def _lower_layer_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    begin = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(begin, jnp.ndim(x)))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    inv = jax.lax.rsqrt(var + jnp.asarray(eps, x.dtype))
+    y = (x - mean) * inv
+    norm_shape = tuple(jnp.shape(x)[begin:])
+    if "Scale" in ins and ins["Scale"]:
+        y = y * jnp.reshape(ins["Scale"][0], norm_shape)
+    if "Bias" in ins and ins["Bias"]:
+        y = y + jnp.reshape(ins["Bias"][0], norm_shape)
+    lead = tuple(jnp.shape(x)[:begin])
+    return {
+        "Y": y,
+        "Mean": jnp.reshape(mean, lead),
+        "Variance": jnp.reshape(var, lead),
+    }
+
+
+register_op(
+    "layer_norm",
+    inputs=["X", "Scale", "Bias"],
+    outputs=["Y", "Mean", "Variance"],
+    attrs={"epsilon": 1e-5, "begin_norm_axis": 1},
+    lower=_lower_layer_norm,
+    intermediate_outputs=("Mean", "Variance"),
+)
+
+
+def _lower_lrn(ctx, ins, attrs):
+    x = ins["X"][0]
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(
+        pad[:, i : i + jnp.shape(x)[1]] for i in range(n)
+    )
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+register_op(
+    "lrn",
+    inputs=["X"],
+    outputs=["Out", "MidOut"],
+    attrs={"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75},
+    lower=_lower_lrn,
+    intermediate_outputs=("MidOut",),
+)
+
+
+def _lower_group_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    groups = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = jnp.shape(x)[0], jnp.shape(x)[1]
+    rest = tuple(jnp.shape(x)[2:])
+    xg = jnp.reshape(x, (n, groups, c // groups) + rest)
+    axes = tuple(range(2, jnp.ndim(xg)))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=axes, keepdims=True)
+    y = (xg - mean) * jax.lax.rsqrt(var + jnp.asarray(eps, x.dtype))
+    y = jnp.reshape(y, jnp.shape(x))
+    bshape = (1, c) + (1,) * len(rest)
+    if "Scale" in ins and ins["Scale"]:
+        y = y * jnp.reshape(ins["Scale"][0], bshape)
+    if "Bias" in ins and ins["Bias"]:
+        y = y + jnp.reshape(ins["Bias"][0], bshape)
+    return {
+        "Y": y,
+        "Mean": jnp.reshape(mean, (n, groups)),
+        "Variance": jnp.reshape(var, (n, groups)),
+    }
+
+
+register_op(
+    "group_norm",
+    inputs=["X", "Scale", "Bias"],
+    outputs=["Y", "Mean", "Variance"],
+    attrs={"epsilon": 1e-5, "groups": 1},
+    lower=_lower_group_norm,
+    intermediate_outputs=("Mean", "Variance"),
+)
+
+
+def _lower_im2sequence(ctx, ins, attrs):
+    x = ins["X"][0]
+    kernels = attrs.get("kernels", [1, 1])
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0, 0, 0])
+    n, c, h, w = jnp.shape(x)
+    xp = jnp.pad(
+        x, [(0, 0), (0, 0), (paddings[0], paddings[2]), (paddings[1], paddings[3])]
+    )
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, kernels, strides, "VALID", dimension_numbers=_CONV_DN
+    )
+    # patches: [N, C*kh*kw, oh, ow] -> [N*oh*ow, C*kh*kw]
+    _, ckk, oh, ow = jnp.shape(patches)
+    out = jnp.transpose(patches, (0, 2, 3, 1))
+    return jnp.reshape(out, (n * oh * ow, ckk))
+
+
+register_op(
+    "im2sequence",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"kernels": [1, 1], "strides": [1, 1], "paddings": [0, 0, 0, 0]},
+    lower=_lower_im2sequence,
+)
+
+
+def _interp(x, out_h, out_w, method):
+    n, c, h, w = jnp.shape(x)
+    xt = jnp.transpose(x, (0, 2, 3, 1))
+    out = jax.image.resize(xt, (n, out_h, out_w, c), method=method)
+    return jnp.transpose(out, (0, 3, 1, 2)).astype(x.dtype)
+
+
+register_op(
+    "bilinear_interp",
+    inputs=["X", "OutSize"],
+    outputs=["Out"],
+    attrs={"out_h": -1, "out_w": -1, "interp_method": "bilinear"},
+    lower=lambda ctx, ins, attrs: _interp(
+        ins["X"][0], attrs["out_h"], attrs["out_w"], "bilinear"
+    ),
+    no_grad_inputs=("OutSize",),
+)
+
+register_op(
+    "nearest_interp",
+    inputs=["X", "OutSize"],
+    outputs=["Out"],
+    attrs={"out_h": -1, "out_w": -1, "interp_method": "nearest"},
+    lower=lambda ctx, ins, attrs: _interp(
+        ins["X"][0], attrs["out_h"], attrs["out_w"], "nearest"
+    ),
+    no_grad_inputs=("OutSize",),
+)

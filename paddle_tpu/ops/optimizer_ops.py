@@ -1,0 +1,300 @@
+"""Optimizer update ops — updates expressed as ops in the graph, exactly as
+in the reference (paddle/fluid/operators/{sgd,momentum,adam,adagrad,adamax,
+adadelta,rmsprop,ftrl,decayed_adagrad,lars_momentum,proximal_*}_op.cc).
+
+Functional-update semantics: each op consumes Param/accumulators and emits
+ParamOut/accumulator-outs bound to the SAME variable names; the executor's
+state threading + donated buffers give the in-place behavior Paddle gets
+from shared scope variables.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+
+def _lr(ins):
+    return jnp.reshape(ins["LearningRate"][0], ())
+
+
+register_op(
+    "sgd",
+    inputs=["Param", "Grad", "LearningRate"],
+    outputs=["ParamOut"],
+    lower=lambda ctx, ins, attrs: ins["Param"][0]
+    - _lr(ins).astype(ins["Param"][0].dtype) * ins["Grad"][0],
+    grad=None,
+)
+
+
+def _lower_momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = _lr(ins).astype(p.dtype)
+    mu = jnp.asarray(attrs.get("mu", 0.0), p.dtype)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+register_op(
+    "momentum",
+    inputs=["Param", "Grad", "Velocity", "LearningRate"],
+    outputs=["ParamOut", "VelocityOut"],
+    attrs={"mu": 0.0, "use_nesterov": False},
+    lower=_lower_momentum,
+    grad=None,
+)
+
+
+def _lower_lars_momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = _lr(ins).astype(p.dtype)
+    mu = jnp.asarray(attrs.get("mu", 0.0), p.dtype)
+    lars_coeff = attrs.get("lars_coeff", 0.001)
+    lars_wd = attrs.get("lars_weight_decay", 0.0005)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + lars_wd * p_norm + 1e-12),
+        lr,
+    )
+    v_out = mu * v + local_lr * (g + lars_wd * p)
+    return {"ParamOut": p - v_out, "VelocityOut": v_out}
+
+
+register_op(
+    "lars_momentum",
+    inputs=["Param", "Grad", "Velocity", "LearningRate"],
+    outputs=["ParamOut", "VelocityOut"],
+    attrs={"mu": 0.0, "lars_coeff": 0.001, "lars_weight_decay": 0.0005},
+    lower=_lower_lars_momentum,
+    grad=None,
+)
+
+
+def _lower_adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p = jnp.reshape(ins["Beta1Pow"][0], ()).astype(p.dtype)
+    b2p = jnp.reshape(ins["Beta2Pow"][0], ()).astype(p.dtype)
+    lr = _lr(ins).astype(p.dtype)
+    b1 = jnp.asarray(attrs.get("beta1", 0.9), p.dtype)
+    b2 = jnp.asarray(attrs.get("beta2", 0.999), p.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-8), p.dtype)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": p_out, "Moment1Out": m1o, "Moment2Out": m2o}
+
+
+register_op(
+    "adam",
+    inputs=["Param", "Grad", "LearningRate", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow"],
+    outputs=["ParamOut", "Moment1Out", "Moment2Out"],
+    attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8, "lazy_mode": False},
+    lower=_lower_adam,
+    grad=None,
+)
+
+
+def _lower_adamax(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = jnp.reshape(ins["Beta1Pow"][0], ()).astype(p.dtype)
+    lr = _lr(ins).astype(p.dtype)
+    b1 = jnp.asarray(attrs.get("beta1", 0.9), p.dtype)
+    b2 = jnp.asarray(attrs.get("beta2", 0.999), p.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-8), p.dtype)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr_t = lr / (1 - b1p)
+    p_out = p - lr_t * m_out / (inf_out + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out}
+
+
+register_op(
+    "adamax",
+    inputs=["Param", "Grad", "LearningRate", "Moment", "InfNorm", "Beta1Pow"],
+    outputs=["ParamOut", "MomentOut", "InfNormOut"],
+    attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+    lower=_lower_adamax,
+    grad=None,
+)
+
+
+def _lower_adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins).astype(p.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-6), p.dtype)
+    m_out = mom + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+register_op(
+    "adagrad",
+    inputs=["Param", "Grad", "Moment", "LearningRate"],
+    outputs=["ParamOut", "MomentOut"],
+    attrs={"epsilon": 1e-6},
+    lower=_lower_adagrad,
+    grad=None,
+)
+
+
+def _lower_decayed_adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins).astype(p.dtype)
+    decay = jnp.asarray(attrs.get("decay", 0.95), p.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-6), p.dtype)
+    m_out = decay * mom + (1 - decay) * jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+register_op(
+    "decayed_adagrad",
+    inputs=["Param", "Grad", "Moment", "LearningRate"],
+    outputs=["ParamOut", "MomentOut"],
+    attrs={"decay": 0.95, "epsilon": 1e-6},
+    lower=_lower_decayed_adagrad,
+    grad=None,
+)
+
+
+def _lower_adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = jnp.asarray(attrs.get("rho", 0.95), p.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-6), p.dtype)
+    asg_out = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (asg_out + eps)) * g
+    asu_out = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    return {
+        "ParamOut": p + update,
+        "AvgSquaredGradOut": asg_out,
+        "AvgSquaredUpdateOut": asu_out,
+    }
+
+
+register_op(
+    "adadelta",
+    inputs=["Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"],
+    outputs=["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"],
+    attrs={"rho": 0.95, "epsilon": 1e-6},
+    lower=_lower_adadelta,
+    grad=None,
+)
+
+
+def _lower_rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    lr = _lr(ins).astype(p.dtype)
+    rho = jnp.asarray(attrs.get("decay", 0.9), p.dtype)
+    eps = jnp.asarray(attrs.get("epsilon", 1e-10), p.dtype)
+    momentum = jnp.asarray(attrs.get("momentum", 0.0), p.dtype)
+    out = {}
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    if attrs.get("centered", False):
+        mg = ins["MeanGrad"][0]
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - jnp.square(mg_out) + eps
+        out["MeanGradOut"] = mg_out
+    else:
+        denom = ms_out + eps
+    mom_out = momentum * mom + lr * g / jnp.sqrt(denom)
+    out.update(
+        {"ParamOut": p - mom_out, "MomentOut": mom_out, "MeanSquareOut": ms_out}
+    )
+    return out
+
+
+register_op(
+    "rmsprop",
+    inputs=["Param", "Grad", "MeanSquare", "MeanGrad", "Moment", "LearningRate"],
+    outputs=["ParamOut", "MomentOut", "MeanSquareOut", "MeanGradOut"],
+    attrs={"decay": 0.9, "epsilon": 1e-10, "momentum": 0.0, "centered": False},
+    lower=_lower_rmsprop,
+    grad=None,
+)
+
+
+def _lower_ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    lr = _lr(ins).astype(p.dtype)
+    l1 = jnp.asarray(attrs.get("l1", 0.0), p.dtype)
+    l2 = jnp.asarray(attrs.get("l2", 0.0), p.dtype)
+    power = jnp.asarray(attrs.get("lr_power", -0.5), p.dtype)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    lin_out = lin + g - sigma * p
+    x = l1 * jnp.sign(lin_out) - lin_out
+    y = jnp.power(new_sq, -power) / lr + 2 * l2
+    p_out = jnp.where(jnp.abs(lin_out) > l1, x / y, jnp.zeros_like(p))
+    return {"ParamOut": p_out, "SquaredAccumOut": new_sq, "LinearAccumOut": lin_out}
+
+
+register_op(
+    "ftrl",
+    inputs=["Param", "Grad", "SquaredAccumulator", "LinearAccumulator", "LearningRate"],
+    outputs=["ParamOut", "SquaredAccumOut", "LinearAccumOut"],
+    attrs={"l1": 0.0, "l2": 0.0, "lr_power": -0.5},
+    lower=_lower_ftrl,
+    grad=None,
+)
+
+
+def _lower_proximal_gd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins).astype(p.dtype)
+    l1 = jnp.asarray(attrs.get("l1", 0.0), p.dtype)
+    l2 = jnp.asarray(attrs.get("l2", 0.0), p.dtype)
+    prox = p - lr * g
+    p_out = (
+        jnp.sign(prox)
+        * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+        / (1.0 + lr * l2)
+    )
+    return {"ParamOut": p_out}
+
+
+register_op(
+    "proximal_gd",
+    inputs=["Param", "Grad", "LearningRate"],
+    outputs=["ParamOut"],
+    attrs={"l1": 0.0, "l2": 0.0},
+    lower=_lower_proximal_gd,
+    grad=None,
+)
+
+
+def _lower_proximal_adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins).astype(p.dtype)
+    l1 = jnp.asarray(attrs.get("l1", 0.0), p.dtype)
+    l2 = jnp.asarray(attrs.get("l2", 0.0), p.dtype)
+    m_out = mom + jnp.square(g)
+    lr_t = lr / jnp.sqrt(m_out)
+    prox = p - lr_t * g
+    p_out = (
+        jnp.sign(prox)
+        * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0)
+        / (1.0 + lr_t * l2)
+    )
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+register_op(
+    "proximal_adagrad",
+    inputs=["Param", "Grad", "Moment", "LearningRate"],
+    outputs=["ParamOut", "MomentOut"],
+    attrs={"l1": 0.0, "l2": 0.0},
+    lower=_lower_proximal_adagrad,
+    grad=None,
+)

@@ -1,0 +1,128 @@
+"""Random / initializer ops (gaussian_random, uniform_random, dropout...).
+
+Reference parity: paddle/fluid/operators/{gaussian_random,uniform_random,
+truncated_gaussian_random,dropout,random_crop,sampling_id}_op.cc. Keys come
+from the LowerContext's counter-based PRNG stream (stateless, TPU-friendly);
+a nonzero ``seed`` attr pins the stream like the reference's fix_seed.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.core.types import canonical_dtype
+
+register_op(
+    "gaussian_random",
+    inputs=[],
+    outputs=["Out"],
+    attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0, "dtype": "float32"},
+    lower=lambda ctx, ins, attrs: attrs.get("mean", 0.0)
+    + attrs.get("std", 1.0)
+    * jax.random.normal(
+        ctx.rng(), tuple(attrs["shape"]), canonical_dtype(attrs.get("dtype"))
+    ),
+    grad=None,
+)
+
+register_op(
+    "uniform_random",
+    inputs=[],
+    outputs=["Out"],
+    attrs={"shape": [], "min": -1.0, "max": 1.0, "seed": 0, "dtype": "float32"},
+    lower=lambda ctx, ins, attrs: jax.random.uniform(
+        ctx.rng(),
+        tuple(attrs["shape"]),
+        canonical_dtype(attrs.get("dtype")),
+        minval=attrs.get("min", -1.0),
+        maxval=attrs.get("max", 1.0),
+    ),
+    grad=None,
+)
+
+register_op(
+    "truncated_gaussian_random",
+    inputs=[],
+    outputs=["Out"],
+    attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0, "dtype": "float32"},
+    lower=lambda ctx, ins, attrs: attrs.get("mean", 0.0)
+    + attrs.get("std", 1.0)
+    * jax.random.truncated_normal(
+        ctx.rng(), -2.0, 2.0, tuple(attrs["shape"]),
+        canonical_dtype(attrs.get("dtype")),
+    ),
+    grad=None,
+)
+
+
+def _lower_dropout(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    if ctx.is_test or attrs.get("is_test", False):
+        # Downgrade-in-infer (reference default dropout_implementation).
+        if attrs.get("dropout_implementation", "downgrade_in_infer") == "upscale_in_train":
+            return {"Out": x, "Mask": jnp.ones_like(x)}
+        return {"Out": x * jnp.asarray(1.0 - p, x.dtype), "Mask": jnp.ones_like(x)}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, jnp.shape(x))
+    mask = keep.astype(x.dtype)
+    if attrs.get("dropout_implementation", "downgrade_in_infer") == "upscale_in_train":
+        if p >= 1.0:
+            out = jnp.zeros_like(x)
+        else:
+            out = x * mask / jnp.asarray(1.0 - p, x.dtype)
+    else:
+        out = x * mask
+    return {"Out": out, "Mask": mask}
+
+
+register_op(
+    "dropout",
+    inputs=["X"],
+    outputs=["Out", "Mask"],
+    attrs={
+        "dropout_prob": 0.5,
+        "is_test": False,
+        "seed": 0,
+        "fix_seed": False,
+        "dropout_implementation": "downgrade_in_infer",
+    },
+    lower=_lower_dropout,
+    intermediate_outputs=("Mask",),
+)
+
+register_op(
+    "sampling_id",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"min": 0.0, "max": 1.0, "seed": 0},
+    lower=lambda ctx, ins, attrs: jax.random.categorical(
+        ctx.rng(), jnp.log(jnp.maximum(ins["X"][0], 1e-20)), axis=-1
+    ).astype(jnp.int64),
+    grad=None,
+)
+
+register_op(
+    "random_crop",
+    inputs=["X", "Seed"],
+    outputs=["Out", "SeedOut"],
+    attrs={"shape": []},
+    lower=lambda ctx, ins, attrs: {
+        "Out": _random_crop(ctx, ins["X"][0], attrs["shape"]),
+        "SeedOut": ins["Seed"][0],
+    },
+    grad=None,
+)
+
+
+def _random_crop(ctx, x, crop_shape):
+    full = jnp.shape(x)
+    nbatch_dims = len(full) - len(crop_shape)
+    key = ctx.rng()
+    starts = []
+    for i, c in enumerate(crop_shape):
+        limit = full[nbatch_dims + i] - c + 1
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, limit))
+    start_idx = [jnp.zeros((), jnp.int32)] * nbatch_dims + starts
+    sizes = list(full[:nbatch_dims]) + list(crop_shape)
+    return jax.lax.dynamic_slice(x, start_idx, sizes)

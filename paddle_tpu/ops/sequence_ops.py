@@ -1,0 +1,149 @@
+"""Sequence ops over the dense-padded + mask device representation.
+
+Reference parity: paddle/fluid/operators/sequence_*. The reference operates
+on LoD-packed flat tensors; XLA needs static shapes, so device-side
+sequences are [batch, max_len, ...] padded tensors with an optional Length
+input (see SURVEY.md §5.7: bucketed padding is the idiomatic TPU move).
+sequence_pool/softmax etc. take an optional "Length" tensor input carried
+alongside by the layers front-end.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+
+def _mask_from(ins, x, time_axis=1):
+    """[batch, max_len] validity mask from optional Length input."""
+    if "Length" in ins and ins["Length"]:
+        lens = jnp.reshape(ins["Length"][0], (-1,))
+        steps = jnp.arange(jnp.shape(x)[time_axis])
+        return steps[None, :] < lens[:, None]
+    return None
+
+
+def _lower_sequence_pool(ctx, ins, attrs):
+    x = ins["X"][0]  # [batch, max_len, d]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    mask = _mask_from(ins, x)
+    if mask is not None:
+        m = mask[..., None].astype(x.dtype)
+        lens = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    else:
+        m = jnp.ones_like(x[..., :1])
+        lens = jnp.asarray(jnp.shape(x)[1], x.dtype)
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / lens
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(lens)
+    elif ptype == "MAX":
+        neg = jnp.asarray(-1e38, x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        if mask is not None:
+            idx = jnp.maximum(
+                jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0
+            )
+            out = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+        else:
+            out = x[:, -1]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError("unknown pooltype %s" % ptype)
+    return {"Out": out, "MaxIndex": jnp.zeros((1,), jnp.int32)}
+
+
+register_op(
+    "sequence_pool",
+    inputs=["X", "Length"],
+    outputs=["Out", "MaxIndex"],
+    attrs={"pooltype": "AVERAGE"},
+    lower=_lower_sequence_pool,
+    no_grad_inputs=("Length",),
+    intermediate_outputs=("MaxIndex",),
+)
+
+
+def _lower_sequence_softmax(ctx, ins, attrs):
+    x = ins["X"][0]  # [batch, max_len]
+    mask = _mask_from(ins, x)
+    if mask is None:
+        return jax.nn.softmax(x, axis=-1)
+    neg = jnp.asarray(-1e38, x.dtype)
+    masked = jnp.where(mask, x, neg)
+    sm = jax.nn.softmax(masked, axis=-1)
+    return jnp.where(mask, sm, jnp.zeros_like(sm))
+
+
+register_op(
+    "sequence_softmax",
+    inputs=["X", "Length"],
+    outputs=["Out"],
+    lower=_lower_sequence_softmax,
+    no_grad_inputs=("Length",),
+)
+
+register_op(
+    "sequence_reverse",
+    inputs=["X", "Length"],
+    outputs=["Y"],
+    lower=lambda ctx, ins, attrs: _lower_seq_reverse(ins),
+    no_grad_inputs=("Length",),
+)
+
+
+def _lower_seq_reverse(ins):
+    x = ins["X"][0]
+    if "Length" in ins and ins["Length"]:
+        lens = jnp.reshape(ins["Length"][0], (-1,))
+        T = jnp.shape(x)[1]
+        steps = jnp.arange(T)
+        # index (len-1-t) for valid steps, t for padding
+        idx = jnp.where(
+            steps[None, :] < lens[:, None], lens[:, None] - 1 - steps[None, :], steps[None, :]
+        )
+        return jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1
+        )
+    return jnp.flip(x, axis=1)
+
+
+register_op(
+    "sequence_expand",
+    inputs=["X", "Y"],
+    outputs=["Out"],
+    attrs={"ref_level": -1},
+    lower=lambda ctx, ins, attrs: jnp.broadcast_to(
+        ins["X"][0][:, None],
+        (jnp.shape(ins["X"][0])[0], jnp.shape(ins["Y"][0])[1])
+        + tuple(jnp.shape(ins["X"][0])[1:]),
+    ).reshape((-1,) + tuple(jnp.shape(ins["X"][0])[1:])),
+    no_grad_inputs=("Y",),
+)
+
+
+def _lower_sequence_mask(ctx, ins, attrs):
+    lens = jnp.reshape(ins["X"][0], (-1,))
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen <= 0:
+        raise ValueError("sequence_mask on TPU requires static maxlen attr")
+    steps = jnp.arange(maxlen)
+    from paddle_tpu.core.types import canonical_dtype
+
+    return (steps[None, :] < lens[:, None]).astype(
+        canonical_dtype(attrs.get("out_dtype", "int64"))
+    )
+
+
+register_op(
+    "sequence_mask",
+    inputs=["X"],
+    outputs=["Y"],
+    attrs={"maxlen": -1, "out_dtype": "int64"},
+    lower=_lower_sequence_mask,
+    grad=None,
+)

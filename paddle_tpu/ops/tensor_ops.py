@@ -1,0 +1,471 @@
+"""Tensor manipulation ops: fill/reshape/transpose/concat/gather/...
+
+Reference parity: paddle/fluid/operators/{fill_constant,reshape,transpose,
+concat,split,cast,slice,gather,scatter,stack,expand,one_hot,lookup_table,
+top_k,argsort,arg_max,assign,shape,...}_op.cc
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.core.types import canonical_dtype
+from paddle_tpu.ops.common import to_dtype
+
+register_op(
+    "fill_constant",
+    inputs=[],
+    outputs=["Out"],
+    attrs={"shape": [1], "dtype": "float32", "value": 0.0, "force_cpu": False},
+    lower=lambda ctx, ins, attrs: jnp.full(
+        tuple(attrs["shape"]), attrs["value"], canonical_dtype(attrs.get("dtype"))
+    ),
+    grad=None,
+)
+
+register_op(
+    "fill_constant_batch_size_like",
+    inputs=["Input"],
+    outputs=["Out"],
+    attrs={
+        "shape": [1],
+        "dtype": "float32",
+        "value": 0.0,
+        "input_dim_idx": 0,
+        "output_dim_idx": 0,
+    },
+    lower=lambda ctx, ins, attrs: _fill_batch_like(ins["Input"][0], attrs),
+    grad=None,
+)
+
+
+def _fill_batch_like(ref, attrs):
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = jnp.shape(ref)[attrs.get("input_dim_idx", 0)]
+    return jnp.full(tuple(shape), attrs["value"], canonical_dtype(attrs.get("dtype")))
+
+
+register_op(
+    "fill_zeros_like",
+    inputs=["X"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jnp.zeros_like(ins["X"][0]),
+    grad=None,
+)
+
+register_op(
+    "assign",
+    inputs=["X"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: ins["X"][0],
+)
+
+register_op(
+    "cast",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"in_dtype": "float32", "out_dtype": "float32"},
+    lower=lambda ctx, ins, attrs: to_dtype(ins["X"][0], attrs["out_dtype"]),
+)
+
+register_op(
+    "shape",
+    inputs=["Input"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jnp.asarray(jnp.shape(ins["Input"][0]), jnp.int32),
+    grad=None,
+)
+
+
+def _lower_reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    in_shape = jnp.shape(x)
+    # Paddle semantics: 0 copies the input dim at that position; -1 infers.
+    out = [in_shape[i] if d == 0 else d for i, d in enumerate(shape)]
+    return jnp.reshape(x, tuple(out))
+
+
+register_op(
+    "reshape",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"shape": [], "inplace": False},
+    lower=_lower_reshape,
+)
+
+register_op(
+    "reshape2",
+    inputs=["X"],
+    outputs=["Out", "XShape"],
+    attrs={"shape": []},
+    lower=lambda ctx, ins, attrs: {
+        "Out": _lower_reshape(ctx, ins, attrs),
+        "XShape": jnp.zeros((0,) + tuple(jnp.shape(ins["X"][0])), ins["X"][0].dtype),
+    },
+    intermediate_outputs=("XShape",),
+)
+
+register_op(
+    "transpose",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"axis": []},
+    lower=lambda ctx, ins, attrs: jnp.transpose(ins["X"][0], attrs["axis"] or None),
+)
+
+register_op(
+    "transpose2",
+    inputs=["X"],
+    outputs=["Out", "XShape"],
+    attrs={"axis": []},
+    lower=lambda ctx, ins, attrs: {
+        "Out": jnp.transpose(ins["X"][0], attrs["axis"] or None),
+        "XShape": jnp.zeros((0,) + tuple(jnp.shape(ins["X"][0])), ins["X"][0].dtype),
+    },
+    intermediate_outputs=("XShape",),
+)
+
+register_op(
+    "concat",
+    inputs=["*X"],
+    outputs=["Out"],
+    attrs={"axis": 0},
+    lower=lambda ctx, ins, attrs: jnp.concatenate(ins["X"], axis=attrs.get("axis", 0)),
+)
+
+
+def _lower_split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, num, axis=axis)
+    return {"Out": parts}
+
+
+def _split_grad_maker(op, out_grads, wanted):
+    # d(split)/dX = concat of output grads.
+    return [
+        {
+            "type": "concat",
+            "inputs": {"X": out_grads["Out"]},
+            "outputs": {"Out": wanted["X"]},
+            "attrs": {"axis": op.attrs.get("axis", 0)},
+        }
+    ]
+
+
+register_op(
+    "split",
+    inputs=["X"],
+    outputs=["*Out"],
+    attrs={"axis": 0, "num": 0, "sections": []},
+    lower=_lower_split,
+    grad=_split_grad_maker,
+)
+
+
+register_op(
+    "squeeze",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"axes": []},
+    lower=lambda ctx, ins, attrs: _squeeze(ins["X"][0], attrs.get("axes", [])),
+)
+
+register_op(
+    "squeeze2",
+    inputs=["X"],
+    outputs=["Out", "XShape"],
+    attrs={"axes": []},
+    lower=lambda ctx, ins, attrs: {
+        "Out": _squeeze(ins["X"][0], attrs.get("axes", [])),
+        "XShape": jnp.zeros((0,) + tuple(jnp.shape(ins["X"][0])), ins["X"][0].dtype),
+    },
+    intermediate_outputs=("XShape",),
+)
+
+
+def _squeeze(x, axes):
+    if not axes:
+        return jnp.squeeze(x)
+    axes = tuple(a % jnp.ndim(x) for a in axes)
+    axes = tuple(a for a in axes if jnp.shape(x)[a] == 1)
+    return jnp.squeeze(x, axis=axes)
+
+
+register_op(
+    "unsqueeze",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"axes": []},
+    lower=lambda ctx, ins, attrs: jnp.expand_dims(
+        ins["X"][0], tuple(attrs.get("axes", []))
+    ),
+)
+
+register_op(
+    "flatten",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"axis": 1},
+    lower=lambda ctx, ins, attrs: _flatten(ins["X"][0], attrs.get("axis", 1)),
+)
+
+
+def _flatten(x, axis):
+    shape = jnp.shape(x)
+    rows = int(np.prod(shape[:axis])) if axis > 0 else 1
+    return jnp.reshape(x, (rows, -1))
+
+
+register_op(
+    "stack",
+    inputs=["*X"],
+    outputs=["Y"],
+    attrs={"axis": 0},
+    lower=lambda ctx, ins, attrs: jnp.stack(ins["X"], axis=attrs.get("axis", 0)),
+)
+
+
+def _unstack_grad_maker(op, out_grads, wanted):
+    return [
+        {
+            "type": "stack",
+            "inputs": {"X": out_grads["Y"]},
+            "outputs": {"Y": wanted["X"]},
+            "attrs": {"axis": op.attrs.get("axis", 0)},
+        }
+    ]
+
+
+register_op(
+    "unstack",
+    inputs=["X"],
+    outputs=["*Y"],
+    attrs={"axis": 0, "num": 0},
+    lower=lambda ctx, ins, attrs: {
+        "Y": [
+            jnp.squeeze(p, attrs.get("axis", 0))
+            for p in jnp.split(
+                ins["X"][0],
+                jnp.shape(ins["X"][0])[attrs.get("axis", 0)],
+                axis=attrs.get("axis", 0),
+            )
+        ]
+    },
+    grad=_unstack_grad_maker,
+)
+
+register_op(
+    "expand",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"expand_times": []},
+    lower=lambda ctx, ins, attrs: jnp.tile(ins["X"][0], tuple(attrs["expand_times"])),
+)
+
+
+def _lower_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * jnp.ndim(x)
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return x[tuple(idx)]
+
+
+register_op(
+    "slice",
+    inputs=["Input"],
+    outputs=["Out"],
+    attrs={"axes": [], "starts": [], "ends": []},
+    lower=_lower_slice,
+)
+
+register_op(
+    "crop",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"offsets": [], "shape": []},
+    lower=lambda ctx, ins, attrs: jax.lax.dynamic_slice(
+        ins["X"][0], attrs["offsets"], attrs["shape"]
+    ),
+)
+
+register_op(
+    "gather",
+    inputs=["X", "Index"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jnp.take(ins["X"][0], ins["Index"][0], axis=0),
+    no_grad_inputs=("Index",),
+)
+
+register_op(
+    "scatter",
+    inputs=["X", "Ids", "Updates"],
+    outputs=["Out"],
+    attrs={"overwrite": True},
+    lower=lambda ctx, ins, attrs: (
+        ins["X"][0].at[ins["Ids"][0]].set(ins["Updates"][0])
+        if attrs.get("overwrite", True)
+        else ins["X"][0].at[ins["Ids"][0]].add(ins["Updates"][0])
+    ),
+    no_grad_inputs=("Ids",),
+)
+
+register_op(
+    "pad",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"paddings": [], "pad_value": 0.0},
+    lower=lambda ctx, ins, attrs: jnp.pad(
+        ins["X"][0],
+        [
+            (attrs["paddings"][2 * i], attrs["paddings"][2 * i + 1])
+            for i in range(jnp.ndim(ins["X"][0]))
+        ],
+        constant_values=attrs.get("pad_value", 0.0),
+    ),
+)
+
+register_op(
+    "pad2d",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"paddings": [0, 0, 0, 0], "mode": "constant", "pad_value": 0.0,
+           "data_format": "NCHW"},
+    lower=lambda ctx, ins, attrs: _pad2d(ins["X"][0], attrs),
+)
+
+
+def _pad2d(x, attrs):
+    p = attrs["paddings"]
+    if attrs.get("data_format", "NCHW") == "NCHW":
+        pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pads = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    mode = attrs.get("mode", "constant")
+    if mode == "constant":
+        return jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return jnp.pad(x, pads, mode=jmode)
+
+
+register_op(
+    "one_hot",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"depth": 1},
+    lower=lambda ctx, ins, attrs: jax.nn.one_hot(
+        jnp.squeeze(ins["X"][0], -1)
+        if jnp.ndim(ins["X"][0]) > 1 and jnp.shape(ins["X"][0])[-1] == 1
+        else ins["X"][0],
+        attrs["depth"],
+        dtype=jnp.float32,
+    ),
+    grad=None,
+)
+
+
+def _lower_lookup_table(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    if jnp.ndim(ids) > 1 and jnp.shape(ids)[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    padding_idx = attrs.get("padding_idx", -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+register_op(
+    "lookup_table",
+    inputs=["W", "Ids"],
+    outputs=["Out"],
+    attrs={"is_sparse": False, "is_distributed": False, "padding_idx": -1},
+    lower=_lower_lookup_table,
+    no_grad_inputs=("Ids",),
+)
+
+
+def _lower_top_k(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs.get("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+register_op(
+    "top_k",
+    inputs=["X"],
+    outputs=["Out", "Indices"],
+    attrs={"k": 1},
+    lower=_lower_top_k,
+    intermediate_outputs=("Indices",),
+)
+
+register_op(
+    "argsort",
+    inputs=["X"],
+    outputs=["Out", "Indices"],
+    attrs={"axis": -1},
+    lower=lambda ctx, ins, attrs: {
+        "Out": jnp.sort(ins["X"][0], axis=attrs.get("axis", -1)),
+        "Indices": jnp.argsort(ins["X"][0], axis=attrs.get("axis", -1)).astype(
+            jnp.int64
+        ),
+    },
+    grad=None,
+)
+
+register_op(
+    "arg_max",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"axis": 0},
+    lower=lambda ctx, ins, attrs: jnp.argmax(
+        ins["X"][0], axis=attrs.get("axis", 0)
+    ).astype(jnp.int64),
+    grad=None,
+)
+
+register_op(
+    "arg_min",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"axis": 0},
+    lower=lambda ctx, ins, attrs: jnp.argmin(
+        ins["X"][0], axis=attrs.get("axis", 0)
+    ).astype(jnp.int64),
+    grad=None,
+)
+
+register_op(
+    "reverse",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"axis": []},
+    lower=lambda ctx, ins, attrs: jnp.flip(ins["X"][0], axis=tuple(attrs["axis"])),
+)
+
+register_op(
+    "range",
+    inputs=[],
+    outputs=["Out"],
+    attrs={"start": 0, "end": 1, "step": 1, "dtype": "int64"},
+    lower=lambda ctx, ins, attrs: jnp.arange(
+        attrs["start"], attrs["end"], attrs["step"],
+        dtype=canonical_dtype(attrs.get("dtype", "int64")),
+    ),
+    grad=None,
+)

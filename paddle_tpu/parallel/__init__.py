@@ -1,0 +1,16 @@
+"""Multi-device parallelism: mesh + sharding policies (GSPMD).
+
+This package is the TPU-native replacement for the reference's entire
+multi-device/multi-host stack: MultiDevSSAGraphBuilder + NCCL allreduce
+(paddle/fluid/framework/details/), the gRPC parameter server
+(operators/distributed/), and gen_nccl_id bootstrap — all become sharding
+annotations over a jax.sharding.Mesh compiled by XLA into ICI/DCN
+collectives.
+"""
+
+from paddle_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    ShardingPolicy,
+    build_mesh,
+    init_distributed,
+)

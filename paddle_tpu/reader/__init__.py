@@ -1,0 +1,15 @@
+"""Functional reader combinators (python/paddle/reader parity)."""
+
+from paddle_tpu.reader.decorator import (  # noqa: F401
+    batch,
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    multiprocess_reader,
+    shuffle,
+    xmap_readers,
+    Fake,
+)

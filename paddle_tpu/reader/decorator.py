@@ -1,0 +1,248 @@
+"""Reader combinators: map/shuffle/chain/compose/buffered/firstn/xmap.
+
+Reference parity: python/paddle/reader/decorator.py:36-509. Readers are
+zero-arg callables returning iterables of samples; combinators compose them
+— same functional contract as the reference.
+"""
+
+import itertools
+import random
+import threading
+from queue import Queue
+
+__all__ = [
+    "map_readers",
+    "buffered",
+    "compose",
+    "chain",
+    "shuffle",
+    "firstn",
+    "xmap_readers",
+    "multiprocess_reader",
+    "cache",
+    "batch",
+    "Fake",
+]
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(map(make_tuple, outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned"
+                    )
+                yield sum(map(make_tuple, outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    class _End(object):
+        pass
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+
+        def producer():
+            for d in r:
+                q.put(d)
+            q.put(_End)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Thread-pool mapped reader (order-preserving optional)."""
+    end_token = object()
+
+    def data_reader():
+        in_q, out_q = Queue(buffer_size), Queue(buffer_size)
+
+        def read_worker():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample) if order else sample)
+            for _ in range(process_num):
+                in_q.put(end_token)
+
+        def map_worker():
+            while True:
+                sample = in_q.get()
+                if sample is end_token:
+                    out_q.put(end_token)
+                    break
+                if order:
+                    i, s = sample
+                    out_q.put((i, mapper(s)))
+                else:
+                    out_q.put(mapper(sample))
+
+        threading.Thread(target=read_worker, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=map_worker, daemon=True).start()
+
+        finished = 0
+        if order:
+            buf, next_i = {}, 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end_token:
+                    finished += 1
+                    continue
+                i, s = item
+                buf[i] = s
+                while next_i in buf:
+                    yield buf.pop(next_i)
+                    next_i += 1
+            while next_i in buf:
+                yield buf.pop(next_i)
+                next_i += 1
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end_token:
+                    finished += 1
+                else:
+                    yield item
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Thread-backed fan-in (multiprocess in the reference; the GIL-released
+    numpy/JAX host work makes threads equivalent here and fork-safe w/ TPU)."""
+    assert len(readers) > 0
+
+    def data_reader():
+        q = Queue(queue_size)
+        end = object()
+
+        def worker(r):
+            for sample in r():
+                q.put(sample)
+            q.put(end)
+
+        for r in readers:
+            threading.Thread(target=worker, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if item is end:
+                finished += 1
+            else:
+                yield item
+
+    return data_reader
+
+
+def cache(reader):
+    all_data = []
+    state = {"cached": False}
+
+    def data_reader():
+        if not state["cached"]:
+            for d in reader():
+                all_data.append(d)
+            state["cached"] = True
+        return iter(all_data)
+
+    return data_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (paddle.batch parity)."""
+
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+class Fake(object):
+    """Replays the first sample forever (decorator.py:509 Fake parity) —
+    used to make IO-bound perf tests data-independent."""
+
+    def __init__(self):
+        self.fake_reader = None
+
+    def __call__(self, reader, length):
+        def fake():
+            data = next(reader())
+            for _ in range(length):
+                yield data
+
+        return fake

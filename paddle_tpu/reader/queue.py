@@ -1,0 +1,67 @@
+"""Host-side blocking queue feeding the device pipeline.
+
+Reference parity: paddle/fluid/operators/reader/lod_tensor_blocking_queue.h
+— Python producers push batches, the training loop pops; close/kill
+semantics match (close = graceful EOF, kill = abort)."""
+
+import threading
+from collections import deque
+
+
+class EOFException(Exception):
+    """Raised when the queue is drained and closed (reader exhausted)."""
+
+
+class BlockingQueue(object):
+    def __init__(self, capacity):
+        self.capacity = capacity
+        self._q = deque()
+        self._mutex = threading.Lock()
+        self._not_full = threading.Condition(self._mutex)
+        self._not_empty = threading.Condition(self._mutex)
+        self._closed = False
+        self._killed = False
+
+    def push(self, item):
+        with self._not_full:
+            while len(self._q) >= self.capacity and not self._killed:
+                self._not_full.wait(timeout=0.1)
+            if self._killed or self._closed:
+                return False
+            self._q.append(item)
+            self._not_empty.notify()
+            return True
+
+    def pop(self, timeout=None):
+        """Returns an item, or None on EOF."""
+        with self._not_empty:
+            while not self._q:
+                if self._closed or self._killed:
+                    return None
+                self._not_empty.wait(timeout=0.1)
+            item = self._q.popleft()
+            self._not_full.notify()
+            return item
+
+    def close(self):
+        with self._mutex:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    def kill(self):
+        with self._mutex:
+            self._killed = True
+            self._q.clear()
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    def reopen(self):
+        with self._mutex:
+            self._q.clear()
+            self._closed = False
+            self._killed = False
+
+    def size(self):
+        with self._mutex:
+            return len(self._q)

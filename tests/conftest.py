@@ -1,0 +1,28 @@
+"""Test harness config: force an 8-device virtual CPU mesh BEFORE jax
+import so multi-device/GSPMD tests run without TPU hardware (SURVEY.md §4:
+dist-parity tests via multi-device CPU XLA)."""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Give every test fresh default programs, scope and name counters."""
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, unique_name
+    from paddle_tpu.core.scope import Scope
+    import paddle_tpu.executor as executor_mod
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch({})
+    executor_mod._global_scope = Scope()
+    executor_mod._scope_stack = [executor_mod._global_scope]
+    np.random.seed(42)
+    yield

@@ -1,0 +1,90 @@
+"""End-to-end slice: MNIST-style MLP trains to convergence on synthetic
+data (book/test_recognize_digits.py parity, SURVEY.md §7 stage 2)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _make_data(n=512, dim=64, classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim).astype("float32") * 2.0
+    labels = rng.randint(0, classes, size=n).astype("int64")
+    x = centers[labels] + rng.randn(n, dim).astype("float32") * 0.5
+    return x.astype("float32"), labels.reshape(n, 1)
+
+
+def build_mlp(img_dim=64, classes=10):
+    image = fluid.layers.data(name="img", shape=[img_dim], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    hidden = fluid.layers.fc(input=image, size=128, act="relu")
+    hidden = fluid.layers.fc(input=hidden, size=64, act="relu")
+    logits = fluid.layers.fc(input=hidden, size=classes, act=None)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(input=logits, label=label)
+    return image, label, avg_loss, acc
+
+
+def test_mnist_mlp_converges():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        image, label, avg_loss, acc = build_mlp()
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(avg_loss)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    x, y = _make_data()
+    bs = 64
+    first_loss, last_loss, last_acc = None, None, None
+    for epoch in range(6):
+        for i in range(0, len(x), bs):
+            loss_v, acc_v = exe.run(
+                main,
+                feed={"img": x[i : i + bs], "label": y[i : i + bs]},
+                fetch_list=[avg_loss, acc],
+            )
+            if first_loss is None:
+                first_loss = float(loss_v[0])
+        last_loss, last_acc = float(loss_v[0]), float(acc_v[0])
+
+    assert first_loss > last_loss, (first_loss, last_loss)
+    assert last_loss < 0.5, last_loss
+    assert last_acc > 0.85, last_acc
+
+
+def test_program_cache_reused():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        image, label, avg_loss, _ = build_mlp()
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x, y = _make_data(n=128)
+    exe.run(main, feed={"img": x[:64], "label": y[:64]}, fetch_list=[avg_loss])
+    n_compiled = len(exe._cache)
+    exe.run(main, feed={"img": x[64:], "label": y[64:]}, fetch_list=[avg_loss])
+    assert len(exe._cache) == n_compiled  # same shapes -> cached executable
+
+
+def test_infer_after_train():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        image, label, avg_loss, acc = build_mlp()
+        test_program = main.clone(for_test=True)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x, y = _make_data(n=256)
+    for _ in range(40):
+        exe.run(main, feed={"img": x, "label": y}, fetch_list=[avg_loss])
+    acc_v, = exe.run(
+        test_program, feed={"img": x, "label": y}, fetch_list=[acc]
+    )
+    assert float(acc_v[0]) > 0.9
