@@ -1,0 +1,81 @@
+"""Benchmark harness: ResNet-50 training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's best committed ResNet-50 train throughput —
+84.08 img/s (MKL-DNN BS256 on 2x Xeon 6148, benchmark/IntelOptimizedPaddle.md:40-46;
+no GPU/Fluid ResNet numbers are committed in-tree, see BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 84.08
+
+
+def main():
+    import jax
+
+    # BENCH_PLATFORM=cpu forces the CPU backend (the axon TPU plugin ignores
+    # JAX_PLATFORMS, and a wedged tunnel would hang device enumeration).
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    # Full ImageNet shapes on TPU; scaled-down proxy on CPU (CI smoke).
+    if on_tpu:
+        img, bs, steps, warmup = 224, 64, 20, 5
+    else:
+        img, bs, steps, warmup = 64, 16, 5, 2
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = 5
+    startup.random_seed = 5
+    with fluid.program_guard(main_prog, startup):
+        loss, feeds, extras = resnet.build(
+            img_shape=(3, img, img), class_num=1000, depth=50
+        )
+        fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+
+    place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(bs, 3, img, img).astype(np.float32)
+    y = rng.randint(0, 1000, (bs, 1)).astype(np.int64)
+
+    for _ in range(warmup):
+        exe.run(main_prog, feed={"pixel": x, "label": y}, fetch_list=[loss])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(
+            main_prog, feed={"pixel": x, "label": y}, fetch_list=[loss]
+        )
+    # fetch already host-synced (np.asarray in executor)
+    dt = time.perf_counter() - t0
+    img_per_sec = steps * bs / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_throughput"
+                + ("" if on_tpu else "_cpu_proxy"),
+                "value": round(img_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
+            }
+        )
+    )
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Benchmark model zoo (benchmark/fluid/models/ parity): each model module
+exposes ``build(...) -> (loss, feeds, extras)`` constructing the Fluid-style
+program for the Executor to compile whole-graph to XLA."""
+
+from paddle_tpu.models import mnist  # noqa: F401
+from paddle_tpu.models import vgg  # noqa: F401
+from paddle_tpu.models import resnet  # noqa: F401

@@ -181,19 +181,34 @@ def _pool2d_core(x, attrs):
             (paddings[i], paddings[i] + extra[i]) for i in range(2)
         ]
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        # init must be a static python scalar for JAX to recognize the max
+        # monoid and use the differentiable reduce_window_max primitive.
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            init = -np.inf
+        else:
+            init = int(jnp.iinfo(x.dtype).min)
         return jax.lax.reduce_window(
-            x, jnp.asarray(init, x.dtype), jax.lax.max, window, strides4, pads
+            x, init, jax.lax.max, window, strides4, pads
         )
-    # avg pooling: exclusive=True divides by actual (unpadded) window size.
-    ones = jnp.ones_like(x)
-    summed = jax.lax.reduce_window(
-        x, jnp.asarray(0, x.dtype), jax.lax.add, window, strides4, pads
-    )
+    # avg pooling via depthwise conv with a ones kernel (differentiable,
+    # MXU-tiled); exclusive=True divides by the unpadded window size.
+    c = jnp.shape(x)[1]
+    kern = jnp.ones((c, 1) + tuple(ksize), x.dtype)
+    spatial_pads = pads[2:]
+
+    def _sum_pool(v):
+        return jax.lax.conv_general_dilated(
+            v,
+            kern,
+            window_strides=strides,
+            padding=spatial_pads,
+            dimension_numbers=_CONV_DN,
+            feature_group_count=c,
+        )
+
+    summed = _sum_pool(x)
     if attrs.get("exclusive", True):
-        counts = jax.lax.reduce_window(
-            ones, jnp.asarray(0, x.dtype), jax.lax.add, window, strides4, pads
-        )
+        counts = _sum_pool(jnp.ones_like(x))
     else:
         counts = jnp.asarray(float(np.prod(ksize)), x.dtype)
     return summed / counts

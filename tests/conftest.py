@@ -7,6 +7,12 @@ import os
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+import jax
+
+# The axon TPU plugin prepends itself to jax_platforms regardless of the env
+# var; pin the backend explicitly before any computation initializes it.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

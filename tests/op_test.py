@@ -1,0 +1,174 @@
+"""OpTest harness: single-op programs checked for output correctness and
+analytic-vs-numeric gradients.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py:131
+(OpTest base), :43 (get_numeric_gradient), :400 (check_grad). Builds a
+one-op program from numpy inputs, runs it through the XLA executor, and
+compares ``calc_gradient`` results against central finite differences.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+from paddle_tpu.core import op_registry
+
+
+class OpTest(object):
+    """Usage: configure self.op_type / self.inputs / self.outputs /
+    self.attrs then call check_output() / check_grad([...], 'Out')."""
+
+    op_type = None
+    inputs = None
+    outputs = None
+    attrs = None
+
+    def setup(self):
+        pass
+
+    # -- program construction ----------------------------------------------
+    def _build(self):
+        self.setup()
+        main = fluid.Program()
+        startup = fluid.Program()
+        self._feed = {}
+        self._out_vars = {}
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            op_inputs = {}
+            for slot, value in (self.inputs or {}).items():
+                pairs = value if isinstance(value, list) else [(slot, value)]
+                names = []
+                for sub_name, arr in pairs:
+                    arr = np.asarray(arr)
+                    block.create_var(
+                        name=sub_name,
+                        shape=arr.shape,
+                        dtype=str(arr.dtype),
+                        stop_gradient=False,
+                    )
+                    self._feed[sub_name] = arr
+                    names.append(sub_name)
+                op_inputs[slot] = names
+            op_outputs = {}
+            opdef = op_registry.get_op_def(self.op_type)
+            for slot in opdef.output_slots():
+                spec = (self.outputs or {}).get(slot)
+                if spec is None and slot not in (self.outputs or {}):
+                    continue
+                if isinstance(spec, list):
+                    names = [n for n, _ in spec]
+                else:
+                    names = [slot]
+                for n in names:
+                    v = block.create_var(name=n, shape=None, dtype="float32")
+                    self._out_vars[n] = v
+                op_outputs[slot] = names
+            block.append_op(
+                type=self.op_type,
+                inputs=op_inputs,
+                outputs=op_outputs,
+                attrs=dict(self.attrs or {}),
+            )
+        self._main = main
+        return main
+
+    def _expected(self):
+        exp = {}
+        for slot, spec in (self.outputs or {}).items():
+            if isinstance(spec, list):
+                for n, arr in spec:
+                    exp[n] = np.asarray(arr)
+            else:
+                exp[slot] = np.asarray(spec)
+        return exp
+
+    def check_output(self, atol=1e-5, rtol=1e-4, no_check_set=()):
+        main = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        expected = self._expected()
+        names = [n for n in expected if n not in no_check_set]
+        got = exe.run(main, feed=self._feed, fetch_list=names)
+        for n, g in zip(names, got):
+            e = expected[n]
+            np.testing.assert_allclose(
+                np.asarray(g, np.float64),
+                np.asarray(e, np.float64),
+                atol=atol,
+                rtol=rtol,
+                err_msg="op %s output %s mismatch" % (self.op_type, n),
+            )
+
+    # -- gradient checking --------------------------------------------------
+    def check_grad(
+        self,
+        inputs_to_check,
+        output_name,
+        max_relative_error=5e-3,
+        delta=5e-3,
+        no_grad_set=None,
+    ):
+        main = self._build()
+        block = main.global_block()
+        # Random-projection loss sum(out * R): well-conditioned for ops whose
+        # plain output-sum gradient degenerates (batch_norm, softmax).
+        ref_shape = self._expected()[output_name].shape
+        proj = (
+            np.random.RandomState(0)
+            .uniform(0.5, 1.5, ref_shape)
+            .astype("float32")
+        )
+        with fluid.program_guard(main):
+            out_var = block.var(output_name)
+            proj_var = fluid.layers.assign_numpy(proj)
+            proj_var.stop_gradient = True
+            loss = fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(out_var, proj_var)
+            )
+            grads = fluid.calc_gradient(
+                loss,
+                [block.var(n) for n in inputs_to_check],
+                no_grad_set=no_grad_set,
+            )
+        exe = fluid.Executor(fluid.CPUPlace())
+        analytic = exe.run(main, feed=self._feed, fetch_list=grads)
+
+        for name, a_grad in zip(inputs_to_check, analytic):
+            n_grad = self._numeric_grad(name, output_name, delta, proj)
+            a = np.asarray(a_grad, np.float64)
+            b = np.asarray(n_grad, np.float64)
+            abs_a = np.maximum(np.abs(a), np.abs(b))
+            abs_a[abs_a < 1e-3] = 1.0
+            rel = np.abs(a - b) / abs_a
+            assert rel.max() <= max_relative_error, (
+                "op %s grad wrt %s: max rel error %g (analytic vs numeric)\n"
+                "analytic:\n%s\nnumeric:\n%s"
+                % (self.op_type, name, rel.max(), a, b)
+            )
+
+    def _numeric_grad(self, input_name, output_name, delta, proj):
+        """Central finite differences of sum(output * proj) wrt input."""
+        exe = fluid.Executor(fluid.CPUPlace())
+        projd = np.asarray(proj, np.float64)
+
+        def f(feed):
+            (out,) = exe.run(self._main, feed=feed, fetch_list=[output_name])
+            return float(np.sum(np.asarray(out, np.float64) * projd))
+
+        base = {k: np.array(v) for k, v in self._feed.items()}
+        x = base[input_name].astype(np.float64)
+        grad = np.zeros_like(x, np.float64)
+        flat = x.reshape(-1)
+        gflat = grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + delta
+            feed = dict(base)
+            feed[input_name] = x.astype(base[input_name].dtype)
+            fp = f(feed)
+            flat[i] = orig - delta
+            feed[input_name] = x.astype(base[input_name].dtype)
+            fm = f(feed)
+            flat[i] = orig
+            gflat[i] = (fp - fm) / (2 * delta)
+        return grad
