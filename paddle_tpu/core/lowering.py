@@ -72,7 +72,7 @@ class BlockLowerer(object):
     def _iter_ops_recursive(self, block):
         for op in block.ops:
             yield op, block
-            for attr in ("sub_block", "block"):
+            for attr in ("sub_block", "block", "true_block", "false_block"):
                 idx = op.attrs.get(attr)
                 if isinstance(idx, int) and 0 <= idx < self.program.num_blocks:
                     sub = self.program.block(idx)

@@ -437,14 +437,23 @@ def _infer_op_shapes(block, op):
                 else:
                     shape.append(d)
             arrs.append(jax.ShapeDtypeStruct(tuple(shape), np.dtype(_np_name(v.dtype))))
-        if arrs or op.input(slot) == []:
+        # Match the executor's lower_op contract: absent optional slots are
+        # omitted from ins entirely (not passed as empty lists).
+        if arrs:
             ins_structs[slot] = arrs
 
     def f(ins):
         import jax.random as jrandom
 
+        from paddle_tpu.core.lowering import BlockLowerer
+
         ctx = op_registry.LowerContext(
-            op, rng=lambda: jrandom.PRNGKey(0), is_test=False
+            op,
+            rng=lambda: jrandom.PRNGKey(0),
+            is_test=False,
+            # Sub-block mega-ops (recurrent/cond/while) lower their nested
+            # blocks through this — required for their shape inference too.
+            block_lowerer=BlockLowerer(block.program, block.idx),
         )
         return op_registry.normalize_outputs(opdef, opdef.lower(ctx, ins, op.attrs))
 

@@ -19,4 +19,5 @@ from paddle_tpu.layers.learning_rate_scheduler import (  # noqa: F401
     cosine_decay,
 )
 from paddle_tpu.layers.sequence import *  # noqa: F401,F403
+from paddle_tpu.layers.rnn import *  # noqa: F401,F403
 from paddle_tpu.layers.detection import *  # noqa: F401,F403

@@ -1,0 +1,246 @@
+"""Recurrent layers (python/paddle/fluid/layers/nn.py dynamic_lstm/
+dynamic_lstmp/dynamic_gru/gru_unit parity).
+
+Contract matches the reference: ``dynamic_lstm(input, size=4*D)`` expects the
+caller to have projected the raw features with an ``fc`` of size 4*D (the
+reference's lstm_op takes the x@W_x product as Input). The dense-padded
+difference: ``input`` here is [batch, max_len, size] with an optional
+``length`` tensor, instead of an LoD-packed flat tensor.
+"""
+
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "dynamic_lstm",
+    "dynamic_lstmp",
+    "dynamic_gru",
+    "gru_unit",
+    "lstm_unit",
+    "row_conv",
+]
+
+
+def dynamic_lstm(
+    input,
+    size,
+    length=None,
+    h_0=None,
+    c_0=None,
+    param_attr=None,
+    bias_attr=None,
+    use_peepholes=True,
+    is_reverse=False,
+    gate_activation="sigmoid",
+    cell_activation="tanh",
+    candidate_activation="tanh",
+    dtype="float32",
+    name=None,
+):
+    """LSTM over a padded sequence. ``size`` = 4 * hidden_dim.
+
+    Reference: layers/nn.py dynamic_lstm -> lstm_op.cc.
+    """
+    helper = LayerHelper("lstm", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    assert size % 4 == 0, "size must be 4 * hidden_dim"
+    hidden = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[hidden, 4 * hidden], dtype=dtype
+    )
+    bias_size = [1, 7 * hidden] if use_peepholes else [1, 4 * hidden]
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=bias_size, dtype=dtype, is_bias=True
+    )
+    hidden_out = helper.create_variable_for_type_inference(dtype)
+    cell_out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        type="dynamic_lstm",
+        inputs=inputs,
+        outputs={"Hidden": [hidden_out], "Cell": [cell_out]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    return hidden_out, cell_out
+
+
+def dynamic_lstmp(
+    input,
+    size,
+    proj_size,
+    length=None,
+    param_attr=None,
+    bias_attr=None,
+    use_peepholes=True,
+    gate_activation="sigmoid",
+    cell_activation="tanh",
+    candidate_activation="tanh",
+    proj_activation="tanh",
+    dtype="float32",
+    name=None,
+):
+    """Projected LSTM (lstmp_op.cc). size = 4*hidden, proj_size = P."""
+    helper = LayerHelper("lstmp", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    assert size % 4 == 0
+    hidden = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[proj_size, 4 * hidden], dtype=dtype
+    )
+    proj_weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[hidden, proj_size], dtype=dtype
+    )
+    bias_size = [1, 7 * hidden] if use_peepholes else [1, 4 * hidden]
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=bias_size, dtype=dtype, is_bias=True
+    )
+    proj_out = helper.create_variable_for_type_inference(dtype)
+    cell_out = helper.create_variable_for_type_inference(dtype)
+    inputs = {
+        "Input": [input],
+        "Weight": [weight],
+        "ProjWeight": [proj_weight],
+        "Bias": [bias],
+    }
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        type="dynamic_lstmp",
+        inputs=inputs,
+        outputs={"Projection": [proj_out], "Cell": [cell_out]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+            "proj_activation": proj_activation,
+        },
+    )
+    return proj_out, cell_out
+
+
+def dynamic_gru(
+    input,
+    size,
+    length=None,
+    param_attr=None,
+    bias_attr=None,
+    is_reverse=False,
+    gate_activation="sigmoid",
+    candidate_activation="tanh",
+    h_0=None,
+    name=None,
+):
+    """GRU over a padded sequence. ``input`` is [B, T, 3*size]."""
+    helper = LayerHelper("gru", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dtype = input.dtype
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, 3 * size], dtype=dtype
+    )
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype, is_bias=True
+    )
+    hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        type="dynamic_gru",
+        inputs=inputs,
+        outputs={"Hidden": [hidden]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+        },
+    )
+    return hidden
+
+
+def gru_unit(
+    input,
+    hidden,
+    size,
+    param_attr=None,
+    bias_attr=None,
+    activation="tanh",
+    gate_activation="sigmoid",
+):
+    """Single GRU step (gru_unit_op.cc); for StaticRNN bodies."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    size = size // 3
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, 3 * size], dtype=dtype
+    )
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype, is_bias=True
+    )
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_hidden_pre = helper.create_variable_for_type_inference(dtype)
+    updated_hidden = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="gru_unit",
+        inputs={
+            "Input": [input],
+            "HiddenPrev": [hidden],
+            "Weight": [weight],
+            "Bias": [bias],
+        },
+        outputs={
+            "Gate": [gate],
+            "ResetHiddenPrev": [reset_hidden_pre],
+            "Hidden": [updated_hidden],
+        },
+        attrs={
+            "activation": activation,
+            "gate_activation": gate_activation,
+        },
+    )
+    return updated_hidden, reset_hidden_pre, gate
+
+
+def lstm_unit(x_t, c_prev, forget_bias=0.0, name=None):
+    """Single LSTM step over pre-projected gates x_t=[B,4D] (lstm_unit_op)."""
+    helper = LayerHelper("lstm_unit", name=name)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [x_t], "C_prev": [c_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": float(forget_bias)},
+    )
+    return h, c
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (row_conv_op.cc)."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    dtype = input.dtype
+    filter_shape = [future_context_size + 1, int(input.shape[-1])]
+    filter_param = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="row_conv",
+        inputs={"X": [input], "Filter": [filter_param]},
+        outputs={"Out": [out]},
+    )
+    return helper.append_activation(out)

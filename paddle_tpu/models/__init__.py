@@ -5,3 +5,4 @@ program for the Executor to compile whole-graph to XLA."""
 from paddle_tpu.models import mnist  # noqa: F401
 from paddle_tpu.models import vgg  # noqa: F401
 from paddle_tpu.models import resnet  # noqa: F401
+from paddle_tpu.models import stacked_lstm  # noqa: F401

@@ -71,43 +71,27 @@ register_op(
 )
 
 
-def _lower_while(ctx, ins, attrs):
-    """while_op (while_op.cc:36): runs sub_block until Condition is false.
+# while / cond / recurrent sub-block mega-ops live in
+# paddle_tpu/ops/subblock_ops.py (lax.while_loop / lax.cond / lax.scan).
 
-    TPU-first lowering: the loop-carried state is every variable that the
-    sub-block writes AND that exists before the loop (plus the condition
-    var); the body is the sub-block lowered functionally. Requires
-    shape-invariant carries (XLA constraint) — Fluid programs that grow
-    tensor arrays per-iteration must use the scan-based DynamicRNN path.
+
+def _lower_where_select(ctx, ins, attrs):
+    """Batch-element select: Cond [batch, 1] bool picks X rows else Y rows.
+
+    The XLA-friendly merge behind the IfElse layer (reference splits the
+    batch with split_lod_tensor and re-merges, conditional_block_op.cc /
+    split_lod_tensor_op.cc); a select is the dense equivalent.
     """
-    raise NotImplementedError(
-        "while lowering is driven by the executor via sub-block capture; "
-        "see paddle_tpu/ops/subblock_ops.py"
-    )
+    cond = ins["Cond"][0]
+    x, y = ins["X"][0], ins["Y"][0]
+    c = jnp.reshape(cond, (-1,) + (1,) * (x.ndim - 1)).astype(bool)
+    return jnp.where(c, x, y)
 
 
 register_op(
-    "while",
-    inputs=["*X", "Condition"],
-    outputs=["*Out", "StepScopes"],
-    attrs={"sub_block": -1},
-    lower=_lower_while,
-    grad=None,
-)
-
-
-def _lower_conditional_block(ctx, ins, attrs):
-    raise NotImplementedError(
-        "conditional_block lowering is driven by the executor; "
-        "see paddle_tpu/ops/subblock_ops.py"
-    )
-
-
-register_op(
-    "conditional_block",
-    inputs=["*X", "Cond"],
-    outputs=["*Out", "Scope"],
-    attrs={"sub_block": -1, "is_scalar_condition": False},
-    lower=_lower_conditional_block,
-    grad=None,
+    "where_select",
+    inputs=["Cond", "X", "Y"],
+    outputs=["Out"],
+    lower=_lower_where_select,
+    no_grad_inputs=("Cond",),
 )

@@ -1,0 +1,397 @@
+"""Recurrent ops lowered to lax.scan.
+
+Reference parity: ``paddle/fluid/operators/lstm_op.cc``, ``gru_op.cc``,
+``lstm_unit_op.cc``, ``gru_unit_op.cc``, ``row_conv_op.cc``. The reference
+batches LoD-packed sequences via ``operators/math/sequence2batch.h`` and
+runs per-timestep fused CPU/CUDA kernels (``math/lstm_compute``,
+``math/gru_compute``); on TPU the idiomatic form is a dense-padded
+[batch, max_len, d] tensor with an optional Length input, scanned over the
+time axis with ``lax.scan`` so XLA unrolls/pipelines the recurrence and the
+per-step matmul lands on the MXU. Gradients come from jax.vjp over the whole
+scan (the registry's auto-grad), which is exactly scan's reverse pass —
+no StepScopes replay needed (SURVEY.md §7 hard part (g)).
+
+Dense-shape contract (differs from the reference's LoD packing by design):
+  Input: [batch, T, gates*D]   (projected input, i.e. x @ W_x, as in the
+                                reference where the user applies fc first)
+  Weight: recurrence weights   Bias: [1, gates*D] (+peephole cols for lstm)
+  Length: optional [batch] int lengths for masking.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _act(name):
+    return _ACTS[name or "tanh"]
+
+
+def _time_major(x):
+    # [B, T, ...] -> [T, B, ...]
+    return jnp.moveaxis(x, 1, 0)
+
+
+def _batch_major(x):
+    return jnp.moveaxis(x, 0, 1)
+
+
+def _step_mask(ins, x):
+    """[T, B, 1] float mask from optional Length input ([B] lengths)."""
+    if "Length" in ins and ins["Length"]:
+        lens = jnp.reshape(ins["Length"][0], (-1,))
+        T = jnp.shape(x)[1]
+        m = (jnp.arange(T)[:, None] < lens[None, :]).astype(x.dtype)
+        return m[:, :, None]
+    return None
+
+
+def _masked(new, old, m_t):
+    if m_t is None:
+        return new
+    return new * m_t + old * (1.0 - m_t)
+
+
+# ---------------------------------------------------------------------------
+# dynamic_lstm  (lstm_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _lower_dynamic_lstm(ctx, ins, attrs):
+    x = ins["Input"][0]  # [B, T, 4D]
+    w = ins["Weight"][0]  # [D, 4D]
+    B, T = jnp.shape(x)[0], jnp.shape(x)[1]
+    D = jnp.shape(w)[0]
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    use_peepholes = attrs.get("use_peepholes", True)
+
+    bias = ins.get("Bias", [None])[0]
+    if bias is not None:
+        bias = jnp.reshape(bias, (-1,))
+        b_gate = bias[: 4 * D]
+        if use_peepholes:
+            w_ic = bias[4 * D: 5 * D]
+            w_fc = bias[5 * D: 6 * D]
+            w_oc = bias[6 * D: 7 * D]
+        else:
+            w_ic = w_fc = w_oc = None
+    else:
+        b_gate = jnp.zeros((4 * D,), x.dtype)
+        w_ic = w_fc = w_oc = None
+
+    h0 = ins.get("H0", [None])[0]
+    c0 = ins.get("C0", [None])[0]
+    if h0 is None:
+        h0 = jnp.zeros((B, D), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, D), x.dtype)
+
+    xs = _time_major(x)  # [T, B, 4D]
+    if attrs.get("is_reverse", False):
+        xs = jnp.flip(xs, axis=0)
+    mask = _step_mask(ins, x)
+    if attrs.get("is_reverse", False) and mask is not None:
+        mask = jnp.flip(mask, axis=0)
+
+    def cell_fn(carry, xm):
+        h_prev, c_prev = carry
+        xt, m_t = xm
+        gates = xt + h_prev @ w + b_gate  # [B, 4D]
+        gi = gates[:, 0 * D:1 * D]
+        gf = gates[:, 1 * D:2 * D]
+        gc = gates[:, 2 * D:3 * D]
+        go = gates[:, 3 * D:4 * D]
+        if w_ic is not None:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c_prev + i * cand_act(gc)
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        h_new = _masked(h_new, h_prev, m_t)
+        c_new = _masked(c_new, c_prev, m_t)
+        return (h_new, c_new), (h_new, c_new)
+
+    ms = mask if mask is not None else jnp.ones((T, 1, 1), x.dtype)
+    (_, _), (hs, cs) = jax.lax.scan(cell_fn, (h0, c0), (xs, ms))
+    if attrs.get("is_reverse", False):
+        hs = jnp.flip(hs, axis=0)
+        cs = jnp.flip(cs, axis=0)
+    return {"Hidden": _batch_major(hs), "Cell": _batch_major(cs)}
+
+
+register_op(
+    "dynamic_lstm",
+    inputs=["Input", "H0", "C0", "Weight", "Bias", "Length"],
+    outputs=["Hidden", "Cell"],
+    attrs={
+        "use_peepholes": True,
+        "is_reverse": False,
+        "gate_activation": "sigmoid",
+        "cell_activation": "tanh",
+        "candidate_activation": "tanh",
+    },
+    lower=_lower_dynamic_lstm,
+    no_grad_inputs=("Length",),
+)
+
+
+# ---------------------------------------------------------------------------
+# dynamic_lstmp  (lstmp_op.cc — LSTM with a recurrent projection layer)
+# ---------------------------------------------------------------------------
+
+
+def _lower_dynamic_lstmp(ctx, ins, attrs):
+    x = ins["Input"][0]  # [B, T, 4D]
+    w = ins["Weight"][0]  # [P, 4D] recurrence over projected state
+    w_proj = ins["ProjWeight"][0]  # [D, P]
+    B = jnp.shape(x)[0]
+    D = jnp.shape(w_proj)[0]
+    P = jnp.shape(w_proj)[1]
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    proj_act = _act(attrs.get("proj_activation", "identity"))
+    use_peepholes = attrs.get("use_peepholes", True)
+
+    bias = ins.get("Bias", [None])[0]
+    if bias is not None:
+        bias = jnp.reshape(bias, (-1,))
+        b_gate = bias[: 4 * D]
+        if use_peepholes:
+            w_ic = bias[4 * D: 5 * D]
+            w_fc = bias[5 * D: 6 * D]
+            w_oc = bias[6 * D: 7 * D]
+        else:
+            w_ic = w_fc = w_oc = None
+    else:
+        b_gate = jnp.zeros((4 * D,), x.dtype)
+        w_ic = w_fc = w_oc = None
+
+    r0 = jnp.zeros((B, P), x.dtype)
+    c0 = jnp.zeros((B, D), x.dtype)
+    xs = _time_major(x)
+    mask = _step_mask(ins, x)
+    ms = mask if mask is not None else jnp.ones(
+        (jnp.shape(x)[1], 1, 1), x.dtype
+    )
+
+    def cell_fn(carry, xm):
+        r_prev, c_prev = carry
+        xt, m_t = xm
+        gates = xt + r_prev @ w + b_gate
+        gi = gates[:, 0 * D:1 * D]
+        gf = gates[:, 1 * D:2 * D]
+        gc = gates[:, 2 * D:3 * D]
+        go = gates[:, 3 * D:4 * D]
+        if w_ic is not None:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c_prev + i * cand_act(gc)
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        r_new = proj_act(h_new @ w_proj)
+        r_new = _masked(r_new, r_prev, m_t)
+        c_new = _masked(c_new, c_prev, m_t)
+        return (r_new, c_new), (r_new, c_new)
+
+    (_, _), (rs, cs) = jax.lax.scan(cell_fn, (r0, c0), (xs, ms))
+    return {"Projection": _batch_major(rs), "Cell": _batch_major(cs)}
+
+
+register_op(
+    "dynamic_lstmp",
+    inputs=["Input", "Weight", "ProjWeight", "Bias", "Length"],
+    outputs=["Projection", "Cell"],
+    attrs={
+        "use_peepholes": True,
+        "gate_activation": "sigmoid",
+        "cell_activation": "tanh",
+        "candidate_activation": "tanh",
+        "proj_activation": "identity",
+    },
+    lower=_lower_dynamic_lstmp,
+    no_grad_inputs=("Length",),
+)
+
+
+# ---------------------------------------------------------------------------
+# dynamic_gru  (gru_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _lower_dynamic_gru(ctx, ins, attrs):
+    x = ins["Input"][0]  # [B, T, 3D]
+    w = ins["Weight"][0]  # [D, 3D]: [:, :2D] gate weights, [:, 2D:] candidate
+    B = jnp.shape(x)[0]
+    D = jnp.shape(w)[0]
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+
+    bias = ins.get("Bias", [None])[0]
+    b = (
+        jnp.reshape(bias, (-1,))
+        if bias is not None
+        else jnp.zeros((3 * D,), x.dtype)
+    )
+    w_g = w[:, : 2 * D]
+    w_c = w[:, 2 * D:]
+
+    h0 = ins.get("H0", [None])[0]
+    if h0 is None:
+        h0 = jnp.zeros((B, D), x.dtype)
+
+    xs = _time_major(x)
+    if attrs.get("is_reverse", False):
+        xs = jnp.flip(xs, axis=0)
+    mask = _step_mask(ins, x)
+    if attrs.get("is_reverse", False) and mask is not None:
+        mask = jnp.flip(mask, axis=0)
+    ms = mask if mask is not None else jnp.ones(
+        (jnp.shape(x)[1], 1, 1), x.dtype
+    )
+
+    def cell_fn(h_prev, xm):
+        xt, m_t = xm
+        g = xt[:, : 2 * D] + h_prev @ w_g + b[: 2 * D]
+        u = gate_act(g[:, :D])
+        r = gate_act(g[:, D:])
+        c = cand_act(xt[:, 2 * D:] + (r * h_prev) @ w_c + b[2 * D:])
+        h_new = u * h_prev + (1.0 - u) * c
+        h_new = _masked(h_new, h_prev, m_t)
+        return h_new, h_new
+
+    _, hs = jax.lax.scan(cell_fn, h0, (xs, ms))
+    if attrs.get("is_reverse", False):
+        hs = jnp.flip(hs, axis=0)
+    return {"Hidden": _batch_major(hs)}
+
+
+register_op(
+    "dynamic_gru",
+    inputs=["Input", "H0", "Weight", "Bias", "Length"],
+    outputs=["Hidden"],
+    attrs={
+        "is_reverse": False,
+        "gate_activation": "sigmoid",
+        "activation": "tanh",
+    },
+    lower=_lower_dynamic_gru,
+    no_grad_inputs=("Length",),
+)
+
+
+# ---------------------------------------------------------------------------
+# single-step units (lstm_unit_op.cc, gru_unit_op.cc) — building blocks for
+# StaticRNN-style user-composed recurrences.
+# ---------------------------------------------------------------------------
+
+
+def _lower_lstm_unit(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, 4D] pre-projected gates
+    c_prev = ins["C_prev"][0]  # [B, D]
+    D = jnp.shape(c_prev)[1]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i = jax.nn.sigmoid(x[:, 0 * D:1 * D])
+    f = jax.nn.sigmoid(x[:, 1 * D:2 * D] + forget_bias)
+    g = jnp.tanh(x[:, 2 * D:3 * D])
+    o = jax.nn.sigmoid(x[:, 3 * D:4 * D])
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+register_op(
+    "lstm_unit",
+    inputs=["X", "C_prev"],
+    outputs=["C", "H"],
+    attrs={"forget_bias": 0.0},
+    lower=_lower_lstm_unit,
+)
+
+
+def _lower_gru_unit(ctx, ins, attrs):
+    x = ins["Input"][0]  # [B, 3D] projected input
+    h_prev = ins["HiddenPrev"][0]  # [B, D]
+    w = ins["Weight"][0]  # [D, 3D]
+    D = jnp.shape(h_prev)[1]
+    bias = ins.get("Bias", [None])[0]
+    b = (
+        jnp.reshape(bias, (-1,))
+        if bias is not None
+        else jnp.zeros((3 * D,), x.dtype)
+    )
+    gate_act = _act(
+        {1: "sigmoid", 2: "tanh", 0: "identity", 3: "relu"}.get(
+            attrs.get("gate_activation", 1), "sigmoid"
+        )
+        if isinstance(attrs.get("gate_activation", 1), int)
+        else attrs.get("gate_activation", "sigmoid")
+    )
+    cand_act = _act(
+        {1: "sigmoid", 2: "tanh", 0: "identity", 3: "relu"}.get(
+            attrs.get("activation", 2), "tanh"
+        )
+        if isinstance(attrs.get("activation", 2), int)
+        else attrs.get("activation", "tanh")
+    )
+    g = x[:, : 2 * D] + h_prev @ w[:, : 2 * D] + b[: 2 * D]
+    u = gate_act(g[:, :D])
+    r = gate_act(g[:, D:])
+    c = cand_act(x[:, 2 * D:] + (r * h_prev) @ w[:, 2 * D:] + b[2 * D:])
+    h = u * h_prev + (1.0 - u) * c
+    gate = jnp.concatenate([u, r, c], axis=1)
+    return {"Gate": gate, "ResetHiddenPrev": r * h_prev, "Hidden": h}
+
+
+register_op(
+    "gru_unit",
+    inputs=["Input", "HiddenPrev", "Weight", "Bias"],
+    outputs=["Gate", "ResetHiddenPrev", "Hidden"],
+    attrs={"activation": 2, "gate_activation": 1},
+    lower=_lower_gru_unit,
+    intermediate_outputs=("Gate", "ResetHiddenPrev"),
+)
+
+
+# ---------------------------------------------------------------------------
+# row_conv (row_conv_op.cc — lookahead convolution for streaming ASR)
+# ---------------------------------------------------------------------------
+
+
+def _lower_row_conv(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, D]
+    f = ins["Filter"][0]  # [future_context + 1, D]
+    k = jnp.shape(f)[0]
+    T = jnp.shape(x)[1]
+    # out[t] = sum_{j=0..k-1} x[t+j] * f[j]  (zero past the end)
+    padded = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for j in range(int(k)):
+        out = out + padded[:, j:j + T, :] * f[j][None, None, :]
+    return {"Out": out}
+
+
+register_op(
+    "row_conv",
+    inputs=["X", "Filter"],
+    outputs=["Out"],
+    lower=_lower_row_conv,
+)

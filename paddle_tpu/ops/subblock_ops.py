@@ -1,0 +1,290 @@
+"""Sub-block mega-ops: recurrent (StaticRNN), cond, while, tensor arrays.
+
+Reference parity: ``paddle/fluid/operators/recurrent_op.cc`` (static RNN
+over StepScopes), ``while_op.cc:36``, ``conditional_block_op.cc``, and the
+tensor-array ops (``tensor_array_read_write_op.cc``). The reference runs a
+nested Executor per iteration and records StepScopes for the backward pass;
+the TPU-first lowering traces the sub-block ONCE into the body of
+``lax.scan`` / ``lax.while_loop`` / ``lax.cond``, so the whole loop compiles
+into a single XLA While/Conditional and the backward pass of ``recurrent``
+is jax.vjp over scan — no scope replay (SURVEY.md §7 hard part (g)).
+
+Conventions:
+  * sequence tensors are [batch, T, ...]; scan runs time-major internally.
+  * carried state must be shape-invariant (XLA constraint).
+  * tensor arrays are (buffer[capacity, ...], size:int32) pytree pairs.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+
+def _sub_lowerer(ctx, block_idx):
+    from paddle_tpu.core.lowering import BlockLowerer
+
+    parent = ctx.block_lowerer
+    return BlockLowerer(parent.program, block_idx, is_test=parent.is_test)
+
+
+def _run_block(sub, env, key):
+    for op in sub.block.ops:
+        sub.lower_op(op, env, key)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# recurrent — scan-based StaticRNN
+# ---------------------------------------------------------------------------
+
+
+def _lower_recurrent(ctx, ins, attrs):
+    sub = _sub_lowerer(ctx, attrs["sub_block"])
+    in_names = list(attrs.get("input_step_names", []))
+    pre_names = list(attrs.get("pre_state_names", []))
+    state_names = list(attrs.get("state_names", []))
+    out_names = list(attrs.get("output_step_names", []))
+    param_names = list(attrs.get("param_names", []))
+    reverse = attrs.get("reverse", False)
+
+    seq_inputs = ins.get("inputs", [])
+    init_states = ins.get("initial_states", [])
+    params = ins.get("parameters", [])
+    base_key = ctx.rng()
+
+    xs = tuple(jnp.moveaxis(x, 1, 0) for x in seq_inputs)  # [T, B, ...]
+    if reverse:
+        xs = tuple(jnp.flip(x, axis=0) for x in xs)
+
+    def body(carry, x_ts):
+        t, states = carry
+        key = jax.random.fold_in(base_key, t)
+        env = dict(zip(param_names, params))
+        env.update(zip(pre_names, states))
+        env.update(zip(in_names, x_ts))
+        _run_block(sub, env, key)
+        new_states = tuple(env[n] for n in state_names)
+        ys = tuple(env[n] for n in out_names)
+        return (t + 1, new_states), ys
+
+    (_, final_states), ys = jax.lax.scan(
+        body, (jnp.asarray(0, jnp.int32), tuple(init_states)), xs
+    )
+    outputs = [jnp.moveaxis(y, 0, 1) for y in ys]
+    if reverse:
+        outputs = [jnp.flip(y, axis=1) for y in outputs]
+    return {"outputs": outputs, "final_states": list(final_states)}
+
+
+register_op(
+    "recurrent",
+    inputs=["*inputs", "*initial_states", "*parameters"],
+    outputs=["*outputs", "*final_states"],
+    attrs={
+        "sub_block": -1,
+        "input_step_names": [],
+        "pre_state_names": [],
+        "state_names": [],
+        "output_step_names": [],
+        "param_names": [],
+        "reverse": False,
+    },
+    lower=_lower_recurrent,
+)
+
+
+# ---------------------------------------------------------------------------
+# cond — two-branch conditional (conditional_block/IfElse capability)
+# ---------------------------------------------------------------------------
+
+
+def _lower_cond(ctx, ins, attrs):
+    """lax.cond over two sub-blocks. Both branches must produce the declared
+    output names with matching shapes (XLA conditional contract)."""
+    input_names = list(attrs.get("input_names", []))
+    true_outs = list(attrs.get("true_out_names", []))
+    false_outs = list(attrs.get("false_out_names", []))
+    sub_t = _sub_lowerer(ctx, attrs["true_block"])
+    sub_f = _sub_lowerer(ctx, attrs["false_block"])
+    xs = ins.get("X", [])
+    pred = jnp.reshape(ins["Cond"][0], ()).astype(bool)
+    key = ctx.rng()
+
+    def branch(sub, out_names):
+        def fn(args):
+            env = dict(zip(input_names, args))
+            _run_block(sub, env, key)
+            return tuple(env[n] for n in out_names)
+
+        return fn
+
+    outs = jax.lax.cond(
+        pred, branch(sub_t, true_outs), branch(sub_f, false_outs), tuple(xs)
+    )
+    return {"Out": list(outs)}
+
+
+register_op(
+    "cond",
+    inputs=["Cond", "*X"],
+    outputs=["*Out"],
+    attrs={
+        "true_block": -1,
+        "false_block": -1,
+        "input_names": [],
+        "true_out_names": [],
+        "false_out_names": [],
+    },
+    lower=_lower_cond,
+    no_grad_inputs=("Cond",),
+)
+
+
+# ---------------------------------------------------------------------------
+# while — lax.while_loop over a sub-block (forward-only, while_op.cc parity)
+# ---------------------------------------------------------------------------
+
+
+def _lower_while(ctx, ins, attrs):
+    """Carried state = the declared carry vars (attr carry_names), which the
+    sub-block reads and writes; Condition is one of them (a [1] bool).
+
+    Reverse-mode autodiff of an unbounded while is impossible under XLA;
+    training-time recurrences use ``recurrent``/DynamicRNN (scan). This op
+    serves inference-time decode loops (beam search etc.).
+    """
+    carry_names = list(attrs.get("carry_names", []))
+    param_names = list(attrs.get("param_names", []))
+    cond_name = attrs["cond_name"]
+    sub = _sub_lowerer(ctx, attrs["sub_block"])
+    carries = ins.get("X", [])
+    params = ins.get("parameters", [])
+    base_key = ctx.rng()
+
+    max_iters = attrs.get("max_iterations", 0)
+
+    def cond_fn(state):
+        t, vals = state
+        env = dict(zip(carry_names, vals))
+        ok = jnp.reshape(env[cond_name], ()).astype(bool)
+        if max_iters:
+            ok = jnp.logical_and(ok, t < max_iters)
+        return ok
+
+    def body_fn(state):
+        t, vals = state
+        env = dict(zip(param_names, params))
+        env.update(zip(carry_names, vals))
+        _run_block(sub, env, jax.random.fold_in(base_key, t))
+        return (t + 1, tuple(env[n] for n in carry_names))
+
+    _, final = jax.lax.while_loop(
+        cond_fn, body_fn, (jnp.asarray(0, jnp.int32), tuple(carries))
+    )
+    return {"Out": list(final)}
+
+
+register_op(
+    "while",
+    inputs=["*X", "*parameters"],
+    outputs=["*Out"],
+    attrs={
+        "sub_block": -1,
+        "carry_names": [],
+        "param_names": [],
+        "cond_name": "",
+        "max_iterations": 0,
+    },
+    lower=_lower_while,
+    grad=None,
+)
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays — (buffer, size) pairs with static capacity
+# ---------------------------------------------------------------------------
+
+
+def _lower_write_to_array(ctx, ins, attrs):
+    x = ins["X"][0]
+    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32)
+    arr = ins.get("Array", [None])
+    if arr and arr[0] is not None:
+        buf, size = arr[0]
+    else:
+        cap = int(attrs.get("capacity", 0))
+        if cap <= 0:
+            raise ValueError(
+                "first write_to_array needs a static 'capacity' attr "
+                "(XLA needs fixed buffer shapes)"
+            )
+        buf = jnp.zeros((cap,) + tuple(jnp.shape(x)), x.dtype)
+        size = jnp.asarray(0, jnp.int32)
+    buf = jax.lax.dynamic_update_index_in_dim(buf, x, i, axis=0)
+    size = jnp.maximum(size, i + 1)
+    return {"Out": [(buf, size)]}
+
+
+register_op(
+    "write_to_array",
+    inputs=["X", "I", "Array"],
+    outputs=["Out"],
+    attrs={"capacity": 0},
+    lower=_lower_write_to_array,
+    grad=None,
+)
+
+
+register_op(
+    "read_from_array",
+    inputs=["X", "I"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jax.lax.dynamic_index_in_dim(
+        ins["X"][0][0],
+        jnp.reshape(ins["I"][0], ()).astype(jnp.int32),
+        axis=0,
+        keepdims=False,
+    ),
+    grad=None,
+)
+
+
+register_op(
+    "lod_array_length",
+    inputs=["X"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: jnp.reshape(
+        ins["X"][0][1].astype(jnp.int64), (1,)
+    ),
+    grad=None,
+)
+
+
+register_op(
+    "array_to_lod_tensor",
+    inputs=["X", "RankTable"],
+    outputs=["Out"],
+    # Stacked time-major array buffer [cap, B, ...] -> dense batch-major
+    # [B, cap, ...] tensor, inverting lod_tensor_to_array. Unwritten slots
+    # past the array's size remain zero padding (dense-padded regime; the
+    # reference's LoD restore re-packs ragged rows instead).
+    lower=lambda ctx, ins, attrs: jnp.moveaxis(ins["X"][0][0], 0, 1),
+    grad=None,
+)
+
+
+register_op(
+    "lod_tensor_to_array",
+    inputs=["X", "RankTable"],
+    outputs=["Out"],
+    lower=lambda ctx, ins, attrs: {
+        "Out": [
+            (
+                jnp.moveaxis(ins["X"][0], 1, 0),
+                jnp.asarray(jnp.shape(ins["X"][0])[1], jnp.int32),
+            )
+        ]
+    },
+    grad=None,
+)

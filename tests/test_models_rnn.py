@@ -1,0 +1,61 @@
+"""Stacked-LSTM model convergence (benchmark/fluid stacked_dynamic_lstm
+recipe on synthetic separable sentiment data)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import stacked_lstm
+
+
+def _synthetic_sentiment(n, seq_len, dict_size, rng):
+    """Class 0 draws tokens from the low half of the vocab, class 1 from
+    the high half — linearly separable through the embedding."""
+    words = np.zeros((n, seq_len), "int64")
+    lens = rng.randint(seq_len // 2, seq_len + 1, size=n).astype("int64")
+    labels = rng.randint(0, 2, size=(n, 1)).astype("int64")
+    for i in range(n):
+        lo, hi = (2, dict_size // 2) if labels[i, 0] == 0 else (
+            dict_size // 2, dict_size - 1
+        )
+        words[i, : lens[i]] = rng.randint(lo, hi, size=lens[i])
+    return words, lens.reshape(-1, 1), labels
+
+
+def test_stacked_lstm_converges():
+    seq_len, dict_size = 16, 200
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        loss, feeds, extras = stacked_lstm.build(
+            seq_len=seq_len,
+            dict_size=dict_size,
+            emb_dim=16,
+            hid_dim=16,
+            stacked_num=2,
+        )
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    bs = 32
+    first = None
+    accs = []
+    for step in range(30):
+        words, lens, labels = _synthetic_sentiment(
+            bs, seq_len, dict_size, rng
+        )
+        lv, acc = exe.run(
+            main,
+            feed={"words": words, "length": lens, "label": labels},
+            fetch_list=[loss, extras["accuracy"]],
+        )
+        if first is None:
+            first = float(np.asarray(lv).ravel()[0])
+        accs.append(float(np.asarray(acc).ravel()[0]))
+    last = float(np.asarray(lv).ravel()[0])
+    assert np.isfinite(last)
+    assert last < first * 0.6, (first, last)
+    assert np.mean(accs[-5:]) > 0.8, accs

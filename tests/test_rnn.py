@@ -1,0 +1,320 @@
+"""RNN + control-flow tests.
+
+Reference parity: tests/unittests/test_lstm_op.py, test_gru_op.py,
+test_recurrent_op.py, test_while_op.py, test_dynrnn_* — adapted to the
+dense-padded sequence regime.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import backward
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_dynamic_lstm_matches_numpy():
+    B, T, D = 3, 5, 4
+    np.random.seed(0)
+    x = np.random.randn(B, T, 4 * D).astype("float32") * 0.1
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data("x", shape=[T, 4 * D])
+        h, c = fluid.layers.dynamic_lstm(
+            input=inp, size=4 * D, use_peepholes=False,
+            param_attr=fluid.ParamAttr(
+                name="lstm_w",
+                initializer=fluid.initializer.ConstantInitializer(0.05),
+            ),
+            bias_attr=fluid.ParamAttr(
+                name="lstm_b",
+                initializer=fluid.initializer.ConstantInitializer(0.1),
+            ),
+        )
+    hv, cv = _run(main, startup, {"x": x}, [h, c])
+
+    # numpy reference
+    w = np.full((D, 4 * D), 0.05, "float32")
+    b = np.full((4 * D,), 0.1, "float32")
+    hp = np.zeros((B, D), "float32")
+    cp = np.zeros((B, D), "float32")
+    for t in range(T):
+        g = x[:, t] + hp @ w + b
+        i = _sigmoid(g[:, :D])
+        f = _sigmoid(g[:, D:2 * D])
+        cand = np.tanh(g[:, 2 * D:3 * D])
+        o = _sigmoid(g[:, 3 * D:])
+        cp = f * cp + i * cand
+        hp = o * np.tanh(cp)
+    np.testing.assert_allclose(np.asarray(hv)[:, -1], hp, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cv)[:, -1], cp, atol=1e-5)
+
+
+def test_dynamic_lstm_length_mask():
+    """Hidden state freezes past each sequence's end."""
+    B, T, D = 2, 6, 3
+    np.random.seed(1)
+    x = np.random.randn(B, T, 4 * D).astype("float32")
+    lens = np.array([3, 6], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data("x", shape=[T, 4 * D])
+        ln = fluid.layers.data("len", shape=[1], dtype="int64")
+        h, _ = fluid.layers.dynamic_lstm(input=inp, size=4 * D, length=ln)
+    hv, = _run(main, startup, {"x": x, "len": lens}, [h])
+    hv = np.asarray(hv)
+    # steps >= len keep the value from step len-1
+    np.testing.assert_allclose(hv[0, 3], hv[0, 2], atol=1e-6)
+    np.testing.assert_allclose(hv[0, 5], hv[0, 2], atol=1e-6)
+    assert not np.allclose(hv[1, 5], hv[1, 2])
+
+
+def test_dynamic_gru_runs_and_trains():
+    B, T, D = 4, 7, 8
+    np.random.seed(2)
+    x = np.random.randn(B, T, 3 * D).astype("float32") * 0.1
+    y = np.random.randn(B, D).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data("x", shape=[T, 3 * D])
+        label = fluid.layers.data("y", shape=[D])
+        proj = fluid.layers.fc(input=inp, size=3 * D, num_flatten_dims=2)
+        hidden = fluid.layers.dynamic_gru(input=proj, size=D)
+        last = fluid.layers.sequence_last_step(hidden)
+        out = fluid.layers.fc(input=last, size=D)
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(out, label))
+        )
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = [
+        float(np.asarray(exe.run(main, feed={"x": x, "y": y},
+                                 fetch_list=[loss])[0]).ravel()[0])
+        for _ in range(40)
+    ]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_static_rnn_matches_manual_loop():
+    B, T, D = 2, 4, 3
+    np.random.seed(3)
+    x = np.random.randn(B, T, D).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data("x", shape=[T, D])
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(inp)
+            h_prev = rnn.memory(shape=[-1, D], batch_ref=inp, init_value=0.0)
+            h = fluid.layers.elementwise_add(
+                fluid.layers.tanh(x_t), h_prev
+            )
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    ov, = _run(main, startup, {"x": x}, [out])
+    ov = np.asarray(ov)
+
+    hp = np.zeros((B, D), "float32")
+    expect = []
+    for t in range(T):
+        hp = np.tanh(x[:, t]) + hp
+        expect.append(hp)
+    np.testing.assert_allclose(ov, np.stack(expect, 1), atol=1e-5)
+
+
+def test_static_rnn_with_fc_trains():
+    """StaticRNN with a parameterized step (fc) — grads flow through scan."""
+    B, T, D, H = 4, 5, 6, 8
+    np.random.seed(4)
+    x = np.random.randn(B, T, D).astype("float32")
+    y = np.random.randn(B, H).astype("float32") * 0.3
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data("x", shape=[T, D])
+        label = fluid.layers.data("y", shape=[H])
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(inp)
+            h_prev = rnn.memory(shape=[-1, H], batch_ref=inp)
+            h = fluid.layers.fc(input=[x_t, h_prev], size=H, act="tanh")
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+        last = fluid.layers.sequence_last_step(out)
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(last, label))
+        )
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = [
+        float(np.asarray(exe.run(main, feed={"x": x, "y": y},
+                                 fetch_list=[loss])[0]).ravel()[0])
+        for _ in range(25)
+    ]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_while_loop_accumulates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        limit = fluid.layers.fill_constant([1], "int64", 10)
+        acc = fluid.layers.fill_constant([1], "float32", 0.0)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond)
+        with w.block():
+            acc2 = fluid.layers.elementwise_add(
+                acc, fluid.layers.cast(i, "float32")
+            )
+            fluid.layers.assign(acc2, acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, limit, cond=cond)
+    av, = _run(main, startup, {}, [acc])
+    assert float(np.asarray(av).ravel()[0]) == sum(range(10))
+
+
+def test_cond_branches():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        p = fluid.layers.data("p", shape=[1], dtype="bool")
+
+        def true_fn():
+            return fluid.layers.scale(x, scale=2.0)
+
+        def false_fn():
+            return fluid.layers.scale(x, scale=-1.0)
+
+        out = fluid.layers.cond(p, true_fn, false_fn)
+    xv = np.random.randn(2, 4).astype("float32")
+    ov_t, = _run(main, startup,
+                 {"x": xv, "p": np.array([True])}, [out])
+    np.testing.assert_allclose(np.asarray(ov_t), xv * 2.0, atol=1e-6)
+    exe = fluid.Executor(fluid.CPUPlace())
+    ov_f, = exe.run(main, feed={"x": xv, "p": np.array([False])},
+                    fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(ov_f), -xv, atol=1e-6)
+
+
+def test_ifelse_elementwise_merge():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        c = fluid.layers.data("c", shape=[1], dtype="bool")
+        ie = fluid.layers.IfElse(c)
+        with ie.true_block():
+            ie.output(fluid.layers.scale(x, scale=10.0))
+        with ie.false_block():
+            ie.output(fluid.layers.scale(x, scale=0.0))
+        out, = ie()
+    xv = np.ones((4, 3), "float32")
+    cv = np.array([[True], [False], [True], [False]])
+    ov, = _run(main, startup, {"x": xv, "c": cv}, [out])
+    ov = np.asarray(ov)
+    np.testing.assert_allclose(ov[0], 10 * np.ones(3), atol=1e-6)
+    np.testing.assert_allclose(ov[1], np.zeros(3), atol=1e-6)
+
+
+def test_while_with_seeded_tensor_array():
+    """Decode-loop pattern: array seeded before the loop, grown inside."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        i = fluid.layers.fill_constant([1], "int64", 1)
+        limit = fluid.layers.fill_constant([1], "int64", 5)
+        i0 = fluid.layers.fill_constant([1], "int64", 0)
+        arr = fluid.layers.array_write(x, i0, capacity=8)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond)
+        with w.block():
+            prev = fluid.layers.array_read(
+                arr, fluid.layers.elementwise_sub(
+                    i, fluid.layers.fill_constant([1], "int64", 1))
+            )
+            fluid.layers.array_write(
+                fluid.layers.scale(prev, scale=2.0), i, array=arr
+            )
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, limit, cond=cond)
+        last = fluid.layers.array_read(
+            arr, fluid.layers.fill_constant([1], "int64", 4)
+        )
+    xv = np.ones((2, 3), "float32")
+    lv, = _run(main, startup, {"x": xv}, [last])
+    np.testing.assert_allclose(np.asarray(lv), 16.0 * xv, atol=1e-5)
+
+
+def test_while_unseeded_carry_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        limit = fluid.layers.fill_constant([1], "int64", 3)
+        arr = fluid.layers.create_array("float32")
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond)
+        with pytest.raises(ValueError, match="no value before the loop"):
+            with w.block():
+                fluid.layers.array_write(
+                    fluid.layers.cast(i, "float32"), i, array=arr
+                )
+                fluid.layers.increment(i, value=1, in_place=True)
+                fluid.layers.less_than(i, limit, cond=cond)
+
+
+def test_static_rnn_output_feeds_fc():
+    """Shape inference flows through the recurrent mega-op (rnn -> fc)."""
+    B, T, D = 2, 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data("x", shape=[T, D])
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(inp)
+            h_prev = rnn.memory(shape=[-1, D], batch_ref=inp)
+            h = fluid.layers.elementwise_add(x_t, h_prev)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+        assert out.shape is not None and out.shape[-1] == D, out.shape
+        last = fluid.layers.sequence_last_step(out)
+        logits = fluid.layers.fc(input=last, size=5)
+    x = np.random.randn(B, T, D).astype("float32")
+    lv, = _run(main, startup, {"x": x}, [logits])
+    assert np.asarray(lv).shape == (B, 5)
+
+
+def test_tensor_array_write_read():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        i0 = fluid.layers.fill_constant([1], "int64", 0)
+        i1 = fluid.layers.fill_constant([1], "int64", 1)
+        arr = fluid.layers.array_write(x, i0, capacity=4)
+        fluid.layers.array_write(
+            fluid.layers.scale(x, scale=2.0), i1, array=arr
+        )
+        r = fluid.layers.array_read(arr, i1)
+        n = fluid.layers.array_length(arr)
+    xv = np.random.randn(2, 3).astype("float32")
+    rv, nv = _run(main, startup, {"x": xv}, [r, n])
+    np.testing.assert_allclose(np.asarray(rv), 2 * xv, atol=1e-6)
+    assert int(np.asarray(nv).ravel()[0]) == 2
