@@ -1,0 +1,12 @@
+"""Hand-tuned Pallas TPU kernels for hot ops.
+
+Reference-parity role: ``paddle/fluid/operators/math/jit_kernel*`` (runtime
+Xbyak x86 codegen for vexp/lstm/gru hot loops) — on TPU the equivalent of
+hand-tuned kernels is Pallas. Every kernel here has an XLA (jnp) reference
+path used on CPU and as the numerical ground truth in tests.
+"""
+
+from paddle_tpu.kernels.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_reference,
+)

@@ -1,0 +1,221 @@
+"""Flash attention: blocked online-softmax attention as a Pallas TPU kernel.
+
+The reference framework has no attention kernel at all (SURVEY.md §5.7 —
+Transformer is composed from matmul/softmax ops, tests/unittests/
+dist_transformer.py); this is the TPU-first upgrade that sets the
+long-context ceiling. Canonical TPU flash blocking: grid =
+(batch, heads, q_blocks, kv_blocks) with the kv dimension innermost, so
+Pallas pipelines each (block_k, d) K/V tile HBM->VMEM while the previous
+tile computes; running (max, sum, acc) live in VMEM scratch that persists
+across the kv grid steps. Per-core memory is O(block), independent of
+sequence length — the full [T, S] score matrix never exists.
+
+Forward is Pallas; backward is a custom_vjp that recomputes through the
+XLA reference path (numerically identical math) — a dedicated backward
+kernel is a later optimization. On CPU (tests) the kernel runs with
+``interpret=True``; the public entry point picks the best path per backend.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_DEFAULT_BLOCK_Q = 128
+_DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def flash_attention_reference(q, k, v, causal=False, sm_scale=None,
+                              mask=None):
+    """XLA reference path. q:[B,H,T,d] k,v:[B,H,S,d]; mask:[B,1|H,T,S]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "bhtd,bhsd->bhts", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        t, ss = s.shape[-2], s.shape[-1]
+        idx_t = jnp.arange(t)[:, None]
+        idx_s = jnp.arange(ss)[None, :]
+        s = jnp.where(idx_s <= idx_t, s, _NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  sm_scale, causal, seq_k, block_q, block_k, n_kv):
+    """One (b, h, qi, kj) grid step: absorb one K/V tile into the running
+    online-softmax state held in VMEM scratch."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:, :] = jnp.zeros_like(acc_ref)
+        m_ref[:, :] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:, :] = jnp.zeros_like(l_ref)
+
+    q_base = qi * block_q
+    k_base = kj * block_k
+
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        k_idx = k_base + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = k_idx < seq_k
+        if causal:
+            q_idx = q_base + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            valid = jnp.logical_and(valid, k_idx <= q_idx)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_ref[:, :]
+        l_prev = l_ref[:, :]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, :] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:, :] = acc_ref[:, :] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, :] = m_new
+
+    if causal:
+        # Tiles strictly above the diagonal contribute nothing — skip.
+        pl.when(k_base <= q_base + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == n_kv - 1)
+    def _finish():
+        o_ref[0, 0, :, :] = (
+            acc_ref[:, :] / jnp.maximum(l_ref[:, :], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, d = q.shape
+    S = k.shape[2]
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+
+    # Pad T/S to block multiples; padded keys are masked inside the kernel
+    # via seq_k, padded queries are sliced off after.
+    T_pad = -T % block_q
+    S_pad = -S % block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, T_pad), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, S_pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, S_pad), (0, 0)))
+    Tp, Sp = T + T_pad, S + S_pad
+    n_kv = Sp // block_k
+
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        seq_k=S,
+        block_q=block_q,
+        block_k=block_k,
+        n_kv=n_kv,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, Tp // block_q, n_kv),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :, :T, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: flash_attention_reference(
+            q_, k_, v_, causal=causal, sm_scale=sm_scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal=False,
+    sm_scale=None,
+    mask=None,
+    block_q=_DEFAULT_BLOCK_Q,
+    block_k=_DEFAULT_BLOCK_K,
+    force_reference=False,
+    force_pallas=False,
+):
+    """Fused attention. q:[B,H,T,d], k,v:[B,H,S,d] -> [B,H,T,d].
+
+    Pallas kernel on TPU (interpret-mode when forced on CPU); XLA reference
+    elsewhere and whenever an additive ``mask`` is supplied (masked variant
+    of the kernel is a later wave).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    use_pallas = force_pallas or (
+        not force_reference
+        and mask is None
+        and jax.default_backend() == "tpu"
+    )
+    if not use_pallas or mask is not None:
+        return flash_attention_reference(
+            q, k, v, causal=causal, sm_scale=sm_scale, mask=mask
+        )
+    interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)

@@ -1,0 +1,137 @@
+"""Attention layers: scaled dot-product + multi-head attention.
+
+Reference role: composed-op attention in the reference's Transformer test
+model (tests/unittests/dist_transformer.py multi_head_attention); here the
+core is the fused scaled_dot_product_attention op (Pallas flash kernel on
+TPU, paddle_tpu/kernels/flash_attention.py).
+"""
+
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "scaled_dot_product_attention",
+    "multi_head_attention",
+    "label_smooth",
+    "add_position_encoding",
+]
+
+
+def scaled_dot_product_attention(
+    queries, keys, values, mask=None, causal=False, sm_scale=None,
+    impl="auto", name=None
+):
+    """Fused attention over [batch, heads, seq, head_dim] tensors."""
+    helper = LayerHelper("sdpa", name=name)
+    out = helper.create_variable_for_type_inference(queries.dtype)
+    inputs = {"Q": [queries], "K": [keys], "V": [values]}
+    if mask is not None:
+        inputs["Mask"] = [mask]
+    helper.append_op(
+        type="scaled_dot_product_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={
+            "causal": causal,
+            "sm_scale": float(sm_scale or 0.0),
+            "impl": impl,
+        },
+    )
+    return out
+
+
+def multi_head_attention(
+    queries,
+    keys,
+    values,
+    d_key,
+    d_value,
+    d_model,
+    n_head=1,
+    dropout_rate=0.0,
+    mask=None,
+    causal=False,
+    param_attr=None,
+    is_test=False,
+    name=None,
+):
+    """Projections + fused attention + output projection.
+
+    queries/keys/values: [batch, seq, d_model]; returns [batch, seq,
+    d_model]. All four projections are single fused matmuls (MXU-sized).
+    """
+    from paddle_tpu.layers import nn as nn_layers
+
+    if keys is None:
+        keys = queries
+    if values is None:
+        values = keys
+
+    q = nn_layers.fc(
+        input=queries, size=d_key * n_head, num_flatten_dims=2,
+        bias_attr=False, param_attr=param_attr,
+        name=(name + "_q") if name else None,
+    )
+    k = nn_layers.fc(
+        input=keys, size=d_key * n_head, num_flatten_dims=2,
+        bias_attr=False, param_attr=param_attr,
+        name=(name + "_k") if name else None,
+    )
+    v = nn_layers.fc(
+        input=values, size=d_value * n_head, num_flatten_dims=2,
+        bias_attr=False, param_attr=param_attr,
+        name=(name + "_v") if name else None,
+    )
+
+    def split_heads(x, d_head):
+        # [B, T, H*dh] -> [B, H, T, dh]
+        reshaped = nn_layers.reshape(x, shape=[0, 0, n_head, d_head])
+        return nn_layers.transpose(reshaped, perm=[0, 2, 1, 3])
+
+    qh = split_heads(q, d_key)
+    kh = split_heads(k, d_key)
+    vh = split_heads(v, d_value)
+
+    ctx = scaled_dot_product_attention(
+        qh, kh, vh, mask=mask, causal=causal,
+        sm_scale=d_key ** -0.5,
+    )
+    # [B, H, T, dh] -> [B, T, H*dh]
+    merged = nn_layers.reshape(
+        nn_layers.transpose(ctx, perm=[0, 2, 1, 3]),
+        shape=[0, 0, n_head * d_value],
+    )
+    if dropout_rate:
+        merged = nn_layers.dropout(
+            merged, dropout_prob=dropout_rate, is_test=is_test
+        )
+    return nn_layers.fc(
+        input=merged, size=d_model, num_flatten_dims=2, bias_attr=False,
+        param_attr=param_attr, name=(name + "_o") if name else None,
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(label.dtype)
+    inputs = {"X": [label]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist]
+    helper.append_op(
+        type="label_smooth",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"epsilon": float(epsilon)},
+    )
+    return out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    helper = LayerHelper("add_position_encoding", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="add_position_encoding",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"alpha": float(alpha), "beta": float(beta)},
+    )
+    return out

@@ -203,10 +203,13 @@ class StaticRNN(object):
         self.status = StaticRNN.IN_RNN
         try:
             yield
-        finally:
+        except BaseException:
+            # Don't mask the user's error with a completion error.
             self._main.rollback()
-            self.status = StaticRNN.AFTER_RNN
-            self._complete_op()
+            raise
+        self._main.rollback()
+        self.status = StaticRNN.AFTER_RNN
+        self._complete_op()
 
     def _assert_in_rnn(self):
         if self.status != StaticRNN.IN_RNN:
@@ -471,7 +474,10 @@ class While(object):
         sub_block = self._main.create_block()
         try:
             yield
-        finally:
+        except BaseException:
+            self._main.rollback()
+            raise
+        else:
             self._main.rollback()
             # Carried vars: sub-block outputs that refer to parent vars
             # (in-place updates), plus the condition var.
@@ -557,8 +563,17 @@ def cond(pred, true_fn, false_fn):
             "true_fn returned %d outputs, false_fn %d"
             % (len(outs_t), len(outs_f))
         )
+    # Capture sub-block reads AND branch outputs that resolve in the parent
+    # block (a branch may pass a parent var through untouched).
+    passthrough = [
+        v.name
+        for v in outs_t + outs_f
+        if parent_block._find_var_recursive(v.name) is not None
+    ]
     inputs = sorted(
-        set(_captured_names(sub_t, [])) | set(_captured_names(sub_f, []))
+        set(_captured_names(sub_t, []))
+        | set(_captured_names(sub_f, []))
+        | set(passthrough)
     )
     outs = [
         helper.create_variable_for_type_inference(v.dtype) for v in outs_t

@@ -1,0 +1,165 @@
+"""Transformer encoder-decoder for machine translation.
+
+Reference parity: the reference's Transformer benchmark model
+(``tests/unittests/dist_transformer.py`` / ``benchmark/fluid/models/
+machine_translation.py`` attention seq2seq). TPU-first differences:
+attention is the fused scaled_dot_product_attention op (Pallas flash on
+TPU), sequences are dense-padded [batch, T] with explicit length masks,
+and pre-norm residual blocks (better large-scale training stability).
+"""
+
+import paddle_tpu as fluid
+
+
+def _ffn(x, d_model, d_inner, name):
+    h = fluid.layers.fc(
+        input=x, size=d_inner, num_flatten_dims=2, act="relu",
+        name=name + "_fc1",
+    )
+    return fluid.layers.fc(
+        input=h, size=d_model, num_flatten_dims=2, name=name + "_fc2"
+    )
+
+
+def _prenorm(x, name):
+    return fluid.layers.layer_norm(
+        x, begin_norm_axis=2, name=name + "_ln"
+    )
+
+
+def _residual(x, y, dropout, is_test, name):
+    if dropout:
+        y = fluid.layers.dropout(y, dropout_prob=dropout, is_test=is_test)
+    return fluid.layers.elementwise_add(x, y)
+
+
+def encoder_layer(x, mask, n_head, d_model, d_inner, dropout, is_test, name):
+    attn = fluid.layers.multi_head_attention(
+        _prenorm(x, name + "_attn"), None, None,
+        d_key=d_model // n_head,
+        d_value=d_model // n_head,
+        d_model=d_model,
+        n_head=n_head,
+        mask=mask,
+        is_test=is_test,
+        name=name + "_mha",
+    )
+    x = _residual(x, attn, dropout, is_test, name + "_res1")
+    ff = _ffn(_prenorm(x, name + "_ffn"), d_model, d_inner, name + "_ffn")
+    return _residual(x, ff, dropout, is_test, name + "_res2")
+
+
+def decoder_layer(x, enc_out, cross_mask, n_head, d_model,
+                  d_inner, dropout, is_test, name):
+    self_attn = fluid.layers.multi_head_attention(
+        _prenorm(x, name + "_sattn"), None, None,
+        d_key=d_model // n_head,
+        d_value=d_model // n_head,
+        d_model=d_model,
+        n_head=n_head,
+        causal=True,
+        is_test=is_test,
+        name=name + "_smha",
+    )
+    x = _residual(x, self_attn, dropout, is_test, name + "_res1")
+    cross = fluid.layers.multi_head_attention(
+        _prenorm(x, name + "_cattn"), enc_out, enc_out,
+        d_key=d_model // n_head,
+        d_value=d_model // n_head,
+        d_model=d_model,
+        n_head=n_head,
+        mask=cross_mask,
+        is_test=is_test,
+        name=name + "_cmha",
+    )
+    x = _residual(x, cross, dropout, is_test, name + "_res2")
+    ff = _ffn(_prenorm(x, name + "_ffn"), d_model, d_inner, name + "_ffn")
+    return _residual(x, ff, dropout, is_test, name + "_res3")
+
+
+def build(
+    src_vocab_size=1000,
+    trg_vocab_size=1000,
+    max_length=64,
+    n_layer=2,
+    n_head=4,
+    d_model=128,
+    d_inner=512,
+    dropout=0.1,
+    label_smooth_eps=0.1,
+    is_test=False,
+):
+    """Returns (avg_cost, feeds, extras). Feeds: src_word [B,S], src_len
+    [B,1], trg_word [B,T] (decoder input), trg_len [B,1], label [B,T]."""
+    src = fluid.layers.data("src_word", shape=[max_length], dtype="int64")
+    src_len = fluid.layers.data("src_len", shape=[1], dtype="int64")
+    trg = fluid.layers.data("trg_word", shape=[max_length], dtype="int64")
+    label = fluid.layers.data("label", shape=[max_length], dtype="int64")
+
+    src_mask = fluid.layers.sequence_mask(
+        src_len, maxlen=max_length, dtype="float32"
+    )  # [B, S] validity
+
+    # Embeddings + sinusoid position encoding
+    src_emb = fluid.layers.embedding(
+        input=src, size=[src_vocab_size, d_model],
+        param_attr=fluid.ParamAttr(name="src_emb"),
+    )
+    src_emb = fluid.layers.scale(src_emb, scale=d_model ** 0.5)
+    enc_in = fluid.layers.add_position_encoding(src_emb)
+
+    trg_emb = fluid.layers.embedding(
+        input=trg, size=[trg_vocab_size, d_model],
+        param_attr=fluid.ParamAttr(name="trg_emb"),
+    )
+    trg_emb = fluid.layers.scale(trg_emb, scale=d_model ** 0.5)
+    dec_in = fluid.layers.add_position_encoding(trg_emb)
+
+    enc = enc_in
+    for i in range(n_layer):
+        enc = encoder_layer(
+            enc, src_mask, n_head, d_model, d_inner, dropout, is_test,
+            "enc_%d" % i,
+        )
+    enc = _prenorm(enc, "enc_final")
+
+    dec = dec_in
+    for i in range(n_layer):
+        dec = decoder_layer(
+            dec, enc, src_mask, n_head, d_model, d_inner, dropout,
+            is_test, "dec_%d" % i,
+        )
+    dec = _prenorm(dec, "dec_final")
+
+    logits = fluid.layers.fc(
+        input=dec, size=trg_vocab_size, num_flatten_dims=2,
+        name="proj_logits",
+    )
+
+    if label_smooth_eps:
+        soft_label = fluid.layers.label_smooth(
+            fluid.layers.one_hot(label, depth=trg_vocab_size),
+            epsilon=label_smooth_eps,
+        )
+        cost = fluid.layers.softmax_with_cross_entropy(
+            logits, soft_label, soft_label=True
+        )
+    else:
+        cost = fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.reshape(logits, shape=[-1, trg_vocab_size]),
+            fluid.layers.reshape(label, shape=[-1, 1]),
+        )
+
+    # Mask loss on padded target positions.
+    trg_len = fluid.layers.data("trg_len", shape=[1], dtype="int64")
+    trg_mask = fluid.layers.sequence_mask(
+        trg_len, maxlen=max_length, dtype="float32"
+    )
+    cost = fluid.layers.reshape(cost, shape=[-1, max_length])
+    masked = fluid.layers.elementwise_mul(cost, trg_mask)
+    total = fluid.layers.reduce_sum(masked)
+    denom = fluid.layers.reduce_sum(trg_mask)
+    avg_cost = fluid.layers.elementwise_div(total, denom)
+
+    feeds = [src, src_len, trg, trg_len, label]
+    return avg_cost, feeds, {"logits": logits}

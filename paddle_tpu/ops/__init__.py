@@ -16,6 +16,7 @@ from paddle_tpu.ops import optimizer_ops  # noqa: F401
 from paddle_tpu.ops import control_flow_ops  # noqa: F401
 from paddle_tpu.ops import subblock_ops  # noqa: F401
 from paddle_tpu.ops import rnn_ops  # noqa: F401
+from paddle_tpu.ops import attention_ops  # noqa: F401
 from paddle_tpu.ops import sequence_ops  # noqa: F401
 from paddle_tpu.ops import metric_ops  # noqa: F401
 from paddle_tpu.ops import io_ops  # noqa: F401

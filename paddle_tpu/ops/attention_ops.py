@@ -1,0 +1,92 @@
+"""Attention ops.
+
+The reference has no fused attention (SURVEY.md §5.7) — Transformer there
+is composed ops (tests/unittests/dist_transformer.py). Here attention is a
+first-class op lowered to the Pallas flash kernel on TPU / fused XLA math
+elsewhere, because it sets the long-context performance ceiling.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.kernels.flash_attention import (
+    flash_attention,
+    flash_attention_reference,
+)
+
+
+def _lower_sdpa(ctx, ins, attrs):
+    q = ins["Q"][0]  # [B, H, T, d]
+    k = ins["K"][0]
+    v = ins["V"][0]
+    mask = ins.get("Mask", [None])[0]
+    sm_scale = attrs.get("sm_scale", 0.0) or None
+    causal = attrs.get("causal", False)
+    if mask is not None:
+        # Mask: [B, T_k] validity (1=keep) or [B, 1|H, T_q, T_k] full mask.
+        if mask.ndim == 2:
+            mask = mask[:, None, None, :]
+        mask = mask.astype(bool)
+    impl = attrs.get("impl", "auto")
+    if impl == "reference":
+        return flash_attention_reference(
+            q, k, v, causal=causal, sm_scale=sm_scale, mask=mask
+        )
+    return flash_attention(
+        q, k, v, causal=causal, sm_scale=sm_scale, mask=mask,
+        force_pallas=(impl == "pallas"),
+    )
+
+
+register_op(
+    "scaled_dot_product_attention",
+    inputs=["Q", "K", "V", "Mask"],
+    outputs=["Out"],
+    attrs={"causal": False, "sm_scale": 0.0, "impl": "auto"},
+    lower=_lower_sdpa,
+    no_grad_inputs=("Mask",),
+)
+
+
+def _lower_label_smooth(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    dist = ins.get("PriorDist", [None])[0]
+    if dist is not None:
+        return (1.0 - eps) * x + eps * dist
+    k = jnp.shape(x)[-1]
+    return (1.0 - eps) * x + eps / k
+
+
+register_op(
+    "label_smooth",
+    inputs=["X", "PriorDist"],
+    outputs=["Out"],
+    attrs={"epsilon": 0.0},
+    lower=_lower_label_smooth,
+)
+
+
+def _lower_position_encoding(ctx, ins, attrs):
+    """Sinusoid position table added to the input [B, T, D]."""
+    x = ins["X"][0]
+    T, D = jnp.shape(x)[1], jnp.shape(x)[2]
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    i = jnp.arange(D // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2.0 * i / D)
+    table = jnp.concatenate(
+        [jnp.sin(angle), jnp.cos(angle)], axis=-1
+    ).astype(x.dtype)
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    return alpha * x + beta * table[None, :, :]
+
+
+register_op(
+    "add_position_encoding",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"alpha": 1.0, "beta": 1.0},
+    lower=_lower_position_encoding,
+)

@@ -221,8 +221,17 @@ def _lower_write_to_array(ctx, ins, attrs):
             )
         buf = jnp.zeros((cap,) + tuple(jnp.shape(x)), x.dtype)
         size = jnp.asarray(0, jnp.int32)
-    buf = jax.lax.dynamic_update_index_in_dim(buf, x, i, axis=0)
-    size = jnp.maximum(size, i + 1)
+    # Out-of-capacity writes are dropped (XLA's dynamic_update clamps OOB
+    # indices, which would silently overwrite the last slot instead).
+    cap = jnp.shape(buf)[0]
+    written = jax.lax.dynamic_update_index_in_dim(
+        buf, x, jnp.minimum(i, cap - 1), axis=0
+    )
+    in_bounds = i < cap
+    buf = jnp.where(in_bounds, written, buf)
+    size = jnp.where(
+        in_bounds, jnp.maximum(size, i + 1), size
+    ).astype(jnp.int32)
     return {"Out": [(buf, size)]}
 
 

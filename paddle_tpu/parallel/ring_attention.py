@@ -1,0 +1,169 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference framework predates long-context training entirely
+(SURVEY.md §5.7 — no attention kernel, no sequence parallelism); this
+module is the TPU-native design that provides it:
+
+* ``ring_attention`` — sequence-sharded Q/K/V; K/V blocks rotate around
+  the mesh axis with ``jax.lax.ppermute`` (ICI neighbor exchange) while a
+  running online-softmax accumulator absorbs one block per step. Memory per
+  chip is O(T/N), enabling contexts N× longer than one chip could hold.
+* ``ulysses_attention`` — all-to-all re-partition: trade the sequence
+  sharding for a head sharding (`jax.lax.all_to_all`), run ordinary
+  (flash) attention on full sequences for a head subset, and trade back.
+  Cheaper for moderate T when heads % N == 0.
+
+Both are pure per-shard functions for use under ``shard_map`` over a
+``jax.sharding.Mesh`` axis, and both are reverse-differentiable (scan +
+ppermute / all_to_all have transposition rules), so they drop into the
+training path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _shard_map():
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map
+
+
+def _ring_attention_shard(q, k, v, axis_name, causal, sm_scale):
+    """Per-shard body. q,k,v: [B, H, Tl, d] local sequence chunks."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    Tl = q.shape[2]
+    d = q.shape[3]
+    qf = q.astype(jnp.float32) * sm_scale
+    q_pos = my * Tl + jnp.arange(Tl)  # global query positions
+
+    def _vary(x):
+        # Mark device-uniform initial carries as varying over the ring axis
+        # (shard_map's varying-axis type system requires carry in/out match).
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        if hasattr(jax.lax, "pvary"):
+            return jax.lax.pvary(x, (axis_name,))
+        return x
+
+    acc0 = _vary(jnp.zeros(q.shape[:3] + (d,), jnp.float32))
+    m0 = _vary(jnp.full(q.shape[:3] + (1,), _NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros(q.shape[:3] + (1,), jnp.float32))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        acc, m, l, k_cur, v_cur = carry
+        src = (my - i) % n  # owner of the block currently held
+        s = jnp.einsum(
+            "bhtd,bhsd->bhts", qf, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            k_pos = src * Tl + jnp.arange(Tl)
+            s = jnp.where(
+                k_pos[None, None, None, :] <= q_pos[None, None, :, None],
+                s,
+                _NEG_INF,
+            )
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhts,bhsd->bhtd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc_new, m_new, l_new, k_next, v_next), None
+
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(n)
+    )
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name="data", causal=False,
+                   sm_scale=None):
+    """Ring attention over sequence-sharded [B, H, T, d] tensors.
+
+    q/k/v are GLOBAL arrays; the mesh axis ``axis_name`` shards the
+    sequence (dim 2). Returns the global output with the same sharding.
+    """
+    shard_map = _shard_map()
+
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_shard,
+            axis_name=axis_name,
+            causal=causal,
+            sm_scale=sm_scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def _ulysses_shard(q, k, v, axis_name, causal, sm_scale):
+    """Per-shard body. q,k,v: [B, H, Tl, d]; requires H % n == 0."""
+    from paddle_tpu.kernels.flash_attention import flash_attention_reference
+
+    # [B, H, Tl, d] -> all_to_all -> [B, H/n, T, d]
+    def seq_to_head(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def head_to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qh = seq_to_head(q)
+    kh = seq_to_head(k)
+    vh = seq_to_head(v)
+    out = flash_attention_reference(
+        qh, kh, vh, causal=causal, sm_scale=sm_scale
+    )
+    return head_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name="data", causal=False,
+                      sm_scale=None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence-parallel attention."""
+    shard_map = _shard_map()
+
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            "ulysses_attention needs heads (%d) divisible by axis size (%d)"
+            % (q.shape[1], n)
+        )
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(
+            _ulysses_shard,
+            axis_name=axis_name,
+            causal=causal,
+            sm_scale=sm_scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -215,6 +215,31 @@ def test_cond_branches():
     np.testing.assert_allclose(np.asarray(ov_f), -xv, atol=1e-6)
 
 
+def test_cond_passthrough_branch():
+    """A branch may return a parent var untouched (identity branch)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        p = fluid.layers.data("p", shape=[1], dtype="bool")
+        out = fluid.layers.cond(
+            p, lambda: fluid.layers.scale(x, scale=3.0), lambda: x
+        )
+    xv = np.random.randn(2, 4).astype("float32")
+    ov, = _run(main, startup, {"x": xv, "p": np.array([False])}, [out])
+    np.testing.assert_allclose(np.asarray(ov), xv, atol=1e-6)
+
+
+def test_static_rnn_user_error_not_masked():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data("x", shape=[4, 3])
+        rnn = fluid.layers.StaticRNN()
+        with pytest.raises(RuntimeError, match="user error"):
+            with rnn.step():
+                rnn.step_input(inp)
+                raise RuntimeError("user error")
+
+
 def test_ifelse_elementwise_merge():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
