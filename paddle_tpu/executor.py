@@ -23,13 +23,14 @@ from paddle_tpu.core.scope import Scope
 from paddle_tpu.core.types import Place, TPUPlace, np_dtype
 
 _global_scope = Scope()
+_scope_stack = [_global_scope]
 
 
 def global_scope():
-    return _global_scope
-
-
-_scope_stack = [_global_scope]
+    """The scope Executor.run defaults to. Like the reference's
+    ``fluid.global_scope()`` / ``scope_guard`` pair (executor.py:g_scope),
+    ``scope_guard`` swaps what this returns for the duration of the guard."""
+    return _scope_stack[-1]
 
 
 def scope_guard(scope):
@@ -44,10 +45,6 @@ def scope_guard(scope):
             _scope_stack.pop()
 
     return guard()
-
-
-def _current_scope():
-    return _scope_stack[-1]
 
 
 def _as_feed_array(value, place):
@@ -110,7 +107,7 @@ class Executor(object):
         program = program or framework.default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
-        scope = scope or _current_scope()
+        scope = scope or global_scope()
         device = self.place.jax_device()
 
         # Prepare feeds.

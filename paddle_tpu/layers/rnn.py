@@ -17,6 +17,10 @@ __all__ = [
     "gru_unit",
     "lstm_unit",
     "row_conv",
+    "attention_lstm_decoder",
+    "attention_lstm_beam_decode",
+    "beam_search",
+    "beam_search_decode",
 ]
 
 
@@ -244,3 +248,168 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
         outputs={"Out": [out]},
     )
     return helper.append_activation(out)
+
+
+def _decoder_params(helper, name, decoder_size, ctx_dim, emb_dim, vocab=None,
+                    dtype="float32"):
+    """Create (or reuse by name) the fused attention-decoder parameters.
+
+    Fixed names keyed on ``name`` so a training program and a separately
+    built generation program share the same weights through the scope
+    (Fluid's param_attr-by-name sharing contract).
+    """
+    from paddle_tpu.param_attr import ParamAttr
+
+    D = decoder_size
+
+    def p(suffix, shape, is_bias=False):
+        return helper.create_parameter(
+            attr=ParamAttr(name="%s_%s" % (name, suffix)), shape=shape,
+            dtype=dtype, is_bias=is_bias,
+        )
+
+    params = {
+        "StateProjW": p("state_proj_w", [D, D]),
+        "AttnW": p("attn_w", [2 * D, 1]),
+        "CellW": p("cell_w", [D + ctx_dim + emb_dim, 4 * D]),
+        "CellB": p("cell_b", [1, 4 * D], is_bias=True),
+    }
+    if vocab is not None:
+        params["OutW"] = p("out_w", [D, vocab])
+        # 1-D so the same named param is shared with the training program's
+        # fc(num_flatten_dims=2) output projection bias.
+        params["OutB"] = p("out_b", [vocab], is_bias=True)
+    return params
+
+
+def attention_lstm_decoder(
+    target_embedding,
+    encoder_vec,
+    encoder_proj,
+    decoder_boot,
+    size,
+    encoder_len=None,
+    name="attention_decoder",
+):
+    """Teacher-forced attention-LSTM decoder (attention_lstm_op.cc parity).
+
+    target_embedding [B, T, M]; encoder_vec [B, S, C]; encoder_proj
+    [B, S, size]; decoder_boot [B, size]. Returns hidden states [B, T, size].
+    """
+    helper = LayerHelper("attention_lstm", name=name)
+    dtype = target_embedding.dtype
+    ctx_dim = int(encoder_vec.shape[-1])
+    emb_dim = int(target_embedding.shape[-1])
+    params = _decoder_params(helper, name, size, ctx_dim, emb_dim,
+                             dtype=dtype)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    attn = helper.create_variable_for_type_inference(dtype)
+    inputs = {
+        "X": [target_embedding],
+        "EncoderVec": [encoder_vec],
+        "EncoderProj": [encoder_proj],
+        "H0": [decoder_boot],
+    }
+    inputs.update({k: [v] for k, v in params.items()})
+    if encoder_len is not None:
+        inputs["EncoderLen"] = [encoder_len]
+    helper.append_op(
+        type="attention_lstm",
+        inputs=inputs,
+        outputs={"Hidden": [hidden], "Cell": [cell],
+                 "AttentionWeight": [attn]},
+    )
+    return hidden
+
+
+def attention_lstm_beam_decode(
+    encoder_vec,
+    encoder_proj,
+    decoder_boot,
+    embedding_param,
+    size,
+    vocab_size,
+    beam_size=4,
+    max_len=32,
+    start_id=1,
+    end_id=2,
+    encoder_len=None,
+    name="attention_decoder",
+):
+    """Whole-loop beam-search generation with the decoder named ``name``
+    (shares weights with attention_lstm_decoder). Returns
+    (sentence_ids [B, beam, max_len], sentence_scores [B, beam])."""
+    helper = LayerHelper("attention_lstm_beam_decode", name=name)
+    dtype = encoder_vec.dtype
+    ctx_dim = int(encoder_vec.shape[-1])
+    emb_dim = int(embedding_param.shape[-1])
+    params = _decoder_params(helper, name, size, ctx_dim, emb_dim,
+                             vocab=vocab_size, dtype=dtype)
+    ids = helper.create_variable_for_type_inference("int32")
+    scores = helper.create_variable_for_type_inference(dtype)
+    inputs = {
+        "EncoderVec": [encoder_vec],
+        "EncoderProj": [encoder_proj],
+        "H0": [decoder_boot],
+        "Embedding": [embedding_param],
+    }
+    inputs.update({k: [v] for k, v in params.items()})
+    if encoder_len is not None:
+        inputs["EncoderLen"] = [encoder_len]
+    helper.append_op(
+        type="attention_lstm_beam_decode",
+        inputs=inputs,
+        outputs={"SentenceIds": [ids], "SentenceScores": [scores]},
+        attrs={
+            "beam_size": int(beam_size),
+            "max_len": int(max_len),
+            "start_id": int(start_id),
+            "end_id": int(end_id),
+        },
+    )
+    return ids, scores
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id=0,
+                is_accumulated=True, name=None):
+    """One dense beam-search step (beam_search_op.cc parity).
+
+    pre_ids/pre_scores [B, K]; scores [B, K, V] (accumulated log-probs, or
+    per-step probabilities when is_accumulated=False). Returns
+    (selected_ids, selected_scores, parent_idx), each [B, K]."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference(pre_ids.dtype)
+    sel_scores = helper.create_variable_for_type_inference(pre_scores.dtype)
+    parent = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "scores": [scores]},
+        outputs={"selected_ids": [sel_ids], "selected_scores": [sel_scores],
+                 "parent_idx": [parent]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id),
+               "is_accumulated": bool(is_accumulated)},
+    )
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids, parent_idx, scores=None, beam_size=4, end_id=0,
+                       name=None):
+    """Backtrack stacked per-step beams ([T, B, K] ids/parents) into
+    sentences [B, K, T] (beam_search_decode_op.cc parity). When the per-step
+    selected scores ([T, B, K]) are passed, they are gathered along the same
+    lattice and returned as per-token scores [B, K, T] (zeros otherwise)."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_variable_for_type_inference(ids.dtype)
+    sent_scores = helper.create_variable_for_type_inference("float32")
+    inputs = {"Ids": [ids], "ParentIdx": [parent_idx]}
+    if scores is not None:
+        inputs["Scores"] = [scores]
+    helper.append_op(
+        type="beam_search_decode",
+        inputs=inputs,
+        outputs={"SentenceIds": [sent_ids], "SentenceScores": [sent_scores]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id)},
+    )
+    return sent_ids, sent_scores

@@ -6,3 +6,5 @@ from paddle_tpu.models import mnist  # noqa: F401
 from paddle_tpu.models import vgg  # noqa: F401
 from paddle_tpu.models import resnet  # noqa: F401
 from paddle_tpu.models import stacked_lstm  # noqa: F401
+from paddle_tpu.models import transformer  # noqa: F401
+from paddle_tpu.models import machine_translation  # noqa: F401

@@ -21,3 +21,5 @@ from paddle_tpu.ops import sequence_ops  # noqa: F401
 from paddle_tpu.ops import metric_ops  # noqa: F401
 from paddle_tpu.ops import io_ops  # noqa: F401
 from paddle_tpu.ops import detection_ops  # noqa: F401
+from paddle_tpu.ops import beam_search_ops  # noqa: F401
+from paddle_tpu.ops import seq2seq_ops  # noqa: F401

@@ -1,0 +1,136 @@
+"""Beam-search ops (dense-shape, static-width TPU design).
+
+Reference parity: ``paddle/fluid/operators/beam_search_op.cc`` (per-step
+candidate selection) and ``beam_search_decode_op.cc`` (backtracking the
+stored beams into sentences). The reference works on LoD-packed candidate
+lists whose width shrinks as beams finish; under XLA every shape must be
+static, so the TPU design keeps a fixed [batch, beam] lattice the whole way:
+finished beams are frozen in place (their only candidate is ``end_id`` at an
+unchanged score) and pruned beams ride along at -inf. Selection is one
+``lax.top_k`` over the flattened [beam * vocab] candidates per batch row —
+an MXU/VPU-friendly dense reduction instead of the reference's host-side
+priority queues.
+
+Convention for the first step: seed ``pre_scores`` with ``[0, -inf, ...,
+-inf]`` per batch row so identical initial beams don't produce duplicate
+candidates (the reference gets this for free from LoD width 1).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+_NEG_INF = -1e9
+
+
+def beam_step(pre_ids, pre_scores, scores, end_id, is_accumulated=False):
+    """One beam-search step over dense [batch, beam, vocab] scores.
+
+    pre_ids: [B, K] int — tokens selected at the previous step.
+    pre_scores: [B, K] float — accumulated log-prob per live beam.
+    scores: [B, K, V] float — this step's log P(token | beam), or the
+      already-accumulated totals when ``is_accumulated`` (then pre_scores is
+      used only to freeze finished beams, never added again).
+    Returns (selected_ids [B,K], selected_scores [B,K], parent_idx [B,K]).
+    """
+    B, K = jnp.shape(pre_ids)[0], jnp.shape(pre_ids)[1]
+    V = jnp.shape(scores)[2]
+    finished = pre_ids == end_id  # [B, K]
+
+    if is_accumulated:
+        total = scores  # [B, K, V]
+    else:
+        total = pre_scores[:, :, None] + scores
+    # A finished beam contributes exactly one candidate: (end_id, pre_score).
+    total = jnp.where(finished[:, :, None], _NEG_INF, total)
+    end_col = jnp.where(finished, pre_scores, total[:, :, end_id])
+    total = total.at[:, :, end_id].set(end_col)
+
+    flat = jnp.reshape(total, (B, K * V))
+    sel_scores, flat_idx = jax.lax.top_k(flat, K)  # [B, K]
+    parent = flat_idx // V
+    token = flat_idx % V
+    return token.astype(pre_ids.dtype), sel_scores, parent.astype(jnp.int32)
+
+
+def backtrack(ids, parents, scores=None):
+    """Follow parent pointers from the last step back to the first.
+
+    ids, parents (and optional scores): [T, B, K]. Returns sentences
+    [B, K, T] (and, when scores is given, the per-token scores gathered
+    along the same lattice, also [B, K, T]); row [b, k] is the sequence
+    ending in beam slot k at the final step.
+    """
+    T = jnp.shape(ids)[0]
+    B, K = jnp.shape(ids)[1], jnp.shape(ids)[2]
+    beam0 = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None, :], (B, K))
+    have_scores = scores is not None
+    if not have_scores:
+        scores = jnp.zeros_like(ids, dtype=jnp.float32)
+
+    def step(beam, t):
+        tok = jnp.take_along_axis(ids[t], beam, axis=1)  # [B, K]
+        sc = jnp.take_along_axis(scores[t], beam, axis=1)
+        prev = jnp.take_along_axis(parents[t], beam, axis=1)
+        return prev.astype(jnp.int32), (tok, sc)
+
+    _, (toks, scs) = jax.lax.scan(step, beam0, jnp.arange(T - 1, -1, -1))
+    toks = jnp.flip(toks, axis=0)  # [T, B, K] in forward order
+    sent = jnp.transpose(toks, (1, 2, 0))
+    if not have_scores:
+        return sent
+    return sent, jnp.transpose(jnp.flip(scs, axis=0), (1, 2, 0))
+
+
+def _lower_beam_search(ctx, ins, attrs):
+    pre_ids = ins["pre_ids"][0]
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]  # [B, K, V]
+    end_id = attrs.get("end_id", 0)
+    is_accumulated = attrs.get("is_accumulated", True)
+    if not is_accumulated:
+        # scores are per-step probabilities (post-softmax), as produced by
+        # the reference's softmax + beam_search(is_accumulated=False) path;
+        # beam_step adds pre_scores to their log.
+        scores = jnp.log(jnp.maximum(scores, 1e-20))
+    ids, sel_scores, parent = beam_step(
+        pre_ids, pre_scores, scores, end_id, is_accumulated=is_accumulated
+    )
+    return {
+        "selected_ids": ids,
+        "selected_scores": sel_scores,
+        "parent_idx": parent,
+    }
+
+
+register_op(
+    "beam_search",
+    inputs=["pre_ids", "pre_scores", "scores"],
+    outputs=["selected_ids", "selected_scores", "parent_idx"],
+    attrs={"beam_size": 4, "end_id": 0, "is_accumulated": True, "level": 0},
+    lower=_lower_beam_search,
+    grad=None,
+)
+
+
+def _lower_beam_search_decode(ctx, ins, attrs):
+    ids = ins["Ids"][0]  # [T, B, K]
+    parents = ins["ParentIdx"][0]  # [T, B, K]
+    scores = ins.get("Scores", [None])[0]  # optional [T, B, K]
+    if scores is None:
+        sentences = backtrack(ids, parents)
+        sent_scores = jnp.zeros(jnp.shape(sentences), jnp.float32)
+    else:
+        sentences, sent_scores = backtrack(ids, parents, scores)
+    return {"SentenceIds": sentences, "SentenceScores": sent_scores}
+
+
+register_op(
+    "beam_search_decode",
+    inputs=["Ids", "ParentIdx", "Scores"],
+    outputs=["SentenceIds", "SentenceScores"],
+    attrs={"beam_size": 4, "end_id": 0},
+    lower=_lower_beam_search_decode,
+    grad=None,
+)

@@ -1,0 +1,179 @@
+"""Fused seq2seq decoder ops: attention LSTM + whole-loop beam generation.
+
+Reference parity: ``paddle/fluid/operators/attention_lstm_op.cc`` (fused
+per-step attention + LSTM cell) and the generation loop the reference builds
+out of while + beam_search + tensor-array ops (RecurrentGradientMachine's
+generation mode, ``benchmark/fluid/models/machine_translation.py``'s
+lstm_decoder_with_attention). The reference dispatches one kernel per op per
+timestep from the host; the TPU design fuses the whole decoder into a single
+``lax.scan`` so XLA pipelines the per-step matmuls onto the MXU with no host
+round-trips, and generation (embed → attend → cell → project → beam-select →
+reorder) is one compiled loop.
+
+Attention form (simple_attention in the reference benchmark):
+  e[b,s]   = tanh(enc_proj[b,s] @ Wa_e + (h @ Ws) @ Wa_s)
+  alpha    = softmax_s(e)  (masked by EncoderLen)
+  context  = sum_s alpha[b,s] * enc_vec[b,s]
+  gates    = [h, context, x_t] @ CellW + CellB   -> standard LSTM cell.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.ops.beam_search_ops import _NEG_INF, backtrack, beam_step
+
+
+def _enc_mask(enc_len, S, dtype):
+    """[B, S] 1/0 validity mask from optional [B] lengths."""
+    if enc_len is None:
+        return None
+    lens = jnp.reshape(enc_len, (-1,))
+    return (jnp.arange(S)[None, :] < lens[:, None]).astype(dtype)
+
+
+def _attend(h, enc_vec, enc_proj, w_state, w_attn, mask):
+    """One attention read. h [B,D] -> context [B,C], weights [B,S]."""
+    D = jnp.shape(w_state)[0]
+    state_proj = h @ w_state  # [B, D]
+    wa_e, wa_s = w_attn[:D], w_attn[D:]  # [D,1] each
+    e = jnp.tanh(enc_proj @ wa_e + (state_proj @ wa_s)[:, None, :])
+    e = jnp.squeeze(e, axis=2)  # [B, S]
+    if mask is not None:
+        e = jnp.where(mask > 0, e, _NEG_INF)
+    alpha = jax.nn.softmax(e, axis=1)
+    context = jnp.einsum("bs,bsc->bc", alpha, enc_vec)
+    return context, alpha
+
+
+def _lstm_cell(h, c, x_t, context, cell_w, cell_b):
+    D = jnp.shape(h)[1]
+    gates = jnp.concatenate([h, context, x_t], axis=1) @ cell_w + cell_b
+    i = jax.nn.sigmoid(gates[:, 0 * D:1 * D])
+    f = jax.nn.sigmoid(gates[:, 1 * D:2 * D])
+    g = jnp.tanh(gates[:, 2 * D:3 * D])
+    o = jax.nn.sigmoid(gates[:, 3 * D:4 * D])
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _lower_attention_lstm(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, M] teacher-forced target embeddings
+    enc_vec = ins["EncoderVec"][0]  # [B, S, C]
+    enc_proj = ins["EncoderProj"][0]  # [B, S, D]
+    w_state = ins["StateProjW"][0]  # [D, D]
+    w_attn = ins["AttnW"][0]  # [2D, 1]
+    cell_w = ins["CellW"][0]  # [D + C + M, 4D]
+    cell_b = jnp.reshape(ins["CellB"][0], (-1,))
+    h0 = ins["H0"][0]  # [B, D]
+    c0 = ins.get("C0", [None])[0]
+    if c0 is None:
+        c0 = jnp.zeros_like(h0)
+    enc_len = ins.get("EncoderLen", [None])[0]
+    mask = _enc_mask(enc_len, jnp.shape(enc_vec)[1], x.dtype)
+
+    xs = jnp.moveaxis(x, 1, 0)  # [T, B, M]
+
+    def step(carry, x_t):
+        h, c = carry
+        context, alpha = _attend(h, enc_vec, enc_proj, w_state, w_attn, mask)
+        h_new, c_new = _lstm_cell(h, c, x_t, context, cell_w, cell_b)
+        return (h_new, c_new), (h_new, c_new, alpha)
+
+    _, (hs, cs, alphas) = jax.lax.scan(step, (h0, c0), xs)
+    return {
+        "Hidden": jnp.moveaxis(hs, 0, 1),
+        "Cell": jnp.moveaxis(cs, 0, 1),
+        "AttentionWeight": jnp.moveaxis(alphas, 0, 1),
+    }
+
+
+register_op(
+    "attention_lstm",
+    inputs=[
+        "X", "EncoderVec", "EncoderProj", "H0", "C0",
+        "StateProjW", "AttnW", "CellW", "CellB", "EncoderLen",
+    ],
+    outputs=["Hidden", "Cell", "AttentionWeight"],
+    lower=_lower_attention_lstm,
+    no_grad_inputs=("EncoderLen",),
+    intermediate_outputs=("Cell", "AttentionWeight"),
+)
+
+
+def _lower_attention_lstm_beam_decode(ctx, ins, attrs):
+    enc_vec = ins["EncoderVec"][0]  # [B, S, C]
+    enc_proj = ins["EncoderProj"][0]  # [B, S, D]
+    h0 = ins["H0"][0]  # [B, D]
+    w_state = ins["StateProjW"][0]
+    w_attn = ins["AttnW"][0]
+    cell_w = ins["CellW"][0]
+    cell_b = jnp.reshape(ins["CellB"][0], (-1,))
+    emb = ins["Embedding"][0]  # [V, M]
+    out_w = ins["OutW"][0]  # [D, V]
+    out_b = jnp.reshape(ins["OutB"][0], (-1,))
+    enc_len = ins.get("EncoderLen", [None])[0]
+
+    K = int(attrs["beam_size"])
+    T = int(attrs["max_len"])
+    start_id = int(attrs["start_id"])
+    end_id = int(attrs["end_id"])
+
+    B = jnp.shape(enc_vec)[0]
+    S = jnp.shape(enc_vec)[1]
+    dtype = enc_vec.dtype
+
+    # Tile encoder state across the beam: [B, ...] -> [B*K, ...].
+    def tile(t):
+        return jnp.repeat(t, K, axis=0)
+
+    enc_vec_k, enc_proj_k = tile(enc_vec), tile(enc_proj)
+    mask = _enc_mask(enc_len, S, dtype)
+    mask_k = tile(mask) if mask is not None else None
+
+    h = tile(h0)  # [B*K, D]
+    c = jnp.zeros_like(h)
+    prev = jnp.full((B, K), start_id, jnp.int32)
+    # Seed: only beam 0 live so the first top-k isn't K duplicates.
+    scores = jnp.tile(
+        jnp.array([0.0] + [_NEG_INF] * (K - 1), dtype)[None, :], (B, 1)
+    )
+
+    def step(carry, _):
+        h, c, prev, scores = carry
+        x_t = jnp.reshape(emb[jnp.reshape(prev, (-1,))], (B * K, -1))
+        context, _ = _attend(h, enc_vec_k, enc_proj_k, w_state, w_attn,
+                             mask_k)
+        h_new, c_new = _lstm_cell(h, c, x_t, context, cell_w, cell_b)
+        logits = h_new @ out_w + out_b  # [B*K, V]
+        logp = jax.nn.log_softmax(logits, axis=1)
+        logp = jnp.reshape(logp, (B, K, -1))
+        ids, sel_scores, parent = beam_step(prev, scores, logp, end_id)
+        # Reorder recurrent state to follow the surviving beams.
+        def reorder(t):
+            t = jnp.reshape(t, (B, K, -1))
+            t = jnp.take_along_axis(t, parent[:, :, None], axis=1)
+            return jnp.reshape(t, (B * K, -1))
+        return (reorder(h_new), reorder(c_new), ids, sel_scores), (
+            ids, parent,
+        )
+
+    (_, _, _, final_scores), (ids_seq, parent_seq) = jax.lax.scan(
+        step, (h, c, prev, scores), None, length=T
+    )
+    sentences = backtrack(ids_seq, parent_seq)  # [B, K, T]
+    return {"SentenceIds": sentences, "SentenceScores": final_scores}
+
+
+register_op(
+    "attention_lstm_beam_decode",
+    inputs=[
+        "EncoderVec", "EncoderProj", "H0", "StateProjW", "AttnW", "CellW",
+        "CellB", "Embedding", "OutW", "OutB", "EncoderLen",
+    ],
+    outputs=["SentenceIds", "SentenceScores"],
+    attrs={"beam_size": 4, "max_len": 32, "start_id": 1, "end_id": 2},
+    lower=_lower_attention_lstm_beam_decode,
+    grad=None,
+)
