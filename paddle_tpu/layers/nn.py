@@ -60,6 +60,7 @@ __all__ = [
     "image_resize",
     "resize_bilinear",
     "im2sequence",
+    "cos_sim",
 ]
 
 from paddle_tpu.layers.ops import relu, log  # noqa: E402,F401  (re-export)
@@ -930,5 +931,19 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
         outputs={"Out": [out]},
         attrs={"kernels": _pair(filter_size), "strides": _pair(stride),
                "paddings": p},
+    )
+    return out
+
+
+def cos_sim(X, Y, name=None):
+    """Row-wise cosine similarity (cos_sim_op.cc); Y may be [1, D]."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xnorm = helper.create_variable_for_type_inference(X.dtype)
+    ynorm = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(
+        type="cos_sim",
+        inputs={"X": [X], "Y": [Y]},
+        outputs={"Out": [out], "XNorm": [xnorm], "YNorm": [ynorm]},
     )
     return out

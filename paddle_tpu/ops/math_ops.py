@@ -243,3 +243,27 @@ register_op(
     ),
     grad=None,
 )
+
+
+def _lower_cos_sim(ctx, ins, attrs):
+    # cos_sim_op.cc: per-sample cosine similarity with all trailing dims
+    # flattened (rows are dim 0); Y may have a single row (broadcast against
+    # every row of X). Output is [N, 1].
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    x = jnp.reshape(x, (jnp.shape(x)[0], -1))
+    y = jnp.reshape(y, (jnp.shape(y)[0], -1))
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=1, keepdims=True))
+    dot = jnp.sum(x * y, axis=1, keepdims=True)
+    out = dot / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+register_op(
+    "cos_sim",
+    inputs=["X", "Y"],
+    outputs=["Out", "XNorm", "YNorm"],
+    lower=_lower_cos_sim,
+    intermediate_outputs=("XNorm", "YNorm"),
+)

@@ -1,0 +1,149 @@
+"""Book-style end-to-end train tests (tests/book/test_{fit_a_line,word2vec,
+recommender_system}.py parity, SURVEY.md §4): full layers->optimizer->
+Executor loops on synthetic data with convergence thresholds, plus the
+save/load_inference_model round-trip fit_a_line exercises."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_fit_a_line(tmp_path):
+    """Linear regression (uci_housing shape): SGD drives MSE well down and
+    the saved inference model reproduces predictions."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        y_predict = fluid.layers.fc(input=x, size=1)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype("float32")
+    losses = []
+    for _ in range(120):
+        xb = rng.randn(32, 13).astype("float32")
+        yb = xb @ w_true + 0.1 * rng.randn(32, 1).astype("float32")
+        (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                        fetch_list=[avg_cost])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < 0.25, losses[::20]
+
+    # save_inference_model -> load_inference_model round trip.
+    path = str(tmp_path / "fit_a_line.model")
+    fluid.io.save_inference_model(path, ["x"], [y_predict], exe,
+                                  main_program=main)
+    xb = rng.randn(8, 13).astype("float32")
+    (want,) = exe.run(main, feed={"x": xb, "y": np.zeros((8, 1), "float32")},
+                      fetch_list=[y_predict])
+    infer_prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+        path, exe
+    )
+    (got,) = exe.run(infer_prog, feed={feed_names[0]: xb},
+                     fetch_list=fetch_vars)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def test_word2vec_ngram():
+    """N-gram LM (book chapter 4): 4 context embeddings -> concat -> hidden
+    -> softmax. Synthetic deterministic-ish text must be learnable."""
+    dict_size, emb_dim, hidden = 40, 16, 64
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = [
+            fluid.layers.data(name="w%d" % i, shape=[1], dtype="int64")
+            for i in range(4)
+        ]
+        label = fluid.layers.data(name="next", shape=[1], dtype="int64")
+        embs = [
+            fluid.layers.embedding(
+                input=w, size=[dict_size, emb_dim],
+                param_attr=fluid.ParamAttr(name="shared_w"),
+            )
+            for w in words
+        ]
+        concat = fluid.layers.concat(
+            [fluid.layers.reshape(e, shape=[-1, emb_dim]) for e in embs],
+            axis=1,
+        )
+        hid = fluid.layers.fc(input=concat, size=hidden, act="sigmoid")
+        predict = fluid.layers.fc(input=hid, size=dict_size, act="softmax")
+        cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=predict, label=label)
+        )
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    # Synthetic corpus: next word deterministically follows the first
+    # context word (a learnable bigram structure).
+    rng = np.random.RandomState(1)
+    succ = rng.permutation(dict_size)
+    losses = []
+    for _ in range(150):
+        ctx = rng.randint(0, dict_size, (64, 4)).astype("int64")
+        nxt = succ[ctx[:, 0]].astype("int64")
+        feed = {"w%d" % i: ctx[:, i:i + 1] for i in range(4)}
+        feed["next"] = nxt.reshape(-1, 1)
+        (lv,) = exe.run(main, feed=feed, fetch_list=[cost])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.7, losses[::30]
+    # The shared embedding is a single parameter (4 lookups, one table).
+    params = [p.name for p in main.global_block().all_parameters()]
+    assert params.count("shared_w") == 1
+
+
+def test_recommender_system():
+    """Dual-tower user/movie model with cos_sim rating head (book ch. 5)."""
+    n_users, n_movies, n_cats = 50, 80, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        uid = fluid.layers.data(name="user_id", shape=[1], dtype="int64")
+        ujob = fluid.layers.data(name="job_id", shape=[1], dtype="int64")
+        mid = fluid.layers.data(name="movie_id", shape=[1], dtype="int64")
+        mcat = fluid.layers.data(name="category_id", shape=[1],
+                                 dtype="int64")
+        score = fluid.layers.data(name="score", shape=[1], dtype="float32")
+
+        def tower(ids, sizes):
+            feats = []
+            for inp, size in zip(ids, sizes):
+                emb = fluid.layers.embedding(input=inp, size=[size, 16])
+                feats.append(fluid.layers.reshape(emb, shape=[-1, 16]))
+            return fluid.layers.fc(input=feats, size=32, act="tanh")
+
+        usr = tower([uid, ujob], [n_users, n_cats])
+        mov = tower([mid, mcat], [n_movies, n_cats])
+        sim = fluid.layers.cos_sim(X=usr, Y=mov)
+        predict = fluid.layers.scale(sim, scale=5.0)
+        cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=predict, label=score)
+        )
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(2)
+    # Rating = affinity of (user mod 8) vs (movie mod 8) buckets.
+    affinity = rng.rand(8, 8).astype("float32") * 5
+    losses = []
+    for _ in range(150):
+        u = rng.randint(0, n_users, (64, 1)).astype("int64")
+        m = rng.randint(0, n_movies, (64, 1)).astype("int64")
+        feed = {
+            "user_id": u,
+            "job_id": (u % n_cats).astype("int64"),
+            "movie_id": m,
+            "category_id": (m % n_cats).astype("int64"),
+            "score": affinity[u.ravel() % 8, m.ravel() % 8].reshape(-1, 1),
+        }
+        (lv,) = exe.run(main, feed=feed, fetch_list=[cost])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.5, losses[::30]

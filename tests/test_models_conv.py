@@ -83,3 +83,42 @@ def test_resnet50_imagenet_builds():
     n_params = len(main.global_block().all_parameters())
     assert n_params > 100  # 53 convs + BN scales/biases
     assert loss.shape == (1,)
+
+
+def test_se_resnext_builds_and_steps():
+    from paddle_tpu.models import se_resnext
+
+    losses = _train(
+        se_resnext.build,
+        {"img_shape": (3, 32, 32), "class_num": 4, "depth": 50},
+        n=8,
+        bs=4,
+        steps=3,
+    )
+    assert all(np.isfinite(losses))
+
+
+def test_googlenet_builds_and_steps():
+    from paddle_tpu.models import googlenet
+
+    losses = _train(
+        googlenet.build,
+        {"img_shape": (3, 64, 64), "class_num": 4},
+        n=8,
+        bs=4,
+        steps=3,
+    )
+    assert all(np.isfinite(losses))
+
+
+def test_alexnet_converges():
+    from paddle_tpu.models import alexnet
+
+    losses = _train(
+        alexnet.build,
+        {"img_shape": (3, 63, 63), "class_num": 4},
+        n=32,
+        bs=8,
+        steps=20,
+    )
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses

@@ -186,6 +186,24 @@ class TestLookupTable(OpTest):
         self.outputs = {"Out": w[ids.ravel()]}
 
 
+class TestCosSim(OpTest):
+    op_type = "cos_sim"
+
+    def setup(self):
+        x = np.random.rand(4, 3, 5).astype("float32") + 0.1
+        y = np.random.rand(4, 3, 5).astype("float32") + 0.1
+        xf = x.reshape(4, -1)
+        yf = y.reshape(4, -1)
+        xn = np.linalg.norm(xf, axis=1, keepdims=True)
+        yn = np.linalg.norm(yf, axis=1, keepdims=True)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {
+            "Out": (xf * yf).sum(1, keepdims=True) / (xn * yn),
+            "XNorm": xn,
+            "YNorm": yn,
+        }
+
+
 ALL_TESTS = [
     TestMulOp,
     TestMatmulTransposed,
@@ -200,6 +218,7 @@ ALL_TESTS = [
     TestSumOp,
     TestConcatOp,
     TestLookupTable,
+    TestCosSim,
 ]
 
 GRAD_SPECS = {
@@ -216,6 +235,7 @@ GRAD_SPECS = {
     TestSumOp: (["x0", "x1"], "Out"),
     TestConcatOp: (["ca", "cb"], "Out"),
     TestLookupTable: (["W"], "Out"),
+    TestCosSim: (["X", "Y"], "Out"),
 }
 
 
