@@ -23,3 +23,6 @@ from paddle_tpu.ops import io_ops  # noqa: F401
 from paddle_tpu.ops import detection_ops  # noqa: F401
 from paddle_tpu.ops import beam_search_ops  # noqa: F401
 from paddle_tpu.ops import seq2seq_ops  # noqa: F401
+from paddle_tpu.ops import crf_ops  # noqa: F401
+from paddle_tpu.ops import ctc_ops  # noqa: F401
+from paddle_tpu.ops import sampling_ops  # noqa: F401

@@ -42,3 +42,28 @@ def flatten_to_2d(x, num_col_dims):
     for d in shape[num_col_dims:]:
         cols *= d
     return jnp.reshape(x, (rows, cols))
+
+
+def optional_lengths(ins, x, key="Length"):
+    """[B] int32 lengths from an optional per-row length input; defaults to
+    the full padded time dimension x.shape[1]."""
+    if key in ins and ins[key]:
+        return jnp.reshape(ins[key][0], (-1,)).astype(jnp.int32)
+    return jnp.full((jnp.shape(x)[0],), jnp.shape(x)[1], jnp.int32)
+
+
+def compact_rows(x, keep, pad_value):
+    """Stable left-compaction of kept elements per row ([B, T] int tensors).
+
+    Returns (compacted, kept_count[B]); dropped positions fill with
+    pad_value. Uses the argsort-partition idiom (stable small-int sort on
+    the VPU keeps every shape static).
+    """
+    T = jnp.shape(x)[1]
+    ar = jnp.arange(T)
+    order = jnp.argsort(jnp.where(keep, ar[None, :], T + ar[None, :]),
+                        axis=1)
+    gathered = jnp.take_along_axis(x, order, axis=1)
+    n_keep = jnp.sum(keep, axis=1).astype(jnp.int32)
+    out = jnp.where(ar[None, :] < n_keep[:, None], gathered, pad_value)
+    return out, n_keep

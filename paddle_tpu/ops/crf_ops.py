@@ -1,0 +1,157 @@
+"""Linear-chain CRF ops: NLL forward + Viterbi decoding.
+
+Reference parity: ``paddle/fluid/operators/linear_chain_crf_op.cc`` and
+``crf_decoding_op.cc`` (used by the label_semantic_roles book chapter). The
+reference walks LoD-packed sequences one at a time on the host; here both
+the forward (log-partition) and Viterbi recursions are a batched
+``lax.scan`` over the padded time axis with length masks, so the [K, K]
+transition contraction is one batched matmul per step on the MXU and the
+gradient of the NLL comes from jax.vjp over the scan (no manual
+beta/backward pass).
+
+Transition layout matches the reference: [num_tags + 2, num_tags], row 0 =
+start weights, row 1 = stop weights, rows 2.. = tag-to-tag transitions.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+_NEG = -1e30
+
+
+from paddle_tpu.ops.common import optional_lengths
+
+
+def _length_mask(ins, x):
+    lens = optional_lengths(ins, x)
+    return jnp.arange(jnp.shape(x)[1])[None, :] < lens[:, None]
+
+
+def _lower_linear_chain_crf(ctx, ins, attrs):
+    x = ins["Emission"][0]  # [B, T, K]
+    trans = ins["Transition"][0]  # [K+2, K]
+    label = ins["Label"][0]  # [B, T] or [B, T, 1]
+    label = jnp.reshape(label, (jnp.shape(x)[0], -1))
+    length = ins.get("Length", [None])[0]
+
+    B, T, K = jnp.shape(x)[0], jnp.shape(x)[1], jnp.shape(x)[2]
+    a = trans[0]  # start [K]
+    b = trans[1]  # stop [K]
+    w = trans[2:]  # [K, K]
+    mask = _length_mask(ins, x).astype(x.dtype)  # [B, T]
+
+    # --- log-partition via forward recursion -----------------------------
+    alpha0 = a[None, :] + x[:, 0, :]  # [B, K]
+
+    def fwd(alpha, xm):
+        x_t, m_t = xm  # [B, K], [B]
+        scores = alpha[:, :, None] + w[None, :, :]  # [B, K, K]
+        new = jax.scipy.special.logsumexp(scores, axis=1) + x_t
+        new = jnp.where(m_t[:, None] > 0, new, alpha)
+        return new, alpha
+
+    xs = jnp.moveaxis(x, 1, 0)[1:]  # [T-1, B, K]
+    ms = jnp.moveaxis(mask, 1, 0)[1:]
+    alpha_last, alphas = jax.lax.scan(fwd, alpha0, (xs, ms))
+    log_z = jax.scipy.special.logsumexp(alpha_last + b[None, :], axis=1)
+
+    # --- gold path score --------------------------------------------------
+    emit = jnp.take_along_axis(x, label[:, :, None], axis=2)[:, :, 0]
+    emit_score = jnp.sum(emit * mask, axis=1)
+    prev_tag = label[:, :-1]
+    next_tag = label[:, 1:]
+    trans_score = jnp.sum(
+        w[prev_tag, next_tag] * mask[:, 1:], axis=1
+    )
+    start_score = a[label[:, 0]]
+    lens_idx = (
+        jnp.sum(mask, axis=1).astype(jnp.int32) - 1
+        if length is not None
+        else jnp.full((B,), T - 1, jnp.int32)
+    )
+    last_tag = jnp.take_along_axis(label, lens_idx[:, None], axis=1)[:, 0]
+    stop_score = b[last_tag]
+    gold = emit_score + trans_score + start_score + stop_score
+
+    nll = (log_z - gold)[:, None]  # [B, 1]
+    full_alpha = jnp.concatenate(
+        [jnp.moveaxis(alphas, 0, 1), alpha_last[:, None, :]], axis=1
+    )
+    return {
+        "Alpha": full_alpha,
+        "EmissionExps": jnp.exp(x),
+        "TransitionExps": jnp.exp(trans),
+        "LogLikelihood": nll,
+    }
+
+
+register_op(
+    "linear_chain_crf",
+    inputs=["Emission", "Transition", "Label", "Length"],
+    outputs=["Alpha", "EmissionExps", "TransitionExps", "LogLikelihood"],
+    lower=_lower_linear_chain_crf,
+    no_grad_inputs=("Label", "Length"),
+    intermediate_outputs=("Alpha", "EmissionExps", "TransitionExps"),
+)
+
+
+def _lower_crf_decoding(ctx, ins, attrs):
+    x = ins["Emission"][0]  # [B, T, K]
+    trans = ins["Transition"][0]
+    length = ins.get("Length", [None])[0]
+    B, T, K = jnp.shape(x)[0], jnp.shape(x)[1], jnp.shape(x)[2]
+    a, b, w = trans[0], trans[1], trans[2:]
+    mask = _length_mask(ins, x)
+    lens_idx = jnp.sum(mask.astype(jnp.int32), axis=1) - 1  # [B]
+
+    delta0 = a[None, :] + x[:, 0, :]
+
+    def fwd(delta, xm):
+        x_t, m_t = xm
+        scores = delta[:, :, None] + w[None, :, :]  # [B, K(prev), K(cur)]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, K]
+        new = jnp.max(scores, axis=1) + x_t
+        new = jnp.where(m_t[:, None], new, delta)
+        return new, best_prev
+
+    xs = jnp.moveaxis(x, 1, 0)[1:]
+    ms = jnp.moveaxis(mask, 1, 0)[1:]
+    delta_last, bps = jax.lax.scan(fwd, delta0, (xs, ms))
+    # bps[t] holds backpointers for step t+1; [T-1, B, K]
+    best_last = jnp.argmax(delta_last + b[None, :], axis=1).astype(jnp.int32)
+
+    def back(carry, t):
+        # carry = tag at position t+1; bps[t] holds position t+1's
+        # backpointers. Positions at/after len-1 pin to the final best tag
+        # so the carry is already best_last when the backtrack reaches the
+        # row's true last position.
+        tag_here = jnp.take_along_axis(
+            bps[t], carry[:, None], axis=1
+        )[:, 0].astype(jnp.int32)
+        tag = jnp.where(t >= lens_idx, best_last, tag_here)
+        return tag, tag
+
+    _, path_rev = jax.lax.scan(
+        back, best_last, jnp.arange(T - 2, -1, -1)
+    )
+    # path_rev[i] = tag at position T-2-i  ->  [B, T-1] forward order.
+    body = jnp.flip(jnp.moveaxis(path_rev, 0, 1), axis=1)
+    path = jnp.concatenate([body, best_last[:, None]], axis=1)  # [B, T]
+    path = jnp.where(mask, path, 0).astype(jnp.int64)
+
+    label = ins.get("Label", [None])[0]
+    if label is not None:
+        label = jnp.reshape(label, (B, -1))
+        path = jnp.where(mask, (path == label).astype(jnp.int64), 0)
+    return {"ViterbiPath": path}
+
+
+register_op(
+    "crf_decoding",
+    inputs=["Emission", "Transition", "Label", "Length"],
+    outputs=["ViterbiPath"],
+    lower=_lower_crf_decoding,
+    grad=None,
+)

@@ -1,0 +1,198 @@
+"""CTC loss/alignment + edit distance.
+
+Reference parity: ``paddle/fluid/operators/warpctc_op.cc`` (dlopen'd
+warp-ctc CUDA/CPU library), ``ctc_align_op.cc``, ``edit_distance_op.cc``.
+The TPU design computes the CTC alpha recursion in log space directly as a
+batched ``lax.scan`` over the padded time axis (the [B, 2L+1] lattice update
+is pure VPU elementwise work), so the gradient falls out of jax.vjp instead
+of warp-ctc's hand-written backward; edit distance uses the prefix-min trick
+(jax.lax.cummin) to vectorize each DP row, giving an O(T_hyp) scan instead
+of the reference's O(T_hyp * T_ref) host loop.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.ops.common import compact_rows, optional_lengths
+
+_NEG = -1e30
+
+
+def _logsumexp3(a, b, c):
+    m = jnp.maximum(jnp.maximum(a, b), c)
+    m = jnp.maximum(m, _NEG)  # keep -inf lanes finite
+    return m + jnp.log(
+        jnp.exp(a - m) + jnp.exp(b - m) + jnp.exp(c - m)
+    )
+
+
+def _lower_warpctc(ctx, ins, attrs):
+    logits = ins["Logits"][0]  # [B, T, V] raw activations
+    label = ins["Label"][0]  # [B, L]
+    label = jnp.reshape(label, (jnp.shape(logits)[0], -1))
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = attrs.get("norm_by_times", False)
+
+    B, T, V = (
+        jnp.shape(logits)[0], jnp.shape(logits)[1], jnp.shape(logits)[2]
+    )
+    L = jnp.shape(label)[1]
+    S = 2 * L + 1
+
+    t_len = optional_lengths(ins, logits, "LogitsLength")
+    l_len = optional_lengths(ins, label, "LabelLength")
+
+    lp = jax.nn.log_softmax(logits, axis=2)  # [B, T, V]
+
+    # Extended sequence: blank, l0, blank, l1, ..., blank  -> [B, S]
+    s_idx = jnp.arange(S)
+    is_lab = (s_idx % 2) == 1
+    lab_pos = jnp.clip((s_idx - 1) // 2, 0, L - 1)
+    ext = jnp.where(
+        is_lab[None, :], label[:, lab_pos], blank
+    ).astype(jnp.int32)  # [B, S]
+    # Valid lattice states: s < 2*l_len + 1.
+    s_valid = s_idx[None, :] < (2 * l_len + 1)[:, None]
+    # Skip transition allowed when ext[s] != blank and ext[s] != ext[s-2].
+    ext_m2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1
+    )
+    can_skip = is_lab[None, :] & (ext != ext_m2)
+
+    alpha0 = jnp.full((B, S), _NEG)
+    alpha0 = alpha0.at[:, 0].set(lp[:, 0, blank])
+    first_lab = jnp.where(l_len > 0, ext[:, 1], blank)
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(
+            l_len > 0,
+            jnp.take_along_axis(lp[:, 0, :], first_lab[:, None], 1)[:, 0],
+            _NEG,
+        )
+    )
+
+    lps = jnp.moveaxis(lp, 1, 0)  # [T, B, V]
+
+    def step(alpha, tx):
+        t, lp_t = tx
+        a_m1 = jnp.concatenate(
+            [jnp.full((B, 1), _NEG), alpha[:, :-1]], axis=1
+        )
+        a_m2 = jnp.concatenate(
+            [jnp.full((B, 2), _NEG), alpha[:, :-2]], axis=1
+        )
+        a_m2 = jnp.where(can_skip, a_m2, _NEG)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)  # [B, S]
+        new = _logsumexp3(alpha, a_m1, a_m2) + emit
+        new = jnp.where(s_valid, new, _NEG)
+        live = (t < t_len)[:, None]
+        return jnp.where(live, new, alpha), None
+
+    alpha_last, _ = jax.lax.scan(
+        step, alpha0, (jnp.arange(1, T), lps[1:])
+    )
+    # Loss: -logsumexp(alpha[2*l_len], alpha[2*l_len - 1]).
+    end0 = jnp.take_along_axis(alpha_last, (2 * l_len)[:, None], 1)[:, 0]
+    end1_idx = jnp.clip(2 * l_len - 1, 0, S - 1)
+    end1 = jnp.take_along_axis(alpha_last, end1_idx[:, None], 1)[:, 0]
+    end1 = jnp.where(l_len > 0, end1, _NEG)
+    m = jnp.maximum(end0, end1)
+    ll = m + jnp.log(jnp.exp(end0 - m) + jnp.exp(end1 - m))
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(t_len.astype(loss.dtype), 1.0)
+    return {"Loss": loss[:, None], "WarpCTCGrad": jnp.zeros_like(logits)}
+
+
+register_op(
+    "warpctc",
+    inputs=["Logits", "Label", "LogitsLength", "LabelLength"],
+    outputs=["Loss", "WarpCTCGrad"],
+    attrs={"blank": 0, "norm_by_times": False},
+    lower=_lower_warpctc,
+    no_grad_inputs=("Label", "LogitsLength", "LabelLength"),
+    intermediate_outputs=("WarpCTCGrad",),
+)
+
+
+def _lower_ctc_align(ctx, ins, attrs):
+    x = ins["Input"][0]  # [B, T] int paths
+    blank = int(attrs.get("blank", 0))
+    B, T = jnp.shape(x)[0], jnp.shape(x)[1]
+    lens = optional_lengths(ins, x, "InputLength")
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, x.dtype), x[:, :-1]], 1)
+    keep = valid & (x != blank) & (x != prev)
+    out, n_keep = compact_rows(x, keep, blank)
+    return {"Output": out, "OutputLength": n_keep[:, None]}
+
+
+register_op(
+    "ctc_align",
+    inputs=["Input", "InputLength"],
+    outputs=["Output", "OutputLength"],
+    attrs={"blank": 0, "merge_repeated": True},
+    lower=_lower_ctc_align,
+    grad=None,
+)
+
+
+def _lower_edit_distance(ctx, ins, attrs):
+    hyp = ins["Hyps"][0]  # [B, T1] int
+    ref = ins["Refs"][0]  # [B, T2] int
+    normalized = attrs.get("normalized", False)
+    B = jnp.shape(hyp)[0]
+    T1, T2 = jnp.shape(hyp)[1], jnp.shape(ref)[1]
+    h_len = optional_lengths(ins, hyp, "HypsLength")
+    r_len = optional_lengths(ins, ref, "RefsLength")
+
+    BIG = jnp.asarray(T1 + T2 + 1, jnp.float32)
+    ar2 = jnp.arange(T2 + 1, dtype=jnp.float32)
+    # Column j > r_len is frozen at BIG so it never wins the final gather.
+    col_valid = jnp.arange(T2 + 1)[None, :] <= r_len[:, None]
+    row0 = jnp.where(col_valid, ar2[None, :], BIG)  # [B, T2+1]
+
+    def row_step(prev_row, i):
+        # prev_row = D[i-1, :]; compute D[i, :] for hypothesis token i-1.
+        tok = hyp[:, i - 1][:, None]  # [B, 1]
+        sub_cost = (ref != tok).astype(jnp.float32)  # [B, T2]
+        del_ = prev_row + 1.0  # delete hyp token
+        sub = prev_row[:, :-1] + sub_cost  # substitute
+        tmp0 = jnp.where(
+            jnp.arange(T2 + 1)[None, :] == 0,
+            i.astype(jnp.float32),
+            BIG,
+        )
+        tmp = jnp.minimum(
+            del_,
+            jnp.concatenate([jnp.full((B, 1), BIG), sub], axis=1),
+        )
+        tmp = jnp.minimum(tmp, tmp0)
+        # Insertions propagate left-to-right: D[j] = min(tmp[j],
+        # min_{k<j} tmp[k] + (j - k)) — a prefix-min of (tmp - j).
+        shifted = jax.lax.cummin(tmp - ar2[None, :], axis=1) + ar2[None, :]
+        row = jnp.minimum(tmp, shifted)
+        # Rows beyond the hypothesis length keep the previous row.
+        live = (i <= h_len)[:, None]
+        row = jnp.where(live & col_valid, row, jnp.where(live, BIG,
+                                                         prev_row))
+        return row, None
+
+    last_row, _ = jax.lax.scan(row_step, row0, jnp.arange(1, T1 + 1))
+    dist = jnp.take_along_axis(last_row, r_len[:, None], axis=1)[:, 0]
+    if normalized:
+        dist = dist / jnp.maximum(r_len.astype(jnp.float32), 1.0)
+    return {
+        "Out": dist[:, None],
+        "SequenceNum": jnp.asarray([B], jnp.int64),
+    }
+
+
+register_op(
+    "edit_distance",
+    inputs=["Hyps", "Refs", "HypsLength", "RefsLength"],
+    outputs=["Out", "SequenceNum"],
+    attrs={"normalized": False},
+    lower=_lower_edit_distance,
+    grad=None,
+)

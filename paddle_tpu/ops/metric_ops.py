@@ -3,6 +3,7 @@
 Reference parity: paddle/fluid/operators/{accuracy,auc}_op.cc.
 """
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.op_registry import register_op
@@ -69,5 +70,143 @@ register_op(
     outputs=["AUC", "StatPosOut", "StatNegOut"],
     attrs={"curve": "ROC", "num_thresholds": 200},
     lower=_lower_auc,
+    grad=None,
+)
+
+
+def _chunk_flags(tags, lens, num_chunk_types, scheme):
+    """Per-position (in, begin, end, type) flags for a tag grid [B, T].
+
+    Tag encoding matches chunk_eval_op.h: tag = chunk_type * num_tag_types
+    + tag_type; ids >= num_chunk_types * num_tag_types are outside ("O").
+    """
+    n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    B, T = tags.shape[0], tags.shape[1]
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    inside = valid & (tags >= 0) & (tags < num_chunk_types * n_tag)
+    ctype = jnp.where(inside, tags // n_tag, -1)
+    tag_type = jnp.where(inside, tags % n_tag, -1)
+    if scheme == "plain":
+        b_marker = inside
+        e_marker = inside
+    elif scheme == "IOB":
+        b_marker = tag_type == 0
+        e_marker = jnp.zeros_like(inside)
+    elif scheme == "IOE":
+        b_marker = jnp.zeros_like(inside)
+        e_marker = tag_type == 1
+    else:  # IOBES
+        b_marker = (tag_type == 0) | (tag_type == 3)
+        e_marker = (tag_type == 2) | (tag_type == 3)
+
+    prev_in = jnp.concatenate(
+        [jnp.zeros((B, 1), bool), inside[:, :-1]], axis=1
+    )
+    prev_type = jnp.concatenate(
+        [jnp.full((B, 1), -2), ctype[:, :-1]], axis=1
+    )
+    prev_e = jnp.concatenate(
+        [jnp.zeros((B, 1), bool), e_marker[:, :-1]], axis=1
+    )
+    begin = inside & (
+        b_marker | ~prev_in | (prev_type != ctype) | prev_e
+    )
+    next_in = jnp.concatenate(
+        [inside[:, 1:], jnp.zeros((B, 1), bool)], axis=1
+    )
+    next_type = jnp.concatenate(
+        [ctype[:, 1:], jnp.full((B, 1), -2)], axis=1
+    )
+    next_b = jnp.concatenate(
+        [b_marker[:, 1:], jnp.zeros((B, 1), bool)], axis=1
+    )
+    end = inside & (
+        e_marker | ~next_in | (next_type != ctype) | next_b
+    )
+    return inside, begin, end, ctype
+
+
+def _lower_chunk_eval(ctx, ins, attrs):
+    """chunk_eval_op.cc capability: precision/recall/F1 over chunks.
+
+    A matched chunk = label and inference chunks that begin together, end
+    together, and share a type; tracked with a scan carrying an
+    'aligned-chunk open' flag (the conlleval in_correct algorithm)."""
+    inf = jnp.reshape(
+        ins["Inference"][0], (jnp.shape(ins["Inference"][0])[0], -1)
+    ).astype(jnp.int32)
+    lab = jnp.reshape(
+        ins["Label"][0], (jnp.shape(ins["Label"][0])[0], -1)
+    ).astype(jnp.int32)
+    B, T = inf.shape[0], inf.shape[1]
+    from paddle_tpu.ops.common import optional_lengths
+
+    lens = optional_lengths(ins, inf)
+    scheme = attrs.get("chunk_scheme", "IOB")
+    nct = int(attrs.get("num_chunk_types", 1))
+    excluded = list(attrs.get("excluded_chunk_types", []))
+
+    l_in, l_b, l_e, l_t = _chunk_flags(lab, lens, nct, scheme)
+    p_in, p_b, p_e, p_t = _chunk_flags(inf, lens, nct, scheme)
+    if excluded:
+        ex = jnp.asarray(excluded)
+        l_ok = ~jnp.isin(l_t, ex)
+        p_ok = ~jnp.isin(p_t, ex)
+        l_b, l_e, l_in = l_b & l_ok, l_e & l_ok, l_in & l_ok
+        p_b, p_e, p_in = p_b & p_ok, p_e & p_ok, p_in & p_ok
+
+    def step(carry, t):
+        was_active, correct = carry
+        both_begin = l_b[:, t] & p_b[:, t] & (l_t[:, t] == p_t[:, t])
+        # An open aligned chunk survives only if both sides continue it.
+        cont = (
+            was_active & ~l_b[:, t] & ~p_b[:, t] & l_in[:, t] & p_in[:, t]
+        )
+        active = both_begin | cont
+        both_end = l_e[:, t] & p_e[:, t]
+        one_end = l_e[:, t] != p_e[:, t]
+        correct = correct + jnp.where(active & both_end, 1, 0)
+        active = active & ~both_end & ~one_end
+        return (active, correct), None
+
+    init = (jnp.zeros((B,), bool), jnp.zeros((B,), jnp.int64))
+    (_, correct), _ = jax.lax.scan(step, init, jnp.arange(T))
+    num_correct = jnp.sum(correct)
+    num_label = jnp.sum(l_b.astype(jnp.int64))
+    num_infer = jnp.sum(p_b.astype(jnp.int64))
+    precision = jnp.where(
+        num_infer > 0, num_correct / jnp.maximum(num_infer, 1), 0.0
+    ).astype(jnp.float32)
+    recall = jnp.where(
+        num_label > 0, num_correct / jnp.maximum(num_label, 1), 0.0
+    ).astype(jnp.float32)
+    f1 = jnp.where(
+        precision + recall > 0,
+        2 * precision * recall / jnp.maximum(precision + recall, 1e-12),
+        0.0,
+    ).astype(jnp.float32)
+    return {
+        "Precision": precision[None],
+        "Recall": recall[None],
+        "F1-Score": f1[None],
+        "NumInferChunks": num_infer[None],
+        "NumLabelChunks": num_label[None],
+        "NumCorrectChunks": num_correct[None],
+    }
+
+
+register_op(
+    "chunk_eval",
+    inputs=["Inference", "Label", "Length"],
+    outputs=[
+        "Precision", "Recall", "F1-Score",
+        "NumInferChunks", "NumLabelChunks", "NumCorrectChunks",
+    ],
+    attrs={
+        "num_chunk_types": 1,
+        "chunk_scheme": "IOB",
+        "excluded_chunk_types": [],
+    },
+    lower=_lower_chunk_eval,
     grad=None,
 )

@@ -1,0 +1,116 @@
+"""Sampled/factorized softmax ops: NCE + hierarchical sigmoid.
+
+Reference parity: ``paddle/fluid/operators/nce_op.cc`` (noise-contrastive
+estimation with a host-side Sampler) and ``hierarchical_sigmoid_op.cc``
+(complete-binary-tree sigmoid via operators/math/matrix_bit_code). Both are
+the reference's big-vocab softmax escape hatches; on TPU the sampled logits
+are small gather+matmul batches and negative sampling uses the op's own
+PRNG key (deterministic per program seed, like the reference's fixed-seed
+Sampler option).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+
+def _lower_nce(ctx, ins, attrs):
+    x = ins["Input"][0]  # [B, D]
+    w = ins["Weight"][0]  # [V, D]
+    label = jnp.reshape(ins["Label"][0], (jnp.shape(x)[0], -1))  # [B, Nt]
+    bias = ins.get("Bias", [None])[0]
+    num_total = int(attrs.get("num_total_classes", jnp.shape(w)[0]))
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    B = jnp.shape(x)[0]
+    n_true = jnp.shape(label)[1]
+
+    if int(attrs.get("sampler", 0)) != 0:
+        raise NotImplementedError(
+            "nce: only the uniform sampler is lowered; log_uniform/"
+            "custom_dist need their own noise-probability correction"
+        )
+    neg = jax.random.randint(ctx.rng(), (B, num_neg), 0, num_total)
+    samples = jnp.concatenate([label, neg], axis=1)  # [B, Nt+Nn]
+    w_s = w[samples]  # [B, S, D]
+    logits = jnp.einsum("bd,bsd->bs", x, w_s)
+    if bias is not None:
+        logits = logits + jnp.reshape(bias, (-1,))[samples]
+    # Uniform noise distribution q = 1/V; NCE logistic correction
+    # log(k * q(y)).
+    log_kq = jnp.log(num_neg / num_total)
+    adjusted = logits - log_kq
+    # Logistic NCE: -log sigma(s) for true classes (averaged), -log(1 -
+    # sigma(s)) for each sampled negative.
+    true_adj = adjusted[:, :n_true]
+    neg_adj = adjusted[:, n_true:]
+    cost = (
+        jnp.sum(jax.nn.softplus(-true_adj), axis=1, keepdims=True) / n_true
+        + jnp.sum(jax.nn.softplus(neg_adj), axis=1, keepdims=True)
+    )
+    return {
+        "Cost": cost,
+        "SampleLogits": logits,
+        "SampleLabels": samples.astype(jnp.int64),
+    }
+
+
+register_op(
+    "nce",
+    inputs=["Input", "Label", "Weight", "Bias", "SampleWeight"],
+    outputs=["Cost", "SampleLogits", "SampleLabels"],
+    attrs={
+        "num_total_classes": 0,
+        "num_neg_samples": 10,
+        "sampler": 0,
+        "seed": 0,
+        "is_sparse": False,
+    },
+    lower=_lower_nce,
+    no_grad_inputs=("Label", "SampleWeight"),
+    intermediate_outputs=("SampleLogits", "SampleLabels"),
+)
+
+
+def _lower_hierarchical_sigmoid(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, D]
+    w = ins["W"][0]  # [num_classes - 1, D] internal-node weights
+    label = jnp.reshape(ins["Label"][0], (-1,))  # [B]
+    bias = ins.get("Bias", [None])[0]
+    num_classes = int(attrs.get("num_classes", jnp.shape(w)[0] + 1))
+    B = jnp.shape(x)[0]
+
+    # Complete binary tree in heap order: leaf for class c is node
+    # c + num_classes; internal nodes 1..num_classes-1 (weight row node-1).
+    code = label.astype(jnp.int32) + num_classes
+    max_depth = max(1, int(num_classes - 1).bit_length())
+
+    losses = jnp.zeros((B, 1), x.dtype)
+    pre_out = []
+    for j in range(max_depth, 0, -1):
+        node = code >> j  # internal node at this level
+        valid = node >= 1
+        bit = (code >> (j - 1)) & 1  # which child the path takes
+        row = jnp.clip(node - 1, 0, num_classes - 2)
+        s = jnp.einsum("bd,bd->b", x, w[row])
+        if bias is not None:
+            s = s + jnp.reshape(bias, (-1,))[row]
+        # -log P(bit | node): softplus(s) - bit * s.
+        step_loss = jax.nn.softplus(s) - bit.astype(s.dtype) * s
+        losses = losses + jnp.where(valid, step_loss, 0.0)[:, None]
+        pre_out.append(jnp.where(valid, s, 0.0))
+    return {
+        "Out": losses,
+        "PreOut": jnp.stack(pre_out, axis=1),
+    }
+
+
+register_op(
+    "hierarchical_sigmoid",
+    inputs=["X", "W", "Label", "Bias"],
+    outputs=["Out", "PreOut"],
+    attrs={"num_classes": 2},
+    lower=_lower_hierarchical_sigmoid,
+    no_grad_inputs=("Label",),
+    intermediate_outputs=("PreOut",),
+)

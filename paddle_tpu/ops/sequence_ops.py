@@ -147,3 +147,297 @@ register_op(
     lower=_lower_sequence_mask,
     grad=None,
 )
+
+
+# ---------------------------------------------------------------------------
+# Wider sequence family (dense-padded forms of the reference's LoD ops:
+# sequence_conv/concat/expand_as/pad/unpad/slice/erase/enumerate/scatter,
+# paddle/fluid/operators/sequence_ops/). Row-compaction ops use the stable
+# argsort-partition idiom (sorting small int keys is cheap on the VPU and
+# keeps every shape static).
+# ---------------------------------------------------------------------------
+
+
+from paddle_tpu.ops.common import compact_rows, optional_lengths
+
+_row_lengths = optional_lengths
+
+
+def _lower_sequence_conv(ctx, ins, attrs):
+    # sequence_conv_op.cc: per-timestep context window [start, start+len)
+    # stacked then projected; dense form gathers shifted copies and does one
+    # MXU matmul.
+    x = ins["X"][0]  # [B, T, D]
+    filt = ins["Filter"][0]  # [ctx_len * D, M]
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -(ctx_len // 2)))
+    if int(attrs.get("contextStride", 1)) != 1:
+        raise NotImplementedError(
+            "sequence_conv contextStride != 1 (the reference op enforces "
+            "stride 1 as well, sequence_conv_op.cc)"
+        )
+    B, T, D = jnp.shape(x)[0], jnp.shape(x)[1], jnp.shape(x)[2]
+    mask = None
+    if "Length" in ins and ins["Length"]:
+        lens = _row_lengths(ins, x)
+        mask = (jnp.arange(T)[None, :] < lens[:, None]).astype(x.dtype)
+        x = x * mask[:, :, None]
+    cols = []
+    for j in range(ctx_len):
+        off = ctx_start + j
+        shifted = jnp.roll(x, -off, axis=1)
+        t_idx = jnp.arange(T) + off
+        ok = ((t_idx >= 0) & (t_idx < T))[None, :, None]
+        cols.append(jnp.where(ok, shifted, 0.0))
+    stacked = jnp.concatenate(cols, axis=2)  # [B, T, ctx_len*D]
+    out = jnp.einsum("btc,cm->btm", stacked, filt)
+    if mask is not None:
+        out = out * mask[:, :, None]
+    return {"Out": out}
+
+
+register_op(
+    "sequence_conv",
+    inputs=["X", "Filter", "Length"],
+    outputs=["Out"],
+    attrs={"contextLength": 3, "contextStart": -1, "contextStride": 1},
+    lower=_lower_sequence_conv,
+    no_grad_inputs=("Length",),
+)
+
+
+def _lower_sequence_concat(ctx, ins, attrs):
+    # Per-row concatenation of valid prefixes: row i of the output is
+    # x[i,:lx] ++ y[i,:ly], re-padded to Tx+Ty.
+    xs = ins["X"]
+    if len(xs) == 1:
+        return {"Out": xs[0]}
+    lens = ins.get("Length", [])
+    out = xs[0]
+    out_len = (
+        jnp.reshape(lens[0], (-1,)).astype(jnp.int32)
+        if lens
+        else jnp.full((jnp.shape(out)[0],), jnp.shape(out)[1], jnp.int32)
+    )
+    for k, nxt in enumerate(xs[1:], start=1):
+        B = jnp.shape(out)[0]
+        T1, T2 = jnp.shape(out)[1], jnp.shape(nxt)[1]
+        n_len = (
+            jnp.reshape(lens[k], (-1,)).astype(jnp.int32)
+            if k < len(lens)
+            else jnp.full((B,), T2, jnp.int32)
+        )
+        T = T1 + T2
+        j = jnp.arange(T)[None, :]
+        from_first = j < out_len[:, None]
+        idx1 = jnp.clip(j, 0, T1 - 1)
+        idx2 = jnp.clip(j - out_len[:, None], 0, T2 - 1)
+        g1 = jnp.take_along_axis(out, idx1[..., None] if jnp.ndim(out) == 3
+                                 else idx1, axis=1)
+        g2 = jnp.take_along_axis(nxt, idx2[..., None] if jnp.ndim(nxt) == 3
+                                 else idx2, axis=1)
+        merged = jnp.where(
+            from_first[..., None] if jnp.ndim(out) == 3 else from_first,
+            g1, g2,
+        )
+        total = out_len + n_len
+        valid = j < total[:, None]
+        merged = jnp.where(
+            valid[..., None] if jnp.ndim(merged) == 3 else valid, merged, 0
+        )
+        out, out_len = merged, total
+    return {"Out": out, "OutLength": out_len[:, None]}
+
+
+register_op(
+    "sequence_concat",
+    inputs=["*X", "*Length"],
+    outputs=["Out", "OutLength"],
+    lower=_lower_sequence_concat,
+    no_grad_inputs=("Length",),
+    intermediate_outputs=("OutLength",),
+)
+
+
+def _lower_sequence_expand_as(ctx, ins, attrs):
+    # sequence_expand_as_op.cc: tile each row of X to Y's time length.
+    x = ins["X"][0]  # [B, D] or [B, 1, D]
+    y = ins["Y"][0]  # [B, T, ...]
+    T = jnp.shape(y)[1]
+    if jnp.ndim(x) == 2:
+        out = jnp.broadcast_to(
+            x[:, None, :], (jnp.shape(x)[0], T, jnp.shape(x)[1])
+        )
+    else:
+        out = jnp.broadcast_to(
+            x[:, :1, :], (jnp.shape(x)[0], T, jnp.shape(x)[2])
+        )
+    return {"Out": out}
+
+
+register_op(
+    "sequence_expand_as",
+    inputs=["X", "Y"],
+    outputs=["Out"],
+    lower=_lower_sequence_expand_as,
+    no_grad_inputs=("Y",),
+)
+
+
+def _lower_sequence_pad(ctx, ins, attrs):
+    # Dense regime: re-pad a [B, T, ...] tensor out to padded_length with
+    # PadValue beyond each row's length (sequence_pad_op.cc capability).
+    x = ins["X"][0]
+    pad_value = ins["PadValue"][0]
+    lens = _row_lengths(ins, x)
+    padded_len = int(attrs.get("padded_length", -1))
+    T = jnp.shape(x)[1]
+    if padded_len > 0 and padded_len != T:
+        if padded_len > T:
+            pad_width = [(0, 0), (0, padded_len - T)] + [(0, 0)] * (
+                jnp.ndim(x) - 2
+            )
+            x = jnp.pad(x, pad_width)
+        else:
+            x = x[:, :padded_len]
+        T = padded_len
+    lens = jnp.minimum(lens, T)  # truncation clips row lengths too
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    if jnp.ndim(x) > 2:
+        valid = valid.reshape(valid.shape + (1,) * (jnp.ndim(x) - 2))
+    out = jnp.where(valid, x, jnp.reshape(pad_value, (-1,))[0])
+    return {"Out": out, "OutLength": lens[:, None].astype(jnp.int64)}
+
+
+register_op(
+    "sequence_pad",
+    inputs=["X", "PadValue", "Length"],
+    outputs=["Out", "OutLength"],
+    attrs={"padded_length": -1},
+    lower=_lower_sequence_pad,
+    no_grad_inputs=("PadValue", "Length"),
+    intermediate_outputs=("OutLength",),
+)
+
+
+def _lower_sequence_unpad(ctx, ins, attrs):
+    # Inverse: zero everything beyond Length (dense stand-in for LoD
+    # re-packing, sequence_unpad_op.cc).
+    x = ins["X"][0]
+    lens = _row_lengths(ins, x)
+    T = jnp.shape(x)[1]
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    if jnp.ndim(x) > 2:
+        valid = valid.reshape(valid.shape + (1,) * (jnp.ndim(x) - 2))
+    return {"Out": jnp.where(valid, x, 0)}
+
+
+register_op(
+    "sequence_unpad",
+    inputs=["X", "Length"],
+    outputs=["Out"],
+    lower=_lower_sequence_unpad,
+    no_grad_inputs=("Length",),
+)
+
+
+def _lower_sequence_slice(ctx, ins, attrs):
+    # sequence_slice_op.cc: per-row [offset, offset+length) window,
+    # left-aligned and re-padded.
+    x = ins["X"][0]  # [B, T, ...]
+    offset = jnp.reshape(ins["Offset"][0], (-1,)).astype(jnp.int32)
+    length = jnp.reshape(ins["Length"][0], (-1,)).astype(jnp.int32)
+    T = jnp.shape(x)[1]
+    j = jnp.arange(T)[None, :]
+    src = jnp.clip(j + offset[:, None], 0, T - 1)
+    idx = src[..., None] if jnp.ndim(x) == 3 else src
+    gathered = jnp.take_along_axis(x, idx, axis=1)
+    valid = j < length[:, None]
+    if jnp.ndim(x) == 3:
+        valid = valid[..., None]
+    return {"Out": jnp.where(valid, gathered, 0)}
+
+
+register_op(
+    "sequence_slice",
+    inputs=["X", "Offset", "Length"],
+    outputs=["Out"],
+    lower=_lower_sequence_slice,
+    no_grad_inputs=("Offset", "Length"),
+)
+
+
+def _lower_sequence_erase(ctx, ins, attrs):
+    # sequence_erase_op.cc: drop listed tokens, compact left, pad with 0.
+    x = ins["X"][0]  # [B, T] int
+    tokens = attrs.get("tokens", [])
+    B, T = jnp.shape(x)[0], jnp.shape(x)[1]
+    lens = _row_lengths(ins, x)
+    keep = jnp.arange(T)[None, :] < lens[:, None]
+    for tok in tokens:
+        keep = keep & (x != tok)
+    out, n_keep = compact_rows(x, keep, 0)
+    return {"Out": out, "OutLength": n_keep[:, None]}
+
+
+register_op(
+    "sequence_erase",
+    inputs=["X", "Length"],
+    outputs=["Out", "OutLength"],
+    attrs={"tokens": []},
+    lower=_lower_sequence_erase,
+    grad=None,
+)
+
+
+def _lower_sequence_enumerate(ctx, ins, attrs):
+    # sequence_enumerate_op.cc: sliding win_size windows, pad_value beyond.
+    x = ins["X"][0]  # [B, T] int
+    win = int(attrs.get("win_size", 2))
+    pad_value = attrs.get("pad_value", 0)
+    B, T = jnp.shape(x)[0], jnp.shape(x)[1]
+    lens = _row_lengths(ins, x)
+    cols = []
+    ar = jnp.arange(T)
+    for j in range(win):
+        idx = jnp.clip(ar + j, 0, T - 1)
+        shifted = x[:, idx]
+        ok = ((ar + j)[None, :] < lens[:, None])
+        cols.append(jnp.where(ok, shifted, pad_value))
+    out = jnp.stack(cols, axis=2)  # [B, T, win]
+    valid = ar[None, :, None] < lens[:, None, None]
+    return {"Out": jnp.where(valid, out, pad_value)}
+
+
+register_op(
+    "sequence_enumerate",
+    inputs=["X", "Length"],
+    outputs=["Out"],
+    attrs={"win_size": 2, "pad_value": 0},
+    lower=_lower_sequence_enumerate,
+    grad=None,
+)
+
+
+def _lower_sequence_scatter(ctx, ins, attrs):
+    # sequence_scatter_op.cc: per-row scatter-add of Updates at time Ids.
+    x = ins["X"][0]  # [B, T, ...] or [B, T]
+    ids = ins["Ids"][0]  # [B, N] int time indices
+    upd = ins["Updates"][0]  # [B, N, ...] matching x trailing dims
+    ids = ids.astype(jnp.int32)
+    if jnp.ndim(x) == 3 and jnp.ndim(upd) == 2:
+        upd = upd[..., None]
+
+    def row(xr, ir, ur):
+        return xr.at[ir].add(ur)
+
+    return {"Out": jax.vmap(row)(x, ids, upd)}
+
+
+register_op(
+    "sequence_scatter",
+    inputs=["X", "Ids", "Updates"],
+    outputs=["Out"],
+    lower=_lower_sequence_scatter,
+    no_grad_inputs=("Ids",),
+)

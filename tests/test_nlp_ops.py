@@ -1,0 +1,389 @@
+"""CRF / CTC / edit-distance / chunk_eval / nce / hsigmoid / sequence-family
+tests against brute-force numpy references (reference: tests/unittests/
+test_{linear_chain_crf,crf_decoding,warpctc,edit_distance,chunk_eval,nce,
+hsigmoid,sequence_*}_op.py)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run_single_op(build_fn, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    outs = exe.run(main, feed=feed, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+
+def _crf_brute_force(x, trans, lens):
+    """Enumerate all paths: returns (nll per row, best path per row)."""
+    B, T, K = x.shape
+    a, b, w = trans[0], trans[1], trans[2:]
+    nlls, paths = [], []
+    for i in range(B):
+        L = lens[i]
+        scores = {}
+        for path in itertools.product(range(K), repeat=L):
+            s = a[path[0]] + b[path[-1]] + sum(
+                x[i, t, path[t]] for t in range(L)
+            )
+            s += sum(w[path[t - 1], path[t]] for t in range(1, L))
+            scores[path] = s
+        vals = np.array(list(scores.values()))
+        m = vals.max()
+        log_z = m + np.log(np.exp(vals - m).sum())
+        best = max(scores, key=scores.get)
+        # NLL of the gold path is computed by the caller; return log_z.
+        nlls.append(log_z)
+        paths.append(list(best) + [0] * (T - L))
+    return np.array(nlls), np.array(paths)
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(0)
+    B, T, K = 3, 4, 3
+    x = rng.randn(B, T, K).astype("float32")
+    trans = (0.5 * rng.randn(K + 2, K)).astype("float32")
+    lens = np.array([4, 2, 3])
+    label = rng.randint(0, K, (B, T)).astype("int64")
+
+    def build():
+        em = fluid.layers.data(name="em", shape=[T, K], dtype="float32")
+        lb = fluid.layers.data(name="lb", shape=[T], dtype="int64")
+        ln = fluid.layers.data(name="ln", shape=[1], dtype="int64")
+        crf = fluid.layers.linear_chain_crf(
+            em, lb, length=ln,
+            param_attr=fluid.ParamAttr(
+                name="crf_w",
+                initializer=fluid.initializer.NumpyArrayInitializer(trans),
+            ),
+        )
+        return [crf]
+
+    (nll,) = _run_single_op(
+        build,
+        {"em": x, "lb": label, "ln": lens.reshape(-1, 1).astype("int64")},
+    )
+    log_z, _ = _crf_brute_force(x, trans, lens)
+    a, b, w = trans[0], trans[1], trans[2:]
+    for i in range(B):
+        L = lens[i]
+        path = label[i, :L]
+        gold = a[path[0]] + b[path[-1]] + x[i, np.arange(L), path].sum()
+        gold += sum(w[path[t - 1], path[t]] for t in range(1, L))
+        np.testing.assert_allclose(
+            nll[i, 0], log_z[i] - gold, rtol=2e-4, atol=2e-4
+        )
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(1)
+    B, T, K = 3, 4, 3
+    x = rng.randn(B, T, K).astype("float32")
+    trans = (0.5 * rng.randn(K + 2, K)).astype("float32")
+    lens = np.array([4, 2, 3])
+
+    def build():
+        em = fluid.layers.data(name="em", shape=[T, K], dtype="float32")
+        ln = fluid.layers.data(name="ln", shape=[1], dtype="int64")
+        path = fluid.layers.crf_decoding(
+            em,
+            param_attr=fluid.ParamAttr(
+                name="crf_w",
+                initializer=fluid.initializer.NumpyArrayInitializer(trans),
+            ),
+            length=ln,
+        )
+        return [path]
+
+    (path,) = _run_single_op(
+        build, {"em": x, "ln": lens.reshape(-1, 1).astype("int64")}
+    )
+    _, want = _crf_brute_force(x, trans, lens)
+    np.testing.assert_array_equal(path, want)
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+def _ctc_brute_force(logits, label, t_len, l_len, blank=0):
+    """Sum probability over all alignments whose collapse equals label."""
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+
+    def collapse(path):
+        out, prev = [], -1
+        for p in path:
+            if p != prev and p != blank:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    B = logits.shape[0]
+    out = []
+    for i in range(B):
+        T = t_len[i]
+        V = logits.shape[2]
+        want = tuple(label[i, : l_len[i]])
+        total = -np.inf
+        for path in itertools.product(range(V), repeat=T):
+            if collapse(path) != want:
+                continue
+            s = sum(lp[i, t, path[t]] for t in range(T))
+            total = np.logaddexp(total, s)
+        out.append(-total)
+    return np.array(out)
+
+
+def test_warpctc_matches_brute_force():
+    rng = np.random.RandomState(2)
+    B, T, V, L = 2, 4, 3, 2
+    logits = rng.randn(B, T, V).astype("float32")
+    label = rng.randint(1, V, (B, L)).astype("int64")
+    t_len = np.array([4, 3])
+    l_len = np.array([2, 1])
+
+    def build():
+        lg = fluid.layers.data(name="lg", shape=[T, V], dtype="float32")
+        lb = fluid.layers.data(name="lb", shape=[L], dtype="int64")
+        tl = fluid.layers.data(name="tl", shape=[1], dtype="int64")
+        ll = fluid.layers.data(name="ll", shape=[1], dtype="int64")
+        loss = fluid.layers.warpctc(
+            lg, lb, blank=0, input_length=tl, label_length=ll
+        )
+        return [loss]
+
+    (loss,) = _run_single_op(
+        build,
+        {
+            "lg": logits,
+            "lb": label,
+            "tl": t_len.reshape(-1, 1).astype("int64"),
+            "ll": l_len.reshape(-1, 1).astype("int64"),
+        },
+    )
+    want = _ctc_brute_force(logits, label, t_len, l_len)
+    np.testing.assert_allclose(loss[:, 0], want, rtol=2e-4, atol=2e-4)
+
+
+def test_ctc_greedy_decoder():
+    # probs argmax path: [b, 1, 1, b, 2] -> collapse -> [1, 2]
+    probs = np.zeros((1, 5, 3), "float32")
+    hot = [0, 1, 1, 0, 2]
+    probs[0, np.arange(5), hot] = 5.0
+
+    def build():
+        p = fluid.layers.data(name="p", shape=[5, 3], dtype="float32")
+        out, out_len = fluid.layers.ctc_greedy_decoder(p, blank=0)
+        return [out, out_len]
+
+    out, out_len = _run_single_op(build, {"p": probs})
+    assert out_len[0, 0] == 2
+    np.testing.assert_array_equal(out[0, :2], [1, 2])
+
+
+def test_edit_distance():
+    # kitten -> sitting = 3; abc -> abc = 0 (with padding + lengths).
+    def enc(s, T):
+        v = [ord(c) for c in s] + [0] * (T - len(s))
+        return v
+
+    hyps = np.array([enc("kitten", 8), enc("abc", 8)], "int64")
+    refs = np.array([enc("sitting", 8), enc("abc", 8)], "int64")
+    hl = np.array([[6], [3]], "int64")
+    rl = np.array([[7], [3]], "int64")
+
+    def build():
+        h = fluid.layers.data(name="h", shape=[8], dtype="int64")
+        r = fluid.layers.data(name="r", shape=[8], dtype="int64")
+        hlen = fluid.layers.data(name="hl", shape=[1], dtype="int64")
+        rlen = fluid.layers.data(name="rl", shape=[1], dtype="int64")
+        d, n = fluid.layers.edit_distance(
+            h, r, normalized=False, input_length=hlen, label_length=rlen
+        )
+        return [d, n]
+
+    d, n = _run_single_op(
+        build, {"h": hyps, "r": refs, "hl": hl, "rl": rl}
+    )
+    np.testing.assert_allclose(d[:, 0], [3.0, 0.0])
+    assert n[0] == 2
+
+
+def test_chunk_eval_iob():
+    # IOB, 2 chunk types. tag = type*2 + {0:B, 1:I}; O = 4.
+    # label:  B0 I0 O  B1 I1   (chunks: [0,1] type0, [3,4] type1)
+    # pred:   B0 I0 O  B1 O    (chunks: [0,1] type0, [3,3] type1)
+    label = np.array([[0, 1, 4, 2, 3]], "int64")
+    pred = np.array([[0, 1, 4, 2, 4]], "int64")
+
+    def build():
+        p = fluid.layers.data(name="p", shape=[5], dtype="int64")
+        l = fluid.layers.data(name="l", shape=[5], dtype="int64")
+        return list(
+            fluid.layers.chunk_eval(
+                p, l, chunk_scheme="IOB", num_chunk_types=2
+            )
+        )
+
+    prec, rec, f1, ni, nl, nc = _run_single_op(
+        build, {"p": pred, "l": label}
+    )
+    assert nl[0] == 2 and ni[0] == 2 and nc[0] == 1
+    np.testing.assert_allclose(prec[0], 0.5)
+    np.testing.assert_allclose(rec[0], 0.5)
+
+
+# ---------------------------------------------------------------------------
+# Sampled softmax family
+# ---------------------------------------------------------------------------
+
+
+def test_hsigmoid_is_normalized_distribution():
+    """exp(-cost(label=c)) over all c must sum to 1: the binary tree's leaf
+    probabilities partition the class space."""
+    rng = np.random.RandomState(3)
+    num_classes, D = 6, 8
+    x = np.tile(rng.randn(1, D).astype("float32"), (num_classes, 1))
+    labels = np.arange(num_classes).reshape(-1, 1).astype("int64")
+
+    def build():
+        xin = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        lb = fluid.layers.data(name="lb", shape=[1], dtype="int64")
+        cost = fluid.layers.hsigmoid(xin, lb, num_classes)
+        return [cost]
+
+    (cost,) = _run_single_op(build, {"x": x, "lb": labels})
+    probs = np.exp(-cost[:, 0])
+    np.testing.assert_allclose(probs.sum(), 1.0, rtol=1e-5)
+
+
+def test_nce_trains():
+    rng = np.random.RandomState(4)
+    dict_size, D = 30, 16
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        lb = fluid.layers.data(name="lb", shape=[1], dtype="int64")
+        cost = fluid.layers.nce(
+            x, lb, num_total_classes=dict_size, num_neg_samples=5
+        )
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # Learnable structure: class = argmax of first dict_size dims pattern.
+    proto = rng.randn(dict_size, D).astype("float32")
+    losses = []
+    for _ in range(60):
+        y = rng.randint(0, dict_size, (32,))
+        xb = proto[y] + 0.1 * rng.randn(32, D).astype("float32")
+        (lv,) = exe.run(
+            main, feed={"x": xb, "lb": y.reshape(-1, 1).astype("int64")},
+            fetch_list=[loss],
+        )
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.8, losses[::10]
+
+
+# ---------------------------------------------------------------------------
+# Sequence family semantics
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_concat():
+    x = np.array([[1, 2, 0], [3, 0, 0]], "int64").astype("float32")
+    y = np.array([[7, 8], [9, 0]], "float32")
+    lx = np.array([[2], [1]], "int64")
+    ly = np.array([[2], [1]], "int64")
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[2], dtype="float32")
+        lxv = fluid.layers.data(name="lx", shape=[1], dtype="int64")
+        lyv = fluid.layers.data(name="ly", shape=[1], dtype="int64")
+        out = fluid.layers.sequence_concat([xv, yv], lengths=[lxv, lyv])
+        return [out]
+
+    (out,) = _run_single_op(
+        build, {"x": x, "y": y, "lx": lx, "ly": ly}
+    )
+    np.testing.assert_allclose(out[0], [1, 2, 7, 8, 0])
+    np.testing.assert_allclose(out[1], [3, 9, 0, 0, 0])
+
+
+def test_sequence_erase_and_enumerate():
+    x = np.array([[2, 5, 2, 7, 0]], "int64")
+    lens = np.array([[4]], "int64")
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[5], dtype="int64")
+        lv = fluid.layers.data(name="l", shape=[1], dtype="int64")
+        erased, n = fluid.layers.sequence_erase(xv, tokens=[2], length=lv)
+        enum = fluid.layers.sequence_enumerate(xv, win_size=2, length=lv)
+        return [erased, n, enum]
+
+    erased, n, enum = _run_single_op(build, {"x": x, "l": lens})
+    assert n[0, 0] == 2
+    np.testing.assert_array_equal(erased[0, :2], [5, 7])
+    np.testing.assert_array_equal(enum[0, 0], [2, 5])
+    np.testing.assert_array_equal(enum[0, 3], [7, 0])  # padded tail
+
+
+def test_sequence_slice_and_pad_unpad():
+    x = np.arange(12, dtype="float32").reshape(1, 6, 2)
+    off = np.array([[2]], "int64")
+    ln = np.array([[3]], "int64")
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[6, 2], dtype="float32")
+        ov = fluid.layers.data(name="o", shape=[1], dtype="int64")
+        lv = fluid.layers.data(name="l", shape=[1], dtype="int64")
+        sl = fluid.layers.sequence_slice(xv, ov, lv)
+        unp = fluid.layers.sequence_unpad(xv, lv)
+        return [sl, unp]
+
+    sl, unp = _run_single_op(build, {"x": x, "o": off, "l": ln})
+    np.testing.assert_allclose(sl[0, :3], x[0, 2:5])
+    assert (sl[0, 3:] == 0).all()
+    np.testing.assert_allclose(unp[0, :3], x[0, :3])
+    assert (unp[0, 3:] == 0).all()
+
+
+def test_sequence_conv_matches_numpy():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 5, 3).astype("float32")
+    w = rng.randn(9, 4).astype("float32")  # ctx_len 3 * D 3 -> 4
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[5, 3], dtype="float32")
+        out = fluid.layers.sequence_conv(
+            xv, num_filters=4, filter_size=3, bias_attr=False,
+            param_attr=fluid.ParamAttr(
+                name="sc_w",
+                initializer=fluid.initializer.NumpyArrayInitializer(w),
+            ),
+        )
+        return [out]
+
+    (out,) = _run_single_op(build, {"x": x})
+    # numpy reference: context [-1, 0, 1] stacked then projected.
+    padded = np.pad(x, ((0, 0), (1, 1), (0, 0)))
+    stacked = np.concatenate(
+        [padded[:, 0:5], padded[:, 1:6], padded[:, 2:7]], axis=2
+    )
+    want = stacked @ w
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
