@@ -1,0 +1,105 @@
+/* C API for the paddle_tpu native host runtime.
+ *
+ * Reference parity: the host-side native infrastructure of
+ * paddle/fluid — ProgramDesc IR (framework/framework.proto, program_desc.h),
+ * Scope/Variable host state (framework/scope.h:41), the reader pipeline's
+ * LoDTensorBlockingQueue (operators/reader/lod_tensor_blocking_queue.h) and
+ * RecordIO file reader (operators/reader/create_recordio_file_reader_op.cc).
+ * Device compute stays with XLA/PJRT; this library is the C++ runtime
+ * around it, consumed from Python via ctypes (no pybind11 in the image).
+ *
+ * All functions return 0 on success, negative on error unless stated.
+ * Thread-safety: queue_* and scope_* are thread-safe; reader/writer handles
+ * are single-owner.
+ */
+#ifndef PTPU_C_API_H_
+#define PTPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- error reporting ---- */
+const char* ptpu_last_error(void); /* thread-local message for last failure */
+
+/* ---- recordio: chunked record file with per-record CRC32 ---- */
+typedef struct ptpu_recordio_writer ptpu_recordio_writer;
+typedef struct ptpu_recordio_reader ptpu_recordio_reader;
+
+ptpu_recordio_writer* ptpu_recordio_writer_open(const char* path);
+int ptpu_recordio_write(ptpu_recordio_writer*, const void* data, uint64_t len);
+int ptpu_recordio_writer_close(ptpu_recordio_writer*);
+
+ptpu_recordio_reader* ptpu_recordio_reader_open(const char* path);
+/* Returns record length (>= 0) and leaves the payload buffered; -1 at
+ * EOF, -2 on corruption (CRC/length mismatch). */
+int64_t ptpu_recordio_next(ptpu_recordio_reader*);
+/* Copy the buffered record into out (size from ptpu_recordio_next). */
+int ptpu_recordio_read(ptpu_recordio_reader*, void* out, uint64_t len);
+int ptpu_recordio_reader_close(ptpu_recordio_reader*);
+
+/* ---- blocking queue (LoDTensorBlockingQueue equivalent) ---- */
+typedef struct ptpu_queue ptpu_queue;
+
+ptpu_queue* ptpu_queue_create(uint64_t capacity);
+/* Blocks while full unless timeout_ms >= 0 (then -2 on timeout).
+ * -1 if the queue is closed. Copies the buffer. */
+int ptpu_queue_push(ptpu_queue*, const void* data, uint64_t len,
+                    int64_t timeout_ms);
+/* Returns popped length (>0), 0 when closed-and-drained, -2 on timeout.
+ * Peek size first with max_len == 0 (record stays queued). */
+int64_t ptpu_queue_pop(ptpu_queue*, void* out, uint64_t max_len,
+                       int64_t timeout_ms);
+uint64_t ptpu_queue_size(ptpu_queue*);
+uint64_t ptpu_queue_capacity(ptpu_queue*);
+void ptpu_queue_close(ptpu_queue*);   /* wakes all waiters */
+void ptpu_queue_kill(ptpu_queue*);    /* close + discard queued items */
+int ptpu_queue_is_closed(ptpu_queue*);
+void ptpu_queue_reopen(ptpu_queue*);  /* reset for a new epoch */
+void ptpu_queue_destroy(ptpu_queue*);
+
+/* ---- host tensor scope (Scope/Variable equivalent) ---- */
+typedef struct ptpu_scope ptpu_scope;
+
+ptpu_scope* ptpu_scope_create(void);
+ptpu_scope* ptpu_scope_new_child(ptpu_scope*);
+/* dtype: numpy-style tag string ("float32", "int64", ...). Copies data. */
+int ptpu_scope_set(ptpu_scope*, const char* name, const char* dtype,
+                   const int64_t* dims, int32_t ndim, const void* data,
+                   uint64_t nbytes);
+/* Var lookup walks parent scopes like Scope::FindVar. Returns nbytes or -1
+ * if absent; fills dtype/dims/ndim metadata when pointers are non-null
+ * (dims capacity must be >= 16). */
+int64_t ptpu_scope_get_meta(ptpu_scope*, const char* name, char* dtype_out,
+                            uint64_t dtype_cap, int64_t* dims_out,
+                            int32_t* ndim_out);
+int ptpu_scope_get_data(ptpu_scope*, const char* name, void* out,
+                        uint64_t nbytes);
+int ptpu_scope_erase(ptpu_scope*, const char* name);
+uint64_t ptpu_scope_num_vars(ptpu_scope*); /* local vars only */
+/* Writes local var names joined by '\n' into out; returns needed size. */
+int64_t ptpu_scope_list(ptpu_scope*, char* out, uint64_t cap);
+void ptpu_scope_destroy(ptpu_scope*); /* also destroys child scopes */
+
+/* ---- PTPB program IR (core/program_bin.py twin) ---- */
+typedef struct ptpu_program ptpu_program;
+
+ptpu_program* ptpu_program_parse(const void* data, uint64_t len);
+int32_t ptpu_program_num_blocks(ptpu_program*);
+int32_t ptpu_program_num_ops(ptpu_program*, int32_t block);
+int32_t ptpu_program_num_vars(ptpu_program*, int32_t block);
+/* Returns needed size; fills out with the op type string. */
+int64_t ptpu_program_op_type(ptpu_program*, int32_t block, int32_t op,
+                             char* out, uint64_t cap);
+/* Re-serialize (must byte-match the Python writer). Returns needed size. */
+int64_t ptpu_program_serialize(ptpu_program*, void* out, uint64_t cap);
+void ptpu_program_destroy(ptpu_program*);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* PTPU_C_API_H_ */

@@ -1,0 +1,261 @@
+// C API surface over the native host runtime (see include/ptpu/c_api.h).
+// Single translation unit; consumed from Python via ctypes.
+
+#include "ptpu/c_api.h"
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "program.h"
+#include "queue.h"
+#include "recordio.h"
+#include "scope.h"
+
+namespace {
+thread_local std::string g_last_error;
+void set_error(const std::string& msg) { g_last_error = msg; }
+}  // namespace
+
+extern "C" {
+
+const char* ptpu_last_error(void) { return g_last_error.c_str(); }
+
+// ---------------------------------------------------------------------------
+// recordio
+// ---------------------------------------------------------------------------
+
+struct ptpu_recordio_writer {
+  ptpu::RecordIOWriter impl;
+  explicit ptpu_recordio_writer(const char* path) : impl(path) {}
+};
+
+struct ptpu_recordio_reader {
+  ptpu::RecordIOReader impl;
+  explicit ptpu_recordio_reader(const char* path) : impl(path) {}
+};
+
+ptpu_recordio_writer* ptpu_recordio_writer_open(const char* path) {
+  auto* w = new ptpu_recordio_writer(path);
+  if (!w->impl.ok()) {
+    set_error(std::string("cannot open for write: ") + path);
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int ptpu_recordio_write(ptpu_recordio_writer* w, const void* data,
+                        uint64_t len) {
+  if (w == nullptr) return -1;
+  if (!w->impl.Write(data, len)) {
+    set_error("recordio write failed");
+    return -1;
+  }
+  return 0;
+}
+
+int ptpu_recordio_writer_close(ptpu_recordio_writer* w) {
+  if (w == nullptr) return -1;
+  int rc = w->impl.Close() ? 0 : -1;
+  delete w;
+  return rc;
+}
+
+ptpu_recordio_reader* ptpu_recordio_reader_open(const char* path) {
+  auto* r = new ptpu_recordio_reader(path);
+  if (!r->impl.ok()) {
+    set_error(std::string("cannot open recordio file: ") + path);
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+int64_t ptpu_recordio_next(ptpu_recordio_reader* r) {
+  if (r == nullptr) return -1;
+  int64_t n = r->impl.Next();
+  if (n == -2) set_error("recordio record corrupt (crc/length mismatch)");
+  return n;
+}
+
+int ptpu_recordio_read(ptpu_recordio_reader* r, void* out, uint64_t len) {
+  if (r == nullptr || len < r->impl.buffer().size()) {
+    set_error("recordio read buffer too small");
+    return -1;
+  }
+  std::memcpy(out, r->impl.buffer().data(), r->impl.buffer().size());
+  return 0;
+}
+
+int ptpu_recordio_reader_close(ptpu_recordio_reader* r) {
+  if (r == nullptr) return -1;
+  r->impl.Close();
+  delete r;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// blocking queue
+// ---------------------------------------------------------------------------
+
+struct ptpu_queue {
+  ptpu::BlockingByteQueue impl;
+  explicit ptpu_queue(uint64_t cap) : impl(cap) {}
+};
+
+ptpu_queue* ptpu_queue_create(uint64_t capacity) {
+  return new ptpu_queue(capacity == 0 ? 1 : capacity);
+}
+
+int ptpu_queue_push(ptpu_queue* q, const void* data, uint64_t len,
+                    int64_t timeout_ms) {
+  return q->impl.Push(data, len, timeout_ms);
+}
+
+int64_t ptpu_queue_pop(ptpu_queue* q, void* out, uint64_t max_len,
+                       int64_t timeout_ms) {
+  return q->impl.Pop(out, max_len, timeout_ms);
+}
+
+uint64_t ptpu_queue_size(ptpu_queue* q) { return q->impl.Size(); }
+uint64_t ptpu_queue_capacity(ptpu_queue* q) { return q->impl.Capacity(); }
+void ptpu_queue_close(ptpu_queue* q) { q->impl.Close(); }
+void ptpu_queue_kill(ptpu_queue* q) { q->impl.Kill(); }
+int ptpu_queue_is_closed(ptpu_queue* q) { return q->impl.IsClosed() ? 1 : 0; }
+void ptpu_queue_reopen(ptpu_queue* q) { q->impl.Reopen(); }
+void ptpu_queue_destroy(ptpu_queue* q) { delete q; }
+
+// ---------------------------------------------------------------------------
+// scope
+// ---------------------------------------------------------------------------
+
+struct ptpu_scope {
+  ptpu::Scope* impl;
+  bool owned;
+};
+
+ptpu_scope* ptpu_scope_create(void) {
+  return new ptpu_scope{new ptpu::Scope(), true};
+}
+
+ptpu_scope* ptpu_scope_new_child(ptpu_scope* s) {
+  return new ptpu_scope{s->impl->NewChild(), false};
+}
+
+int ptpu_scope_set(ptpu_scope* s, const char* name, const char* dtype,
+                   const int64_t* dims, int32_t ndim, const void* data,
+                   uint64_t nbytes) {
+  ptpu::HostTensor t;
+  t.dtype = dtype;
+  t.dims.assign(dims, dims + ndim);
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  t.data.assign(p, p + nbytes);
+  s->impl->Set(name, std::move(t));
+  return 0;
+}
+
+int64_t ptpu_scope_get_meta(ptpu_scope* s, const char* name, char* dtype_out,
+                            uint64_t dtype_cap, int64_t* dims_out,
+                            int32_t* ndim_out) {
+  const ptpu::HostTensor* t = s->impl->Find(name);
+  if (t == nullptr) return -1;
+  if (dtype_out != nullptr && dtype_cap > 0) {
+    std::snprintf(dtype_out, dtype_cap, "%s", t->dtype.c_str());
+  }
+  if (ndim_out != nullptr) *ndim_out = static_cast<int32_t>(t->dims.size());
+  if (dims_out != nullptr) {
+    for (size_t i = 0; i < t->dims.size() && i < 16; ++i) {
+      dims_out[i] = t->dims[i];
+    }
+  }
+  return static_cast<int64_t>(t->data.size());
+}
+
+int ptpu_scope_get_data(ptpu_scope* s, const char* name, void* out,
+                        uint64_t nbytes) {
+  const ptpu::HostTensor* t = s->impl->Find(name);
+  if (t == nullptr || nbytes < t->data.size()) {
+    set_error("scope var missing or buffer too small");
+    return -1;
+  }
+  std::memcpy(out, t->data.data(), t->data.size());
+  return 0;
+}
+
+int ptpu_scope_erase(ptpu_scope* s, const char* name) {
+  return s->impl->Erase(name) ? 0 : -1;
+}
+
+uint64_t ptpu_scope_num_vars(ptpu_scope* s) { return s->impl->NumVars(); }
+
+int64_t ptpu_scope_list(ptpu_scope* s, char* out, uint64_t cap) {
+  std::string joined = s->impl->ListJoined();
+  if (out != nullptr && cap > joined.size()) {
+    std::memcpy(out, joined.c_str(), joined.size() + 1);
+  }
+  return static_cast<int64_t>(joined.size() + 1);
+}
+
+void ptpu_scope_destroy(ptpu_scope* s) {
+  if (s->owned) delete s->impl;  // children die with the parent tree
+  delete s;
+}
+
+// ---------------------------------------------------------------------------
+// program
+// ---------------------------------------------------------------------------
+
+struct ptpu_program {
+  ptpu::ProgramDesc impl;
+};
+
+ptpu_program* ptpu_program_parse(const void* data, uint64_t len) {
+  auto* p = new ptpu_program();
+  if (!ptpu::ParseProgram(static_cast<const uint8_t*>(data), len,
+                          &p->impl)) {
+    set_error("PTPB parse failed (bad magic/version or truncated stream)");
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+int32_t ptpu_program_num_blocks(ptpu_program* p) {
+  return static_cast<int32_t>(p->impl.blocks.size());
+}
+
+int32_t ptpu_program_num_ops(ptpu_program* p, int32_t block) {
+  if (block < 0 || block >= ptpu_program_num_blocks(p)) return -1;
+  return static_cast<int32_t>(p->impl.blocks[block].ops.size());
+}
+
+int32_t ptpu_program_num_vars(ptpu_program* p, int32_t block) {
+  if (block < 0 || block >= ptpu_program_num_blocks(p)) return -1;
+  return static_cast<int32_t>(p->impl.blocks[block].vars.size());
+}
+
+int64_t ptpu_program_op_type(ptpu_program* p, int32_t block, int32_t op,
+                             char* out, uint64_t cap) {
+  if (block < 0 || block >= ptpu_program_num_blocks(p)) return -1;
+  const auto& ops = p->impl.blocks[block].ops;
+  if (op < 0 || op >= static_cast<int32_t>(ops.size())) return -1;
+  const std::string& t = ops[op].type;
+  if (out != nullptr && cap > t.size()) {
+    std::memcpy(out, t.c_str(), t.size() + 1);
+  }
+  return static_cast<int64_t>(t.size() + 1);
+}
+
+int64_t ptpu_program_serialize(ptpu_program* p, void* out, uint64_t cap) {
+  std::vector<uint8_t> buf;
+  ptpu::SerializeProgram(p->impl, &buf);
+  if (out != nullptr && cap >= buf.size()) {
+    std::memcpy(out, buf.data(), buf.size());
+  }
+  return static_cast<int64_t>(buf.size());
+}
+
+void ptpu_program_destroy(ptpu_program* p) { delete p; }
+
+}  // extern "C"

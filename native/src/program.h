@@ -1,0 +1,321 @@
+// PTPB program IR parser/serializer — the C++ twin of
+// paddle_tpu/core/program_bin.py (reference role: framework.proto +
+// program_desc.h/op_desc.h C++ IR shared by runtime and front-end). The
+// writer must produce byte-identical output to the Python writer for the
+// same program; the round-trip test in tests/test_native_runtime.py holds
+// the two in lockstep.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace ptpu {
+
+struct AttrValue {
+  enum Tag : uint8_t {
+    kInt = 0,
+    kFloat = 1,
+    kStr = 2,
+    kBool = 3,
+    kInts = 4,
+    kFloats = 5,
+    kStrs = 6,
+    kNone = 7,
+  };
+  Tag tag = kNone;
+  int64_t i = 0;
+  double f = 0.0;
+  bool b = false;
+  std::string s;
+  std::vector<int64_t> ints;
+  std::vector<double> floats;
+  std::vector<std::string> strs;
+};
+
+struct VarDesc {
+  std::string name;
+  std::string type;
+  bool has_dtype = false;
+  std::string dtype;
+  bool has_shape = false;
+  std::vector<int64_t> shape;
+  uint32_t lod_level = 0;
+  uint8_t flags = 0;  // 1 persistable, 2 stop_gradient, 4 is_data,
+                      // 8 is_parameter, 16 trainable
+};
+
+struct OpDesc {
+  std::string type;
+  // Slot order is the Python writer's sorted() order; std::map matches.
+  std::map<std::string, std::vector<std::string>> inputs;
+  std::map<std::string, std::vector<std::string>> outputs;
+  std::map<std::string, AttrValue> attrs;
+};
+
+struct BlockDesc {
+  int32_t idx = 0;
+  int32_t parent_idx = -1;
+  int32_t forward_block_idx = -1;
+  // Var order is sorted-by-name in the byte stream.
+  std::vector<VarDesc> vars;
+  std::vector<OpDesc> ops;
+};
+
+struct ProgramDesc {
+  uint32_t version = 1;
+  uint64_t random_seed = 0;
+  std::vector<BlockDesc> blocks;
+};
+
+// ---------------------------------------------------------------------------
+// Reader
+// ---------------------------------------------------------------------------
+
+class BinReader {
+ public:
+  BinReader(const uint8_t* data, uint64_t len)
+      : data_(data), len_(len), off_(0), ok_(true) {}
+
+  bool ok() const { return ok_; }
+
+  template <typename T>
+  T Read() {
+    T v{};
+    if (off_ + sizeof(T) > len_) {
+      ok_ = false;
+      return v;
+    }
+    std::memcpy(&v, data_ + off_, sizeof(T));
+    off_ += sizeof(T);
+    return v;
+  }
+
+  std::string ReadStr() {
+    uint32_t n = Read<uint32_t>();
+    if (!ok_ || off_ + n > len_) {
+      ok_ = false;
+      return std::string();
+    }
+    std::string s(reinterpret_cast<const char*>(data_ + off_), n);
+    off_ += n;
+    return s;
+  }
+
+ private:
+  const uint8_t* data_;
+  uint64_t len_;
+  uint64_t off_;
+  bool ok_;
+};
+
+// ---------------------------------------------------------------------------
+// Writer
+// ---------------------------------------------------------------------------
+
+class BinWriter {
+ public:
+  template <typename T>
+  void Write(T v) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
+    buf_.insert(buf_.end(), p, p + sizeof(T));
+  }
+  void WriteStr(const std::string& s) {
+    Write<uint32_t>(static_cast<uint32_t>(s.size()));
+    buf_.insert(buf_.end(), s.begin(), s.end());
+  }
+  void WriteRaw(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf_.insert(buf_.end(), b, b + n);
+  }
+  const std::vector<uint8_t>& buffer() const { return buf_; }
+
+ private:
+  std::vector<uint8_t> buf_;
+};
+
+static bool ReadAttr(BinReader* r, AttrValue* out) {
+  out->tag = static_cast<AttrValue::Tag>(r->Read<uint8_t>());
+  switch (out->tag) {
+    case AttrValue::kNone:
+      return r->ok();
+    case AttrValue::kBool:
+      out->b = r->Read<uint8_t>() != 0;
+      return r->ok();
+    case AttrValue::kInt:
+      out->i = r->Read<int64_t>();
+      return r->ok();
+    case AttrValue::kFloat:
+      out->f = r->Read<double>();
+      return r->ok();
+    case AttrValue::kStr:
+      out->s = r->ReadStr();
+      return r->ok();
+    case AttrValue::kInts: {
+      uint32_t n = r->Read<uint32_t>();
+      out->ints.resize(n);
+      for (uint32_t i = 0; i < n; ++i) out->ints[i] = r->Read<int64_t>();
+      return r->ok();
+    }
+    case AttrValue::kFloats: {
+      uint32_t n = r->Read<uint32_t>();
+      out->floats.resize(n);
+      for (uint32_t i = 0; i < n; ++i) out->floats[i] = r->Read<double>();
+      return r->ok();
+    }
+    case AttrValue::kStrs: {
+      uint32_t n = r->Read<uint32_t>();
+      out->strs.resize(n);
+      for (uint32_t i = 0; i < n; ++i) out->strs[i] = r->ReadStr();
+      return r->ok();
+    }
+    default:
+      return false;
+  }
+}
+
+static void WriteAttr(BinWriter* w, const AttrValue& a) {
+  w->Write<uint8_t>(a.tag);
+  switch (a.tag) {
+    case AttrValue::kNone:
+      break;
+    case AttrValue::kBool:
+      w->Write<uint8_t>(a.b ? 1 : 0);
+      break;
+    case AttrValue::kInt:
+      w->Write<int64_t>(a.i);
+      break;
+    case AttrValue::kFloat:
+      w->Write<double>(a.f);
+      break;
+    case AttrValue::kStr:
+      w->WriteStr(a.s);
+      break;
+    case AttrValue::kInts:
+      w->Write<uint32_t>(static_cast<uint32_t>(a.ints.size()));
+      for (int64_t v : a.ints) w->Write<int64_t>(v);
+      break;
+    case AttrValue::kFloats:
+      w->Write<uint32_t>(static_cast<uint32_t>(a.floats.size()));
+      for (double v : a.floats) w->Write<double>(v);
+      break;
+    case AttrValue::kStrs:
+      w->Write<uint32_t>(static_cast<uint32_t>(a.strs.size()));
+      for (const std::string& v : a.strs) w->WriteStr(v);
+      break;
+  }
+}
+
+static bool ReadIOMap(BinReader* r,
+                      std::map<std::string, std::vector<std::string>>* io) {
+  uint32_t nslots = r->Read<uint32_t>();
+  for (uint32_t i = 0; i < nslots && r->ok(); ++i) {
+    std::string slot = r->ReadStr();
+    uint32_t n = r->Read<uint32_t>();
+    std::vector<std::string> names(n);
+    for (uint32_t j = 0; j < n; ++j) names[j] = r->ReadStr();
+    (*io)[slot] = std::move(names);
+  }
+  return r->ok();
+}
+
+static void WriteIOMap(
+    BinWriter* w, const std::map<std::string, std::vector<std::string>>& io) {
+  w->Write<uint32_t>(static_cast<uint32_t>(io.size()));
+  for (const auto& kv : io) {
+    w->WriteStr(kv.first);
+    w->Write<uint32_t>(static_cast<uint32_t>(kv.second.size()));
+    for (const std::string& n : kv.second) w->WriteStr(n);
+  }
+}
+
+bool ParseProgram(const uint8_t* data, uint64_t len, ProgramDesc* out) {
+  if (len < 4 || std::memcmp(data, "PTPB", 4) != 0) return false;
+  BinReader r(data + 4, len - 4);
+  out->version = r.Read<uint32_t>();
+  if (out->version != 1) return false;
+  out->random_seed = r.Read<uint64_t>();
+  uint32_t nblocks = r.Read<uint32_t>();
+  out->blocks.resize(nblocks);
+  for (uint32_t b = 0; b < nblocks && r.ok(); ++b) {
+    BlockDesc& blk = out->blocks[b];
+    blk.idx = r.Read<int32_t>();
+    blk.parent_idx = r.Read<int32_t>();
+    blk.forward_block_idx = r.Read<int32_t>();
+    uint32_t nvars = r.Read<uint32_t>();
+    blk.vars.resize(nvars);
+    for (uint32_t v = 0; v < nvars && r.ok(); ++v) {
+      VarDesc& var = blk.vars[v];
+      var.name = r.ReadStr();
+      var.type = r.ReadStr();
+      var.has_dtype = r.Read<uint8_t>() != 0;
+      if (var.has_dtype) var.dtype = r.ReadStr();
+      var.has_shape = r.Read<uint8_t>() != 0;
+      if (var.has_shape) {
+        uint32_t ndim = r.Read<uint32_t>();
+        var.shape.resize(ndim);
+        for (uint32_t d = 0; d < ndim; ++d) var.shape[d] = r.Read<int64_t>();
+      }
+      var.lod_level = r.Read<uint32_t>();
+      var.flags = r.Read<uint8_t>();
+    }
+    uint32_t nops = r.Read<uint32_t>();
+    blk.ops.resize(nops);
+    for (uint32_t o = 0; o < nops && r.ok(); ++o) {
+      OpDesc& op = blk.ops[o];
+      op.type = r.ReadStr();
+      if (!ReadIOMap(&r, &op.inputs)) return false;
+      if (!ReadIOMap(&r, &op.outputs)) return false;
+      uint32_t nattrs = r.Read<uint32_t>();
+      for (uint32_t a = 0; a < nattrs && r.ok(); ++a) {
+        std::string name = r.ReadStr();
+        AttrValue val;
+        if (!ReadAttr(&r, &val)) return false;
+        op.attrs[name] = std::move(val);
+      }
+    }
+  }
+  return r.ok();
+}
+
+void SerializeProgram(const ProgramDesc& prog, std::vector<uint8_t>* out) {
+  BinWriter w;
+  w.WriteRaw("PTPB", 4);
+  w.Write<uint32_t>(prog.version);
+  w.Write<uint64_t>(prog.random_seed);
+  w.Write<uint32_t>(static_cast<uint32_t>(prog.blocks.size()));
+  for (const BlockDesc& blk : prog.blocks) {
+    w.Write<int32_t>(blk.idx);
+    w.Write<int32_t>(blk.parent_idx);
+    w.Write<int32_t>(blk.forward_block_idx);
+    w.Write<uint32_t>(static_cast<uint32_t>(blk.vars.size()));
+    for (const VarDesc& var : blk.vars) {
+      w.WriteStr(var.name);
+      w.WriteStr(var.type);
+      w.Write<uint8_t>(var.has_dtype ? 1 : 0);
+      if (var.has_dtype) w.WriteStr(var.dtype);
+      w.Write<uint8_t>(var.has_shape ? 1 : 0);
+      if (var.has_shape) {
+        w.Write<uint32_t>(static_cast<uint32_t>(var.shape.size()));
+        for (int64_t d : var.shape) w.Write<int64_t>(d);
+      }
+      w.Write<uint32_t>(var.lod_level);
+      w.Write<uint8_t>(var.flags);
+    }
+    w.Write<uint32_t>(static_cast<uint32_t>(blk.ops.size()));
+    for (const OpDesc& op : blk.ops) {
+      w.WriteStr(op.type);
+      WriteIOMap(&w, op.inputs);
+      WriteIOMap(&w, op.outputs);
+      w.Write<uint32_t>(static_cast<uint32_t>(op.attrs.size()));
+      for (const auto& kv : op.attrs) {
+        w.WriteStr(kv.first);
+        WriteAttr(&w, kv.second);
+      }
+    }
+  }
+  *out = w.buffer();
+}
+
+}  // namespace ptpu

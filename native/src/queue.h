@@ -1,0 +1,102 @@
+// Bounded blocking byte-buffer queue: the LoDTensorBlockingQueue /
+// BlockingQueue<T> equivalent (framework/blocking_queue.h,
+// operators/reader/lod_tensor_blocking_queue.h).
+//
+// Python feeder threads push serialized batches; the input pipeline pops
+// them for device transfer. close() wakes every waiter (the reference's
+// queue close-on-epoch-end contract); reopen() resets for the next epoch.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace ptpu {
+
+class BlockingByteQueue {
+ public:
+  explicit BlockingByteQueue(uint64_t capacity) : capacity_(capacity) {}
+
+  // 0 ok, -1 closed, -2 timeout.
+  int Push(const void* data, uint64_t len, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto ready = [this] { return closed_ || items_.size() < capacity_; };
+    if (!WaitFor(lk, not_full_, ready, timeout_ms)) return -2;
+    if (closed_) return -1;
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    items_.emplace_back(p, p + len);
+    not_empty_.notify_one();
+    return 0;
+  }
+
+  // >0 popped size, 0 closed-and-drained, -2 timeout, -3 out buffer too
+  // small (record stays queued). max_len == 0 peeks the size.
+  int64_t Pop(void* out, uint64_t max_len, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto ready = [this] { return closed_ || !items_.empty(); };
+    if (!WaitFor(lk, not_empty_, ready, timeout_ms)) return -2;
+    if (items_.empty()) return 0;  // closed and drained
+    const std::vector<uint8_t>& front = items_.front();
+    int64_t n = static_cast<int64_t>(front.size());
+    if (max_len == 0) return n;  // size query
+    if (static_cast<uint64_t>(n) > max_len) return -3;
+    if (n != 0) std::memcpy(out, front.data(), front.size());
+    items_.pop_front();
+    not_full_.notify_one();
+    return n;
+  }
+
+  uint64_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return items_.size();
+  }
+  uint64_t Capacity() const { return capacity_; }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+  // Abort: close AND discard queued items (BlockingQueue::Kill contract —
+  // a reset mid-epoch must not serve stale batches).
+  void Kill() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    items_.clear();
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+  bool IsClosed() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return closed_;
+  }
+  void Reopen() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = false;
+    items_.clear();
+  }
+
+ private:
+  template <typename Pred>
+  bool WaitFor(std::unique_lock<std::mutex>& lk, std::condition_variable& cv,
+               Pred pred, int64_t timeout_ms) {
+    if (timeout_ms < 0) {
+      cv.wait(lk, pred);
+      return true;
+    }
+    return cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+  }
+
+  const uint64_t capacity_;
+  std::mutex mu_;
+  std::condition_variable not_empty_;
+  std::condition_variable not_full_;
+  std::deque<std::vector<uint8_t>> items_;
+  bool closed_ = false;
+};
+
+}  // namespace ptpu

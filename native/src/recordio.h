@@ -1,0 +1,126 @@
+// RecordIO-style chunked record file with per-record CRC32.
+//
+// Reference parity: the reference's recordio reader
+// (operators/reader/create_recordio_file_reader_op.cc over the recordio
+// library) — a simple length+checksum framing that lets the input pipeline
+// detect truncated/corrupt shards instead of feeding garbage.
+//
+// On-disk: "PTRC" magic, then per record: u64 payload length, u32 crc32 of
+// the payload, payload bytes. Little-endian throughout.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace ptpu {
+
+// CRC-32 (IEEE 802.3), bytewise table implementation.
+class Crc32 {
+ public:
+  Crc32() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) {
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      }
+      table_[i] = c;
+    }
+  }
+  uint32_t compute(const void* data, size_t len) const {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    uint32_t c = 0xFFFFFFFFu;
+    for (size_t i = 0; i < len; ++i) {
+      c = table_[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+    }
+    return c ^ 0xFFFFFFFFu;
+  }
+
+ private:
+  uint32_t table_[256];
+};
+
+static const Crc32& crc32_instance() {
+  static Crc32 crc;
+  return crc;
+}
+
+static const char kMagic[4] = {'P', 'T', 'R', 'C'};
+
+class RecordIOWriter {
+ public:
+  explicit RecordIOWriter(const std::string& path)
+      : f_(std::fopen(path.c_str(), "wb")) {
+    if (f_ != nullptr) {
+      std::fwrite(kMagic, 1, 4, f_);
+    }
+  }
+  bool ok() const { return f_ != nullptr; }
+  bool Write(const void* data, uint64_t len) {
+    if (f_ == nullptr) return false;
+    uint32_t crc = crc32_instance().compute(data, len);
+    return std::fwrite(&len, sizeof(len), 1, f_) == 1 &&
+           std::fwrite(&crc, sizeof(crc), 1, f_) == 1 &&
+           (len == 0 || std::fwrite(data, 1, len, f_) == len);
+  }
+  bool Close() {
+    if (f_ == nullptr) return false;
+    int rc = std::fclose(f_);
+    f_ = nullptr;
+    return rc == 0;
+  }
+  ~RecordIOWriter() {
+    if (f_ != nullptr) std::fclose(f_);
+  }
+
+ private:
+  std::FILE* f_;
+};
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const std::string& path)
+      : f_(std::fopen(path.c_str(), "rb")) {
+    if (f_ != nullptr) {
+      char magic[4];
+      if (std::fread(magic, 1, 4, f_) != 4 ||
+          std::memcmp(magic, kMagic, 4) != 0) {
+        std::fclose(f_);
+        f_ = nullptr;
+      }
+    }
+  }
+  bool ok() const { return f_ != nullptr; }
+
+  // Reads the next record into the internal buffer.
+  // Returns payload size (>= 0; empty records are legal), -1 at EOF,
+  // -2 on corruption.
+  int64_t Next() {
+    if (f_ == nullptr) return -2;
+    uint64_t len = 0;
+    uint32_t crc = 0;
+    if (std::fread(&len, sizeof(len), 1, f_) != 1) return -1;  // EOF
+    if (std::fread(&crc, sizeof(crc), 1, f_) != 1) return -2;
+    buf_.resize(len);
+    if (len != 0 && std::fread(buf_.data(), 1, len, f_) != len) return -2;
+    if (crc32_instance().compute(buf_.data(), len) != crc) return -2;
+    return static_cast<int64_t>(len);
+  }
+  const std::vector<uint8_t>& buffer() const { return buf_; }
+  bool Close() {
+    if (f_ == nullptr) return false;
+    int rc = std::fclose(f_);
+    f_ = nullptr;
+    return rc == 0;
+  }
+  ~RecordIOReader() {
+    if (f_ != nullptr) std::fclose(f_);
+  }
+
+ private:
+  std::FILE* f_;
+  std::vector<uint8_t> buf_;
+};
+
+}  // namespace ptpu

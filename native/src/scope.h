@@ -1,0 +1,76 @@
+// Hierarchical host-tensor scope: Scope/Variable equivalent
+// (framework/scope.h:41, variable.h:26). Name -> host tensor (dtype tag,
+// dims, byte buffer); child scopes delegate lookups to parents
+// (Scope::FindVar semantics) and are owned by their parent
+// (Scope::NewScope/DropKids).
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace ptpu {
+
+struct HostTensor {
+  std::string dtype;
+  std::vector<int64_t> dims;
+  std::vector<uint8_t> data;
+};
+
+class Scope {
+ public:
+  Scope() : parent_(nullptr) {}
+
+  Scope* NewChild() {
+    std::lock_guard<std::mutex> lk(mu_);
+    children_.emplace_back(new Scope());
+    children_.back()->parent_ = this;
+    return children_.back().get();
+  }
+
+  void Set(const std::string& name, HostTensor tensor) {
+    std::lock_guard<std::mutex> lk(mu_);
+    vars_[name] = std::move(tensor);
+  }
+
+  // FindVar: local first, then walk parents.
+  const HostTensor* Find(const std::string& name) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = vars_.find(name);
+      if (it != vars_.end()) return &it->second;
+    }
+    return parent_ != nullptr ? parent_->Find(name) : nullptr;
+  }
+
+  bool Erase(const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return vars_.erase(name) != 0;
+  }
+
+  uint64_t NumVars() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return vars_.size();
+  }
+
+  std::string ListJoined() {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string out;
+    for (const auto& kv : vars_) {
+      if (!out.empty()) out.push_back('\n');
+      out += kv.first;
+    }
+    return out;
+  }
+
+ private:
+  Scope* parent_;
+  std::mutex mu_;
+  std::unordered_map<std::string, HostTensor> vars_;
+  std::vector<std::unique_ptr<Scope>> children_;
+};
+
+}  // namespace ptpu

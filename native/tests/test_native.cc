@@ -1,0 +1,168 @@
+// Assert-based unit tests for the native runtime (cc_test-style,
+// cmake/generic.cmake:303 role). Covers recordio round-trip + corruption
+// detection, blocking-queue producer/consumer + close semantics, scope
+// parent lookup, and PTPB parse/re-serialize identity.
+
+// Assertions ARE the test; keep them in release builds.
+#undef NDEBUG
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ptpu/c_api.h"
+
+static void test_recordio() {
+  const char* path = "/tmp/ptpu_test.recordio";
+  ptpu_recordio_writer* w = ptpu_recordio_writer_open(path);
+  assert(w != nullptr);
+  std::string a = "hello records";
+  std::string b(1 << 16, 'x');  // 64 KiB record
+  assert(ptpu_recordio_write(w, a.data(), a.size()) == 0);
+  assert(ptpu_recordio_write(w, b.data(), b.size()) == 0);
+  assert(ptpu_recordio_write(w, nullptr, 0) == 0);  // empty record
+  assert(ptpu_recordio_writer_close(w) == 0);
+
+  ptpu_recordio_reader* r = ptpu_recordio_reader_open(path);
+  assert(r != nullptr);
+  int64_t n = ptpu_recordio_next(r);
+  assert(n == (int64_t)a.size());
+  std::vector<char> buf(n);
+  assert(ptpu_recordio_read(r, buf.data(), n) == 0);
+  assert(std::memcmp(buf.data(), a.data(), n) == 0);
+  assert(ptpu_recordio_next(r) == (int64_t)b.size());
+  // Next() without Read() discards the previous payload.
+  assert(ptpu_recordio_next(r) == 0);   // the empty third record
+  assert(ptpu_recordio_next(r) == -1);  // EOF
+  ptpu_recordio_reader_close(r);
+
+  // Corrupt a payload byte -> CRC failure.
+  std::FILE* f = std::fopen(path, "r+b");
+  std::fseek(f, 4 + 8 + 4 + 2, SEEK_SET);  // into record 1's payload
+  std::fputc('X', f);
+  std::fclose(f);
+  r = ptpu_recordio_reader_open(path);
+  assert(ptpu_recordio_next(r) == -2);
+  ptpu_recordio_reader_close(r);
+  std::remove(path);
+  std::printf("recordio ok\n");
+}
+
+static void test_queue() {
+  ptpu_queue* q = ptpu_queue_create(2);
+  assert(ptpu_queue_capacity(q) == 2);
+
+  // Producer pushes 50 records; consumer pops them all.
+  std::thread producer([q] {
+    for (int i = 0; i < 50; ++i) {
+      int payload = i * 3;
+      int rc = ptpu_queue_push(q, &payload, sizeof(payload), -1);
+      assert(rc == 0);
+    }
+    ptpu_queue_close(q);
+  });
+  int got = 0, sum = 0;
+  for (;;) {
+    int payload = 0;
+    int64_t n = ptpu_queue_pop(q, &payload, sizeof(payload), -1);
+    if (n == 0) break;  // closed and drained
+    assert(n == sizeof(payload));
+    sum += payload;
+    ++got;
+  }
+  producer.join();
+  assert(got == 50);
+  assert(sum == 3 * (49 * 50 / 2));
+  assert(ptpu_queue_is_closed(q) == 1);
+
+  // Reopen for a new epoch; timeout semantics.
+  ptpu_queue_reopen(q);
+  int x = 7;
+  assert(ptpu_queue_push(q, &x, sizeof(x), 10) == 0);
+  assert(ptpu_queue_push(q, &x, sizeof(x), 10) == 0);
+  assert(ptpu_queue_push(q, &x, sizeof(x), 10) == -2);  // full -> timeout
+  int64_t peek = ptpu_queue_pop(q, nullptr, 0, 10);
+  assert(peek == sizeof(x));  // size query leaves the record queued
+  assert(ptpu_queue_size(q) == 2);
+  ptpu_queue_destroy(q);
+  std::printf("queue ok\n");
+}
+
+static void test_scope() {
+  ptpu_scope* root = ptpu_scope_create();
+  float w[6] = {1, 2, 3, 4, 5, 6};
+  int64_t dims[2] = {2, 3};
+  assert(ptpu_scope_set(root, "w", "float32", dims, 2, w, sizeof(w)) == 0);
+
+  ptpu_scope* child = ptpu_scope_new_child(root);
+  // FindVar walks to the parent.
+  char dtype[32];
+  int64_t got_dims[16];
+  int32_t ndim = 0;
+  int64_t nbytes =
+      ptpu_scope_get_meta(child, "w", dtype, sizeof(dtype), got_dims, &ndim);
+  assert(nbytes == (int64_t)sizeof(w));
+  assert(std::strcmp(dtype, "float32") == 0);
+  assert(ndim == 2 && got_dims[0] == 2 && got_dims[1] == 3);
+  float back[6];
+  assert(ptpu_scope_get_data(child, "w", back, sizeof(back)) == 0);
+  assert(std::memcmp(back, w, sizeof(w)) == 0);
+
+  // Local shadowing: child's own var wins.
+  float v = 9;
+  int64_t d1[1] = {1};
+  ptpu_scope_set(child, "w", "float32", d1, 1, &v, sizeof(v));
+  assert(ptpu_scope_get_meta(child, "w", nullptr, 0, nullptr, nullptr) ==
+         (int64_t)sizeof(v));
+  assert(ptpu_scope_get_meta(root, "w", nullptr, 0, nullptr, nullptr) ==
+         (int64_t)sizeof(w));
+  assert(ptpu_scope_num_vars(child) == 1);
+  assert(ptpu_scope_get_meta(child, "absent", nullptr, 0, nullptr,
+                             nullptr) == -1);
+  ptpu_scope_destroy(child);  // wrapper only; tree dies with root
+  ptpu_scope_destroy(root);
+  std::printf("scope ok\n");
+}
+
+static void test_program_roundtrip(const char* ptpb_path) {
+  // When the Python test wrote a program file, parse + re-serialize and
+  // require byte identity (lockstep guarantee with program_bin.py).
+  std::FILE* f = std::fopen(ptpb_path, "rb");
+  if (f == nullptr) {
+    std::printf("program roundtrip skipped (no input file)\n");
+    return;
+  }
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> data(size);
+  assert(std::fread(data.data(), 1, size, f) == (size_t)size);
+  std::fclose(f);
+
+  ptpu_program* p = ptpu_program_parse(data.data(), data.size());
+  assert(p != nullptr);
+  assert(ptpu_program_num_blocks(p) >= 1);
+  assert(ptpu_program_num_ops(p, 0) >= 1);
+  char op0[128];
+  assert(ptpu_program_op_type(p, 0, 0, op0, sizeof(op0)) > 0);
+  int64_t need = ptpu_program_serialize(p, nullptr, 0);
+  assert(need == (int64_t)data.size());
+  std::vector<uint8_t> out(need);
+  ptpu_program_serialize(p, out.data(), out.size());
+  assert(out == data);
+  ptpu_program_destroy(p);
+  std::printf("program roundtrip ok (%ld bytes, first op %s)\n", size, op0);
+}
+
+int main(int argc, char** argv) {
+  test_recordio();
+  test_queue();
+  test_scope();
+  test_program_roundtrip(argc > 1 ? argv[1]
+                                  : "/tmp/ptpu_test_program.ptpb");
+  std::printf("ALL NATIVE TESTS PASSED\n");
+  return 0;
+}

@@ -150,13 +150,24 @@ def save_inference_model(
         inference_program, feeded_var_names, target_names
     )
     os.makedirs(dirname, exist_ok=True)
-    meta = {
-        "program": inference_program,
-        "feed_names": list(feeded_var_names),
-        "fetch_names": target_names,
-    }
+    # __model__ is the language-neutral PTPB binary (core/program_bin.py;
+    # C++ twin in native/src/program.cc) so the C++ predictor can load it —
+    # the reference's ProgramDesc-protobuf role. Feed/fetch names ride in a
+    # JSON sidecar (the reference encodes them as feed/fetch ops).
+    from paddle_tpu.core.program_bin import serialize_program
+
     with open(os.path.join(dirname, model_filename or "__model__"), "wb") as f:
-        pickle.dump(meta, f)
+        f.write(serialize_program(inference_program))
+    import json
+
+    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
+        json.dump(
+            {
+                "feed_names": list(feeded_var_names),
+                "fetch_names": target_names,
+            },
+            f,
+        )
     save_persistables(
         executor, dirname, inference_program, filename=params_filename,
         scope=scope,
@@ -167,8 +178,18 @@ def save_inference_model(
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None, scope=None):
     with open(os.path.join(dirname, model_filename or "__model__"), "rb") as f:
-        meta = pickle.load(f)
-    program = meta["program"]
+        blob = f.read()
+    if blob[:4] == b"PTPB":
+        import json
+
+        from paddle_tpu.core.program_bin import deserialize_program
+
+        program = deserialize_program(blob)
+        with open(os.path.join(dirname, "__meta__.json")) as f:
+            meta = json.load(f)
+    else:  # legacy pickled format
+        meta = pickle.loads(blob)
+        program = meta["program"]
     load_persistables(
         executor, dirname, program, filename=params_filename, scope=scope
     )

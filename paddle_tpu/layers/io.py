@@ -41,7 +41,19 @@ class PyReader(object):
         from paddle_tpu.reader.queue import BlockingQueue
 
         self.feed_vars = feed_vars
-        self.queue = BlockingQueue(capacity)
+        # Prefer the C++ queue (LoDTensorBlockingQueue parity): producers
+        # block in native code instead of a Python condition variable.
+        self.queue = None
+        try:
+            from paddle_tpu import native
+            from paddle_tpu.reader.queue import NativeTensorQueue
+
+            if native.prebuilt():
+                self.queue = NativeTensorQueue(capacity)
+        except Exception:
+            pass
+        if self.queue is None:
+            self.queue = BlockingQueue(capacity)
         self._decorated = None
         self._thread = None
         self.use_double_buffer = use_double_buffer

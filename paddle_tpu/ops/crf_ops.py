@@ -17,10 +17,6 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.op_registry import register_op
-
-_NEG = -1e30
-
-
 from paddle_tpu.ops.common import optional_lengths
 
 

@@ -48,6 +48,9 @@ def _lower_nce(ctx, ins, attrs):
         jnp.sum(jax.nn.softplus(-true_adj), axis=1, keepdims=True) / n_true
         + jnp.sum(jax.nn.softplus(neg_adj), axis=1, keepdims=True)
     )
+    sample_weight = ins.get("SampleWeight", [None])[0]
+    if sample_weight is not None:
+        cost = cost * jnp.reshape(sample_weight, (-1, 1))
     return {
         "Cost": cost,
         "SampleLogits": logits,

@@ -210,8 +210,6 @@ def _lower_sequence_concat(ctx, ins, attrs):
     # Per-row concatenation of valid prefixes: row i of the output is
     # x[i,:lx] ++ y[i,:ly], re-padded to Tx+Ty.
     xs = ins["X"]
-    if len(xs) == 1:
-        return {"Out": xs[0]}
     lens = ins.get("Length", [])
     out = xs[0]
     out_len = (

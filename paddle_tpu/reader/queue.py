@@ -65,3 +65,73 @@ class BlockingQueue(object):
     def size(self):
         with self._mutex:
             return len(self._q)
+
+
+class NativeTensorQueue(object):
+    """BlockingQueue-compatible adapter over the C++ byte queue
+    (native/src/queue.h), speaking tuples of numpy arrays. Batches
+    serialize with np.savez into the native buffer, so producer threads
+    hold the GIL only for the memcpy while consumers block in C++.
+
+    Drop-in for BlockingQueue when paddle_tpu.native.available().
+    """
+
+    def __init__(self, capacity):
+        from paddle_tpu import native
+
+        self.capacity = capacity
+        self._q = native.NativeBlockingQueue(capacity)
+
+    @staticmethod
+    def _encode(item):
+        import io as _io
+
+        import numpy as np
+
+        buf = _io.BytesIO()
+        if isinstance(item, dict):
+            np.savez(buf, **{"d@" + k: np.asarray(v)
+                             for k, v in item.items()})
+        else:
+            arrays = item if isinstance(item, (list, tuple)) else [item]
+            np.savez(buf, *[np.asarray(a) for a in arrays])
+        return buf.getvalue()
+
+    @staticmethod
+    def _decode(blob):
+        import io as _io
+
+        import numpy as np
+
+        with np.load(_io.BytesIO(blob), allow_pickle=False) as z:
+            if z.files and z.files[0].startswith("d@"):
+                return {k[2:]: z[k] for k in z.files}
+            return tuple(z[k] for k in z.files)
+
+    def push(self, item):
+        try:
+            return self._q.push(self._encode(item))
+        except TimeoutError:
+            return False
+
+    def pop(self, timeout=None):
+        timeout_ms = -1 if timeout is None else int(timeout * 1000)
+        try:
+            blob = self._q.pop(timeout_ms=timeout_ms)
+        except TimeoutError:
+            return None
+        if blob is None:
+            return None
+        return self._decode(blob)
+
+    def close(self):
+        self._q.close()
+
+    def kill(self):
+        self._q.kill()
+
+    def reopen(self):
+        self._q.reopen()
+
+    def size(self):
+        return self._q.size()
