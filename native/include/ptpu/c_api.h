@@ -98,6 +98,11 @@ int64_t ptpu_program_op_type(ptpu_program*, int32_t block, int32_t op,
 int64_t ptpu_program_serialize(ptpu_program*, void* out, uint64_t cap);
 void ptpu_program_destroy(ptpu_program*);
 
+/* ---- CPU reference interpreter (NaiveExecutor role, f32 op subset) ---- */
+/* Executes every op of `block` against the scope (inputs pre-set, outputs
+ * written back). 0 on success; -1 with ptpu_last_error() detail. */
+int ptpu_interp_run(ptpu_program*, ptpu_scope*, int32_t block);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

@@ -7,6 +7,7 @@
 #include <cstring>
 #include <string>
 
+#include "interp.h"
 #include "program.h"
 #include "queue.h"
 #include "recordio.h"
@@ -257,5 +258,19 @@ int64_t ptpu_program_serialize(ptpu_program* p, void* out, uint64_t cap) {
 }
 
 void ptpu_program_destroy(ptpu_program* p) { delete p; }
+
+// ---------------------------------------------------------------------------
+// reference interpreter
+// ---------------------------------------------------------------------------
+
+int ptpu_interp_run(ptpu_program* p, ptpu_scope* s, int32_t block) {
+  ptpu::interp::Interpreter interp(p->impl);
+  std::string err = interp.Run(block, s->impl);
+  if (!err.empty()) {
+    set_error(err);
+    return -1;
+  }
+  return 0;
+}
 
 }  // extern "C"

@@ -1,0 +1,241 @@
+#pragma once
+// Minimal C++ CPU reference interpreter over PTPB programs.
+//
+// Reference parity: the NaiveExecutor + CPU-kernel path that backs the
+// reference's C++ predictor (framework/naive_executor.cc,
+// inference/api/api_impl.cc) and its "C++-only train/infer demo"
+// (train/demo/demo_trainer.cc). On TPU the production inference path is
+// the XLA-compiled executable; this interpreter is the host-side reference
+// implementation used to (a) prove the C++ runtime can execute the IR end
+// to end without Python and (b) cross-check XLA lowerings from C++ parity
+// tests (SURVEY.md §2.9 item 7). Float32, core op subset; unsupported ops
+// report an error rather than mis-executing.
+
+#include <cmath>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "program.h"
+#include "scope.h"
+
+namespace ptpu {
+
+namespace interp {
+
+inline int64_t NumElements(const std::vector<int64_t>& dims) {
+  int64_t n = 1;
+  for (int64_t d : dims) n *= d;
+  return n;
+}
+
+inline const float* F32(const HostTensor& t) {
+  return reinterpret_cast<const float*>(t.data.data());
+}
+
+inline bool IsF32(const HostTensor& t) { return t.dtype == "float32"; }
+
+inline HostTensor MakeF32(std::vector<int64_t> dims) {
+  HostTensor t;
+  t.dtype = "float32";
+  t.dims = std::move(dims);
+  t.data.resize(NumElements(t.dims) * sizeof(float));
+  return t;
+}
+
+inline float* MutF32(HostTensor* t) {
+  return reinterpret_cast<float*>(t->data.data());
+}
+
+// Fetches the single input bound to `slot` (empty-name entries skipped).
+inline const std::string* OneName(const OpDesc& op, const std::string& slot,
+                                  bool input = true) {
+  const auto& io = input ? op.inputs : op.outputs;
+  auto it = io.find(slot);
+  if (it == io.end()) return nullptr;
+  for (const std::string& n : it->second) {
+    if (!n.empty()) return &n;
+  }
+  return nullptr;
+}
+
+class Interpreter {
+ public:
+  explicit Interpreter(const ProgramDesc& prog) : prog_(prog) {}
+
+  // Runs every op of `block` against `scope`. Returns "" on success or an
+  // error description.
+  std::string Run(int32_t block_idx, Scope* scope) {
+    if (block_idx < 0 ||
+        block_idx >= static_cast<int32_t>(prog_.blocks.size())) {
+      return "bad block index";
+    }
+    for (const OpDesc& op : prog_.blocks[block_idx].ops) {
+      std::string err = RunOp(op, scope);
+      if (!err.empty()) return "op " + op.type + ": " + err;
+    }
+    return "";
+  }
+
+ private:
+  std::string RunOp(const OpDesc& op, Scope* scope) {
+    if (op.type == "feed" || op.type == "fetch") return "";  // host-managed
+    if (op.type == "mul") return RunMul(op, scope);
+    if (op.type == "elementwise_add") return RunAdd(op, scope);
+    if (op.type == "relu") return RunUnary(op, scope, [](float v) {
+      return v > 0.0f ? v : 0.0f;
+    });
+    if (op.type == "sigmoid") return RunUnary(op, scope, [](float v) {
+      return 1.0f / (1.0f + std::exp(-v));
+    });
+    if (op.type == "tanh") return RunUnary(op, scope, [](float v) {
+      return std::tanh(v);
+    });
+    if (op.type == "scale") {
+      float s = 1.0f, b = 0.0f;
+      auto it = op.attrs.find("scale");
+      if (it != op.attrs.end()) {
+        s = it->second.tag == AttrValue::kFloat
+                ? static_cast<float>(it->second.f)
+                : static_cast<float>(it->second.i);
+      }
+      it = op.attrs.find("bias");
+      if (it != op.attrs.end()) {
+        b = it->second.tag == AttrValue::kFloat
+                ? static_cast<float>(it->second.f)
+                : static_cast<float>(it->second.i);
+      }
+      return RunUnary(op, scope, [s, b](float v) { return s * v + b; });
+    }
+    if (op.type == "softmax") return RunSoftmax(op, scope);
+    return "unsupported op type";
+  }
+
+  std::string RunMul(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* yn = OneName(op, "Y");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || yn == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* y = scope->Find(*yn);
+    if (x == nullptr || y == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*y)) return "non-f32 dtype";
+    // x_num_col_dims semantics: flatten x to [rows, K], y to [K, cols].
+    int64_t xcol = 1;
+    auto it = op.attrs.find("x_num_col_dims");
+    if (it != op.attrs.end()) xcol = it->second.i;
+    int64_t rows = 1, k = 1;
+    for (size_t d = 0; d < x->dims.size(); ++d) {
+      (static_cast<int64_t>(d) < xcol ? rows : k) *= x->dims[d];
+    }
+    int64_t k2 = y->dims.empty() ? 1 : y->dims[0];
+    int64_t cols = NumElements(y->dims) / (k2 == 0 ? 1 : k2);
+    if (k != k2) return "shape mismatch";
+    std::vector<int64_t> odims(x->dims.begin(), x->dims.begin() + xcol);
+    odims.push_back(cols);
+    HostTensor out = MakeF32(odims);
+    const float* xa = F32(*x);
+    const float* ya = F32(*y);
+    float* oa = MutF32(&out);
+    for (int64_t i = 0; i < rows; ++i) {
+      for (int64_t j = 0; j < cols; ++j) {
+        float acc = 0.0f;
+        for (int64_t t = 0; t < k; ++t) {
+          acc += xa[i * k + t] * ya[t * cols + j];
+        }
+        oa[i * cols + j] = acc;
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunAdd(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* yn = OneName(op, "Y");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || yn == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* y = scope->Find(*yn);
+    if (x == nullptr || y == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*y)) return "non-f32 dtype";
+    // Only trailing-dim broadcast is implemented; any other axis must be
+    // rejected, not mis-executed.
+    auto ax_it = op.attrs.find("axis");
+    if (ax_it != op.attrs.end() && ax_it->second.tag == AttrValue::kInt) {
+      int64_t ax = ax_it->second.i;
+      int64_t trailing = static_cast<int64_t>(x->dims.size()) -
+                         static_cast<int64_t>(y->dims.size());
+      if (ax != -1 && ax != trailing) return "non-trailing broadcast axis";
+    }
+    int64_t nx = NumElements(x->dims);
+    int64_t ny = NumElements(y->dims);
+    if (ny == 0 || nx % ny != 0) return "broadcast mismatch";
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    const float* ya = F32(*y);
+    float* oa = MutF32(&out);
+    // Trailing-dim broadcast (bias add): y repeats every ny elements.
+    for (int64_t i = 0; i < nx; ++i) oa[i] = xa[i] + ya[i % ny];
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunUnary(const OpDesc& op, Scope* scope,
+                       const std::function<float(float)>& fn) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x)) return "non-f32 dtype";
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    int64_t n = NumElements(x->dims);
+    for (int64_t i = 0; i < n; ++i) oa[i] = fn(xa[i]);
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunSoftmax(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x)) return "non-f32 dtype";
+    if (x->dims.empty()) return "scalar softmax";
+    int64_t cols = x->dims.back();
+    int64_t rows = NumElements(x->dims) / cols;
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t i = 0; i < rows; ++i) {
+      const float* row = xa + i * cols;
+      float* orow = oa + i * cols;
+      float mx = row[0];
+      for (int64_t j = 1; j < cols; ++j) mx = std::max(mx, row[j]);
+      float sum = 0.0f;
+      for (int64_t j = 0; j < cols; ++j) {
+        orow[j] = std::exp(row[j] - mx);
+        sum += orow[j];
+      }
+      for (int64_t j = 0; j < cols; ++j) orow[j] /= sum;
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  const ProgramDesc& prog_;
+};
+
+}  // namespace interp
+
+}  // namespace ptpu

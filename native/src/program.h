@@ -1,3 +1,4 @@
+#pragma once
 // PTPB program IR parser/serializer — the C++ twin of
 // paddle_tpu/core/program_bin.py (reference role: framework.proto +
 // program_desc.h/op_desc.h C++ IR shared by runtime and front-end). The

@@ -1,3 +1,4 @@
+#pragma once
 // Bounded blocking byte-buffer queue: the LoDTensorBlockingQueue /
 // BlockingQueue<T> equivalent (framework/blocking_queue.h,
 // operators/reader/lod_tensor_blocking_queue.h).

@@ -1,3 +1,4 @@
+#pragma once
 // RecordIO-style chunked record file with per-record CRC32.
 //
 // Reference parity: the reference's recordio reader

@@ -1,3 +1,4 @@
+#pragma once
 // Hierarchical host-tensor scope: Scope/Variable equivalent
 // (framework/scope.h:41, variable.h:26). Name -> host tensor (dtype tag,
 // dims, byte buffer); child scopes delegate lookups to parents

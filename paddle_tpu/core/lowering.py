@@ -37,10 +37,12 @@ class BlockLowerer(object):
 
         Returns (state_in, state_out):
           state_in: persistable vars the block reads that must come from the
-            scope (function inputs, donated);
-          state_out: persistable vars the block writes (function outputs,
-            written back to the scope) — superset includes state_in so
-            donation aliasing is total.
+            scope (function inputs);
+          state_out: persistable vars the block actually WRITES (function
+            outputs, written back to the scope). Read-only state (inference
+            params) stays out of state_out so CompiledProgram never donates
+            its buffers — donation would invalidate scope arrays shared
+            with concurrent runs.
         """
         defined = set(feed_names)
         state_in = []
@@ -64,9 +66,6 @@ class BlockLowerer(object):
                 if v is not None and v.persistable and name not in seen_out:
                     seen_out.add(name)
                     state_out.append(name)
-        for name in state_in:
-            if name not in seen_out:
-                state_out.append(name)
         return state_in, state_out
 
     def _iter_ops_recursive(self, block):
@@ -178,6 +177,7 @@ class CompiledProgram(object):
         scope_names,
         is_test=False,
         shardings=None,
+        device=None,
     ):
         self.fetch_names = list(fetch_names)
         lowerer = BlockLowerer(program, 0, is_test=is_test)
@@ -192,19 +192,48 @@ class CompiledProgram(object):
             self.state_out,
             is_test=is_test,
         )
+        # Donate ONLY state the program replaces (optimizer updates, BN
+        # stats). Donating untouched state (e.g. params in an inference
+        # program) would invalidate the scope's live buffers on backends
+        # with real donation — a use-after-free for any later run or a
+        # concurrent clone sharing the scope.
+        self.mutable_state = sorted(set(self.state_in) & set(self.state_out))
+        self.frozen_state = sorted(set(self.state_in) - set(self.state_out))
+        step = self.step
+
+        def split_step(mut_state, frozen_state, feeds, key):
+            state = dict(frozen_state)
+            state.update(mut_state)
+            return step(state, feeds, key)
+
         self.shardings = shardings
         if shardings is None:
-            self.jitted = jax.jit(self.step, donate_argnums=(0,))
+            if device is not None:
+                # Pin the executable to the Place's device: with multiple
+                # backends loaded (e.g. the TPU plugin + CPU), jit would
+                # otherwise follow the default platform, not the Place.
+                s = jax.sharding.SingleDeviceSharding(device)
+                self.jitted = jax.jit(
+                    split_step, donate_argnums=(0,), in_shardings=s,
+                    out_shardings=s,
+                )
+            else:
+                self.jitted = jax.jit(split_step, donate_argnums=(0,))
         else:
-            state_in_s = {n: shardings.state_sharding(n) for n in self.state_in}
+            mut_s = {n: shardings.state_sharding(n)
+                     for n in self.mutable_state}
+            frz_s = {n: shardings.state_sharding(n)
+                     for n in self.frozen_state}
             feed_s = {n: shardings.feed_sharding(n) for n in feed_specs}
             state_out_s = {n: shardings.state_sharding(n) for n in self.state_out}
             self.jitted = jax.jit(
-                self.step,
-                in_shardings=(state_in_s, feed_s, shardings.replicated()),
+                split_step,
+                in_shardings=(mut_s, frz_s, feed_s, shardings.replicated()),
                 out_shardings=(state_out_s, None),
                 donate_argnums=(0,),
             )
 
     def __call__(self, state, feeds, key):
-        return self.jitted(state, feeds, key)
+        mut = {n: state[n] for n in self.mutable_state}
+        frz = {n: state[n] for n in self.frozen_state}
+        return self.jitted(mut, frz, feeds, key)

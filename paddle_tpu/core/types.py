@@ -20,11 +20,17 @@ class Place(object):
     def jax_device(self):
         import jax
 
-        devices = jax.devices()
         if self._kind is not None:
-            matching = [d for d in devices if self._kind in d.platform.lower()]
-            if matching:
-                devices = matching
+            # Ask the backend for this platform directly: jax.devices()
+            # only lists the DEFAULT platform, so with an accelerator
+            # plugin loaded a CPUPlace would otherwise silently resolve to
+            # the accelerator.
+            try:
+                devs = jax.devices(self._kind)
+                return devs[self.device_id % len(devs)]
+            except RuntimeError:
+                pass  # platform not present; fall through to default
+        devices = jax.devices()
         return devices[self.device_id % len(devices)]
 
     def __eq__(self, other):

@@ -89,6 +89,7 @@ class Executor(object):
                 fetch_names,
                 scope_names,
                 is_test=program._is_test,
+                device=self.place.jax_device(),
             )
             self._cache[key] = cp
         return cp
@@ -109,6 +110,17 @@ class Executor(object):
         fetch_list = fetch_list or []
         scope = scope or global_scope()
         device = self.place.jax_device()
+        # Everything below (feed transfer, key creation, dispatch) stays on
+        # the Place's device: with several backends loaded (TPU plugin +
+        # CPU), stray ops like PRNGKey would otherwise run on the default
+        # platform — wrong device, and unsafe under concurrent serving.
+        with jax.default_device(device):
+            return self._run_on_device(
+                program, feed, fetch_list, scope, device, return_numpy
+            )
+
+    def _run_on_device(self, program, feed, fetch_list, scope, device,
+                       return_numpy):
 
         # Prepare feeds.
         feeds = {}
@@ -143,6 +155,10 @@ class Executor(object):
             val = v.value
             if not isinstance(val, jax.Array):
                 val = jax.device_put(np.asarray(val), device)
+            elif val.sharding.device_set != {device}:
+                # Scope value lives on another Place's device (e.g. trained
+                # on TPU, now serving on CPU): move it once.
+                val = jax.device_put(val, device)
             state[n] = val
 
         self._run_counter += 1

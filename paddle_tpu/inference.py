@@ -1,0 +1,136 @@
+"""Inference predictor API.
+
+Reference parity: ``paddle/fluid/inference/api/paddle_inference_api.h``
+(:141 PaddlePredictor, :183 NativeConfig, :211 CreatePaddlePredictor) and
+``api_impl.cc``'s NativePaddlePredictor. The TPU design compiles the pruned
+inference program once per feed-shape signature through the Executor's
+program cache (analysis/fusion passes are XLA's job) and serves from it;
+``Clone()`` shares the loaded weights (scope) while giving each server
+thread its own predictor handle, matching the reference's multi-threaded
+serving contract.
+"""
+
+import threading
+
+import numpy as np
+
+__all__ = ["NativeConfig", "Predictor", "create_paddle_predictor"]
+
+
+class NativeConfig(object):
+    """Model-dir config (NativeConfig parity). ``use_tpu`` picks the device
+    place; fraction/device knobs kept for API compatibility."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None,
+                 use_tpu=True, device=0,
+                 fraction_of_gpu_memory=-1.0):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self.use_tpu = use_tpu
+        self.device = device
+        self.fraction_of_gpu_memory = fraction_of_gpu_memory
+
+
+class Predictor(object):
+    """Compiled-program predictor over a saved inference model."""
+
+    def __init__(self, config, _shared=None):
+        import paddle_tpu as fluid
+        from paddle_tpu.core.scope import Scope
+
+        self._config = config
+        if _shared is not None:
+            # Clone(): share program + weights, new executor cache handle.
+            (self._program, self._feed_names, self._fetch_vars,
+             self._scope) = _shared
+        else:
+            self._scope = Scope()
+            place = (
+                fluid.TPUPlace() if config.use_tpu else fluid.CPUPlace()
+            )
+            exe = fluid.Executor(place)
+            with fluid.scope_guard(self._scope):
+                (self._program, self._feed_names,
+                 self._fetch_vars) = fluid.io.load_inference_model(
+                    config.model_dir, exe,
+                    model_filename=config.prog_file,
+                    params_filename=config.params_file,
+                )
+        place = fluid.TPUPlace() if config.use_tpu else fluid.CPUPlace()
+        self._exe = fluid.Executor(place)
+        self._lock = threading.Lock()
+
+    def run(self, inputs):
+        """inputs: dict feed-name -> ndarray, or list matching the saved
+        feed order. Returns list of ndarrays (fetch order)."""
+        import paddle_tpu as fluid
+
+        if not isinstance(inputs, dict):
+            if len(inputs) != len(self._feed_names):
+                raise ValueError(
+                    "expected %d inputs (%s), got %d"
+                    % (len(self._feed_names), self._feed_names, len(inputs))
+                )
+            inputs = dict(zip(self._feed_names, inputs))
+        with self._lock:  # executor cache mutation is not thread-safe
+            # Scope passed explicitly: the scope_guard stack is a process
+            # global, unsafe when several predictors serve concurrently.
+            outs = self._exe.run(
+                self._program, feed=inputs, fetch_list=self._fetch_vars,
+                scope=self._scope,
+            )
+        return [np.asarray(o) for o in outs]
+
+    def clone(self):
+        """A predictor sharing this one's weights for another serving
+        thread (PaddlePredictor::Clone parity)."""
+        return Predictor(
+            self._config,
+            _shared=(self._program, self._feed_names, self._fetch_vars,
+                     self._scope),
+        )
+
+    @property
+    def feed_names(self):
+        return list(self._feed_names)
+
+    def run_native_reference(self, inputs, fetch_index=0):
+        """Run the C++ reference interpreter (native/src/interp.h) on this
+        model: host-only execution of the PTPB program, used to cross-check
+        the XLA path from C++ (NaiveExecutor role). Core f32 op subset."""
+        from paddle_tpu import native
+        from paddle_tpu.core.program_bin import serialize_program
+
+        if not native.available():
+            raise RuntimeError("native library unavailable")
+        lib = native.get_lib()
+        blob = serialize_program(self._program)
+        prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+        if not prog:
+            raise ValueError(native.last_error())
+        try:
+            nscope = native.NativeScope()
+            # Parameters from the shared scope + user feeds.
+            for name in self._scope.local_var_names():
+                val = self._scope.get_value(name)
+                if val is not None:
+                    nscope.set(name, np.asarray(val))
+            if not isinstance(inputs, dict):
+                inputs = dict(zip(self._feed_names, inputs))
+            for name, val in inputs.items():
+                nscope.set(name, np.asarray(val, np.float32))
+            rc = lib.ptpu_interp_run(prog, nscope._h, 0)
+            if rc != 0:
+                raise RuntimeError(native.last_error())
+            out = nscope.get(self._fetch_vars[fetch_index].name)
+            if out is None:
+                raise RuntimeError("fetch var missing after interp run")
+            return out
+        finally:
+            lib.ptpu_program_destroy(prog)
+
+
+def create_paddle_predictor(config):
+    """CreatePaddlePredictor parity."""
+    return Predictor(config)

@@ -99,6 +99,7 @@ def _declare(lib):
                                   c.c_uint64], c.c_int64),
         "ptpu_program_serialize": ([P, c.c_void_p, c.c_uint64], c.c_int64),
         "ptpu_program_destroy": ([P], None),
+        "ptpu_interp_run": ([P, P, c.c_int32], c.c_int),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)

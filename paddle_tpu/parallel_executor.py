@@ -175,7 +175,20 @@ class ParallelExecutor(object):
                 raise RuntimeError(
                     "persistable var %r not initialized (run startup first)" % n
                 )
-            state[n] = v.value
+            val = v.value
+            # State initialized by the single-device startup Executor is
+            # committed to one device; donated jit args must already carry
+            # the mesh sharding, so reshard explicitly (BCastParamsToDevices
+            # role, parallel_executor.cc:180).
+            if isinstance(val, jax.Array):
+                target = cp.shardings.state_sharding(n)
+                try:
+                    ok = val.sharding.is_equivalent_to(target, val.ndim)
+                except Exception:
+                    ok = False
+                if not ok:
+                    val = jax.device_put(val, target)
+            state[n] = val
 
         self._run_counter += 1
         key = jax.random.fold_in(

@@ -1,0 +1,97 @@
+"""Predictor API tests (inference/api/api_impl_tester.cc role): config ->
+predictor -> run parity with the training executor, Clone() multithreaded
+serving, and the C++ reference interpreter cross-check of the XLA path."""
+
+import threading
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.inference import NativeConfig, create_paddle_predictor
+
+
+def _train_and_save(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[12], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=24, act="relu")
+        pred = fluid.layers.fc(input=h, size=3, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=y)
+        )
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    rng = np.random.RandomState(0)
+    base = rng.randn(3, 12).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(40):
+            lbl = rng.randint(0, 3, 32)
+            xb = base[lbl] + 0.2 * rng.randn(32, 12).astype("float32")
+            exe.run(main, feed={"x": xb, "y": lbl.reshape(-1, 1)},
+                    fetch_list=[loss])
+        path = str(tmp_path / "model")
+        fluid.io.save_inference_model(path, ["x"], [pred], exe,
+                                      main_program=main)
+        xb = base[[0, 1, 2]] + 0.1
+        (want,) = exe.run(
+            main, feed={"x": xb, "y": np.zeros((3, 1), "int64")},
+            fetch_list=[pred],
+        )
+    return path, xb, np.asarray(want)
+
+
+def test_predictor_matches_executor(tmp_path):
+    path, xb, want = _train_and_save(tmp_path)
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=path, use_tpu=False)
+    )
+    (got,) = predictor.run({"x": xb})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # Positional input form.
+    (got2,) = predictor.run([xb])
+    np.testing.assert_allclose(got2, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_clone_multithreaded(tmp_path):
+    path, xb, want = _train_and_save(tmp_path)
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=path, use_tpu=False)
+    )
+    results = {}
+
+    def serve(tid):
+        p = predictor.clone()
+        for _ in range(5):
+            (out,) = p.run({"x": xb})
+            results.setdefault(tid, []).append(out)
+
+    threads = [threading.Thread(target=serve, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 4
+    for outs in results.values():
+        for out in outs:
+            np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_cpp_reference_interpreter_matches_xla(tmp_path):
+    from paddle_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    path, xb, want = _train_and_save(tmp_path)
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=path, use_tpu=False)
+    )
+    got = predictor.run_native_reference({"x": xb})
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
