@@ -52,7 +52,10 @@ from paddle_tpu.parallel_executor import (  # noqa: F401
 from paddle_tpu.data_feeder import DataFeeder  # noqa: F401
 from paddle_tpu import io  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
+from paddle_tpu import transpiler  # noqa: F401
+from paddle_tpu.transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from paddle_tpu.core.lod import LoDTensor, create_lod_tensor  # noqa: F401
+from paddle_tpu.core.selected_rows import SelectedRows  # noqa: F401
 from paddle_tpu import unique_name  # noqa: F401
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 

@@ -1,0 +1,63 @@
+"""SelectedRows: sparse row-subset tensor {rows, value, height}.
+
+Reference parity: ``paddle/fluid/framework/selected_rows.h:32`` and the
+selected_rows_functor math — the reference's representation for embedding
+gradients and sparse pserver updates. On TPU the compiled path keeps
+gradients dense (XLA scatter-add onto the row-sharded table rides the mesh
+collectives), so this host-side type serves the *interchange* role: sparse
+checkpoint shards, host-offloaded embedding updates, and feed/fetch of
+sparse values.
+"""
+
+import numpy as np
+
+
+class SelectedRows(object):
+    def __init__(self, rows, value, height):
+        self.rows = np.asarray(rows, np.int64).reshape(-1)
+        self.value = np.asarray(value)
+        if self.value.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                "value has %d rows, rows index has %d"
+                % (self.value.shape[0], self.rows.shape[0])
+            )
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    def to_dense(self):
+        """Scatter-ADD duplicate rows into a dense [height, ...] array
+        (selected_rows_functor.cc merge-add semantics)."""
+        dense = np.zeros(self.shape, self.value.dtype)
+        np.add.at(dense, self.rows, self.value)
+        return dense
+
+    @classmethod
+    def from_dense_rows(cls, dense, rows):
+        """Pick the given rows out of a dense table."""
+        dense = np.asarray(dense)
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        return cls(rows, dense[rows], dense.shape[0])
+
+    def merge_rows(self):
+        """Coalesce duplicate row ids (merge_add): unique rows, summed
+        values — what the pserver applies for sparse grads."""
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+        merged = np.zeros((len(uniq),) + self.value.shape[1:],
+                          self.value.dtype)
+        np.add.at(merged, inv, self.value)
+        return SelectedRows(uniq, merged, self.height)
+
+    def apply_sgd(self, table, lr):
+        """In-place sparse SGD row update on a dense host table (the
+        pserver optimize-block capability for is_sparse grads)."""
+        m = self.merge_rows()
+        table[m.rows] -= lr * m.value
+        return table
+
+    def __repr__(self):
+        return "SelectedRows(height=%d, nnz_rows=%d, row_dim=%s)" % (
+            self.height, len(self.rows), self.value.shape[1:]
+        )

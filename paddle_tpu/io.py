@@ -25,6 +25,10 @@ __all__ = [
     "save_inference_model",
     "load_inference_model",
     "get_inference_program",
+    "save_sharded_persistables",
+    "load_sharded_persistables",
+    "save_checkpoint",
+    "load_checkpoint",
 ]
 
 
@@ -210,3 +214,174 @@ def get_inference_program(target_vars, main_program=None):
         v.name for v in program.list_vars() if getattr(v, "is_data", False)
     ]
     return prune_program(program, data_names, targets)
+
+
+# ---------------------------------------------------------------------------
+# Sharded / distributed checkpointing (reference: checkpoint_notify +
+# _save_lookup_tables_by_notify io.py:763, slice-aware load io.py:881 —
+# pserver param shards; here: GSPMD mesh shards, each process saving only
+# its addressable shards so multi-host checkpointing never gathers a full
+# array on one host).
+# ---------------------------------------------------------------------------
+
+
+def _shard_index_to_json(index, ndim):
+    out = []
+    for d in range(ndim):
+        sl = index[d] if d < len(index) else slice(None)
+        if isinstance(sl, slice):
+            out.append([sl.start, sl.stop])
+        else:
+            out.append([int(sl), int(sl) + 1])
+    return out
+
+
+def save_sharded_persistables(executor, dirname, main_program=None,
+                              scope=None):
+    """Per-shard persistable save. Multi-device jax Arrays write one
+    ``<var>.shard<k>.npy`` per addressable shard + slice metadata;
+    single-device values fall back to plain ``.npy``."""
+    import json
+
+    import jax
+
+    main_program = main_program or framework.default_main_program()
+    scope = _scope_of(executor, scope)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {}
+    for v in main_program.list_vars():
+        if not v.persistable:
+            continue
+        val = scope.get_value(v.name)
+        if val is None:
+            continue
+        safe = v.name.replace("/", "__")
+        if isinstance(val, jax.Array) and len(val.sharding.device_set) > 1:
+            # One file per DISTINCT shard index: replicated (or partially
+            # replicated) arrays would otherwise write N identical copies.
+            shards = []
+            seen_idx = set()
+            for shard in val.addressable_shards:
+                idx_json = _shard_index_to_json(shard.index, val.ndim)
+                key = tuple(map(tuple, idx_json))
+                if key in seen_idx:
+                    continue
+                seen_idx.add(key)
+                fname = "%s.shard%d.npy" % (safe, shard.device.id)
+                np.save(os.path.join(dirname, fname),
+                        np.asarray(shard.data))
+                shards.append({"file": fname, "index": idx_json})
+            if len(shards) == 1:
+                # Fully replicated: store as a plain dense var.
+                os.replace(
+                    os.path.join(dirname, shards[0]["file"]),
+                    os.path.join(dirname, safe + ".npy"),
+                )
+            else:
+                meta[v.name] = {
+                    "shape": list(val.shape),
+                    "dtype": str(val.dtype),
+                    "shards": shards,
+                }
+        else:
+            np.save(os.path.join(dirname, safe), np.asarray(val))
+    with open(os.path.join(dirname, "__sharding__.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def load_sharded_persistables(executor, dirname, main_program=None,
+                              scope=None, strict=True):
+    """Inverse of save_sharded_persistables: assembles shard files and sets
+    full host arrays — the next mesh run reshards them (the
+    ParallelExecutor's BCast-equivalent). ``strict`` (default) errors on a
+    missing shard file; multi-host loaders that only see their own process's
+    shards pass strict=False."""
+    import json
+
+    main_program = main_program or framework.default_main_program()
+    scope = _scope_of(executor, scope)
+    meta_path = os.path.join(dirname, "__sharding__.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    for v in main_program.list_vars():
+        if not v.persistable:
+            continue
+        if v.name in meta:
+            m = meta[v.name]
+            full = np.zeros(tuple(m["shape"]), dtype=np.dtype(m["dtype"]))
+            for shard in m["shards"]:
+                path = os.path.join(dirname, shard["file"])
+                if not os.path.exists(path):
+                    if strict:
+                        raise IOError(
+                            "checkpoint shard %s of %r is missing (pass "
+                            "strict=False for multi-host partial loads)"
+                            % (shard["file"], v.name)
+                        )
+                    continue  # other host's shard
+                idx = tuple(
+                    slice(lo, hi) for lo, hi in shard["index"]
+                )
+                full[idx] = np.load(path)
+            scope.set_value(v.name, full)
+        else:
+            path = os.path.join(
+                dirname, v.name.replace("/", "__") + ".npy"
+            )
+            if os.path.exists(path):
+                scope.set_value(v.name, np.load(path))
+
+
+def _checkpoint_serials(checkpoint_dir):
+    """Sorted numeric checkpoint serials; non-numeric suffixes (e.g. a
+    user's checkpoint_best symlink) are ignored, not fatal."""
+    out = []
+    for d in os.listdir(checkpoint_dir):
+        if d.startswith("checkpoint_") and d.split("_")[-1].isdigit():
+            out.append(int(d.split("_")[-1]))
+    return sorted(out)
+
+
+def save_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
+                    serial=0, max_num_checkpoints=3, sharded=True):
+    """Numbered checkpoint dirs + retention (reference io.py CheckpointConfig
+    capability): checkpoint_dir/checkpoint_<serial>/ with sharded (or plain)
+    persistables; old serials beyond max_num_checkpoints are pruned."""
+    import shutil
+
+    step_dir = os.path.join(checkpoint_dir, "checkpoint_%d" % serial)
+    saver = (
+        save_sharded_persistables if sharded else save_persistables
+    )
+    saver(executor, step_dir, main_program=main_program, scope=scope)
+    keep = max(int(max_num_checkpoints), 1)
+    serials = _checkpoint_serials(checkpoint_dir)
+    # Never prune the serial just written, whatever its ordering.
+    prune = [s for s in serials if s != serial]
+    prune = prune[: max(len(serials) - keep, 0)]
+    for s in prune:
+        shutil.rmtree(
+            os.path.join(checkpoint_dir, "checkpoint_%d" % s),
+            ignore_errors=True,
+        )
+    return step_dir
+
+
+def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
+                    serial=None):
+    """Load the given (default: latest) checkpoint serial; returns the
+    serial loaded or None when the directory holds no checkpoints."""
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    serials = _checkpoint_serials(checkpoint_dir)
+    if not serials:
+        return None
+    serial = serial if serial is not None else serials[-1]
+    load_sharded_persistables(
+        executor,
+        os.path.join(checkpoint_dir, "checkpoint_%d" % serial),
+        main_program=main_program, scope=scope,
+    )
+    return serial

@@ -1,0 +1,13 @@
+"""Graph-to-graph transpilers (python/paddle/fluid/transpiler parity)."""
+
+from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
+from paddle_tpu.transpiler.ps_dispatcher import (  # noqa: F401
+    HashName,
+    RoundRobin,
+)
+from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401
+    slice_variable,
+)

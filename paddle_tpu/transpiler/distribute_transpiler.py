@@ -1,0 +1,253 @@
+"""DistributeTranspiler: single-process program -> distributed training.
+
+Reference parity: ``python/paddle/fluid/transpiler/distribute_transpiler.py``
+(:81 slice_variable, :240 transpile, get_trainer_program,
+get_pserver_program, get_startup_program) — the reference rewrites the graph
+into trainer programs (split/send/recv around the backward) and pserver
+programs (listen_and_serv over per-grad optimize blocks).
+
+TPU-first mapping: gradient exchange is NOT rewritten into RPC ops — the
+trainer program stays whole and the data-parallel collectives come from
+GSPMD when it runs under a mesh (``build_sharding_policy`` hands the
+ParallelExecutor the plan; SURVEY.md §2.6 parallelism map). What this class
+preserves from the reference is the *planning and structural* surface:
+block-sliced parameter placement over endpoints (the sharded-pserver
+capability), pserver-side optimize programs (runnable on the shard owner:
+the host-offload path for huge embeddings), and the nccl2 mode that maps to
+collective data parallel over the mesh.
+"""
+
+import math
+
+from paddle_tpu import framework
+from paddle_tpu.framework import (
+    OP_ROLE_ATTR_NAME,
+    OP_ROLE_VAR_ATTR_NAME,
+    OpRole,
+    Program,
+)
+from paddle_tpu.transpiler.ps_dispatcher import RoundRobin
+
+
+class VarBlock(object):
+    """One slice of a parameter: [offset, offset+size) of the flat var."""
+
+    def __init__(self, varname, offset, size):
+        self.varname = varname
+        self.offset = offset
+        self.size = size
+
+    def __str__(self):
+        return "%s:%d:%d" % (self.varname, self.offset, self.size)
+
+
+def slice_variable(var_list, slice_count, min_block_size=8192):
+    """Split vars into ~equal blocks, each >= min_block_size elements and
+    aligned so a block holds whole rows (distribute_transpiler.py:81)."""
+    blocks = []
+    for var in var_list:
+        numel = 1
+        for d in var.shape or ():
+            if int(d) > 0:
+                numel *= int(d)
+        split_count = slice_count
+        max_pserver_count = int(math.floor(numel / float(min_block_size)))
+        if max_pserver_count == 0:
+            max_pserver_count = 1
+        if max_pserver_count < slice_count:
+            split_count = max_pserver_count
+        block_size = int(math.ceil(numel / float(split_count)))
+
+        if len(var.shape or ()) >= 2:
+            # Align to whole rows.
+            dim1 = 1
+            for d in var.shape[1:]:
+                dim1 *= int(d)
+            remains = block_size % dim1
+            if remains != 0:
+                block_size += dim1 - remains
+        split_count = int(math.ceil(numel / float(block_size)))
+        for block_id in range(split_count):
+            curr_size = min(block_size, numel - block_id * block_size)
+            blocks.append(VarBlock(var.name, block_id * block_size,
+                                   curr_size))
+    return blocks
+
+
+class DistributeTranspilerConfig(object):
+    """slice_var_up: split big params into blocks over pservers;
+    split_method: placement policy class; min_block_size: elements."""
+
+    slice_var_up = True
+    split_method = RoundRobin
+    min_block_size = 8192
+
+    def __init__(self, slice_var_up=True, split_method=None,
+                 min_block_size=8192):
+        self.slice_var_up = slice_var_up
+        self.split_method = split_method or RoundRobin
+        self.min_block_size = min_block_size
+
+
+class DistributeTranspiler(object):
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._transpiled = False
+
+    # -- planning ----------------------------------------------------------
+
+    def _param_grad_pairs(self, program):
+        """(param_name, grad_name) pairs from op_role_var on optimize ops
+        (the reference reads the same attr, op_proto_maker OpRole)."""
+        pairs = []
+        seen = set()
+        for op in program.global_block().ops:
+            role = op.attrs.get(OP_ROLE_ATTR_NAME, OpRole.Forward)
+            rv = op.attrs.get(OP_ROLE_VAR_ATTR_NAME)
+            if role == OpRole.Optimize and rv and len(rv) >= 2:
+                p, g = rv[0], rv[1]
+                if p not in seen:
+                    seen.add(p)
+                    pairs.append((p, g))
+        return pairs
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint=""):
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or framework.default_main_program()
+        self.startup_program = (
+            startup_program or framework.default_startup_program()
+        )
+        if isinstance(pservers, str):
+            self.pserver_endpoints = [
+                e for e in pservers.split(",") if e.strip()
+            ]
+        else:
+            self.pserver_endpoints = list(pservers)
+        self.current_endpoint = current_endpoint
+
+        pairs = self._param_grad_pairs(self.origin_program)
+        block = self.origin_program.global_block()
+        params = [block._find_var_recursive(p) for p, _ in pairs]
+        params = [p for p in params if p is not None]
+        slice_count = (
+            len(self.pserver_endpoints) if self.config.slice_var_up else 1
+        )
+        self.param_blocks = slice_variable(
+            params, max(slice_count, 1), self.config.min_block_size
+        )
+        dispatcher = self.config.split_method(self.pserver_endpoints)
+        eps = dispatcher.dispatch(self.param_blocks)
+        self.param_block_map = {}  # endpoint -> [VarBlock]
+        for blk, ep in zip(self.param_blocks, eps):
+            self.param_block_map.setdefault(ep, []).append(blk)
+        self.param_grad_map = dict(pairs)
+        self._transpiled = True
+        return self
+
+    # -- outputs -----------------------------------------------------------
+
+    def get_trainer_program(self):
+        """The trainer keeps the whole graph: under the mesh, GSPMD inserts
+        the gradient collectives the reference's send/recv ops performed."""
+        assert self._transpiled, "call transpile() first"
+        return self.origin_program
+
+    def build_sharding_policy(self, mesh, state_shapes=None,
+                              sparse_tables=()):
+        """The GSPMD execution of the plan: params that were block-sliced
+        over pservers become dim-0-sharded state on the mesh (ZeRO-ish
+        'reduce' strategy); sparse tables shard on the model axis (the
+        distributed-lookup capability)."""
+        from paddle_tpu.parallel.mesh import ShardingPolicy
+
+        return ShardingPolicy(
+            mesh,
+            strategy="reduce" if len(self.pserver_endpoints) > 1
+            else "all_reduce",
+            state_shapes=state_shapes,
+            model_sharded_vars=set(sparse_tables),
+        )
+
+    def get_pserver_program(self, endpoint):
+        """A runnable optimize-only program for the params placed on
+        ``endpoint``: for each owned param, the optimize ops from the origin
+        program (listen_and_serv's per-grad block structure, flattened).
+        Feeds: the grads; state: the owned params + optimizer accumulators.
+        """
+        assert self._transpiled, "call transpile() first"
+        owned = {
+            blk.varname for blk in self.param_block_map.get(endpoint, [])
+        }
+        pserver_prog = Program()
+        pblock = pserver_prog.global_block()
+        src_block = self.origin_program.global_block()
+
+        needed_vars = set()
+        ops_to_copy = []
+        for op in src_block.ops:
+            role = op.attrs.get(OP_ROLE_ATTR_NAME, OpRole.Forward)
+            rv = op.attrs.get(OP_ROLE_VAR_ATTR_NAME)
+            # LR-schedule ops are replicated onto every pserver (the
+            # reference clones lr-decay ops the same way) so copied
+            # optimize ops never read a frozen/uninitialized rate.
+            if role not in (OpRole.Optimize, OpRole.LRSched):
+                continue
+            if (role == OpRole.Optimize and rv and len(rv) >= 2
+                    and rv[0] not in owned):
+                continue
+            ops_to_copy.append(op)
+            needed_vars.update(op.input_arg_names())
+            needed_vars.update(op.output_arg_names())
+        for name in sorted(needed_vars):
+            v = src_block._find_var_recursive(name)
+            if v is None:
+                continue
+            nv = pblock.create_var(
+                name=v.name, shape=v.shape, dtype=v.dtype, type=v.type,
+                persistable=v.persistable,
+            )
+            nv.stop_gradient = v.stop_gradient
+        for op in ops_to_copy:
+            pblock.append_op(
+                type=op.type,
+                inputs={k: list(v) for k, v in op.inputs.items()},
+                outputs={k: list(v) for k, v in op.outputs.items()},
+                attrs=dict(op.attrs),
+            )
+        return pserver_prog
+
+    def get_startup_program(self, endpoint, pserver_program=None):
+        """Init ops for the params (+accumulators) owned by ``endpoint``."""
+        assert self._transpiled, "call transpile() first"
+        owned = {
+            blk.varname for blk in self.param_block_map.get(endpoint, [])
+        }
+        if pserver_program is not None:
+            owned = owned | {
+                v for v in pserver_program.global_block().vars
+            }
+        startup = Program()
+        sblock = startup.global_block()
+        src = self.startup_program.global_block()
+        for op in src.ops:
+            outs = set(op.output_arg_names())
+            if not outs & owned:
+                continue
+            for name in set(op.input_arg_names()) | outs:
+                v = src._find_var_recursive(name)
+                if v is not None and name not in sblock.vars:
+                    sblock.create_var(
+                        name=v.name, shape=v.shape, dtype=v.dtype,
+                        type=v.type, persistable=v.persistable,
+                    )
+            sblock.append_op(
+                type=op.type,
+                inputs={k: list(v) for k, v in op.inputs.items()},
+                outputs={k: list(v) for k, v in op.outputs.items()},
+                attrs=dict(op.attrs),
+            )
+        return startup

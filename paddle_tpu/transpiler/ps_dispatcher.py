@@ -1,0 +1,47 @@
+"""Parameter-block placement policies.
+
+Reference parity: ``python/paddle/fluid/transpiler/ps_dispatcher.py``
+(RoundRobin / HashName) — decides which endpoint (pserver in the reference;
+mesh shard group here) owns each sliced parameter block.
+"""
+
+
+class PSDispatcher(object):
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        out = []
+        for _v in varlist:
+            out.append(self._eps[self._step])
+            self._step = (self._step + 1) % len(self._eps)
+        return out
+
+
+class HashName(PSDispatcher):
+    def dispatch(self, varlist):
+        import zlib
+
+        out = []
+        for v in varlist:
+            # VarBlock carries .varname; plain vars carry .name. crc32 is
+            # process-stable (builtin str hash is salted per process, which
+            # would give trainers and pservers conflicting placements).
+            name = getattr(v, "varname", None) or v.name
+            out.append(
+                self._eps[zlib.crc32(name.encode()) % len(self._eps)]
+            )
+        return out
