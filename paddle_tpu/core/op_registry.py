@@ -208,6 +208,17 @@ def lower_grad_via_vjp(fwd_def, ctx, ins, attrs, out_grads, wanted_input_grads):
         # Output pytree: dict slot -> list of arrays.
         return normalize_outputs(fwd_def, fwd_def.lower(ctx, local, attrs))
 
+    # memory_optimize: recompute this op's forward inside the backward
+    # (jax.checkpoint) instead of letting XLA CSE share stored activations
+    # with the forward pass — FLOPs for peak HBM.
+    program = ctx.op.block.program
+    if getattr(program, "_remat", False) or _flag_remat():
+        skip = getattr(program, "_remat_skip", ())
+        # skip_opt_set holds forward var names; they appear among the grad
+        # op's inputs (forward ins/outs are replayed into it).
+        if not (skip and set(ctx.op.input_arg_names()) & set(skip)):
+            fwd_fn = jax.checkpoint(fwd_fn)
+
     primals = tuple(ins[slot][i] for slot, i in diff_index)
     out_tree, vjp_fn = jax.vjp(fwd_fn, *primals)
 
@@ -285,3 +296,12 @@ def ensure_auto_grad_op(fwd_type):
 
 def assert_dtype(x, dtype):
     return jnp.asarray(x, canonical_dtype(dtype))
+
+
+def _flag_remat():
+    try:
+        from paddle_tpu import flags
+
+        return flags.get("remat_gradients")
+    except Exception:
+        return False

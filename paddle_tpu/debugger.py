@@ -1,0 +1,97 @@
+"""Program inspection: pretty-printer + graphviz export.
+
+Reference parity: ``python/paddle/fluid/debugger.py`` (pprint_program_codes,
+draw_block_graphviz) and ``framework/ir/graph_viz_pass.cc`` (dot output of
+the op graph).
+"""
+
+__all__ = ["program_to_code", "draw_block_graphviz"]
+
+
+def _fmt_var(v):
+    from paddle_tpu.framework import Parameter
+
+    kind = "param" if isinstance(v, Parameter) else (
+        "data" if getattr(v, "is_data", False) else "var"
+    )
+    extras = []
+    if v.persistable:
+        extras.append("persist")
+    if v.stop_gradient:
+        extras.append("stop_grad")
+    return "%s %s : %s%s %s" % (
+        kind, v.name, v.dtype,
+        list(v.shape) if v.shape is not None else "?",
+        ",".join(extras),
+    )
+
+
+def program_to_code(program, skip_op_callstack=True):
+    """Readable text dump of every block (debugger.pprint_program_codes)."""
+    lines = []
+    for block in program.blocks:
+        lines.append(
+            "-- block %d (parent %d) --" % (block.idx, block.parent_idx)
+        )
+        for name in sorted(block.vars):
+            lines.append("  " + _fmt_var(block.vars[name]))
+        for i, op in enumerate(block.ops):
+            ins = ", ".join(
+                "%s=[%s]" % (slot, ",".join(ns))
+                for slot, ns in sorted(op.inputs.items()) if ns
+            )
+            outs = ", ".join(
+                "%s=[%s]" % (slot, ",".join(ns))
+                for slot, ns in sorted(op.outputs.items()) if ns
+            )
+            attrs = ", ".join(
+                "%s=%r" % (k, v)
+                for k, v in sorted(op.attrs.items())
+                if not k.startswith("__") and k not in ("op_role",
+                                                        "op_role_var")
+            )
+            lines.append(
+                "  [%3d] %s(%s) -> %s  {%s}" % (i, op.type, ins, outs,
+                                                attrs)
+            )
+    return "\n".join(lines)
+
+
+def draw_block_graphviz(block, highlights=None, path="/tmp/program.dot"):
+    """Emit a graphviz dot file of a block's op/var dataflow
+    (graph_viz_pass.cc / debugger.draw_block_graphviz parity)."""
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_nodes = set()
+
+    def var_node(name):
+        nid = "var_" + name.replace(".", "_").replace("@", "_").replace(
+            "/", "_"
+        )
+        if name not in var_nodes:
+            var_nodes.add(name)
+            color = ', style=filled, fillcolor="#ffd2d2"' if (
+                name in highlights
+            ) else ""
+            lines.append(
+                '  %s [label="%s", shape=oval%s];' % (nid, name, color)
+            )
+        return nid
+
+    for i, op in enumerate(block.ops):
+        op_id = "op_%d" % i
+        lines.append(
+            '  %s [label="%s", shape=box, style=filled, '
+            'fillcolor="#d2e3fc"];' % (op_id, op.type)
+        )
+        for name in op.input_arg_names():
+            if name:
+                lines.append("  %s -> %s;" % (var_node(name), op_id))
+        for name in op.output_arg_names():
+            if name:
+                lines.append("  %s -> %s;" % (op_id, var_node(name)))
+    lines.append("}")
+    dot = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(dot)
+    return dot

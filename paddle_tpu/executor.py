@@ -173,6 +173,23 @@ class Executor(object):
         for n, val in new_state.items():
             scope.set_value(n, val)
 
+        from paddle_tpu import flags as _flags
+
+        if _flags.get("check_nan_inf"):
+            # FLAGS_check_nan_inf (operator.cc:754): scan every produced
+            # value host-side and fail loudly on the first bad one.
+            for name, val in list(new_state.items()) + list(
+                zip(cp.fetch_names, fetches)
+            ):
+                arr = np.asarray(val)
+                if np.issubdtype(arr.dtype, np.floating) and not np.all(
+                    np.isfinite(arr)
+                ):
+                    raise RuntimeError(
+                        "NaN/Inf detected in variable %r after program run "
+                        "(FLAGS_check_nan_inf)" % name
+                    )
+
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches

@@ -1,0 +1,73 @@
+"""Global flag system read from FLAGS_* environment variables.
+
+Reference parity: the gflags DEFINE_*/--tryfromenv surface
+(``python/paddle/fluid/__init__.py:111-133`` whitelists flags and reads
+them from env; C++ point-of-use DEFINE_bool/int in executor.cc, malloc.cc,
+gpu_info.cc). Same contract here: ``FLAGS_check_nan_inf=1`` in the
+environment flips the flag at import (or via ``refresh_from_env``), and
+code reads ``flags.get("check_nan_inf")`` at point of use.
+"""
+
+import os
+
+__all__ = ["get", "set_flag", "refresh_from_env", "all_flags"]
+
+# name -> (default, parser)
+_DEFS = {
+    # numeric guards (operator.cc:754 FLAGS_check_nan_inf)
+    "check_nan_inf": (False, bool),
+    # per-op sync + memory print (executor.cc FLAGS_benchmark)
+    "benchmark": (False, bool),
+    # eager GC threshold, GB (executor.cc FLAGS_eager_delete_tensor_gb);
+    # device memory is XLA's on TPU — kept for config-surface parity.
+    "eager_delete_tensor_gb": (-1.0, float),
+    # deterministic reductions (build_strategy.h FLAGS_cpu_deterministic)
+    "cpu_deterministic": (False, bool),
+    # poison freshly allocated host buffers (malloc.cc FLAGS_init_allocated_mem)
+    "init_allocated_mem": (False, bool),
+    # fraction of device memory to use (gpu_info.cc:22) — advisory on TPU
+    # (maps to XLA_PYTHON_CLIENT_MEM_FRACTION at process start).
+    "fraction_of_gpu_memory_to_use": (0.92, float),
+    # reader queue soak-test mode (FLAGS_reader_queue_speed_test_mode)
+    "reader_queue_speed_test_mode": (False, bool),
+    # rpc knobs kept for config parity (rpc_deadline etc.)
+    "rpc_deadline": (180000, int),
+    # forced rematerialization for all grad ops (memory_optimize's lever)
+    "remat_gradients": (False, bool),
+}
+
+
+def _parse(raw, parser):
+    if parser is bool:
+        return str(raw).lower() in ("1", "true", "yes", "on")
+    return parser(raw)
+
+
+_values = {}
+
+
+def refresh_from_env():
+    """Re-read every FLAGS_<name> env var (init_gflags --tryfromenv)."""
+    for name, (default, parser) in _DEFS.items():
+        raw = os.environ.get("FLAGS_" + name)
+        _values[name] = _parse(raw, parser) if raw is not None else default
+
+
+def get(name):
+    if name not in _DEFS:
+        raise KeyError("unknown flag %r (known: %s)"
+                       % (name, sorted(_DEFS)))
+    return _values[name]
+
+
+def set_flag(name, value):
+    if name not in _DEFS:
+        raise KeyError("unknown flag %r" % name)
+    _values[name] = _parse(value, _DEFS[name][1])
+
+
+def all_flags():
+    return dict(_values)
+
+
+refresh_from_env()

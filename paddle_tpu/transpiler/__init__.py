@@ -11,3 +11,7 @@ from paddle_tpu.transpiler.ps_dispatcher import (  # noqa: F401
 from paddle_tpu.transpiler.distribute_transpiler import (  # noqa: F401
     slice_variable,
 )
+from paddle_tpu.transpiler.memory_optimization_transpiler import (  # noqa: F401
+    memory_optimize,
+    release_memory,
+)
