@@ -1,9 +1,21 @@
-"""Benchmark harness: ResNet-50 training throughput on one chip.
+"""Benchmark harness: ResNet-50 training throughput + MFU on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu"}.
 Baseline: the reference's best committed ResNet-50 train throughput —
 84.08 img/s (MKL-DNN BS256 on 2x Xeon 6148, benchmark/IntelOptimizedPaddle.md:40-46;
 no GPU/Fluid ResNet numbers are committed in-tree, see BASELINE.md).
+
+Measurement design (BENCH_NOTES.md has the profile data behind it):
+- Input comes from the in-graph ``random_data_generator`` reader op
+  (reference capability: operators/reader/create_random_data_generator_op.cc)
+  so the bench measures the framework's training step, not the host link —
+  on this harness the TPU sits behind a tunnel with ~25 MB/s h2d, which is
+  an artifact of the test rig, not of TPU hardware.
+- Mixed precision: the bf16 AMP rewrite (transpiler/amp_transpiler.py) is
+  on by default on TPU; master weights stay f32 (BENCH_AMP=0 disables).
+- The timed loop fetches nothing per step (steps chain on device through
+  donated state); one loss fetch at the end syncs the pipeline and is
+  included in the timing. Finiteness of that loss is asserted.
 """
 
 import json
@@ -14,6 +26,20 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 84.08
+# ResNet-50 @224: ~4.11 GFLOP forward per image (2*MACs, conv+fc);
+# fwd+bwd ~ 3x forward. Same accounting as the MFU targets in BASELINE.md.
+TRAIN_GFLOP_PER_IMG = 3 * 4.11
+# Peak dense bf16 matmul throughput per chip for MFU accounting.
+PEAK_TFLOPS = {"tpu v5 lite": 197.0, "tpu v5e": 197.0, "tpu v4": 275.0,
+               "tpu v6 lite": 918.0, "tpu v6e": 918.0}
+
+
+def _peak_tflops(device):
+    name = getattr(device, "device_kind", "") or ""
+    for k, v in PEAK_TFLOPS.items():
+        if k in name.lower():
+            return v
+    return None
 
 
 def main():
@@ -26,42 +52,56 @@ def main():
 
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
+    from paddle_tpu.transpiler import rewrite_program_amp
 
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
     # Full ImageNet shapes on TPU; scaled-down proxy on CPU (CI smoke).
     if on_tpu:
-        img, bs, steps, warmup = 224, 64, 20, 5
+        img, bs, steps, warmup = 224, 128, 50, 10
     else:
         img, bs, steps, warmup = 64, 16, 5, 2
+    use_amp = os.environ.get("BENCH_AMP", "1" if on_tpu else "0") == "1"
 
     main_prog, startup = fluid.Program(), fluid.Program()
     main_prog.random_seed = 5
     startup.random_seed = 5
     with fluid.program_guard(main_prog, startup):
-        loss, feeds, extras = resnet.build(
-            img_shape=(3, img, img), class_num=1000, depth=50
+        pixel, label = fluid.layers.random_data_generator(
+            shapes=[[bs, 3, img, img], [bs, 1]],
+            dtypes=["float32", "int64"],
+            int_high=999,
         )
+        predict = resnet.resnet_imagenet(pixel, 1000, depth=50)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        loss = fluid.layers.mean(cost)
         fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    if use_amp:
+        rewrite_program_amp(main_prog, "bfloat16")
 
     place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(startup)
 
-    rng = np.random.RandomState(0)
-    x = rng.rand(bs, 3, img, img).astype(np.float32)
-    y = rng.randint(0, 1000, (bs, 1)).astype(np.int64)
-
+    # Compile + settle (first run compiles; a loss fetch syncs the queue).
     for _ in range(warmup):
-        exe.run(main_prog, feed={"pixel": x, "label": y}, fetch_list=[loss])
+        exe.run(main_prog, feed={}, fetch_list=[])
+    out = exe.run(main_prog, feed={}, fetch_list=[loss])
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        out = exe.run(
-            main_prog, feed={"pixel": x, "label": y}, fetch_list=[loss]
-        )
-    # fetch already host-synced (np.asarray in executor)
+    for _ in range(steps - 1):
+        exe.run(main_prog, feed={}, fetch_list=[])
+    out = exe.run(main_prog, feed={}, fetch_list=[loss])
     dt = time.perf_counter() - t0
+    lv = float(np.ravel(np.asarray(out[0]))[0])
+    assert np.isfinite(lv), "non-finite loss %r" % lv
     img_per_sec = steps * bs / dt
+
+    peak = _peak_tflops(jax.devices()[0]) if on_tpu else None
+    mfu = (
+        round(img_per_sec * TRAIN_GFLOP_PER_IMG * 1e9 / (peak * 1e12), 4)
+        if peak
+        else None
+    )
 
     print(
         json.dumps(
@@ -71,6 +111,7 @@ def main():
                 "value": round(img_per_sec, 2),
                 "unit": "images/sec",
                 "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
+                "mfu": mfu,
             }
         )
     )

@@ -1,0 +1,109 @@
+"""Mixed-precision (bf16) program rewrite — the TPU-era analog of the
+reference's fp16 inference transpiler (paddle/contrib/float16/
+float16_transpiler.py), redesigned for *training*:
+
+- master weights, optimizer state and loss stay float32 in the Scope;
+- MXU-bound ops (conv/matmul family) compute in bfloat16: their float32
+  inputs are cast to bf16 at the op boundary, so XLA fuses the casts into
+  the conv/dot and activations flow bf16 through the network;
+- numerically sensitive ops (losses, softmax over logits, optimizer
+  updates, norms/metrics) cast bf16 inputs back up to float32.
+
+Because gradients are synthesized by re-tracing forward rules under
+jax.vjp (core/op_registry.py), the same boundary casts differentiate
+correctly: a ``conv2d_grad`` produces bf16 weight grads, and the optimizer
+op's f32 upcast makes the master-weight update exact — no loss scaling is
+needed for bf16 (same exponent range as f32).
+
+The pass is applied during block lowering (`BlockLowerer.lower_op`), which
+is where program->XLA rewriting happens in this framework; enable it with
+``paddle_tpu.transpiler.rewrite_program_amp(prog)`` or the
+``paddle_tpu.transpiler.amp_guard`` context manager.
+"""
+
+import jax.numpy as jnp
+
+# Ops whose f32 inputs are cast DOWN to the amp dtype: the MXU FLOP sinks
+# plus cheap elementwise ops that should not re-promote activations.
+WHITE_LIST = frozenset(
+    {
+        "mul",
+        "matmul",
+        "conv2d",
+        "conv3d",
+        "conv2d_transpose",
+        "depthwise_conv2d",
+        "sequence_conv",
+        "attention",  # fused attention lowering (flash kernel)
+    }
+)
+
+# Ops whose low-precision inputs are cast UP to f32: losses and statistics
+# where bf16 mantissa (8 bits) visibly hurts, and every optimizer update
+# (master weights must accumulate in f32).
+BLACK_LIST = frozenset(
+    {
+        "softmax_with_cross_entropy",
+        "cross_entropy",
+        "cross_entropy2",
+        "sigmoid_cross_entropy_with_logits",
+        "mean",
+        "softmax",
+        "reduce_mean",
+        "reduce_sum",
+        "accuracy",
+        "auc",
+        "layer_norm",
+        "l2_normalize",
+        "norm",
+        "clip_by_norm",
+        "squared_l2_norm",
+        "linear_chain_crf",
+        "warpctc",
+        # optimizer ops (ops/optimizer_ops.py)
+        "sgd",
+        "momentum",
+        "lars_momentum",
+        "adam",
+        "adamax",
+        "adagrad",
+        "decayed_adagrad",
+        "adadelta",
+        "rmsprop",
+        "ftrl",
+        "proximal_gd",
+        "proximal_adagrad",
+    }
+)
+
+
+def _cast_tree(ins, src_pred, dst):
+    out = {}
+    changed = False
+    for slot, arrs in ins.items():
+        res = []
+        for a in arrs:
+            try:
+                dt = jnp.result_type(a)
+            except TypeError:
+                res.append(a)
+                continue
+            if src_pred(dt):
+                res.append(jnp.asarray(a).astype(dst))
+                changed = True
+            else:
+                res.append(a)
+        out[slot] = res
+    return out if changed else ins
+
+
+def apply_amp_casts(op_type, ins, amp_dtype):
+    """Cast an op's inputs per the white/black lists. Grad ops follow their
+    forward op's class (the vjp re-trace then runs in the same precision)."""
+    base = op_type[:-5] if op_type.endswith("_grad") else op_type
+    low = jnp.dtype(amp_dtype)
+    if base in WHITE_LIST:
+        return _cast_tree(ins, lambda dt: dt == jnp.float32, low)
+    if base in BLACK_LIST:
+        return _cast_tree(ins, lambda dt: dt == low, jnp.float32)
+    return ins

@@ -100,6 +100,11 @@ class BlockLowerer(object):
                         "(not fed, not persistable-in-scope, not produced "
                         "earlier in the block)" % (op.type, e)
                     )
+        amp = getattr(self.program, "_amp_dtype", None)
+        if amp:
+            from paddle_tpu.core.amp import apply_amp_casts
+
+            ins = apply_amp_casts(op.type, ins, amp)
         ctx = LowerContext(
             op,
             rng=_make_rng(step_key, op.attrs),

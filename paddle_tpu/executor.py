@@ -80,6 +80,7 @@ class Executor(object):
             # initialized later (e.g. startup program ran) must recompile.
             hash(frozenset(scope_names)),
             program._is_test,
+            getattr(program, "_amp_dtype", None),
         )
         cp = self._cache.get(key)
         if cp is None:

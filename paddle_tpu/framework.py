@@ -321,6 +321,8 @@ class Program(object):
         self._version = 0
         self._rng_counter = 0
         self._is_test = False
+        # Mixed-precision compute dtype (core/amp.py); None = pure f32.
+        self._amp_dtype = None
         self._op_role = OpRole.Forward
         self._op_role_var = []
 

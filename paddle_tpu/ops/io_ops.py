@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.op_registry import register_op
-from paddle_tpu.core.types import canonical_dtype
+from paddle_tpu.core.types import canonical_dtype, np_dtype
 
 register_op(
     "assign_value",
@@ -21,6 +21,55 @@ register_op(
             attrs["shape"]
         )
     ),
+    grad=None,
+)
+
+
+def _lower_random_data_generator(ctx, ins, attrs):
+    """On-device synthetic batch source (create_random_data_generator_op.cc
+    capability, TPU-first): data is drawn by the XLA program itself from the
+    step's PRNG key, so benchmark/IO-bound runs never cross the host link.
+    Float slots ~ U[min, max); integer slots ~ U{int_min, int_max}."""
+    shape_concat = list(attrs["shape_concat"])
+    ranks = list(attrs["ranks"])
+    dtypes = list(attrs["dtypes"])
+    lo, hi = float(attrs.get("min", 0.0)), float(attrs.get("max", 1.0))
+    ilo, ihi = int(attrs.get("int_min", 0)), int(attrs.get("int_max", 1))
+    key = ctx.rng()
+    keys = jax.random.split(key, max(len(ranks), 1))
+    outs = []
+    off = 0
+    for i, rank in enumerate(ranks):
+        shape = tuple(shape_concat[off:off + rank])
+        off += rank
+        # canonicalize through jax (int64 -> int32 without x64) so randint
+        # does not emit a truncation warning per trace.
+        dt = jax.dtypes.canonicalize_dtype(np_dtype(dtypes[i]))
+        if jnp.issubdtype(dt, jnp.floating):
+            outs.append(
+                jax.random.uniform(keys[i], shape, dt, minval=lo, maxval=hi)
+            )
+        else:
+            outs.append(
+                jax.random.randint(keys[i], shape, ilo, ihi + 1, dtype=dt)
+            )
+    return {"Out": outs}
+
+
+register_op(
+    "random_data_generator",
+    inputs=[],
+    outputs=["*Out"],
+    attrs={
+        "shape_concat": [],
+        "ranks": [],
+        "dtypes": [],
+        "min": 0.0,
+        "max": 1.0,
+        "int_min": 0,
+        "int_max": 1,
+    },
+    lower=_lower_random_data_generator,
     grad=None,
 )
 

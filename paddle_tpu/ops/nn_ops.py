@@ -263,6 +263,9 @@ def _lower_batch_norm(ctx, ins, attrs):
     y = (x - jnp.reshape(mean.astype(x.dtype), bshape)) * jnp.reshape(
         inv_std * scale, bshape
     ) + jnp.reshape(bias, bshape)
+    # Under AMP, scale/bias stay f32 and the arithmetic above promotes; keep
+    # activations in the network's compute dtype (bf16) for HBM bandwidth.
+    y = y.astype(x.dtype)
     return {
         "Y": y,
         "MeanOut": mean_out,

@@ -15,3 +15,7 @@ from paddle_tpu.transpiler.memory_optimization_transpiler import (  # noqa: F401
     memory_optimize,
     release_memory,
 )
+from paddle_tpu.transpiler.amp_transpiler import (  # noqa: F401
+    rewrite_program_amp,
+    amp_guard,
+)

@@ -1,0 +1,60 @@
+"""AMP transpiler: enable bf16 mixed-precision compute on a Program.
+
+Capability parity with the reference's fp16 transpiler
+(``paddle/contrib/float16/float16_transpiler.py``), redesigned TPU-first
+for *training*: instead of rewriting a serialized inference program with
+explicit cast ops, the rewrite marks the Program and the Block->XLA
+lowering applies dtype boundaries per op (core/amp.py white/black lists)
+— master weights stay f32 in the Scope, conv/matmul run in bf16 on the
+MXU, losses/optimizer updates compute in f32. Works for training AND
+inference programs, and gradients inherit the precision of their forward
+op automatically (vjp re-trace).
+"""
+
+from paddle_tpu.core import amp as amp_core
+
+__all__ = ["rewrite_program_amp", "amp_guard", "AMP_WHITE_LIST",
+           "AMP_BLACK_LIST"]
+
+AMP_WHITE_LIST = amp_core.WHITE_LIST
+AMP_BLACK_LIST = amp_core.BLACK_LIST
+
+
+def rewrite_program_amp(program, amp_dtype="bfloat16"):
+    """Mark ``program`` for mixed-precision lowering. Pass ``None`` to
+    restore pure-f32 compute. Returns the program for chaining."""
+    import jax.numpy as jnp
+
+    if amp_dtype is not None:
+        dt = jnp.dtype(amp_dtype)
+        # fp16 would need a loss-scaling pass (its exponent range underflows
+        # small grads); only bf16 (f32-range exponents) is sound without one.
+        if dt != jnp.dtype(jnp.bfloat16):
+            raise ValueError(
+                "amp_dtype must be bfloat16 (float16 needs loss scaling, "
+                "which this pass does not implement), got %r" % (amp_dtype,)
+            )
+        amp_dtype = dt.name
+    program._amp_dtype = amp_dtype
+    program._bump_version()
+    return program
+
+
+def amp_guard(program=None, amp_dtype="bfloat16"):
+    """Context manager enabling AMP on ``program`` (default main program)
+    for the duration of the block."""
+    import contextlib
+
+    from paddle_tpu import framework
+
+    @contextlib.contextmanager
+    def guard():
+        prog = program or framework.default_main_program()
+        prev = prog._amp_dtype
+        rewrite_program_amp(prog, amp_dtype)
+        try:
+            yield prog
+        finally:
+            rewrite_program_amp(prog, prev)
+
+    return guard()

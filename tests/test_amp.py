@@ -1,0 +1,166 @@
+"""bf16 mixed-precision (AMP) rewrite + in-graph random reader tests.
+
+Covers the fp16-transpiler-equivalent capability
+(paddle/contrib/float16/float16_transpiler.py) redesigned for bf16
+training, and the synthetic reader op
+(operators/reader/create_random_data_generator_op.cc capability).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.amp import apply_amp_casts
+from paddle_tpu.transpiler import amp_guard, rewrite_program_amp
+
+
+def _mlp_program(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        logits = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+class TestAmpCasts:
+    def test_white_op_casts_f32_down(self):
+        ins = {"X": [jnp.ones((2, 3), jnp.float32)],
+               "Y": [jnp.ones((3, 4), jnp.float32)]}
+        out = apply_amp_casts("mul", ins, "bfloat16")
+        assert out["X"][0].dtype == jnp.bfloat16
+        assert out["Y"][0].dtype == jnp.bfloat16
+
+    def test_grad_op_follows_forward_class(self):
+        ins = {"X": [jnp.ones((2, 3), jnp.float32)]}
+        out = apply_amp_casts("conv2d_grad", ins, "bfloat16")
+        assert out["X"][0].dtype == jnp.bfloat16
+
+    def test_black_op_casts_up(self):
+        ins = {"X": [jnp.ones((2, 3), jnp.bfloat16)]}
+        out = apply_amp_casts("mean", ins, "bfloat16")
+        assert out["X"][0].dtype == jnp.float32
+
+    def test_neutral_op_untouched(self):
+        ins = {"X": [jnp.ones((2, 3), jnp.bfloat16)]}
+        out = apply_amp_casts("relu", ins, "bfloat16")
+        assert out["X"][0].dtype == jnp.bfloat16
+
+    def test_int_inputs_never_cast(self):
+        ins = {"Label": [jnp.ones((2, 1), jnp.int32)]}
+        out = apply_amp_casts("cross_entropy", ins, "bfloat16")
+        assert out["Label"][0].dtype == jnp.int32
+
+
+class TestAmpTraining:
+    def test_amp_training_converges_and_masters_stay_f32(self):
+        main, startup, loss = _mlp_program()
+        rewrite_program_amp(main, "bfloat16")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 16).astype(np.float32)
+        y = rng.randint(0, 4, (32, 1)).astype(np.int64)
+        losses = []
+        for _ in range(30):
+            lv, = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+        assert losses[-1] < losses[0] * 0.8, losses[::10]
+        for name in fluid.global_scope().local_var_names():
+            if name.endswith(".w_0") or name.endswith(".b_0"):
+                assert fluid.global_scope().get_value(name).dtype == \
+                    jnp.float32, name
+
+    def test_amp_matches_f32_loss_roughly(self):
+        results = {}
+        for amp in (False, True):
+            main, startup, loss = _mlp_program(seed=11)
+            if amp:
+                rewrite_program_amp(main, "bfloat16")
+            from paddle_tpu.core.scope import Scope
+
+            exe = fluid.Executor(fluid.CPUPlace())
+            with fluid.scope_guard(Scope()):
+                exe.run(startup)
+                rng = np.random.RandomState(1)
+                x = rng.rand(16, 16).astype(np.float32)
+                y = rng.randint(0, 4, (16, 1)).astype(np.int64)
+                for _ in range(5):
+                    lv, = exe.run(
+                        main, feed={"x": x, "y": y}, fetch_list=[loss]
+                    )
+                results[amp] = float(np.ravel(lv)[0])
+        # bf16 has ~3 decimal digits; trajectories stay close over 5 steps.
+        assert abs(results[True] - results[False]) < 0.05, results
+
+    def test_amp_guard_restores(self):
+        main, _, _ = _mlp_program()
+        assert main._amp_dtype is None
+        with amp_guard(main, "bfloat16"):
+            assert main._amp_dtype == "bfloat16"
+        assert main._amp_dtype is None
+
+    def test_rejects_bad_dtype(self):
+        main, _, _ = _mlp_program()
+        with pytest.raises(ValueError):
+            rewrite_program_amp(main, "int8")
+
+
+class TestRandomDataGenerator:
+    def test_shapes_dtypes_and_freshness(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            pixel, label = fluid.layers.random_data_generator(
+                shapes=[[4, 3, 8, 8], [4, 1]],
+                dtypes=["float32", "int64"],
+                int_high=9,
+            )
+            psum = fluid.layers.reduce_sum(pixel)
+        exe = fluid.Executor(fluid.CPUPlace())
+        a1, l1, s1 = exe.run(main, fetch_list=[pixel, label, psum])
+        a2, l2, s2 = exe.run(main, fetch_list=[pixel, label, psum])
+        assert a1.shape == (4, 3, 8, 8) and l1.shape == (4, 1)
+        assert np.issubdtype(l1.dtype, np.integer)
+        assert l1.min() >= 0 and l1.max() <= 9
+        assert a1.min() >= 0.0 and a1.max() < 1.0
+        # fresh draw every step
+        assert float(s1) != float(s2)
+
+    def test_rejects_dynamic_shape(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            with pytest.raises(ValueError):
+                fluid.layers.random_data_generator(
+                    shapes=[[-1, 3]], dtypes=["float32"]
+                )
+
+    def test_trains_resnet_block_no_feed(self):
+        from paddle_tpu.models import resnet
+
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            pixel, label = fluid.layers.random_data_generator(
+                shapes=[[4, 3, 16, 16], [4, 1]],
+                dtypes=["float32", "int64"],
+                int_high=9,
+            )
+            pred = resnet.resnet_cifar10(pixel, 10, depth=8)
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred, label=label)
+            )
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(3):
+            lv, = exe.run(main, feed={}, fetch_list=[loss])
+        assert np.isfinite(float(np.ravel(lv)[0]))
