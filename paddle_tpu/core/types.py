@@ -27,6 +27,12 @@ class Place(object):
             # the accelerator.
             try:
                 devs = jax.devices(self._kind)
+                # Under jax.distributed, jax.devices() is the GLOBAL list;
+                # an Executor place must be a device this process owns.
+                local = [
+                    d for d in devs if d.process_index == jax.process_index()
+                ]
+                devs = local or devs
                 return devs[self.device_id % len(devs)]
             except RuntimeError:
                 pass  # platform not present; fall through to default
@@ -53,7 +59,7 @@ class TPUPlace(Place):
     def jax_device(self):
         import jax
 
-        devices = jax.devices()
+        devices = jax.local_devices()
         non_cpu = [d for d in devices if d.platform.lower() != "cpu"]
         pool = non_cpu if non_cpu else devices
         return pool[self.device_id % len(pool)]

@@ -5,7 +5,7 @@ draw_block_graphviz) and ``framework/ir/graph_viz_pass.cc`` (dot output of
 the op graph).
 """
 
-__all__ = ["program_to_code", "draw_block_graphviz"]
+__all__ = ["program_to_code", "draw_block_graphviz", "dump_sharding_plan"]
 
 
 def _fmt_var(v):
@@ -95,3 +95,16 @@ def draw_block_graphviz(block, highlights=None, path="/tmp/program.dot"):
     with open(path, "w") as f:
         f.write(dot)
     return dot
+
+
+def dump_sharding_plan(policy, file=None):
+    """Print a ShardingPolicy's var->PartitionSpec plan (parallel/mesh.py),
+    flagging vars that fell back to replication ("no silent caps")."""
+    import sys
+
+    out = file or sys.stdout
+    print("sharding plan (mesh=%s, strategy=%s):"
+          % (dict(policy.mesh.shape), policy.strategy), file=out)
+    for name, (spec, note) in policy.plan().items():
+        print("  %-40s %s%s" % (name, spec, "  [" + note + "]" if note
+                                else ""), file=out)

@@ -14,10 +14,14 @@ Reference-capability map:
   - gen_nccl_id multi-host bootstrap -> jax.distributed.initialize.
 """
 
+import logging
+
 import numpy as np
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger("paddle_tpu.parallel")
 
 
 class MeshConfig(object):
@@ -80,6 +84,24 @@ class ShardingPolicy(object):
         self.model_sharded_vars = set(model_sharded_vars or ())
         self.feed_batch_axis = feed_batch_axis
         self.overrides = dict(overrides or {})
+        self._logged = set()
+
+    def _note_fallback(self, name, reason):
+        """No silent caps: every var that degrades to full replication when a
+        sharded layout was plausible is logged once, and tagged in plan()."""
+        if name not in self._logged:
+            self._logged.add(name)
+            logger.info("sharding fallback: %s -> replicated (%s)", name,
+                        reason)
+
+    def plan(self):
+        """name -> (spec, note) for every known state var (observability)."""
+        out = {}
+        for name in sorted(self.state_shapes):
+            s = self.state_sharding(name)
+            out[name] = (str(s.spec), "fallback" if name in self._logged
+                         else "")
+        return out
 
     def replicated(self):
         return NamedSharding(self.mesh, P())
@@ -91,11 +113,16 @@ class ShardingPolicy(object):
         if name in self.overrides:
             return self._spec_to_sharding(self.overrides[name])
         shape = self.state_shapes.get(name)
+        missed = []  # why each plausible sharded layout was not taken
         if name in self.model_sharded_vars and shape:
             msize = self.mesh.shape.get("model", 1)
             if msize > 1 and shape[0] % msize == 0:
                 return self._spec_to_sharding(
                     P("model", *([None] * (len(shape) - 1)))
+                )
+            if msize > 1:
+                missed.append(
+                    "model axis %d does not divide dim0 of %s" % (msize, shape)
                 )
         if self.strategy == "reduce" and shape:
             dsize = self.mesh.shape.get("data", 1)
@@ -105,6 +132,15 @@ class ShardingPolicy(object):
                 return self._spec_to_sharding(
                     P("data", *([None] * (len(shape) - 1)))
                 )
+            if len(shape) >= 1 and dsize > 1:
+                missed.append(
+                    "dim0 of %s not divisible by data axis %d"
+                    % (shape, dsize)
+                    if shape[0] % dsize
+                    else "numel %d < 1024 threshold" % int(np.prod(shape))
+                )
+        if missed:
+            self._note_fallback(name, "; ".join(missed))
         return self.replicated()
 
     def feed_sharding(self, name):

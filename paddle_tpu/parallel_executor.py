@@ -87,6 +87,26 @@ class ParallelExecutor(object):
         self._run_counter = 0
         self._base_seed = np.random.randint(0, 2**31 - 1)
 
+        # Multi-trainer (NCCL2-mode parity): each trainer is one
+        # jax.distributed process; the mesh spans the GLOBAL device list and
+        # XLA's collectives cross hosts the way gen_nccl_id-bootstrapped
+        # ncclAllReduce did (gen_nccl_id_op.cc:31, nccl_helper.h:103-120).
+        self._num_trainers = int(num_trainers)
+        self._trainer_id = int(trainer_id)
+        if self._num_trainers > 1:
+            if jax.process_count() != self._num_trainers:
+                raise RuntimeError(
+                    "num_trainers=%d but jax.process_count()=%d — call "
+                    "paddle_tpu.parallel.init_distributed(coordinator, "
+                    "num_processes, process_id) before ParallelExecutor"
+                    % (self._num_trainers, jax.process_count())
+                )
+            if jax.process_index() != self._trainer_id:
+                raise RuntimeError(
+                    "trainer_id=%d does not match jax.process_index()=%d"
+                    % (self._trainer_id, jax.process_index())
+                )
+
         devices = jax.devices()
         non_cpu = [d for d in devices if d.platform != "cpu"]
         pool = non_cpu if (use_tpu and non_cpu) else devices
@@ -124,11 +144,7 @@ class ParallelExecutor(object):
         )
         cp = self._cache.get(key)
         if cp is None:
-            state_shapes = {}
-            for n in scope_names:
-                v = self._scope.get_value(n)
-                if v is not None and hasattr(v, "shape"):
-                    state_shapes[n] = tuple(v.shape)
+            state_shapes = self._collect_state_shapes()
             cp = CompiledProgram(
                 self._program,
                 feed_specs,
@@ -159,6 +175,15 @@ class ParallelExecutor(object):
                 if isinstance(value, LoDTensor)
                 else np.asarray(value)
             )
+            if self._num_trainers > 1:
+                # Each trainer feeds its LOCAL batch shard; assemble the
+                # global array (this is the FeedAndSplitTensorIntoLocalScopes
+                # role, parallel_executor.cc:286, inverted: shards in,
+                # global view out).
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                sh = NamedSharding(self.mesh, P("data"))
+                arr = jax.make_array_from_process_local_data(sh, arr)
             feeds[name] = arr
             feed_specs[name] = (tuple(arr.shape), str(arr.dtype))
 
@@ -181,13 +206,7 @@ class ParallelExecutor(object):
             # the mesh sharding, so reshard explicitly (BCastParamsToDevices
             # role, parallel_executor.cc:180).
             if isinstance(val, jax.Array):
-                target = cp.shardings.state_sharding(n)
-                try:
-                    ok = val.sharding.is_equivalent_to(target, val.ndim)
-                except Exception:
-                    ok = False
-                if not ok:
-                    val = jax.device_put(val, target)
+                val = self._ensure_sharded(val, cp.shardings.state_sharding(n))
             state[n] = val
 
         self._run_counter += 1
@@ -199,13 +218,68 @@ class ParallelExecutor(object):
         for n, val in new_state.items():
             self._scope.set_value(n, val)
         if return_numpy:
-            fetches = [np.asarray(f) for f in fetches]
+            fetches = [self._fetch_to_numpy(f) for f in fetches]
         return fetches
 
-    def bcast_params(self):
-        """BCastParamsToDevices parity — under GSPMD state is already
-        mesh-placed by the first compiled run; kept as an explicit resharper."""
+    def _ensure_sharded(self, val, target):
+        """Reshard ``val`` to ``target`` if it is not already equivalent."""
+        try:
+            if val.sharding.is_equivalent_to(target, val.ndim):
+                return val
+        except Exception:
+            pass
+        if (
+            self._num_trainers > 1
+            and not getattr(target, "is_fully_addressable", True)
+            and getattr(val, "is_fully_addressable", True)
+        ):
+            # Host value exists (identically, thanks to seeded startup) in
+            # every trainer: each process materializes its own shards.
+            host = np.asarray(val)
+            return jax.make_array_from_callback(
+                host.shape, target, lambda idx: host[idx]
+            )
+        # Already-global arrays reshard device-side (XLA collectives).
+        return jax.device_put(val, target)
+
+    def _fetch_to_numpy(self, f):
+        """Fetched global arrays: fully-addressable values read directly;
+        otherwise stitch this process's addressable shards (the reference
+        likewise fetches trainer-local values in NCCL2 mode)."""
+        if not (isinstance(f, jax.Array) and not f.is_fully_addressable):
+            return np.asarray(f)
+        shards = {}
+        for s in f.addressable_shards:
+            key = tuple(
+                (sl.start or 0, sl.stop) for sl in s.index
+            )
+            shards.setdefault(key, np.asarray(s.data))
+        if len(shards) == 1:
+            return next(iter(shards.values()))
+        keys = sorted(shards)
+        axis = next(
+            i for i in range(len(keys[0]))
+            if len({k[i] for k in keys}) > 1
+        )
+        ordered = [shards[k] for k in sorted(shards, key=lambda k: k[axis])]
+        return np.concatenate(ordered, axis=axis)
+
+    def _collect_state_shapes(self):
+        state_shapes = {}
         for n in self._scope.local_var_names():
             v = self._scope.get_value(n)
-            if v is not None and isinstance(v, jax.Array):
-                pass  # placement is handled by jit in_shardings
+            if v is not None and hasattr(v, "shape"):
+                state_shapes[n] = tuple(v.shape)
+        return state_shapes
+
+    def bcast_params(self):
+        """BCastParamsToDevices parity (parallel_executor.cc:180): eagerly
+        reshard every initialized scope var onto the mesh per the current
+        ShardingPolicy (jit would otherwise do this lazily on first run)."""
+        policy = self._policy(self._collect_state_shapes())
+        for n in sorted(policy.state_shapes):
+            v = self._scope.get_value(n)
+            if isinstance(v, jax.Array):
+                self._scope.set_value(
+                    n, self._ensure_sharded(v, policy.state_sharding(n))
+                )

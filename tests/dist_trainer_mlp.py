@@ -1,0 +1,104 @@
+"""Multi-process data-parallel trainer (test_dist_base.py model-file
+pattern, e.g. /root/reference/python/paddle/fluid/tests/unittests/
+dist_mnist.py): run the same MLP either single-process (the parity
+reference) or as one of N jax.distributed trainer processes.
+
+As a script (spawned by test_dist_multiproc.py), env carries the cluster
+config — PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_COORDINATOR,
+DIST_OUT_FILE — mirroring the reference's PADDLE_* env cluster surface.
+"""
+
+import json
+import os
+import sys
+
+GLOBAL_BATCH = 16
+STEPS = 5
+SEED = 23
+
+
+def build_model(fluid):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = SEED
+    startup.random_seed = SEED
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[12], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=24, act="relu")
+        logits = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    return main, startup, loss
+
+
+def global_batch(step):
+    import numpy as np
+
+    rng = np.random.RandomState(100 + step)
+    return (
+        rng.rand(GLOBAL_BATCH, 12).astype(np.float32),
+        rng.randint(0, 4, (GLOBAL_BATCH, 1)).astype(np.int64),
+    )
+
+
+def run_trainer(num_trainers, trainer_id, reduce_strategy="all_reduce"):
+    """Train STEPS steps; returns the per-step loss list. In multi-trainer
+    mode feeds only this trainer's batch shard."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel_executor import BuildStrategy, ParallelExecutor
+
+    main, startup, loss = build_model(fluid)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    bs = BuildStrategy()
+    if reduce_strategy == "reduce":
+        bs.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
+    pe = ParallelExecutor(
+        loss_name=loss.name,
+        main_program=main,
+        build_strategy=bs,
+        use_tpu=False,
+        num_trainers=num_trainers,
+        trainer_id=trainer_id,
+    )
+    shard = GLOBAL_BATCH // num_trainers
+    lo, hi = trainer_id * shard, (trainer_id + 1) * shard
+    losses = []
+    for step in range(STEPS):
+        xs, ys = global_batch(step)
+        lv, = pe.run(fetch_list=[loss], feed={"x": xs[lo:hi], "y": ys[lo:hi]})
+        losses.append(float(np.ravel(lv)[0]))
+    return losses
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nprocs = int(os.environ["PADDLE_TRAINERS_NUM"])
+    coord = os.environ["PADDLE_COORDINATOR"]
+    out_file = os.environ["DIST_OUT_FILE"]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=%d" % (
+        8 // nprocs
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.parallel.mesh import init_distributed
+
+    init_distributed(
+        coordinator_address=coord, num_processes=nprocs, process_id=rank
+    )
+    losses = run_trainer(nprocs, rank,
+                         os.environ.get("DIST_REDUCE", "all_reduce"))
+    with open(out_file, "w") as f:
+        json.dump({"rank": rank, "losses": losses}, f)
+    print("trainer %d done: %s" % (rank, losses), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
