@@ -93,7 +93,13 @@ class BlockLowerer(object):
             names = op.input(slot)
             if names:
                 try:
-                    ins[slot] = [env[n] for n in _valid(names)]
+                    if slot.endswith("@GRAD"):
+                        # Grad slots keep positional alignment with their
+                        # forward outputs: a hole (no incoming grad for that
+                        # output) is None, not dropped.
+                        ins[slot] = [env[n] if n else None for n in names]
+                    else:
+                        ins[slot] = [env[n] for n in _valid(names)]
                 except KeyError as e:
                     raise RuntimeError(
                         "op %s reads uninitialized variable %s "
@@ -229,7 +235,10 @@ class CompiledProgram(object):
                      for n in self.mutable_state}
             frz_s = {n: shardings.state_sharding(n)
                      for n in self.frozen_state}
-            feed_s = {n: shardings.feed_sharding(n) for n in feed_specs}
+            feed_s = {
+                n: shardings.feed_sharding(n, shape=feed_specs[n][0])
+                for n in feed_specs
+            }
             state_out_s = {n: shardings.state_sharding(n) for n in self.state_out}
             self.jitted = jax.jit(
                 split_step,

@@ -188,14 +188,20 @@ def lower_grad_via_vjp(fwd_def, ctx, ins, attrs, out_grads, wanted_input_grads):
     """
     import numpy as np
 
+    def _is_inexact_array(a):
+        # Composite values (tensor arrays = (buffer, size) tuples) are not
+        # differentiable leaves themselves.
+        try:
+            return jnp.issubdtype(jnp.result_type(a), jnp.inexact)
+        except TypeError:
+            return False
+
     # Differentiable leaves: wanted AND inexact-dtyped.
     diff_index = []  # (slot, i)
     for slot, arrs in ins.items():
         wants = wanted_input_grads.get(slot, [False] * len(arrs))
         for i, a in enumerate(arrs):
-            if i < len(wants) and wants[i] and jnp.issubdtype(
-                jnp.result_type(a), jnp.inexact
-            ):
+            if i < len(wants) and wants[i] and _is_inexact_array(a):
                 diff_index.append((slot, i))
 
     if not diff_index:
@@ -222,13 +228,27 @@ def lower_grad_via_vjp(fwd_def, ctx, ins, attrs, out_grads, wanted_input_grads):
     primals = tuple(ins[slot][i] for slot, i in diff_index)
     out_tree, vjp_fn = jax.vjp(fwd_fn, *primals)
 
+    def _zero_cot(ref):
+        # Composite refs (tensor arrays): zero cotangent per leaf.
+        def per_leaf(r):
+            rd = jnp.result_type(r)
+            if jnp.issubdtype(rd, jnp.inexact):
+                return jnp.zeros(jnp.shape(r), rd)
+            return np.zeros(jnp.shape(r), jax.dtypes.float0)
+
+        return jax.tree.map(per_leaf, ref)
+
     # Cotangent pytree mirroring out_tree's structure.
     cot = {}
     for oslot, refs in out_tree.items():
         gs = out_grads.get(oslot, [])
         slot_cot = []
         for j, ref in enumerate(refs):
-            rdtype = jnp.result_type(ref)
+            try:
+                rdtype = jnp.result_type(ref)
+            except TypeError:
+                slot_cot.append(_zero_cot(ref))
+                continue
             if not jnp.issubdtype(rdtype, jnp.inexact):
                 slot_cot.append(np.zeros(jnp.shape(ref), jax.dtypes.float0))
                 continue

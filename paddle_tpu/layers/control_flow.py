@@ -515,10 +515,25 @@ class While(object):
                 for n in _captured_names(sub_block, carry)
                 if n not in set(carry)
             ]
+            # InitX saves the pre-loop carry values under fresh names so
+            # while_grad can restart the loop (Out aliases X in-place).
+            from paddle_tpu import unique_name
+
+            init_names = []
+            for n in carry:
+                v = parent_block._find_var_recursive(n)
+                iname = unique_name.generate(n + "__while_init")
+                parent_block.create_var(
+                    name=iname,
+                    shape=None if v is None else v.shape,
+                    dtype="float32" if v is None else v.dtype,
+                    stop_gradient=True,
+                )
+                init_names.append(iname)
             parent_block.append_op(
                 type="while",
                 inputs={"X": carry, "parameters": params},
-                outputs={"Out": carry},
+                outputs={"Out": carry, "InitX": init_names},
                 attrs={
                     "sub_block": sub_block.idx,
                     "carry_names": carry,
@@ -527,6 +542,25 @@ class While(object):
                     "max_iterations": int(self.max_iterations),
                 },
             )
+            # Float carries are (re)defined by the loop body, so gradients
+            # must flow through them even though constant initializers
+            # (fill_constant & co) mark their outputs stop_gradient —
+            # otherwise a loss downstream of the loop never reaches
+            # while_grad. A user's explicit stop_gradient on a non-constant
+            # carry (detached EMA etc.) is respected.
+            from paddle_tpu.core.types import is_float_dtype
+
+            _const_producers = {"fill_constant", "fill_zeros_like",
+                                "fill_constant_batch_size_like", "assign_value"}
+            for n in carry:
+                v = parent_block._find_var_recursive(n)
+                if (
+                    v is not None
+                    and is_float_dtype(v.dtype)
+                    and v.op is not None
+                    and v.op.type in _const_producers
+                ):
+                    v.stop_gradient = False
 
 
 # ---------------------------------------------------------------------------

@@ -150,9 +150,19 @@ def _lower_while(ctx, ins, attrs):
     """Carried state = the declared carry vars (attr carry_names), which the
     sub-block reads and writes; Condition is one of them (a [1] bool).
 
-    Reverse-mode autodiff of an unbounded while is impossible under XLA;
-    training-time recurrences use ``recurrent``/DynamicRNN (scan). This op
-    serves inference-time decode loops (beam search etc.).
+    Two lowerings (SURVEY §7 hard part (g), while_op.cc:50-72 StepScopes
+    backward redesigned graph-level):
+
+    - ``max_iterations > 0``: a masked ``lax.scan`` over the static bound —
+      iterations past loop exit are no-ops via jnp.where select, so the
+      result is identical to the dynamic loop AND reverse-mode autodiff
+      works (the synthesized ``while_grad`` re-traces this rule under
+      jax.vjp; scan stores per-iteration residuals instead of the
+      reference's StepScopes).
+    - ``max_iterations == 0``: a ``lax.while_loop`` — cheapest for
+      inference decode loops with early exit, but forward-only (XLA cannot
+      reverse-differentiate an unbounded loop; set max_iterations to train
+      through a While).
     """
     carry_names = list(attrs.get("carry_names", []))
     param_names = list(attrs.get("param_names", []))
@@ -164,13 +174,27 @@ def _lower_while(ctx, ins, attrs):
 
     max_iters = attrs.get("max_iterations", 0)
 
+    if max_iters:
+        def step(vals, t):
+            env = dict(zip(param_names, params))
+            env.update(zip(carry_names, vals))
+            active = jnp.reshape(env[cond_name], ()).astype(bool)
+            _run_block(sub, env, jax.random.fold_in(base_key, t))
+            new_vals = tuple(env[n] for n in carry_names)
+            sel = jax.tree.map(
+                lambda a, b: jnp.where(active, a, b), new_vals, tuple(vals)
+            )
+            return sel, None
+
+        final, _ = jax.lax.scan(
+            step, tuple(carries), jnp.arange(max_iters, dtype=jnp.int32)
+        )
+        return {"Out": list(final), "InitX": list(carries)}
+
     def cond_fn(state):
         t, vals = state
         env = dict(zip(carry_names, vals))
-        ok = jnp.reshape(env[cond_name], ()).astype(bool)
-        if max_iters:
-            ok = jnp.logical_and(ok, t < max_iters)
-        return ok
+        return jnp.reshape(env[cond_name], ()).astype(bool)
 
     def body_fn(state):
         t, vals = state
@@ -182,13 +206,72 @@ def _lower_while(ctx, ins, attrs):
     _, final = jax.lax.while_loop(
         cond_fn, body_fn, (jnp.asarray(0, jnp.int32), tuple(carries))
     )
-    return {"Out": list(final)}
+    return {"Out": list(final), "InitX": list(carries)}
+
+
+def _while_grad_maker(op, out_grads, wanted):
+    """while's Out aliases X (in-place carries), so by grad time the env
+    holds POST-loop values under those names; the InitX outputs saved the
+    pre-loop carries under fresh names (graph-level StepScopes,
+    while_op.cc:50-72), and while_grad re-runs the bounded scan from them
+    under jax.vjp."""
+    inputs = {
+        "InitX": list(op.output("InitX")),
+        "parameters": list(op.input("parameters")),
+        "Out@GRAD": [g or "" for g in out_grads.get("Out", [])],
+    }
+    outputs = {}
+    if "X" in wanted:
+        outputs["X@GRAD"] = wanted["X"]
+    if "parameters" in wanted:
+        outputs["parameters@GRAD"] = wanted["parameters"]
+    keep = ("sub_block", "carry_names", "param_names", "cond_name",
+            "max_iterations")
+    return [{
+        "type": "while_grad",
+        "inputs": inputs,
+        "outputs": outputs,
+        "attrs": {k: op.attrs[k] for k in keep if k in op.attrs},
+    }]
+
+
+def _lower_while_grad(ctx, ins, attrs):
+    from paddle_tpu.core.op_registry import get_op_def, lower_grad_via_vjp
+
+    if not attrs.get("max_iterations", 0):
+        raise RuntimeError(
+            "cannot differentiate a While with max_iterations=0: the "
+            "unbounded lax.while_loop lowering is forward-only. Build the "
+            "loop as fluid.layers.While(cond, max_iterations=N) to train "
+            "through it (bounded masked-scan lowering)."
+        )
+    op = ctx.op
+    init = ins.get("InitX", [])
+    params = ins.get("parameters", [])
+    out_gs = ins.get("Out@GRAD", [])
+    wanted = {}
+    xg = op.output("X@GRAD")
+    pg = op.output("parameters@GRAD")
+    if any(xg):
+        wanted["X"] = [bool(n) for n in xg]
+    if any(pg):
+        wanted["parameters"] = [bool(n) for n in pg]
+    gres = lower_grad_via_vjp(
+        get_op_def("while"), ctx, {"X": init, "parameters": params}, attrs,
+        {"Out": out_gs}, wanted,
+    )
+    out = {}
+    if "X" in gres:
+        out["X@GRAD"] = gres["X"]
+    if "parameters" in gres:
+        out["parameters@GRAD"] = gres["parameters"]
+    return out
 
 
 register_op(
     "while",
     inputs=["*X", "*parameters"],
-    outputs=["*Out"],
+    outputs=["*Out", "*InitX"],
     attrs={
         "sub_block": -1,
         "carry_names": [],
@@ -197,6 +280,23 @@ register_op(
         "max_iterations": 0,
     },
     lower=_lower_while,
+    grad=_while_grad_maker,
+    intermediate_outputs=("InitX",),
+)
+
+
+register_op(
+    "while_grad",
+    inputs=["*InitX", "*parameters", "*Out@GRAD"],
+    outputs=["*X@GRAD", "*parameters@GRAD"],
+    attrs={
+        "sub_block": -1,
+        "carry_names": [],
+        "param_names": [],
+        "cond_name": "",
+        "max_iterations": 0,
+    },
+    lower=_lower_while_grad,
     grad=None,
 )
 

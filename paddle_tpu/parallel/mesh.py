@@ -143,7 +143,17 @@ class ShardingPolicy(object):
             self._note_fallback(name, "; ".join(missed))
         return self.replicated()
 
-    def feed_sharding(self, name):
+    def feed_sharding(self, name, shape=None):
         if name in self.overrides:
             return self._spec_to_sharding(self.overrides[name])
+        if shape is not None:
+            dsize = self.mesh.shape.get("data", 1)
+            if len(shape) == 0 or (dsize > 1 and shape[0] % dsize != 0):
+                # Scalar / non-batch feed (fed LR, margin...): replicate.
+                self._note_fallback(
+                    name,
+                    "feed shape %s not batch-shardable over data axis %d"
+                    % (tuple(shape), dsize),
+                )
+                return self.replicated()
         return self._spec_to_sharding(P("data"))

@@ -106,6 +106,15 @@ class ParallelExecutor(object):
                     "trainer_id=%d does not match jax.process_index()=%d"
                     % (self._trainer_id, jax.process_index())
                 )
+            # All trainers must agree on the step-PRNG base seed when the
+            # program has none (dropout/random ops would diverge).
+            from jax.experimental import multihost_utils
+
+            self._base_seed = int(
+                multihost_utils.broadcast_one_to_all(
+                    np.int64(self._base_seed)
+                )
+            )
 
         devices = jax.devices()
         non_cpu = [d for d in devices if d.platform != "cpu"]
@@ -179,11 +188,22 @@ class ParallelExecutor(object):
                 # Each trainer feeds its LOCAL batch shard; assemble the
                 # global array (this is the FeedAndSplitTensorIntoLocalScopes
                 # role, parallel_executor.cc:286, inverted: shards in,
-                # global view out).
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                sh = NamedSharding(self.mesh, P("data"))
-                arr = jax.make_array_from_process_local_data(sh, arr)
+                # global view out). Non-batch feeds replicate (each trainer
+                # must pass the full value) per the policy's shape check —
+                # the global dim0 for sharded feeds is num_trainers * local.
+                policy = self._policy(self._collect_state_shapes())
+                gshape = list(arr.shape)
+                if gshape:
+                    gshape[0] *= self._num_trainers
+                sh = policy.feed_sharding(name, shape=tuple(gshape))
+                if sh.is_fully_replicated:
+                    # every trainer passes the identical full value
+                    host = arr
+                    arr = jax.make_array_from_callback(
+                        host.shape, sh, lambda idx: host[idx]
+                    )
+                else:
+                    arr = jax.make_array_from_process_local_data(sh, arr)
             feeds[name] = arr
             feed_specs[name] = (tuple(arr.shape), str(arr.dtype))
 
@@ -233,9 +253,14 @@ class ParallelExecutor(object):
             and not getattr(target, "is_fully_addressable", True)
             and getattr(val, "is_fully_addressable", True)
         ):
-            # Host value exists (identically, thanks to seeded startup) in
-            # every trainer: each process materializes its own shards.
-            host = np.asarray(val)
+            # First mesh placement of locally-initialized state: broadcast
+            # rank 0's value so every trainer materializes shards of the
+            # SAME array even when startup init was unseeded — the actual
+            # BCastParamsToDevices (parallel_executor.cc:180).
+            from jax.experimental import multihost_utils
+
+            host = multihost_utils.broadcast_one_to_all(np.asarray(val))
+            host = np.asarray(host)
             return jax.make_array_from_callback(
                 host.shape, target, lambda idx: host[idx]
             )
