@@ -1,0 +1,71 @@
+"""CIFAR-10/100 readers (python/paddle/dataset/cifar.py parity):
+train10()/test10()/train100()/test100() yield (image float32[3072] in
+[0, 1], label int). Real data parses the python-pickle tarballs; offline,
+class-tinted noise images (learnable by a convnet)."""
+
+import pickle
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+URL_PREFIX = "https://dataset.bj.bcebos.com/cifar/"
+CIFAR10 = ("cifar-10-python.tar.gz", "c58f30108f718f92721af3b95e74349a")
+CIFAR100 = ("cifar-100-python.tar.gz", "eb9058c3a382ffc7106e4002c42a8d85")
+
+_SYN_TRAIN, _SYN_TEST = 1024, 256
+
+
+def _tar_reader(path, sub_name, label_key):
+    with tarfile.open(path, "r:gz") as tf:
+        for member in tf.getmembers():
+            if sub_name not in member.name:
+                continue
+            batch = pickle.load(tf.extractfile(member), encoding="latin1")
+            data = batch["data"].astype(np.float32) / 255.0
+            for img, lbl in zip(data, batch[label_key]):
+                yield img, int(lbl)
+
+
+def _synthetic(n, classes, seed):
+    common.note_synthetic("cifar")
+    rng = np.random.RandomState(seed)
+    tints = np.random.RandomState(77).rand(classes, 3).astype(np.float32)
+    for _ in range(n):
+        lbl = int(rng.randint(0, classes))
+        img = rng.rand(3, 32 * 32).astype(np.float32) * 0.4
+        img += tints[lbl][:, None] * 0.6
+        yield img.reshape(-1), lbl
+
+
+def _reader(spec, sub_name, label_key, classes, syn_n, seed):
+    def reader():
+        path = common.try_download(URL_PREFIX + spec[0], "cifar", spec[1])
+        if path is None:
+            yield from _synthetic(syn_n, classes, seed)
+        else:
+            yield from _tar_reader(path, sub_name, label_key)
+
+    return reader
+
+
+def train10():
+    return _reader(CIFAR10, "data_batch", "labels", 10, _SYN_TRAIN, 11)
+
+
+def test10():
+    return _reader(CIFAR10, "test_batch", "labels", 10, _SYN_TEST, 12)
+
+
+def train100():
+    return _reader(CIFAR100, "train", "fine_labels", 100, _SYN_TRAIN, 13)
+
+
+def test100():
+    return _reader(CIFAR100, "test", "fine_labels", 100, _SYN_TEST, 14)
+
+
+def fetch():
+    common.try_download(URL_PREFIX + CIFAR10[0], "cifar", CIFAR10[1])
+    common.try_download(URL_PREFIX + CIFAR100[0], "cifar", CIFAR100[1])

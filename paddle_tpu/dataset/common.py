@@ -1,0 +1,122 @@
+"""Dataset plumbing: cache dir, checksummed download, offline fallback.
+
+Reference parity: python/paddle/dataset/common.py (DATA_HOME, download with
+md5 verification and retries, md5file). TPU-rebuild difference: every
+dataset in this package must also work with zero network egress — when a
+download fails (or ``PADDLE_TPU_DATASET=synthetic`` forces it), the caller
+falls back to a deterministic, *learnable* synthetic sample stream so the
+book-style convergence tests still exercise real training dynamics. The
+fallback is loud (one warning per dataset) and never silently replaces an
+already-cached real file.
+
+Env knobs:
+  PADDLE_TPU_DATASET=auto   (default) real data if cached/downloadable,
+                            else synthetic with a warning
+  PADDLE_TPU_DATASET=real   never fall back (raise on download failure)
+  PADDLE_TPU_DATASET=synthetic  never touch the network
+"""
+
+import hashlib
+import logging
+import os
+import shutil
+
+logger = logging.getLogger("paddle_tpu.dataset")
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset")
+)
+
+
+def _mode():
+    m = os.environ.get("PADDLE_TPU_DATASET", "auto").lower()
+    if m not in ("auto", "real", "synthetic"):
+        raise ValueError("PADDLE_TPU_DATASET must be auto/real/synthetic")
+    return m
+
+
+def must_download():
+    return _mode() == "real"
+
+
+def synthetic_only():
+    return _mode() == "synthetic"
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def cached_path(module_name, filename):
+    return os.path.join(DATA_HOME, module_name, filename)
+
+
+def download(url, module_name, md5sum=None, save_name=None, retries=3):
+    """Fetch ``url`` into DATA_HOME/module_name, verifying md5 when given.
+    Returns the local path; raises on failure (callers decide whether to
+    fall back to synthetic data via ``try_download``)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    os.makedirs(dirname, exist_ok=True)
+    filename = os.path.join(dirname, save_name or url.split("/")[-1])
+    if os.path.exists(filename) and (
+        md5sum is None or md5file(filename) == md5sum
+    ):
+        return filename
+
+    import urllib.request
+
+    last_err = None
+    for attempt in range(retries):
+        try:
+            tmp = filename + ".part"
+            with urllib.request.urlopen(url, timeout=30) as resp, open(
+                tmp, "wb"
+            ) as out:
+                shutil.copyfileobj(resp, out)
+            if md5sum is not None and md5file(tmp) != md5sum:
+                raise IOError("md5 mismatch for %s" % url)
+            os.replace(tmp, filename)
+            return filename
+        except Exception as e:  # noqa: BLE001 - network errors vary widely
+            last_err = e
+            logger.info("download attempt %d/%d for %s failed: %s",
+                        attempt + 1, retries, url, e)
+    raise IOError("could not download %s: %s" % (url, last_err))
+
+
+def try_download(url, module_name, md5sum=None, save_name=None):
+    """Download unless synthetic-only; returns local path or None (meaning:
+    use the dataset's synthetic fallback)."""
+    if synthetic_only():
+        return None
+    try:
+        return download(url, module_name, md5sum, save_name)
+    except Exception as e:  # noqa: BLE001
+        if must_download():
+            raise
+        _warn_synthetic(module_name, e)
+        return None
+
+
+_warned = set()
+
+
+def _warn_synthetic(module_name, reason):
+    if module_name not in _warned:
+        _warned.add(module_name)
+        logger.warning(
+            "dataset %r: falling back to deterministic SYNTHETIC data "
+            "(%s); set PADDLE_TPU_DATASET=real to require the download",
+            module_name, reason,
+        )
+
+
+def note_synthetic(module_name):
+    """Datasets call this when serving synthetic samples so the fallback is
+    visible even on the forced-synthetic path."""
+    _warn_synthetic(module_name, "PADDLE_TPU_DATASET=synthetic"
+                    if synthetic_only() else "download unavailable")

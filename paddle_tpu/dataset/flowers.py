@@ -1,0 +1,82 @@
+"""Oxford-102 flowers readers (python/paddle/dataset/flowers.py parity):
+train()/test()/valid() yield (image float32[3*H*W] in [0,1], label int).
+The real corpus ships JPEGs + .mat splits; offline, class-tinted noise
+images at the standard 3x224x224 crop."""
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+DATA_URL = "http://paddlemodels.bj.bcebos.com/flowers/102flowers.tgz"
+LABEL_URL = "http://paddlemodels.bj.bcebos.com/flowers/imagelabels.mat"
+SETID_URL = "http://paddlemodels.bj.bcebos.com/flowers/setid.mat"
+DATA_MD5 = "52808999861908f626f3c1f4e79d11fa"
+LABEL_MD5 = "e0620be6f572b9609742df49c70aed4d"
+SETID_MD5 = "a5357ecc9cb78c4bef273ce3793fc85c"
+
+CLASSES = 102
+_SYN = {"train": 512, "test": 128, "valid": 128}
+_SHAPE = (3, 224, 224)
+
+
+def _synthetic(split, seed):
+    common.note_synthetic("flowers")
+    rng = np.random.RandomState(seed)
+    tints = np.random.RandomState(88).rand(CLASSES, 3).astype(np.float32)
+    for _ in range(_SYN[split]):
+        lbl = int(rng.randint(0, CLASSES))
+        img = rng.rand(3, _SHAPE[1] * _SHAPE[2]).astype(np.float32) * 0.4
+        img += tints[lbl][:, None] * 0.6
+        yield img.reshape(-1), lbl
+
+
+def _reader(split, seed):
+    def reader():
+        data = common.try_download(DATA_URL, "flowers", DATA_MD5)
+        labels = common.try_download(LABEL_URL, "flowers", LABEL_MD5)
+        setid = common.try_download(SETID_URL, "flowers", SETID_MD5)
+        if data is None or labels is None or setid is None:
+            yield from _synthetic(split, seed)
+            return
+        # Real path requires scipy(.mat) + PIL decoding; both ship in this
+        # image's torch stack. Split ids per setid.mat: trnid/tstid/valid.
+        import tarfile
+
+        from scipy.io import loadmat  # noqa: WPS433 (optional heavy dep)
+
+        key = {"train": "trnid", "test": "tstid", "valid": "valid"}[split]
+        ids = set(int(i) for i in loadmat(setid)[key].ravel())
+        lbls = loadmat(labels)["labels"].ravel()
+        from PIL import Image
+
+        with tarfile.open(data, "r:gz") as tf:
+            for member in tf.getmembers():
+                if not member.name.endswith(".jpg"):
+                    continue
+                idx = int(member.name[-9:-4])
+                if idx not in ids:
+                    continue
+                im = Image.open(tf.extractfile(member)).convert("RGB")
+                im = im.resize((_SHAPE[2], _SHAPE[1]))
+                arr = np.asarray(im, np.float32).transpose(2, 0, 1) / 255.0
+                yield arr.reshape(-1), int(lbls[idx - 1]) - 1
+
+    return reader
+
+
+def train():
+    return _reader("train", 91)
+
+
+def test():
+    return _reader("test", 92)
+
+
+def valid():
+    return _reader("valid", 93)
+
+
+def fetch():
+    common.try_download(DATA_URL, "flowers", DATA_MD5)
+    common.try_download(LABEL_URL, "flowers", LABEL_MD5)
+    common.try_download(SETID_URL, "flowers", SETID_MD5)

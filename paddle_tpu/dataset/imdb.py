@@ -1,0 +1,103 @@
+"""IMDB sentiment readers (python/paddle/dataset/imdb.py parity):
+word_dict() builds token->id from the aclImdb tarball; train(word_dict)/
+test(word_dict) yield ([word ids], label 0/1). Offline fallback: two
+token distributions (positive/negative vocab halves) — learnable by the
+bow/lstm book models."""
+
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
+_SYN_VOCAB = 200
+_SYN_TRAIN, _SYN_TEST = 1500, 300
+
+
+def _tokenize(text):
+    return re.sub(
+        "[%s]" % re.escape(string.punctuation), "", text.lower()
+    ).split()
+
+
+def _tar_docs(path, pattern):
+    pat = re.compile(pattern)
+    with tarfile.open(path, "r:gz") as tf:
+        for member in tf.getmembers():
+            if member.isfile() and pat.match(member.name):
+                yield _tokenize(
+                    tf.extractfile(member).read().decode("utf-8", "replace")
+                )
+
+
+def _synthetic_word_dict():
+    common.note_synthetic("imdb")
+    d = {"w%d" % i: i for i in range(_SYN_VOCAB)}
+    d["<unk>"] = len(d)
+    return d
+
+
+def _synthetic_docs(n, seed, word_dict):
+    """label 1 docs draw 70% from the low vocab half, label 0 from the
+    high half; sequence lengths vary."""
+    rng = np.random.RandomState(seed)
+    half = _SYN_VOCAB // 2
+    for _ in range(n):
+        label = int(rng.randint(0, 2))
+        length = int(rng.randint(8, 40))
+        main_ids = rng.randint(0, half, length)
+        if label == 0:
+            main_ids = main_ids + half
+        flip = rng.rand(length) < 0.3
+        noise = rng.randint(0, _SYN_VOCAB, length)
+        ids = np.where(flip, noise, main_ids)
+        yield [int(i) for i in ids], label
+
+
+def word_dict():
+    path = common.try_download(URL, "imdb", MD5)
+    if path is None:
+        return _synthetic_word_dict()
+    freq = {}
+    for pattern in ("aclImdb/train/pos/.*\\.txt$",
+                    "aclImdb/train/neg/.*\\.txt$"):
+        for doc in _tar_docs(path, pattern):
+            for w in doc:
+                freq[w] = freq.get(w, 0) + 1
+    words = sorted(freq, key=lambda w: (-freq[w], w))
+    d = {w: i for i, w in enumerate(words)}
+    d["<unk>"] = len(d)
+    return d
+
+
+def _reader(pos_pattern, neg_pattern, syn_n, seed, word_idx):
+    def reader():
+        path = common.try_download(URL, "imdb", MD5)
+        if path is None:
+            yield from _synthetic_docs(syn_n, seed, word_idx)
+            return
+        unk = word_idx.get("<unk>", len(word_idx))
+        for label, pattern in ((1, pos_pattern), (0, neg_pattern)):
+            for doc in _tar_docs(path, pattern):
+                yield [word_idx.get(w, unk) for w in doc], label
+
+    return reader
+
+
+def train(word_idx):
+    return _reader("aclImdb/train/pos/.*\\.txt$",
+                   "aclImdb/train/neg/.*\\.txt$", _SYN_TRAIN, 21, word_idx)
+
+
+def test(word_idx):
+    return _reader("aclImdb/test/pos/.*\\.txt$",
+                   "aclImdb/test/neg/.*\\.txt$", _SYN_TEST, 22, word_idx)
+
+
+def fetch():
+    common.try_download(URL, "imdb", MD5)

@@ -1,0 +1,106 @@
+"""PTB (imikolov) language-model readers (python/paddle/dataset/
+imikolov.py parity): build_dict() then train(word_idx, n)/test(word_idx, n)
+yield n-gram id tuples (or (src, trg) sequences in NGRAM/SEQ data types).
+Offline fallback: a deterministic order-2 Markov chain over a small vocab
+— n-gram models reach well-below-uniform perplexity on it."""
+
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+
+_SYN_VOCAB = 60
+_SYN_TRAIN_SENT, _SYN_TEST_SENT = 800, 160
+
+
+class DataType(object):
+    NGRAM = 1
+    SEQ = 2
+
+
+def _tar_lines(path, member_name):
+    with tarfile.open(path, "r:gz") as tf:
+        f = tf.extractfile("./simple-examples/data/" + member_name)
+        for line in f.read().decode("utf-8").splitlines():
+            yield line.strip().split()
+
+
+def _synthetic_sentences(n_sent, seed):
+    common.note_synthetic("imikolov")
+    rng = np.random.RandomState(seed)
+    # sparse row-stochastic transition matrix fixed across runs
+    trans = np.random.RandomState(55).rand(_SYN_VOCAB, _SYN_VOCAB) ** 8
+    trans /= trans.sum(axis=1, keepdims=True)
+    for _ in range(n_sent):
+        length = int(rng.randint(5, 20))
+        w = int(rng.randint(0, _SYN_VOCAB))
+        sent = []
+        for _ in range(length):
+            w = int(rng.choice(_SYN_VOCAB, p=trans[w]))
+            sent.append("w%d" % w)
+        yield sent
+
+
+def build_dict(min_word_freq=50):
+    path = common.try_download(URL, "imikolov", MD5)
+    if path is None:
+        d = {"w%d" % i: i for i in range(_SYN_VOCAB)}
+        d["<unk>"] = len(d)
+        d["<s>"] = len(d)
+        d["<e>"] = len(d)
+        return d
+    freq = {}
+    for sent in _tar_lines(path, "ptb.train.txt"):
+        for w in sent:
+            freq[w] = freq.get(w, 0) + 1
+    freq.pop("<unk>", None)
+    words = sorted(
+        [w for w, c in freq.items() if c >= min_word_freq],
+        key=lambda w: (-freq[w], w),
+    )
+    d = {w: i for i, w in enumerate(words)}
+    d["<unk>"] = len(d)
+    d["<s>"] = len(d)
+    d["<e>"] = len(d)
+    return d
+
+
+def _reader(member_name, syn_sent, seed, word_idx, n, data_type):
+    def reader():
+        path = common.try_download(URL, "imikolov", MD5)
+        sents = (
+            _synthetic_sentences(syn_sent, seed)
+            if path is None
+            else _tar_lines(path, member_name)
+        )
+        unk = word_idx["<unk>"]
+        s_id, e_id = word_idx["<s>"], word_idx["<e>"]
+        for sent in sents:
+            ids = [s_id] + [word_idx.get(w, unk) for w in sent] + [e_id]
+            if data_type == DataType.NGRAM:
+                if len(ids) < n:
+                    continue
+                for i in range(n, len(ids) + 1):
+                    yield tuple(ids[i - n:i])
+            else:
+                yield ids[:-1], ids[1:]
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return _reader("ptb.train.txt", _SYN_TRAIN_SENT, 31, word_idx, n,
+                   data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return _reader("ptb.test.txt", _SYN_TEST_SENT, 32, word_idx, n,
+                   data_type)
+
+
+def fetch():
+    common.try_download(URL, "imikolov", MD5)

@@ -1,0 +1,80 @@
+"""MNIST readers (python/paddle/dataset/mnist.py parity): train()/test()
+yield (image float32[784] scaled to [-1, 1], label int). Real data parses
+the IDX gzip files; offline, a deterministic learnable fallback draws each
+digit as a noisy class template (common.py fallback contract)."""
+
+import gzip
+import struct
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+URL_PREFIX = "https://storage.googleapis.com/cvdf-datasets/mnist/"
+TRAIN_IMAGE = ("train-images-idx3-ubyte.gz", "f68b3c2dcbeaaa9fbdd348bbdeb94873")
+TRAIN_LABEL = ("train-labels-idx1-ubyte.gz", "d53e105ee54ea40749a09fcbcd1e9432")
+TEST_IMAGE = ("t10k-images-idx3-ubyte.gz", "9fb629c4189551a2d022fa330f9573f3")
+TEST_LABEL = ("t10k-labels-idx1-ubyte.gz", "ec29112dd5afa0611ce80d1b7f02629c")
+
+_SYN_TRAIN, _SYN_TEST = 2048, 512
+
+
+def _parse_idx(image_path, label_path):
+    with gzip.open(image_path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, "bad MNIST image magic %d" % magic
+        images = np.frombuffer(f.read(n * rows * cols), np.uint8)
+        images = images.reshape(n, rows * cols)
+    with gzip.open(label_path, "rb") as f:
+        magic, n2 = struct.unpack(">II", f.read(8))
+        assert magic == 2049, "bad MNIST label magic %d" % magic
+        labels = np.frombuffer(f.read(n2), np.uint8)
+    assert n == n2
+    return images, labels
+
+
+def _synthetic(n, seed):
+    """Class templates + noise: linearly separable enough for the book
+    test's convergence threshold, deterministic across runs."""
+    common.note_synthetic("mnist")
+    rng = np.random.RandomState(seed)
+    templates = np.random.RandomState(1234).rand(10, 784).astype(np.float32)
+    images = np.empty((n, 784), np.float32)
+    labels = rng.randint(0, 10, n)
+    for i in range(n):
+        noise = rng.rand(784).astype(np.float32)
+        images[i] = 0.75 * templates[labels[i]] + 0.25 * noise
+    return (images * 255).astype(np.uint8), labels.astype(np.uint8)
+
+
+def _reader(image_spec, label_spec, synthetic_n, synthetic_seed):
+    def reader():
+        img_path = common.try_download(
+            URL_PREFIX + image_spec[0], "mnist", image_spec[1]
+        )
+        lbl_path = common.try_download(
+            URL_PREFIX + label_spec[0], "mnist", label_spec[1]
+        )
+        if img_path is None or lbl_path is None:
+            images, labels = _synthetic(synthetic_n, synthetic_seed)
+        else:
+            images, labels = _parse_idx(img_path, lbl_path)
+        for img, lbl in zip(images, labels):
+            yield img.astype(np.float32) / 127.5 - 1.0, int(lbl)
+
+    return reader
+
+
+def train():
+    return _reader(TRAIN_IMAGE, TRAIN_LABEL, _SYN_TRAIN, 7)
+
+
+def test():
+    return _reader(TEST_IMAGE, TEST_LABEL, _SYN_TEST, 8)
+
+
+def fetch():
+    common.try_download(URL_PREFIX + TRAIN_IMAGE[0], "mnist", TRAIN_IMAGE[1])
+    common.try_download(URL_PREFIX + TRAIN_LABEL[0], "mnist", TRAIN_LABEL[1])
+    common.try_download(URL_PREFIX + TEST_IMAGE[0], "mnist", TEST_IMAGE[1])
+    common.try_download(URL_PREFIX + TEST_LABEL[0], "mnist", TEST_LABEL[1])

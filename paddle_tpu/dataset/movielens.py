@@ -1,0 +1,163 @@
+"""MovieLens-1M readers (python/paddle/dataset/movielens.py parity):
+train()/test() yield (user_id, gender, age, job, movie_id, category_ids,
+title_ids, rating) — the recommender-system book layout. Offline
+fallback: synthetic users/movies with a low-rank preference structure so
+the factorization model has signal to fit."""
+
+import re
+import zipfile
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+MD5 = "c4d9eecfca2ab87c1945afe126590906"
+
+_SYN_USERS, _SYN_MOVIES = 200, 120
+_SYN_TRAIN, _SYN_TEST = 4000, 800
+_SYN_CATEGORIES = 8
+_SYN_TITLE_VOCAB = 100
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+max_job_id_val = 20
+
+
+def _age_index(age):
+    for i, a in enumerate(age_table):
+        if age <= a:
+            return i
+    return len(age_table) - 1
+
+
+class _Info(object):
+    """Parsed corpus tables shared by the reader closures."""
+
+    def __init__(self):
+        self.users = {}       # id -> (gender01, age_idx, job)
+        self.movies = {}      # id -> (category ids, title ids)
+        self.categories = {}
+        self.title_vocab = {}
+        self.ratings = []     # (user, movie, rating)
+
+
+def _parse_real(path):
+    info = _Info()
+    with zipfile.ZipFile(path) as z:
+        with z.open("ml-1m/users.dat") as f:
+            for line in f.read().decode("latin1").splitlines():
+                uid, gender, age, job, _zip = line.split("::")
+                info.users[int(uid)] = (
+                    0 if gender == "M" else 1,
+                    _age_index(int(age)),
+                    int(job),
+                )
+        with z.open("ml-1m/movies.dat") as f:
+            for line in f.read().decode("latin1").splitlines():
+                mid, title, genres = line.split("::")
+                cat_ids = []
+                for g in genres.split("|"):
+                    cat_ids.append(
+                        info.categories.setdefault(g, len(info.categories))
+                    )
+                title_ids = []
+                for w in re.sub(r"\(\d{4}\)$", "", title).strip().lower().split():
+                    title_ids.append(
+                        info.title_vocab.setdefault(w, len(info.title_vocab))
+                    )
+                info.movies[int(mid)] = (cat_ids, title_ids or [0])
+        with z.open("ml-1m/ratings.dat") as f:
+            for line in f.read().decode("latin1").splitlines():
+                uid, mid, rating, _ts = line.split("::")
+                info.ratings.append((int(uid), int(mid), float(rating)))
+    return info
+
+
+def _parse_synthetic():
+    common.note_synthetic("movielens")
+    info = _Info()
+    rng = np.random.RandomState(41)
+    u_vec = rng.randn(_SYN_USERS + 1, 4)
+    m_vec = rng.randn(_SYN_MOVIES + 1, 4)
+    for uid in range(1, _SYN_USERS + 1):
+        info.users[uid] = (
+            int(rng.randint(0, 2)),
+            int(rng.randint(0, len(age_table))),
+            int(rng.randint(0, max_job_id_val)),
+        )
+    for mid in range(1, _SYN_MOVIES + 1):
+        cats = sorted(set(rng.randint(0, _SYN_CATEGORIES, 2).tolist()))
+        titles = rng.randint(0, _SYN_TITLE_VOCAB, 3).tolist()
+        info.movies[mid] = ([int(c) for c in cats], [int(t) for t in titles])
+    info.categories = {"c%d" % i: i for i in range(_SYN_CATEGORIES)}
+    info.title_vocab = {"t%d" % i: i for i in range(_SYN_TITLE_VOCAB)}
+    n = _SYN_TRAIN + _SYN_TEST
+    for _ in range(n):
+        uid = int(rng.randint(1, _SYN_USERS + 1))
+        mid = int(rng.randint(1, _SYN_MOVIES + 1))
+        score = float(u_vec[uid] @ m_vec[mid])
+        rating = float(np.clip(np.round(3 + score), 1, 5))
+        info.ratings.append((uid, mid, rating))
+    return info
+
+
+_cached_info = None
+
+
+def _get_info():
+    global _cached_info
+    if _cached_info is None:
+        path = common.try_download(URL, "movielens", MD5)
+        _cached_info = (
+            _parse_synthetic() if path is None else _parse_real(path)
+        )
+    return _cached_info
+
+
+def _reader(is_train):
+    def reader():
+        info = _get_info()
+        n = len(info.ratings)
+        split = int(n * 0.9)
+        lo, hi = (0, split) if is_train else (split, n)
+        for uid, mid, rating in info.ratings[lo:hi]:
+            if uid not in info.users or mid not in info.movies:
+                continue
+            gender, age_idx, job = info.users[uid]
+            cat_ids, title_ids = info.movies[mid]
+            yield (uid, gender, age_idx, job, mid, cat_ids, title_ids,
+                   [rating])
+
+    return reader
+
+
+def train():
+    return _reader(True)
+
+
+def test():
+    return _reader(False)
+
+
+def max_user_id():
+    return max(_get_info().users)
+
+
+def max_movie_id():
+    return max(_get_info().movies)
+
+
+def max_job_id():
+    return max(job for _, _, job in _get_info().users.values())
+
+
+def movie_categories():
+    return _get_info().categories
+
+
+def get_movie_title_dict():
+    return _get_info().title_vocab
+
+
+def fetch():
+    common.try_download(URL, "movielens", MD5)

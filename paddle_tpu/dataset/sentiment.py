@@ -1,0 +1,74 @@
+"""Movie-review sentiment readers (python/paddle/dataset/sentiment.py
+parity, NLTK movie_reviews corpus): get_word_dict(), train()/test()
+yielding (word ids, 0/1). Offline fallback shares imdb's synthetic
+two-distribution scheme."""
+
+from paddle_tpu.dataset import common, imdb
+
+URL = ("https://corpora.bj.bcebos.com/movie_reviews%2Fmovie_reviews.zip")
+MD5 = "155de2b77c6834dd8eea7cbe88e93acb"
+
+NUM_TRAINING_INSTANCES = 1600
+
+
+def _load_reviews():
+    path = common.try_download(URL, "sentiment", MD5)
+    if path is None:
+        return None
+    import zipfile
+
+    docs = []
+    with zipfile.ZipFile(path) as z:
+        for name in z.namelist():
+            for label, tag in ((1, "/pos/"), (0, "/neg/")):
+                if tag in name and name.endswith(".txt"):
+                    words = z.read(name).decode("latin1").lower().split()
+                    docs.append((words, label))
+    # interleave pos/neg for a balanced train/test split
+    docs.sort(key=lambda d: hash(tuple(d[0][:5])))
+    return docs
+
+
+def get_word_dict():
+    docs = _load_reviews()
+    if docs is None:
+        return imdb._synthetic_word_dict()
+    freq = {}
+    for words, _ in docs:
+        for w in words:
+            freq[w] = freq.get(w, 0) + 1
+    ranked = sorted(freq, key=lambda w: (-freq[w], w))
+    return {w: i for i, w in enumerate(ranked)}
+
+
+def _reader(is_train):
+    def reader():
+        docs = _load_reviews()
+        if docs is None:
+            n = 1200 if is_train else 240
+            yield from imdb._synthetic_docs(
+                n, 81 if is_train else 82, imdb._synthetic_word_dict()
+            )
+            return
+        wd = get_word_dict()
+        lo, hi = (
+            (0, NUM_TRAINING_INSTANCES)
+            if is_train
+            else (NUM_TRAINING_INSTANCES, len(docs))
+        )
+        for words, label in docs[lo:hi]:
+            yield [wd[w] for w in words if w in wd], label
+
+    return reader
+
+
+def train():
+    return _reader(True)
+
+
+def test():
+    return _reader(False)
+
+
+def fetch():
+    common.try_download(URL, "sentiment", MD5)

@@ -1,0 +1,62 @@
+"""UCI Boston housing readers (python/paddle/dataset/uci_housing.py
+parity): train()/test() yield (features float32[13] z-normalized, price
+float32[1]). Offline fallback: a fixed linear model + noise — the
+fit-a-line book test then still fits something real."""
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+URL = ("http://paddlemodels.bj.bcebos.com/uci_housing/housing.data")
+MD5 = "d4accdce7a25600298819f8e28e8d593"
+FEATURE_NUM = 14  # 13 features + target
+
+_TRAIN_RATIO = 0.8
+
+
+def _load_real(path):
+    data = np.fromfile(path, sep=" ", dtype=np.float32)
+    data = data.reshape(-1, FEATURE_NUM)
+    feats, target = data[:, :-1], data[:, -1:]
+    mean, std = feats.mean(axis=0), feats.std(axis=0)
+    feats = (feats - mean) / np.where(std == 0, 1, std)
+    return feats.astype(np.float32), target.astype(np.float32)
+
+
+def _synthetic(n, seed):
+    common.note_synthetic("uci_housing")
+    rng = np.random.RandomState(seed)
+    w = np.random.RandomState(99).randn(13, 1).astype(np.float32)
+    feats = rng.randn(n, 13).astype(np.float32)
+    target = feats @ w + 0.1 * rng.randn(n, 1).astype(np.float32) + 22.5
+    return feats, target.astype(np.float32)
+
+
+def _load():
+    path = common.try_download(URL, "uci_housing", MD5)
+    if path is None:
+        return _synthetic(506, 5)
+    return _load_real(path)
+
+
+def _reader(is_train):
+    def reader():
+        feats, target = _load()
+        split = int(len(feats) * _TRAIN_RATIO)
+        lo, hi = (0, split) if is_train else (split, len(feats))
+        for i in range(lo, hi):
+            yield feats[i], target[i]
+
+    return reader
+
+
+def train():
+    return _reader(True)
+
+
+def test():
+    return _reader(False)
+
+
+def fetch():
+    common.try_download(URL, "uci_housing", MD5)

@@ -1,0 +1,85 @@
+"""Pascal VOC2012 segmentation readers (python/paddle/dataset/voc2012.py
+parity): train()/test()/val() yield (image float32[3,H,W] in [0,1], label
+int32[H,W] class mask). Offline fallback: blocky synthetic scenes whose
+mask matches the painted rectangles — a tiny FCN can overfit them."""
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+       "VOCtrainval_11-May-2012.tar")
+MD5 = "6cd6e144f989b92b3379bac3b3de84fd"
+
+CLASSES = 21
+_SHAPE = (128, 128)
+_SYN = {"train": 300, "test": 60, "val": 60}
+
+
+def _synthetic(split, seed):
+    common.note_synthetic("voc2012")
+    rng = np.random.RandomState(seed)
+    h, w = _SHAPE
+    for _ in range(_SYN[split]):
+        img = rng.rand(3, h, w).astype(np.float32) * 0.3
+        mask = np.zeros((h, w), np.int32)
+        for _obj in range(int(rng.randint(1, 4))):
+            cls = int(rng.randint(1, CLASSES))
+            y0, x0 = rng.randint(0, h // 2), rng.randint(0, w // 2)
+            y1, x1 = y0 + rng.randint(8, h // 2), x0 + rng.randint(8, w // 2)
+            mask[y0:y1, x0:x1] = cls
+            tint = np.random.RandomState(cls).rand(3).astype(np.float32)
+            img[:, y0:y1, x0:x1] = (
+                0.7 * tint[:, None, None] + 0.3 * img[:, y0:y1, x0:x1]
+            )
+        yield img, mask
+
+
+def _reader(split, seed):
+    def reader():
+        path = common.try_download(URL, "voc2012", MD5)
+        if path is None:
+            yield from _synthetic(split, seed)
+            return
+        import io
+        import tarfile
+
+        from PIL import Image
+
+        seg_dir = "VOCdevkit/VOC2012/SegmentationClass/"
+        img_dir = "VOCdevkit/VOC2012/JPEGImages/"
+        split_file = (
+            "VOCdevkit/VOC2012/ImageSets/Segmentation/%s.txt"
+            % ("trainval" if split == "test" else split)
+        )
+        with tarfile.open(path) as tf:
+            names = tf.extractfile(split_file).read().decode().split()
+            for name in names:
+                img = Image.open(
+                    io.BytesIO(tf.extractfile(img_dir + name + ".jpg").read())
+                ).convert("RGB")
+                mask = Image.open(
+                    io.BytesIO(tf.extractfile(seg_dir + name + ".png").read())
+                )
+                arr = np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0
+                m = np.asarray(mask, np.int32)
+                m = np.where(m == 255, 0, m)
+                yield arr, m
+
+    return reader
+
+
+def train():
+    return _reader("train", 95)
+
+
+def test():
+    return _reader("test", 96)
+
+
+def val():
+    return _reader("val", 97)
+
+
+def fetch():
+    common.try_download(URL, "voc2012", MD5)

@@ -1,0 +1,99 @@
+"""WMT14 fr->en machine-translation readers (python/paddle/dataset/
+wmt14.py parity): train(dict_size)/test(dict_size) yield
+(src_ids, trg_ids, trg_next_ids) with <s>/<e>/<unk> conventions. Offline
+fallback: an invertible toy language pair (target = per-token mapped
+source, reversed) — seq2seq models can genuinely learn it."""
+
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+URL_TRAIN = ("http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz")
+MD5_TRAIN = "0791583d57d5beb693b9414c5b36798c"
+
+START, END, UNK = "<s>", "<e>", "<unk>"
+START_ID, END_ID, UNK_ID = 0, 1, 2
+
+_SYN_VOCAB = 80
+_SYN_TRAIN, _SYN_TEST = 1200, 200
+
+
+def _synthetic_pairs(n, seed, dict_size):
+    common.note_synthetic("wmt14")
+    rng = np.random.RandomState(seed)
+    v = min(_SYN_VOCAB, dict_size - 3)
+    perm = np.random.RandomState(66).permutation(v)
+    for _ in range(n):
+        length = int(rng.randint(3, 10))
+        src = rng.randint(0, v, length)
+        trg = perm[src][::-1]
+        src_ids = [int(s) + 3 for s in src]
+        trg_ids = [START_ID] + [int(t) + 3 for t in trg]
+        trg_next = trg_ids[1:] + [END_ID]
+        yield src_ids, trg_ids, trg_next
+
+
+def _tar_pairs(path, member_pat, dict_size):
+    src_dict, trg_dict = __read_dicts(path, dict_size)
+    with tarfile.open(path, "r:gz") as tf:
+        for member in tf.getmembers():
+            if member_pat not in member.name or not member.isfile():
+                continue
+            for line in tf.extractfile(member).read().decode(
+                "utf-8", "replace"
+            ).splitlines():
+                parts = line.split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [src_dict.get(w, UNK_ID) for w in parts[0].split()]
+                trg = [trg_dict.get(w, UNK_ID) for w in parts[1].split()]
+                if not src or not trg:
+                    continue
+                trg_ids = [START_ID] + trg
+                yield src, trg_ids, trg + [END_ID]
+
+
+def __read_dicts(path, dict_size):
+    dicts = []
+    with tarfile.open(path, "r:gz") as tf:
+        for name in ("src.dict", "trg.dict"):
+            member = next(
+                (m for m in tf.getmembers() if m.name.endswith(name)), None
+            )
+            d = {START: START_ID, END: END_ID, UNK: UNK_ID}
+            if member is not None:
+                for i, w in enumerate(
+                    tf.extractfile(member).read().decode(
+                        "utf-8", "replace"
+                    ).splitlines()
+                ):
+                    if i >= dict_size:
+                        break
+                    d.setdefault(w.strip(), len(d))
+            dicts.append(d)
+    return dicts
+
+
+def _reader(member_pat, syn_n, seed, dict_size):
+    def reader():
+        path = common.try_download(URL_TRAIN, "wmt14", MD5_TRAIN)
+        if path is None:
+            yield from _synthetic_pairs(syn_n, seed, dict_size)
+        else:
+            yield from _tar_pairs(path, member_pat, dict_size)
+
+    return reader
+
+
+def train(dict_size):
+    return _reader("train/", _SYN_TRAIN, 61, dict_size)
+
+
+def test(dict_size):
+    return _reader("test/", _SYN_TEST, 62, dict_size)
+
+
+def fetch():
+    common.try_download(URL_TRAIN, "wmt14", MD5_TRAIN)

@@ -1,0 +1,38 @@
+"""WMT16 en<->de readers (python/paddle/dataset/wmt16.py parity):
+train/test/validation(src_dict_size, trg_dict_size, src_lang) yield dicts
+is replaced by the reference's tuple layout (src_ids, trg_ids, trg_next).
+Offline fallback mirrors wmt14's invertible toy pair with a different
+mapping so models can't share weights across the two datasets."""
+
+from paddle_tpu.dataset import common, wmt14
+
+URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+MD5 = "0c38be43600334966403524a40dcd81e"
+
+
+def _reader(member_pat, syn_n, seed, dict_size):
+    def reader():
+        path = common.try_download(URL, "wmt16", MD5)
+        if path is None:
+            common.note_synthetic("wmt16")
+            yield from wmt14._synthetic_pairs(syn_n, seed, dict_size)
+        else:
+            yield from wmt14._tar_pairs(path, member_pat, dict_size)
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("train", 1200, 63, min(src_dict_size, trg_dict_size))
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("test", 200, 64, min(src_dict_size, trg_dict_size))
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("val", 200, 65, min(src_dict_size, trg_dict_size))
+
+
+def fetch():
+    common.try_download(URL, "wmt16", MD5)
