@@ -48,6 +48,7 @@ __all__ = [
     "slice",
     "shape",
     "gather",
+    "batched_gather",
     "scatter",
     "pad",
     "pad2d",
@@ -945,5 +946,19 @@ def cos_sim(X, Y, name=None):
         type="cos_sim",
         inputs={"X": [X], "Y": [Y]},
         outputs={"Out": [out], "XNorm": [xnorm], "YNorm": [ynorm]},
+    )
+    return out
+
+
+def batched_gather(input, index):
+    """Per-batch gather along dim 1: out[n, s] = input[n, index[n, s]].
+    Negative indices (padding) clamp to row 0 — mask via the caller's
+    weights. TPU-friendly take_along_axis, no LoD offsets."""
+    helper = LayerHelper("batched_gather")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="batched_gather",
+        inputs={"X": [input], "Index": [index]},
+        outputs={"Out": [out]},
     )
     return out

@@ -93,11 +93,18 @@ def _iou(a, b):
     return inter / jnp.maximum(union, 1e-10)
 
 
+def _lower_iou_similarity(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim == 3:  # padded batch [N, G, 4] vs shared [P, 4]
+        return jax.vmap(lambda xi: _iou(xi, y))(x)
+    return _iou(x, y)
+
+
 register_op(
     "iou_similarity",
     inputs=["X", "Y"],
     outputs=["Out"],
-    lower=lambda ctx, ins, attrs: _iou(ins["X"][0], ins["Y"][0]),
+    lower=_lower_iou_similarity,
     grad=None,
 )
 
@@ -114,22 +121,27 @@ def _lower_box_coder(ctx, ins, attrs):
     if pvar is None:
         pvar = jnp.ones((jnp.shape(prior)[0], 4), prior.dtype)
     if code_type.startswith("encode"):
-        tw = target[:, 2] - target[:, 0]
-        th = target[:, 3] - target[:, 1]
-        tcx = target[:, 0] + tw / 2
-        tcy = target[:, 1] + th / 2
-        out = jnp.stack(
-            [
-                (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0],
-                (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1],
-                jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
-                / pvar[None, :, 2],
-                jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
-                / pvar[None, :, 3],
-            ],
-            axis=-1,
-        )
-        return out
+
+        def encode(t):  # t [T, 4] -> [T, P, 4]
+            tw = t[:, 2] - t[:, 0]
+            th = t[:, 3] - t[:, 1]
+            tcx = t[:, 0] + tw / 2
+            tcy = t[:, 1] + th / 2
+            return jnp.stack(
+                [
+                    (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0],
+                    (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1],
+                    jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+                    / pvar[None, :, 2],
+                    jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+                    / pvar[None, :, 3],
+                ],
+                axis=-1,
+            )
+
+        if target.ndim == 3:  # padded gt batch [N, G, 4] -> [N, G, P, 4]
+            return jax.vmap(encode)(target)
+        return encode(target)
     # decode: target [N, M, 4]
     t = target
     dcx = pvar[None, :, 0] * t[..., 0] * pw[None, :] + pcx[None, :]
@@ -147,5 +159,901 @@ register_op(
     outputs=["OutputBox"],
     attrs={"code_type": "encode_center_size", "box_normalized": True},
     lower=_lower_box_coder,
+    grad=None,
+)
+
+
+# ---------------------------------------------------------------------------
+# Matching / target assignment (SSD + RPN training machinery).
+#
+# Reference parity: paddle/fluid/operators/detection/bipartite_match_op.cc,
+# target_assign_op.cc, mine_hard_examples_op.cc, rpn_target_assign_op.cc.
+#
+# TPU-first divergence (documented, by design): the reference threads
+# variable-length ground-truth through LoD tensors; here ground truth is a
+# padded dense batch [N, G, ...] where padded rows are all-zero boxes (their
+# IoU row is <= 0 against every prior, so the matcher skips them), and the
+# reference's LoD *index* outputs (NegIndices) become dense masks. Static
+# shapes keep the whole loss inside one XLA program.
+# ---------------------------------------------------------------------------
+
+from jax import lax
+
+
+def _bipartite_match_single(dist, match_type, overlap_threshold):
+    """Greedy bipartite match on dist [G, P] -> (match_idx [P], match_dist [P]).
+
+    Rows whose max dist <= 0 (zero-padded gt) are never matched. Mirrors
+    BipartiteMatch in bipartite_match_op.cc: repeatedly take the global
+    argmax, bind that (row, col), and retire both.
+    """
+    g, p = dist.shape
+    row_valid = jnp.max(dist, axis=1) > 0
+    d0 = jnp.where(row_valid[:, None], dist, -1.0)
+
+    def body(_, carry):
+        d, midx, mdist = carry
+        flat = jnp.reshape(d, (-1,))
+        k = jnp.argmax(flat)
+        r, c = k // p, k % p
+        v = flat[k]
+        take = v > 0
+        midx2 = midx.at[c].set(r.astype(jnp.int32))
+        mdist2 = mdist.at[c].set(v)
+        d2 = d.at[r, :].set(-1.0).at[:, c].set(-1.0)
+        return (
+            jnp.where(take, d2, d),
+            jnp.where(take, midx2, midx),
+            jnp.where(take, mdist2, mdist),
+        )
+
+    midx = jnp.full((p,), -1, jnp.int32)
+    mdist = jnp.zeros((p,), dist.dtype)
+    _, midx, mdist = lax.fori_loop(0, min(g, p), body, (d0, midx, mdist))
+
+    if match_type == "per_prediction":
+        d = jnp.where(row_valid[:, None], dist, -1.0)
+        best = jnp.max(d, axis=0)
+        best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+        upd = (midx < 0) & (best >= overlap_threshold)
+        midx = jnp.where(upd, best_row, midx)
+        mdist = jnp.where(upd, best, mdist)
+    return midx, mdist
+
+
+def _lower_bipartite_match(ctx, ins, attrs):
+    dist = ins["DistMat"][0]
+    mt = attrs.get("match_type", "bipartite")
+    thr = attrs.get("dist_threshold", 0.5)
+    if dist.ndim == 2:
+        dist = dist[None]
+    midx, mdist = jax.vmap(
+        lambda d: _bipartite_match_single(d, mt, thr)
+    )(dist)
+    return {"ColToRowMatchIndices": midx, "ColToRowMatchDist": mdist}
+
+
+register_op(
+    "bipartite_match",
+    inputs=["DistMat"],
+    outputs=["ColToRowMatchIndices", "ColToRowMatchDist"],
+    attrs={"match_type": "bipartite", "dist_threshold": 0.5},
+    lower=_lower_bipartite_match,
+    grad=None,
+)
+
+
+def _lower_target_assign(ctx, ins, attrs):
+    x = ins["X"][0]  # [N, G, K] or [N, G, P, K] padded per-image gt rows
+    midx = ins["MatchIndices"][0]  # [N, P], -1 = unmatched
+    neg = ins["NegMask"][0] if ins.get("NegMask") else None  # [N, P] dense mask
+    mismatch = attrs.get("mismatch_value", 0)
+
+    matched = midx >= 0
+    safe = jnp.maximum(midx, 0)
+    if x.ndim == 4:
+        # per-prior targets (encoded boxes): out[n,p,:] = x[n, match[n,p], p, :]
+        n, p = midx.shape
+        out = x[jnp.arange(n)[:, None], safe, jnp.arange(p)[None, :]]
+    else:
+        out = jnp.take_along_axis(x, safe[..., None], axis=1)
+    out = jnp.where(
+        matched[..., None], out, jnp.asarray(mismatch, x.dtype)
+    )
+    w = matched.astype(jnp.float32)
+    if neg is not None:
+        w = jnp.maximum(w, neg.astype(jnp.float32))
+    return {"Out": out, "OutWeight": w[..., None]}
+
+
+register_op(
+    "target_assign",
+    inputs=["X", "MatchIndices", "NegMask"],
+    outputs=["Out", "OutWeight"],
+    attrs={"mismatch_value": 0},
+    lower=_lower_target_assign,
+    grad=None,
+)
+
+
+def _lower_mine_hard_examples(ctx, ins, attrs):
+    cls_loss = ins["ClsLoss"][0]  # [N, P]
+    loc_loss = ins["LocLoss"][0] if ins.get("LocLoss") else None
+    midx = ins["MatchIndices"][0]  # [N, P]
+    mdist = ins["MatchDist"][0]
+    ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg_thr = attrs.get("neg_dist_threshold", 0.5)
+    mining = attrs.get("mining_type", "max_negative")
+    sample_size = attrs.get("sample_size", 0) or 0
+
+    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+    n, p = loss.shape
+    pos = midx >= 0
+    cand = (~pos) & (mdist < neg_thr)
+    num_pos = jnp.sum(pos, axis=1)
+    num_cand = jnp.sum(cand, axis=1)
+    if mining == "hard_example" and sample_size:
+        num_neg = jnp.minimum(jnp.full_like(num_cand, sample_size), num_cand)
+    else:
+        num_neg = jnp.minimum(
+            (ratio * num_pos.astype(jnp.float32)).astype(num_cand.dtype),
+            num_cand,
+        )
+    masked = jnp.where(cand, loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1)
+    rank = jnp.zeros_like(order).at[
+        jnp.arange(n)[:, None], order
+    ].set(jnp.broadcast_to(jnp.arange(p), (n, p)))
+    neg_mask = cand & (rank < num_neg[:, None])
+    return {
+        "NegMask": neg_mask.astype(jnp.float32),
+        "UpdatedMatchIndices": midx,
+    }
+
+
+register_op(
+    "mine_hard_examples",
+    inputs=["ClsLoss", "LocLoss", "MatchIndices", "MatchDist"],
+    outputs=["NegMask", "UpdatedMatchIndices"],
+    attrs={
+        "neg_pos_ratio": 3.0,
+        "neg_dist_threshold": 0.5,
+        "mining_type": "max_negative",
+        "sample_size": 0,
+    },
+    lower=_lower_mine_hard_examples,
+    grad=None,
+)
+
+
+# ---------------------------------------------------------------------------
+# NMS family (multiclass_nms / detection inference path).
+# Reference: multiclass_nms_op.cc (NMSFast + MultiClassNMS + MultiClassOutput).
+# TPU formulation: fixed-capacity outputs padded with label -1 plus an explicit
+# per-image valid count, instead of LoD-shaped results.
+# ---------------------------------------------------------------------------
+
+
+def _nms_single_class(boxes, scores, score_threshold, nms_threshold, eta, top_k):
+    """Static NMS for one class. boxes [P,4], scores [P] ->
+    (keep mask over the top_k candidates, cand indices [top_k])."""
+    p = scores.shape[0]
+    k = min(top_k, p) if top_k > 0 else p
+    cand = jnp.argsort(-scores)[:k]
+    b = boxes[cand]
+    s = scores[cand]
+    iou = _iou(b, b)
+    eligible = s > score_threshold
+
+    def body(i, carry):
+        keep, thr = carry
+        before = jnp.arange(k) < i
+        suppressed = jnp.any(keep & before & (iou[i] > thr))
+        take = eligible[i] & ~suppressed
+        keep = keep.at[i].set(take)
+        thr = jnp.where(
+            take & (eta < 1.0) & (thr > 0.5), thr * eta, thr
+        )
+        return keep, thr
+
+    keep = jnp.zeros((k,), bool)
+    keep, _ = lax.fori_loop(
+        0, k, body, (keep, jnp.asarray(nms_threshold, jnp.float32))
+    )
+    return keep, cand
+
+
+def _multiclass_nms_single(scores, boxes, attrs):
+    """scores [C, P], boxes [P, 4] -> (out [keep_top_k, 6], count)."""
+    c, p = scores.shape
+    bg = attrs.get("background_label", 0)
+    score_thr = attrs.get("score_threshold", 0.0)
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    eta = attrs.get("nms_eta", 1.0)
+    nms_top_k = attrs.get("nms_top_k", -1)
+    keep_top_k = attrs.get("keep_top_k", -1)
+    k = min(nms_top_k, p) if nms_top_k > 0 else p
+
+    all_labels, all_scores, all_boxes = [], [], []
+    for cls in range(c):
+        if cls == bg:
+            continue
+        keep, cand = _nms_single_class(
+            boxes, scores[cls], score_thr, nms_thr, eta, k
+        )
+        all_labels.append(jnp.full((keep.shape[0],), cls, jnp.float32))
+        all_scores.append(jnp.where(keep, scores[cls][cand], -jnp.inf))
+        all_boxes.append(boxes[cand])
+    cat_l = jnp.concatenate(all_labels)
+    cat_s = jnp.concatenate(all_scores)
+    cat_b = jnp.concatenate(all_boxes, axis=0)
+    total = cat_s.shape[0]
+    kk = min(keep_top_k, total) if keep_top_k > 0 else total
+    top = jnp.argsort(-cat_s)[:kk]
+    sel_s = cat_s[top]
+    valid = jnp.isfinite(sel_s)
+    out = jnp.concatenate(
+        [
+            jnp.where(valid, cat_l[top], -1.0)[:, None],
+            jnp.where(valid, sel_s, 0.0)[:, None],
+            jnp.where(valid[:, None], cat_b[top], 0.0),
+        ],
+        axis=1,
+    )
+    return out, jnp.sum(valid).astype(jnp.int32)
+
+
+def _lower_multiclass_nms(ctx, ins, attrs):
+    scores = ins["Scores"][0]  # [N, C, P]
+    boxes = ins["BBoxes"][0]  # [N, P, 4]
+    out, count = jax.vmap(
+        lambda s, b: _multiclass_nms_single(s, b, attrs)
+    )(scores, boxes)
+    return {"Out": out, "Count": count}
+
+
+register_op(
+    "multiclass_nms",
+    inputs=["BBoxes", "Scores"],
+    outputs=["Out", "Count"],
+    attrs={
+        "background_label": 0,
+        "score_threshold": 0.0,
+        "nms_top_k": -1,
+        "nms_threshold": 0.3,
+        "nms_eta": 1.0,
+        "keep_top_k": -1,
+        "normalized": True,
+    },
+    lower=_lower_multiclass_nms,
+    grad=None,
+)
+
+
+# ---------------------------------------------------------------------------
+# Anchor / prior generators.
+# Reference: anchor_generator_op.h:40-90, density_prior_box semantics.
+# ---------------------------------------------------------------------------
+
+
+def _lower_anchor_generator(ctx, ins, attrs):
+    feat = ins["Input"][0]
+    sizes = attrs["anchor_sizes"]
+    ratios = attrs.get("aspect_ratios", [1.0])
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    stride = attrs.get("stride", [16.0, 16.0])
+    offset = attrs.get("offset", 0.5)
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
+
+    anchors = []
+    for h in range(fh):
+        row = []
+        for w in range(fw):
+            x_ctr = w * sw + offset * (sw - 1)
+            y_ctr = h * sh + offset * (sh - 1)
+            cell = []
+            for ar in ratios:
+                area = sw * sh
+                base_w = round(np.sqrt(area / ar))
+                base_h = round(base_w * ar)
+                for s in sizes:
+                    aw = (s / sw) * base_w
+                    ah = (s / sh) * base_h
+                    cell.append(
+                        [
+                            x_ctr - 0.5 * (aw - 1),
+                            y_ctr - 0.5 * (ah - 1),
+                            x_ctr + 0.5 * (aw - 1),
+                            y_ctr + 0.5 * (ah - 1),
+                        ]
+                    )
+            row.append(cell)
+        anchors.append(row)
+    arr = jnp.asarray(np.asarray(anchors, np.float32))
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), arr.shape
+    )
+    return {"Anchors": arr, "Variances": var}
+
+
+register_op(
+    "anchor_generator",
+    inputs=["Input"],
+    outputs=["Anchors", "Variances"],
+    attrs={
+        "anchor_sizes": [64.0, 128.0, 256.0, 512.0],
+        "aspect_ratios": [0.5, 1.0, 2.0],
+        "variances": [0.1, 0.1, 0.2, 0.2],
+        "stride": [16.0, 16.0],
+        "offset": 0.5,
+    },
+    lower=_lower_anchor_generator,
+    grad=None,
+)
+
+
+def _lower_density_prior_box(ctx, ins, attrs):
+    feat, image = ins["Input"][0], ins["Image"][0]
+    densities = attrs.get("densities", [])
+    fixed_sizes = attrs.get("fixed_sizes", [])
+    fixed_ratios = attrs.get("fixed_ratios", [1.0])
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = attrs.get("clip", False)
+    offset = attrs.get("offset", 0.5)
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = attrs.get("step_w", 0.0) or iw / fw
+    step_h = attrs.get("step_h", 0.0) or ih / fh
+
+    boxes = []
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            for size, density in zip(fixed_sizes, densities):
+                for ar in fixed_ratios:
+                    bw = size * np.sqrt(ar)
+                    bh = size / np.sqrt(ar)
+                    shift_w = step_w / density
+                    shift_h = step_h / density
+                    for di in range(density):
+                        for dj in range(density):
+                            ccx = cx - step_w / 2.0 + shift_w / 2.0 + dj * shift_w
+                            ccy = cy - step_h / 2.0 + shift_h / 2.0 + di * shift_h
+                            boxes.append(
+                                [
+                                    (ccx - bw / 2.0) / iw,
+                                    (ccy - bh / 2.0) / ih,
+                                    (ccx + bw / 2.0) / iw,
+                                    (ccy + bh / 2.0) / ih,
+                                ]
+                            )
+    arr = np.asarray(boxes, np.float32)
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    num_priors = arr.shape[0] // (fh * fw)
+    out = jnp.asarray(arr.reshape(fh, fw, num_priors, 4))
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (fh, fw, num_priors, 4)
+    )
+    return {"Boxes": out, "Variances": var}
+
+
+register_op(
+    "density_prior_box",
+    inputs=["Input", "Image"],
+    outputs=["Boxes", "Variances"],
+    attrs={
+        "densities": [],
+        "fixed_sizes": [],
+        "fixed_ratios": [1.0],
+        "variances": [0.1, 0.1, 0.2, 0.2],
+        "clip": False,
+        "step_w": 0.0,
+        "step_h": 0.0,
+        "offset": 0.5,
+        "flatten_to_2d": False,
+    },
+    lower=_lower_density_prior_box,
+    grad=None,
+)
+
+
+# ---------------------------------------------------------------------------
+# ROI ops. Reference: roi_pool_op.cc (quantized max pool), roi_align_op.cc
+# (bilinear average). Batch mapping uses a dense RoisBatch index vector
+# instead of the reference's ROI-LoD.
+# ---------------------------------------------------------------------------
+
+
+def _roi_pool_one(x, roi, ph, pw, spatial_scale):
+    """x [C,H,W], roi [4] -> [C,ph,pw] quantized max pool (roi_pool_op.cc)."""
+    c, h, w = x.shape
+    rs = jnp.round(roi * spatial_scale)
+    x1, y1 = rs[0], rs[1]
+    rw = jnp.maximum(rs[2] - rs[0] + 1, 1.0)
+    rh = jnp.maximum(rs[3] - rs[1] + 1, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    ii = jnp.arange(ph, dtype=jnp.float32)
+    jj = jnp.arange(pw, dtype=jnp.float32)
+    hstart = jnp.clip(jnp.floor(ii * bin_h) + y1, 0, h)
+    hend = jnp.clip(jnp.ceil((ii + 1) * bin_h) + y1, 0, h)
+    wstart = jnp.clip(jnp.floor(jj * bin_w) + x1, 0, w)
+    wend = jnp.clip(jnp.ceil((jj + 1) * bin_w) + x1, 0, w)
+    hh = jnp.arange(h, dtype=jnp.float32)
+    ww = jnp.arange(w, dtype=jnp.float32)
+    # mask [ph, pw, H, W]: pixel in bin
+    hm = (hh[None, :] >= hstart[:, None]) & (hh[None, :] < hend[:, None])
+    wm = (ww[None, :] >= wstart[:, None]) & (ww[None, :] < wend[:, None])
+    mask = hm[:, None, :, None] & wm[None, :, None, :]
+    vals = jnp.where(mask[None], x[:, None, None, :, :], -jnp.inf)
+    out = jnp.max(vals, axis=(3, 4))
+    return jnp.where(jnp.isfinite(out), out, 0.0)
+
+
+def _lower_roi_pool(ctx, ins, attrs):
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    batch = (
+        ins["RoisBatch"][0].astype(jnp.int32)
+        if ins.get("RoisBatch")
+        else jnp.zeros((rois.shape[0],), jnp.int32)
+    )
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    feats = x[batch]  # [R, C, H, W]
+    return jax.vmap(lambda f, r: _roi_pool_one(f, r, ph, pw, scale))(
+        feats, rois
+    )
+
+
+register_op(
+    "roi_pool",
+    inputs=["X", "ROIs", "RoisBatch"],
+    outputs=["Out"],
+    attrs={"pooled_height": 1, "pooled_width": 1, "spatial_scale": 1.0},
+    lower=_lower_roi_pool,
+    grad="auto",
+    no_grad_inputs=("ROIs", "RoisBatch"),
+)
+
+
+def _roi_align_one(x, roi, ph, pw, spatial_scale, sampling_ratio):
+    """x [C,H,W], roi [4] -> [C,ph,pw] bilinear average (roi_align_op.cc)."""
+    c, h, w = x.shape
+    x1 = roi[0] * spatial_scale
+    y1 = roi[1] * spatial_scale
+    rw = jnp.maximum(roi[2] * spatial_scale - x1, 1.0)
+    rh = jnp.maximum(roi[3] * spatial_scale - y1, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample points: [ph, s] x [pw, s]
+    ii = jnp.arange(ph, dtype=jnp.float32)[:, None]
+    jj = jnp.arange(pw, dtype=jnp.float32)[:, None]
+    sy = y1 + (ii + (jnp.arange(s, dtype=jnp.float32)[None, :] + 0.5) / s) * bin_h
+    sx = x1 + (jj + (jnp.arange(s, dtype=jnp.float32)[None, :] + 0.5) / s) * bin_w
+
+    def bilinear(yy, xx):
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        y1i = jnp.minimum(y0 + 1, h - 1.0)
+        x1i = jnp.minimum(x0 + 1, w - 1.0)
+        ly, lx = yy - y0, xx - x0
+        g = lambda a, b: x[:, a.astype(jnp.int32), b.astype(jnp.int32)]
+        return (
+            g(y0, x0) * (1 - ly) * (1 - lx)
+            + g(y0, x1i) * (1 - ly) * lx
+            + g(y1i, x0) * ly * (1 - lx)
+            + g(y1i, x1i) * ly * lx
+        )
+
+    # grid of all sample points: [ph*s] y coords x [pw*s] x coords
+    ys = jnp.reshape(sy, (-1,))  # [ph*s]
+    xs = jnp.reshape(sx, (-1,))  # [pw*s]
+    vals = bilinear(ys[:, None], xs[None, :])  # [C, ph*s, pw*s]
+    vals = jnp.reshape(vals, (c, ph, s, pw, s))
+    return jnp.mean(vals, axis=(2, 4))
+
+
+def _lower_roi_align(ctx, ins, attrs):
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    batch = (
+        ins["RoisBatch"][0].astype(jnp.int32)
+        if ins.get("RoisBatch")
+        else jnp.zeros((rois.shape[0],), jnp.int32)
+    )
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    sr = attrs.get("sampling_ratio", -1)
+    feats = x[batch]
+    return jax.vmap(
+        lambda f, r: _roi_align_one(f, r, ph, pw, scale, sr)
+    )(feats, rois)
+
+
+register_op(
+    "roi_align",
+    inputs=["X", "ROIs", "RoisBatch"],
+    outputs=["Out"],
+    attrs={
+        "pooled_height": 1,
+        "pooled_width": 1,
+        "spatial_scale": 1.0,
+        "sampling_ratio": -1,
+    },
+    lower=_lower_roi_align,
+    grad="auto",
+    no_grad_inputs=("ROIs", "RoisBatch"),
+)
+
+
+def _lower_polygon_box_transform(ctx, ins, attrs):
+    x = ins["Input"][0]  # [N, C, H, W], C = 2*coords (x,y interleaved)
+    n, c, h, w = x.shape
+    jj = jnp.arange(w, dtype=x.dtype)
+    ii = jnp.arange(h, dtype=x.dtype)
+    even = jj[None, :] * 4.0 - x  # x-channels: id_w * 4 - in
+    odd = ii[:, None] * 4.0 - x  # y-channels: id_h * 4 - in
+    is_even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return jnp.where(is_even, even, odd)
+
+
+register_op(
+    "polygon_box_transform",
+    inputs=["Input"],
+    outputs=["Output"],
+    lower=_lower_polygon_box_transform,
+    grad=None,
+)
+
+
+# ---------------------------------------------------------------------------
+# RPN target assignment + proposal generation (Faster R-CNN machinery).
+# Reference: rpn_target_assign_op.cc:490-560, generate_proposals_op.cc.
+# Static-shape formulation: fixed sample counts with -1 padding + weights
+# instead of the reference's dynamically-sized index LoDs.
+# ---------------------------------------------------------------------------
+
+
+def _rpn_encode(anchors, gt):
+    """Standard RPN box encoding (dx,dy,dw,dh); anchors/gt [*, 4]."""
+    aw = anchors[..., 2] - anchors[..., 0] + 1.0
+    ah = anchors[..., 3] - anchors[..., 1] + 1.0
+    acx = anchors[..., 0] + aw * 0.5
+    acy = anchors[..., 1] + ah * 0.5
+    gw = gt[..., 2] - gt[..., 0] + 1.0
+    gh = gt[..., 3] - gt[..., 1] + 1.0
+    gcx = gt[..., 0] + gw * 0.5
+    gcy = gt[..., 1] + gh * 0.5
+    return jnp.stack(
+        [
+            (gcx - acx) / aw,
+            (gcy - acy) / ah,
+            jnp.log(jnp.maximum(gw / aw, 1e-10)),
+            jnp.log(jnp.maximum(gh / ah, 1e-10)),
+        ],
+        axis=-1,
+    )
+
+
+def _rpn_assign_single(anchors, gt, im_info, key, attrs):
+    """anchors [A,4], gt [G,4] zero-padded, im_info [3] -> fixed-size samples."""
+    bs = attrs.get("rpn_batch_size_per_im", 256)
+    straddle = attrs.get("rpn_straddle_thresh", 0.0)
+    fg_frac = attrs.get("rpn_fg_fraction", 0.5)
+    pos_thr = attrs.get("rpn_positive_overlap", 0.7)
+    neg_thr = attrs.get("rpn_negative_overlap", 0.3)
+    use_random = attrs.get("use_random", True)
+    n_fg = int(round(bs * fg_frac))
+    n_all = bs
+    a = anchors.shape[0]
+
+    ih, iw = im_info[0], im_info[1]
+    inside = (
+        (anchors[:, 0] >= -straddle)
+        & (anchors[:, 1] >= -straddle)
+        & (anchors[:, 2] < iw + straddle)
+        & (anchors[:, 3] < ih + straddle)
+    )
+    gt_valid = jnp.max(gt, axis=1) > 0  # zero-padded rows invalid
+    iou = _iou(gt, anchors)  # [G, A]
+    iou = jnp.where(gt_valid[:, None] & inside[None, :], iou, -1.0)
+    anchor_best = jnp.max(iou, axis=0)  # [A]
+    anchor_gt = jnp.argmax(iou, axis=0).astype(jnp.int32)
+    # (i) per-gt best anchor is positive; (ii) iou >= pos_thr is positive
+    gt_best = jnp.max(iou, axis=1)  # [G]
+    is_gt_best = jnp.any(
+        (iou == gt_best[:, None]) & gt_valid[:, None] & (gt_best[:, None] > 0),
+        axis=0,
+    )
+    pos = inside & ((anchor_best >= pos_thr) | is_gt_best)
+    neg = inside & ~pos & (anchor_best < neg_thr) & (anchor_best >= 0)
+
+    k1, k2 = jax.random.split(key)
+    if use_random:
+        fg_score = jnp.where(pos, jax.random.uniform(k1, (a,)), -jnp.inf)
+        bg_score = jnp.where(neg, jax.random.uniform(k2, (a,)), -jnp.inf)
+    else:
+        fg_score = jnp.where(pos, anchor_best, -jnp.inf)
+        bg_score = jnp.where(neg, -anchor_best, -jnp.inf)
+    fg_idx = jnp.argsort(-fg_score)[:n_fg]
+    fg_ok = pos[fg_idx]
+    num_fg = jnp.sum(fg_ok)
+    n_bg = n_all - n_fg
+    bg_idx = jnp.argsort(-bg_score)[:n_bg]
+    bg_ok = neg[bg_idx] & (jnp.arange(n_bg) < (n_all - num_fg))
+
+    loc_index = jnp.where(fg_ok, fg_idx, -1).astype(jnp.int32)
+    score_index = jnp.concatenate(
+        [loc_index, jnp.where(bg_ok, bg_idx, -1).astype(jnp.int32)]
+    )
+    tgt_label = jnp.concatenate(
+        [fg_ok.astype(jnp.int32), jnp.zeros((n_bg,), jnp.int32)]
+    )
+    label_w = jnp.concatenate([fg_ok, bg_ok]).astype(jnp.float32)
+    matched_gt = gt[anchor_gt[jnp.maximum(fg_idx, 0)]]
+    tgt_bbox = _rpn_encode(anchors[jnp.maximum(fg_idx, 0)], matched_gt)
+    bbox_w = jnp.broadcast_to(fg_ok[:, None].astype(jnp.float32), (n_fg, 4))
+    return loc_index, score_index, tgt_bbox, tgt_label, bbox_w, label_w
+
+
+def _lower_rpn_target_assign(ctx, ins, attrs):
+    anchors = ins["Anchor"][0]
+    if anchors.ndim == 4:
+        anchors = jnp.reshape(anchors, (-1, 4))
+    gt = ins["GtBoxes"][0]  # [N, G, 4]
+    im_info = ins["ImInfo"][0]  # [N, 3]
+    n = gt.shape[0]
+    keys = jax.random.split(ctx.rng(), n)
+    outs = jax.vmap(
+        lambda g, ii, k: _rpn_assign_single(anchors, g, ii, k, attrs)
+    )(gt, im_info, keys)
+    names = [
+        "LocIndex",
+        "ScoreIndex",
+        "TargetBBox",
+        "TargetLabel",
+        "BBoxInsideWeight",
+        "LabelWeight",
+    ]
+    return dict(zip(names, outs))
+
+
+register_op(
+    "rpn_target_assign",
+    inputs=["Anchor", "GtBoxes", "IsCrowd", "ImInfo"],
+    outputs=[
+        "LocIndex",
+        "ScoreIndex",
+        "TargetBBox",
+        "TargetLabel",
+        "BBoxInsideWeight",
+        "LabelWeight",
+    ],
+    attrs={
+        "rpn_batch_size_per_im": 256,
+        "rpn_straddle_thresh": 0.0,
+        "rpn_fg_fraction": 0.5,
+        "rpn_positive_overlap": 0.7,
+        "rpn_negative_overlap": 0.3,
+        "use_random": True,
+    },
+    lower=_lower_rpn_target_assign,
+    grad=None,
+)
+
+
+def _gen_proposals_single(scores, deltas, im_info, anchors, variances, attrs):
+    """scores [A], deltas [A,4], anchors [A,4] -> (rois [post_n,4], valid)."""
+    pre_n = attrs.get("pre_nms_topN", 6000)
+    post_n = attrs.get("post_nms_topN", 1000)
+    nms_thr = attrs.get("nms_thresh", 0.5)
+    min_size = attrs.get("min_size", 0.1)
+    a = scores.shape[0]
+    k = min(pre_n, a)
+    top = jnp.argsort(-scores)[:k]
+    sc = scores[top]
+    d = deltas[top]
+    an = anchors[top]
+    var = variances[top]
+    # decode (anchor + variance-scaled deltas), generate_proposals_op.cc BoxCoder
+    aw = an[:, 2] - an[:, 0] + 1.0
+    ah = an[:, 3] - an[:, 1] + 1.0
+    acx = an[:, 0] + aw * 0.5
+    acy = an[:, 1] + ah * 0.5
+    cx = var[:, 0] * d[:, 0] * aw + acx
+    cy = var[:, 1] * d[:, 1] * ah + acy
+    wf = jnp.exp(jnp.minimum(var[:, 2] * d[:, 2], 10.0)) * aw
+    hf = jnp.exp(jnp.minimum(var[:, 3] * d[:, 3], 10.0)) * ah
+    boxes = jnp.stack(
+        [cx - wf * 0.5, cy - hf * 0.5, cx + wf * 0.5 - 1, cy + hf * 0.5 - 1],
+        axis=1,
+    )
+    # clip to image
+    ih, iw = im_info[0], im_info[1]
+    boxes = jnp.stack(
+        [
+            jnp.clip(boxes[:, 0], 0, iw - 1),
+            jnp.clip(boxes[:, 1], 0, ih - 1),
+            jnp.clip(boxes[:, 2], 0, iw - 1),
+            jnp.clip(boxes[:, 3], 0, ih - 1),
+        ],
+        axis=1,
+    )
+    # filter small (scaled by im_info[2])
+    ms = min_size * im_info[2]
+    keep_size = ((boxes[:, 2] - boxes[:, 0] + 1) >= ms) & (
+        (boxes[:, 3] - boxes[:, 1] + 1) >= ms
+    )
+    sc = jnp.where(keep_size, sc, -jnp.inf)
+    # NMS over the k candidates (already score-sorted)
+    iou = _iou(boxes, boxes)
+
+    def body(i, keep):
+        before = jnp.arange(k) < i
+        sup = jnp.any(keep & before & (iou[i] > nms_thr))
+        return keep.at[i].set(jnp.isfinite(sc[i]) & ~sup)
+
+    keep = lax.fori_loop(0, k, body, jnp.zeros((k,), bool))
+    # compact kept boxes to the front, fixed capacity post_n
+    sel = jnp.argsort(jnp.where(keep, jnp.arange(k), k))[:post_n]
+    out = jnp.where((keep[sel])[:, None], boxes[sel], 0.0)
+    valid = jnp.minimum(jnp.sum(keep), post_n).astype(jnp.int32)
+    probs = jnp.where(keep[sel], sc[sel], 0.0)
+    return out, probs, valid
+
+
+def _lower_generate_proposals(ctx, ins, attrs):
+    scores = ins["Scores"][0]  # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]  # [N, A*4, H, W]
+    im_info = ins["ImInfo"][0]  # [N, 3]
+    anchors = jnp.reshape(ins["Anchors"][0], (-1, 4))
+    variances = jnp.reshape(ins["Variances"][0], (-1, 4))
+    n, a, h, w = scores.shape
+    # [N, A, H, W] -> [N, H*W*A] matching anchors layout [H, W, A, 4]
+    sc = jnp.reshape(jnp.transpose(scores, (0, 2, 3, 1)), (n, -1))
+    dl = jnp.reshape(
+        jnp.transpose(jnp.reshape(deltas, (n, a, 4, h, w)), (0, 3, 4, 1, 2)),
+        (n, -1, 4),
+    )
+    rois, probs, valid = jax.vmap(
+        lambda s, d, ii: _gen_proposals_single(
+            s, d, ii, anchors, variances, attrs
+        )
+    )(sc, dl, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs, "RpnRoisCount": valid}
+
+
+register_op(
+    "generate_proposals",
+    inputs=["Scores", "BboxDeltas", "ImInfo", "Anchors", "Variances"],
+    outputs=["RpnRois", "RpnRoiProbs", "RpnRoisCount"],
+    attrs={
+        "pre_nms_topN": 6000,
+        "post_nms_topN": 1000,
+        "nms_thresh": 0.5,
+        "min_size": 0.1,
+        "eta": 1.0,
+    },
+    lower=_lower_generate_proposals,
+    grad=None,
+)
+
+
+# ---------------------------------------------------------------------------
+# detection_map: mean Average Precision metric. Reference:
+# paddle/fluid/operators/detection_map_op.cc (integral + 11point AP).
+# Dense formulation: detections [N, D, 6] padded with label -1; ground truth
+# as (label [N,G], box [N,G,4], difficult [N,G]) with label -1 padding.
+# ---------------------------------------------------------------------------
+
+
+def _lower_detection_map(ctx, ins, attrs):
+    det = ins["DetectRes"][0]  # [N, D, 6] (label, score, x1,y1,x2,y2)
+    gt_label = ins["GtLabel"][0].astype(jnp.int32)  # [N, G]
+    gt_box = ins["GtBox"][0]  # [N, G, 4]
+    if ins.get("GtDifficult"):
+        difficult = ins["GtDifficult"][0] > 0
+    else:
+        difficult = jnp.zeros(gt_label.shape, bool)
+    thr = attrs.get("overlap_threshold", 0.5)
+    eval_diff = attrs.get("evaluate_difficult", True)
+    ap_type = attrs.get("ap_type", "integral")
+    class_num = attrs.get("class_num")
+    bg = attrs.get("background_label", 0)
+
+    n, d_cap, _ = det.shape
+    g_cap = gt_label.shape[1]
+    gt_valid = gt_label >= 0
+    if not eval_diff:
+        gt_valid = gt_valid & ~difficult
+    det_label = det[:, :, 0].astype(jnp.int32)
+    det_score = det[:, :, 1]
+    det_valid = det[:, :, 0] >= 0
+
+    # IoU of every detection against every gt in its image: [N, D, G]
+    iou = jax.vmap(_iou)(det[:, :, 2:6], gt_box)
+
+    # Greedy match in global score order (per class), as the reference does
+    # per-image; cross-image order does not change per-image greedy results.
+    flat_score = jnp.reshape(jnp.where(det_valid, det_score, -jnp.inf), (-1,))
+    order = jnp.argsort(-flat_score)  # [N*D]
+
+    aps = []
+    for cls in range(class_num):
+        if cls == bg:
+            continue
+        n_pos = jnp.sum(gt_valid & (gt_label == cls))
+        cls_det = det_valid & (det_label == cls)
+
+        def body(t, carry):
+            matched, tp, fp = carry
+            k = order[t]
+            img, j = k // d_cap, k % d_cap
+            is_cls = cls_det[img, j]
+            overlaps = jnp.where(
+                gt_valid[img] & (gt_label[img] == cls), iou[img, j], -1.0
+            )
+            best_g = jnp.argmax(overlaps)
+            best = overlaps[best_g]
+            hit = is_cls & (best >= thr) & ~matched[img, best_g]
+            is_diff = difficult[img, best_g] & (best >= thr)
+            ignore = is_cls & (not eval_diff) & is_diff
+            matched = matched.at[img, best_g].set(matched[img, best_g] | hit)
+            tp = tp.at[t].set(jnp.where(is_cls & ~ignore, hit, False))
+            fp = fp.at[t].set(jnp.where(is_cls & ~ignore, ~hit, False))
+            return matched, tp, fp
+
+        total = n * d_cap
+        matched0 = jnp.zeros((n, g_cap), bool)
+        tp0 = jnp.zeros((total,), bool)
+        fp0 = jnp.zeros((total,), bool)
+        _, tp, fp = lax.fori_loop(0, total, body, (matched0, tp0, fp0))
+        ctp = jnp.cumsum(tp.astype(jnp.float32))
+        cfp = jnp.cumsum(fp.astype(jnp.float32))
+        denom = jnp.maximum(ctp + cfp, 1e-10)
+        precision = ctp / denom
+        recall = ctp / jnp.maximum(n_pos.astype(jnp.float32), 1e-10)
+        active = (tp | fp)
+        if ap_type == "11point":
+            pts = []
+            for r in np.arange(0.0, 1.1, 0.1):
+                m = active & (recall >= r)
+                pts.append(jnp.max(jnp.where(m, precision, 0.0)))
+            ap = jnp.sum(jnp.stack(pts)) / 11.0
+        else:  # integral
+            prev_recall = jnp.concatenate([jnp.zeros((1,)), recall[:-1]])
+            ap = jnp.sum(
+                jnp.where(active, (recall - prev_recall) * precision, 0.0)
+            )
+        aps.append(jnp.where(n_pos > 0, ap, jnp.nan))
+    stacked = jnp.stack(aps)
+    present = jnp.isfinite(stacked)
+    m_ap = jnp.sum(jnp.where(present, stacked, 0.0)) / jnp.maximum(
+        jnp.sum(present), 1
+    )
+    return {"MAP": m_ap}
+
+
+register_op(
+    "detection_map",
+    inputs=["DetectRes", "GtLabel", "GtBox", "GtDifficult"],
+    outputs=["MAP"],
+    attrs={
+        "overlap_threshold": 0.5,
+        "evaluate_difficult": True,
+        "ap_type": "integral",
+        "class_num": 2,
+        "background_label": 0,
+    },
+    lower=_lower_detection_map,
     grad=None,
 )

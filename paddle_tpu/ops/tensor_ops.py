@@ -469,3 +469,20 @@ register_op(
     ),
     grad=None,
 )
+
+
+def _lower_batched_gather(ctx, ins, attrs):
+    x = ins["X"][0]  # [N, A, ...]
+    idx = ins["Index"][0].astype(jnp.int32)  # [N, S]; negatives clamp to 0
+    safe = jnp.maximum(idx, 0)
+    idxe = jnp.reshape(safe, safe.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, idxe, axis=1)
+
+
+register_op(
+    "batched_gather",
+    inputs=["X", "Index"],
+    outputs=["Out"],
+    lower=_lower_batched_gather,
+    no_grad_inputs=("Index",),
+)

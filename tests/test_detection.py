@@ -1,0 +1,479 @@
+"""Detection op/layer tests against brute-force numpy oracles.
+
+Reference test strategy: tests/unittests/test_{bipartite_match,multiclass_nms,
+anchor_generator,density_prior_box,roi_pool,roi_align,rpn_target_assign,
+detection_map,polygon_box_transform}_op.py and test_detection.py — each op is
+checked against an independent host-side implementation, then an SSD-style
+loss is trained end-to-end on synthetic boxes.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(build_fn, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    outs = exe.run(main, feed=feed, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs]
+
+
+def _np_iou(a, b):
+    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+def _rand_boxes(rng, n, scale=1.0):
+    xy = rng.uniform(0, 0.7 * scale, (n, 2))
+    wh = rng.uniform(0.1 * scale, 0.3 * scale, (n, 2))
+    return np.concatenate([xy, xy + wh], axis=1).astype("float32")
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match
+# ---------------------------------------------------------------------------
+
+
+def _np_bipartite(dist, match_type, thr):
+    g, p = dist.shape
+    d = dist.copy()
+    row_valid = d.max(axis=1) > 0
+    d[~row_valid] = -1.0
+    midx = np.full(p, -1, np.int32)
+    mdist = np.zeros(p, np.float32)
+    work = d.copy()
+    for _ in range(min(g, p)):
+        k = np.argmax(work)
+        r, c = k // p, k % p
+        if work[r, c] <= 0:
+            break
+        midx[c] = r
+        mdist[c] = work[r, c]
+        work[r, :] = -1
+        work[:, c] = -1
+    if match_type == "per_prediction":
+        best = d.max(axis=0)
+        best_row = d.argmax(axis=0)
+        for c in range(p):
+            if midx[c] < 0 and best[c] >= thr:
+                midx[c] = best_row[c]
+                mdist[c] = best[c]
+    return midx, mdist
+
+
+@pytest.mark.parametrize("match_type", ["bipartite", "per_prediction"])
+def test_bipartite_match_matches_numpy(match_type):
+    rng = np.random.RandomState(7)
+    n, g, p = 2, 3, 8
+    gt = np.stack([_rand_boxes(rng, g) for _ in range(n)])
+    gt[1, 2] = 0.0  # padded gt row
+    priors = _rand_boxes(rng, p)
+    dist = np.stack([_np_iou(gt[i], priors) for i in range(n)])
+
+    def build():
+        d = fluid.layers.data("dist", [g, p], append_batch_size=True)
+        mi, md = fluid.layers.bipartite_match(d, match_type, 0.3)
+        return mi, md
+
+    mi, md = _run(build, {"dist": dist.astype("float32")})
+    for i in range(n):
+        emi, emd = _np_bipartite(dist[i], match_type, 0.3)
+        np.testing.assert_array_equal(mi[i], emi)
+        np.testing.assert_allclose(md[i], emd, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# target_assign
+# ---------------------------------------------------------------------------
+
+
+def test_target_assign_gathers_matched_rows():
+    rng = np.random.RandomState(3)
+    n, g, p, k = 2, 3, 5, 4
+    x = rng.randn(n, g, k).astype("float32")
+    midx = np.array([[0, -1, 2, 1, -1], [2, 2, -1, 0, 1]], np.int32)
+
+    def build():
+        xv = fluid.layers.data("x", [g, k])
+        mv = fluid.layers.data("m", [p], dtype="int32")
+        out, w = fluid.layers.target_assign(xv, mv, mismatch_value=0)
+        return out, w
+
+    out, w = _run(build, {"x": x, "m": midx})
+    for i in range(n):
+        for j in range(p):
+            if midx[i, j] >= 0:
+                np.testing.assert_allclose(out[i, j], x[i, midx[i, j]], rtol=1e-6)
+                assert w[i, j, 0] == 1.0
+            else:
+                np.testing.assert_array_equal(out[i, j], np.zeros(k))
+                assert w[i, j, 0] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# multiclass NMS
+# ---------------------------------------------------------------------------
+
+
+def _np_nms(boxes, scores, score_thr, nms_thr, top_k):
+    order = np.argsort(-scores)[:top_k]
+    keep = []
+    for i in order:
+        if scores[i] <= score_thr:
+            continue
+        ok = True
+        for j in keep:
+            if _np_iou(boxes[i:i + 1], boxes[j:j + 1])[0, 0] > nms_thr:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+    return keep
+
+
+def test_multiclass_nms_matches_numpy():
+    rng = np.random.RandomState(11)
+    n, c, p = 2, 3, 12
+    boxes = np.stack([_rand_boxes(rng, p) for _ in range(n)])
+    scores = rng.uniform(0, 1, (n, c, p)).astype("float32")
+
+    def build():
+        bv = fluid.layers.data("b", [p, 4])
+        sv = fluid.layers.data("s", [c, p])
+        out, count = fluid.layers.multiclass_nms(
+            bv, sv, background_label=0, score_threshold=0.3,
+            nms_top_k=10, nms_threshold=0.4, keep_top_k=6)
+        return out, count
+
+    out, count = _run(build, {"b": boxes, "s": scores})
+    for i in range(n):
+        expected = []
+        for cls in range(1, c):
+            for j in _np_nms(boxes[i], scores[i, cls], 0.3, 0.4, 10):
+                expected.append((cls, scores[i, cls, j], j))
+        expected.sort(key=lambda t: -t[1])
+        expected = expected[:6]
+        assert count[i] == len(expected)
+        got = out[i][out[i][:, 0] >= 0]
+        assert got.shape[0] == len(expected)
+        for row, (cls, sc, j) in zip(got, expected):
+            assert int(row[0]) == cls
+            np.testing.assert_allclose(row[1], sc, rtol=1e-5)
+            np.testing.assert_allclose(row[2:6], boxes[i, j], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# anchor / density prior generators
+# ---------------------------------------------------------------------------
+
+
+def test_anchor_generator_matches_reference_formula():
+    def build():
+        feat = fluid.layers.data("feat", [8, 2, 2], append_batch_size=True)
+        anchors, variances = fluid.layers.anchor_generator(
+            feat, anchor_sizes=[32.0, 64.0], aspect_ratios=[0.5, 1.0],
+            variance=[0.1, 0.1, 0.2, 0.2], stride=[16.0, 16.0], offset=0.5)
+        return anchors, variances
+
+    a, v = _run(build, {"feat": np.zeros((1, 8, 2, 2), "float32")})
+    assert a.shape == (2, 2, 4, 4) and v.shape == (2, 2, 4, 4)
+    # anchor (h=0, w=0, ratio=0.5, size=32): reference anchor_generator_op.h
+    sw = sh = 16.0
+    x_ctr = 0.5 * (sw - 1)
+    y_ctr = 0.5 * (sh - 1)
+    base_w = round(np.sqrt(sw * sh / 0.5))
+    base_h = round(base_w * 0.5)
+    aw = (32.0 / sw) * base_w
+    ah = (32.0 / sh) * base_h
+    np.testing.assert_allclose(
+        a[0, 0, 0],
+        [x_ctr - 0.5 * (aw - 1), y_ctr - 0.5 * (ah - 1),
+         x_ctr + 0.5 * (aw - 1), y_ctr + 0.5 * (ah - 1)],
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2], rtol=1e-6)
+
+
+def test_density_prior_box_counts_and_range():
+    def build():
+        feat = fluid.layers.data("feat", [4, 4, 4], append_batch_size=True)
+        img = fluid.layers.data("img", [3, 32, 32], append_batch_size=True)
+        boxes, variances = fluid.layers.density_prior_box(
+            feat, img, densities=[2, 1], fixed_sizes=[8.0, 16.0],
+            fixed_ratios=[1.0], clip=True)
+        return boxes, variances
+
+    b, v = _run(build, {
+        "feat": np.zeros((1, 4, 4, 4), "float32"),
+        "img": np.zeros((1, 3, 32, 32), "float32"),
+    })
+    # densities [2,1] with one ratio -> 2*2 + 1*1 = 5 priors per cell
+    assert b.shape == (4, 4, 5, 4)
+    assert (b >= 0).all() and (b <= 1).all()
+    assert v.shape == b.shape
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+
+
+def test_roi_pool_matches_numpy():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    rois = np.array([[0, 0, 7, 7], [2, 2, 6, 6], [1, 0, 5, 3]], "float32")
+    batch = np.array([0, 1, 1], "int32")
+    ph = pw = 2
+
+    def build():
+        xv = fluid.layers.data("x", [3, 8, 8])
+        rv = fluid.layers.data("r", [4], append_batch_size=True)
+        bv = fluid.layers.data("bi", [], dtype="int32", append_batch_size=True)
+        out = fluid.layers.roi_pool(xv, rv, ph, pw, 1.0, rois_batch=bv)
+        return (out,)
+
+    (out,) = _run(build, {"x": x, "r": rois, "bi": batch})
+    # numpy oracle (roi_pool_op.cc quantized bins)
+    for r in range(3):
+        x1, y1, x2, y2 = np.round(rois[r]).astype(int)
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for c in range(3):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = int(np.floor(i * rh / ph)) + y1
+                    he = int(np.ceil((i + 1) * rh / ph)) + y1
+                    ws = int(np.floor(j * rw / pw)) + x1
+                    we = int(np.ceil((j + 1) * rw / pw)) + x1
+                    hs, he = np.clip([hs, he], 0, 8)
+                    ws, we = np.clip([ws, we], 0, 8)
+                    patch = x[batch[r], c, hs:he, ws:we]
+                    exp = patch.max() if patch.size else 0.0
+                    np.testing.assert_allclose(out[r, c, i, j], exp, rtol=1e-5)
+
+
+def test_roi_align_shape_and_grad():
+    rng = np.random.RandomState(9)
+    x = rng.randn(1, 2, 8, 8).astype("float32")
+    rois = np.array([[0.5, 0.5, 6.5, 6.5], [2, 2, 5, 5]], "float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [2, 8, 8], stop_gradient=False)
+        rv = fluid.layers.data("r", [4], append_batch_size=True)
+        out = fluid.layers.roi_align(xv, rv, 3, 3, 1.0, sampling_ratio=2)
+        loss = fluid.layers.mean(out)
+        grads = fluid.backward.calc_gradient(loss, [xv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    o, g = exe.run(main, feed={"x": x, "r": rois}, fetch_list=[out, grads[0]])
+    assert np.asarray(o).shape == (2, 2, 3, 3)
+    g = np.asarray(g)
+    assert g.shape == x.shape and np.abs(g).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# RPN target assign + generate_proposals
+# ---------------------------------------------------------------------------
+
+
+def test_rpn_target_assign_labels_and_counts():
+    rng = np.random.RandomState(21)
+    a, n, g, s = 32, 2, 3, 16
+    anchors = (_rand_boxes(rng, a, scale=30.0)).astype("float32")
+    gt = np.stack([_rand_boxes(rng, g, scale=30.0) for _ in range(n)])
+    gt[0, 2] = 0.0  # padding
+    im_info = np.tile(np.array([[40.0, 40.0, 1.0]], "float32"), (n, 1))
+    bbox_pred = rng.randn(n, a, 4).astype("float32")
+    cls_logits = rng.randn(n, a, 1).astype("float32")
+
+    def build():
+        av = fluid.layers.data("a", [a, 4], append_batch_size=False)
+        gv = fluid.layers.data("g", [g, 4])
+        iv = fluid.layers.data("im", [3])
+        bp = fluid.layers.data("bp", [a, 4])
+        cl = fluid.layers.data("cl", [a, 1])
+        outs = fluid.layers.rpn_target_assign(
+            bp, cl, av, None, gv, im_info=iv, rpn_batch_size_per_im=s,
+            rpn_straddle_thresh=-1.0, rpn_fg_fraction=0.5,
+            rpn_positive_overlap=0.6, rpn_negative_overlap=0.3,
+            use_random=False)
+        return outs
+
+    logits, locs, tlabel, tbbox, bw, lw = _run(build, {
+        "a": anchors, "g": gt, "im": im_info,
+        "bp": bbox_pred, "cl": cls_logits,
+    })
+    n_fg = s // 2
+    assert logits.shape == (n, s, 1)
+    assert locs.shape == (n, n_fg, 4)
+    assert tlabel.shape == (n, s) and lw.shape == (n, s)
+    assert tbbox.shape == (n, n_fg, 4) and bw.shape == (n, n_fg, 4)
+    for i in range(n):
+        valid = lw[i] > 0
+        # positives come first; labels are 1/0; weights mask padding
+        assert set(np.unique(tlabel[i][valid])) <= {0, 1}
+        # every gt with nonzero box should create >= 1 positive (best-anchor rule)
+        n_valid_gt = int((gt[i].max(axis=1) > 0).sum())
+        assert tlabel[i][valid].sum() >= min(n_valid_gt, 1)
+
+
+def test_generate_proposals_runs_and_clips():
+    rng = np.random.RandomState(2)
+    n, a, h, w = 1, 3, 4, 4
+    scores = rng.uniform(0, 1, (n, a, h, w)).astype("float32")
+    deltas = (0.1 * rng.randn(n, a * 4, h, w)).astype("float32")
+    im_info = np.array([[32.0, 32.0, 1.0]], "float32")
+
+    def build():
+        sv = fluid.layers.data("s", [a, h, w])
+        dv = fluid.layers.data("d", [a * 4, h, w])
+        iv = fluid.layers.data("im", [3])
+        feat = fluid.layers.data("feat", [8, h, w])
+        anchors, variances = fluid.layers.anchor_generator(
+            feat, anchor_sizes=[8.0], aspect_ratios=[0.5, 1.0, 2.0],
+            stride=[8.0, 8.0])
+        rois, probs, count = fluid.layers.generate_proposals(
+            sv, dv, iv, anchors, variances, pre_nms_top_n=24,
+            post_nms_top_n=8, nms_thresh=0.7, min_size=1.0)
+        return rois, probs, count
+
+    rois, probs, count = _run(build, {
+        "s": scores, "d": deltas, "im": im_info,
+        "feat": np.zeros((1, 8, h, w), "float32"),
+    })
+    assert rois.shape[0] == 1 and rois.shape[2] == 4
+    assert 0 < count[0] <= 8
+    k = count[0]
+    assert (rois[0, :k, 0::2] >= 0).all() and (rois[0, :k, 0::2] <= 31).all()
+    assert (rois[0, :k, 1::2] >= 0).all() and (rois[0, :k, 1::2] <= 31).all()
+    # probs sorted descending among valid
+    p = probs[0, :k]
+    assert (np.diff(p) <= 1e-6).all()
+
+
+# ---------------------------------------------------------------------------
+# detection_map
+# ---------------------------------------------------------------------------
+
+
+def test_detection_map_perfect_and_mixed():
+    # image 0: one gt of class 1, detection hits it -> AP 1.0
+    det = np.zeros((1, 3, 6), "float32")
+    det[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]  # IoU 1 with gt
+    det[0, 1] = [1, 0.5, 0.6, 0.6, 0.9, 0.9]  # miss (FP)
+    det[0, 2] = [-1, 0, 0, 0, 0, 0]  # padding
+    gt_label = np.array([[1, -1]], "int32")
+    gt_box = np.zeros((1, 2, 4), "float32")
+    gt_box[0, 0] = [0.1, 0.1, 0.4, 0.4]
+
+    def build():
+        dv = fluid.layers.data("d", [3, 6])
+        lv = fluid.layers.data("l", [2], dtype="int32")
+        bv = fluid.layers.data("b", [2, 4])
+        m = fluid.layers.detection_map(dv, lv, bv, class_num=2,
+                                       overlap_threshold=0.5)
+        return (m,)
+
+    (m,) = _run(build, {"d": det, "l": gt_label, "b": gt_box})
+    # one TP at rank 0 (p=1, r=1), one FP at rank 1: integral AP = 1.0
+    np.testing.assert_allclose(m, 1.0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# polygon_box_transform
+# ---------------------------------------------------------------------------
+
+
+def test_polygon_box_transform_formula():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 4, 3, 3).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [4, 3, 3])
+        return (fluid.layers.polygon_box_transform(xv),)
+
+    (out,) = _run(build, {"x": x})
+    jj = np.arange(3)[None, :]
+    ii = np.arange(3)[:, None]
+    for c in range(4):
+        exp = (jj * 4.0 - x[0, c]) if c % 2 == 0 else (ii * 4.0 - x[0, c])
+        np.testing.assert_allclose(out[0, c], exp, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# SSD end-to-end: multi_box_head + ssd_loss trains on synthetic boxes
+# ---------------------------------------------------------------------------
+
+
+def test_ssd_loss_trains_on_synthetic_boxes():
+    rng = np.random.RandomState(42)
+    num_classes, g = 3, 2
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, 32, 32], stop_gradient=False)
+        gt_box = fluid.layers.data("gt_box", [g, 4])
+        gt_label = fluid.layers.data("gt_label", [g], dtype="int32")
+        c1 = fluid.layers.conv2d(img, 8, 3, stride=2, padding=1, act="relu")
+        c2 = fluid.layers.conv2d(c1, 8, 3, stride=2, padding=1, act="relu")
+        c3 = fluid.layers.conv2d(c2, 8, 3, stride=2, padding=1, act="relu")
+        loc, conf, boxes, variances = fluid.layers.multi_box_head(
+            inputs=[c2, c3], image=img, base_size=32,
+            num_classes=num_classes, aspect_ratios=[[1.0], [1.0]],
+            min_sizes=[8.0, 16.0], max_sizes=[16.0, 24.0], flip=False)
+        loss = fluid.layers.ssd_loss(loc, conf, gt_box, gt_label,
+                                     boxes, variances)
+        avg = fluid.layers.mean(loss)
+        opt = fluid.optimizer.Adam(learning_rate=5e-3)
+        opt.minimize(avg)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    def batch():
+        imgs = rng.rand(4, 3, 32, 32).astype("float32")
+        gb = np.stack([_rand_boxes(rng, g) for _ in range(4)])
+        gl = rng.randint(1, num_classes, (4, g)).astype("int32")
+        return {"img": imgs, "gt_box": gb.astype("float32"), "gt_label": gl}
+
+    losses = []
+    for _ in range(15):
+        (lv,) = exe.run(main, feed=batch(), fetch_list=[avg])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_detection_output_inference_path():
+    rng = np.random.RandomState(8)
+    n, p, c = 2, 6, 3
+    loc = (0.05 * rng.randn(n, p, 4)).astype("float32")
+    scores = rng.randn(n, p, c).astype("float32")
+    priors = _rand_boxes(rng, p)
+    pvar = np.tile(np.array([0.1, 0.1, 0.2, 0.2], "float32"), (p, 1))
+
+    def build():
+        lv = fluid.layers.data("loc", [p, 4])
+        sv = fluid.layers.data("sc", [p, c])
+        pb = fluid.layers.data("pb", [p, 4], append_batch_size=False)
+        pv = fluid.layers.data("pv", [p, 4], append_batch_size=False)
+        out = fluid.layers.detection_output(
+            lv, sv, pb, pv, nms_threshold=0.45, score_threshold=0.01,
+            nms_top_k=6, keep_top_k=4)
+        return (out,)
+
+    (out,) = _run(build, {"loc": loc, "sc": scores, "pb": priors, "pv": pvar})
+    assert out.shape == (n, 4, 6)
+    valid = out[out[:, :, 0] >= 0]
+    assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()
