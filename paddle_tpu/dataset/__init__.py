@@ -1,0 +1,27 @@
+"""Auto-download datasets (python/paddle/dataset parity, offline-capable).
+
+Each module exposes Fluid-style reader creators (``train()``/``test()``
+returning generators of samples). With no network, every dataset serves a
+deterministic learnable synthetic stream instead (see common.py).
+"""
+
+from paddle_tpu.dataset import (  # noqa: F401
+    cifar,
+    common,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    sentiment,
+    uci_housing,
+    voc2012,
+    wmt14,
+    wmt16,
+)
+
+__all__ = [
+    "cifar", "common", "conll05", "flowers", "imdb", "imikolov", "mnist",
+    "movielens", "sentiment", "uci_housing", "voc2012", "wmt14", "wmt16",
+]

@@ -347,6 +347,8 @@ def detection_map(detect_res, gt_label, gt_box, gt_difficult=None,
     paddle_tpu.metrics.DetectionMAP (host-side), this op scores one batch
     in-graph.
     """
+    if class_num is None:
+        raise ValueError("detection_map requires class_num")
     helper = LayerHelper("detection_map", name=name)
     m_ap = helper.create_variable_for_type_inference("float32",
                                                      stop_gradient=True)
@@ -404,11 +406,13 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var, gt_boxes,
 
     bbox_pred [N, A, 4], cls_logits [N, A, 1], anchor_box [A, 4],
     gt_boxes [N, G, 4] zero-padded, im_info [N, 3]. Returns
-    (predicted_cls_logits [N, S, 1], predicted_bbox_pred [N, S_fg, 4],
-    target_label [N, S], target_bbox [N, S_fg, 4],
-    bbox_inside_weight [N, S_fg, 4], label_weight [N, S]) where
-    S = rpn_batch_size_per_im, S_fg = round(S * fg_fraction); the trailing
-    weight output marks valid (non-padding) samples.
+    (predicted_cls_logits [N, S_fg+S, 1], predicted_bbox_pred [N, S_fg, 4],
+    target_label [N, S_fg+S], target_bbox [N, S_fg, 4],
+    bbox_inside_weight [N, S_fg, 4], label_weight [N, S_fg+S]) where
+    S = rpn_batch_size_per_im, S_fg = round(S * fg_fraction). Slots are
+    fixed capacity (fg slots first, then up to S - num_fg negatives);
+    label_weight marks the valid samples — exactly S of them when enough
+    candidates exist, fewer only when the image lacks candidates.
     Reference: rpn_target_assign_op.cc:490-560 + layers/detection.py:51.
     """
     helper = LayerHelper("rpn_target_assign")

@@ -81,13 +81,19 @@ register_op(
 )
 
 
-def _iou(a, b):
-    """a: [N,4], b: [M,4] -> [N,M] IoU (xmin,ymin,xmax,ymax)."""
-    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
-    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+def _iou(a, b, offset=0.0):
+    """a: [N,4], b: [M,4] -> [N,M] IoU (xmin,ymin,xmax,ymax).
+
+    offset=1.0 selects the unnormalized pixel-box convention
+    (w = x2 - x1 + 1), as the reference's JaccardOverlap(normalized=false).
+    """
+    area_a = jnp.maximum(a[:, 2] - a[:, 0] + offset, 0) * jnp.maximum(
+        a[:, 3] - a[:, 1] + offset, 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0] + offset, 0) * jnp.maximum(
+        b[:, 3] - b[:, 1] + offset, 0)
     lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
     rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
-    wh = jnp.maximum(rb - lt, 0)
+    wh = jnp.maximum(rb - lt + offset, 0)
     inter = wh[..., 0] * wh[..., 1]
     union = area_a[:, None] + area_b[None, :] - inter
     return inter / jnp.maximum(union, 1e-10)
@@ -334,7 +340,8 @@ register_op(
 # ---------------------------------------------------------------------------
 
 
-def _nms_single_class(boxes, scores, score_threshold, nms_threshold, eta, top_k):
+def _nms_single_class(boxes, scores, score_threshold, nms_threshold, eta, top_k,
+                      normalized=True):
     """Static NMS for one class. boxes [P,4], scores [P] ->
     (keep mask over the top_k candidates, cand indices [top_k])."""
     p = scores.shape[0]
@@ -342,7 +349,7 @@ def _nms_single_class(boxes, scores, score_threshold, nms_threshold, eta, top_k)
     cand = jnp.argsort(-scores)[:k]
     b = boxes[cand]
     s = scores[cand]
-    iou = _iou(b, b)
+    iou = _iou(b, b, offset=0.0 if normalized else 1.0)
     eligible = s > score_threshold
 
     def body(i, carry):
@@ -372,6 +379,7 @@ def _multiclass_nms_single(scores, boxes, attrs):
     eta = attrs.get("nms_eta", 1.0)
     nms_top_k = attrs.get("nms_top_k", -1)
     keep_top_k = attrs.get("keep_top_k", -1)
+    normalized = attrs.get("normalized", True)
     k = min(nms_top_k, p) if nms_top_k > 0 else p
 
     all_labels, all_scores, all_boxes = [], [], []
@@ -379,7 +387,7 @@ def _multiclass_nms_single(scores, boxes, attrs):
         if cls == bg:
             continue
         keep, cand = _nms_single_class(
-            boxes, scores[cls], score_thr, nms_thr, eta, k
+            boxes, scores[cls], score_thr, nms_thr, eta, k, normalized
         )
         all_labels.append(jnp.full((keep.shape[0],), cls, jnp.float32))
         all_scores.append(jnp.where(keep, scores[cls][cand], -jnp.inf))
@@ -568,7 +576,12 @@ register_op(
 
 
 def _roi_pool_one(x, roi, ph, pw, spatial_scale):
-    """x [C,H,W], roi [4] -> [C,ph,pw] quantized max pool (roi_pool_op.cc)."""
+    """x [C,H,W], roi [4] -> [C,ph,pw] quantized max pool (roi_pool_op.cc).
+
+    Separable masked max (rows then cols) keeps the largest intermediate at
+    [ph, C, W] instead of the naive [C, ph, pw, H, W] blowup, so realistic
+    Faster R-CNN sizes (R~128, C~256, 7x7) stay well inside HBM.
+    """
     c, h, w = x.shape
     rs = jnp.round(roi * spatial_scale)
     x1, y1 = rs[0], rs[1]
@@ -584,12 +597,20 @@ def _roi_pool_one(x, roi, ph, pw, spatial_scale):
     wend = jnp.clip(jnp.ceil((jj + 1) * bin_w) + x1, 0, w)
     hh = jnp.arange(h, dtype=jnp.float32)
     ww = jnp.arange(w, dtype=jnp.float32)
-    # mask [ph, pw, H, W]: pixel in bin
     hm = (hh[None, :] >= hstart[:, None]) & (hh[None, :] < hend[:, None])
     wm = (ww[None, :] >= wstart[:, None]) & (ww[None, :] < wend[:, None])
-    mask = hm[:, None, :, None] & wm[None, :, None, :]
-    vals = jnp.where(mask[None], x[:, None, None, :, :], -jnp.inf)
-    out = jnp.max(vals, axis=(3, 4))
+
+    def row_max(hmask):  # [H] -> [C, W] max over the bin's rows
+        return jnp.max(
+            jnp.where(hmask[None, :, None], x, -jnp.inf), axis=1
+        )
+
+    rows = jax.vmap(row_max)(hm)  # [ph, C, W]
+
+    def col_max(wmask):  # [W] -> [ph, C] max over the bin's cols
+        return jnp.max(jnp.where(wmask[None, None, :], rows, -jnp.inf), axis=2)
+
+    out = jnp.transpose(jax.vmap(col_max)(wm), (2, 1, 0))  # [C, ph, pw]
     return jnp.where(jnp.isfinite(out), out, 0.0)
 
 
@@ -744,7 +765,7 @@ def _rpn_encode(anchors, gt):
     )
 
 
-def _rpn_assign_single(anchors, gt, im_info, key, attrs):
+def _rpn_assign_single(anchors, gt, is_crowd, im_info, key, attrs):
     """anchors [A,4], gt [G,4] zero-padded, im_info [3] -> fixed-size samples."""
     bs = attrs.get("rpn_batch_size_per_im", 256)
     straddle = attrs.get("rpn_straddle_thresh", 0.0)
@@ -763,8 +784,15 @@ def _rpn_assign_single(anchors, gt, im_info, key, attrs):
         & (anchors[:, 2] < iw + straddle)
         & (anchors[:, 3] < ih + straddle)
     )
-    gt_valid = jnp.max(gt, axis=1) > 0  # zero-padded rows invalid
+    gt_valid = (jnp.max(gt, axis=1) > 0) & (is_crowd == 0)
     iou = _iou(gt, anchors)  # [G, A]
+    # anchors sitting on crowd regions are excluded from sampling entirely
+    # (reference rpn_target_assign_op.cc filters crowd gt + its anchors)
+    crowd_rows = (jnp.max(gt, axis=1) > 0) & (is_crowd != 0)
+    crowd_hit = jnp.any(
+        jnp.where(crowd_rows[:, None], iou, -1.0) >= neg_thr, axis=0
+    )
+    inside = inside & ~crowd_hit
     iou = jnp.where(gt_valid[:, None] & inside[None, :], iou, -1.0)
     anchor_best = jnp.max(iou, axis=0)  # [A]
     anchor_gt = jnp.argmax(iou, axis=0).astype(jnp.int32)
@@ -775,7 +803,9 @@ def _rpn_assign_single(anchors, gt, im_info, key, attrs):
         axis=0,
     )
     pos = inside & ((anchor_best >= pos_thr) | is_gt_best)
-    neg = inside & ~pos & (anchor_best < neg_thr) & (anchor_best >= 0)
+    # anchors overlapping nothing (incl. background-only images, where the
+    # whole IoU matrix is masked to -1) are negatives, as in the reference
+    neg = inside & ~pos & (anchor_best < neg_thr)
 
     k1, k2 = jax.random.split(key)
     if use_random:
@@ -787,16 +817,18 @@ def _rpn_assign_single(anchors, gt, im_info, key, attrs):
     fg_idx = jnp.argsort(-fg_score)[:n_fg]
     fg_ok = pos[fg_idx]
     num_fg = jnp.sum(fg_ok)
-    n_bg = n_all - n_fg
-    bg_idx = jnp.argsort(-bg_score)[:n_bg]
-    bg_ok = neg[bg_idx] & (jnp.arange(n_bg) < (n_all - num_fg))
+    # negative capacity is the full minibatch (an image with few positives
+    # takes bs - num_fg negatives, reference rpn_target_assign_op.cc); the
+    # ScoreIndex/TargetLabel slots are therefore n_fg + bs wide.
+    bg_idx = jnp.argsort(-bg_score)[:n_all]
+    bg_ok = neg[bg_idx] & (jnp.arange(n_all) < (n_all - num_fg))
 
     loc_index = jnp.where(fg_ok, fg_idx, -1).astype(jnp.int32)
     score_index = jnp.concatenate(
         [loc_index, jnp.where(bg_ok, bg_idx, -1).astype(jnp.int32)]
     )
     tgt_label = jnp.concatenate(
-        [fg_ok.astype(jnp.int32), jnp.zeros((n_bg,), jnp.int32)]
+        [fg_ok.astype(jnp.int32), jnp.zeros((n_all,), jnp.int32)]
     )
     label_w = jnp.concatenate([fg_ok, bg_ok]).astype(jnp.float32)
     matched_gt = gt[anchor_gt[jnp.maximum(fg_idx, 0)]]
@@ -810,12 +842,21 @@ def _lower_rpn_target_assign(ctx, ins, attrs):
     if anchors.ndim == 4:
         anchors = jnp.reshape(anchors, (-1, 4))
     gt = ins["GtBoxes"][0]  # [N, G, 4]
-    im_info = ins["ImInfo"][0]  # [N, 3]
-    n = gt.shape[0]
+    n, g = gt.shape[0], gt.shape[1]
+    if ins.get("ImInfo"):
+        im_info = ins["ImInfo"][0]  # [N, 3]
+    else:  # no image bounds: every anchor counts as inside
+        im_info = jnp.broadcast_to(
+            jnp.asarray([jnp.inf, jnp.inf, 1.0], jnp.float32), (n, 3)
+        )
+    if ins.get("IsCrowd"):
+        is_crowd = ins["IsCrowd"][0].astype(jnp.int32)  # [N, G]
+    else:
+        is_crowd = jnp.zeros((n, g), jnp.int32)
     keys = jax.random.split(ctx.rng(), n)
     outs = jax.vmap(
-        lambda g, ii, k: _rpn_assign_single(anchors, g, ii, k, attrs)
-    )(gt, im_info, keys)
+        lambda gb, ic, ii, k: _rpn_assign_single(anchors, gb, ic, ii, k, attrs)
+    )(gt, is_crowd, im_info, keys)
     names = [
         "LocIndex",
         "ScoreIndex",
@@ -857,6 +898,7 @@ def _gen_proposals_single(scores, deltas, im_info, anchors, variances, attrs):
     post_n = attrs.get("post_nms_topN", 1000)
     nms_thr = attrs.get("nms_thresh", 0.5)
     min_size = attrs.get("min_size", 0.1)
+    eta = attrs.get("eta", 1.0)
     a = scores.shape[0]
     k = min(pre_n, a)
     top = jnp.argsort(-scores)[:k]
@@ -894,15 +936,23 @@ def _gen_proposals_single(scores, deltas, im_info, anchors, variances, attrs):
         (boxes[:, 3] - boxes[:, 1] + 1) >= ms
     )
     sc = jnp.where(keep_size, sc, -jnp.inf)
-    # NMS over the k candidates (already score-sorted)
+    # NMS over the k candidates (already score-sorted), adaptive eta as in
+    # generate_proposals_op.cc / NMSFast
     iou = _iou(boxes, boxes)
 
-    def body(i, keep):
+    def body(i, carry):
+        keep, thr = carry
         before = jnp.arange(k) < i
-        sup = jnp.any(keep & before & (iou[i] > nms_thr))
-        return keep.at[i].set(jnp.isfinite(sc[i]) & ~sup)
+        sup = jnp.any(keep & before & (iou[i] > thr))
+        take = jnp.isfinite(sc[i]) & ~sup
+        keep = keep.at[i].set(take)
+        thr = jnp.where(take & (eta < 1.0) & (thr > 0.5), thr * eta, thr)
+        return keep, thr
 
-    keep = lax.fori_loop(0, k, body, jnp.zeros((k,), bool))
+    keep, _ = lax.fori_loop(
+        0, k, body,
+        (jnp.zeros((k,), bool), jnp.asarray(nms_thr, jnp.float32)),
+    )
     # compact kept boxes to the front, fixed capacity post_n
     sel = jnp.argsort(jnp.where(keep, jnp.arange(k), k))[:post_n]
     out = jnp.where((keep[sel])[:, None], boxes[sel], 0.0)
@@ -972,9 +1022,11 @@ def _lower_detection_map(ctx, ins, attrs):
 
     n, d_cap, _ = det.shape
     g_cap = gt_label.shape[1]
-    gt_valid = gt_label >= 0
-    if not eval_diff:
-        gt_valid = gt_valid & ~difficult
+    gt_exists = gt_label >= 0
+    # positives counted for recall exclude difficult gt when not evaluated;
+    # difficult gt stays matchable so detections on it are *ignored*, not FP
+    # (detection_map_op.cc semantics)
+    gt_countable = gt_exists if eval_diff else gt_exists & ~difficult
     det_label = det[:, :, 0].astype(jnp.int32)
     det_score = det[:, :, 1]
     det_valid = det[:, :, 0] >= 0
@@ -982,47 +1034,53 @@ def _lower_detection_map(ctx, ins, attrs):
     # IoU of every detection against every gt in its image: [N, D, G]
     iou = jax.vmap(_iou)(det[:, :, 2:6], gt_box)
 
-    # Greedy match in global score order (per class), as the reference does
-    # per-image; cross-image order does not change per-image greedy results.
+    # One greedy pass over ALL detections in global score order (the
+    # reference loops per image/class; per-image greedy results are order-
+    # independent across images, and class masking keeps matches in-class).
     flat_score = jnp.reshape(jnp.where(det_valid, det_score, -jnp.inf), (-1,))
     order = jnp.argsort(-flat_score)  # [N*D]
+    total = n * d_cap
 
+    def body(t, carry):
+        matched, tp, fp = carry
+        k = order[t]
+        img, j = k // d_cap, k % d_cap
+        cls = det_label[img, j]
+        overlaps = jnp.where(
+            gt_exists[img] & (gt_label[img] == cls), iou[img, j], -1.0
+        )
+        best_g = jnp.argmax(overlaps)
+        best = overlaps[best_g]
+        covered = best >= thr
+        hit = det_valid[img, j] & covered & ~matched[img, best_g]
+        ignore = (not eval_diff) & covered & difficult[img, best_g]
+        matched = matched.at[img, best_g].set(matched[img, best_g] | hit)
+        score = det_valid[img, j] & ~ignore
+        tp = tp.at[t].set(score & hit)
+        fp = fp.at[t].set(score & ~hit)
+        return matched, tp, fp
+
+    matched0 = jnp.zeros((n, g_cap), bool)
+    _, tp, fp = lax.fori_loop(
+        0, total, body,
+        (matched0, jnp.zeros((total,), bool), jnp.zeros((total,), bool)),
+    )
+
+    # per-class AP from the shared pass (vectorized; no further loops)
+    sorted_cls = jnp.reshape(det_label, (-1,))[order]
     aps = []
     for cls in range(class_num):
         if cls == bg:
             continue
-        n_pos = jnp.sum(gt_valid & (gt_label == cls))
-        cls_det = det_valid & (det_label == cls)
-
-        def body(t, carry):
-            matched, tp, fp = carry
-            k = order[t]
-            img, j = k // d_cap, k % d_cap
-            is_cls = cls_det[img, j]
-            overlaps = jnp.where(
-                gt_valid[img] & (gt_label[img] == cls), iou[img, j], -1.0
-            )
-            best_g = jnp.argmax(overlaps)
-            best = overlaps[best_g]
-            hit = is_cls & (best >= thr) & ~matched[img, best_g]
-            is_diff = difficult[img, best_g] & (best >= thr)
-            ignore = is_cls & (not eval_diff) & is_diff
-            matched = matched.at[img, best_g].set(matched[img, best_g] | hit)
-            tp = tp.at[t].set(jnp.where(is_cls & ~ignore, hit, False))
-            fp = fp.at[t].set(jnp.where(is_cls & ~ignore, ~hit, False))
-            return matched, tp, fp
-
-        total = n * d_cap
-        matched0 = jnp.zeros((n, g_cap), bool)
-        tp0 = jnp.zeros((total,), bool)
-        fp0 = jnp.zeros((total,), bool)
-        _, tp, fp = lax.fori_loop(0, total, body, (matched0, tp0, fp0))
-        ctp = jnp.cumsum(tp.astype(jnp.float32))
-        cfp = jnp.cumsum(fp.astype(jnp.float32))
-        denom = jnp.maximum(ctp + cfp, 1e-10)
-        precision = ctp / denom
+        sel = sorted_cls == cls
+        n_pos = jnp.sum(gt_countable & (gt_label == cls))
+        tpc = tp & sel
+        fpc = fp & sel
+        ctp = jnp.cumsum(tpc.astype(jnp.float32))
+        cfp = jnp.cumsum(fpc.astype(jnp.float32))
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
         recall = ctp / jnp.maximum(n_pos.astype(jnp.float32), 1e-10)
-        active = (tp | fp)
+        active = tpc | fpc
         if ap_type == "11point":
             pts = []
             for r in np.arange(0.0, 1.1, 0.1):

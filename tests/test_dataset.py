@@ -1,0 +1,173 @@
+"""Dataset package tests (python/paddle/dataset parity).
+
+Runs with PADDLE_TPU_DATASET=synthetic so no network is touched: each
+module must serve deterministic, well-formed, learnable samples. The
+recognize-digits book test then trains on the mnist reader exactly as the
+reference's test_recognize_digits does on real MNIST — when a cached real
+download exists the same test consumes it transparently (common.py contract).
+Reference: python/paddle/dataset/tests/*, book/test_recognize_digits.py.
+"""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("PADDLE_TPU_DATASET", "synthetic")
+
+import paddle_tpu as fluid
+import paddle_tpu.dataset as ds
+from paddle_tpu.dataset import common
+
+
+def _take(reader, n):
+    return list(itertools.islice(reader(), n))
+
+
+def test_mnist_shapes_and_determinism():
+    a = _take(ds.mnist.train(), 32)
+    b = _take(ds.mnist.train(), 32)
+    assert len(a) == 32
+    for (img, lbl), (img2, lbl2) in zip(a, b):
+        assert img.shape == (784,) and img.dtype == np.float32
+        assert -1.0 <= img.min() and img.max() <= 1.0
+        assert 0 <= lbl <= 9
+        np.testing.assert_array_equal(img, img2)
+        assert lbl == lbl2
+    test_set = _take(ds.mnist.test(), 16)
+    assert len(test_set) == 16
+
+
+def test_cifar_readers():
+    for reader, classes in [(ds.cifar.train10(), 10), (ds.cifar.test10(), 10),
+                            (ds.cifar.train100(), 100)]:
+        img, lbl = _take(reader, 2)[0]
+        assert img.shape == (3072,) and img.dtype == np.float32
+        assert 0 <= lbl < classes
+
+
+def test_uci_housing_feature_scaling():
+    rows = _take(ds.uci_housing.train(), 64)
+    x = np.stack([r[0] for r in rows])
+    y = np.stack([r[1] for r in rows])
+    assert x.shape == (64, 13) and y.shape == (64, 1)
+    assert np.isfinite(x).all() and np.isfinite(y).all()
+
+
+def test_imdb_word_dict_and_readers():
+    wd = ds.imdb.word_dict()
+    assert len(wd) > 50
+    sample = _take(ds.imdb.train(wd), 4)
+    for words, label in sample:
+        assert len(words) > 0 and all(isinstance(w, int) for w in words)
+        assert label in (0, 1)
+
+
+def test_imikolov_ngrams():
+    wd = ds.imikolov.build_dict(min_word_freq=1)
+    n = 5
+    grams = _take(ds.imikolov.train(wd, n), 8)
+    assert all(len(g) == n for g in grams)
+    vocab = len(wd)
+    assert all(0 <= w < vocab for g in grams for w in g)
+
+
+def test_movielens_schema():
+    rows = _take(ds.movielens.train(), 8)
+    assert len(rows) == 8
+    assert ds.movielens.max_user_id() > 0
+    # each row: user features..., movie features..., rating (last)
+    for row in rows:
+        assert np.isfinite(float(np.asarray(row[-1]).reshape(-1)[0]))
+
+
+def test_conll05_srl_samples():
+    rows = _take(ds.conll05.test(), 4)
+    word_dict, verb_dict, label_dict = ds.conll05.get_dict()
+    assert len(word_dict) > 0 and len(label_dict) > 0
+    for row in rows:
+        # (words, ctx_n2..ctx_p2, verb, mark, labels) per the reference layout
+        assert len(row) >= 3
+
+
+def test_image_datasets():
+    img, lbl = _take(ds.flowers.train(), 1)[0]
+    assert img.ndim == 1 and img.size % 3 == 0
+    img2, seg = _take(ds.voc2012.train(), 1)[0]
+    assert img2.ndim >= 1
+
+
+def test_sentiment_reader():
+    wd = ds.sentiment.get_word_dict()
+    rows = _take(ds.sentiment.train(), 4)
+    for words, label in rows:
+        assert label in (0, 1) and len(words) > 0
+
+
+@pytest.mark.parametrize("mod,args", [
+    ("wmt14", (30,)),
+    ("wmt16", (30, 30)),
+])
+def test_wmt_translation_pairs(mod, args):
+    reader = getattr(ds, mod).train(*args)
+    rows = _take(reader, 4)
+    for row in rows:
+        src, trg = row[0], row[1]
+        assert len(src) > 0 and len(trg) > 0
+        assert all(0 <= w < args[0] for w in src)
+
+
+def test_common_download_uses_cache(tmp_path):
+    # a file:// URL exercises download+md5 without network
+    src = tmp_path / "blob.bin"
+    src.write_bytes(b"hello paddle_tpu")
+    md5 = common.md5file(str(src))
+    old_home, common.DATA_HOME = common.DATA_HOME, str(tmp_path / "cache")
+    old_mode = os.environ.get("PADDLE_TPU_DATASET")
+    os.environ["PADDLE_TPU_DATASET"] = "auto"
+    try:
+        p1 = common.download("file://" + str(src), "t", md5)
+        assert os.path.exists(p1)
+        os.remove(src)  # cached copy must now satisfy the second call
+        p2 = common.download("file://" + str(src), "t", md5)
+        assert p1 == p2
+        with pytest.raises(IOError):
+            common.download("file://" + str(tmp_path / "missing"), "t")
+    finally:
+        common.DATA_HOME = old_home
+        os.environ["PADDLE_TPU_DATASET"] = old_mode or "synthetic"
+
+
+def test_recognize_digits_trains_on_mnist_reader():
+    """Book test: MLP on the mnist dataset reader to an accuracy threshold
+    (reference book/test_recognize_digits.py; real data when cached,
+    synthetic-template fallback offline — either stream is learnable)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [784], stop_gradient=False)
+        label = fluid.layers.data("label", [1], dtype="int64")
+        h = fluid.layers.fc(img, 64, act="relu")
+        logits = fluid.layers.fc(h, 10, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    reader = ds.mnist.train()
+    batch = []
+    accs = []
+    for epoch in range(3):
+        for sample in itertools.islice(reader(), 512):
+            batch.append(sample)
+            if len(batch) == 64:
+                imgs = np.stack([s[0] for s in batch]).astype("float32")
+                lbls = np.array([[s[1]] for s in batch], "int64")
+                _, a = exe.run(main, feed={"img": imgs, "label": lbls},
+                               fetch_list=[loss, acc])
+                accs.append(float(np.asarray(a).reshape(-1)[0]))
+                batch = []
+    assert np.mean(accs[-4:]) > 0.8, "final train acc %s" % accs[-4:]

@@ -315,9 +315,10 @@ def test_rpn_target_assign_labels_and_counts():
         "bp": bbox_pred, "cl": cls_logits,
     })
     n_fg = s // 2
-    assert logits.shape == (n, s, 1)
+    cap = n_fg + s  # fg slots + full-minibatch negative capacity
+    assert logits.shape == (n, cap, 1)
     assert locs.shape == (n, n_fg, 4)
-    assert tlabel.shape == (n, s) and lw.shape == (n, s)
+    assert tlabel.shape == (n, cap) and lw.shape == (n, cap)
     assert tbbox.shape == (n, n_fg, 4) and bw.shape == (n, n_fg, 4)
     for i in range(n):
         valid = lw[i] > 0
@@ -325,7 +326,67 @@ def test_rpn_target_assign_labels_and_counts():
         assert set(np.unique(tlabel[i][valid])) <= {0, 1}
         # every gt with nonzero box should create >= 1 positive (best-anchor rule)
         n_valid_gt = int((gt[i].max(axis=1) > 0).sum())
-        assert tlabel[i][valid].sum() >= min(n_valid_gt, 1)
+        num_pos = tlabel[i][valid].sum()
+        assert num_pos >= min(n_valid_gt, 1)
+        # with plentiful anchors the minibatch is filled: pos + neg == S
+        assert valid.sum() == s
+
+
+def test_rpn_target_assign_background_only_image():
+    """All-padding gt: every inside anchor is a negative candidate and the
+    minibatch is filled with background samples (reference behavior)."""
+    rng = np.random.RandomState(4)
+    a, g, s = 24, 2, 8
+    anchors = _rand_boxes(rng, a, scale=30.0)
+    gt = np.zeros((1, g, 4), "float32")
+    im_info = np.array([[40.0, 40.0, 1.0]], "float32")
+
+    def build():
+        av = fluid.layers.data("a", [a, 4], append_batch_size=False)
+        gv = fluid.layers.data("g", [g, 4])
+        iv = fluid.layers.data("im", [3])
+        bp = fluid.layers.data("bp", [a, 4])
+        cl = fluid.layers.data("cl", [a, 1])
+        return fluid.layers.rpn_target_assign(
+            bp, cl, av, None, gv, im_info=iv, rpn_batch_size_per_im=s,
+            rpn_straddle_thresh=-1.0, use_random=False)
+
+    outs = _run(build, {
+        "a": anchors, "g": gt, "im": im_info,
+        "bp": rng.randn(1, a, 4).astype("float32"),
+        "cl": rng.randn(1, a, 1).astype("float32"),
+    })
+    tlabel, lw = outs[2], outs[5]
+    valid = lw[0] > 0
+    assert valid.sum() == s  # full minibatch of negatives
+    assert (tlabel[0][valid] == 0).all()
+
+
+def test_detection_map_ignores_difficult_when_not_evaluated():
+    # det 0 hits a difficult gt -> ignored (not FP); det 1 hits normal gt
+    det = np.zeros((1, 2, 6), "float32")
+    det[0, 0] = [1, 0.9, 0.5, 0.5, 0.8, 0.8]  # on difficult gt
+    det[0, 1] = [1, 0.8, 0.1, 0.1, 0.4, 0.4]  # on normal gt
+    gt_label = np.array([[1, 1]], "int32")
+    gt_box = np.zeros((1, 2, 4), "float32")
+    gt_box[0, 0] = [0.1, 0.1, 0.4, 0.4]  # normal
+    gt_box[0, 1] = [0.5, 0.5, 0.8, 0.8]  # difficult
+    difficult = np.array([[0.0, 1.0]], "float32")
+
+    def build():
+        dv = fluid.layers.data("d", [2, 6])
+        lv = fluid.layers.data("l", [2], dtype="int32")
+        bv = fluid.layers.data("b", [2, 4])
+        fv = fluid.layers.data("f", [2])
+        m = fluid.layers.detection_map(dv, lv, bv, gt_difficult=fv,
+                                       class_num=2,
+                                       evaluate_difficult=False)
+        return (m,)
+
+    (m,) = _run(build, {"d": det, "l": gt_label, "b": gt_box, "f": difficult})
+    # difficult det ignored; remaining det is a clean TP on the 1 countable
+    # gt -> AP 1.0 (were the difficult hit counted as FP, AP would be 0.5)
+    np.testing.assert_allclose(m, 1.0, atol=1e-5)
 
 
 def test_generate_proposals_runs_and_clips():
