@@ -16,6 +16,7 @@ __all__ = [
     "conv2d_transpose",
     "depthwise_conv2d",
     "pool2d",
+    "pool3d",
     "batch_norm",
     "layer_norm",
     "group_norm",
@@ -960,5 +961,30 @@ def batched_gather(input, index):
         type="batched_gather",
         inputs={"X": [input], "Index": [index]},
         outputs={"Out": [out]},
+    )
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    """NCDHW 3D pooling (pool_op.cc pool3d registration)."""
+    def _t(v):
+        return [v, v, v] if isinstance(v, int) else list(v)
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="pool3d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": _t(pool_size),
+            "strides": _t(pool_stride),
+            "paddings": _t(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        },
     )
     return out

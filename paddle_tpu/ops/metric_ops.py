@@ -210,3 +210,74 @@ register_op(
     lower=_lower_chunk_eval,
     grad=None,
 )
+
+
+def _lower_precision_recall(ctx, ins, attrs):
+    """Multi-class precision/recall/F1 (precision_recall_op.cc).
+
+    Per sample with predicted class p, gold class l, weight w:
+    p == l -> TP[l] += w; else FP[p] += w, FN[l] += w; classes not involved
+    get TN += w. BatchMetrics/AccumMetrics are [macro-P, macro-R, macro-F1,
+    micro-P, micro-R, micro-F1]; AccumStatesInfo accumulates [C, 4] stats
+    (TP, FP, TN, FN) on top of the StatesInfo input.
+    """
+    idx = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    c = attrs["class_number"]
+    if ins.get("Weights"):
+        w = ins["Weights"][0].reshape(-1).astype(jnp.float32)
+    else:
+        w = jnp.ones(idx.shape, jnp.float32)
+    one_p = jax.nn.one_hot(idx, c, dtype=jnp.float32)
+    one_l = jax.nn.one_hot(label, c, dtype=jnp.float32)
+    correct = (idx == label).astype(jnp.float32) * w
+    wrong = (idx != label).astype(jnp.float32) * w
+    tp = jnp.sum(one_l * correct[:, None], axis=0)
+    fp = jnp.sum(one_p * wrong[:, None], axis=0)
+    fn = jnp.sum(one_l * wrong[:, None], axis=0)
+    involved = jnp.clip(one_p + one_l, 0.0, 1.0)
+    tn = jnp.sum((1.0 - involved) * w[:, None], axis=0)
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)  # [C, 4]
+
+    if ins.get("StatesInfo"):
+        accum_states = batch_states + ins["StatesInfo"][0].astype(jnp.float32)
+    else:
+        accum_states = batch_states
+
+    def metrics(st):
+        stp, sfp, stn, sfn = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        # CalcPrecision/CalcRecall return 1.0 for classes with no
+        # predictions/instances (precision_recall_op.h:102-114); macro-F1 is
+        # the harmonic mean of the macro averages (op.h:144), not the mean
+        # of per-class F1s.
+        prec = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1e-10), 1.0)
+        rec = jnp.where(stp + sfn > 0, stp / jnp.maximum(stp + sfn, 1e-10), 1.0)
+        macro_p = jnp.mean(prec)
+        macro_r = jnp.mean(rec)
+        macro_f1 = jnp.where(
+            macro_p + macro_r > 0,
+            2 * macro_p * macro_r / jnp.maximum(macro_p + macro_r, 1e-10), 0.0)
+        mtp, mfp, mfn = jnp.sum(stp), jnp.sum(sfp), jnp.sum(sfn)
+        micro_p = jnp.where(mtp + mfp > 0, mtp / jnp.maximum(mtp + mfp, 1e-10), 1.0)
+        micro_r = jnp.where(mtp + mfn > 0, mtp / jnp.maximum(mtp + mfn, 1e-10), 1.0)
+        micro_f1 = jnp.where(
+            micro_p + micro_r > 0,
+            2 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-10), 0.0)
+        return jnp.stack([macro_p, macro_r, macro_f1,
+                          micro_p, micro_r, micro_f1])
+
+    return {
+        "BatchMetrics": metrics(batch_states),
+        "AccumMetrics": metrics(accum_states),
+        "AccumStatesInfo": accum_states,
+    }
+
+
+register_op(
+    "precision_recall",
+    inputs=["MaxProbs", "Indices", "Labels", "Weights", "StatesInfo"],
+    outputs=["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
+    attrs={"class_number": 2},
+    lower=_lower_precision_recall,
+    grad=None,
+)

@@ -154,33 +154,37 @@ register_op(
 )
 
 
-def _pool2d_core(x, attrs):
-    ptype = attrs.get("pooling_type", "max")
-    ksize = _pair(attrs.get("ksize", [2, 2]))
-    strides = _pair(attrs.get("strides", [1, 1]))
-    paddings = _pair(attrs.get("paddings", [0, 0]))
-    global_pool = attrs.get("global_pooling", False)
-    if global_pool:
-        axis = (2, 3)
-        if ptype == "max":
-            return jnp.max(x, axis=axis, keepdims=True)
-        return jnp.mean(x, axis=axis, keepdims=True)
-    window = (1, 1) + tuple(ksize)
-    strides4 = (1, 1) + tuple(strides)
-    pads = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
+def _pool_geometry(x, attrs, nd):
+    """Shared N-spatial-dim pooling geometry: (ksize, strides, window,
+    full strides, pads) honoring ceil_mode's extra high-side padding."""
+    ksize = _pair(attrs.get("ksize", [2] * nd), nd)
+    strides = _pair(attrs.get("strides", [1] * nd), nd)
+    paddings = _pair(attrs.get("paddings", [0] * nd), nd)
+    pads = [(0, 0), (0, 0)]
     if attrs.get("ceil_mode", False):
         # pad extra on the high side so ceil-division window count fits
-        extra = []
-        for i in range(2):
-            size = jnp.shape(x)[2 + i]
+        for i in range(nd):
+            size = int(jnp.shape(x)[2 + i])
             k, s, p = ksize[i], strides[i], paddings[i]
             out_ceil = -(-(size + 2 * p - k) // s) + 1
             needed = (out_ceil - 1) * s + k - (size + 2 * p)
-            extra.append(max(0, int(needed)))
-        pads = [(0, 0), (0, 0)] + [
-            (paddings[i], paddings[i] + extra[i]) for i in range(2)
-        ]
+            pads.append((p, p + max(0, int(needed))))
+    else:
+        pads += [(p, p) for p in paddings]
+    return ksize, strides, (1, 1) + tuple(ksize), (1, 1) + tuple(strides), pads
+
+
+def _pool_max_or_global(x, attrs, nd):
+    """Global and max pooling, any rank; returns None for windowed avg
+    (the 2d/3d cores differ only in their avg strategy)."""
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        axis = tuple(range(2, 2 + nd))
+        if ptype == "max":
+            return jnp.max(x, axis=axis, keepdims=True)
+        return jnp.mean(x, axis=axis, keepdims=True)
     if ptype == "max":
+        _, _, window, strides_full, pads = _pool_geometry(x, attrs, nd)
         # init must be a static python scalar for JAX to recognize the max
         # monoid and use the differentiable reduce_window_max primitive.
         if jnp.issubdtype(x.dtype, jnp.floating):
@@ -188,10 +192,18 @@ def _pool2d_core(x, attrs):
         else:
             init = int(jnp.iinfo(x.dtype).min)
         return jax.lax.reduce_window(
-            x, init, jax.lax.max, window, strides4, pads
+            x, init, jax.lax.max, window, strides_full, pads
         )
+    return None
+
+
+def _pool2d_core(x, attrs):
+    out = _pool_max_or_global(x, attrs, 2)
+    if out is not None:
+        return out
     # avg pooling via depthwise conv with a ones kernel (differentiable,
     # MXU-tiled); exclusive=True divides by the unpadded window size.
+    ksize, strides, _, _, pads = _pool_geometry(x, attrs, 2)
     c = jnp.shape(x)[1]
     kern = jnp.ones((c, 1) + tuple(ksize), x.dtype)
     spatial_pads = pads[2:]
@@ -230,6 +242,45 @@ register_op(
         "use_cudnn": False,
     },
     lower=lambda ctx, ins, attrs: _pool2d_core(ins["X"][0], attrs),
+)
+
+
+def _pool3d_core(x, attrs):
+    """NCDHW pooling (pool_op.cc pool3d registration): same windowing rules
+    as pool2d with three spatial dims; avg uses reduce_window so the kernel
+    does not blow up into a depthwise conv over D*H*W."""
+    out = _pool_max_or_global(x, attrs, 3)
+    if out is not None:
+        return out
+    ksize, _, window, strides5, pads = _pool_geometry(x, attrs, 3)
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, window, strides5, pads
+    )
+    if attrs.get("exclusive", True):
+        counts = jax.lax.reduce_window(
+            jnp.ones_like(x), 0.0, jax.lax.add, window, strides5, pads
+        )
+    else:
+        counts = jnp.asarray(float(np.prod(ksize)), x.dtype)
+    return summed / counts
+
+
+register_op(
+    "pool3d",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={
+        "pooling_type": "max",
+        "ksize": [2, 2, 2],
+        "strides": [1, 1, 1],
+        "paddings": [0, 0, 0],
+        "global_pooling": False,
+        "exclusive": True,
+        "ceil_mode": False,
+        "adaptive": False,
+        "use_cudnn": False,
+    },
+    lower=lambda ctx, ins, attrs: _pool3d_core(ins["X"][0], attrs),
 )
 
 

@@ -19,3 +19,6 @@ from paddle_tpu.transpiler.amp_transpiler import (  # noqa: F401
     rewrite_program_amp,
     amp_guard,
 )
+from paddle_tpu.transpiler.inference_transpiler import (  # noqa: F401
+    InferenceTranspiler,
+)

@@ -1,0 +1,132 @@
+"""Inference transpiler: fold batch_norm into the preceding conv/fc for a
+pre-optimized deploy program.
+
+Reference parity: python/paddle/fluid/transpiler/inference_transpiler.py
+(fuse_batch_norm). The capability is to *serialize* an already-optimized
+program — at runtime XLA would fuse these anyway, but a folded program (a)
+ships fewer parameters, (b) runs as-is on the native C++ interpreter, and
+(c) matches the reference deployment flow (save_inference_model after
+transpile).
+
+Given ``conv2d -> (elementwise_add bias ->) batch_norm`` the BN affine is
+folded into the conv filter and bias:
+
+    a = scale / sqrt(variance + eps)
+    W' = W * a[:, None, None, None]
+    b' = (b - mean) * a + bn_bias
+
+The batch_norm op and its now-unused parameters are removed from the
+program, and downstream readers of the BN output are rewired to the conv
+(or bias-add) output. Values are updated in the scope in place.
+"""
+
+import numpy as np
+
+__all__ = ["InferenceTranspiler"]
+
+
+class InferenceTranspiler(object):
+    def transpile(self, program, scope=None, place=None):
+        """Fold conv+bn pairs in ``program`` (in place), updating parameter
+        values in ``scope`` (defaults to the global scope)."""
+        if scope is None:
+            from paddle_tpu.executor import global_scope
+
+            scope = global_scope()
+        block = program.global_block()
+
+        i = 0
+        while i < len(block.ops) - 1:
+            op = block.ops[i]
+            if op.type != "conv2d":
+                i += 1
+                continue
+            conv_out = op.output("Output")[0]
+            j = i + 1
+            bias_op = None
+            nxt = block.ops[j]
+            if (
+                nxt.type == "elementwise_add"
+                and nxt.input("X")
+                and nxt.input("X")[0] == conv_out
+                and j + 1 < len(block.ops)
+                and self._is_parameter(block, nxt.input("Y"))
+            ):
+                # only a parameter Y is a bias; a residual/skip add (Y is an
+                # activation) must not be folded into
+                bias_op = nxt
+                j += 1
+                nxt = block.ops[j]
+            if nxt.type != "batch_norm":
+                i += 1
+                continue
+            bn_in = nxt.input("X")[0]
+            expect = bias_op.output("Out")[0] if bias_op else conv_out
+            if bn_in != expect:
+                i += 1
+                continue
+            self._fold(block, scope, op, bias_op, nxt, j)
+            i += 1
+        program._bump_version()
+        return program
+
+    @staticmethod
+    def _is_parameter(block, names):
+        from paddle_tpu.framework import Parameter
+
+        if not names:
+            return False
+        var = block.vars.get(names[0])
+        return isinstance(var, Parameter)
+
+    def _fold(self, block, scope, conv_op, bias_op, bn_op, bn_idx):
+        eps = bn_op.attr("epsilon") if bn_op.has_attr("epsilon") else 1e-5
+        w_name = conv_op.input("Filter")[0]
+        scale = np.asarray(scope.get_value(bn_op.input("Scale")[0]))
+        bn_bias = np.asarray(scope.get_value(bn_op.input("Bias")[0]))
+        mean = np.asarray(scope.get_value(bn_op.input("Mean")[0]))
+        var = np.asarray(scope.get_value(bn_op.input("Variance")[0]))
+        a = scale / np.sqrt(var + eps)
+
+        w = np.asarray(scope.get_value(w_name))
+        scope.set_value(w_name, (w * a[:, None, None, None]).astype(w.dtype))
+
+        if bias_op is not None:
+            b_name = bias_op.input("Y")[0]
+            b = np.asarray(scope.get_value(b_name)).reshape(-1)
+            new_b = ((b - mean) * a + bn_bias).astype(b.dtype)
+            scope.set_value(b_name, new_b.reshape(np.asarray(
+                scope.get_value(b_name)).shape))
+            out_name = bias_op.output("Out")[0]
+        else:
+            # fold the BN shift into a fresh bias parameter + add op
+            b_name = w_name + ".bn_fused_bias"
+            new_b = ((0.0 - mean) * a + bn_bias).astype(w.dtype)
+            block.create_parameter(
+                name=b_name, shape=[int(new_b.shape[0])], dtype=str(w.dtype)
+            )
+            scope.set_value(b_name, new_b)
+            conv_out = conv_op.output("Output")[0]
+            out_name = bn_op.output("Y")[0]
+            block.insert_op(
+                bn_idx,
+                "elementwise_add",
+                inputs={"X": [conv_out], "Y": [b_name]},
+                outputs={"Out": [out_name]},
+                attrs={"axis": 1},
+            )
+            bn_idx += 1
+
+        bn_out = bn_op.output("Y")[0]
+        # drop the BN op and point its readers at the folded output
+        block.remove_op(bn_idx)
+        if bias_op is not None and bn_out != out_name:
+            for later in block.ops:
+                for slot, names in list(later.inputs.items()):
+                    later.inputs[slot] = [
+                        out_name if n == bn_out else n for n in names
+                    ]
+        # remove BN params from the program so serialization skips them
+        for slot in ("Scale", "Bias", "Mean", "Variance"):
+            name = bn_op.input(slot)[0]
+            block.vars.pop(name, None)

@@ -1,0 +1,46 @@
+"""Golden API-surface test (paddle/fluid/API.spec +
+tools/print_signatures.py parity): the committed API.spec must match the
+live public signatures; regenerate deliberately with
+`python tools/print_signatures.py --update` when the API changes."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import print_signatures  # noqa: E402
+
+
+def test_api_spec_matches_committed_golden():
+    live = list(print_signatures.iter_spec())
+    with open(os.path.join(REPO, "API.spec")) as f:
+        committed = f.read().splitlines()
+    live_set, committed_set = set(live), set(committed)
+    removed = committed_set - live_set
+    added = live_set - committed_set
+    msg = []
+    if removed:
+        msg.append("API signatures removed/changed:\n  " +
+                   "\n  ".join(sorted(removed)[:20]))
+    if added:
+        msg.append("API signatures added (update API.spec):\n  " +
+                   "\n  ".join(sorted(added)[:20]))
+    assert not msg, (
+        "\n".join(msg) +
+        "\nIf intentional: python tools/print_signatures.py --update"
+    )
+
+
+def test_api_spec_covers_core_surface():
+    with open(os.path.join(REPO, "API.spec")) as f:
+        spec = f.read()
+    for must in [
+        "paddle_tpu.layers.nn.fc ",
+        "paddle_tpu.layers.nn.conv2d ",
+        "paddle_tpu.layers.detection.ssd_loss ",
+        "paddle_tpu.optimizer.Adam CLASS",
+        "paddle_tpu.io.save_inference_model ",
+        "paddle_tpu.backward.append_backward ",
+    ]:
+        assert must in spec, "missing from API.spec: %r" % must

@@ -1,0 +1,67 @@
+"""Nested-LoD (lod_level=2) behaviors pinned per docs/LOD_DESIGN.md.
+
+Reference: paddle/fluid/framework/lod_tensor_test.cc and
+tests/unittests/test_lod_tensor.py — here restricted to the host-boundary
+contract the TPU design keeps (offsets never reach the device).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor, create_lod_tensor
+
+
+def test_level2_lod_roundtrip_and_validity():
+    # 2 "documents": first has 2 sentences (lens 2, 3), second has 1 (len 1)
+    words = np.arange(6 * 4, dtype=np.float32).reshape(6, 4)
+    t = create_lod_tensor(words, [[2, 1], [2, 3, 1]])
+    assert t.lod() == [[0, 2, 3], [0, 2, 5, 6]]
+    assert t.has_valid_recursive_sequence_lengths()
+    assert t.recursive_sequence_lengths() == [[2, 1], [2, 3, 1]]
+
+    # innermost-level densification: 3 sentences padded to len 3
+    padded, lengths = t.to_padded(pad_value=0.0)
+    assert padded.shape == (3, 3, 4)
+    np.testing.assert_array_equal(lengths, [2, 3, 1])
+    np.testing.assert_array_equal(padded[0, :2], words[0:2])
+    np.testing.assert_array_equal(padded[1], words[2:5])
+    np.testing.assert_array_equal(padded[0, 2], np.zeros(4))
+
+    # round trip back to ragged
+    back = LoDTensor.from_padded(padded, lengths)
+    np.testing.assert_array_equal(back.numpy(), words)
+
+
+def test_invalid_nested_lod_detected():
+    words = np.zeros((6, 2), np.float32)
+    bad = LoDTensor(words, [[0, 2, 3], [0, 2, 5]])  # inner doesn't cover 6
+    assert not bad.has_valid_recursive_sequence_lengths()
+    bad2 = LoDTensor(words, [[1, 2, 3], [0, 2, 5, 6]])  # level not 0-based
+    assert not bad2.has_valid_recursive_sequence_lengths()
+
+
+def test_sequence_ops_consume_innermost_level_of_nested_lod():
+    """A level-2 batch flows through sequence_pool by densifying the inner
+    level; the outer level groups results on the host (design note
+    'lod_level>2 graph ops')."""
+    words = np.arange(6 * 4, dtype=np.float32).reshape(6, 4)
+    t = create_lod_tensor(words, [[2, 1], [2, 3, 1]])
+    padded, lengths = t.to_padded()
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [3, 4])
+        lv = fluid.layers.data("len", [], dtype="int32")
+        pooled = fluid.layers.sequence_pool(xv, "sum", length=lv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (out,) = exe.run(main, feed={"x": padded, "len": lengths},
+                     fetch_list=[pooled])
+    out = np.asarray(out)
+    # sentence sums honoring true lengths, not padding
+    np.testing.assert_allclose(out[0], words[0:2].sum(0), rtol=1e-6)
+    np.testing.assert_allclose(out[1], words[2:5].sum(0), rtol=1e-6)
+    np.testing.assert_allclose(out[2], words[5:6].sum(0), rtol=1e-6)
+    # outer level reduces host-side: document means over sentence vectors
+    doc_split = np.split(out, np.cumsum([2, 1])[:-1])
+    assert len(doc_split) == 2 and doc_split[0].shape == (2, 4)

@@ -1,0 +1,251 @@
+"""pool3d / precision_recall / InferenceTranspiler tests.
+
+Reference: tests/unittests/test_pool3d_op.py, test_precision_recall_op.py,
+tests/test_inference_transpiler (inference_transpiler.py fuse_batch_norm).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(build_fn, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    outs = exe.run(main, feed=feed, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs]
+
+
+def _np_pool3d(x, k, s, p, ptype, exclusive):
+    n, c, d, h, w = x.shape
+    od = (d + 2 * p[0] - k[0]) // s[0] + 1
+    oh = (h + 2 * p[1] - k[1]) // s[1] + 1
+    ow = (w + 2 * p[2] - k[2]) // s[2] + 1
+    out = np.zeros((n, c, od, oh, ow), x.dtype)
+    xp = np.pad(x, [(0, 0), (0, 0)] + [(pp, pp) for pp in p],
+                constant_values=-np.inf if ptype == "max" else 0.0)
+    for i in range(od):
+        for j in range(oh):
+            for l in range(ow):
+                patch = xp[:, :, i * s[0]:i * s[0] + k[0],
+                           j * s[1]:j * s[1] + k[1],
+                           l * s[2]:l * s[2] + k[2]]
+                if ptype == "max":
+                    out[:, :, i, j, l] = patch.max(axis=(2, 3, 4))
+                else:
+                    total = patch.sum(axis=(2, 3, 4))
+                    if exclusive:
+                        ones = np.pad(np.ones_like(x),
+                                      [(0, 0), (0, 0)] + [(pp, pp) for pp in p])
+                        cnt = ones[:, :, i * s[0]:i * s[0] + k[0],
+                                   j * s[1]:j * s[1] + k[1],
+                                   l * s[2]:l * s[2] + k[2]].sum(axis=(2, 3, 4))
+                    else:
+                        cnt = np.prod(k)
+                    out[:, :, i, j, l] = total / cnt
+    return out
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool3d_matches_numpy(ptype):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 6, 6, 6).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [3, 6, 6, 6])
+        out = fluid.layers.pool3d(xv, pool_size=2, pool_type=ptype,
+                                  pool_stride=2, pool_padding=1)
+        return (out,)
+
+    (out,) = _run(build, {"x": x})
+    exp = _np_pool3d(x, [2] * 3, [2] * 3, [1] * 3, ptype, True)
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-5)
+
+
+def test_pool3d_global_and_grad():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 4, 4, 4).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [2, 4, 4, 4], stop_gradient=False)
+        out = fluid.layers.pool3d(xv, pool_type="avg", global_pooling=True)
+        loss = fluid.layers.mean(out)
+        grads = fluid.backward.calc_gradient(loss, [xv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    o, g = exe.run(main, feed={"x": x}, fetch_list=[out, grads[0]])
+    np.testing.assert_allclose(
+        np.asarray(o)[:, :, 0, 0, 0], x.mean(axis=(2, 3, 4)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g), np.full_like(x, 1.0 / (2 * 64)),
+                               rtol=1e-5)
+
+
+def test_precision_recall_matches_numpy():
+    rng = np.random.RandomState(3)
+    n, c = 32, 4
+    probs = rng.rand(n, c).astype("float32")
+    probs /= probs.sum(1, keepdims=True)
+    labels = rng.randint(0, c, (n, 1)).astype("int32")
+
+    def build():
+        pv = fluid.layers.data("p", [c])
+        lv = fluid.layers.data("l", [1], dtype="int32")
+        batch_m, accum_m, states = fluid.layers.precision_recall(pv, lv, c)
+        return batch_m, accum_m, states
+
+    batch_m, accum_m, states = _run(build, {"p": probs, "l": labels})
+
+    pred = probs.argmax(1)
+    gold = labels.reshape(-1)
+    tp = np.zeros(c)
+    fp = np.zeros(c)
+    fn = np.zeros(c)
+    tn = np.zeros(c)
+    for p_i, g_i in zip(pred, gold):
+        if p_i == g_i:
+            tp[p_i] += 1
+            tn += 1
+            tn[p_i] -= 1
+        else:
+            fp[p_i] += 1
+            fn[g_i] += 1
+            tn += 1
+            tn[p_i] -= 1
+            tn[g_i] -= 1
+    np.testing.assert_allclose(states, np.stack([tp, fp, tn, fn], 1), atol=1e-5)
+    # empty classes score 1.0 (precision_recall_op.h CalcPrecision/CalcRecall)
+    prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1e-10), 1.0)
+    rec = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1e-10), 1.0)
+    macro_p, macro_r = prec.mean(), rec.mean()
+    micro_p = tp.sum() / (tp.sum() + fp.sum())
+    micro_r = tp.sum() / (tp.sum() + fn.sum())
+    np.testing.assert_allclose(batch_m[0], macro_p, rtol=1e-5)
+    np.testing.assert_allclose(batch_m[1], macro_r, rtol=1e-5)
+    np.testing.assert_allclose(batch_m[3], micro_p, rtol=1e-5)
+    np.testing.assert_allclose(batch_m[4], micro_r, rtol=1e-5)
+    # single batch: accumulated == batch
+    np.testing.assert_allclose(accum_m, batch_m, rtol=1e-5)
+
+
+def test_precision_recall_accumulates_across_batches():
+    rng = np.random.RandomState(5)
+    c = 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pv = fluid.layers.data("p", [c])
+        lv = fluid.layers.data("l", [1], dtype="int32")
+        batch_m, accum_m, states = fluid.layers.precision_recall(pv, lv, c)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    totals = []
+    for _ in range(3):
+        probs = rng.rand(16, c).astype("float32")
+        labels = rng.randint(0, c, (16, 1)).astype("int32")
+        _, _, st = exe.run(main, feed={"p": probs, "l": labels},
+                           fetch_list=[batch_m, accum_m, states])
+        totals.append(np.asarray(st))
+    # TP+FP+TN+FN per class = accumulated sample count
+    assert totals[-1].sum() == pytest.approx(3 * 16 * c)
+    assert (totals[1].sum(1) >= totals[0].sum(1)).all()
+
+
+def test_inference_transpiler_folds_batch_norm():
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [3, 8, 8])
+        c = fluid.layers.conv2d(xv, 4, 3, padding=1, bias_attr=True)
+        bn = fluid.layers.batch_norm(c)
+        out = fluid.layers.relu(bn)
+    test_prog = main.clone(for_test=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # make BN stats non-trivial so folding is actually exercised
+    scope = fluid.global_scope()
+    scope.set_value("batch_norm_0.w_0",
+                    rng.rand(4).astype("float32") + 0.5)  # scale
+    scope.set_value("batch_norm_0.b_0", rng.randn(4).astype("float32"))
+    for name in scope.local_var_names():
+        if "mean" in name:
+            scope.set_value(name, rng.randn(4).astype("float32") * 0.1)
+        if "variance" in name:
+            scope.set_value(name, rng.rand(4).astype("float32") + 0.5)
+
+    (before,) = exe.run(test_prog, feed={"x": x}, fetch_list=[out])
+
+    t = fluid.transpiler.InferenceTranspiler()
+    t.transpile(test_prog, scope)
+    bn_ops = [op for op in test_prog.global_block().ops
+              if op.type == "batch_norm"]
+    assert not bn_ops, "batch_norm op should be folded away"
+
+    (after,) = exe.run(test_prog, feed={"x": x}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_inference_transpiler_without_conv_bias():
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 3, 6, 6).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [3, 6, 6])
+        c = fluid.layers.conv2d(xv, 2, 3, bias_attr=False)
+        bn = fluid.layers.batch_norm(c)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    scope.set_value("batch_norm_1.b_0", rng.randn(2).astype("float32"))
+    (before,) = exe.run(test_prog, feed={"x": x}, fetch_list=[bn])
+    fluid.transpiler.InferenceTranspiler().transpile(test_prog, scope)
+    assert not any(op.type == "batch_norm"
+                   for op in test_prog.global_block().ops)
+    (after,) = exe.run(test_prog, feed={"x": x}, fetch_list=[bn])
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_precision_recall_empty_class_scores_one():
+    # class 2 never appears: contributes P=R=1.0 to the macro averages
+    probs = np.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1]], "float32")
+    labels = np.array([[0], [1]], "int32")
+
+    def build():
+        pv = fluid.layers.data("p", [3])
+        lv = fluid.layers.data("l", [1], dtype="int32")
+        batch_m, _, _ = fluid.layers.precision_recall(pv, lv, 3)
+        return (batch_m,)
+
+    (m,) = _run(build, {"p": probs, "l": labels})
+    np.testing.assert_allclose(m[0], 1.0, rtol=1e-6)  # macro-P
+    np.testing.assert_allclose(m[1], 1.0, rtol=1e-6)  # macro-R
+
+
+def test_inference_transpiler_skips_residual_add():
+    """conv -> elementwise_add(conv, skip) -> batch_norm must NOT be folded
+    as if the skip activation were a bias parameter."""
+    rng = np.random.RandomState(11)
+    x = rng.randn(2, 2, 6, 6).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [2, 6, 6])
+        c = fluid.layers.conv2d(xv, 2, 3, padding=1, bias_attr=False)
+        res = fluid.layers.elementwise_add(c, xv)  # residual, not bias
+        bn = fluid.layers.batch_norm(res)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (before,) = exe.run(test_prog, feed={"x": x}, fetch_list=[bn])
+    fluid.transpiler.InferenceTranspiler().transpile(test_prog,
+                                                     fluid.global_scope())
+    (after,) = exe.run(test_prog, feed={"x": x}, fetch_list=[bn])
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before),
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,104 @@
+"""Emit the public API signature spec for paddle_tpu.
+
+Reference parity: tools/print_signatures.py + paddle/fluid/API.spec — the
+reference locks its Python surface in a golden file so accidental API breaks
+fail CI. Usage:
+
+    python tools/print_signatures.py            # print spec to stdout
+    python tools/print_signatures.py --update   # rewrite API.spec
+
+The spec line format is ``qualified.name (param, param=default, ...)`` for
+functions and ``qualified.name CLASS (init params)`` for classes; defaults
+are repr()s so value changes are caught, not just renames.
+"""
+
+import argparse
+import importlib
+import inspect
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.layers.nn",
+    "paddle_tpu.layers.tensor",
+    "paddle_tpu.layers.control_flow",
+    "paddle_tpu.layers.detection",
+    "paddle_tpu.layers.sequence",
+    "paddle_tpu.layers.io",
+    "paddle_tpu.layers.rnn",
+    "paddle_tpu.layers.attention",
+    "paddle_tpu.layers.loss",
+    "paddle_tpu.layers.metric_op",
+    "paddle_tpu.layers.nlp",
+    "paddle_tpu.layers.learning_rate_scheduler",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.backward",
+    "paddle_tpu.io",
+    "paddle_tpu.initializer",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.clip",
+    "paddle_tpu.metrics",
+    "paddle_tpu.nets",
+    "paddle_tpu.inference",
+    "paddle_tpu.data_feeder",
+    "paddle_tpu.profiler",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.parallel_executor",
+    "paddle_tpu.reader.decorator",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def iter_spec():
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+            # without __all__, only symbols defined in this module count
+            names = [
+                n for n in names
+                if getattr(getattr(mod, n), "__module__", None) == modname
+            ]
+        for name in sorted(names):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            qual = "%s.%s" % (modname, name)
+            if inspect.isclass(obj):
+                yield "%s CLASS %s" % (qual, _sig(obj.__init__))
+            elif callable(obj):
+                yield "%s %s" % (qual, _sig(obj))
+            else:
+                yield "%s CONST %r" % (qual, type(obj).__name__)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--update", action="store_true",
+                        help="rewrite API.spec next to this script's repo root")
+    args = parser.parse_args()
+    lines = list(iter_spec())
+    if args.update:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "API.spec"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print("wrote %d signatures to API.spec" % len(lines))
+    else:
+        sys.stdout.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()
