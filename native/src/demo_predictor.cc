@@ -12,6 +12,7 @@
 
 #include <cstdio>
 #include <dirent.h>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -44,8 +45,11 @@ std::string FirstName(const std::string& json, const std::string& key) {
   if (at == std::string::npos) return "";
   at = json.find('[', at);
   if (at == std::string::npos) return "";
+  size_t close = json.find(']', at);
   size_t q1 = json.find('"', at);
-  if (q1 == std::string::npos) return "";
+  if (q1 == std::string::npos || (close != std::string::npos && q1 > close)) {
+    return "";  // empty array
+  }
   size_t q2 = json.find('"', q1 + 1);
   if (q2 == std::string::npos) return "";
   return json.substr(q1 + 1, q2 - q1 - 1);
@@ -83,6 +87,13 @@ int main(int argc, char** argv) {
     return 1;
   }
 
+  // names the program actually declares, to disambiguate the save_vars
+  // mangling ('/' -> '__', which is not injective)
+  std::set<std::string> declared;
+  for (const auto& blk : prog.blocks) {
+    for (const auto& v : blk.vars) declared.insert(v.name);
+  }
+
   // load every .npy in the model dir as a parameter (save_vars layout:
   // one file per persistable, '/' mangled to '__')
   ptpu::Scope scope;
@@ -102,9 +113,14 @@ int main(int argc, char** argv) {
       return 1;
     }
     std::string name = fn.substr(0, fn.size() - 4);
-    size_t at;
-    while ((at = name.find("__")) != std::string::npos) {
-      name.replace(at, 2, "/");
+    if (declared.find(name) == declared.end()) {
+      std::string demangled = name;
+      size_t at = 0;
+      while ((at = demangled.find("__", at)) != std::string::npos) {
+        demangled.replace(at, 2, "/");
+        ++at;
+      }
+      if (declared.find(demangled) != declared.end()) name = demangled;
     }
     scope.Set(name, std::move(t));
     ++n_params;

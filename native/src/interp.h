@@ -305,9 +305,19 @@ class Interpreter {
       return "input not in scope";
     }
     if (!IsF32(*x) || x->dims.size() < 2) return "bad input";
+    if (IntAttr(op, "is_test", 0) == 0) {
+      return "training-mode batch_norm unsupported (clone for_test first)";
+    }
     float eps = FloatAttr(op, "epsilon", 1e-5f);
     int64_t n = x->dims[0], c = x->dims[1];
     if (n <= 0 || c <= 0) return "empty input";
+    if (!IsF32(*sc) || !IsF32(*bi) || !IsF32(*me) || !IsF32(*va)) {
+      return "non-f32 dtype";
+    }
+    if (NumElements(sc->dims) < c || NumElements(bi->dims) < c ||
+        NumElements(me->dims) < c || NumElements(va->dims) < c) {
+      return "bn param too small";
+    }
     int64_t spatial = NumElements(x->dims) / (n * c);
     HostTensor out = MakeF32(x->dims);
     const float* xa = F32(*x);
@@ -436,6 +446,9 @@ class Interpreter {
   // Inference dropout (dropout_op.cc is_test path): downgrade_in_infer
   // scales by (1 - p); upscale_in_train is identity.
   std::string RunDropoutTest(const OpDesc& op, Scope* scope) {
+    if (IntAttr(op, "is_test", 0) == 0) {
+      return "training-mode dropout unsupported (clone for_test first)";
+    }
     float p = FloatAttr(op, "dropout_prob", 0.5f);
     std::string impl =
         StrAttr(op, "dropout_implementation", "downgrade_in_infer");

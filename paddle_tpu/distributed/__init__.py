@@ -1,0 +1,9 @@
+"""Elastic / fault-tolerant training services (go/master + go/pserver
+capability surface, rebuilt for TPU pods)."""
+
+from paddle_tpu.distributed.master import (  # noqa: F401
+    MasterClient,
+    MasterService,
+    Task,
+    task_reader,
+)

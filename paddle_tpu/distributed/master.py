@@ -1,0 +1,407 @@
+"""Elastic data-dispatch master: leased tasks, timeout requeue, failure
+discard, pass management, crash-recoverable snapshots.
+
+Reference parity: go/master/service.go — ``SetDataset``/``partition``
+(:106), ``GetTask`` (:368, lease + timeout timer), ``TaskFinished`` (:411),
+``TaskFailed`` (:455, requeue until failure_max then discard), snapshot-to-
+store recovery (:166,207) — and go/master/client.go's task-backed reader.
+
+TPU-first differences: the store is a local file (or any object with
+save/load) instead of etcd — on Cloud TPU pods the coordinator's disk or
+GCS plays that role; the wire protocol is newline-delimited JSON over TCP
+instead of Go net/rpc, so Python workers need no extra deps. The trainer
+process is stateless: any worker can fetch any task, so killing a worker
+mid-epoch only delays its leased tasks until the lease times out and the
+task is re-dispatched (the elastic-training contract the reference's
+fault-tolerance docs describe).
+"""
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+
+__all__ = ["Task", "MasterService", "MasterClient", "task_reader"]
+
+
+class Task(object):
+    __slots__ = ("task_id", "chunks", "epoch", "num_failures")
+
+    def __init__(self, task_id, chunks, epoch=0, num_failures=0):
+        self.task_id = task_id
+        self.chunks = list(chunks)
+        self.epoch = epoch
+        self.num_failures = num_failures
+
+    def to_json(self):
+        return {
+            "task_id": self.task_id,
+            "chunks": self.chunks,
+            "epoch": self.epoch,
+            "num_failures": self.num_failures,
+        }
+
+    @staticmethod
+    def from_json(d):
+        return Task(d["task_id"], d["chunks"], d["epoch"], d["num_failures"])
+
+
+class _Errors(object):
+    PASS_BEFORE = "pass_before"
+    PASS_AFTER = "pass_after"
+    NO_MORE_AVAILABLE = "no_more_available"
+    ALL_FAILED = "all_task_failed"
+
+
+class MasterService(object):
+    """In-process task-queue service; optionally served over TCP."""
+
+    def __init__(self, chunks_per_task=1, timeout_s=5.0, failure_max=3,
+                 snapshot_path=None):
+        self._chunks_per_task = max(1, int(chunks_per_task))
+        self._timeout_s = timeout_s
+        self._failure_max = failure_max
+        self._snapshot_path = snapshot_path
+        self._mu = threading.RLock()
+        self._todo = []  # [Task]
+        self._pending = {}  # task_id -> (Task, lease_deadline)
+        self._done = []
+        self._failed = []
+        self._cur_pass = 0
+        self._all_chunks = []
+        self._server = None
+        self._watcher = None
+        self._closed = threading.Event()
+        self._snapshot_interval_s = 0.5
+        self._last_snapshot = 0.0
+        self._snapshot_dirty = False
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+
+    # -- dataset / partition (service.go:106,280) ---------------------------
+
+    def set_dataset(self, chunks):
+        """chunks: list of opaque JSON-serializable chunk descriptors (file
+        paths, (file, offset) pairs...). Partitioned chunks_per_task each."""
+        with self._mu:
+            self._all_chunks = list(chunks)
+            if not self._todo and not self._pending and not self._done:
+                self._todo = self._partition(self._all_chunks)
+                self._snapshot(force=True)
+
+    def _partition(self, chunks):
+        tasks = []
+        for i in range(0, len(chunks), self._chunks_per_task):
+            tasks.append(Task(len(tasks), chunks[i:i + self._chunks_per_task]))
+        return tasks
+
+    # -- task protocol ------------------------------------------------------
+
+    def get_task(self, pass_id):
+        """Lease the next task. Returns (task, None) or (None, error_code)."""
+        with self._mu:
+            if pass_id < self._cur_pass:
+                return None, _Errors.PASS_BEFORE
+            if pass_id > self._cur_pass:
+                return None, _Errors.PASS_AFTER
+            if not self._todo:
+                if not self._done and not self._pending:
+                    return None, _Errors.ALL_FAILED
+                return None, _Errors.NO_MORE_AVAILABLE
+            t = self._todo.pop(0)
+            t.epoch += 1
+            self._pending[t.task_id] = (t, time.time() + self._timeout_s)
+            self._snapshot()
+            self._ensure_watcher()
+            return Task(t.task_id, t.chunks, t.epoch, t.num_failures), None
+
+    def task_finished(self, task_id):
+        with self._mu:
+            ent = self._pending.pop(task_id, None)
+            if ent is None:
+                return False
+            self._done.append(ent[0])
+            rolled = False
+            if not self._todo and not self._pending:
+                self._next_pass()
+                rolled = True
+            self._snapshot(force=rolled)
+            return True
+
+    def task_failed(self, task_id, epoch=None):
+        """Report failure (worker crash detected, bad data...). Requeues the
+        task until failure_max, then discards it (service.go:455)."""
+        with self._mu:
+            ent = self._pending.get(task_id)
+            if ent is None:
+                return False
+            t, _ = ent
+            if epoch is not None and epoch != t.epoch:
+                return False  # stale report from a previous lease
+            del self._pending[task_id]
+            t.num_failures += 1
+            if t.num_failures >= self._failure_max:
+                self._failed.append(t)
+            else:
+                self._todo.append(t)
+            if not self._todo and not self._pending and self._done:
+                self._next_pass()
+            self._snapshot()
+            return True
+
+    def _next_pass(self):
+        self._cur_pass += 1
+        todo = self._done + self._failed
+        for t in todo:
+            t.num_failures = 0
+        self._todo = sorted(todo, key=lambda t: t.task_id)
+        self._done = []
+        self._failed = []
+
+    # -- lease timeout watcher (service.go checkTimeoutFunc) ----------------
+
+    def _ensure_watcher(self):
+        if self._watcher is None or not self._watcher.is_alive():
+            self._watcher = threading.Thread(
+                target=self._watch_loop, daemon=True)
+            self._watcher.start()
+
+    def _watch_loop(self):
+        while not self._closed.is_set():
+            now = time.time()
+            with self._mu:
+                expired = [
+                    (tid, t.epoch) for tid, (t, dl) in self._pending.items()
+                    if dl <= now
+                ]
+                for tid, epoch in expired:
+                    self.task_failed(tid, epoch)
+                if not self._pending:
+                    return  # watcher exits when nothing is leased
+            self._closed.wait(min(self._timeout_s / 4.0, 0.25))
+
+    # -- introspection / persistence ----------------------------------------
+
+    def status(self):
+        with self._mu:
+            return {
+                "todo": len(self._todo),
+                "pending": len(self._pending),
+                "done": len(self._done),
+                "failed": len(self._failed),
+                "cur_pass": self._cur_pass,
+            }
+
+    def _snapshot(self, force=False):
+        """Write-throttled persistence: per-lease churn is coalesced (at
+        most one write per _snapshot_interval_s); structural transitions
+        (dataset set, pass rollover, close) force a write. Bounded
+        staleness is the TPU-rebuild trade vs the reference's
+        every-mutation etcd write (service.go:207) — on recovery a
+        slightly-stale snapshot only re-dispatches already-done tasks."""
+        if not self._snapshot_path:
+            return
+        now = time.time()
+        if not force and now - self._last_snapshot < self._snapshot_interval_s:
+            self._snapshot_dirty = True
+            return
+        self._last_snapshot = now
+        self._snapshot_dirty = False
+        state = {
+            "todo": [t.to_json() for t in self._todo],
+            "pending": [t.to_json() for t, _ in self._pending.values()],
+            "done": [t.to_json() for t in self._done],
+            "failed": [t.to_json() for t in self._failed],
+            "cur_pass": self._cur_pass,
+            "chunks": self._all_chunks,
+        }
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self._snapshot_path)
+
+    def _recover(self):
+        """service.go:166 — a restarted master resumes from the snapshot;
+        tasks that were pending at crash time go back to todo."""
+        with open(self._snapshot_path) as f:
+            state = json.load(f)
+        self._todo = [Task.from_json(d) for d in state["todo"]]
+        self._todo += [Task.from_json(d) for d in state["pending"]]
+        self._done = [Task.from_json(d) for d in state["done"]]
+        self._failed = [Task.from_json(d) for d in state["failed"]]
+        self._cur_pass = state["cur_pass"]
+        self._all_chunks = state["chunks"]
+
+    # -- TCP front-end (JSON lines) -----------------------------------------
+
+    def serve(self, host="127.0.0.1", port=0):
+        """Start the TCP endpoint; returns (host, port)."""
+        service = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    try:
+                        req = json.loads(line)
+                        resp = service._dispatch(req)
+                    except Exception as e:  # noqa: BLE001
+                        resp = {"ok": False, "error": str(e)}
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode("utf-8"))
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True).start()
+        return self._server.server_address
+
+    def _dispatch(self, req):
+        method = req.get("method")
+        if method == "get_task":
+            task, err = self.get_task(req.get("pass_id", 0))
+            if err:
+                return {"ok": False, "error": err}
+            return {"ok": True, "task": task.to_json()}
+        if method == "task_finished":
+            return {"ok": self.task_finished(req["task_id"])}
+        if method == "task_failed":
+            return {"ok": self.task_failed(req["task_id"],
+                                           req.get("epoch"))}
+        if method == "set_dataset":
+            self.set_dataset(req["chunks"])
+            return {"ok": True}
+        if method == "status":
+            return {"ok": True, "status": self.status()}
+        return {"ok": False, "error": "unknown method %r" % method}
+
+    def close(self):
+        with self._mu:
+            if self._snapshot_dirty:
+                self._snapshot(force=True)
+        self._closed.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class MasterClient(object):
+    """Worker-side client (go/master/client.go role): fetch/finish/fail
+    tasks over the JSON-lines TCP protocol, with pass tracking."""
+
+    def __init__(self, addr, timeout_s=10.0):
+        self._addr = addr
+        self._timeout_s = timeout_s
+        self._sock = None
+        self._rfile = None
+        self.pass_id = 0
+        # set when the master reports our pass is over (PASS_BEFORE with
+        # sync_pass=False); task_reader uses it as the end-of-epoch signal
+        self.pass_ended = False
+
+    def _connect(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self._addr, timeout=self._timeout_s)
+            self._rfile = self._sock.makefile("rb")
+
+    def _call(self, **req):
+        self._connect()
+        try:
+            self._sock.sendall((json.dumps(req) + "\n").encode("utf-8"))
+            line = self._rfile.readline()
+        except OSError:
+            self.close()
+            raise
+        if not line:
+            self.close()
+            raise ConnectionError("master closed connection")
+        return json.loads(line)
+
+    def get_task(self, sync_pass=True):
+        """Returns a Task or None. With sync_pass (default), a client
+        lagging behind the master's pass fast-forwards and keeps fetching;
+        with sync_pass=False it instead sets ``pass_ended`` and returns
+        None, so callers get a clean end-of-epoch boundary."""
+        resp = self._call(method="get_task", pass_id=self.pass_id)
+        if resp.get("ok"):
+            return Task.from_json(resp["task"])
+        err = resp.get("error")
+        if err == _Errors.PASS_BEFORE:
+            if sync_pass:
+                self.pass_id += 1
+                return self.get_task(sync_pass)
+            self.pass_ended = True
+        elif err == _Errors.ALL_FAILED:
+            self.pass_ended = True
+        return None
+
+    def next_pass(self):
+        """Acknowledge end of epoch: advance to the master's next pass."""
+        self.pass_id += 1
+        self.pass_ended = False
+
+    def task_finished(self, task_id):
+        return self._call(method="task_finished", task_id=task_id).get("ok")
+
+    def task_failed(self, task_id, epoch=None):
+        return self._call(
+            method="task_failed", task_id=task_id, epoch=epoch).get("ok")
+
+    def status(self):
+        return self._call(method="status").get("status")
+
+    def set_dataset(self, chunks):
+        return self._call(method="set_dataset", chunks=chunks).get("ok")
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+                self._rfile = None
+
+
+def task_reader(client, load_chunk, poll_s=0.1, max_polls=600):
+    """Fluid-style reader over master-dispatched tasks (client.go's
+    paddle.reader.creator.cloud_reader role).
+
+    ``load_chunk(chunk)`` yields samples for one chunk descriptor. Each
+    ``reader()`` iteration is ONE pass: it leases tasks until the master
+    rolls to the next pass (or every task failed), reporting
+    task_finished per completed task and task_failed on a chunk
+    exception. Call ``reader()`` again for the next epoch.
+    """
+
+    def reader():
+        polls = 0
+        while True:
+            task = client.get_task(sync_pass=False)
+            if task is None:
+                if client.pass_ended:
+                    client.next_pass()  # epoch boundary
+                    return
+                polls += 1
+                if polls >= max_polls:
+                    return
+                # tasks may still be leased elsewhere; wait for requeue
+                time.sleep(poll_s)
+                continue
+            polls = 0
+            try:
+                for chunk in task.chunks:
+                    for sample in load_chunk(chunk):
+                        yield sample
+            except Exception:  # noqa: BLE001 - report and move on
+                client.task_failed(task.task_id, task.epoch)
+                continue
+            client.task_finished(task.task_id)
+
+    return reader
