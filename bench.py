@@ -42,25 +42,53 @@ def _peak_tflops(device):
     return None
 
 
-def main():
-    import jax
+def _timed_steps(exe, main_prog, loss, steps, warmup, feed=None):
+    """Warmup + timed run. Prefers the compiled multi-step path (one
+    lax.scan executable per K steps, no per-step host dispatch); falls
+    back to the per-step loop if the program can't scan. Returns
+    (seconds, last_loss)."""
+    feed = feed or {}
+    # default per-step: measured equal on TPU (async dispatch already hides
+    # per-step host cost: 2517 vs 2530 img/s) and 4x slower on XLA:CPU
+    # (scan bodies lose intra-op parallelism); the capability itself is
+    # tested in tests/test_multi_step.py and pays off when dispatch is
+    # synchronous (multi-host barriers, very small step times)
+    use_multi = os.environ.get("BENCH_MULTISTEP", "0") == "1"
+    if use_multi:
+        try:
+            # warmup at the SAME step count: the scan executable is keyed
+            # on K, so a different K would recompile inside the timing
+            exe.run_multi_step(main_prog, steps, feed=feed,
+                               fetch_list=[loss])
+            t0 = time.perf_counter()
+            out = exe.run_multi_step(main_prog, steps, feed=feed,
+                                     fetch_list=[loss])
+            dt = time.perf_counter() - t0
+            return dt, float(np.ravel(np.asarray(out[0]))[0])
+        except (RuntimeError, TypeError):
+            # not scannable: state_out ⊄ state_in (RuntimeError) or a scan
+            # carry type mismatch surfacing as TypeError at trace time
+            pass
+    for _ in range(warmup):
+        exe.run(main_prog, feed=feed, fetch_list=[])
+    exe.run(main_prog, feed=feed, fetch_list=[loss])
+    t0 = time.perf_counter()
+    for _ in range(steps - 1):
+        exe.run(main_prog, feed=feed, fetch_list=[])
+    out = exe.run(main_prog, feed=feed, fetch_list=[loss])
+    dt = time.perf_counter() - t0
+    return dt, float(np.ravel(np.asarray(out[0]))[0])
 
-    # BENCH_PLATFORM=cpu forces the CPU backend (the axon TPU plugin ignores
-    # JAX_PLATFORMS, and a wedged tunnel would hang device enumeration).
-    if os.environ.get("BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
-    import paddle_tpu as fluid
+def _bench_resnet(fluid, on_tpu, use_amp):
     from paddle_tpu.models import resnet
     from paddle_tpu.transpiler import rewrite_program_amp
 
-    on_tpu = any(d.platform != "cpu" for d in jax.devices())
     # Full ImageNet shapes on TPU; scaled-down proxy on CPU (CI smoke).
     if on_tpu:
         img, bs, steps, warmup = 224, 128, 50, 10
     else:
         img, bs, steps, warmup = 64, 16, 5, 2
-    use_amp = os.environ.get("BENCH_AMP", "1" if on_tpu else "0") == "1"
 
     main_prog, startup = fluid.Program(), fluid.Program()
     main_prog.random_seed = 5
@@ -81,40 +109,111 @@ def main():
     place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(startup)
-
-    # Compile + settle (first run compiles; a loss fetch syncs the queue).
-    for _ in range(warmup):
-        exe.run(main_prog, feed={}, fetch_list=[])
-    out = exe.run(main_prog, feed={}, fetch_list=[loss])
-
-    t0 = time.perf_counter()
-    for _ in range(steps - 1):
-        exe.run(main_prog, feed={}, fetch_list=[])
-    out = exe.run(main_prog, feed={}, fetch_list=[loss])
-    dt = time.perf_counter() - t0
-    lv = float(np.ravel(np.asarray(out[0]))[0])
+    dt, lv = _timed_steps(exe, main_prog, loss, steps, warmup)
     assert np.isfinite(lv), "non-finite loss %r" % lv
     img_per_sec = steps * bs / dt
+    return {
+        "metric": "resnet50_train_throughput" + ("" if on_tpu else "_cpu_proxy"),
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
+        "gflop_per_unit": TRAIN_GFLOP_PER_IMG,
+        "rate": img_per_sec,
+    }
+
+
+def _bench_transformer(fluid, on_tpu, use_amp):
+    """Transformer-base-ish NMT train throughput in tokens/sec (the
+    BASELINE.md 'Transformer base NMT train MFU' config, single chip).
+    No reference throughput number is committed in-tree (BENCH_NOTES.md),
+    so vs_baseline is null; MFU is the comparable figure."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.transpiler import rewrite_program_amp
+
+    if on_tpu:
+        bs, seq, steps, warmup = 64, 256, 30, 5
+        n_layer, n_head, d_model, d_inner = 6, 8, 512, 2048
+    else:
+        bs, seq, steps, warmup = 4, 32, 4, 2
+        n_layer, n_head, d_model, d_inner = 2, 4, 64, 128
+    vocab = 32000 if on_tpu else 500
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main_prog, startup):
+        loss, feeds, _ = transformer.build(
+            src_vocab_size=vocab, trg_vocab_size=vocab, max_length=seq,
+            n_layer=n_layer, n_head=n_head, d_model=d_model,
+            d_inner=d_inner, dropout=0.1,
+        )
+        fluid.optimizer.Adam(learning_rate=2e-4).minimize(loss)
+    if use_amp:
+        rewrite_program_amp(main_prog, "bfloat16")
+
+    rng = np.random.RandomState(11)
+    feed = {
+        "src_word": rng.randint(1, vocab, (bs, seq)).astype("int64"),
+        "src_len": np.full((bs, 1), seq, "int64"),
+        "trg_word": rng.randint(1, vocab, (bs, seq)).astype("int64"),
+        "trg_len": np.full((bs, 1), seq, "int64"),
+        "label": rng.randint(1, vocab, (bs, seq)).astype("int64"),
+    }
+    feed = {k: v for k, v in feed.items()
+            if any(f.name == k for f in feeds)}
+
+    place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+    dt, lv = _timed_steps(exe, main_prog, loss, steps, warmup, feed=feed)
+    assert np.isfinite(lv), "non-finite loss %r" % lv
+    # decoder tokens/sec (standard NMT accounting); with src_len == trg_len
+    # each decoder token corresponds to one src token of encoder work, so
+    # charging enc+dec params per decoder token is exact, not double-counted
+    tok_per_sec = steps * bs * seq / dt
+    # 6N rule (2N fwd + 4N bwd) on non-embedding params; attention
+    # score/context FLOPs are excluded, so MFU is slightly conservative
+    n_params = (
+        n_layer * (4 * d_model * d_model + 2 * d_model * d_inner)  # enc
+        + n_layer * (8 * d_model * d_model + 2 * d_model * d_inner)  # dec
+    )
+    gflop_per_tok = 3 * 2 * n_params / 1e9
+    return {
+        "metric": "transformer_train_throughput" + ("" if on_tpu else "_cpu_proxy"),
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "gflop_per_unit": gflop_per_tok,
+        "rate": tok_per_sec,
+    }
+
+
+def main():
+    import jax
+
+    # BENCH_PLATFORM=cpu forces the CPU backend (the axon TPU plugin ignores
+    # JAX_PLATFORMS, and a wedged tunnel would hang device enumeration).
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import paddle_tpu as fluid
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    use_amp = os.environ.get("BENCH_AMP", "1" if on_tpu else "0") == "1"
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+
+    if model == "transformer":
+        result = _bench_transformer(fluid, on_tpu, use_amp)
+    else:
+        result = _bench_resnet(fluid, on_tpu, use_amp)
 
     peak = _peak_tflops(jax.devices()[0]) if on_tpu else None
-    mfu = (
-        round(img_per_sec * TRAIN_GFLOP_PER_IMG * 1e9 / (peak * 1e12), 4)
-        if peak
-        else None
+    rate = result.pop("rate")
+    gflop = result.pop("gflop_per_unit")
+    result["mfu"] = (
+        round(rate * gflop * 1e9 / (peak * 1e12), 4) if peak else None
     )
-
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_throughput"
-                + ("" if on_tpu else "_cpu_proxy"),
-                "value": round(img_per_sec, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
-                "mfu": mfu,
-            }
-        )
-    )
+    print(json.dumps(result))
     sys.stdout.flush()
 
 

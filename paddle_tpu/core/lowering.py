@@ -251,3 +251,96 @@ class CompiledProgram(object):
         mut = {n: state[n] for n in self.mutable_state}
         frz = {n: state[n] for n in self.frozen_state}
         return self.jitted(mut, frz, feeds, key)
+
+
+class MultiStepProgram(object):
+    """K training steps compiled into ONE XLA executable via lax.scan.
+
+    SURVEY §7 hard part (c): per-step Python dispatch costs a host round
+    trip per step (severe through a tunnel, nonzero everywhere). Scanning
+    the step function amortizes dispatch to one call per K steps; state
+    chains on device through the scan carry, and per-step fetches come
+    back stacked [K, ...] (the loss curve, not just the last value).
+
+    Feeds are constant across the K steps (synthetic-input benches) — real
+    input pipelines should use the in-graph reader ops instead, which need
+    no feeds at all. Requires state_out ⊆ state_in (training programs
+    satisfy this: optimizer/BN state is read-modify-write).
+    """
+
+    def __init__(self, program, steps, feed_specs, fetch_names, scope_names,
+                 is_test=False, device=None, stack_fetches=False):
+        self.steps = int(steps)
+        if self.steps <= 0:
+            raise ValueError("multi-step needs steps >= 1, got %d" % steps)
+        self.fetch_names = list(fetch_names)
+        lowerer = BlockLowerer(program, 0, is_test=is_test)
+        self.state_in, self.state_out = lowerer.analyze(
+            scope_names, set(feed_specs)
+        )
+        extra_out = set(self.state_out) - set(self.state_in)
+        if extra_out:
+            raise RuntimeError(
+                "multi-step compilation needs state_out ⊆ state_in; program "
+                "creates persistables mid-run: %s" % sorted(extra_out)
+            )
+        step = build_step_fn(
+            program, list(feed_specs), self.fetch_names,
+            self.state_in, self.state_out, is_test=is_test,
+        )
+        self.mutable_state = sorted(
+            set(self.state_in) & set(self.state_out))
+        self.frozen_state = sorted(
+            set(self.state_in) - set(self.state_out))
+        n_steps = self.steps
+
+        def multi(mut_state, frozen_state, feeds, key):
+            import jax.numpy as jnp
+
+            def body(carry, i):
+                state = dict(frozen_state)
+                state.update(carry)
+                new_state, fetches = step(
+                    state, feeds, jax.random.fold_in(key, i)
+                )
+                carry = {n: new_state[n] for n in carry}
+                return carry, tuple(fetches)
+
+            if stack_fetches:
+                # per-step fetch trajectory [K, ...] — costs scan-output
+                # buffers every iteration; use for small diagnostics only
+                carry, ys = jax.lax.scan(
+                    body, mut_state, jnp.arange(n_steps)
+                )
+                return carry, list(ys)
+
+            # default: fetches from the LAST step ride the carry — no
+            # per-iteration output buffers in the scan
+            def body_carry(carry, i):
+                st, _ = carry
+                st2, fetches = body(st, i)
+                return (st2, tuple(fetches)), None
+
+            _, fetch0 = jax.eval_shape(
+                lambda c: body(c, jnp.asarray(0)), mut_state
+            )
+            init_f = tuple(
+                jnp.zeros(f.shape, f.dtype) for f in fetch0
+            )
+            (carry, fetches), _ = jax.lax.scan(
+                body_carry, (mut_state, init_f), jnp.arange(n_steps)
+            )
+            return carry, list(fetches)
+
+        if device is not None:
+            s = jax.sharding.SingleDeviceSharding(device)
+            self.jitted = jax.jit(
+                multi, donate_argnums=(0,), in_shardings=s, out_shardings=s
+            )
+        else:
+            self.jitted = jax.jit(multi, donate_argnums=(0,))
+
+    def __call__(self, state, feeds, key):
+        mut = {n: state[n] for n in self.mutable_state}
+        frz = {n: state[n] for n in self.frozen_state}
+        return self.jitted(mut, frz, feeds, key)

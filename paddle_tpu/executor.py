@@ -65,11 +65,7 @@ class Executor(object):
 
     # -- compilation cache --------------------------------------------------
     def _get_compiled(self, program, feed_specs, fetch_names, scope):
-        scope_names = set()
-        s = scope
-        while s is not None:
-            scope_names.update(s.local_var_names())
-            s = s._parent
+        scope_names = self._scope_names(scope)
         key = (
             id(program),
             program._version,
@@ -120,33 +116,38 @@ class Executor(object):
                 program, feed, fetch_list, scope, device, return_numpy
             )
 
-    def _run_on_device(self, program, feed, fetch_list, scope, device,
-                       return_numpy):
-
-        # Prepare feeds.
+    # -- shared run plumbing -------------------------------------------------
+    def _prepare_feeds(self, program, feed, device):
+        """numpy/LoDTensor feeds -> (device arrays, (shape, dtype) specs),
+        cast to the declared var dtype when compatible."""
         feeds = {}
         feed_specs = {}
         for name, value in feed.items():
-            arr, lod = _as_feed_array(value, self.place)
+            arr, _lod = _as_feed_array(value, self.place)
             var = program.global_block()._find_var_recursive(name)
-            if var is not None and var.dtype and arr.dtype != np_dtype(var.dtype):
+            if (var is not None and var.dtype
+                    and arr.dtype != np_dtype(var.dtype)):
                 if np.issubdtype(arr.dtype, np.floating) or np.issubdtype(
                     arr.dtype, np.integer
                 ):
                     arr = arr.astype(np_dtype(var.dtype))
             feeds[name] = jax.device_put(arr, device)
             feed_specs[name] = (tuple(arr.shape), str(arr.dtype))
+        return feeds, feed_specs
 
-        fetch_names = [
-            v.name if isinstance(v, framework.Variable) else str(v)
-            for v in fetch_list
-        ]
+    @staticmethod
+    def _scope_names(scope):
+        names = set()
+        s = scope
+        while s is not None:
+            names.update(s.local_var_names())
+            s = s._parent
+        return names
 
-        cp = self._get_compiled(program, feed_specs, fetch_names, scope)
-
-        # Gather state from scope (device arrays).
+    @staticmethod
+    def _gather_state(state_in, scope, device):
         state = {}
-        for n in cp.state_in:
+        for n in state_in:
             v = scope.find_var(n)
             if v is None or v.value is None:
                 raise RuntimeError(
@@ -161,39 +162,99 @@ class Executor(object):
                 # on TPU, now serving on CPU): move it once.
                 val = jax.device_put(val, device)
             state[n] = val
+        return state
 
+    def _step_key(self, program):
         self._run_counter += 1
-        key = jax.random.fold_in(
+        return jax.random.fold_in(
             jax.random.PRNGKey(program.random_seed or self._base_seed),
             self._run_counter,
         )
 
-        new_state, fetches = cp(state, feeds, key)
-
-        # Write back mutated/donated state.
-        for n, val in new_state.items():
-            scope.set_value(n, val)
-
+    @staticmethod
+    def _check_nan_inf(new_state, fetch_names, fetches):
         from paddle_tpu import flags as _flags
 
-        if _flags.get("check_nan_inf"):
-            # FLAGS_check_nan_inf (operator.cc:754): scan every produced
-            # value host-side and fail loudly on the first bad one.
-            for name, val in list(new_state.items()) + list(
-                zip(cp.fetch_names, fetches)
+        if not _flags.get("check_nan_inf"):
+            return
+        # FLAGS_check_nan_inf (operator.cc:754): scan every produced
+        # value host-side and fail loudly on the first bad one.
+        for name, val in list(new_state.items()) + list(
+            zip(fetch_names, fetches)
+        ):
+            arr = np.asarray(val)
+            if np.issubdtype(arr.dtype, np.floating) and not np.all(
+                np.isfinite(arr)
             ):
-                arr = np.asarray(val)
-                if np.issubdtype(arr.dtype, np.floating) and not np.all(
-                    np.isfinite(arr)
-                ):
-                    raise RuntimeError(
-                        "NaN/Inf detected in variable %r after program run "
-                        "(FLAGS_check_nan_inf)" % name
-                    )
+                raise RuntimeError(
+                    "NaN/Inf detected in variable %r after program run "
+                    "(FLAGS_check_nan_inf)" % name
+                )
 
+    def _run_on_device(self, program, feed, fetch_list, scope, device,
+                       return_numpy):
+        feeds, feed_specs = self._prepare_feeds(program, feed, device)
+        fetch_names = [
+            v.name if isinstance(v, framework.Variable) else str(v)
+            for v in fetch_list
+        ]
+        cp = self._get_compiled(program, feed_specs, fetch_names, scope)
+        state = self._gather_state(cp.state_in, scope, device)
+        key = self._step_key(program)
+        new_state, fetches = cp(state, feeds, key)
+        for n, val in new_state.items():
+            scope.set_value(n, val)
+        self._check_nan_inf(new_state, cp.fetch_names, fetches)
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
+
+    def run_multi_step(self, program, steps, feed=None, fetch_list=None,
+                       scope=None, return_numpy=True, stack_fetches=False):
+        """Run ``steps`` iterations of ``program`` inside ONE compiled
+        executable (lax.scan over the step function) — one host dispatch
+        per K steps instead of per step. ``feed`` is constant across the
+        steps (real pipelines use in-graph reader ops and need none).
+        Fetches are the LAST step's values; pass stack_fetches=True for
+        the per-step trajectory stacked along a leading [steps] axis
+        (costs scan output buffers every iteration)."""
+        from paddle_tpu.core.lowering import MultiStepProgram
+
+        program = program or framework.default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+        device = self.place.jax_device()
+        with jax.default_device(device):
+            feeds, feed_specs = self._prepare_feeds(program, feed, device)
+            fetch_names = [
+                v.name if isinstance(v, framework.Variable) else str(v)
+                for v in fetch_list
+            ]
+            scope_names = self._scope_names(scope)
+            key_id = (
+                "multi", id(program), program._version, int(steps),
+                tuple(sorted(feed_specs.items())), tuple(fetch_names),
+                id(scope), hash(frozenset(scope_names)), program._is_test,
+                getattr(program, "_amp_dtype", None), bool(stack_fetches),
+            )
+            cp = self._cache.get(key_id)
+            if cp is None:
+                cp = MultiStepProgram(
+                    program, steps, feed_specs, fetch_names, scope_names,
+                    is_test=program._is_test, device=device,
+                    stack_fetches=stack_fetches,
+                )
+                self._cache[key_id] = cp
+            state = self._gather_state(cp.state_in, scope, device)
+            key = self._step_key(program)
+            new_state, fetches = cp(state, feeds, key)
+            for n, val in new_state.items():
+                scope.set_value(n, val)
+            self._check_nan_inf(new_state, cp.fetch_names, fetches)
+            if return_numpy:
+                fetches = [np.asarray(f) for f in fetches]
+            return fetches
 
     def close(self):
         self._cache.clear()

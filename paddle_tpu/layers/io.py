@@ -13,7 +13,8 @@ from paddle_tpu.core.types import VarType
 from paddle_tpu.layer_helper import LayerHelper
 
 __all__ = ["data", "py_reader", "double_buffer", "read_file", "batch",
-           "shuffle", "random_data_generator"]
+           "shuffle", "random_data_generator", "open_recordio_file",
+           "open_files"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, type=VarType.LOD_TENSOR,
@@ -199,3 +200,46 @@ def shuffle(reader, buffer_size):
     from paddle_tpu.reader import decorator
 
     return decorator.shuffle(reader, buffer_size)
+
+
+def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
+                       pass_num=1, for_parallel=False, capacity=64,
+                       name=None):
+    """Graph-level recordio reader (create_recordio_file_reader_op.cc
+    role): returns a PyReader whose worker thread streams records from
+    the native recordio reader into the C++ blocking queue — the
+    file->queue->device pipeline, with the file parsing in the reader
+    thread instead of an in-graph op (XLA programs cannot do file I/O;
+    the queue hop is where the reference's DecoratedReader chain ran)."""
+    return open_files([filename], shapes, dtypes, lod_levels=lod_levels,
+                      pass_num=pass_num, capacity=capacity, name=name)
+
+
+def open_files(filenames, shapes, dtypes, thread_num=1, buffer_size=None,
+               lod_levels=None, pass_num=1, capacity=64, name=None):
+    """Multi-file recordio reader (open_files_op.cc role). Files are
+    consumed in order per pass (shuffle with the reader decorators).
+    ``buffer_size`` maps onto the queue capacity; ``thread_num > 1`` is
+    accepted for API parity but reads single-threaded (one reader thread
+    feeding the native blocking queue) — a warning is logged."""
+    import logging
+
+    from paddle_tpu import native
+    from paddle_tpu.recordio_writer import unpack_sample
+
+    if thread_num and thread_num > 1:
+        logging.getLogger("paddle_tpu.reader").warning(
+            "open_files(thread_num=%d): multi-threaded file reading is not "
+            "implemented; reading single-threaded", thread_num)
+    reader = py_reader(buffer_size or capacity, shapes, dtypes,
+                       lod_levels=lod_levels, name=name or "open_files")
+
+    def source():
+        for _ in range(pass_num):
+            for path in filenames:
+                with native.RecordIOReader(path) as r:
+                    for blob in r:
+                        yield unpack_sample(blob)
+
+    reader.decorate_paddle_reader(source)
+    return reader

@@ -1,0 +1,96 @@
+"""RecordIO dataset conversion (python/paddle/fluid/recordio_writer.py
+parity): serialize a Fluid reader's samples into a recordio file that the
+graph-reader layers (layers.io.open_recordio_file / open_files) consume.
+
+Storage format per record: little-endian uint32 field count, then per
+field uint32 length + the field's .npy bytes (language-neutral — the C++
+runtime reads the same container via native/src/recordio.h + npy.h).
+"""
+
+import io
+import struct
+
+import numpy as np
+
+from paddle_tpu import native
+
+__all__ = [
+    "convert_reader_to_recordio_file",
+    "convert_reader_to_recordio_files",
+    "pack_sample",
+    "unpack_sample",
+]
+
+
+def pack_sample(sample):
+    """tuple/list of arrays -> bytes."""
+    fields = [np.asarray(f) for f in sample]
+    out = io.BytesIO()
+    out.write(struct.pack("<I", len(fields)))
+    for f in fields:
+        buf = io.BytesIO()
+        np.save(buf, f, allow_pickle=False)
+        raw = buf.getvalue()
+        out.write(struct.pack("<I", len(raw)))
+        out.write(raw)
+    return out.getvalue()
+
+
+def unpack_sample(blob):
+    """bytes -> tuple of arrays."""
+    view = memoryview(blob)
+    (n,) = struct.unpack_from("<I", view, 0)
+    off = 4
+    fields = []
+    for _ in range(n):
+        (ln,) = struct.unpack_from("<I", view, off)
+        off += 4
+        buf = io.BytesIO(bytes(view[off:off + ln]))
+        fields.append(np.load(buf, allow_pickle=False))
+        off += ln
+    return tuple(fields)
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None):
+    """Write every sample of ``reader_creator()`` into ``filename``.
+    Returns the number of records written. ``feeder`` (a DataFeeder) may
+    pre-convert samples, as in the reference API."""
+    count = 0
+    with native.RecordIOWriter(filename) as w:
+        for sample in reader_creator():
+            if feeder is not None:
+                fed = feeder.feed([sample])
+                sample = tuple(fed[k] for k in feeder.feed_names)
+            w.write(pack_sample(sample))
+            count += 1
+    return count
+
+
+def convert_reader_to_recordio_files(filename, batch_per_file,
+                                     reader_creator, feeder=None):
+    """Shard into multiple files of ``batch_per_file`` records each
+    (reference convert_reader_to_recordio_files); returns the file list."""
+    paths = []
+    writer = None
+    n_in_file = 0
+    count = 0
+    try:
+        for sample in reader_creator():
+            if writer is None:
+                path = "%s-%05d" % (filename, len(paths))
+                paths.append(path)
+                writer = native.RecordIOWriter(path)
+                n_in_file = 0
+            if feeder is not None:
+                fed = feeder.feed([sample])
+                sample = tuple(fed[k] for k in feeder.feed_names)
+            writer.write(pack_sample(sample))
+            count += 1
+            n_in_file += 1
+            if n_in_file >= batch_per_file:
+                writer.close()
+                writer = None
+    finally:
+        if writer is not None:
+            writer.close()
+    return paths

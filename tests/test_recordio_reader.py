@@ -1,0 +1,104 @@
+"""Graph-level recordio reader tests: convert_reader_to_recordio_file(s) +
+open_recordio_file / open_files feeding a training loop.
+
+Reference: python/paddle/fluid/recordio_writer.py,
+operators/reader/create_recordio_file_reader_op.cc, open_files_op.cc,
+tests/unittests/test_recordio_reader.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import native, recordio_writer
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason="native toolchain unavailable: %s" % native.last_error(),
+)
+
+
+def _sample_reader(n, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for i in range(n):
+            x = rng.rand(4).astype("float32")
+            y = np.array([x.sum()], "float32")
+            yield x, y
+
+    return reader
+
+
+def test_pack_unpack_roundtrip():
+    sample = (np.arange(6, dtype="float32").reshape(2, 3),
+              np.array([3], "int64"))
+    blob = recordio_writer.pack_sample(sample)
+    back = recordio_writer.unpack_sample(blob)
+    assert len(back) == 2
+    np.testing.assert_array_equal(back[0], sample[0])
+    np.testing.assert_array_equal(back[1], sample[1])
+
+
+def test_convert_and_read_back(tmp_path):
+    path = str(tmp_path / "data.recordio")
+    n = recordio_writer.convert_reader_to_recordio_file(
+        path, _sample_reader(10))
+    assert n == 10
+    with native.RecordIOReader(path) as r:
+        rows = [recordio_writer.unpack_sample(b) for b in r]
+    assert len(rows) == 10
+    expected = list(_sample_reader(10)())
+    for got, exp in zip(rows, expected):
+        np.testing.assert_allclose(got[0], exp[0], rtol=1e-6)
+
+
+def test_sharded_files_cover_everything(tmp_path):
+    base = str(tmp_path / "shard")
+    paths = recordio_writer.convert_reader_to_recordio_files(
+        base, 4, _sample_reader(10))
+    assert len(paths) == 3  # 4 + 4 + 2
+    total = 0
+    for p in paths:
+        with native.RecordIOReader(p) as r:
+            total += sum(1 for _ in r)
+    assert total == 10
+
+
+def test_open_files_trains_a_model(tmp_path):
+    base = str(tmp_path / "train")
+    # pre-batched records: [8,4] x, [8,1] y per record
+    def batched():
+        rng = np.random.RandomState(3)
+        for _ in range(12):
+            x = rng.rand(8, 4).astype("float32")
+            yield x, x.sum(1, keepdims=True).astype("float32")
+
+    paths = recordio_writer.convert_reader_to_recordio_files(base, 6, batched)
+    assert len(paths) == 2
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.open_files(
+            paths, shapes=[[-1, 4], [-1, 1]],
+            dtypes=["float32", "float32"], pass_num=3)
+        xv, yv = fluid.layers.read_file(reader)
+        xv.stop_gradient = False
+        pred = fluid.layers.fc(xv, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, yv))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    reader.start()
+    losses = []
+    from paddle_tpu.reader.queue import EOFException
+
+    while True:
+        try:
+            feed = reader.next_feed()
+        except EOFException:
+            break
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert len(losses) == 12 * 3  # every record, every pass
+    assert np.mean(losses[-6:]) < np.mean(losses[:6])
