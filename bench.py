@@ -46,7 +46,8 @@ def _timed_steps(exe, main_prog, loss, steps, warmup, feed=None):
     """Warmup + timed run. Prefers the compiled multi-step path (one
     lax.scan executable per K steps, no per-step host dispatch); falls
     back to the per-step loop if the program can't scan. Returns
-    (seconds, last_loss)."""
+    (seconds, last_loss, mode) — mode records which path actually ran so
+    a silent fallback can't masquerade as a multi-step measurement."""
     feed = feed or {}
     # default per-step: measured equal on TPU (async dispatch already hides
     # per-step host cost: 2517 vs 2530 img/s) and 4x slower on XLA:CPU
@@ -64,11 +65,12 @@ def _timed_steps(exe, main_prog, loss, steps, warmup, feed=None):
             out = exe.run_multi_step(main_prog, steps, feed=feed,
                                      fetch_list=[loss])
             dt = time.perf_counter() - t0
-            return dt, float(np.ravel(np.asarray(out[0]))[0])
-        except (RuntimeError, TypeError):
-            # not scannable: state_out ⊄ state_in (RuntimeError) or a scan
-            # carry type mismatch surfacing as TypeError at trace time
-            pass
+            return dt, float(np.ravel(np.asarray(out[0]))[0]), "multi-step"
+        except (RuntimeError, TypeError) as e:
+            # not scannable: state_out ⊄ state_in, a scan carry type
+            # mismatch, or an XLA compile failure — fall back LOUDLY
+            print("multi-step path failed (%s: %s); falling back to "
+                  "per-step" % (type(e).__name__, e), file=sys.stderr)
     for _ in range(warmup):
         exe.run(main_prog, feed=feed, fetch_list=[])
     exe.run(main_prog, feed=feed, fetch_list=[loss])
@@ -77,7 +79,7 @@ def _timed_steps(exe, main_prog, loss, steps, warmup, feed=None):
         exe.run(main_prog, feed=feed, fetch_list=[])
     out = exe.run(main_prog, feed=feed, fetch_list=[loss])
     dt = time.perf_counter() - t0
-    return dt, float(np.ravel(np.asarray(out[0]))[0])
+    return dt, float(np.ravel(np.asarray(out[0]))[0]), "per-step"
 
 
 def _bench_resnet(fluid, on_tpu, use_amp):
@@ -109,7 +111,7 @@ def _bench_resnet(fluid, on_tpu, use_amp):
     place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(startup)
-    dt, lv = _timed_steps(exe, main_prog, loss, steps, warmup)
+    dt, lv, mode = _timed_steps(exe, main_prog, loss, steps, warmup)
     assert np.isfinite(lv), "non-finite loss %r" % lv
     img_per_sec = steps * bs / dt
     return {
@@ -119,6 +121,7 @@ def _bench_resnet(fluid, on_tpu, use_amp):
         "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
         "gflop_per_unit": TRAIN_GFLOP_PER_IMG,
         "rate": img_per_sec,
+        "mode": mode,
     }
 
 
@@ -165,7 +168,8 @@ def _bench_transformer(fluid, on_tpu, use_amp):
     place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(startup)
-    dt, lv = _timed_steps(exe, main_prog, loss, steps, warmup, feed=feed)
+    dt, lv, mode = _timed_steps(exe, main_prog, loss, steps, warmup,
+                                feed=feed)
     assert np.isfinite(lv), "non-finite loss %r" % lv
     # decoder tokens/sec (standard NMT accounting); with src_len == trg_len
     # each decoder token corresponds to one src token of encoder work, so
@@ -185,6 +189,7 @@ def _bench_transformer(fluid, on_tpu, use_amp):
         "vs_baseline": None,
         "gflop_per_unit": gflop_per_tok,
         "rate": tok_per_sec,
+        "mode": mode,
     }
 
 

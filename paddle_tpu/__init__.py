@@ -42,6 +42,8 @@ from paddle_tpu import optimizer  # noqa: F401
 from paddle_tpu import regularizer  # noqa: F401
 from paddle_tpu import clip  # noqa: F401
 from paddle_tpu import metrics  # noqa: F401
+from paddle_tpu import evaluator  # noqa: F401
+from paddle_tpu import recordio_writer  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu.executor import Executor, global_scope, scope_guard  # noqa: F401
 from paddle_tpu.parallel_executor import (  # noqa: F401

@@ -11,6 +11,7 @@ __all__ = [
     "ChunkEvaluator",
     "EditDistance",
     "Auc",
+    "DetectionMAP",
 ]
 
 
@@ -204,3 +205,119 @@ class Auc(MetricBase):
         return (
             auc / tot_pos / tot_neg if tot_pos > 0.0 and tot_neg > 0.0 else 0.0
         )
+
+
+class DetectionMAP(MetricBase):
+    """Accumulative mean-Average-Precision across batches (host side).
+
+    Reference parity: python/paddle/fluid/metrics.py DetectionMAP /
+    detection_map_op.cc accumulative states. The in-graph
+    ``layers.detection_map`` op scores ONE batch; this class accumulates
+    padded detections + dense ground truth over many batches and computes
+    the epoch mAP with the same greedy-matching + integral/11point rules.
+
+    update() takes the padded-batch layout (docs/LOD_DESIGN.md):
+      detections [N, D, 6] (label, score, x1, y1, x2, y2), label -1 pads;
+      gt_labels [N, G] int with -1 pads; gt_boxes [N, G, 4];
+      difficult [N, G] optional.
+    """
+
+    def __init__(self, name=None, class_num=None, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral",
+                 background_label=0):
+        super(DetectionMAP, self).__init__(name)
+        if class_num is None:
+            raise ValueError("DetectionMAP requires class_num")
+        self._class_num = class_num
+        self._overlap_threshold = overlap_threshold
+        self._evaluate_difficult = evaluate_difficult
+        self._ap_version = ap_version
+        self._background_label = background_label
+        self.reset()
+
+    def reset(self):
+        # per image: (det [d,6], gt_label [g], gt_box [g,4], difficult [g])
+        self._images = []
+
+    def update(self, detections, gt_labels, gt_boxes, difficult=None):
+        det = np.asarray(detections)
+        gl = np.asarray(gt_labels)
+        gb = np.asarray(gt_boxes)
+        dif = (np.asarray(difficult) if difficult is not None
+               else np.zeros_like(gl, dtype=np.float64))
+        for i in range(det.shape[0]):
+            dv = det[i][det[i, :, 0] >= 0]
+            keep = gl[i] >= 0
+            self._images.append(
+                (dv.copy(), gl[i][keep].copy(), gb[i][keep].copy(),
+                 dif[i][keep].astype(bool).copy()))
+
+    @staticmethod
+    def _iou(a, b):
+        area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(
+            a[:, 3] - a[:, 1], 0)
+        area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(
+            b[:, 3] - b[:, 1], 0)
+        lt = np.maximum(a[:, None, :2], b[None, :, :2])
+        rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = np.maximum(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / np.maximum(
+            area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+    def eval(self):
+        thr = self._overlap_threshold
+        aps = []
+        for cls in range(self._class_num):
+            if cls == self._background_label:
+                continue
+            # gather this class's detections (img idx, score, box) and gts
+            rows = []
+            n_pos = 0
+            per_img_gt = []
+            for img, (det, gl, gb, dif) in enumerate(self._images):
+                sel = gl == cls
+                countable = sel & (np.ones_like(sel)
+                                   if self._evaluate_difficult else ~dif)
+                n_pos += int(countable.sum())
+                per_img_gt.append((gb[sel], dif[sel]))
+                for d in det[det[:, 0].astype(int) == cls]:
+                    rows.append((img, d[1], d[2:6]))
+            if n_pos == 0:
+                continue
+            rows.sort(key=lambda r: -r[1])
+            matched = [np.zeros(g.shape[0], bool) for g, _ in per_img_gt]
+            tp, fp = [], []
+            for img, _score, box in rows:
+                g, dif = per_img_gt[img]
+                if g.shape[0] == 0:
+                    tp.append(0.0)
+                    fp.append(1.0)
+                    continue
+                overlaps = self._iou(box[None], g)[0]
+                best = int(np.argmax(overlaps))
+                covered = overlaps[best] >= thr
+                if covered and not self._evaluate_difficult and dif[best]:
+                    continue  # ignored: neither TP nor FP
+                hit = covered and not matched[img][best]
+                if hit:
+                    matched[img][best] = True
+                tp.append(1.0 if hit else 0.0)
+                fp.append(0.0 if hit else 1.0)
+            if not tp:
+                aps.append(0.0)
+                continue
+            ctp = np.cumsum(tp)
+            cfp = np.cumsum(fp)
+            precision = ctp / np.maximum(ctp + cfp, 1e-10)
+            recall = ctp / n_pos
+            if self._ap_version == "11point":
+                ap = sum(
+                    float(np.max(precision[recall >= r], initial=0.0))
+                    for r in np.arange(0.0, 1.1, 0.1)
+                ) / 11.0
+            else:
+                prev = np.concatenate([[0.0], recall[:-1]])
+                ap = float(np.sum((recall - prev) * precision))
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

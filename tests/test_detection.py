@@ -538,3 +538,33 @@ def test_detection_output_inference_path():
     assert out.shape == (n, 4, 6)
     valid = out[out[:, :, 0] >= 0]
     assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()
+
+
+def test_metrics_detection_map_accumulates_and_matches_op():
+    from paddle_tpu.metrics import DetectionMAP
+
+    det = np.zeros((1, 3, 6), "float32")
+    det[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]
+    det[0, 1] = [1, 0.5, 0.6, 0.6, 0.9, 0.9]
+    det[0, 2] = [-1, 0, 0, 0, 0, 0]
+    gt_label = np.array([[1, -1]], "int32")
+    gt_box = np.zeros((1, 2, 4), "float32")
+    gt_box[0, 0] = [0.1, 0.1, 0.4, 0.4]
+
+    m = DetectionMAP(class_num=2)
+    m.update(det, gt_label, gt_box)
+    # single batch == the in-graph op's verdict (1.0, see op test above)
+    np.testing.assert_allclose(m.eval(), 1.0, atol=1e-6)
+
+    # second batch: one pure miss halves per-class precision tail but the
+    # integral AP only integrates at recall increases -> stays 1.0 until
+    # an actual hit ranks below a miss
+    det2 = np.zeros((1, 1, 6), "float32")
+    det2[0, 0] = [1, 0.95, 0.5, 0.5, 0.9, 0.9]  # miss (top-ranked FP)
+    gt2 = np.array([[1]], "int32")
+    gb2 = np.array([[[0.0, 0.0, 0.2, 0.2]]], "float32")
+    m.update(det2, gt2, gb2)
+    v = m.eval()
+    assert 0.0 < v < 1.0
+    m.reset()
+    assert m.eval() == 0.0

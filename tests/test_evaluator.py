@@ -1,0 +1,79 @@
+"""In-program evaluator tests (python/paddle/fluid/evaluator.py parity):
+ChunkEvaluator / EditDistance accumulate across minibatches."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_chunk_evaluator_accumulates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = fluid.layers.data("pred", [6], dtype="int64")
+        label = fluid.layers.data("label", [6], dtype="int64")
+        length = fluid.layers.data("len", [1], dtype="int64")
+        ev = fluid.evaluator.ChunkEvaluator(pred, label, "IOB", 2,
+                                            length=length)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ev.reset()
+    # tag = chunk_type * 2 + (0=B, 1=I); O = 4 (>= num_types * num_tags)
+    # batch 1: perfect prediction; batch 2: all-O prediction (no chunks)
+    seq = np.array([[0, 1, 4, 2, 3, 4]], "int64")  # B-0 I-0 O B-1 I-1 O
+    none = np.full((1, 6), 4, "int64")
+    ln = np.array([[6]], "int64")
+    for pred_v, label_v in [(seq, seq), (none, seq)]:
+        counts = exe.run(main, feed={"pred": pred_v, "label": label_v,
+                                     "len": ln},
+                         fetch_list=ev.metrics)
+        ev.update(counts)
+    precision, recall, f1 = ev.eval()
+    # 2 correct of 2 inferred chunks; 2 correct of 4 labeled chunks
+    np.testing.assert_allclose(precision, 1.0)
+    np.testing.assert_allclose(recall, 0.5)
+    np.testing.assert_allclose(f1, 2 / 3, rtol=1e-6)
+    ev.reset()
+    assert ev.eval() == (0.0, 0.0, 0.0)
+
+
+def test_edit_distance_evaluator_accumulates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        hyp = fluid.layers.data("hyp", [4], dtype="int64")
+        ref = fluid.layers.data("ref", [4], dtype="int64")
+        ev = fluid.evaluator.EditDistance(hyp, ref, normalized=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ev.reset()
+    h = np.array([[1, 2, 3, 4], [1, 2, 3, 4]], "int64")
+    r = np.array([[1, 2, 3, 4], [4, 3, 2, 1]], "int64")
+    fetched = exe.run(main, feed={"hyp": h, "ref": r},
+                      fetch_list=ev.metrics)
+    ev.update(fetched)
+    avg, err_rate = ev.eval()
+    assert err_rate == 0.5  # one of two sequences differs
+    assert avg > 0
+
+
+def test_detection_map_evaluator_accumulates():
+    det = np.zeros((1, 2, 6), "float32")
+    det[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]
+    det[0, 1] = [-1, 0, 0, 0, 0, 0]
+    gt_label = np.array([[1]], "int32")
+    gt_box = np.array([[[0.1, 0.1, 0.4, 0.4]]], "float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dv = fluid.layers.data("d", [2, 6])
+        lv = fluid.layers.data("l", [1], dtype="int32")
+        bv = fluid.layers.data("b", [1, 4])
+        ev = fluid.evaluator.DetectionMAP(dv, lv, bv, class_num=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"d": det, "l": gt_label, "b": gt_box}
+    (batch_map,) = exe.run(main, feed=feed, fetch_list=ev.metrics)
+    ev.update(det, gt_label, gt_box)
+    np.testing.assert_allclose(float(np.ravel(batch_map)[0]), 1.0, atol=1e-5)
+    np.testing.assert_allclose(ev.eval(), 1.0, atol=1e-6)
+    ev.reset()
+    assert ev.eval() == 0.0

@@ -51,6 +51,10 @@ MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.parallel_executor",
     "paddle_tpu.reader.decorator",
+    "paddle_tpu.evaluator",
+    "paddle_tpu.recordio_writer",
+    "paddle_tpu.distributed.master",
+    "paddle_tpu.dataset.common",
 ]
 
 
