@@ -35,6 +35,8 @@ __all__ = [
     "rpn_target_assign",
     "anchor_generator",
     "generate_proposals",
+    "generate_proposal_labels",
+    "roi_perspective_transform",
     "iou_similarity",
     "box_coder",
     "polygon_box_transform",
@@ -616,3 +618,74 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     boxes = tensor.concat(boxes_all, axis=0)
     variances = tensor.concat(vars_all, axis=0)
     return mbox_loc, mbox_conf, boxes, variances
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True):
+    """Fast R-CNN RoI sampling (generate_proposal_labels_op.cc). Fixed
+    capacity: S_fg + S slots per image (fg first), labels -1 on padding,
+    with a RoisWeight mask marking valid samples. Returns (rois, labels,
+    bbox_targets, bbox_inside_weights, bbox_outside_weights, rois_weight).
+    """
+    if class_nums is None:
+        raise ValueError("generate_proposal_labels requires class_nums")
+    helper = LayerHelper("generate_proposal_labels")
+    mk = lambda d: helper.create_variable_for_type_inference(
+        d, stop_gradient=True)
+    rois = mk(rpn_rois.dtype)
+    labels = mk("int32")
+    targets, inw, outw, rw = mk("float32"), mk("float32"), mk("float32"), \
+        mk("float32")
+    inputs = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+              "GtBoxes": [gt_boxes]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info]
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs=inputs,
+        outputs={
+            "Rois": [rois], "LabelsInt32": [labels],
+            "BboxTargets": [targets], "BboxInsideWeights": [inw],
+            "BboxOutsideWeights": [outw], "RoisWeight": [rw],
+        },
+        attrs={
+            "batch_size_per_im": batch_size_per_im,
+            "fg_fraction": fg_fraction,
+            "fg_thresh": fg_thresh,
+            "bg_thresh_hi": bg_thresh_hi,
+            "bg_thresh_lo": bg_thresh_lo,
+            "bbox_reg_weights": list(bbox_reg_weights),
+            "class_nums": class_nums,
+            "use_random": use_random,
+        },
+    )
+    return rois, labels, targets, inw, outw, rw
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_batch=None, name=None):
+    """Perspective-warp quadrilateral ROIs [R, 8] to a fixed rectangle
+    (roi_perspective_transform_op.cc, EAST-style text recognition)."""
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch]
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={
+            "transformed_height": transformed_height,
+            "transformed_width": transformed_width,
+            "spatial_scale": spatial_scale,
+        },
+    )
+    return out

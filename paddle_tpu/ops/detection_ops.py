@@ -1115,3 +1115,226 @@ register_op(
     lower=_lower_detection_map,
     grad=None,
 )
+
+
+# ---------------------------------------------------------------------------
+# Fast R-CNN RoI sampling + perspective RoI transform.
+# Reference: generate_proposal_labels_op.cc:440-505,
+# roi_perspective_transform_op.cc:110-300.
+# ---------------------------------------------------------------------------
+
+
+def _gen_proposal_labels_single(rois, gt_cls, gt, is_crowd, im_scale, key,
+                                attrs):
+    """rois [R,4], gt [G,4] zero-padded, gt_cls [G] -> fixed [bs] samples."""
+    bs = attrs.get("batch_size_per_im", 256)
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    fg_thresh = attrs.get("fg_thresh", 0.5)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    weights = attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    class_nums = attrs.get("class_nums", 2)
+    use_random = attrs.get("use_random", True)
+    n_fg = int(round(bs * fg_frac))
+
+    # crowd gt is excluded from sampling (generate_proposal_labels_op.cc
+    # filters crowd rows); gt comes in original-image coords and is scaled
+    # into the roi frame by im_info's scale
+    gt = gt * im_scale
+    gt_valid = (jnp.max(gt, axis=1) > 0) & (is_crowd == 0)
+    # gt boxes join the candidate pool (generate_proposal_labels appends gt)
+    pool = jnp.concatenate([rois, gt], axis=0)
+    pool_valid = jnp.concatenate(
+        [jnp.ones(rois.shape[0], bool), gt_valid]
+    )
+    # pad the pool so the fixed-capacity slices below always have n_fg +
+    # bs candidates to index (zero rows are invalid and never selected
+    # while real candidates remain)
+    deficit = max(0, n_fg + bs - int(pool.shape[0]))
+    if deficit:
+        pool = jnp.concatenate([pool, jnp.zeros((deficit, 4), pool.dtype)])
+        pool_valid = jnp.concatenate(
+            [pool_valid, jnp.zeros((deficit,), bool)]
+        )
+    iou = _iou(pool, gt)  # [P, G]
+    iou = jnp.where(gt_valid[None, :] & pool_valid[:, None], iou, -1.0)
+    best = jnp.max(iou, axis=1)
+    best_gt = jnp.argmax(iou, axis=1)
+
+    fg = pool_valid & (best >= fg_thresh)
+    bg = pool_valid & (best < bg_hi) & (best >= bg_lo)
+    p = pool.shape[0]
+    k1, k2 = jax.random.split(key)
+    if use_random:
+        fg_score = jnp.where(fg, jax.random.uniform(k1, (p,)), -jnp.inf)
+        bg_score = jnp.where(bg, jax.random.uniform(k2, (p,)), -jnp.inf)
+    else:
+        fg_score = jnp.where(fg, best, -jnp.inf)
+        bg_score = jnp.where(bg, -best, -jnp.inf)
+    fg_idx = jnp.argsort(-fg_score)[:n_fg]
+    fg_ok = fg[fg_idx]
+    num_fg = jnp.sum(fg_ok)
+    bg_idx = jnp.argsort(-bg_score)[:bs]
+    bg_ok = bg[bg_idx] & (jnp.arange(bs) < (bs - num_fg))
+
+    sel = jnp.concatenate([fg_idx, bg_idx])  # [n_fg + bs]
+    ok = jnp.concatenate([fg_ok, bg_ok])
+    out_rois = jnp.where(ok[:, None], pool[sel], 0.0)
+    labels = jnp.where(
+        jnp.concatenate([fg_ok, jnp.zeros(bs, bool)]),
+        gt_cls[best_gt[sel]].astype(jnp.int32),
+        0,
+    )
+    labels = jnp.where(ok, labels, -1)  # -1 marks padding slots
+
+    # class-aware regression targets: the shared RPN center-form encoding
+    # scaled by bbox_reg_weights (padding rows have pw == ph == 1.0)
+    matched = gt[best_gt[sel]]
+    w = jnp.asarray(weights, jnp.float32)
+    deltas = _rpn_encode(out_rois, matched) / w[None, :]
+    is_fg = jnp.concatenate([fg_ok, jnp.zeros(bs, bool)])
+    cls = jnp.maximum(labels, 0)
+    col = jnp.arange(4 * class_nums)[None, :]
+    in_class = (col // 4) == cls[:, None]
+    targets = jnp.where(
+        is_fg[:, None] & in_class,
+        jnp.tile(deltas, (1, class_nums)),
+        0.0,
+    )
+    inside_w = jnp.where(is_fg[:, None] & in_class, 1.0, 0.0)
+    outside_w = inside_w
+    return (out_rois, labels, targets, inside_w, outside_w,
+            ok.astype(jnp.float32))
+
+
+def _lower_generate_proposal_labels(ctx, ins, attrs):
+    rois = ins["RpnRois"][0]  # [N, R, 4] or [R, 4]
+    gt_cls = ins["GtClasses"][0].astype(jnp.int32)  # [N, G]
+    gt = ins["GtBoxes"][0]  # [N, G, 4]
+    n, g = gt.shape[0], gt.shape[1]
+    if rois.ndim == 2:
+        rois = jnp.broadcast_to(rois[None], (n,) + rois.shape)
+    if ins.get("IsCrowd"):
+        is_crowd = ins["IsCrowd"][0].astype(jnp.int32)
+    else:
+        is_crowd = jnp.zeros((n, g), jnp.int32)
+    if ins.get("ImInfo"):
+        im_scale = ins["ImInfo"][0][:, 2]
+    else:
+        im_scale = jnp.ones((n,), jnp.float32)
+    keys = jax.random.split(ctx.rng(), n)
+    outs = jax.vmap(
+        lambda r, c, g_, ic, sc, k: _gen_proposal_labels_single(
+            r, c, g_, ic, sc, k, attrs)
+    )(rois, gt_cls, gt, is_crowd, im_scale, keys)
+    names = ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+             "BboxOutsideWeights", "RoisWeight"]
+    return dict(zip(names, outs))
+
+
+register_op(
+    "generate_proposal_labels",
+    inputs=["RpnRois", "GtClasses", "IsCrowd", "GtBoxes", "ImInfo"],
+    outputs=["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+             "BboxOutsideWeights", "RoisWeight"],
+    attrs={
+        "batch_size_per_im": 256,
+        "fg_fraction": 0.25,
+        "fg_thresh": 0.5,
+        "bg_thresh_hi": 0.5,
+        "bg_thresh_lo": 0.0,
+        "bbox_reg_weights": [0.1, 0.1, 0.2, 0.2],
+        "class_nums": 2,
+        "use_random": True,
+    },
+    lower=_lower_generate_proposal_labels,
+    grad=None,
+)
+
+
+def _perspective_matrix(quad_x, quad_y, tw, th):
+    """Homography mapping output rect [tw,th] -> roi quad
+    (get_transform_matrix in roi_perspective_transform_op.cc)."""
+    # solve for the 8 coefficients of
+    #   x = (a0 u + a1 v + a2) / (c0 u + c1 v + 1)
+    #   y = (b0 u + b1 v + b2) / (c0 u + c1 v + 1)
+    # from the 4 corner correspondences (u,v) in {0,w-1}x{0,h-1}
+    u = jnp.asarray([0.0, tw - 1.0, 0.0, tw - 1.0])
+    v = jnp.asarray([0.0, 0.0, th - 1.0, th - 1.0])
+    x = quad_x
+    y = quad_y
+    zeros = jnp.zeros(4)
+    ones = jnp.ones(4)
+    a_rows = jnp.stack([u, v, ones, zeros, zeros, zeros, -u * x, -v * x], 1)
+    b_rows = jnp.stack([zeros, zeros, zeros, u, v, ones, -u * y, -v * y], 1)
+    mat = jnp.concatenate([a_rows, b_rows], axis=0)  # [8, 8]
+    rhs = jnp.concatenate([x, y])
+    coef = jnp.linalg.solve(mat, rhs)
+    return coef  # a0 a1 a2 b0 b1 b2 c0 c1
+
+
+def _roi_perspective_one(x, quad, tw, th, spatial_scale):
+    """x [C,H,W], quad [8] (x1,y1..x4,y4 in input coords) -> [C,th,tw]."""
+    c, h, w = x.shape
+    qx = quad[0::2] * spatial_scale
+    qy = quad[1::2] * spatial_scale
+    # reference corner order: (x1,y1) top-left, (x2,y2) top-right,
+    # (x3,y3) bottom-right, (x4,y4) bottom-left -> map to u/v grid order
+    qx = jnp.stack([qx[0], qx[1], qx[3], qx[2]])
+    qy = jnp.stack([qy[0], qy[1], qy[3], qy[2]])
+    coef = _perspective_matrix(qx, qy, tw, th)
+    uu, vv = jnp.meshgrid(
+        jnp.arange(tw, dtype=jnp.float32),
+        jnp.arange(th, dtype=jnp.float32),
+    )
+    denom = coef[6] * uu + coef[7] * vv + 1.0
+    sx = (coef[0] * uu + coef[1] * vv + coef[2]) / denom
+    sy = (coef[3] * uu + coef[4] * vv + coef[5]) / denom
+    inside = (sx >= -0.5) & (sx <= w - 0.5) & (sy >= -0.5) & (sy <= h - 0.5)
+    sxc = jnp.clip(sx, 0.0, w - 1.0)
+    syc = jnp.clip(sy, 0.0, h - 1.0)
+    x0 = jnp.floor(sxc)
+    y0 = jnp.floor(syc)
+    x1 = jnp.minimum(x0 + 1, w - 1.0)
+    y1 = jnp.minimum(y0 + 1, h - 1.0)
+    lx, ly = sxc - x0, syc - y0
+    g = lambda yy, xx: x[:, yy.astype(jnp.int32), xx.astype(jnp.int32)]
+    val = (
+        g(y0, x0) * (1 - ly) * (1 - lx)
+        + g(y0, x1) * (1 - ly) * lx
+        + g(y1, x0) * ly * (1 - lx)
+        + g(y1, x1) * ly * lx
+    )
+    return jnp.where(inside[None], val, 0.0)
+
+
+def _lower_roi_perspective_transform(ctx, ins, attrs):
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]  # [R, 8] quads
+    batch = (
+        ins["RoisBatch"][0].astype(jnp.int32)
+        if ins.get("RoisBatch")
+        else jnp.zeros((rois.shape[0],), jnp.int32)
+    )
+    th = attrs.get("transformed_height", 1)
+    tw = attrs.get("transformed_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    feats = x[batch]
+    return jax.vmap(
+        lambda f, q: _roi_perspective_one(f, q, tw, th, scale)
+    )(feats, rois)
+
+
+register_op(
+    "roi_perspective_transform",
+    inputs=["X", "ROIs", "RoisBatch"],
+    outputs=["Out"],
+    attrs={
+        "transformed_height": 1,
+        "transformed_width": 1,
+        "spatial_scale": 1.0,
+    },
+    lower=_lower_roi_perspective_transform,
+    grad="auto",
+    no_grad_inputs=("ROIs", "RoisBatch"),
+)

@@ -27,34 +27,33 @@ __all__ = [
 
 def map_readers(func, *readers):
     def reader():
-        rs = [r() for r in readers]
-        for items in zip(*rs):
-            yield func(*items)
+        yield from itertools.starmap(func, zip(*(r() for r in readers)))
 
     return reader
 
 
 def shuffle(reader, buf_size):
+    """Windowed shuffle: fill a buf_size window, emit it permuted."""
+
     def data_reader():
-        buf = []
-        for e in reader():
-            buf.append(e)
-            if len(buf) >= buf_size:
-                random.shuffle(buf)
-                for b in buf:
-                    yield b
-                buf = []
-        if buf:
-            random.shuffle(buf)
-            for b in buf:
-                yield b
+        it = iter(reader())
+        if buf_size <= 0:  # degenerate window: plain pass-through
+            yield from it
+            return
+        while True:
+            window = list(itertools.islice(it, buf_size))
+            if not window:
+                return
+            random.shuffle(window)
+            yield from window
 
     return data_reader
 
 
 def chain(*readers):
     def reader():
-        return itertools.chain(*[r() for r in readers])
+        for r in readers:
+            yield from r()
 
     return reader
 

@@ -568,3 +568,107 @@ def test_metrics_detection_map_accumulates_and_matches_op():
     assert 0.0 < v < 1.0
     m.reset()
     assert m.eval() == 0.0
+
+
+def test_generate_proposal_labels_sampling():
+    rng = np.random.RandomState(13)
+    r, g, bs = 20, 3, 8
+    gt = np.stack([_rand_boxes(rng, g, scale=30.0)])
+    gt[0, 2] = 0.0  # padding
+    gt_cls = np.array([[1, 2, -1]], "int32")
+    # candidate rois: jittered copies of the gts + random junk
+    rois = np.concatenate([
+        gt[0, :2] + 0.8 * rng.randn(2, 4).astype("float32"),
+        _rand_boxes(rng, r - 2, scale=30.0),
+    ])
+
+    def build():
+        rv = fluid.layers.data("r", [r, 4], append_batch_size=False)
+        cv = fluid.layers.data("c", [g], dtype="int32")
+        gv = fluid.layers.data("g", [g, 4])
+        return fluid.layers.generate_proposal_labels(
+            rv, cv, None, gv, batch_size_per_im=bs, fg_fraction=0.25,
+            class_nums=3, use_random=False)
+
+    rois_o, labels, targets, inw, outw, rw = _run(build, {
+        "r": rois, "c": gt_cls, "g": gt})
+    n_fg = 2  # round(8 * 0.25)
+    cap = n_fg + bs
+    assert rois_o.shape == (1, cap, 4)
+    assert labels.shape == (1, cap)
+    assert targets.shape == (1, cap, 12)  # 4 * class_nums
+    valid = rw[0] > 0
+    # gt boxes join the pool, so >=1 fg with the right class labels
+    fg_labels = labels[0][:n_fg][valid[:n_fg]]
+    assert (fg_labels > 0).all() and set(fg_labels) <= {1, 2}
+    # regression targets only on the matched class columns of fg rows
+    for i in range(n_fg):
+        if not valid[i]:
+            continue
+        cls = labels[0, i]
+        cols = slice(4 * cls, 4 * cls + 4)
+        assert inw[0, i, cols].sum() == 4.0
+        other = np.delete(inw[0, i], np.r_[cols])
+        assert other.sum() == 0.0
+    # background rows: label 0, no regression
+    bg = labels[0][n_fg:][valid[n_fg:]]
+    assert (bg == 0).all()
+    assert inw[0, n_fg:][valid[n_fg:]].sum() == 0.0
+
+
+def test_roi_perspective_transform_identity_and_warp():
+    rng = np.random.RandomState(2)
+    x = rng.rand(1, 2, 8, 8).astype("float32")
+    # axis-aligned quad == the whole image: output resamples the image grid
+    quad = np.array([[0, 0, 7, 0, 7, 7, 0, 7]], "float32")
+
+    def build():
+        xv = fluid.layers.data("x", [2, 8, 8])
+        rv = fluid.layers.data("q", [8], append_batch_size=True)
+        out = fluid.layers.roi_perspective_transform(xv, rv, 8, 8, 1.0)
+        return (out,)
+
+    (out,) = _run(build, {"x": x, "q": quad})
+    assert out.shape == (1, 2, 8, 8)
+    np.testing.assert_allclose(out[0], x[0], rtol=1e-4, atol=1e-4)
+
+    # half-size output = downsampled content, still finite and in-range
+    def build2():
+        xv = fluid.layers.data("x", [2, 8, 8])
+        rv = fluid.layers.data("q", [8], append_batch_size=True)
+        out = fluid.layers.roi_perspective_transform(xv, rv, 4, 4, 1.0)
+        return (out,)
+
+    (out2,) = _run(build2, {"x": x, "q": quad})
+    assert out2.shape == (1, 2, 4, 4)
+    assert np.isfinite(out2).all()
+    assert out2.min() >= x.min() - 1e-5 and out2.max() <= x.max() + 1e-5
+
+
+def test_generate_proposal_labels_small_pool_and_crowd():
+    """Pool smaller than the sample capacity must pad, not crash; crowd
+    gt rows are excluded from sampling."""
+    rng = np.random.RandomState(17)
+    r, g, bs = 3, 2, 8  # pool (r + g) << n_fg + bs
+    gt = np.stack([_rand_boxes(rng, g, scale=30.0)])
+    gt_cls = np.array([[1, 2]], "int32")
+    is_crowd = np.array([[0, 1]], "int32")  # second gt is crowd
+    rois = gt[0] + 0.5 * rng.randn(g, 4).astype("float32")
+    rois = np.concatenate([rois, _rand_boxes(rng, r - g, scale=30.0)])
+
+    def build():
+        rv = fluid.layers.data("r", [r, 4], append_batch_size=False)
+        cv = fluid.layers.data("c", [g], dtype="int32")
+        gv = fluid.layers.data("g", [g, 4])
+        ic = fluid.layers.data("ic", [g], dtype="int32")
+        return fluid.layers.generate_proposal_labels(
+            rv, cv, ic, gv, batch_size_per_im=bs, fg_fraction=0.25,
+            class_nums=3, use_random=False)
+
+    rois_o, labels, targets, inw, outw, rw = _run(build, {
+        "r": rois, "c": gt_cls, "g": gt, "ic": is_crowd})
+    n_fg = 2
+    assert rois_o.shape == (1, n_fg + bs, 4)  # fixed capacity held
+    valid = rw[0] > 0
+    # crowd class (2) never appears as a foreground label
+    assert 2 not in set(labels[0][valid].tolist())
