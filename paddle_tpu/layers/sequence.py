@@ -7,6 +7,7 @@ the reference; masked-dense on TPU, per SURVEY.md §5.7).
 from paddle_tpu.layer_helper import LayerHelper
 
 __all__ = [
+    "sequence_reshape",
     "sequence_pool",
     "sequence_softmax",
     "sequence_reverse",
@@ -219,5 +220,19 @@ def sequence_scatter(input, index, updates, name=None):
         type="sequence_scatter",
         inputs={"X": [input], "Ids": [index], "Updates": [updates]},
         outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    """Re-chunk the trailing feature dim (sequence_reshape_op.cc); on the
+    padded layout [B, T, D] -> [B, T*D/new_dim, new_dim]."""
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_reshape",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"new_dim": new_dim},
     )
     return out

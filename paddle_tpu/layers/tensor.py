@@ -6,6 +6,7 @@ from paddle_tpu import framework
 from paddle_tpu.layer_helper import LayerHelper
 
 __all__ = [
+    "pad_constant_like",
     "create_tensor",
     "create_parameter",
     "create_global_var",
@@ -248,5 +249,19 @@ def range(start, end, step, dtype):
         type="range",
         outputs={"Out": [out]},
         attrs={"start": start, "end": end, "step": step, "dtype": dtype},
+    )
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y on the high side of each dim up to x's shape
+    (pad_constant_like_op.cc)."""
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op(
+        type="pad_constant_like",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"pad_value": float(pad_value)},
     )
     return out

@@ -11,3 +11,4 @@ from paddle_tpu.models import machine_translation  # noqa: F401
 from paddle_tpu.models import se_resnext  # noqa: F401
 from paddle_tpu.models import googlenet  # noqa: F401
 from paddle_tpu.models import alexnet  # noqa: F401
+from paddle_tpu.models import ssd  # noqa: F401

@@ -439,3 +439,23 @@ register_op(
     lower=_lower_sequence_scatter,
     no_grad_inputs=("Ids",),
 )
+
+
+def _lower_sequence_reshape(ctx, ins, attrs):
+    """sequence_reshape_op.cc: re-chunk the feature dim. Padded layout:
+    [B, T, D] -> [B, T * D / new_dim, new_dim]; lengths scale by
+    D / new_dim (the caller adjusts its Length tensor the same way)."""
+    x = ins["X"][0]
+    new_dim = attrs["new_dim"]
+    b, t, d = x.shape
+    assert (t * d) % new_dim == 0, "sequence_reshape dim mismatch"
+    return jnp.reshape(x, (b, (t * d) // new_dim, new_dim))
+
+
+register_op(
+    "sequence_reshape",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"new_dim": 1},
+    lower=_lower_sequence_reshape,
+)

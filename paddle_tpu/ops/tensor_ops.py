@@ -486,3 +486,24 @@ register_op(
     lower=_lower_batched_gather,
     no_grad_inputs=("Index",),
 )
+
+
+register_op(
+    "pad_constant_like",
+    inputs=["X", "Y"],
+    outputs=["Out"],
+    attrs={"pad_value": 0.0},
+    # pad Y up to X's shape on the high side of every dim
+    # (pad_constant_like_op.cc)
+    lower=lambda ctx, ins, attrs: jnp.pad(
+        ins["Y"][0],
+        [
+            (0, int(xd) - int(yd))
+            for xd, yd in zip(
+                jnp.shape(ins["X"][0]), jnp.shape(ins["Y"][0])
+            )
+        ],
+        constant_values=attrs.get("pad_value", 0.0),
+    ),
+    no_grad_inputs=("X",),
+)

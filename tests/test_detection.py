@@ -672,3 +672,40 @@ def test_generate_proposal_labels_small_pool_and_crowd():
     valid = rw[0] > 0
     # crowd class (2) never appears as a foreground label
     assert 2 not in set(labels[0][valid].tolist())
+
+
+def test_ssd_model_zoo_trains_and_evals():
+    """models/ssd.py book-style check: loss falls on synthetic boxes and
+    the eval head (NMS + mAP) runs on the test clone."""
+    from paddle_tpu.models import ssd
+
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss, feeds, extras = ssd.build(img_shape=(3, 64, 64), class_num=3,
+                                        max_gt=2, nms_keep_top_k=10)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(3e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    def batch(n=4):
+        xy = rng.uniform(0, 0.6, (n, 2, 2))
+        wh = rng.uniform(0.15, 0.35, (n, 2, 2))
+        gb = np.concatenate([xy, xy + wh], -1).astype("float32")
+        return {"image": rng.rand(n, 3, 64, 64).astype("float32"),
+                "gt_box": gb,
+                "gt_label": rng.randint(1, 3, (n, 2)).astype("int32")}
+
+    losses = []
+    for _ in range(12):
+        (lv,) = exe.run(main, feed=batch(), fetch_list=[loss])
+        losses.append(float(np.ravel(np.asarray(lv))[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+    out, m = exe.run(test_prog, feed=batch(),
+                     fetch_list=[extras["nmsed_out"], extras["map_eval"]])
+    out = np.asarray(out)
+    assert out.shape[2] == 6
+    assert 0.0 <= float(np.ravel(np.asarray(m))[0]) <= 1.0
