@@ -448,7 +448,10 @@ def _lower_sequence_reshape(ctx, ins, attrs):
     x = ins["X"][0]
     new_dim = attrs["new_dim"]
     b, t, d = x.shape
-    assert (t * d) % new_dim == 0, "sequence_reshape dim mismatch"
+    if new_dim <= 0 or (t * d) % new_dim != 0:
+        raise ValueError(
+            "sequence_reshape: T*D = %d not divisible by new_dim %d"
+            % (t * d, new_dim))
     return jnp.reshape(x, (b, (t * d) // new_dim, new_dim))
 
 

@@ -488,22 +488,29 @@ register_op(
 )
 
 
+def _lower_pad_constant_like(ctx, ins, attrs):
+    """Pad Y up to X's shape on the high side of every dim
+    (pad_constant_like_op.cc, which enforces X.dims >= Y.dims)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim != y.ndim:
+        raise ValueError(
+            "pad_constant_like: rank mismatch (X %dd vs Y %dd)"
+            % (x.ndim, y.ndim))
+    widths = []
+    for d, (xd, yd) in enumerate(zip(jnp.shape(x), jnp.shape(y))):
+        if int(yd) > int(xd):
+            raise ValueError(
+                "pad_constant_like: Y dim %d (%d) exceeds X dim (%d)"
+                % (d, int(yd), int(xd)))
+        widths.append((0, int(xd) - int(yd)))
+    return jnp.pad(y, widths, constant_values=attrs.get("pad_value", 0.0))
+
+
 register_op(
     "pad_constant_like",
     inputs=["X", "Y"],
     outputs=["Out"],
     attrs={"pad_value": 0.0},
-    # pad Y up to X's shape on the high side of every dim
-    # (pad_constant_like_op.cc)
-    lower=lambda ctx, ins, attrs: jnp.pad(
-        ins["Y"][0],
-        [
-            (0, int(xd) - int(yd))
-            for xd, yd in zip(
-                jnp.shape(ins["X"][0]), jnp.shape(ins["Y"][0])
-            )
-        ],
-        constant_values=attrs.get("pad_value", 0.0),
-    ),
+    lower=_lower_pad_constant_like,
     no_grad_inputs=("X",),
 )

@@ -249,3 +249,51 @@ def test_inference_transpiler_skips_residual_add():
     (after,) = exe.run(test_prog, feed={"x": x}, fetch_list=[bn])
     np.testing.assert_allclose(np.asarray(after), np.asarray(before),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_pad_constant_like_and_errors():
+    x = np.zeros((1, 4, 6), "float32")
+    y = np.arange(6, dtype="float32").reshape(1, 2, 3)
+
+    def build():
+        xv = fluid.layers.data("x", [4, 6])
+        yv = fluid.layers.data("y", [2, 3])
+        return (fluid.layers.pad_constant_like(xv, yv, 9.0),)
+
+    (out,) = _run(build, {"x": x, "y": y})
+    assert out.shape == (1, 4, 6)
+    np.testing.assert_array_equal(out[0, :2, :3], y[0])
+    assert (out[0, 2:, :] == 9.0).all() and (out[0, :, 3:] == 9.0).all()
+
+    # grad flows through Y only (X is shape-only)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [4, 6])
+        yv = fluid.layers.data("y", [2, 3], stop_gradient=False)
+        p = fluid.layers.pad_constant_like(xv, yv)
+        loss = fluid.layers.mean(p)
+        grads = fluid.backward.calc_gradient(loss, [yv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (g,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[grads[0]])
+    np.testing.assert_allclose(np.asarray(g), np.full_like(y, 1.0 / 24),
+                               rtol=1e-6)
+
+
+def test_sequence_reshape_rechunks_and_validates():
+    x = np.arange(24, dtype="float32").reshape(1, 4, 6)
+
+    def build():
+        xv = fluid.layers.data("x", [4, 6])
+        return (fluid.layers.sequence_reshape(xv, 3),)
+
+    (out,) = _run(build, {"x": x})
+    assert out.shape == (1, 8, 3)
+    np.testing.assert_array_equal(out.reshape(1, 24), x.reshape(1, 24))
+
+    def build_bad():
+        xv = fluid.layers.data("x", [4, 6])
+        return (fluid.layers.sequence_reshape(xv, 7),)
+
+    with pytest.raises(Exception, match="sequence_reshape"):
+        _run(build_bad, {"x": x})
