@@ -1,0 +1,165 @@
+"""Program-pass framework: registry + PassManager over Program graphs.
+
+Reference parity: paddle/fluid/framework/ir/ (Pass base + REGISTER_PASS,
+pass_builder) and the analysis layer that AnalysisPredictor drives. The
+TPU-first difference in *scope*: XLA already performs the kernel-level
+fusions the reference's mkldnn/ir passes hand-write (conv+relu,
+conv+eltwise), so passes here operate at PROGRAM level — semantic
+rewrites XLA cannot do on its own (precision policy, BN folding for
+serialization, graph slicing, dead-op cleanup) — and the heavy
+per-op fusion stays the compiler's job.
+
+A pass is ``fn(program, scope=None, **kwargs) -> program`` (in-place or
+returning a new Program). Register with :func:`register_pass`; run with
+:class:`PassManager` or :func:`apply_pass`.
+"""
+
+import inspect
+import logging
+
+logger = logging.getLogger("paddle_tpu.passes")
+
+_PASSES = {}
+
+__all__ = ["register_pass", "get_pass", "list_passes", "apply_pass",
+           "PassManager"]
+
+
+def register_pass(name, fn=None):
+    """REGISTER_PASS analog; usable as a decorator."""
+
+    def deco(f):
+        if name in _PASSES:
+            raise ValueError("pass %r already registered" % name)
+        _PASSES[name] = f
+        return f
+
+    return deco(fn) if fn is not None else deco
+
+
+def get_pass(name):
+    if name not in _PASSES:
+        raise KeyError(
+            "unknown pass %r (have: %s)" % (name, ", ".join(sorted(_PASSES)))
+        )
+    return _PASSES[name]
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+def apply_pass(program, name, scope=None, **kwargs):
+    logger.debug("applying pass %s", name)
+    fn = get_pass(name)
+    # pipelines broadcast kwargs; hand each pass only what it accepts
+    sig = inspect.signature(fn)
+    if not any(p.kind is inspect.Parameter.VAR_KEYWORD
+               for p in sig.parameters.values()):
+        kwargs = {k: v for k, v in kwargs.items() if k in sig.parameters}
+    out = fn(program, scope=scope, **kwargs)
+    return out if out is not None else program
+
+
+class PassManager(object):
+    """Ordered pass pipeline (pass_builder role). ``strategies`` maps a
+    use case to a default pipeline, as AnalysisPredictor's pass lists do."""
+
+    STRATEGIES = {
+        # deploy: fold BN into convs, slice to the inference subgraph
+        "inference": ["fuse_batch_norm", "prune_feed_fetch"],
+        # training memory: rematerialization planning
+        "memory": ["memory_optimize"],
+        # mixed precision training
+        "amp_bf16": ["amp_rewrite"],
+    }
+
+    def __init__(self, passes=None, strategy=None):
+        if strategy is not None:
+            passes = self.STRATEGIES[strategy] + list(passes or [])
+        self.passes = list(passes or [])
+        for p in self.passes:
+            get_pass(p)  # fail fast on unknown names
+
+    def apply(self, program, scope=None, **kwargs):
+        for name in self.passes:
+            program = apply_pass(program, name, scope=scope, **kwargs)
+        return program
+
+
+# -- built-in passes wrapping the program transforms ------------------------
+
+
+@register_pass("fuse_batch_norm")
+def _fuse_batch_norm(program, scope=None, **kwargs):
+    """conv(+bias)+batch_norm fold (ConvBNFusePass / inference
+    transpiler role)."""
+    from paddle_tpu.transpiler.inference_transpiler import (
+        InferenceTranspiler,
+    )
+
+    return InferenceTranspiler().transpile(program, scope=scope)
+
+
+@register_pass("amp_rewrite")
+def _amp_rewrite(program, scope=None, dtype="bfloat16", **kwargs):
+    """bf16 mixed-precision policy (float16_transpiler role)."""
+    from paddle_tpu.transpiler import rewrite_program_amp
+
+    rewrite_program_amp(program, dtype)
+    return program
+
+
+@register_pass("memory_optimize")
+def _memory_optimize(program, scope=None, **kwargs):
+    """Rematerialization planning (memory_optimize transpiler)."""
+    from paddle_tpu.transpiler import memory_optimize
+
+    memory_optimize(program)
+    return program
+
+
+@register_pass("prune_feed_fetch")
+def _prune_feed_fetch(program, scope=None, feed_names=None,
+                      fetch_names=None, **kwargs):
+    """Backward slice to the feed->fetch subgraph (framework/prune.cc).
+    No-op unless both name lists are given."""
+    if not feed_names or not fetch_names:
+        return program
+    from paddle_tpu.io import prune_program
+
+    return prune_program(program, feed_names, fetch_names)
+
+
+@register_pass("delete_dropout")
+def _delete_dropout(program, scope=None, **kwargs):
+    """Neutralize inference-mode dropout (identity at is_test with
+    upscale_in_train), in every block. Downstream readers are rewired to
+    the dropout input; because the pass cannot know what a future
+    exe.run will fetch, the op itself is downgraded to an ``assign``
+    (XLA elides the copy) rather than removed, so fetching the old
+    output name keeps working. The dead Mask var is dropped."""
+    for bi in range(program.num_blocks):
+        block = program.block(bi)
+        for i, op in enumerate(block.ops):
+            if not (
+                op.type == "dropout"
+                and op.attrs.get("is_test", False)
+                and op.attrs.get("dropout_implementation")
+                == "upscale_in_train"
+            ):
+                continue
+            src = op.input("X")[0]
+            dst = op.output("Out")[0]
+            for mask in op.output("Mask"):
+                block.vars.pop(mask, None)
+            for later in block.ops[i + 1:]:
+                for slot, names in list(later.inputs.items()):
+                    later.inputs[slot] = [
+                        src if n == dst else n for n in names
+                    ]
+            op.type = "assign"
+            op.inputs = {"X": [src]}
+            op.outputs = {"Out": [dst]}
+    program._bump_version()
+    return program

@@ -1,0 +1,108 @@
+"""Pass framework tests (framework/ir Pass + pass_builder parity)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import passes
+
+
+def _conv_bn_net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3, 8, 8])
+        c = fluid.layers.conv2d(x, 4, 3, padding=1, bias_attr=True)
+        b = fluid.layers.batch_norm(c)
+        d = fluid.layers.dropout(b, 0.3,
+                                 dropout_implementation="upscale_in_train")
+        out = fluid.layers.relu(d)
+    return main, startup, out
+
+
+def test_registry_and_unknown_pass():
+    assert "fuse_batch_norm" in passes.list_passes()
+    with pytest.raises(KeyError):
+        passes.get_pass("nope")
+    with pytest.raises(ValueError):
+        passes.register_pass("fuse_batch_norm", lambda p, scope=None: p)
+
+
+def test_inference_strategy_pipeline_preserves_outputs():
+    main, startup, out = _conv_bn_net()
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.random.RandomState(0).rand(2, 3, 8, 8).astype("float32")
+    (before,) = exe.run(test_prog, feed={"x": x}, fetch_list=[out])
+
+    pm = fluid.passes.PassManager(strategy="inference",
+                                  passes=["delete_dropout"])
+    test_prog = pm.apply(test_prog, scope=fluid.global_scope(),
+                         feed_names=["x"], fetch_names=[out.name])
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "batch_norm" not in types
+    assert "dropout" not in types
+    (after,) = exe.run(test_prog, feed={"x": x}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_custom_pass_applies_in_order():
+    calls = []
+
+    @passes.register_pass("_test_tag_a")
+    def tag_a(program, scope=None, **kw):
+        calls.append("a")
+        return program
+
+    @passes.register_pass("_test_tag_b")
+    def tag_b(program, scope=None, **kw):
+        calls.append("b")
+        return program
+
+    try:
+        main = fluid.Program()
+        fluid.passes.PassManager(["_test_tag_b", "_test_tag_a"]).apply(main)
+        assert calls == ["b", "a"]
+    finally:
+        passes._PASSES.pop("_test_tag_a", None)
+        passes._PASSES.pop("_test_tag_b", None)
+
+
+def test_amp_strategy_marks_program():
+    main, startup, out = _conv_bn_net()
+    fluid.passes.PassManager(strategy="amp_bf16").apply(main)
+    assert getattr(main, "_amp_dtype", None) == "bfloat16"
+
+
+def test_delete_dropout_keeps_fetchable_output():
+    """Fetching the (former) dropout output must keep working: the pass
+    downgrades the op to assign instead of deleting it."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        d = fluid.layers.dropout(x, 0.5,
+                                 dropout_implementation="upscale_in_train")
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    passes.apply_pass(test_prog, "delete_dropout")
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "dropout" not in types and "assign" in types
+    xb = np.ones((2, 4), "float32")
+    (out,) = exe.run(test_prog, feed={"x": xb}, fetch_list=[d])
+    np.testing.assert_array_equal(np.asarray(out), xb)
+
+
+def test_pass_kwargs_filtered_per_signature():
+    @passes.register_pass("_test_no_kwargs")
+    def strict(program, scope=None):
+        return program
+
+    try:
+        main = fluid.Program()
+        # feed/fetch kwargs must not leak into a pass that can't take them
+        fluid.passes.PassManager(["_test_no_kwargs"]).apply(
+            main, feed_names=["x"], fetch_names=["y"])
+    finally:
+        passes._PASSES.pop("_test_no_kwargs", None)

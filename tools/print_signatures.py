@@ -55,6 +55,7 @@ MODULES = [
     "paddle_tpu.recordio_writer",
     "paddle_tpu.distributed.master",
     "paddle_tpu.dataset.common",
+    "paddle_tpu.core.passes",
 ]
 
 
