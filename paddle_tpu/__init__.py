@@ -60,7 +60,12 @@ from paddle_tpu import debugger  # noqa: F401
 from paddle_tpu.core import passes  # noqa: F401
 from paddle_tpu.transpiler import memory_optimize, release_memory  # noqa: F401
 from paddle_tpu.transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
-from paddle_tpu.core.lod import LoDTensor, create_lod_tensor  # noqa: F401
+from paddle_tpu.core.lod import (  # noqa: F401
+    LoDTensor,
+    create_lod_tensor,
+    create_random_int_lodtensor,
+)
+from paddle_tpu import average  # noqa: F401
 from paddle_tpu.core.selected_rows import SelectedRows  # noqa: F401
 from paddle_tpu import unique_name  # noqa: F401
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401

@@ -1,0 +1,33 @@
+"""Weighted averaging across fetched batch values
+(python/paddle/fluid/average.py parity)."""
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+class WeightedAverage(object):
+    """Accumulate (value, weight) pairs; eval() = weighted mean. The
+    typical use is averaging per-batch losses weighted by batch size."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = 0.0
+        self.denominator = 0.0
+
+    def add(self, value, weight):
+        value = np.ravel(np.asarray(value, dtype=np.float64))
+        if value.size != 1:
+            raise ValueError("add() expects a scalar value, got shape %s"
+                             % (value.shape,))
+        w = float(weight)
+        self.numerator += float(value[0]) * w
+        self.denominator += w
+
+    def eval(self):
+        if self.denominator == 0.0:
+            raise ValueError(
+                "WeightedAverage.eval() before any add() (zero weight)")
+        return self.numerator / self.denominator

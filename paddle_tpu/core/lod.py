@@ -101,3 +101,14 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
         t = LoDTensor(np.asarray(data))
     t.set_recursive_sequence_lengths(recursive_seq_lens)
     return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    """fluid.create_random_int_lodtensor parity
+    (python/paddle/fluid/lod_tensor.py:92): random ints shaped
+    [sum(innermost lens)] + base_shape with the given nesting."""
+    flat = recursive_seq_lens[-1]
+    total = int(np.sum(flat))
+    data = np.random.randint(low, high + 1, [total] + list(base_shape))
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -137,3 +137,28 @@ def test_profiler_report_and_chrome_trace(tmp_path, capsys):
     steps = [e for e in trace["traceEvents"] if e["name"] == "train_step"]
     assert len(steps) == 3
     assert all(e["ph"] == "X" and e["dur"] > 0 for e in steps)
+
+
+def test_weighted_average():
+    from paddle_tpu.average import WeightedAverage
+
+    wa = WeightedAverage()
+    wa.add(2.0, 3)
+    wa.add(np.array([4.0]), 1)
+    assert wa.eval() == pytest.approx((2.0 * 3 + 4.0) / 4)
+    wa.reset()
+    with pytest.raises(ValueError):
+        wa.eval()
+    with pytest.raises(ValueError):
+        wa.add(np.ones(3), 1.0)
+
+
+def test_create_random_int_lodtensor():
+    import paddle_tpu as fluid
+
+    t = fluid.create_random_int_lodtensor(
+        [[2, 3]], base_shape=[4], low=1, high=9)
+    assert t.numpy().shape == (5, 4)
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    arr = t.numpy()
+    assert arr.min() >= 1 and arr.max() <= 9
