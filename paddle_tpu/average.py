@@ -18,16 +18,16 @@ class WeightedAverage(object):
         self.denominator = 0.0
 
     def add(self, value, weight):
-        value = np.ravel(np.asarray(value, dtype=np.float64))
-        if value.size != 1:
-            raise ValueError("add() expects a scalar value, got shape %s"
-                             % (value.shape,))
+        """Accumulate a scalar or array value (upstream accepts matrices
+        and averages element-wise)."""
+        value = np.asarray(value, dtype=np.float64)
         w = float(weight)
-        self.numerator += float(value[0]) * w
+        self.numerator = self.numerator + value * w
         self.denominator += w
 
     def eval(self):
         if self.denominator == 0.0:
             raise ValueError(
                 "WeightedAverage.eval() before any add() (zero weight)")
-        return self.numerator / self.denominator
+        out = self.numerator / self.denominator
+        return float(out) if np.ndim(out) == 0 else out

@@ -110,5 +110,6 @@ def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
     [sum(innermost lens)] + base_shape with the given nesting."""
     flat = recursive_seq_lens[-1]
     total = int(np.sum(flat))
-    data = np.random.randint(low, high + 1, [total] + list(base_shape))
+    data = np.random.randint(
+        low, high + 1, [total] + list(base_shape)).astype('int64')
     return create_lod_tensor(data, recursive_seq_lens, place)

@@ -149,8 +149,10 @@ def test_weighted_average():
     wa.reset()
     with pytest.raises(ValueError):
         wa.eval()
-    with pytest.raises(ValueError):
-        wa.add(np.ones(3), 1.0)
+    # element-wise matrix averaging, as upstream average.py supports
+    wa.add(np.array([1.0, 3.0]), 1.0)
+    wa.add(np.array([3.0, 5.0]), 3.0)
+    np.testing.assert_allclose(wa.eval(), [2.5, 4.5])
 
 
 def test_create_random_int_lodtensor():
@@ -162,3 +164,4 @@ def test_create_random_int_lodtensor():
     assert t.recursive_sequence_lengths() == [[2, 3]]
     arr = t.numpy()
     assert arr.min() >= 1 and arr.max() <= 9
+    assert arr.dtype == np.int64
