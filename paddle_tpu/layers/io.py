@@ -119,24 +119,59 @@ class PyReader(object):
     def decorate_tensor_provider(self, reader):
         self._decorated = reader
 
+    def decorate_paddle_readers(self, readers, passes=1):
+        """Multiple source readers drained by parallel worker threads into
+        the one queue (open_files_op.cc thread_num capability). Sample
+        order interleaves arbitrarily across sources WITHIN a pass;
+        passes are synchronized — every source finishes pass k before any
+        source starts pass k+1 (upstream multi_pass semantics)."""
+        readers = list(readers)
+        if not readers:
+            raise ValueError("decorate_paddle_readers needs >= 1 reader")
+        self._decorated = readers
+        self._passes = max(1, int(passes))
+
     def start(self):
         import threading
 
         if self._decorated is None:
             raise RuntimeError("no reader decorated onto py_reader")
+        if not isinstance(self._decorated, list):
+            sources, passes = [self._decorated], 1
+        else:
+            sources, passes = self._decorated, getattr(self, "_passes", 1)
         self.queue.reopen()
+        self._worker_error = None
 
-        def _worker():
+    # one coordinator drives `passes` barrier-synchronized rounds of
+    # shard workers; a worker exception is recorded and surfaced from
+    # next_feed() instead of masquerading as a clean EOF
+
+        def _worker(src):
             try:
-                for item in self._decorated():
+                for item in src():
                     if not self.queue.push(item):
                         return
-                self.queue.close()
-            except Exception:
-                self.queue.close()
-                raise
+            except BaseException as e:  # noqa: BLE001 - resurfaced in next_feed
+                self._worker_error = e
+                self.queue.kill()
 
-        self._thread = threading.Thread(target=_worker, daemon=True)
+        def _coordinator():
+            for _ in range(passes):
+                threads = [
+                    threading.Thread(target=_worker, args=(src,),
+                                     daemon=True)
+                    for src in sources
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                if self._worker_error is not None:
+                    return
+            self.queue.close()
+
+        self._thread = threading.Thread(target=_coordinator, daemon=True)
         self._thread.start()
 
     def reset(self):
@@ -144,11 +179,16 @@ class PyReader(object):
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self._worker_error = None
 
     def next_feed(self):
-        """Pop one batch -> feed dict; raises EOFException at end."""
+        """Pop one batch -> feed dict; raises EOFException at end, or the
+        reader thread's exception if one died mid-stream."""
         item = self.queue.pop()
         if item is None:
+            err = getattr(self, "_worker_error", None)
+            if err is not None:
+                raise RuntimeError("py_reader source failed") from err
             from paddle_tpu.reader.queue import EOFException
 
             raise EOFException()
@@ -217,29 +257,33 @@ def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
 
 def open_files(filenames, shapes, dtypes, thread_num=1, buffer_size=None,
                lod_levels=None, pass_num=1, capacity=64, name=None):
-    """Multi-file recordio reader (open_files_op.cc role). Files are
-    consumed in order per pass (shuffle with the reader decorators).
-    ``buffer_size`` maps onto the queue capacity; ``thread_num > 1`` is
-    accepted for API parity but reads single-threaded (one reader thread
-    feeding the native blocking queue) — a warning is logged."""
-    import logging
-
+    """Multi-file recordio reader (open_files_op.cc role). With
+    thread_num > 1 the files are split round-robin across that many
+    reader threads all feeding the one blocking queue (records then
+    interleave across files, as in the reference); with one thread files
+    are consumed in order per pass. ``buffer_size`` maps onto the queue
+    capacity. Shuffle with the reader decorators."""
     from paddle_tpu import native
     from paddle_tpu.recordio_writer import unpack_sample
 
-    if thread_num and thread_num > 1:
-        logging.getLogger("paddle_tpu.reader").warning(
-            "open_files(thread_num=%d): multi-threaded file reading is not "
-            "implemented; reading single-threaded", thread_num)
     reader = py_reader(buffer_size or capacity, shapes, dtypes,
                        lod_levels=lod_levels, name=name or "open_files")
 
-    def source():
-        for _ in range(pass_num):
-            for path in filenames:
-                with native.RecordIOReader(path) as r:
-                    for blob in r:
-                        yield unpack_sample(blob)
+    def make_source(paths, n_passes=1):
+        def source():
+            for _ in range(n_passes):
+                for path in paths:
+                    with native.RecordIOReader(path) as r:
+                        for blob in r:
+                            yield unpack_sample(blob)
 
-    reader.decorate_paddle_reader(source)
+        return source
+
+    n_threads = max(1, min(int(thread_num or 1), len(filenames)))
+    if n_threads == 1:
+        reader.decorate_paddle_reader(make_source(list(filenames), pass_num))
+    else:
+        shards = [list(filenames[i::n_threads]) for i in range(n_threads)]
+        reader.decorate_paddle_readers(
+            [make_source(s) for s in shards], passes=pass_num)
     return reader

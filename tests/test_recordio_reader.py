@@ -102,3 +102,76 @@ def test_open_files_trains_a_model(tmp_path):
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert len(losses) == 12 * 3  # every record, every pass
     assert np.mean(losses[-6:]) < np.mean(losses[:6])
+
+
+def test_open_files_multithreaded_covers_all_records(tmp_path):
+    base = str(tmp_path / "mt")
+
+    def batched():
+        for i in range(9):
+            yield (np.full((2, 3), i, "float32"),)
+
+    paths = recordio_writer.convert_reader_to_recordio_files(base, 3, batched)
+    assert len(paths) == 3
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.open_files(
+            paths, shapes=[[-1, 3]], dtypes=["float32"], thread_num=3)
+    reader.start()
+    from paddle_tpu.reader.queue import EOFException
+
+    seen = []
+    while True:
+        try:
+            feed = reader.next_feed()
+        except EOFException:
+            break
+        (arr,) = feed.values()
+        seen.append(int(np.asarray(arr)[0, 0]))
+    # all 9 records arrive exactly once, any interleaving
+    assert sorted(seen) == list(range(9))
+
+
+def test_open_files_multithreaded_pass_barrier_and_error(tmp_path):
+    base = str(tmp_path / "pb")
+
+    def batched():
+        for i in range(4):
+            yield (np.full((1,), i, "float32"),)
+
+    paths = recordio_writer.convert_reader_to_recordio_files(base, 2, batched)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.open_files(
+            paths, shapes=[[-1]], dtypes=["float32"], thread_num=2,
+            pass_num=3)
+    reader.start()
+    from paddle_tpu.reader.queue import EOFException
+
+    seen = []
+    while True:
+        try:
+            feed = reader.next_feed()
+        except EOFException:
+            break
+        (arr,) = feed.values()
+        seen.append(int(np.asarray(arr)[0]))
+    assert len(seen) == 4 * 3
+    # pass barrier: each contiguous window of 4 records is one full pass
+    for k in range(3):
+        assert sorted(seen[4 * k:4 * (k + 1)]) == [0, 1, 2, 3]
+
+    # a corrupt shard surfaces as an error, not a quiet partial EOF
+    blob = bytearray(open(paths[0], "rb").read())
+    blob[4 + 8 + 4 + 1] ^= 0xFF
+    open(paths[0], "wb").write(bytes(blob))
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        reader2 = fluid.layers.open_files(
+            paths, shapes=[[-1]], dtypes=["float32"], thread_num=2)
+    reader2.start()
+    with pytest.raises((RuntimeError, EOFException)) as exc_info:
+        for _ in range(20):
+            reader2.next_feed()
+    assert exc_info.type is RuntimeError
