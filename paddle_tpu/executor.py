@@ -107,6 +107,13 @@ class Executor(object):
         fetch_list = fetch_list or []
         scope = scope or global_scope()
         device = self.place.jax_device()
+        if not use_program_cache:
+            # reference use_program_cache=False semantics: drop this
+            # program's cached executables so the next run retraces
+            self._cache = {
+                k: v for k, v in self._cache.items()
+                if (k[1] if k and k[0] == "multi" else k[0]) != id(program)
+            }
         # Everything below (feed transfer, key creation, dispatch) stays on
         # the Place's device: with several backends loaded (TPU plugin +
         # CPU), stray ops like PRNGKey would otherwise run on the default

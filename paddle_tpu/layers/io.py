@@ -143,10 +143,9 @@ class PyReader(object):
         self.queue.reopen()
         self._worker_error = None
 
-    # one coordinator drives `passes` barrier-synchronized rounds of
-    # shard workers; a worker exception is recorded and surfaced from
-    # next_feed() instead of masquerading as a clean EOF
-
+        # one coordinator drives `passes` barrier-synchronized rounds of
+        # shard workers; a worker exception is recorded and surfaced from
+        # next_feed() instead of masquerading as a clean EOF
         def _worker(src):
             try:
                 for item in src():
@@ -266,6 +265,7 @@ def open_files(filenames, shapes, dtypes, thread_num=1, buffer_size=None,
     from paddle_tpu import native
     from paddle_tpu.recordio_writer import unpack_sample
 
+    filenames = list(filenames)  # accept any iterable of paths
     reader = py_reader(buffer_size or capacity, shapes, dtypes,
                        lod_levels=lod_levels, name=name or "open_files")
 

@@ -5,6 +5,7 @@ scaled_dot_product_attention."""
 from paddle_tpu import layers
 
 __all__ = [
+    "sequence_conv_pool",
     "simple_img_conv_pool",
     "img_conv_group",
     "glu",
@@ -103,6 +104,22 @@ def img_conv_group(
         input=tmp, pool_size=pool_size, pool_type=pool_type,
         pool_stride=pool_stride,
     )
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", length=None):
+    """Sequence convolution + pooling block (reference nets.py:248). On
+    the padded layout input is [B, T, N]; pass ``length`` to mask the
+    pooled tail."""
+    conv_out = layers.sequence_conv(
+        input=input,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        param_attr=param_attr,
+        act=act,
+        length=length,
+    )
+    return layers.sequence_pool(conv_out, pool_type, length=length)
 
 
 def glu(input, dim=-1):
