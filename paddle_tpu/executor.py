@@ -109,10 +109,12 @@ class Executor(object):
         device = self.place.jax_device()
         if not use_program_cache:
             # reference use_program_cache=False semantics: drop this
-            # program's cached executables so the next run retraces
+            # program's cached single-run executables so the next run
+            # retraces (multi-step scan executables are keyed separately
+            # and survive — they are expensive compiles run() never uses)
             self._cache = {
                 k: v for k, v in self._cache.items()
-                if (k[1] if k and k[0] == "multi" else k[0]) != id(program)
+                if k[0] == "multi" or k[0] != id(program)
             }
         # Everything below (feed transfer, key creation, dispatch) stays on
         # the Place's device: with several backends loaded (TPU plugin +

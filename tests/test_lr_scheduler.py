@@ -1,0 +1,109 @@
+"""LR schedule tests against closed-form numpy oracles.
+
+Reference: tests/unittests/test_learning_rate_scheduler.py — each decay's
+fetched value at step t must match the python formula; schedules run as
+in-graph ops over the @LR_DECAY_COUNTER@ persistable.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.layers import learning_rate_scheduler as lrs
+
+
+def _run_schedule(build_fn, steps=8):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    values = []
+    for _ in range(steps):
+        (v,) = exe.run(main, feed={}, fetch_list=[lr])
+        values.append(float(np.ravel(np.asarray(v))[0]))
+    return values
+
+
+@pytest.mark.parametrize("staircase", [False, True])
+def test_exponential_decay(staircase):
+    got = _run_schedule(
+        lambda: lrs.exponential_decay(0.1, 3, 0.5, staircase=staircase))
+    for t, v in enumerate(got):
+        div = t / 3.0
+        if staircase:
+            div = math.floor(div)
+        assert v == pytest.approx(0.1 * 0.5 ** div, rel=1e-5)
+
+
+def test_natural_exp_and_inverse_time_decay():
+    got = _run_schedule(lambda: lrs.natural_exp_decay(0.1, 2, 0.9))
+    for t, v in enumerate(got):
+        assert v == pytest.approx(0.1 * math.exp(-0.9 * t / 2.0), rel=1e-5)
+    got = _run_schedule(lambda: lrs.inverse_time_decay(0.1, 2, 0.5))
+    for t, v in enumerate(got):
+        assert v == pytest.approx(0.1 / (1 + 0.5 * t / 2.0), rel=1e-5)
+
+
+@pytest.mark.parametrize("cycle", [False, True])
+def test_polynomial_decay(cycle):
+    lr0, end, k, p = 0.1, 0.01, 4, 2.0
+    got = _run_schedule(
+        lambda: lrs.polynomial_decay(lr0, k, end, power=p, cycle=cycle),
+        steps=10)
+    for t, v in enumerate(got):
+        if cycle:
+            div = max(1.0, math.ceil(t / float(k)))
+            frac = t / (div * k)
+        else:
+            frac = min(float(t), float(k)) / k
+        expect = (lr0 - end) * (1 - frac) ** p + end
+        assert v == pytest.approx(expect, rel=1e-4), t
+
+
+def test_piecewise_decay():
+    got = _run_schedule(
+        lambda: lrs.piecewise_decay([2, 5], [0.1, 0.05, 0.01]), steps=8)
+    expect = [0.1, 0.1, 0.05, 0.05, 0.05, 0.01, 0.01, 0.01]
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_noam_and_cosine_decay():
+    d_model, warm = 64, 4
+    got = _run_schedule(lambda: lrs.noam_decay(d_model, warm), steps=8)
+    for t, v in enumerate(got):
+        if t == 0:
+            continue  # 0**-0.5 -> inf; min picks the warmup branch
+        expect = d_model ** -0.5 * min(t ** -0.5, t * warm ** -1.5)
+        assert v == pytest.approx(expect, rel=1e-5)
+    got = _run_schedule(lambda: lrs.cosine_decay(0.1, 2, 4), steps=8)
+    for t, v in enumerate(got):
+        epoch = math.floor(t / 2.0)
+        expect = 0.1 * (math.cos(epoch * math.pi / 4.0) + 1) / 2
+        assert v == pytest.approx(expect, rel=1e-5)
+
+
+def test_scheduler_drives_optimizer():
+    """The schedule actually changes the applied step size."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2], stop_gradient=False)
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        lr = lrs.piecewise_decay([2], [0.5, 0.0])  # step 0-1 lr .5, then 0
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.ones((4, 2), "float32"), "y": np.ones((4, 1), "float32")}
+    w_name = [n for n in fluid.global_scope().local_var_names()
+              if n.endswith("w_0")][0]
+    exe.run(main, feed=feed, fetch_list=[loss])
+    exe.run(main, feed=feed, fetch_list=[loss])
+    w_after_2 = np.array(fluid.global_scope().get_value(w_name))
+    exe.run(main, feed=feed, fetch_list=[loss])
+    w_after_3 = np.array(fluid.global_scope().get_value(w_name))
+    # lr dropped to 0 at step 2 -> weights frozen from then on
+    np.testing.assert_allclose(w_after_3, w_after_2, rtol=0, atol=0)
