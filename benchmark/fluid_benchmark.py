@@ -235,11 +235,16 @@ def main():
             prof.__enter__()
         t0 = time.perf_counter()
         for i in range(args.iterations - 1):
-            run([])
+            if args.profile and pass_id == 0:
+                with profiler.RecordEvent("iter_%d" % i):
+                    run([])
+            else:
+                run([])
         out = run([loss])
         dt = time.perf_counter() - t0
         if args.profile and pass_id == 0:
             prof.__exit__(None, None, None)
+            print("chrome trace written to %s" % args.profile_path)
 
         lv = float(np.ravel(np.asarray(out[0]))[0])
         ips = args.iterations * args.batch_size / dt
