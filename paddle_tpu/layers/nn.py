@@ -63,6 +63,16 @@ __all__ = [
     "resize_bilinear",
     "im2sequence",
     "cos_sim",
+    "affine_channel",
+    "affine_grid",
+    "grid_sampler",
+    "multiplex",
+    "bilinear_tensor_product",
+    "mean_iou",
+    "hash",
+    "lod_reset",
+    "fake_quantize_abs_max",
+    "conv3d_transpose",
 ]
 
 from paddle_tpu.layers.ops import relu, log  # noqa: E402,F401  (re-export)
@@ -324,6 +334,8 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
         attr=helper.param_attr, shape=filter_shape, dtype=input.dtype
     )
     out = helper.create_variable_for_type_inference(input.dtype)
+    if output_size is not None and isinstance(output_size, int):
+        output_size = [output_size, output_size]
     helper.append_op(
         type="conv2d_transpose",
         inputs={"Input": [input], "Filter": [w]},
@@ -333,6 +345,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
             "paddings": [padding, padding] if isinstance(padding, int) else padding,
             "dilations": [dilation, dilation] if isinstance(dilation, int) else dilation,
             "groups": groups,
+            "output_size": list(output_size or []),
         },
     )
     pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
@@ -988,3 +1001,210 @@ def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
         },
     )
     return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    """Per-channel affine (affine_channel_op.cc): out = scale_c * x + bias_c.
+    The conv+frozen-BN idiom of detection backbones. When scale/bias are
+    not given, per-channel parameters are created (initialized to 1 / 0,
+    i.e. identity until trained)."""
+    helper = LayerHelper("affine_channel", name=name)
+    channels = int(x.shape[1] if data_layout == "NCHW" else x.shape[-1])
+    if scale is None:
+        from paddle_tpu import initializer as init_mod
+        scale = helper.create_parameter(
+            attr=None, shape=[channels], dtype=x.dtype,
+            default_initializer=init_mod.ConstantInitializer(1.0),
+        )
+    if bias is None:
+        bias = helper.create_parameter(
+            attr=None, shape=[channels], dtype=x.dtype, is_bias=True,
+        )
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="affine_channel",
+        inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
+        outputs={"Out": [out]},
+        attrs={"data_layout": data_layout},
+    )
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    """Affine sampling grid for a spatial transformer
+    (affine_grid_op.cc); out_shape must be static under XLA."""
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    if not isinstance(out_shape, (list, tuple)):
+        raise TypeError("affine_grid: out_shape must be a static list/tuple "
+                        "(XLA needs static shapes)")
+    helper.append_op(
+        type="affine_grid",
+        inputs={"Theta": [theta]},
+        outputs={"Output": [out]},
+        attrs={"output_shape": list(out_shape)},
+    )
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    """Bilinear sampling of x at normalized grid coords
+    (grid_sampler_op.cc)."""
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="grid_sampler",
+        inputs={"X": [x], "Grid": [grid]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (multiplex_op.cc)."""
+    helper = LayerHelper("multiplex", name=name)
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(
+        type="multiplex",
+        inputs={"Ids": [index], "X": list(inputs)},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out_k = x^T W_k y (bilinear_tensor_product_op.cc) with learned
+    [size, Mx, My] weight and optional bias/activation."""
+    helper = LayerHelper("bilinear_tensor_product", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[size, x.shape[-1], y.shape[-1]],
+        dtype=x.dtype,
+    )
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if helper.bias_attr is not None:
+        bias = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, size], dtype=x.dtype,
+            is_bias=True,
+        )
+        inputs["Bias"] = [bias]
+    helper.append_op(
+        type="bilinear_tensor_product",
+        inputs=inputs,
+        outputs={"Out": [out]},
+    )
+    return helper.append_activation(out)
+
+
+def mean_iou(input, label, num_classes, name=None):
+    """Segmentation mean-IoU (mean_iou_op.cc): returns (mean_iou, wrong,
+    correct) for streaming accumulation."""
+    helper = LayerHelper("mean_iou", name=name)
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="mean_iou",
+        inputs={"Predictions": [input], "Labels": [label]},
+        outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                 "OutCorrect": [correct]},
+        attrs={"num_classes": num_classes},
+    )
+    return miou, wrong, correct
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """num_hash integer hashes per input row, mod hash_size
+    (hash_op.cc)."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="hash",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"num_hash": num_hash, "mod_by": hash_size},
+    )
+    return out
+
+
+def lod_reset(x, target_lod=None, name=None):
+    """Re-segment a padded sequence batch (lod_reset_op.cc). Returns
+    (out, length): the re-chunked [B', T', ...] tensor plus its Length
+    column for downstream sequence ops (the padded-design carrier of the
+    LoD the reference mutates in place — docs/LOD_DESIGN.md). The
+    reference's reset-from-Y's-lod form is obviated: under XLA the new
+    segmentation must be static, so it is always the target_lod attr."""
+    if not target_lod:
+        raise ValueError(
+            "lod_reset: target_lod is required (the reference's "
+            "runtime-Y segmenter cannot exist under static XLA shapes)")
+    helper = LayerHelper("lod_reset", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="lod_reset",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "Length": [length]},
+        attrs={"target_lod": list(target_lod)},
+    )
+    return out, length
+
+
+def fake_quantize_abs_max(x, bit_length=8, name=None):
+    """QAT fake-quantization (fake_quantize_op.cc): returns (quantized,
+    scale); gradients pass straight through the rounding."""
+    helper = LayerHelper("fake_quantize_abs_max", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    scale = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="fake_quantize_abs_max",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "OutScale": [scale]},
+        attrs={"bit_length": bit_length},
+    )
+    return out, scale
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    """3D transposed convolution (conv_transpose_op.cc conv3d_transpose)."""
+    helper = LayerHelper(
+        "conv3d_transpose", param_attr=param_attr, bias_attr=bias_attr,
+        act=act, name=name,
+    )
+    groups = groups or 1
+    num_channels = int(input.shape[1])
+    if filter_size is None:
+        raise ValueError("filter_size must be given for conv3d_transpose")
+    if isinstance(filter_size, int):
+        filter_size = [filter_size] * 3
+    filter_shape = [num_channels, num_filters // groups] + list(filter_size)
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=input.dtype
+    )
+    out = helper.create_variable_for_type_inference(input.dtype)
+
+    def _t(v):
+        return [v, v, v] if isinstance(v, int) else list(v)
+
+    if output_size is not None and isinstance(output_size, int):
+        output_size = [output_size] * 3
+    helper.append_op(
+        type="conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={
+            "strides": _t(stride),
+            "paddings": _t(padding),
+            "dilations": _t(dilation),
+            "groups": groups,
+            "output_size": list(output_size or []),
+        },
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)

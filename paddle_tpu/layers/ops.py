@@ -136,3 +136,49 @@ logical_and = _make_binary_logical("logical_and")
 logical_or = _make_binary_logical("logical_or")
 logical_xor = _make_binary_logical("logical_xor")
 logical_not = _make_binary_logical("logical_not")
+
+
+def fill(shape, value, dtype="float32", name=None):
+    """Materialize an explicit value list (fill_op.cc)."""
+    helper = LayerHelper("fill", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="fill",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "value": [float(v) for v in value],
+               "dtype": dtype},
+    )
+    return out
+
+
+def _make_batch_size_like(op_type, extra):
+    def layer_fn(input, shape, input_dim_idx=0, output_dim_idx=0,
+                 dtype="float32", name=None, **kwargs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(dtype)
+        attrs = {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                 "output_dim_idx": output_dim_idx, "dtype": dtype}
+        for k, dv in extra.items():
+            attrs[k] = kwargs.get(k, dv)
+        helper.append_op(
+            type=op_type,
+            inputs={"Input": [input]},
+            outputs={"Out": [out]},
+            attrs=attrs,
+        )
+        return out
+
+    layer_fn.__name__ = op_type
+    layer_fn.__doc__ = (
+        "Generated layer for operator %r: output shape follows the "
+        "input's batch dimension (batch_size_like_op.h role)." % op_type)
+    return layer_fn
+
+
+gaussian_random_batch_size_like = _make_batch_size_like(
+    "gaussian_random_batch_size_like", {"mean": 0.0, "std": 1.0, "seed": 0})
+uniform_random_batch_size_like = _make_batch_size_like(
+    "uniform_random_batch_size_like", {"min": -1.0, "max": 1.0, "seed": 0})
+
+__all__ += ["fill", "gaussian_random_batch_size_like",
+            "uniform_random_batch_size_like"]

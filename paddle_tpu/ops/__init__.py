@@ -26,3 +26,5 @@ from paddle_tpu.ops import seq2seq_ops  # noqa: F401
 from paddle_tpu.ops import crf_ops  # noqa: F401
 from paddle_tpu.ops import ctc_ops  # noqa: F401
 from paddle_tpu.ops import sampling_ops  # noqa: F401
+from paddle_tpu.ops import vision_ops  # noqa: F401
+from paddle_tpu.ops import quantize_ops  # noqa: F401

@@ -231,3 +231,30 @@ def _kldiv(ins, attrs):
     if red == "batchmean":
         return jnp.reshape(jnp.sum(loss) / jnp.shape(x)[0], (1,))
     return loss
+
+
+def _lower_modified_huber_loss(ctx, ins, attrs):
+    """modified_huber_loss_op.cc: binary classification loss on labels
+    {0,1} mapped to {-1,+1}. With z = (2y-1)*x: quadratic max(0, 1-z)^2
+    for z >= -1, linear -4z beyond (outlier robustness)."""
+    x = jnp.reshape(ins["X"][0], (-1,))
+    y = jnp.reshape(ins["Y"][0], (-1,)).astype(x.dtype)
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(
+        z >= -1.0, jnp.square(jnp.maximum(1.0 - z, 0.0)), -4.0 * z
+    )
+    shape = (x.shape[0], 1)
+    return {
+        "Out": jnp.reshape(loss, shape),
+        "IntermediateVal": jnp.reshape(z, shape),
+    }
+
+
+register_op(
+    "modified_huber_loss",
+    inputs=["X", "Y"],
+    outputs=["Out", "IntermediateVal"],
+    lower=_lower_modified_huber_loss,
+    no_grad_inputs=("Y",),
+    intermediate_outputs=("IntermediateVal",),
+)

@@ -267,3 +267,97 @@ register_op(
     lower=_lower_cos_sim,
     intermediate_outputs=("XNorm", "YNorm"),
 )
+
+
+def _lower_minus(ctx, ins, attrs):
+    """minus_op.cc: Out = X - Y (kept as its own schema; the v2 layer
+    surface exposes it separately from elementwise_sub)."""
+    return ins["X"][0] - ins["Y"][0]
+
+
+register_op(
+    "minus",
+    inputs=["X", "Y"],
+    outputs=["Out"],
+    lower=_lower_minus,
+)
+
+
+def _lower_l1_norm(ctx, ins, attrs):
+    """l1_norm_op.cc: scalar sum of absolute values."""
+    return jnp.reshape(jnp.sum(jnp.abs(ins["X"][0])), (1,))
+
+
+register_op(
+    "l1_norm",
+    inputs=["X"],
+    outputs=["Out"],
+    lower=_lower_l1_norm,
+)
+
+
+def _lower_multiplex(ctx, ins, attrs):
+    """multiplex_op.cc: per-row select among the candidate tensors —
+    Out[b] = X[Ids[b]][b]. Lowering: stack candidates on a new axis and
+    take_along_axis with the row index (one fused gather on TPU)."""
+    ids = jnp.reshape(ins["Ids"][0], (-1,)).astype(jnp.int32)
+    xs = jnp.stack(ins["X"], axis=0)  # [K, B, ...]
+    k, b = xs.shape[0], xs.shape[1]
+    idx = jnp.reshape(ids, (1, b) + (1,) * (xs.ndim - 2))
+    return jnp.squeeze(
+        jnp.take_along_axis(xs, jnp.broadcast_to(idx, (1,) + xs.shape[1:]),
+                            axis=0),
+        axis=0,
+    )
+
+
+register_op(
+    "multiplex",
+    inputs=["Ids", "*X"],
+    outputs=["Out"],
+    lower=_lower_multiplex,
+    no_grad_inputs=("Ids",),
+)
+
+
+def _lower_bilinear_tensor_product(ctx, ins, attrs):
+    """bilinear_tensor_product_op.cc: Out[b,k] = X[b]^T W_k Y[b] (+bias);
+    one einsum so XLA maps it onto batched MXU matmuls."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    w = ins["Weight"][0]  # [K, M, N]
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if "Bias" in ins and ins["Bias"]:
+        out = out + jnp.reshape(ins["Bias"][0], (1, -1))
+    return out
+
+
+register_op(
+    "bilinear_tensor_product",
+    inputs=["X", "Y", "Weight", "Bias"],
+    outputs=["Out"],
+    lower=_lower_bilinear_tensor_product,
+)
+
+
+def _lower_conv_shift(ctx, ins, attrs):
+    """conv_shift_op.cc (NTM circular convolution): X [B,M], Y [B,N] with
+    N odd; Out[b,i] = sum_j X[b, (i + j - (N-1)/2) mod M] * Y[b,j].
+    Lowered as a static modular gather + one einsum (no scalar loops)."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    m = x.shape[1]
+    n = y.shape[1]
+    half = (n - 1) // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
+    # windows[b, i, j] = X[b, idx[i, j]]
+    windows = x[:, idx]
+    return jnp.einsum("bij,bj->bi", windows, y)
+
+
+register_op(
+    "conv_shift",
+    inputs=["X", "Y"],
+    outputs=["Out"],
+    lower=_lower_conv_shift,
+)

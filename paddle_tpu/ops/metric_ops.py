@@ -281,3 +281,101 @@ register_op(
     lower=_lower_precision_recall,
     grad=None,
 )
+
+
+def _lower_mean_iou(ctx, ins, attrs):
+    """mean_iou_op.cc: segmentation mean-IoU with streaming accumulators.
+    Per element: pred==label adds to Correct[label]; otherwise both
+    Wrong[label] and Wrong[pred] get a count (so Wrong = FP+FN and
+    IoU_c = correct_c / (correct_c + wrong_c)). Optional In* accumulator
+    inputs are summed in before the mean; classes never seen score no
+    contribution (mean over classes with a nonzero union)."""
+    num_classes = attrs["num_classes"]
+    pred = jnp.reshape(ins["Predictions"][0], (-1,)).astype(jnp.int32)
+    label = jnp.reshape(ins["Labels"][0], (-1,)).astype(jnp.int32)
+    hit = pred == label
+    onehot = lambda v, m: jax.nn.one_hot(v, num_classes, dtype=jnp.int32) * (
+        m.astype(jnp.int32)[:, None]
+    )
+    correct = jnp.sum(onehot(label, hit), axis=0)
+    wrong = jnp.sum(onehot(label, ~hit), axis=0) + jnp.sum(
+        onehot(pred, ~hit), axis=0
+    )
+    for extra in ins.get("InCorrects", []):
+        correct = correct + extra.astype(jnp.int32)
+    for extra in ins.get("InWrongs", []):
+        wrong = wrong + extra.astype(jnp.int32)
+    union = correct + wrong
+    valid = union > 0
+    iou = jnp.where(valid, correct / jnp.maximum(union, 1).astype(jnp.float32),
+                    0.0)
+    mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    mean = jnp.reshape(mean, (1,))
+    for extra in ins.get("InMeanIou", []):
+        mean = mean + jnp.reshape(extra, (1,))
+    return {"OutMeanIou": mean, "OutWrong": wrong, "OutCorrect": correct}
+
+
+register_op(
+    "mean_iou",
+    inputs=["Predictions", "Labels", "*InWrongs", "*InCorrects", "*InMeanIou"],
+    outputs=["OutMeanIou", "OutWrong", "OutCorrect"],
+    attrs={"num_classes": 2},
+    lower=_lower_mean_iou,
+    grad=None,
+)
+
+
+def _lower_positive_negative_pair(ctx, ins, attrs):
+    """positive_negative_pair_op.h: LTR pair statistics. Over all item
+    pairs sharing a QueryID whose labels differ, a pair weighted by the
+    mean of the two row weights counts as positive when score and label
+    order agree, negative when they disagree (ties included — reference
+    quirk: a score tie adds to BOTH neutral and negative). Pairwise masks
+    over [N,N] replace the reference's per-query hash buckets (N is
+    metric-sized; one fused masked reduction on TPU)."""
+    column = attrs.get("column", -1)
+    score_t = ins["Score"][0]
+    score = score_t[:, column]
+    label = jnp.reshape(ins["Label"][0], (-1,)).astype(score.dtype)
+    query = jnp.reshape(ins["QueryID"][0], (-1,))
+    if "Weight" in ins and ins["Weight"]:
+        weight = jnp.reshape(ins["Weight"][0], (-1,)).astype(score.dtype)
+    else:
+        weight = jnp.ones_like(score)
+    n = score.shape[0]
+    iu = jnp.triu(jnp.ones((n, n), bool), k=1)
+    same_q = query[:, None] == query[None, :]
+    diff_l = label[:, None] != label[None, :]
+    consider = iu & same_q & diff_l
+    w = (weight[:, None] + weight[None, :]) * 0.5
+    sd = score[:, None] - score[None, :]
+    ld = label[:, None] - label[None, :]
+    agree = sd * ld > 0
+    tie = sd == 0
+    zero = jnp.zeros_like(w)
+    pos = jnp.sum(jnp.where(consider & agree, w, zero))
+    neg = jnp.sum(jnp.where(consider & ~agree, w, zero))
+    neu = jnp.sum(jnp.where(consider & tie, w, zero))
+    if ins.get("AccumulatePositivePair"):
+        pos = pos + jnp.reshape(ins["AccumulatePositivePair"][0], ())
+    if ins.get("AccumulateNegativePair"):
+        neg = neg + jnp.reshape(ins["AccumulateNegativePair"][0], ())
+    if ins.get("AccumulateNeutralPair"):
+        neu = neu + jnp.reshape(ins["AccumulateNeutralPair"][0], ())
+    return {
+        "PositivePair": jnp.reshape(pos, (1,)),
+        "NegativePair": jnp.reshape(neg, (1,)),
+        "NeutralPair": jnp.reshape(neu, (1,)),
+    }
+
+
+register_op(
+    "positive_negative_pair",
+    inputs=["Score", "Label", "QueryID", "AccumulatePositivePair",
+            "AccumulateNegativePair", "AccumulateNeutralPair", "Weight"],
+    outputs=["PositivePair", "NegativePair", "NeutralPair"],
+    attrs={"column": -1},
+    lower=_lower_positive_negative_pair,
+    grad=None,
+)

@@ -120,24 +120,56 @@ def _lower_conv2d_transpose(ctx, ins, attrs):
     kw = (jnp.shape(w)[3] - 1) * dilations[1] + 1
     pad_h = kh - 1 - paddings[0]
     pad_w = kw - 1 - paddings[1]
-    w_flip = jnp.flip(w, axis=(2, 3))
-    w_t = jnp.swapaxes(w_flip, 0, 1)  # -> [out_c, in_c, kh, kw]
-    if groups > 1:
-        # regroup: [in_c, oc/g, ...] -> per-group transpose
-        ic, ocg = jnp.shape(w)[0], jnp.shape(w)[1]
-        wg = jnp.reshape(w_flip, (groups, ic // groups, ocg) + tuple(jnp.shape(w)[2:]))
-        wg = jnp.swapaxes(wg, 1, 2)
-        w_t = jnp.reshape(wg, (groups * ocg, ic // groups) + tuple(jnp.shape(w)[2:]))
+    # output_size picks among the stride ambiguous output shapes: the
+    # shortfall vs the default arithmetic becomes extra high-side padding
+    extra = _transpose_extra_pad(
+        attrs.get("output_size"), [jnp.shape(x)[2], jnp.shape(x)[3]],
+        strides, paddings, [kh, kw],
+    )
     return jax.lax.conv_general_dilated(
         x,
-        w_t,
+        _transpose_weight(w, groups, 2),
         window_strides=(1, 1),
-        padding=[(pad_h, pad_h), (pad_w, pad_w)],
+        padding=[(pad_h, pad_h + extra[0]), (pad_w, pad_w + extra[1])],
         lhs_dilation=strides,
         rhs_dilation=dilations,
         dimension_numbers=_CONV_DN,
         feature_group_count=groups,
     )
+
+
+def _transpose_weight(w, groups, nd):
+    """Paddle transpose-conv filter [in_c, out_c/groups, *k] -> the
+    [out_c, in_c/groups, *k] layout of the gradient-of-conv formulation:
+    spatial flip + (per-group) in/out channel transpose."""
+    spatial = tuple(range(2, 2 + nd))
+    w_flip = jnp.flip(w, axis=spatial)
+    if groups == 1:
+        return jnp.swapaxes(w_flip, 0, 1)
+    ic, ocg = jnp.shape(w)[0], jnp.shape(w)[1]
+    wg = jnp.reshape(w_flip, (groups, ic // groups, ocg) + tuple(jnp.shape(w)[2:]))
+    wg = jnp.swapaxes(wg, 1, 2)
+    return jnp.reshape(wg, (groups * ocg, ic // groups) + tuple(jnp.shape(w)[2:]))
+
+
+def _transpose_extra_pad(output_size, in_spatial, strides, paddings, keff):
+    """conv_transpose_op.cc InferShape: output_size selects an output among
+    the stride-ambiguous candidates; here the surplus over the minimal
+    arithmetic becomes high-side padding (must satisfy 0 <= surplus <
+    stride, as in the reference's shape check)."""
+    nd = len(in_spatial)
+    if not output_size:
+        return [0] * nd
+    extras = []
+    for d in range(nd):
+        base = (int(in_spatial[d]) - 1) * strides[d] - 2 * paddings[d] + keff[d]
+        surplus = int(output_size[d]) - base
+        if not 0 <= surplus < strides[d]:
+            raise ValueError(
+                "conv_transpose: output_size %d for dim %d not reachable "
+                "(base %d, stride %d)" % (output_size[d], d, base, strides[d]))
+        extras.append(surplus)
+    return extras
 
 
 register_op(
@@ -149,6 +181,7 @@ register_op(
         "paddings": [0, 0],
         "dilations": [1, 1],
         "groups": 1,
+        "output_size": [],
     },
     lower=_lower_conv2d_transpose,
 )
@@ -489,4 +522,48 @@ register_op(
         ins["X"][0], attrs["out_h"], attrs["out_w"], "nearest"
     ),
     no_grad_inputs=("OutSize",),
+)
+
+
+def _lower_conv3d_transpose(ctx, ins, attrs):
+    """conv_transpose_op.cc (conv3d_transpose): same gradient-of-conv
+    formulation as conv2d_transpose over three spatial dims."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    paddings = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    dilations = _pair(attrs.get("dilations", [1, 1, 1]), 3)
+    groups = attrs.get("groups", 1)
+    ks = [
+        (jnp.shape(w)[2 + i] - 1) * dilations[i] + 1 for i in range(3)
+    ]
+    extra = _transpose_extra_pad(
+        attrs.get("output_size"), [jnp.shape(x)[2 + i] for i in range(3)],
+        strides, paddings, ks,
+    )
+    pads = [(k - 1 - p, k - 1 - p + e)
+            for k, p, e in zip(ks, paddings, extra)]
+    return jax.lax.conv_general_dilated(
+        x,
+        _transpose_weight(w, groups, 3),
+        window_strides=(1, 1, 1),
+        padding=pads,
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+
+
+register_op(
+    "conv3d_transpose",
+    inputs=["Input", "Filter"],
+    outputs=["Output"],
+    attrs={
+        "strides": [1, 1, 1],
+        "paddings": [0, 0, 0],
+        "dilations": [1, 1, 1],
+        "groups": 1,
+        "output_size": [],
+    },
+    lower=_lower_conv3d_transpose,
 )

@@ -1,0 +1,100 @@
+"""Quantization-aware-training ops: fake quantize / dequantize.
+
+Reference parity: paddle/fluid/operators/fake_quantize_op.cc
+(FakeQuantizeAbsMaxOp :124, FakeQuantizeRangeAbsMaxOp :184) and
+fake_dequantize_op.cc. The reference pairs these with a dedicated grad op
+that passes gradients straight through the rounding; here the lowering
+writes the quantized value as ``x + stop_gradient(q - x)`` so the
+vjp-synthesized ``<op>_grad`` is exactly that straight-through estimator —
+no custom grad machinery needed.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+
+def _quant_range(bit_length):
+    return float((1 << (bit_length - 1)) - 1)
+
+
+def _quantize(x, scale, bit_length, clip=True):
+    """Quantize with a full straight-through estimator: the forward value
+    is round(clip(x/scale)*range) but the backward pass is d(out)/d(x) =
+    range/scale everywhere — the reference grad kernel passes dout through
+    unconditionally, including for clipped elements."""
+    rng = _quant_range(bit_length)
+    scale = jnp.maximum(scale, jnp.asarray(1e-8, x.dtype))
+    y = x / scale * rng
+    q = y
+    if clip:
+        q = jnp.clip(x / scale, -1.0, 1.0) * rng
+    return y + jax.lax.stop_gradient(jnp.round(q) - y)
+
+
+def _lower_fake_quantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bit_length = attrs.get("bit_length", 8)
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    # no clip needed: |x| <= scale by construction
+    return {
+        "Out": _quantize(x, scale, bit_length, clip=False),
+        "OutScale": jnp.reshape(scale, (1,)),
+    }
+
+
+register_op(
+    "fake_quantize_abs_max",
+    inputs=["X"],
+    outputs=["Out", "OutScale"],
+    attrs={"bit_length": 8},
+    lower=_lower_fake_quantize_abs_max,
+    intermediate_outputs=("OutScale",),
+)
+
+
+def _lower_fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Running-range variant: in training the scale is the max of the
+    incoming scale and the current batch's abs-max (a monotone envelope —
+    the windowed decay of the reference needs host state and is noted as
+    approximated); at test time the stored scale is used unchanged."""
+    x = ins["X"][0]
+    bit_length = attrs.get("bit_length", 8)
+    in_scale = jnp.reshape(ins["InScale"][0], ())
+    if ctx.is_test or attrs.get("is_test", False):
+        scale = in_scale
+    else:
+        scale = jnp.maximum(in_scale, jnp.max(jnp.abs(x)))
+    scale = jax.lax.stop_gradient(scale)
+    return {
+        "Out": _quantize(x, scale, bit_length),
+        "OutScale": jnp.reshape(scale, (1,)),
+    }
+
+
+register_op(
+    "fake_quantize_range_abs_max",
+    inputs=["X", "InScale"],
+    outputs=["Out", "OutScale"],
+    attrs={"bit_length": 8, "window_size": 10000, "is_test": False},
+    lower=_lower_fake_quantize_range_abs_max,
+    no_grad_inputs=("InScale",),
+    intermediate_outputs=("OutScale",),
+)
+
+
+def _lower_fake_dequantize_max_abs(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = jnp.reshape(ins["Scale"][0], ())
+    return x.astype(scale.dtype) * scale / attrs.get("max_range", 127.0)
+
+
+register_op(
+    "fake_dequantize_max_abs",
+    inputs=["X", "Scale"],
+    outputs=["Out"],
+    attrs={"max_range": 127.0},
+    lower=_lower_fake_dequantize_max_abs,
+    no_grad_inputs=("Scale",),
+)

@@ -126,3 +126,45 @@ def _random_crop(ctx, x, crop_shape):
     start_idx = [jnp.zeros((), jnp.int32)] * nbatch_dims + starts
     sizes = list(full[:nbatch_dims]) + list(crop_shape)
     return jax.lax.dynamic_slice(x, start_idx, sizes)
+
+
+def _batch_size_like_shape(ins, attrs):
+    shape = list(attrs["shape"])
+    x = ins["Input"][0]
+    shape[attrs.get("output_dim_idx", 0)] = jnp.shape(x)[
+        attrs.get("input_dim_idx", 0)
+    ]
+    return tuple(shape)
+
+
+register_op(
+    "gaussian_random_batch_size_like",
+    inputs=["Input"],
+    outputs=["Out"],
+    attrs={"shape": [], "input_dim_idx": 0, "output_dim_idx": 0,
+           "mean": 0.0, "std": 1.0, "seed": 0, "dtype": "float32"},
+    lower=lambda ctx, ins, attrs: attrs.get("mean", 0.0)
+    + attrs.get("std", 1.0)
+    * jax.random.normal(
+        ctx.rng(),
+        _batch_size_like_shape(ins, attrs),
+        canonical_dtype(attrs.get("dtype")),
+    ),
+    grad=None,
+)
+
+register_op(
+    "uniform_random_batch_size_like",
+    inputs=["Input"],
+    outputs=["Out"],
+    attrs={"shape": [], "input_dim_idx": 0, "output_dim_idx": 0,
+           "min": -1.0, "max": 1.0, "seed": 0, "dtype": "float32"},
+    lower=lambda ctx, ins, attrs: jax.random.uniform(
+        ctx.rng(),
+        _batch_size_like_shape(ins, attrs),
+        canonical_dtype(attrs.get("dtype")),
+        minval=attrs.get("min", -1.0),
+        maxval=attrs.get("max", 1.0),
+    ),
+    grad=None,
+)

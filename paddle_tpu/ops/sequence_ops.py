@@ -10,6 +10,7 @@ alongside by the layers front-end.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.op_registry import register_op
 
@@ -461,4 +462,50 @@ register_op(
     outputs=["Out"],
     attrs={"new_dim": 1},
     lower=_lower_sequence_reshape,
+)
+
+
+def _lower_lod_reset(ctx, ins, attrs):
+    """lod_reset_op.cc: re-segment a sequence batch. The reference keeps
+    the flat rows and swaps the LoD; in the padded [B, T, ...] layout the
+    rows themselves must be re-packed: the input's valid rows (all B*T —
+    lod_reset sources are dense row blocks) are re-chunked by the static
+    target_lod attr into a new [B', T', ...] padding with a Length output
+    carrying the new mask. (The reference's reset-from-Y's-lod form needs
+    a runtime-valued segmentation and is obviated under static shapes.)"""
+    x = ins["X"][0]
+    target = [int(v) for v in attrs.get("target_lod", [])]
+    if len(target) < 2 or target[0] != 0:
+        raise ValueError("lod_reset: invalid target lod %r" % (target,))
+    b, t = x.shape[0], x.shape[1]
+    feat = x.shape[2:]
+    total = b * t
+    if target[-1] != total:
+        raise ValueError(
+            "lod_reset: target lod covers %d rows, input has %d"
+            % (target[-1], total))
+    lens = [e - s for s, e in zip(target[:-1], target[1:])]
+    nb, nt = len(lens), max(lens)
+    flat = jnp.reshape(x, (total,) + feat)
+    rows = np.zeros((nb, nt), np.int32)
+    valid = np.zeros((nb, nt), bool)
+    for i, (s, l) in enumerate(zip(target[:-1], lens)):
+        rows[i, :l] = np.arange(s, s + l)
+        valid[i, :l] = True
+    out = flat[jnp.asarray(rows).reshape(-1)].reshape((nb, nt) + feat)
+    mask = jnp.asarray(valid)
+    out = out * mask.reshape((nb, nt) + (1,) * len(feat)).astype(out.dtype)
+    return {
+        "Out": out,
+        "Length": jnp.asarray(np.asarray(lens, np.int64))[:, None],
+    }
+
+
+register_op(
+    "lod_reset",
+    inputs=["X"],
+    outputs=["Out", "Length"],
+    attrs={"target_lod": []},
+    lower=_lower_lod_reset,
+    intermediate_outputs=("Length",),
 )

@@ -514,3 +514,68 @@ register_op(
     lower=_lower_pad_constant_like,
     no_grad_inputs=("X",),
 )
+
+
+def _lower_fill(ctx, ins, attrs):
+    """fill_op.cc: materialize an explicit value list as a tensor of the
+    attr shape/dtype (force_cpu is meaningless under XLA: constants are
+    folded into the program)."""
+    vals = jnp.asarray(
+        np.asarray(attrs["value"], np.float64),
+        canonical_dtype(attrs.get("dtype")),
+    )
+    return jnp.reshape(vals, tuple(attrs["shape"]))
+
+
+register_op(
+    "fill",
+    inputs=[],
+    outputs=["Out"],
+    attrs={"value": [], "shape": [], "dtype": "float32", "force_cpu": False},
+    lower=_lower_fill,
+    grad=None,
+)
+
+
+def _lower_hash(ctx, ins, attrs):
+    """hash_op.cc: num_hash integer hashes of each input row, mod mod_by.
+    The reference uses XXH64 with the slot number as seed; hash values are
+    implementation-defined (only their distribution matters), so this
+    lowering uses a splitmix64-style mixer — vectorized, no byte loops —
+    seeded per slot the same way."""
+    x = ins["X"][0]
+    num_hash = attrs.get("num_hash", 1)
+    mod_by = attrs.get("mod_by", 100000)
+    if not 0 < mod_by <= 2 ** 31 - 1:
+        # hash buckets are int32 lanes on TPU (x64 disabled); a larger
+        # modulus would wrap — refuse rather than silently mis-bucket
+        raise ValueError(
+            "hash: mod_by %d out of the int32 bucket range (TPU x32 "
+            "config); use mod_by <= 2**31-1" % mod_by)
+    # uint32 lanes (x64 is off under JAX defaults): murmur3-finalizer mixer
+    rows = jnp.reshape(x, (jnp.shape(x)[0], -1)).astype(jnp.uint32)
+
+    def mix(h):
+        h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+        h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+        return h ^ (h >> 16)
+
+    outs = []
+    for slot in range(num_hash):
+        h = jnp.full(
+            (rows.shape[0],), jnp.uint32((slot * 0x9E3779B9 + 1) & 0xFFFFFFFF)
+        )
+        for j in range(rows.shape[1]):
+            h = mix(h ^ rows[:, j])
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int32))
+    return jnp.stack(outs, axis=1)[:, :, None]  # [N, num_hash, 1]
+
+
+register_op(
+    "hash",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"num_hash": 1, "mod_by": 100000},
+    lower=_lower_hash,
+    grad=None,
+)

@@ -1,0 +1,300 @@
+"""Vision structural ops: channel affine, spatial transformer (affine_grid +
+grid_sampler), index-tracking max pooling, unpooling, and spatial
+pyramid pooling. (maxout lives in activation_ops.py.)
+
+Reference parity: paddle/fluid/operators/{affine_channel,affine_grid,
+grid_sampler,pool_with_index,unpool,spp}_op.cc. On TPU these lower
+to gather/scatter + reduce-window HLOs; the cuDNN spatial-transformer path
+(grid_sampler_cudnn_op.cu) has no analog — XLA fuses the bilinear gather.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.op_registry import register_op
+
+
+def _lower_affine_channel(ctx, ins, attrs):
+    """affine_channel_op.cc: Out = Scale_c * X + Bias_c, per channel.
+    Used to express conv+frozen-BN in detection models."""
+    x = ins["X"][0]
+    scale = jnp.reshape(ins["Scale"][0], (-1,))
+    bias = jnp.reshape(ins["Bias"][0], (-1,))
+    layout = attrs.get("data_layout", "NCHW")
+    if layout == "NHWC":
+        return x * scale + bias
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return x * jnp.reshape(scale, shape) + jnp.reshape(bias, shape)
+
+
+register_op(
+    "affine_channel",
+    inputs=["X", "Scale", "Bias"],
+    outputs=["Out"],
+    attrs={"data_layout": "NCHW"},
+    lower=_lower_affine_channel,
+)
+
+
+def _affine_out_hw(ins, attrs):
+    shape = attrs.get("output_shape") or []
+    if len(shape) == 4:
+        return int(shape[2]), int(shape[3])
+    if "OutputShape" in ins and ins["OutputShape"]:
+        v = ins["OutputShape"][0]
+        try:
+            arr = np.asarray(v)
+        except Exception:
+            raise ValueError(
+                "affine_grid: OutputShape must be a host-known constant "
+                "under XLA (static shapes); pass attr output_shape instead"
+            )
+        return int(arr[2]), int(arr[3])
+    raise ValueError("affine_grid: no output shape given")
+
+
+def _lower_affine_grid(ctx, ins, attrs):
+    """affine_grid_op.cc: theta [N,2,3] -> sampling grid [N,H,W,2] of
+    normalized target coords mapped through the affine transform
+    (align-corners convention: +-1 hits the corner pixel centers)."""
+    theta = ins["Theta"][0]
+    h, w = _affine_out_hw(ins, attrs)
+    xs = jnp.linspace(-1.0, 1.0, w, dtype=theta.dtype)
+    ys = jnp.linspace(-1.0, 1.0, h, dtype=theta.dtype)
+    xg, yg = jnp.meshgrid(xs, ys)  # [H,W]
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], axis=-1)  # [H,W,3]
+    # out[n,h,w,k] = sum_c base[h,w,c] * theta[n,k,c]
+    return jnp.einsum("hwc,nkc->nhwk", base, theta)
+
+
+register_op(
+    "affine_grid",
+    inputs=["Theta", "OutputShape"],
+    outputs=["Output"],
+    attrs={"output_shape": [], "use_cudnn": False},
+    lower=_lower_affine_grid,
+    no_grad_inputs=("OutputShape",),
+)
+
+
+def _lower_grid_sampler(ctx, ins, attrs):
+    """grid_sampler_op.h: bilinear sampling of X [N,C,H,W] at grid
+    [N,H,W,2] normalized coords; out-of-bound corner contributions are
+    dropped (zero), matching the isInBound masking of the reference."""
+    x = ins["X"][0]
+    grid = ins["Grid"][0]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0  # [N,Hg,Wg]
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    # corner offsets and bilinear weights
+    out = 0.0
+    for dy, dx in ((0, 0), (0, 1), (1, 0), (1, 1)):
+        cx = x0 + dx
+        cy = y0 + dy
+        wgt = (1.0 - jnp.abs(gx - cx)) * (1.0 - jnp.abs(gy - cy))
+        inb = (cx >= 0) & (cx <= w - 1) & (cy >= 0) & (cy <= h - 1)
+        ix = jnp.clip(cx, 0, w - 1).astype(jnp.int32)
+        iy = jnp.clip(cy, 0, h - 1).astype(jnp.int32)
+        # gather per batch: vals[n, :, hg, wg] = x[n, :, iy, ix]
+        vals = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, iy, ix)
+        out = out + vals * (wgt * inb.astype(x.dtype))[:, None, :, :]
+    return out
+
+
+register_op(
+    "grid_sampler",
+    inputs=["X", "Grid"],
+    outputs=["Output"],
+    attrs={"use_cudnn": False},
+    lower=_lower_grid_sampler,
+)
+
+
+def _pool_with_index(x, ksize, strides, paddings, global_pooling, nd):
+    """Shared body: windowed max + flat spatial argmax (the reference's
+    Mask semantics: index into the flattened input feature map)."""
+    spatial = x.shape[2:]
+    if global_pooling:
+        ksize = list(spatial)
+        paddings = [0] * nd
+        strides = list(strides)
+    import itertools
+
+    xf = x.astype(jnp.float32)
+    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
+    xp = jnp.pad(xf, pad_cfg, constant_values=-1e38)
+    out_spatial = tuple(
+        (spatial[d] + 2 * paddings[d] - ksize[d]) // strides[d] + 1
+        for d in range(nd)
+    )
+    # windows as stacked strided slices (exact, fused by XLA; a
+    # conv_general_dilated_patches formulation would run the identity
+    # kernel at conv precision and round the values)
+    slabs = []
+    for offs in itertools.product(*[range(k) for k in ksize]):
+        idx = (slice(None), slice(None)) + tuple(
+            slice(offs[d], offs[d] + (out_spatial[d] - 1) * strides[d] + 1,
+                  strides[d])
+            for d in range(nd)
+        )
+        slabs.append(xp[idx])
+    patches = jnp.stack(slabs, axis=2)  # [N, C, prod(k), *out_spatial]
+    out = jnp.max(patches, axis=2)
+    local = jnp.argmax(patches, axis=2)  # flat idx within the window
+    # unravel local index -> per-dim input coordinates -> flat input index
+    flat = jnp.zeros_like(local)
+    rem = local
+    for d in range(nd):
+        tail = int(np.prod(ksize[d + 1:])) if d + 1 < nd else 1
+        off = rem // tail  # offset within the window along dim d
+        rem = rem % tail
+        grid = jnp.arange(out_spatial[d]) * strides[d] - paddings[d]
+        shape = [1] * (2 + nd)
+        shape[2 + d] = out_spatial[d]
+        coord = off + jnp.reshape(grid, shape)
+        flat = flat * spatial[d] + coord
+    return out.astype(x.dtype), flat.astype(jnp.int32)
+
+
+def _lower_max_pool2d_with_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    out, mask = _pool_with_index(
+        x,
+        list(attrs["ksize"]),
+        list(attrs.get("strides", [1, 1])),
+        list(attrs.get("paddings", [0, 0])),
+        attrs.get("global_pooling", False),
+        2,
+    )
+    return {"Out": out, "Mask": mask}
+
+
+register_op(
+    "max_pool2d_with_index",
+    inputs=["X"],
+    outputs=["Out", "Mask"],
+    attrs={
+        "ksize": [1, 1],
+        "strides": [1, 1],
+        "paddings": [0, 0],
+        "global_pooling": False,
+    },
+    lower=_lower_max_pool2d_with_index,
+    intermediate_outputs=("Mask",),
+)
+
+
+def _lower_max_pool3d_with_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    out, mask = _pool_with_index(
+        x,
+        list(attrs["ksize"]),
+        list(attrs.get("strides", [1, 1, 1])),
+        list(attrs.get("paddings", [0, 0, 0])),
+        attrs.get("global_pooling", False),
+        3,
+    )
+    return {"Out": out, "Mask": mask}
+
+
+register_op(
+    "max_pool3d_with_index",
+    inputs=["X"],
+    outputs=["Out", "Mask"],
+    attrs={
+        "ksize": [1, 1, 1],
+        "strides": [1, 1, 1],
+        "paddings": [0, 0, 0],
+        "global_pooling": False,
+    },
+    lower=_lower_max_pool3d_with_index,
+    intermediate_outputs=("Mask",),
+)
+
+
+def _lower_unpool(ctx, ins, attrs):
+    """unpool_op.cc (unpooltype="max"): scatter pooled values back to the
+    positions recorded by max_pool2d_with_index. Output H/W follow the
+    inverse-of-pooling arithmetic; duplicate indices carry equal values
+    (two windows sharing one argmax), so last-write-wins is exact."""
+    x = ins["X"][0]
+    idx = ins["Indices"][0]
+    if attrs.get("unpooling_type", "max") != "max":
+        raise ValueError("unpool: only max unpooling exists (reference parity)")
+    ksize = list(attrs["ksize"])
+    strides = list(attrs.get("strides", [1, 1]))
+    paddings = list(attrs.get("paddings", [0, 0]))
+    n, c, h, w = x.shape
+    oh = (h - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    ow = (w - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    flat_v = jnp.reshape(x, (n * c, h * w))
+    flat_i = jnp.reshape(idx, (n * c, h * w)).astype(jnp.int32)
+    out = jnp.zeros((n * c, oh * ow), x.dtype)
+    out = jax.vmap(lambda o, i, v: o.at[i].set(v))(out, flat_i, flat_v)
+    return jnp.reshape(out, (n, c, oh, ow))
+
+
+register_op(
+    "unpool",
+    inputs=["X", "Indices"],
+    outputs=["Out"],
+    attrs={
+        "unpooling_type": "max",
+        "ksize": [1, 1],
+        "strides": [1, 1],
+        "paddings": [0, 0],
+    },
+    lower=_lower_unpool,
+    no_grad_inputs=("Indices",),
+)
+
+
+def _lower_spp(ctx, ins, attrs):
+    """spp_op.cc: spatial pyramid pooling. Level l pools X into a
+    2^l x 2^l grid (kernel = ceil(dim/bins), symmetric padding completing
+    the last window, reference spp_op.h arithmetic); levels are flattened
+    and concatenated -> [N, C * sum(4^l)]."""
+    x = ins["X"][0]
+    height = attrs["pyramid_height"]
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lvl in range(height):
+        bins = 2 ** lvl
+        kh = int(np.ceil(h / float(bins)))
+        kw = int(np.ceil(w / float(bins)))
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        if ptype == "max":
+            init, op_fn = -jnp.inf, jax.lax.max
+            xf = x.astype(jnp.float32)
+        else:
+            init, op_fn = 0.0, jax.lax.add
+            xf = x.astype(jnp.float32)
+        pooled = jax.lax.reduce_window(
+            xf,
+            init,
+            op_fn,
+            window_dimensions=(1, 1, kh, kw),
+            window_strides=(1, 1, kh, kw),
+            padding=((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                     (pw, kw * bins - w - pw)),
+        )
+        if ptype != "max":
+            pooled = pooled / float(kh * kw)
+        outs.append(jnp.reshape(pooled, (n, c * bins * bins)))
+    return jnp.concatenate(outs, axis=1).astype(x.dtype)
+
+
+register_op(
+    "spp",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"pyramid_height": 1, "pooling_type": "max"},
+    lower=_lower_spp,
+)

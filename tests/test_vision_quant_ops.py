@@ -1,0 +1,610 @@
+"""Vision / quantization / misc op-wave tests: affine_channel, spatial
+transformer (affine_grid + grid_sampler), index pooling + unpool, spp,
+multiplex, bilinear_tensor_product, conv_shift, mean_iou,
+positive_negative_pair, modified_huber_loss, lod_reset, hash, fill,
+*_batch_size_like, conv3d_transpose, fake quant/dequant.
+
+Reference test strategy parity: python/paddle/fluid/tests/unittests/
+test_{affine_channel,grid_sampler,pool_max,unpool,spp,multiplex,...}_op.py
+— numpy oracles + analytic-vs-numeric gradients via the OpTest harness.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+from op_test import OpTest
+
+
+def _run_program(build, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=list(fetches))
+
+
+# -- affine_channel ---------------------------------------------------------
+
+class TestAffineChannel(OpTest):
+    op_type = "affine_channel"
+
+    def setup(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 4, 5).astype("float32")
+        scale = rng.randn(3).astype("float32")
+        bias = rng.randn(3).astype("float32")
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        out = x * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.outputs = {"Out": out}
+
+
+def test_affine_channel_output_and_grad():
+    t = TestAffineChannel()
+    t.check_output()
+    t2 = TestAffineChannel()
+    t2.check_grad(["X", "Scale", "Bias"], "Out")
+
+
+def test_affine_channel_nhwc():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 5, 3).astype("float32")
+    scale = rng.randn(3).astype("float32")
+    bias = rng.randn(3).astype("float32")
+    t = TestAffineChannel()
+    t.setup = lambda: None
+    t.inputs = {"X": x, "Scale": scale, "Bias": bias}
+    t.attrs = {"data_layout": "NHWC"}
+    t.outputs = {"Out": x * scale + bias}
+    t.check_output()
+
+
+# -- spatial transformer ----------------------------------------------------
+
+def _np_affine_grid(theta, h, w):
+    xs = np.linspace(-1, 1, w)
+    ys = np.linspace(-1, 1, h)
+    xg, yg = np.meshgrid(xs, ys)
+    base = np.stack([xg, yg, np.ones_like(xg)], axis=-1)  # [H,W,3]
+    return np.einsum("hwc,nkc->nhwk", base, theta).astype("float32")
+
+
+def test_affine_grid_matches_numpy():
+    theta = np.random.RandomState(2).randn(2, 2, 3).astype("float32")
+    t = OpTest()
+    t.op_type = "affine_grid"
+    t.inputs = {"Theta": theta}
+    t.attrs = {"output_shape": [2, 3, 4, 5]}
+    t.outputs = {"Output": _np_affine_grid(theta, 4, 5)}
+    t.check_output()
+    t2 = OpTest()
+    t2.op_type = "affine_grid"
+    t2.inputs = {"Theta": theta}
+    t2.attrs = {"output_shape": [2, 3, 4, 5]}
+    t2.outputs = {"Output": _np_affine_grid(theta, 4, 5)}
+    t2.check_grad(["Theta"], "Output")
+
+
+def test_grid_sampler_identity_roundtrip():
+    """Identity theta -> grid samples every pixel exactly."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 5, 6).astype("float32")
+    theta = np.tile(
+        np.asarray([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]], "float32"), (2, 1, 1)
+    )
+
+    def build():
+        xv = fluid.layers.data("x", [3, 5, 6])
+        tv = fluid.layers.data("theta", [2, 3])
+        grid = fluid.layers.affine_grid(tv, out_shape=[2, 3, 5, 6])
+        out = fluid.layers.grid_sampler(xv, grid)
+        return (out,)
+
+    (out,) = _run_program(build, {"x": x, "theta": theta})
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-4, atol=1e-4)
+
+
+def test_grid_sampler_grad():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    # keep sample points away from the integer lattice so the bilinear
+    # surface is smooth in the finite-difference neighborhood
+    grid = rng.uniform(-0.85, 0.85, (1, 3, 3, 2)).astype("float32")
+    t = OpTest()
+    t.op_type = "grid_sampler"
+    t.inputs = {"X": x, "Grid": grid}
+    gx = (grid[..., 0] + 1) * 1.5
+    gy = (grid[..., 1] + 1) * 1.5
+    exp = np.zeros((1, 2, 3, 3), "float32")
+    for i in range(3):
+        for j in range(3):
+            xx, yy = gx[0, i, j], gy[0, i, j]
+            x0, y0 = int(np.floor(xx)), int(np.floor(yy))
+            acc = np.zeros(2)
+            for dy in (0, 1):
+                for dx in (0, 1):
+                    cx, cy = x0 + dx, y0 + dy
+                    if 0 <= cx <= 3 and 0 <= cy <= 3:
+                        wgt = (1 - abs(xx - cx)) * (1 - abs(yy - cy))
+                        acc += wgt * x[0, :, cy, cx]
+            exp[0, :, i, j] = acc
+    t.outputs = {"Output": exp}
+    t.check_output(atol=1e-4)
+    t2 = OpTest()
+    t2.op_type = "grid_sampler"
+    t2.inputs = {"X": x, "Grid": grid}
+    t2.outputs = {"Output": exp}
+    t2.check_grad(["X", "Grid"], "Output", max_relative_error=2e-2)
+
+
+# -- index pooling / unpool / spp ------------------------------------------
+
+def _np_max_pool_with_index(x, k, s, p):
+    n, c, h, w = x.shape
+    oh = (h + 2 * p[0] - k[0]) // s[0] + 1
+    ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+    xo = np.full((n, c, h + 2 * p[0], w + 2 * p[1]), -np.inf, x.dtype)
+    xo[:, :, p[0]:p[0] + h, p[1]:p[1] + w] = x
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    mask = np.zeros((n, c, oh, ow), np.int64)
+    for i in range(oh):
+        for j in range(ow):
+            win = xo[:, :, i * s[0]:i * s[0] + k[0],
+                     j * s[1]:j * s[1] + k[1]].reshape(n, c, -1)
+            out[:, :, i, j] = win.max(-1)
+            loc = win.argmax(-1)
+            hh = i * s[0] - p[0] + loc // k[1]
+            ww = j * s[1] - p[1] + loc % k[1]
+            mask[:, :, i, j] = hh * w + ww
+    return out, mask
+
+
+def test_max_pool2d_with_index_matches_numpy():
+    x = np.random.RandomState(5).randn(2, 3, 6, 8).astype("float32")
+    eo, em = _np_max_pool_with_index(x, [2, 3], [2, 2], [1, 1])
+    t = OpTest()
+    t.op_type = "max_pool2d_with_index"
+    t.inputs = {"X": x}
+    t.attrs = {"ksize": [2, 3], "strides": [2, 2], "paddings": [1, 1]}
+    t.outputs = {"Out": eo, "Mask": em.astype("int32")}
+    t.check_output()
+
+
+def test_max_pool2d_with_index_grad():
+    # well-separated values -> unique argmax -> smooth in the fd window
+    x = (np.arange(16, dtype="float32").reshape(1, 1, 4, 4) * 7.3) % 11.0
+    eo, em = _np_max_pool_with_index(x, [2, 2], [2, 2], [0, 0])
+    t = OpTest()
+    t.op_type = "max_pool2d_with_index"
+    t.inputs = {"X": x}
+    t.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+    t.outputs = {"Out": eo, "Mask": em.astype("int32")}
+    t.check_grad(["X"], "Out")
+
+
+def test_max_pool3d_with_index():
+    x = np.random.RandomState(6).randn(1, 2, 4, 4, 4).astype("float32")
+    exp = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    t = OpTest()
+    t.op_type = "max_pool3d_with_index"
+    t.inputs = {"X": x}
+    t.attrs = {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+               "paddings": [0, 0, 0]}
+    t.outputs = {"Out": exp}
+    t.check_output(no_check_set=("Mask",))
+
+
+def test_unpool_roundtrip_and_grad():
+    x = np.random.RandomState(7).randn(1, 2, 4, 4).astype("float32")
+    pooled, mask = _np_max_pool_with_index(x, [2, 2], [2, 2], [0, 0])
+    exp = np.zeros((1, 2, 4, 4), "float32")
+    for c in range(2):
+        flat = exp[0, c].ravel()
+        flat[mask[0, c].ravel()] = pooled[0, c].ravel()
+    t = OpTest()
+    t.op_type = "unpool"
+    t.inputs = {"X": pooled, "Indices": mask.astype("int32")}
+    t.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+    t.outputs = {"Out": exp}
+    t.check_output()
+    t2 = OpTest()
+    t2.op_type = "unpool"
+    t2.inputs = {"X": pooled, "Indices": mask.astype("int32")}
+    t2.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+    t2.outputs = {"Out": exp}
+    t2.check_grad(["X"], "Out")
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_spp_matches_manual(ptype):
+    x = np.random.RandomState(8).randn(2, 3, 4, 4).astype("float32")
+    # level 0: global 1x1; level 1: 2x2 bins of 2x2 windows
+    red = np.max if ptype == "max" else np.mean
+    lvl0 = red(x, axis=(2, 3)).reshape(2, 3)
+    lvl1 = np.zeros((2, 3, 2, 2), "float32")
+    for i in range(2):
+        for j in range(2):
+            lvl1[:, :, i, j] = red(
+                x[:, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2], axis=(2, 3))
+    exp = np.concatenate([lvl0, lvl1.reshape(2, -1)], axis=1)
+    t = OpTest()
+    t.op_type = "spp"
+    t.inputs = {"X": x}
+    t.attrs = {"pyramid_height": 2, "pooling_type": ptype}
+    t.outputs = {"Out": exp}
+    t.check_output()
+
+
+# -- multiplex / bilinear / conv_shift -------------------------------------
+
+def test_multiplex_selects_rows():
+    rng = np.random.RandomState(9)
+    xs = [rng.randn(4, 5).astype("float32") for _ in range(3)]
+    ids = np.asarray([[2], [0], [1], [2]], "int32")
+    exp = np.stack([xs[int(ids[b, 0])][b] for b in range(4)])
+    t = OpTest()
+    t.op_type = "multiplex"
+    t.inputs = {"Ids": ids, "X": [("x%d" % i, x) for i, x in enumerate(xs)]}
+    t.outputs = {"Out": exp}
+    t.check_output()
+    t2 = OpTest()
+    t2.op_type = "multiplex"
+    t2.inputs = {"Ids": ids, "X": [("x%d" % i, x) for i, x in enumerate(xs)]}
+    t2.outputs = {"Out": exp}
+    t2.check_grad(["x0", "x1", "x2"], "Out")
+
+
+def test_bilinear_tensor_product():
+    rng = np.random.RandomState(10)
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 5).astype("float32")
+    w = rng.randn(6, 4, 5).astype("float32")
+    b = rng.randn(1, 6).astype("float32")
+    exp = np.einsum("bm,kmn,bn->bk", x, w, y) + b
+    t = OpTest()
+    t.op_type = "bilinear_tensor_product"
+    t.inputs = {"X": x, "Y": y, "Weight": w, "Bias": b}
+    t.outputs = {"Out": exp}
+    t.check_output(atol=1e-4)
+    t2 = OpTest()
+    t2.op_type = "bilinear_tensor_product"
+    t2.inputs = {"X": x, "Y": y, "Weight": w, "Bias": b}
+    t2.outputs = {"Out": exp}
+    t2.check_grad(["X", "Y", "Weight", "Bias"], "Out",
+                  max_relative_error=1e-2)
+
+
+def test_conv_shift_circular():
+    rng = np.random.RandomState(11)
+    x = rng.randn(3, 7).astype("float32")
+    y = rng.randn(3, 3).astype("float32")
+    exp = np.zeros_like(x)
+    m, n = 7, 3
+    for b in range(3):
+        for i in range(m):
+            for j in range(n):
+                exp[b, i] += x[b, (i + j - (n - 1) // 2) % m] * y[b, j]
+    t = OpTest()
+    t.op_type = "conv_shift"
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"Out": exp}
+    t.check_output()
+    t2 = OpTest()
+    t2.op_type = "conv_shift"
+    t2.inputs = {"X": x, "Y": y}
+    t2.outputs = {"Out": exp}
+    t2.check_grad(["X", "Y"], "Out")
+
+
+# -- metrics ---------------------------------------------------------------
+
+def test_mean_iou_confusion():
+    pred = np.asarray([0, 1, 2, 2, 1, 0, 1], "int32")
+    label = np.asarray([0, 1, 1, 2, 2, 0, 1], "int32")
+    ncls = 3
+    correct = np.zeros(ncls, np.int64)
+    wrong = np.zeros(ncls, np.int64)
+    for p, l in zip(pred, label):
+        if p == l:
+            correct[l] += 1
+        else:
+            wrong[l] += 1
+            wrong[p] += 1
+    union = correct + wrong
+    iou = np.where(union > 0, correct / np.maximum(union, 1), 0.0)
+    mean = iou[union > 0].mean()
+    t = OpTest()
+    t.op_type = "mean_iou"
+    t.inputs = {"Predictions": pred, "Labels": label}
+    t.attrs = {"num_classes": ncls}
+    t.outputs = {
+        "OutMeanIou": np.asarray([mean], "float32"),
+        "OutWrong": wrong.astype("int32"),
+        "OutCorrect": correct.astype("int32"),
+    }
+    t.check_output()
+
+
+def test_positive_negative_pair_counts():
+    score = np.asarray(
+        [[0.9], [0.5], [0.7], [0.2], [0.2]], "float32")
+    label = np.asarray([[1.0], [0.0], [1.0], [0.0], [1.0]], "float32")
+    query = np.asarray([[1], [1], [1], [2], [2]], "int64")
+    # brute force with the reference's tie quirk (tie -> neutral AND
+    # negative)
+    pos = neg = neu = 0.0
+    rows = list(range(5))
+    for a in rows:
+        for b in rows:
+            if a >= b or query[a, 0] != query[b, 0]:
+                continue
+            if label[a, 0] == label[b, 0]:
+                continue
+            sd = score[a, 0] - score[b, 0]
+            ld = label[a, 0] - label[b, 0]
+            if sd == 0:
+                neu += 1
+            if sd * ld > 0:
+                pos += 1
+            else:
+                neg += 1
+    t = OpTest()
+    t.op_type = "positive_negative_pair"
+    t.inputs = {"Score": score, "Label": label, "QueryID": query}
+    t.outputs = {
+        "PositivePair": np.asarray([pos], "float32"),
+        "NegativePair": np.asarray([neg], "float32"),
+        "NeutralPair": np.asarray([neu], "float32"),
+    }
+    t.check_output()
+    assert pos == 2.0 and neg == 1.0 and neu == 1.0
+
+
+def test_positive_negative_pair_accumulates_and_weights():
+    score = np.asarray([[0.3], [0.6]], "float32")
+    label = np.asarray([[1.0], [0.0]], "float32")
+    query = np.asarray([[7], [7]], "int64")
+    weight = np.asarray([[2.0], [4.0]], "float32")
+    t = OpTest()
+    t.op_type = "positive_negative_pair"
+    t.inputs = {
+        "Score": score, "Label": label, "QueryID": query,
+        "Weight": weight,
+        "AccumulatePositivePair": np.asarray([10.0], "float32"),
+        "AccumulateNegativePair": np.asarray([20.0], "float32"),
+        "AccumulateNeutralPair": np.asarray([30.0], "float32"),
+    }
+    # one discordant pair, weight (2+4)/2 = 3 -> negative
+    t.outputs = {
+        "PositivePair": np.asarray([10.0], "float32"),
+        "NegativePair": np.asarray([23.0], "float32"),
+        "NeutralPair": np.asarray([30.0], "float32"),
+    }
+    t.check_output()
+
+
+# -- losses ----------------------------------------------------------------
+
+def test_modified_huber_loss():
+    x = np.asarray([[2.0], [0.5], [-0.5], [-3.0]], "float32")
+    y = np.asarray([[1.0], [1.0], [1.0], [1.0]], "float32")
+    z = (2 * y - 1) * x
+    exp = np.where(z >= -1, np.maximum(1 - z, 0) ** 2, -4 * z)
+    t = OpTest()
+    t.op_type = "modified_huber_loss"
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"Out": exp.astype("float32")}
+    t.check_output(no_check_set=("IntermediateVal",))
+    t2 = OpTest()
+    t2.op_type = "modified_huber_loss"
+    # keep away from the z = -1 and z = 1 kinks for the fd check
+    t2.inputs = {"X": np.asarray([[2.2], [0.4], [-0.3], [-3.1]], "float32"),
+                 "Y": y}
+    t2.outputs = {"Out": exp.astype("float32")}
+    t2.check_grad(["X"], "Out")
+
+
+# -- tensor utilities -------------------------------------------------------
+
+def test_fill_and_batch_size_like():
+    def build():
+        x = fluid.layers.data("x", [5], dtype="float32")
+        filled = fluid.layers.fill(
+            shape=[2, 3], value=[1, 2, 3, 4, 5, 6], dtype="float32")
+        g = fluid.layers.gaussian_random_batch_size_like(
+            x, shape=[-1, 16], mean=0.0, std=1.0)
+        u = fluid.layers.uniform_random_batch_size_like(
+            x, shape=[-1, 8], min=-2.0, max=2.0)
+        return filled, g, u
+
+    f, g, u = _run_program(
+        build, {"x": np.zeros((6, 5), "float32")})
+    np.testing.assert_allclose(
+        np.asarray(f), np.arange(1, 7, dtype="float32").reshape(2, 3))
+    assert np.asarray(g).shape == (6, 16)
+    assert np.asarray(u).shape == (6, 8)
+    assert np.abs(np.asarray(u)).max() <= 2.0
+
+
+def test_hash_deterministic_in_range():
+    ids = np.asarray([[3], [3], [77], [123456]], "int64")
+
+    def build():
+        x = fluid.layers.data("x", [1], dtype="int64")
+        return (fluid.layers.hash(x, hash_size=1000, num_hash=4),)
+
+    (h1,) = _run_program(build, {"x": ids})
+    (h2,) = _run_program(build, {"x": ids})
+    h1 = np.asarray(h1)
+    assert h1.shape == (4, 4, 1)
+    assert (h1 >= 0).all() and (h1 < 1000).all()
+    np.testing.assert_array_equal(h1, np.asarray(h2))  # deterministic
+    np.testing.assert_array_equal(h1[0], h1[1])  # same id -> same hashes
+    assert not (h1[0] == h1[2]).all()  # different ids differ somewhere
+    assert len(np.unique(h1[3])) > 1  # slots use different seeds
+
+
+def test_lod_reset_rechunks():
+    x = np.arange(12, dtype="float32").reshape(2, 3, 2)  # 6 rows of dim 2
+
+    def build():
+        xv = fluid.layers.data("x", [3, 2])
+        out, length = fluid.layers.lod_reset(xv, target_lod=[0, 2, 6])
+        return out, length
+
+    out, length = _run_program(build, {"x": x})
+    out = np.asarray(out)
+    assert out.shape == (2, 4, 2)
+    flat = x.reshape(6, 2)
+    np.testing.assert_allclose(out[0, :2], flat[0:2])
+    np.testing.assert_allclose(out[0, 2:], 0.0)
+    np.testing.assert_allclose(out[1], flat[2:6])
+    np.testing.assert_array_equal(np.asarray(length).ravel(), [2, 4])
+
+
+# -- conv3d_transpose -------------------------------------------------------
+
+def test_conv3d_transpose_matches_loop():
+    rng = np.random.RandomState(12)
+    x = rng.randn(1, 2, 3, 3, 3).astype("float32")
+    w = rng.randn(2, 3, 2, 2, 2).astype("float32")  # [in_c, out_c, kd,kh,kw]
+    stride, pad = 2, 0
+    od = (3 - 1) * stride + 2
+    exp = np.zeros((1, 3, od, od, od), "float32")
+    for ic in range(2):
+        for d in range(3):
+            for h in range(3):
+                for ww_ in range(3):
+                    exp[0, :, d * stride:d * stride + 2,
+                        h * stride:h * stride + 2,
+                        ww_ * stride:ww_ * stride + 2] += (
+                        x[0, ic, d, h, ww_] * w[ic]
+                    )
+    t = OpTest()
+    t.op_type = "conv3d_transpose"
+    t.inputs = {"Input": x, "Filter": w}
+    t.attrs = {"strides": [2, 2, 2], "paddings": [0, 0, 0]}
+    t.outputs = {"Output": exp}
+    t.check_output(atol=1e-4)
+
+
+# -- quantization -----------------------------------------------------------
+
+def test_fake_quantize_abs_max():
+    x = np.asarray([[0.5, -1.0], [0.25, 0.75]], "float32")
+    scale = 1.0
+    exp = np.round(np.clip(x / scale, -1, 1) * 127)
+    t = OpTest()
+    t.op_type = "fake_quantize_abs_max"
+    t.inputs = {"X": x}
+    t.outputs = {"Out": exp, "OutScale": np.asarray([scale], "float32")}
+    t.check_output()
+
+
+def test_fake_quantize_range_abs_max_train_vs_test():
+    x = np.asarray([[2.0, -4.0]], "float32")
+    in_scale = np.asarray([3.0], "float32")
+    # train: scale grows to batch abs-max
+    t = OpTest()
+    t.op_type = "fake_quantize_range_abs_max"
+    t.inputs = {"X": x, "InScale": in_scale}
+    t.attrs = {"is_test": False}
+    t.outputs = {
+        "Out": np.round(np.clip(x / 4.0, -1, 1) * 127),
+        "OutScale": np.asarray([4.0], "float32"),
+    }
+    t.check_output()
+    # test: stored scale wins, saturating the -4
+    t2 = OpTest()
+    t2.op_type = "fake_quantize_range_abs_max"
+    t2.inputs = {"X": x, "InScale": in_scale}
+    t2.attrs = {"is_test": True}
+    t2.outputs = {
+        "Out": np.round(np.clip(x / 3.0, -1, 1) * 127),
+        "OutScale": np.asarray([3.0], "float32"),
+    }
+    t2.check_output()
+
+
+def test_fake_dequantize_max_abs():
+    x = np.asarray([[127.0, -64.0]], "float32")
+    scale = np.asarray([2.0], "float32")
+    t = OpTest()
+    t.op_type = "fake_dequantize_max_abs"
+    t.inputs = {"X": x, "Scale": scale}
+    t.attrs = {"max_range": 127.0}
+    t.outputs = {"Out": x * 2.0 / 127.0}
+    t.check_output()
+
+
+def test_fake_quantize_straight_through_gradient():
+    """The vjp through fake_quantize must be the straight-through
+    estimator: d(out)/d(x) = 127/scale (never zero despite round)."""
+    x = np.asarray([[0.5, -0.25], [0.125, -1.0]], "float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [2], stop_gradient=False)
+        out, _scale = fluid.layers.fake_quantize_abs_max(xv)
+        loss = fluid.layers.reduce_sum(out)
+        grads = fluid.calc_gradient(loss, [xv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (g,) = exe.run(main, feed={"x": x}, fetch_list=grads)
+    np.testing.assert_allclose(
+        np.asarray(g), np.full_like(x, 127.0), rtol=1e-5)
+
+
+def test_conv2d_transpose_output_size():
+    """output_size disambiguates the stride-ambiguous output shape by
+    extra high-side padding (conv_transpose_op.cc InferShape role)."""
+    x = np.random.RandomState(13).randn(1, 2, 5, 5).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [2, 5, 5])
+        out = fluid.layers.conv2d_transpose(
+            xv, num_filters=3, filter_size=3, stride=2, padding=1,
+            output_size=[10, 10])
+        return (out,)
+
+    (out,) = _run_program(build, {"x": x})
+    assert np.asarray(out).shape == (1, 3, 10, 10)  # default would be 9x9
+
+
+def test_affine_channel_default_params():
+    """scale/bias default to created parameters (identity at init)."""
+    x = np.random.RandomState(14).randn(2, 3, 4, 4).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [3, 4, 4])
+        return (fluid.layers.affine_channel(xv),)
+
+    (out,) = _run_program(build, {"x": x})
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6)
+
+
+def test_fake_quantize_range_clipped_gradient_passes_through():
+    """Clipped elements keep the straight-through gradient (the reference
+    grad kernel is an unconditional pass-through)."""
+    x = np.asarray([[0.5, 9.0]], "float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [2], stop_gradient=False)
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("fake_quantize_range_abs_max")
+        out = helper.create_variable_for_type_inference("float32")
+        scale_out = helper.create_variable_for_type_inference("float32")
+        in_scale = fluid.layers.fill(shape=[1], value=[1.0], dtype="float32")
+        helper.append_op(
+            type="fake_quantize_range_abs_max",
+            inputs={"X": [xv], "InScale": [in_scale]},
+            outputs={"Out": [out], "OutScale": [scale_out]},
+            attrs={"is_test": True},
+        )
+        loss = fluid.layers.reduce_sum(out)
+        grads = fluid.calc_gradient(loss, [xv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (g,) = exe.run(main, feed={"x": x}, fetch_list=grads)
+    np.testing.assert_allclose(np.asarray(g), [[127.0, 127.0]], rtol=1e-5)
