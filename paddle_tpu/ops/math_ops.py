@@ -302,7 +302,7 @@ def _lower_multiplex(ctx, ins, attrs):
     take_along_axis with the row index (one fused gather on TPU)."""
     ids = jnp.reshape(ins["Ids"][0], (-1,)).astype(jnp.int32)
     xs = jnp.stack(ins["X"], axis=0)  # [K, B, ...]
-    k, b = xs.shape[0], xs.shape[1]
+    b = xs.shape[1]
     idx = jnp.reshape(ids, (1, b) + (1,) * (xs.ndim - 2))
     return jnp.squeeze(
         jnp.take_along_axis(xs, jnp.broadcast_to(idx, (1,) + xs.shape[1:]),

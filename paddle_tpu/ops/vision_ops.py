@@ -263,6 +263,7 @@ def _lower_spp(ctx, ins, attrs):
     height = attrs["pyramid_height"]
     ptype = attrs.get("pooling_type", "max")
     n, c, h, w = x.shape
+    xf = x.astype(jnp.float32)
     outs = []
     for lvl in range(height):
         bins = 2 ** lvl
@@ -270,23 +271,19 @@ def _lower_spp(ctx, ins, attrs):
         kw = int(np.ceil(w / float(bins)))
         ph = (kh * bins - h + 1) // 2
         pw = (kw * bins - w + 1) // 2
+        pad = ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+               (pw, kw * bins - w - pw))
+        win = dict(window_dimensions=(1, 1, kh, kw),
+                   window_strides=(1, 1, kh, kw), padding=pad)
         if ptype == "max":
-            init, op_fn = -jnp.inf, jax.lax.max
-            xf = x.astype(jnp.float32)
+            pooled = jax.lax.reduce_window(xf, -jnp.inf, jax.lax.max, **win)
         else:
-            init, op_fn = 0.0, jax.lax.add
-            xf = x.astype(jnp.float32)
-        pooled = jax.lax.reduce_window(
-            xf,
-            init,
-            op_fn,
-            window_dimensions=(1, 1, kh, kw),
-            window_strides=(1, 1, kh, kw),
-            padding=((0, 0), (0, 0), (ph, kh * bins - h - ph),
-                     (pw, kw * bins - w - pw)),
-        )
-        if ptype != "max":
-            pooled = pooled / float(kh * kw)
+            # exclusive average: divide by the count of real (unpadded)
+            # elements per window, matching the reference AvgPool clipping
+            total = jax.lax.reduce_window(xf, 0.0, jax.lax.add, **win)
+            count = jax.lax.reduce_window(
+                jnp.ones_like(xf), 0.0, jax.lax.add, **win)
+            pooled = total / jnp.maximum(count, 1.0)
         outs.append(jnp.reshape(pooled, (n, c * bins * bins)))
     return jnp.concatenate(outs, axis=1).astype(x.dtype)
 

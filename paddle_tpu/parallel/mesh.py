@@ -113,6 +113,17 @@ class ShardingPolicy(object):
         if name in self.overrides:
             return self._spec_to_sharding(self.overrides[name])
         shape = self.state_shapes.get(name)
+        # optimizer accumulators ("<param>_<acc>_<n>") inherit their
+        # param's tensor-parallel layout when same-shaped (moments must be
+        # partitioned like the weight or GSPMD resharding thrashes);
+        # scalar state (beta_pow etc.) falls through to the policies below
+        for base, spec in self.overrides.items():
+            if (
+                name.startswith(base + "_")
+                and shape is not None
+                and tuple(shape) == tuple(self.state_shapes.get(base, ()))
+            ):
+                return self._spec_to_sharding(spec)
         missed = []  # why each plausible sharded layout was not taken
         if name in self.model_sharded_vars and shape:
             msize = self.mesh.shape.get("model", 1)

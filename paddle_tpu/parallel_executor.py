@@ -77,6 +77,7 @@ class ParallelExecutor(object):
         use_tpu=True,
         num_devices=None,
         model_sharded_vars=None,
+        sharding_overrides=None,
     ):
         self._program = main_program or framework.default_main_program()
         self._scope = scope or global_scope()
@@ -122,6 +123,11 @@ class ParallelExecutor(object):
         n = num_devices or len(pool)
         self.mesh = build_mesh(num_devices=n, devices=pool)
         self._model_sharded_vars = set(model_sharded_vars or ())
+        # Tensor-parallel layout control: var name -> PartitionSpec (or a
+        # plain tuple of axis names / None). GSPMD inserts the matching
+        # collectives (all-gather for column-parallel, psum for
+        # row-parallel) — the scaling-book recipe.
+        self._sharding_overrides = dict(sharding_overrides or {})
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
 
@@ -136,11 +142,19 @@ class ParallelExecutor(object):
             == BuildStrategy.ReduceStrategy.Reduce
             else "all_reduce"
         )
+        from jax.sharding import PartitionSpec
+
+        overrides = {
+            name: spec if isinstance(spec, PartitionSpec)
+            else PartitionSpec(*spec)
+            for name, spec in self._sharding_overrides.items()
+        }
         return ShardingPolicy(
             self.mesh,
             strategy=strategy,
             state_shapes=state_shapes,
             model_sharded_vars=self._model_sharded_vars,
+            overrides=overrides,
         )
 
     def _get_compiled(self, feed_specs, fetch_names):

@@ -608,3 +608,16 @@ def test_fake_quantize_range_clipped_gradient_passes_through():
     exe.run(startup)
     (g,) = exe.run(main, feed={"x": x}, fetch_list=grads)
     np.testing.assert_allclose(np.asarray(g), [[127.0, 127.0]], rtol=1e-5)
+
+
+def test_spp_avg_exclusive_on_nondivisible():
+    """Edge bins on non-divisible inputs average over real elements only
+    (reference AvgPool clips the window; padding must not deflate)."""
+    x = np.ones((1, 1, 5, 5), "float32")
+    t = OpTest()
+    t.op_type = "spp"
+    t.inputs = {"X": x}
+    t.attrs = {"pyramid_height": 2, "pooling_type": "avg"}
+    # all-ones input: every bin's exclusive average is exactly 1
+    t.outputs = {"Out": np.ones((1, 5), "float32")}
+    t.check_output()
