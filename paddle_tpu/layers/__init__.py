@@ -17,6 +17,7 @@ from paddle_tpu.layers.learning_rate_scheduler import (  # noqa: F401
     piecewise_decay,
     noam_decay,
     cosine_decay,
+    append_LARS,
 )
 from paddle_tpu.layers.sequence import *  # noqa: F401,F403
 from paddle_tpu.layers.rnn import *  # noqa: F401,F403

@@ -29,19 +29,27 @@ _DECAY_COUNTER = "@LR_DECAY_COUNTER@"
 
 
 def _global_step_counter(counter_name=None, begin=0, step=1):
+    """Shared per-program step counter. The increment op is appended only
+    when the counter var is first created, so several call sites (two LR
+    schedules, user autoincreased_step_counter) share ONE +step per run —
+    the reference's is-new-var guard. Kept float32 (x64 is off on TPU;
+    exact to 2^24 steps) where the reference uses int64."""
     helper = LayerHelper("global_step_counter")
     name = counter_name or _DECAY_COUNTER
+    gblock = helper.main_program.global_block()
+    existed = gblock.has_var(name)
     counter = helper.create_global_variable(
         name=name, shape=[1], dtype="float32", persistable=True,
         initializer=init_mod.ConstantInitializer(float(begin - step)),
     )
-    helper.main_program.global_block().append_op(
-        type="increment",
-        inputs={"X": [counter.name]},
-        outputs={"Out": [counter.name]},
-        attrs={"step": float(step), framework.OP_ROLE_ATTR_NAME:
-               framework.OpRole.LRSched},
-    )
+    if not existed:
+        gblock.append_op(
+            type="increment",
+            inputs={"X": [counter.name]},
+            outputs={"Out": [counter.name]},
+            attrs={"step": float(step), framework.OP_ROLE_ATTR_NAME:
+                   framework.OpRole.LRSched},
+        )
     return counter
 
 

@@ -73,6 +73,12 @@ __all__ = [
     "lod_reset",
     "fake_quantize_abs_max",
     "conv3d_transpose",
+    "Print",
+    "random_crop",
+    "dice_loss",
+    "image_resize_short",
+    "autoincreased_step_counter",
+    "sequence_expand",
 ]
 
 from paddle_tpu.layers.ops import relu, log  # noqa: E402,F401  (re-export)
@@ -1208,3 +1214,115 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
     )
     pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both", name=None):
+    """Debug print of a tensor at execution time (print_op.cc surface;
+    lowers to jax.debug.print inside the compiled step)."""
+    helper = LayerHelper("print", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="print",
+        inputs={"In": [input]},
+        outputs={"Out": [out]},
+        attrs={"message": message or input.name},
+    )
+    return out
+
+
+def random_crop(x, shape, seed=None, name=None):
+    """Random spatial crop to `shape` (random_crop_op.cc). The reference
+    threads an explicit Seed tensor; here the op draws from the program's
+    stateless PRNG stream, and `seed` pins it via a constant."""
+    from paddle_tpu.layers import tensor as tensor_layers
+
+    helper = LayerHelper("random_crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    seed_var = tensor_layers.fill_constant(
+        shape=[1], dtype="int64", value=int(seed or 0))
+    seed_out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="random_crop",
+        inputs={"X": [x], "Seed": [seed_var]},
+        outputs={"Out": [out], "SeedOut": [seed_out]},
+        # nonzero seed pins the op's PRNG stream (fix_seed semantics in
+        # core/op_registry.LowerContext.rng)
+        attrs={"shape": list(shape), "seed": int(seed or 0)},
+    )
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice coefficient loss for segmentation (layers/nn.py dice_loss
+    parity): integer class-index labels are one-hot encoded over the last
+    dim of `input` as in the reference; float labels are taken as masks
+    directly. Reduces over the last dim, then means over samples."""
+    from paddle_tpu.layers import tensor as tensor_layers
+
+    if str(label.dtype).startswith("int"):
+        label = one_hot(label, depth=int(input.shape[-1]))
+        if len(label.shape) > len(input.shape):
+            label = squeeze(label, axes=[len(input.shape) - 1])
+    label = tensor_layers.cast(label, input.dtype)
+    reduce_dim = len(input.shape) - 1
+    inse = reduce_sum(elementwise_mul(input, label), dim=reduce_dim)
+    dice_denominator = elementwise_add(
+        reduce_sum(input, dim=reduce_dim),
+        reduce_sum(label, dim=reduce_dim),
+    )
+    dice_score = scale(
+        elementwise_div(
+            scale(inse, scale=2.0),
+            elementwise_add(
+                dice_denominator,
+                tensor_layers.fill_constant([1], input.dtype, epsilon),
+            ),
+        ),
+        scale=-1.0, bias=1.0,
+    )
+    return reduce_mean(dice_score)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR",
+                       name=None):
+    """Resize so the SHORT image side equals out_short_len, keeping the
+    aspect ratio (layers/nn.py image_resize_short parity)."""
+    in_h, in_w = int(input.shape[2]), int(input.shape[3])
+    # int(x + 0.5), not round(): matches the reference's half-up rounding
+    # (Python round() is banker's and differs on exact .5 ratios)
+    if in_h < in_w:
+        out_h = out_short_len
+        out_w = int(in_w * out_short_len / float(in_h) + 0.5)
+    else:
+        out_w = out_short_len
+        out_h = int(in_h * out_short_len / float(in_w) + 0.5)
+    return image_resize(input, out_shape=[out_h, out_w], resample=resample,
+                        name=name)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """A persistable int step counter incremented once per run
+    (layers/nn.py autoincreased_step_counter parity; the LR schedulers
+    share the same counter machinery)."""
+    from paddle_tpu.layers import learning_rate_scheduler as lrs
+
+    return lrs._global_step_counter(
+        counter_name=counter_name or "@STEP_COUNTER@", begin=begin,
+        step=step)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat each row of x across y's time dimension then flatten
+    (sequence_expand_op.cc, padded-design form: y supplies max_len)."""
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_expand",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"ref_level": ref_level},
+    )
+    return out

@@ -265,3 +265,45 @@ def pad_constant_like(x, y, pad_value=0.0, name=None):
         attrs={"pad_value": float(pad_value)},
     )
     return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop (crop_op.cc): slice `shape` starting at `offsets`
+    (defaults to zeros). Runtime-tensor shape/offsets are obviated under
+    XLA static shapes — pass lists."""
+    if shape is None or not isinstance(shape, (list, tuple)):
+        raise TypeError("crop: shape must be a static list/tuple")
+    offsets = list(offsets) if offsets is not None else [0] * len(shape)
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="crop",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "offsets": offsets},
+    )
+    return out
+
+
+def sum(x, name=None):
+    """Elementwise sum of a list of tensors (sum_op.cc layer surface;
+    same op as the sums() helper above)."""
+    return sums(x if isinstance(x, (list, tuple)) else [x])
+
+
+def load(file_path, dtype=None, name=None):
+    """Materialize a variable saved by fluid.io.save_vars (load_op.cc
+    layer surface); the value is folded into the executable at trace
+    time, cast to `dtype` when given (else the file's dtype), and the
+    shape comes from the file via shape inference."""
+    helper = LayerHelper("load", name=name)
+    out = helper.create_variable_for_type_inference(dtype or "float32")
+    helper.append_op(
+        type="load",
+        outputs={"Out": [out]},
+        attrs={"file_path": file_path, "dtype": dtype or ""},
+    )
+    return out
+
+
+__all__ += ["crop", "sum", "load"]

@@ -96,3 +96,34 @@ register_op(
     },
     lower=_lower_print,
 )
+
+
+def _lower_load(ctx, ins, attrs):
+    """load_op.cc: materialize a variable from a file saved by
+    fluid.io.save_vars (.npy per var). Under whole-program XLA the file
+    read happens at trace time and the value enters the executable as a
+    constant — re-tracing (program edit / shape change) re-reads it."""
+    import numpy as np
+
+    path = attrs.get("file_path", "")
+    if not path:
+        raise ValueError("load: file_path attr is required")
+    if not path.endswith(".npy"):
+        path = path + ".npy"
+    val = jnp.asarray(np.load(path))
+    dtype = attrs.get("dtype", "")
+    if dtype:
+        from paddle_tpu.core.types import canonical_dtype
+
+        val = val.astype(canonical_dtype(dtype))
+    return val
+
+
+register_op(
+    "load",
+    inputs=[],
+    outputs=["Out"],
+    attrs={"file_path": "", "dtype": ""},
+    lower=_lower_load,
+    grad=None,
+)

@@ -105,7 +105,7 @@ register_op(
     "random_crop",
     inputs=["X", "Seed"],
     outputs=["Out", "SeedOut"],
-    attrs={"shape": []},
+    attrs={"shape": [], "seed": 0},
     lower=lambda ctx, ins, attrs: {
         "Out": _random_crop(ctx, ins["X"][0], attrs["shape"]),
         "SeedOut": ins["Seed"][0],

@@ -1,0 +1,201 @@
+"""Front-end layer-surface completion tests: Print, crop, sum,
+random_crop, dice_loss, image_resize_short, autoincreased_step_counter,
+sequence_expand, load, append_LARS export.
+
+Reference parity: python/paddle/fluid/layers __all__ (the API surface the
+golden API.spec test locks); semantics from layers/nn.py + the op kernels.
+"""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run(build, feed=None, steps=1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = None
+    for _ in range(steps):
+        out = exe.run(main, feed=feed or {}, fetch_list=list(fetches))
+    return out
+
+
+def test_crop_and_sum_layers():
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+
+    def build():
+        xv = fluid.layers.data("x", [3, 4])
+        c = fluid.layers.crop(xv, shape=[1, 2, 3], offsets=[1, 1, 0])
+        s = fluid.layers.sum([xv, xv, xv])
+        return c, s
+
+    c, s = _run(build, {"x": x})
+    np.testing.assert_allclose(np.asarray(c), x[1:2, 1:3, 0:3])
+    np.testing.assert_allclose(np.asarray(s), 3 * x)
+
+
+def test_print_layer_passthrough(capfd):
+    x = np.asarray([[1.5, 2.5]], "float32")
+
+    def build():
+        xv = fluid.layers.data("x", [2])
+        out = fluid.layers.Print(xv, message="dbg")
+        return (fluid.layers.scale(out, scale=2.0),)
+
+    (out,) = _run(build, {"x": x})
+    np.testing.assert_allclose(np.asarray(out), 2 * x)
+
+
+def test_random_crop_layer():
+    x = np.random.RandomState(0).rand(4, 3, 8, 8).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [3, 8, 8])
+        return (fluid.layers.random_crop(xv, shape=[3, 5, 5]),)
+
+    (out,) = _run(build, {"x": x})
+    out = np.asarray(out)
+    assert out.shape == (4, 3, 5, 5)
+    # crop content must exist inside the source image
+    found = False
+    for i in range(4):
+        for j in range(4):
+            if np.allclose(out[0, :, :, :], x[0, :, i:i + 5, j:j + 5]):
+                found = True
+    assert found
+
+
+def test_dice_loss_perfect_and_disjoint():
+    # perfect overlap -> ~0; disjoint -> ~1
+    a = np.zeros((2, 4), "float32")
+    a[:, :2] = 1.0
+
+    def build():
+        p = fluid.layers.data("p", [4])
+        l = fluid.layers.data("l", [4])
+        return (fluid.layers.dice_loss(p, l),)
+
+    (perfect,) = _run(build, {"p": a, "l": a})
+    assert abs(float(np.asarray(perfect).ravel()[0])) < 1e-4
+    b = 1.0 - a
+    (disjoint,) = _run(build, {"p": a, "l": b})
+    assert abs(float(np.asarray(disjoint).ravel()[0]) - 1.0) < 1e-4
+
+
+def test_image_resize_short_keeps_aspect():
+    x = np.random.RandomState(1).rand(1, 3, 6, 12).astype("float32")
+
+    def build():
+        xv = fluid.layers.data("x", [3, 6, 12])
+        return (fluid.layers.image_resize_short(xv, 3),)
+
+    (out,) = _run(build, {"x": x})
+    assert np.asarray(out).shape == (1, 3, 3, 6)
+
+
+def test_autoincreased_step_counter():
+    def build():
+        step = fluid.layers.autoincreased_step_counter(begin=1)
+        return (step,)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        (step,) = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    vals = [float(np.asarray(exe.run(main, fetch_list=[step])[0]).ravel()[0])
+            for _ in range(3)]
+    assert vals == [1.0, 2.0, 3.0], vals
+
+
+def test_sequence_expand_repeats_rows():
+    x = np.asarray([[1.0, 2.0], [3.0, 4.0]], "float32")  # [2, d]
+    y = np.zeros((2, 3, 1), "float32")  # ref: max_len 3
+
+    def build():
+        xv = fluid.layers.data("x", [2], append_batch_size=True)
+        yv = fluid.layers.data("y", [3, 1])
+        return (fluid.layers.sequence_expand(xv, yv),)
+
+    (out,) = _run(build, {"x": x, "y": y})
+    exp = np.repeat(x, 3, axis=0)
+    np.testing.assert_allclose(np.asarray(out), exp)
+
+
+def test_load_layer_roundtrip(tmp_path):
+    val = np.arange(6, dtype="float32").reshape(2, 3)
+    path = os.path.join(str(tmp_path), "w.npy")
+    np.save(path, val)
+
+    def build():
+        w = fluid.layers.load(path)
+        return (fluid.layers.scale(w, scale=1.0),)
+
+    (out,) = _run(build)
+    np.testing.assert_allclose(np.asarray(out), val)
+
+
+def test_append_lars_exported():
+    assert callable(fluid.layers.append_LARS)
+
+
+def test_dice_loss_int_class_labels_one_hot():
+    """Integer labels are one-hot encoded over the last dim (reference
+    dice_loss contract), not cast to float indices."""
+    probs = np.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1]], "float32")
+    labs = np.asarray([[0], [1]], "int64")
+
+    def build():
+        p = fluid.layers.data("p", [3])
+        l = fluid.layers.data("l", [1], dtype="int64")
+        return (fluid.layers.dice_loss(p, l),)
+
+    (v,) = _run(build, {"p": probs, "l": labs})
+    oh = np.eye(3)[labs[:, 0]]
+    inse = (probs * oh).sum(-1)
+    den = probs.sum(-1) + oh.sum(-1)
+    exp = (1 - 2 * inse / (den + 1e-5)).mean()
+    np.testing.assert_allclose(float(np.asarray(v).ravel()[0]), exp,
+                               rtol=1e-5)
+
+
+def test_random_crop_seed_deterministic():
+    def crop_once():
+        def build():
+            xv = fluid.layers.data("x", [1, 6, 6])
+            return (fluid.layers.random_crop(xv, shape=[1, 3, 3], seed=42),)
+
+        x = np.arange(36, dtype="float32").reshape(1, 1, 6, 6)
+        return np.asarray(_run(build, {"x": x})[0])
+
+    np.testing.assert_array_equal(crop_once(), crop_once())
+
+
+def test_step_counter_shared_single_increment():
+    """Two call sites share ONE +1 per run (reference is-new-var guard)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        c1 = fluid.layers.autoincreased_step_counter(begin=1)
+        c2 = fluid.layers.autoincreased_step_counter(begin=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for expect in (1.0, 2.0):
+        v1, v2 = exe.run(main, fetch_list=[c1, c2])
+        assert float(np.asarray(v1).ravel()[0]) == expect
+        assert float(np.asarray(v2).ravel()[0]) == expect
+
+
+def test_load_layer_dtype_cast(tmp_path):
+    path = os.path.join(str(tmp_path), "v.npy")
+    np.save(path, np.asarray([1, 2, 3], np.int32))
+
+    def build():
+        return (fluid.layers.load(path, dtype="float32"),)
+
+    (v,) = _run(build)
+    assert np.asarray(v).dtype == np.float32
