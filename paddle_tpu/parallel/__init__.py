@@ -14,3 +14,11 @@ from paddle_tpu.parallel.mesh import (  # noqa: F401
     build_mesh,
     init_distributed,
 )
+from paddle_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)
+from paddle_tpu.parallel.pipeline import (  # noqa: F401
+    gpipe,
+    stack_stage_params,
+)

@@ -1,0 +1,26 @@
+"""JAX API compatibility shims shared by the parallel modules.
+
+The shard_map entry point and the varying-axis cast have moved across JAX
+releases; both ring_attention and pipeline need the same fallbacks, so
+they live here once.
+"""
+
+import jax
+
+
+def shard_map():
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm
+
+
+def vary(x, axis_name):
+    """Mark a device-uniform value as varying over ``axis_name`` (required
+    for scan carries inside shard_map whose outputs become varying)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, (axis_name,))
+    return x

@@ -25,15 +25,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from paddle_tpu.parallel import _compat
+
 _NEG_INF = -1e30
-
-
-def _shard_map():
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map
-
-    return shard_map
+_shard_map = _compat.shard_map
 
 
 def _ring_attention_shard(q, k, v, axis_name, causal, sm_scale):
@@ -48,11 +43,7 @@ def _ring_attention_shard(q, k, v, axis_name, causal, sm_scale):
     def _vary(x):
         # Mark device-uniform initial carries as varying over the ring axis
         # (shard_map's varying-axis type system requires carry in/out match).
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(x, (axis_name,), to="varying")
-        if hasattr(jax.lax, "pvary"):
-            return jax.lax.pvary(x, (axis_name,))
-        return x
+        return _compat.vary(x, axis_name)
 
     acc0 = _vary(jnp.zeros(q.shape[:3] + (d,), jnp.float32))
     m0 = _vary(jnp.full(q.shape[:3] + (1,), _NEG_INF, jnp.float32))
