@@ -11,6 +11,8 @@
 // tests (SURVEY.md §2.9 item 7). Float32, core op subset; unsupported ops
 // report an error rather than mis-executing.
 
+#include <algorithm>
+#include <cctype>
 #include <cmath>
 #include <cstdint>
 #include <functional>
@@ -110,6 +112,10 @@ class Interpreter {
     }
     if (op.type == "mean") return RunMean(op, scope);
     if (op.type == "dropout") return RunDropoutTest(op, scope);
+    if (op.type == "lookup_table") return RunLookupTable(op, scope);
+    if (op.type == "sum") return RunSum(op, scope);
+    if (op.type == "sequence_pool") return RunSequencePool(op, scope);
+    if (op.type == "dynamic_lstm") return RunDynamicLstm(op, scope);
     return "unsupported op type";
   }
 
@@ -592,6 +598,278 @@ class Interpreter {
       for (int64_t j = 0; j < cols; ++j) orow[j] /= sum;
     }
     scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // Integer ids from an int32/int64/float32 tensor (feeds arrive in any
+  // of the three; .npy params keep their stored width).
+  static std::string ReadIds(const HostTensor& t, std::vector<int64_t>* out) {
+    int64_t n = NumElements(t.dims);
+    out->resize(n);
+    if (t.dtype == "int32") {
+      const int32_t* p = reinterpret_cast<const int32_t*>(t.data.data());
+      for (int64_t i = 0; i < n; ++i) (*out)[i] = p[i];
+    } else if (t.dtype == "int64") {
+      const int64_t* p = reinterpret_cast<const int64_t*>(t.data.data());
+      for (int64_t i = 0; i < n; ++i) (*out)[i] = p[i];
+    } else if (t.dtype == "float32") {
+      const float* p = reinterpret_cast<const float*>(t.data.data());
+      for (int64_t i = 0; i < n; ++i) (*out)[i] = static_cast<int64_t>(p[i]);
+    } else {
+      return "unsupported ids dtype " + t.dtype;
+    }
+    return "";
+  }
+
+  // lookup_table_op.cc role: rows of W gathered by Ids; padding_idx rows
+  // come back zero. Trailing singleton id dim is squeezed like the XLA
+  // lowering (ops/tensor_ops.py _lower_lookup_table).
+  std::string RunLookupTable(const OpDesc& op, Scope* scope) {
+    const std::string* wn = OneName(op, "W");
+    const std::string* idn = OneName(op, "Ids");
+    const std::string* on = OneName(op, "Out", false);
+    if (wn == nullptr || idn == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* w = scope->Find(*wn);
+    const HostTensor* ids_t = scope->Find(*idn);
+    if (w == nullptr || ids_t == nullptr) return "input not in scope";
+    if (!IsF32(*w) || w->dims.size() != 2) return "bad table";
+    std::vector<int64_t> ids;
+    std::string err = ReadIds(*ids_t, &ids);
+    if (!err.empty()) return err;
+    int64_t rows = w->dims[0], dim = w->dims[1];
+    // padding_idx < 0 is the kNoPadding sentinel (XLA lowering only pads
+    // when >= 0); trailing singleton squeezes only above rank 1, matching
+    // jnp.ndim(ids) > 1 in _lower_lookup_table
+    int64_t padding_idx = IntAttr(op, "padding_idx", -1);
+    std::vector<int64_t> odims = ids_t->dims;
+    if (odims.size() > 1 && odims.back() == 1) odims.pop_back();
+    odims.push_back(dim);
+    HostTensor out = MakeF32(odims);
+    const float* wa = F32(*w);
+    float* oa = MutF32(&out);
+    for (size_t i = 0; i < ids.size(); ++i) {
+      int64_t id = ids[i];
+      if (padding_idx >= 0 && id == padding_idx) {
+        for (int64_t j = 0; j < dim; ++j) oa[i * dim + j] = 0.0f;
+        continue;
+      }
+      if (id < 0 || id >= rows) return "id out of range";
+      for (int64_t j = 0; j < dim; ++j) oa[i * dim + j] = wa[id * dim + j];
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // sum_op.cc role: elementwise sum of N same-shaped inputs.
+  std::string RunSum(const OpDesc& op, Scope* scope) {
+    auto it = op.inputs.find("X");
+    const std::string* on = OneName(op, "Out", false);
+    if (it == op.inputs.end() || it->second.empty() || on == nullptr) {
+      return "missing io";
+    }
+    HostTensor out;
+    bool first = true;
+    for (const std::string& name : it->second) {
+      if (name.empty()) continue;
+      const HostTensor* x = scope->Find(name);
+      if (x == nullptr) return "input not in scope";
+      if (!IsF32(*x)) return "non-f32 dtype";
+      if (first) {
+        out = MakeF32(x->dims);
+        std::fill(MutF32(&out), MutF32(&out) + NumElements(out.dims), 0.0f);
+        first = false;
+      } else if (x->dims != out.dims) {
+        return "shape mismatch";
+      }
+      const float* xa = F32(*x);
+      float* oa = MutF32(&out);
+      int64_t n = NumElements(out.dims);
+      for (int64_t i = 0; i < n; ++i) oa[i] += xa[i];
+    }
+    if (first) return "no inputs";
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // Per-row valid lengths: the optional Length input of the padded
+  // sequence design (clamped to [0, T]); full T when absent.
+  static std::string RowLengths(const OpDesc& op, Scope* scope, int64_t b,
+                                int64_t t, std::vector<int64_t>* lens) {
+    lens->assign(b, t);
+    const std::string* ln = OneName(op, "Length");
+    if (ln == nullptr) return "";
+    const HostTensor* lt = scope->Find(*ln);
+    if (lt == nullptr) return "Length not in scope";
+    std::vector<int64_t> raw;
+    std::string err = ReadIds(*lt, &raw);
+    if (!err.empty()) return err;
+    if (static_cast<int64_t>(raw.size()) != b) return "Length size mismatch";
+    for (int64_t i = 0; i < b; ++i) {
+      (*lens)[i] = std::max<int64_t>(0, std::min(raw[i], t));
+    }
+    return "";
+  }
+
+  // sequence_pool_op.cc role over the padded [B, T, D] layout
+  // (ops/sequence_ops.py _lower_sequence_pool semantics, incl. the
+  // len>=1 clamp for AVERAGE/SQRT and -1e38 fill for empty MAX rows).
+  std::string RunSequencePool(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.size() != 3) return "need f32 [B,T,D]";
+    int64_t b = x->dims[0], t = x->dims[1], d = x->dims[2];
+    std::vector<int64_t> lens;
+    std::string err = RowLengths(op, scope, b, t, &lens);
+    if (!err.empty()) return err;
+    std::string ptype = StrAttr(op, "pooltype", "AVERAGE");
+    for (char& c : ptype) c = std::toupper(c);
+    if (ptype != "MAX" && ptype != "LAST" && ptype != "FIRST" &&
+        ptype != "SUM" && ptype != "AVERAGE" && ptype != "SQRT") {
+      return "unknown pooltype " + ptype;
+    }
+    HostTensor out = MakeF32({b, d});
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t i = 0; i < b; ++i) {
+      int64_t len = lens[i];
+      for (int64_t j = 0; j < d; ++j) {
+        float v = 0.0f;
+        if (ptype == "MAX") {
+          v = -1e38f;
+          for (int64_t s = 0; s < len; ++s) {
+            v = std::max(v, xa[(i * t + s) * d + j]);
+          }
+        } else if (ptype == "LAST") {
+          // zero-length rows clamp to index 0 (XLA: max(len-1, 0))
+          v = xa[(i * t + std::max<int64_t>(len - 1, 0)) * d + j];
+        } else if (ptype == "FIRST") {
+          // FIRST ignores the mask entirely (XLA: x[:, 0])
+          v = xa[(i * t + 0) * d + j];
+        } else {  // SUM / AVERAGE / SQRT
+          for (int64_t s = 0; s < len; ++s) v += xa[(i * t + s) * d + j];
+          float denom = static_cast<float>(std::max<int64_t>(len, 1));
+          if (ptype == "AVERAGE") v /= denom;
+          if (ptype == "SQRT") v /= std::sqrt(denom);
+        }
+        oa[i * d + j] = v;
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  static float Sigmoid(float v) { return 1.0f / (1.0f + std::exp(-v)); }
+
+  static std::function<float(float)> ActFn(const std::string& name,
+                                           bool* ok) {
+    *ok = true;
+    if (name == "sigmoid") return [](float v) { return Sigmoid(v); };
+    if (name == "tanh") return [](float v) { return std::tanh(v); };
+    if (name == "relu") return [](float v) { return std::max(0.0f, v); };
+    if (name == "identity") return [](float v) { return v; };
+    *ok = false;
+    return [](float v) { return v; };
+  }
+
+  // lstm_op.cc role over the padded layout (same recurrence as
+  // ops/rnn_ops.py _lower_dynamic_lstm): Input [B,T,4D] pre-projected
+  // gates, Weight [D,4D] recurrent matrix, Bias [4D] (+[3D] peephole
+  // diagonals), gate order i,f,c,o; masked steps carry h/c through.
+  std::string RunDynamicLstm(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "Input");
+    const std::string* wn = OneName(op, "Weight");
+    const std::string* hn = OneName(op, "Hidden", false);
+    const std::string* cn = OneName(op, "Cell", false);
+    if (xn == nullptr || wn == nullptr || hn == nullptr) return "missing io";
+    if (OneName(op, "H0") != nullptr || OneName(op, "C0") != nullptr) {
+      // zero initial state only; error rather than silently diverging
+      // from the XLA lowering's H0/C0 handling
+      return "H0/C0 initial state not supported";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* w = scope->Find(*wn);
+    if (x == nullptr || w == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*w)) return "non-f32 dtype";
+    if (x->dims.size() != 3 || w->dims.size() != 2) return "bad ranks";
+    int64_t b = x->dims[0], t = x->dims[1], d = w->dims[0];
+    if (x->dims[2] != 4 * d || w->dims[1] != 4 * d) return "gate dims";
+    bool peephole = IntAttr(op, "use_peepholes", 1) != 0;
+    bool reverse = IntAttr(op, "is_reverse", 0) != 0;
+    bool ok1 = true, ok2 = true, ok3 = true;
+    auto gate_act = ActFn(StrAttr(op, "gate_activation", "sigmoid"), &ok1);
+    auto cell_act = ActFn(StrAttr(op, "cell_activation", "tanh"), &ok2);
+    auto cand_act = ActFn(StrAttr(op, "candidate_activation", "tanh"), &ok3);
+    if (!ok1 || !ok2 || !ok3) return "unsupported activation";
+
+    const float* bias = nullptr;
+    const std::string* bn = OneName(op, "Bias");
+    if (bn != nullptr) {
+      const HostTensor* bt = scope->Find(*bn);
+      if (bt == nullptr) return "Bias not in scope";
+      if (!IsF32(*bt)) return "non-f32 bias";
+      int64_t need = peephole ? 7 * d : 4 * d;
+      if (NumElements(bt->dims) < need) return "bias too small";
+      bias = F32(*bt);
+    }
+    std::vector<int64_t> lens;
+    std::string err = RowLengths(op, scope, b, t, &lens);
+    if (!err.empty()) return err;
+
+    HostTensor hidden = MakeF32({b, t, d});
+    HostTensor cell = MakeF32({b, t, d});
+    const float* xa = F32(*x);
+    const float* wa = F32(*w);
+    float* ha = MutF32(&hidden);
+    float* ca = MutF32(&cell);
+    std::vector<float> h(b * d, 0.0f), c(b * d, 0.0f), gates(4 * d);
+    for (int64_t step = 0; step < t; ++step) {
+      int64_t s = reverse ? t - 1 - step : step;
+      for (int64_t i = 0; i < b; ++i) {
+        // padded-step semantics: beyond the row's length, carry state
+        // through and emit it unchanged (matches the XLA mask)
+        bool valid = s < lens[i];
+        const float* xrow = xa + (i * t + s) * 4 * d;
+        float* hrow = h.data() + i * d;
+        float* crow = c.data() + i * d;
+        if (valid) {
+          for (int64_t g = 0; g < 4 * d; ++g) {
+            float acc = xrow[g] + (bias != nullptr ? bias[g] : 0.0f);
+            for (int64_t k = 0; k < d; ++k) {
+              acc += hrow[k] * wa[k * 4 * d + g];
+            }
+            gates[g] = acc;
+          }
+          for (int64_t k = 0; k < d; ++k) {
+            float gi = gates[0 * d + k];
+            float gf = gates[1 * d + k];
+            float gc = gates[2 * d + k];
+            float go = gates[3 * d + k];
+            if (peephole && bias != nullptr) {
+              gi += crow[k] * bias[4 * d + k];
+              gf += crow[k] * bias[5 * d + k];
+            }
+            float iv = gate_act(gi);
+            float fv = gate_act(gf);
+            float cv = fv * crow[k] + iv * cand_act(gc);
+            if (peephole && bias != nullptr) go += cv * bias[6 * d + k];
+            float ov = gate_act(go);
+            crow[k] = cv;
+            hrow[k] = ov * cell_act(cv);
+          }
+        }
+        for (int64_t k = 0; k < d; ++k) {
+          ha[(i * t + s) * d + k] = hrow[k];
+          ca[(i * t + s) * d + k] = crow[k];
+        }
+      }
+    }
+    scope->Set(*hn, std::move(hidden));
+    if (cn != nullptr) scope->Set(*cn, std::move(cell));
     return "";
   }
 

@@ -119,7 +119,12 @@ class Predictor(object):
             if not isinstance(inputs, dict):
                 inputs = dict(zip(self._feed_names, inputs))
             for name, val in inputs.items():
-                nscope.set(name, np.asarray(val, np.float32))
+                arr = np.asarray(val)
+                # floats run f32 in the reference interpreter; integer
+                # feeds (ids, lengths) keep their integer dtype
+                if arr.dtype.kind == "f" and arr.dtype != np.float32:
+                    arr = arr.astype(np.float32)
+                nscope.set(name, arr)
             rc = lib.ptpu_interp_run(prog, nscope._h, 0)
             if rc != 0:
                 raise RuntimeError(native.last_error())

@@ -134,3 +134,42 @@ def test_demo_predictor_rejects_garbage(tmp_path):
         [binary, str(tmp_path), "nope.npy", "out.npy"],
         capture_output=True, text=True, timeout=60)
     assert res.returncode != 0
+
+
+def _lstm_classifier():
+    """Sequence classifier: embedding -> fc(4H) -> dynamic_lstm ->
+    max-pool over time -> softmax head (the stacked_lstm book family)."""
+    words = fluid.layers.data("words", [12], dtype="int64")
+    length = fluid.layers.data("length", [1], dtype="int64")
+    emb = fluid.layers.embedding(words, size=[50, 16])
+    proj = fluid.layers.fc(emb, size=4 * 16, num_flatten_dims=2)
+    hidden, _cell = fluid.layers.dynamic_lstm(
+        input=proj, size=4 * 16, length=length)
+    pooled = fluid.layers.sequence_pool(hidden, "max", length=length)
+    out = fluid.layers.fc(pooled, 4, act="softmax")
+    return ["words", "length"], out
+
+
+def test_native_interp_runs_lstm_classifier(tmp_path):
+    """The C++ interpreter executes the sequence-model op family
+    (lookup_table, dynamic_lstm, sequence_pool, sum) with integer feeds,
+    matching the XLA path."""
+    rng = np.random.RandomState(11)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feeds, fetch = _lstm_classifier()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {
+        "words": rng.randint(0, 50, (3, 12)).astype("int64"),
+        "length": np.asarray([[12], [7], [1]], "int64"),
+    }
+    test_prog = main.clone(for_test=True)
+    (want,) = exe.run(test_prog, feed=feed, fetch_list=[fetch])
+    path = str(tmp_path / "model")
+    fluid.io.save_inference_model(path, feeds, [fetch], exe,
+                                  main_program=main)
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=path, use_tpu=False))
+    got = predictor.run_native_reference(feed)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4, atol=1e-5)
