@@ -118,12 +118,20 @@ class Predictor(object):
                     nscope.set(name, np.asarray(val))
             if not isinstance(inputs, dict):
                 inputs = dict(zip(self._feed_names, inputs))
+            feed_dtypes = {
+                v.name: str(v.dtype)
+                for v in self._program.global_block().vars.values()
+            }
             for name, val in inputs.items():
                 arr = np.asarray(val)
-                # floats run f32 in the reference interpreter; integer
-                # feeds (ids, lengths) keep their integer dtype
-                if arr.dtype.kind == "f" and arr.dtype != np.float32:
+                # the feed var's DECLARED dtype decides: float vars run
+                # f32 in the reference interpreter (so int/py-list feeds
+                # still work), integer vars (ids, lengths) keep ints
+                want = feed_dtypes.get(name, "float32")
+                if want in ("float32", "float64"):
                     arr = arr.astype(np.float32)
+                elif arr.dtype.kind == "f":
+                    arr = arr.astype(want)
                 nscope.set(name, arr)
             rc = lib.ptpu_interp_run(prog, nscope._h, 0)
             if rc != 0:

@@ -14,7 +14,7 @@ from paddle_tpu.layer_helper import LayerHelper
 
 __all__ = ["data", "py_reader", "double_buffer", "read_file", "batch",
            "shuffle", "random_data_generator", "open_recordio_file",
-           "open_files"]
+           "open_files", "Preprocessor"]
 
 
 def data(name, shape, dtype="float32", lod_level=0, type=VarType.LOD_TENSOR,
@@ -287,3 +287,61 @@ def open_files(filenames, shapes, dtypes, thread_num=1, buffer_size=None,
         reader.decorate_paddle_readers(
             [make_source(s) for s in shards], passes=pass_num)
     return reader
+
+
+class Preprocessor(object):
+    """In-graph reader preprocessing (layers/io.py Preprocessor parity).
+
+    The reference builds a separate sub-block executed by a
+    create_custom_reader op; here the transform layers are ordinary ops
+    in the main block operating on the reader's output vars (the XLA
+    program fuses them with the model), so ``block()`` only brackets the
+    definition and validates the protocol.
+
+    Usage::
+
+        pre = fluid.layers.Preprocessor(reader=py_reader_obj)
+        with pre.block():
+            img, label = pre.inputs()
+            pre.outputs(fluid.layers.scale(img, 1. / 255), label)
+        img, label = pre()
+    """
+
+    def __init__(self, reader, name=None):
+        self._reader = reader
+        self._outputs = None
+        self._in_block = False
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            self._in_block = True
+            try:
+                yield self
+            finally:
+                self._in_block = False
+            # only after a clean exit: an exception from user code inside
+            # the block must propagate, not be masked by this check
+            if self._outputs is None:
+                raise RuntimeError(
+                    "Preprocessor.block() ended without outputs(); "
+                    "call pre.outputs(...) inside the block")
+
+        return guard()
+
+    def inputs(self):
+        if not self._in_block:
+            raise RuntimeError("Preprocessor.inputs() outside block()")
+        return read_file(self._reader)
+
+    def outputs(self, *outs):
+        if not self._in_block:
+            raise RuntimeError("Preprocessor.outputs() outside block()")
+        self._outputs = list(outs)
+
+    def __call__(self):
+        if self._outputs is None:
+            raise RuntimeError("Preprocessor was never defined via block()")
+        return self._outputs

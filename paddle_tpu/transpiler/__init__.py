@@ -22,3 +22,6 @@ from paddle_tpu.transpiler.amp_transpiler import (  # noqa: F401
 from paddle_tpu.transpiler.inference_transpiler import (  # noqa: F401
     InferenceTranspiler,
 )
+from paddle_tpu.transpiler.quantize_transpiler import (  # noqa: F401
+    QuantizeTranspiler,
+)

@@ -1,0 +1,200 @@
+"""Quantization-aware-training transpiler.
+
+Capability parity with the reference's contrib QuantizeTranspiler
+(``python/paddle/fluid/contrib/quantize/quantize_transpiler.py``: insert
+fake_quantize/fake_dequantize pairs around the quantizable ops for QAT,
+then freeze for deployment), redesigned TPU-first:
+
+* ``training_transpile`` runs BEFORE ``optimizer.minimize``: gradients are
+  then synthesized from the quantized forward graph by the vjp-based grad
+  makers, so the straight-through estimator flows automatically — no
+  backward-op input-renaming pass (the reference needs one because its
+  backward ops already exist).
+* the running activation scale of ``range_abs_max`` is a persistable
+  state var updated in-graph (OutScale aliased onto InScale, the
+  batch-norm running-stats idiom) instead of a host-managed window
+  buffer.
+* ``freeze_program`` folds the QAT error into the weights (each quantized
+  weight is snapped to its round(w/s * Q)/Q * s grid) and strips the fake
+  ops: the deploy program is a plain float program that computes exactly
+  what the quantized model computes, which is the right target when the
+  deploy compiler is XLA (there is no int8 CPU kernel zoo to feed;
+  BASELINE int8 serving is out of the TPU deployment model). The
+  weight scales are returned for toolchains that want the int8 tensors.
+"""
+
+import numpy as np
+
+__all__ = ["QuantizeTranspiler"]
+
+_QUANTIZABLE_OP_TYPES = ("conv2d", "depthwise_conv2d", "mul")
+
+
+def _quantized_name(name):
+    return "%s.quantized" % name
+
+
+def _dequantized_name(name):
+    return "%s.dequantized" % name
+
+
+def _scale_name(name):
+    return "%s.scale" % name
+
+
+class QuantizeTranspiler(object):
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        if activation_quantize_type not in ("abs_max", "range_abs_max"):
+            raise ValueError(
+                "unknown activation_quantize_type %r"
+                % (activation_quantize_type,))
+        if weight_quantize_type != "abs_max":
+            raise ValueError(
+                "weights quantize per-batch abs_max (their value IS the "
+                "batch); got %r" % (weight_quantize_type,))
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.window_size = window_size
+
+    # -- training ----------------------------------------------------------
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake quant->dequant pairs on every input of the
+        quantizable ops. Call BEFORE optimizer.minimize (the backward
+        graph is then generated from the quantized forward)."""
+        from paddle_tpu import framework
+
+        program = program or framework.default_main_program()
+        startup_program = (startup_program
+                           or framework.default_startup_program())
+        block = program.global_block()
+        params = {p.name for p in block.all_parameters()}
+        dequanted = {}  # var name -> dequantized var name
+
+        idx = 0
+        while idx < len(block.ops):
+            op = block.ops[idx]
+            if op.type not in _QUANTIZABLE_OP_TYPES:
+                idx += 1
+                continue
+            for slot, names in list(op.inputs.items()):
+                new_names = []
+                for name in names:
+                    var = block.var(name)
+                    if str(var.dtype) not in ("float32", "float64"):
+                        new_names.append(name)
+                        continue
+                    if name not in dequanted:
+                        is_weight = name in params
+                        bits = (self.weight_bits if is_weight
+                                else self.activation_bits)
+                        qtype = ("abs_max" if is_weight
+                                 else self.activation_quantize_type)
+                        inserted = self._insert_quant_dequant(
+                            block, startup_program, idx, name, var, bits,
+                            qtype)
+                        idx += inserted
+                        dequanted[name] = _dequantized_name(name)
+                    new_names.append(dequanted[name])
+                op.inputs[slot] = new_names
+            idx += 1
+        program._bump_version()
+        return program
+
+    def _insert_quant_dequant(self, block, startup_program, idx, name, var,
+                              bits, qtype):
+        """Insert (at op index idx) the quantize + dequantize ops for
+        `name`; returns how many ops were inserted."""
+        quant_var = block.create_var(
+            name=_quantized_name(name), shape=var.shape, dtype=var.dtype)
+        scale_var = block.create_var(
+            name=_scale_name(name), shape=[1], dtype="float32")
+        dequant_var = block.create_var(
+            name=_dequantized_name(name), shape=var.shape, dtype=var.dtype)
+        max_range = float((1 << (bits - 1)) - 1)
+        if qtype == "abs_max":
+            block.insert_op(
+                idx,
+                type="fake_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [quant_var.name],
+                         "OutScale": [scale_var.name]},
+                attrs={"bit_length": bits},
+            )
+        else:  # range_abs_max: persistable running scale, updated in-graph
+            state = block.create_var(
+                name="%s.state" % _scale_name(name), shape=[1],
+                dtype="float32", persistable=True)
+            sb = startup_program.global_block()
+            if not sb.has_var(state.name):
+                sv = sb.create_var(name=state.name, shape=[1],
+                                   dtype="float32", persistable=True)
+                from paddle_tpu import initializer
+
+                initializer.ConstantInitializer(1e-3)(sv, sb)
+            block.insert_op(
+                idx,
+                type="fake_quantize_range_abs_max",
+                inputs={"X": [name], "InScale": [state.name]},
+                outputs={"Out": [quant_var.name],
+                         # alias onto the state var: running-stats idiom
+                         "OutScale": [state.name]},
+                attrs={"bit_length": bits,
+                       "window_size": self.window_size},
+            )
+            scale_var = state
+        block.insert_op(
+            idx + 1,
+            type="fake_dequantize_max_abs",
+            inputs={"X": [quant_var.name], "Scale": [scale_var.name]},
+            outputs={"Out": [dequant_var.name]},
+            attrs={"max_range": max_range},
+        )
+        return 2
+
+    # -- deployment --------------------------------------------------------
+    def freeze_program(self, program, scope=None):
+        """Strip the fake quant/dequant ops for deployment and snap every
+        quantized WEIGHT in `scope` onto its int grid (round(w/s*Q)/Q*s),
+        so the plain float program computes the quantized model exactly.
+        Returns {weight name: scale} for int8 export tooling."""
+        from paddle_tpu import framework
+        from paddle_tpu.executor import global_scope
+
+        scope = scope or global_scope()
+        block = program.global_block()
+        params = {p.name for p in block.all_parameters()}
+        scales = {}
+
+        # undo the input rewiring and drop the fake ops (incl. any _grad
+        # twins, for programs frozen after minimize)
+        keep = []
+        for op in block.ops:
+            if op.type.startswith("fake_quantize") or \
+                    op.type.startswith("fake_dequantize"):
+                continue
+            for slot, names in list(op.inputs.items()):
+                op.inputs[slot] = [
+                    n[:-len(".dequantized")] if n.endswith(".dequantized")
+                    else n
+                    for n in names
+                ]
+            keep.append(op)
+        block.ops[:] = keep
+
+        # snap weights
+        q = float((1 << (self.weight_bits - 1)) - 1)
+        for name in sorted(params):
+            if not block.has_var(_quantized_name(name)):
+                continue
+            val = scope.get_value(name)
+            if val is None:
+                continue
+            w = np.asarray(val, np.float32)
+            s = float(np.max(np.abs(w))) or 1e-8
+            scope.set_value(name, np.round(w / s * q) / q * s)
+            scales[name] = s
+        program._bump_version()
+        return scales

@@ -146,7 +146,10 @@ def _lstm_classifier():
     hidden, _cell = fluid.layers.dynamic_lstm(
         input=proj, size=4 * 16, length=length)
     pooled = fluid.layers.sequence_pool(hidden, "max", length=length)
-    out = fluid.layers.fc(pooled, 4, act="softmax")
+    avg = fluid.layers.sequence_pool(hidden, "average", length=length)
+    # two-input fc emits a real sum op (nn.py fc multi-input path), so
+    # the interpreter's RunSum is exercised too
+    out = fluid.layers.fc([pooled, avg], 4, act="softmax")
     return ["words", "length"], out
 
 

@@ -1,0 +1,144 @@
+"""QuantizeTranspiler tests (contrib/quantize/quantize_transpiler.py
+capability): QAT graph rewriting, running activation scales, convergence
+through the straight-through gradients, and deploy freezing.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler import QuantizeTranspiler
+
+
+def _build_convnet():
+    img = fluid.layers.data("img", [1, 8, 8])
+    label = fluid.layers.data("label", [1], dtype="int64")
+    c = fluid.layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                            act="relu")
+    logits = fluid.layers.fc(fluid.layers.flatten(c), size=3)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    return img, label, logits, loss
+
+
+def _batch(rng, bs=8):
+    y = rng.randint(0, 3, (bs, 1)).astype("int64")
+    x = np.zeros((bs, 1, 8, 8), "float32")
+    for i, l in enumerate(y[:, 0]):
+        x[i, 0, int(l) * 2:(int(l) + 1) * 2, :] = 1.0
+    x += 0.1 * rng.rand(bs, 1, 8, 8)
+    return x.astype("float32"), y
+
+
+def test_training_transpile_inserts_pairs_and_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        img, label, logits, loss = _build_convnet()
+        QuantizeTranspiler().training_transpile(main, startup)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    # conv input + conv weight + two mul inputs (fc) at minimum
+    assert types.count("fake_quantize_abs_max") >= 4
+    assert types.count("fake_dequantize_max_abs") >= 4
+    # the conv now consumes the dequantized tensors
+    conv = next(op for op in main.global_block().ops if op.type == "conv2d")
+    assert all(n.endswith(".dequantized")
+               for n in conv.inputs["Input"] + conv.inputs["Filter"])
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    first = last = None
+    for _ in range(60):
+        x, y = _batch(rng)
+        (lv,) = exe.run(main, feed={"img": x, "label": y},
+                        fetch_list=[loss])
+        last = float(np.asarray(lv).ravel()[0])
+        if first is None:
+            first = last
+    assert last < first * 0.3, (first, last)
+
+
+def test_range_abs_max_scale_state_grows():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.fc(x, size=2)
+        QuantizeTranspiler(
+            activation_quantize_type="range_abs_max"
+        ).training_transpile(main, startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    state_names = [n for n in main.global_block().vars
+                   if n.endswith(".scale.state")]
+    assert state_names, "no running-scale state var created"
+    exe.run(main, feed={"x": np.full((2, 4), 3.0, "float32")},
+            fetch_list=[y])
+    s1 = float(np.asarray(
+        fluid.global_scope().get_value(state_names[0])).ravel()[0])
+    assert abs(s1 - 3.0) < 1e-5  # grew from 1e-3 to the batch abs-max
+    # a smaller batch must NOT shrink the running max
+    exe.run(main, feed={"x": np.full((2, 4), 1.0, "float32")},
+            fetch_list=[y])
+    s2 = float(np.asarray(
+        fluid.global_scope().get_value(state_names[0])).ravel()[0])
+    assert abs(s2 - 3.0) < 1e-5
+
+
+def test_freeze_program_strips_fakes_and_snaps_weights():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        img, label, logits, loss = _build_convnet()
+        qt = QuantizeTranspiler()
+        qt.training_transpile(main, startup)
+        # reference contract: clone(for_test) BEFORE minimize (clone does
+        # not prune; framework.py clone docstring)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    for _ in range(20):
+        x, y = _batch(rng)
+        exe.run(main, feed={"img": x, "label": y}, fetch_list=[loss])
+
+    x, y = _batch(rng, bs=4)
+    (qat_out,) = exe.run(test_prog, feed={"img": x, "label": y},
+                         fetch_list=[logits])
+
+    scales = qt.freeze_program(test_prog)
+    assert scales, "no weights were snapped"
+    types = [op.type for op in test_prog.global_block().ops]
+    assert not any(t.startswith("fake_") for t in types)
+    (frozen_out,) = exe.run(test_prog, feed={"img": x, "label": y},
+                            fetch_list=[logits])
+    # the frozen float program reproduces the QAT activations up to the
+    # activation-quantization noise removed by freezing
+    np.testing.assert_allclose(np.asarray(frozen_out), np.asarray(qat_out),
+                               rtol=0.15, atol=0.15)
+
+
+def test_preprocessor_in_graph():
+    """layers.Preprocessor: reader outputs transformed in-graph."""
+    import paddle_tpu.layers as layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(
+            capacity=4, shapes=[[-1, 4]], dtypes=["float32"],
+            use_double_buffer=False)
+        pre = layers.Preprocessor(reader=reader)
+        with pre.block():
+            (x,) = pre.inputs()
+            pre.outputs(fluid.layers.scale(x, scale=0.5))
+        (scaled,) = pre()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    reader.decorate_paddle_reader(
+        lambda: iter([(np.full((2, 4), 8.0, "float32"),)]))
+    reader.start()
+    (out,) = exe.run(main, feed=reader.next_feed(), fetch_list=[scaled])
+    np.testing.assert_allclose(np.asarray(out), np.full((2, 4), 4.0))
