@@ -60,6 +60,12 @@ class Predictor(object):
         place = fluid.TPUPlace() if config.use_tpu else fluid.CPUPlace()
         self._exe = fluid.Executor(place)
         self._lock = threading.Lock()
+        # feed name -> declared dtype, fixed at load time (used by
+        # run_native_reference's cast policy)
+        gvars = self._program.global_block().vars
+        self._feed_dtypes = {
+            n: str(gvars[n].dtype) for n in self._feed_names if n in gvars
+        }
 
     def run(self, inputs):
         """inputs: dict feed-name -> ndarray, or list matching the saved
@@ -118,18 +124,14 @@ class Predictor(object):
                     nscope.set(name, np.asarray(val))
             if not isinstance(inputs, dict):
                 inputs = dict(zip(self._feed_names, inputs))
-            feed_dtypes = {
-                v.name: str(v.dtype)
-                for v in self._program.global_block().vars.values()
-            }
             for name, val in inputs.items():
                 arr = np.asarray(val)
                 # the feed var's DECLARED dtype decides: float vars run
                 # f32 in the reference interpreter (so int/py-list feeds
                 # still work), integer vars (ids, lengths) keep ints
-                want = feed_dtypes.get(name, "float32")
+                want = self._feed_dtypes.get(name, "float32")
                 if want in ("float32", "float64"):
-                    arr = arr.astype(np.float32)
+                    arr = arr.astype(np.float32, copy=False)
                 elif arr.dtype.kind == "f":
                     arr = arr.astype(want)
                 nscope.set(name, arr)

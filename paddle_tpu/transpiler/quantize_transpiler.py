@@ -69,10 +69,15 @@ class QuantizeTranspiler(object):
         program = program or framework.default_main_program()
         startup_program = (startup_program
                            or framework.default_startup_program())
-        block = program.global_block()
-        params = {p.name for p in block.all_parameters()}
-        dequanted = {}  # var name -> dequantized var name
+        params = {p.name
+                  for p in program.global_block().all_parameters()}
+        for block in program.blocks:  # sub-blocks (while/cond bodies) too
+            self._transpile_block(block, startup_program, params)
+        program._bump_version()
+        return program
 
+    def _transpile_block(self, block, startup_program, params):
+        dequanted = {}  # var name -> dequantized var name (this block)
         idx = 0
         while idx < len(block.ops):
             op = block.ops[idx]
@@ -100,8 +105,6 @@ class QuantizeTranspiler(object):
                     new_names.append(dequanted[name])
                 op.inputs[slot] = new_names
             idx += 1
-        program._bump_version()
-        return program
 
     def _insert_quant_dequant(self, block, startup_program, idx, name, var,
                               bits, qtype):
@@ -109,12 +112,12 @@ class QuantizeTranspiler(object):
         `name`; returns how many ops were inserted."""
         quant_var = block.create_var(
             name=_quantized_name(name), shape=var.shape, dtype=var.dtype)
-        scale_var = block.create_var(
-            name=_scale_name(name), shape=[1], dtype="float32")
         dequant_var = block.create_var(
             name=_dequantized_name(name), shape=var.shape, dtype=var.dtype)
         max_range = float((1 << (bits - 1)) - 1)
         if qtype == "abs_max":
+            scale_var = block.create_var(
+                name=_scale_name(name), shape=[1], dtype="float32")
             block.insert_op(
                 idx,
                 type="fake_quantize_abs_max",
@@ -159,21 +162,32 @@ class QuantizeTranspiler(object):
         """Strip the fake quant/dequant ops for deployment and snap every
         quantized WEIGHT in `scope` onto its int grid (round(w/s*Q)/Q*s),
         so the plain float program computes the quantized model exactly.
+        Only inference programs may be frozen (the for_test clone taken
+        before minimize, or a loaded inference model): removing the fake
+        ops from a training graph would sever its gradient chain.
         Returns {weight name: scale} for int8 export tooling."""
         from paddle_tpu import framework
         from paddle_tpu.executor import global_scope
 
         scope = scope or global_scope()
         block = program.global_block()
+        for op in block.ops:
+            role = op.attrs.get(framework.OP_ROLE_ATTR_NAME, 0)
+            if role & (framework.OpRole.Backward | framework.OpRole.Optimize):
+                raise ValueError(
+                    "freeze_program: program contains backward/optimizer "
+                    "ops; freeze the clone(for_test=True) taken before "
+                    "minimize instead")
         params = {p.name for p in block.all_parameters()}
         scales = {}
 
-        # undo the input rewiring and drop the fake ops (incl. any _grad
-        # twins, for programs frozen after minimize)
+        # undo the input rewiring and drop the fake ops + their dead vars
         keep = []
+        dead_vars = set()
         for op in block.ops:
             if op.type.startswith("fake_quantize") or \
                     op.type.startswith("fake_dequantize"):
+                dead_vars.update(op.output_arg_names())
                 continue
             for slot, names in list(op.inputs.items()):
                 op.inputs[slot] = [
@@ -184,10 +198,10 @@ class QuantizeTranspiler(object):
             keep.append(op)
         block.ops[:] = keep
 
-        # snap weights
+        # snap weights (identified by their now-dead .quantized twins)
         q = float((1 << (self.weight_bits - 1)) - 1)
         for name in sorted(params):
-            if not block.has_var(_quantized_name(name)):
+            if _quantized_name(name) not in dead_vars:
                 continue
             val = scope.get_value(name)
             if val is None:
@@ -196,5 +210,12 @@ class QuantizeTranspiler(object):
             s = float(np.max(np.abs(w))) or 1e-8
             scope.set_value(name, np.round(w / s * q) / q * s)
             scales[name] = s
+
+        for name in dead_vars:
+            # running-scale STATE survives (it is real trained state a
+            # later int8 exporter reads); pure wiring vars are dropped
+            if name.endswith(".scale.state"):
+                continue
+            block.vars.pop(name, None)
         program._bump_version()
         return scales

@@ -165,3 +165,29 @@ def test_create_random_int_lodtensor():
     arr = t.numpy()
     assert arr.min() >= 1 and arr.max() <= 9
     assert arr.dtype == np.int64
+
+
+def test_contrib_memory_usage_and_op_freq():
+    """contrib utilities: memory band estimate + op frequency report
+    (contrib/memory_usage_calc.py, contrib/op_frequence.py roles)."""
+    from paddle_tpu import contrib
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [256])
+        h = fluid.layers.fc(x, 128, act="relu")
+        h = fluid.layers.fc(h, 128, act="relu")
+        loss = fluid.layers.mean(h)
+    low, high, unit = contrib.memory_usage(main, batch_size=64)
+    assert 0 < low < high and unit in ("B", "KB", "MB", "GB")
+    # doubling the batch cannot shrink the estimate
+    low2, high2, unit2 = contrib.memory_usage(main, batch_size=128)
+    bytes_for = {"B": 1, "KB": 2**10, "MB": 2**20, "GB": 2**30}
+    assert high2 * bytes_for[unit2] > high * bytes_for[unit]
+
+    uni, pairs = contrib.op_freq_statis(main)
+    assert uni["mul"] == 2 and uni["relu"] == 2
+    assert pairs.get("elementwise_add->relu") == 2  # fc bias -> act chain
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        contrib.memory_usage("not a program", 4)

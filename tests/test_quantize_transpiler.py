@@ -142,3 +142,18 @@ def test_preprocessor_in_graph():
     reader.start()
     (out,) = exe.run(main, feed=reader.next_feed(), fetch_list=[scaled])
     np.testing.assert_allclose(np.asarray(out), np.full((2, 4), 4.0))
+
+
+def test_freeze_rejects_training_program():
+    """Freezing a program that still carries backward/optimizer ops must
+    fail loudly (it would sever the gradient chain)."""
+    import pytest
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, logits, loss = _build_convnet()
+        qt = QuantizeTranspiler()
+        qt.training_transpile(main, startup)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    with pytest.raises(ValueError, match="backward/optimizer"):
+        qt.freeze_program(main)
