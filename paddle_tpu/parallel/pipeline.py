@@ -16,10 +16,12 @@ to the mesh's reserved "pipe" axis (parallel/mesh.py):
   pipeline comes for free from jax.grad (reverse ppermute direction,
   reverse tick order — exactly GPipe's B-phase).
 
-Activations are fed replicated and outputs are stage-stacked; per-device
-activation memory is O(batch), parameter memory O(params / S). This is the
-capability layer (like ring_attention): models wire it explicitly; the
-Program-level front-end keeps dp/tp/ZeRO shardings via ParallelExecutor.
+Activations are fed replicated by default, or batch-sharded over a second
+mesh axis (``batch_axis``, pipeline x data parallel); outputs are
+stage-stacked. Per-device activation memory is O(local batch), parameter
+memory O(params / S). This is the capability layer (like ring_attention):
+models wire it explicitly; the Program-level front-end keeps dp/tp/ZeRO
+shardings via ParallelExecutor.
 """
 
 import functools
@@ -41,9 +43,10 @@ def stack_stage_params(stage_params_list):
 
 def _gpipe_shard(params, x, stage_fn, axis_name):
     """Per-device body. params leaves: [1, ...] (this stage's block);
-    x: [M, B, ...] microbatches (replicated). Returns [M, B, ...] — only
-    the LAST device's block holds the pipeline output; gpipe() slices it
-    out of the stage-stacked global result."""
+    x: [M, B_local, ...] microbatches (the full batch when replicated, a
+    batch shard under gpipe's batch_axis). Returns [M, B_local, ...] —
+    only the LAST device's block holds the pipeline output; gpipe()
+    slices it out of the stage-stacked global result."""
     n = jax.lax.psum(1, axis_name)
     d = jax.lax.axis_index(axis_name)
     local = jax.tree_util.tree_map(lambda l: l[0], params)
@@ -91,7 +94,8 @@ def _gpipe_shard(params, x, stage_fn, axis_name):
     return outbuf
 
 
-def gpipe(stage_fn, stage_params, x, mesh, axis_name="pipe"):
+def gpipe(stage_fn, stage_params, x, mesh, axis_name="pipe",
+          batch_axis=None):
     """Run x through S pipelined stages.
 
     Args:
@@ -102,9 +106,12 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name="pipe"):
         (see stack_stage_params); S must equal mesh.shape[axis_name].
       x: [M, B, ...] — M microbatches.
       mesh: jax.sharding.Mesh containing ``axis_name``.
+      batch_axis: optional second mesh axis to keep the microbatch batch
+        dim sharded over (pipeline x data parallel on a 2-D mesh). Without
+        it the activations are replicated across the other axes.
 
     Returns [M, B, ...]: the pipeline output, differentiable w.r.t. both
-    stage_params and x.
+    stage_params and x; with batch_axis it stays batch-sharded.
     """
     n = mesh.shape[axis_name]
     leaves = jax.tree_util.tree_leaves(stage_params)
@@ -121,13 +128,24 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name="pipe"):
     param_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name), stage_params
     )
+    if batch_axis is not None:
+        if batch_axis not in mesh.shape or batch_axis == axis_name:
+            raise ValueError(
+                "gpipe: batch_axis must name a mesh axis distinct from "
+                "the pipe axis %r; got %r (mesh axes: %s)"
+                % (axis_name, batch_axis, tuple(mesh.shape)))
+        x_spec = P(None, batch_axis)
+        out_spec = P(axis_name, batch_axis)
+    else:
+        x_spec = P()
+        out_spec = P(axis_name)
     fn = shard_map(
         functools.partial(
             _gpipe_shard, stage_fn=stage_fn, axis_name=axis_name
         ),
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(axis_name),
+        in_specs=(param_specs, x_spec),
+        out_specs=out_spec,
     )
     stacked = fn(stage_params, x)  # [S*M, B, ...], last block is real
     m = x.shape[0]
