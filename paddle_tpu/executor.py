@@ -54,6 +54,17 @@ def _as_feed_array(value, place):
     return np.asarray(value), None
 
 
+# Flags whose value changes what the block lowers TO (not just runtime
+# behavior); they join the executable cache key so toggling recompiles.
+_TRACE_FLAGS = ("use_pallas_lstm", "remat_gradients")
+
+
+def _trace_flags_key():
+    from paddle_tpu import flags
+
+    return tuple((n, flags.get(n)) for n in _TRACE_FLAGS)
+
+
 class Executor(object):
     def __init__(self, place=None):
         self.place = place if place is not None else TPUPlace()
@@ -77,6 +88,9 @@ class Executor(object):
             hash(frozenset(scope_names)),
             program._is_test,
             getattr(program, "_amp_dtype", None),
+            # trace-time flags alter the lowered computation; toggling one
+            # must recompile, not reuse the stale executable
+            _trace_flags_key(),
         )
         cp = self._cache.get(key)
         if cp is None:

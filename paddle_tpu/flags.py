@@ -34,6 +34,9 @@ _DEFS = {
     "rpc_deadline": (180000, int),
     # forced rematerialization for all grad ops (memory_optimize's lever)
     "remat_gradients": (False, bool),
+    # route dynamic_lstm through the fused Pallas recurrence kernel
+    # (kernels/lstm_cell.py); opt-in until measured on hardware
+    "use_pallas_lstm": (False, bool),
 }
 
 

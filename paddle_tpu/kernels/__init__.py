@@ -10,3 +10,7 @@ from paddle_tpu.kernels.flash_attention import (  # noqa: F401
     flash_attention,
     flash_attention_reference,
 )
+from paddle_tpu.kernels.lstm_cell import (  # noqa: F401
+    fused_lstm,
+    lstm_reference,
+)

@@ -1,0 +1,243 @@
+"""Fused LSTM recurrence as a Pallas TPU kernel.
+
+The reference computes LSTM as per-timestep CPU/CUDA kernels over packed
+LoD batches (lstm_op.cc); the XLA path here (ops/rnn_ops.py) is a
+lax.scan whose per-step gates tensor [B, 4D] round-trips through HBM
+between the matmul and the elementwise gate math. This kernel fuses the
+sequential part the way a TPU wants it:
+
+* the INPUT projection x @ W_x for all timesteps is left outside — it is
+  one big MXU matmul XLA already does at peak;
+* the kernel runs grid = (batch_blocks, T) with T innermost; h and c
+  live in VMEM scratch that persists across the T grid steps, so each
+  step does (h @ W_h on the MXU) + bias/peephole/gate math + state
+  update entirely in VMEM — the [B, 4D] gates tile never touches HBM;
+* masked (padded) steps carry state through, matching the padded-design
+  semantics of ops/rnn_ops.py.
+
+Forward is Pallas; backward is a custom_vjp recomputing through the XLA
+reference scan (identical math), like kernels/flash_attention.py. On CPU
+the kernel runs with interpret=True (tests); the public entry point picks
+the path per backend, and the dynamic_lstm op opts in via
+FLAGS_use_pallas_lstm (off by default until measured on hardware).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda v: v,
+}
+
+
+def lstm_reference(xw, w_h, bias, peephole, h0, c0, mask,
+                   gate_act="sigmoid", cell_act="tanh", cand_act="tanh"):
+    """XLA scan reference. xw: [B, T, 4D] pre-projected inputs (+bias NOT
+    added); w_h: [D, 4D]; bias: [4D]; peephole: None or (w_ic, w_fc,
+    w_oc) each [D]; h0/c0: [B, D]; mask: None or [B, T] (1 = valid).
+    Returns (hidden [B, T, D], cell [B, T, D])."""
+    ga = _ACTS[gate_act]
+    ca = _ACTS[cell_act]
+    na = _ACTS[cand_act]
+    d = w_h.shape[0]
+
+    xs = jnp.moveaxis(xw, 1, 0)  # [T, B, 4D]
+    ms = (jnp.moveaxis(mask, 1, 0)[:, :, None]
+          if mask is not None else None)
+
+    def step(carry, inp):
+        h, c = carry
+        if ms is None:
+            xt = inp
+            m = None
+        else:
+            xt, m = inp
+        gates = xt + h @ w_h + bias
+        gi, gf, gc, go = (gates[:, i * d:(i + 1) * d] for i in range(4))
+        if peephole is not None:
+            gi = gi + c * peephole[0]
+            gf = gf + c * peephole[1]
+        i_v = ga(gi)
+        f_v = ga(gf)
+        c_new = f_v * c + i_v * na(gc)
+        if peephole is not None:
+            go = go + c_new * peephole[2]
+        o_v = ga(go)
+        h_new = o_v * ca(c_new)
+        if m is not None:
+            h_new = h_new * m + h * (1.0 - m)
+            c_new = c_new * m + c * (1.0 - m)
+        return (h_new, c_new), (h_new, c_new)
+
+    inp = xs if ms is None else (xs, ms)
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), inp)
+    return jnp.moveaxis(hs, 0, 1), jnp.moveaxis(cs, 0, 1)
+
+
+def _lstm_kernel(xw_ref, wh_ref, b_ref, peep_ref, m_ref, h_out_ref,
+                 c_out_ref, h_ref, c_ref, *, d, gate_act, cell_act,
+                 cand_act, peephole):
+    """One (bi, t) grid step: advance the recurrence one timestep for one
+    batch block; h/c persist in VMEM scratch across the T steps."""
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+    ga = _ACTS[gate_act]
+    ca = _ACTS[cell_act]
+    na = _ACTS[cand_act]
+
+    @pl.when(t == 0)
+    def _init():
+        h_ref[:, :] = jnp.zeros_like(h_ref)
+        c_ref[:, :] = jnp.zeros_like(c_ref)
+
+    h = h_ref[:, :]
+    c = c_ref[:, :]
+    xt = xw_ref[:, 0, :].astype(jnp.float32)
+    gates = xt + jax.lax.dot_general(
+        h, wh_ref[:, :].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    ) + b_ref[0, :].astype(jnp.float32)
+    gi = gates[:, 0 * d:1 * d]
+    gf = gates[:, 1 * d:2 * d]
+    gc = gates[:, 2 * d:3 * d]
+    go = gates[:, 3 * d:4 * d]
+    if peephole:
+        gi = gi + c * peep_ref[0, :]
+        gf = gf + c * peep_ref[1, :]
+    i_v = ga(gi)
+    f_v = ga(gf)
+    c_new = f_v * c + i_v * na(gc)
+    if peephole:
+        go = go + c_new * peep_ref[2, :]
+    o_v = ga(go)
+    h_new = o_v * ca(c_new)
+    m = m_ref[:, 0:1].astype(jnp.float32)
+    h_new = h_new * m + h * (1.0 - m)
+    c_new = c_new * m + c * (1.0 - m)
+    h_ref[:, :] = h_new
+    c_ref[:, :] = c_new
+    h_out_ref[:, 0, :] = h_new.astype(h_out_ref.dtype)
+    c_out_ref[:, 0, :] = c_new.astype(c_out_ref.dtype)
+
+
+def _lstm_pallas_forward(xw, w_h, bias, peep_arr, has_peep, mask, gate_act,
+                         cell_act, cand_act, block_b, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t, d4 = xw.shape
+    d = w_h.shape[0]
+    block_b = min(block_b, b)
+    bp = -(-b // block_b) * block_b  # pad batch to the block multiple
+    if bp != b:
+        xw = jnp.pad(xw, ((0, bp - b), (0, 0), (0, 0)))
+    if mask is None:
+        m_arr = jnp.ones((bp, t), jnp.float32)
+    else:
+        m_arr = jnp.pad(mask.astype(jnp.float32), ((0, bp - b), (0, 0)))
+
+    kernel = functools.partial(
+        _lstm_kernel, d=d, gate_act=gate_act, cell_act=cell_act,
+        cand_act=cand_act, peephole=has_peep,
+    )
+    hidden, cell = pl.pallas_call(
+        kernel,
+        grid=(bp // block_b, t),
+        in_specs=[
+            pl.BlockSpec((block_b, 1, d4), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((d, d4), lambda i, t: (0, 0)),
+            pl.BlockSpec((1, d4), lambda i, t: (0, 0)),
+            pl.BlockSpec((3, d), lambda i, t: (0, 0)),
+            pl.BlockSpec((block_b, 1), lambda i, t: (i, t)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_b, 1, d), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((block_b, 1, d), lambda i, t: (i, t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, t, d), xw.dtype),
+            jax.ShapeDtypeStruct((bp, t, d), xw.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_b, d), jnp.float32),
+            pltpu.VMEM((block_b, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xw, w_h, jnp.reshape(bias, (1, d4)), peep_arr, m_arr)
+    return hidden[:b], cell[:b]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _fused(xw, w_h, bias, peep_arr, mask, has_peep, gate_act, cell_act,
+           cand_act, interpret):
+    return _lstm_pallas_forward(xw, w_h, bias, peep_arr, has_peep, mask,
+                                gate_act, cell_act, cand_act, 128,
+                                interpret)
+
+
+def _fused_fwd(xw, w_h, bias, peep_arr, mask, has_peep, gate_act, cell_act,
+               cand_act, interpret):
+    out = _fused(xw, w_h, bias, peep_arr, mask, has_peep, gate_act,
+                 cell_act, cand_act, interpret)
+    return out, (xw, w_h, bias, peep_arr, mask)
+
+
+def _fused_bwd(has_peep, gate_act, cell_act, cand_act, interpret, res, g):
+    xw, w_h, bias, peep_arr, mask = res
+
+    def ref(xw_, w_h_, bias_, peep_):
+        b, d = xw_.shape[0], w_h_.shape[0]
+        peephole = tuple(peep_) if has_peep else None
+        return lstm_reference(
+            xw_, w_h_, bias_, peephole,
+            jnp.zeros((b, d), xw_.dtype), jnp.zeros((b, d), xw_.dtype),
+            mask, gate_act, cell_act, cand_act,
+        )
+
+    _, vjp = jax.vjp(ref, xw, w_h, bias, peep_arr)
+    gxw, gwh, gb, gpeep = vjp(g)
+    return gxw, gwh, gb, gpeep, None
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_lstm(xw, w_h, bias, peephole=None, mask=None,
+               gate_act="sigmoid", cell_act="tanh", cand_act="tanh",
+               force_pallas=False, force_reference=False):
+    """Fused LSTM over pre-projected inputs.
+
+    xw: [B, T, 4D] (= x @ W_x, WITHOUT bias); w_h: [D, 4D]; bias: [4D];
+    peephole: optional (w_ic, w_fc, w_oc) each [D]; mask: optional [B, T]
+    validity. Returns (hidden, cell), each [B, T, D]; differentiable.
+    Pallas on TPU (interpret-mode when forced elsewhere), XLA scan
+    reference otherwise.
+    """
+    for name in (gate_act, cell_act, cand_act):
+        if name not in _ACTS:
+            raise ValueError("fused_lstm: unsupported activation %r" % name)
+    b, _, d4 = xw.shape
+    d = w_h.shape[0]
+    if d4 != 4 * d or w_h.shape[1] != 4 * d:
+        raise ValueError(
+            "fused_lstm: xw last dim %d / w_h %s inconsistent with 4*D"
+            % (d4, tuple(w_h.shape)))
+    use_pallas = force_pallas or (
+        not force_reference and jax.default_backend() == "tpu"
+    )
+    if not use_pallas:
+        h0 = jnp.zeros((b, d), xw.dtype)
+        return lstm_reference(xw, w_h, bias, peephole, h0, h0, mask,
+                              gate_act, cell_act, cand_act)
+    peep_arr = (jnp.stack(list(peephole), axis=0) if peephole is not None
+                else jnp.zeros((3, d), xw.dtype))
+    interpret = jax.default_backend() != "tpu"
+    return _fused(xw, w_h, jnp.reshape(bias, (-1,)), peep_arr, mask,
+                  peephole is not None, gate_act, cell_act, cand_act,
+                  interpret)

@@ -125,6 +125,31 @@ def _lower_dynamic_lstm(ctx, ins, attrs):
         c_new = _masked(c_new, c_prev, m_t)
         return (h_new, c_new), (h_new, c_new)
 
+    from paddle_tpu import flags as _flags
+
+    no_init_state = (ins.get("H0", [None])[0] is None
+                     and ins.get("C0", [None])[0] is None)
+    # kernel starts from zero state; any activation the op accepts is
+    # also in the kernel's table, so no further gating is needed
+    if _flags.get("use_pallas_lstm") and no_init_state:
+        # fused Pallas recurrence (kernels/lstm_cell.py): h/c live in
+        # VMEM across timesteps; the scan below is the reference path
+        from paddle_tpu.kernels.lstm_cell import fused_lstm
+
+        xw_bt = _batch_major(xs)  # [B, T', 4D] (already reversed if set)
+        m_bt = (_batch_major(mask[:, :, 0]) if mask is not None else None)
+        peep = ((w_ic, w_fc, w_oc) if w_ic is not None else None)
+        hid, cel = fused_lstm(
+            xw_bt, w, b_gate, peephole=peep, mask=m_bt,
+            gate_act=attrs.get("gate_activation", "sigmoid"),
+            cell_act=attrs.get("cell_activation", "tanh"),
+            cand_act=attrs.get("candidate_activation", "tanh"),
+        )
+        if attrs.get("is_reverse", False):
+            hid = jnp.flip(hid, axis=1)
+            cel = jnp.flip(cel, axis=1)
+        return {"Hidden": hid, "Cell": cel}
+
     ms = mask if mask is not None else jnp.ones((T, 1, 1), x.dtype)
     (_, _), (hs, cs) = jax.lax.scan(cell_fn, (h0, c0), (xs, ms))
     if attrs.get("is_reverse", False):

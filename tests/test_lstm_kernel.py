@@ -1,0 +1,158 @@
+"""Fused Pallas LSTM kernel tests (kernels/lstm_cell.py): interpret-mode
+parity with the XLA scan reference for values and gradients, padding /
+peephole / masking / reverse variants, and the FLAGS_use_pallas_lstm
+routing of the dynamic_lstm op.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import flags
+from paddle_tpu.kernels.lstm_cell import fused_lstm, lstm_reference
+
+
+def _inputs(b=3, t=5, d=8, seed=0, with_peep=True, with_mask=True):
+    rng = np.random.RandomState(seed)
+    xw = jnp.asarray(rng.randn(b, t, 4 * d).astype("float32") * 0.4)
+    wh = jnp.asarray(rng.randn(d, 4 * d).astype("float32") * 0.3)
+    bias = jnp.asarray(rng.randn(4 * d).astype("float32") * 0.1)
+    peep = (tuple(jnp.asarray(rng.randn(d).astype("float32") * 0.1)
+                  for _ in range(3)) if with_peep else None)
+    if with_mask:
+        lens = rng.randint(1, t + 1, b)
+        mask = jnp.asarray(
+            (np.arange(t)[None, :] < lens[:, None]).astype("float32"))
+    else:
+        mask = None
+    return xw, wh, bias, peep, mask
+
+
+@pytest.mark.parametrize("with_peep,with_mask", [
+    (True, True), (False, False), (True, False), (False, True)])
+def test_fused_lstm_matches_reference(with_peep, with_mask):
+    xw, wh, bias, peep, mask = _inputs(with_peep=with_peep,
+                                       with_mask=with_mask)
+    d = wh.shape[0]
+    h0 = jnp.zeros((xw.shape[0], d))
+    ref = lstm_reference(xw, wh, bias, peep, h0, h0, mask)
+    got = fused_lstm(xw, wh, bias, peephole=peep, mask=mask,
+                     force_pallas=True)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(ref[1]),
+                               atol=1e-5)
+
+
+def test_fused_lstm_gradients_match_reference():
+    xw, wh, bias, peep, mask = _inputs(seed=2)
+    d = wh.shape[0]
+    h0 = jnp.zeros((xw.shape[0], d))
+
+    def loss_pal(xw, wh, bias):
+        h, c = fused_lstm(xw, wh, bias, peephole=peep, mask=mask,
+                          force_pallas=True)
+        return jnp.sum(h ** 2) + jnp.sum(c)
+
+    def loss_ref(xw, wh, bias):
+        h, c = lstm_reference(xw, wh, bias, peep, h0, h0, mask)
+        return jnp.sum(h ** 2) + jnp.sum(c)
+
+    gp = jax.grad(loss_pal, argnums=(0, 1, 2))(xw, wh, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(xw, wh, bias)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_fused_lstm_batch_padding_path():
+    # batch bigger than one block multiple exercises the pad/unpad path
+    xw, wh, bias, _, _ = _inputs(b=5, t=3, seed=3, with_peep=False,
+                                 with_mask=False)
+    d = wh.shape[0]
+    h0 = jnp.zeros((5, d))
+    ref = lstm_reference(xw, wh, bias, None, h0, h0, None)
+    got = fused_lstm(xw, wh, bias, force_pallas=True)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]),
+                               atol=1e-5)
+
+
+def test_fused_lstm_validates():
+    xw, wh, bias, _, _ = _inputs(with_peep=False, with_mask=False)
+    with pytest.raises(ValueError, match="activation"):
+        fused_lstm(xw, wh, bias, gate_act="softsign")
+    with pytest.raises(ValueError, match="4\\*D"):
+        fused_lstm(xw[:, :, :-4], wh, bias)
+
+
+def test_dynamic_lstm_flag_routes_to_fused_path():
+    """FLAGS_use_pallas_lstm=1 must produce the same training results as
+    the scan path (on CPU the fused entry point falls back to the same
+    reference math; the routing itself is what's exercised)."""
+    def run(flag):
+        flags.set_flag("use_pallas_lstm", flag)
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = 11
+            startup.random_seed = 11
+            with fluid.program_guard(main, startup):
+                words = fluid.layers.data("w", [6], dtype="int64")
+                length = fluid.layers.data("len", [1], dtype="int64")
+                label = fluid.layers.data("y", [1], dtype="int64")
+                emb = fluid.layers.embedding(words, size=[30, 8])
+                proj = fluid.layers.fc(emb, size=4 * 8, num_flatten_dims=2)
+                hid, _ = fluid.layers.dynamic_lstm(proj, size=4 * 8,
+                                                   length=length)
+                pooled = fluid.layers.sequence_pool(hid, "max",
+                                                    length=length)
+                loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+                    fluid.layers.fc(pooled, 3), label))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(1)
+            out = []
+            for _ in range(5):
+                feed = {
+                    "w": rng.randint(0, 30, (4, 6)).astype("int64"),
+                    "len": rng.randint(1, 7, (4, 1)).astype("int64"),
+                    "y": rng.randint(0, 3, (4, 1)).astype("int64"),
+                }
+                (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+                out.append(float(np.asarray(lv).ravel()[0]))
+            return out
+        finally:
+            flags.set_flag("use_pallas_lstm", False)
+
+    base = run(False)
+    fused = run(True)
+    np.testing.assert_allclose(base, fused, rtol=1e-5, atol=1e-6)
+
+
+def test_flag_toggle_recompiles_cached_program():
+    """Toggling FLAGS_use_pallas_lstm between runs of the SAME program on
+    the SAME executor must recompile (the executable cache is keyed on
+    trace-time flags)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4, 4 * 4])
+        hid, _ = fluid.layers.dynamic_lstm(x, size=4 * 4)
+        out = fluid.layers.reduce_sum(hid)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.random.RandomState(0).randn(2, 4, 16)
+            .astype("float32")}
+    flags.set_flag("use_pallas_lstm", False)
+    try:
+        (a,) = exe.run(main, feed=feed, fetch_list=[out])
+        n_cached = len(exe._cache)
+        flags.set_flag("use_pallas_lstm", True)
+        (b,) = exe.run(main, feed=feed, fetch_list=[out])
+        assert len(exe._cache) == n_cached + 1, "flag flip did not recompile"
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+    finally:
+        flags.set_flag("use_pallas_lstm", False)
