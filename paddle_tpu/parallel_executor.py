@@ -21,7 +21,7 @@ import jax
 from paddle_tpu import framework
 from paddle_tpu.core.lod import LoDTensor
 from paddle_tpu.core.lowering import CompiledProgram
-from paddle_tpu.executor import global_scope
+from paddle_tpu.executor import _trace_flags_key, global_scope
 from paddle_tpu.parallel.mesh import ShardingPolicy, build_mesh
 
 
@@ -164,6 +164,7 @@ class ParallelExecutor(object):
             tuple(sorted((n, s, d) for n, (s, d) in feed_specs.items())),
             tuple(fetch_names),
             hash(frozenset(scope_names)),
+            _trace_flags_key(),
         )
         cp = self._cache.get(key)
         if cp is None:
