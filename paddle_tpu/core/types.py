@@ -135,3 +135,17 @@ def is_float_dtype(dtype):
 
 def core_version():
     return "paddle_tpu-core-0.1"
+
+
+def device_dtype(dtype):
+    """The dtype a value of `dtype` actually takes ON DEVICE: with jax
+    x64 disabled (the TPU default), int64/uint64/float64 narrow to their
+    32-bit forms. Lowerings request this directly instead of asking jnp
+    for a width it will warn about and truncate anyway; host-side code
+    (feeds, .npy persistence) keeps the declared width via np_dtype."""
+    import jax.dtypes
+
+    # the supported API for "what does this dtype canonicalize to on
+    # device": narrows 64-bit widths iff x64 is off, tracking the flag
+    # across jax versions
+    return str(jax.dtypes.canonicalize_dtype(np_dtype(dtype)))

@@ -20,7 +20,11 @@ def broadcast_y(x, y, axis):
 
 
 def to_dtype(x, dtype):
-    return jnp.asarray(x, canonical_dtype(dtype))
+    # request the width the device will actually use (int64 -> int32 with
+    # x64 off) so jnp neither warns nor re-truncates
+    from paddle_tpu.core.types import device_dtype
+
+    return jnp.asarray(x, device_dtype(dtype))
 
 
 def reduce_axes(ndim, dim, reduce_all):

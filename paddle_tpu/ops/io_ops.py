@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.op_registry import register_op
-from paddle_tpu.core.types import canonical_dtype, np_dtype
+from paddle_tpu.core.types import device_dtype, np_dtype
 
 register_op(
     "assign_value",
@@ -17,7 +17,7 @@ register_op(
     outputs=["Out"],
     attrs={"shape": [], "dtype": "float32", "values": []},
     lower=lambda ctx, ins, attrs: jnp.asarray(
-        np.asarray(attrs["values"], canonical_dtype(attrs.get("dtype"))).reshape(
+        np.asarray(attrs["values"], device_dtype(attrs.get("dtype"))).reshape(
             attrs["shape"]
         )
     ),
@@ -113,9 +113,9 @@ def _lower_load(ctx, ins, attrs):
     val = jnp.asarray(np.load(path))
     dtype = attrs.get("dtype", "")
     if dtype:
-        from paddle_tpu.core.types import canonical_dtype
+        from paddle_tpu.core.types import device_dtype
 
-        val = val.astype(canonical_dtype(dtype))
+        val = val.astype(device_dtype(dtype))
     return val
 
 

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.op_registry import register_op
-from paddle_tpu.core.types import canonical_dtype
+from paddle_tpu.core.types import device_dtype
 
 register_op(
     "gaussian_random",
@@ -20,7 +20,7 @@ register_op(
     lower=lambda ctx, ins, attrs: attrs.get("mean", 0.0)
     + attrs.get("std", 1.0)
     * jax.random.normal(
-        ctx.rng(), tuple(attrs["shape"]), canonical_dtype(attrs.get("dtype"))
+        ctx.rng(), tuple(attrs["shape"]), device_dtype(attrs.get("dtype"))
     ),
     grad=None,
 )
@@ -33,7 +33,7 @@ register_op(
     lower=lambda ctx, ins, attrs: jax.random.uniform(
         ctx.rng(),
         tuple(attrs["shape"]),
-        canonical_dtype(attrs.get("dtype")),
+        device_dtype(attrs.get("dtype")),
         minval=attrs.get("min", -1.0),
         maxval=attrs.get("max", 1.0),
     ),
@@ -49,7 +49,7 @@ register_op(
     + attrs.get("std", 1.0)
     * jax.random.truncated_normal(
         ctx.rng(), -2.0, 2.0, tuple(attrs["shape"]),
-        canonical_dtype(attrs.get("dtype")),
+        device_dtype(attrs.get("dtype")),
     ),
     grad=None,
 )
@@ -148,7 +148,7 @@ register_op(
     * jax.random.normal(
         ctx.rng(),
         _batch_size_like_shape(ins, attrs),
-        canonical_dtype(attrs.get("dtype")),
+        device_dtype(attrs.get("dtype")),
     ),
     grad=None,
 )
@@ -162,7 +162,7 @@ register_op(
     lower=lambda ctx, ins, attrs: jax.random.uniform(
         ctx.rng(),
         _batch_size_like_shape(ins, attrs),
-        canonical_dtype(attrs.get("dtype")),
+        device_dtype(attrs.get("dtype")),
         minval=attrs.get("min", -1.0),
         maxval=attrs.get("max", 1.0),
     ),

@@ -133,10 +133,10 @@ def _lower_sequence_mask(ctx, ins, attrs):
     if maxlen <= 0:
         raise ValueError("sequence_mask on TPU requires static maxlen attr")
     steps = jnp.arange(maxlen)
-    from paddle_tpu.core.types import canonical_dtype
+    from paddle_tpu.core.types import device_dtype
 
     return (steps[None, :] < lens[:, None]).astype(
-        canonical_dtype(attrs.get("out_dtype", "int64"))
+        device_dtype(attrs.get("out_dtype", "int64"))
     )
 
 

@@ -10,7 +10,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.op_registry import register_op
-from paddle_tpu.core.types import canonical_dtype
+# index outputs request the device's integer width via device_dtype
+# (int32 when x64 is off) — asking jnp for int64 would warn and truncate
+from paddle_tpu.core.types import device_dtype
 from paddle_tpu.ops.common import to_dtype
 
 register_op(
@@ -19,7 +21,7 @@ register_op(
     outputs=["Out"],
     attrs={"shape": [1], "dtype": "float32", "value": 0.0, "force_cpu": False},
     lower=lambda ctx, ins, attrs: jnp.full(
-        tuple(attrs["shape"]), attrs["value"], canonical_dtype(attrs.get("dtype"))
+        tuple(attrs["shape"]), attrs["value"], device_dtype(attrs.get("dtype"))
     ),
     grad=None,
 )
@@ -43,7 +45,7 @@ register_op(
 def _fill_batch_like(ref, attrs):
     shape = list(attrs["shape"])
     shape[attrs.get("output_dim_idx", 0)] = jnp.shape(ref)[attrs.get("input_dim_idx", 0)]
-    return jnp.full(tuple(shape), attrs["value"], canonical_dtype(attrs.get("dtype")))
+    return jnp.full(tuple(shape), attrs["value"], device_dtype(attrs.get("dtype")))
 
 
 register_op(
@@ -402,7 +404,7 @@ def _lower_top_k(ctx, ins, attrs):
     x = ins["X"][0]
     k = attrs.get("k", 1)
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(device_dtype("int64"))}
 
 
 register_op(
@@ -422,7 +424,7 @@ register_op(
     lower=lambda ctx, ins, attrs: {
         "Out": jnp.sort(ins["X"][0], axis=attrs.get("axis", -1)),
         "Indices": jnp.argsort(ins["X"][0], axis=attrs.get("axis", -1)).astype(
-            jnp.int64
+            device_dtype("int64")
         ),
     },
     grad=None,
@@ -435,7 +437,7 @@ register_op(
     attrs={"axis": 0},
     lower=lambda ctx, ins, attrs: jnp.argmax(
         ins["X"][0], axis=attrs.get("axis", 0)
-    ).astype(jnp.int64),
+    ).astype(device_dtype("int64")),
     grad=None,
 )
 
@@ -446,7 +448,7 @@ register_op(
     attrs={"axis": 0},
     lower=lambda ctx, ins, attrs: jnp.argmin(
         ins["X"][0], axis=attrs.get("axis", 0)
-    ).astype(jnp.int64),
+    ).astype(device_dtype("int64")),
     grad=None,
 )
 
@@ -465,7 +467,7 @@ register_op(
     attrs={"start": 0, "end": 1, "step": 1, "dtype": "int64"},
     lower=lambda ctx, ins, attrs: jnp.arange(
         attrs["start"], attrs["end"], attrs["step"],
-        dtype=canonical_dtype(attrs.get("dtype", "int64")),
+        dtype=device_dtype(attrs.get("dtype", "int64")),
     ),
     grad=None,
 )
@@ -522,7 +524,7 @@ def _lower_fill(ctx, ins, attrs):
     folded into the program)."""
     vals = jnp.asarray(
         np.asarray(attrs["value"], np.float64),
-        canonical_dtype(attrs.get("dtype")),
+        device_dtype(attrs.get("dtype")),
     )
     return jnp.reshape(vals, tuple(attrs["shape"]))
 
