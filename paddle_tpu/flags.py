@@ -37,6 +37,8 @@ _DEFS = {
     # route dynamic_lstm through the fused Pallas recurrence kernel
     # (kernels/lstm_cell.py); opt-in until measured on hardware
     "use_pallas_lstm": (False, bool),
+    # same for dynamic_gru (kernels/gru_cell.py)
+    "use_pallas_gru": (False, bool),
 }
 
 

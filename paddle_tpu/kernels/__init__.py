@@ -14,3 +14,7 @@ from paddle_tpu.kernels.lstm_cell import (  # noqa: F401
     fused_lstm,
     lstm_reference,
 )
+from paddle_tpu.kernels.gru_cell import (  # noqa: F401
+    fused_gru,
+    gru_reference,
+)

@@ -1,0 +1,176 @@
+"""Fused GRU recurrence as a Pallas TPU kernel.
+
+Sibling of kernels/lstm_cell.py for the GRU half of the reference's
+jit_kernel hot loops (math/jit_kernel_rnn.cc covers both): the input
+projection x @ W_x stays one big XLA matmul outside; the kernel runs
+grid = (batch_blocks, T) with T innermost and h resident in VMEM scratch,
+fusing the two recurrent matmuls (h @ W_gate, (r*h) @ W_cand) with the
+gate math so the [B, 3D] gates tile never round-trips through HBM.
+
+Forward is Pallas; backward recomputes through the XLA scan reference via
+custom_vjp. Opt-in from dynamic_gru via FLAGS_use_pallas_gru.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.lstm_cell import _ACTS
+
+
+def gru_reference(xw, w_gate, w_cand, bias, h0, mask,
+                  gate_act="sigmoid", cand_act="tanh"):
+    """XLA scan reference. xw: [B, T, 3D] pre-projected inputs; w_gate:
+    [D, 2D]; w_cand: [D, D]; bias: [3D]; h0: [B, D]; mask: None or
+    [B, T]. Returns hidden [B, T, D] (gru_op.cc update-gate form:
+    h = u * h_prev + (1 - u) * c)."""
+    ga = _ACTS[gate_act]
+    ca = _ACTS[cand_act]
+    d = w_cand.shape[0]
+    xs = jnp.moveaxis(xw, 1, 0)
+    ms = (jnp.moveaxis(mask, 1, 0)[:, :, None]
+          if mask is not None else None)
+
+    def step(h, inp):
+        if ms is None:
+            xt = inp
+            m = None
+        else:
+            xt, m = inp
+        g = xt[:, :2 * d] + h @ w_gate + bias[:2 * d]
+        u = ga(g[:, :d])
+        r = ga(g[:, d:])
+        c = ca(xt[:, 2 * d:] + (r * h) @ w_cand + bias[2 * d:])
+        h_new = u * h + (1.0 - u) * c
+        if m is not None:
+            h_new = h_new * m + h * (1.0 - m)
+        return h_new, h_new
+
+    inp = xs if ms is None else (xs, ms)
+    _, hs = jax.lax.scan(step, h0, inp)
+    return jnp.moveaxis(hs, 0, 1)
+
+
+def _gru_kernel(xw_ref, wg_ref, wc_ref, b_ref, m_ref, h_out_ref, h_ref, *,
+                d, gate_act, cand_act):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+    ga = _ACTS[gate_act]
+    ca = _ACTS[cand_act]
+
+    @pl.when(t == 0)
+    def _init():
+        h_ref[:, :] = jnp.zeros_like(h_ref)
+
+    h = h_ref[:, :]
+    xt = xw_ref[:, 0, :].astype(jnp.float32)
+    b = b_ref[0, :].astype(jnp.float32)
+    g = xt[:, :2 * d] + jax.lax.dot_general(
+        h, wg_ref[:, :].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    ) + b[:2 * d]
+    u = ga(g[:, :d])
+    r = ga(g[:, d:])
+    c = ca(xt[:, 2 * d:] + jax.lax.dot_general(
+        r * h, wc_ref[:, :].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    ) + b[2 * d:])
+    h_new = u * h + (1.0 - u) * c
+    m = m_ref[:, 0:1].astype(jnp.float32)
+    h_new = h_new * m + h * (1.0 - m)
+    h_ref[:, :] = h_new
+    h_out_ref[:, 0, :] = h_new.astype(h_out_ref.dtype)
+
+
+def _gru_pallas_forward(xw, w_gate, w_cand, bias, mask, gate_act, cand_act,
+                        block_b, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t, d3 = xw.shape
+    d = w_cand.shape[0]
+    block_b = min(block_b, b)
+    bp = -(-b // block_b) * block_b
+    if bp != b:
+        xw = jnp.pad(xw, ((0, bp - b), (0, 0), (0, 0)))
+    if mask is None:
+        m_arr = jnp.ones((bp, t), jnp.float32)
+    else:
+        m_arr = jnp.pad(mask.astype(jnp.float32), ((0, bp - b), (0, 0)))
+
+    kernel = functools.partial(
+        _gru_kernel, d=d, gate_act=gate_act, cand_act=cand_act)
+    hidden = pl.pallas_call(
+        kernel,
+        grid=(bp // block_b, t),
+        in_specs=[
+            pl.BlockSpec((block_b, 1, d3), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((d, 2 * d), lambda i, t: (0, 0)),
+            pl.BlockSpec((d, d), lambda i, t: (0, 0)),
+            pl.BlockSpec((1, d3), lambda i, t: (0, 0)),
+            pl.BlockSpec((block_b, 1), lambda i, t: (i, t)),
+        ],
+        out_specs=pl.BlockSpec((block_b, 1, d), lambda i, t: (i, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp, t, d), xw.dtype),
+        scratch_shapes=[pltpu.VMEM((block_b, d), jnp.float32)],
+        interpret=interpret,
+    )(xw, w_gate, w_cand, jnp.reshape(bias, (1, d3)), m_arr)
+    return hidden[:b]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _fused(xw, w_gate, w_cand, bias, mask, gate_act, cand_act, interpret):
+    return _gru_pallas_forward(xw, w_gate, w_cand, bias, mask, gate_act,
+                               cand_act, 128, interpret)
+
+
+def _fused_fwd(xw, w_gate, w_cand, bias, mask, gate_act, cand_act,
+               interpret):
+    out = _fused(xw, w_gate, w_cand, bias, mask, gate_act, cand_act,
+                 interpret)
+    return out, (xw, w_gate, w_cand, bias, mask)
+
+
+def _fused_bwd(gate_act, cand_act, interpret, res, g):
+    xw, w_gate, w_cand, bias, mask = res
+
+    def ref(xw_, wg_, wc_, b_):
+        h0 = jnp.zeros((xw_.shape[0], wc_.shape[0]), xw_.dtype)
+        return gru_reference(xw_, wg_, wc_, b_, h0, mask, gate_act,
+                             cand_act)
+
+    _, vjp = jax.vjp(ref, xw, w_gate, w_cand, bias)
+    gxw, gwg, gwc, gb = vjp(g)
+    return gxw, gwg, gwc, gb, None
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_gru(xw, w_gate, w_cand, bias, mask=None, gate_act="sigmoid",
+              cand_act="tanh", force_pallas=False, force_reference=False):
+    """Fused GRU over pre-projected inputs. xw: [B, T, 3D]; w_gate:
+    [D, 2D]; w_cand: [D, D]; bias: [3D]; mask: optional [B, T].
+    Returns hidden [B, T, D]; differentiable."""
+    for name in (gate_act, cand_act):
+        if name not in _ACTS:
+            raise ValueError("fused_gru: unsupported activation %r" % name)
+    b, _, d3 = xw.shape
+    d = w_cand.shape[0]
+    if d3 != 3 * d or w_gate.shape != (d, 2 * d) or w_cand.shape != (d, d):
+        raise ValueError(
+            "fused_gru: shapes inconsistent with 3*D layout: xw %s, "
+            "w_gate %s, w_cand %s"
+            % (tuple(xw.shape), tuple(w_gate.shape), tuple(w_cand.shape)))
+    use_pallas = force_pallas or (
+        not force_reference and jax.default_backend() == "tpu"
+    )
+    if not use_pallas:
+        h0 = jnp.zeros((b, d), xw.dtype)
+        return gru_reference(xw, w_gate, w_cand, bias, h0, mask, gate_act,
+                             cand_act)
+    interpret = jax.default_backend() != "tpu"
+    return _fused(xw, w_gate, w_cand, jnp.reshape(bias, (-1,)), mask,
+                  gate_act, cand_act, interpret)

@@ -2,7 +2,7 @@
 
 import jax.numpy as jnp
 
-from paddle_tpu.core.types import canonical_dtype
+from paddle_tpu.core.types import device_dtype
 
 
 def broadcast_y(x, y, axis):
@@ -22,8 +22,6 @@ def broadcast_y(x, y, axis):
 def to_dtype(x, dtype):
     # request the width the device will actually use (int64 -> int32 with
     # x64 off) so jnp neither warns nor re-truncates
-    from paddle_tpu.core.types import device_dtype
-
     return jnp.asarray(x, device_dtype(dtype))
 
 

@@ -303,6 +303,23 @@ def _lower_dynamic_gru(ctx, ins, attrs):
         h_new = _masked(h_new, h_prev, m_t)
         return h_new, h_new
 
+    from paddle_tpu import flags as _flags
+
+    if _flags.get("use_pallas_gru") and ins.get("H0", [None])[0] is None:
+        # fused Pallas recurrence (kernels/gru_cell.py); scan is reference
+        from paddle_tpu.kernels.gru_cell import fused_gru
+
+        hid = fused_gru(
+            _batch_major(xs), w_g, w_c, b,
+            mask=(_batch_major(mask[:, :, 0]) if mask is not None
+                  else None),
+            gate_act=attrs.get("gate_activation", "sigmoid"),
+            cand_act=attrs.get("activation", "tanh"),
+        )
+        if attrs.get("is_reverse", False):
+            hid = jnp.flip(hid, axis=1)
+        return {"Hidden": hid}
+
     _, hs = jax.lax.scan(cell_fn, h0, (xs, ms))
     if attrs.get("is_reverse", False):
         hs = jnp.flip(hs, axis=0)

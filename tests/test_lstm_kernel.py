@@ -156,3 +156,76 @@ def test_flag_toggle_recompiles_cached_program():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
     finally:
         flags.set_flag("use_pallas_lstm", False)
+
+
+# -- GRU sibling kernel (kernels/gru_cell.py) -------------------------------
+
+def _gru_inputs(b=3, t=5, d=8, seed=4, with_mask=True):
+    rng = np.random.RandomState(seed)
+    xw = jnp.asarray(rng.randn(b, t, 3 * d).astype("float32") * 0.4)
+    wg = jnp.asarray(rng.randn(d, 2 * d).astype("float32") * 0.3)
+    wc = jnp.asarray(rng.randn(d, d).astype("float32") * 0.3)
+    bias = jnp.asarray(rng.randn(3 * d).astype("float32") * 0.1)
+    if with_mask:
+        lens = rng.randint(1, t + 1, b)
+        mask = jnp.asarray(
+            (np.arange(t)[None, :] < lens[:, None]).astype("float32"))
+    else:
+        mask = None
+    return xw, wg, wc, bias, mask
+
+
+@pytest.mark.parametrize("with_mask", [True, False])
+def test_fused_gru_matches_reference(with_mask):
+    from paddle_tpu.kernels.gru_cell import fused_gru, gru_reference
+
+    xw, wg, wc, bias, mask = _gru_inputs(with_mask=with_mask)
+    h0 = jnp.zeros((xw.shape[0], wc.shape[0]))
+    ref = gru_reference(xw, wg, wc, bias, h0, mask)
+    got = fused_gru(xw, wg, wc, bias, mask=mask, force_pallas=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_fused_gru_gradients_match_reference():
+    from paddle_tpu.kernels.gru_cell import fused_gru, gru_reference
+
+    xw, wg, wc, bias, mask = _gru_inputs(seed=6)
+    h0 = jnp.zeros((xw.shape[0], wc.shape[0]))
+
+    def loss_pal(xw, wg, wc, bias):
+        return jnp.sum(fused_gru(xw, wg, wc, bias, mask=mask,
+                                 force_pallas=True) ** 2)
+
+    def loss_ref(xw, wg, wc, bias):
+        return jnp.sum(gru_reference(xw, wg, wc, bias, h0, mask) ** 2)
+
+    gp = jax.grad(loss_pal, argnums=(0, 1, 2, 3))(xw, wg, wc, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(xw, wg, wc, bias)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_dynamic_gru_flag_parity():
+    """FLAGS_use_pallas_gru routing reproduces the scan-path training."""
+    def run(flag):
+        flags.set_flag("use_pallas_gru", flag)
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = 13
+            startup.random_seed = 13
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", [5, 3 * 6])
+                length = fluid.layers.data("len", [1], dtype="int64")
+                hid = fluid.layers.dynamic_gru(x, size=6, length=length)
+                out = fluid.layers.reduce_sum(hid)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(2)
+            feed = {"x": rng.randn(3, 5, 18).astype("float32"),
+                    "len": np.asarray([[5], [2], [4]], "int64")}
+            (v,) = exe.run(main, feed=feed, fetch_list=[out])
+            return float(np.asarray(v).ravel()[0])
+        finally:
+            flags.set_flag("use_pallas_gru", False)
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-5)
