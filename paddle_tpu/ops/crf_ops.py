@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.core.types import device_dtype
 from paddle_tpu.ops.common import optional_lengths
 
 
@@ -135,12 +136,12 @@ def _lower_crf_decoding(ctx, ins, attrs):
     # path_rev[i] = tag at position T-2-i  ->  [B, T-1] forward order.
     body = jnp.flip(jnp.moveaxis(path_rev, 0, 1), axis=1)
     path = jnp.concatenate([body, best_last[:, None]], axis=1)  # [B, T]
-    path = jnp.where(mask, path, 0).astype(jnp.int64)
+    path = jnp.where(mask, path, 0).astype(device_dtype("int64"))
 
     label = ins.get("Label", [None])[0]
     if label is not None:
         label = jnp.reshape(label, (B, -1))
-        path = jnp.where(mask, (path == label).astype(jnp.int64), 0)
+        path = jnp.where(mask, (path == label).astype(device_dtype("int64")), 0)
     return {"ViterbiPath": path}
 
 

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.core.types import device_dtype
 from paddle_tpu.ops.common import compact_rows, optional_lengths
 
 _NEG = -1e30
@@ -184,7 +185,7 @@ def _lower_edit_distance(ctx, ins, attrs):
         dist = dist / jnp.maximum(r_len.astype(jnp.float32), 1.0)
     return {
         "Out": dist[:, None],
-        "SequenceNum": jnp.asarray([B], jnp.int64),
+        "SequenceNum": jnp.asarray([B], device_dtype("int64")),
     }
 
 

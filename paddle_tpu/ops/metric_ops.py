@@ -7,6 +7,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.core.types import device_dtype
 
 
 def _lower_accuracy(ctx, ins, attrs):
@@ -44,9 +45,9 @@ def _lower_auc(ctx, ins, attrs):
     bucket = jnp.clip(
         (pos_prob * num_thresholds).astype(jnp.int32), 0, num_thresholds - 1
     )
-    onehot = jnp.zeros((num_thresholds,), jnp.int64)
-    pos_hist = onehot.at[bucket].add(lbl.astype(jnp.int64))
-    neg_hist = onehot.at[bucket].add((~lbl).astype(jnp.int64))
+    onehot = jnp.zeros((num_thresholds,), device_dtype("int64"))
+    pos_hist = onehot.at[bucket].add(lbl.astype(device_dtype("int64")))
+    neg_hist = onehot.at[bucket].add((~lbl).astype(device_dtype("int64")))
     stat_pos = ins["StatPos"][0] + pos_hist
     stat_neg = ins["StatNeg"][0] + neg_hist
     # AUC from histogram: sweep thresholds high->low.
@@ -169,11 +170,11 @@ def _lower_chunk_eval(ctx, ins, attrs):
         active = active & ~both_end & ~one_end
         return (active, correct), None
 
-    init = (jnp.zeros((B,), bool), jnp.zeros((B,), jnp.int64))
+    init = (jnp.zeros((B,), bool), jnp.zeros((B,), device_dtype("int64")))
     (_, correct), _ = jax.lax.scan(step, init, jnp.arange(T))
     num_correct = jnp.sum(correct)
-    num_label = jnp.sum(l_b.astype(jnp.int64))
-    num_infer = jnp.sum(p_b.astype(jnp.int64))
+    num_label = jnp.sum(l_b.astype(device_dtype("int64")))
+    num_infer = jnp.sum(p_b.astype(device_dtype("int64")))
     precision = jnp.where(
         num_infer > 0, num_correct / jnp.maximum(num_infer, 1), 0.0
     ).astype(jnp.float32)

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.core.types import device_dtype
 
 
 def _lower_nce(ctx, ins, attrs):
@@ -54,7 +55,7 @@ def _lower_nce(ctx, ins, attrs):
     return {
         "Cost": cost,
         "SampleLogits": logits,
-        "SampleLabels": samples.astype(jnp.int64),
+        "SampleLabels": samples.astype(device_dtype("int64")),
     }
 
 

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.core.types import device_dtype
 
 
 def _sub_lowerer(ctx, block_idx):
@@ -364,7 +365,7 @@ register_op(
     inputs=["X"],
     outputs=["Out"],
     lower=lambda ctx, ins, attrs: jnp.reshape(
-        ins["X"][0][1].astype(jnp.int64), (1,)
+        ins["X"][0][1].astype(device_dtype("int64")), (1,)
     ),
     grad=None,
 )

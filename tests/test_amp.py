@@ -132,7 +132,7 @@ class TestRandomDataGenerator:
         assert l1.min() >= 0 and l1.max() <= 9
         assert a1.min() >= 0.0 and a1.max() < 1.0
         # fresh draw every step
-        assert float(s1) != float(s2)
+        assert float(np.ravel(s1)[0]) != float(np.ravel(s2)[0])
 
     def test_rejects_dynamic_shape(self):
         main, startup = fluid.Program(), fluid.Program()
