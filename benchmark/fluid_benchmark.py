@@ -57,6 +57,9 @@ def parse_args():
                         help="in-graph random reader instead of host feeds")
     parser.add_argument("--amp", action="store_true",
                         help="bf16 AMP program rewrite")
+    parser.add_argument("--pallas_rnn", action="store_true",
+                        help="route dynamic_lstm/gru through the fused "
+                             "Pallas kernels (FLAGS_use_pallas_lstm/gru)")
     parser.add_argument("--memory_optimize", action="store_true")
     parser.add_argument("--profile", action="store_true",
                         help="profile the timed region (chrome trace)")
@@ -199,6 +202,11 @@ def main():
         from paddle_tpu.transpiler import rewrite_program_amp
 
         rewrite_program_amp(main_prog, "bfloat16")
+    if args.pallas_rnn:
+        from paddle_tpu import flags as _flags
+
+        _flags.set_flag("use_pallas_lstm", True)
+        _flags.set_flag("use_pallas_gru", True)
     if args.memory_optimize:
         from paddle_tpu.transpiler import memory_optimize
 

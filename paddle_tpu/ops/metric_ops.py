@@ -51,8 +51,8 @@ def _lower_auc(ctx, ins, attrs):
     stat_pos = ins["StatPos"][0] + pos_hist
     stat_neg = ins["StatNeg"][0] + neg_hist
     # AUC from histogram: sweep thresholds high->low.
-    tp = jnp.cumsum(stat_pos[::-1])[::-1].astype(jnp.float64)
-    fp = jnp.cumsum(stat_neg[::-1])[::-1].astype(jnp.float64)
+    tp = jnp.cumsum(stat_pos[::-1])[::-1].astype(device_dtype("float64"))
+    fp = jnp.cumsum(stat_neg[::-1])[::-1].astype(device_dtype("float64"))
     tot_pos = jnp.maximum(tp[0], 1.0)
     tot_neg = jnp.maximum(fp[0], 1.0)
     tpr = tp / tot_pos
