@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Single build-and-test driver (the paddle_build.sh role, sized to this
+# repo): native C++ build + its unit tests, the Python suite on the
+# 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
+# proxy of the benchmark. Runs everything by default; pass stage names
+# (native|python|dryrun|bench) to run a subset.
+#
+#   tools/run_ci.sh                      # everything
+#   tools/run_ci.sh python               # just pytest
+#   BENCH_PLATFORM= tools/run_ci.sh bench   # on a TPU host: real-chip bench
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ALL_STAGES=(native python dryrun bench)
+stages=("$@")
+[ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
+for s in "${stages[@]}"; do
+  case " ${ALL_STAGES[*]} " in
+    *" $s "*) ;;
+    *) echo "unknown stage '$s' (valid: ${ALL_STAGES[*]})" >&2; exit 2 ;;
+  esac
+done
+
+want() {
+  local s
+  for s in "${stages[@]}"; do [ "$s" = "$1" ] && return 0; done
+  return 1
+}
+
+if want native; then
+  echo "== native build + C++ tests =="
+  cmake -S native -B native/build -G Ninja >/dev/null
+  cmake --build native/build >/dev/null
+  ./native/build/ptpu_native_test
+fi
+
+if want python; then
+  echo "== python suite (8-device virtual CPU mesh) =="
+  # force-merge the device-count flag: a pre-set XLA_FLAGS would defeat
+  # conftest.py's setdefault and silently shrink the mesh to 1 device
+  merged="--xla_force_host_platform_device_count=8"
+  for tok in ${XLA_FLAGS:-}; do
+    case "$tok" in
+      --xla_force_host_platform_device_count=*) ;;
+      *) merged="$merged $tok" ;;
+    esac
+  done
+  XLA_FLAGS="$merged" python -m pytest tests/ -q
+fi
+
+if want dryrun; then
+  echo "== multichip dryrun (dp+ZeRO / tp / sp / pp) =="
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+fi
+
+if want bench; then
+  # Default cpu for CI determinism (and because a wedged TPU tunnel hangs
+  # device enumeration); export BENCH_PLATFORM= (empty) on a TPU host to
+  # let bench.py use the real chip.
+  echo "== benchmark (BENCH_PLATFORM='${BENCH_PLATFORM-cpu}') =="
+  BENCH_PLATFORM="${BENCH_PLATFORM-cpu}" python bench.py
+fi
+
+echo "CI OK"
