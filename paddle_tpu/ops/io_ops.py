@@ -1,7 +1,9 @@
-"""IO/persistence/debug ops: print, assign_value. save/load are implemented
-host-side in paddle_tpu.io (graph save/load ops have no device work to do —
-the reference's save_op.cc serializes from the scope, which here is the
-executor writing scope arrays to disk).
+"""IO/persistence/debug ops: print, assign_value, and the GRAPH-level
+save/load pair (save_op.cc / load_op.cc roles): `load` folds a .npy file
+into the executable at trace time; `save` persists a value at EXECUTION
+time through an ordered io_callback. Bulk scope persistence (parameters,
+checkpoints) stays host-side in paddle_tpu.io, which writes scope arrays
+directly.
 """
 
 import jax
@@ -126,4 +128,60 @@ register_op(
     attrs={"file_path": "", "dtype": ""},
     lower=_lower_load,
     grad=None,
+)
+
+
+def _lower_save(ctx, ins, attrs):
+    """save_op.cc: persist a variable to disk AT EXECUTION TIME (the
+    in-graph checkpointing primitive). Under jit the write happens through
+    jax.experimental.io_callback, ordered against the surrounding step;
+    the value passes through unchanged so downstream ops (and the
+    fetch/state machinery) stay pure."""
+    import numpy as np
+
+    x = ins["X"][0]
+    path = attrs.get("file_path", "")
+    if not path:
+        raise ValueError("save: file_path attr is required")
+    if not path.endswith(".npy"):
+        path = path + ".npy"  # normalize once: guard and write must agree
+    overwrite = attrs.get("overwrite", True)
+
+    def _write(val):
+        import os
+
+        if not overwrite and os.path.exists(path):
+            raise RuntimeError(
+                "save: %r exists and overwrite=False" % path)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        np.save(path, np.asarray(val))
+
+    from jax.experimental import io_callback
+
+    io_callback(_write, None, x, ordered=True)
+    return x
+
+
+def _save_grad_maker(op, out_grads, wanted):
+    # save is identity in the dataflow; its gradient is a plain assign
+    # (the io_callback must NOT be traced by vjp — no JVP rule exists)
+    return [
+        {
+            "type": "assign",
+            "inputs": {"X": out_grads["Out"]},
+            "outputs": {"Out": wanted["X"]},
+            "attrs": {},
+        }
+    ]
+
+
+register_op(
+    "save",
+    inputs=["X"],
+    outputs=["Out"],
+    attrs={"file_path": "", "overwrite": True},
+    lower=_lower_save,
+    grad=_save_grad_maker,
 )

@@ -199,3 +199,72 @@ def test_load_layer_dtype_cast(tmp_path):
 
     (v,) = _run(build)
     assert np.asarray(v).dtype == np.float32
+
+
+def test_save_op_writes_during_execution(tmp_path):
+    """The in-graph save op persists a mid-program value at execution
+    time (save_op.cc role), round-tripping through layers.load."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    path = os.path.join(str(tmp_path), "ckpt", "h.npy")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3])
+        h = fluid.layers.scale(x, scale=2.0)
+        helper = LayerHelper("save")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type="save", inputs={"X": [h]},
+                         outputs={"Out": [out]},
+                         attrs={"file_path": path})
+        final = fluid.layers.scale(out, scale=3.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.asarray([[1.0, 2.0, 3.0]], "float32")
+    (fv,) = exe.run(main, feed={"x": xv}, fetch_list=[final])
+    np.testing.assert_allclose(np.asarray(fv), 6 * xv)
+    saved = np.load(path)
+    np.testing.assert_allclose(saved, 2 * xv)
+
+    # reload in a fresh program through layers.load
+    p2, s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(p2, s2):
+        w = fluid.layers.load(path)
+    e2 = fluid.Executor(fluid.CPUPlace())
+    e2.run(s2)
+    (wv,) = e2.run(p2, fetch_list=[w])
+    np.testing.assert_allclose(np.asarray(wv), 2 * xv)
+
+
+def test_save_op_passes_gradients_through(tmp_path):
+    """save is identity in the dataflow: training THROUGH a save op must
+    converge (its grad is an assign — the io_callback has no JVP rule)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    path = os.path.join(str(tmp_path), "h.npy")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 16, act="relu")
+        helper = LayerHelper("save")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type="save", inputs={"X": [h]},
+                         outputs={"Out": [out]},
+                         attrs={"file_path": path})
+        pred = fluid.layers.fc(out, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 1).astype("float32")
+    losses = []
+    for _ in range(20):
+        xb = rng.randn(16, 8).astype("float32")
+        (lv,) = exe.run(main, feed={"x": xb, "y": xb @ w},
+                        fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.6
+    assert os.path.exists(path)
