@@ -140,28 +140,42 @@ def _lower_save(ctx, ins, attrs):
     import numpy as np
 
     x = ins["X"][0]
+    path = _save_path(attrs, "save", ".npy")
+    write = _guarded_writer(
+        path, attrs.get("overwrite", True), "save",
+        lambda val: np.save(path, np.asarray(val)),
+    )
+    from jax.experimental import io_callback
+
+    io_callback(write, None, x, ordered=True)
+    return x
+
+
+def _save_path(attrs, op_name, suffix):
     path = attrs.get("file_path", "")
     if not path:
-        raise ValueError("save: file_path attr is required")
-    if not path.endswith(".npy"):
-        path = path + ".npy"  # normalize once: guard and write must agree
-    overwrite = attrs.get("overwrite", True)
+        raise ValueError("%s: file_path attr is required" % op_name)
+    if not path.endswith(suffix):
+        path = path + suffix  # normalize once: guard and write must agree
+    return path
 
-    def _write(val):
+
+def _guarded_writer(path, overwrite, op_name, write_fn):
+    """Shared execution-time write wrapper: overwrite guard + makedirs,
+    used by both save and save_combine."""
+
+    def _write(*vals):
         import os
 
         if not overwrite and os.path.exists(path):
             raise RuntimeError(
-                "save: %r exists and overwrite=False" % path)
+                "%s: %r exists and overwrite=False" % (op_name, path))
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        np.save(path, np.asarray(val))
+        write_fn(*vals)
 
-    from jax.experimental import io_callback
-
-    io_callback(_write, None, x, ordered=True)
-    return x
+    return _write
 
 
 def _save_grad_maker(op, out_grads, wanted):
@@ -184,4 +198,88 @@ register_op(
     attrs={"file_path": "", "overwrite": True},
     lower=_lower_save,
     grad=_save_grad_maker,
+)
+
+
+def _lower_save_combine(ctx, ins, attrs):
+    """save_combine_op.cc: bundle several variables into one .npz at
+    execution time (ordered io_callback, like save). Slot order follows
+    the op's X list; names inside the archive are arg_0..arg_{n-1} — the
+    LOAD side restores by position, exactly the reference's contract
+    (the combined file is positional, not named)."""
+    import numpy as np
+
+    xs = ins["X"]
+    path = _save_path(attrs, "save_combine", ".npz")
+    write = _guarded_writer(
+        path, attrs.get("overwrite", True), "save_combine",
+        lambda *vals: np.savez(path, **{"arg_%d" % i: np.asarray(v)
+                                        for i, v in enumerate(vals)}),
+    )
+    from jax.experimental import io_callback
+
+    io_callback(write, None, *xs, ordered=True)
+    return {"Out": list(xs)}
+
+
+def _save_combine_grad_maker(op, out_grads, wanted):
+    # identity dataflow per slot entry, like save; an entry whose output
+    # has NO downstream gradient still owes its wanted input grad — zeros
+    # (the dup-grad sum op reads every declared contribution)
+    ops = []
+    xs = op.inputs.get("X", [])
+    for i, (g, w) in enumerate(zip(out_grads["Out"], wanted["X"])):
+        if not w:  # backward marks skipped entries with "" (not None)
+            continue
+        if g is not None:
+            ops.append({
+                "type": "assign",
+                "inputs": {"X": [g]},
+                "outputs": {"Out": [w]},
+                "attrs": {},
+            })
+        else:
+            ops.append({
+                "type": "fill_zeros_like",
+                "inputs": {"X": [xs[i]]},
+                "outputs": {"Out": [w]},
+                "attrs": {},
+            })
+    return ops
+
+
+register_op(
+    "save_combine",
+    inputs=["*X"],
+    outputs=["*Out"],
+    attrs={"file_path": "", "overwrite": True},
+    lower=_lower_save_combine,
+    grad=_save_combine_grad_maker,
+)
+
+
+def _lower_load_combine(ctx, ins, attrs):
+    """load_combine_op.cc: restore the positional bundle written by
+    save_combine; values fold into the executable at trace time like
+    load."""
+    import numpy as np
+
+    path = _save_path(attrs, "load_combine", ".npz")
+    n_out = len([n for n in ctx.op.output("Out") if n])
+    with np.load(path) as z:
+        if len(z.files) != n_out:
+            raise ValueError(
+                "load_combine: archive %r holds %d entries but the op "
+                "declares %d outputs" % (path, len(z.files), n_out))
+        vals = [jnp.asarray(z["arg_%d" % i]) for i in range(n_out)]
+    return {"Out": vals}
+
+
+register_op(
+    "load_combine",
+    inputs=[],
+    outputs=["*Out"],
+    attrs={"file_path": ""},
+    lower=_lower_load_combine,
+    grad=None,
 )

@@ -268,3 +268,81 @@ def test_save_op_passes_gradients_through(tmp_path):
         losses.append(float(np.asarray(lv).ravel()[0]))
     assert losses[-1] < losses[0] * 0.6
     assert os.path.exists(path)
+
+
+def test_save_combine_load_combine_roundtrip(tmp_path):
+    """save_combine bundles several mid-graph values into one archive at
+    execution time; load_combine restores them positionally."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    path = os.path.join(str(tmp_path), "bundle")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        a = fluid.layers.scale(x, scale=2.0)
+        b = fluid.layers.scale(x, scale=-1.0)
+        helper = LayerHelper("save_combine")
+        oa = helper.create_variable_for_type_inference("float32")
+        ob = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type="save_combine",
+                         inputs={"X": [a, b]},
+                         outputs={"Out": [oa, ob]},
+                         attrs={"file_path": path})
+        total = fluid.layers.elementwise_add(oa, ob)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.asarray([[1.0, 2.0, 3.0, 4.0]], "float32")
+    (tv,) = exe.run(main, feed={"x": xv}, fetch_list=[total])
+    np.testing.assert_allclose(np.asarray(tv), xv)  # 2x + (-x) = x
+
+    p2, s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(p2, s2):
+        helper = LayerHelper("load_combine")
+        ra = helper.create_variable_for_type_inference("float32")
+        rb = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type="load_combine",
+                         outputs={"Out": [ra, rb]},
+                         attrs={"file_path": path})
+    e2 = fluid.Executor(fluid.CPUPlace())
+    e2.run(s2)
+    va, vb = e2.run(p2, fetch_list=[ra, rb])
+    np.testing.assert_allclose(np.asarray(va), 2 * xv)
+    np.testing.assert_allclose(np.asarray(vb), -xv)
+
+
+def test_save_combine_partial_gradient_path(tmp_path):
+    """Only ONE bundled output feeds the loss: the other entry's input
+    grad must come back as zeros (not vanish — the dup-grad sum reads
+    every declared contribution)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    path = os.path.join(str(tmp_path), "state")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6])
+        y = fluid.layers.data("y", [1])
+        h1 = fluid.layers.fc(x, 12, act="relu")
+        h2 = fluid.layers.fc(h1, 12, act="relu")
+        helper = LayerHelper("save_combine")
+        o1 = helper.create_variable_for_type_inference("float32")
+        o2 = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type="save_combine", inputs={"X": [h1, h2]},
+                         outputs={"Out": [o1, o2]},
+                         attrs={"file_path": path})
+        pred = fluid.layers.fc(o2, 1)  # o1 is checkpoint-only
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    w = rng.randn(6, 1).astype("float32")
+    losses = []
+    for _ in range(20):
+        xb = rng.randn(8, 6).astype("float32")
+        (lv,) = exe.run(main, feed={"x": xb, "y": xb @ w},
+                        fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.6
+    assert os.path.exists(path + ".npz")
