@@ -145,7 +145,19 @@ def _make_rng(step_key, attrs):
     return rng
 
 
-def build_step_fn(program, feed_names, fetch_names, state_in, state_out, is_test=False):
+_AMBIENT_MESH = []  # trace-time stack: the mesh a sharded compile runs under
+
+
+def ambient_mesh():
+    """The jax.sharding.Mesh of the ParallelExecutor compile currently
+    being traced, or None. Lets op lowerings opt into mesh-aware forms
+    (e.g. scaled_dot_product_attention's seq_parallel_axis routing to
+    ring attention) without plumbing the mesh through every rule."""
+    return _AMBIENT_MESH[-1] if _AMBIENT_MESH else None
+
+
+def build_step_fn(program, feed_names, fetch_names, state_in, state_out,
+                  is_test=False, mesh=None):
     """Build the pure step function: (state, feeds, key) -> (new_state, fetches)."""
     lowerer = BlockLowerer(program, 0, is_test=is_test)
 
@@ -153,7 +165,11 @@ def build_step_fn(program, feed_names, fetch_names, state_in, state_out, is_test
         env = {}
         env.update(state)
         env.update(feeds)
-        lowerer.lower_into(env, key)
+        _AMBIENT_MESH.append(mesh)
+        try:
+            lowerer.lower_into(env, key)
+        finally:
+            _AMBIENT_MESH.pop()
         new_state = {}
         for n in state_out:
             if n in env:
@@ -202,6 +218,7 @@ class CompiledProgram(object):
             self.state_in,
             self.state_out,
             is_test=is_test,
+            mesh=shardings.mesh if shardings is not None else None,
         )
         # Donate ONLY state the program replaces (optimizer updates, BN
         # stats). Donating untouched state (e.g. params in an inference

@@ -18,9 +18,14 @@ __all__ = [
 
 def scaled_dot_product_attention(
     queries, keys, values, mask=None, causal=False, sm_scale=None,
-    impl="auto", name=None
+    impl="auto", seq_parallel_axis=None, name=None
 ):
-    """Fused attention over [batch, heads, seq, head_dim] tensors."""
+    """Fused attention over [batch, heads, seq, head_dim] tensors.
+
+    With ``seq_parallel_axis`` (the name of a ParallelExecutor mesh
+    axis), the op runs ring attention with the sequence sharded over
+    that axis — in-program context parallelism for sequences too long
+    for one chip."""
     helper = LayerHelper("sdpa", name=name)
     out = helper.create_variable_for_type_inference(queries.dtype)
     inputs = {"Q": [queries], "K": [keys], "V": [values]}
@@ -34,6 +39,7 @@ def scaled_dot_product_attention(
             "causal": causal,
             "sm_scale": float(sm_scale or 0.0),
             "impl": impl,
+            "seq_parallel_axis": seq_parallel_axis or "",
         },
     )
     return out

@@ -23,6 +23,41 @@ def _lower_sdpa(ctx, ins, attrs):
     mask = ins.get("Mask", [None])[0]
     sm_scale = attrs.get("sm_scale", 0.0) or None
     causal = attrs.get("causal", False)
+    seq_axis = attrs.get("seq_parallel_axis", "")
+    if seq_axis:
+        # sequence-parallel region inside the program: Q/K/V reshard so
+        # the SEQUENCE spans the named mesh axis and K/V blocks rotate on
+        # ppermute (parallel/ring_attention.py) — long-context attention
+        # whose per-chip memory is O(T / axis_size). Requires the
+        # ParallelExecutor compile's mesh (the ambient mesh).
+        from paddle_tpu.core.lowering import ambient_mesh
+        from paddle_tpu.parallel.ring_attention import ring_attention
+
+        if mask is not None:
+            raise ValueError(
+                "scaled_dot_product_attention: seq_parallel_axis does not "
+                "take an explicit Mask (use causal=)")
+        if attrs.get("impl", "auto") != "auto":
+            raise ValueError(
+                "scaled_dot_product_attention: impl=%r conflicts with "
+                "seq_parallel_axis (the ring path IS the implementation)"
+                % attrs["impl"])
+        mesh = ambient_mesh()
+        if mesh is None or seq_axis not in mesh.shape:
+            raise ValueError(
+                "scaled_dot_product_attention: seq_parallel_axis=%r needs "
+                "a ParallelExecutor mesh containing that axis (got %s)"
+                % (seq_axis, None if mesh is None else tuple(mesh.shape)))
+        n = mesh.shape[seq_axis]
+        if q.shape[2] % n != 0:
+            raise ValueError(
+                "scaled_dot_product_attention: sequence length %d not "
+                "divisible by seq_parallel_axis %r size %d"
+                % (q.shape[2], seq_axis, n))
+        return ring_attention(
+            q, k, v, mesh=mesh, axis_name=seq_axis, causal=causal,
+            sm_scale=sm_scale,
+        )
     if mask is not None:
         # Mask: [B, T_k] validity (1=keep) or [B, 1|H, T_q, T_k] full mask.
         if mask.ndim == 2:
@@ -43,10 +78,24 @@ register_op(
     "scaled_dot_product_attention",
     inputs=["Q", "K", "V", "Mask"],
     outputs=["Out"],
-    attrs={"causal": False, "sm_scale": 0.0, "impl": "auto"},
+    attrs={"causal": False, "sm_scale": 0.0, "impl": "auto",
+           "seq_parallel_axis": ""},
     lower=_lower_sdpa,
     no_grad_inputs=("Mask",),
+    # Out mirrors Q's shape/dtype. Declared (not eval_shape'd) because the
+    # seq-parallel form needs the PE mesh, which doesn't exist at build
+    # time.
+    infer_shape=lambda block, op: _sdpa_infer_shape(block, op),
 )
+
+
+def _sdpa_infer_shape(block, op):
+    q = block._find_var_recursive(op.input("Q")[0])
+    for name in op.output("Out"):
+        out = block._find_var_recursive(name)
+        if out is not None and q is not None:
+            out.shape = list(q.shape) if q.shape is not None else None
+            out.dtype = q.dtype
 
 
 def _lower_label_smooth(ctx, ins, attrs):

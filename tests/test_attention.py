@@ -181,3 +181,85 @@ def test_transformer_converges_on_copy_task():
     assert np.isfinite(losses[-1])
     # chance level is ln(30) ~ 3.4; copy task must be far below it
     assert min(losses[-10:]) < 1.0, (losses[0], losses[-10:])
+
+
+def test_sdpa_seq_parallel_axis_in_program():
+    """In-program sequence parallelism: a Fluid program whose attention
+    runs ring attention over the ParallelExecutor mesh axis must match
+    the single-device run step for step (context parallelism from the
+    front-end API, not just the JAX level)."""
+    from paddle_tpu.parallel_executor import ParallelExecutor
+
+    seq, d_model, n_head, nclass = 16, 16, 4, 4
+
+    def build(seq_axis=None):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 21
+        startup.random_seed = 21
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [seq, d_model])
+            label = fluid.layers.data("label", [1], dtype="int64")
+            qkv = fluid.layers.fc(x, 3 * d_model, num_flatten_dims=2,
+                                  bias_attr=False)
+            q, k, v = fluid.layers.split(qkv, 3, dim=-1)
+
+            def heads(t):
+                t = fluid.layers.reshape(
+                    t, [-1, seq, n_head, d_model // n_head])
+                return fluid.layers.transpose(t, [0, 2, 1, 3])
+
+            ctx = fluid.layers.scaled_dot_product_attention(
+                heads(q), heads(k), heads(v), causal=True,
+                seq_parallel_axis=seq_axis)
+            ctx = fluid.layers.reshape(
+                fluid.layers.transpose(ctx, [0, 2, 1, 3]),
+                [-1, seq, d_model])
+            pooled = fluid.layers.reduce_mean(ctx, dim=1)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    fluid.layers.fc(pooled, nclass), label))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(5)
+    xs = rng.randn(4, 8, seq, d_model).astype("float32")
+    ys = rng.randint(0, nclass, (4, 8, 1)).astype("int64")
+
+    main, startup, loss = build(seq_axis=None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    single = []
+    for i in range(4):
+        (lv,) = exe.run(main, feed={"x": xs[i], "label": ys[i]},
+                        fetch_list=[loss])
+        single.append(float(np.asarray(lv).ravel()[0]))
+
+    main, startup, loss = build(seq_axis="data")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    pe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                          use_tpu=False)
+    par = []
+    for i in range(4):
+        (lv,) = pe.run(fetch_list=[loss],
+                       feed={"x": xs[i], "label": ys[i]})
+        par.append(float(np.asarray(lv).ravel()[0]))
+    np.testing.assert_allclose(single, par, rtol=1e-4, atol=1e-5)
+
+
+def test_sdpa_seq_parallel_axis_requires_mesh():
+    """Without a ParallelExecutor mesh the attr fails with a clear error
+    instead of silently running unsharded."""
+    import pytest
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.layers.data("q", [2, 8, 4])
+        out = fluid.layers.scaled_dot_product_attention(
+            q, q, q, seq_parallel_axis="data")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(Exception, match="seq_parallel_axis"):
+        exe.run(main,
+                feed={"q": np.zeros((1, 2, 8, 4), "float32")},
+                fetch_list=[out])
