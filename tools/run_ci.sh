@@ -58,7 +58,20 @@ if want bench; then
   # device enumeration); export BENCH_PLATFORM= (empty) on a TPU host to
   # let bench.py use the real chip.
   echo "== benchmark (BENCH_PLATFORM='${BENCH_PLATFORM-cpu}') =="
-  BENCH_PLATFORM="${BENCH_PLATFORM-cpu}" python bench.py
+  # bench.py itself always exits 0 (the driver must get a JSON capture even
+  # when the TPU tunnel is wedged), so CI red-flags total failure here: the
+  # line must parse and at least one model must have produced a number.
+  out="$(BENCH_PLATFORM="${BENCH_PLATFORM-cpu}" python bench.py)"
+  echo "$out"
+  echo "$out" | BENCH_EXPECT="${BENCH_MODELS-resnet50,transformer}" python -c '
+import json, os, sys
+rec = json.loads(sys.stdin.readline())
+models = rec.get("models") or {}
+want = [m.strip() for m in os.environ["BENCH_EXPECT"].split(",") if m.strip()]
+missing = [m for m in want if m not in models]
+assert not missing, "bench missing results for %s: %s" % (
+    missing, rec.get("error"))
+'
 fi
 
 echo "CI OK"
