@@ -62,6 +62,34 @@ class BuildStrategy(object):
         self.fuse_elewise_add_act_ops = False
 
 
+def _warn_noop_strategy_knobs(build_strategy, exec_strategy):
+    """Tell the user, once, when they set a knob the XLA execution model
+    makes meaningless (docs/XLA_EXECUTION.md has the per-knob rationale)."""
+    import warnings
+
+    noop = []
+    if getattr(build_strategy, "fuse_elewise_add_act_ops", False):
+        noop.append("BuildStrategy.fuse_elewise_add_act_ops")
+    bs_defaults = BuildStrategy()
+    # unlike reduce_strategy (honored in _shard_grad_outputs), these two
+    # never reach the lowering — changing them would silently change
+    # nothing, so say so
+    for f in ("gradient_scale_strategy", "enable_data_balance"):
+        if getattr(build_strategy, f, None) != getattr(bs_defaults, f):
+            noop.append("BuildStrategy.%s" % f)
+    defaults = ExecutionStrategy()
+    for f in ("num_threads", "allow_op_delay", "num_iteration_per_drop_scope",
+              "use_experimental_executor"):
+        if getattr(exec_strategy, f, None) != getattr(defaults, f):
+            noop.append("ExecutionStrategy.%s" % f)
+    if noop:
+        warnings.warn(
+            "%s have no effect: the whole program compiles to one XLA "
+            "executable, which owns scheduling and elementwise fusion — "
+            "see docs/XLA_EXECUTION.md" % ", ".join(noop),
+            UserWarning, stacklevel=3)
+
+
 class ParallelExecutor(object):
     def __init__(
         self,
@@ -83,6 +111,7 @@ class ParallelExecutor(object):
         self._scope = scope or global_scope()
         self._build_strategy = build_strategy or BuildStrategy()
         self._exec_strategy = exec_strategy or ExecutionStrategy()
+        _warn_noop_strategy_knobs(self._build_strategy, self._exec_strategy)
         self._loss_name = loss_name
         self._cache = {}
         self._run_counter = 0

@@ -25,3 +25,7 @@ from paddle_tpu.transpiler.inference_transpiler import (  # noqa: F401
 from paddle_tpu.transpiler.quantize_transpiler import (  # noqa: F401
     QuantizeTranspiler,
 )
+from paddle_tpu.transpiler.gradient_merge_transpiler import (  # noqa: F401
+    GradientMergeTranspiler,
+    rewrite_program_gradient_merge,
+)

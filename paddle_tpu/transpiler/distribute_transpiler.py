@@ -117,6 +117,15 @@ class DistributeTranspiler(object):
         self.trainer_id = trainer_id
         self.trainer_num = trainers
         self.sync_mode = sync_mode
+        if not sync_mode:
+            import warnings
+
+            warnings.warn(
+                "sync_mode=False is accepted for API parity but the "
+                "transpiled program always runs SYNCHRONOUSLY: XLA arrays "
+                "are immutable, so there is no racy-apply parameter store "
+                "to run async SGD against — see docs/XLA_EXECUTION.md and "
+                "docs/DISTRIBUTED_DESIGN.md", UserWarning, stacklevel=2)
         self.origin_program = program or framework.default_main_program()
         self.startup_program = (
             startup_program or framework.default_startup_program()

@@ -1,0 +1,216 @@
+"""Gradient merge: accumulate K microbatch grads, apply the optimizer once.
+
+Reference capability: multi_batch_merge_pass
+(paddle/fluid/framework/ir/multi_batch_merge_pass.cc) — repeat a batch K
+times, sum the grads, run one optimizer update for the merged batch.
+
+TPU-first redesign: the reference clones the forward/backward subgraph K
+times into one giant graph (K is baked into the executable and compile
+time scales with it). Here the per-microbatch step function stays intact
+and the optimizer apply becomes CONDITIONAL inside the same XLA program:
+
+- every step, each grad is added into a persistable ``@GradientMerge``
+  accumulator and a persistable step counter advances;
+- every op that writes persistable state under an Optimize/LRSched role
+  (param updates, moments, beta-pow scalings, LR schedule counters) has
+  its writes gated by ``where_select(counter == K, new, old)``;
+- on the boundary step the optimizer consumes the (optionally averaged)
+  accumulator instead of the raw microbatch grad, and the accumulators
+  reset to zero.
+
+The gate is a select, not a branch, so XLA still compiles ONE static
+program with no data-dependent control flow; the discarded update math on
+non-boundary steps is a fused elementwise pass, negligible next to
+forward+backward. Feeds stay per-microbatch (each ``exe.run`` is one
+microbatch), which the graph-cloning design cannot do.
+
+Semantics notes:
+- ``avg=True`` divides the merged grad by K, so K microbatches of size
+  B/K follow the same trajectory as one batch of size B (each microbatch
+  loss being a mean over its samples). ``avg=False`` sums.
+- Gradient clipping / regularization ops appended by ``minimize`` run on
+  the raw per-microbatch grad BEFORE accumulation (same caveat as the
+  reference pass, which merges whatever the optimizer was wired to read).
+- LR schedule ops are gated too, so a decaying schedule advances once per
+  merged step, matching the unmerged program step-for-step.
+"""
+
+from paddle_tpu import framework, initializer
+from paddle_tpu.framework import OP_ROLE_ATTR_NAME, OpRole, VarType
+
+__all__ = ["GradientMergeTranspiler", "rewrite_program_gradient_merge"]
+
+_STEP_VAR = "@GradientMerge@.step"
+_COND_VAR = "@GradientMerge@.cond"
+
+
+def _is_gated_role(op):
+    role = op.attrs.get(OP_ROLE_ATTR_NAME, OpRole.Forward)
+    return role in (OpRole.Optimize, OpRole.LRSched)
+
+
+class GradientMergeTranspiler(object):
+    """Rewrite a training Program so optimizer state only advances every
+    ``k_steps``-th run, with grads merged across the runs in between."""
+
+    def transpile(self, program=None, startup_program=None, k_steps=1,
+                  avg=True):
+        program = program or framework.default_main_program()
+        startup_program = (startup_program
+                           or framework.default_startup_program())
+        k_steps = int(k_steps)
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1, got %d" % k_steps)
+        if k_steps == 1:
+            return program  # no-op: every step is a boundary step
+        if getattr(program, "_gradient_merge_k", None):
+            # a second pass would double-increment the shared counter and
+            # stack accumulators on accumulators — corrupt, so refuse
+            raise ValueError(
+                "program is already gradient-merge transpiled (k=%d)"
+                % program._gradient_merge_k)
+        block = program.global_block()
+
+        gated_ops = [op for op in block.ops if _is_gated_role(op)]
+        opt_ops = [op for op in gated_ops if op.input("Grad")
+                   and op.input("Param")]
+        if not opt_ops:
+            raise ValueError(
+                "gradient merge needs a program with optimizer ops "
+                "(call optimizer.minimize before transpiling)")
+        for op in opt_ops:
+            gvar = block._find_var_recursive(op.input("Grad")[0])
+            if gvar is not None and gvar.type == VarType.SELECTED_ROWS:
+                raise ValueError(
+                    "gradient merge does not support sparse "
+                    "(SELECTED_ROWS) gradients: %r" % gvar.name)
+
+        self._insert_counter(block, startup_program, k_steps)
+        self._accumulate_grads(block, startup_program, opt_ops, k_steps, avg)
+        self._gate_persistable_writes(block, gated_ops)
+        self._reset_accumulators(block)
+        program._gradient_merge_k = k_steps
+        program._bump_version()
+        return program
+
+    # -- pieces -------------------------------------------------------------
+    @staticmethod
+    def _startup_zero_var(startup_program, name, shape, dtype):
+        sb = startup_program.global_block()
+        if not sb.has_var(name):
+            sv = sb.create_var(name=name, shape=shape, dtype=dtype,
+                               persistable=True)
+            initializer.ConstantInitializer(0.0)(sv, sb)
+
+    def _insert_counter(self, block, startup_program, k_steps):
+        """Prepend: step += 1; cond = (step == K); step = cond ? 0 : step.
+        Prepending (not inserting at the first optimize op) makes the gate
+        available to LR-schedule ops, which sit early in the block."""
+        attrs = {OP_ROLE_ATTR_NAME: OpRole.Optimize}
+        block.create_var(name=_STEP_VAR, shape=[1], dtype="int32",
+                         persistable=True)
+        block.create_var(name=_COND_VAR, shape=[1], dtype="bool")
+        k_var = block.create_var(name="@GradientMerge@.k", shape=[1],
+                                 dtype="int32")
+        zero = block.create_var(name="@GradientMerge@.zero", shape=[1],
+                                dtype="int32")
+        self._startup_zero_var(startup_program, _STEP_VAR, [1], "int32")
+        ops = [
+            ("fill_constant", {}, {"Out": [k_var.name]},
+             dict(attrs, shape=[1], dtype="int32", value=float(k_steps))),
+            ("fill_constant", {}, {"Out": [zero.name]},
+             dict(attrs, shape=[1], dtype="int32", value=0.0)),
+            ("increment", {"X": [_STEP_VAR]}, {"Out": [_STEP_VAR]},
+             dict(attrs, step=1.0)),
+            ("equal", {"X": [_STEP_VAR], "Y": [k_var.name]},
+             {"Out": [_COND_VAR]}, dict(attrs)),
+            ("where_select",
+             {"Cond": [_COND_VAR], "X": [zero.name], "Y": [_STEP_VAR]},
+             {"Out": [_STEP_VAR]}, dict(attrs)),
+        ]
+        for i, (tp, ins, outs, at) in enumerate(ops):
+            block.insert_op(i, type=tp, inputs=ins, outputs=outs, attrs=at)
+
+    def _accumulate_grads(self, block, startup_program, opt_ops, k_steps,
+                          avg):
+        """acc += grad right before each optimize op; point its Grad input
+        at the (averaged) accumulator."""
+        attrs = {OP_ROLE_ATTR_NAME: OpRole.Optimize}
+        self._acc_names = []
+        done = set()
+        for op in opt_ops:
+            g_name = op.input("Grad")[0]
+            gvar = block._find_var_recursive(g_name)
+            acc_name = g_name + "@GradientMerge"
+            read_name = acc_name + "@AVG" if avg else acc_name
+            if g_name not in done:
+                done.add(g_name)
+                self._acc_names.append(acc_name)
+                block.create_var(name=acc_name, shape=gvar.shape,
+                                 dtype=gvar.dtype, persistable=True)
+                self._startup_zero_var(startup_program, acc_name,
+                                       list(gvar.shape or [1]), gvar.dtype)
+                idx = block.ops.index(op)
+                block.insert_op(
+                    idx, type="elementwise_add",
+                    inputs={"X": [acc_name], "Y": [g_name]},
+                    outputs={"Out": [acc_name]}, attrs=dict(attrs))
+                if avg:
+                    block.create_var(name=read_name, shape=gvar.shape,
+                                     dtype=gvar.dtype)
+                    block.insert_op(
+                        idx + 1, type="scale",
+                        inputs={"X": [acc_name]},
+                        outputs={"Out": [read_name]},
+                        attrs=dict(attrs, scale=1.0 / k_steps))
+            op.inputs["Grad"] = [read_name]
+
+    def _gate_persistable_writes(self, block, gated_ops):
+        """For each Optimize/LRSched op output bound to a persistable var,
+        reroute the write to a temp and select (cond ? new : old) back into
+        the var, so state only advances on boundary steps."""
+        attrs = {OP_ROLE_ATTR_NAME: OpRole.Optimize}
+        for op_seq, op in enumerate(gated_ops):
+            selects = []
+            for slot, names in op.outputs.items():
+                for j, name in enumerate(names):
+                    var = block._find_var_recursive(name)
+                    if var is None or not var.persistable:
+                        continue
+                    tmp = block.create_var(
+                        name="%s@GM_NEW.%d" % (name, op_seq),
+                        shape=var.shape, dtype=var.dtype)
+                    names[j] = tmp.name
+                    selects.append((tmp.name, name))
+            idx = block.ops.index(op) + 1
+            for tmp_name, name in selects:
+                block.insert_op(
+                    idx, type="where_select",
+                    inputs={"Cond": [_COND_VAR], "X": [tmp_name],
+                            "Y": [name]},
+                    outputs={"Out": [name]}, attrs=dict(attrs))
+                idx += 1
+
+    def _reset_accumulators(self, block):
+        """Append: acc = cond ? zeros : acc, for every accumulator."""
+        attrs = {OP_ROLE_ATTR_NAME: OpRole.Optimize}
+        for acc_name in self._acc_names:
+            zero_name = acc_name + "@ZERO"
+            var = block.var(acc_name)
+            block.create_var(name=zero_name, shape=var.shape,
+                             dtype=var.dtype)
+            block.append_op(
+                type="fill_zeros_like", inputs={"X": [acc_name]},
+                outputs={"Out": [zero_name]}, attrs=dict(attrs))
+            block.append_op(
+                type="where_select",
+                inputs={"Cond": [_COND_VAR], "X": [zero_name],
+                        "Y": [acc_name]},
+                outputs={"Out": [acc_name]}, attrs=dict(attrs))
+
+
+def rewrite_program_gradient_merge(program=None, startup_program=None,
+                                   k_steps=1, avg=True):
+    """Functional wrapper over :class:`GradientMergeTranspiler`."""
+    return GradientMergeTranspiler().transpile(
+        program, startup_program, k_steps=k_steps, avg=avg)

@@ -1,0 +1,168 @@
+"""Gradient merge (accumulation) parity tests.
+
+Reference capability: multi_batch_merge_pass
+(paddle/fluid/framework/ir/multi_batch_merge_pass.cc). The contract under
+test: training with batch size N for T steps follows the SAME parameter
+trajectory as training with batch size N/K for K*T runs under
+``rewrite_program_gradient_merge(k_steps=K, avg=True)``.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler import rewrite_program_gradient_merge
+
+
+def _build(optimizer_fn, seed=123):
+    from paddle_tpu import unique_name
+
+    unique_name.switch()  # same param names across rebuilt programs
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=10, act="tanh")
+        pred = fluid.layers.fc(input=h, size=1, act=None)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        optimizer_fn().minimize(loss)
+    return main, startup, loss
+
+
+def _data(n=64, seed=7):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 6).astype("float32")
+    w = rng.randn(6, 1).astype("float32")
+    y = (x @ w + 0.1 * rng.randn(n, 1)).astype("float32")
+    return x, y
+
+
+def _params(exe_scope, main):
+    out = {}
+    for p in main.global_block().all_parameters():
+        out[p.name] = np.asarray(exe_scope.find_var(p.name).value)
+    return out
+
+
+def _run_trajectory(optimizer_fn, k_steps, big_bs=16, n_big_steps=6):
+    """Train; return final params. k_steps=1 trains on full batches;
+    k_steps>1 feeds each big batch as k_steps microbatches under the
+    gradient-merge rewrite."""
+    main, startup, loss = _build(optimizer_fn)
+    if k_steps > 1:
+        rewrite_program_gradient_merge(main, startup, k_steps=k_steps,
+                                       avg=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        x, y = _data(big_bs * n_big_steps)
+        micro = big_bs // k_steps
+        for s in range(n_big_steps):
+            xb = x[s * big_bs:(s + 1) * big_bs]
+            yb = y[s * big_bs:(s + 1) * big_bs]
+            for m in range(k_steps):
+                exe.run(main,
+                        feed={"x": xb[m * micro:(m + 1) * micro],
+                              "y": yb[m * micro:(m + 1) * micro]},
+                        fetch_list=[loss])
+        return _params(fluid.executor.global_scope(), main)
+
+
+@pytest.mark.parametrize("opt_fn", [
+    lambda: fluid.optimizer.SGD(learning_rate=0.05),
+    lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+    lambda: fluid.optimizer.Adam(learning_rate=0.01),
+], ids=["sgd", "momentum", "adam"])
+def test_merged_matches_full_batch(opt_fn):
+    full = _run_trajectory(opt_fn, k_steps=1)
+    merged = _run_trajectory(opt_fn, k_steps=4)
+    assert set(full) == set(merged)
+    for name in full:
+        np.testing.assert_allclose(
+            merged[name], full[name], rtol=2e-4, atol=2e-5,
+            err_msg="param %s diverged under gradient merge" % name)
+
+
+def test_state_frozen_between_boundaries():
+    """Params must NOT move on non-boundary microbatch runs."""
+    main, startup, loss = _build(
+        lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    rewrite_program_gradient_merge(main, startup, k_steps=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        x, y = _data(12)
+        scope = fluid.executor.global_scope()
+        p0 = _params(scope, main)
+        exe.run(main, feed={"x": x[:4], "y": y[:4]}, fetch_list=[loss])
+        p1 = _params(scope, main)
+        for name in p0:
+            np.testing.assert_array_equal(p0[name], p1[name])
+        exe.run(main, feed={"x": x[4:8], "y": y[4:8]}, fetch_list=[loss])
+        exe.run(main, feed={"x": x[8:], "y": y[8:]}, fetch_list=[loss])
+        p3 = _params(scope, main)
+        moved = any(
+            not np.array_equal(p0[name], p3[name]) for name in p0)
+        assert moved, "no parameter moved after the boundary step"
+
+
+def test_lr_schedule_advances_per_merged_step():
+    """A decaying schedule must step once per K microbatches, matching the
+    unmerged program's per-step decay."""
+    def build(k):
+        from paddle_tpu import unique_name
+
+        unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=1, act=None)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            lr = fluid.layers.exponential_decay(
+                learning_rate=0.1, decay_steps=1, decay_rate=0.5,
+                staircase=True)
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        if k > 1:
+            rewrite_program_gradient_merge(main, startup, k_steps=k)
+        return main, startup, loss
+
+    results = {}
+    for k in (1, 2):
+        main, startup, loss = build(k)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            x, yv = _data(8, seed=3)
+            for s in range(2 * k):  # 2 merged steps for either k
+                exe.run(main, feed={"x": x[:4], "y": yv[:4]},
+                        fetch_list=[loss])
+            results[k] = _params(fluid.executor.global_scope(), main)
+    # k=1 ran 2 steps; k=2 ran 4 microbatches = 2 merged steps on the
+    # same (repeated) batch -> identical decay count and trajectory
+    for name in results[1]:
+        np.testing.assert_allclose(results[2][name], results[1][name],
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_rejects_bad_k_and_missing_optimizer():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=1)
+    with pytest.raises(ValueError):
+        rewrite_program_gradient_merge(main, startup, k_steps=0)
+    with pytest.raises(ValueError):
+        rewrite_program_gradient_merge(main, startup, k_steps=2)
+
+
+def test_rejects_double_transpile():
+    main, startup, _ = _build(lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    rewrite_program_gradient_merge(main, startup, k_steps=2)
+    with pytest.raises(ValueError, match="already"):
+        rewrite_program_gradient_merge(main, startup, k_steps=2)

@@ -1,0 +1,64 @@
+"""Knobs the XLA execution model makes meaningless must WARN, not pass
+silently (docs/XLA_EXECUTION.md; the reference honors these knobs, so a
+porting user needs to hear about the difference immediately)."""
+
+import warnings
+
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _tiny_train_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_fuse_elewise_knob_warns():
+    main, startup, loss = _tiny_train_program()
+    bs = fluid.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    with pytest.warns(UserWarning, match="fuse_elewise_add_act_ops"):
+        fluid.ParallelExecutor(loss_name=loss.name, main_program=main,
+                               build_strategy=bs, num_devices=1)
+
+
+def test_gradient_scale_strategy_warns():
+    main, startup, loss = _tiny_train_program()
+    bs = fluid.BuildStrategy()
+    bs.gradient_scale_strategy = fluid.BuildStrategy.GradientScaleStrategy.One
+    with pytest.warns(UserWarning, match="gradient_scale_strategy"):
+        fluid.ParallelExecutor(loss_name=loss.name, main_program=main,
+                               build_strategy=bs, num_devices=1)
+
+
+def test_exec_strategy_scheduler_knobs_warn():
+    main, startup, loss = _tiny_train_program()
+    es = fluid.ExecutionStrategy()
+    es.num_threads = 8
+    es.allow_op_delay = True
+    with pytest.warns(UserWarning, match="num_threads"):
+        fluid.ParallelExecutor(loss_name=loss.name, main_program=main,
+                               exec_strategy=es, num_devices=1)
+
+
+def test_default_strategies_do_not_warn():
+    main, startup, loss = _tiny_train_program()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        fluid.ParallelExecutor(loss_name=loss.name, main_program=main,
+                               num_devices=1)
+
+
+def test_async_dist_transpile_warns():
+    main, startup, loss = _tiny_train_program()
+    t = fluid.DistributeTranspiler()
+    with pytest.warns(UserWarning, match="SYNCHRONOUS"):
+        t.transpile(trainer_id=0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:6174", trainers=1, sync_mode=False)
