@@ -17,6 +17,7 @@ num_trainers/trainer_id (NCCL2 multi-node) -> jax.distributed processes.
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from paddle_tpu import framework
 from paddle_tpu.core.lod import LoDTensor
@@ -106,6 +107,8 @@ class ParallelExecutor(object):
         num_devices=None,
         model_sharded_vars=None,
         sharding_overrides=None,
+        pipeline_stages=None,
+        pipeline_microbatches=None,
     ):
         self._program = main_program or framework.default_main_program()
         self._scope = scope or global_scope()
@@ -150,7 +153,28 @@ class ParallelExecutor(object):
         non_cpu = [d for d in devices if d.platform != "cpu"]
         pool = non_cpu if (use_tpu and non_cpu) else devices
         n = num_devices or len(pool)
-        self.mesh = build_mesh(num_devices=n, devices=pool)
+        # Program-level pipeline parallelism: cut the Program into S
+        # stages over the mesh's pipe axis (parallel/program_pipeline.py);
+        # remaining devices form the data axis (pipeline x dp).
+        self._pipeline_stages = pipeline_stages
+        self._pipeline_micro = pipeline_microbatches or (
+            2 * pipeline_stages if pipeline_stages else None)
+        self._pipeline_entry = None
+        if pipeline_stages:
+            if n % pipeline_stages:
+                raise ValueError(
+                    "pipeline_stages=%d must divide the device count %d"
+                    % (pipeline_stages, n))
+            if self._num_trainers > 1:
+                raise NotImplementedError(
+                    "pipeline_stages does not yet compose with "
+                    "num_trainers>1 (multi-host feed assembly is only "
+                    "wired for the data-parallel path)")
+            self.mesh = build_mesh(
+                num_devices=n, data=n // pipeline_stages,
+                pipe=pipeline_stages, devices=pool)
+        else:
+            self.mesh = build_mesh(num_devices=n, devices=pool)
         self._model_sharded_vars = set(model_sharded_vars or ())
         # Tensor-parallel layout control: var name -> PartitionSpec (or a
         # plain tuple of axis names / None). GSPMD inserts the matching
@@ -211,6 +235,8 @@ class ParallelExecutor(object):
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else (feed_dict or {})
+        if self._pipeline_stages:
+            return self._run_pipeline(fetch_list, feed, return_numpy)
         if isinstance(feed, list):
             # per-device feed dicts (fluid API) -> concat along batch.
             merged = {}
@@ -284,6 +310,83 @@ class ParallelExecutor(object):
         if return_numpy:
             fetches = [self._fetch_to_numpy(f) for f in fetches]
         return fetches
+
+    # -- program-level pipeline path ---------------------------------------
+    def _run_pipeline(self, fetch_list, feed, return_numpy):
+        from paddle_tpu.parallel.program_pipeline import PipelinedProgram
+
+        if isinstance(feed, list):
+            feed = {
+                name: np.concatenate(
+                    [np.asarray(d[name]) for d in feed], axis=0)
+                for name in feed[0]
+            }
+        fetch_names = [
+            v.name if isinstance(v, framework.Variable) else str(v)
+            for v in fetch_list
+        ]
+        if self._loss_name and fetch_names and fetch_names != [
+                self._loss_name]:
+            raise ValueError(
+                "pipeline runs fetch only the loss (%r), got %r — params "
+                "live packed per stage; use pipeline_sync_scope() to "
+                "inspect them" % (self._loss_name, fetch_names))
+        feeds = {}
+        feed_specs = {}
+        for name, value in feed.items():
+            arr = (
+                np.asarray(value.numpy())
+                if isinstance(value, LoDTensor)
+                else np.asarray(value)
+            )
+            feeds[name] = arr
+            feed_specs[name] = (tuple(arr.shape), str(arr.dtype))
+        sig = (self._program._version, tuple(sorted(feed_specs.items())),
+               _trace_flags_key())
+        entry = self._pipeline_entry
+        if entry is None or entry["sig"] != sig:
+            if entry is not None:
+                # the executable is stale (new feed shapes or program
+                # version) but the TRAINED packed state is not: flush it
+                # to the scope so the rebuilt entry repacks current values
+                self.pipeline_sync_scope()
+            pp = PipelinedProgram(
+                self._program,
+                self._loss_name,
+                feed_specs,
+                self.mesh,
+                self._pipeline_micro,
+                batch_axis="data" if self.mesh.shape["data"] > 1 else None,
+            )
+            entry = {"pp": pp, "state": pp.pack_from_scope(self._scope),
+                     "sig": sig}
+            self._pipeline_entry = entry
+        pp = entry["pp"]
+        params, accs, scalars = entry["state"]
+        self._run_counter += 1
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self._program.random_seed or self._base_seed),
+            self._run_counter,
+        )
+        params, accs, scalars, loss = pp.jitted(
+            params, accs, scalars, feeds, key)
+        entry["state"] = (params, accs, scalars)
+        # scalar persistables (lr counters, beta pows) stay scope-visible
+        for n, val in scalars.items():
+            self._scope.set_value(n, val)
+        if not fetch_names:
+            return []
+        if return_numpy:
+            return [np.reshape(np.asarray(loss), (1,))]
+        return [jnp.reshape(loss, (1,))]
+
+    def pipeline_sync_scope(self):
+        """Unpack the pipeline's packed params/accumulators back into their
+        per-name scope vars (so save_persistables etc. see current values)."""
+        entry = self._pipeline_entry
+        if entry is not None:
+            params, accs, _ = entry["state"]
+            entry["pp"].unpack_to_scope(self._scope, params, accs)
 
     def _ensure_sharded(self, val, target):
         """Reshard ``val`` to ``target`` if it is not already equivalent."""

@@ -1,0 +1,205 @@
+"""Program-level pipeline parallelism (parallel/program_pipeline.py).
+
+The contract: ParallelExecutor(pipeline_stages=S) trains an ORDINARY
+Program (heterogeneous per-stage params, optimizer.minimize) over the
+mesh's pipe axis with loss parity against the plain single-device
+Executor — the transparent multi-device story of the reference's
+multi_devices_graph_pass.cc, extended to the pipeline dimension.
+Runs on the 8-device virtual CPU mesh (conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _deep_mlp(depth=8, width=32, seed=11):
+    from paddle_tpu import unique_name
+
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[width], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = x
+        for _ in range(depth):
+            h = fluid.layers.fc(input=h, size=width, act="tanh")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Momentum(
+            learning_rate=0.05, momentum=0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _block_stack(n_blocks=4, width=32, seed=13):
+    """Encoder-style residual blocks (fc + residual + layer_norm):
+    heterogeneous params, single-var block boundaries."""
+    from paddle_tpu import unique_name
+
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[width], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=width, act=None)
+        for _ in range(n_blocks):
+            inner = fluid.layers.fc(input=h, size=width * 2, act="relu")
+            proj = fluid.layers.fc(input=inner, size=width, act=None)
+            h = fluid.layers.layer_norm(h + proj)
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    return main, startup, loss
+
+
+def _data(bs=32, width=32, seed=2):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(bs, width).astype("float32")
+    w = rng.randn(width, 1).astype("float32")
+    ys = (np.tanh(xs) @ w).astype("float32")
+    return xs, ys
+
+
+def _train(build, runner, steps=12):
+    """runner(main, startup, loss) -> callable(feed) -> loss value."""
+    with fluid.scope_guard(fluid.executor.Scope()):
+        main, startup, loss = build()
+        step = runner(main, startup, loss)
+        xs, ys = _data()
+        return [float(step({"x": xs, "y": ys})) for _ in range(steps)]
+
+
+def _single_device(main, startup, loss):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return lambda feed: exe.run(main, feed=feed, fetch_list=[loss])[0][0]
+
+
+def _pipelined(stages, micro, num_devices=None):
+    def runner(main, startup, loss):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main,
+            pipeline_stages=stages, pipeline_microbatches=micro,
+            num_devices=num_devices)
+        return lambda feed: pe.run([loss], feed=feed)[0][0]
+
+    return runner
+
+
+@pytest.mark.parametrize("stages,micro,ndev,rtol", [
+    (4, 4, 4, 5e-4),   # pure pipeline
+    # dp reduces the batch mean in a different order; float drift
+    # compounds along the trajectory, hence the looser bound
+    (4, 4, 8, 5e-3),   # pipeline x data parallel (data axis = 2)
+    (8, 4, 8, 5e-4),   # one stage per device
+], ids=["pipe4", "pipe4xdp2", "pipe8"])
+def test_mlp_loss_parity(stages, micro, ndev, rtol):
+    base = _train(_deep_mlp, _single_device)
+    piped = _train(_deep_mlp, _pipelined(stages, micro, ndev))
+    np.testing.assert_allclose(piped, base, rtol=rtol, atol=1e-5)
+
+
+def test_block_stack_adam_parity():
+    """Heterogeneous stages (first/last differ from the middle) + Adam
+    (packed moments + shared beta-pow scalars)."""
+    base = _train(_block_stack, _single_device)
+    piped = _train(_block_stack, _pipelined(4, 4, 8))
+    np.testing.assert_allclose(piped, base, rtol=1e-3, atol=1e-5)
+
+
+def test_params_sync_back_to_scope():
+    with fluid.scope_guard(fluid.executor.Scope()):
+        main, startup, loss = _deep_mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.global_scope()
+        before = {
+            p.name: np.asarray(scope.find_var(p.name).value).copy()
+            for p in main.global_block().all_parameters()}
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main,
+            pipeline_stages=4, pipeline_microbatches=4, num_devices=4)
+        xs, ys = _data()
+        for _ in range(3):
+            pe.run([loss], feed={"x": xs, "y": ys})
+        pe.pipeline_sync_scope()
+        moved = 0
+        for name, old in before.items():
+            new = np.asarray(scope.find_var(name).value)
+            assert new.shape == old.shape
+            if not np.array_equal(new, old):
+                moved += 1
+        assert moved == len(before), (
+            "only %d/%d params updated in scope" % (moved, len(before)))
+
+
+def test_rejects_non_loss_fetch_and_bad_batch():
+    with fluid.scope_guard(fluid.executor.Scope()):
+        main, startup, loss = _deep_mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main,
+            pipeline_stages=4, pipeline_microbatches=4, num_devices=4)
+        xs, ys = _data()
+        with pytest.raises(ValueError, match="fetch only the loss"):
+            pe.run(["fc_0.w_0"], feed={"x": xs, "y": ys})
+        with pytest.raises(ValueError, match="divide"):
+            pe.run([loss], feed={"x": xs[:30], "y": ys[:30]})
+
+
+def test_feed_shape_change_keeps_training_state():
+    """A new batch size must rebuild the executable, NOT restart training
+    from the startup weights."""
+    with fluid.scope_guard(fluid.executor.Scope()):
+        main, startup, loss = _deep_mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main,
+            pipeline_stages=4, pipeline_microbatches=4, num_devices=4)
+        xs, ys = _data(bs=32)
+        first = float(pe.run([loss], feed={"x": xs, "y": ys})[0][0])
+        for _ in range(10):
+            lv = float(pe.run([loss], feed={"x": xs, "y": ys})[0][0])
+        assert lv < first
+        # half-size batch: new shapes, same (carried-over) weights
+        lv_small = float(
+            pe.run([loss], feed={"x": xs[:16], "y": ys[:16]})[0][0])
+        assert lv_small < 0.9 * first, (
+            "feed-shape change restarted training: %.4f vs first %.4f"
+            % (lv_small, first))
+
+
+def test_list_feed_and_device_array_fetch():
+    with fluid.scope_guard(fluid.executor.Scope()):
+        main, startup, loss = _deep_mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main,
+            pipeline_stages=4, pipeline_microbatches=4, num_devices=4)
+        xs, ys = _data(bs=32)
+        # fluid-style per-device list feed: concatenated along batch
+        out = pe.run([loss], feed=[
+            {"x": xs[:16], "y": ys[:16]}, {"x": xs[16:], "y": ys[16:]}])
+        assert np.isfinite(float(out[0][0]))
+        out = pe.run([loss], feed={"x": xs, "y": ys}, return_numpy=False)
+        import jax
+
+        assert isinstance(out[0], jax.Array), type(out[0])
+
+
+def test_rejects_undivisible_stages():
+    main, startup, loss = _deep_mlp()
+    with pytest.raises(ValueError, match="divide the device count"):
+        fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main,
+            pipeline_stages=3, pipeline_microbatches=4, num_devices=8)
