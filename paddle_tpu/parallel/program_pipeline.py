@@ -158,11 +158,21 @@ def _find_cuts(block, fwd_ops, feed_names, n_stages):
                     block._find_var_recursive(name))
     cum = np.cumsum(weight)
     total = float(cum[-1]) or 1.0
+    group.sort(key=lambda c: c[0])
     cuts = []
     for s in range(1, n_stages):
         target = total * s / n_stages
+        remaining_after = n_stages - 1 - s
+        # a pick must stay increasing AND leave enough later candidates
+        # for the cuts still to be placed (greedy-by-target alone could
+        # grab a late position and strand the tail)
+        feasible = [
+            c for i, c in enumerate(group)
+            if (not cuts or c[0] > cuts[-1][0])
+            and len(group) - i - 1 >= remaining_after
+        ]
         best = min(
-            (c for c in group if not cuts or c[0] > cuts[-1][0]),
+            feasible,
             key=lambda c: abs(float(cum[c[0] - 1]) - target),
             default=None)
         if best is None:
@@ -260,10 +270,8 @@ class PipelinedProgram(object):
                         seg.feed_names.append(name)
                 produced.update(op.output_arg_names())
             self.segments.append(seg)
-        if self.loss_name not in set(
-                self.segments[-1].ops and
-                [n for op in self.segments[-1].ops
-                 for n in op.output_arg_names()]):
+        if not any(self.loss_name in op.output_arg_names()
+                   for op in self.segments[-1].ops):
             raise ValueError(
                 "pipeline: loss %r is not produced by the last stage"
                 % self.loss_name)
@@ -340,7 +348,7 @@ class PipelinedProgram(object):
                 self.scalar_state.append(n)
 
     # -- the compiled step --------------------------------------------------
-    def _branch(self, s, micro_local):
+    def _branch(self, s):
         seg = self.segments[s]
         layout = self.layouts[s]
         lowerer = self.lowerer
@@ -372,7 +380,7 @@ class PipelinedProgram(object):
         """Trace stage 0 alone to learn the boundary activation shape for
         one LOCAL microbatch (batch dim = B / M / data_parallel)."""
         micro = self._micro_local(feed_specs)
-        branch0 = self._branch(0, micro)
+        branch0 = self._branch(0)
 
         def probe(feeds):
             vec = jnp.zeros((self.row_len,), jnp.float32)
@@ -406,8 +414,7 @@ class PipelinedProgram(object):
         axis = self.axis_name
         n, m = self.n_stages, self.n_micro
         act_shape, act_dtype = self._boundary_act_spec(feed_specs)
-        micro_local = self._micro_local(feed_specs)
-        branches = [self._branch(s, micro_local) for s in range(n)]
+        branches = [self._branch(s) for s in range(n)]
         fwd_perm = [(i, i + 1) for i in range(n - 1)]
         batch_axis = self.batch_axis
 

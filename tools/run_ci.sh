@@ -63,7 +63,7 @@ if want bench; then
   # line must parse and at least one model must have produced a number.
   out="$(BENCH_PLATFORM="${BENCH_PLATFORM-cpu}" python bench.py)"
   echo "$out"
-  echo "$out" | BENCH_EXPECT="${BENCH_MODELS-resnet50,transformer}" python -c '
+  echo "$out" | BENCH_EXPECT="${BENCH_MODELS-${BENCH_MODEL-resnet50,transformer}}" python -c '
 import json, os, sys
 rec = json.loads(sys.stdin.readline())
 models = rec.get("models") or {}
