@@ -104,16 +104,28 @@ def _bench_resnet(fluid, on_tpu, use_amp):
         img, bs, steps, warmup = 224, 128, 50, 10
     else:
         img, bs, steps, warmup = 64, 16, 5, 2
+    # BENCH_DATA=host feeds real numpy batches through the PyReader path
+    # (h2d transfer on the timed path; BENCH_DOUBLE_BUFFER=0 disables the
+    # device prefetch so the overlap win is measurable). Default "graph"
+    # keeps the in-graph generator: the framework step, not the host link.
+    host_data = os.environ.get("BENCH_DATA", "graph") == "host"
+    double_buffer = os.environ.get("BENCH_DOUBLE_BUFFER", "1") == "1"
 
     main_prog, startup = fluid.Program(), fluid.Program()
     main_prog.random_seed = 5
     startup.random_seed = 5
     with fluid.program_guard(main_prog, startup):
-        pixel, label = fluid.layers.random_data_generator(
-            shapes=[[bs, 3, img, img], [bs, 1]],
-            dtypes=["float32", "int64"],
-            int_high=999,
-        )
+        if host_data:
+            pixel = fluid.layers.data(
+                name="bench_pixel", shape=[3, img, img], dtype="float32")
+            label = fluid.layers.data(
+                name="bench_label", shape=[1], dtype="int64")
+        else:
+            pixel, label = fluid.layers.random_data_generator(
+                shapes=[[bs, 3, img, img], [bs, 1]],
+                dtypes=["float32", "int64"],
+                int_high=999,
+            )
         predict = resnet.resnet_imagenet(pixel, 1000, depth=50)
         cost = fluid.layers.cross_entropy(input=predict, label=label)
         loss = fluid.layers.mean(cost)
@@ -124,7 +136,13 @@ def _bench_resnet(fluid, on_tpu, use_amp):
     place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
     exe = fluid.Executor(place)
     exe.run(startup)
-    dt, lv, mode = _timed_steps(exe, main_prog, loss, steps, warmup)
+    if host_data:
+        dt, lv = _host_data_steps(
+            fluid, exe, main_prog, loss, steps, warmup, bs, img, place,
+            double_buffer)
+        mode = "host-data" + ("+double-buffer" if double_buffer else "")
+    else:
+        dt, lv, mode = _timed_steps(exe, main_prog, loss, steps, warmup)
     assert np.isfinite(lv), "non-finite loss %r" % lv
     img_per_sec = steps * bs / dt
     return {
@@ -136,6 +154,50 @@ def _bench_resnet(fluid, on_tpu, use_amp):
         "rate": img_per_sec,
         "mode": mode,
     }
+
+
+def _host_data_steps(fluid, exe, main_prog, loss, steps, warmup, bs, img,
+                     place, double_buffer):
+    """Timed loop fed per-step from a PyReader over pre-generated numpy
+    batches: the h2d transfer is ON the timed path, so the double-buffer
+    prefetch delta is what this mode exists to measure."""
+    rng = np.random.RandomState(13)
+    n_distinct = 8  # enough to defeat any transfer caching, bounded RAM
+    batches = [
+        {"bench_pixel": rng.rand(bs, 3, img, img).astype("float32"),
+         "bench_label": rng.randint(0, 999, (bs, 1)).astype("int64")}
+        for _ in range(n_distinct)
+    ]
+
+    def make_reader(n):
+        def reader():
+            for i in range(n):
+                yield batches[i % n_distinct]
+        return reader
+
+    # dict batches bypass feed slots, so the PyReader is constructed bare
+    # (py_reader() would append unused slot vars to the default program)
+    from paddle_tpu.layers.io import PyReader
+
+    pyreader = PyReader([], capacity=4, use_double_buffer=double_buffer)
+
+    pyreader.decorate_paddle_reader(make_reader(warmup))
+    pyreader.start(place=place if double_buffer else None)
+    for _ in range(warmup):
+        exe.run(main_prog, feed=pyreader.next_feed(), fetch_list=[])
+    pyreader.reset()
+
+    pyreader.decorate_paddle_reader(make_reader(steps))
+    # clock starts BEFORE reader start in both modes: the double buffer's
+    # head-start transfers are part of what the comparison measures
+    t0 = time.perf_counter()
+    pyreader.start(place=place if double_buffer else None)
+    for _ in range(steps - 1):
+        exe.run(main_prog, feed=pyreader.next_feed(), fetch_list=[])
+    out = exe.run(main_prog, feed=pyreader.next_feed(), fetch_list=[loss])
+    dt = time.perf_counter() - t0
+    pyreader.reset()
+    return dt, float(np.ravel(np.asarray(out[0]))[0])
 
 
 def _bench_transformer(fluid, on_tpu, use_amp):

@@ -48,15 +48,21 @@ def scope_guard(scope):
 
 
 def _as_feed_array(value, place):
-    """numpy / LoDTensor -> (device array, lod or None)."""
+    """numpy / LoDTensor / device array -> (array, lod or None). Device
+    arrays (a double-buffered PyReader's prefetched feeds) pass through
+    untouched — np.asarray would block on the in-flight transfer and
+    round-trip the data through the host."""
     if isinstance(value, LoDTensor):
         return np.asarray(value.numpy()), value.lod() or None
+    if isinstance(value, jax.Array):
+        return value, None
     return np.asarray(value), None
 
 
 # Flags whose value changes what the block lowers TO (not just runtime
 # behavior); they join the executable cache key so toggling recompiles.
-_TRACE_FLAGS = ("use_pallas_lstm", "use_pallas_gru", "remat_gradients")
+_TRACE_FLAGS = ("use_pallas_lstm", "use_pallas_gru", "remat_gradients",
+                "conv_nhwc")
 
 
 def _trace_flags_key():

@@ -108,6 +108,8 @@ class PyReader(object):
             self.queue = BlockingQueue(capacity)
         self._decorated = None
         self._thread = None
+        self._prefetch_q = None
+        self._prefetch_thread = None
         self.use_double_buffer = use_double_buffer
 
     def decorate_paddle_reader(self, reader):
@@ -131,7 +133,14 @@ class PyReader(object):
         self._decorated = readers
         self._passes = max(1, int(passes))
 
-    def start(self):
+    def start(self, place=None):
+        """Begin draining the decorated reader into the queue. With
+        ``use_double_buffer`` and a ``place``, a prefetch stage
+        additionally moves batches to the device AHEAD of consumption
+        (buffered_reader.h:27 capability): ``jax.device_put`` is async, so
+        the host->device copy of batch k+1 overlaps compute on batch k,
+        and next_feed() hands back device arrays the executor feeds
+        without another transfer."""
         import threading
 
         if self._decorated is None:
@@ -172,28 +181,102 @@ class PyReader(object):
 
         self._thread = threading.Thread(target=_coordinator, daemon=True)
         self._thread.start()
+        if self.use_double_buffer and place is not None:
+            self._start_prefetch(place)
+
+    def _start_prefetch(self, place):
+        """Double buffer: a host thread pops batches and device_puts them
+        up to 2 deep; the async transfer rides under the previous step's
+        compute instead of serializing in front of it."""
+        import queue as pyqueue
+        import threading
+
+        import jax
+        import numpy as np
+
+        device = place.jax_device()
+        self._prefetch_q = pyqueue.Queue(maxsize=2)
+        pq = self._prefetch_q
+
+        def _prefetcher():
+            try:
+                while True:
+                    item = self.queue.pop()
+                    if item is None:
+                        pq.put(None)
+                        return
+                    feed = self._to_feed_dict(item)
+                    feed = {
+                        k: jax.device_put(np.asarray(v), device)
+                        for k, v in feed.items()
+                    }
+                    if not self._pq_put(pq, feed):
+                        return
+            except BaseException as e:  # noqa: BLE001 - resurfaced in next_feed
+                # a device_put/conversion failure must not strand the
+                # consumer on pq.get() forever: record + sentinel
+                self._worker_error = e
+                self.queue.kill()
+                pq.put(None)
+
+        self._prefetch_thread = threading.Thread(
+            target=_prefetcher, daemon=True)
+        self._prefetch_thread.start()
+
+    def _pq_put(self, pq, feed):
+        """Bounded put that gives up when the reader is reset (the consumer
+        is gone; blocking forever would leak the thread)."""
+        import queue as pyqueue
+
+        while pq is self._prefetch_q:
+            try:
+                pq.put(feed, timeout=0.2)
+                return True
+            except pyqueue.Full:
+                continue
+        return False
+
+    def _to_feed_dict(self, item):
+        if isinstance(item, dict):
+            return item
+        return {v.name: arr for v, arr in zip(self.feed_vars, item)}
 
     def reset(self):
         self.queue.kill()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        pq = getattr(self, "_prefetch_q", None)
+        self._prefetch_q = None
+        if pq is not None:
+            while True:  # drain so a blocked prefetcher sees the reset
+                try:
+                    pq.get_nowait()
+                except Exception:
+                    break
+            self._prefetch_thread.join(timeout=5)
+            self._prefetch_thread = None
         self._worker_error = None
 
     def next_feed(self):
-        """Pop one batch -> feed dict; raises EOFException at end, or the
-        reader thread's exception if one died mid-stream."""
-        item = self.queue.pop()
+        """Pop one batch -> feed dict (device arrays when the prefetch
+        stage is on); raises EOFException at end, or the reader thread's
+        exception if one died mid-stream."""
+        pq = getattr(self, "_prefetch_q", None)
+        item = pq.get() if pq is not None else self.queue.pop()
         if item is None:
+            if pq is not None:
+                # keep the sentinel: a second post-EOF next_feed() must
+                # raise again, not block (matches the unbuffered path,
+                # where pop() on a closed queue keeps returning None)
+                pq.put(None)
             err = getattr(self, "_worker_error", None)
             if err is not None:
                 raise RuntimeError("py_reader source failed") from err
             from paddle_tpu.reader.queue import EOFException
 
             raise EOFException()
-        if isinstance(item, dict):
-            return item
-        return {v.name: arr for v, arr in zip(self.feed_vars, item)}
+        return self._to_feed_dict(item)
 
 
 def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
