@@ -33,6 +33,24 @@ inline int64_t NumElements(const std::vector<int64_t>& dims) {
   return n;
 }
 
+// xorshift64* stream shared by uniform_random and the C++ demos:
+// deterministic for a given seed, no <random> heft.
+struct XorShiftRng {
+  uint64_t s;
+  explicit XorShiftRng(uint64_t seed)
+      : s(seed ? seed : 0x9e3779b97f4a7c15ull) {}
+  uint64_t next() {
+    s ^= s >> 12;
+    s ^= s << 25;
+    s ^= s >> 27;
+    return s * 0x2545f4914f6cdd1dull;
+  }
+  float uniform() {  // [0, 1)
+    return static_cast<float>(next() >> 40) /
+           static_cast<float>(1ull << 24);
+  }
+};
+
 inline const float* F32(const HostTensor& t) {
   return reinterpret_cast<const float*>(t.data.data());
 }
@@ -116,6 +134,18 @@ class Interpreter {
     if (op.type == "sum") return RunSum(op, scope);
     if (op.type == "sequence_pool") return RunSequencePool(op, scope);
     if (op.type == "dynamic_lstm") return RunDynamicLstm(op, scope);
+    // training subset (train/demo/demo_trainer.cc parity): the backward +
+    // update ops a minimize()'d MLP program serializes
+    if (op.type == "fill_constant") return RunFillConstant(op, scope);
+    if (op.type == "uniform_random") return RunUniformRandom(op, scope);
+    if (op.type == "mean_grad") return RunMeanGrad(op, scope);
+    if (op.type == "relu_grad") return RunReluGrad(op, scope);
+    if (op.type == "softmax_with_cross_entropy_grad") {
+      return RunSCEGrad(op, scope);
+    }
+    if (op.type == "elementwise_add_grad") return RunAddGrad(op, scope);
+    if (op.type == "mul_grad") return RunMulGrad(op, scope);
+    if (op.type == "sgd") return RunSgd(op, scope);
     return "unsupported op type";
   }
 
@@ -870,6 +900,278 @@ class Interpreter {
     }
     scope->Set(*hn, std::move(hidden));
     if (cn != nullptr) scope->Set(*cn, std::move(cell));
+    return "";
+  }
+
+  // ---- training subset --------------------------------------------------
+  // Backward + update kernels for the serialized MLP training program
+  // (mul/elementwise_add/relu/softmax_with_cross_entropy/mean + sgd),
+  // matching the slot layout backward.py emits: grad ops read the forward
+  // inputs/outputs plus Out@GRAD and write <name>@GRAD.
+
+  std::string RunFillConstant(const OpDesc& op, Scope* scope) {
+    const std::string* on = OneName(op, "Out", false);
+    if (on == nullptr) return "missing io";
+    if (StrAttr(op, "dtype", "float32") != "float32") return "non-f32 fill";
+    HostTensor out = MakeF32(IntsAttr(op, "shape", {1}));
+    float v = FloatAttr(op, "value", 0.0f);
+    float* oa = MutF32(&out);
+    std::fill(oa, oa + NumElements(out.dims), v);
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunUniformRandom(const OpDesc& op, Scope* scope) {
+    const std::string* on = OneName(op, "Out", false);
+    if (on == nullptr) return "missing io";
+    HostTensor out = MakeF32(IntsAttr(op, "shape", {1}));
+    float lo = FloatAttr(op, "min", -1.0f);
+    float hi = FloatAttr(op, "max", 1.0f);
+    uint64_t seed = static_cast<uint64_t>(IntAttr(op, "seed", 0));
+    if (seed == 0) {
+      // seed 0 = "op picks": mix the output name so same-shape params
+      // do NOT share one stream (two equal fc layers must differ)
+      seed = std::hash<std::string>{}(*on) | 1;
+    }
+    XorShiftRng rng(seed);
+    float* oa = MutF32(&out);
+    int64_t n = NumElements(out.dims);
+    for (int64_t i = 0; i < n; ++i) {
+      oa[i] = lo + rng.uniform() * (hi - lo);
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunMeanGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (xn == nullptr || ogn == nullptr || gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || og == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*og)) return "non-f32 dtype";
+    int64_t n = NumElements(x->dims);
+    if (n == 0) return "empty input";
+    float g = F32(*og)[0] / static_cast<float>(n);
+    HostTensor grad = MakeF32(x->dims);
+    float* ga = MutF32(&grad);
+    std::fill(ga, ga + n, g);
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  std::string RunReluGrad(const OpDesc& op, Scope* scope) {
+    const std::string* on = OneName(op, "Out");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (on == nullptr || ogn == nullptr || gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* out = scope->Find(*on);
+    const HostTensor* og = scope->Find(*ogn);
+    if (out == nullptr || og == nullptr) return "input not in scope";
+    if (!IsF32(*out) || !IsF32(*og)) return "non-f32 dtype";
+    int64_t n = NumElements(out->dims);
+    if (n != NumElements(og->dims)) return "shape mismatch";
+    HostTensor grad = MakeF32(out->dims);
+    const float* oa = F32(*out);
+    const float* ga = F32(*og);
+    float* ra = MutF32(&grad);
+    for (int64_t i = 0; i < n; ++i) {
+      ra[i] = oa[i] > 0.0f ? ga[i] : 0.0f;
+    }
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  std::string RunSCEGrad(const OpDesc& op, Scope* scope) {
+    const std::string* sn = OneName(op, "Softmax");
+    const std::string* labn = OneName(op, "Label");
+    const std::string* ogn = OneName(op, "Loss@GRAD");
+    const std::string* gn = OneName(op, "Logits@GRAD", false);
+    if (sn == nullptr || labn == nullptr || ogn == nullptr ||
+        gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* soft = scope->Find(*sn);
+    const HostTensor* label = scope->Find(*labn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (soft == nullptr || label == nullptr || og == nullptr) {
+      return "input not in scope";
+    }
+    if (!IsF32(*soft) || soft->dims.size() != 2) return "bad softmax";
+    int64_t n = soft->dims[0], c = soft->dims[1];
+    if (NumElements(og->dims) < n) return "loss grad too small";
+    HostTensor grad = MakeF32(soft->dims);
+    const float* sa = F32(*soft);
+    const float* ga = F32(*og);
+    float* ra = MutF32(&grad);
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t gold;
+      if (label->dtype == "int64") {
+        gold = reinterpret_cast<const int64_t*>(label->data.data())[i];
+      } else if (label->dtype == "int32") {
+        gold = reinterpret_cast<const int32_t*>(label->data.data())[i];
+      } else {
+        return "label dtype";
+      }
+      if (gold < 0 || gold >= c) return "label out of range";
+      for (int64_t j = 0; j < c; ++j) {
+        float d = sa[i * c + j] - (j == gold ? 1.0f : 0.0f);
+        ra[i * c + j] = d * ga[i];
+      }
+    }
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  std::string RunAddGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* yn = OneName(op, "Y");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    if (xn == nullptr || yn == nullptr || ogn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* y = scope->Find(*yn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || y == nullptr || og == nullptr) {
+      return "input not in scope";
+    }
+    if (!IsF32(*y) || !IsF32(*og)) return "non-f32 dtype";
+    int64_t n = NumElements(og->dims);
+    const std::string* xgn = OneName(op, "X@GRAD", false);
+    if (xgn != nullptr) {  // dL/dX = dL/dOut
+      HostTensor xg = MakeF32(og->dims);
+      std::copy(F32(*og), F32(*og) + n, MutF32(&xg));
+      scope->Set(*xgn, std::move(xg));
+    }
+    const std::string* ygn = OneName(op, "Y@GRAD", false);
+    if (ygn != nullptr) {
+      // reduce dOut onto y with the SAME index mapping the forward
+      // broadcast used: y element of out[i] is (i / inner) % ny
+      int64_t ax = IntAttr(op, "axis", -1);
+      if (ax < 0) {
+        ax = static_cast<int64_t>(x->dims.size()) -
+             static_cast<int64_t>(y->dims.size());
+      }
+      std::vector<int64_t> ydims = y->dims;
+      while (ydims.size() > 1 && ydims.back() == 1) ydims.pop_back();
+      if (ax < 0 || ax + ydims.size() > x->dims.size()) {
+        return "broadcast axis out of range";
+      }
+      for (size_t d = 0; d < ydims.size(); ++d) {
+        if (ydims[d] != x->dims[ax + d]) {
+          return "broadcast shape mismatch";
+        }
+      }
+      int64_t yn_elems = NumElements(y->dims);
+      if (yn_elems == 0 || n % yn_elems != 0) return "bad broadcast";
+      int64_t inner = 1;
+      for (size_t d = ax + ydims.size(); d < x->dims.size(); ++d) {
+        inner *= x->dims[d];
+      }
+      if (inner <= 0) return "bad broadcast";
+      HostTensor yg = MakeF32(y->dims);
+      float* ya = MutF32(&yg);
+      std::fill(ya, ya + yn_elems, 0.0f);
+      const float* ga = F32(*og);
+      for (int64_t i = 0; i < n; ++i) {
+        ya[(i / inner) % yn_elems] += ga[i];
+      }
+      scope->Set(*ygn, std::move(yg));
+    }
+    return "";
+  }
+
+  std::string RunMulGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* yn = OneName(op, "Y");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    if (xn == nullptr || yn == nullptr || ogn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* y = scope->Find(*yn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || y == nullptr || og == nullptr) {
+      return "input not in scope";
+    }
+    if (!IsF32(*x) || !IsF32(*y) || !IsF32(*og)) return "non-f32 dtype";
+    int64_t xcol = IntAttr(op, "x_num_col_dims", 1);
+    int64_t rows = 1, k = 1;
+    for (size_t d = 0; d < x->dims.size(); ++d) {
+      (static_cast<int64_t>(d) < xcol ? rows : k) *= x->dims[d];
+    }
+    int64_t k2 = y->dims.empty() ? 1 : y->dims[0];
+    int64_t cols = NumElements(y->dims) / (k2 == 0 ? 1 : k2);
+    if (k != k2 || NumElements(og->dims) != rows * cols) {
+      return "shape mismatch";
+    }
+    const float* xa = F32(*x);
+    const float* ya = F32(*y);
+    const float* ga = F32(*og);
+    const std::string* xgn = OneName(op, "X@GRAD", false);
+    if (xgn != nullptr) {  // dX = dOut . Y^T
+      HostTensor xg = MakeF32(x->dims);
+      float* ra = MutF32(&xg);
+      for (int64_t i = 0; i < rows; ++i) {
+        for (int64_t t = 0; t < k; ++t) {
+          float acc = 0.0f;
+          for (int64_t j = 0; j < cols; ++j) {
+            acc += ga[i * cols + j] * ya[t * cols + j];
+          }
+          ra[i * k + t] = acc;
+        }
+      }
+      scope->Set(*xgn, std::move(xg));
+    }
+    const std::string* ygn = OneName(op, "Y@GRAD", false);
+    if (ygn != nullptr) {  // dY = X^T . dOut
+      HostTensor yg = MakeF32(y->dims);
+      float* ra = MutF32(&yg);
+      for (int64_t t = 0; t < k; ++t) {
+        for (int64_t j = 0; j < cols; ++j) {
+          float acc = 0.0f;
+          for (int64_t i = 0; i < rows; ++i) {
+            acc += xa[i * k + t] * ga[i * cols + j];
+          }
+          ra[t * cols + j] = acc;
+        }
+      }
+      scope->Set(*ygn, std::move(yg));
+    }
+    return "";
+  }
+
+  std::string RunSgd(const OpDesc& op, Scope* scope) {
+    const std::string* pn = OneName(op, "Param");
+    const std::string* gn = OneName(op, "Grad");
+    const std::string* lrn = OneName(op, "LearningRate");
+    const std::string* on = OneName(op, "ParamOut", false);
+    if (pn == nullptr || gn == nullptr || lrn == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* p = scope->Find(*pn);
+    const HostTensor* g = scope->Find(*gn);
+    const HostTensor* lr = scope->Find(*lrn);
+    if (p == nullptr || g == nullptr || lr == nullptr) {
+      return "input not in scope";
+    }
+    if (!IsF32(*p) || !IsF32(*g) || !IsF32(*lr)) return "non-f32 dtype";
+    int64_t n = NumElements(p->dims);
+    if (n != NumElements(g->dims)) return "shape mismatch";
+    float rate = F32(*lr)[0];
+    HostTensor out = MakeF32(p->dims);
+    const float* pa = F32(*p);
+    const float* ga = F32(*g);
+    float* oa = MutF32(&out);
+    for (int64_t i = 0; i < n; ++i) oa[i] = pa[i] - rate * ga[i];
+    scope->Set(*on, std::move(out));
     return "";
   }
 

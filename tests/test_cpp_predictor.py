@@ -88,9 +88,9 @@ def test_native_interp_runs_resnet_block(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
 
 
-def _demo_binary():
+def _demo_binary(name="ptpu_demo_predictor"):
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    path = os.path.join(root, "native", "build", "ptpu_demo_predictor")
+    path = os.path.join(root, "native", "build", name)
     if os.path.exists(path):
         return path
     try:
@@ -123,6 +123,65 @@ def test_demo_predictor_binary_end_to_end(tmp_path):
     assert "ok params=" in res.stdout
     got = np.load(outp)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_demo_trainer_binary_trains(tmp_path):
+    """The reference's train/demo/demo_trainer.cc capability: a C++ main
+    runs the STARTUP program, then loops the full training IR (forward +
+    synthesized grads + sgd) and the loss falls — no Python in that
+    process."""
+    from paddle_tpu.core.program_bin import serialize_program
+
+    binary = _demo_binary("ptpu_demo_trainer")
+    if binary is None:
+        pytest.skip("cmake/ninja unavailable to build the demo binary")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=img, size=32, act="relu")
+        logits = fluid.layers.fc(input=h, size=10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    (tmp_path / "main.ptpb").write_bytes(serialize_program(main))
+    (tmp_path / "startup.ptpb").write_bytes(serialize_program(startup))
+    res = subprocess.run(
+        [binary, str(tmp_path), loss.name, "30", "32"],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr + res.stdout
+    last_line = res.stdout.strip().splitlines()[-1]
+    first, last = float(last_line.split()[1]), float(last_line.split()[3])
+    assert last < 0.25 * first, res.stdout
+
+
+def test_compiled_predictor_binary_matches_python(tmp_path):
+    """The api_impl.cc:141 capability on the COMPILED path: a C++ serving
+    main executes the whole-program XLA executable (via the embedded
+    CPython binding) on a conv model, matching the Python executor."""
+    binary = _demo_binary("ptpu_compiled_predictor")
+    if binary is None:
+        pytest.skip("embeddable Python or cmake/ninja unavailable")
+    path, feed, want = _save_model(
+        tmp_path, _mnist_cnn, {"x": (2, 1, 28, 28)}, seed=9)
+    inp = str(tmp_path / "input.npy")
+    outp = str(tmp_path / "output.npy")
+    np.save(inp, feed["x"])
+    import sysconfig
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [root, sysconfig.get_paths()["purelib"]]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    res = subprocess.run([binary, path, inp, outp],
+                         capture_output=True, text=True, timeout=300,
+                         env=env)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "ok compiled" in res.stdout
+    got = np.load(outp)
+    # same engine, same executable: tight tolerance
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
 def test_demo_predictor_rejects_garbage(tmp_path):
