@@ -39,6 +39,10 @@ _DEFS = {
     "use_pallas_lstm": (False, bool),
     # same for dynamic_gru (kernels/gru_cell.py)
     "use_pallas_gru": (False, bool),
+    # lower conv2d internally in NHWC (transpose sandwich; adjacent
+    # sandwiches cancel under XLA) — the layout experiment for the MFU
+    # push; numerics identical, measured per-hardware
+    "conv_nhwc": (False, bool),
 }
 
 

@@ -21,12 +21,35 @@ def _pair(v, n=2):
     return [v] * n
 
 
+def _conv_nhwc():
+    from paddle_tpu import flags
+
+    return flags.get("conv_nhwc")
+
+
 def _lower_conv2d(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
+    if _conv_nhwc():
+        # FLAGS_conv_nhwc layout experiment: run the conv in NHWC inside a
+        # transpose sandwich. Between consecutive convs the out-transpose
+        # and the next in-transpose cancel in XLA, so a conv-dominated
+        # block effectively runs NHWC end to end while the Program stays
+        # NCHW at every op boundary. Numerics unchanged; per-hardware win
+        # measured by the bench (BENCH_NOTES round-3 section).
+        out = jax.lax.conv_general_dilated(
+            jnp.transpose(x, (0, 2, 3, 1)),
+            w,
+            window_strides=strides,
+            padding=[(p, p) for p in paddings],
+            rhs_dilation=dilations,
+            dimension_numbers=("NHWC", "OIHW", "NHWC"),
+            feature_group_count=groups,
+        )
+        return jnp.transpose(out, (0, 3, 1, 2))
     out = jax.lax.conv_general_dilated(
         x,
         w,

@@ -122,3 +122,41 @@ def test_alexnet_converges():
         steps=20,
     )
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_conv_nhwc_flag_parity():
+    """FLAGS_conv_nhwc (the MFU layout experiment) must be a pure layout
+    change: identical losses, forward and backward, vs the NCHW default."""
+    from paddle_tpu import flags, unique_name
+
+    def run():
+        unique_name.switch()
+        np.random.seed(0)
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 21
+        startup.random_seed = 21
+        with fluid.program_guard(main, startup):
+            loss, feeds, _ = mnist.build(class_num=4)
+            fluid.optimizer.Momentum(learning_rate=0.01,
+                                     momentum=0.9).minimize(loss)
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            shape = tuple(int(d) for d in feeds[0].shape[1:])
+            x, y = _synthetic_images(32, shape, 4)
+            out = []
+            for step in range(6):
+                lv, = exe.run(
+                    main,
+                    feed={feeds[0].name: x[:16], feeds[1].name: y[:16]},
+                    fetch_list=[loss])
+                out.append(float(lv[0]))
+            return out
+
+    base = run()
+    flags.set_flag("conv_nhwc", True)
+    try:
+        nhwc = run()
+    finally:
+        flags.set_flag("conv_nhwc", False)
+    np.testing.assert_allclose(nhwc, base, rtol=1e-5, atol=1e-6)
