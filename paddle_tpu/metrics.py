@@ -23,20 +23,23 @@ class MetricBase(object):
         return self._name
 
     def reset(self):
-        states = {
-            a: v
-            for a, v in self.__dict__.items()
-            if not a.startswith("_") and not callable(v)
-        }
-        for attr, value in states.items():
-            if isinstance(value, int):
-                setattr(self, attr, 0)
-            elif isinstance(value, float):
-                setattr(self, attr, 0.0)
-            elif isinstance(value, (np.ndarray,)):
-                setattr(self, attr, np.zeros_like(value))
+        """Zero every public accumulator in place. Subclasses keep their
+        running state as public attributes, so the base reset can restart
+        an epoch without knowing each metric's fields: numbers restart at
+        zero, arrays at zeros of the same shape, anything else is cleared."""
+        for attr in list(vars(self)):
+            if attr.startswith("_"):
+                continue
+            value = getattr(self, attr)
+            if callable(value):
+                continue
+            if isinstance(value, np.ndarray):
+                fresh = np.zeros_like(value)
+            elif isinstance(value, (int, float)):
+                fresh = type(value)(0)
             else:
-                setattr(self, attr, None)
+                fresh = None
+            setattr(self, attr, fresh)
 
     def update(self, preds, labels):
         raise NotImplementedError

@@ -1,0 +1,184 @@
+"""Pallas-vs-XLA kernel microbench (VERDICT r2 item 3: measure the Pallas
+kernels or delete them).
+
+For each kernel family the hand-written Pallas path is timed against the
+XLA-composed lowering it replaces, at >= 3 shapes, THROUGH the op layer
+(the flags/attrs users flip), so the numbers reflect what the framework
+actually runs. Prints one JSON line per (kernel, shape, impl) plus a
+closing summary with the per-kernel speedup and a default recommendation.
+
+Usage (TPU host):   python tools/kernel_bench.py
+CPU smoke:          BENCH_PLATFORM=cpu python tools/kernel_bench.py --quick
+(on CPU the Pallas paths run in interpreter mode and are expected to lose
+badly; only the TPU numbers decide flag defaults.)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _time_steps(fn, steps, warmup):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn()
+    # sync on the last value
+    import numpy as np
+
+    np.asarray(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def _bench_rnn(fluid, op_name, flag, shapes, steps, warmup):
+    import numpy as np
+
+    rows = []
+    for bs, seq, hidden in shapes:
+        times = {}
+        for use_pallas in (False, True):
+            fluid.flags.set_flag(flag, use_pallas)
+            try:
+                from paddle_tpu import unique_name
+
+                unique_name.switch()
+                main, startup = fluid.Program(), fluid.Program()
+                main.random_seed = 3
+                startup.random_seed = 3
+                with fluid.program_guard(main, startup):
+                    x = fluid.layers.data(
+                        name="x", shape=[seq, 4 * hidden
+                                         if op_name == "dynamic_lstm"
+                                         else 3 * hidden],
+                        dtype="float32")
+                    if op_name == "dynamic_lstm":
+                        out, _ = fluid.layers.dynamic_lstm(
+                            input=x, size=4 * hidden)
+                    else:
+                        out = fluid.layers.dynamic_gru(
+                            input=x, size=hidden)
+                    loss = fluid.layers.reduce_mean(out)
+                with fluid.scope_guard(fluid.executor.Scope()):
+                    exe = fluid.Executor(fluid.TPUPlace()
+                                         if _on_tpu() else fluid.CPUPlace())
+                    exe.run(startup)
+                    width = (4 if op_name == "dynamic_lstm" else 3) * hidden
+                    feed = {"x": np.random.RandomState(0).rand(
+                        bs, seq, width).astype("float32")}
+                    dt = _time_steps(
+                        lambda: exe.run(main, feed=feed,
+                                        fetch_list=[loss])[0],
+                        steps, warmup)
+                times["pallas" if use_pallas else "xla"] = dt
+            finally:
+                fluid.flags.set_flag(flag, False)
+        row = {"kernel": op_name, "shape": [bs, seq, hidden],
+               "xla_ms": round(times["xla"] * 1e3, 3),
+               "pallas_ms": round(times["pallas"] * 1e3, 3),
+               "speedup": round(times["xla"] / times["pallas"], 3)}
+        print(json.dumps(row))
+        rows.append(row)
+    return rows
+
+
+def _bench_flash(fluid, shapes, steps, warmup):
+    import numpy as np
+
+    rows = []
+    for b, h, t, d in shapes:
+        times = {}
+        rng = np.random.RandomState(1)
+        feed = {
+            "q": rng.randn(b, h, t, d).astype("float32"),
+            "k": rng.randn(b, h, t, d).astype("float32"),
+            "v": rng.randn(b, h, t, d).astype("float32"),
+        }
+        for impl in ("reference", "pallas"):
+            from paddle_tpu import unique_name
+
+            unique_name.switch()
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                q = fluid.layers.data(name="q", shape=[h, t, d])
+                kk = fluid.layers.data(name="k", shape=[h, t, d])
+                v = fluid.layers.data(name="v", shape=[h, t, d])
+                for var in (q, kk, v):
+                    var.stop_gradient = False
+                out = fluid.layers.scaled_dot_product_attention(
+                    q, kk, v, causal=True, impl=impl)
+                loss = fluid.layers.reduce_mean(out)
+                # fwd+bwd: flash attention's win is the backward pass
+                fluid.optimizer.SGD(learning_rate=0.0).minimize(
+                    loss, parameter_list=[])
+            with fluid.scope_guard(fluid.executor.Scope()):
+                exe = fluid.Executor(fluid.TPUPlace()
+                                     if _on_tpu() else fluid.CPUPlace())
+                exe.run(startup)
+                dt = _time_steps(
+                    lambda: exe.run(main, feed=feed,
+                                    fetch_list=[loss])[0],
+                    steps, warmup)
+            times[impl] = dt
+        row = {"kernel": "flash_attention", "shape": [b, h, t, d],
+               "xla_ms": round(times["reference"] * 1e3, 3),
+               "pallas_ms": round(times["pallas"] * 1e3, 3),
+               "speedup": round(times["reference"] / times["pallas"], 3)}
+        print(json.dumps(row))
+        rows.append(row)
+    return rows
+
+
+def _on_tpu():
+    import jax
+
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes + few steps (CPU smoke)")
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    import paddle_tpu as fluid
+
+    if args.quick:
+        steps, warmup = 3, 1
+        rnn_shapes = [(4, 16, 32)]
+        fa_shapes = [(1, 2, 128, 32)]
+    else:
+        steps, warmup = 20, 5
+        rnn_shapes = [(32, 128, 256), (64, 256, 512), (16, 512, 1024)]
+        fa_shapes = [(8, 8, 1024, 64), (4, 8, 2048, 64), (2, 8, 4096, 128)]
+
+    all_rows = []
+    all_rows += _bench_rnn(fluid, "dynamic_lstm", "use_pallas_lstm",
+                           rnn_shapes, steps, warmup)
+    all_rows += _bench_rnn(fluid, "dynamic_gru", "use_pallas_gru",
+                           rnn_shapes, steps, warmup)
+    all_rows += _bench_flash(fluid, fa_shapes, steps, warmup)
+
+    summary = {}
+    for row in all_rows:
+        summary.setdefault(row["kernel"], []).append(row["speedup"])
+    verdicts = {
+        k: {"geomean_speedup": round(
+            float(__import__("numpy").prod(v)) ** (1.0 / len(v)), 3),
+            "recommend_default": "pallas"
+            if all(s > 1.05 for s in v) else "xla"}
+        for k, v in summary.items()
+    }
+    print(json.dumps({"on_tpu": _on_tpu(), "verdicts": verdicts}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# MFU push sweep (VERDICT r2 item 2): one command that captures every
+# prepared experiment on the real chip the moment the tunnel is up, so a
+# short hardware window is enough. Each line of output is one bench JSON
+# capture tagged with the configuration that produced it.
+#
+#   tools/mfu_sweep.sh              # on a TPU host
+#   BENCH_PLATFORM=cpu tools/mfu_sweep.sh   # CPU smoke of the harness
+#
+# Experiments (ResNet-50 unless stated):
+#   baseline          bf16 AMP, in-graph data (the round-2 configuration)
+#   fp32              AMP off (isolates the bf16 win)
+#   nhwc              FLAGS_conv_nhwc=1 layout experiment
+#   bs64 / bs256      batch sweep via BENCH_BS override
+#   multistep         K-step lax.scan executable (dispatch amortization)
+#   hostdata+db       PyReader host feeds, double buffer ON (h2d overlap)
+#   hostdata-nodb     same with the prefetch off (the control)
+#   transformer       the second north-star model
+#   kernels           Pallas-vs-XLA microbench (tools/kernel_bench.py)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+run() {
+  local tag="$1"; shift
+  echo "== $tag =="
+  env "$@" python bench.py --worker 2>/dev/null | tail -1 \
+    | sed "s/^/{\"experiment\": \"$tag\", \"capture\": /; s/$/}/"
+}
+
+# SWEEP_QUICK=1 runs a 3-experiment subset (harness smoke on CPU; the
+# full list is sized for the TPU, where each capture is seconds).
+if [ "${SWEEP_QUICK:-0}" = "1" ]; then
+  run transformer      BENCH_MODEL=transformer
+  run transformer-fp32 BENCH_MODEL=transformer BENCH_AMP=0
+  run nhwc-quick       BENCH_MODEL=transformer FLAGS_conv_nhwc=1
+else
+  run baseline      BENCH_MODEL=resnet50
+  run fp32          BENCH_MODEL=resnet50 BENCH_AMP=0
+  run nhwc          BENCH_MODEL=resnet50 FLAGS_conv_nhwc=1
+  run multistep     BENCH_MODEL=resnet50 BENCH_MULTISTEP=1
+  run hostdata+db   BENCH_MODEL=resnet50 BENCH_DATA=host BENCH_DOUBLE_BUFFER=1
+  run hostdata-nodb BENCH_MODEL=resnet50 BENCH_DATA=host BENCH_DOUBLE_BUFFER=0
+  run transformer   BENCH_MODEL=transformer
+  run transformer-fp32 BENCH_MODEL=transformer BENCH_AMP=0
+fi
+
+echo "== kernels =="
+python tools/kernel_bench.py ${BENCH_PLATFORM:+--quick}
+
+echo "sweep done"
