@@ -66,8 +66,11 @@ class PassManager(object):
     use case to a default pipeline, as AnalysisPredictor's pass lists do."""
 
     STRATEGIES = {
-        # deploy: fold BN into convs, slice to the inference subgraph
-        "inference": ["fuse_batch_norm", "prune_feed_fetch"],
+        # deploy: slice to the inference subgraph FIRST (so training-only
+        # ops from a clone-after-minimize program can't block fusion
+        # conditions), then fold BN into convs and collapse mul+add(+act)
+        # chains into fc ops
+        "inference": ["prune_feed_fetch", "fuse_batch_norm", "fc_fuse"],
         # training memory: rematerialization planning
         "memory": ["memory_optimize"],
         # mixed precision training
@@ -129,6 +132,208 @@ def _prune_feed_fetch(program, scope=None, feed_names=None,
     from paddle_tpu.io import prune_program
 
     return prune_program(program, feed_names, fetch_names)
+
+
+@register_pass("fc_fuse")
+def _fc_fuse(program, scope=None, feed_names=None, fetch_names=None,
+             **kwargs):
+    """Collapse mul + elementwise_add(persistable bias) [+ activation]
+    chains into single ``fc`` ops (fc_fuse_pass.cc role). Applied to
+    inference programs: intermediates consumed by grad ops (training
+    graphs) fail the single-consumer condition and are left alone.
+    Vars named in feed_names/fetch_names are never deleted or absorbed."""
+    from paddle_tpu.core.graph_pattern import GraphPatternDetector, consumers
+
+    protected = set(feed_names or ()) | set(fetch_names or ())
+
+    def _persistable(block, name):
+        v = block.vars.get(name)
+        return v is not None and getattr(v, "persistable", False)
+
+    def _rewrite(block, m, with_act):
+        if not (_persistable(block, m.var("w"))
+                and _persistable(block, m.var("b"))):
+            return False
+        mul_op, add_op = m.op("mul"), m.op("add")
+        xn = mul_op.attrs.get("x_num_col_dims", 1)
+        # the fc lowering is a plain 2-D matmul + trailing-axis bias
+        # broadcast: bail out of shapes/axes it would silently change
+        # (reference fc_fuse_pass makes the same bias-shape checks)
+        if mul_op.attrs.get("y_num_col_dims", 1) != 1:
+            return False
+        bvar = block.vars.get(m.var("b"))
+        if bvar is None or len(getattr(bvar, "shape", ()) or ()) != 1:
+            return False
+        if add_op.attrs.get("axis", -1) not in (-1, xn):
+            return False
+        # every intermediate must feed ONLY the next chain op, and never
+        # be a feed/fetch target
+        if m.var("mid") in protected:
+            return False
+        mid_users = [i for i, _, _ in consumers(block, m.var("mid"))]
+        if mid_users != [m.op_index("add")]:
+            return False
+        if with_act:
+            if m.var("out") in protected:
+                return False
+            out_users = [i for i, _, _ in consumers(block, m.var("out"))]
+            if out_users != [m.op_index("act")]:
+                return False
+        idxs = m.op_indices()
+        final = m.var("final") if with_act else m.var("out")
+        attrs = {
+            "in_num_col_dims": xn,
+            "activation_type": m.op("act").type if with_act else "",
+        }
+        for i in reversed(idxs):
+            block.remove_op(i)
+        block.insert_op(
+            idxs[0], "fc",
+            inputs={"Input": [m.var("x")], "W": [m.var("w")],
+                    "Bias": [m.var("b")]},
+            outputs={"Out": [final]},
+            attrs=attrs)
+        block.vars.pop(m.var("mid"), None)
+        if with_act:
+            block.vars.pop(m.var("out"), None)
+        return True
+
+    for bi in range(program.num_blocks):
+        block = program.block(bi)
+        # longest chain first so mul+add+act doesn't half-match; within a
+        # wave, rewrite bottom-up so earlier matches' indices stay valid
+        for with_act in (True, False):
+            changed = True
+            while changed:
+                changed = False
+                pat = GraphPatternDetector()
+                pat.op("mul", "mul",
+                       inputs={"X": "x", "Y": "w"}, outputs={"Out": "mid"})
+                pat.op("add", "elementwise_add",
+                       inputs={"X": "mid", "Y": "b"}, outputs={"Out": "out"})
+                if with_act:
+                    pat.op("act", ("relu", "tanh", "sigmoid", "gelu"),
+                           inputs={"X": "out"}, outputs={"Out": "final"})
+                matches = pat.detect(block)
+                for m in sorted(matches, key=lambda m: -m.op_indices()[0]):
+                    if not m.is_live(block):
+                        changed = True  # shifted by an earlier rewrite in
+                        continue        # this wave; next wave retries it
+                    changed |= _rewrite(block, m, with_act)
+    program._bump_version()
+    return program
+
+
+@register_pass("fuse_elewise_add_act")
+def _fuse_elewise_add_act(program, scope=None, **kwargs):
+    """elementwise_add + activation -> fused_elemwise_activation
+    (fuse_elewise_add_act_pass.cc role), on forward AND backward ops.
+
+    The fused op also exports the sum as IntermediateOut under the add
+    output's original name, so any other consumer (metrics, fetches,
+    grad-op forward replays) keeps resolving. The matching grad pair
+    act_grad + elementwise_add_grad is collapsed into one synthesized
+    fused_elemwise_activation_grad when the intermediate gradient flows
+    nowhere else."""
+    from paddle_tpu.core.graph_pattern import GraphPatternDetector
+
+    acts = ("relu", "tanh", "sigmoid", "gelu")
+    for bi in range(program.num_blocks):
+        block = program.block(bi)
+        changed = True
+        while changed:
+            changed = False
+            pat = GraphPatternDetector()
+            pat.op("add", "elementwise_add",
+                   inputs={"X": "x", "Y": "y"}, outputs={"Out": "mid"})
+            pat.op("act", acts, inputs={"X": "mid"}, outputs={"Out": "out"})
+            # apply the whole disjoint wave bottom-up (earlier matches'
+            # indices survive later-in-block rewrites), then re-detect
+            # once for cascades
+            for m in sorted(pat.detect(block),
+                            key=lambda m: -m.op_indices()[0]):
+                if not m.is_live(block):
+                    # an earlier rewrite in this wave shifted this match's
+                    # indices (interleaved chains); the next wave's fresh
+                    # detect() will retry it
+                    changed = True
+                    continue
+                act_type = m.op("act").type
+                add_op = m.op("add")
+                axis = add_op.attrs.get("axis", -1)
+                i_add, i_act = m.op_index("add"), m.op_index("act")
+                for i in sorted((i_add, i_act), reverse=True):
+                    block.remove_op(i)
+                block.insert_op(
+                    i_add, "fused_elemwise_activation",
+                    inputs={"X": [m.var("x")], "Y": [m.var("y")]},
+                    outputs={"Out": [m.var("out")],
+                             "IntermediateOut": [m.var("mid")]},
+                    attrs=dict(
+                        _role_attrs(add_op),
+                        functor_list=["elementwise_add", act_type],
+                        axis=axis, save_intermediate_out=True))
+                _fuse_add_act_grad_pair(block, m, act_type, axis)
+                changed = True
+    program._bump_version()
+    return program
+
+
+def _role_attrs(src_op):
+    """OpRole (+role-var) attrs carried from a replaced op onto its fused
+    replacement, so role-keyed passes (pipeline cut, gradient merge,
+    distribute transpiler) keep classifying the op correctly."""
+    from paddle_tpu.framework import OP_ROLE_ATTR_NAME, OP_ROLE_VAR_ATTR_NAME
+
+    out = {}
+    for k in (OP_ROLE_ATTR_NAME, OP_ROLE_VAR_ATTR_NAME):
+        if k in src_op.attrs:
+            out[k] = src_op.attrs[k]
+    return out
+
+
+def _fuse_add_act_grad_pair(block, m, act_type, axis):
+    """Collapse the backward twin of a fused add+act pair, if present."""
+    from paddle_tpu.core.graph_pattern import GraphPatternDetector, consumers
+    from paddle_tpu.core.op_registry import ensure_auto_grad_op
+
+    gpat = GraphPatternDetector()
+    gpat.op("act_grad", act_type + "_grad",
+            inputs={"X": "mid", "Out@GRAD": "dout"},
+            outputs={"X@GRAD": "dmid"})
+    gpat.op("add_grad", "elementwise_add_grad",
+            inputs={"X": "x", "Y": "y", "Out@GRAD": "dmid"})
+    for gm in gpat.detect(block):
+        if (gm.var("mid") != m.var("mid") or gm.var("x") != m.var("x")
+                or gm.var("y") != m.var("y")):
+            continue
+        # the intermediate gradient must flow nowhere else
+        dmid = gm.var("dmid")
+        users = [i for i, _, _ in consumers(block, dmid)]
+        if users != [gm.op_index("add_grad")]:
+            continue
+        add_g = gm.op("add_grad")
+        dx = add_g.output("X@GRAD")
+        dy = add_g.output("Y@GRAD")
+        ensure_auto_grad_op("fused_elemwise_activation")
+        i_ag, i_eg = gm.op_index("act_grad"), gm.op_index("add_grad")
+        for i in sorted((i_ag, i_eg), reverse=True):
+            block.remove_op(i)
+        outputs = {}
+        if any(dx):
+            outputs["X@GRAD"] = dx
+        if any(dy):
+            outputs["Y@GRAD"] = dy
+        block.insert_op(
+            i_ag, "fused_elemwise_activation_grad",
+            inputs={"X": [gm.var("x")], "Y": [gm.var("y")],
+                    "Out": [m.var("out")], "Out@GRAD": [gm.var("dout")]},
+            outputs=outputs,
+            attrs=dict(_role_attrs(add_g),
+                       functor_list=["elementwise_add", act_type],
+                       axis=axis, save_intermediate_out=True))
+        block.vars.pop(dmid, None)
+        return
 
 
 @register_pass("delete_dropout")

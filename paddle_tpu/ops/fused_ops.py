@@ -1,0 +1,74 @@
+"""Fused ops produced by the fusion passes (core/passes.py).
+
+Reference parity: ``paddle/fluid/operators/fc_op`` (target of
+fc_fuse_pass.cc) and ``fused_elemwise_activation_op.cc`` (target of
+fuse_elewise_add_act_pass.cc). On TPU the fusion itself is XLA's job —
+these ops exist so the *graph* can be collapsed (fewer ops to trace,
+fewer intermediate vars to name/GC, parity for the reference's pass
+surface); their lowerings are plain compositions XLA fuses to the same
+kernels either way.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.ops.common import broadcast_y, flatten_to_2d
+
+# unary functors usable as the activation half of a fused pair; mirrors
+# the whitelist in the reference pass (relu/scale/tanh/sigmoid/gelu)
+_ACT = {
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "gelu": jax.nn.gelu,
+    "identity": lambda x: x,
+}
+
+
+def _lower_fc(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["W"][0]
+    x2 = flatten_to_2d(x, attrs.get("in_num_col_dims", 1))
+    out = x2 @ w
+    bias = ins.get("Bias")
+    if bias:
+        out = out + bias[0]
+    act = attrs.get("activation_type", "")
+    if act:
+        out = _ACT[act](out)
+    n = attrs.get("in_num_col_dims", 1)
+    return jnp.reshape(out, tuple(jnp.shape(x)[:n]) + (jnp.shape(w)[1],))
+
+
+register_op(
+    "fc",
+    inputs=["Input", "W", "Bias"],
+    outputs=["Out"],
+    attrs={"in_num_col_dims": 1, "activation_type": ""},
+    lower=_lower_fc,
+)
+
+
+def _lower_fused_elemwise_activation(ctx, ins, attrs):
+    """out = act(x + y) (functor_list ["elementwise_add", act]); the
+    intermediate sum is exported so pre-fusion consumers of the add
+    output keep working (save_intermediate_out, reference attr)."""
+    functors = list(attrs.get("functor_list", []))
+    if len(functors) != 2 or functors[0] != "elementwise_add":
+        raise ValueError(
+            "fused_elemwise_activation supports functor_list "
+            "['elementwise_add', <act>]; got %r" % (functors,))
+    act = _ACT[functors[1]]
+    x, y = ins["X"][0], ins["Y"][0]
+    mid = x + broadcast_y(x, y, attrs.get("axis", -1))
+    return {"Out": act(mid), "IntermediateOut": mid}
+
+
+register_op(
+    "fused_elemwise_activation",
+    inputs=["X", "Y"],
+    outputs=["Out", "IntermediateOut"],
+    attrs={"functor_list": [], "axis": -1, "save_intermediate_out": True},
+    intermediate_outputs=("IntermediateOut",),
+    lower=_lower_fused_elemwise_activation,
+)

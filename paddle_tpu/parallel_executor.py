@@ -69,8 +69,6 @@ def _warn_noop_strategy_knobs(build_strategy, exec_strategy):
     import warnings
 
     noop = []
-    if getattr(build_strategy, "fuse_elewise_add_act_ops", False):
-        noop.append("BuildStrategy.fuse_elewise_add_act_ops")
     bs_defaults = BuildStrategy()
     # unlike reduce_strategy (honored in _shard_grad_outputs), these two
     # never reach the lowering — changing them would silently change
@@ -115,6 +113,12 @@ class ParallelExecutor(object):
         self._build_strategy = build_strategy or BuildStrategy()
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         _warn_noop_strategy_knobs(self._build_strategy, self._exec_strategy)
+        if getattr(self._build_strategy, "fuse_elewise_add_act_ops", False):
+            # fuse_elewise_add_act_pass.cc role: collapse add+act (and the
+            # backward twin) into fused ops before compiling the program
+            from paddle_tpu.core.passes import apply_pass
+
+            self._program = apply_pass(self._program, "fuse_elewise_add_act")
         self._loss_name = loss_name
         self._cache = {}
         self._run_counter = 0

@@ -1,0 +1,244 @@
+"""GraphPatternDetector + fusion passes (graph_pattern_detector.cc,
+fc_fuse_pass.cc, fuse_elewise_add_act_pass.cc roles): structural matches,
+graph rewrites, and numeric parity fused-vs-unfused."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.graph_pattern import (
+    GraphPatternDetector,
+    consumers,
+    producer,
+)
+from paddle_tpu.core.passes import apply_pass
+
+
+def _mlp_infer_program():
+    """x -> fc(mul+add) -> relu -> fc(mul+add) chain, built from raw ops."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        out = fluid.layers.fc(input=h, size=4)
+        sm = fluid.layers.softmax(out)
+    return main, startup, sm
+
+
+def test_detector_matches_mul_add_chain():
+    main, _, _ = _mlp_infer_program()
+    block = main.block(0)
+    pat = GraphPatternDetector()
+    pat.op("mul", "mul", inputs={"X": "x", "Y": "w"}, outputs={"Out": "mid"})
+    pat.op("add", "elementwise_add",
+           inputs={"X": "mid", "Y": "b"}, outputs={"Out": "out"})
+    matches = pat.detect(block)
+    assert len(matches) == 2  # two fc layers
+    m = matches[0]
+    assert m.op("mul").type == "mul"
+    assert m.var("mid") in m.op("add").input("X")
+    # matches are disjoint
+    assert not set(matches[0].op_indices()) & set(matches[1].op_indices())
+
+
+def test_detector_edge_constraint_rejects_disconnected():
+    main, _, _ = _mlp_infer_program()
+    block = main.block(0)
+    pat = GraphPatternDetector()
+    # softmax's input must equal the FIRST mul's output: no such chain
+    pat.op("mul", "mul", outputs={"Out": "v"})
+    pat.op("sm", "softmax", inputs={"X": "v"})
+    assert pat.detect(block) == []
+
+
+def test_producer_consumers_helpers():
+    main, _, _ = _mlp_infer_program()
+    block = main.block(0)
+    mul_out = block.ops[0].output("Out")[0]
+    i, op = producer(block, mul_out)
+    assert op.type == "mul" and i == 0
+    cons = consumers(block, mul_out)
+    assert [c[1].type for c in cons] == ["elementwise_add"]
+
+
+def _run(main, startup, fetch, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=[fetch])[0]
+
+
+def test_fc_fuse_pass_structure_and_numerics():
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(5, 8).astype("float32")}
+    main, startup, sm = _mlp_infer_program()
+    ref = _run(main, startup, sm, feed)
+
+    apply_pass(main, "fc_fuse")
+    types = [op.type for op in main.block(0).ops]
+    assert types.count("fc") == 2
+    assert "mul" not in types and "elementwise_add" not in types
+    # first fc absorbed its relu
+    fcs = [op for op in main.block(0).ops if op.type == "fc"]
+    assert fcs[0].attrs["activation_type"] == "relu"
+    assert fcs[1].attrs["activation_type"] == ""
+    got = _run(main, startup, sm, feed)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_fc_fuse_skips_shared_intermediate():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4)
+        # fc's internal mul output feeds ONLY the add; but the fc OUTPUT
+        # feeding two consumers must not block fusion of the chain itself
+        a = fluid.layers.relu(h)
+        b = fluid.layers.tanh(h)
+        out = fluid.layers.elementwise_add(a, b)
+    apply_pass(main, "fc_fuse")
+    types = [op.type for op in main.block(0).ops]
+    # plain fc fused; the trailing act was NOT absorbed (h has 2 readers)
+    assert "fc" in types and "relu" in types and "tanh" in types
+
+
+def _add_act_train_program():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        w = fluid.layers.create_parameter(shape=[6, 6], dtype="float32",
+                                          name="w_aa")
+        z = fluid.layers.relu(
+            fluid.layers.elementwise_add(fluid.layers.matmul(x, w), x))
+        pred = fluid.layers.fc(input=z, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _train_losses(main, startup, loss, steps=4):
+    rng = np.random.RandomState(5)
+    feeds = [
+        {"x": rng.rand(4, 6).astype("float32"),
+         "y": rng.rand(4, 1).astype("float32")}
+        for _ in range(steps)
+    ]
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = []
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        for f in feeds:
+            out.append(float(exe.run(main, feed=f,
+                                     fetch_list=[loss])[0].ravel()[0]))
+    return out
+
+
+def test_fuse_elewise_add_act_training_parity():
+    ref = _train_losses(*_add_act_train_program())
+
+    main, startup, loss = _add_act_train_program()
+    apply_pass(main, "fuse_elewise_add_act")
+    types = [op.type for op in main.block(0).ops]
+    assert "fused_elemwise_activation" in types
+    assert "relu" not in types
+    # the backward twin collapsed too (the fc layer's own bias add_grad,
+    # which has no paired activation, legitimately remains)
+    assert "fused_elemwise_activation_grad" in types
+    assert "relu_grad" not in types
+    assert types.count("elementwise_add_grad") == 1
+    got = _train_losses(main, startup, loss)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fuse_keeps_intermediate_consumers_working():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        s = fluid.layers.elementwise_add(x, x)
+        r = fluid.layers.relu(s)
+        # a second reader of the pre-activation sum
+        out = fluid.layers.elementwise_add(r, s)
+    apply_pass(main, "fuse_elewise_add_act")
+    types = [op.type for op in main.block(0).ops]
+    assert "fused_elemwise_activation" in types
+    feed = {"x": np.array([[-1.0, 2.0, -3.0, 4.0]], dtype="float32")}
+    got = _run(main, startup, out, feed)
+    np.testing.assert_allclose(
+        got, np.array([[-2.0, 8.0, -6.0, 16.0]], dtype="float32"))
+
+
+def test_fused_grad_op_keeps_backward_role():
+    from paddle_tpu.framework import OP_ROLE_ATTR_NAME
+
+    main, startup, loss = _add_act_train_program()
+    roles = {op.type: op.attrs.get(OP_ROLE_ATTR_NAME)
+             for op in main.block(0).ops}
+    apply_pass(main, "fuse_elewise_add_act")
+    for op in main.block(0).ops:
+        if op.type == "fused_elemwise_activation":
+            assert op.attrs[OP_ROLE_ATTR_NAME] == roles["elementwise_add"]
+        if op.type == "fused_elemwise_activation_grad":
+            # role-keyed passes (pipeline cut, gradient merge) must still
+            # see a Backward op
+            assert op.attrs[OP_ROLE_ATTR_NAME] == roles["elementwise_add_grad"]
+
+
+def test_fc_fuse_rejects_axis0_bias():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        w = fluid.layers.create_parameter(shape=[4, 4], dtype="float32",
+                                          name="w_ax")
+        b = fluid.layers.create_parameter(shape=[3], dtype="float32",
+                                          name="b_ax")
+        h = fluid.layers.mul(x, w)
+        # axis=0 broadcasts the bias per ROW — not what fc computes
+        out = fluid.layers.elementwise_add(h, b, axis=0)
+    apply_pass(main, "fc_fuse")
+    assert "fc" not in [op.type for op in main.block(0).ops]
+
+
+def test_fuse_interleaved_matches_stay_correct():
+    """Two add+act chains whose act order is INVERTED vs their add order:
+    the second processed match's recorded indices go stale after the
+    first rewrite — it must be retried on fresh indices, not rewritten
+    with stale ones (which deleted the model output op)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        a = fluid.layers.elementwise_add(x, x)       # op 0
+        b = fluid.layers.elementwise_add(a, x)       # op 1
+        r2 = fluid.layers.relu(b)                    # op 2: act for add 1
+        r1 = fluid.layers.tanh(a)                    # op 3: act for add 0
+        out = fluid.layers.elementwise_add(r1, r2)   # op 4: model output
+    apply_pass(main, "fuse_elewise_add_act")
+    types = [op.type for op in main.block(0).ops]
+    assert types.count("fused_elemwise_activation") == 2
+    assert "relu" not in types and "tanh" not in types
+    # the final combining add survives and still produces the output
+    feed = {"x": np.array([[1.0, -2.0, 3.0, -4.0]], dtype="float32")}
+    got = _run(main, startup, out, feed)
+    xv = feed["x"]
+    np.testing.assert_allclose(
+        got, np.tanh(2 * xv) + np.maximum(3 * xv, 0.0), rtol=1e-6)
+
+
+def test_build_strategy_knob_applies_fusion():
+    main, startup, loss = _add_act_train_program()
+    bs = fluid.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=main,
+                                    build_strategy=bs, num_devices=2)
+        rng = np.random.RandomState(5)
+        feed = {"x": rng.rand(4, 6).astype("float32"),
+                "y": rng.rand(4, 1).astype("float32")}
+        lv = pe.run(feed=feed, fetch_list=[loss.name])[0]
+    assert np.isfinite(np.asarray(lv)).all()
+    assert any(op.type == "fused_elemwise_activation"
+               for op in main.block(0).ops)

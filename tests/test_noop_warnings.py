@@ -20,11 +20,14 @@ def _tiny_train_program():
     return main, startup, loss
 
 
-def test_fuse_elewise_knob_warns():
+def test_fuse_elewise_knob_fuses_not_warns():
+    # the knob is honored now (core/passes.py fuse_elewise_add_act), so it
+    # must rewrite the graph and NOT warn
     main, startup, loss = _tiny_train_program()
     bs = fluid.BuildStrategy()
     bs.fuse_elewise_add_act_ops = True
-    with pytest.warns(UserWarning, match="fuse_elewise_add_act_ops"):
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
         fluid.ParallelExecutor(loss_name=loss.name, main_program=main,
                                build_strategy=bs, num_devices=1)
 

@@ -23,8 +23,18 @@ cd "$(dirname "$0")/.."
 run() {
   local tag="$1"; shift
   echo "== $tag =="
-  env "$@" python bench.py --worker 2>/dev/null | tail -1 \
-    | sed "s/^/{\"experiment\": \"$tag\", \"capture\": /; s/$/}/"
+  local out
+  out=$(env "$@" python bench.py --worker 2>"/tmp/mfu_sweep_$tag.err" \
+    | tail -1)
+  if [ -n "$out" ]; then
+    printf '{"experiment": "%s", "capture": %s}\n' "$tag" "$out"
+  else
+    # a lost capture must be visible IN the sweep record, not silently
+    # absent (the hardware window may be gone before anyone rereads logs)
+    printf '{"experiment": "%s", "capture": {"error": "worker produced no output; see /tmp/mfu_sweep_%s.err"}}\n' \
+      "$tag" "$tag"
+    tail -3 "/tmp/mfu_sweep_$tag.err" >&2
+  fi
 }
 
 # SWEEP_QUICK=1 runs a 3-experiment subset (harness smoke on CPU; the
