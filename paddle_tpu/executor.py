@@ -62,7 +62,7 @@ def _as_feed_array(value, place):
 # Flags whose value changes what the block lowers TO (not just runtime
 # behavior); they join the executable cache key so toggling recompiles.
 _TRACE_FLAGS = ("use_pallas_lstm", "use_pallas_gru", "remat_gradients",
-                "conv_nhwc")
+                "conv_nhwc", "attention_impl")
 
 
 def _trace_flags_key():

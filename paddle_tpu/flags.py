@@ -43,6 +43,11 @@ _DEFS = {
     # sandwiches cancel under XLA) — the layout experiment for the MFU
     # push; numerics identical, measured per-hardware
     "conv_nhwc": (False, bool),
+    # override scaled_dot_product_attention's impl="auto" resolution:
+    # "auto" (backend picks), "pallas" (force flash kernel), "reference"
+    # (XLA-composed attention) — the escape hatch when the Pallas compile
+    # path is unavailable/slow on a given rig
+    "attention_impl": ("auto", str),
 }
 
 

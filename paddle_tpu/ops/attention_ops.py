@@ -64,6 +64,10 @@ def _lower_sdpa(ctx, ins, attrs):
             mask = mask[:, None, None, :]
         mask = mask.astype(bool)
     impl = attrs.get("impl", "auto")
+    if impl == "auto":
+        from paddle_tpu import flags
+
+        impl = flags.get("attention_impl")
     if impl == "reference":
         return flash_attention_reference(
             q, k, v, causal=causal, sm_scale=sm_scale, mask=mask
