@@ -1,0 +1,396 @@
+// Minimal JSON value + parser/serializer (C++17, no deps) for the native
+// master's wire protocol and snapshots. The protocol (one JSON object per
+// line over TCP) and the snapshot schema are shared byte-compatibly with
+// the Python master (paddle_tpu/distributed/master.py), so workers and
+// recovery interoperate across the two implementations.
+//
+// Scope: the full JSON grammar except \uXXXX escapes beyond Latin-1 are
+// passed through undecoded (chunk descriptors are opaque round-tripped
+// values; the master never interprets them).
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ptpu {
+namespace json {
+
+class Value;
+using Array = std::vector<Value>;
+// std::map keeps key order deterministic for snapshot diffs; the Python
+// side does not depend on member order.
+using Object = std::map<std::string, Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+  Value() : type_(Type::Null) {}
+  Value(std::nullptr_t) : type_(Type::Null) {}  // NOLINT
+  Value(bool b) : type_(Type::Bool), bool_(b) {}  // NOLINT
+  Value(int v) : type_(Type::Int), int_(v) {}  // NOLINT
+  Value(int64_t v) : type_(Type::Int), int_(v) {}  // NOLINT
+  Value(size_t v) : type_(Type::Int), int_(static_cast<int64_t>(v)) {}  // NOLINT
+  Value(double v) : type_(Type::Double), dbl_(v) {}  // NOLINT
+  Value(const char* s) : type_(Type::String), str_(s) {}  // NOLINT
+  Value(std::string s) : type_(Type::String), str_(std::move(s)) {}  // NOLINT
+  Value(Array a)  // NOLINT
+      : type_(Type::Array), arr_(std::make_shared<Array>(std::move(a))) {}
+  Value(Object o)  // NOLINT
+      : type_(Type::Object), obj_(std::make_shared<Object>(std::move(o))) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    if (type_ == Type::Int) return int_;
+    if (type_ == Type::Double) return static_cast<int64_t>(dbl_);
+    return dflt;
+  }
+  double as_double(double dflt = 0.0) const {
+    if (type_ == Type::Double) return dbl_;
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    return dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string kEmpty;
+    return type_ == Type::String ? str_ : kEmpty;
+  }
+  const Array& as_array() const {
+    static const Array kEmpty;
+    return type_ == Type::Array && arr_ ? *arr_ : kEmpty;
+  }
+  const Object& as_object() const {
+    static const Object kEmpty;
+    return type_ == Type::Object && obj_ ? *obj_ : kEmpty;
+  }
+  Array& mutable_array() {
+    if (type_ != Type::Array) *this = Value(Array{});
+    return *arr_;
+  }
+  Object& mutable_object() {
+    if (type_ != Type::Object) *this = Value(Object{});
+    return *obj_;
+  }
+
+  // object convenience: v["key"] (missing -> Null value)
+  const Value& operator[](const std::string& k) const {
+    static const Value kNull;
+    if (type_ != Type::Object || !obj_) return kNull;
+    auto it = obj_->find(k);
+    return it == obj_->end() ? kNull : it->second;
+  }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+  void write(std::ostream& os) const {
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Int: os << int_; break;
+      case Type::Double: {
+        if (std::isfinite(dbl_)) {
+          std::ostringstream tmp;
+          tmp.precision(17);
+          tmp << dbl_;
+          os << tmp.str();
+        } else {
+          os << "null";  // JSON has no inf/nan; match json.dumps(allow_nan=False) spirit
+        }
+        break;
+      }
+      case Type::String: write_string(os, str_); break;
+      case Type::Array: {
+        os << '[';
+        bool first = true;
+        for (const auto& v : *arr_) {
+          if (!first) os << ", ";
+          first = false;
+          v.write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& kv : *obj_) {
+          if (!first) os << ", ";
+          first = false;
+          write_string(os, kv.first);
+          os << ": ";
+          kv.second.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+
+ private:
+  static void write_string(std::ostream& os, const std::string& s) {
+    os << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;  // UTF-8 bytes pass through
+          }
+      }
+    }
+    os << '"';
+  }
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double dbl_ = 0.0;
+  std::string str_;
+  std::shared_ptr<Array> arr_;
+  std::shared_ptr<Object> obj_;
+};
+
+class ParseError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  Value parse() {
+    Value v = value();
+    skip_ws();
+    if (pos_ != s_.size()) throw ParseError("trailing bytes after JSON value");
+    return v;
+  }
+
+ private:
+  void skip_ws() {
+    while (pos_ < s_.size() && (s_[pos_] == ' ' || s_[pos_] == '\t' ||
+                                s_[pos_] == '\n' || s_[pos_] == '\r'))
+      ++pos_;
+  }
+
+  char peek() {
+    if (pos_ >= s_.size()) throw ParseError("unexpected end of input");
+    return s_[pos_];
+  }
+
+  void expect(char c) {
+    if (peek() != c)
+      throw ParseError(std::string("expected '") + c + "' at offset " +
+                       std::to_string(pos_));
+    ++pos_;
+  }
+
+  bool consume_literal(const char* lit) {
+    size_t n = std::strlen(lit);
+    if (s_.compare(pos_, n, lit) == 0) {
+      pos_ += n;
+      return true;
+    }
+    return false;
+  }
+
+  Value value() {
+    skip_ws();
+    char c = peek();
+    if (c == '{') return object();
+    if (c == '[') return array();
+    if (c == '"') return Value(string());
+    if (c == 't') {
+      if (consume_literal("true")) return Value(true);
+    } else if (c == 'f') {
+      if (consume_literal("false")) return Value(false);
+    } else if (c == 'n') {
+      if (consume_literal("null")) return Value();
+    } else if (c == '-' || (c >= '0' && c <= '9')) {
+      return number();
+    }
+    throw ParseError("unexpected character at offset " + std::to_string(pos_));
+  }
+
+  Value object() {
+    expect('{');
+    Object o;
+    skip_ws();
+    if (peek() == '}') {
+      ++pos_;
+      return Value(std::move(o));
+    }
+    while (true) {
+      skip_ws();
+      std::string k = string();
+      skip_ws();
+      expect(':');
+      o[std::move(k)] = value();
+      skip_ws();
+      if (peek() == ',') {
+        ++pos_;
+        continue;
+      }
+      expect('}');
+      return Value(std::move(o));
+    }
+  }
+
+  Value array() {
+    expect('[');
+    Array a;
+    skip_ws();
+    if (peek() == ']') {
+      ++pos_;
+      return Value(std::move(a));
+    }
+    while (true) {
+      a.push_back(value());
+      skip_ws();
+      if (peek() == ',') {
+        ++pos_;
+        continue;
+      }
+      expect(']');
+      return Value(std::move(a));
+    }
+  }
+
+  std::string string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) throw ParseError("unterminated string");
+      char c = s_[pos_++];
+      if (c == '"') return out;
+      if (c != '\\') {
+        out += c;
+        continue;
+      }
+      if (pos_ >= s_.size()) throw ParseError("unterminated escape");
+      char e = s_[pos_++];
+      switch (e) {
+        case '"': out += '"'; break;
+        case '\\': out += '\\'; break;
+        case '/': out += '/'; break;
+        case 'b': out += '\b'; break;
+        case 'f': out += '\f'; break;
+        case 'n': out += '\n'; break;
+        case 'r': out += '\r'; break;
+        case 't': out += '\t'; break;
+        case 'u': {
+          unsigned code = hex4();
+          // surrogate pair (Python json.dumps ensure_ascii escapes every
+          // astral char this way): combine into one code point
+          if (code >= 0xD800 && code <= 0xDBFF) {
+            if (pos_ + 1 >= s_.size() || s_[pos_] != '\\' ||
+                s_[pos_ + 1] != 'u')
+              throw ParseError("unpaired high surrogate");
+            pos_ += 2;
+            unsigned low = hex4();
+            if (low < 0xDC00 || low > 0xDFFF)
+              throw ParseError("invalid low surrogate");
+            code = 0x10000 + ((code - 0xD800) << 10) + (low - 0xDC00);
+          } else if (code >= 0xDC00 && code <= 0xDFFF) {
+            throw ParseError("unpaired low surrogate");
+          }
+          append_utf8(out, code);
+          break;
+        }
+        default: throw ParseError("bad escape character");
+      }
+    }
+  }
+
+  unsigned hex4() {
+    if (pos_ + 4 > s_.size()) throw ParseError("bad \\u escape");
+    unsigned code = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = s_[pos_++];
+      code <<= 4;
+      if (c >= '0' && c <= '9')
+        code |= static_cast<unsigned>(c - '0');
+      else if (c >= 'a' && c <= 'f')
+        code |= static_cast<unsigned>(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F')
+        code |= static_cast<unsigned>(c - 'A' + 10);
+      else
+        throw ParseError("non-hex digit in \\u escape");
+    }
+    return code;
+  }
+
+  static void append_utf8(std::string& out, unsigned code) {
+    if (code < 0x80) {
+      out += static_cast<char>(code);
+    } else if (code < 0x800) {
+      out += static_cast<char>(0xC0 | (code >> 6));
+      out += static_cast<char>(0x80 | (code & 0x3F));
+    } else if (code < 0x10000) {
+      out += static_cast<char>(0xE0 | (code >> 12));
+      out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (code & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (code >> 18));
+      out += static_cast<char>(0x80 | ((code >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (code & 0x3F));
+    }
+  }
+
+  Value number() {
+    size_t start = pos_;
+    bool is_double = false;
+    if (peek() == '-') ++pos_;
+    while (pos_ < s_.size()) {
+      char c = s_[pos_];
+      if (c >= '0' && c <= '9') {
+        ++pos_;
+      } else if (c == '.' || c == 'e' || c == 'E' || c == '+' || c == '-') {
+        is_double = true;
+        ++pos_;
+      } else {
+        break;
+      }
+    }
+    std::string tok = s_.substr(start, pos_ - start);
+    if (is_double) return Value(std::stod(tok));
+    try {
+      return Value(static_cast<int64_t>(std::stoll(tok)));
+    } catch (const std::out_of_range&) {
+      return Value(std::stod(tok));
+    }
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+inline Value parse(const std::string& text) { return Parser(text).parse(); }
+
+}  // namespace json
+}  // namespace ptpu

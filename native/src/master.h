@@ -1,0 +1,470 @@
+// Native elastic data-dispatch master (C++17, POSIX sockets, no deps).
+//
+// Reference parity: go/master/service.go — SetDataset/partition (:106),
+// GetTask lease + timeout (:368), TaskFinished (:411), TaskFailed requeue-
+// until-failure-max (:455), snapshot/recover (:166,207). This is the
+// native twin of paddle_tpu/distributed/master.py: SAME newline-JSON TCP
+// protocol and SAME snapshot schema, so Python MasterClient/task_reader
+// workers connect to either implementation unchanged, and either can
+// recover the other's snapshot (native-checklist item 12: the reference's
+// Go master maps to a C++ coordination service here).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <fstream>
+#include <list>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "json.h"
+
+namespace ptpu {
+namespace master {
+
+struct Task {
+  int64_t task_id = 0;
+  json::Array chunks;  // opaque descriptors, round-tripped verbatim
+  int64_t epoch = 0;
+  int64_t num_failures = 0;
+
+  json::Value to_json() const {
+    json::Object o;
+    o["task_id"] = json::Value(task_id);
+    o["chunks"] = json::Value(chunks);
+    o["epoch"] = json::Value(epoch);
+    o["num_failures"] = json::Value(num_failures);
+    return json::Value(std::move(o));
+  }
+
+  static Task from_json(const json::Value& v) {
+    Task t;
+    t.task_id = v["task_id"].as_int();
+    t.chunks = v["chunks"].as_array();
+    t.epoch = v["epoch"].as_int();
+    t.num_failures = v["num_failures"].as_int();
+    return t;
+  }
+};
+
+// Error codes shared with the Python protocol (_Errors in master.py).
+inline const char* kPassBefore = "pass_before";
+inline const char* kPassAfter = "pass_after";
+inline const char* kNoMoreAvailable = "no_more_available";
+inline const char* kAllFailed = "all_task_failed";
+
+class MasterService {
+ public:
+  MasterService(int chunks_per_task, double timeout_s, int failure_max,
+                std::string snapshot_path)
+      : chunks_per_task_(std::max(1, chunks_per_task)),
+        timeout_s_(timeout_s),
+        failure_max_(failure_max),
+        snapshot_path_(std::move(snapshot_path)) {
+    if (!snapshot_path_.empty()) {
+      std::ifstream f(snapshot_path_);
+      if (f.good()) Recover(f);
+    }
+  }
+
+  ~MasterService() { Close(); }
+
+  void SetDataset(const json::Array& chunks) {
+    std::lock_guard<std::mutex> lk(mu_);
+    all_chunks_ = chunks;
+    if (todo_.empty() && pending_.empty() && done_.empty()) {
+      int64_t id = 0;
+      for (size_t i = 0; i < chunks.size();
+           i += static_cast<size_t>(chunks_per_task_)) {
+        Task t;
+        t.task_id = id++;
+        size_t end = std::min(chunks.size(),
+                              i + static_cast<size_t>(chunks_per_task_));
+        t.chunks.assign(chunks.begin() + i, chunks.begin() + end);
+        todo_.push_back(std::move(t));
+      }
+      Snapshot(/*force=*/true);
+    }
+  }
+
+  // Lease the next task. ok=false -> err holds the protocol error code.
+  bool GetTask(int64_t pass_id, Task* out, std::string* err) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (pass_id < cur_pass_) {
+      *err = kPassBefore;
+      return false;
+    }
+    if (pass_id > cur_pass_) {
+      *err = kPassAfter;
+      return false;
+    }
+    if (todo_.empty()) {
+      *err = (done_.empty() && pending_.empty()) ? kAllFailed
+                                                 : kNoMoreAvailable;
+      return false;
+    }
+    Task t = std::move(todo_.front());
+    todo_.pop_front();
+    t.epoch += 1;
+    *out = t;
+    int64_t id = t.task_id;
+    pending_[id] = {std::move(t), Clock::now() + ToDuration(timeout_s_)};
+    EnsureWatcher();
+    Snapshot(false);
+    return true;
+  }
+
+  bool TaskFinished(int64_t task_id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = pending_.find(task_id);
+    if (it == pending_.end()) return false;
+    done_.push_back(std::move(it->second.task));
+    pending_.erase(it);
+    bool rolled = false;
+    if (todo_.empty() && pending_.empty()) {
+      NextPass();
+      rolled = true;
+    }
+    Snapshot(rolled);
+    return true;
+  }
+
+  bool TaskFailed(int64_t task_id, const json::Value& epoch) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return TaskFailedLocked(task_id, epoch);
+  }
+
+  json::Value Status() {
+    std::lock_guard<std::mutex> lk(mu_);
+    json::Object o;
+    o["todo"] = json::Value(todo_.size());
+    o["pending"] = json::Value(pending_.size());
+    o["done"] = json::Value(done_.size());
+    o["failed"] = json::Value(failed_.size());
+    o["cur_pass"] = json::Value(cur_pass_);
+    return json::Value(std::move(o));
+  }
+
+  // One request -> one response (the JSON-lines dispatch table; mirrors
+  // MasterService._dispatch in master.py).
+  json::Value Dispatch(const json::Value& req) {
+    const std::string& method = req["method"].as_string();
+    json::Object resp;
+    if (method == "get_task") {
+      Task t;
+      std::string err;
+      if (GetTask(req["pass_id"].as_int(0), &t, &err)) {
+        resp["ok"] = json::Value(true);
+        resp["task"] = t.to_json();
+      } else {
+        resp["ok"] = json::Value(false);
+        resp["error"] = json::Value(err);
+      }
+    } else if (method == "task_finished") {
+      resp["ok"] = json::Value(TaskFinished(req["task_id"].as_int()));
+    } else if (method == "task_failed") {
+      resp["ok"] =
+          json::Value(TaskFailed(req["task_id"].as_int(), req["epoch"]));
+    } else if (method == "set_dataset") {
+      SetDataset(req["chunks"].as_array());
+      resp["ok"] = json::Value(true);
+    } else if (method == "status") {
+      resp["ok"] = json::Value(true);
+      resp["status"] = Status();
+    } else {
+      resp["ok"] = json::Value(false);
+      resp["error"] = json::Value("unknown method '" + method + "'");
+    }
+    return json::Value(std::move(resp));
+  }
+
+  // Start the TCP endpoint; returns the bound port (0 on failure).
+  int Serve(const std::string& host, int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return 0;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    ::inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 64) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return 0;
+    }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return ntohs(addr.sin_port);
+  }
+
+  void Close() {
+    bool was_closed = closed_.exchange(true);
+    if (was_closed) return;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (snapshot_dirty_) Snapshot(/*force=*/true);
+    }
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    {
+      // unblock connection threads stuck in recv() on live clients
+      std::lock_guard<std::mutex> lk(mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    if (watcher_.joinable()) watcher_.join();
+    for (auto& c : conn_threads_)
+      if (c.th.joinable()) c.th.join();
+  }
+
+ private:
+  using Clock = std::chrono::steady_clock;
+
+  static Clock::duration ToDuration(double seconds) {
+    return std::chrono::duration_cast<Clock::duration>(
+        std::chrono::duration<double>(seconds));
+  }
+
+  struct Pending {
+    Task task;
+    Clock::time_point deadline;
+  };
+
+  bool TaskFailedLocked(int64_t task_id, const json::Value& epoch) {
+    auto it = pending_.find(task_id);
+    if (it == pending_.end()) return false;
+    if (!epoch.is_null() && epoch.as_int() != it->second.task.epoch)
+      return false;  // stale report from a previous lease
+    Task t = std::move(it->second.task);
+    pending_.erase(it);
+    t.num_failures += 1;
+    if (t.num_failures >= failure_max_) {
+      failed_.push_back(std::move(t));
+    } else {
+      todo_.push_back(std::move(t));
+    }
+    if (todo_.empty() && pending_.empty() && !done_.empty()) NextPass();
+    Snapshot(false);
+    return true;
+  }
+
+  void NextPass() {
+    cur_pass_ += 1;
+    std::vector<Task> all;
+    for (auto& t : done_) all.push_back(std::move(t));
+    for (auto& t : failed_) all.push_back(std::move(t));
+    done_.clear();
+    failed_.clear();
+    std::sort(all.begin(), all.end(),
+              [](const Task& a, const Task& b) { return a.task_id < b.task_id; });
+    todo_.clear();
+    for (auto& t : all) {
+      t.num_failures = 0;
+      todo_.push_back(std::move(t));
+    }
+  }
+
+  // -- lease timeout watcher (service.go checkTimeoutFunc) ---------------
+
+  void EnsureWatcher() {
+    if (watcher_running_) return;
+    watcher_running_ = true;
+    if (watcher_.joinable()) watcher_.join();
+    watcher_ = std::thread([this] { WatchLoop(); });
+  }
+
+  void WatchLoop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (!closed_) {
+      auto now = Clock::now();
+      std::vector<std::pair<int64_t, int64_t>> expired;
+      for (auto& kv : pending_)
+        if (kv.second.deadline <= now)
+          expired.emplace_back(kv.first, kv.second.task.epoch);
+      for (auto& e : expired)
+        TaskFailedLocked(e.first, json::Value(e.second));
+      if (pending_.empty()) break;  // watcher exits when nothing is leased
+      cv_.wait_for(lk, std::min(ToDuration(timeout_s_ / 4.0),
+                                ToDuration(0.25)));
+    }
+    watcher_running_ = false;
+  }
+
+  // -- persistence (same schema as master.py _snapshot/_recover) ---------
+
+  void Snapshot(bool force) {
+    if (snapshot_path_.empty()) return;
+    auto now = Clock::now();
+    if (!force && now - last_snapshot_ < ToDuration(0.5)) {
+      snapshot_dirty_ = true;
+      return;
+    }
+    last_snapshot_ = now;
+    snapshot_dirty_ = false;
+    json::Object state;
+    json::Array todo, pending, done, failed;
+    for (const auto& t : todo_) todo.push_back(t.to_json());
+    for (const auto& kv : pending_) pending.push_back(kv.second.task.to_json());
+    for (const auto& t : done_) done.push_back(t.to_json());
+    for (const auto& t : failed_) failed.push_back(t.to_json());
+    state["todo"] = json::Value(std::move(todo));
+    state["pending"] = json::Value(std::move(pending));
+    state["done"] = json::Value(std::move(done));
+    state["failed"] = json::Value(std::move(failed));
+    state["cur_pass"] = json::Value(cur_pass_);
+    state["chunks"] = json::Value(all_chunks_);
+    std::string tmp = snapshot_path_ + ".tmp";
+    {
+      std::ofstream f(tmp, std::ios::trunc);
+      json::Value(std::move(state)).write(f);
+    }
+    std::rename(tmp.c_str(), snapshot_path_.c_str());
+  }
+
+  void Recover(std::ifstream& f) {
+    std::string text((std::istreambuf_iterator<char>(f)),
+                     std::istreambuf_iterator<char>());
+    json::Value state = json::parse(text);
+    for (const auto& v : state["todo"].as_array())
+      todo_.push_back(Task::from_json(v));
+    // tasks pending at crash time go back to todo (service.go:166)
+    for (const auto& v : state["pending"].as_array())
+      todo_.push_back(Task::from_json(v));
+    for (const auto& v : state["done"].as_array())
+      done_.push_back(Task::from_json(v));
+    for (const auto& v : state["failed"].as_array())
+      failed_.push_back(Task::from_json(v));
+    cur_pass_ = state["cur_pass"].as_int();
+    all_chunks_ = state["chunks"].as_array();
+  }
+
+  // -- TCP front-end (one thread per connection, JSON lines) -------------
+
+  void AcceptLoop() {
+    while (!closed_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      std::lock_guard<std::mutex> lk(mu_);
+      ReapLocked();  // bound growth: join threads of closed connections
+      auto done = std::make_shared<std::atomic<bool>>(false);
+      conn_fds_.push_back(fd);
+      conn_threads_.push_back(
+          {std::thread([this, fd, done] {
+             ConnLoop(fd);
+             {
+               std::lock_guard<std::mutex> lk2(mu_);
+               conn_fds_.erase(
+                   std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+                   conn_fds_.end());
+             }
+             // last statement: after this the thread touches nothing, so
+             // ReapLocked may join it while holding mu_ without deadlock
+             done->store(true);
+           }),
+           done});
+    }
+  }
+
+  void ReapLocked() {
+    for (auto it = conn_threads_.begin(); it != conn_threads_.end();) {
+      if (it->done->load()) {
+        it->th.join();
+        it = conn_threads_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  void ConnLoop(int fd) {
+    std::string buf;
+    char chunk[4096];
+    while (!closed_) {
+      ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+      if (n <= 0) break;
+      buf.append(chunk, static_cast<size_t>(n));
+      size_t nl;
+      while ((nl = buf.find('\n')) != std::string::npos) {
+        std::string line = buf.substr(0, nl);
+        buf.erase(0, nl + 1);
+        if (line.empty()) continue;
+        std::string out;
+        try {
+          out = Dispatch(json::parse(line)).dump();
+        } catch (const std::exception& e) {
+          json::Object err;
+          err["ok"] = json::Value(false);
+          err["error"] = json::Value(std::string(e.what()));
+          out = json::Value(std::move(err)).dump();
+        }
+        out += '\n';
+        size_t sent = 0;
+        while (sent < out.size()) {
+          // MSG_NOSIGNAL: a worker that died mid-request must cost one
+          // connection, not a SIGPIPE that kills the whole coordinator
+          ssize_t m = ::send(fd, out.data() + sent, out.size() - sent,
+                             MSG_NOSIGNAL);
+          if (m <= 0) {
+            ::close(fd);
+            return;
+          }
+          sent += static_cast<size_t>(m);
+        }
+      }
+    }
+    ::close(fd);
+  }
+
+  const int chunks_per_task_;
+  const double timeout_s_;
+  const int failure_max_;
+  const std::string snapshot_path_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Task> todo_;
+  std::unordered_map<int64_t, Pending> pending_;
+  std::vector<Task> done_;
+  std::vector<Task> failed_;
+  int64_t cur_pass_ = 0;
+  json::Array all_chunks_;
+
+  std::atomic<bool> closed_{false};
+  bool watcher_running_ = false;
+  bool snapshot_dirty_ = false;
+  Clock::time_point last_snapshot_{};
+  struct Conn {
+    std::thread th;
+    std::shared_ptr<std::atomic<bool>> done;
+  };
+
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  std::thread watcher_;
+  std::list<Conn> conn_threads_;
+  std::vector<int> conn_fds_;
+};
+
+}  // namespace master
+}  // namespace ptpu

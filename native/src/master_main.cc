@@ -1,0 +1,92 @@
+// ptpu_master: standalone elastic data-dispatch master (go/cmd/master
+// role). Serves the same newline-JSON TCP protocol as the Python
+// MasterService, so paddle_tpu.distributed.MasterClient workers connect
+// unchanged. Prints "LISTENING <port>" once bound (test harness contract).
+//
+//   ptpu_master [--host 127.0.0.1] [--port 0] [--chunks_per_task 1]
+//               [--timeout_s 5.0] [--failure_max 3] [--snapshot PATH]
+
+#include <unistd.h>
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "master.h"
+
+namespace {
+// Self-pipe: the handler only write()s (async-signal-safe); the main
+// thread, parked on read(), performs the actual Close() — which takes
+// mutexes and joins threads and therefore must NOT run in a handler
+// (a signal landing on a thread holding mu_ would self-deadlock).
+int g_wake_pipe[2] = {-1, -1};
+
+void HandleSignal(int) {
+  char b = 1;
+  ssize_t n = ::write(g_wake_pipe[1], &b, 1);
+  (void)n;
+}
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  std::string snapshot;
+  int port = 0;
+  int chunks_per_task = 1;
+  double timeout_s = 5.0;
+  int failure_max = 3;
+
+  for (int i = 1; i < argc; ++i) {
+    auto next = [&](const char* flag) -> const char* {
+      if (i + 1 >= argc) {
+        std::fprintf(stderr, "%s needs a value\n", flag);
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (!std::strcmp(argv[i], "--host")) {
+      host = next("--host");
+    } else if (!std::strcmp(argv[i], "--port")) {
+      port = std::atoi(next("--port"));
+    } else if (!std::strcmp(argv[i], "--chunks_per_task")) {
+      chunks_per_task = std::atoi(next("--chunks_per_task"));
+    } else if (!std::strcmp(argv[i], "--timeout_s")) {
+      timeout_s = std::atof(next("--timeout_s"));
+    } else if (!std::strcmp(argv[i], "--failure_max")) {
+      failure_max = std::atoi(next("--failure_max"));
+    } else if (!std::strcmp(argv[i], "--snapshot")) {
+      snapshot = next("--snapshot");
+    } else {
+      std::fprintf(stderr, "unknown flag %s\n", argv[i]);
+      return 2;
+    }
+  }
+
+  ptpu::master::MasterService service(chunks_per_task, timeout_s,
+                                      failure_max, snapshot);
+  if (::pipe(g_wake_pipe) != 0) {
+    std::perror("pipe");
+    return 1;
+  }
+  std::signal(SIGINT, HandleSignal);
+  std::signal(SIGTERM, HandleSignal);
+  std::signal(SIGPIPE, SIG_IGN);  // dead workers cost a connection, not us
+
+  int bound = service.Serve(host, port);
+  if (bound == 0) {
+    std::fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);
+    return 1;
+  }
+  std::printf("LISTENING %d\n", bound);
+  std::fflush(stdout);
+  // serve until signalled, then shut down (and flush the snapshot) from
+  // the main thread where locking is safe
+  char b;
+  while (::read(g_wake_pipe[0], &b, 1) < 0 && errno == EINTR) {
+  }
+  service.Close();
+  return 0;
+}

@@ -32,3 +32,32 @@ def _fresh_programs():
     executor_mod._scope_stack = [executor_mod._global_scope]
     np.random.seed(42)
     yield
+
+
+_NATIVE_BUILD_RESULT = {}
+
+
+def build_native_binary(name):
+    """Locate a native/build binary, running the cmake build AT MOST once
+    per session and only when first asked (never at collection time).
+    Returns the path or None when the toolchain is unavailable. Shared by
+    every test that drives a native executable."""
+    import subprocess
+
+    if name in _NATIVE_BUILD_RESULT:
+        return _NATIVE_BUILD_RESULT[name]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "native", "build", name)
+    if not os.path.exists(path):
+        try:
+            subprocess.run(
+                ["cmake", "-S", os.path.join(root, "native"), "-B",
+                 os.path.join(root, "native", "build"), "-G", "Ninja"],
+                check=True, capture_output=True)
+            subprocess.run(
+                ["cmake", "--build", os.path.join(root, "native", "build")],
+                check=True, capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            pass
+    _NATIVE_BUILD_RESULT[name] = path if os.path.exists(path) else None
+    return _NATIVE_BUILD_RESULT[name]

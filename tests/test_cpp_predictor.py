@@ -89,21 +89,9 @@ def test_native_interp_runs_resnet_block(tmp_path):
 
 
 def _demo_binary(name="ptpu_demo_predictor"):
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    path = os.path.join(root, "native", "build", name)
-    if os.path.exists(path):
-        return path
-    try:
-        subprocess.run(
-            ["cmake", "-S", os.path.join(root, "native"), "-B",
-             os.path.join(root, "native", "build"), "-G", "Ninja"],
-            check=True, capture_output=True)
-        subprocess.run(
-            ["cmake", "--build", os.path.join(root, "native", "build")],
-            check=True, capture_output=True)
-    except (OSError, subprocess.CalledProcessError):
-        return None
-    return path if os.path.exists(path) else None
+    from tests.conftest import build_native_binary
+
+    return build_native_binary(name)
 
 
 def test_demo_predictor_binary_end_to_end(tmp_path):

@@ -1,0 +1,180 @@
+"""Native (C++) elastic master: protocol parity with the Python
+MasterService, elastic lease-timeout/failure semantics, and CROSS-LANGUAGE
+snapshot recovery (either implementation resumes the other's snapshot).
+
+Reference parity: go/master/service.go + go/cmd/master, rebuilt as the
+C++ coordination service SURVEY.md §2.9 item 12 calls for. The Python
+MasterClient/task_reader from paddle_tpu.distributed drive the binary
+unchanged — the wire protocol is shared.
+"""
+
+import json
+import os
+import subprocess
+import time
+
+import pytest
+
+from paddle_tpu.distributed.master import (
+    MasterClient,
+    MasterService,
+    task_reader,
+)
+
+
+class _NativeMaster(object):
+    """Context manager: spawn ptpu_master, parse its bound port. Skips
+    the calling test when the native toolchain is unavailable (lazy: the
+    cmake build runs at most once, at first use, not at collection)."""
+
+    def __init__(self, *args):
+        from tests.conftest import build_native_binary
+
+        binary = build_native_binary("ptpu_master")
+        if binary is None:
+            pytest.skip("native toolchain unavailable")
+        self.proc = subprocess.Popen(
+            [binary] + [str(a) for a in args],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        line = self.proc.stdout.readline().decode()
+        assert line.startswith("LISTENING "), line
+        self.port = int(line.split()[1])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.proc.terminate()
+        self.proc.wait(timeout=10)
+
+
+def test_protocol_parity_full_epoch():
+    chunks = ["part-%03d" % i for i in range(10)]
+    with _NativeMaster("--chunks_per_task", 3) as m:
+        client = MasterClient(("127.0.0.1", m.port))
+        assert client.set_dataset(chunks)
+        # 10 chunks / 3 per task = 4 tasks
+        st = client.status()
+        assert st["todo"] == 4 and st["cur_pass"] == 0
+
+        seen = []
+        loaded = task_reader(client, lambda c: iter([c]))
+        for sample in loaded():
+            seen.append(sample)
+        assert sorted(seen) == chunks  # one full pass, every chunk once
+        assert client.status()["cur_pass"] == 1  # rolled to the next pass
+
+        # second epoch redispatches everything
+        seen2 = sorted(loaded())
+        assert seen2 == chunks
+        client.close()
+
+
+def test_unicode_chunk_descriptors_round_trip():
+    """Chunk descriptors are opaque: non-ASCII (incl. astral plane, which
+    Python json.dumps ships as \\u-surrogate pairs) must round-trip
+    through the C++ master byte-exactly."""
+    chunks = ["データ/part-0", "shards/\U0001F600.rec", {"file": "naïve.txt",
+                                                        "offset": 42}]
+    with _NativeMaster() as m:
+        client = MasterClient(("127.0.0.1", m.port))
+        client.set_dataset(chunks)
+        got = []
+        while True:
+            task = client.get_task(sync_pass=False)
+            if task is None:
+                break
+            got.extend(task.chunks)
+            client.task_finished(task.task_id)
+        assert sorted(got, key=str) == sorted(chunks, key=str)
+        client.close()
+
+
+def test_lease_timeout_requeues_and_failure_max_discards():
+    with _NativeMaster("--timeout_s", 0.3, "--failure_max", 2) as m:
+        client = MasterClient(("127.0.0.1", m.port))
+        client.set_dataset(["only-chunk"])
+
+        # lease and abandon: the lease must expire back to todo
+        t1 = client.get_task()
+        assert t1 is not None and t1.epoch == 1
+        deadline = time.time() + 5.0
+        while client.status()["todo"] == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert client.status()["todo"] == 1  # requeued (num_failures=1)
+
+        # fail it once more explicitly: reaches failure_max -> discarded
+        t2 = client.get_task()
+        assert t2.num_failures == 1
+        assert client.task_failed(t2.task_id, t2.epoch)
+        st = client.status()
+        assert st["failed"] == 1 and st["todo"] == 0
+
+        # stale failure reports (old epoch) are rejected
+        client2 = MasterClient(("127.0.0.1", m.port))
+        assert not client2.task_failed(t2.task_id, epoch=0)
+        client.close()
+        client2.close()
+
+
+def test_native_master_recovers_python_snapshot(tmp_path):
+    """A Python-master snapshot restarts under the C++ master: pending
+    tasks go back to todo, pass counter and chunks carry over."""
+    snap = str(tmp_path / "master.snap")
+    py = MasterService(chunks_per_task=2, timeout_s=30.0, snapshot_path=snap)
+    py.set_dataset(list(range(8)))  # 4 tasks
+    t, err = py.get_task(0)
+    assert err is None
+    py.task_finished(t.task_id)
+    t2, _ = py.get_task(0)  # leave one leased ("crash" with it pending)
+    assert t2 is not None
+    py.close()
+    assert os.path.exists(snap)
+
+    with _NativeMaster("--snapshot", snap, "--timeout_s", 30.0) as m:
+        client = MasterClient(("127.0.0.1", m.port))
+        st = client.status()
+        # 2 untouched todo + 1 recovered-from-pending; 1 done
+        assert st == {"todo": 3, "pending": 0, "done": 1, "failed": 0,
+                      "cur_pass": 0}
+        got = []
+        while True:
+            task = client.get_task(sync_pass=False)  # one pass only
+            if task is None:
+                break
+            got.extend(task.chunks)
+            client.task_finished(task.task_id)
+        # task (0,1) was finished pre-crash; leased (2,3) was recovered
+        assert sorted(got) == [2, 3, 4, 5, 6, 7]
+        client.close()
+
+
+def test_python_master_recovers_native_snapshot(tmp_path):
+    """And the reverse: the C++ master's snapshot file loads into the
+    Python MasterService (same schema both ways)."""
+    snap = str(tmp_path / "native.snap")
+    with _NativeMaster("--snapshot", snap, "--chunks_per_task", 1,
+                       "--timeout_s", 30.0) as m:
+        client = MasterClient(("127.0.0.1", m.port))
+        client.set_dataset(["a", "b", "c"])
+        t = client.get_task()
+        client.task_finished(t.task_id)
+        client.close()
+    # binary got SIGTERM -> flushed its snapshot on Close
+    assert os.path.exists(snap)
+    with open(snap) as f:
+        state = json.load(f)
+    assert state["cur_pass"] == 0 and len(state["done"]) == 1
+
+    py = MasterService(chunks_per_task=1, snapshot_path=snap)
+    assert py.status() == {"todo": 2, "pending": 0, "done": 1, "failed": 0,
+                           "cur_pass": 0}
+    remaining = []
+    while True:
+        task, err = py.get_task(0)
+        if err:
+            break
+        remaining.extend(task.chunks)
+        py.task_finished(task.task_id)
+    assert sorted(remaining) == ["b", "c"]
+    py.close()
