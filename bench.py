@@ -104,6 +104,7 @@ def _bench_resnet(fluid, on_tpu, use_amp):
         img, bs, steps, warmup = 224, 128, 50, 10
     else:
         img, bs, steps, warmup = 64, 16, 5, 2
+    bs = int(os.environ.get("BENCH_BS", bs))  # batch-sweep override
     # BENCH_DATA=host feeds real numpy batches through the PyReader path
     # (h2d transfer on the timed path; BENCH_DOUBLE_BUFFER=0 disables the
     # device prefetch so the overlap win is measurable). Default "graph"
@@ -215,6 +216,8 @@ def _bench_transformer(fluid, on_tpu, use_amp):
         bs, seq, steps, warmup = 4, 32, 4, 2
         n_layer, n_head, d_model, d_inner = 2, 4, 64, 128
     vocab = 32000 if on_tpu else 500
+    bs = int(os.environ.get("BENCH_BS", bs))  # batch-sweep override
+    seq = int(os.environ.get("BENCH_SEQ", seq))
 
     main_prog, startup = fluid.Program(), fluid.Program()
     main_prog.random_seed = 7

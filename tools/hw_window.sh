@@ -35,7 +35,10 @@ capture() {
   # 2. Pallas-vs-XLA kernel verdicts (flag defaults depend on these)
   timeout -k 30 2400 python tools/kernel_bench.py \
     >"$LOG/kernels.jsonl" 2>"$LOG/kernels.err"
-  # 3. the prepared MFU experiments
+  # 3. per-HLO-op xprof breakdown of the ResNet step (MFU push evidence)
+  timeout -k 30 2400 python tools/step_breakdown.py --model resnet50 \
+    --xprof >"$LOG/breakdown.jsonl" 2>"$LOG/breakdown.err"
+  # 4. the prepared MFU experiments
   timeout -k 30 7200 tools/mfu_sweep.sh \
     >"$LOG/sweep.jsonl" 2>"$LOG/sweep.err"
   echo "capture done $(date -u +%FT%TZ)" | tee -a "$LOG/log"

@@ -24,8 +24,10 @@ run() {
   local tag="$1"; shift
   echo "== $tag =="
   local out
-  out=$(env "$@" python bench.py --worker 2>"/tmp/mfu_sweep_$tag.err" \
-    | tail -1)
+  # per-experiment bound: one wedged worker (the axon tunnel can hang
+  # in-process jax) must cost ONE capture, not every experiment after it
+  out=$(env "$@" timeout -k 15 "${SWEEP_EXP_TIMEOUT:-1800}" \
+    python bench.py --worker 2>"/tmp/mfu_sweep_$tag.err" | tail -1)
   if [ -n "$out" ]; then
     printf '{"experiment": "%s", "capture": %s}\n' "$tag" "$out"
   else
@@ -47,11 +49,15 @@ else
   run baseline      BENCH_MODEL=resnet50
   run fp32          BENCH_MODEL=resnet50 BENCH_AMP=0
   run nhwc          BENCH_MODEL=resnet50 FLAGS_conv_nhwc=1
+  run bs64          BENCH_MODEL=resnet50 BENCH_BS=64
+  run bs256         BENCH_MODEL=resnet50 BENCH_BS=256
   run multistep     BENCH_MODEL=resnet50 BENCH_MULTISTEP=1
   run hostdata+db   BENCH_MODEL=resnet50 BENCH_DATA=host BENCH_DOUBLE_BUFFER=1
   run hostdata-nodb BENCH_MODEL=resnet50 BENCH_DATA=host BENCH_DOUBLE_BUFFER=0
   run transformer   BENCH_MODEL=transformer
   run transformer-fp32 BENCH_MODEL=transformer BENCH_AMP=0
+  run transformer-bs128 BENCH_MODEL=transformer BENCH_BS=128
+  run transformer-refattn BENCH_MODEL=transformer FLAGS_attention_impl=reference
 fi
 
 echo "== kernels =="

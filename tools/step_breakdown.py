@@ -1,0 +1,279 @@
+"""Training-step decomposition: phase timings + per-HLO-op xprof shares.
+
+The measurement VERDICT r2 called for behind the MFU push ("commit an
+xprof/step-decomposition to BENCH_NOTES"): where does the step time go?
+
+Two independent views, printed as JSON lines:
+
+1. Phase timing — the model's program is compiled and timed three ways
+   (forward only; forward+backward via append_backward; the full train
+   step with the optimizer), so bwd and optimizer cost are the deltas.
+2. ``--xprof`` — run the full step under ``jax.profiler.trace`` and
+   aggregate XLA op self-times from the xplane.pb the profiler writes.
+   The xplane wire format is decoded directly (a ~60-line generic
+   protobuf reader; the tensorboard_plugin_profile converter in this
+   image is incompatible with its tensorflow build, and the schema —
+   XPlane{name=2, lines=3, event_metadata=4} / XLine{name=2, events=4} /
+   XEvent{metadata_id=1, duration_ps=3} — is stable across xprof
+   versions). Top-N ops by total self time, with % of the plane.
+
+Usage (CPU smoke / TPU real):
+  BENCH_PLATFORM=cpu python tools/step_breakdown.py --model resnet50 --xprof
+  python tools/step_breakdown.py --model resnet50 --steps 20 --xprof
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# xplane.pb decoding (generic protobuf wire reader; schema constants above)
+# ---------------------------------------------------------------------------
+
+
+def _varint(buf, i):
+    v = s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        v |= (b & 0x7F) << s
+        if not b & 0x80:
+            return v, i
+        s += 7
+
+
+def _fields(buf):
+    i = 0
+    out = []
+    while i < len(buf):
+        tag, i = _varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+        out.append((fn, wt, v))
+    return out
+
+
+def op_times_from_xplane(path, plane_filter=None):
+    """{plane_name: {op_name: total_self_time_ps}} from one xplane.pb."""
+    data = open(path, "rb").read()
+    result = {}
+    for fn, wt, plane_buf in _fields(data):
+        if fn != 1 or wt != 2:  # XSpace.planes
+            continue
+        plane = _fields(plane_buf)
+        name = next((v.decode("utf-8", "replace")
+                     for f, w, v in plane if f == 2 and w == 2), "")
+        if plane_filter and plane_filter not in name:
+            continue
+        # event metadata id -> name (map entries: key=1, value=XEventMetadata)
+        md = {}
+        for f, w, v in plane:
+            if f != 4 or w != 2:
+                continue
+            entry = _fields(v)
+            key = next((x for fk, _, x in entry if fk == 1), None)
+            val = next((x for fk, wk, x in entry if fk == 2 and wk == 2), b"")
+            try:
+                emeta = _fields(val)
+                ename = next((x.decode("utf-8", "replace")
+                              for fk, wk, x in emeta if fk == 2 and wk == 2),
+                             "")
+            except (ValueError, IndexError):
+                ename = ""
+            if key is not None and ename:
+                md[key] = ename
+        # lines -> events
+        times = defaultdict(int)
+        for f, w, v in plane:
+            if f != 3 or w != 2:  # XPlane.lines
+                continue
+            for lf, lw, lv in _fields(v):
+                if lf != 4 or lw != 2:  # XLine.events
+                    continue
+                ev = _fields(lv)
+                mid = next((x for fk, _, x in ev if fk == 1), None)
+                dur = next((x for fk, _, x in ev if fk == 3), 0)
+                if mid is not None:
+                    times[md.get(mid, "id:%s" % mid)] += dur
+        if times:
+            result[name] = dict(times)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# phase timing
+# ---------------------------------------------------------------------------
+
+
+def _build(fluid, model, on_tpu, mode):
+    """mode: 'fwd' | 'fwdbwd' | 'step'. Returns (main, startup, loss)."""
+    from paddle_tpu.models import resnet, transformer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        if model == "resnet50":
+            img, bs = (224, 128) if on_tpu else (64, 8)
+            pixel, label = fluid.layers.random_data_generator(
+                shapes=[[bs, 3, img, img], [bs, 1]],
+                dtypes=["float32", "int64"], int_high=999)
+            pred = resnet.resnet_imagenet(pixel, 1000, depth=50)
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred, label=label))
+            denom = bs
+        else:
+            seq, bs = (256, 64) if on_tpu else (32, 4)
+            nl, nh, dm, di = (6, 8, 512, 2048) if on_tpu else (2, 4, 64, 128)
+            vocab = 32000 if on_tpu else 500
+            loss, feeds, _ = transformer.build(
+                src_vocab_size=vocab, trg_vocab_size=vocab, max_length=seq,
+                n_layer=nl, n_head=nh, d_model=dm, d_inner=di, dropout=0.1)
+            denom = bs * seq
+        if mode == "fwdbwd":
+            # lr=0 SGD anchors the backward as live program state; a bare
+            # append_backward would leave grads unread and XLA would DCE
+            # the whole backward (measured: "bwd" came out free)
+            fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+        elif mode == "step":
+            fluid.optimizer.Momentum(
+                learning_rate=0.1, momentum=0.9).minimize(loss)
+    return main, startup, loss, denom
+
+
+def _transformer_feed(on_tpu):
+    import numpy as np
+
+    seq, bs = (256, 64) if on_tpu else (32, 4)
+    vocab = 32000 if on_tpu else 500
+    rng = np.random.RandomState(11)
+    return {
+        "src_word": rng.randint(1, vocab, (bs, seq)).astype("int64"),
+        "src_len": np.full((bs, 1), seq, "int64"),
+        "trg_word": rng.randint(1, vocab, (bs, seq)).astype("int64"),
+        "trg_len": np.full((bs, 1), seq, "int64"),
+        "label": rng.randint(1, vocab, (bs, seq)).astype("int64"),
+    }
+
+
+def _time_phase(fluid, model, on_tpu, mode, steps, warmup, use_amp):
+    import numpy as np
+    from paddle_tpu.transpiler import rewrite_program_amp
+    from paddle_tpu import unique_name
+
+    unique_name.switch()
+    main, startup, loss, denom = _build(fluid, model, on_tpu, mode)
+    if use_amp:
+        rewrite_program_amp(main, "bfloat16")
+    feed = _transformer_feed(on_tpu) if model == "transformer" else {}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace() if on_tpu
+                             else fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(main, feed=feed, fetch_list=[])
+        exe.run(main, feed=feed, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for _ in range(steps - 1):
+            exe.run(main, feed=feed, fetch_list=[])
+        out = exe.run(main, feed=feed, fetch_list=[loss])
+        dt = (time.perf_counter() - t0) / steps
+    assert np.isfinite(float(np.ravel(np.asarray(out[0]))[0]))
+    return dt, denom
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet50", "transformer"])
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--xprof", action="store_true",
+                    help="also capture + aggregate an xprof trace")
+    ap.add_argument("--top", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    import paddle_tpu as fluid
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    use_amp = on_tpu
+
+    phases = {}
+    for mode in ("fwd", "fwdbwd", "step"):
+        dt, denom = _time_phase(fluid, args.model, on_tpu, mode,
+                                args.steps, args.warmup, use_amp)
+        phases[mode] = dt
+        print(json.dumps({"phase": mode, "ms": round(dt * 1e3, 3),
+                          "per_unit_us": round(dt / denom * 1e6, 3)}))
+    print(json.dumps({
+        "phase": "deltas",
+        "bwd_ms": round((phases["fwdbwd"] - phases["fwd"]) * 1e3, 3),
+        "opt_ms": round((phases["step"] - phases["fwdbwd"]) * 1e3, 3),
+        "bwd_over_fwd": round(phases["fwdbwd"] / phases["fwd"] - 1, 2),
+    }))
+
+    if not args.xprof:
+        return
+    from paddle_tpu.transpiler import rewrite_program_amp
+    from paddle_tpu import unique_name
+
+    unique_name.switch()
+    main_p, startup, loss, _ = _build(fluid, args.model, on_tpu, "step")
+    if use_amp:
+        rewrite_program_amp(main_p, "bfloat16")
+    feed = _transformer_feed(on_tpu) if args.model == "transformer" else {}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace() if on_tpu
+                             else fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(args.warmup):
+            exe.run(main_p, feed=feed, fetch_list=[])
+        trace_dir = tempfile.mkdtemp(prefix="step_breakdown_")
+        with jax.profiler.trace(trace_dir):
+            for _ in range(args.steps):
+                exe.run(main_p, feed=feed, fetch_list=[])
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+    # device plane if present (TPU), else the host CPU plane
+    for path in glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True):
+        planes = op_times_from_xplane(path)
+        device = {n: t for n, t in planes.items() if "CPU" not in n} or planes
+        for pname, times in sorted(device.items()):
+            total = sum(times.values())
+            if not total:
+                continue
+            top = sorted(times.items(), key=lambda kv: -kv[1])[:args.top]
+            print(json.dumps({
+                "plane": pname, "total_ms": round(total / 1e9, 3),
+                "top_ops": [
+                    {"op": op, "ms": round(t / 1e9, 3),
+                     "pct": round(100.0 * t / total, 1)}
+                    for op, t in top
+                ]}))
+
+
+if __name__ == "__main__":
+    main()
