@@ -1,0 +1,106 @@
+"""Multi-process tensor-parallel x data-parallel trainer (the multi-host
+leg of the Megatron-style TP design): 2 jax.distributed processes x 4
+virtual CPU devices = a (data=2, model=4) global mesh whose DATA axis
+crosses the process boundary — grad all-reduces ride the inter-process
+link (the DCN stand-in), TP collectives stay intra-process (the ICI
+stand-in), exactly how a real multi-host TP topology lays out.
+
+Spawned by test_dist_multiproc.py with the PADDLE_* env cluster surface;
+MODEL_AXIS devices per process must equal the local device count. The
+single-process parity reference runs the SAME program over the same
+(2, 4) mesh built from 8 local devices (no process boundary).
+"""
+
+import json
+import os
+import sys
+
+GLOBAL_BATCH = 16
+STEPS = 4
+MODEL_AXIS = 4
+
+
+def run_tp_trainer(num_trainers, trainer_id):
+    import numpy as np
+
+    import paddle_tpu as fluid
+    import __graft_entry__ as graft
+    from paddle_tpu.parallel.mesh import build_mesh
+    from paddle_tpu.parallel_executor import BuildStrategy, ParallelExecutor
+
+    seq, nclass, d_model = 8, 8, 16
+    main, startup, loss = graft.build_tp_block_program(
+        seq=seq, nclass=nclass, d_model=d_model)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    import jax
+
+    devices = jax.devices()
+    if len(devices) != 8 // num_trainers:
+        raise RuntimeError(
+            "TP parity needs %d devices in this process, found %d — was "
+            "XLA_FLAGS=--xla_force_host_platform_device_count overridden?"
+            % (8 // num_trainers, len(devices)))
+    bs_strategy = BuildStrategy()
+    if os.environ.get("DIST_REDUCE", "reduce") == "reduce":
+        bs_strategy.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
+    pe = ParallelExecutor(
+        loss_name=loss.name,
+        main_program=main,
+        build_strategy=bs_strategy,
+        use_tpu=False,
+        sharding_overrides=graft.TP_OVERRIDES,
+        num_trainers=num_trainers,
+        trainer_id=trainer_id,
+    )
+    pe.mesh = build_mesh(
+        num_devices=len(devices),
+        data=len(devices) // MODEL_AXIS,
+        model=MODEL_AXIS,
+        devices=devices,
+    )
+
+    shard = GLOBAL_BATCH // num_trainers
+    lo, hi = trainer_id * shard, (trainer_id + 1) * shard
+    rng_feeds = []
+    for step in range(STEPS):
+        rng = np.random.RandomState(300 + step)
+        rng_feeds.append({
+            "x": rng.randn(GLOBAL_BATCH, seq, d_model).astype(np.float32),
+            "label": rng.randint(0, nclass,
+                                 (GLOBAL_BATCH, 1)).astype(np.int64),
+        })
+    losses = []
+    for step in range(STEPS):
+        feed = {k: v[lo:hi] for k, v in rng_feeds[step].items()}
+        lv, = pe.run(fetch_list=[loss], feed=feed)
+        losses.append(float(np.ravel(np.asarray(lv))[0]))
+    return losses
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nprocs = int(os.environ["PADDLE_TRAINERS_NUM"])
+    coord = os.environ["PADDLE_COORDINATOR"]
+    out_file = os.environ["DIST_OUT_FILE"]
+    local_devices = 8 // nprocs
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=%d" % local_devices)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.parallel.mesh import init_distributed
+
+    if nprocs > 1:
+        init_distributed(
+            coordinator_address=coord, num_processes=nprocs, process_id=rank)
+    losses = run_tp_trainer(nprocs, rank)
+    with open(out_file, "w") as f:
+        json.dump({"rank": rank, "losses": losses}, f)
+    print("tp trainer %d done: %s" % (rank, losses), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
