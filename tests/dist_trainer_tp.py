@@ -36,12 +36,13 @@ def run_tp_trainer(num_trainers, trainer_id):
 
     import jax
 
-    devices = jax.devices()
-    if len(devices) != 8 // num_trainers:
+    devices = jax.devices()  # global list: all processes' devices
+    if jax.local_device_count() != 8 // num_trainers or len(devices) != 8:
         raise RuntimeError(
-            "TP parity needs %d devices in this process, found %d — was "
-            "XLA_FLAGS=--xla_force_host_platform_device_count overridden?"
-            % (8 // num_trainers, len(devices)))
+            "TP parity needs %d local devices (8 global), found %d local / "
+            "%d global — was XLA_FLAGS=--xla_force_host_platform_device_"
+            "count overridden?"
+            % (8 // num_trainers, jax.local_device_count(), len(devices)))
     bs_strategy = BuildStrategy()
     if os.environ.get("DIST_REDUCE", "reduce") == "reduce":
         bs_strategy.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
