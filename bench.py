@@ -109,8 +109,12 @@ def _bench_resnet(fluid, on_tpu, use_amp):
     # (h2d transfer on the timed path; BENCH_DOUBLE_BUFFER=0 disables the
     # device prefetch so the overlap win is measurable). Default "graph"
     # keeps the in-graph generator: the framework step, not the host link.
+    # BENCH_UINT8=1 ships the pixels as uint8 and normalizes ON DEVICE —
+    # a 4x smaller h2d transfer, the input-pipeline recipe for real TPU
+    # hosts (and the fix VERDICT r2 named for the host-link-bound mode).
     host_data = os.environ.get("BENCH_DATA", "graph") == "host"
     double_buffer = os.environ.get("BENCH_DOUBLE_BUFFER", "1") == "1"
+    uint8_input = os.environ.get("BENCH_UINT8", "0") == "1"
 
     main_prog, startup = fluid.Program(), fluid.Program()
     main_prog.random_seed = 5
@@ -118,9 +122,15 @@ def _bench_resnet(fluid, on_tpu, use_amp):
     with fluid.program_guard(main_prog, startup):
         if host_data:
             pixel = fluid.layers.data(
-                name="bench_pixel", shape=[3, img, img], dtype="float32")
+                name="bench_pixel", shape=[3, img, img],
+                dtype="uint8" if uint8_input else "float32")
             label = fluid.layers.data(
                 name="bench_label", shape=[1], dtype="int64")
+            if uint8_input:
+                # cast + scale to [0,1) on device; XLA fuses this into the
+                # first conv's input so it costs one pass over the batch
+                pixel = fluid.layers.scale(
+                    fluid.layers.cast(pixel, "float32"), scale=1.0 / 255.0)
         else:
             pixel, label = fluid.layers.random_data_generator(
                 shapes=[[bs, 3, img, img], [bs, 1]],
@@ -140,8 +150,10 @@ def _bench_resnet(fluid, on_tpu, use_amp):
     if host_data:
         dt, lv = _host_data_steps(
             fluid, exe, main_prog, loss, steps, warmup, bs, img, place,
-            double_buffer)
-        mode = "host-data" + ("+double-buffer" if double_buffer else "")
+            double_buffer, uint8_input)
+        mode = ("host-data"
+                + ("+double-buffer" if double_buffer else "")
+                + ("+uint8" if uint8_input else ""))
     else:
         dt, lv, mode = _timed_steps(exe, main_prog, loss, steps, warmup)
     assert np.isfinite(lv), "non-finite loss %r" % lv
@@ -158,14 +170,21 @@ def _bench_resnet(fluid, on_tpu, use_amp):
 
 
 def _host_data_steps(fluid, exe, main_prog, loss, steps, warmup, bs, img,
-                     place, double_buffer):
+                     place, double_buffer, uint8_input=False):
     """Timed loop fed per-step from a PyReader over pre-generated numpy
     batches: the h2d transfer is ON the timed path, so the double-buffer
-    prefetch delta is what this mode exists to measure."""
+    prefetch delta (and the uint8 4x-smaller-transfer delta) is what
+    this mode exists to measure."""
     rng = np.random.RandomState(13)
     n_distinct = 8  # enough to defeat any transfer caching, bounded RAM
+
+    def make_pixels():
+        if uint8_input:
+            return rng.randint(0, 256, (bs, 3, img, img), dtype="uint8")
+        return rng.rand(bs, 3, img, img).astype("float32")
+
     batches = [
-        {"bench_pixel": rng.rand(bs, 3, img, img).astype("float32"),
+        {"bench_pixel": make_pixels(),
          "bench_label": rng.randint(0, 999, (bs, 1)).astype("int64")}
         for _ in range(n_distinct)
     ]

@@ -15,7 +15,9 @@
 #   multistep         K-step lax.scan executable (dispatch amortization)
 #   hostdata+db       PyReader host feeds, double buffer ON (h2d overlap)
 #   hostdata-nodb     same with the prefetch off (the control)
+#   hostdata-u8       uint8 pixels + on-device normalize (4x smaller h2d)
 #   transformer       the second north-star model
+#   transformer-*     fp32 / bs128 / reference-attention variants
 #   kernels           Pallas-vs-XLA microbench (tools/kernel_bench.py)
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -54,6 +56,7 @@ else
   run multistep     BENCH_MODEL=resnet50 BENCH_MULTISTEP=1
   run hostdata+db   BENCH_MODEL=resnet50 BENCH_DATA=host BENCH_DOUBLE_BUFFER=1
   run hostdata-nodb BENCH_MODEL=resnet50 BENCH_DATA=host BENCH_DOUBLE_BUFFER=0
+  run hostdata-u8   BENCH_MODEL=resnet50 BENCH_DATA=host BENCH_UINT8=1
   run transformer   BENCH_MODEL=transformer
   run transformer-fp32 BENCH_MODEL=transformer BENCH_AMP=0
   run transformer-bs128 BENCH_MODEL=transformer BENCH_BS=128
