@@ -33,6 +33,8 @@ __all__ = [
     "Switch",
     "IfElse",
     "cond",
+    "lod_rank_table",
+    "reorder_lod_tensor_by_rank",
 ]
 
 
@@ -792,3 +794,56 @@ class IfElse(object):
             )
             merged.append(out)
         return merged
+
+
+class RankTable(object):
+    """Build-time handle to a sequence rank table: sequences sorted by
+    descending length (ties stable). ``index``/``length`` are [batch]
+    int64 Variables computed at run time — unlike the reference's
+    LOD_RANK_TABLE (control_flow.py:741), contents are not inspectable at
+    build time because lengths are runtime tensors here."""
+
+    def __init__(self, index, length):
+        self.index = index
+        self.length = length
+
+
+def lod_rank_table(x=None, level=0, lengths=None):
+    """Rank sequences by descending length (lod_rank_table op role).
+
+    The reference reads the LoD of ``x``; in the dense-padded design
+    (docs/LOD_DESIGN.md) lengths are an explicit tensor, so pass
+    ``lengths`` ([batch] or [batch, 1] int). ``x`` and ``level`` are
+    accepted for API compatibility; ``level`` must be 0 (one ragged
+    level on device).
+    """
+    if lengths is None:
+        raise ValueError(
+            "lod_rank_table needs lengths= (the dense-padded design "
+            "carries sequence lengths as an explicit tensor; see "
+            "docs/LOD_DESIGN.md)")
+    if level != 0:
+        raise ValueError("only level=0 is supported on device")
+    helper = LayerHelper("lod_rank_table")
+    index = helper.create_variable_for_type_inference("int64")
+    sorted_len = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="lod_rank_table",
+        inputs={"Length": [lengths]},
+        outputs={"Index": [index], "SortedLength": [sorted_len]},
+    )
+    return RankTable(index, sorted_len)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Permute ``x``'s batch dimension into the rank table's order
+    (reorder_lod_tensor_by_rank_op.cc role). Gradient scatters back
+    through the permutation."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="reorder_lod_tensor_by_rank",
+        inputs={"X": [x], "RankIndex": [rank_table.index]},
+        outputs={"Out": [out]},
+    )
+    return out

@@ -509,3 +509,37 @@ register_op(
     lower=_lower_lod_reset,
     intermediate_outputs=("Length",),
 )
+
+
+def _lower_lod_rank_table(ctx, ins, attrs):
+    """Descending stable sort of sequence lengths: the lod_rank_table
+    op's runtime content (control_flow.py:741 items())."""
+    lens = jnp.reshape(ins["Length"][0], (-1,)).astype(jnp.int64)
+    # stable ascending argsort of -lens == descending by length with ties
+    # kept in original order (the reference table's tie rule)
+    order = jnp.argsort(-lens, stable=True)
+    return {"Index": order.astype(jnp.int64), "SortedLength": lens[order]}
+
+
+register_op(
+    "lod_rank_table",
+    inputs=["Length"],
+    outputs=["Index", "SortedLength"],
+    lower=_lower_lod_rank_table,
+    grad=None,
+)
+
+
+def _lower_reorder_by_rank(ctx, ins, attrs):
+    x = ins["X"][0]
+    idx = jnp.reshape(ins["RankIndex"][0], (-1,))
+    return jnp.take(x, idx, axis=0)
+
+
+register_op(
+    "reorder_lod_tensor_by_rank",
+    inputs=["X", "RankIndex"],
+    outputs=["Out"],
+    lower=_lower_reorder_by_rank,
+    no_grad_inputs=("RankIndex",),
+)

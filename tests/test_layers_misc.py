@@ -346,3 +346,39 @@ def test_save_combine_partial_gradient_path(tmp_path):
         losses.append(float(np.asarray(lv).ravel()[0]))
     assert losses[-1] < losses[0] * 0.6
     assert os.path.exists(path + ".npz")
+
+
+def test_lod_rank_table_and_reorder():
+    """lod_rank_table (desc-stable rank over lengths) + batch reorder,
+    with the gradient scattering back through the permutation."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        x.stop_gradient = False
+        lens = fluid.layers.data(name="lens", shape=[1], dtype="int64")
+        table = fluid.layers.lod_rank_table(lengths=lens)
+        y = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+        loss = fluid.layers.reduce_sum(
+            y * fluid.layers.assign(
+                np.arange(12, dtype="float32").reshape(4, 3)))
+        fluid.backward.append_backward(loss)
+        xg = main.block(0).vars["x@GRAD"]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.arange(12, dtype="float32").reshape(4, 3)
+    lv = np.array([[2], [5], [5], [1]], dtype="int64")
+    idx, slen, yv, gv = exe.run(
+        main, feed={"x": xv, "lens": lv},
+        fetch_list=[table.index, table.length, y, xg])
+    # desc by length, ties stable: lens [2,5,5,1] -> order [1,2,0,3]
+    np.testing.assert_array_equal(np.ravel(idx), [1, 2, 0, 3])
+    np.testing.assert_array_equal(np.ravel(slen), [5, 5, 2, 1])
+    np.testing.assert_allclose(yv, xv[[1, 2, 0, 3]])
+    # dL/dx permutes the weight matrix back through the gather
+    w = np.arange(12, dtype="float32").reshape(4, 3)
+    want = np.empty_like(w)
+    want[[1, 2, 0, 3]] = w
+    np.testing.assert_allclose(gv, want)
