@@ -68,9 +68,12 @@ class PassManager(object):
     STRATEGIES = {
         # deploy: slice to the inference subgraph FIRST (so training-only
         # ops from a clone-after-minimize program can't block fusion
-        # conditions), then fold BN into convs and collapse mul+add(+act)
-        # chains into fc ops
-        "inference": ["prune_feed_fetch", "fuse_batch_norm", "fc_fuse"],
+        # conditions), fold BN into convs, fuse fc->recurrence projections
+        # (before fc_fuse, which would otherwise claim those mul+add
+        # chains as plain fc ops — the reference analyzer orders its pass
+        # list the same way), then collapse mul+add(+act) chains into fc
+        "inference": ["prune_feed_fetch", "fuse_batch_norm",
+                      "fc_lstm_fuse", "fc_gru_fuse", "fc_fuse"],
         # training memory: rematerialization planning
         "memory": ["memory_optimize"],
         # mixed precision training
@@ -134,6 +137,26 @@ def _prune_feed_fetch(program, scope=None, feed_names=None,
     return prune_program(program, feed_names, fetch_names)
 
 
+def _persistable(block, name):
+    v = block.vars.get(name)
+    return v is not None and getattr(v, "persistable", False)
+
+
+def _projection_safe(block, mul_op, add_op, bias_name):
+    """The fused lowerings compute a plain 2-D matmul + trailing-axis
+    bias broadcast; reject mul/add attr combinations that mean something
+    else (the reference fc_fuse_pass's bias-shape checks)."""
+    if mul_op.attrs.get("y_num_col_dims", 1) != 1:
+        return False
+    if add_op is None:
+        return True
+    bvar = block.vars.get(bias_name)
+    if bvar is None or len(getattr(bvar, "shape", ()) or ()) != 1:
+        return False
+    xn = mul_op.attrs.get("x_num_col_dims", 1)
+    return add_op.attrs.get("axis", -1) in (-1, xn)
+
+
 @register_pass("fc_fuse")
 def _fc_fuse(program, scope=None, feed_names=None, fetch_names=None,
              **kwargs):
@@ -146,25 +169,13 @@ def _fc_fuse(program, scope=None, feed_names=None, fetch_names=None,
 
     protected = set(feed_names or ()) | set(fetch_names or ())
 
-    def _persistable(block, name):
-        v = block.vars.get(name)
-        return v is not None and getattr(v, "persistable", False)
-
     def _rewrite(block, m, with_act):
         if not (_persistable(block, m.var("w"))
                 and _persistable(block, m.var("b"))):
             return False
         mul_op, add_op = m.op("mul"), m.op("add")
         xn = mul_op.attrs.get("x_num_col_dims", 1)
-        # the fc lowering is a plain 2-D matmul + trailing-axis bias
-        # broadcast: bail out of shapes/axes it would silently change
-        # (reference fc_fuse_pass makes the same bias-shape checks)
-        if mul_op.attrs.get("y_num_col_dims", 1) != 1:
-            return False
-        bvar = block.vars.get(m.var("b"))
-        if bvar is None or len(getattr(bvar, "shape", ()) or ()) != 1:
-            return False
-        if add_op.attrs.get("axis", -1) not in (-1, xn):
+        if not _projection_safe(block, mul_op, add_op, m.var("b")):
             return False
         # every intermediate must feed ONLY the next chain op, and never
         # be a feed/fetch target
@@ -222,6 +233,106 @@ def _fc_fuse(program, scope=None, feed_names=None, fetch_names=None,
                     changed |= _rewrite(block, m, with_act)
     program._bump_version()
     return program
+
+
+def _fc_rnn_fuse(program, rnn_type, fused_type, feed_names, fetch_names):
+    """Shared body of fc_lstm_fuse / fc_gru_fuse (fc_lstm_fuse_pass.cc,
+    fc_gru_fuse_pass.cc roles): collapse the projection fc feeding a
+    recurrence into one fusion op. Inference-scope, like fc_fuse."""
+    from paddle_tpu.core.graph_pattern import GraphPatternDetector, consumers
+
+    protected = set(feed_names or ()) | set(fetch_names or ())
+
+    for bi in range(program.num_blocks):
+        block = program.block(bi)
+        for with_bias in (True, False):
+            changed = True
+            while changed:
+                changed = False
+                pat = GraphPatternDetector()
+                pat.op("mul", "mul",
+                       inputs={"X": "x", "Y": "wx"}, outputs={"Out": "mid"})
+                rnn_in = "mid"
+                if with_bias:
+                    pat.op("add", "elementwise_add",
+                           inputs={"X": "mid", "Y": "bx"},
+                           outputs={"Out": "proj"})
+                    rnn_in = "proj"
+                pat.op("rnn", rnn_type, inputs={"Input": rnn_in})
+                for m in sorted(pat.detect(block),
+                                key=lambda mm: -mm.op_indices()[0]):
+                    if not m.is_live(block):
+                        changed = True
+                        continue
+                    if not _persistable(block, m.var("wx")):
+                        continue
+                    if with_bias and not _persistable(block, m.var("bx")):
+                        continue
+                    if not _projection_safe(
+                            block, m.op("mul"),
+                            m.op("add") if with_bias else None,
+                            m.var("bx") if with_bias else None):
+                        continue
+                    # chain intermediates: single consumer, not protected
+                    names = [("mid", m.op_index("add") if with_bias
+                              else m.op_index("rnn"))]
+                    if with_bias:
+                        names.append(("proj", m.op_index("rnn")))
+                    ok = True
+                    for label, consumer_idx in names:
+                        if m.var(label) in protected:
+                            ok = False
+                            break
+                        users = [i for i, _, _
+                                 in consumers(block, m.var(label))]
+                        if users != [consumer_idx]:
+                            ok = False
+                            break
+                    if not ok:
+                        continue
+                    rnn = m.op("rnn")
+                    inputs = {"X": [m.var("x")], "WeightX": [m.var("wx")],
+                              "WeightH": rnn.input("Weight")}
+                    if with_bias:
+                        inputs["BiasX"] = [m.var("bx")]
+                    for slot in ("Bias", "H0", "C0", "Length"):
+                        if rnn.input(slot):
+                            inputs[slot] = rnn.input(slot)
+                    idxs = m.op_indices()
+                    for i in reversed(idxs):
+                        block.remove_op(i)
+                    # insert at the RECURRENCE's (shifted) position, not
+                    # the mul's: ops between them may produce the rnn's
+                    # H0/C0/Length inputs, which must stay upstream
+                    at = m.op_index("rnn") - (len(idxs) - 1)
+                    block.insert_op(
+                        at, fused_type,
+                        inputs=inputs,
+                        outputs=dict(rnn.outputs),
+                        attrs=dict(_role_attrs(rnn), **{
+                            k: v for k, v in rnn.attrs.items()
+                            if not k.startswith("__")}))
+                    for label, _ in names:
+                        block.vars.pop(m.var(label), None)
+                    changed = True
+    program._bump_version()
+    return program
+
+
+@register_pass("fc_lstm_fuse")
+def _fc_lstm_fuse(program, scope=None, feed_names=None, fetch_names=None,
+                  **kwargs):
+    """mul(+bias) feeding dynamic_lstm -> fusion_lstm."""
+    return _fc_rnn_fuse(program, "dynamic_lstm", "fusion_lstm",
+                        feed_names, fetch_names)
+
+
+@register_pass("fc_gru_fuse")
+def _fc_gru_fuse(program, scope=None, feed_names=None, fetch_names=None,
+                 **kwargs):
+    """mul(+bias) feeding dynamic_gru -> fusion_gru."""
+    return _fc_rnn_fuse(program, "dynamic_gru", "fusion_gru",
+                        feed_names, fetch_names)
 
 
 @register_pass("fuse_elewise_add_act")

@@ -72,3 +72,66 @@ register_op(
     intermediate_outputs=("IntermediateOut",),
     lower=_lower_fused_elemwise_activation,
 )
+
+
+def _project_then(delegate, ctx, ins, attrs):
+    """Shared fusion_lstm/fusion_gru body: input projection
+    (X @ WeightX + BiasX) feeding the delegated recurrence lowering, so
+    the Pallas-recurrence flags and masking behave identically. BiasX
+    holds an absorbed fc bias (the reference pass folds it into the gate
+    bias numerically at pass time, which a graph-level pass cannot do
+    before startup has run)."""
+    x, wx = ins["X"][0], ins["WeightX"][0]
+    proj = x @ wx
+    bias_x = ins.get("BiasX", [None])[0]
+    if bias_x is not None:
+        proj = proj + jnp.reshape(bias_x, (-1,))
+    inner = dict(ins)
+    inner["Input"] = [proj]
+    inner["Weight"] = ins["WeightH"]
+    return delegate(ctx, inner, attrs)
+
+
+def _lower_fusion_lstm(ctx, ins, attrs):
+    """fusion_lstm_op.cc role."""
+    from paddle_tpu.ops.rnn_ops import _lower_dynamic_lstm
+
+    return _project_then(_lower_dynamic_lstm, ctx, ins, attrs)
+
+
+register_op(
+    "fusion_lstm",
+    inputs=["X", "WeightX", "WeightH", "Bias", "BiasX", "H0", "C0",
+            "Length"],
+    outputs=["Hidden", "Cell"],
+    attrs={
+        "use_peepholes": True,
+        "is_reverse": False,
+        "gate_activation": "sigmoid",
+        "cell_activation": "tanh",
+        "candidate_activation": "tanh",
+    },
+    lower=_lower_fusion_lstm,
+    no_grad_inputs=("Length",),
+)
+
+
+def _lower_fusion_gru(ctx, ins, attrs):
+    """fusion_gru_op.cc role."""
+    from paddle_tpu.ops.rnn_ops import _lower_dynamic_gru
+
+    return _project_then(_lower_dynamic_gru, ctx, ins, attrs)
+
+
+register_op(
+    "fusion_gru",
+    inputs=["X", "WeightX", "WeightH", "Bias", "BiasX", "H0", "Length"],
+    outputs=["Hidden"],
+    attrs={
+        "is_reverse": False,
+        "gate_activation": "sigmoid",
+        "activation": "tanh",
+    },
+    lower=_lower_fusion_gru,
+    no_grad_inputs=("Length",),
+)

@@ -226,6 +226,76 @@ def test_fuse_interleaved_matches_stay_correct():
         got, np.tanh(2 * xv) + np.maximum(3 * xv, 0.0), rtol=1e-6)
 
 
+def _lstm_infer_program(rnn="lstm"):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6, 8], dtype="float32")
+        if rnn == "lstm":
+            proj = fluid.layers.fc(input=x, size=4 * 12, num_flatten_dims=2)
+            out, _ = fluid.layers.dynamic_lstm(input=proj, size=4 * 12)
+        else:
+            proj = fluid.layers.fc(input=x, size=3 * 12, num_flatten_dims=2)
+            out = fluid.layers.dynamic_gru(input=proj, size=12)
+        final = fluid.layers.reduce_mean(out)
+    return main, startup, final
+
+
+@pytest.mark.parametrize("rnn", ["lstm", "gru"])
+def test_fc_rnn_fuse_structure_and_numerics(rnn):
+    """fc_lstm_fuse_pass.cc / fc_gru_fuse_pass.cc role: the projection fc
+    collapses into fusion_lstm / fusion_gru with identical numerics."""
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.rand(3, 6, 8).astype("float32")}
+    main, startup, final = _lstm_infer_program(rnn)
+    ref = _run(main, startup, final, feed)
+
+    apply_pass(main, "fc_%s_fuse" % rnn)
+    types = [op.type for op in main.block(0).ops]
+    assert "fusion_%s" % rnn in types
+    assert "mul" not in types and "dynamic_%s" % rnn not in types
+    got = _run(main, startup, final, feed)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fc_rnn_fuse_keeps_late_h0_producer_upstream():
+    """The fused op must land at the RECURRENCE's position: an initial
+    state produced between the projection fc and the lstm would otherwise
+    end up downstream of its consumer (reproduced pre-fix)."""
+    rng = np.random.RandomState(4)
+    feed = {"x": rng.rand(2, 5, 8).astype("float32")}
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[5, 8], dtype="float32")
+        proj = fluid.layers.fc(input=x, size=4 * 6, num_flatten_dims=2)
+        # h0/c0 created AFTER the projection, feeding the lstm
+        h0 = fluid.layers.fill_constant([2, 6], "float32", 0.3)
+        c0 = fluid.layers.fill_constant([2, 6], "float32", 0.1)
+        out, _ = fluid.layers.dynamic_lstm(
+            input=proj, size=4 * 6, h_0=h0, c_0=c0)
+        final = fluid.layers.reduce_mean(out)
+    ref = _run(main, startup, final, feed)
+    apply_pass(main, "fc_lstm_fuse")
+    types = [op.type for op in main.block(0).ops]
+    assert "fusion_lstm" in types
+    assert types.index("fill_constant") < types.index("fusion_lstm")
+    got = _run(main, startup, final, feed)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_inference_strategy_orders_rnn_fuse_before_fc_fuse():
+    """fc_fuse must not claim the projection chain before fc_lstm_fuse
+    sees it (the reference analyzer's pass-order contract)."""
+    from paddle_tpu.core.passes import PassManager
+
+    main, startup, final = _lstm_infer_program("lstm")
+    pm = PassManager(strategy="inference")
+    fused = pm.apply(main, feed_names=["x"], fetch_names=[final.name])
+    types = [op.type for op in fused.block(0).ops]
+    assert "fusion_lstm" in types and "fc" not in types
+
+
 def test_build_strategy_knob_applies_fusion():
     main, startup, loss = _add_act_train_program()
     bs = fluid.BuildStrategy()
