@@ -309,9 +309,9 @@ def _fc_rnn_fuse(program, rnn_type, fused_type, feed_names, fetch_names):
                         at, fused_type,
                         inputs=inputs,
                         outputs=dict(rnn.outputs),
-                        attrs=dict(_role_attrs(rnn), **{
-                            k: v for k, v in rnn.attrs.items()
-                            if not k.startswith("__")}))
+                        # plain attr copy carries op_role/op_role_var too
+                        attrs={k: v for k, v in rnn.attrs.items()
+                               if not k.startswith("__")})
                     for label, _ in names:
                         block.vars.pop(m.var(label), None)
                     changed = True

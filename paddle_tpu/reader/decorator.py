@@ -21,6 +21,7 @@ __all__ = [
     "multiprocess_reader",
     "cache",
     "batch",
+    "bucket_by_length",
     "Fake",
 ]
 
@@ -229,6 +230,124 @@ def batch(reader, batch_size, drop_last=False):
             yield b
 
     return batch_reader
+
+
+def bucket_by_length(reader, key, bucket_boundaries, batch_size,
+                     pad_value=0, drop_last=False, yield_lengths=True,
+                     pad_fields=None, max_length=None):
+    """Bucketed padding — the idiomatic TPU answer to variable-length
+    batching (SURVEY.md §5.7 / §7 hard part (a)). The reference packs
+    ragged batches with LoD (zero padding waste, dynamic shapes); XLA
+    compiles one executable per shape, so unconstrained lengths mean
+    unbounded recompiles. This decorator bounds both costs: samples are
+    grouped by length into buckets with FIXED padded widths, so shape
+    count (= XLA compiles, Executor program cache) is bounded by the
+    bucket count, and padding waste by the bucket granularity.
+
+    Shape contract: with ``max_length`` set, the stream produces at most
+    ``len(bucket_boundaries) + ceil((max_length - last) / last)``
+    distinct widths (overflow batches are padded to the next multiple of
+    the last boundary above the BATCH maximum). Without ``max_length``
+    the overflow widths are still quantized to last-boundary multiples
+    but follow the data — pick boundaries that cover the corpus.
+
+    Args:
+      reader: sample-level reader; each sample is a tuple/list of fields.
+      key: fn(sample) -> int length used for bucketing, e.g.
+        ``lambda s: len(s[0])``.
+      bucket_boundaries: ascending max-lengths, e.g. [16, 32, 64]; one
+        overflow bucket takes anything longer.
+      batch_size: samples per emitted batch (per bucket).
+      pad_value: fill value for padded fields.
+      drop_last: drop per-bucket remainder batches at stream end.
+      yield_lengths: append a [batch] int64 key-lengths field to each
+        batch (the Length input the sequence ops take).
+      pad_fields: indices of fields to pad up to the bucket width (each
+        from its OWN leading length, so a seq2seq (src, tgt) pair
+        bucketed by max(len(src), len(tgt)) pads both). Default: every
+        field whose leading dimension equals the sample's key length —
+        fine for single-sequence samples; pass the indices explicitly
+        when another field's size could coincide with the length.
+      max_length: optional hard cap; a longer sample raises ValueError
+        (truncate upstream if that is the right policy for the data).
+
+    Yields ``(field0, field1, ..., lengths)`` batches; non-padded fields
+    must be fixed-size across the batch.
+    """
+    import numpy as np
+
+    bounds = sorted(bucket_boundaries)
+    if not bounds:
+        raise ValueError("bucket_boundaries must be non-empty")
+
+    def bucket_of(n):
+        for i, b in enumerate(bounds):
+            if n <= b:
+                return i
+        return len(bounds)  # overflow bucket
+
+    def width_of(idx, batch_max):
+        if idx < len(bounds):
+            return bounds[idx]
+        # quantized to multiples of the last boundary: bounded shape
+        # churn instead of one shape per distinct batch maximum
+        step = bounds[-1]
+        return ((batch_max + step - 1) // step) * step
+
+    def pad_field(arr, width):
+        n = arr.shape[0]
+        if n > width:
+            raise ValueError(
+                "field of length %d exceeds bucket width %d (is this "
+                "field really keyed by the bucketing length? see "
+                "pad_fields)" % (n, width))
+        padded = np.full((width,) + arr.shape[1:], pad_value,
+                         dtype=arr.dtype)
+        padded[:n] = arr
+        return padded
+
+    def emit(bucket, idx):
+        width = width_of(idx, max(n for n, _ in bucket))
+        fields = []
+        nfields = len(bucket[0][1])
+        for f in range(nfields):
+            col = []
+            for n, s in bucket:
+                arr = np.asarray(s[f])
+                do_pad = (f in pad_fields if pad_fields is not None
+                          else arr.ndim >= 1 and arr.shape[0] == n)
+                col.append(pad_field(arr, width) if do_pad else arr)
+            try:
+                fields.append(np.stack(col))
+            except ValueError as e:
+                raise ValueError(
+                    "field %d is ragged across the batch but not padded "
+                    "(%s); list it in pad_fields, or pad it upstream"
+                    % (f, e)) from e
+        if yield_lengths:
+            fields.append(np.asarray([n for n, _ in bucket],
+                                     dtype=np.int64))
+        return tuple(fields)
+
+    def bucketed_reader():
+        buckets = [[] for _ in range(len(bounds) + 1)]
+        for sample in reader():
+            n = int(key(sample))
+            if max_length is not None and n > max_length:
+                raise ValueError(
+                    "sample length %d exceeds max_length %d"
+                    % (n, max_length))
+            idx = bucket_of(n)
+            buckets[idx].append((n, sample))
+            if len(buckets[idx]) == batch_size:
+                yield emit(buckets[idx], idx)
+                buckets[idx] = []
+        if not drop_last:
+            for idx, bucket in enumerate(buckets):
+                if bucket:
+                    yield emit(bucket, idx)
+
+    return bucketed_reader
 
 
 class Fake(object):

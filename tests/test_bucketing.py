@@ -1,0 +1,123 @@
+"""bucket_by_length reader decorator: the bucketed-padding strategy that
+bounds XLA recompiles for variable-length data (SURVEY.md §5.7 /
+§7 hard part (a); the LoD-free answer to the reference's ragged batching).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.reader.decorator import bucket_by_length
+
+
+def _var_len_reader(n, seed=0, lo=3, hi=70):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(lo, hi))
+            seq = rng.randint(1, 100, (length,)).astype("int64")
+            label = int(rng.randint(0, 2))
+            yield seq, label
+    return reader
+
+
+def test_bucketing_bounds_shapes_and_pads_correctly():
+    bucketed = bucket_by_length(
+        _var_len_reader(200), key=lambda s: len(s[0]),
+        bucket_boundaries=[16, 32, 64], batch_size=8)
+    widths = set()
+    for seqs, labels, lengths in bucketed():
+        widths.add(seqs.shape[1])
+        assert seqs.shape[0] == labels.shape[0] == lengths.shape[0] <= 8
+        for row, n in zip(seqs, lengths):
+            assert (row[:n] > 0).all()      # payload intact
+            assert (row[n:] == 0).all()     # padded with pad_value
+            assert seqs.shape[1] >= n
+    # at most one shape per bucket (3 boundaries + overflow)
+    assert len(widths) <= 4
+    assert widths <= {16, 32, 64, 128}
+
+
+def test_bucketing_overflow_bucket_width_is_quantized():
+    # overflow widths are quantized to multiples of the last boundary:
+    # bounded shape churn, not one shape per distinct batch maximum
+    bucketed = bucket_by_length(
+        _var_len_reader(60, lo=65, hi=90), key=lambda s: len(s[0]),
+        bucket_boundaries=[16, 32, 64], batch_size=4)
+    widths = {seqs.shape[1] for seqs, _, _ in bucketed()}
+    assert widths == {128}  # every batch max in (64, 128]
+
+
+def test_bucketing_seq2seq_pad_fields():
+    """Two variable-length fields (src, tgt) bucketed by their max: both
+    padded to the bucket width from their own lengths."""
+    def reader():
+        rng = np.random.RandomState(7)
+        for _ in range(40):
+            src = rng.randint(1, 9, (int(rng.randint(3, 30)),))
+            tgt = rng.randint(1, 9, (int(rng.randint(3, 30)),))
+            yield src, tgt
+
+    bucketed = bucket_by_length(
+        reader, key=lambda s: max(len(s[0]), len(s[1])),
+        bucket_boundaries=[8, 16, 32], batch_size=4, pad_fields=[0, 1])
+    n_batches = 0
+    for src, tgt, lengths in bucketed():
+        n_batches += 1
+        assert src.shape == tgt.shape
+        assert src.shape[1] in (8, 16, 32)
+        assert (lengths <= src.shape[1]).all()
+    assert n_batches > 0
+
+
+def test_bucketing_ragged_unpadded_field_raises_clearly():
+    def reader():
+        yield np.arange(3), np.arange(5)
+        yield np.arange(3), np.arange(9)
+
+    bucketed = bucket_by_length(
+        reader, key=lambda s: len(s[0]),
+        bucket_boundaries=[4], batch_size=2, pad_fields=[0])
+    with np.testing.assert_raises_regex(ValueError, "pad_fields"):
+        list(bucketed())
+
+
+def test_bucketing_max_length_cap():
+    bucketed = bucket_by_length(
+        _var_len_reader(10, lo=60, hi=70), key=lambda s: len(s[0]),
+        bucket_boundaries=[16], batch_size=2, max_length=50)
+    with np.testing.assert_raises_regex(ValueError, "max_length"):
+        list(bucketed())
+
+
+def test_bucketing_bounds_executor_compiles():
+    """The point of the exercise: a 200-sample variable-length stream
+    trains through the Executor with at most one compile per bucket."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        seq = fluid.layers.data(name="seq", shape=[-1], dtype="int64")
+        length = fluid.layers.data(name="len", shape=[1], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(seq, size=[100, 8])
+        pooled = fluid.layers.sequence_pool(emb, "average", length=length)
+        logits = fluid.layers.fc(pooled, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    compiles_before = len(exe._cache)  # startup's own executable
+
+    bucketed = bucket_by_length(
+        _var_len_reader(200), key=lambda s: len(s[0]),
+        bucket_boundaries=[16, 32, 64], batch_size=8, drop_last=True)
+    losses = []
+    for seqs, labels, lengths in bucketed():
+        lv, = exe.run(main, feed={
+            "seq": seqs,
+            "len": lengths.reshape(-1, 1),
+            "label": np.asarray(labels).reshape(-1, 1),
+        }, fetch_list=[loss])
+        losses.append(float(np.ravel(lv)[0]))
+    assert all(np.isfinite(losses))
+    # one executable per distinct feed-shape set = one per bucket
+    assert len(exe._cache) - compiles_before <= 4
