@@ -73,7 +73,9 @@ class PassManager(object):
         # chains as plain fc ops — the reference analyzer orders its pass
         # list the same way), then collapse mul+add(+act) chains into fc
         "inference": ["prune_feed_fetch", "fuse_batch_norm",
-                      "fc_lstm_fuse", "fc_gru_fuse", "fc_fuse"],
+                      "fc_lstm_fuse", "embedding_fc_lstm_fuse",
+                      "fc_gru_fuse", "seqconv_eltadd_relu_fuse",
+                      "fc_fuse"],
         # training memory: rematerialization planning
         "memory": ["memory_optimize"],
         # mixed precision training
@@ -142,6 +144,20 @@ def _persistable(block, name):
     return v is not None and getattr(v, "persistable", False)
 
 
+def _chain_clear(block, protected, pairs):
+    """Shared fusion-chain safety rule: every intermediate var must feed
+    ONLY the next op in the chain and never be a feed/fetch target.
+    ``pairs`` = [(var_name, expected_consumer_index), ...]."""
+    from paddle_tpu.core.graph_pattern import consumers
+
+    for var_name, consumer_idx in pairs:
+        if var_name in protected:
+            return False
+        if [i for i, _, _ in consumers(block, var_name)] != [consumer_idx]:
+            return False
+    return True
+
+
 def _projection_safe(block, mul_op, add_op, bias_name):
     """The fused lowerings compute a plain 2-D matmul + trailing-axis
     bias broadcast; reject mul/add attr combinations that mean something
@@ -177,19 +193,11 @@ def _fc_fuse(program, scope=None, feed_names=None, fetch_names=None,
         xn = mul_op.attrs.get("x_num_col_dims", 1)
         if not _projection_safe(block, mul_op, add_op, m.var("b")):
             return False
-        # every intermediate must feed ONLY the next chain op, and never
-        # be a feed/fetch target
-        if m.var("mid") in protected:
-            return False
-        mid_users = [i for i, _, _ in consumers(block, m.var("mid"))]
-        if mid_users != [m.op_index("add")]:
-            return False
+        pairs = [(m.var("mid"), m.op_index("add"))]
         if with_act:
-            if m.var("out") in protected:
-                return False
-            out_users = [i for i, _, _ in consumers(block, m.var("out"))]
-            if out_users != [m.op_index("act")]:
-                return False
+            pairs.append((m.var("out"), m.op_index("act")))
+        if not _chain_clear(block, protected, pairs):
+            return False
         idxs = m.op_indices()
         final = m.var("final") if with_act else m.var("out")
         attrs = {
@@ -273,22 +281,11 @@ def _fc_rnn_fuse(program, rnn_type, fused_type, feed_names, fetch_names):
                             m.op("add") if with_bias else None,
                             m.var("bx") if with_bias else None):
                         continue
-                    # chain intermediates: single consumer, not protected
-                    names = [("mid", m.op_index("add") if with_bias
+                    pairs = [(m.var("mid"), m.op_index("add") if with_bias
                               else m.op_index("rnn"))]
                     if with_bias:
-                        names.append(("proj", m.op_index("rnn")))
-                    ok = True
-                    for label, consumer_idx in names:
-                        if m.var(label) in protected:
-                            ok = False
-                            break
-                        users = [i for i, _, _
-                                 in consumers(block, m.var(label))]
-                        if users != [consumer_idx]:
-                            ok = False
-                            break
-                    if not ok:
+                        pairs.append((m.var("proj"), m.op_index("rnn")))
+                    if not _chain_clear(block, protected, pairs):
                         continue
                     rnn = m.op("rnn")
                     inputs = {"X": [m.var("x")], "WeightX": [m.var("wx")],
@@ -312,8 +309,8 @@ def _fc_rnn_fuse(program, rnn_type, fused_type, feed_names, fetch_names):
                         # plain attr copy carries op_role/op_role_var too
                         attrs={k: v for k, v in rnn.attrs.items()
                                if not k.startswith("__")})
-                    for label, _ in names:
-                        block.vars.pop(m.var(label), None)
+                    for var_name, _ in pairs:
+                        block.vars.pop(var_name, None)
                     changed = True
     program._bump_version()
     return program
@@ -333,6 +330,111 @@ def _fc_gru_fuse(program, scope=None, feed_names=None, fetch_names=None,
     """mul(+bias) feeding dynamic_gru -> fusion_gru."""
     return _fc_rnn_fuse(program, "dynamic_gru", "fusion_gru",
                         feed_names, fetch_names)
+
+
+@register_pass("embedding_fc_lstm_fuse")
+def _embedding_fc_lstm_fuse(program, scope=None, feed_names=None,
+                            fetch_names=None, **kwargs):
+    """lookup_table feeding a fusion_lstm -> fused_embedding_fc_lstm
+    (embedding_fc_lstm_fuse_pass.cc role). Run AFTER fc_lstm_fuse, which
+    builds the fusion_lstm this pass extends by one hop."""
+    from paddle_tpu.core.graph_pattern import GraphPatternDetector, consumers
+
+    protected = set(feed_names or ()) | set(fetch_names or ())
+    for bi in range(program.num_blocks):
+        block = program.block(bi)
+        changed = True
+        while changed:
+            changed = False
+            pat = GraphPatternDetector()
+            pat.op("emb", "lookup_table",
+                   inputs={"W": "table", "Ids": "ids"},
+                   outputs={"Out": "mid"})
+            pat.op("lstm", "fusion_lstm", inputs={"X": "mid"})
+            for m in sorted(pat.detect(block),
+                            key=lambda mm: -mm.op_indices()[0]):
+                if not m.is_live(block):
+                    changed = True
+                    continue
+                if not _persistable(block, m.var("table")):
+                    continue
+                if not _chain_clear(block, protected,
+                                    [(m.var("mid"), m.op_index("lstm"))]):
+                    continue
+                lstm = m.op("lstm")
+                inputs = dict(lstm.inputs)
+                inputs.pop("X", None)
+                inputs["Ids"] = [m.var("ids")]
+                inputs["Embeddings"] = [m.var("table")]
+                attrs = {k: v for k, v in lstm.attrs.items()
+                         if not k.startswith("__")}
+                attrs["padding_idx"] = m.op("emb").attrs.get(
+                    "padding_idx", -1)
+                idxs = m.op_indices()
+                for i in reversed(idxs):
+                    block.remove_op(i)
+                at = m.op_index("lstm") - (len(idxs) - 1)
+                block.insert_op(at, "fused_embedding_fc_lstm",
+                                inputs=inputs,
+                                outputs=dict(lstm.outputs), attrs=attrs)
+                block.vars.pop(m.var("mid"), None)
+                changed = True
+    program._bump_version()
+    return program
+
+
+@register_pass("seqconv_eltadd_relu_fuse")
+def _seqconv_eltadd_relu_fuse(program, scope=None, feed_names=None,
+                              fetch_names=None, **kwargs):
+    """sequence_conv + elementwise_add(persistable bias) + relu ->
+    fusion_seqconv_eltadd_relu (fuse_pass role of the same name)."""
+    from paddle_tpu.core.graph_pattern import GraphPatternDetector, consumers
+
+    protected = set(feed_names or ()) | set(fetch_names or ())
+    for bi in range(program.num_blocks):
+        block = program.block(bi)
+        changed = True
+        while changed:
+            changed = False
+            pat = GraphPatternDetector()
+            pat.op("conv", "sequence_conv", outputs={"Out": "mid"})
+            pat.op("add", "elementwise_add",
+                   inputs={"X": "mid", "Y": "b"}, outputs={"Out": "mid2"})
+            pat.op("relu", "relu", inputs={"X": "mid2"},
+                   outputs={"Out": "out"})
+            for m in sorted(pat.detect(block),
+                            key=lambda mm: -mm.op_indices()[0]):
+                if not m.is_live(block):
+                    changed = True
+                    continue
+                if not _persistable(block, m.var("b")):
+                    continue
+                bvar = block.vars.get(m.var("b"))
+                if len(getattr(bvar, "shape", ()) or ()) != 1:
+                    continue
+                if m.op("add").attrs.get("axis", -1) not in (-1, 2):
+                    continue
+                if not _chain_clear(block, protected, [
+                        (m.var("mid"), m.op_index("add")),
+                        (m.var("mid2"), m.op_index("relu"))]):
+                    continue
+                conv = m.op("conv")
+                inputs = dict(conv.inputs)
+                inputs["Bias"] = [m.var("b")]
+                attrs = {k: v for k, v in conv.attrs.items()
+                         if not k.startswith("__")}
+                idxs = m.op_indices()
+                for i in reversed(idxs):
+                    block.remove_op(i)
+                block.insert_op(idxs[0], "fusion_seqconv_eltadd_relu",
+                                inputs=inputs,
+                                outputs={"Out": [m.var("out")]},
+                                attrs=attrs)
+                for label in ("mid", "mid2"):
+                    block.vars.pop(m.var(label), None)
+                changed = True
+    program._bump_version()
+    return program
 
 
 @register_pass("fuse_elewise_add_act")

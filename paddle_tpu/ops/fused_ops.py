@@ -123,6 +123,64 @@ def _lower_fusion_gru(ctx, ins, attrs):
     return _project_then(_lower_dynamic_gru, ctx, ins, attrs)
 
 
+def _lower_fusion_seqconv_eltadd_relu(ctx, ins, attrs):
+    """fusion_seqconv_eltadd_relu_op.cc role: sequence_conv + bias add +
+    relu in one op; delegates the context-window conv."""
+    from paddle_tpu.ops.sequence_ops import _lower_sequence_conv
+
+    out = _lower_sequence_conv(ctx, ins, attrs)["Out"]
+    bias = ins.get("Bias", [None])[0]
+    if bias is not None:
+        out = out + jnp.reshape(bias, (-1,))
+    return jax.nn.relu(out)
+
+
+register_op(
+    "fusion_seqconv_eltadd_relu",
+    inputs=["X", "Filter", "Bias", "Length"],
+    outputs=["Out"],
+    attrs={"contextLength": 3, "contextStart": -1, "contextStride": 1},
+    lower=_lower_fusion_seqconv_eltadd_relu,
+    no_grad_inputs=("Length",),
+)
+
+
+def _lower_fused_embedding_fc_lstm(ctx, ins, attrs):
+    """fused_embedding_fc_lstm_op.cc role: lookup_table + projection fc +
+    LSTM recurrence. The reference pass pre-multiplies the table with the
+    fc weight numerically at pass time (scope surgery); keeping
+    Embeddings and WeightX separate is the graph-level equivalent and
+    lets XLA fuse gather + matmul itself."""
+    from paddle_tpu.ops.tensor_ops import _lower_lookup_table
+
+    emb = _lower_lookup_table(
+        ctx,
+        {"W": ins["Embeddings"], "Ids": ins["Ids"]},
+        {"padding_idx": attrs.get("padding_idx", -1)},
+    )
+    inner = dict(ins)
+    inner["X"] = [emb]
+    return _lower_fusion_lstm(ctx, inner, attrs)
+
+
+register_op(
+    "fused_embedding_fc_lstm",
+    inputs=["Ids", "Embeddings", "WeightX", "WeightH", "Bias", "BiasX",
+            "H0", "C0", "Length"],
+    outputs=["Hidden", "Cell"],
+    attrs={
+        "use_peepholes": True,
+        "is_reverse": False,
+        "gate_activation": "sigmoid",
+        "cell_activation": "tanh",
+        "candidate_activation": "tanh",
+        "padding_idx": -1,
+    },
+    lower=_lower_fused_embedding_fc_lstm,
+    no_grad_inputs=("Ids", "Length"),
+)
+
+
 register_op(
     "fusion_gru",
     inputs=["X", "WeightX", "WeightH", "Bias", "BiasX", "H0", "Length"],

@@ -296,6 +296,50 @@ def test_inference_strategy_orders_rnn_fuse_before_fc_fuse():
     assert "fusion_lstm" in types and "fc" not in types
 
 
+def test_embedding_fc_lstm_fuse_chain():
+    """lookup_table -> fc -> lstm collapses end to end: fc_lstm_fuse
+    builds the fusion_lstm, embedding_fc_lstm_fuse absorbs the lookup
+    (embedding_fc_lstm_fuse_pass.cc role); numerics identical."""
+    rng = np.random.RandomState(6)
+    feed = {"ids": rng.randint(0, 50, (2, 7)).astype("int64")}
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[7], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[50, 8])
+        proj = fluid.layers.fc(input=emb, size=4 * 6, num_flatten_dims=2)
+        out, _ = fluid.layers.dynamic_lstm(input=proj, size=4 * 6)
+        final = fluid.layers.reduce_mean(out)
+    ref = _run(main, startup, final, feed)
+    apply_pass(main, "fc_lstm_fuse")
+    apply_pass(main, "embedding_fc_lstm_fuse")
+    types = [op.type for op in main.block(0).ops]
+    assert "fused_embedding_fc_lstm" in types
+    assert "lookup_table" not in types and "fusion_lstm" not in types
+    got = _run(main, startup, final, feed)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_seqconv_eltadd_relu_fuse():
+    rng = np.random.RandomState(8)
+    feed = {"x": rng.rand(2, 9, 4).astype("float32")}
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 23
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[9, 4], dtype="float32")
+        conv = fluid.layers.sequence_conv(
+            x, num_filters=6, filter_size=3, act=None)
+        out = fluid.layers.relu(conv)
+        final = fluid.layers.reduce_mean(out)
+    ref = _run(main, startup, final, feed)
+    apply_pass(main, "seqconv_eltadd_relu_fuse")
+    types = [op.type for op in main.block(0).ops]
+    assert "fusion_seqconv_eltadd_relu" in types
+    assert "sequence_conv" not in types and "relu" not in types
+    got = _run(main, startup, final, feed)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
 def test_build_strategy_knob_applies_fusion():
     main, startup, loss = _add_act_train_program()
     bs = fluid.BuildStrategy()
