@@ -181,7 +181,7 @@ def _fc_fuse(program, scope=None, feed_names=None, fetch_names=None,
     inference programs: intermediates consumed by grad ops (training
     graphs) fail the single-consumer condition and are left alone.
     Vars named in feed_names/fetch_names are never deleted or absorbed."""
-    from paddle_tpu.core.graph_pattern import GraphPatternDetector, consumers
+    from paddle_tpu.core.graph_pattern import GraphPatternDetector
 
     protected = set(feed_names or ()) | set(fetch_names or ())
 
@@ -247,7 +247,7 @@ def _fc_rnn_fuse(program, rnn_type, fused_type, feed_names, fetch_names):
     """Shared body of fc_lstm_fuse / fc_gru_fuse (fc_lstm_fuse_pass.cc,
     fc_gru_fuse_pass.cc roles): collapse the projection fc feeding a
     recurrence into one fusion op. Inference-scope, like fc_fuse."""
-    from paddle_tpu.core.graph_pattern import GraphPatternDetector, consumers
+    from paddle_tpu.core.graph_pattern import GraphPatternDetector
 
     protected = set(feed_names or ()) | set(fetch_names or ())
 
@@ -338,7 +338,7 @@ def _embedding_fc_lstm_fuse(program, scope=None, feed_names=None,
     """lookup_table feeding a fusion_lstm -> fused_embedding_fc_lstm
     (embedding_fc_lstm_fuse_pass.cc role). Run AFTER fc_lstm_fuse, which
     builds the fusion_lstm this pass extends by one hop."""
-    from paddle_tpu.core.graph_pattern import GraphPatternDetector, consumers
+    from paddle_tpu.core.graph_pattern import GraphPatternDetector
 
     protected = set(feed_names or ()) | set(fetch_names or ())
     for bi in range(program.num_blocks):
@@ -388,7 +388,7 @@ def _seqconv_eltadd_relu_fuse(program, scope=None, feed_names=None,
                               fetch_names=None, **kwargs):
     """sequence_conv + elementwise_add(persistable bias) + relu ->
     fusion_seqconv_eltadd_relu (fuse_pass role of the same name)."""
-    from paddle_tpu.core.graph_pattern import GraphPatternDetector, consumers
+    from paddle_tpu.core.graph_pattern import GraphPatternDetector
 
     protected = set(feed_names or ()) | set(fetch_names or ())
     for bi in range(program.num_blocks):
@@ -507,7 +507,10 @@ def _role_attrs(src_op):
 
 def _fuse_add_act_grad_pair(block, m, act_type, axis):
     """Collapse the backward twin of a fused add+act pair, if present."""
-    from paddle_tpu.core.graph_pattern import GraphPatternDetector, consumers
+    from paddle_tpu.core.graph_pattern import (
+        GraphPatternDetector,
+        consumers,
+    )
     from paddle_tpu.core.op_registry import ensure_auto_grad_op
 
     gpat = GraphPatternDetector()

@@ -2,19 +2,21 @@
 
 Reference parity: ``paddle/fluid/inference/api/paddle_inference_api.h``
 (:141 PaddlePredictor, :183 NativeConfig, :211 CreatePaddlePredictor) and
-``api_impl.cc``'s NativePaddlePredictor. The TPU design compiles the pruned
-inference program once per feed-shape signature through the Executor's
-program cache (analysis/fusion passes are XLA's job) and serves from it;
-``Clone()`` shares the loaded weights (scope) while giving each server
-thread its own predictor handle, matching the reference's multi-threaded
-serving contract.
+``api_impl.cc``'s NativePaddlePredictor; ``AnalysisConfig`` adds the
+AnalysisPredictor role (analysis_predictor.cc) — the graph-level pass
+pipeline (prune, BN fold, fc/rnn fusion; core/passes.py "inference"
+strategy) runs over the loaded program before it compiles. Kernel-level
+fusion stays XLA's job either way. ``Clone()`` shares the loaded weights
+(scope) while giving each server thread its own predictor handle,
+matching the reference's multi-threaded serving contract.
 """
 
 import threading
 
 import numpy as np
 
-__all__ = ["NativeConfig", "Predictor", "create_paddle_predictor"]
+__all__ = ["NativeConfig", "AnalysisConfig", "Predictor",
+           "create_paddle_predictor"]
 
 
 class NativeConfig(object):
@@ -32,6 +34,22 @@ class NativeConfig(object):
         self.fraction_of_gpu_memory = fraction_of_gpu_memory
 
 
+class AnalysisConfig(NativeConfig):
+    """AnalysisPredictor's config (analysis_predictor.cc role): the
+    graph-level "inference" pass pipeline runs over the loaded program.
+    ``extra_passes`` appends registered pass names after the strategy's
+    list (pass_builder role); ``switch_ir_optim(False)`` degrades to the
+    plain NativeConfig path."""
+
+    def __init__(self, *args, ir_optim=True, extra_passes=None, **kwargs):
+        super(AnalysisConfig, self).__init__(*args, **kwargs)
+        self.ir_optim = ir_optim
+        self.extra_passes = list(extra_passes or ())
+
+    def switch_ir_optim(self, flag=True):
+        self.ir_optim = bool(flag)
+
+
 class Predictor(object):
     """Compiled-program predictor over a saved inference model."""
 
@@ -42,8 +60,8 @@ class Predictor(object):
         self._config = config
         if _shared is not None:
             # Clone(): share program + weights, new executor cache handle.
-            (self._program, self._feed_names, self._fetch_vars,
-             self._scope) = _shared
+            (self._program, self._native_program, self._feed_names,
+             self._fetch_vars, self._scope) = _shared
         else:
             self._scope = Scope()
             place = (
@@ -57,6 +75,23 @@ class Predictor(object):
                     model_filename=config.prog_file,
                     params_filename=config.params_file,
                 )
+            # the C++ reference interpreter knows the unfused op set only;
+            # run_native_reference always executes the as-loaded program
+            self._native_program = self._program
+            if getattr(config, "ir_optim", False):
+                # AnalysisPredictor role: graph-level optimization pipeline
+                from paddle_tpu.core.passes import PassManager
+
+                fetch_names = [v.name for v in self._fetch_vars]
+                pm = PassManager(strategy="inference",
+                                 passes=getattr(config, "extra_passes", ()))
+                self._program = pm.apply(
+                    self._program, scope=self._scope,
+                    feed_names=list(self._feed_names),
+                    fetch_names=fetch_names)
+                # passes may return a rebuilt program: re-resolve fetches
+                gb = self._program.global_block()
+                self._fetch_vars = [gb.vars[n] for n in fetch_names]
         place = fluid.TPUPlace() if config.use_tpu else fluid.CPUPlace()
         self._exe = fluid.Executor(place)
         self._lock = threading.Lock()
@@ -93,8 +128,8 @@ class Predictor(object):
         thread (PaddlePredictor::Clone parity)."""
         return Predictor(
             self._config,
-            _shared=(self._program, self._feed_names, self._fetch_vars,
-                     self._scope),
+            _shared=(self._program, self._native_program, self._feed_names,
+                     self._fetch_vars, self._scope),
         )
 
     @property
@@ -111,7 +146,7 @@ class Predictor(object):
         if not native.available():
             raise RuntimeError("native library unavailable")
         lib = native.get_lib()
-        blob = serialize_program(self._program)
+        blob = serialize_program(self._native_program)
         prog = lib.ptpu_program_parse(bytes(blob), len(blob))
         if not prog:
             raise ValueError(native.last_error())

@@ -95,3 +95,29 @@ def test_cpp_reference_interpreter_matches_xla(tmp_path):
     )
     got = predictor.run_native_reference({"x": xb})
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_analysis_predictor_fuses_and_matches(tmp_path):
+    """AnalysisConfig runs the inference pass pipeline over the loaded
+    program (analysis_predictor.cc role): fc chains collapse to fc ops,
+    outputs identical to the un-optimized NativeConfig path."""
+    from paddle_tpu.inference import AnalysisConfig
+
+    path, xb, want = _train_and_save(tmp_path)
+    analysis = create_paddle_predictor(
+        AnalysisConfig(model_dir=path, use_tpu=False))
+    (got,) = analysis.run({"x": xb})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    types = [op.type for op in analysis._program.global_block().ops]
+    assert "fc" in types and "mul" not in types
+
+    # ir_optim off degrades to the native path (no fusion)
+    plain = create_paddle_predictor(
+        AnalysisConfig(model_dir=path, use_tpu=False, ir_optim=False))
+    (got2,) = plain.run({"x": xb})
+    np.testing.assert_allclose(got2, want, rtol=1e-5, atol=1e-6)
+    assert "mul" in [op.type for op in plain._program.global_block().ops]
+
+    # clone shares the optimized program + weights
+    (got3,) = analysis.clone().run({"x": xb})
+    np.testing.assert_allclose(got3, want, rtol=1e-5, atol=1e-6)
