@@ -101,6 +101,29 @@ register_op(
 )
 
 
+def _lower_depthwise_conv2d_transpose(ctx, ins, attrs):
+    # depthwise transpose: groups == in_channels (filter [C, mult, kh, kw])
+    a = dict(attrs)
+    a["groups"] = jnp.shape(ins["Input"][0])[1]
+    return _lower_conv2d_transpose(ctx, ins, a)
+
+
+register_op(
+    "depthwise_conv2d_transpose",
+    inputs=["Input", "Filter"],
+    outputs=["Output"],
+    attrs={
+        "strides": [1, 1],
+        "paddings": [0, 0],
+        "dilations": [1, 1],
+        "groups": 1,
+        "output_size": None,
+        "data_format": "NCHW",
+    },
+    lower=_lower_depthwise_conv2d_transpose,
+)
+
+
 def _lower_conv3d(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = _pair(attrs.get("strides", [1, 1, 1]), 3)

@@ -213,11 +213,38 @@ register_op(
 )
 
 register_op(
+    "unsqueeze2",
+    inputs=["X"],
+    outputs=["Out", "XShape"],
+    attrs={"axes": []},
+    lower=lambda ctx, ins, attrs: {
+        "Out": jnp.expand_dims(ins["X"][0], tuple(attrs.get("axes", []))),
+        "XShape": jnp.zeros((0,) + tuple(jnp.shape(ins["X"][0])),
+                            ins["X"][0].dtype),
+    },
+    intermediate_outputs=("XShape",),
+)
+
+register_op(
     "flatten",
     inputs=["X"],
     outputs=["Out"],
     attrs={"axis": 1},
     lower=lambda ctx, ins, attrs: _flatten(ins["X"][0], attrs.get("axis", 1)),
+)
+
+
+register_op(
+    "flatten2",
+    inputs=["X"],
+    outputs=["Out", "XShape"],
+    attrs={"axis": 1},
+    lower=lambda ctx, ins, attrs: {
+        "Out": _flatten(ins["X"][0], attrs.get("axis", 1)),
+        "XShape": jnp.zeros((0,) + tuple(jnp.shape(ins["X"][0])),
+                            ins["X"][0].dtype),
+    },
+    intermediate_outputs=("XShape",),
 )
 
 

@@ -297,3 +297,81 @@ def test_sequence_reshape_rechunks_and_validates():
 
     with pytest.raises(Exception, match="sequence_reshape"):
         _run(build_bad, {"x": x})
+
+
+def test_flatten2_unsqueeze2_xshape_variants():
+    """The *2 op variants carry an XShape intermediate (reference op pair
+    design); Out matches the base ops."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3, 4], dtype="float32")
+        block = main.current_block()
+        f_out = block.create_var(name="f2_out", dtype="float32", shape=None)
+        f_xs = block.create_var(name="f2_xs", dtype="float32", shape=None)
+        block.append_op("flatten2", inputs={"X": [x.name]},
+                        outputs={"Out": [f_out.name], "XShape": [f_xs.name]},
+                        attrs={"axis": 1})
+        u_out = block.create_var(name="u2_out", dtype="float32", shape=None)
+        u_xs = block.create_var(name="u2_xs", dtype="float32", shape=None)
+        block.append_op("unsqueeze2", inputs={"X": [x.name]},
+                        outputs={"Out": [u_out.name], "XShape": [u_xs.name]},
+                        attrs={"axes": [1]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    fo, uo = exe.run(main, feed={"x": xv}, fetch_list=[f_out, u_out])
+    np.testing.assert_allclose(fo, xv.reshape(2, 12))
+    np.testing.assert_allclose(uo, xv[:, None])
+
+
+def test_depthwise_conv2d_transpose():
+    """groups == channels transpose conv == per-channel transpose convs."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+
+    rng = np.random.RandomState(3)
+    xv = rng.rand(2, 3, 5, 5).astype("float32")
+    wv = rng.rand(3, 1, 3, 3).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3, 5, 5], dtype="float32")
+        w = fluid.layers.data(name="w", shape=[1, 3, 3], dtype="float32")
+        block = main.current_block()
+        out = block.create_var(name="dct_out", dtype="float32", shape=None)
+        block.append_op(
+            "depthwise_conv2d_transpose",
+            inputs={"Input": [x.name], "Filter": [w.name]},
+            outputs={"Output": [out.name]},
+            attrs={"strides": [2, 2], "paddings": [1, 1]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, = exe.run(main, feed={"x": xv, "w": wv}, fetch_list=[out])
+    # oracle: per-channel conv2d_transpose stacked
+    chans = []
+    for c in range(3):
+        main2, startup2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main2, startup2):
+            xc = fluid.layers.data(name="xc", shape=[1, 5, 5],
+                                   dtype="float32")
+            wc = fluid.layers.data(name="wc", shape=[1, 3, 3],
+                                   dtype="float32")
+            b2 = main2.current_block()
+            oc = b2.create_var(name="oc", dtype="float32", shape=None)
+            b2.append_op(
+                "conv2d_transpose",
+                inputs={"Input": [xc.name], "Filter": [wc.name]},
+                outputs={"Output": [oc.name]},
+                attrs={"strides": [2, 2], "paddings": [1, 1]})
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        got_c, = exe2.run(main2, feed={"xc": xv[:, c:c + 1],
+                                       "wc": wv[c:c + 1]},
+                          fetch_list=[oc])
+        chans.append(np.asarray(got_c))
+    want = np.concatenate(chans, axis=1)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
