@@ -1,0 +1,74 @@
+"""User-error paths must fail with pointed messages, not XLA tracebacks
+(enforce.h role: errors carry op/var context a user can act on)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_run_before_startup_names_the_variable():
+    main, startup, loss = _program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": np.zeros((2, 4), "float32"), "y": np.zeros((2, 1), "float32")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        with pytest.raises(Exception, match="[Uu]ninitialized|not.*initialized"):
+            exe.run(main, feed=feed, fetch_list=[loss])
+
+
+def test_missing_feed_is_reported():
+    main, startup, loss = _program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        with pytest.raises(Exception, match="x|feed|uninitialized"):
+            exe.run(main, feed={"y": np.zeros((2, 1), "float32")},
+                    fetch_list=[loss])
+
+
+def test_unknown_fetch_name_is_reported():
+    main, startup, loss = _program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": np.zeros((2, 4), "float32"), "y": np.zeros((2, 1), "float32")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        with pytest.raises(Exception, match="no_such_var"):
+            exe.run(main, feed=feed, fetch_list=["no_such_var"])
+
+
+def test_unknown_op_type_is_reported_at_append():
+    # fails at graph-BUILD time, naming the op (OpRegistry::CreateOp role)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        block = main.current_block()
+        out = block.create_var(name="o", dtype="float32", shape=None)
+        with pytest.raises(KeyError, match="definitely_not_an_op"):
+            block.append_op("definitely_not_an_op",
+                            inputs={"X": [x.name]},
+                            outputs={"Out": [out.name]})
+
+
+def test_shape_mismatch_across_cached_runs_recompiles_not_crashes():
+    """Feeding a different batch size must hit a fresh executable, not a
+    stale shape (program cache keyed on feed shapes)."""
+    main, startup, loss = _program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        for bs in (2, 5, 2):
+            feed = {"x": np.zeros((bs, 4), "float32"),
+                    "y": np.zeros((bs, 1), "float32")}
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            assert np.isfinite(np.ravel(lv)).all()
