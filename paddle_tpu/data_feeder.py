@@ -95,3 +95,47 @@ class DataFeeder(object):
             t = each_converter.done()
             ret_dict[each_name] = t if t.lod() else t.numpy()
         return ret_dict
+
+    def feed_parallel(self, iterable, num_places=None):
+        """One feed dict per place from per-place sample iterables
+        (data_feeder.py feed_parallel parity). ``iterable`` holds one
+        minibatch iterable per device; ParallelExecutor.run accepts the
+        resulting list and concatenates along the batch axis."""
+        if num_places is not None and len(iterable) != int(num_places):
+            raise ValueError(
+                "feed_parallel got %d iterables for %d places"
+                % (len(iterable), int(num_places)))
+        return [self.feed(batch) for batch in iterable]
+
+    def _num_places(self, num_places):
+        if num_places is not None:
+            return int(num_places)
+        import jax
+
+        return jax.local_device_count()
+
+    def decorate_reader(self, reader, multi_devices=True, num_places=None,
+                        drop_last=True):
+        """Wrap a batch-level reader into feed dicts (decorate_reader
+        parity): each yielded item becomes one feed dict, or a list of
+        per-device dicts with the batch split evenly when
+        ``multi_devices``. Indivisible final batches are dropped
+        (drop_last) or raise, matching the reference contract."""
+        n = self._num_places(num_places) if multi_devices else 1
+
+        def decorated():
+            for batch in reader():
+                if not multi_devices:
+                    yield self.feed(batch)
+                    continue
+                if len(batch) % n != 0:
+                    if drop_last:
+                        continue
+                    raise ValueError(
+                        "batch size %d not divisible by %d devices and "
+                        "drop_last=False" % (len(batch), n))
+                per = len(batch) // n
+                yield [self.feed(batch[i * per:(i + 1) * per])
+                       for i in range(n)]
+
+        return decorated

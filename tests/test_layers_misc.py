@@ -382,3 +382,59 @@ def test_lod_rank_table_and_reorder():
     want = np.empty_like(w)
     want[[1, 2, 0, 3]] = w
     np.testing.assert_allclose(gv, want)
+
+
+def test_data_feeder_parallel_and_decorate_reader():
+    """feed_parallel + decorate_reader (reference DataFeeder API): batch
+    split across places, trained through ParallelExecutor's per-device
+    feed-list form."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+
+    rng = np.random.RandomState(0)
+    def batch_reader():
+        for _ in range(3):
+            yield [(rng.rand(4).astype("float32"),
+                    rng.rand(1).astype("float32")) for _ in range(16)]
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=main,
+                                    num_devices=8)
+        seen = 0
+        for feed_list in feeder.decorate_reader(
+                batch_reader, multi_devices=True, num_places=8)():
+            assert isinstance(feed_list, list) and len(feed_list) == 8
+            assert feed_list[0]["x"].shape == (2, 4)
+            lv, = pe.run(feed=feed_list, fetch_list=[loss.name])
+            assert np.isfinite(np.ravel(np.asarray(lv))).all()
+            seen += 1
+        assert seen == 3
+
+    # feed_parallel: explicit per-place iterables
+    samples = [[(rng.rand(4).astype("float32"),
+                 rng.rand(1).astype("float32"))] for _ in range(8)]
+    dicts = feeder.feed_parallel(samples, num_places=8)
+    assert len(dicts) == 8 and dicts[0]["x"].shape == (1, 4)
+
+    # indivisible batch without drop_last raises
+    def bad_reader():
+        yield [(rng.rand(4).astype("float32"),
+                rng.rand(1).astype("float32")) for _ in range(5)]
+    import pytest
+    with pytest.raises(ValueError, match="not divisible"):
+        list(feeder.decorate_reader(bad_reader, multi_devices=True,
+                                    num_places=8, drop_last=False)())
+    # with drop_last the batch is silently skipped
+    assert list(feeder.decorate_reader(bad_reader, multi_devices=True,
+                                       num_places=8)()) == []
