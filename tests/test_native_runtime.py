@@ -187,3 +187,29 @@ def test_save_inference_model_uses_ptpb(tmp_path):
         prog, feeds, fetches = fluid.io.load_inference_model(path, exe)
         assert feeds == ["x", "y"] or set(feeds) <= {"x", "y"}
         assert fetches[0] is not None
+
+
+def test_ptpb_lockstep_covers_fused_ops():
+    """Programs rewritten by the fusion passes (fused ops with list/None
+    attrs) still round-trip byte-exactly through the C++ PTPB parser."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.passes import apply_pass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        z = fluid.layers.relu(fluid.layers.elementwise_add(h, h))
+        proj = fluid.layers.fc(input=fluid.layers.unsqueeze(z, axes=[1]),
+                               size=4 * 6, num_flatten_dims=2)
+        out, _ = fluid.layers.dynamic_lstm(input=proj, size=4 * 6)
+    apply_pass(main, "fc_lstm_fuse")
+    apply_pass(main, "fuse_elewise_add_act")
+    apply_pass(main, "fc_fuse")
+    types = [op.type for op in main.global_block().ops]
+    assert "fusion_lstm" in types and "fused_elemwise_activation" in types
+    blob = serialize_program(main)
+    nblocks, ops, reserialized = native.parse_program_bytes(blob)
+    assert reserialized == blob
+    back = deserialize_program(blob)
+    assert [op.type for op in back.global_block().ops] == types
