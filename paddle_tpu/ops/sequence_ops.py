@@ -514,11 +514,14 @@ register_op(
 def _lower_lod_rank_table(ctx, ins, attrs):
     """Descending stable sort of sequence lengths: the lod_rank_table
     op's runtime content (control_flow.py:741 items())."""
-    lens = jnp.reshape(ins["Length"][0], (-1,)).astype(jnp.int64)
+    from paddle_tpu.core.types import device_dtype
+
+    ints = device_dtype("int64")  # int32 lanes on TPU (x64 disabled)
+    lens = jnp.reshape(ins["Length"][0], (-1,)).astype(ints)
     # stable ascending argsort of -lens == descending by length with ties
     # kept in original order (the reference table's tie rule)
     order = jnp.argsort(-lens, stable=True)
-    return {"Index": order.astype(jnp.int64), "SortedLength": lens[order]}
+    return {"Index": order.astype(ints), "SortedLength": lens[order]}
 
 
 register_op(
