@@ -1,7 +1,9 @@
 """Functional reader combinators (python/paddle/reader parity)."""
 
+from paddle_tpu.reader import creator  # noqa: F401
 from paddle_tpu.reader.decorator import (  # noqa: F401
     batch,
+    bucket_by_length,
     buffered,
     cache,
     chain,

@@ -1,0 +1,55 @@
+"""Reader creators (python/paddle/reader/creator.py parity): build
+sample-level readers from common data sources. The recordio creator reads
+through the native C++ reader (native/src/recordio.h) and unpacks the
+PTRC sample framing recordio_writer produces.
+"""
+
+__all__ = ["np_array", "text_file", "recordio"]
+
+
+def np_array(x):
+    """Reader yielding the leading-axis elements of an ndarray."""
+    import numpy as np
+
+    arr = np.asarray(x)
+
+    def reader():
+        for row in arr:
+            yield row
+
+    return reader
+
+
+def text_file(path):
+    """Reader yielding the file's lines with trailing newlines stripped."""
+
+    def reader():
+        with open(path, "r") as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, buf_size=None):
+    """Reader over recordio file(s) written by
+    ``recordio_writer.convert_reader_to_recordio_file(s)``; ``paths`` is
+    one path, a comma-separated string, or a list. Samples come back as
+    the original feed tuples/arrays (PTRC unpack)."""
+    from paddle_tpu.recordio_writer import unpack_sample
+
+    if isinstance(paths, str):
+        paths = [p for p in paths.split(",") if p]
+
+    def reader():
+        from paddle_tpu import native
+
+        for path in paths:
+            r = native.RecordIOReader(path)
+            try:
+                for blob in r:
+                    yield unpack_sample(blob)
+            finally:
+                r.close()
+
+    return reader

@@ -121,3 +121,32 @@ def test_bucketing_bounds_executor_compiles():
     assert all(np.isfinite(losses))
     # one executable per distinct feed-shape set = one per bucket
     assert len(exe._cache) - compiles_before <= 4
+
+
+def test_reader_creators(tmp_path):
+    """reader.creator: np_array rows, text_file lines, recordio samples
+    through the native reader (creator.py parity)."""
+    from paddle_tpu.reader import creator
+    from paddle_tpu.recordio_writer import convert_reader_to_recordio_file
+    from paddle_tpu import native
+    import pytest
+
+    arr = np.arange(12).reshape(4, 3)
+    assert [r.tolist() for r in creator.np_array(arr)()] == arr.tolist()
+
+    p = tmp_path / "lines.txt"
+    p.write_text("alpha\nbeta\ngamma\n")
+    assert list(creator.text_file(str(p))()) == ["alpha", "beta", "gamma"]
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.RandomState(1)
+    samples = [(rng.rand(3).astype("float32"), np.int64(i))
+               for i in range(5)]
+    rio = str(tmp_path / "data.recordio")
+    convert_reader_to_recordio_file(rio, lambda: iter(samples))
+    got = list(creator.recordio(rio)())
+    assert len(got) == 5
+    for (x, y), (gx, gy) in zip(samples, got):
+        np.testing.assert_allclose(gx, x)
+        assert int(gy) == int(y)
