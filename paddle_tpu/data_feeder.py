@@ -119,8 +119,10 @@ class DataFeeder(object):
         """Wrap a batch-level reader into feed dicts (decorate_reader
         parity): each yielded item becomes one feed dict, or a list of
         per-device dicts with the batch split evenly when
-        ``multi_devices``. Indivisible final batches are dropped
-        (drop_last) or raise, matching the reference contract."""
+        ``multi_devices``. An indivisible batch is truncated to the
+        largest device multiple (only the remainder SAMPLES drop; a
+        batch smaller than the device count drops whole) when
+        ``drop_last``, else raises."""
         n = self._num_places(num_places) if multi_devices else 1
 
         def decorated():
@@ -128,13 +130,14 @@ class DataFeeder(object):
                 if not multi_devices:
                     yield self.feed(batch)
                     continue
-                if len(batch) % n != 0:
-                    if drop_last:
-                        continue
+                usable = (len(batch) // n) * n
+                if usable != len(batch) and not drop_last:
                     raise ValueError(
                         "batch size %d not divisible by %d devices and "
                         "drop_last=False" % (len(batch), n))
-                per = len(batch) // n
+                if usable == 0:
+                    continue
+                per = usable // n
                 yield [self.feed(batch[i * per:(i + 1) * per])
                        for i in range(n)]
 

@@ -52,4 +52,8 @@ def recordio(paths, buf_size=None):
             finally:
                 r.close()
 
+    if buf_size is not None:
+        from paddle_tpu.reader.decorator import buffered
+
+        return buffered(reader, buf_size)
     return reader

@@ -435,6 +435,15 @@ def test_data_feeder_parallel_and_decorate_reader():
     with pytest.raises(ValueError, match="not divisible"):
         list(feeder.decorate_reader(bad_reader, multi_devices=True,
                                     num_places=8, drop_last=False)())
-    # with drop_last the batch is silently skipped
+    # with drop_last a sub-device-count batch is skipped whole...
     assert list(feeder.decorate_reader(bad_reader, multi_devices=True,
                                        num_places=8)()) == []
+
+    # ...while a larger indivisible batch only loses remainder samples
+    def uneven_reader():
+        yield [(rng.rand(4).astype("float32"),
+                rng.rand(1).astype("float32")) for _ in range(10)]
+    (dicts2,) = list(feeder.decorate_reader(uneven_reader,
+                                            multi_devices=True,
+                                            num_places=8)())
+    assert len(dicts2) == 8 and dicts2[0]["x"].shape == (1, 4)
