@@ -117,6 +117,45 @@ def test_lease_timeout_requeues_and_failure_max_discards():
         client2.close()
 
 
+def test_concurrent_workers_each_chunk_exactly_once():
+    """8 worker threads hammering one C++ master: across a pass every
+    chunk is dispatched exactly once (no double-lease, no loss) — the
+    mutex discipline in master.h under real connection concurrency."""
+    import threading
+
+    chunks = list(range(64))
+    with _NativeMaster("--chunks_per_task", 2, "--timeout_s", 30.0) as m:
+        boot = MasterClient(("127.0.0.1", m.port))
+        boot.set_dataset(chunks)
+        seen = []
+        seen_lock = threading.Lock()
+        errors = []
+
+        def worker():
+            try:
+                client = MasterClient(("127.0.0.1", m.port))
+                while True:
+                    task = client.get_task(sync_pass=False)
+                    if task is None:
+                        break
+                    with seen_lock:
+                        seen.extend(task.chunks)
+                    client.task_finished(task.task_id)
+                client.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert sorted(seen) == chunks  # exactly once each
+        assert boot.status()["cur_pass"] == 1
+        boot.close()
+
+
 def test_native_master_recovers_python_snapshot(tmp_path):
     """A Python-master snapshot restarts under the C++ master: pending
     tasks go back to todo, pass counter and chunks carry over."""
