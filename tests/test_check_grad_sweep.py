@@ -294,3 +294,55 @@ def test_modified_huber_loss_grad():
         "Y": np.asarray([[1.0], [1.0], [0.0]], "float32"),
     }, {"Out": (3, 1)})
     t.check_grad(["X"], "Out", no_grad_set={"Y"})
+
+
+# --- fused ops (graph_pattern fusion-pass targets) -----------------------
+def test_fused_elemwise_activation_grad():
+    rng = np.random.RandomState(11)
+    x = rng.randn(3, 4).astype("float32") + 0.3  # keep relu off its kink
+    y = rng.randn(3, 4).astype("float32") * 0.1
+    t = _t("fused_elemwise_activation", {"X": x, "Y": y},
+           {"Out": x.shape, "IntermediateOut": x.shape},
+           {"functor_list": ["elementwise_add", "tanh"], "axis": -1})
+    t.check_grad(["X", "Y"], "Out")
+
+
+def test_fusion_lstm_grad():
+    rng = np.random.RandomState(12)
+    B, T, M, D = 2, 4, 3, 5
+    t = _t("fusion_lstm", {
+        "X": rng.randn(B, T, M).astype("float32"),
+        "WeightX": rng.randn(M, 4 * D).astype("float32") * 0.3,
+        "WeightH": rng.randn(D, 4 * D).astype("float32") * 0.3,
+        "Bias": rng.randn(7 * D).astype("float32") * 0.1,
+        "BiasX": rng.randn(4 * D).astype("float32") * 0.1,
+    }, {"Hidden": (B, T, D), "Cell": (B, T, D)})
+    t.check_grad(["X", "WeightX", "WeightH"], "Hidden",
+                 max_relative_error=1e-2)
+
+
+def test_fusion_gru_grad():
+    rng = np.random.RandomState(13)
+    B, T, M, D = 2, 4, 3, 5
+    t = _t("fusion_gru", {
+        "X": rng.randn(B, T, M).astype("float32"),
+        "WeightX": rng.randn(M, 3 * D).astype("float32") * 0.3,
+        "WeightH": rng.randn(D, 3 * D).astype("float32") * 0.3,
+        "Bias": rng.randn(3 * D).astype("float32") * 0.1,
+    }, {"Hidden": (B, T, D)})
+    # f32 fd noise compounds through the recurrence; 2e-2 matches the
+    # dynamic-rnn entries above
+    t.check_grad(["X", "WeightX", "WeightH"], "Hidden",
+                 max_relative_error=2e-2)
+
+
+def test_fusion_seqconv_eltadd_relu_grad():
+    rng = np.random.RandomState(14)
+    B, T, D, M = 2, 6, 3, 4
+    # keep pre-relu values away from zero so fd never crosses the kink
+    t = _t("fusion_seqconv_eltadd_relu", {
+        "X": rng.randn(B, T, D).astype("float32"),
+        "Filter": rng.randn(3 * D, M).astype("float32") * 0.4,
+        "Bias": np.full((M,), 1.5, "float32"),
+    }, {"Out": (B, T, M)})
+    t.check_grad(["X", "Filter"], "Out", max_relative_error=1e-2)
