@@ -61,6 +61,13 @@ def parse_args():
                         help="route dynamic_lstm/gru through the fused "
                              "Pallas kernels (FLAGS_use_pallas_lstm/gru)")
     parser.add_argument("--memory_optimize", action="store_true")
+    parser.add_argument("--gradient_merge", type=int, default=0,
+                        metavar="K",
+                        help="accumulate K microbatches per optimizer "
+                             "step (multi_batch_merge capability)")
+    parser.add_argument("--fuse_elewise", action="store_true",
+                        help="run the fuse_elewise_add_act pass "
+                             "(BuildStrategy.fuse_elewise_add_act_ops)")
     parser.add_argument("--profile", action="store_true",
                         help="profile the timed region (chrome trace)")
     parser.add_argument("--profile_path", type=str,
@@ -211,16 +218,28 @@ def main():
         from paddle_tpu.transpiler import memory_optimize
 
         memory_optimize(main_prog)
+    if args.gradient_merge > 1:
+        from paddle_tpu.transpiler import rewrite_program_gradient_merge
+
+        rewrite_program_gradient_merge(
+            main_prog, startup, k_steps=args.gradient_merge, avg=True)
+    if args.fuse_elewise and args.update_method == "local":
+        from paddle_tpu.core.passes import apply_pass
+
+        apply_pass(main_prog, "fuse_elewise_add_act")
 
     place = fluid.CPUPlace() if args.device == "CPU" else fluid.TPUPlace()
 
     if args.update_method in ("spmd", "multiproc"):
+        build_strategy = fluid.BuildStrategy()
+        build_strategy.fuse_elewise_add_act_ops = bool(args.fuse_elewise)
         exe = fluid.Executor(place)
         exe.run(startup)
         pexe = fluid.ParallelExecutor(
             use_tpu=args.device != "CPU",
             loss_name=loss.name,
             main_program=main_prog,
+            build_strategy=build_strategy,
             num_devices=args.num_devices or None,
         )
         run = lambda fetch: pexe.run(
