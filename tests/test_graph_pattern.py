@@ -356,3 +356,28 @@ def test_build_strategy_knob_applies_fusion():
     assert np.isfinite(np.asarray(lv)).all()
     assert any(op.type == "fused_elemwise_activation"
                for op in main.block(0).ops)
+
+
+def test_fusion_parity_on_8_device_mesh():
+    """The fused program must train to the same losses as the unfused one
+    under GSPMD data parallelism (fusion x mesh composition)."""
+    def run(fuse):
+        main, startup, loss = _add_act_train_program()
+        bs = fluid.BuildStrategy()
+        bs.fuse_elewise_add_act_ops = fuse
+        exe = fluid.Executor(fluid.CPUPlace())
+        out = []
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            pe = fluid.ParallelExecutor(loss_name=loss.name,
+                                        main_program=main,
+                                        build_strategy=bs, num_devices=8)
+            rng = np.random.RandomState(5)
+            for _ in range(4):
+                feed = {"x": rng.rand(8, 6).astype("float32"),
+                        "y": rng.rand(8, 1).astype("float32")}
+                lv = pe.run(feed=feed, fetch_list=[loss.name])[0]
+                out.append(float(np.ravel(np.asarray(lv))[0]))
+        return out
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
