@@ -25,6 +25,8 @@ __all__ = [
     "save_inference_model",
     "load_inference_model",
     "get_inference_program",
+    "get_parameter_value",
+    "get_parameter_value_by_name",
     "save_sharded_persistables",
     "load_sharded_persistables",
     "save_checkpoint",
@@ -223,6 +225,31 @@ def get_inference_program(target_vars, main_program=None):
 # its addressable shards so multi-host checkpointing never gathers a full
 # array on one host).
 # ---------------------------------------------------------------------------
+
+
+def get_parameter_value(para, executor, scope=None):
+    """Current value of a Parameter as a numpy array (io.py:818 parity;
+    the value lives in the executor's scope, not the graph)."""
+    import numpy as np
+
+    if not is_parameter(para):
+        raise AssertionError("%r is not a Parameter" % getattr(
+            para, "name", para))
+    val = _scope_of(executor, scope).get_value(para.name)
+    if val is None:
+        raise RuntimeError(
+            "parameter %s has no value in scope (run the startup program "
+            "first)" % para.name)
+    return np.asarray(val)
+
+
+def get_parameter_value_by_name(name, executor, program=None, scope=None):
+    """io.py:848 parity: look the Parameter up by name first."""
+    from paddle_tpu import framework
+
+    program = program or framework.default_main_program()
+    var = program.global_block().var(name)
+    return get_parameter_value(var, executor, scope=scope)
 
 
 def _shard_index_to_json(index, ndim):

@@ -191,3 +191,29 @@ def test_contrib_memory_usage_and_op_freq():
     import pytest as _pytest
     with _pytest.raises(TypeError):
         contrib.memory_usage("not a program", 4)
+
+
+def test_get_parameter_value():
+    """io.get_parameter_value(_by_name): scope-backed parameter reads
+    (io.py:818/:848 parity) including the not-initialized error."""
+    import numpy as np
+    import pytest
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    param = main.global_block().all_parameters()[0]
+    with fluid.scope_guard(fluid.executor.Scope()):
+        with pytest.raises(RuntimeError, match="startup"):
+            fluid.io.get_parameter_value(param, exe)
+        exe.run(startup)
+        v = fluid.io.get_parameter_value(param, exe)
+        assert v.shape == (3, 2)
+        v2 = fluid.io.get_parameter_value_by_name(param.name, exe,
+                                                  program=main)
+        np.testing.assert_array_equal(v, v2)
+    with pytest.raises(AssertionError, match="not a Parameter"):
+        fluid.io.get_parameter_value(x, exe)
