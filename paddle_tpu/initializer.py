@@ -177,5 +177,31 @@ MSRA = MSRAInitializer
 Bilinear = BilinearInitializer
 
 
+_force_init_on_cpu = False
+
+
 def force_init_on_cpu():
-    return False
+    """True inside an ``init_on_cpu()`` block (initializer.py:32 parity)."""
+    return _force_init_on_cpu
+
+
+def init_on_cpu():
+    """Context manager marking initializer ops force_cpu
+    (initializer.py:49 parity). Under the whole-program XLA design the
+    startup program compiles as one executable and XLA owns placement,
+    so the tag is advisory; the capability the reference used it for
+    (initializing huge embeddings without a device-memory spike) is
+    covered by GSPMD-sharded tables (docs/DISTRIBUTED_DESIGN.md)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _force_init_on_cpu
+        prev = _force_init_on_cpu
+        _force_init_on_cpu = True
+        try:
+            yield
+        finally:
+            _force_init_on_cpu = prev
+
+    return guard()
