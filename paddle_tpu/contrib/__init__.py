@@ -15,7 +15,9 @@ from paddle_tpu.transpiler.quantize_transpiler import (  # noqa: F401
     QuantizeTranspiler,
 )
 
-__all__ = ["memory_usage", "op_freq_statis", "QuantizeTranspiler"]
+__all__ = ["memory_usage", "op_freq_statistic", "op_freq_statis",
+           "QuantizeTranspiler", "InitState", "StateCell",
+           "TrainingDecoder", "BeamSearchDecoder"]
 
 _DTYPE_SIZE = {
     "float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
@@ -92,3 +94,15 @@ def op_freq_statis(program):
     order = lambda d: OrderedDict(
         sorted(d.items(), key=lambda kv: -kv[1]))
     return order(uni), order(pair)
+
+
+from paddle_tpu.contrib.decoder import (  # noqa: E402,F401
+    BeamSearchDecoder,
+    InitState,
+    StateCell,
+    TrainingDecoder,
+)
+
+# reference name (contrib/op_frequence.py:op_freq_statistic); the
+# shorter alias predates the rename and is kept for compatibility
+op_freq_statistic = op_freq_statis

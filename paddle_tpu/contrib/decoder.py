@@ -1,0 +1,356 @@
+"""Customizable RNN decoder DSL: InitState / StateCell / TrainingDecoder /
+BeamSearchDecoder.
+
+Reference parity: ``python/paddle/fluid/contrib/decoder/beam_search_decoder.py``
+(the high-level decoder API over StateCell). TPU-first differences:
+
+- TrainingDecoder drives this framework's DynamicRNN (scan-based), so the
+  user's state-updater callback builds ops inside the scanned step block
+  exactly as in the reference; ``need_reorder`` is accepted and ignored
+  (no LoD rank sorting exists in the dense-padded design — masks do that
+  job, docs/LOD_DESIGN.md).
+- BeamSearchDecoder keeps the reference's dense [batch, beam] lattice
+  CONSTANT-shaped (beam_search_ops.py design): finished beams freeze at
+  end_id instead of shrinking the candidate set, and the generation loop
+  is laid out step-by-step at graph-build time (max_len static), which
+  XLA compiles into one executable. Batch size must be static — the
+  per-step parent backtracking gathers need it.
+"""
+
+import numpy as np
+
+from paddle_tpu.param_attr import ParamAttr
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]
+
+
+class InitState(object):
+    """Initial hidden-state holder (reference InitState contract)."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        from paddle_tpu.layers import tensor as tensor_layers
+
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "init_boot must be provided to infer the shape of InitState")
+        else:
+            self._init = tensor_layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=shape, dtype=dtype)
+        self._need_reorder = need_reorder  # accepted; masks replace LoD sort
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell(object):
+    """Named hidden states + step inputs + a user-registered updater.
+
+    Usage (reference-compatible)::
+
+        cell = StateCell(inputs={'x': None}, states={'h': init_h},
+                         out_state='h')
+
+        @cell.state_updater
+        def updater(cell):
+            h = cell.get_state('h')
+            x = cell.get_input('x')
+            cell.set_state('h', layers.fc(input=[x, h], size=D, act='tanh'))
+    """
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._cur_states = {}
+        self._state_names = []
+        for state_name, state in states.items():
+            if not isinstance(state, InitState):
+                raise ValueError("state must be an InitState object")
+            self._cur_states[state_name] = state
+            self._state_names.append(state_name)
+        self._inputs = dict(inputs)
+        self._out_state = out_state
+        self._state_updater = None
+        self._decoder = None
+        if out_state not in self._cur_states:
+            raise ValueError("out_state must be one state in states")
+
+    # -- decoder hand-off ---------------------------------------------------
+
+    def _enter_decoder(self, decoder):
+        if self._decoder is not None:
+            raise ValueError("StateCell has already entered a decoder")
+        self._decoder = decoder
+
+    def _leave_decoder(self, decoder):
+        if self._decoder is not decoder:
+            raise ValueError("inconsistent decoder object in StateCell")
+        self._decoder = None
+
+    def _set_raw_state(self, state_name, value):
+        self._cur_states[state_name] = value
+
+    # -- user API -----------------------------------------------------------
+
+    def get_state(self, state_name):
+        if state_name not in self._cur_states:
+            raise ValueError("unknown state %r" % state_name)
+        state = self._cur_states[state_name]
+        return state.value if isinstance(state, InitState) else state
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs or self._inputs[input_name] is None:
+            raise ValueError("invalid input %r" % input_name)
+        return self._inputs[input_name]
+
+    def set_state(self, state_name, state_value):
+        self._cur_states[state_name] = state_value
+
+    def state_updater(self, updater):
+        self._state_updater = updater
+        return updater
+
+    def compute_state(self, inputs):
+        for name, value in inputs.items():
+            if name not in self._inputs:
+                raise ValueError(
+                    "unknown input %r (declared: %s)"
+                    % (name, sorted(self._inputs)))
+            self._inputs[name] = value
+        if self._state_updater is None:
+            raise ValueError("no state_updater registered")
+        self._state_updater(self)
+
+    def update_states(self):
+        if self._decoder is not None:
+            self._decoder._update_states(self)
+
+    def out_state(self):
+        return self.get_state(self._out_state)
+
+
+class TrainingDecoder(object):
+    """Training-time RNN decoder over a StateCell (reference contract)::
+
+        decoder = TrainingDecoder(state_cell)
+        with decoder.block():
+            w = decoder.step_input(trg_embedding)     # [B, T, D]
+            decoder.state_cell.compute_state(inputs={'x': w})
+            score = layers.fc(decoder.state_cell.get_state('h'),
+                              size=V, act='softmax')
+            decoder.state_cell.update_states()
+            decoder.output(score)
+        out = decoder()                               # [B, T, V]
+    """
+
+    def __init__(self, state_cell, name=None):
+        from paddle_tpu.layers.control_flow import DynamicRNN
+
+        self._rnn = DynamicRNN(name=name)
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+        self._memories = {}  # state name -> rnn memory var
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            try:
+                with self._rnn.block():
+                    # materialize every state as a scan memory
+                    for name in self._state_cell._state_names:
+                        init = self._state_cell._cur_states[name]
+                        assert isinstance(init, InitState), (
+                            "decoder.block() must be entered before the "
+                            "cell computes states")
+                        mem = self._rnn.memory(init=init.value)
+                        self._memories[name] = mem
+                        self._state_cell._set_raw_state(name, mem)
+                    yield
+            finally:
+                # release the cell even if the user's block raised, so a
+                # corrected decoder can be built from the same cell
+                self._state_cell._leave_decoder(self)
+
+        return guard()
+
+    def step_input(self, x):
+        return self._rnn.step_input(x)
+
+    def static_input(self, x):
+        return self._rnn.static_input(x)
+
+    def output(self, *outputs):
+        self._rnn.output(*outputs)
+
+    def _update_states(self, cell):
+        for name, mem in self._memories.items():
+            new = cell._cur_states[name]
+            if new is not mem:
+                self._rnn.update_memory(mem, new)
+                cell._set_raw_state(name, mem)
+
+    def __call__(self):
+        return self._rnn()
+
+
+class BeamSearchDecoder(object):
+    """Generation-time beam-search decoder over a StateCell.
+
+    The reference builds a while-loop over LoD-shrinking candidate sets
+    (beam_search_decoder.py:420+); here the loop is laid out at build
+    time over the dense constant-shape [batch, beam] lattice that this
+    framework's beam_search op works on, and the per-step state update
+    is the SAME user updater the training decoder ran — so one StateCell
+    definition serves both decoders, the reference's design goal.
+
+    Args follow the reference: init_ids [B, 1] int64, init_scores [B, 1]
+    float32, target vocabulary size, word embedding dim; the embedding
+    parameter name is ``word_emb`` by default so generation can share the
+    training embedding via ParamAttr naming.
+    """
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim, input_var_dict=None, topk_size=50,
+                 sparse_emb=True, max_len=100, beam_size=4, end_id=1,
+                 name=None, emb_param_name="word_emb",
+                 score_param_name="beam_score_fc"):
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._v = int(target_dict_dim)
+        self._word_dim = int(word_dim)
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = topk_size  # accepted; dense top-k uses beam*V
+        self._sparse_emb = sparse_emb
+        self._max_len = int(max_len)
+        self._beam_size = int(beam_size)
+        self._end_id = int(end_id)
+        self._emb_param_name = emb_param_name
+        self._score_param_name = score_param_name
+        self._decoded = None
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    def _update_states(self, cell):
+        pass  # beam states update positionally inside decode()
+
+    def decode(self):
+        """Build the unrolled generation graph. Returns
+        (sentence_ids [B, beam, <=max_len], sentence_scores)."""
+        from paddle_tpu import layers
+
+        cell = self._state_cell
+        # validate BEFORE mutating the cell, releasing it on failure so a
+        # corrected decoder can be built from the same cell
+        B = self._init_ids.shape[0] if self._init_ids.shape else None
+        if B is None or int(B) < 0:
+            cell._leave_decoder(self)
+            raise ValueError(
+                "BeamSearchDecoder needs a static batch size on init_ids "
+                "(the per-step parent gathers index a [batch*beam] "
+                "lattice); declare the input with append_batch_size=False "
+                "or a fixed shape")
+        B, K = int(B), self._beam_size
+
+        input_names = [n for n in cell._inputs
+                       if n not in self._input_var_dict]
+        if len(input_names) != 1:
+            cell._leave_decoder(self)
+            raise ValueError(
+                "StateCell must declare exactly one step input beyond "
+                "input_var_dict (the previous-word embedding); got %s"
+                % input_names)
+        word_input = input_names[0]
+
+        # expand every state and static input to the beam lattice
+        # [B, ...] -> [B*K, ...]
+        def to_beam(v):
+            e = layers.expand(layers.unsqueeze(v, axes=[1]),
+                              expand_times=[1, K] + [1] * (len(v.shape) - 1))
+            return layers.reshape(e, [B * K] + list(v.shape[1:]))
+
+        for name in cell._state_names:
+            init = cell._cur_states[name]
+            val = init.value if isinstance(init, InitState) else init
+            cell._set_raw_state(name, to_beam(val))
+        beam_inputs = {n: to_beam(v)
+                       for n, v in self._input_var_dict.items()}
+
+        prev_ids = layers.reshape(self._init_ids, [B, 1])
+        prev_ids = layers.expand(prev_ids, expand_times=[1, K])  # [B, K]
+        # [0, -inf, ...] seed (identical initial beams must not produce
+        # duplicate candidates) shifted by the caller's init_scores
+        seed = np.full((1, K), -1e9, "float32")
+        seed[0, 0] = 0.0
+        prev_scores = layers.elementwise_add(
+            layers.expand(layers.assign(seed), expand_times=[B, 1]),
+            layers.expand(layers.reshape(self._init_scores, [B, 1]),
+                          expand_times=[1, K]))
+
+        offsets = layers.assign(
+            (np.arange(B, dtype="int64")[:, None] * K).repeat(K, axis=1))
+
+        step_ids, step_parents, step_scores = [], [], []
+        for _ in range(self._max_len):
+            emb = layers.embedding(
+                layers.reshape(prev_ids, [B * K, 1]),
+                size=[self._v, self._word_dim],
+                is_sparse=self._sparse_emb,
+                param_attr=ParamAttr(name=self._emb_param_name))
+            cell.compute_state(inputs=dict(
+                beam_inputs, **{word_input: emb}))
+            out = cell.out_state()  # [B*K, H]
+            logits = layers.fc(
+                input=out, size=self._v,
+                param_attr=ParamAttr(
+                    name=self._score_param_name + ".w"),
+                bias_attr=ParamAttr(
+                    name=self._score_param_name + ".b"))
+            log_probs = layers.log(layers.softmax(logits))
+            # accumulate: candidate total = beam total + step log-prob
+            # (beam_search with is_accumulated=True expects TOTALS; the
+            # op only uses pre_scores to freeze finished beams)
+            totals = layers.elementwise_add(
+                layers.reshape(log_probs, [B, K, self._v]),
+                layers.unsqueeze(prev_scores, axes=[2]))
+            sel_ids, sel_scores, parent = layers.beam_search(
+                pre_ids=prev_ids, pre_scores=prev_scores, scores=totals,
+                beam_size=K, end_id=self._end_id)
+            # reorder every state by the parent beam
+            flat_parent = layers.reshape(
+                layers.elementwise_add(parent, offsets), [B * K])
+            for name in cell._state_names:
+                cell._set_raw_state(
+                    name, layers.gather(cell._cur_states[name], flat_parent))
+            step_ids.append(sel_ids)
+            step_parents.append(parent)
+            step_scores.append(sel_scores)
+            prev_ids, prev_scores = sel_ids, sel_scores
+
+        ids_t = layers.stack(step_ids, axis=0)        # [T, B, K]
+        parents_t = layers.stack(step_parents, axis=0)
+        scores_t = layers.stack(step_scores, axis=0)
+        self._decoded = layers.beam_search_decode(
+            ids=ids_t, parent_idx=parents_t, scores=scores_t,
+            beam_size=K, end_id=self._end_id)
+        cell._leave_decoder(self)
+        return self._decoded
+
+    def __call__(self):
+        if self._decoded is None:
+            return self.decode()
+        return self._decoded
