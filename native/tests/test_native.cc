@@ -14,6 +14,8 @@
 #include <vector>
 
 #include "ptpu/c_api.h"
+#include "../src/json.h"
+#include "../src/master.h"
 
 static void test_recordio() {
   const char* path = "/tmp/ptpu_test.recordio";
@@ -157,10 +159,72 @@ static void test_program_roundtrip(const char* ptpb_path) {
   std::printf("program roundtrip ok (%ld bytes, first op %s)\n", size, op0);
 }
 
+static void test_json_codec() {
+  using ptpu::json::Value;
+  // round-trip the master's wire/snapshot shapes, incl. unicode escapes
+  const std::string text =
+      "{\"chunks\": [\"a,b\", 3, 2.5, null, true,"
+      " \"\\ud83d\\ude00\\u00e9\"], \"cur_pass\": 7}";
+  Value v = ptpu::json::parse(text);
+  assert(v["cur_pass"].as_int() == 7);
+  const auto& arr = v["chunks"].as_array();
+  assert(arr.size() == 6);
+  assert(arr[0].as_string() == "a,b");
+  assert(arr[1].as_int() == 3);
+  assert(arr[2].as_double() == 2.5);
+  assert(arr[3].is_null());
+  assert(arr[4].as_bool());
+  assert(arr[5].as_string() == "\xF0\x9F\x98\x80\xC3\xA9");  // UTF-8
+  // dump -> parse -> dump is a fixed point
+  std::string d1 = v.dump();
+  std::string d2 = ptpu::json::parse(d1).dump();
+  assert(d1 == d2);
+  // malformed inputs raise, never crash
+  for (const char* bad : {"{", "[1,", "\"\\u12g4\"", "\"\\ud800\"",
+                          "01x", "{\"a\" 1}"}) {
+    bool threw = false;
+    try {
+      ptpu::json::parse(bad);
+    } catch (const std::exception&) {
+      threw = true;
+    }
+    assert(threw);
+  }
+  std::printf("json codec ok\n");
+}
+
+static void test_master_service() {
+  using ptpu::master::MasterService;
+  using ptpu::master::Task;
+  MasterService svc(2, /*timeout_s=*/30.0, /*failure_max=*/2, "");
+  ptpu::json::Array chunks;
+  for (int i = 0; i < 5; ++i) chunks.push_back(ptpu::json::Value(i));
+  svc.SetDataset(chunks);  // -> 3 tasks (2+2+1)
+  Task t;
+  std::string err;
+  int got = 0;
+  while (svc.GetTask(0, &t, &err)) {
+    got += (int)t.chunks.size();
+    assert(svc.TaskFinished(t.task_id));
+  }
+  assert(got == 5);
+  assert(err == ptpu::master::kPassBefore ||
+         err == ptpu::master::kNoMoreAvailable);
+  // pass rolled; old-pass fetches are rejected, new pass serves again
+  assert(!svc.GetTask(0, &t, &err) && err == ptpu::master::kPassBefore);
+  assert(svc.GetTask(1, &t, &err));
+  // stale-epoch failure reports are rejected
+  assert(!svc.TaskFailed(t.task_id, ptpu::json::Value((int64_t)0)));
+  assert(svc.TaskFailed(t.task_id, ptpu::json::Value(t.epoch)));
+  std::printf("master service ok\n");
+}
+
 int main(int argc, char** argv) {
   test_recordio();
   test_queue();
   test_scope();
+  test_json_codec();
+  test_master_service();
   test_program_roundtrip(argc > 1 ? argv[1]
                                   : "/tmp/ptpu_test_program.ptpb");
   std::printf("ALL NATIVE TESTS PASSED\n");
