@@ -145,6 +145,38 @@ register_op(
 )
 
 
+def _lower_fusion_seqexpand_concat_fc(ctx, ins, attrs):
+    """fusion_seqexpand_concat_fc_op.cc role: X[0] is the sequence
+    [B, T, M0]; every further X[i] is a per-sequence vector [B, Mi]
+    broadcast along T (the sequence_expand), all concatenated and run
+    through one fc. Dense-padded formulation of the reference's
+    LoD-expand + concat + fc chain."""
+    xs = ins["X"]
+    x0 = xs[0]
+    T = jnp.shape(x0)[1]
+    cols = [x0]
+    for v in xs[1:]:
+        cols.append(jnp.broadcast_to(
+            v[:, None, :], (jnp.shape(v)[0], T, jnp.shape(v)[1])))
+    cat = jnp.concatenate(cols, axis=-1)  # [B, T, sum(Mi)]
+    out = cat @ ins["FCWeight"][0]
+    bias = ins.get("FCBias", [None])[0]
+    if bias is not None:
+        out = out + jnp.reshape(bias, (-1,))
+    act = attrs.get("fc_activation", "identity")
+    return {"Out": _ACT[act](out), "FCOut": out}
+
+
+register_op(
+    "fusion_seqexpand_concat_fc",
+    inputs=["*X", "FCWeight", "FCBias"],
+    outputs=["Out", "FCOut"],
+    attrs={"fc_activation": "identity"},
+    intermediate_outputs=("FCOut",),
+    lower=_lower_fusion_seqexpand_concat_fc,
+)
+
+
 def _lower_fused_embedding_fc_lstm(ctx, ins, attrs):
     """fused_embedding_fc_lstm_op.cc role: lookup_table + projection fc +
     LSTM recurrence. The reference pass pre-multiplies the table with the

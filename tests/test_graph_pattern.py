@@ -381,3 +381,40 @@ def test_fusion_parity_on_8_device_mesh():
         return out
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_fusion_seqexpand_concat_fc_op():
+    """fusion_seqexpand_concat_fc: sequence + broadcast vectors + one fc,
+    oracle = the unfused expand/concat/fc composition."""
+    rng = np.random.RandomState(15)
+    B, T, M0, M1, D = 2, 5, 3, 4, 6
+    xv = rng.rand(B, T, M0).astype("float32")
+    vv = rng.rand(B, M1).astype("float32")
+    wv = rng.rand(M0 + M1, D).astype("float32")
+    bv = rng.rand(D).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, M0], dtype="float32")
+        v = fluid.layers.data(name="v", shape=[M1], dtype="float32")
+        w = fluid.layers.data(name="w", shape=[M0 + M1, D],
+                              dtype="float32", append_batch_size=False)
+        b = fluid.layers.data(name="b", shape=[D], dtype="float32",
+                              append_batch_size=False)
+        block = main.current_block()
+        out = block.create_var(name="fx_out", dtype="float32", shape=None)
+        fco = block.create_var(name="fx_fco", dtype="float32", shape=None)
+        block.append_op(
+            "fusion_seqexpand_concat_fc",
+            inputs={"X": [x.name, v.name], "FCWeight": [w.name],
+                    "FCBias": [b.name]},
+            outputs={"Out": [out.name], "FCOut": [fco.name]},
+            attrs={"fc_activation": "relu"})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, = exe.run(main, feed={"x": xv, "v": vv, "w": wv, "b": bv},
+                   fetch_list=[out])
+    cat = np.concatenate(
+        [xv, np.broadcast_to(vv[:, None, :], (B, T, M1))], axis=-1)
+    want = np.maximum(cat @ wv + bv, 0.0)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
