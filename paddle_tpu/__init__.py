@@ -15,6 +15,8 @@ Reference parity: python/paddle/fluid/__init__.py in reyoung/Paddle.
 
 from paddle_tpu.core.types import (  # noqa: F401
     CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
     TPUPlace,
     Place,
     VarType,
@@ -69,6 +71,10 @@ from paddle_tpu import average  # noqa: F401
 from paddle_tpu.core.selected_rows import SelectedRows  # noqa: F401
 from paddle_tpu import unique_name  # noqa: F401
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from paddle_tpu import contrib  # noqa: F401
+from paddle_tpu.executor import Scope  # noqa: F401
+from paddle_tpu.layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401,E501
+from paddle_tpu.layers.control_flow import LoDTensorArray  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -69,6 +69,35 @@ class CPUPlace(Place):
     _kind = "cpu"
 
 
+class CUDAPlace(TPUPlace):
+    """Porting-compat alias (place.h:36): there is no CUDA in this
+    framework — a script's ``fluid.CUDAPlace(0)`` maps to the accelerator
+    place (TPUPlace), with a one-time warning so the difference is
+    visible."""
+
+    _warned = False
+
+    def __init__(self, device_id=0):
+        super(CUDAPlace, self).__init__(device_id)
+        if not CUDAPlace._warned:
+            CUDAPlace._warned = True
+            import warnings
+
+            warnings.warn(
+                "CUDAPlace maps to the TPU/accelerator place in "
+                "paddle_tpu (no CUDA backend exists)", UserWarning,
+                stacklevel=2)
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """Porting-compat alias (place.h:51): pinned host memory is a CUDA
+    transfer-staging concept; host arrays feed the accelerator directly
+    here, so this is the CPU place."""
+
+    def __init__(self, device_id=0):
+        super(CUDAPinnedPlace, self).__init__(device_id)
+
+
 class VarType(object):
     """Variable type tags (framework.proto:105 VarType.Type)."""
 

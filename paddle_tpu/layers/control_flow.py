@@ -89,6 +89,12 @@ def is_empty(x, cond=None):
 # -- LoDTensorArray (device repr: (buffer[capacity, ...], size) pair) -------
 
 
+class LoDTensorArray(list):
+    """Host-side tensor array (fluid.LoDTensorArray parity): a plain list
+    of arrays/LoDTensors. On device the array ops use a fixed-capacity
+    (buffer, size) pair — this class is the feed/fetch-side container."""
+
+
 def create_array(dtype):
     from paddle_tpu.core.types import VarType
 
