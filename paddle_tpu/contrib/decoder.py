@@ -276,6 +276,17 @@ class BeamSearchDecoder(object):
                 % input_names)
         word_input = input_names[0]
 
+        try:
+            return self._build(cell, B, K, word_input)
+        finally:
+            # release the cell even when the user's updater raises mid
+            # build, so a corrected decoder can reuse it
+            if cell._decoder is self:
+                cell._leave_decoder(self)
+
+    def _build(self, cell, B, K, word_input):
+        from paddle_tpu import layers
+
         # expand every state and static input to the beam lattice
         # [B, ...] -> [B*K, ...]
         def to_beam(v):
@@ -320,7 +331,15 @@ class BeamSearchDecoder(object):
                     name=self._score_param_name + ".w"),
                 bias_attr=ParamAttr(
                     name=self._score_param_name + ".b"))
-            log_probs = layers.log(layers.softmax(logits))
+            # stable log-softmax: shifted - log(sum(exp(shifted))).
+            # log-after-softmax underflows to -inf for tokens far below
+            # the max, poisoning the accumulated totals.
+            shifted = layers.elementwise_sub(
+                logits, layers.reduce_max(logits, dim=-1, keep_dim=True))
+            log_probs = layers.elementwise_sub(
+                shifted,
+                layers.log(layers.reduce_sum(
+                    layers.exp(shifted), dim=-1, keep_dim=True)))
             # accumulate: candidate total = beam total + step log-prob
             # (beam_search with is_accumulated=True expects TOTALS; the
             # op only uses pre_scores to freeze finished beams)
@@ -347,8 +366,7 @@ class BeamSearchDecoder(object):
         self._decoded = layers.beam_search_decode(
             ids=ids_t, parent_idx=parents_t, scores=scores_t,
             beam_size=K, end_id=self._end_id)
-        cell._leave_decoder(self)
-        return self._decoded
+        return self._decoded  # decode()'s finally releases the cell
 
     def __call__(self):
         if self._decoded is None:

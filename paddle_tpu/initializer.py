@@ -185,6 +185,10 @@ def force_init_on_cpu():
     return _force_init_on_cpu
 
 
+import contextlib
+
+
+@contextlib.contextmanager
 def init_on_cpu():
     """Context manager marking initializer ops force_cpu
     (initializer.py:49 parity). Under the whole-program XLA design the
@@ -192,16 +196,10 @@ def init_on_cpu():
     so the tag is advisory; the capability the reference used it for
     (initializing huge embeddings without a device-memory spike) is
     covered by GSPMD-sharded tables (docs/DISTRIBUTED_DESIGN.md)."""
-    import contextlib
-
-    @contextlib.contextmanager
-    def guard():
-        global _force_init_on_cpu
-        prev = _force_init_on_cpu
-        _force_init_on_cpu = True
-        try:
-            yield
-        finally:
-            _force_init_on_cpu = prev
-
-    return guard()
+    global _force_init_on_cpu
+    prev = _force_init_on_cpu
+    _force_init_on_cpu = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu = prev

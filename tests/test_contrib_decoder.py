@@ -146,3 +146,49 @@ def test_state_cell_validates():
             out_state="missing")
     with pytest.raises(ValueError, match="InitState"):
         StateCell(inputs={}, states={"h": 3}, out_state="h")
+
+
+def test_cell_released_when_updater_raises_mid_build():
+    """A failing user updater must not permanently lock the StateCell:
+    a corrected decoder can be built from the same cell afterwards."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src4 = fluid.layers.data(name="src", shape=[4, H],
+                                 dtype="float32", append_batch_size=False)
+        ids4 = fluid.layers.data(name="ids", shape=[4, 1], dtype="int64",
+                                 append_batch_size=False)
+        scores4 = fluid.layers.data(name="sc", shape=[4, 1],
+                                    dtype="float32",
+                                    append_batch_size=False)
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=src4)},
+                         out_state="h")
+
+        calls = {"n": 0}
+
+        @cell.state_updater
+        def updater(c):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("boom in user updater")
+            xh = fluid.layers.concat([c.get_input("x"),
+                                      c.get_state("h")], axis=1)
+            c.set_state("h", fluid.layers.fc(
+                xh, size=H, act="tanh",
+                param_attr=fluid.ParamAttr(name="cell2.w"),
+                bias_attr=fluid.ParamAttr(name="cell2.b")))
+
+        bad = BeamSearchDecoder(cell, init_ids=ids4, init_scores=scores4,
+                                target_dict_dim=V, word_dim=D, max_len=3,
+                                beam_size=2, end_id=END_ID)
+        with pytest.raises(RuntimeError, match="boom"):
+            bad.decode()
+        # the cell is free again: a corrected decoder builds fine
+        cell._set_raw_state("h", src4)  # restore the pre-lattice state
+        good = BeamSearchDecoder(cell, init_ids=ids4, init_scores=scores4,
+                                 target_dict_dim=V, word_dim=D, max_len=3,
+                                 beam_size=2, end_id=END_ID,
+                                 emb_param_name="word_emb2",
+                                 score_param_name="score2")
+        sent_ids, _ = good.decode()
+        assert sent_ids is not None
