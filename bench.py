@@ -237,6 +237,10 @@ def _bench_transformer(fluid, on_tpu, use_amp):
     vocab = 32000 if on_tpu else 500
     bs = int(os.environ.get("BENCH_BS", bs))  # batch-sweep override
     seq = int(os.environ.get("BENCH_SEQ", seq))
+    # compile-light fallback: fewer layers compile much faster through a
+    # degraded tunnel; MFU stays a valid per-model measurement since the
+    # FLOP accounting below scales with n_layer
+    n_layer = int(os.environ.get("BENCH_LAYERS", n_layer))
 
     main_prog, startup = fluid.Program(), fluid.Program()
     main_prog.random_seed = 7
