@@ -65,7 +65,7 @@ def _gru_kernel(xw_ref, wg_ref, wc_ref, b_ref, m_ref, h_out_ref, h_ref, *,
         h_ref[:, :] = jnp.zeros_like(h_ref)
 
     h = h_ref[:, :]
-    xt = xw_ref[:, 0, :].astype(jnp.float32)
+    xt = xw_ref[0, :, :].astype(jnp.float32)
     b = b_ref[0, :].astype(jnp.float32)
     g = xt[:, :2 * d] + jax.lax.dot_general(
         h, wg_ref[:, :].astype(jnp.float32),
@@ -78,10 +78,10 @@ def _gru_kernel(xw_ref, wg_ref, wc_ref, b_ref, m_ref, h_out_ref, h_ref, *,
         (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
     ) + b[2 * d:])
     h_new = u * h + (1.0 - u) * c
-    m = m_ref[:, 0:1].astype(jnp.float32)
+    m = m_ref[0, :, :].astype(jnp.float32)
     h_new = h_new * m + h * (1.0 - m)
     h_ref[:, :] = h_new
-    h_out_ref[:, 0, :] = h_new.astype(h_out_ref.dtype)
+    h_out_ref[0, :, :] = h_new.astype(h_out_ref.dtype)
 
 
 def _gru_pallas_forward(xw, w_gate, w_cand, bias, mask, gate_act, cand_act,
@@ -91,14 +91,19 @@ def _gru_pallas_forward(xw, w_gate, w_cand, bias, mask, gate_act, cand_act,
 
     b, t, d3 = xw.shape
     d = w_cand.shape[0]
-    block_b = min(block_b, b)
+    # Same Mosaic tiling rule as lstm_cell: time on the leading axis,
+    # batch block a multiple of 8 (see _lstm_pallas_forward).
+    block_b = -(-min(block_b, b) // 8) * 8
     bp = -(-b // block_b) * block_b
+    xs = jnp.moveaxis(xw, 1, 0)  # [T, B, 3D]
     if bp != b:
-        xw = jnp.pad(xw, ((0, bp - b), (0, 0), (0, 0)))
+        xs = jnp.pad(xs, ((0, 0), (0, bp - b), (0, 0)))
     if mask is None:
-        m_arr = jnp.ones((bp, t), jnp.float32)
+        m_arr = jnp.ones((t, bp, 1), jnp.float32)
     else:
-        m_arr = jnp.pad(mask.astype(jnp.float32), ((0, bp - b), (0, 0)))
+        m_arr = jnp.pad(
+            jnp.moveaxis(mask.astype(jnp.float32), 1, 0)[:, :, None],
+            ((0, 0), (0, bp - b), (0, 0)))
 
     kernel = functools.partial(
         _gru_kernel, d=d, gate_act=gate_act, cand_act=cand_act)
@@ -106,18 +111,18 @@ def _gru_pallas_forward(xw, w_gate, w_cand, bias, mask, gate_act, cand_act,
         kernel,
         grid=(bp // block_b, t),
         in_specs=[
-            pl.BlockSpec((block_b, 1, d3), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((1, block_b, d3), lambda i, t: (t, i, 0)),
             pl.BlockSpec((d, 2 * d), lambda i, t: (0, 0)),
             pl.BlockSpec((d, d), lambda i, t: (0, 0)),
             pl.BlockSpec((1, d3), lambda i, t: (0, 0)),
-            pl.BlockSpec((block_b, 1), lambda i, t: (i, t)),
+            pl.BlockSpec((1, block_b, 1), lambda i, t: (t, i, 0)),
         ],
-        out_specs=pl.BlockSpec((block_b, 1, d), lambda i, t: (i, t, 0)),
-        out_shape=jax.ShapeDtypeStruct((bp, t, d), xw.dtype),
+        out_specs=pl.BlockSpec((1, block_b, d), lambda i, t: (t, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((t, bp, d), xw.dtype),
         scratch_shapes=[pltpu.VMEM((block_b, d), jnp.float32)],
         interpret=interpret,
-    )(xw, w_gate, w_cand, jnp.reshape(bias, (1, d3)), m_arr)
-    return hidden[:b]
+    )(xs, w_gate, w_cand, jnp.reshape(bias, (1, d3)), m_arr)
+    return jnp.moveaxis(hidden, 0, 1)[:b]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))

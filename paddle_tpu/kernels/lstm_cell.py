@@ -98,7 +98,7 @@ def _lstm_kernel(xw_ref, wh_ref, b_ref, peep_ref, m_ref, h_out_ref,
 
     h = h_ref[:, :]
     c = c_ref[:, :]
-    xt = xw_ref[:, 0, :].astype(jnp.float32)
+    xt = xw_ref[0, :, :].astype(jnp.float32)
     gates = xt + jax.lax.dot_general(
         h, wh_ref[:, :].astype(jnp.float32),
         (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -117,13 +117,13 @@ def _lstm_kernel(xw_ref, wh_ref, b_ref, peep_ref, m_ref, h_out_ref,
         go = go + c_new * peep_ref[2, :]
     o_v = ga(go)
     h_new = o_v * ca(c_new)
-    m = m_ref[:, 0:1].astype(jnp.float32)
+    m = m_ref[0, :, :].astype(jnp.float32)
     h_new = h_new * m + h * (1.0 - m)
     c_new = c_new * m + c * (1.0 - m)
     h_ref[:, :] = h_new
     c_ref[:, :] = c_new
-    h_out_ref[:, 0, :] = h_new.astype(h_out_ref.dtype)
-    c_out_ref[:, 0, :] = c_new.astype(c_out_ref.dtype)
+    h_out_ref[0, :, :] = h_new.astype(h_out_ref.dtype)
+    c_out_ref[0, :, :] = c_new.astype(c_out_ref.dtype)
 
 
 def _lstm_pallas_forward(xw, w_h, bias, peep_arr, has_peep, mask, gate_act,
@@ -133,14 +133,21 @@ def _lstm_pallas_forward(xw, w_h, bias, peep_arr, has_peep, mask, gate_act,
 
     b, t, d4 = xw.shape
     d = w_h.shape[0]
-    block_b = min(block_b, b)
+    # Mosaic tiling rule: the last two dims of every block must be
+    # divisible by (8, 128) or equal the array dims. Time therefore goes
+    # on the LEADING axis (block size 1 there is unconstrained) and the
+    # batch block is padded to a multiple of 8.
+    block_b = -(-min(block_b, b) // 8) * 8
     bp = -(-b // block_b) * block_b  # pad batch to the block multiple
+    xs = jnp.moveaxis(xw, 1, 0)  # [T, B, 4D]
     if bp != b:
-        xw = jnp.pad(xw, ((0, bp - b), (0, 0), (0, 0)))
+        xs = jnp.pad(xs, ((0, 0), (0, bp - b), (0, 0)))
     if mask is None:
-        m_arr = jnp.ones((bp, t), jnp.float32)
+        m_arr = jnp.ones((t, bp, 1), jnp.float32)
     else:
-        m_arr = jnp.pad(mask.astype(jnp.float32), ((0, bp - b), (0, 0)))
+        m_arr = jnp.pad(
+            jnp.moveaxis(mask.astype(jnp.float32), 1, 0)[:, :, None],
+            ((0, 0), (0, bp - b), (0, 0)))
 
     kernel = functools.partial(
         _lstm_kernel, d=d, gate_act=gate_act, cell_act=cell_act,
@@ -150,27 +157,28 @@ def _lstm_pallas_forward(xw, w_h, bias, peep_arr, has_peep, mask, gate_act,
         kernel,
         grid=(bp // block_b, t),
         in_specs=[
-            pl.BlockSpec((block_b, 1, d4), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((1, block_b, d4), lambda i, t: (t, i, 0)),
             pl.BlockSpec((d, d4), lambda i, t: (0, 0)),
             pl.BlockSpec((1, d4), lambda i, t: (0, 0)),
             pl.BlockSpec((3, d), lambda i, t: (0, 0)),
-            pl.BlockSpec((block_b, 1), lambda i, t: (i, t)),
+            pl.BlockSpec((1, block_b, 1), lambda i, t: (t, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((block_b, 1, d), lambda i, t: (i, t, 0)),
-            pl.BlockSpec((block_b, 1, d), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((1, block_b, d), lambda i, t: (t, i, 0)),
+            pl.BlockSpec((1, block_b, d), lambda i, t: (t, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bp, t, d), xw.dtype),
-            jax.ShapeDtypeStruct((bp, t, d), xw.dtype),
+            jax.ShapeDtypeStruct((t, bp, d), xw.dtype),
+            jax.ShapeDtypeStruct((t, bp, d), xw.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_b, d), jnp.float32),
             pltpu.VMEM((block_b, d), jnp.float32),
         ],
         interpret=interpret,
-    )(xw, w_h, jnp.reshape(bias, (1, d4)), peep_arr, m_arr)
-    return hidden[:b], cell[:b]
+    )(xs, w_h, jnp.reshape(bias, (1, d4)), peep_arr, m_arr)
+    return (jnp.moveaxis(hidden, 0, 1)[:b],
+            jnp.moveaxis(cell, 0, 1)[:b])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))

@@ -1,0 +1,490 @@
+"""Breadth sweep part 1: op families that previously had no dedicated test.
+
+Reference model: the per-op test files under
+python/paddle/fluid/tests/unittests/ (op_test.py:131 OpTest, :400
+check_grad) — one output-parity check against an independent numpy
+mirror plus an analytic-vs-numeric gradient check per differentiable op.
+Inputs are placed away from kinks (clip bounds, shrink thresholds,
+argmax ties) so the finite-difference window never straddles a
+non-smooth point; the numpy mirrors are written from the reference op
+semantics (activation_op.cc, elementwise_op.h, reduce_op.h, ...), not
+from this repo's lowerings.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+from op_test import OpTest
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = dict(attrs or {})
+    return t
+
+
+def _shapes(op_type, inputs, out_shapes, attrs=None):
+    """Grad-only variant: outputs need correct shapes, not values."""
+    return _t(op_type, inputs,
+              {k: np.zeros(v, "float32") for k, v in out_shapes.items()},
+              attrs)
+
+
+_RNG = np.random.RandomState
+
+
+def _away_from(rng, shape, kinks, margin=0.08, lo=-3.0, hi=3.0):
+    """Uniform sample resampled until every element is > margin from
+    every kink (finite differences use delta=5e-3, so 0.08 is safe)."""
+    x = rng.uniform(lo, hi, shape)
+    for _ in range(100):
+        bad = np.zeros(x.shape, bool)
+        for k in kinks:
+            bad |= np.abs(x - k) < margin
+        if not bad.any():
+            break
+        x[bad] = rng.uniform(lo, hi, int(bad.sum()))
+    return x.astype("float32")
+
+
+# --- activations ---------------------------------------------------------
+def _np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_softplus(x):
+    return np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0.0)
+
+
+_ACTIVATIONS = {
+    # name: (attrs, kinks, domain, numpy mirror)
+    "logsigmoid": ({}, [], (-3, 3),
+                   lambda x, a: np.minimum(x, 0) - np.log1p(np.exp(-np.abs(x)))),
+    "tanh_shrink": ({}, [], (-3, 3), lambda x, a: x - np.tanh(x)),
+    "sin": ({}, [], (-3, 3), lambda x, a: np.sin(x)),
+    "reciprocal": ({}, [], (0.4, 3), lambda x, a: 1.0 / x),
+    "softplus": ({}, [], (-3, 3), lambda x, a: _np_softplus(x)),
+    "gelu": ({}, [], (-3, 3),
+             lambda x, a: 0.5 * x * (1.0 + np.tanh(
+                 np.sqrt(2.0 / np.pi) * (x + 0.044715 * x ** 3)))),
+    "relu6": ({"threshold": 6.0}, [0.0, 6.0], (-3, 8),
+              lambda x, a: np.clip(x, 0.0, 6.0)),
+    "leaky_relu": ({"alpha": 0.1}, [0.0], (-3, 3),
+                   lambda x, a: np.where(x >= 0, x, 0.1 * x)),
+    "elu": ({"alpha": 1.0}, [0.0], (-3, 3),
+            lambda x, a: np.where(x > 0, x, np.expm1(np.minimum(x, 0.0)))),
+    "stanh": ({"scale_a": 2.0 / 3.0, "scale_b": 1.7159}, [], (-3, 3),
+              lambda x, a: 1.7159 * np.tanh(x * 2.0 / 3.0)),
+    "hard_sigmoid": ({"slope": 0.2, "offset": 0.5}, [-2.5, 2.5], (-4, 4),
+                     lambda x, a: np.clip(0.2 * x + 0.5, 0.0, 1.0)),
+    "thresholded_relu": ({"threshold": 1.0}, [1.0], (-3, 3),
+                         lambda x, a: np.where(x > 1.0, x, 0.0)),
+    "soft_relu": ({"threshold": 40.0}, [], (-3, 3),
+                  lambda x, a: np.log1p(np.exp(np.clip(x, -40.0, 40.0)))),
+    "brelu": ({"t_min": 0.0, "t_max": 24.0}, [0.0], (-3, 3),
+              lambda x, a: np.clip(x, 0.0, 24.0)),
+    "swish": ({"beta": 1.0}, [], (-3, 3), lambda x, a: x * _np_sigmoid(x)),
+    "softshrink": ({"lambda": 0.5}, [-0.5, 0.5], (-3, 3),
+                   lambda x, a: np.sign(x) * np.maximum(np.abs(x) - 0.5, 0)),
+    "hard_shrink": ({"threshold": 0.5}, [-0.5, 0.5], (-3, 3),
+                    lambda x, a: np.where(np.abs(x) > 0.5, x, 0.0)),
+    "rsqrt": ({}, [], (0.4, 3), lambda x, a: 1.0 / np.sqrt(x)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_ACTIVATIONS), ids=sorted(_ACTIVATIONS))
+def test_activation_output_and_grad(name):
+    attrs, kinks, (lo, hi), mirror = _ACTIVATIONS[name]
+    x = _away_from(_RNG(11), (3, 7), kinks, lo=lo, hi=hi)
+    t = _t(name, {"X": x}, {"Out": mirror(x.astype("float64"), attrs)}, attrs)
+    t.check_output(atol=1e-5, rtol=1e-4)
+    t2 = _t(name, {"X": x}, {"Out": mirror(x.astype("float64"), attrs)}, attrs)
+    t2.check_grad(["X"], "Out")
+
+
+def test_log_softmax_output_and_grad():
+    x = _RNG(12).randn(4, 6).astype("float32")
+    x64 = x.astype("float64")
+    expect = x64 - np.log(np.sum(np.exp(x64 - x64.max(-1, keepdims=True)),
+                                 -1, keepdims=True)) - x64.max(-1, keepdims=True)
+    t = _t("log_softmax", {"X": x}, {"Out": expect}, {"axis": -1})
+    t.check_output()
+    _t("log_softmax", {"X": x}, {"Out": expect},
+       {"axis": -1}).check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+# --- elementwise ---------------------------------------------------------
+def test_elementwise_div_output_and_grad():
+    rng = _RNG(13)
+    x = rng.uniform(-2, 2, (3, 5)).astype("float32")
+    y = _away_from(rng, (3, 5), [0.0], margin=0.5)
+    t = _t("elementwise_div", {"X": x, "Y": y},
+           {"Out": x.astype("float64") / y.astype("float64")})
+    t.check_output()
+    _shapes("elementwise_div", {"X": x, "Y": y},
+            {"Out": (3, 5)}).check_grad(["X", "Y"], "Out")
+
+
+@pytest.mark.parametrize("op,npf", [
+    ("elementwise_max", np.maximum), ("elementwise_min", np.minimum),
+], ids=["max", "min"])
+def test_elementwise_minmax_output_and_grad(op, npf):
+    rng = _RNG(14)
+    x = rng.uniform(-2, 2, (3, 5)).astype("float32")
+    # keep |x - y| > 0.2: the selection never flips inside the fd window
+    y = x + np.where(rng.rand(3, 5) > 0.5, 1.0, -1.0).astype("float32") * \
+        rng.uniform(0.2, 1.5, (3, 5)).astype("float32")
+    t = _t(op, {"X": x, "Y": y}, {"Out": npf(x, y).astype("float64")})
+    t.check_output()
+    _shapes(op, {"X": x, "Y": y}, {"Out": (3, 5)}).check_grad(
+        ["X", "Y"], "Out")
+
+
+def test_elementwise_pow_output_and_grad():
+    rng = _RNG(15)
+    x = rng.uniform(0.3, 2.5, (3, 4)).astype("float32")
+    y = rng.uniform(-2, 2, (3, 4)).astype("float32")
+    t = _t("elementwise_pow", {"X": x, "Y": y},
+           {"Out": np.power(x.astype("float64"), y.astype("float64"))})
+    t.check_output()
+    _shapes("elementwise_pow", {"X": x, "Y": y},
+            {"Out": (3, 4)}).check_grad(["X", "Y"], "Out",
+                                        max_relative_error=1e-2)
+
+
+@pytest.mark.parametrize("op,npf", [
+    ("elementwise_floordiv", lambda x, y: x // y),
+    ("elementwise_mod", lambda x, y: x % y),
+], ids=["floordiv", "mod"])
+def test_elementwise_int_ops_output(op, npf):
+    rng = _RNG(16)
+    x = rng.randint(1, 50, (3, 5)).astype("int32")
+    y = rng.randint(1, 7, (3, 5)).astype("int32")
+    _t(op, {"X": x, "Y": y}, {"Out": npf(x, y)}).check_output()
+
+
+def test_minus_output_and_grad():
+    rng = _RNG(17)
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    t = _t("minus", {"X": x, "Y": y}, {"Out": (x - y).astype("float64")})
+    t.check_output()
+    _shapes("minus", {"X": x, "Y": y}, {"Out": (3, 4)}).check_grad(
+        ["X", "Y"], "Out")
+
+
+# --- reductions ----------------------------------------------------------
+@pytest.mark.parametrize("op,npf", [
+    ("reduce_max", np.max), ("reduce_min", np.min), ("reduce_prod", np.prod),
+], ids=["max", "min", "prod"])
+def test_reduce_output_and_grad(op, npf):
+    rng = _RNG(18)
+    # distinct, well-separated magnitudes: unique argmax/argmin per row,
+    # and products stay O(1)
+    x = (rng.permutation(24).reshape(4, 6) * 0.11 + 0.2).astype("float32")
+    expect = npf(x.astype("float64"), axis=1)
+    t = _t(op, {"X": x}, {"Out": expect}, {"dim": [1], "keep_dim": False})
+    t.check_output()
+    _shapes(op, {"X": x}, {"Out": (4,)},
+            {"dim": [1], "keep_dim": False}).check_grad(
+        ["X"], "Out", max_relative_error=1e-2)
+
+
+# --- shape / movement ----------------------------------------------------
+def test_reshape2_output_and_grad():
+    x = _RNG(19).randn(3, 4).astype("float32")
+    t = _t("reshape2", {"X": x}, {"Out": x.reshape(2, 6)}, {"shape": [2, 6]})
+    t.check_output()
+    _shapes("reshape2", {"X": x}, {"Out": (2, 6)},
+            {"shape": [2, 6]}).check_grad(["X"], "Out")
+
+
+@pytest.mark.parametrize("op", ["squeeze", "squeeze2"])
+def test_squeeze_output_and_grad(op):
+    x = _RNG(20).randn(3, 1, 4).astype("float32")
+    t = _t(op, {"X": x}, {"Out": x.reshape(3, 4)}, {"axes": [1]})
+    t.check_output()
+    _shapes(op, {"X": x}, {"Out": (3, 4)}, {"axes": [1]}).check_grad(
+        ["X"], "Out")
+
+
+def test_transpose2_output_and_grad():
+    x = _RNG(21).randn(2, 3, 4).astype("float32")
+    t = _t("transpose2", {"X": x}, {"Out": x.transpose(1, 0, 2)},
+           {"axis": [1, 0, 2]})
+    t.check_output()
+    _shapes("transpose2", {"X": x}, {"Out": (3, 2, 4)},
+            {"axis": [1, 0, 2]}).check_grad(["X"], "Out")
+
+
+def test_unstack_output_and_grad():
+    x = _RNG(22).randn(3, 4).astype("float32")
+    outs = [("y0", x[0]), ("y1", x[1]), ("y2", x[2])]
+    t = _t("unstack", {"X": x}, {"Y": outs}, {"axis": 0, "num": 3})
+    t.check_output()
+    t2 = _t("unstack", {"X": x}, {"Y": outs}, {"axis": 0, "num": 3})
+    t2.check_grad(["X"], "y1")
+
+
+def test_scatter_output_and_grad():
+    rng = _RNG(23)
+    x = rng.randn(5, 3).astype("float32")
+    ids = np.asarray([1, 3], "int32")
+    upd = rng.randn(2, 3).astype("float32")
+    expect = x.copy()
+    expect[ids] = upd
+    t = _t("scatter", {"X": x, "Ids": ids, "Updates": upd}, {"Out": expect},
+           {"overwrite": True})
+    t.check_output()
+    _shapes("scatter", {"X": x, "Ids": ids, "Updates": upd},
+            {"Out": (5, 3)}, {"overwrite": True}).check_grad(
+        ["X", "Updates"], "Out")
+
+
+def test_batched_gather_output_and_grad():
+    rng = _RNG(24)
+    x = rng.randn(2, 5, 3).astype("float32")
+    idx = np.asarray([[0, 4, 2], [1, 1, 3]], "int32")
+    expect = np.stack([x[b][idx[b]] for b in range(2)])
+    t = _t("batched_gather", {"X": x, "Index": idx}, {"Out": expect})
+    t.check_output()
+    _shapes("batched_gather", {"X": x, "Index": idx},
+            {"Out": (2, 3, 3)}).check_grad(["X"], "Out")
+
+
+def test_where_select_output_and_grad():
+    # Cond is a per-ROW selector [batch, 1]: the dense merge behind IfElse
+    rng = _RNG(25)
+    cond = (rng.rand(3, 1) > 0.5)
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    t = _t("where_select", {"Cond": cond, "X": x, "Y": y},
+           {"Out": np.where(cond, x, y)})
+    t.check_output()
+    _shapes("where_select", {"Cond": cond, "X": x, "Y": y},
+            {"Out": (3, 4)}).check_grad(["X", "Y"], "Out")
+
+
+def test_pad2d_output_and_grad():
+    x = _RNG(26).randn(2, 3, 4, 5).astype("float32")
+    pads = [1, 2, 0, 1]  # top, bottom, left, right
+    expect = np.pad(x, ((0, 0), (0, 0), (1, 2), (0, 1)), constant_values=0.5)
+    t = _t("pad2d", {"X": x}, {"Out": expect},
+           {"paddings": pads, "mode": "constant", "pad_value": 0.5})
+    t.check_output()
+    _shapes("pad2d", {"X": x}, {"Out": (2, 3, 7, 6)},
+            {"paddings": pads, "mode": "constant",
+             "pad_value": 0.5}).check_grad(["X"], "Out")
+
+
+@pytest.mark.parametrize("mode", ["reflect", "edge"])
+def test_pad2d_modes_output(mode):
+    x = _RNG(27).randn(1, 2, 4, 4).astype("float32")
+    np_mode = {"reflect": "reflect", "edge": "edge"}[mode]
+    expect = np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 1)), mode=np_mode)
+    _t("pad2d", {"X": x}, {"Out": expect},
+       {"paddings": [1, 1, 2, 1], "mode": mode}).check_output()
+
+
+def test_label_smooth_output_and_grad():
+    x = np.eye(4, 6, dtype="float32")
+    eps = 0.1
+    expect = (1 - eps) * x + eps / 6.0
+    t = _t("label_smooth", {"X": x}, {"Out": expect}, {"epsilon": eps})
+    t.check_output()
+    _shapes("label_smooth", {"X": x}, {"Out": (4, 6)},
+            {"epsilon": eps}).check_grad(["X"], "Out")
+
+
+def test_add_position_encoding_grad():
+    x = _RNG(28).randn(2, 5, 8).astype("float32")
+    _shapes("add_position_encoding", {"X": x}, {"Out": (2, 5, 8)},
+            {"alpha": 1.0, "beta": 1.0}).check_grad(["X"], "Out")
+
+
+def test_fill_zeros_like_output():
+    x = _RNG(29).randn(3, 4).astype("float32")
+    _t("fill_zeros_like", {"X": x}, {"Out": np.zeros_like(x)}).check_output()
+
+
+def test_fill_constant_batch_size_like_output():
+    x = np.zeros((5, 3), "float32")
+    expect = np.full((5, 7), 2.5, "float32")
+    _t("fill_constant_batch_size_like", {"Input": x}, {"Out": expect},
+       {"shape": [1, 7], "value": 2.5, "dtype": "float32",
+        "input_dim_idx": 0, "output_dim_idx": 0}).check_output()
+
+
+def test_assign_value_output():
+    vals = [0.5, -1.5, 2.0, 3.25, 0.0, -7.0]
+    expect = np.asarray(vals, "float32").reshape(2, 3)
+    _t("assign_value", {}, {"Out": expect},
+       {"shape": [2, 3], "dtype": "float32", "values": vals}).check_output()
+
+
+def test_arg_min_output():
+    x = np.asarray([[3.0, 1.0, 2.0], [0.5, 4.0, -1.0]], "float32")
+    _t("arg_min", {"X": x}, {"Out": np.argmin(x, 1)},
+       {"axis": 1}).check_output()
+
+
+# --- losses --------------------------------------------------------------
+def test_bce_loss_output_and_grad():
+    rng = _RNG(30)
+    x = rng.uniform(0.05, 0.95, (4, 3)).astype("float32")
+    label = (rng.rand(4, 3) > 0.5).astype("float32")
+    x64, l64 = x.astype("float64"), label.astype("float64")
+    expect = -(l64 * np.log(x64) + (1 - l64) * np.log(1 - x64))
+    t = _t("bce_loss", {"X": x, "Label": label}, {"Out": expect})
+    t.check_output()
+    _shapes("bce_loss", {"X": x, "Label": label},
+            {"Out": (4, 3)}).check_grad(["X"], "Out")
+
+
+def test_log_loss_output_and_grad():
+    rng = _RNG(31)
+    p = rng.uniform(0.1, 0.9, (6, 1)).astype("float32")
+    label = (rng.rand(6, 1) > 0.5).astype("float32")
+    eps = 1e-4
+    p64, l64 = p.astype("float64"), label.astype("float64")
+    expect = -l64 * np.log(p64 + eps) - (1 - l64) * np.log(1 - p64 + eps)
+    t = _t("log_loss", {"Predicted": p, "Labels": label}, {"Loss": expect},
+           {"epsilon": eps})
+    t.check_output()
+    _shapes("log_loss", {"Predicted": p, "Labels": label},
+            {"Loss": (6, 1)}, {"epsilon": eps}).check_grad(
+        ["Predicted"], "Loss")
+
+
+def test_kldiv_loss_grad():
+    rng = _RNG(32)
+    x = np.log(rng.dirichlet(np.ones(5), 4)).astype("float32")
+    target = rng.dirichlet(np.ones(5), 4).astype("float32")
+    _shapes("kldiv_loss", {"X": x, "Target": target}, {"Loss": ()},
+            {"reduction": "mean"}).check_grad(["X"], "Loss")
+
+
+def test_smooth_l1_loss_grad():
+    rng = _RNG(33)
+    x = rng.randn(4, 3).astype("float32")
+    # |x - y| kept away from the quadratic/linear switch at 1/sigma^2 = 1
+    d = np.where(rng.rand(4, 3) > 0.5,
+                 rng.uniform(0.2, 0.8, (4, 3)),
+                 rng.uniform(1.2, 1.8, (4, 3))).astype("float32")
+    y = (x + d * np.where(rng.rand(4, 3) > 0.5, 1, -1)).astype("float32")
+    iw = np.ones((4, 3), "float32")
+    t = _shapes("smooth_l1_loss",
+                {"X": x, "Y": y, "InsideWeight": iw, "OutsideWeight": iw},
+                {"Out": (4, 1)}, {"sigma": 1.0})
+    t.check_grad(["X", "Y"], "Out")
+
+
+def test_sigmoid_cross_entropy_with_logits_output_and_grad():
+    rng = _RNG(34)
+    x = rng.randn(4, 5).astype("float32")
+    label = rng.uniform(0, 1, (4, 5)).astype("float32")
+    x64, l64 = x.astype("float64"), label.astype("float64")
+    expect = np.maximum(x64, 0) - x64 * l64 + np.log1p(np.exp(-np.abs(x64)))
+    t = _t("sigmoid_cross_entropy_with_logits", {"X": x, "Label": label},
+           {"Out": expect}, {"ignore_index": -100})
+    t.check_output()
+    _shapes("sigmoid_cross_entropy_with_logits", {"X": x, "Label": label},
+            {"Out": (4, 5)}, {"ignore_index": -100}).check_grad(["X"], "Out")
+
+
+def test_squared_l2_norm_output_and_grad():
+    x = _RNG(35).randn(3, 4).astype("float32")
+    t = _t("squared_l2_norm", {"X": x},
+           {"Out": np.sum(x.astype("float64") ** 2)})
+    t.check_output()
+    _shapes("squared_l2_norm", {"X": x}, {"Out": ()}).check_grad(
+        ["X"], "Out")
+
+
+def test_l1_norm_output_and_grad():
+    x = _away_from(_RNG(36), (3, 4), [0.0], margin=0.2)
+    t = _t("l1_norm", {"X": x}, {"Out": np.sum(np.abs(x))})
+    t.check_output()
+    _shapes("l1_norm", {"X": x}, {"Out": ()}).check_grad(["X"], "Out")
+
+
+def test_l2_normalize_rows_unit_norm_and_grad():
+    x = _RNG(37).randn(4, 6).astype("float32") + 0.5
+    t = _shapes("l2_normalize", {"X": x}, {"Out": (4, 6)},
+                {"axis": -1, "epsilon": 1e-10})
+    main = t._build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(main, feed=t._feed, fetch_list=["Out"])
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1), np.ones(4), rtol=1e-5)
+    _shapes("l2_normalize", {"X": x}, {"Out": (4, 6)},
+            {"axis": -1, "epsilon": 1e-10}).check_grad(
+        ["X"], "Out", max_relative_error=1e-2)
+
+
+# --- norms ---------------------------------------------------------------
+def test_group_norm_grad():
+    rng = _RNG(38)
+    x = rng.randn(2, 4, 3, 3).astype("float32")
+    scale = (1.0 + 0.1 * rng.randn(4)).astype("float32")
+    bias = (0.1 * rng.randn(4)).astype("float32")
+    t = _shapes("group_norm", {"X": x, "Scale": scale, "Bias": bias},
+                {"Y": (2, 4, 3, 3)}, {"groups": 2, "epsilon": 1e-5})
+    t.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=1e-2)
+
+
+def test_lrn_grad():
+    x = _RNG(39).randn(2, 7, 3, 3).astype("float32")
+    t = _shapes("lrn", {"X": x}, {"Out": (2, 7, 3, 3)},
+                {"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75})
+    t.check_grad(["X"], "Out")
+
+
+# --- comparisons / logicals ---------------------------------------------
+@pytest.mark.parametrize("op,npf", [
+    ("greater_than", np.greater), ("greater_equal", np.greater_equal),
+    ("less_equal", np.less_equal), ("not_equal", np.not_equal),
+], ids=["gt", "ge", "le", "ne"])
+def test_compare_output(op, npf):
+    rng = _RNG(40)
+    x = rng.randint(0, 4, (3, 5)).astype("float32")
+    y = rng.randint(0, 4, (3, 5)).astype("float32")
+    _t(op, {"X": x, "Y": y}, {"Out": npf(x, y)}).check_output()
+
+
+@pytest.mark.parametrize("op,npf", [
+    ("logical_and", np.logical_and), ("logical_or", np.logical_or),
+    ("logical_xor", np.logical_xor),
+], ids=["and", "or", "xor"])
+def test_logical_binary_output(op, npf):
+    rng = _RNG(41)
+    x = rng.rand(3, 4) > 0.5
+    y = rng.rand(3, 4) > 0.5
+    _t(op, {"X": x, "Y": y}, {"Out": npf(x, y)}).check_output()
+
+
+def test_logical_not_output():
+    x = _RNG(42).rand(3, 4) > 0.5
+    _t("logical_not", {"X": x}, {"Out": np.logical_not(x)}).check_output()
+
+
+def test_isinf_output():
+    x = np.asarray([[1.0, np.inf], [-np.inf, 0.0]], "float32")
+    _t("isinf", {"X": x}, {"Out": np.asarray(True)}).check_output()
+
+
+def test_is_empty_output():
+    x = np.ones((2, 3), "float32")
+    _t("is_empty", {"X": x}, {"Out": np.asarray(False)}).check_output()
+
+
+def test_one_hot_output():
+    x = np.asarray([[0], [3], [1]], "int64")
+    expect = np.zeros((3, 5), "float32")
+    expect[np.arange(3), x.ravel()] = 1.0
+    _t("one_hot", {"X": x}, {"Out": expect}, {"depth": 5}).check_output()
