@@ -432,7 +432,12 @@ def main():
 
     errors = {}
     probe_timeout = _int_env("BENCH_PROBE_TIMEOUT", 90)
-    worker_timeout = _int_env("BENCH_WORKER_TIMEOUT", 1500)
+    # 2700s default: the round-3 first window lost its Transformer capture
+    # to a 1500s ceiling while the compile crawled through a degraded
+    # tunnel — and the SIGKILL wedged the tunnel for the rest of the round.
+    # A healthy worker finishes in ~5 min; the headroom only matters when
+    # the tunnel is slow, exactly when killing it costs the window.
+    worker_timeout = _int_env("BENCH_WORKER_TIMEOUT", 2700)
 
     forced_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
     tpu_kind = None if forced_cpu else _probe_tpu(probe_timeout)

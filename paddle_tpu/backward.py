@@ -12,6 +12,7 @@ forward, so the emitted step program matches a hand-written backward.
 
 from paddle_tpu import framework
 from paddle_tpu.core import op_registry
+from paddle_tpu.core.types import VarType
 from paddle_tpu.framework import OpRole, Parameter, Variable, grad_var_name
 
 
@@ -124,12 +125,41 @@ def _append_grad_ops_for(block, op, acc, no_grad):
         return
 
     if callable(opdef.grad):
-        specs = opdef.grad(
-            op,
-            {s: [g for g in gs] for s, gs in out_grads.items()},
-            wanted,
-        )
-        new_ops = []
+        # In partially-used output slots, replace missing (None) grads
+        # with fill_zeros_like over the forward output BEFORE the maker
+        # runs, so no hand-written maker can drop a piece from its
+        # concat/stack (the reference backward inserts fill_zeros_like
+        # for exactly this case). Slots with no grads at all stay None —
+        # makers skip those wholesale.
+        zero_ops = []
+        filled = {}
+        for slot, gs in out_grads.items():
+            if not any(g is not None for g in gs):
+                filled[slot] = list(gs)
+                continue
+            names = []
+            for name, g in zip(op.output(slot), gs):
+                if g is None and name:
+                    # only dense tensors can be zero-filled; tensor-array
+                    # carries (e.g. While outputs) stay None — their
+                    # makers map None to "" and the vjp lowering emits
+                    # per-leaf zero cotangents for composite refs
+                    fwd = block._find_var_recursive(name)
+                    if fwd is not None and getattr(
+                            fwd, "type", None) == VarType.LOD_TENSOR_ARRAY:
+                        names.append(g)
+                        continue
+                    g = name + "@GRAD@zero"
+                    zero_ops.append((
+                        "fill_zeros_like",
+                        {"X": [name]},
+                        {"Out": [g]},
+                        {framework.OP_ROLE_ATTR_NAME: OpRole.Backward},
+                    ))
+                names.append(g)
+            filled[slot] = names
+        specs = opdef.grad(op, filled, wanted)
+        new_ops = list(zero_ops)
         for spec in specs:
             attrs = dict(spec.get("attrs", {}))
             attrs[framework.OP_ROLE_ATTR_NAME] = OpRole.Backward

@@ -146,6 +146,7 @@ def _make_rng(step_key, attrs):
 
 
 _AMBIENT_MESH = []  # trace-time stack: the mesh a sharded compile runs under
+_AMBIENT_PLATFORM = []  # trace-time stack: platform the compile targets
 
 
 def ambient_mesh():
@@ -156,8 +157,32 @@ def ambient_mesh():
     return _AMBIENT_MESH[-1] if _AMBIENT_MESH else None
 
 
+def ambient_platform():
+    """The platform ('cpu', 'tpu', ...) of the device the compile being
+    traced is pinned to, or None when unpinned. Pallas kernel entry
+    points use this to pick interpret mode: with several backends loaded
+    (the tunnel TPU plugin + CPU), ``jax.default_backend()`` names the
+    highest-priority platform, NOT the Place this executable targets."""
+    return _AMBIENT_PLATFORM[-1] if _AMBIENT_PLATFORM else None
+
+
+def target_platform():
+    """Platform the enclosing compile targets: the executor's pinned
+    Place when lowering a program, else the process default backend."""
+    plat = ambient_platform()
+    if plat is not None:
+        return plat
+    return jax.default_backend()
+
+
+def is_tpu_target():
+    """True when the enclosing compile targets a non-CPU backend —
+    the signal Pallas kernel entry points key interpret mode on."""
+    return target_platform() not in ("cpu",)
+
+
 def build_step_fn(program, feed_names, fetch_names, state_in, state_out,
-                  is_test=False, mesh=None):
+                  is_test=False, mesh=None, platform=None):
     """Build the pure step function: (state, feeds, key) -> (new_state, fetches)."""
     lowerer = BlockLowerer(program, 0, is_test=is_test)
 
@@ -166,10 +191,12 @@ def build_step_fn(program, feed_names, fetch_names, state_in, state_out,
         env.update(state)
         env.update(feeds)
         _AMBIENT_MESH.append(mesh)
+        _AMBIENT_PLATFORM.append(platform)
         try:
             lowerer.lower_into(env, key)
         finally:
             _AMBIENT_MESH.pop()
+            _AMBIENT_PLATFORM.pop()
         new_state = {}
         for n in state_out:
             if n in env:
@@ -219,6 +246,7 @@ class CompiledProgram(object):
             self.state_out,
             is_test=is_test,
             mesh=shardings.mesh if shardings is not None else None,
+            platform=getattr(device, "platform", None),
         )
         # Donate ONLY state the program replaces (optimizer updates, BN
         # stats). Donating untouched state (e.g. params in an inference
@@ -304,6 +332,7 @@ class MultiStepProgram(object):
         step = build_step_fn(
             program, list(feed_specs), self.fetch_names,
             self.state_in, self.state_out, is_test=is_test,
+            platform=getattr(device, "platform", None),
         )
         self.mutable_state = sorted(
             set(self.state_in) & set(self.state_out))

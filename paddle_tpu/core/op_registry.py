@@ -190,7 +190,10 @@ def lower_grad_via_vjp(fwd_def, ctx, ins, attrs, out_grads, wanted_input_grads):
 
     def _is_inexact_array(a):
         # Composite values (tensor arrays = (buffer, size) tuples) are not
-        # differentiable leaves themselves.
+        # differentiable leaves themselves. Checked structurally:
+        # jnp.result_type over a tuple PROMOTES instead of raising.
+        if isinstance(a, (tuple, list)):
+            return False
         try:
             return jnp.issubdtype(jnp.result_type(a), jnp.inexact)
         except TypeError:
@@ -244,6 +247,11 @@ def lower_grad_via_vjp(fwd_def, ctx, ins, attrs, out_grads, wanted_input_grads):
         gs = out_grads.get(oslot, [])
         slot_cot = []
         for j, ref in enumerate(refs):
+            if isinstance(ref, (tuple, list)):
+                # composite (tensor-array) output: zero cotangent per
+                # leaf — result_type would silently promote the tuple
+                slot_cot.append(_zero_cot(ref))
+                continue
             try:
                 rdtype = jnp.result_type(ref)
             except TypeError:

@@ -48,6 +48,10 @@ _DEFS = {
     # (XLA-composed attention) — the escape hatch when the Pallas compile
     # path is unavailable/slow on a given rig
     "attention_impl": ("auto", str),
+    # backward pass of the flash kernel: "pallas" (FlashAttention-2-style
+    # dkv/dq kernels, O(block) memory) or "reference" (recompute through
+    # the XLA-composed path — materializes the [T, S] score matrix)
+    "flash_backward": ("pallas", str),
 }
 
 

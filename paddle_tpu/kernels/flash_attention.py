@@ -10,10 +10,14 @@ tile computes; running (max, sum, acc) live in VMEM scratch that persists
 across the kv grid steps. Per-core memory is O(block), independent of
 sequence length — the full [T, S] score matrix never exists.
 
-Forward is Pallas; backward is a custom_vjp that recomputes through the
-XLA reference path (numerically identical math) — a dedicated backward
-kernel is a later optimization. On CPU (tests) the kernel runs with
-``interpret=True``; the public entry point picks the best path per backend.
+Forward and backward are both Pallas: the forward emits the per-row
+log-sum-exp residual, and the backward is the FlashAttention-2 recipe —
+delta = rowsum(dO*O) precomputed in XLA, a dK/dV kernel scanning Q tiles
+innermost, and a dQ kernel scanning K/V tiles innermost — so neither
+direction ever materializes the [T, S] score matrix
+(FLAGS_flash_backward=reference restores the recompute-through-XLA
+fallback). On CPU (tests) the kernels run with ``interpret=True``; the
+public entry point picks the best path per backend.
 """
 
 import functools
@@ -24,6 +28,17 @@ import jax.numpy as jnp
 _DEFAULT_BLOCK_Q = 128
 _DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
+
+
+def _is_tpu_target():
+    """Pinned-Place-aware backend test (core/lowering.is_tpu_target);
+    falls back to default_backend for standalone (non-executor) use."""
+    try:
+        from paddle_tpu.core.lowering import is_tpu_target
+
+        return is_tpu_target()
+    except Exception:
+        return jax.default_backend() != "cpu"
 
 
 def flash_attention_reference(q, k, v, causal=False, sm_scale=None,
@@ -45,10 +60,13 @@ def flash_attention_reference(q, k, v, causal=False, sm_scale=None,
     return jnp.einsum("bhts,bhsd->bhtd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  sm_scale, causal, seq_k, block_q, block_k, n_kv):
+def _flash_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref, acc_ref,
+                  m_ref, l_ref, *, sm_scale, causal, seq_k, block_q,
+                  block_k, n_kv, has_mask):
     """One (b, h, qi, kj) grid step: absorb one K/V tile into the running
-    online-softmax state held in VMEM scratch."""
+    online-softmax state held in VMEM scratch. ``kvm_ref`` is the
+    per-batch key-validity mask tile ([1, block_k] float, 1 = keep) when
+    has_mask, else an unused dummy."""
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
@@ -75,6 +93,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             jnp.int32, (block_q, block_k), 1
         )
         valid = k_idx < seq_k
+        if has_mask:
+            valid = jnp.logical_and(valid, kvm_ref[0, 0, :][None, :] > 0)
         if causal:
             q_idx = q_base + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -104,9 +124,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[0, 0, :, :] = (
             acc_ref[:, :] / jnp.maximum(l_ref[:, :], 1e-30)
         ).astype(o_ref.dtype)
+        # log-sum-exp per query row, the backward pass's softmax residual;
+        # fully-masked / padded rows yield ~-1e30 (backward zeroes them).
+        # Layout is [B, H, 1, T]: a trailing dim of 1 would be tile-padded
+        # to 128 (a 128x HBM expansion, enough to OOM a 6-layer model).
+        lse_ref[0, 0, 0, :] = (
+            m_ref[:, :] + jnp.log(jnp.maximum(l_ref[:, :], 1e-30))
+        )[:, 0]
 
 
-def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, kv_mask, causal, sm_scale, block_q, block_k,
+                   interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -125,6 +153,16 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     Tp, Sp = T + T_pad, S + S_pad
     n_kv = Sp // block_k
 
+    has_mask = kv_mask is not None
+    if has_mask:
+        # [B, S] validity -> [B, 1, S] so the block's last two dims are
+        # (1, block_k): dim -2 equals the array dim, dim -1 divides 128
+        # (Mosaic tiling rule).
+        kvm = jnp.pad(kv_mask.astype(jnp.float32), ((0, 0), (0, S_pad)))
+        kvm = kvm[:, None, :]
+    else:
+        kvm = jnp.ones((B, 1, block_k), jnp.float32)
+
     kernel = functools.partial(
         _flash_kernel,
         sm_scale=sm_scale,
@@ -133,6 +171,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         block_q=block_q,
         block_k=block_k,
         n_kv=n_kv,
+        has_mask=has_mask,
     )
     out = pl.pallas_call(
         kernel,
@@ -147,42 +186,302 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec(
                 (1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)
             ),
+            pl.BlockSpec(
+                (1, 1, block_k),
+                (lambda b, h, i, j: (b, 0, j)) if has_mask
+                else (lambda b, h, i, j: (b, 0, 0)),
+            ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype),
+            jax.ShapeDtypeStruct((B, H, 1, Tp), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp)
-    return out[:, :, :T, :]
+    )(qp, kp, vp, kvm)
+    out, lse = out
+    return out[:, :, :T, :], lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                          interpret)
-
-
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                         interpret)
-    return out, (q, k, v)
-
-
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: flash_attention_reference(
-            q_, k_, v_, causal=causal, sm_scale=sm_scale
-        ),
-        q, k, v,
+def _bwd_tile_grads(q, k, v, do, lse, delta, valid, sm_scale):
+    """Shared per-tile backward math. q/do: [bq, d]; k/v: [bk, d];
+    lse/delta: [bq, 1]; valid: [bq, bk] bool (key validity + causal +
+    row validity). Returns (dS_scaled [bq, bk], p [bq, bk])."""
+    s = jax.lax.dot_general(
+        q * sm_scale, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
-    return vjp(g)
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta) * sm_scale
+    return ds, p
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          sm_scale, causal, seq_q, seq_k, block_q, block_k,
+                          n_q, has_mask):
+    """Grid (b, h, kj, qi), q innermost: accumulate dK/dV for one K/V tile
+    across all Q tiles; VMEM accumulators persist over the qi steps."""
+    from jax.experimental import pallas as pl
+
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:, :] = jnp.zeros_like(dk_acc)
+        dv_acc[:, :] = jnp.zeros_like(dv_acc)
+
+    q_base = qi * block_q
+    k_base = kj * block_k
+
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0, :][:, None]
+        delta = delta_ref[0, 0, 0, :][:, None]
+        q_idx = q_base + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_idx = k_base + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        # row validity: padded / fully-masked rows have lse ~ -1e30 and
+        # must contribute nothing (exp(s - lse) would blow up there)
+        valid = (q_idx < seq_q) & (k_idx < seq_k) & (lse > -1e29)
+        if has_mask:
+            valid &= kvm_ref[0, 0, :][None, :] > 0
+        if causal:
+            valid &= k_idx <= q_idx
+        ds, p = _bwd_tile_grads(q, k, v, do, lse, delta, valid, sm_scale)
+        dv_acc[:, :] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[:, :] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Q tiles entirely above the diagonal see only masked positions.
+        pl.when(q_base + block_q - 1 >= k_base)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0, 0, :, :] = dk_acc[:, :].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:, :].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         kvm_ref, dq_ref, dq_acc, *, sm_scale, causal,
+                         seq_q, seq_k, block_q, block_k, n_kv, has_mask):
+    """Grid (b, h, qi, kj), kv innermost: accumulate dQ for one Q tile."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:, :] = jnp.zeros_like(dq_acc)
+
+    q_base = qi * block_q
+    k_base = kj * block_k
+
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0, :][:, None]
+        delta = delta_ref[0, 0, 0, :][:, None]
+        q_idx = q_base + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_idx = k_base + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = (q_idx < seq_q) & (k_idx < seq_k) & (lse > -1e29)
+        if has_mask:
+            valid &= kvm_ref[0, 0, :][None, :] > 0
+        if causal:
+            valid &= k_idx <= q_idx
+        ds, _ = _bwd_tile_grads(q, k, v, do, lse, delta, valid, sm_scale)
+        dq_acc[:, :] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(k_base <= q_base + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == n_kv - 1)
+    def _finish():
+        dq_ref[0, 0, :, :] = dq_acc[:, :].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
+                    block_q, block_k, interpret):
+    """FlashAttention-2-style backward: delta precomputed in XLA, then a
+    dK/dV kernel (q innermost) and a dQ kernel (kv innermost). O(block)
+    memory — the [T, S] score matrix never materializes, matching the
+    forward's long-context contract."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, d = q.shape
+    S = k.shape[2]
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    T_pad = -T % block_q
+    S_pad = -S % block_k
+    Tp, Sp = T + T_pad, S + S_pad
+    n_q, n_kv = Tp // block_q, Sp // block_k
+
+    pad_q = ((0, 0), (0, 0), (0, T_pad), (0, 0))
+    pad_k = ((0, 0), (0, 0), (0, S_pad), (0, 0))
+    qp = jnp.pad(q, pad_q)
+    kp = jnp.pad(k, pad_k)
+    vp = jnp.pad(v, pad_k)
+    dop = jnp.pad(g.astype(jnp.float32), pad_q)
+    # delta_i = rowsum(dO * O): one cheap fused elementwise+reduce in XLA;
+    # [B, H, 1, T] layout like lse (trailing-1 dims tile-pad 128x)
+    delta = jnp.pad(
+        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1)[:, :, None, :],
+        ((0, 0), (0, 0), (0, 0), (0, T_pad)),
+    )
+    # lse comes back from the forward already padded to Tp
+
+    has_mask = kv_mask is not None
+    if has_mask:
+        kvm = jnp.pad(kv_mask.astype(jnp.float32), ((0, 0), (0, S_pad)))
+        kvm = kvm[:, None, :]
+    else:
+        kvm = jnp.ones((B, 1, block_k), jnp.float32)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0))
+    row_spec = pl.BlockSpec((1, 1, 1, block_q), lambda b, h, j, i: (b, h, 0, i))
+    kvm_spec = pl.BlockSpec(
+        (1, 1, block_k),
+        (lambda b, h, j, i: (b, 0, j)) if has_mask
+        else (lambda b, h, j, i: (b, 0, 0)),
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            seq_q=T, seq_k=S, block_q=block_q, block_k=block_k, n_q=n_q,
+            has_mask=has_mask,
+        ),
+        grid=(B, H, n_kv, n_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+                  kvm_spec],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sp, d), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Sp, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, delta, kvm)
+
+    q_spec2 = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0))
+    row_spec2 = pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i))
+    kvm_spec2 = pl.BlockSpec(
+        (1, 1, block_k),
+        (lambda b, h, i, j: (b, 0, j)) if has_mask
+        else (lambda b, h, i, j: (b, 0, 0)),
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            seq_q=T, seq_k=S, block_q=block_q, block_k=block_k, n_kv=n_kv,
+            has_mask=has_mask,
+        ),
+        grid=(B, H, n_q, n_kv),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                  row_spec2, kvm_spec2],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, delta, kvm)
+
+    return dq[:, :, :T, :], dk[:, :, :S, :], dv[:, :, :S, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, kv_mask, has_mask, causal, sm_scale, block_q, block_k,
+           interpret):
+    out, _ = _flash_forward(q, k, v, kv_mask if has_mask else None, causal,
+                            sm_scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, kv_mask, has_mask, causal, sm_scale, block_q,
+               block_k, interpret):
+    out, lse = _flash_forward(q, k, v, kv_mask if has_mask else None,
+                              causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v, kv_mask, out, lse)
+
+
+def _flash_bwd(has_mask, causal, sm_scale, block_q, block_k, interpret,
+               res, g):
+    q, k, v, kv_mask, out, lse = res
+    if _backward_impl() == "reference":
+        mask = kv_mask[:, None, None, :].astype(bool) if has_mask else None
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: flash_attention_reference(
+                q_, k_, v_, causal=causal, sm_scale=sm_scale, mask=mask
+            ),
+            q, k, v,
+        )
+        return vjp(g) + (jnp.zeros_like(kv_mask),)
+    dq, dk, dv = _flash_backward(
+        q, k, v, kv_mask if has_mask else None, out, lse, g, causal,
+        sm_scale, block_q, block_k, interpret,
+    )
+    return dq, dk, dv, jnp.zeros_like(kv_mask)
+
+
+def _backward_impl():
+    """FLAGS_flash_backward: 'pallas' (default) or 'reference' — the
+    escape hatch mirrors FLAGS_attention_impl for the whole op."""
+    try:
+        from paddle_tpu import flags
+
+        return flags.get("flash_backward")
+    except Exception:  # flags unavailable in standalone kernel use
+        return "pallas"
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -203,19 +502,38 @@ def flash_attention(
     """Fused attention. q:[B,H,T,d], k,v:[B,H,S,d] -> [B,H,T,d].
 
     Pallas kernel on TPU (interpret-mode when forced on CPU); XLA reference
-    elsewhere and whenever an additive ``mask`` is supplied (masked variant
-    of the kernel is a later wave).
+    elsewhere. Key-validity masks — [B, S], or [B, 1, 1, S] as the sdpa op
+    normalizes them — run through the kernel (the tile test absorbs them);
+    only full [B, H, T, S] masks fall back to the reference path. A query
+    row whose keys are ALL masked returns 0 from the kernel (the reference
+    path returns the uniform-softmax average; such rows are meaningless
+    either way).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    kv_mask = None
+    if mask is not None:
+        if mask.ndim == 2:
+            kv_mask = mask
+        elif mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+            kv_mask = mask[:, 0, 0, :]
     use_pallas = force_pallas or (
         not force_reference
-        and mask is None
-        and jax.default_backend() == "tpu"
+        and (mask is None or kv_mask is not None)
+        and _is_tpu_target()
     )
-    if not use_pallas or mask is not None:
+    if not use_pallas or (mask is not None and kv_mask is None):
+        # normalize a [B, S] key mask to [B, 1, 1, S] for the reference
+        # einsum path (raw 2-D would broadcast B against the T axis)
+        ref_mask = (kv_mask[:, None, None, :] if kv_mask is not None
+                    else mask)
         return flash_attention_reference(
-            q, k, v, causal=causal, sm_scale=sm_scale, mask=mask
+            q, k, v, causal=causal, sm_scale=sm_scale, mask=ref_mask
         )
-    interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    interpret = not _is_tpu_target()
+    has_mask = kv_mask is not None
+    if not has_mask:
+        # static dummy so the custom_vjp signature stays array-only
+        kv_mask = jnp.ones((q.shape[0], 1), jnp.float32)
+    return _flash(q, k, v, kv_mask.astype(jnp.float32), has_mask, causal,
+                  sm_scale, block_q, block_k, interpret)

@@ -16,7 +16,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.kernels.lstm_cell import _ACTS
+from paddle_tpu.kernels.lstm_cell import _ACTS, _is_tpu
 
 
 def gru_reference(xw, w_gate, w_cand, bias, h0, mask,
@@ -170,12 +170,12 @@ def fused_gru(xw, w_gate, w_cand, bias, mask=None, gate_act="sigmoid",
             "w_gate %s, w_cand %s"
             % (tuple(xw.shape), tuple(w_gate.shape), tuple(w_cand.shape)))
     use_pallas = force_pallas or (
-        not force_reference and jax.default_backend() == "tpu"
+        not force_reference and _is_tpu()
     )
     if not use_pallas:
         h0 = jnp.zeros((b, d), xw.dtype)
         return gru_reference(xw, w_gate, w_cand, bias, h0, mask, gate_act,
                              cand_act)
-    interpret = jax.default_backend() != "tpu"
+    interpret = not _is_tpu()
     return _fused(xw, w_gate, w_cand, jnp.reshape(bias, (-1,)), mask,
                   gate_act, cand_act, interpret)

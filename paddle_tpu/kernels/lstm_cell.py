@@ -35,6 +35,14 @@ _ACTS = {
 }
 
 
+def _is_tpu():
+    """True when the enclosing compile targets a non-CPU backend (the
+    executor's pinned Place wins over jax.default_backend)."""
+    from paddle_tpu.core.lowering import is_tpu_target
+
+    return is_tpu_target()
+
+
 def lstm_reference(xw, w_h, bias, peephole, h0, c0, mask,
                    gate_act="sigmoid", cell_act="tanh", cand_act="tanh"):
     """XLA scan reference. xw: [B, T, 4D] pre-projected inputs (+bias NOT
@@ -237,7 +245,7 @@ def fused_lstm(xw, w_h, bias, peephole=None, mask=None,
             "fused_lstm: xw last dim %d / w_h %s inconsistent with 4*D"
             % (d4, tuple(w_h.shape)))
     use_pallas = force_pallas or (
-        not force_reference and jax.default_backend() == "tpu"
+        not force_reference and _is_tpu()
     )
     if not use_pallas:
         h0 = jnp.zeros((b, d), xw.dtype)
@@ -245,7 +253,7 @@ def fused_lstm(xw, w_h, bias, peephole=None, mask=None,
                               gate_act, cell_act, cand_act)
     peep_arr = (jnp.stack(list(peephole), axis=0) if peephole is not None
                 else jnp.zeros((3, d), xw.dtype))
-    interpret = jax.default_backend() != "tpu"
+    interpret = not _is_tpu()
     return _fused(xw, w_h, jnp.reshape(bias, (-1,)), peep_arr, mask,
                   peephole is not None, gate_act, cell_act, cand_act,
                   interpret)

@@ -35,6 +35,8 @@ __all__ = [
     "cond",
     "lod_rank_table",
     "reorder_lod_tensor_by_rank",
+    "lod_tensor_to_array",
+    "array_to_lod_tensor",
 ]
 
 
@@ -839,6 +841,42 @@ def lod_rank_table(x=None, level=0, lengths=None):
         outputs={"Index": [index], "SortedLength": [sorted_len]},
     )
     return RankTable(index, sorted_len)
+
+
+def lod_tensor_to_array(x, table):
+    """Move a dense-padded [B, T, ...] tensor into a tensor array whose
+    time axis is the array index (lod_tensor_to_array_op.cc role; the
+    reference splits ragged rows per rank-table bucket, the dense design
+    re-axes the padded tensor — docs/LOD_DESIGN.md)."""
+    from paddle_tpu.core.types import VarType
+
+    helper = LayerHelper("lod_tensor_to_array")
+    array = helper.block.create_var(
+        name=unique_name.generate("lod_tensor_to_array"),
+        type=VarType.LOD_TENSOR_ARRAY,
+        dtype=x.dtype,
+        shape=None,
+    )
+    helper.append_op(
+        type="lod_tensor_to_array",
+        inputs={"X": [x], "RankTable": [table.index]},
+        outputs={"Out": [array]},
+    )
+    array._array_written = True
+    return array
+
+
+def array_to_lod_tensor(x, table):
+    """Inverse of lod_tensor_to_array: stack the array back into a dense
+    batch-major [B, T, ...] tensor (array_to_lod_tensor_op.cc role)."""
+    helper = LayerHelper("array_to_lod_tensor")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="array_to_lod_tensor",
+        inputs={"X": [x], "RankTable": [table.index]},
+        outputs={"Out": [out]},
+    )
+    return out
 
 
 def reorder_lod_tensor_by_rank(x, rank_table):

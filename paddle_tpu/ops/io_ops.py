@@ -223,28 +223,20 @@ def _lower_save_combine(ctx, ins, attrs):
 
 
 def _save_combine_grad_maker(op, out_grads, wanted):
-    # identity dataflow per slot entry, like save; an entry whose output
-    # has NO downstream gradient still owes its wanted input grad — zeros
-    # (the dup-grad sum op reads every declared contribution)
+    # identity dataflow per slot entry, like save; entries whose output
+    # has no downstream gradient arrive pre-zero-filled from backward.py,
+    # so every wanted input grad is a plain assign (the dup-grad sum op
+    # reads every declared contribution)
     ops = []
-    xs = op.inputs.get("X", [])
-    for i, (g, w) in enumerate(zip(out_grads["Out"], wanted["X"])):
+    for g, w in zip(out_grads["Out"], wanted["X"]):
         if not w:  # backward marks skipped entries with "" (not None)
             continue
-        if g is not None:
-            ops.append({
-                "type": "assign",
-                "inputs": {"X": [g]},
-                "outputs": {"Out": [w]},
-                "attrs": {},
-            })
-        else:
-            ops.append({
-                "type": "fill_zeros_like",
-                "inputs": {"X": [xs[i]]},
-                "outputs": {"Out": [w]},
-                "attrs": {},
-            })
+        ops.append({
+            "type": "assign",
+            "inputs": {"X": [g]},
+            "outputs": {"Out": [w]},
+            "attrs": {},
+        })
     return ops
 
 

@@ -97,7 +97,7 @@ register_op(
     attrs={"min": 0.0, "max": 1.0, "seed": 0},
     lower=lambda ctx, ins, attrs: jax.random.categorical(
         ctx.rng(), jnp.log(jnp.maximum(ins["X"][0], 1e-20)), axis=-1
-    ).astype(jnp.int64),
+    ).astype(device_dtype("int64")),
     grad=None,
 )
 

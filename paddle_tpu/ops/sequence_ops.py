@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.core.types import device_dtype
 
 
 def _mask_from(ins, x, time_axis=1):
@@ -305,7 +306,7 @@ def _lower_sequence_pad(ctx, ins, attrs):
     if jnp.ndim(x) > 2:
         valid = valid.reshape(valid.shape + (1,) * (jnp.ndim(x) - 2))
     out = jnp.where(valid, x, jnp.reshape(pad_value, (-1,))[0])
-    return {"Out": out, "OutLength": lens[:, None].astype(jnp.int64)}
+    return {"Out": out, "OutLength": lens[:, None].astype(device_dtype("int64"))}
 
 
 register_op(

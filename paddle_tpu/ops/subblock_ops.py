@@ -371,6 +371,19 @@ register_op(
 )
 
 
+def _array_to_lod_tensor_grad_maker(op, out_grads, wanted):
+    # The inverse re-axing: dX (an array composite) = lod_tensor_to_array
+    # of the dense dOut. The pair makes the round trip differentiable
+    # (reference: array_to_lod_tensor_op.cc's grad is lod_tensor_to_array).
+    return [{
+        "type": "lod_tensor_to_array",
+        "inputs": {"X": [out_grads["Out"][0]],
+                   "RankTable": list(op.input("RankTable"))},
+        "outputs": {"Out": wanted["X"]},
+        "attrs": {},
+    }]
+
+
 register_op(
     "array_to_lod_tensor",
     inputs=["X", "RankTable"],
@@ -380,8 +393,20 @@ register_op(
     # past the array's size remain zero padding (dense-padded regime; the
     # reference's LoD restore re-packs ragged rows instead).
     lower=lambda ctx, ins, attrs: jnp.moveaxis(ins["X"][0][0], 0, 1),
-    grad=None,
+    grad=_array_to_lod_tensor_grad_maker,
+    no_grad_inputs=("RankTable",),
 )
+
+
+def _lod_tensor_to_array_grad_maker(op, out_grads, wanted):
+    # dX (dense) = array_to_lod_tensor of the array-composite grad.
+    return [{
+        "type": "array_to_lod_tensor",
+        "inputs": {"X": [out_grads["Out"][0]],
+                   "RankTable": list(op.input("RankTable"))},
+        "outputs": {"Out": wanted["X"]},
+        "attrs": {},
+    }]
 
 
 register_op(
@@ -396,5 +421,6 @@ register_op(
             )
         ]
     },
-    grad=None,
+    grad=_lod_tensor_to_array_grad_maker,
+    no_grad_inputs=("RankTable",),
 )

@@ -151,32 +151,13 @@ def _lower_split(ctx, ins, attrs):
     return {"Out": parts}
 
 
-def _zero_filled_out_grads(op, slot, out_grads):
-    """Replace missing (None) output grads with fill_zeros_like over the
-    forward output, so pieces with no downstream gradient contribute
-    zeros instead of vanishing from the concat/stack (the reference
-    backward inserts fill_zeros_like for exactly this case)."""
-    specs, names = [], []
-    for name, g in zip(op.output(slot), out_grads[slot]):
-        if g is None:
-            g = name + "@GRAD@zero"
-            specs.append({
-                "type": "fill_zeros_like",
-                "inputs": {"X": [name]},
-                "outputs": {"Out": [g]},
-                "attrs": {},
-            })
-        names.append(g)
-    return specs, names
-
-
 def _split_grad_maker(op, out_grads, wanted):
-    # d(split)/dX = concat of output grads.
-    specs, grads = _zero_filled_out_grads(op, "Out", out_grads)
-    return specs + [
+    # d(split)/dX = concat of output grads (pieces with no downstream
+    # gradient arrive pre-zero-filled by backward.py's maker path).
+    return [
         {
             "type": "concat",
-            "inputs": {"X": grads},
+            "inputs": {"X": out_grads["Out"]},
             "outputs": {"Out": wanted["X"]},
             "attrs": {"axis": op.attrs.get("axis", 0)},
         }
@@ -284,11 +265,11 @@ register_op(
 
 
 def _unstack_grad_maker(op, out_grads, wanted):
-    specs, grads = _zero_filled_out_grads(op, "Y", out_grads)
-    return specs + [
+    # Pieces without a downstream gradient arrive pre-zero-filled.
+    return [
         {
             "type": "stack",
-            "inputs": {"X": grads},
+            "inputs": {"X": out_grads["Y"]},
             "outputs": {"Y": wanted["X"]},
             "attrs": {"axis": op.attrs.get("axis", 0)},
         }

@@ -172,3 +172,22 @@ class OpTest(object):
             flat[i] = orig
             gflat[i] = (fp - fm) / (2 * delta)
         return grad
+
+
+def make_op_test(op_type, inputs, outputs, attrs=None):
+    """One-line OpTest construction for sweep-style tests."""
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = dict(attrs or {})
+    return t
+
+
+def make_grad_test(op_type, inputs, out_shapes, attrs=None):
+    """Grad-only variant: outputs need correct SHAPES, not values
+    (check_grad uses the expected array only for the random projection)."""
+    return make_op_test(
+        op_type, inputs,
+        {k: np.zeros(v, "float32") for k, v in out_shapes.items()},
+        attrs)

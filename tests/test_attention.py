@@ -5,7 +5,7 @@ import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.kernels.flash_attention import (
-    _flash,
+    flash_attention,
     flash_attention_reference,
 )
 
@@ -37,9 +37,9 @@ def test_flash_kernel_matches_reference(causal):
     if causal:
         S = T
         k, v = k[:, :, :T], v[:, :, :T]
-    out = _flash(
+    out = flash_attention(
         jax.numpy.asarray(q), jax.numpy.asarray(k), jax.numpy.asarray(v),
-        causal, 1.0 / np.sqrt(d), 8, 8, True,
+        causal=causal, block_q=8, block_k=8, force_pallas=True,
     )
     expect = _np_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), expect, atol=2e-5, rtol=2e-5)
@@ -56,7 +56,8 @@ def test_flash_kernel_grad_matches_reference():
 
     def loss_pallas(q, k, v):
         return jax.numpy.sum(
-            _flash(q, k, v, True, 1.0 / np.sqrt(d), 8, 8, True) ** 2
+            flash_attention(q, k, v, causal=True, block_q=8, block_k=8,
+                            force_pallas=True) ** 2
         )
 
     def loss_ref(q, k, v):
@@ -70,6 +71,46 @@ def test_flash_kernel_grad_matches_reference():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4
         )
+
+
+@pytest.mark.parametrize("mask_rank", [2, 4], ids=["BS", "B11S"])
+def test_flash_kernel_key_mask_matches_reference(mask_rank):
+    """[B, S] key-validity masks run through the Pallas kernel (interpret
+    mode on CPU): forward and grads must match the masked reference."""
+    import jax
+
+    rng = np.random.RandomState(7)
+    B, H, T, S, d = 2, 2, 10, 13, 8
+    q = jax.numpy.asarray(rng.randn(B, H, T, d).astype("float32"))
+    k = jax.numpy.asarray(rng.randn(B, H, S, d).astype("float32"))
+    v = jax.numpy.asarray(rng.randn(B, H, S, d).astype("float32"))
+    lens = np.asarray([S, S - 5])
+    kv_valid = (np.arange(S)[None, :] < lens[:, None])
+    mask = jax.numpy.asarray(
+        kv_valid if mask_rank == 2 else kv_valid[:, None, None, :])
+
+    out = flash_attention(q, k, v, mask=mask, block_q=8, block_k=8,
+                          force_pallas=True)
+    expect = _np_attention(np.asarray(q), np.asarray(k), np.asarray(v),
+                           mask=kv_valid[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out), expect, atol=2e-5,
+                               rtol=2e-5)
+
+    def loss_pallas(q_, k_, v_):
+        return jax.numpy.sum(flash_attention(
+            q_, k_, v_, mask=mask, block_q=8, block_k=8,
+            force_pallas=True) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        m4 = mask if mask_rank == 4 else mask[:, None, None, :]
+        return jax.numpy.sum(flash_attention_reference(
+            q_, k_, v_, mask=m4) ** 2)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
 
 
 def test_sdpa_layer_with_mask():
@@ -263,3 +304,21 @@ def test_sdpa_seq_parallel_axis_requires_mesh():
         exe.run(main,
                 feed={"q": np.zeros((1, 2, 8, 4), "float32")},
                 fetch_list=[out])
+
+
+def test_flash_key_mask_reference_fallback_normalizes():
+    """A [B, S] key mask on the reference fallback (CPU target, no
+    force_pallas) must be expanded to [B, 1, 1, S], not broadcast raw."""
+    import jax
+
+    rng = np.random.RandomState(9)
+    B, H, T, S, d = 3, 2, 5, 7, 4  # B != T: raw broadcast would raise
+    q = jax.numpy.asarray(rng.randn(B, H, T, d).astype("float32"))
+    k = jax.numpy.asarray(rng.randn(B, H, S, d).astype("float32"))
+    v = jax.numpy.asarray(rng.randn(B, H, S, d).astype("float32"))
+    kv_valid = (np.arange(S)[None, :] < np.asarray([S, 3, 5])[:, None])
+    out = flash_attention(q, k, v, mask=jax.numpy.asarray(kv_valid))
+    expect = _np_attention(np.asarray(q), np.asarray(k), np.asarray(v),
+                           mask=kv_valid[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out), expect, atol=2e-5,
+                               rtol=2e-5)

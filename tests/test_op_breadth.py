@@ -16,24 +16,7 @@ import pytest
 
 import paddle_tpu as fluid
 
-from op_test import OpTest
-
-
-def _t(op_type, inputs, outputs, attrs=None):
-    t = OpTest()
-    t.op_type = op_type
-    t.inputs = inputs
-    t.outputs = outputs
-    t.attrs = dict(attrs or {})
-    return t
-
-
-def _shapes(op_type, inputs, out_shapes, attrs=None):
-    """Grad-only variant: outputs need correct shapes, not values."""
-    return _t(op_type, inputs,
-              {k: np.zeros(v, "float32") for k, v in out_shapes.items()},
-              attrs)
-
+from op_test import make_grad_test as _shapes, make_op_test as _t
 
 _RNG = np.random.RandomState
 

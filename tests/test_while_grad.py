@@ -134,3 +134,37 @@ def test_training_through_while_converges():
         lv, = exe.run(main, feed={"x": xv, "t": tv}, fetch_list=[loss])
         losses.append(float(np.ravel(lv)[0]))
     assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+
+
+def test_while_grad_with_tensor_array_carry():
+    """A While whose carries include a tensor array next to the
+    differentiable float carry: the backward engine's missing-grad
+    pre-fill must skip the array carry (zeros_like over a (buffer, size)
+    tensor-array rep would crash) while the float carry still trains."""
+    iters, n = 3, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[n], append_batch_size=False)
+        w = fluid.layers.create_parameter([n], "float32", name="w_arr")
+        acc = fluid.layers.fill_constant([n], "float32", 0.0)
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        limit = fluid.layers.fill_constant([1], "int64", iters)
+        trace = fluid.layers.array_write(acc, i)  # seed the array carry
+        cond = fluid.layers.less_than(i, limit)
+        loop = fluid.layers.While(cond, max_iterations=8)
+        with loop.block():
+            step = fluid.layers.elementwise_mul(x, w)
+            acc2 = fluid.layers.elementwise_add(acc, step)
+            fluid.layers.assign(acc2, acc)
+            fluid.layers.array_write(acc2, i, array=trace)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, limit, cond=cond)
+        loss = fluid.layers.mean(acc)
+        grads = backward.append_backward(loss)
+    gmap = dict((p.name, g) for p, g in grads)
+    (gvar,) = [g for name, g in gmap.items() if name.startswith("w_arr")]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.arange(1.0, n + 1, dtype="float32")
+    (gw,) = exe.run(main, feed={"x": xv}, fetch_list=[gvar])
+    np.testing.assert_allclose(np.asarray(gw), iters * xv / n, rtol=1e-5)

@@ -22,32 +22,24 @@ EOF
 
 capture() {
   echo "tunnel up $(date -u +%FT%TZ); capturing" | tee -a "$LOG/log"
-  # 1. the missing north-star number: Transformer train on the chip
-  BENCH_MODELS=transformer BENCH_WORKER_TIMEOUT=2700 \
-    python bench.py >"$LOG/transformer.json" 2>"$LOG/transformer.err"
-  # if the Pallas-flash compile is what hangs this rig, the reference
-  # attention impl is the fallback lever (FLAGS_attention_impl)
-  if ! grep -q '"platform": "tpu"' "$LOG/transformer.json"; then
-    FLAGS_attention_impl=reference BENCH_MODELS=transformer \
-      BENCH_WORKER_TIMEOUT=2700 python bench.py \
-      >"$LOG/transformer_ref_attn.json" 2>"$LOG/transformer_ref_attn.err"
-  fi
-  # last resort: a compile-light 2-layer capture (valid MFU, smaller
-  # model) beats no Transformer chip number at all
-  if ! grep -q '"platform": "tpu"' "$LOG/transformer.json" \
-      "$LOG/transformer_ref_attn.json" 2>/dev/null; then
-    BENCH_LAYERS=2 BENCH_MODELS=transformer BENCH_WORKER_TIMEOUT=2700 \
-      python bench.py >"$LOG/transformer_2l.json" 2>"$LOG/transformer_2l.err"
-  fi
-  # 2. Pallas-vs-XLA kernel verdicts (flag defaults depend on these)
+  # Priority for THIS window reflects what the 07-31 morning window
+  # already banked (BENCH_NOTES.md "second window"): the Transformer
+  # driver number, the full ResNet sweep, host-data A/B, fp32 A/B and
+  # the xprof breakdown are all captured. Still owed, in order:
+  # 1. Pallas-vs-XLA kernel verdicts — missed in THREE windows now
+  #    (crash, then sweep-tail backend loss); flag defaults depend on it
   timeout -k 30 2400 python tools/kernel_bench.py \
     >"$LOG/kernels.jsonl" 2>"$LOG/kernels.err"
-  # 3. per-HLO-op xprof breakdown of the ResNet step (MFU push evidence)
-  timeout -k 30 2400 python tools/step_breakdown.py --model resnet50 \
-    --xprof >"$LOG/breakdown.jsonl" 2>"$LOG/breakdown.err"
-  # 4. the prepared MFU experiments
-  timeout -k 30 7200 tools/mfu_sweep.sh \
-    >"$LOG/sweep.jsonl" 2>"$LOG/sweep.err"
+  # 2. Transformer re-capture with the fixed lse layout + factored loss
+  #    (the morning number predates both; direct A/B vs 102,970 tok/s)
+  BENCH_MODELS=transformer BENCH_WORKER_TIMEOUT=2700 \
+    python bench.py >"$LOG/transformer.json" 2>"$LOG/transformer.err"
+  # 3. the reference-attention control the sweep's timeout lost
+  SWEEP_QUICK=1 SWEEP_EXP_TIMEOUT=2400 timeout -k 30 7500 \
+    tools/mfu_sweep.sh >"$LOG/sweep_quick.jsonl" 2>"$LOG/sweep_quick.err"
+  # 4. ResNet sanity re-pin (cheap; confirms chip-side consistency)
+  BENCH_MODELS=resnet50 BENCH_WORKER_TIMEOUT=2700 \
+    python bench.py >"$LOG/resnet.json" 2>"$LOG/resnet.err"
   echo "capture done $(date -u +%FT%TZ)" | tee -a "$LOG/log"
 }
 
