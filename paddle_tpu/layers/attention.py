@@ -13,6 +13,7 @@ __all__ = [
     "multi_head_attention",
     "label_smooth",
     "add_position_encoding",
+    "moe_ffn",
 ]
 
 
@@ -141,3 +142,65 @@ def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
         attrs={"alpha": float(alpha), "beta": float(beta)},
     )
     return out
+
+
+def moe_ffn(
+    x,
+    num_experts,
+    d_hidden,
+    top_k=1,
+    capacity_factor=1.25,
+    act="gelu",
+    param_attr=None,
+    name=None,
+):
+    """Mixture-of-Experts feed-forward block (Switch-Transformer style;
+    ops/moe_ops.py). x: [batch, seq, d_model]; returns (out, aux_loss) —
+    add ``aux_loss`` (scaled, typically by 1e-2) to the training loss to
+    balance expert load.
+
+    Expert parallelism: shard the stacked expert parameters on dim 0
+    over a mesh axis via ParallelExecutor(sharding_overrides=...); GSPMD
+    inserts the token all-to-alls.
+    """
+    import copy
+
+    from paddle_tpu import initializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("moe_ffn", param_attr=param_attr, name=name)
+    d_model = int(x.shape[-1])
+    e, h = int(num_experts), int(d_hidden)
+
+    def _slot_attr(suffix):
+        # Five distinct parameters: a single user-NAMED ParamAttr would
+        # otherwise alias them all (create_parameter returns the existing
+        # var on name collision), so suffix the name per slot.
+        attr = ParamAttr._to_attr(copy.copy(helper.param_attr))
+        if getattr(attr, "name", None):
+            attr.name = attr.name + "_" + suffix
+        return attr
+
+    gate_w = helper.create_parameter(
+        attr=_slot_attr("gate"), shape=[d_model, e], dtype=x.dtype)
+    w1 = helper.create_parameter(
+        attr=_slot_attr("w1"), shape=[e, d_model, h], dtype=x.dtype)
+    b1 = helper.create_parameter(
+        attr=_slot_attr("b1"), shape=[e, h], dtype=x.dtype,
+        default_initializer=initializer.Constant(0.0))
+    w2 = helper.create_parameter(
+        attr=_slot_attr("w2"), shape=[e, h, d_model], dtype=x.dtype)
+    b2 = helper.create_parameter(
+        attr=_slot_attr("b2"), shape=[e, d_model], dtype=x.dtype,
+        default_initializer=initializer.Constant(0.0))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    aux = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="moe_ffn",
+        inputs={"X": [x], "GateW": [gate_w], "ExpertW1": [w1],
+                "ExpertB1": [b1], "ExpertW2": [w2], "ExpertB2": [b2]},
+        outputs={"Out": [out], "AuxLoss": [aux]},
+        attrs={"top_k": int(top_k),
+               "capacity_factor": float(capacity_factor), "act": act},
+    )
+    return out, aux

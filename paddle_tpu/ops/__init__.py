@@ -29,3 +29,4 @@ from paddle_tpu.ops import sampling_ops  # noqa: F401
 from paddle_tpu.ops import vision_ops  # noqa: F401
 from paddle_tpu.ops import quantize_ops  # noqa: F401
 from paddle_tpu.ops import fused_ops  # noqa: F401
+from paddle_tpu.ops import moe_ops  # noqa: F401

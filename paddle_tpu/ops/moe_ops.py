@@ -1,0 +1,138 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+The reference framework predates MoE entirely (like long-context —
+SURVEY.md §5.7); this is the TPU-native design that provides the expert
+(ep) axis of the parallelism story. Switch-Transformer-style routing in
+fully static shapes (XLA requirement): top-1/top-2 gating, a fixed
+per-expert capacity, einsum dispatch/combine tensors instead of
+scatter/gather, and the load-balancing auxiliary loss.
+
+Expert parallelism falls out of GSPMD: the stacked expert weights
+[E, ...] are sharded on dim 0 over a mesh axis
+(ParallelExecutor(sharding_overrides={"...moe...w": ("expert", ...)})),
+the [E, C, D] dispatched activations inherit that sharding, and XLA
+inserts the all-to-alls — no hand-written token exchange.
+
+Routing is non-differentiable by design (argmax); gradients flow through
+the gate probabilities via the combine weights, exactly the Switch
+Transformer formulation.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+
+_ACTS = {
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "identity": lambda v: v,
+}
+
+
+def _route_one(probs, base, capacity):
+    """Route each token to its best remaining expert. probs: [N, E]
+    (zeroed at experts already used by earlier routes); base: [E] queue
+    occupancy from earlier routes. Returns (expert_idx [N], gate [N],
+    dispatch [N, E, C] one-hot with over-capacity tokens dropped,
+    new base)."""
+    n, e = probs.shape
+    expert = jnp.argmax(probs, axis=-1)  # [N]
+    gate = jnp.max(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert, e, dtype=probs.dtype)  # [N, E]
+    # Position of each token within its expert's queue, in token order —
+    # the static-shape stand-in for a scatter with overflow dropping.
+    # Earlier routes' assignments (incl. dropped ones) advance the queue,
+    # so routes never collide in the [E, C] buffer.
+    pos = jnp.cumsum(onehot, axis=0) - onehot + base[None, :]  # [N, E]
+    pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [N]
+    keep = pos_tok < capacity
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(pos_tok, capacity, dtype=probs.dtype)[:, None, :]
+        * keep[:, None, None]
+    )  # [N, E, C]
+    return (expert, gate * keep, gate, dispatch,
+            base + jnp.sum(onehot, axis=0))
+
+
+def _lower_moe_ffn(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, D] or [N, D]
+    gate_w = ins["GateW"][0]  # [D, E]
+    w1 = ins["ExpertW1"][0]  # [E, D, H]
+    b1 = ins["ExpertB1"][0]  # [E, H]
+    w2 = ins["ExpertW2"][0]  # [E, H, D]
+    b2 = ins["ExpertB2"][0]  # [E, D]
+    top_k = int(attrs.get("top_k", 1))
+    cap_factor = float(attrs.get("capacity_factor", 1.25))
+    act = _ACTS[attrs.get("act", "gelu")]
+
+    orig_shape = jnp.shape(x)
+    d = orig_shape[-1]
+    xf = jnp.reshape(x, (-1, d))  # [N, D]
+    n = xf.shape[0]
+    e = gate_w.shape[1]
+    capacity = max(1, int(cap_factor * n * top_k / e))
+
+    logits = (xf @ gate_w).astype(jnp.float32)  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    combines = []
+    used = jnp.zeros_like(probs)
+    masked = probs
+    base = jnp.zeros((e,), probs.dtype)
+    for _ in range(top_k):
+        expert, gate, gate_raw, dispatch, base = _route_one(
+            masked, base, capacity)
+        combines.append((gate, gate_raw, dispatch))
+        used = used + jax.nn.one_hot(expert, e, dtype=probs.dtype)
+        masked = probs * (1.0 - used)
+    if top_k > 1:
+        # Switch/GShard renormalization: divide by the sum of the
+        # SELECTED (pre-drop) gates, so a token whose second route
+        # overflowed keeps weight g1/(g1+g2) on the surviving expert —
+        # not full weight 1.0.
+        total = sum(g_raw for _, g_raw, _ in combines) + 1e-9
+        combines = [(g / total, g_raw, disp)
+                    for g, g_raw, disp in combines]
+
+    # One dispatch/combine pair covers all k routes.
+    dispatch = sum(disp for _, _, disp in combines)  # [N, E, C]
+    combine = sum(
+        g[:, None, None] * disp for g, _, disp in combines
+    )  # [N, E, C]
+
+    xe = jnp.einsum(
+        "nec,nd->ecd", dispatch.astype(x.dtype), xf
+    )  # [E, C, D]
+    h = act(
+        jnp.einsum("ecd,edh->ech", xe, w1) + b1[:, None, :]
+    )
+    ye = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]  # [E, C, D]
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), ye)
+
+    # Switch load-balancing loss: E * sum_e f_e * P_e, where f_e is the
+    # fraction of tokens routed (top-1) to expert e and P_e the mean
+    # router probability — minimized at the uniform distribution.
+    f = jnp.mean(
+        jnp.sum(dispatch, axis=-1).astype(jnp.float32), axis=0
+    )  # [E]
+    p = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(f * p) / top_k
+
+    return {
+        "Out": jnp.reshape(out, orig_shape),
+        "AuxLoss": jnp.reshape(aux.astype(x.dtype), (1,)),
+    }
+
+
+register_op(
+    "moe_ffn",
+    inputs=["X", "GateW", "ExpertW1", "ExpertB1", "ExpertW2", "ExpertB2"],
+    outputs=["Out", "AuxLoss"],
+    attrs={"top_k": 1, "capacity_factor": 1.25, "act": "gelu"},
+    lower=_lower_moe_ffn,
+    grad="auto",
+)

@@ -110,7 +110,9 @@ def ring_attention(q, k, v, mesh, axis_name="data", causal=False,
 
 def _ulysses_shard(q, k, v, axis_name, causal, sm_scale):
     """Per-shard body. q,k,v: [B, H, Tl, d]; requires H % n == 0."""
-    from paddle_tpu.kernels.flash_attention import flash_attention_reference
+    # public entry: Pallas flash kernel on TPU targets, XLA reference on
+    # CPU (pallas_call composes with shard_map)
+    from paddle_tpu.kernels.flash_attention import flash_attention
 
     # [B, H, Tl, d] -> all_to_all -> [B, H/n, T, d]
     def seq_to_head(x):
@@ -126,9 +128,7 @@ def _ulysses_shard(q, k, v, axis_name, causal, sm_scale):
     qh = seq_to_head(q)
     kh = seq_to_head(k)
     vh = seq_to_head(v)
-    out = flash_attention_reference(
-        qh, kh, vh, causal=causal, sm_scale=sm_scale
-    )
+    out = flash_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
     return head_to_seq(out)
 
 

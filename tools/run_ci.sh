@@ -45,12 +45,18 @@ if want python; then
       *) merged="$merged $tok" ;;
     esac
   done
-  XLA_FLAGS="$merged" python -m pytest tests/ -q
+  # env -u PALLAS_AXON_POOL_IPS: the TPU-tunnel plugin registers itself
+  # at interpreter start when that var is set, and a WEDGED tunnel then
+  # hangs the first jax backend init even under JAX_PLATFORMS=cpu —
+  # CPU-only stages must not depend on tunnel health
+  XLA_FLAGS="$merged" env -u PALLAS_AXON_POOL_IPS \
+    python -m pytest tests/ -q
 fi
 
 if want dryrun; then
   echo "== multichip dryrun (dp+ZeRO / tp / sp / pp) =="
-  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 fi
 
 if want bench; then
