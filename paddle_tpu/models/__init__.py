@@ -7,6 +7,7 @@ from paddle_tpu.models import vgg  # noqa: F401
 from paddle_tpu.models import resnet  # noqa: F401
 from paddle_tpu.models import stacked_lstm  # noqa: F401
 from paddle_tpu.models import transformer  # noqa: F401
+from paddle_tpu.models import switch_transformer  # noqa: F401
 from paddle_tpu.models import machine_translation  # noqa: F401
 from paddle_tpu.models import se_resnext  # noqa: F401
 from paddle_tpu.models import googlenet  # noqa: F401

@@ -33,7 +33,9 @@ def _residual(x, y, dropout, is_test, name):
     return fluid.layers.elementwise_add(x, y)
 
 
-def encoder_layer(x, mask, n_head, d_model, d_inner, dropout, is_test, name):
+def _self_attention_block(x, mask, n_head, d_model, dropout, is_test, name):
+    """Pre-norm self-attention + residual — the shared first half of an
+    encoder layer (dense-FFN here, MoE-FFN in switch_transformer)."""
     attn = fluid.layers.multi_head_attention(
         _prenorm(x, name + "_attn"), None, None,
         d_key=d_model // n_head,
@@ -44,7 +46,12 @@ def encoder_layer(x, mask, n_head, d_model, d_inner, dropout, is_test, name):
         is_test=is_test,
         name=name + "_mha",
     )
-    x = _residual(x, attn, dropout, is_test, name + "_res1")
+    return _residual(x, attn, dropout, is_test, name + "_res1")
+
+
+def encoder_layer(x, mask, n_head, d_model, d_inner, dropout, is_test, name):
+    x = _self_attention_block(x, mask, n_head, d_model, dropout, is_test,
+                              name)
     ff = _ffn(_prenorm(x, name + "_ffn"), d_model, d_inner, name + "_ffn")
     return _residual(x, ff, dropout, is_test, name + "_res2")
 

@@ -114,13 +114,15 @@ def _lower_moe_ffn(ctx, ins, attrs):
     out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), ye)
 
     # Switch load-balancing loss: E * sum_e f_e * P_e, where f_e is the
-    # fraction of tokens routed (top-1) to expert e and P_e the mean
-    # router probability — minimized at the uniform distribution.
-    f = jnp.mean(
-        jnp.sum(dispatch, axis=-1).astype(jnp.float32), axis=0
-    )  # [E]
+    # fraction of tokens whose TOP-1 router choice is expert e — the
+    # PRE-capacity-drop assignment (switch_transformer paper eq. 4).
+    # Computing f from the post-drop dispatch would cap it at
+    # capacity/N, saturating the loss exactly when routing collapses
+    # onto one expert and it needs the strongest push.
+    top1 = jnp.argmax(probs, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
     p = jnp.mean(probs, axis=0)
-    aux = e * jnp.sum(f * p) / top_k
+    aux = e * jnp.sum(f * p)
 
     return {
         "Out": jnp.reshape(out, orig_shape),

@@ -119,11 +119,13 @@ def test_moe_capacity_drops_overflow():
 
 
 def test_moe_aux_loss_prefers_balance():
-    """The load-balancing loss is minimized at uniform routing: a gate
-    that spreads tokens evenly scores lower than one that collapses
-    onto a single expert."""
+    """The load-balancing loss is minimized at uniform routing and must
+    see routing collapse at FULL strength even when capacity drops most
+    of the collapsed tokens (f comes from pre-drop router assignments:
+    switch_transformer paper eq. 4; a post-drop f would saturate at the
+    capacity cap and stop penalizing exactly when pressure is needed)."""
     e, h, d = 4, 8, 8
-    main, startup, x, out, aux, _ = _build(e=e, h=h, d=d, cap=8.0)
+    main, startup, x, out, aux, _ = _build(e=e, h=h, d=d, cap=1.0)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
     scope = fluid.global_scope()
@@ -141,8 +143,12 @@ def test_moe_aux_loss_prefers_balance():
         balanced[j, j % e] = 4.0  # distinct one-hot rows -> spread
     scope.set_value(gate_name, balanced)
     (aux_balanced,) = exe.run(main, feed={"x": xv}, fetch_list=[aux])
-    assert float(np.ravel(aux_balanced)[0]) < float(
-        np.ravel(aux_collapsed)[0])
+    a_col = float(np.ravel(aux_collapsed)[0])
+    a_bal = float(np.ravel(aux_balanced)[0])
+    assert a_bal < a_col
+    # full collapse onto one expert scores ~E (here 4), not the ~1.0 a
+    # post-capacity-drop f would report
+    assert a_col > 0.5 * e, a_col
 
 
 def test_moe_trains_with_aux():
@@ -232,3 +238,35 @@ def test_moe_named_param_attr_creates_distinct_params():
     xv = np.zeros((2, 4, 6), "float32")
     (ov,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
     assert np.asarray(ov).shape == (2, 4, 6)
+
+
+def test_switch_transformer_model_trains():
+    """models/switch_transformer: MoE encoder classifier learns a
+    separable toy task (first-token parity decides the class)."""
+    from paddle_tpu.models import switch_transformer
+
+    vocab, seq = 20, 8
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 21
+    startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        loss, feeds, extras = switch_transformer.build(
+            vocab_size=vocab, max_length=seq, n_layer=2, n_head=2,
+            d_model=16, d_inner=32, num_experts=4, top_k=1,
+            moe_every=2, num_classes=2)
+        fluid.optimizer.Adam(3e-3).minimize(loss)
+    assert extras["aux_loss"] is not None  # one MoE layer present
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(22)
+    losses = []
+    for _ in range(90):
+        w = rng.randint(1, vocab, (16, seq)).astype("int64")
+        y = (w[:, :1] % 2).astype("int64")
+        (lv,) = exe.run(
+            main,
+            feed={"word": w, "seq_len": np.full((16, 1), seq, "int64"),
+                  "label": y},
+            fetch_list=[extras["ce_loss"]])
+        losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
