@@ -151,13 +151,16 @@ def moe_ffn(
     top_k=1,
     capacity_factor=1.25,
     act="gelu",
+    mask=None,
     param_attr=None,
     name=None,
 ):
     """Mixture-of-Experts feed-forward block (Switch-Transformer style;
     ops/moe_ops.py). x: [batch, seq, d_model]; returns (out, aux_loss) —
     add ``aux_loss`` (scaled, typically by 1e-2) to the training loss to
-    balance expert load.
+    balance expert load. ``mask`` ([batch, seq] validity, 1 = real
+    token) keeps padding out of routing: pads consume no expert
+    capacity and are excluded from the load-balancing statistics.
 
     Expert parallelism: shard the stacked expert parameters on dim 0
     over a mesh axis via ParallelExecutor(sharding_overrides=...); GSPMD
@@ -195,10 +198,13 @@ def moe_ffn(
         default_initializer=initializer.Constant(0.0))
     out = helper.create_variable_for_type_inference(x.dtype)
     aux = helper.create_variable_for_type_inference(x.dtype)
+    op_inputs = {"X": [x], "GateW": [gate_w], "ExpertW1": [w1],
+                 "ExpertB1": [b1], "ExpertW2": [w2], "ExpertB2": [b2]}
+    if mask is not None:
+        op_inputs["Mask"] = [mask]
     helper.append_op(
         type="moe_ffn",
-        inputs={"X": [x], "GateW": [gate_w], "ExpertW1": [w1],
-                "ExpertB1": [b1], "ExpertW2": [w2], "ExpertB2": [b2]},
+        inputs=op_inputs,
         outputs={"Out": [out], "AuxLoss": [aux]},
         attrs={"top_k": int(top_k),
                "capacity_factor": float(capacity_factor), "act": act},

@@ -17,6 +17,7 @@ from paddle_tpu.models.transformer import (
     _prenorm,
     _residual,
     _self_attention_block,
+    encoder_layer,
 )
 
 
@@ -26,7 +27,7 @@ def _moe_encoder_layer(x, mask, n_head, d_model, d_inner, num_experts,
                               name)
     ff, aux = fluid.layers.moe_ffn(
         _prenorm(x, name + "_ffn"), num_experts=num_experts,
-        d_hidden=d_inner, top_k=top_k,
+        d_hidden=d_inner, top_k=top_k, mask=mask,
         param_attr=fluid.ParamAttr(name=name + "_moe"),
         name=name + "_moe",
     )
@@ -51,8 +52,6 @@ def build(
     """Sequence classifier over a Switch encoder stack. Returns
     (loss, feeds, extras): extras carries ``logits`` and the summed
     ``aux_loss``. Feeds: word [B, T], seq_len [B, 1], label [B, 1]."""
-    from paddle_tpu.models import transformer as tf
-
     word = fluid.layers.data("word", shape=[max_length], dtype="int64")
     seq_len = fluid.layers.data("seq_len", shape=[1], dtype="int64")
     label = fluid.layers.data("label", shape=[1], dtype="int64")
@@ -74,7 +73,7 @@ def build(
                 dropout, is_test, name)
             aux_losses.append(aux)
         else:
-            h = tf.encoder_layer(
+            h = encoder_layer(
                 h, mask, n_head, d_model, d_inner, dropout, is_test, name)
     h = _prenorm(h, "switch_final")
 

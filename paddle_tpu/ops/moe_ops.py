@@ -32,16 +32,19 @@ _ACTS = {
 }
 
 
-def _route_one(probs, base, capacity):
+def _route_one(probs, base, capacity, valid=None):
     """Route each token to its best remaining expert. probs: [N, E]
     (zeroed at experts already used by earlier routes); base: [E] queue
-    occupancy from earlier routes. Returns (expert_idx [N], gate [N],
-    dispatch [N, E, C] one-hot with over-capacity tokens dropped,
-    new base)."""
+    occupancy from earlier routes; valid: optional [N] token validity
+    (invalid tokens occupy no queue slots). Returns (expert_idx [N],
+    gate [N], gate_raw [N], dispatch [N, E, C] one-hot with
+    over-capacity tokens dropped, new base)."""
     n, e = probs.shape
     expert = jnp.argmax(probs, axis=-1)  # [N]
     gate = jnp.max(probs, axis=-1)
     onehot = jax.nn.one_hot(expert, e, dtype=probs.dtype)  # [N, E]
+    if valid is not None:
+        onehot = onehot * valid[:, None]
     # Position of each token within its expert's queue, in token order —
     # the static-shape stand-in for a scatter with overflow dropping.
     # Earlier routes' assignments (incl. dropped ones) advance the queue,
@@ -65,6 +68,7 @@ def _lower_moe_ffn(ctx, ins, attrs):
     b1 = ins["ExpertB1"][0]  # [E, H]
     w2 = ins["ExpertW2"][0]  # [E, H, D]
     b2 = ins["ExpertB2"][0]  # [E, D]
+    tok_mask = ins.get("Mask", [None])[0]  # optional [B, T] validity
     top_k = int(attrs.get("top_k", 1))
     cap_factor = float(attrs.get("capacity_factor", 1.25))
     act = _ACTS[attrs.get("act", "gelu")]
@@ -78,6 +82,16 @@ def _lower_moe_ffn(ctx, ins, attrs):
 
     logits = (xf @ gate_w).astype(jnp.float32)  # [N, E]
     probs = jax.nn.softmax(logits, axis=-1)
+    if tok_mask is not None:
+        # Padding tokens must not route: they would consume shared expert
+        # capacity (dropping REAL tokens' outputs) and dominate the
+        # load-balancing statistics. Zeroing their probs gives them gate
+        # 0 everywhere; _route_one's onehot is also zeroed below so they
+        # occupy no queue slots.
+        valid = (jnp.reshape(tok_mask, (-1,)) > 0).astype(probs.dtype)
+        probs = probs * valid[:, None]
+    else:
+        valid = None
 
     combines = []
     used = jnp.zeros_like(probs)
@@ -85,7 +99,7 @@ def _lower_moe_ffn(ctx, ins, attrs):
     base = jnp.zeros((e,), probs.dtype)
     for _ in range(top_k):
         expert, gate, gate_raw, dispatch, base = _route_one(
-            masked, base, capacity)
+            masked, base, capacity, valid)
         combines.append((gate, gate_raw, dispatch))
         used = used + jax.nn.one_hot(expert, e, dtype=probs.dtype)
         masked = probs * (1.0 - used)
@@ -118,10 +132,17 @@ def _lower_moe_ffn(ctx, ins, attrs):
     # PRE-capacity-drop assignment (switch_transformer paper eq. 4).
     # Computing f from the post-drop dispatch would cap it at
     # capacity/N, saturating the loss exactly when routing collapses
-    # onto one expert and it needs the strongest push.
+    # onto one expert and it needs the strongest push. With a token
+    # mask, both statistics run over VALID tokens only.
     top1 = jnp.argmax(probs, axis=-1)
-    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
-    p = jnp.mean(probs, axis=0)
+    oh1 = jax.nn.one_hot(top1, e, dtype=jnp.float32)
+    if valid is not None:
+        oh1 = oh1 * valid[:, None]
+        denom = jnp.maximum(jnp.sum(valid), 1.0)
+    else:
+        denom = float(n)
+    f = jnp.sum(oh1, axis=0) / denom
+    p = jnp.sum(probs, axis=0) / denom
     aux = e * jnp.sum(f * p)
 
     return {
@@ -132,9 +153,11 @@ def _lower_moe_ffn(ctx, ins, attrs):
 
 register_op(
     "moe_ffn",
-    inputs=["X", "GateW", "ExpertW1", "ExpertB1", "ExpertW2", "ExpertB2"],
+    inputs=["X", "GateW", "ExpertW1", "ExpertB1", "ExpertW2", "ExpertB2",
+            "Mask"],
     outputs=["Out", "AuxLoss"],
     attrs={"top_k": 1, "capacity_factor": 1.25, "act": "gelu"},
     lower=_lower_moe_ffn,
     grad="auto",
+    no_grad_inputs=("Mask",),
 )

@@ -270,3 +270,33 @@ def test_switch_transformer_model_trains():
             fetch_list=[extras["ce_loss"]])
         losses.append(float(np.ravel(lv)[0]))
     assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+
+def test_moe_mask_keeps_padding_out_of_routing():
+    """With a token mask: padded tokens get zero output, consume no
+    expert capacity (a real token still gets its slot even when pads
+    would have filled the queue first), and the aux statistics run over
+    valid tokens only."""
+    e, h, d = 2, 8, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6, d])
+        m = fluid.layers.data("m", [6])
+        out, aux = fluid.layers.moe_ffn(
+            x, num_experts=e, d_hidden=h, capacity_factor=0.5, mask=m,
+            name="mk")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(30)
+    xv = rng.randn(1, 6, d).astype("float32")
+    # only the LAST token is real; with pads routing, the capacity-0.5
+    # queues (capacity max(1, 0.5*6/2)=1) would be full before it
+    mv = np.zeros((1, 6), "float32")
+    mv[0, -1] = 1.0
+    ov, av = exe.run(main, feed={"x": xv, "m": mv},
+                     fetch_list=[out, aux])
+    ov = np.asarray(ov)[0]
+    assert (np.abs(ov[:-1]).sum(-1) < 1e-7).all()  # pads: zero output
+    assert np.abs(ov[-1]).sum() > 1e-4  # the real token was served
+    # aux over the single valid token: f is one-hot -> aux = E * p_e <= E
+    assert 0.0 < float(np.ravel(av)[0]) <= e + 1e-5
