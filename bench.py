@@ -397,6 +397,10 @@ def _run_worker(model, platform, timeout_s):
     env = dict(os.environ, BENCH_MODEL=model)
     if platform == "cpu":
         env["BENCH_PLATFORM"] = "cpu"
+        # the TPU-tunnel plugin registers at interpreter start via this
+        # var, and a WEDGED tunnel then hangs the first jax backend init
+        # even on a CPU-only worker — exactly the fallback-path scenario
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     try:
         rc, stdout, stderr = _run_isolated(
             [sys.executable, os.path.abspath(__file__), "--worker"],
