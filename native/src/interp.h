@@ -138,6 +138,15 @@ class Interpreter {
     // update ops a minimize()'d MLP program serializes
     if (op.type == "fill_constant") return RunFillConstant(op, scope);
     if (op.type == "uniform_random") return RunUniformRandom(op, scope);
+    // transformer serving subset (inference/api_impl.cc parity for the
+    // attention-era models): layer_norm + transpose + fused attention
+    if (op.type == "layer_norm") return RunLayerNorm(op, scope);
+    if (op.type == "transpose" || op.type == "transpose2") {
+      return RunTranspose(op, scope);
+    }
+    if (op.type == "sequence_mask") return RunSequenceMask(op, scope);
+    if (op.type == "scaled_dot_product_attention") return RunSDPA(op, scope);
+    if (op.type == "reduce_mean") return RunReduceMean(op, scope);
     if (op.type == "mean_grad") return RunMeanGrad(op, scope);
     if (op.type == "relu_grad") return RunReluGrad(op, scope);
     if (op.type == "softmax_with_cross_entropy_grad") {
@@ -188,6 +197,263 @@ class Interpreter {
       return fallback;
     }
     return it->second.s;
+  }
+
+  // layer_norm_op.cc role: normalize over the trailing dims from
+  // begin_norm_axis; Scale/Bias are flat [prod(trailing)] (mirrors
+  // ops/nn_ops.py _lower_layer_norm).
+  std::string RunLayerNorm(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* yn = OneName(op, "Y", false);
+    if (xn == nullptr || yn == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr || !IsF32(*x)) return "bad input";
+    int64_t begin = IntAttr(op, "begin_norm_axis", 1);
+    float eps = FloatAttr(op, "epsilon", 1e-5f);
+    if (begin < 1 || begin >= static_cast<int64_t>(x->dims.size())) {
+      return "bad begin_norm_axis";
+    }
+    int64_t rows = 1, inner = 1;
+    for (int64_t d = 0; d < begin; ++d) rows *= x->dims[d];
+    for (size_t d = begin; d < x->dims.size(); ++d) inner *= x->dims[d];
+    const std::string* sn = OneName(op, "Scale");
+    const std::string* bn = OneName(op, "Bias");
+    const HostTensor* sc = sn != nullptr ? scope->Find(*sn) : nullptr;
+    const HostTensor* bi = bn != nullptr ? scope->Find(*bn) : nullptr;
+    if (sc != nullptr && NumElements(sc->dims) != inner) return "bad scale";
+    if (bi != nullptr && NumElements(bi->dims) != inner) return "bad bias";
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t r = 0; r < rows; ++r) {
+      const float* src = xa + r * inner;
+      float* dst = oa + r * inner;
+      double mean = 0.0;
+      for (int64_t i = 0; i < inner; ++i) mean += src[i];
+      mean /= inner;
+      double var = 0.0;
+      for (int64_t i = 0; i < inner; ++i) {
+        double dv = src[i] - mean;
+        var += dv * dv;
+      }
+      var /= inner;
+      float inv = 1.0f / std::sqrt(static_cast<float>(var) + eps);
+      for (int64_t i = 0; i < inner; ++i) {
+        float v = (src[i] - static_cast<float>(mean)) * inv;
+        if (sc != nullptr) v *= F32(*sc)[i];
+        if (bi != nullptr) v += F32(*bi)[i];
+        dst[i] = v;
+      }
+    }
+    scope->Set(*yn, std::move(out));
+    return "";
+  }
+
+  // transpose_op.cc role: general permutation via strides.
+  std::string RunTranspose(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr || !IsF32(*x)) return "bad input";
+    std::vector<int64_t> perm = IntsAttr(op, "axis", {});
+    size_t rank = x->dims.size();
+    if (perm.size() != rank) return "bad perm";
+    std::vector<int64_t> odims(rank);
+    for (size_t d = 0; d < rank; ++d) odims[d] = x->dims[perm[d]];
+    std::vector<int64_t> xstride(rank, 1), ostride(rank, 1);
+    for (int64_t d = static_cast<int64_t>(rank) - 2; d >= 0; --d) {
+      xstride[d] = xstride[d + 1] * x->dims[d + 1];
+      ostride[d] = ostride[d + 1] * odims[d + 1];
+    }
+    HostTensor out = MakeF32(odims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    int64_t total = NumElements(odims);
+    for (int64_t idx = 0; idx < total; ++idx) {
+      int64_t rem = idx, src = 0;
+      for (size_t d = 0; d < rank; ++d) {
+        int64_t coord = rem / ostride[d];
+        rem -= coord * ostride[d];
+        src += coord * xstride[perm[d]];
+      }
+      oa[idx] = xa[src];
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // sequence_mask_op.cc role: [B] (or [B, 1]) lengths -> [B, maxlen] f32.
+  std::string RunSequenceMask(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Y", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "bad input";
+    int64_t maxlen = IntAttr(op, "maxlen", -1);
+    if (maxlen <= 0) return "needs static maxlen";
+    std::vector<int64_t> lens;
+    std::string err = ReadIds(*x, &lens);
+    if (!err.empty()) return err;
+    HostTensor out = MakeF32({static_cast<int64_t>(lens.size()), maxlen});
+    float* oa = MutF32(&out);
+    for (size_t b = 0; b < lens.size(); ++b) {
+      for (int64_t t = 0; t < maxlen; ++t) {
+        oa[b * maxlen + t] = t < lens[b] ? 1.0f : 0.0f;
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // Fused attention (ops/attention_ops.py reference semantics):
+  // q,k,v [B, H, T, d]; optional Mask [B, S] validity or [B, 1|H, T, S];
+  // softmax((q k^T) * sm_scale + causal/key masks) v, all f32.
+  std::string RunSDPA(const OpDesc& op, Scope* scope) {
+    const std::string* qn = OneName(op, "Q");
+    const std::string* kn = OneName(op, "K");
+    const std::string* vn = OneName(op, "V");
+    const std::string* on = OneName(op, "Out", false);
+    if (qn == nullptr || kn == nullptr || vn == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* q = scope->Find(*qn);
+    const HostTensor* k = scope->Find(*kn);
+    const HostTensor* v = scope->Find(*vn);
+    if (q == nullptr || k == nullptr || v == nullptr) return "bad input";
+    if (!IsF32(*q) || !IsF32(*k) || !IsF32(*v)) return "non-f32";
+    if (q->dims.size() != 4 || k->dims.size() != 4) return "needs [B,H,T,d]";
+    if (!StrAttr(op, "seq_parallel_axis", "").empty()) {
+      return "seq_parallel_axis needs the XLA path";
+    }
+    int64_t B = q->dims[0], H = q->dims[1], T = q->dims[2], d = q->dims[3];
+    int64_t S = k->dims[2];
+    // full MHA only (no GQA broadcasting in the C++ path): K and V must
+    // agree with Q on batch/heads/depth and with each other on S —
+    // anything else would walk off the buffers below
+    if (k->dims[0] != B || k->dims[1] != H || k->dims[3] != d) {
+      return "K shape mismatch";
+    }
+    if (v->dims != k->dims) return "V shape mismatch";
+    bool causal = IntAttr(op, "causal", 0) != 0;
+    float scale = FloatAttr(op, "sm_scale", 0.0f);
+    if (scale == 0.0f) scale = 1.0f / std::sqrt(static_cast<float>(d));
+    const std::string* mn = OneName(op, "Mask");
+    const HostTensor* mask = mn != nullptr ? scope->Find(*mn) : nullptr;
+    if (mask != nullptr &&
+        (mask->dims.size() != 2 || mask->dims[0] != B ||
+         mask->dims[1] != S)) {
+      return "only [B, S] key-validity masks in the C++ path";
+    }
+    HostTensor out = MakeF32(q->dims);
+    const float* qa = F32(*q);
+    const float* ka = F32(*k);
+    const float* va = F32(*v);
+    const float* ma = mask != nullptr ? F32(*mask) : nullptr;
+    float* oa = MutF32(&out);
+    std::vector<float> s(S);
+    for (int64_t b = 0; b < B; ++b) {
+      for (int64_t h = 0; h < H; ++h) {
+        const float* kb = ka + (b * H + h) * S * d;
+        const float* vb = va + (b * H + h) * S * d;
+        for (int64_t t = 0; t < T; ++t) {
+          const float* qr = qa + ((b * H + h) * T + t) * d;
+          float mx = -1e30f;
+          bool any_valid = false;
+          for (int64_t j = 0; j < S; ++j) {
+            bool valid = (!causal || j <= t) &&
+                         (ma == nullptr || ma[b * S + j] > 0.0f);
+            if (valid) {
+              any_valid = true;
+              float dot = 0.0f;
+              for (int64_t c = 0; c < d; ++c) dot += qr[c] * kb[j * d + c];
+              s[j] = dot * scale;
+              if (s[j] > mx) mx = s[j];
+            } else {
+              s[j] = -1e30f;
+            }
+          }
+          float* orow = oa + ((b * H + h) * T + t) * d;
+          for (int64_t c = 0; c < d; ++c) orow[c] = 0.0f;
+          // fully-masked rows output 0, the Pallas kernel contract
+          // (docs/LONG_CONTEXT.md) — NOT the uniform average the
+          // exp(-1e30 - -1e30) arithmetic would produce
+          if (!any_valid) continue;
+          float denom = 0.0f;
+          for (int64_t j = 0; j < S; ++j) {
+            s[j] = std::exp(s[j] - mx);
+            denom += s[j];
+          }
+          if (denom <= 0.0f) denom = 1.0f;
+          for (int64_t j = 0; j < S; ++j) {
+            float p = s[j] / denom;
+            for (int64_t c = 0; c < d; ++c) orow[c] += p * vb[j * d + c];
+          }
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // reduce_mean over the attrs' dim list (keep_dim supported).
+  std::string RunReduceMean(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr || !IsF32(*x)) return "bad input";
+    size_t rank = x->dims.size();
+    std::vector<int64_t> dims = IntsAttr(op, "dim", {0});
+    bool keep = IntAttr(op, "keep_dim", 0) != 0;
+    std::vector<bool> reduced(rank, false);
+    if (IntAttr(op, "reduce_all", 0) != 0) {
+      reduced.assign(rank, true);
+    } else {
+      for (int64_t d : dims) {
+        if (d < 0) d += rank;
+        if (d < 0 || d >= static_cast<int64_t>(rank)) return "bad dim";
+        reduced[d] = true;
+      }
+    }
+    std::vector<int64_t> odims;
+    for (size_t d = 0; d < rank; ++d) {
+      if (!reduced[d]) {
+        odims.push_back(x->dims[d]);
+      } else if (keep) {
+        odims.push_back(1);
+      }
+    }
+    if (odims.empty()) odims.push_back(1);
+    std::vector<int64_t> xstride(rank, 1);
+    for (int64_t d = static_cast<int64_t>(rank) - 2; d >= 0; --d) {
+      xstride[d] = xstride[d + 1] * x->dims[d + 1];
+    }
+    HostTensor out = MakeF32(odims);
+    float* oa = MutF32(&out);
+    int64_t on_elems = NumElements(odims);
+    std::fill(oa, oa + on_elems, 0.0f);
+    const float* xa = F32(*x);
+    int64_t total = NumElements(x->dims);
+    int64_t denom = 1;
+    for (size_t d = 0; d < rank; ++d) {
+      if (reduced[d]) denom *= x->dims[d];
+    }
+    for (int64_t idx = 0; idx < total; ++idx) {
+      int64_t rem = idx, oidx = 0;
+      // output index folds in the non-reduced coords, row-major
+      for (size_t d = 0; d < rank; ++d) {
+        int64_t coord = rem / xstride[d];
+        rem -= coord * xstride[d];
+        if (!reduced[d]) {
+          oidx = oidx * x->dims[d] + coord;
+        }
+      }
+      oa[oidx] += xa[idx];
+    }
+    for (int64_t i = 0; i < on_elems; ++i) oa[i] /= denom;
+    scope->Set(*on, std::move(out));
+    return "";
   }
 
   // NCHW direct convolution (conv_op.cc CPU kernel role): strides,

@@ -223,3 +223,37 @@ def test_native_interp_runs_lstm_classifier(tmp_path):
         NativeConfig(model_dir=path, use_tpu=False))
     got = predictor.run_native_reference(feed)
     np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_native_interp_runs_transformer_encoder(tmp_path):
+    """The C++ interpreter serves a transformer encoder block end to end
+    (layer_norm, transpose, fused scaled_dot_product_attention with a
+    key-validity mask, sequence_mask, reduce_mean), matching the XLA
+    path — the attention-era analog of the CNN serving tests."""
+    from paddle_tpu.models.transformer import encoder_layer
+
+    rng = np.random.RandomState(17)
+    T, D = 6, 16
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [T, D])
+        ln = fluid.layers.data("len", [1], dtype="int64")
+        m = fluid.layers.sequence_mask(ln, maxlen=T, dtype="float32")
+        h = encoder_layer(x, m, 4, D, 32, 0.0, True, "enc0")
+        h = fluid.layers.layer_norm(h, begin_norm_axis=2, name="enc_final")
+        out = fluid.layers.reduce_mean(h, dim=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {
+        "x": rng.randn(3, T, D).astype("float32"),
+        "len": np.asarray([[6], [4], [1]], "int64"),
+    }
+    test_prog = main.clone(for_test=True)
+    (want,) = exe.run(test_prog, feed=feed, fetch_list=[out])
+    path = str(tmp_path / "model")
+    fluid.io.save_inference_model(path, ["x", "len"], [out], exe,
+                                  main_program=main)
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=path, use_tpu=False))
+    got = predictor.run_native_reference(feed)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4, atol=1e-5)
