@@ -485,6 +485,21 @@ def main():
     elif tpu_kind is None:
         errors["tpu"] = "tpu-unavailable (probe failed or timed out); " \
                         "values are cpu proxies"
+        # surface the most recent on-chip capture so a degraded round
+        # record still carries the hardware numbers (the tunnel wedges
+        # unpredictably; BENCH_NOTES.md documents each window)
+        import glob
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        manuals = sorted(glob.glob(
+            os.path.join(here, "BENCH_r*_manual.json")))
+        if manuals:
+            try:
+                with open(manuals[-1]) as f:
+                    out["last_tpu_capture"] = json.load(f)
+                out["last_tpu_capture_file"] = os.path.basename(manuals[-1])
+            except (OSError, ValueError):
+                pass
     elif primary is not None and primary.get("platform") == "tpu":
         # only label the capture with the chip when the HEADLINE result
         # actually ran there — CPU-proxy retries must not masquerade as

@@ -61,6 +61,10 @@ else
   run transformer-fp32 BENCH_MODEL=transformer BENCH_AMP=0
   run transformer-bs128 BENCH_MODEL=transformer BENCH_BS=128
   run transformer-refattn BENCH_MODEL=transformer FLAGS_attention_impl=reference
+  # long-context leg: seq 1024 (16x the default attention area) — the
+  # regime the flash fwd+bwd kernels exist for; reference attention at
+  # this size materializes 4 GiB of [B,H,T,S] scores per direction
+  run transformer-seq1024 BENCH_MODEL=transformer BENCH_SEQ=1024 BENCH_BS=16
 fi
 
 echo "== kernels =="
