@@ -139,11 +139,63 @@ def _on_tpu():
     return any(d.platform != "cpu" for d in jax.devices())
 
 
+_FAMILIES = ("dynamic_lstm", "dynamic_gru", "flash_attention")
+
+
+def _orchestrate(args):
+    """Run each kernel family in its OWN subprocess under a deadline:
+    a crash OR a hang (the tunnel wedging mid-run — the way the first
+    hardware window lost every verdict) costs one family, and rows a
+    child printed before dying still reach the log and the summary."""
+    import subprocess
+    import sys
+
+    all_rows = []
+    for fam in _FAMILIES:
+        cmd = [sys.executable, os.path.abspath(__file__), "--family", fam]
+        if args.quick:
+            cmd.append("--quick")
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=int(os.environ.get("KERNEL_BENCH_FAMILY_TIMEOUT",
+                                           "900")))
+            stderr, rc = proc.stderr, proc.returncode
+            stdout = proc.stdout
+        except subprocess.TimeoutExpired as e:
+            stdout = (e.stdout or b"").decode() if isinstance(
+                e.stdout, bytes) else (e.stdout or "")
+            stderr = "family timed out (wedged backend?)"
+            rc = -1
+        for line in stdout.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            print(line)
+            try:
+                all_rows.append(json.loads(line))
+            except ValueError:
+                pass
+        if rc != 0:
+            sys.stderr.write(stderr[-6000:] + "\n")
+            print(json.dumps({"kernel": fam,
+                              "error": "family rc=%s; stderr tail above"
+                              % rc}))
+    return all_rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes + few steps (CPU smoke)")
+    ap.add_argument("--family", choices=_FAMILIES,
+                    help="internal: run ONE family in this process")
     args = ap.parse_args()
+
+    if args.family is None:
+        all_rows = _orchestrate(args)
+        _print_verdicts(all_rows)
+        return
 
     import jax
 
@@ -160,40 +212,33 @@ def main():
         rnn_shapes = [(32, 128, 256), (64, 256, 512), (16, 512, 1024)]
         fa_shapes = [(8, 8, 1024, 64), (4, 8, 2048, 64), (2, 8, 4096, 128)]
 
-    # Each family is independent: one kernel crashing (or wedging the
-    # tunnel mid-run) must not cost the other families' verdicts — the
-    # first hardware window died exactly that way.
-    all_rows = []
-    families = [
-        ("dynamic_lstm", lambda: _bench_rnn(
-            fluid, "dynamic_lstm", "use_pallas_lstm", rnn_shapes, steps,
-            warmup)),
-        ("dynamic_gru", lambda: _bench_rnn(
-            fluid, "dynamic_gru", "use_pallas_gru", rnn_shapes, steps,
-            warmup)),
-        ("flash_attention", lambda: _bench_flash(
-            fluid, fa_shapes, steps, warmup)),
-    ]
-    for fam_name, runner in families:
-        try:
-            all_rows += runner()
-        except Exception as e:  # noqa: BLE001 - record, keep benching
-            print(json.dumps({
-                "kernel": fam_name,
-                "error": "%s: %s" % (type(e).__name__, str(e)[:500]),
-            }))
+    # child mode: exactly one family, crash loudly (the parent records
+    # the traceback from stderr and keeps the other families)
+    if args.family == "dynamic_lstm":
+        _bench_rnn(fluid, "dynamic_lstm", "use_pallas_lstm", rnn_shapes,
+                   steps, warmup)
+    elif args.family == "dynamic_gru":
+        _bench_rnn(fluid, "dynamic_gru", "use_pallas_gru", rnn_shapes,
+                   steps, warmup)
+    else:
+        _bench_flash(fluid, fa_shapes, steps, warmup)
+
+
+def _print_verdicts(all_rows):
+    import numpy as np
 
     summary = {}
     for row in all_rows:
-        summary.setdefault(row["kernel"], []).append(row["speedup"])
+        if "speedup" in row:
+            summary.setdefault(row["kernel"], []).append(row["speedup"])
     verdicts = {
         k: {"geomean_speedup": round(
-            float(__import__("numpy").prod(v)) ** (1.0 / len(v)), 3),
+            float(np.prod(v)) ** (1.0 / len(v)), 3),
             "recommend_default": "pallas"
             if all(s > 1.05 for s in v) else "xla"}
         for k, v in summary.items()
     }
-    print(json.dumps({"on_tpu": _on_tpu(), "verdicts": verdicts}))
+    print(json.dumps({"verdicts": verdicts}))
 
 
 if __name__ == "__main__":
