@@ -54,6 +54,7 @@ def multi_head_attention(
     d_value,
     d_model,
     n_head=1,
+    n_kv_head=None,
     dropout_rate=0.0,
     mask=None,
     causal=False,
@@ -65,6 +66,12 @@ def multi_head_attention(
 
     queries/keys/values: [batch, seq, d_model]; returns [batch, seq,
     d_model]. All four projections are single fused matmuls (MXU-sized).
+
+    ``n_kv_head`` enables grouped-query attention (GQA; beyond the
+    reference): K/V are projected to n_kv_head heads (n_head must be a
+    multiple) and repeated per query group before the fused kernel —
+    the K/V projection weights and any cached K/V shrink by
+    n_head/n_kv_head. n_kv_head=1 is multi-query attention.
     """
     from paddle_tpu.layers import nn as nn_layers
 
@@ -73,30 +80,46 @@ def multi_head_attention(
     if values is None:
         values = keys
 
+    kv_heads = n_head if n_kv_head is None else int(n_kv_head)
+    if kv_heads < 1 or n_head % kv_heads != 0:
+        raise ValueError(
+            "multi_head_attention: n_kv_head (%d) must be >= 1 and "
+            "divide n_head (%d)" % (kv_heads, n_head))
     q = nn_layers.fc(
         input=queries, size=d_key * n_head, num_flatten_dims=2,
         bias_attr=False, param_attr=param_attr,
         name=(name + "_q") if name else None,
     )
     k = nn_layers.fc(
-        input=keys, size=d_key * n_head, num_flatten_dims=2,
+        input=keys, size=d_key * kv_heads, num_flatten_dims=2,
         bias_attr=False, param_attr=param_attr,
         name=(name + "_k") if name else None,
     )
     v = nn_layers.fc(
-        input=values, size=d_value * n_head, num_flatten_dims=2,
+        input=values, size=d_value * kv_heads, num_flatten_dims=2,
         bias_attr=False, param_attr=param_attr,
         name=(name + "_v") if name else None,
     )
 
-    def split_heads(x, d_head):
+    def split_heads(x, d_head, heads):
         # [B, T, H*dh] -> [B, H, T, dh]
-        reshaped = nn_layers.reshape(x, shape=[0, 0, n_head, d_head])
+        reshaped = nn_layers.reshape(x, shape=[0, 0, heads, d_head])
         return nn_layers.transpose(reshaped, perm=[0, 2, 1, 3])
 
-    qh = split_heads(q, d_key)
-    kh = split_heads(k, d_key)
-    vh = split_heads(v, d_value)
+    def repeat_kv(x, d_head):
+        # [B, Hkv, T, dh] -> [B, H, T, dh]: each kv head serves
+        # n_head // kv_heads query heads (XLA folds the broadcast)
+        group = n_head // kv_heads
+        if group == 1:
+            return x
+        expanded = nn_layers.expand(
+            nn_layers.unsqueeze(x, axes=[2]),
+            expand_times=[1, 1, group, 1, 1])
+        return nn_layers.reshape(expanded, shape=[0, n_head, -1, d_head])
+
+    qh = split_heads(q, d_key, n_head)
+    kh = repeat_kv(split_heads(k, d_key, kv_heads), d_key)
+    vh = repeat_kv(split_heads(v, d_value, kv_heads), d_value)
 
     ctx = scaled_dot_product_attention(
         qh, kh, vh, mask=mask, causal=causal,

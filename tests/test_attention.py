@@ -349,3 +349,41 @@ def test_flash_kernel_long_context_fwd_bwd():
                                atol=2e-5, rtol=2e-5)
     gq, = jax.grad(loss, argnums=(0,))(q, k, v)
     assert np.isfinite(np.asarray(gq)).all()
+
+
+@pytest.mark.parametrize("n_kv", [1, 2], ids=["mqa", "gqa"])
+def test_multi_head_attention_gqa(n_kv):
+    """Grouped-query attention: K/V projected to n_kv heads then
+    repeated per query group — equals full MHA run with the repeated
+    projection weights; the K/V projections shrink accordingly."""
+    B, T, D, H, dh = 2, 6, 16, 4, 4
+    rng = np.random.RandomState(8)
+    x = rng.randn(B, T, D).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data("x", shape=[T, D])
+        out = fluid.layers.multi_head_attention(
+            inp, None, None, d_key=dh, d_value=dh, d_model=D, n_head=H,
+            n_kv_head=n_kv, name="gqa")
+    kw = [p for p in main.global_block().all_parameters()
+          if p.name.startswith("gqa_k")][0]
+    assert list(kw.shape) == [D, dh * n_kv], kw.shape  # shrunk projection
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (got,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+
+    # numpy oracle: repeat the kv projections across each query group
+    scope = fluid.global_scope()
+    wq, wk, wv, wo = (np.asarray(scope.get_value("gqa_%s.w_0" % s))
+                      for s in ("q", "k", "v", "o"))
+    group = H // n_kv
+    q = (x @ wq).reshape(B, T, H, dh).transpose(0, 2, 1, 3)
+    k = (x @ wk).reshape(B, T, n_kv, dh).transpose(0, 2, 1, 3)
+    v = (x @ wv).reshape(B, T, n_kv, dh).transpose(0, 2, 1, 3)
+    k = np.repeat(k, group, axis=1)
+    v = np.repeat(v, group, axis=1)
+    out_np = _np_attention(q, k, v)
+    merged = out_np.transpose(0, 2, 1, 3).reshape(B, T, H * dh)
+    np.testing.assert_allclose(np.asarray(got), merged @ wo,
+                               atol=2e-5, rtol=2e-5)

@@ -142,6 +142,27 @@ def _on_tpu():
 _FAMILIES = ("dynamic_lstm", "dynamic_gru", "flash_attention")
 
 
+def _probe_on_tpu():
+    """Ask a throwaway subprocess (timeout-bounded: a wedged tunnel hangs
+    backend init) whether jax sees a non-CPU device."""
+    import subprocess
+    import sys
+
+    code = ("import jax\n"
+            "print('ONTPU|' + str(any(d.platform != 'cpu'"
+            " for d in jax.devices())))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=90)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("ONTPU|"):
+            return line.split("|", 1)[1] == "True"
+    return None
+
+
 def _orchestrate(args):
     """Run each kernel family in its OWN subprocess under a deadline:
     a crash OR a hang (the tunnel wedging mid-run — the way the first
@@ -152,7 +173,10 @@ def _orchestrate(args):
 
     all_rows = []
     for fam in _FAMILIES:
-        cmd = [sys.executable, os.path.abspath(__file__), "--family", fam]
+        # -u: unbuffered child stdout, so rows printed before a hang
+        # survive the SIGKILL (a pipe is block-buffered by default)
+        cmd = [sys.executable, "-u", os.path.abspath(__file__),
+               "--family", fam]
         if args.quick:
             cmd.append("--quick")
         try:
@@ -238,7 +262,11 @@ def _print_verdicts(all_rows):
             if all(s > 1.05 for s in v) else "xla"}
         for k, v in summary.items()
     }
-    print(json.dumps({"verdicts": verdicts}))
+    # None = probe timed out (unknown platform): verdicts from a
+    # non-TPU run must be distinguishable — only chip numbers set
+    # flag defaults (module docstring)
+    print(json.dumps({"on_tpu": _probe_on_tpu(),
+                      "verdicts": verdicts}))
 
 
 if __name__ == "__main__":
