@@ -119,7 +119,11 @@ def load_persistables(executor, dirname, main_program=None, filename=None,
 
 
 def prune_program(program, feed_names, fetch_names):
-    """Backward slice from fetches (framework/prune.cc capability)."""
+    """Backward slice from fetches (framework/prune.cc capability).
+
+    ``feed_names`` is validated, not used for slicing: every data var
+    the slice still reads must be in it, so a caller naming too few
+    feeds finds out here instead of at run time."""
     pruned = program.clone()
     block = pruned.global_block()
     needed = set(fetch_names)
@@ -131,6 +135,21 @@ def prune_program(program, feed_names, fetch_names):
             for n in op.input_arg_names():
                 needed.add(n)
     keep.reverse()
+    produced = set()
+    for op in keep:
+        produced.update(op.output_arg_names())
+    missing = []
+    for n in needed - produced - set(fetch_names):
+        v = block._find_var_recursive(n)
+        if v is not None and getattr(v, "is_data", False) \
+                and not getattr(v, "persistable", False) \
+                and n not in feed_names:
+            missing.append(n)
+    if missing:
+        raise ValueError(
+            "prune_program: the slice to %s still reads data vars %s "
+            "not listed in feed_names %s"
+            % (sorted(fetch_names), sorted(missing), sorted(feed_names)))
     block.ops = keep
     return pruned
 

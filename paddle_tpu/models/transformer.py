@@ -181,3 +181,111 @@ def build(
 
     feeds = [src, src_len, trg, trg_len, label]
     return avg_cost, feeds, {"logits": logits}
+
+
+def build_inference(train_prog, logits):
+    """Derive the generation graph from the TRAINED program: clone with
+    is_test flipped (inference dropout) and prune to the logits fetch —
+    the loss head, backward and optimizer ops all fall away, so running
+    it cannot touch the weights. Parameters bind through the shared
+    scope. Used by greedy_generate/beam_generate below."""
+    from paddle_tpu import io
+
+    return io.prune_program(
+        train_prog.clone(for_test=True),
+        ["src_word", "src_len", "trg_word"],
+        [logits.name if hasattr(logits, "name") else logits],
+    )
+
+
+def greedy_generate(exe, infer_prog, logits_var, src, src_len,
+                    max_length, bos_id=1, eos_id=2):
+    """Greedy decode by re-running the full (fixed-shape) decoder over
+    the growing prefix — the whole-program-XLA analog of the reference's
+    re-score loop; one executable serves every step because shapes never
+    change. Returns [B, max_length] int64 (eos-padded)."""
+    import numpy as np
+
+    bs = src.shape[0]
+    trg = np.full((bs, max_length), eos_id, np.int64)
+    trg[:, 0] = bos_id
+    done = np.zeros(bs, bool)
+    for t in range(max_length - 1):
+        (lg,) = exe.run(
+            infer_prog,
+            feed={
+                "src_word": src,
+                "src_len": src_len,
+                "trg_word": trg,
+            },
+            fetch_list=[logits_var],
+        )
+        nxt = np.asarray(lg)[:, t, :].argmax(-1)
+        nxt = np.where(done, eos_id, nxt)
+        trg[:, t + 1] = nxt
+        done |= nxt == eos_id
+        if done.all():
+            break
+    return trg
+
+
+def beam_generate(exe, infer_prog, logits_var, src, src_len, max_length,
+                  beam_size=4, bos_id=1, eos_id=2, len_penalty=0.6):
+    """Beam-search decode over the same fixed-shape program: beams ride
+    the batch dimension (B*K rows); the per-step selection (incl.
+    finished-beam freezing and first-step duplicate suppression) is
+    ops/beam_search_ops.beam_step — the same lattice step the in-graph
+    beam_search op uses. A GNMT-style length penalty picks the final
+    beam. Returns [B, max_length] int64 (best beam per source)."""
+    import numpy as np
+
+    from paddle_tpu.ops.beam_search_ops import beam_step
+
+    bs = src.shape[0]
+    K = int(beam_size)
+    src_k = np.repeat(src, K, axis=0)
+    len_k = np.repeat(src_len, K, axis=0)
+    trg = np.full((bs * K, max_length), eos_id, np.int64)
+    trg[:, 0] = bos_id
+    # int32: beam_step mirrors the dtype, and jnp int64 would
+    # warn-and-truncate with x64 disabled
+    pre_ids = np.full((bs, K), bos_id, np.int32)
+    pre_scores = np.full((bs, K), -1e9, np.float32)
+    pre_scores[:, 0] = 0.0  # only beam 0 live at t=0 (no K duplicates)
+    rows = np.arange(bs)[:, None]
+    for t in range(max_length - 1):
+        (lg,) = exe.run(
+            infer_prog,
+            feed={
+                "src_word": src_k,
+                "src_len": len_k,
+                "trg_word": trg,
+            },
+            fetch_list=[logits_var],
+        )
+        step = np.asarray(lg)[:, t, :].astype(np.float64)  # [B*K, V]
+        mx = step.max(-1, keepdims=True)
+        step = step - mx - np.log(
+            np.exp(step - mx).sum(-1, keepdims=True))  # stable log softmax
+        token, sel_scores, parent = beam_step(
+            pre_ids, pre_scores, step.reshape(
+                bs, K, -1).astype(np.float32), eos_id)
+        token = np.asarray(token)
+        parent = np.asarray(parent)
+        # prefixes follow their beams (the decoder re-reads them)
+        trg_bk = trg.reshape(bs, K, max_length)[rows, parent]
+        trg_bk[:, :, t + 1] = token
+        trg = trg_bk.reshape(bs * K, max_length)
+        pre_ids = token
+        pre_scores = np.asarray(sel_scores)
+        if (token == eos_id).all():
+            break
+    # length penalty over the eos-trimmed lengths
+    trg_bk = trg.reshape(bs, K, max_length)
+    tail = trg_bk[:, :, 1:]
+    has_eos = (tail == eos_id).any(-1)
+    first = (tail == eos_id).argmax(-1)
+    lengths = np.where(has_eos, first + 1, max_length).astype(np.float64)
+    lp = ((5.0 + lengths) / 6.0) ** len_penalty
+    best = (pre_scores.astype(np.float64) / lp).argmax(-1)
+    return trg_bk[np.arange(bs), best]
