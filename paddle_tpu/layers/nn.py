@@ -7,6 +7,7 @@ from paddle_tpu.layer_helper import LayerHelper
 from paddle_tpu.param_attr import ParamAttr
 
 __all__ = [
+    "dynamic_update_slice",
     "fc",
     "embedding",
     "dropout",
@@ -815,6 +816,24 @@ def scatter(input, index, updates, name=None, overwrite=True):
         inputs={"X": [input], "Ids": [index], "Updates": [updates]},
         outputs={"Out": [out]},
         attrs={"overwrite": overwrite},
+    )
+    return out
+
+
+def dynamic_update_slice(x, update, index, axis=0, out=None, name=None):
+    """Write ``update`` into ``x`` at position ``index`` (a [1] int
+    tensor) along ``axis`` — the KV-cache write primitive (XLA
+    dynamic-update-slice). Pass ``out=x`` bound to a persistable var to
+    get the in-place state-update form the executor threads across
+    runs (the optimizer-op convention)."""
+    helper = LayerHelper("dynamic_update_slice", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="dynamic_update_slice",
+        inputs={"X": [x], "Update": [update], "Index": [index]},
+        outputs={"Out": [out]},
+        attrs={"axis": int(axis)},
     )
     return out
 

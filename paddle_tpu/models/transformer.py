@@ -289,3 +289,197 @@ def beam_generate(exe, infer_prog, logits_var, src, src_len, max_length,
     lp = ((5.0 + lengths) / 6.0) ** len_penalty
     best = (pre_scores.astype(np.float64) / lp).argmax(-1)
     return trg_bk[np.arange(bs), best]
+
+
+def position_encoding_row(t, d_model, dtype="float32"):
+    """Host mirror of the add_position_encoding table's row ``t`` —
+    fed to the cached decode step (exact same formula as
+    ops/attention_ops.py _lower_position_encoding)."""
+    import numpy as np
+
+    i = np.arange(d_model // 2, dtype=np.float64)
+    angle = float(t) / np.power(10000.0, 2.0 * i / d_model)
+    return np.concatenate([np.sin(angle), np.cos(angle)]).astype(
+        dtype)[None, :]
+
+
+def build_cached_decoder(
+    batch_size,
+    src_vocab_size=1000,
+    trg_vocab_size=1000,
+    max_length=64,
+    n_layer=2,
+    n_head=4,
+    d_model=128,
+    d_inner=512,
+):
+    """Incremental (KV-cached) decoding: O(T) attention per new token
+    instead of re-running the decoder over the whole prefix.
+
+    Returns (prepare_prog, step_prog, logits_name). ``prepare_prog``
+    runs once per batch: encoder forward, per-layer cross K/V
+    projections, src mask, and zeroed self-attention caches — all
+    written to persistable scope vars. ``step_prog`` consumes one token
+    per run, updates the K/V caches in place via dynamic_update_slice
+    (the optimizer-style persistable-state convention), and fetches
+    [B, 1, V] logits.
+
+    Build it under the same fresh ``unique_name`` scope as the training
+    ``build()`` (both start from empty counters, and every
+    param-creating layer here carries the training build's explicit
+    name), so parameters bind through the shared scope.
+    """
+    from paddle_tpu import unique_name
+
+    nn = fluid.layers
+    B, T, D = int(batch_size), int(max_length), int(d_model)
+    dh = D // n_head
+
+    def heads(x):
+        # [B, seq, H*dh] -> [B, H, seq, dh] (seq inferred by reshape)
+        return nn.transpose(
+            nn.reshape(x, shape=[0, 0, n_head, dh]), perm=[0, 2, 1, 3])
+
+    with unique_name.guard({}):
+        prepare = fluid.Program()
+        prep_startup = fluid.Program()
+        with fluid.program_guard(prepare, prep_startup):
+            src = nn.data("src_word", shape=[T], dtype="int64")
+            src_len = nn.data("src_len", shape=[1], dtype="int64")
+            src_mask = nn.sequence_mask(src_len, maxlen=T, dtype="float32")
+            emb = nn.embedding(
+                input=src, size=[src_vocab_size, D],
+                param_attr=fluid.ParamAttr(name="src_emb"))
+            enc = nn.add_position_encoding(nn.scale(emb, scale=D ** 0.5))
+            for i in range(n_layer):
+                enc = encoder_layer(enc, src_mask, n_head, D, d_inner,
+                                    0.0, True, "enc_%d" % i)
+            enc = _prenorm(enc, "enc_final")
+            blk = prepare.global_block()
+
+            def persist(name, value):
+                out = blk.create_var(name=name, shape=None,
+                                     dtype="float32", persistable=True)
+                nn.assign(value, output=out)
+
+            persist("gen_src_mask", src_mask)
+            for i in range(n_layer):
+                kc = heads(nn.fc(enc, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False,
+                                 name="dec_%d_cmha_k" % i))
+                vc = heads(nn.fc(enc, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False,
+                                 name="dec_%d_cmha_v" % i))
+                persist("gen_kcross_%d" % i, kc)
+                persist("gen_vcross_%d" % i, vc)
+                zeros = nn.fill_constant([B, n_head, T, dh], "float32",
+                                         0.0)
+                persist("gen_kcache_%d" % i, zeros)
+                persist("gen_vcache_%d" % i, zeros)
+
+        step = fluid.Program()
+        step_startup = fluid.Program()
+        with fluid.program_guard(step, step_startup):
+            blk = step.global_block()
+            cur = nn.data("cur_tok", shape=[1], dtype="int64")
+            pe_row = nn.data("pe_row", shape=[1, D], dtype="float32")
+            pos = nn.data("gen_pos", shape=[1], dtype="int64",
+                          append_batch_size=False)
+            # cache validity is derived from gen_pos in-graph (positions
+            # <= pos), so callers cannot feed an inconsistent length
+            cache_mask = nn.expand(
+                nn.sequence_mask(
+                    fluid.layers.increment(pos, value=1, in_place=False),
+                    maxlen=T, dtype="float32"),
+                expand_times=[B, 1])
+
+            def pvar(name, shape):
+                return blk.create_var(name=name, shape=shape,
+                                      dtype="float32", persistable=True)
+
+            src_mask = pvar("gen_src_mask", [B, T])
+            emb = nn.embedding(
+                input=cur, size=[trg_vocab_size, D],
+                param_attr=fluid.ParamAttr(name="trg_emb"))
+            # lookup_table squeezes the trailing singleton id dim
+            # ([B, 1] ids -> [B, D]); restore the length-1 seq axis
+            emb = nn.reshape(emb, shape=[0, 1, D])
+            h = nn.elementwise_add(nn.scale(emb, scale=D ** 0.5), pe_row)
+            for i in range(n_layer):
+                name = "dec_%d" % i
+                kcache = pvar("gen_kcache_%d" % i, [B, n_head, T, dh])
+                vcache = pvar("gen_vcache_%d" % i, [B, n_head, T, dh])
+                nx = _prenorm(h, name + "_sattn")
+                q = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                bias_attr=False, name=name + "_smha_q"))
+                k1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False, name=name + "_smha_k"))
+                v1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False, name=name + "_smha_v"))
+                kcache = nn.dynamic_update_slice(kcache, k1, pos, axis=2,
+                                                 out=kcache)
+                vcache = nn.dynamic_update_slice(vcache, v1, pos, axis=2,
+                                                 out=vcache)
+                att = fluid.layers.scaled_dot_product_attention(
+                    q, kcache, vcache, mask=cache_mask,
+                    sm_scale=dh ** -0.5)
+                att = nn.reshape(nn.transpose(att, perm=[0, 2, 1, 3]),
+                                 shape=[0, 0, n_head * dh])
+                h = nn.elementwise_add(h, nn.fc(
+                    att, D, num_flatten_dims=2, bias_attr=False,
+                    name=name + "_smha_o"))
+                nx2 = _prenorm(h, name + "_cattn")
+                q2 = heads(nn.fc(nx2, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False,
+                                 name=name + "_cmha_q"))
+                ctx = fluid.layers.scaled_dot_product_attention(
+                    q2, pvar("gen_kcross_%d" % i, [B, n_head, T, dh]),
+                    pvar("gen_vcross_%d" % i, [B, n_head, T, dh]),
+                    mask=src_mask, sm_scale=dh ** -0.5)
+                ctx = nn.reshape(nn.transpose(ctx, perm=[0, 2, 1, 3]),
+                                 shape=[0, 0, n_head * dh])
+                h = nn.elementwise_add(h, nn.fc(
+                    ctx, D, num_flatten_dims=2, bias_attr=False,
+                    name=name + "_cmha_o"))
+                ff = _ffn(_prenorm(h, name + "_ffn"), D, d_inner,
+                          name + "_ffn")
+                h = nn.elementwise_add(h, ff)
+            h = _prenorm(h, "dec_final")
+            logits = nn.fc(h, trg_vocab_size, num_flatten_dims=2,
+                           name="proj_logits")
+    return prepare, step, logits.name
+
+
+def cached_greedy_generate(exe, prepare_prog, step_prog, logits_name,
+                           src, src_len, max_length, d_model,
+                           bos_id=1, eos_id=2):
+    """Greedy decode through the KV-cached step program: prepare once
+    (encoder + cross caches), then one [B, 1] token per step. Matches
+    greedy_generate output; cost per step is O(T) attention instead of
+    a full-prefix decoder re-run."""
+    import numpy as np
+
+    bs = src.shape[0]
+    exe.run(prepare_prog, feed={"src_word": src, "src_len": src_len},
+            fetch_list=[])
+    trg = np.full((bs, max_length), eos_id, np.int64)
+    trg[:, 0] = bos_id
+    done = np.zeros(bs, bool)
+    for t in range(max_length - 1):
+        (lg,) = exe.run(
+            step_prog,
+            feed={
+                "cur_tok": trg[:, t:t + 1],
+                "pe_row": np.tile(
+                    position_encoding_row(t, d_model)[None], (bs, 1, 1)),
+                "gen_pos": np.asarray([t], np.int64),
+            },
+            fetch_list=[logits_name],
+        )
+        nxt = np.asarray(lg)[:, 0, :].argmax(-1)
+        nxt = np.where(done, eos_id, nxt)
+        trg[:, t + 1] = nxt
+        done |= nxt == eos_id
+        if done.all():
+            break
+    return trg

@@ -610,3 +610,24 @@ register_op(
     lower=_lower_hash,
     grad=None,
 )
+
+
+def _lower_dynamic_update_slice(ctx, ins, attrs):
+    # KV-cache writes and other in-place-style slab updates: place
+    # Update into X at position Index along `axis` (XLA
+    # dynamic-update-slice; clamps like the HLO).
+    x = ins["X"][0]
+    upd = ins["Update"][0]
+    idx = jnp.reshape(ins["Index"][0], ()).astype(jnp.int32)
+    return jax.lax.dynamic_update_slice_in_dim(
+        x, upd.astype(x.dtype), idx, axis=int(attrs.get("axis", 0)))
+
+
+register_op(
+    "dynamic_update_slice",
+    inputs=["X", "Update", "Index"],
+    outputs=["Out"],
+    attrs={"axis": 0},
+    lower=_lower_dynamic_update_slice,
+    no_grad_inputs=("Index",),
+)
