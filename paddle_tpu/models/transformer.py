@@ -229,6 +229,29 @@ def greedy_generate(exe, infer_prog, logits_var, src, src_len,
     return trg
 
 
+def _log_softmax_rows(step):
+    """Stable log-softmax over the vocab dim of [N, V] float64 rows."""
+    import numpy as np
+
+    mx = step.max(-1, keepdims=True)
+    return step - mx - np.log(np.exp(step - mx).sum(-1, keepdims=True))
+
+
+def _pick_best_beam(trg, pre_scores, bs, K, max_length, eos_id,
+                    len_penalty):
+    """GNMT length-penalty selection over the final beams."""
+    import numpy as np
+
+    trg_bk = trg.reshape(bs, K, max_length)
+    tail = trg_bk[:, :, 1:]
+    has_eos = (tail == eos_id).any(-1)
+    first = (tail == eos_id).argmax(-1)
+    lengths = np.where(has_eos, first + 1, max_length).astype(np.float64)
+    lp = ((5.0 + lengths) / 6.0) ** len_penalty
+    best = (pre_scores.astype(np.float64) / lp).argmax(-1)
+    return trg_bk[np.arange(bs), best]
+
+
 def beam_generate(exe, infer_prog, logits_var, src, src_len, max_length,
                   beam_size=4, bos_id=1, eos_id=2, len_penalty=0.6):
     """Beam-search decode over the same fixed-shape program: beams ride
@@ -263,10 +286,8 @@ def beam_generate(exe, infer_prog, logits_var, src, src_len, max_length,
             },
             fetch_list=[logits_var],
         )
-        step = np.asarray(lg)[:, t, :].astype(np.float64)  # [B*K, V]
-        mx = step.max(-1, keepdims=True)
-        step = step - mx - np.log(
-            np.exp(step - mx).sum(-1, keepdims=True))  # stable log softmax
+        step = _log_softmax_rows(
+            np.asarray(lg)[:, t, :].astype(np.float64))  # [B*K, V]
         token, sel_scores, parent = beam_step(
             pre_ids, pre_scores, step.reshape(
                 bs, K, -1).astype(np.float32), eos_id)
@@ -483,3 +504,86 @@ def cached_greedy_generate(exe, prepare_prog, step_prog, logits_name,
         if done.all():
             break
     return trg
+
+
+def build_cache_reorder(batch_size, max_length, n_layer, n_head, d_model):
+    """Companion to build_cached_decoder for beam search: permute every
+    self-attention cache's batch rows by a fed index vector (beam
+    survivors adopt their parent's cache). Cross caches and masks are
+    row-constant across a source's beams, so only the self caches move."""
+    nn = fluid.layers
+    B, T = int(batch_size), int(max_length)
+    dh = d_model // n_head
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        blk = prog.global_block()
+        parent = nn.reshape(
+            nn.data("beam_parent_rows", shape=[1], dtype="int64"),
+            shape=[-1])  # [B, 1] feed -> flat row indices
+        for i in range(n_layer):
+            for kind in ("k", "v"):
+                cache = blk.create_var(
+                    name="gen_%scache_%d" % (kind, i),
+                    shape=[B, n_head, T, dh], dtype="float32",
+                    persistable=True)
+                nn.assign(nn.gather(cache, parent), output=cache)
+    return prog
+
+
+def cached_beam_generate(exe, prepare_prog, step_prog, reorder_prog,
+                         logits_name, src, src_len, max_length, d_model,
+                         beam_size=4, bos_id=1, eos_id=2,
+                         len_penalty=0.6):
+    """Beam search over the KV-cached step program: beams ride the batch
+    dim (B*K rows, so build the cached decoder with
+    batch_size=B*beam_size), the per-step selection is
+    ops/beam_search_ops.beam_step, and surviving beams adopt their
+    parent's caches through the reorder program."""
+    import numpy as np
+
+    from paddle_tpu.ops.beam_search_ops import beam_step
+
+    bs = src.shape[0]
+    K = int(beam_size)
+    src_k = np.repeat(src, K, axis=0)
+    len_k = np.repeat(src_len, K, axis=0)
+    exe.run(prepare_prog, feed={"src_word": src_k, "src_len": len_k},
+            fetch_list=[])
+    trg = np.full((bs * K, max_length), eos_id, np.int64)
+    trg[:, 0] = bos_id
+    pre_ids = np.full((bs, K), bos_id, np.int32)
+    pre_scores = np.full((bs, K), -1e9, np.float32)
+    pre_scores[:, 0] = 0.0
+    rows = np.arange(bs)[:, None]
+    for t in range(max_length - 1):
+        (lg,) = exe.run(
+            step_prog,
+            feed={
+                "cur_tok": trg[:, t:t + 1],
+                "pe_row": np.tile(
+                    position_encoding_row(t, d_model)[None],
+                    (bs * K, 1, 1)),
+                "gen_pos": np.asarray([t], np.int64),
+            },
+            fetch_list=[logits_name],
+        )
+        step = _log_softmax_rows(
+            np.asarray(lg)[:, 0, :].astype(np.float64))
+        token, sel_scores, parent = beam_step(
+            pre_ids, pre_scores,
+            step.reshape(bs, K, -1).astype(np.float32), eos_id)
+        token = np.asarray(token)
+        parent = np.asarray(parent)
+        global_rows = (rows * K + parent).reshape(-1).astype(np.int64)
+        exe.run(reorder_prog, feed={
+            "beam_parent_rows": global_rows[:, None]}, fetch_list=[])
+        trg_bk = trg.reshape(bs, K, max_length)[rows, parent]
+        trg_bk[:, :, t + 1] = token
+        trg = trg_bk.reshape(bs * K, max_length)
+        pre_ids = token
+        pre_scores = np.asarray(sel_scores)
+        if (token == eos_id).all():
+            break
+    return _pick_best_beam(trg, pre_scores, bs, K, max_length, eos_id,
+                           len_penalty)

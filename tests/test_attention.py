@@ -463,3 +463,43 @@ def test_transformer_cached_decode_matches_full_rerun():
     cached = transformer.cached_greedy_generate(
         exe, prepare, step, step_logits, src, src_len, seq, D)
     np.testing.assert_array_equal(cached, full)
+
+
+def test_transformer_cached_beam_matches_full_beam():
+    """Cached beam decode (per-parent cache reordering) matches the
+    full-prefix beam_generate on a trained model."""
+    from paddle_tpu.models import transformer
+
+    vocab, seq, D, K = 24, 8, 32, 3
+    cfg = dict(src_vocab_size=vocab, trg_vocab_size=vocab,
+               max_length=seq, n_layer=2, n_head=2, d_model=D,
+               d_inner=64)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 12
+    startup.random_seed = 12
+    with fluid.program_guard(main, startup):
+        loss, feeds, extras = transformer.build(
+            dropout=0.0, label_smooth_eps=0.0, **cfg)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    infer_prog = transformer.build_inference(main, extras["logits"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(13)
+    for _ in range(60):
+        exe.run(main, feed=_copy_task_batch(rng, 16, seq, vocab),
+                fetch_list=[loss])
+
+    prepare, step, step_logits = transformer.build_cached_decoder(
+        batch_size=4 * K, **cfg)
+    reorder = transformer.build_cache_reorder(4 * K, seq, 2, 2, D)
+    src = rng.randint(3, vocab, (4, seq)).astype("int64")
+    # ragged source lengths: the prepared per-row src mask must survive
+    # the K-fold beam batching
+    src_len = np.asarray([[seq], [seq - 3], [seq - 1], [2]], "int64")
+    full = transformer.beam_generate(
+        exe, infer_prog, extras["logits"].name, src, src_len, seq,
+        beam_size=K)
+    cached = transformer.cached_beam_generate(
+        exe, prepare, step, reorder, step_logits, src, src_len, seq, D,
+        beam_size=K)
+    np.testing.assert_array_equal(cached, full)
