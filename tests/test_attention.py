@@ -503,3 +503,49 @@ def test_transformer_cached_beam_matches_full_beam():
         exe, prepare, step, reorder, step_logits, src, src_len, seq, D,
         beam_size=K)
     np.testing.assert_array_equal(cached, full)
+
+
+def test_transformer_generation_survives_save_load(tmp_path):
+    """Deployment flow: save_inference_model on the pruned generation
+    graph, reload into a FRESH scope/program, greedy decode matches the
+    original session's output."""
+    from paddle_tpu.models import transformer
+
+    vocab, seq = 24, 8
+    cfg = dict(src_vocab_size=vocab, trg_vocab_size=vocab,
+               max_length=seq, n_layer=1, n_head=2, d_model=32,
+               d_inner=64)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 15
+    startup.random_seed = 15
+    with fluid.program_guard(main, startup):
+        loss, feeds, extras = transformer.build(
+            dropout=0.0, label_smooth_eps=0.0, **cfg)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    infer_prog = transformer.build_inference(main, extras["logits"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(16)
+    for _ in range(80):
+        exe.run(main, feed=_copy_task_batch(rng, 16, seq, vocab),
+                fetch_list=[loss])
+    src = rng.randint(3, vocab, (3, seq)).astype("int64")
+    src_len = np.full((3, 1), seq, "int64")
+    want = transformer.greedy_generate(
+        exe, infer_prog, extras["logits"].name, src, src_len, seq)
+
+    path = str(tmp_path / "nmt")
+    fluid.io.save_inference_model(
+        path, ["src_word", "src_len", "trg_word"],
+        [infer_prog.global_block().var(extras["logits"].name)], exe,
+        main_program=infer_prog)
+
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        loaded, feed_names, fetch_vars = fluid.io.load_inference_model(
+            path, exe2)
+        got = transformer.greedy_generate(
+            exe2, loaded, fetch_vars[0].name
+            if hasattr(fetch_vars[0], "name") else fetch_vars[0],
+            src, src_len, seq)
+    np.testing.assert_array_equal(got, want)
