@@ -13,6 +13,7 @@ __all__ = [
     "multi_head_attention",
     "label_smooth",
     "add_position_encoding",
+    "rotary_position_embedding",
     "moe_ffn",
 ]
 
@@ -138,6 +139,27 @@ def multi_head_attention(
         input=merged, size=d_model, num_flatten_dims=2, bias_attr=False,
         param_attr=param_attr, name=(name + "_o") if name else None,
     )
+
+
+def rotary_position_embedding(q, k, position=None, base=10000.0,
+                              name=None):
+    """RoPE over [batch, heads, seq, head_dim] q/k (rotate-half
+    convention); returns (q_rot, k_rot). ``position``: optional [1] int
+    offset for KV-cached decoding. Beyond the reference — pairs with
+    flash attention and n_kv_head for a modern attention stack."""
+    helper = LayerHelper("rope", name=name)
+    q_out = helper.create_variable_for_type_inference(q.dtype)
+    k_out = helper.create_variable_for_type_inference(k.dtype)
+    inputs = {"Q": [q], "K": [k]}
+    if position is not None:
+        inputs["Position"] = [position]
+    helper.append_op(
+        type="rotary_embedding",
+        inputs=inputs,
+        outputs={"QOut": [q_out], "KOut": [k_out]},
+        attrs={"base": float(base)},
+    )
+    return q_out, k_out
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):

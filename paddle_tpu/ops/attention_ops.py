@@ -143,3 +143,49 @@ register_op(
     attrs={"alpha": 1.0, "beta": 1.0},
     lower=_lower_position_encoding,
 )
+
+
+def _lower_rotary_embedding(ctx, ins, attrs):
+    """Rotary position embedding (RoPE, rotate-half convention) applied
+    to [B, H, T, d] queries/keys; beyond the reference (its models
+    predate RoPE) — the relative-position encoding modern attention
+    stacks expect. Optional Position input: [1] int offset (KV-cached
+    decoding feeds the current step), else positions are 0..T-1."""
+    q = ins["Q"][0]
+    k = ins["K"][0]
+    base = float(attrs.get("base", 10000.0))
+    d = q.shape[-1]
+    if d % 2 != 0:
+        raise ValueError(
+            "rotary_embedding needs an even head_dim (rotate-half "
+            "pairs dimensions); got %d" % d)
+    half = d // 2
+    pos_in = ins.get("Position", [None])[0]
+    offset = (jnp.reshape(pos_in, ()).astype(jnp.float32)
+              if pos_in is not None else jnp.asarray(0.0, jnp.float32))
+    inv_freq = jnp.power(
+        base, -jnp.arange(0, half, dtype=jnp.float32) / half)
+
+    def rotate(x):
+        t = x.shape[2]
+        pos = offset + jnp.arange(t, dtype=jnp.float32)
+        ang = pos[:, None] * inv_freq[None, :]  # [T, half]
+        cos = jnp.concatenate([jnp.cos(ang), jnp.cos(ang)], -1)
+        sin = jnp.concatenate([jnp.sin(ang), jnp.sin(ang)], -1)
+        x1, x2 = x[..., :half], x[..., half:]
+        rotated = jnp.concatenate([-x2, x1], -1)
+        return (x.astype(jnp.float32) * cos[None, None]
+                + rotated.astype(jnp.float32) * sin[None, None]
+                ).astype(x.dtype)
+
+    return {"QOut": rotate(q), "KOut": rotate(k)}
+
+
+register_op(
+    "rotary_embedding",
+    inputs=["Q", "K", "Position"],
+    outputs=["QOut", "KOut"],
+    attrs={"base": 10000.0},
+    lower=_lower_rotary_embedding,
+    no_grad_inputs=("Position",),
+)

@@ -549,3 +549,84 @@ def test_transformer_generation_survives_save_load(tmp_path):
             if hasattr(fetch_vars[0], "name") else fetch_vars[0],
             src, src_len, seq)
     np.testing.assert_array_equal(got, want)
+
+
+def test_rotary_embedding_properties():
+    """RoPE: norm-preserving rotation; attention scores depend only on
+    RELATIVE position (shifting q and k positions together leaves
+    q . k unchanged); a Position offset reproduces the shifted slice —
+    the property KV-cached decoding relies on."""
+    import jax
+
+    rng = np.random.RandomState(20)
+    B, H, T, d = 2, 2, 8, 8
+    q = rng.randn(B, H, T, d).astype("float32")
+    k = rng.randn(B, H, T, d).astype("float32")
+
+    def run(qv, kv, pos=None):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            qd = fluid.layers.data("q", shape=[H, qv.shape[2], d])
+            kd = fluid.layers.data("k", shape=[H, kv.shape[2], d])
+            feed = {"q": qv, "k": kv}
+            inputs = dict(q=qd, k=kd)
+            if pos is not None:
+                pd = fluid.layers.data("pos", shape=[1], dtype="int64",
+                                       append_batch_size=False)
+                inputs["position"] = pd
+                feed["pos"] = np.asarray([pos], "int64")
+            qo, ko = fluid.layers.rotary_position_embedding(**inputs)
+        exe = fluid.Executor(fluid.CPUPlace())
+        return [np.asarray(v) for v in
+                exe.run(main, feed=feed, fetch_list=[qo, ko])]
+
+    q_rot, k_rot = run(q, k)
+    # rotation preserves norms
+    np.testing.assert_allclose(
+        np.linalg.norm(q_rot, axis=-1), np.linalg.norm(q, axis=-1),
+        rtol=1e-5)
+    # relative-position property: scores at (t, s) shift-invariant
+    s0 = np.einsum("bhtd,bhsd->bhts", q_rot, k_rot)
+    q_shift, k_shift = run(q, k, pos=5)
+    s5 = np.einsum("bhtd,bhsd->bhts", q_shift, k_shift)
+    np.testing.assert_allclose(s5, s0, atol=2e-4, rtol=2e-4)
+    # position offset == the matching slice of a longer rotation
+    q_long = np.concatenate([np.zeros_like(q[:, :, :3]), q], axis=2)
+    ql_rot, _ = run(q_long, q_long)
+    q_off, _ = run(q, k, pos=3)
+    np.testing.assert_allclose(q_off, ql_rot[:, :, 3:], atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_rope_attention_trains():
+    """RoPE + fused attention + GQA compose in a training program."""
+    B, T, D, H = 4, 8, 16, 4
+    rng = np.random.RandomState(21)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 22
+    startup.random_seed = 22
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [T, D])
+        t = fluid.layers.data("t", [T, D])
+        nx = fluid.layers.fc(x, D, num_flatten_dims=2, name="rp_in")
+        qh = fluid.layers.transpose(
+            fluid.layers.reshape(nx, shape=[0, 0, H, D // H]),
+            perm=[0, 2, 1, 3])
+        q, k = fluid.layers.rotary_position_embedding(qh, qh)
+        att = fluid.layers.scaled_dot_product_attention(
+            q, k, qh, causal=True)
+        out = fluid.layers.reshape(
+            fluid.layers.transpose(att, perm=[0, 2, 1, 3]),
+            shape=[0, 0, D])
+        y = fluid.layers.fc(out, D, num_flatten_dims=2, name="rp_out")
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(y, t)))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = rng.randn(B, T, D).astype("float32")
+    tv = np.roll(xv, 1, 1) * 0.3
+    losses = [float(np.ravel(exe.run(
+        main, feed={"x": xv, "t": tv}, fetch_list=[loss])[0])[0])
+        for _ in range(40)]
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
