@@ -328,10 +328,12 @@ class Interpreter {
     }
     int64_t B = q->dims[0], H = q->dims[1], T = q->dims[2], d = q->dims[3];
     int64_t S = k->dims[2];
-    // full MHA only (no GQA broadcasting in the C++ path): K and V must
-    // agree with Q on batch/heads/depth and with each other on S —
-    // anything else would walk off the buffers below
-    if (k->dims[0] != B || k->dims[1] != H || k->dims[3] != d) {
+    // grouped-query attention: K/V carry H / kv_group heads, each
+    // serving kv_group query heads (kv_group 1 = full MHA)
+    int64_t g = IntAttr(op, "kv_group", 1);
+    if (g < 1 || H % g != 0) return "bad kv_group";
+    int64_t Hkv = H / g;
+    if (k->dims[0] != B || k->dims[1] != Hkv || k->dims[3] != d) {
       return "K shape mismatch";
     }
     if (v->dims != k->dims) return "V shape mismatch";
@@ -354,8 +356,8 @@ class Interpreter {
     std::vector<float> s(S);
     for (int64_t b = 0; b < B; ++b) {
       for (int64_t h = 0; h < H; ++h) {
-        const float* kb = ka + (b * H + h) * S * d;
-        const float* vb = va + (b * H + h) * S * d;
+        const float* kb = ka + (b * Hkv + h / g) * S * d;
+        const float* vb = va + (b * Hkv + h / g) * S * d;
         for (int64_t t = 0; t < T; ++t) {
           const float* qr = qa + ((b * H + h) * T + t) * d;
           float mx = -1e30f;

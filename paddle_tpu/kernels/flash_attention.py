@@ -134,12 +134,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref, acc_ref,
 
 
 def _flash_forward(q, k, v, kv_mask, causal, sm_scale, block_q, block_k,
-                   interpret):
+                   interpret, kv_group=1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, d = q.shape
     S = k.shape[2]
+    # grouped-query attention: K/V carry H // kv_group heads and each
+    # serves kv_group query heads THROUGH THE INDEX MAP — the repeated
+    # K/V never materializes (a custom call can't fold a broadcast
+    # operand the way XLA fuses one)
+    g = int(kv_group)
+    if g < 1 or k.shape[1] * g != H:
+        raise ValueError(
+            "flash_attention: kv heads (%d) * kv_group (%d) must "
+            "equal query heads (%d)" % (k.shape[1], g, H))
     block_q = min(block_q, T)
     block_k = min(block_k, S)
 
@@ -181,10 +190,10 @@ def _flash_forward(q, k, v, kv_mask, causal, sm_scale, block_q, block_k,
                 (1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)
             ),
             pl.BlockSpec(
-                (1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)
+                (1, 1, block_k, d), lambda b, h, i, j: (b, h // g, j, 0)
             ),
             pl.BlockSpec(
-                (1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)
+                (1, 1, block_k, d), lambda b, h, i, j: (b, h // g, j, 0)
             ),
             pl.BlockSpec(
                 (1, 1, block_k),
@@ -235,15 +244,18 @@ def _bwd_tile_grads(q, k, v, do, lse, delta, valid, sm_scale):
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
                           sm_scale, causal, seq_q, seq_k, block_q, block_k,
-                          n_q, has_mask):
-    """Grid (b, h, kj, qi), q innermost: accumulate dK/dV for one K/V tile
-    across all Q tiles; VMEM accumulators persist over the qi steps."""
+                          n_q, has_mask, n_group=1):
+    """Grid (b, hkv, kj, gi, qi), q innermost: accumulate dK/dV for one
+    K/V tile across all Q tiles — and, under grouped-query attention,
+    across the n_group query heads this kv head serves (the gi axis);
+    VMEM accumulators persist over the (gi, qi) steps."""
     from jax.experimental import pallas as pl
 
     kj = pl.program_id(2)
-    qi = pl.program_id(3)
+    gi = pl.program_id(3)
+    qi = pl.program_id(4)
 
-    @pl.when(qi == 0)
+    @pl.when((gi == 0) & (qi == 0))
     def _init():
         dk_acc[:, :] = jnp.zeros_like(dk_acc)
         dv_acc[:, :] = jnp.zeros_like(dv_acc)
@@ -285,7 +297,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _compute()
 
-    @pl.when(qi == n_q - 1)
+    @pl.when((gi == n_group - 1) & (qi == n_q - 1))
     def _finish():
         dk_ref[0, 0, :, :] = dk_acc[:, :].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[:, :].astype(dv_ref.dtype)
@@ -339,16 +351,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0, :, :] = dq_acc[:, :].astype(dq_ref.dtype)
 
 
-def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
-                    block_q, block_k, interpret):
+def _flash_backward(q, k, v, kv_mask, out, lse, dout, causal, sm_scale,
+                    block_q, block_k, interpret, kv_group=1):
     """FlashAttention-2-style backward: delta precomputed in XLA, then a
     dK/dV kernel (q innermost) and a dQ kernel (kv innermost). O(block)
     memory — the [T, S] score matrix never materializes, matching the
-    forward's long-context contract."""
+    forward's long-context contract. Under grouped-query attention
+    (kv_group > 1) the index maps serve each kv head to its query group
+    and dK/dV accumulate across the group — the memory contract holds
+    for GQA training too."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, d = q.shape
+    grp = int(kv_group)
+    Hkv = H // grp
     S = k.shape[2]
     block_q = min(block_q, T)
     block_k = min(block_k, S)
@@ -362,11 +379,11 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
     qp = jnp.pad(q, pad_q)
     kp = jnp.pad(k, pad_k)
     vp = jnp.pad(v, pad_k)
-    dop = jnp.pad(g.astype(jnp.float32), pad_q)
+    dop = jnp.pad(dout.astype(jnp.float32), pad_q)
     # delta_i = rowsum(dO * O): one cheap fused elementwise+reduce in XLA;
     # [B, H, 1, T] layout like lse (trailing-1 dims tile-pad 128x)
     delta = jnp.pad(
-        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+        jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                 axis=-1)[:, :, None, :],
         ((0, 0), (0, 0), (0, 0), (0, T_pad)),
     )
@@ -379,30 +396,39 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
     else:
         kvm = jnp.ones((B, 1, block_k), jnp.float32)
 
-    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0))
-    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0))
-    row_spec = pl.BlockSpec((1, 1, 1, block_q), lambda b, h, j, i: (b, h, 0, i))
+    # dkv grid: (b, kv-head, kv-block, group-member, q-block); q-side
+    # tensors index the ACTUAL query head hk * grp + gi
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, d),
+        lambda b, hk, j, gi, i: (b, hk * grp + gi, i, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, d), lambda b, hk, j, gi, i: (b, hk, j, 0))
+    row_spec = pl.BlockSpec(
+        (1, 1, 1, block_q),
+        lambda b, hk, j, gi, i: (b, hk * grp + gi, 0, i))
     kvm_spec = pl.BlockSpec(
         (1, 1, block_k),
-        (lambda b, h, j, i: (b, 0, j)) if has_mask
-        else (lambda b, h, j, i: (b, 0, 0)),
+        (lambda b, hk, j, gi, i: (b, 0, j)) if has_mask
+        else (lambda b, hk, j, gi, i: (b, 0, 0)),
     )
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             seq_q=T, seq_k=S, block_q=block_q, block_k=block_k, n_q=n_q,
-            has_mask=has_mask,
+            has_mask=has_mask, n_group=grp,
         ),
-        grid=(B, H, n_kv, n_q),
+        grid=(B, Hkv, n_kv, grp, n_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
                   kvm_spec],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, hk, j, gi, i: (b, hk, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, hk, j, gi, i: (b, hk, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sp, d), k.dtype),
-            jax.ShapeDtypeStruct((B, H, Sp, d), v.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Sp, d), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Sp, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -412,7 +438,8 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
     )(qp, kp, vp, dop, lse, delta, kvm)
 
     q_spec2 = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
-    kv_spec2 = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0))
+    kv_spec2 = pl.BlockSpec(
+        (1, 1, block_k, d), lambda b, h, i, j: (b, h // grp, j, 0))
     row_spec2 = pl.BlockSpec((1, 1, 1, block_q), lambda b, h, i, j: (b, h, 0, i))
     kvm_spec2 = pl.BlockSpec(
         (1, 1, block_k),
@@ -439,36 +466,40 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, sm_scale,
     return dq[:, :, :T, :], dk[:, :, :S, :], dv[:, :, :S, :]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, kv_mask, has_mask, causal, sm_scale, block_q, block_k,
-           interpret):
+           interpret, kv_group=1):
     out, _ = _flash_forward(q, k, v, kv_mask if has_mask else None, causal,
-                            sm_scale, block_q, block_k, interpret)
+                            sm_scale, block_q, block_k, interpret,
+                            kv_group=kv_group)
     return out
 
 
 def _flash_fwd(q, k, v, kv_mask, has_mask, causal, sm_scale, block_q,
-               block_k, interpret):
+               block_k, interpret, kv_group=1):
     out, lse = _flash_forward(q, k, v, kv_mask if has_mask else None,
-                              causal, sm_scale, block_q, block_k, interpret)
+                              causal, sm_scale, block_q, block_k, interpret,
+                              kv_group=kv_group)
     return out, (q, k, v, kv_mask, out, lse)
 
 
 def _flash_bwd(has_mask, causal, sm_scale, block_q, block_k, interpret,
-               res, g):
+               kv_group, res, g):
     q, k, v, kv_mask, out, lse = res
     if _backward_impl() == "reference":
         mask = kv_mask[:, None, None, :].astype(bool) if has_mask else None
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: flash_attention_reference(
-                q_, k_, v_, causal=causal, sm_scale=sm_scale, mask=mask
-            ),
-            q, k, v,
-        )
+
+        def ref(q_, k_, v_):
+            k_r = jnp.repeat(k_, kv_group, axis=1) if kv_group != 1 else k_
+            v_r = jnp.repeat(v_, kv_group, axis=1) if kv_group != 1 else v_
+            return flash_attention_reference(
+                q_, k_r, v_r, causal=causal, sm_scale=sm_scale, mask=mask)
+
+        _, vjp = jax.vjp(ref, q, k, v)
         return vjp(g) + (jnp.zeros_like(kv_mask),)
     dq, dk, dv = _flash_backward(
         q, k, v, kv_mask if has_mask else None, out, lse, g, causal,
-        sm_scale, block_q, block_k, interpret,
+        sm_scale, block_q, block_k, interpret, kv_group=kv_group,
     )
     return dq, dk, dv, jnp.zeros_like(kv_mask)
 
@@ -498,8 +529,13 @@ def flash_attention(
     block_k=_DEFAULT_BLOCK_K,
     force_reference=False,
     force_pallas=False,
+    kv_group=1,
 ):
     """Fused attention. q:[B,H,T,d], k,v:[B,H,S,d] -> [B,H,T,d].
+
+    ``kv_group`` > 1 is grouped-query attention: k/v carry H/kv_group
+    heads, each serving kv_group query heads through the kernel's index
+    map — the repeated K/V never materializes.
 
     Pallas kernel on TPU (interpret-mode when forced on CPU); XLA reference
     elsewhere. Key-validity masks — [B, S], or [B, 1, 1, S] as the sdpa op
@@ -527,6 +563,9 @@ def flash_attention(
         # einsum path (raw 2-D would broadcast B against the T axis)
         ref_mask = (kv_mask[:, None, None, :] if kv_mask is not None
                     else mask)
+        if kv_group != 1:
+            k = jnp.repeat(k, kv_group, axis=1)
+            v = jnp.repeat(v, kv_group, axis=1)
         return flash_attention_reference(
             q, k, v, causal=causal, sm_scale=sm_scale, mask=ref_mask
         )
@@ -536,4 +575,4 @@ def flash_attention(
         # static dummy so the custom_vjp signature stays array-only
         kv_mask = jnp.ones((q.shape[0], 1), jnp.float32)
     return _flash(q, k, v, kv_mask.astype(jnp.float32), has_mask, causal,
-                  sm_scale, block_q, block_k, interpret)
+                  sm_scale, block_q, block_k, interpret, kv_group)

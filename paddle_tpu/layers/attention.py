@@ -20,7 +20,7 @@ __all__ = [
 
 def scaled_dot_product_attention(
     queries, keys, values, mask=None, causal=False, sm_scale=None,
-    impl="auto", seq_parallel_axis=None, name=None
+    impl="auto", seq_parallel_axis=None, kv_group=1, name=None
 ):
     """Fused attention over [batch, heads, seq, head_dim] tensors.
 
@@ -42,6 +42,7 @@ def scaled_dot_product_attention(
             "sm_scale": float(sm_scale or 0.0),
             "impl": impl,
             "seq_parallel_axis": seq_parallel_axis or "",
+            "kv_group": int(kv_group),
         },
     )
     return out
@@ -70,9 +71,10 @@ def multi_head_attention(
 
     ``n_kv_head`` enables grouped-query attention (GQA; beyond the
     reference): K/V are projected to n_kv_head heads (n_head must be a
-    multiple) and repeated per query group before the fused kernel —
-    the K/V projection weights and any cached K/V shrink by
-    n_head/n_kv_head. n_kv_head=1 is multi-query attention.
+    multiple) and the attention op serves each kv head to its query
+    group through the kernel's index map — no repeated K/V tensor
+    materializes, and the K/V projection weights and any cached K/V
+    shrink by n_head/n_kv_head. n_kv_head=1 is multi-query attention.
     """
     from paddle_tpu.layers import nn as nn_layers
 
@@ -107,24 +109,16 @@ def multi_head_attention(
         reshaped = nn_layers.reshape(x, shape=[0, 0, heads, d_head])
         return nn_layers.transpose(reshaped, perm=[0, 2, 1, 3])
 
-    def repeat_kv(x, d_head):
-        # [B, Hkv, T, dh] -> [B, H, T, dh]: each kv head serves
-        # n_head // kv_heads query heads (XLA folds the broadcast)
-        group = n_head // kv_heads
-        if group == 1:
-            return x
-        expanded = nn_layers.expand(
-            nn_layers.unsqueeze(x, axes=[2]),
-            expand_times=[1, 1, group, 1, 1])
-        return nn_layers.reshape(expanded, shape=[0, n_head, -1, d_head])
-
     qh = split_heads(q, d_key, n_head)
-    kh = repeat_kv(split_heads(k, d_key, kv_heads), d_key)
-    vh = repeat_kv(split_heads(v, d_value, kv_heads), d_value)
+    kh = split_heads(k, d_key, kv_heads)
+    vh = split_heads(v, d_value, kv_heads)
 
+    # grouped K/V ride through the attention op's kv_group attr: the
+    # Pallas kernel maps query head h to kv head h // group in its index
+    # map, so the repeated K/V never materializes
     ctx = scaled_dot_product_attention(
         qh, kh, vh, mask=mask, causal=causal,
-        sm_scale=d_key ** -0.5,
+        sm_scale=d_key ** -0.5, kv_group=n_head // kv_heads,
     )
     # [B, H, T, dh] -> [B, T, H*dh]
     merged = nn_layers.reshape(

@@ -42,6 +42,11 @@ def _lower_sdpa(ctx, ins, attrs):
                 "scaled_dot_product_attention: impl=%r conflicts with "
                 "seq_parallel_axis (the ring path IS the implementation)"
                 % attrs["impl"])
+        if int(attrs.get("kv_group", 1)) != 1:
+            raise ValueError(
+                "scaled_dot_product_attention: kv_group > 1 is not "
+                "supported with seq_parallel_axis yet — repeat K/V to "
+                "full heads before the ring")
         mesh = ambient_mesh()
         if mesh is None or seq_axis not in mesh.shape:
             raise ValueError(
@@ -68,13 +73,13 @@ def _lower_sdpa(ctx, ins, attrs):
         from paddle_tpu import flags
 
         impl = flags.get("attention_impl")
-    if impl == "reference":
-        return flash_attention_reference(
-            q, k, v, causal=causal, sm_scale=sm_scale, mask=mask
-        )
+    # impl == "reference" routes through the same entry with
+    # force_reference so the grouped-K/V handling lives in ONE place
     return flash_attention(
         q, k, v, causal=causal, sm_scale=sm_scale, mask=mask,
+        force_reference=(impl == "reference"),
         force_pallas=(impl == "pallas"),
+        kv_group=int(attrs.get("kv_group", 1)),
     )
 
 
@@ -83,7 +88,7 @@ register_op(
     inputs=["Q", "K", "V", "Mask"],
     outputs=["Out"],
     attrs={"causal": False, "sm_scale": 0.0, "impl": "auto",
-           "seq_parallel_axis": ""},
+           "seq_parallel_axis": "", "kv_group": 1},
     lower=_lower_sdpa,
     no_grad_inputs=("Mask",),
     # Out mirrors Q's shape/dtype. Declared (not eval_shape'd) because the

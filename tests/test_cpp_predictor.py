@@ -257,3 +257,31 @@ def test_native_interp_runs_transformer_encoder(tmp_path):
         NativeConfig(model_dir=path, use_tpu=False))
     got = predictor.run_native_reference(feed)
     np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_native_interp_runs_gqa_attention(tmp_path):
+    """The C++ SDPA kernel maps query heads onto grouped K/V heads
+    (kv_group attr) — multi-query attention serves from C++ matching
+    the XLA path."""
+    rng = np.random.RandomState(19)
+    T, D, H = 5, 16, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [T, D])
+        out = fluid.layers.multi_head_attention(
+            x, None, None, d_key=D // H, d_value=D // H, d_model=D,
+            n_head=H, n_kv_head=1, causal=True, name="cppgqa")
+        out = fluid.layers.reduce_mean(out, dim=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": rng.randn(2, T, D).astype("float32")}
+    (want,) = exe.run(main.clone(for_test=True), feed=feed,
+                      fetch_list=[out])
+    path = str(tmp_path / "model")
+    fluid.io.save_inference_model(path, ["x"], [out], exe,
+                                  main_program=main)
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=path, use_tpu=False))
+    got = predictor.run_native_reference(feed)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4,
+                               atol=1e-5)
