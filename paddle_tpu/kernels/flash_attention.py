@@ -60,9 +60,20 @@ def flash_attention_reference(q, k, v, causal=False, sm_scale=None,
     return jnp.einsum("bhts,bhsd->bhtd", p, v)
 
 
+def _window_band(T, S, window, causal):
+    """[T, S] sliding-window visibility band (q - w < k <= q when causal,
+    |q - k| < w otherwise) for the reference/backward paths."""
+    qi = jnp.arange(T)[:, None]
+    ki = jnp.arange(S)[None, :]
+    band = (qi - ki) < window
+    if not causal:
+        band = band & ((ki - qi) < window)
+    return band
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref, acc_ref,
                   m_ref, l_ref, *, sm_scale, causal, seq_k, block_q,
-                  block_k, n_kv, has_mask):
+                  block_k, n_kv, has_mask, window=0):
     """One (b, h, qi, kj) grid step: absorb one K/V tile into the running
     online-softmax state held in VMEM scratch. ``kvm_ref`` is the
     per-batch key-validity mask tile ([1, block_k] float, 1 = keep) when
@@ -95,11 +106,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref, acc_ref,
         valid = k_idx < seq_k
         if has_mask:
             valid = jnp.logical_and(valid, kvm_ref[0, 0, :][None, :] > 0)
-        if causal:
+        if causal or window:
             q_idx = q_base + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            valid = jnp.logical_and(valid, k_idx <= q_idx)
+            if causal:
+                valid = jnp.logical_and(valid, k_idx <= q_idx)
+            if window:
+                # sliding window: only the last `window` positions are
+                # visible (causal: q - w < k <= q; else |q - k| < w)
+                valid = jnp.logical_and(valid, q_idx - k_idx < window)
+                if not causal:
+                    valid = jnp.logical_and(valid, k_idx - q_idx < window)
         s = jnp.where(valid, s, _NEG_INF)
         m_prev = m_ref[:, :]
         l_prev = l_ref[:, :]
@@ -113,9 +131,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref, acc_ref,
         )
         m_ref[:, :] = m_new
 
+    run = None
     if causal:
         # Tiles strictly above the diagonal contribute nothing — skip.
-        pl.when(k_base <= q_base + block_q - 1)(_compute)
+        run = k_base <= q_base + block_q - 1
+    if window:
+        # Tiles entirely OUTSIDE the window contribute nothing either:
+        # the real FLOP saving of local attention (compute per query is
+        # O(window), not O(S))
+        behind = k_base + block_k - 1 > q_base - window
+        run = behind if run is None else (run & behind)
+        if not causal:
+            ahead = k_base - (q_base + block_q - 1) < window
+            run = run & ahead
+    if run is not None:
+        pl.when(run)(_compute)
     else:
         _compute()
 
@@ -134,7 +164,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref, acc_ref,
 
 
 def _flash_forward(q, k, v, kv_mask, causal, sm_scale, block_q, block_k,
-                   interpret, kv_group=1):
+                   interpret, kv_group=1, window=0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -181,6 +211,7 @@ def _flash_forward(q, k, v, kv_mask, causal, sm_scale, block_q, block_k,
         block_k=block_k,
         n_kv=n_kv,
         has_mask=has_mask,
+        window=int(window),
     )
     out = pl.pallas_call(
         kernel,
@@ -244,7 +275,7 @@ def _bwd_tile_grads(q, k, v, do, lse, delta, valid, sm_scale):
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
                           sm_scale, causal, seq_q, seq_k, block_q, block_k,
-                          n_q, has_mask, n_group=1):
+                          n_q, has_mask, n_group=1, window=0):
     """Grid (b, hkv, kj, gi, qi), q innermost: accumulate dK/dV for one
     K/V tile across all Q tiles — and, under grouped-query attention,
     across the n_group query heads this kv head serves (the gi axis);
@@ -281,6 +312,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             valid &= kvm_ref[0, 0, :][None, :] > 0
         if causal:
             valid &= k_idx <= q_idx
+        if window:
+            valid &= q_idx - k_idx < window
+            if not causal:
+                valid &= k_idx - q_idx < window
         ds, p = _bwd_tile_grads(q, k, v, do, lse, delta, valid, sm_scale)
         dv_acc[:, :] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -291,9 +326,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
 
+    run = None
     if causal:
         # Q tiles entirely above the diagonal see only masked positions.
-        pl.when(q_base + block_q - 1 >= k_base)(_compute)
+        run = q_base + block_q - 1 >= k_base
+    if window:
+        behind = q_base - (k_base + block_k - 1) < window
+        run = behind if run is None else (run & behind)
+        if not causal:
+            run = run & (k_base - (q_base + block_q - 1) < window)
+    if run is not None:
+        pl.when(run)(_compute)
     else:
         _compute()
 
@@ -305,7 +348,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          kvm_ref, dq_ref, dq_acc, *, sm_scale, causal,
-                         seq_q, seq_k, block_q, block_k, n_kv, has_mask):
+                         seq_q, seq_k, block_q, block_k, n_kv, has_mask,
+                         window=0):
     """Grid (b, h, qi, kj), kv innermost: accumulate dQ for one Q tile."""
     from jax.experimental import pallas as pl
 
@@ -335,14 +379,26 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             valid &= kvm_ref[0, 0, :][None, :] > 0
         if causal:
             valid &= k_idx <= q_idx
+        if window:
+            valid &= q_idx - k_idx < window
+            if not causal:
+                valid &= k_idx - q_idx < window
         ds, _ = _bwd_tile_grads(q, k, v, do, lse, delta, valid, sm_scale)
         dq_acc[:, :] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
+    run = None
     if causal:
-        pl.when(k_base <= q_base + block_q - 1)(_compute)
+        run = k_base <= q_base + block_q - 1
+    if window:
+        behind = k_base + block_k - 1 > q_base - window
+        run = behind if run is None else (run & behind)
+        if not causal:
+            run = run & (k_base - (q_base + block_q - 1) < window)
+    if run is not None:
+        pl.when(run)(_compute)
     else:
         _compute()
 
@@ -352,7 +408,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, kv_mask, out, lse, dout, causal, sm_scale,
-                    block_q, block_k, interpret, kv_group=1):
+                    block_q, block_k, interpret, kv_group=1, window=0):
     """FlashAttention-2-style backward: delta precomputed in XLA, then a
     dK/dV kernel (q innermost) and a dQ kernel (kv innermost). O(block)
     memory — the [T, S] score matrix never materializes, matching the
@@ -415,7 +471,7 @@ def _flash_backward(q, k, v, kv_mask, out, lse, dout, causal, sm_scale,
         functools.partial(
             _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             seq_q=T, seq_k=S, block_q=block_q, block_k=block_k, n_q=n_q,
-            has_mask=has_mask, n_group=grp,
+            has_mask=has_mask, n_group=grp, window=int(window),
         ),
         grid=(B, Hkv, n_kv, grp, n_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
@@ -450,7 +506,7 @@ def _flash_backward(q, k, v, kv_mask, out, lse, dout, causal, sm_scale,
         functools.partial(
             _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
             seq_q=T, seq_k=S, block_q=block_q, block_k=block_k, n_kv=n_kv,
-            has_mask=has_mask,
+            has_mask=has_mask, window=int(window),
         ),
         grid=(B, H, n_q, n_kv),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
@@ -466,28 +522,33 @@ def _flash_backward(q, k, v, kv_mask, out, lse, dout, causal, sm_scale,
     return dq[:, :, :T, :], dk[:, :, :S, :], dv[:, :, :S, :]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, kv_mask, has_mask, causal, sm_scale, block_q, block_k,
-           interpret, kv_group=1):
+           interpret, kv_group=1, window=0):
     out, _ = _flash_forward(q, k, v, kv_mask if has_mask else None, causal,
                             sm_scale, block_q, block_k, interpret,
-                            kv_group=kv_group)
+                            kv_group=kv_group, window=window)
     return out
 
 
 def _flash_fwd(q, k, v, kv_mask, has_mask, causal, sm_scale, block_q,
-               block_k, interpret, kv_group=1):
+               block_k, interpret, kv_group=1, window=0):
     out, lse = _flash_forward(q, k, v, kv_mask if has_mask else None,
                               causal, sm_scale, block_q, block_k, interpret,
-                              kv_group=kv_group)
+                              kv_group=kv_group, window=window)
     return out, (q, k, v, kv_mask, out, lse)
 
 
 def _flash_bwd(has_mask, causal, sm_scale, block_q, block_k, interpret,
-               kv_group, res, g):
+               kv_group, window, res, g):
     q, k, v, kv_mask, out, lse = res
     if _backward_impl() == "reference":
         mask = kv_mask[:, None, None, :].astype(bool) if has_mask else None
+        if window:
+            band = _window_band(q.shape[2], k.shape[2], window, causal)
+            band = band[None, None]
+            mask = band if mask is None else (mask & band)
 
         def ref(q_, k_, v_):
             k_r = jnp.repeat(k_, kv_group, axis=1) if kv_group != 1 else k_
@@ -500,6 +561,7 @@ def _flash_bwd(has_mask, causal, sm_scale, block_q, block_k, interpret,
     dq, dk, dv = _flash_backward(
         q, k, v, kv_mask if has_mask else None, out, lse, g, causal,
         sm_scale, block_q, block_k, interpret, kv_group=kv_group,
+        window=window,
     )
     return dq, dk, dv, jnp.zeros_like(kv_mask)
 
@@ -530,6 +592,7 @@ def flash_attention(
     force_reference=False,
     force_pallas=False,
     kv_group=1,
+    window=0,
 ):
     """Fused attention. q:[B,H,T,d], k,v:[B,H,S,d] -> [B,H,T,d].
 
@@ -547,6 +610,10 @@ def flash_attention(
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if int(window) < 0:
+        raise ValueError(
+            "flash_attention: window must be >= 0 (0 disables the "
+            "sliding window); got %d" % window)
     kv_mask = None
     if mask is not None:
         if mask.ndim == 2:
@@ -566,6 +633,11 @@ def flash_attention(
         if kv_group != 1:
             k = jnp.repeat(k, kv_group, axis=1)
             v = jnp.repeat(v, kv_group, axis=1)
+        if window:
+            band = _window_band(q.shape[2], k.shape[2], window,
+                                causal)[None, None]
+            ref_mask = band if ref_mask is None else (
+                ref_mask.astype(bool) & band)
         return flash_attention_reference(
             q, k, v, causal=causal, sm_scale=sm_scale, mask=ref_mask
         )
@@ -575,4 +647,5 @@ def flash_attention(
         # static dummy so the custom_vjp signature stays array-only
         kv_mask = jnp.ones((q.shape[0], 1), jnp.float32)
     return _flash(q, k, v, kv_mask.astype(jnp.float32), has_mask, causal,
-                  sm_scale, block_q, block_k, interpret, kv_group)
+                  sm_scale, block_q, block_k, interpret, kv_group,
+                  int(window))

@@ -20,7 +20,7 @@ __all__ = [
 
 def scaled_dot_product_attention(
     queries, keys, values, mask=None, causal=False, sm_scale=None,
-    impl="auto", seq_parallel_axis=None, kv_group=1, name=None
+    impl="auto", seq_parallel_axis=None, kv_group=1, window=0, name=None
 ):
     """Fused attention over [batch, heads, seq, head_dim] tensors.
 
@@ -43,6 +43,7 @@ def scaled_dot_product_attention(
             "impl": impl,
             "seq_parallel_axis": seq_parallel_axis or "",
             "kv_group": int(kv_group),
+            "window": int(window),
         },
     )
     return out

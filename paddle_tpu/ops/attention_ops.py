@@ -47,6 +47,11 @@ def _lower_sdpa(ctx, ins, attrs):
                 "scaled_dot_product_attention: kv_group > 1 is not "
                 "supported with seq_parallel_axis yet — repeat K/V to "
                 "full heads before the ring")
+        if int(attrs.get("window", 0)) != 0:
+            raise ValueError(
+                "scaled_dot_product_attention: window is not supported "
+                "with seq_parallel_axis yet (the ring absorbs whole "
+                "blocks)")
         mesh = ambient_mesh()
         if mesh is None or seq_axis not in mesh.shape:
             raise ValueError(
@@ -80,6 +85,7 @@ def _lower_sdpa(ctx, ins, attrs):
         force_reference=(impl == "reference"),
         force_pallas=(impl == "pallas"),
         kv_group=int(attrs.get("kv_group", 1)),
+        window=int(attrs.get("window", 0)),
     )
 
 
@@ -88,7 +94,7 @@ register_op(
     inputs=["Q", "K", "V", "Mask"],
     outputs=["Out"],
     attrs={"causal": False, "sm_scale": 0.0, "impl": "auto",
-           "seq_parallel_axis": "", "kv_group": 1},
+           "seq_parallel_axis": "", "kv_group": 1, "window": 0},
     lower=_lower_sdpa,
     no_grad_inputs=("Mask",),
     # Out mirrors Q's shape/dtype. Declared (not eval_shape'd) because the
