@@ -28,6 +28,10 @@ import jax.numpy as jnp
 _DEFAULT_BLOCK_Q = 128
 _DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
+# rows whose running max never rose above this saw no visible key:
+# forward zeroes them, backward skips them (must stay > _NEG_INF and
+# below any reachable finite score)
+_MASKED_ROW_LSE = -1e29
 
 
 def _is_tpu_target():
@@ -151,7 +155,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref, acc_ref,
 
     @pl.when(kj == n_kv - 1)
     def _finish():
-        o_ref[0, 0, :, :] = (
+        # A row with NO visible key keeps m at _NEG_INF: inside a
+        # computed tile its p = exp(-1e30 - (-1e30)) = 1 per entry, so
+        # acc holds a garbage mean-of-V — zero those rows explicitly to
+        # honor the fully-masked-rows-return-0 contract.
+        dead = m_ref[:, :] <= _MASKED_ROW_LSE
+        o_ref[0, 0, :, :] = jnp.where(
+            dead, 0.0,
             acc_ref[:, :] / jnp.maximum(l_ref[:, :], 1e-30)
         ).astype(o_ref.dtype)
         # log-sum-exp per query row, the backward pass's softmax residual;
@@ -307,7 +317,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.int32, (block_q, block_k), 1)
         # row validity: padded / fully-masked rows have lse ~ -1e30 and
         # must contribute nothing (exp(s - lse) would blow up there)
-        valid = (q_idx < seq_q) & (k_idx < seq_k) & (lse > -1e29)
+        valid = (q_idx < seq_q) & (k_idx < seq_k) & (lse > _MASKED_ROW_LSE)
         if has_mask:
             valid &= kvm_ref[0, 0, :][None, :] > 0
         if causal:
@@ -374,7 +384,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.int32, (block_q, block_k), 0)
         k_idx = k_base + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        valid = (q_idx < seq_q) & (k_idx < seq_k) & (lse > -1e29)
+        valid = (q_idx < seq_q) & (k_idx < seq_k) & (lse > _MASKED_ROW_LSE)
         if has_mask:
             valid &= kvm_ref[0, 0, :][None, :] > 0
         if causal:
