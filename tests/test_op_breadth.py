@@ -471,3 +471,18 @@ def test_one_hot_output():
     expect = np.zeros((3, 5), "float32")
     expect[np.arange(3), x.ravel()] = 1.0
     _t("one_hot", {"X": x}, {"Out": expect}, {"depth": 5}).check_output()
+
+
+def test_dynamic_update_slice_output_and_grad():
+    rng = _RNG(80)
+    x = rng.randn(5, 3).astype("float32")
+    u = rng.randn(1, 3).astype("float32")
+    idx = np.asarray([2], "int64")
+    expect = x.copy()
+    expect[2] = u[0]
+    t = _t("dynamic_update_slice", {"X": x, "Update": u, "Index": idx},
+           {"Out": expect}, {"axis": 0})
+    t.check_output()
+    _shapes("dynamic_update_slice", {"X": x, "Update": u, "Index": idx},
+            {"Out": (5, 3)}, {"axis": 0}).check_grad(
+        ["X", "Update"], "Out")
