@@ -1,0 +1,41 @@
+"""Offline dataset fixtures in each dataset's REAL on-disk format.
+
+This zero-egress rig cannot download the book datasets, so convergence
+tests and the on-chip convergence proof (tools/convergence_run.py) write
+deterministic, learnable fixtures in the native wire formats and push
+them through the real file->parser->reader pipeline (tests/
+test_book_realdata.py and the tool share these writers so the recipe
+cannot drift between them).
+
+Reference analogy: paddle/fluid/inference/tests' test.cmake downloads
+pinned artifacts; here the artifact is generated, but the parse path
+exercised is the same one real downloads take.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+
+def write_mnist_idx_fixture(dirname, n, seed, prefix):
+    """IDX gzip pair (images magic 2051, labels magic 2049): 10 class
+    templates + noise — linearly separable enough for the book
+    recognize_digits convergence threshold, deterministic per seed.
+    Returns (image_path, label_path)."""
+    rng = np.random.RandomState(seed)
+    templates = np.random.RandomState(1234).rand(10, 784)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    images = (0.75 * templates[labels] + 0.25 * rng.rand(n, 784))
+    images = (images * 255).astype(np.uint8)
+    os.makedirs(dirname, exist_ok=True)
+    img_path = os.path.join(dirname, prefix + "-images-idx3-ubyte.gz")
+    lbl_path = os.path.join(dirname, prefix + "-labels-idx1-ubyte.gz")
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return img_path, lbl_path

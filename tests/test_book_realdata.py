@@ -12,11 +12,9 @@ what trains here went through the same bytes-on-disk parse path real
 downloads use. (tests/test_book.py keeps the fast synthetic path.)
 """
 
-import gzip
 import hashlib
 import io
 import os
-import struct
 import tarfile
 
 import numpy as np
@@ -35,23 +33,12 @@ def _md5(path):
 
 
 # --- fixtures in real on-disk formats ------------------------------------
-def _write_mnist_fixture(dirname, n, seed, prefix):
-    """IDX gzip pair: class templates + noise, linearly separable."""
-    rng = np.random.RandomState(seed)
-    templates = np.random.RandomState(1234).rand(10, 784)
-    labels = rng.randint(0, 10, n).astype(np.uint8)
-    images = (0.75 * templates[labels] + 0.25 * rng.rand(n, 784))
-    images = (images * 255).astype(np.uint8)
-    os.makedirs(dirname, exist_ok=True)
-    img_path = os.path.join(dirname, prefix + "-images-idx3-ubyte.gz")
-    lbl_path = os.path.join(dirname, prefix + "-labels-idx1-ubyte.gz")
-    with gzip.open(img_path, "wb") as f:
-        f.write(struct.pack(">IIII", 2051, n, 28, 28))
-        f.write(images.tobytes())
-    with gzip.open(lbl_path, "wb") as f:
-        f.write(struct.pack(">II", 2049, n))
-        f.write(labels.tobytes())
-    return img_path, lbl_path
+# the MNIST IDX writer is shared with tools/convergence_run.py (the
+# on-chip convergence proof) via paddle_tpu.dataset.fixtures so the
+# recipe cannot drift between the test and the hardware artifact
+from paddle_tpu.dataset.fixtures import (  # noqa: E402
+    write_mnist_idx_fixture as _write_mnist_fixture,
+)
 
 
 def _write_housing_fixture(path, n=320, seed=4):

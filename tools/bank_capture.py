@@ -1,0 +1,137 @@
+"""Bank an on-chip bench capture into the round's driver-format BENCH file.
+
+VERDICT r3 Weak #5: three rounds running, the driver's end-of-round
+BENCH_r{N}.json degraded to a CPU proxy while fresher chip numbers sat in
+manual capture files. Fix: every successful capture immediately rewrites
+``BENCH_r04_manual.json`` at the repo root in the driver's own format, so
+bench.py's degraded path (which embeds the newest ``BENCH_r*_manual.json``
+as ``last_tpu_capture``) and any human reader always see the latest
+hardware truth.
+
+Usage:  python tools/bank_capture.py CAPTURE.json TAG
+  CAPTURE.json  a file whose last JSON line is bench.py output (driver
+                format: {"metric", "value", ..., "models": {...}})
+  TAG           experiment tag (e.g. transformer-default, resnet50-bs256)
+
+Behavior:
+* refuses captures with no model on platform "tpu" (CPU proxies must
+  never overwrite chip numbers) — exit 3, bank untouched;
+* merges TPU models into the bank's "models" map when the tag is a
+  *-default tag (the driver configuration), and always records the
+  capture under "experiments"[TAG] with a UTC timestamp + git rev;
+* recomputes the headline (resnet50 if banked, else first model);
+* commits the bank file — but only when nothing else is staged, so a
+  concurrent interactive commit can never swallow the watcher's change.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BANK = os.path.join(ROOT, "BENCH_r04_manual.json")
+
+
+def _last_json_line(path):
+    last = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                last = line
+    if last is None:
+        raise ValueError("no JSON line in %s" % path)
+    return json.loads(last)
+
+
+def _git(*args):
+    return subprocess.run(["git", "-C", ROOT] + list(args),
+                          capture_output=True, text=True)
+
+
+def main():
+    if len(sys.argv) != 3:
+        print(__doc__, file=sys.stderr)
+        return 2
+    capture_path, tag = sys.argv[1], sys.argv[2]
+    try:
+        cap = _last_json_line(capture_path)
+    except (OSError, ValueError) as e:
+        print("bank_capture: unreadable capture: %s" % e, file=sys.stderr)
+        return 2
+
+    tpu_models = {
+        name: m for name, m in (cap.get("models") or {}).items()
+        if isinstance(m, dict) and m.get("platform") == "tpu"
+    }
+    # single-worker captures (bench.py --worker) have no "models" wrapper
+    if not tpu_models and cap.get("platform") == "tpu" and "value" in cap:
+        name = "resnet50" if "resnet" in str(cap.get("metric")) else \
+            "transformer"
+        tpu_models = {name: cap}
+    if not tpu_models:
+        print("bank_capture: no TPU-platform model in capture; refusing "
+              "to bank a CPU proxy", file=sys.stderr)
+        return 3
+
+    bank = {}
+    if os.path.exists(BANK):
+        try:
+            with open(BANK) as f:
+                bank = json.load(f)
+        except ValueError:
+            bank = {}
+    bank.setdefault("models", {})
+    bank.setdefault("experiments", {})
+
+    if tag.endswith("-default"):
+        bank["models"].update(tpu_models)
+    rev = _git("rev-parse", "--short", "HEAD").stdout.strip()
+    bank["experiments"][tag] = {
+        "models": tpu_models,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_rev": rev,
+    }
+
+    if bank["models"].get("resnet50"):
+        headline, headline_from = bank["models"]["resnet50"], "resnet50-default"
+    elif bank["models"]:
+        name = next(iter(bank["models"]))
+        headline, headline_from = bank["models"][name], name + "-default"
+    else:
+        # no default-config capture banked yet: promote this experiment's
+        # first model so the file is never headline-less, but carry the
+        # experiment tag so a bs128/seq1024 number can't masquerade as
+        # the driver configuration
+        headline, headline_from = next(iter(tpu_models.values())), tag
+    for k in ("metric", "value", "unit", "vs_baseline", "mfu"):
+        bank[k] = headline.get(k)
+    bank["headline_from"] = headline_from
+    bank["device_kind"] = cap.get("device_kind", bank.get("device_kind"))
+    bank["banked_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    bank["git_rev"] = rev
+
+    tmp = BANK + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(bank, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, BANK)
+    print("banked %s -> %s" % (tag, os.path.basename(BANK)))
+
+    # commit only when the index is otherwise clean: a human mid-commit
+    # must never have the watcher's `git add` swept into their commit
+    if _git("diff", "--cached", "--quiet").returncode == 0:
+        _git("add", os.path.basename(BANK))
+        r = _git("commit", "-m",
+                 "Bank on-chip capture %s into BENCH_r04_manual" % tag)
+        print(r.stdout.strip() or r.stderr.strip())
+    else:
+        print("bank_capture: index busy; bank file left for the next "
+              "commit", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,145 @@
+"""Convergence-on-chip proof (VERDICT r3 Next #9): train the book MNIST
+conv model to its convergence threshold ON THE TPU and emit the loss
+curve + final test accuracy as a committable artifact.
+
+Reference: python/paddle/fluid/tests/book/test_recognize_digits.py trains
+to a convergence threshold on real downloaded MNIST. This rig has zero
+network egress, so the data is an IDX-gzip fixture written in MNIST's
+real on-disk format (class templates + noise, the test_book_realdata.py
+fixture recipe) and parsed by the REAL file->parser->reader pipeline
+under PADDLE_TPU_DATASET=real — the synthetic in-memory fallback is
+disabled, so what trains here went through the same bytes-on-disk path a
+real download would. The artifact records that provenance.
+
+Usage:  python tools/convergence_run.py            # TPU if reachable
+        BENCH_PLATFORM=cpu python tools/convergence_run.py   # CPU smoke
+Prints one JSON line (the artifact) and exits 0 on convergence,
+1 otherwise.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_TRAIN, N_TEST, BS = 4096, 1024, 64
+MAX_EPOCHS = 5
+ACC_THRESHOLD = 0.95  # test-split accuracy (book threshold is 0.9 train)
+
+
+def _batches(reader, bs):
+    buf = []
+    for sample in reader():
+        buf.append(sample)
+        if len(buf) == bs:
+            yield buf
+            buf = []
+
+
+def main():
+    data_home = tempfile.mkdtemp(prefix="convergence_mnist_")
+    # DATA_HOME is read at import time: set it before paddle_tpu loads
+    os.environ["PADDLE_TPU_DATA_HOME"] = data_home
+    os.environ["PADDLE_TPU_DATASET"] = "real"
+    # imported only after DATA_HOME is set: the package reads it at import
+    from paddle_tpu.dataset.fixtures import write_mnist_idx_fixture
+
+    write_mnist_idx_fixture(os.path.join(data_home, "mnist"), N_TRAIN, 7,
+                            "train")
+    write_mnist_idx_fixture(os.path.join(data_home, "mnist"), N_TEST, 8,
+                            "t10k")
+
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    import paddle_tpu as fluid
+    import paddle_tpu.dataset as ds
+    from paddle_tpu.models import mnist as mnist_model
+
+    # repoint the md5 pins at the fixtures (the book-realdata-test
+    # recipe): try_download then verifies the on-disk files and never
+    # touches the (absent) network
+    for attr in ("TRAIN_IMAGE", "TRAIN_LABEL", "TEST_IMAGE", "TEST_LABEL"):
+        fname = getattr(ds.mnist, attr)[0]
+        path = os.path.join(data_home, "mnist", fname)
+        md5 = hashlib.md5(open(path, "rb").read()).hexdigest()
+        setattr(ds.mnist, attr, (fname, md5))
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main_prog, startup):
+        loss, feeds, outs = mnist_model.build()
+        test_prog = main_prog.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    acc = outs["accuracy"]
+
+    place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    def feed_of(batch):
+        return {
+            "pixel": np.stack(
+                [s[0].reshape(1, 28, 28) for s in batch]),
+            "label": np.asarray([[s[1]] for s in batch], "int64"),
+        }
+
+    loss_curve = []  # (global_step, loss) every 8 steps
+    epochs_run = 0
+    final_acc = 0.0
+    t0 = time.perf_counter()
+    step = 0
+    for epoch in range(MAX_EPOCHS):
+        for batch in _batches(ds.mnist.train(), BS):
+            fetch = [loss] if step % 8 == 0 else []
+            out = exe.run(main_prog, feed=feed_of(batch), fetch_list=fetch)
+            if fetch:
+                loss_curve.append(
+                    [step, round(float(np.ravel(out[0])[0]), 5)])
+            step += 1
+        accs = [
+            float(np.ravel(exe.run(test_prog, feed=feed_of(b),
+                                   fetch_list=[acc])[0])[0])
+            for b in _batches(ds.mnist.test(), BS)
+        ]
+        final_acc = float(np.mean(accs))
+        epochs_run = epoch + 1
+        if final_acc >= ACC_THRESHOLD:
+            break
+    wall = time.perf_counter() - t0
+
+    artifact = {
+        "model": "mnist_conv (models/mnist.py, book recognize_digits)",
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "") or dev.platform,
+        "data": "IDX-gzip fixture in MNIST's real on-disk format, parsed "
+                "by the real pipeline (zero-egress rig; "
+                "PADDLE_TPU_DATASET=real, synthetic fallback disabled)",
+        "train_samples": N_TRAIN, "test_samples": N_TEST,
+        "batch_size": BS, "epochs_run": epochs_run, "steps": step,
+        "final_test_accuracy": round(final_acc, 4),
+        "threshold": ACC_THRESHOLD,
+        "converged": final_acc >= ACC_THRESHOLD,
+        "final_train_loss": loss_curve[-1][1] if loss_curve else None,
+        "wall_seconds": round(wall, 1),
+        "loss_curve": loss_curve,
+    }
+    print(json.dumps(artifact))
+    # exit 0 either way: a completed non-convergent run is still a valid
+    # (negative) artifact — the JSON carries "converged"; only a crash
+    # (unhandled exception) signals a capture worth discarding
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
