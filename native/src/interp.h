@@ -104,6 +104,23 @@ class Interpreter {
     if (op.type == "feed" || op.type == "fetch") return "";  // host-managed
     if (op.type == "mul") return RunMul(op, scope);
     if (op.type == "elementwise_add") return RunAdd(op, scope);
+    if (op.type == "elementwise_sub") {
+      return RunBinary(op, scope, [](float a, float b) { return a - b; });
+    }
+    if (op.type == "elementwise_mul") {
+      return RunBinary(op, scope, [](float a, float b) { return a * b; });
+    }
+    if (op.type == "elementwise_div") {
+      return RunBinary(op, scope, [](float a, float b) { return a / b; });
+    }
+    if (op.type == "elementwise_max") {
+      return RunBinary(op, scope,
+                       [](float a, float b) { return std::max(a, b); });
+    }
+    if (op.type == "elementwise_min") {
+      return RunBinary(op, scope,
+                       [](float a, float b) { return std::min(a, b); });
+    }
     if (op.type == "relu") return RunUnary(op, scope, [](float v) {
       return v > 0.0f ? v : 0.0f;
     });
@@ -147,6 +164,23 @@ class Interpreter {
     if (op.type == "sequence_mask") return RunSequenceMask(op, scope);
     if (op.type == "scaled_dot_product_attention") return RunSDPA(op, scope);
     if (op.type == "reduce_mean") return RunReduceMean(op, scope);
+    if (op.type == "reduce_sum") {
+      return RunReduce(op, scope, /*mean=*/false);
+    }
+    // model-zoo breadth (GoogLeNet/SE-ResNeXt/AlexNet/MT/Transformer
+    // serving + metric heads)
+    if (op.type == "concat") return RunConcat(op, scope);
+    if (op.type == "split") return RunSplit(op, scope);
+    if (op.type == "lrn") return RunLrn(op, scope);
+    if (op.type == "conv2d_transpose") return RunConvTranspose2d(op, scope);
+    if (op.type == "dynamic_gru") return RunDynamicGru(op, scope);
+    if (op.type == "attention_lstm") return RunAttentionLstm(op, scope);
+    if (op.type == "log_softmax") return RunLogSoftmax(op, scope);
+    if (op.type == "add_position_encoding") return RunPosEncoding(op, scope);
+    if (op.type == "cast") return RunCast(op, scope);
+    if (op.type == "cross_entropy") return RunCrossEntropy(op, scope);
+    if (op.type == "top_k") return RunTopK(op, scope);
+    if (op.type == "accuracy") return RunAccuracy(op, scope);
     if (op.type == "mean_grad") return RunMeanGrad(op, scope);
     if (op.type == "relu_grad") return RunReluGrad(op, scope);
     if (op.type == "softmax_with_cross_entropy_grad") {
@@ -338,6 +372,11 @@ class Interpreter {
     }
     if (v->dims != k->dims) return "V shape mismatch";
     bool causal = IntAttr(op, "causal", 0) != 0;
+    // sliding window, matching kernels/flash_attention.py _window_band:
+    // causal keeps q - w < k <= q; non-causal keeps |q - k| < w
+    // (window 0 = disabled)
+    int64_t window = IntAttr(op, "window", 0);
+    if (window < 0) return "bad window";
     float scale = FloatAttr(op, "sm_scale", 0.0f);
     if (scale == 0.0f) scale = 1.0f / std::sqrt(static_cast<float>(d));
     const std::string* mn = OneName(op, "Mask");
@@ -364,6 +403,8 @@ class Interpreter {
           bool any_valid = false;
           for (int64_t j = 0; j < S; ++j) {
             bool valid = (!causal || j <= t) &&
+                         (window == 0 ||
+                          (t - j < window && (causal || j - t < window))) &&
                          (ma == nullptr || ma[b * S + j] > 0.0f);
             if (valid) {
               any_valid = true;
@@ -400,6 +441,12 @@ class Interpreter {
 
   // reduce_mean over the attrs' dim list (keep_dim supported).
   std::string RunReduceMean(const OpDesc& op, Scope* scope) {
+    return RunReduce(op, scope, /*mean=*/true);
+  }
+
+  // shared reduce kernel: reduce_mean / reduce_sum differ only in the
+  // final divide (reduce_op.h functor-split capability)
+  std::string RunReduce(const OpDesc& op, Scope* scope, bool mean) {
     const std::string* xn = OneName(op, "X");
     const std::string* on = OneName(op, "Out", false);
     if (xn == nullptr || on == nullptr) return "missing io";
@@ -453,7 +500,9 @@ class Interpreter {
       }
       oa[oidx] += xa[idx];
     }
-    for (int64_t i = 0; i < on_elems; ++i) oa[i] /= denom;
+    if (mean) {
+      for (int64_t i = 0; i < on_elems; ++i) oa[i] /= denom;
+    }
     scope->Set(*on, std::move(out));
     return "";
   }
@@ -802,6 +851,13 @@ class Interpreter {
   }
 
   std::string RunAdd(const OpDesc& op, Scope* scope) {
+    return RunBinary(op, scope, [](float a, float b) { return a + b; });
+  }
+
+  // shared elementwise-with-broadcast kernel (elementwise_op_function.h
+  // role): add/sub/mul/div/min/max share the axis-aligned y broadcast
+  std::string RunBinary(const OpDesc& op, Scope* scope,
+                        const std::function<float(float, float)>& fn) {
     const std::string* xn = OneName(op, "X");
     const std::string* yn = OneName(op, "Y");
     const std::string* on = OneName(op, "Out", false);
@@ -847,7 +903,7 @@ class Interpreter {
     const float* ya = F32(*y);
     float* oa = MutF32(&out);
     for (int64_t i = 0; i < nx; ++i) {
-      oa[i] = xa[i] + ya[(i / inner) % ny];
+      oa[i] = fn(xa[i], ya[(i / inner) % ny]);
     }
     scope->Set(*on, std::move(out));
     return "";
@@ -1078,6 +1134,779 @@ class Interpreter {
   // ops/rnn_ops.py _lower_dynamic_lstm): Input [B,T,4D] pre-projected
   // gates, Weight [D,4D] recurrent matrix, Bias [4D] (+[3D] peephole
   // diagonals), gate order i,f,c,o; masked steps carry h/c through.
+  // ---- model-zoo breadth (VERDICT r3 Next #4): the ops GoogLeNet,
+  // SE-ResNeXt, AlexNet, VGG, the MT model and the Transformer's full
+  // logits path need beyond the CNN/transformer-encoder subset, so those
+  // models serve Python-free like NativePaddlePredictor serves any
+  // program (inference/api/api_impl.cc role).
+
+  std::string RunConcat(const OpDesc& op, Scope* scope) {
+    auto it = op.inputs.find("X");
+    const std::string* on = OneName(op, "Out", false);
+    if (it == op.inputs.end() || it->second.empty() || on == nullptr) {
+      return "missing io";
+    }
+    std::vector<const HostTensor*> xs;
+    for (const std::string& n : it->second) {
+      if (n.empty()) continue;
+      const HostTensor* x = scope->Find(n);
+      if (x == nullptr) return "input not in scope";
+      if (!IsF32(*x)) return "non-f32 dtype";
+      xs.push_back(x);
+    }
+    if (xs.empty()) return "no inputs";
+    size_t rank = xs[0]->dims.size();
+    int64_t axis = IntAttr(op, "axis", 0);
+    if (axis < 0) axis += rank;
+    if (axis < 0 || axis >= static_cast<int64_t>(rank)) return "bad axis";
+    std::vector<int64_t> odims = xs[0]->dims;
+    int64_t cat = 0;
+    for (const HostTensor* x : xs) {
+      if (x->dims.size() != rank) return "rank mismatch";
+      for (size_t d = 0; d < rank; ++d) {
+        if (static_cast<int64_t>(d) != axis && x->dims[d] != odims[d]) {
+          return "shape mismatch off the concat axis";
+        }
+      }
+      cat += x->dims[axis];
+    }
+    odims[axis] = cat;
+    // outer = product of dims before axis; copy per input its
+    // (axis..end) contiguous run for each outer index
+    int64_t outer = 1;
+    for (int64_t d = 0; d < axis; ++d) outer *= odims[d];
+    int64_t inner = 1;
+    for (size_t d = axis + 1; d < rank; ++d) inner *= odims[d];
+    HostTensor out = MakeF32(odims);
+    float* oa = MutF32(&out);
+    int64_t ostride = cat * inner;
+    int64_t off = 0;
+    for (const HostTensor* x : xs) {
+      const float* xa = F32(*x);
+      int64_t run = x->dims[axis] * inner;
+      for (int64_t o = 0; o < outer; ++o) {
+        std::copy(xa + o * run, xa + (o + 1) * run,
+                  oa + o * ostride + off);
+      }
+      off += run;
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunSplit(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    auto ot = op.outputs.find("Out");
+    if (xn == nullptr || ot == op.outputs.end() || ot->second.empty()) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr || !IsF32(*x)) return "bad input";
+    size_t rank = x->dims.size();
+    int64_t axis = IntAttr(op, "axis", 0);
+    if (axis < 0) axis += rank;
+    if (axis < 0 || axis >= static_cast<int64_t>(rank)) return "bad axis";
+    int64_t n_out = static_cast<int64_t>(ot->second.size());
+    std::vector<int64_t> sections = IntsAttr(op, "sections", {});
+    if (sections.empty()) {
+      int64_t num = IntAttr(op, "num", n_out);
+      if (num <= 0 || x->dims[axis] % num != 0) return "bad num";
+      sections.assign(num, x->dims[axis] / num);
+    }
+    if (static_cast<int64_t>(sections.size()) != n_out) {
+      return "sections/outputs mismatch";
+    }
+    int64_t total = 0;
+    for (int64_t s : sections) total += s;
+    if (total != x->dims[axis]) return "sections do not cover the axis";
+    int64_t outer = 1;
+    for (int64_t d = 0; d < axis; ++d) outer *= x->dims[d];
+    int64_t inner = 1;
+    for (size_t d = axis + 1; d < rank; ++d) inner *= x->dims[d];
+    const float* xa = F32(*x);
+    int64_t xstride = x->dims[axis] * inner;
+    int64_t off = 0;
+    for (int64_t k = 0; k < n_out; ++k) {
+      std::vector<int64_t> odims = x->dims;
+      odims[axis] = sections[k];
+      HostTensor out = MakeF32(odims);
+      float* oa = MutF32(&out);
+      int64_t run = sections[k] * inner;
+      for (int64_t o = 0; o < outer; ++o) {
+        std::copy(xa + o * xstride + off, xa + o * xstride + off + run,
+                  oa + o * run);
+      }
+      off += run;
+      scope->Set(ot->second[k], std::move(out));
+    }
+    return "";
+  }
+
+  std::string RunLrn(const OpDesc& op, Scope* scope) {
+    // cross-channel local response normalization (lrn_op.cc):
+    // out = x / (k + alpha * sum_{window n}(x^2))^beta, NCHW
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr || !IsF32(*x) || x->dims.size() != 4) {
+      return "bad input";
+    }
+    int64_t n = IntAttr(op, "n", 5);
+    float k = FloatAttr(op, "k", 2.0f);
+    float alpha = FloatAttr(op, "alpha", 1e-4f);
+    float beta = FloatAttr(op, "beta", 0.75f);
+    if (n <= 0) return "bad window";
+    int64_t half = n / 2;
+    int64_t b = x->dims[0], c = x->dims[1], h = x->dims[2], w = x->dims[3];
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    int64_t hw = h * w;
+    for (int64_t bi = 0; bi < b; ++bi) {
+      for (int64_t ci = 0; ci < c; ++ci) {
+        int64_t lo = std::max<int64_t>(0, ci - half);
+        int64_t hi = std::min<int64_t>(c - 1, ci + (n - 1 - half));
+        for (int64_t p = 0; p < hw; ++p) {
+          float acc = 0.0f;
+          for (int64_t cj = lo; cj <= hi; ++cj) {
+            float v = xa[(bi * c + cj) * hw + p];
+            acc += v * v;
+          }
+          float mid = k + alpha * acc;
+          oa[(bi * c + ci) * hw + p] =
+              xa[(bi * c + ci) * hw + p] / std::pow(mid, beta);
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunConvTranspose2d(const OpDesc& op, Scope* scope) {
+    // transposed conv (conv_transpose_op.cc role): scatter-accumulate
+    // the forward-conv adjoint; filter layout [in_c, out_c/groups, kh, kw]
+    const std::string* xn = OneName(op, "Input");
+    const std::string* wn = OneName(op, "Filter");
+    const std::string* on = OneName(op, "Output", false);
+    if (xn == nullptr || wn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* w = scope->Find(*wn);
+    if (x == nullptr || w == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*w)) return "non-f32 dtype";
+    if (x->dims.size() != 4 || w->dims.size() != 4) return "rank != 4";
+    auto strides = IntsAttr(op, "strides", {1, 1});
+    auto pads = IntsAttr(op, "paddings", {0, 0});
+    auto dil = IntsAttr(op, "dilations", {1, 1});
+    auto osize = IntsAttr(op, "output_size", {});
+    if (strides.size() != 2 || pads.size() != 2 || dil.size() != 2) {
+      return "bad geometry attrs";
+    }
+    int64_t groups = IntAttr(op, "groups", 1);
+    if (groups <= 0) groups = 1;
+    int64_t n = x->dims[0], ci = x->dims[1], h = x->dims[2], wd = x->dims[3];
+    int64_t wci = w->dims[0], cog = w->dims[1], kh = w->dims[2],
+            kw = w->dims[3];
+    if (wci != ci || ci % groups != 0) return "filter/channel mismatch";
+    int64_t co = cog * groups;
+    int64_t keffh = dil[0] * (kh - 1) + 1, keffw = dil[1] * (kw - 1) + 1;
+    int64_t oh = (h - 1) * strides[0] - 2 * pads[0] + keffh;
+    int64_t ow = (wd - 1) * strides[1] - 2 * pads[1] + keffw;
+    if (osize.size() == 2) {
+      // output_size picks among the stride-ambiguous candidates
+      // (ops/nn_ops.py _transpose_extra_pad contract)
+      if (osize[0] < oh || osize[0] >= oh + strides[0] ||
+          osize[1] < ow || osize[1] >= ow + strides[1]) {
+        return "output_size not reachable";
+      }
+      oh = osize[0];
+      ow = osize[1];
+    } else if (!osize.empty()) {
+      return "bad output_size";
+    }
+    if (oh <= 0 || ow <= 0) return "empty output";
+    HostTensor out = MakeF32({n, co, oh, ow});
+    const float* xa = F32(*x);
+    const float* wa = F32(*w);
+    float* oa = MutF32(&out);
+    std::fill(oa, oa + NumElements(out.dims), 0.0f);
+    int64_t cig = ci / groups;
+    for (int64_t b = 0; b < n; ++b) {
+      for (int64_t ic = 0; ic < ci; ++ic) {
+        int64_t g = ic / cig;
+        for (int64_t i = 0; i < h; ++i) {
+          for (int64_t j = 0; j < wd; ++j) {
+            float xv = xa[((b * ci + ic) * h + i) * wd + j];
+            if (xv == 0.0f) continue;
+            for (int64_t ocg = 0; ocg < cog; ++ocg) {
+              int64_t oc = g * cog + ocg;
+              for (int64_t r = 0; r < kh; ++r) {
+                int64_t yy = i * strides[0] - pads[0] + r * dil[0];
+                if (yy < 0 || yy >= oh) continue;
+                for (int64_t s = 0; s < kw; ++s) {
+                  int64_t xx = j * strides[1] - pads[1] + s * dil[1];
+                  if (xx < 0 || xx >= ow) continue;
+                  oa[((b * co + oc) * oh + yy) * ow + xx] +=
+                      xv * wa[((ic * cog + ocg) * kh + r) * kw + s];
+                }
+              }
+            }
+          }
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunLogSoftmax(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr || !IsF32(*x)) return "bad input";
+    size_t rank = x->dims.size();
+    int64_t axis = IntAttr(op, "axis", -1);
+    if (axis < 0) axis += rank;
+    if (axis != static_cast<int64_t>(rank) - 1) {
+      return "only last-axis log_softmax";
+    }
+    int64_t c = x->dims[rank - 1];
+    int64_t rows = NumElements(x->dims) / c;
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t r = 0; r < rows; ++r) {
+      const float* xr = xa + r * c;
+      float mx = xr[0];
+      for (int64_t j = 1; j < c; ++j) mx = std::max(mx, xr[j]);
+      float denom = 0.0f;
+      for (int64_t j = 0; j < c; ++j) denom += std::exp(xr[j] - mx);
+      float lse = mx + std::log(denom);
+      for (int64_t j = 0; j < c; ++j) oa[r * c + j] = xr[j] - lse;
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunPosEncoding(const OpDesc& op, Scope* scope) {
+    // sinusoid position table (ops/attention_ops.py contract:
+    // concat(sin, cos) halves over D): out = alpha*x + beta*table[t]
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr || !IsF32(*x) || x->dims.size() != 3) {
+      return "bad input";
+    }
+    int64_t b = x->dims[0], t = x->dims[1], d = x->dims[2];
+    if (d % 2 != 0) return "odd d_model";
+    float alpha = FloatAttr(op, "alpha", 1.0f);
+    float beta = FloatAttr(op, "beta", 1.0f);
+    int64_t half = d / 2;
+    std::vector<float> table(t * d);
+    for (int64_t p = 0; p < t; ++p) {
+      for (int64_t i = 0; i < half; ++i) {
+        double angle = p / std::pow(
+            10000.0, 2.0 * static_cast<double>(i) / d);
+        table[p * d + i] = std::sin(angle);
+        table[p * d + half + i] = std::cos(angle);
+      }
+    }
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t bi = 0; bi < b; ++bi) {
+      for (int64_t p = 0; p < t; ++p) {
+        for (int64_t j = 0; j < d; ++j) {
+          oa[(bi * t + p) * d + j] =
+              alpha * xa[(bi * t + p) * d + j] + beta * table[p * d + j];
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunCast(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    std::string out_dtype = StrAttr(op, "out_dtype", "float32");
+    int64_t total = NumElements(x->dims);
+    // int -> int never goes through a float intermediate (a double
+    // mangles int64 beyond 2^53)
+    if ((x->dtype == "int64" || x->dtype == "int32") &&
+        (out_dtype == "int64" || out_dtype == "int32")) {
+      HostTensor iout;
+      iout.dims = x->dims;
+      iout.dtype = out_dtype;
+      bool out64 = out_dtype == "int64";
+      iout.data.resize(total * (out64 ? sizeof(int64_t) : sizeof(int32_t)));
+      for (int64_t i = 0; i < total; ++i) {
+        int64_t v = x->dtype == "int64"
+            ? reinterpret_cast<const int64_t*>(x->data.data())[i]
+            : reinterpret_cast<const int32_t*>(x->data.data())[i];
+        if (out64) {
+          reinterpret_cast<int64_t*>(iout.data.data())[i] = v;
+        } else {
+          reinterpret_cast<int32_t*>(iout.data.data())[i] =
+              static_cast<int32_t>(v);
+        }
+      }
+      scope->Set(*on, std::move(iout));
+      return "";
+    }
+    // float-involved casts: read as double, write as the target
+    std::vector<double> vals(total);
+    if (x->dtype == "float32") {
+      const float* p = F32(*x);
+      for (int64_t i = 0; i < total; ++i) vals[i] = p[i];
+    } else if (x->dtype == "int64") {
+      const int64_t* p = reinterpret_cast<const int64_t*>(x->data.data());
+      for (int64_t i = 0; i < total; ++i) {
+        vals[i] = static_cast<double>(p[i]);
+      }
+    } else if (x->dtype == "int32") {
+      const int32_t* p = reinterpret_cast<const int32_t*>(x->data.data());
+      for (int64_t i = 0; i < total; ++i) vals[i] = p[i];
+    } else {
+      return "unsupported source dtype " + x->dtype;
+    }
+    HostTensor out;
+    out.dims = x->dims;
+    out.dtype = out_dtype;
+    if (out_dtype == "float32") {
+      out.data.resize(total * sizeof(float));
+      float* p = reinterpret_cast<float*>(out.data.data());
+      for (int64_t i = 0; i < total; ++i) {
+        p[i] = static_cast<float>(vals[i]);
+      }
+    } else if (out_dtype == "int64") {
+      out.data.resize(total * sizeof(int64_t));
+      int64_t* p = reinterpret_cast<int64_t*>(out.data.data());
+      for (int64_t i = 0; i < total; ++i) {
+        p[i] = static_cast<int64_t>(vals[i]);
+      }
+    } else if (out_dtype == "int32") {
+      out.data.resize(total * sizeof(int32_t));
+      int32_t* p = reinterpret_cast<int32_t*>(out.data.data());
+      for (int64_t i = 0; i < total; ++i) {
+        p[i] = static_cast<int32_t>(vals[i]);
+      }
+    } else {
+      return "unsupported target dtype " + out_dtype;
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunCrossEntropy(const OpDesc& op, Scope* scope) {
+    // hard-label NLL over probabilities (cross_entropy_op.cc):
+    // y = -log(max(p[label], eps)), eps matching ops/loss_ops.py
+    const std::string* xn = OneName(op, "X");
+    const std::string* ln = OneName(op, "Label");
+    const std::string* on = OneName(op, "Y", false);
+    if (xn == nullptr || ln == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    if (IntAttr(op, "soft_label", 0) != 0) return "soft_label unsupported";
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* label = scope->Find(*ln);
+    if (x == nullptr || label == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.size() != 2) return "bad probs";
+    int64_t n = x->dims[0], c = x->dims[1];
+    if (NumElements(label->dims) != n) return "label count mismatch";
+    std::vector<int64_t> lbl(n);
+    if (label->dtype == "int64") {
+      const int64_t* p =
+          reinterpret_cast<const int64_t*>(label->data.data());
+      std::copy(p, p + n, lbl.begin());
+    } else if (label->dtype == "int32") {
+      const int32_t* p =
+          reinterpret_cast<const int32_t*>(label->data.data());
+      std::copy(p, p + n, lbl.begin());
+    } else {
+      return "non-integer label";
+    }
+    HostTensor out = MakeF32({n, 1});
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t i = 0; i < n; ++i) {
+      if (lbl[i] < 0 || lbl[i] >= c) return "label out of range";
+      oa[i] = -std::log(std::max(xa[i * c + lbl[i]], 1e-8f));
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunTopK(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    const std::string* in = OneName(op, "Indices", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr || !IsF32(*x) || x->dims.empty()) return "bad input";
+    int64_t k = IntAttr(op, "k", 1);
+    int64_t c = x->dims.back();
+    if (k <= 0 || k > c) return "bad k";
+    int64_t rows = NumElements(x->dims) / c;
+    std::vector<int64_t> odims = x->dims;
+    odims.back() = k;
+    HostTensor vals = MakeF32(odims);
+    HostTensor idx;
+    idx.dtype = "int64";
+    idx.dims = odims;
+    idx.data.resize(rows * k * sizeof(int64_t));
+    const float* xa = F32(*x);
+    float* va = MutF32(&vals);
+    int64_t* ia = reinterpret_cast<int64_t*>(idx.data.data());
+    std::vector<int64_t> order(c);
+    for (int64_t r = 0; r < rows; ++r) {
+      const float* xr = xa + r * c;
+      for (int64_t j = 0; j < c; ++j) order[j] = j;
+      // stable partial sort: ties keep the lower index first, matching
+      // jax.lax.top_k
+      std::stable_sort(order.begin(), order.end(),
+                       [xr](int64_t a, int64_t b2) {
+                         return xr[a] > xr[b2];
+                       });
+      for (int64_t j = 0; j < k; ++j) {
+        va[r * k + j] = xr[order[j]];
+        ia[r * k + j] = order[j];
+      }
+    }
+    scope->Set(*on, std::move(vals));
+    if (in != nullptr) scope->Set(*in, std::move(idx));
+    return "";
+  }
+
+  std::string RunAccuracy(const OpDesc& op, Scope* scope) {
+    // hit-rate over top-k indices (accuracy_op.cc): Indices [N, k],
+    // Label [N, 1] -> Accuracy [1]
+    const std::string* in = OneName(op, "Indices");
+    const std::string* ln = OneName(op, "Label");
+    const std::string* an = OneName(op, "Accuracy", false);
+    if (in == nullptr || ln == nullptr || an == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* indices = scope->Find(*in);
+    const HostTensor* label = scope->Find(*ln);
+    if (indices == nullptr || label == nullptr) return "input not in scope";
+    if (indices->dtype != "int64" || indices->dims.size() != 2) {
+      return "bad indices";
+    }
+    int64_t n = indices->dims[0], k = indices->dims[1];
+    if (NumElements(label->dims) != n) return "label count mismatch";
+    std::vector<int64_t> lbl(n);
+    if (label->dtype == "int64") {
+      const int64_t* p =
+          reinterpret_cast<const int64_t*>(label->data.data());
+      std::copy(p, p + n, lbl.begin());
+    } else if (label->dtype == "int32") {
+      const int32_t* p =
+          reinterpret_cast<const int32_t*>(label->data.data());
+      std::copy(p, p + n, lbl.begin());
+    } else {
+      return "non-integer label";
+    }
+    const int64_t* ia =
+        reinterpret_cast<const int64_t*>(indices->data.data());
+    int64_t correct = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      for (int64_t j = 0; j < k; ++j) {
+        if (ia[i * k + j] == lbl[i]) {
+          ++correct;
+          break;
+        }
+      }
+    }
+    HostTensor acc = MakeF32({1});
+    MutF32(&acc)[0] =
+        static_cast<float>(correct) / static_cast<float>(n);
+    scope->Set(*an, std::move(acc));
+    const std::string* cn = OneName(op, "Correct", false);
+    const std::string* tn = OneName(op, "Total", false);
+    if (cn != nullptr) {
+      HostTensor c32;
+      c32.dtype = "int32";
+      c32.dims = {1};
+      c32.data.resize(sizeof(int32_t));
+      *reinterpret_cast<int32_t*>(c32.data.data()) =
+          static_cast<int32_t>(correct);
+      scope->Set(*cn, std::move(c32));
+    }
+    if (tn != nullptr) {
+      HostTensor t32;
+      t32.dtype = "int32";
+      t32.dims = {1};
+      t32.data.resize(sizeof(int32_t));
+      *reinterpret_cast<int32_t*>(t32.data.data()) =
+          static_cast<int32_t>(n);
+      scope->Set(*tn, std::move(t32));
+    }
+    return "";
+  }
+
+  std::string RunAttentionLstm(const OpDesc& op, Scope* scope) {
+    // fused per-step attention + LSTM cell (attention_lstm_op.cc role;
+    // math contract = ops/seq2seq_ops.py _lower_attention_lstm):
+    //   e[b,s] = tanh(enc_proj[b,s]@wa_e + (h@Ws)@wa_s); alpha =
+    //   masked softmax_s(e); context = sum_s alpha*enc_vec;
+    //   gates = [h, context, x_t]@CellW + CellB -> standard cell
+    const std::string* xn = OneName(op, "X");
+    const std::string* evn = OneName(op, "EncoderVec");
+    const std::string* epn = OneName(op, "EncoderProj");
+    const std::string* h0n = OneName(op, "H0");
+    const std::string* wsn = OneName(op, "StateProjW");
+    const std::string* wan = OneName(op, "AttnW");
+    const std::string* cwn = OneName(op, "CellW");
+    const std::string* cbn = OneName(op, "CellB");
+    const std::string* hn = OneName(op, "Hidden", false);
+    if (xn == nullptr || evn == nullptr || epn == nullptr ||
+        h0n == nullptr || wsn == nullptr || wan == nullptr ||
+        cwn == nullptr || cbn == nullptr || hn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* ev = scope->Find(*evn);
+    const HostTensor* ep = scope->Find(*epn);
+    const HostTensor* h0 = scope->Find(*h0n);
+    const HostTensor* ws = scope->Find(*wsn);
+    const HostTensor* wa = scope->Find(*wan);
+    const HostTensor* cw = scope->Find(*cwn);
+    const HostTensor* cb = scope->Find(*cbn);
+    for (const HostTensor* t :
+         {x, ev, ep, h0, ws, wa, cw, cb}) {
+      if (t == nullptr) return "input not in scope";
+      if (!IsF32(*t)) return "non-f32 dtype";
+    }
+    if (x->dims.size() != 3 || ev->dims.size() != 3 ||
+        ep->dims.size() != 3 || h0->dims.size() != 2 ||
+        ws->dims.size() != 2 || cw->dims.size() != 2) {
+      return "bad ranks";
+    }
+    int64_t B = x->dims[0], T = x->dims[1], M = x->dims[2];
+    int64_t S = ev->dims[1], C = ev->dims[2], D = h0->dims[1];
+    if (ev->dims[0] != B || ep->dims[0] != B || ep->dims[1] != S ||
+        ep->dims[2] != D || h0->dims[0] != B ||
+        ws->dims[0] != D || ws->dims[1] != D ||
+        NumElements(wa->dims) != 2 * D ||
+        cw->dims[0] != D + C + M || cw->dims[1] != 4 * D ||
+        NumElements(cb->dims) != 4 * D) {
+      return "shape mismatch";
+    }
+    std::vector<float> c0v(B * D, 0.0f);
+    const std::string* c0n = OneName(op, "C0");
+    if (c0n != nullptr) {
+      const HostTensor* c0 = scope->Find(*c0n);
+      if (c0 == nullptr || !IsF32(*c0) ||
+          NumElements(c0->dims) != B * D) {
+        return "bad C0";
+      }
+      const float* p = F32(*c0);
+      std::copy(p, p + B * D, c0v.begin());
+    }
+    std::vector<int64_t> enc_lens(B, S);
+    const std::string* eln = OneName(op, "EncoderLen");
+    if (eln != nullptr) {
+      const HostTensor* el = scope->Find(*eln);
+      if (el == nullptr || NumElements(el->dims) != B) {
+        return "bad EncoderLen";
+      }
+      if (el->dtype == "int64") {
+        const int64_t* p =
+            reinterpret_cast<const int64_t*>(el->data.data());
+        std::copy(p, p + B, enc_lens.begin());
+      } else if (el->dtype == "int32") {
+        const int32_t* p =
+            reinterpret_cast<const int32_t*>(el->data.data());
+        std::copy(p, p + B, enc_lens.begin());
+      } else {
+        return "non-integer EncoderLen";
+      }
+      for (int64_t i = 0; i < B; ++i) {
+        enc_lens[i] = std::min<int64_t>(std::max<int64_t>(enc_lens[i], 0),
+                                        S);
+      }
+    }
+    const float* xa = F32(*x);
+    const float* eva = F32(*ev);
+    const float* epa = F32(*ep);
+    const float* wsa = F32(*ws);
+    const float* waa = F32(*wa);  // [2D]: wa_e = [:D], wa_s = [D:]
+    const float* cwa = F32(*cw);
+    const float* cba = F32(*cb);
+    HostTensor hidden = MakeF32({B, T, D});
+    float* ha = MutF32(&hidden);
+    const std::string* cn = OneName(op, "Cell", false);
+    const std::string* awn = OneName(op, "AttentionWeight", false);
+    HostTensor cell = MakeF32({B, T, D});
+    HostTensor attw = MakeF32({B, T, S});
+    float* ca = MutF32(&cell);
+    float* awa = MutF32(&attw);
+    std::vector<float> h(B * D), c(c0v), sp(D), e(S), ctx(C),
+        gates(4 * D);
+    std::copy(F32(*h0), F32(*h0) + B * D, h.begin());
+    for (int64_t t = 0; t < T; ++t) {
+      for (int64_t b = 0; b < B; ++b) {
+        const float* hrow = h.data() + b * D;
+        float* crow = c.data() + b * D;
+        // state_proj = h @ Ws, then its scalar read (state_proj @ wa_s)
+        float sp_scalar = 0.0f;
+        for (int64_t j = 0; j < D; ++j) {
+          float acc = 0.0f;
+          for (int64_t k2 = 0; k2 < D; ++k2) {
+            acc += hrow[k2] * wsa[k2 * D + j];
+          }
+          sp[j] = acc;
+          sp_scalar += acc * waa[D + j];
+        }
+        float mx = -1e30f;
+        int64_t len = enc_lens[b];
+        for (int64_t s = 0; s < S; ++s) {
+          if (s < len) {
+            float dot = 0.0f;
+            for (int64_t j = 0; j < D; ++j) {
+              dot += epa[(b * S + s) * D + j] * waa[j];
+            }
+            e[s] = std::tanh(dot + sp_scalar);
+            mx = std::max(mx, e[s]);
+          } else {
+            e[s] = -1e30f;
+          }
+        }
+        float denom = 0.0f;
+        for (int64_t s = 0; s < S; ++s) {
+          e[s] = std::exp(e[s] - mx);
+          denom += e[s];
+        }
+        if (denom <= 0.0f) denom = 1.0f;
+        std::fill(ctx.begin(), ctx.end(), 0.0f);
+        for (int64_t s = 0; s < S; ++s) {
+          float alpha = e[s] / denom;
+          awa[(b * T + t) * S + s] = alpha;
+          const float* evr = eva + (b * S + s) * C;
+          for (int64_t j = 0; j < C; ++j) ctx[j] += alpha * evr[j];
+        }
+        // gates = [h, context, x_t] @ CellW + CellB
+        const float* xrow = xa + (b * T + t) * M;
+        for (int64_t g = 0; g < 4 * D; ++g) {
+          float acc = cba[g];
+          for (int64_t j = 0; j < D; ++j) {
+            acc += hrow[j] * cwa[j * 4 * D + g];
+          }
+          for (int64_t j = 0; j < C; ++j) {
+            acc += ctx[j] * cwa[(D + j) * 4 * D + g];
+          }
+          for (int64_t j = 0; j < M; ++j) {
+            acc += xrow[j] * cwa[(D + C + j) * 4 * D + g];
+          }
+          gates[g] = acc;
+        }
+        float* hout = h.data() + b * D;
+        for (int64_t k2 = 0; k2 < D; ++k2) {
+          float iv = 1.0f / (1.0f + std::exp(-gates[0 * D + k2]));
+          float fv = 1.0f / (1.0f + std::exp(-gates[1 * D + k2]));
+          float gv = std::tanh(gates[2 * D + k2]);
+          float ov = 1.0f / (1.0f + std::exp(-gates[3 * D + k2]));
+          float cv = fv * crow[k2] + iv * gv;
+          crow[k2] = cv;
+          hout[k2] = ov * std::tanh(cv);
+        }
+        for (int64_t k2 = 0; k2 < D; ++k2) {
+          ha[(b * T + t) * D + k2] = hout[k2];
+          ca[(b * T + t) * D + k2] = crow[k2];
+        }
+      }
+    }
+    scope->Set(*hn, std::move(hidden));
+    if (cn != nullptr) scope->Set(*cn, std::move(cell));
+    if (awn != nullptr) scope->Set(*awn, std::move(attw));
+    return "";
+  }
+
+  std::string RunDynamicGru(const OpDesc& op, Scope* scope) {
+    // GRU recurrence matching ops/rnn_ops.py _lower_dynamic_gru: gates
+    // g = x[:, :2D] + h @ W[:, :2D] + b[:2D]; u = act(g[:, :D]),
+    // r = act(g[:, D:2D]); c = cand(x[:, 2D:] + (r*h) @ W[:, 2D:] +
+    // b[2D:]); h' = u*h + (1-u)*c
+    const std::string* xn = OneName(op, "Input");
+    const std::string* wn = OneName(op, "Weight");
+    const std::string* hn = OneName(op, "Hidden", false);
+    if (xn == nullptr || wn == nullptr || hn == nullptr) return "missing io";
+    if (OneName(op, "H0") != nullptr) {
+      return "H0 initial state not supported";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* w = scope->Find(*wn);
+    if (x == nullptr || w == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*w)) return "non-f32 dtype";
+    if (x->dims.size() != 3 || w->dims.size() != 2) return "bad ranks";
+    int64_t b = x->dims[0], t = x->dims[1], d = w->dims[0];
+    if (x->dims[2] != 3 * d || w->dims[1] != 3 * d) return "gate dims";
+    bool reverse = IntAttr(op, "is_reverse", 0) != 0;
+    bool ok1 = true, ok2 = true;
+    auto gate_act = ActFn(StrAttr(op, "gate_activation", "sigmoid"), &ok1);
+    auto cand_act = ActFn(StrAttr(op, "activation", "tanh"), &ok2);
+    if (!ok1 || !ok2) return "unsupported activation";
+    const float* bias = nullptr;
+    const std::string* bn = OneName(op, "Bias");
+    if (bn != nullptr) {
+      const HostTensor* bt = scope->Find(*bn);
+      if (bt == nullptr) return "Bias not in scope";
+      if (!IsF32(*bt) || NumElements(bt->dims) < 3 * d) return "bad bias";
+      bias = F32(*bt);
+    }
+    std::vector<int64_t> lens;
+    std::string err = RowLengths(op, scope, b, t, &lens);
+    if (!err.empty()) return err;
+    HostTensor hidden = MakeF32({b, t, d});
+    const float* xa = F32(*x);
+    const float* wa = F32(*w);
+    float* ha = MutF32(&hidden);
+    std::vector<float> h(b * d, 0.0f), g(2 * d), c(d), rh(d);
+    for (int64_t step = 0; step < t; ++step) {
+      int64_t s = reverse ? t - 1 - step : step;
+      for (int64_t i = 0; i < b; ++i) {
+        bool valid = s < lens[i];
+        const float* xrow = xa + (i * t + s) * 3 * d;
+        float* hrow = h.data() + i * d;
+        if (valid) {
+          for (int64_t j = 0; j < 2 * d; ++j) {
+            float acc = xrow[j] + (bias != nullptr ? bias[j] : 0.0f);
+            for (int64_t k = 0; k < d; ++k) {
+              acc += hrow[k] * wa[k * 3 * d + j];
+            }
+            g[j] = acc;
+          }
+          for (int64_t k = 0; k < d; ++k) {
+            rh[k] = gate_act(g[d + k]) * hrow[k];  // r * h
+          }
+          for (int64_t k = 0; k < d; ++k) {
+            float acc = xrow[2 * d + k] +
+                        (bias != nullptr ? bias[2 * d + k] : 0.0f);
+            for (int64_t m = 0; m < d; ++m) {
+              acc += rh[m] * wa[m * 3 * d + 2 * d + k];
+            }
+            c[k] = cand_act(acc);
+          }
+          for (int64_t k = 0; k < d; ++k) {
+            float u = gate_act(g[k]);
+            hrow[k] = u * hrow[k] + (1.0f - u) * c[k];
+          }
+        }
+        for (int64_t k = 0; k < d; ++k) {
+          ha[(i * t + s) * d + k] = hrow[k];
+        }
+      }
+    }
+    scope->Set(*hn, std::move(hidden));
+    return "";
+  }
+
   std::string RunDynamicLstm(const OpDesc& op, Scope* scope) {
     const std::string* xn = OneName(op, "Input");
     const std::string* wn = OneName(op, "Weight");

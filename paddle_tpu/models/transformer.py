@@ -301,15 +301,8 @@ def beam_generate(exe, infer_prog, logits_var, src, src_len, max_length,
         pre_scores = np.asarray(sel_scores)
         if (token == eos_id).all():
             break
-    # length penalty over the eos-trimmed lengths
-    trg_bk = trg.reshape(bs, K, max_length)
-    tail = trg_bk[:, :, 1:]
-    has_eos = (tail == eos_id).any(-1)
-    first = (tail == eos_id).argmax(-1)
-    lengths = np.where(has_eos, first + 1, max_length).astype(np.float64)
-    lp = ((5.0 + lengths) / 6.0) ** len_penalty
-    best = (pre_scores.astype(np.float64) / lp).argmax(-1)
-    return trg_bk[np.arange(bs), best]
+    return _pick_best_beam(trg, pre_scores, bs, K, max_length, eos_id,
+                           len_penalty)
 
 
 def position_encoding_row(t, d_model, dtype="float32"):

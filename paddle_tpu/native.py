@@ -28,6 +28,28 @@ _lib_lock = threading.Lock()
 _build_error = None
 
 
+def _stale():
+    """True when any native source is newer than the built library —
+    the cmake path rebuilds incrementally anyway, but the bare-g++
+    fallback (and a pre-built .so from an older checkout) would
+    otherwise serve stale code silently."""
+    try:
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+    for sub in ("src", "include"):
+        root = os.path.join(_NATIVE_DIR, sub)
+        for dirpath, _, files in os.walk(root):
+            for fn in files:
+                try:
+                    if os.path.getmtime(os.path.join(dirpath, fn)) \
+                            > lib_mtime:
+                        return True
+                except OSError:
+                    continue
+    return False
+
+
 def _build_library():
     """Compile libptpu_core.so (cmake+ninja, falling back to bare g++)."""
     build_dir = os.path.join(_NATIVE_DIR, "build")
@@ -108,7 +130,12 @@ def _declare(lib):
 
 
 def get_lib():
-    """Load (building if needed) the native library; None if unbuildable."""
+    """Load (building if needed) the native library; None if unbuildable.
+
+    A failed stale-rebuild falls back to loading the existing library:
+    stale-but-working beats none (e.g. a shipped prebuilt .so on a
+    machine with no toolchain whose file mtimes got scrambled by the
+    copy)."""
     global _lib, _build_error
     with _lib_lock:
         if _lib is not None:
@@ -118,6 +145,11 @@ def get_lib():
         try:
             if not os.path.exists(_LIB_PATH):
                 _build_library()
+            elif _stale():
+                try:
+                    _build_library()
+                except Exception:
+                    pass  # keep serving the existing (stale) library
             lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)
             _lib = lib
@@ -134,12 +166,18 @@ def available():
 
 
 def prebuilt():
-    """True only if libptpu_core.so is already built — never triggers a
-    compile. Hot paths (PyReader) use this so constructing a reader never
-    stalls on a surprise cmake build."""
+    """True only if libptpu_core.so is already built AND fresh — never
+    triggers a compile. Hot paths (PyReader) use this so constructing a
+    reader never stalls on a surprise cmake build. A STALE prebuilt lib
+    returns False instead of being loaded: loading it would cache the
+    stale handle into _lib and silently bypass the rebuild every later
+    get_lib() would otherwise run (CDLL handles can't be reloaded
+    in-process)."""
     if _lib is not None:
         return True
-    return os.path.exists(_LIB_PATH) and available()
+    if not os.path.exists(_LIB_PATH) or _stale():
+        return False
+    return get_lib() is not None  # fresh: no build can trigger
 
 
 def last_error():

@@ -1,0 +1,50 @@
+"""Testing utilities: deterministic parameter materialization.
+
+``set_deterministic_params`` overwrites every persistable a program's
+startup created with values drawn from numpy (seeded per variable name),
+so a model's parameters are bit-identical across runs, platforms, and
+jax versions — the foundation the committed golden-output regressions
+(tests/golden/, tools/make_goldens.py) rest on. The reference pins
+inference regressions to downloaded pretrained models
+(paddle/fluid/inference/tests/api/, inference/test.cmake); with zero
+egress the pin is deterministic synthetic weights instead, which pins
+the same thing: the serving stack's numerics over a fixed program and
+fixed parameters.
+"""
+
+import hashlib
+
+import numpy as np
+
+
+def _seed_of(name):
+    return int.from_bytes(
+        hashlib.md5(name.encode("utf-8")).digest()[:4], "little")
+
+
+def set_deterministic_params(program, scope, scale=0.1):
+    """Overwrite every float persistable of ``program`` in ``scope`` with
+    seeded numpy values. BatchNorm running stats get valid statistics
+    (mean ~ small, variance >= 0.5) so the is_test normalization path is
+    well-conditioned."""
+    for var in program.global_block().vars.values():
+        if not getattr(var, "persistable", False):
+            continue
+        cur = scope.get_value(var.name)  # None when not in scope
+        if cur is None:
+            continue
+        cur = np.asarray(cur)
+        if cur.dtype.kind != "f":
+            continue
+        rng = np.random.RandomState(_seed_of(var.name))
+        lname = var.name.lower()
+        # batch_norm running stats: ".var_0"/"variance" must stay
+        # positive or the is_test rsqrt goes NaN
+        if "variance" in lname or ".var_" in lname or \
+                lname.endswith("_var") or lname.endswith(".var"):
+            val = 0.5 + rng.rand(*cur.shape)
+        elif "mean" in lname:
+            val = 0.05 * rng.randn(*cur.shape)
+        else:
+            val = scale * rng.randn(*cur.shape)
+        scope.set_value(var.name, val.astype(cur.dtype))

@@ -1,0 +1,153 @@
+"""The golden-regression model registry (shared by tools/make_goldens.py
+and tests/test_golden_cpp.py).
+
+Each entry builds a model's serving slice at a small, C++-interpreter-
+friendly shape and supplies a seeded feed. Parameters are materialized
+deterministically (paddle_tpu.testing.set_deterministic_params), so
+(model code, param recipe, feed) fully determine the expected output —
+which is what tests/golden/<name>.npz pins.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _img_feed(name, shape, seed):
+    rng = np.random.RandomState(seed)
+    return {name: rng.rand(*shape).astype("float32")}
+
+
+def _mnist():
+    from paddle_tpu.models import mnist
+
+    _, feeds, outs = mnist.build()
+    return ["pixel"], outs["predict"], _img_feed("pixel", (2, 1, 28, 28), 31)
+
+
+def _resnet_cifar10():
+    from paddle_tpu.models import resnet
+
+    _, feeds, outs = resnet.build(img_shape=(3, 32, 32), class_num=10,
+                                  variant="cifar10", depth=20)
+    return ["pixel"], outs["predict"], _img_feed("pixel", (2, 3, 32, 32), 32)
+
+
+def _vgg():
+    from paddle_tpu.models import vgg
+
+    _, feeds, outs = vgg.build(img_shape=(3, 32, 32), class_num=10)
+    return ["pixel"], outs["predict"], _img_feed("pixel", (1, 3, 32, 32), 33)
+
+
+def _googlenet():
+    from paddle_tpu.models import googlenet
+
+    _, feeds, outs = googlenet.build(img_shape=(3, 96, 96), class_num=10)
+    return ["pixel"], outs["predict"], _img_feed("pixel", (1, 3, 96, 96), 34)
+
+
+def _se_resnext():
+    from paddle_tpu.models import se_resnext
+
+    _, feeds, outs = se_resnext.build(img_shape=(3, 64, 64), class_num=10)
+    return ["pixel"], outs["predict"], _img_feed("pixel", (1, 3, 64, 64), 35)
+
+
+def _alexnet():
+    from paddle_tpu.models import alexnet
+
+    _, feeds, outs = alexnet.build(img_shape=(3, 224, 224), class_num=10)
+    return ["pixel"], outs["predict"], _img_feed(
+        "pixel", (1, 3, 224, 224), 36)
+
+
+def _stacked_lstm():
+    from paddle_tpu.models import stacked_lstm
+
+    _, feeds, outs = stacked_lstm.build()
+    rng = np.random.RandomState(37)
+    names = [getattr(f, "name", f) for f in feeds]
+    data_name, len_name = names[0], names[1]
+    feed = {
+        data_name: rng.randint(0, 100, (2, 16)).astype("int64"),
+        len_name: np.asarray([[16], [9]], "int64"),
+    }
+    return [data_name, len_name], outs["predict"], feed
+
+
+def _transformer():
+    from paddle_tpu.models import transformer
+
+    bs, seq, vocab = 2, 8, 60
+    _, feeds, outs = transformer.build(
+        src_vocab_size=vocab, trg_vocab_size=vocab, max_length=seq,
+        n_layer=1, n_head=2, d_model=32, d_inner=64, dropout=0.0)
+    rng = np.random.RandomState(38)
+    feed = {
+        "src_word": rng.randint(1, vocab, (bs, seq)).astype("int64"),
+        "src_len": np.asarray([[seq], [seq - 3]], "int64"),
+        "trg_word": rng.randint(1, vocab, (bs, seq)).astype("int64"),
+        "trg_len": np.asarray([[seq], [seq - 2]], "int64"),
+    }
+    return (["src_word", "src_len", "trg_word", "trg_len"],
+            outs["logits"], feed)
+
+
+def _machine_translation():
+    from paddle_tpu.models import machine_translation as mt
+
+    bs, ts, tt = 2, 6, 5
+    avg_cost, feeds, _ = mt.build(
+        src_vocab=40, tgt_vocab=30, src_seq_len=ts, tgt_seq_len=tt,
+        emb_dim=8, encoder_size=8, decoder_size=8)
+    rng = np.random.RandomState(39)
+    mask = np.ones((bs, tt), "float32")
+    mask[1, 3:] = 0.0
+    feed = {
+        "source_sequence": rng.randint(1, 40, (bs, ts)).astype("int64"),
+        "source_length": np.asarray([[ts], [ts - 2]], "int64"),
+        "target_sequence": rng.randint(1, 30, (bs, tt)).astype("int64"),
+        "label": rng.randint(1, 30, (bs, tt)).astype("int64"),
+        "label_mask": mask,
+    }
+    return (["source_sequence", "source_length", "target_sequence",
+             "label", "label_mask"], avg_cost, feed)
+
+
+GOLDEN_MODELS = {
+    "mnist": _mnist,
+    "resnet_cifar10": _resnet_cifar10,
+    "vgg16": _vgg,
+    "googlenet": _googlenet,
+    "se_resnext50": _se_resnext,
+    "alexnet": _alexnet,
+    "stacked_lstm": _stacked_lstm,
+    "transformer": _transformer,
+    "machine_translation": _machine_translation,
+}
+
+
+def build_golden(name):
+    """Build ``name``'s serving slice with deterministic params in the
+    CURRENT scope (callers wrap in their own
+    ``fluid.scope_guard(Scope())`` to avoid leaking params process-wide).
+    Returns (pruned_program, feed_names, fetch_var, feed, exe)."""
+    from paddle_tpu.io import prune_program
+    from paddle_tpu.testing import set_deterministic_params
+    from paddle_tpu import unique_name
+
+    # param seeds derive from variable NAMES: reset the unique-name
+    # counters so the names (hence the seeds) are identical no matter
+    # what was built earlier in the process
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        feed_names, fetch, feed = GOLDEN_MODELS[name]()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    set_deterministic_params(main, fluid.global_scope())
+    pruned = prune_program(main.clone(for_test=True), feed_names,
+                           [fetch.name])
+    return pruned, feed_names, fetch, feed, exe

@@ -15,7 +15,13 @@ import print_signatures  # noqa: E402
 def test_api_spec_matches_committed_golden():
     live = list(print_signatures.iter_spec())
     with open(os.path.join(REPO, "API.spec")) as f:
-        committed = f.read().splitlines()
+        committed = [
+            line for line in f.read().splitlines()
+            # '#' lines annotate DELIBERATE absences vs the reference
+            # surface (async-pserver methods etc.); they are docs, not
+            # signatures
+            if line.strip() and not line.lstrip().startswith("#")
+        ]
     live_set, committed_set = set(live), set(committed)
     removed = committed_set - live_set
     added = live_set - committed_set

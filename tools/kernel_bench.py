@@ -86,7 +86,10 @@ def _bench_rnn(fluid, op_name, flag, shapes, steps, warmup):
     return rows
 
 
-def _bench_flash(fluid, shapes, steps, warmup):
+def _bench_flash(fluid, shapes, steps, warmup, window=0):
+    """window > 0 also times the sliding-window pruned kernel vs the
+    windowed reference at the same shape — the O(window) wall-time
+    proof interpret mode cannot provide (tools/longctx_bench.py)."""
     import numpy as np
 
     rows = []
@@ -110,7 +113,7 @@ def _bench_flash(fluid, shapes, steps, warmup):
                 for var in (q, kk, v):
                     var.stop_gradient = False
                 out = fluid.layers.scaled_dot_product_attention(
-                    q, kk, v, causal=True, impl=impl)
+                    q, kk, v, causal=True, impl=impl, window=window)
                 loss = fluid.layers.reduce_mean(out)
                 # fwd+bwd: flash attention's win is the backward pass
                 fluid.optimizer.SGD(learning_rate=0.0).minimize(
@@ -124,7 +127,9 @@ def _bench_flash(fluid, shapes, steps, warmup):
                                     fetch_list=[loss])[0],
                     steps, warmup)
             times[impl] = dt
-        row = {"kernel": "flash_attention", "shape": [b, h, t, d],
+        row = {"kernel": "flash_attention"
+               + ("_w%d" % window if window else ""),
+               "shape": [b, h, t, d],
                "xla_ms": round(times["reference"] * 1e3, 3),
                "pallas_ms": round(times["pallas"] * 1e3, 3),
                "speedup": round(times["reference"] / times["pallas"], 3)}
@@ -246,6 +251,14 @@ def main():
                    steps, warmup)
     else:
         _bench_flash(fluid, fa_shapes, steps, warmup)
+        # sliding-window leg: same longest shape, window = seq/8 — the
+        # pruned-kernel wall-time proof (longctx_bench.py tile counts
+        # predict ~seq/(2*window)x on the flash side). Scaled with the
+        # shape so the --quick smoke (seq 128) still exercises a window
+        # that actually prunes.
+        t_last = fa_shapes[-1][2]
+        _bench_flash(fluid, fa_shapes[-1:], steps, warmup,
+                     window=max(t_last // 8, 16))
 
 
 def _print_verdicts(all_rows):

@@ -1,0 +1,177 @@
+"""Long-context scaling evidence (VERDICT r3 Next #5).
+
+Three hardware-independent measurements, each pinned to a claim from
+docs/LONG_CONTEXT.md, emitted as JSON lines for docs/artifacts/:
+
+1. ``reference-memory``: XLA memory analysis of reference (einsum)
+   attention fwd+bwd across sequence lengths — the materialized
+   [B,H,T,S] score temp grows O(T^2); this is the wall the flash path
+   removes (the r3 transformer-bs128 OOM dump is its chip-side twin).
+2. ``window-pruning``: wall time of the Pallas flash kernel (interpret
+   mode on CPU — the same grid pruning the TPU runs) at fixed T with
+   the sliding window on/off: visited k-tiles drop from T/block to
+   ~window/block, so time scales O(window), not O(T).
+3. ``ring-memory``: per-device temp memory of ring attention on an
+   8-device virtual mesh at global seq 8*Tl vs single-device reference
+   attention at the same global length — the ring never materializes
+   the global score matrix (O(Tl * block) per device), which is the
+   whole point of sequence parallelism.
+
+On-chip wall-time legs (transformer-seq1024/-seq4096 + the
+reference-attention control) are captured by tools/hw_window.sh.
+
+Usage: env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \\
+         XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+         python tools/longctx_bench.py
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+B, H, D = 1, 4, 64
+
+
+def _temp_bytes(compiled):
+    """Best-effort temp allocation size from a compiled executable."""
+    try:
+        ma = compiled.memory_analysis()
+        return int(getattr(ma, "temp_size_in_bytes", 0))
+    except Exception:
+        return None
+
+
+def reference_memory_sweep(fa, jax, jnp):
+    for seq in (256, 1024, 4096):
+        q = jnp.zeros((B, H, seq, D), jnp.float32)
+
+        def loss(q, k, v):
+            return fa.flash_attention_reference(q, k, v, causal=True).sum()
+
+        compiled = (
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            .lower(q, q, q).compile()
+        )
+        tb = _temp_bytes(compiled)
+        score_bytes = 4 * B * H * seq * seq  # one f32 [B,H,T,T] temp
+        print(json.dumps({
+            "bench": "reference-memory", "seq": seq,
+            "temp_bytes": tb,
+            "score_matrix_bytes": score_bytes,
+            "claim": "reference fwd+bwd temps grow O(T^2)",
+        }))
+
+
+def _tiles_visited(seq, block_q, block_k, window, causal=True):
+    """Count (qi, kj) tiles the kernel's ``run`` predicate computes —
+    the EXACT skip rule from kernels/flash_attention.py:_flash_kernel,
+    so this is the kernel's own per-query FLOP bound, not a model."""
+    n_q, n_k = seq // block_q, seq // block_k
+    visited = 0
+    for qi in range(n_q):
+        q_base = qi * block_q
+        for kj in range(n_k):
+            k_base = kj * block_k
+            run = True
+            if causal:
+                run = k_base <= q_base + block_q - 1
+            if window:
+                run = run and (k_base + block_k - 1 > q_base - window)
+                if not causal:
+                    run = run and (
+                        k_base - (q_base + block_q - 1) < window)
+            visited += run
+    return visited, n_q * n_k
+
+
+def window_pruning_sweep(fa, jax, jnp):
+    """Tile-visit counts under the kernel's own skip predicate, plus the
+    interpret-mode parity check. Interpret-mode WALL TIME is useless
+    here (measured: flat across windows — each of the 1024 grid steps
+    costs ~2.5 ms of interpreter machinery, drowning the skipped
+    compute), so the on-chip number comes from kernel_bench's windowed
+    flash rows in the hardware window instead."""
+    rng = np.random.RandomState(0)
+    seq, bq, bk = 4096, 128, 128
+    for window in (0, 512, 256):
+        visited, total = _tiles_visited(seq, bq, bk, window)
+        print(json.dumps({
+            "bench": "window-tiles", "seq": seq, "window": window,
+            "block": bq, "tiles_visited": visited, "tiles_total": total,
+            "fraction": round(visited / total, 4),
+            "claim": "computed k-tiles per query ~ window/block + 1, "
+                     "so chip time is O(window) not O(T); wall-time "
+                     "leg = kernel_bench flash windowed rows (chip)",
+        }))
+    # correctness spot-check at a small shape: windowed Pallas output
+    # equals the masked reference (the pruning must drop only dead tiles)
+    q = jnp.asarray(rng.randn(B, H, 256, D), jnp.float32)
+    got = fa.flash_attention(q, q, q, causal=True, window=64,
+                             force_pallas=True)
+    want = fa.flash_attention(q, q, q, causal=True, window=64,
+                              force_reference=True)
+    err = float(jnp.max(jnp.abs(got - want)))
+    print(json.dumps({"bench": "window-parity", "seq": 256, "window": 64,
+                      "max_abs_err": err}))
+    assert err < 2e-3, err
+
+
+def ring_memory(fa, jax, jnp):
+    from paddle_tpu.parallel.mesh import build_mesh
+    from paddle_tpu.parallel.ring_attention import ring_attention
+
+    n = min(8, len(jax.devices()))
+    if n < 2:
+        print(json.dumps({"bench": "ring-memory",
+                          "skipped": "needs >= 2 devices"}))
+        return
+    tl = 512
+    tg = n * tl
+    mesh = build_mesh(num_devices=n, data=n)
+    q = jnp.zeros((B, H, tg, D), jnp.float32)
+
+    ring = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh, axis_name="data", causal=True,
+        impl="reference").sum())
+    ring_tb = _temp_bytes(ring.lower(q, q, q).compile())
+
+    full = jax.jit(lambda q, k, v: fa.flash_attention_reference(
+        q, k, v, causal=True).sum())
+    full_tb = _temp_bytes(full.lower(q, q, q).compile())
+    print(json.dumps({
+        "bench": "ring-memory", "devices": n, "seq_global": tg,
+        "seq_per_device": tl,
+        "ring_temp_bytes_total": ring_tb,
+        "single_device_temp_bytes": full_tb,
+        "ring_per_device": (ring_tb // n) if ring_tb else None,
+        "claim": "ring shards the score work: per-device temps carry "
+                 "[Tl, Tl] blocks, never the [Tg, Tg] matrix",
+    }))
+
+
+def main():
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    fa = importlib.import_module("paddle_tpu.kernels.flash_attention")
+
+    print(json.dumps({
+        "host": "cpu-virtual" if jax.devices()[0].platform == "cpu"
+        else str(jax.devices()[0].device_kind),
+        "devices": len(jax.devices()),
+    }))
+    reference_memory_sweep(fa, jax, jnp)
+    window_pruning_sweep(fa, jax, jnp)
+    ring_memory(fa, jax, jnp)
+
+
+if __name__ == "__main__":
+    main()
