@@ -178,6 +178,9 @@ class Interpreter {
     if (op.type == "log_softmax") return RunLogSoftmax(op, scope);
     if (op.type == "add_position_encoding") return RunPosEncoding(op, scope);
     if (op.type == "cast") return RunCast(op, scope);
+    if (op.type == "dequantize_weight") {
+      return RunDequantizeWeight(op, scope);
+    }
     if (op.type == "cross_entropy") return RunCrossEntropy(op, scope);
     if (op.type == "top_k") return RunTopK(op, scope);
     if (op.type == "accuracy") return RunAccuracy(op, scope);
@@ -1498,6 +1501,35 @@ class Interpreter {
       }
     } else {
       return "unsupported target dtype " + out_dtype;
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunDequantizeWeight(const OpDesc& op, Scope* scope) {
+    // int8-storage weight rehydration (convert_to_int8 deployment):
+    // Out = int8 * step, step = scale / max_range
+    const std::string* xn = OneName(op, "X");
+    const std::string* sn = OneName(op, "Scale");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || sn == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* sc = scope->Find(*sn);
+    if (x == nullptr || sc == nullptr) return "input not in scope";
+    if (x->dtype != "int8") return "weight not int8";
+    if (!IsF32(*sc) || NumElements(sc->dims) < 1) return "bad scale";
+    int64_t total = NumElements(x->dims);
+    if (static_cast<int64_t>(x->data.size()) < total) {
+      return "int8 payload shorter than shape";  // truncated/bad .npy
+    }
+    float step = F32(*sc)[0];
+    HostTensor out = MakeF32(x->dims);
+    const int8_t* xa = reinterpret_cast<const int8_t*>(x->data.data());
+    float* oa = MutF32(&out);
+    for (int64_t i = 0; i < total; ++i) {
+      oa[i] = static_cast<float>(xa[i]) * step;
     }
     scope->Set(*on, std::move(out));
     return "";

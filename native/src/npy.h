@@ -24,6 +24,7 @@ inline std::string DescrToDtype(const std::string& descr) {
   if (descr == "<i4") return "int32";
   if (descr == "<i8") return "int64";
   if (descr == "|u1") return "uint8";
+  if (descr == "|i1") return "int8";
   if (descr == "|b1") return "bool";
   return "";
 }
@@ -34,6 +35,7 @@ inline std::string DtypeToDescr(const std::string& dtype) {
   if (dtype == "int32") return "<i4";
   if (dtype == "int64") return "<i8";
   if (dtype == "uint8") return "|u1";
+  if (dtype == "int8") return "|i1";
   if (dtype == "bool") return "|b1";
   return "";
 }
@@ -41,7 +43,7 @@ inline std::string DtypeToDescr(const std::string& dtype) {
 inline int64_t DtypeSize(const std::string& dtype) {
   if (dtype == "float32" || dtype == "int32") return 4;
   if (dtype == "float64" || dtype == "int64") return 8;
-  if (dtype == "uint8" || dtype == "bool") return 1;
+  if (dtype == "uint8" || dtype == "int8" || dtype == "bool") return 1;
   return 0;
 }
 

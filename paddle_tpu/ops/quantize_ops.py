@@ -98,3 +98,25 @@ register_op(
     lower=_lower_fake_dequantize_max_abs,
     no_grad_inputs=("Scale",),
 )
+
+
+def _lower_dequantize_weight(ctx, ins, attrs):
+    """int8-storage weight dequantization: Out = X_int8 * step, where
+    ``step`` (= scale / max_range) was computed by convert_to_int8. The
+    deployment counterpart of the reference's convert_to_int8
+    (contrib/quantize/quantize_transpiler.py:348): the model dir stores
+    int8 tensors; the serving graph rehydrates floats on load, XLA folds
+    the multiply into the weight constant after the first step."""
+    x = ins["X"][0]
+    step = jnp.reshape(ins["Scale"][0], ())
+    return x.astype(step.dtype) * step
+
+
+register_op(
+    "dequantize_weight",
+    inputs=["X", "Scale"],
+    outputs=["Out"],
+    attrs={},
+    lower=_lower_dequantize_weight,
+    no_grad_inputs=("X", "Scale"),
+)

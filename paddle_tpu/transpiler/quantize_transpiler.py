@@ -219,3 +219,70 @@ class QuantizeTranspiler(object):
             block.vars.pop(name, None)
         program._bump_version()
         return scales
+
+    def convert_to_int8(self, program, scope=None, scales=None):
+        """Rewrite a FROZEN inference program so its quantized weights are
+        STORED int8 (reference: contrib/quantize/quantize_transpiler.py:348
+        convert_to_int8): each weight ``w`` becomes an int8 persistable
+        ``w.int8`` plus a per-tensor step ``w.int8_scale`` (= s/Q), and a
+        ``dequantize_weight`` op rehydrates the float at the top of the
+        block — ``save_inference_model`` then persists int8 tensors (4x
+        smaller checkpoints + host->device transfers), and both serving
+        engines (XLA and the C++ interpreter) dequantize on load. The
+        dequantized floats are EXACTLY the grid values freeze_program
+        snapped to, so outputs match the frozen model bit-for-float.
+
+        ``scales``: the dict freeze_program returned; recomputed from the
+        (already snapped) weights when omitted. Returns the list of
+        converted weight names."""
+        from paddle_tpu.executor import global_scope
+
+        scope = scope or global_scope()
+        block = program.global_block()
+        q = float((1 << (self.weight_bits - 1)) - 1)
+        if scales is None:
+            # snapped weights: abs-max IS the original scale s
+            scales = {}
+            params = {p.name for p in block.all_parameters()}
+            for op in block.ops:
+                if op.type not in _QUANTIZABLE_OP_TYPES:
+                    continue
+                for name in op.input_arg_names():
+                    if name in params and name not in scales:
+                        val = scope.get_value(name)
+                        if val is not None:
+                            scales[name] = float(
+                                np.max(np.abs(np.asarray(val)))) or 1e-8
+        converted = []
+        for name in sorted(scales):
+            var = block.vars.get(name)
+            val = scope.get_value(name)
+            if var is None or val is None:
+                continue
+            s = scales[name]
+            w = np.asarray(val, np.float32)
+            i8 = np.clip(np.round(w / s * q), -q - 1, q).astype(np.int8)
+            int8_name = name + ".int8"
+            step_name = name + ".int8_scale"
+            block.create_var(name=int8_name, shape=var.shape,
+                             dtype="int8", persistable=True)
+            block.create_var(name=step_name, shape=[1], dtype="float32",
+                             persistable=True)
+            # the float weight is now PRODUCED (by dequantize_weight),
+            # not persisted: save_inference_model writes only the int8
+            # twin + step
+            var.persistable = False
+            block.insert_op(
+                0,
+                type="dequantize_weight",
+                inputs={"X": [int8_name], "Scale": [step_name]},
+                outputs={"Out": [name]},
+                attrs={},
+            )
+            scope.set_value(int8_name, i8)
+            scope.set_value(step_name,
+                            np.asarray([s / q], np.float32))
+            scope.erase([name])
+            converted.append(name)
+        program._bump_version()
+        return converted

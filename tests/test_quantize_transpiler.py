@@ -157,3 +157,101 @@ def test_freeze_rejects_training_program():
         fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
     with pytest.raises(ValueError, match="backward/optimizer"):
         qt.freeze_program(main)
+
+
+def test_convert_to_int8_roundtrip(tmp_path):
+    """QAT -> freeze -> convert_to_int8 -> save -> serve: the saved model
+    stores int8 weights (4x smaller), the dequantize_weight op rehydrates
+    the exact grid values freeze snapped to (XLA parity ~float-exact),
+    and the C++ interpreter serves the int8 model too (VERDICT r3
+    Next #7)."""
+    from paddle_tpu import native
+    from paddle_tpu.inference import NativeConfig, create_paddle_predictor
+    from paddle_tpu.io import prune_program
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        img, label, logits, loss = _build_convnet()
+        qt = QuantizeTranspiler()
+        qt.training_transpile(main, startup)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(2)
+    for _ in range(15):
+        x, y = _batch(rng)
+        exe.run(main, feed={"img": x, "label": y}, fetch_list=[loss])
+
+    test_prog = prune_program(test_prog, ["img"], [logits.name])
+    scales = qt.freeze_program(test_prog)
+    assert scales
+    x, y = _batch(rng, bs=4)
+    (frozen_out,) = exe.run(test_prog, feed={"img": x},
+                            fetch_list=[logits])
+
+    converted = qt.convert_to_int8(test_prog, scales=scales)
+    assert sorted(converted) == sorted(scales)
+    gb = test_prog.global_block()
+    assert gb.ops[0].type == "dequantize_weight"
+    for name in converted:
+        assert str(gb.vars[name + ".int8"].dtype) == "int8"
+        assert not gb.vars[name].persistable
+    # int8 dequantization reproduces the snapped grid values exactly
+    (int8_out,) = exe.run(test_prog, feed={"img": x},
+                          fetch_list=[logits])
+    np.testing.assert_allclose(np.asarray(int8_out),
+                               np.asarray(frozen_out),
+                               rtol=1e-5, atol=1e-6)
+
+    # deployment: the saved dir stores int8 tensors
+    path = str(tmp_path / "int8_model")
+    fluid.io.save_inference_model(path, ["img"], [logits], exe,
+                                  main_program=test_prog)
+    import os
+
+    saved = {}
+    for fn in os.listdir(path):
+        if fn.endswith(".npy"):
+            saved[fn] = np.load(os.path.join(path, fn))
+    int8_files = [fn for fn, a in saved.items() if a.dtype == np.int8]
+    assert len(int8_files) == len(converted)
+    for name in converted:
+        assert not any(fn.startswith(name + ".npy") for fn in saved), \
+            "float weight %s must not be persisted" % name
+
+    # serve the int8 model through BOTH engines
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        prog2, feeds2, fetches2 = fluid.io.load_inference_model(path, exe2)
+        (loaded_out,) = exe2.run(prog2, feed={"img": x},
+                                 fetch_list=fetches2)
+    np.testing.assert_allclose(np.asarray(loaded_out),
+                               np.asarray(frozen_out),
+                               rtol=1e-5, atol=1e-6)
+    if native.available():
+        predictor = create_paddle_predictor(
+            NativeConfig(model_dir=path, use_tpu=False))
+        got_cpp = predictor.run_native_reference({"img": x})
+        np.testing.assert_allclose(np.asarray(got_cpp),
+                                   np.asarray(frozen_out),
+                                   rtol=1e-4, atol=1e-5)
+    # the STANDALONE C++ binary exercises npy::Load on the int8 files
+    # (the ctypes path above feeds params through the Python scope)
+    from tests.conftest import build_native_binary
+
+    binary = build_native_binary("ptpu_demo_predictor")
+    if binary is not None:
+        import subprocess
+
+        inp = str(tmp_path / "input.npy")
+        outp = str(tmp_path / "output.npy")
+        np.save(inp, x)
+        res = subprocess.run([binary, path, inp, outp],
+                             capture_output=True, text=True, timeout=120)
+        assert res.returncode == 0, res.stderr
+        np.testing.assert_allclose(np.load(outp),
+                                   np.asarray(frozen_out),
+                                   rtol=1e-4, atol=1e-5)
