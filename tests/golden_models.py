@@ -151,3 +151,38 @@ def build_golden(name):
     pruned = prune_program(main.clone(for_test=True), feed_names,
                            [fetch.name])
     return pruned, feed_names, fetch, feed, exe
+
+
+def _ssd():
+    from paddle_tpu.models import ssd
+
+    _, feeds, outs = ssd.build(img_shape=(3, 96, 96), class_num=4)
+    rng = np.random.RandomState(40)
+    feed = {"image": rng.rand(1, 3, 96, 96).astype("float32")}
+    # pin the DENSE location head, not nmsed_out: NMS is a thresholded
+    # top-k selection, so a near-tie flip would reorder rows wholesale
+    # and break allclose without any real numerics regression
+    return ["image"], outs["mbox_locs"], feed
+
+
+def _switch_transformer():
+    from paddle_tpu.models import switch_transformer
+
+    bs, seq = 2, 12
+    _, feeds, outs = switch_transformer.build(
+        vocab_size=80, max_length=seq, n_layer=2, n_head=2, d_model=32,
+        d_inner=64, num_experts=2, moe_every=2, dropout=0.0)
+    rng = np.random.RandomState(41)
+    feed = {
+        "word": rng.randint(1, 80, (bs, seq)).astype("int64"),
+        "seq_len": np.asarray([[seq], [seq - 4]], "int64"),
+    }
+    return ["word", "seq_len"], outs["logits"], feed
+
+
+GOLDEN_MODELS["ssd"] = _ssd
+GOLDEN_MODELS["switch_transformer"] = _switch_transformer
+
+# models whose serving op set is beyond the C++ interpreter (dense
+# detection ops / MoE dispatch): the golden pins the XLA engine only
+XLA_ONLY = {"ssd", "switch_transformer"}
