@@ -24,6 +24,8 @@ __all__ = [
     "load_persistables",
     "save_inference_model",
     "load_inference_model",
+    "save_compiled_inference_model",
+    "load_compiled_inference_model",
     "get_inference_program",
     "get_parameter_value",
     "get_parameter_value_by_name",
@@ -223,6 +225,168 @@ def load_inference_model(dirname, executor, model_filename=None,
         for n in meta["fetch_names"]
     ]
     return program, meta["feed_names"], fetch_vars
+
+
+def save_compiled_inference_model(
+    dirname,
+    feeded_var_names,
+    target_vars,
+    executor,
+    feed_shapes,
+    main_program=None,
+    scope=None,
+    platforms=None,
+):
+    """AOT-compile the inference slice and serialize the EXECUTABLE
+    (jax.export), the TPU-native analog of the reference's optimized
+    inference-program deployment (inference/api/api_impl.cc load path):
+    the artifact is a self-contained StableHLO program with the trained
+    parameters baked in as constants — the serving host needs no model
+    source, no parameter files, and pays no trace/lower cost at load.
+
+    feed_shapes: {feed name: (shape tuple, dtype str)} — exported
+    executables are shape-specialized, like any XLA executable.
+    platforms: lowering platforms (default: the current backend); pass
+    ("cpu", "tpu") to emit one artifact servable on both.
+
+    Writes ``__compiled__.bin`` (serialized export) + ``__compiled__.json``
+    (feed order/shapes + fetch names). Returns the fetch names.
+    """
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.lowering import BlockLowerer, build_step_fn
+
+    main_program = main_program or framework.default_main_program()
+    scope = _scope_of(executor, scope)
+    target_names = [
+        v.name if isinstance(v, Variable) else str(v) for v in target_vars
+    ]
+    program = prune_program(
+        main_program.clone(for_test=True), feeded_var_names, target_names
+    )
+    feed_names = list(feeded_var_names)
+    missing = [n for n in feed_names if n not in feed_shapes]
+    if missing:
+        raise ValueError(
+            "save_compiled_inference_model: feed_shapes missing %s"
+            % missing)
+
+    from paddle_tpu.executor import Executor
+
+    lowerer = BlockLowerer(program, 0, is_test=True)
+    scope_names = Executor._scope_names(scope)
+    state_in, _ = lowerer.analyze(scope_names, set(feed_names))
+    params = {}
+    for n in state_in:
+        val = scope.get_value(n)
+        if val is None:
+            raise RuntimeError(
+                "save_compiled_inference_model: state var %r not in "
+                "scope (run the startup program / load params first)" % n)
+        params[n] = jnp.asarray(val)  # device values pass through
+
+    # the ambient platform drives platform-keyed kernel selection
+    # (flash attention / RNN Pallas vs XLA reference): it must follow
+    # the EXPORT target, not the build host's default backend — else a
+    # CPU build host would bake the reference path into a TPU artifact
+    if platforms is not None and len(platforms) > 1:
+        raise ValueError(
+            "save_compiled_inference_model: kernel lowering is "
+            "platform-keyed; export one artifact per platform instead "
+            "of %r" % (platforms,))
+    target_platform = (list(platforms)[0] if platforms
+                       else jax.default_backend())
+    step = build_step_fn(program, feed_names, target_names, state_in,
+                         [], is_test=True, platform=target_platform)
+
+    def serve(*feed_vals):
+        feeds = dict(zip(feed_names, feed_vals))
+        # inference: deterministic key (dropout is off under is_test;
+        # any sampling op in the slice becomes deterministic, which is
+        # the right serving default)
+        _, fetches = step(dict(params), feeds, jax.random.PRNGKey(0))
+        return tuple(fetches)
+
+    specs = [
+        jax.ShapeDtypeStruct(tuple(feed_shapes[n][0]),
+                             np.dtype(feed_shapes[n][1]))
+        for n in feed_names
+    ]
+    kwargs = {}
+    if platforms is not None:
+        kwargs["platforms"] = list(platforms)
+    exported = jax.export.export(jax.jit(serve), **kwargs)(*specs)
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__compiled__.bin"), "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(dirname, "__compiled__.json"), "w") as f:
+        json.dump(
+            {
+                "feed_names": feed_names,
+                "feed_shapes": {
+                    n: [list(feed_shapes[n][0]), str(feed_shapes[n][1])]
+                    for n in feed_names
+                },
+                "fetch_names": target_names,
+                "platforms": list(exported.platforms),
+            },
+            f,
+        )
+    return target_names
+
+
+class CompiledInferenceModel(object):
+    """A deserialized AOT executable (save_compiled_inference_model).
+    ``run(feed_dict)`` returns the fetch list; no program IR, parameter
+    files, or tracing are involved — the artifact IS the model."""
+
+    def __init__(self, dirname):
+        import json
+
+        import jax
+
+        with open(os.path.join(dirname, "__compiled__.bin"), "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        with open(os.path.join(dirname, "__compiled__.json")) as f:
+            meta = json.load(f)
+        self.feed_names = meta["feed_names"]
+        self.feed_shapes = meta["feed_shapes"]
+        self.fetch_names = meta["fetch_names"]
+        self.platforms = meta.get("platforms", [])
+
+    def run(self, feed):
+        vals = []
+        for n in self.feed_names:
+            if n not in feed:
+                raise KeyError("missing feed %r (wants %s)"
+                               % (n, self.feed_names))
+            want_shape, want_dtype = self.feed_shapes[n]
+            arr = np.asarray(feed[n])
+            if list(arr.shape) != list(want_shape):
+                raise ValueError(
+                    "feed %r shape %s != exported shape %s (AOT "
+                    "executables are shape-specialized)"
+                    % (n, list(arr.shape), want_shape))
+            # same cast policy as the Executor feed path: numeric
+            # sources cast to the declared dtype, anything else errors
+            if arr.dtype != np.dtype(want_dtype):
+                if np.issubdtype(arr.dtype, np.floating) or                         np.issubdtype(arr.dtype, np.integer):
+                    arr = arr.astype(np.dtype(want_dtype))
+                else:
+                    raise TypeError(
+                        "feed %r dtype %s incompatible with exported "
+                        "%s" % (n, arr.dtype, want_dtype))
+            vals.append(arr)
+        outs = self._exported.call(*vals)
+        return [np.asarray(o) for o in outs]
+
+
+def load_compiled_inference_model(dirname):
+    return CompiledInferenceModel(dirname)
 
 
 def get_inference_program(target_vars, main_program=None):
