@@ -118,3 +118,25 @@ def test_flash_uneven_tail_lowers_for_tpu():
         return fa.flash_attention(q, k, v, force_pallas=True).sum()
 
     _export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def test_ring_flash_lowers_for_tpu():
+    """Ring attention's shard_map + per-block Pallas engine lowers for
+    the TPU platform on the 8-device mesh — guards the Mosaic x
+    shard_map composition (sequence parallelism's hot path) without
+    hardware."""
+    import pytest
+
+    from paddle_tpu.parallel.mesh import build_mesh
+    from paddle_tpu.parallel.ring_attention import ring_attention
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = build_mesh(num_devices=8, data=8)
+    q = jnp.zeros((1, 2, 8 * 128, 64), jnp.float32)
+
+    def loss(q, k, v):
+        return ring_attention(q, k, v, mesh, axis_name="data",
+                              causal=True, impl="flash").sum()
+
+    _export_tpu(loss, q, q, q)
