@@ -1551,18 +1551,9 @@ class Interpreter {
     if (!IsF32(*x) || x->dims.size() != 2) return "bad probs";
     int64_t n = x->dims[0], c = x->dims[1];
     if (NumElements(label->dims) != n) return "label count mismatch";
-    std::vector<int64_t> lbl(n);
-    if (label->dtype == "int64") {
-      const int64_t* p =
-          reinterpret_cast<const int64_t*>(label->data.data());
-      std::copy(p, p + n, lbl.begin());
-    } else if (label->dtype == "int32") {
-      const int32_t* p =
-          reinterpret_cast<const int32_t*>(label->data.data());
-      std::copy(p, p + n, lbl.begin());
-    } else {
-      return "non-integer label";
-    }
+    std::vector<int64_t> lbl;
+    std::string lerr = ReadIds(*label, &lbl);
+    if (!lerr.empty()) return lerr;
     HostTensor out = MakeF32({n, 1});
     const float* xa = F32(*x);
     float* oa = MutF32(&out);
@@ -1632,18 +1623,9 @@ class Interpreter {
     }
     int64_t n = indices->dims[0], k = indices->dims[1];
     if (NumElements(label->dims) != n) return "label count mismatch";
-    std::vector<int64_t> lbl(n);
-    if (label->dtype == "int64") {
-      const int64_t* p =
-          reinterpret_cast<const int64_t*>(label->data.data());
-      std::copy(p, p + n, lbl.begin());
-    } else if (label->dtype == "int32") {
-      const int32_t* p =
-          reinterpret_cast<const int32_t*>(label->data.data());
-      std::copy(p, p + n, lbl.begin());
-    } else {
-      return "non-integer label";
-    }
+    std::vector<int64_t> lbl;
+    std::string lerr = ReadIds(*label, &lbl);
+    if (!lerr.empty()) return lerr;
     const int64_t* ia =
         reinterpret_cast<const int64_t*>(indices->data.data());
     int64_t correct = 0;
@@ -1748,17 +1730,8 @@ class Interpreter {
       if (el == nullptr || NumElements(el->dims) != B) {
         return "bad EncoderLen";
       }
-      if (el->dtype == "int64") {
-        const int64_t* p =
-            reinterpret_cast<const int64_t*>(el->data.data());
-        std::copy(p, p + B, enc_lens.begin());
-      } else if (el->dtype == "int32") {
-        const int32_t* p =
-            reinterpret_cast<const int32_t*>(el->data.data());
-        std::copy(p, p + B, enc_lens.begin());
-      } else {
-        return "non-integer EncoderLen";
-      }
+      std::string lerr = ReadIds(*el, &enc_lens);
+      if (!lerr.empty()) return lerr;
       for (int64_t i = 0; i < B; ++i) {
         enc_lens[i] = std::min<int64_t>(std::max<int64_t>(enc_lens[i], 0),
                                         S);
