@@ -1,0 +1,74 @@
+"""Golden regression for the GENERATION stack: greedy decode over
+deterministic weights must keep producing the committed token ids.
+
+The per-step numerics goldens (tests/test_golden_cpp.py) pin the logits;
+this pins everything above them — build_inference pruning, the
+fixed-shape re-decode loop, argmax/eos handling — i.e. the deploy path a
+reference user of the generation mode depends on. KV-cached and beam
+decoding already have exact-parity tests against this path
+(tests/test_attention.py), so one committed pin transitively anchors
+all three decoders.
+
+Regenerate deliberately: python tests/test_golden_generation.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as fluid  # noqa: E402
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden", "transformer_greedy.npz")
+
+
+def _generate():
+    from paddle_tpu import unique_name
+    from paddle_tpu.models import transformer
+    from paddle_tpu.testing import set_deterministic_params
+
+    unique_name.switch()
+    bs, seq, vocab = 2, 10, 50
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        _, feeds, outs = transformer.build(
+            src_vocab_size=vocab, trg_vocab_size=vocab, max_length=seq,
+            n_layer=1, n_head=2, d_model=32, d_inner=64, dropout=0.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    set_deterministic_params(main, fluid.global_scope())
+    infer = transformer.build_inference(main, outs["logits"])
+    rng = np.random.RandomState(42)
+    src = rng.randint(3, vocab, (bs, seq)).astype("int64")
+    src_len = np.asarray([[seq], [seq - 4]], "int64")
+    # eos_id=0 (the pad id, which argmax over random-ish logits never
+    # emits) so the decode runs the FULL length and the pin covers every
+    # step of the loop rather than an instant all-eos stop
+    tokens = transformer.greedy_generate(
+        exe, infer, outs["logits"], src, src_len, max_length=seq,
+        eos_id=0)
+    return src, src_len, np.asarray(tokens)
+
+
+def test_greedy_generation_matches_committed_golden():
+    src, src_len, tokens = _generate()
+    assert os.path.exists(GOLDEN), (
+        "missing committed golden %s — run this file as a script and "
+        "commit the output" % GOLDEN)
+    golden = np.load(GOLDEN)
+    np.testing.assert_array_equal(src, golden["src"])
+    np.testing.assert_array_equal(src_len, golden["src_len"])
+    np.testing.assert_array_equal(
+        tokens, golden["tokens"],
+        err_msg="greedy decode drifted from the committed token ids")
+
+
+if __name__ == "__main__":
+    with fluid.scope_guard(fluid.executor.Scope()):
+        src, src_len, tokens = _generate()
+    np.savez_compressed(GOLDEN, src=src, src_len=src_len, tokens=tokens)
+    print("wrote", GOLDEN, "tokens:\n", tokens)
