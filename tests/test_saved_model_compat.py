@@ -1,0 +1,57 @@
+"""Serialized-format compatibility pin: a COMMITTED saved-model dir
+(tests/golden/mnist_saved_model/: PTPB `__model__`, `.npy` params,
+`io_pin.npz` inputs + expected outputs) must keep loading and serving on
+every engine — the format-level half of the golden regressions
+(test_golden_cpp.py pins numerics over rebuilt programs; this pins the
+BYTES ON DISK: a PTPB schema change, a var-file naming change, or a
+loader regression breaks here first, before any user's saved model does).
+
+Reference analog: paddle/fluid/inference/tests/api/ keeps serving
+models serialized by older producers.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import native
+
+MODEL_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "golden", "mnist_saved_model")
+
+
+def _pin():
+    pin = np.load(os.path.join(MODEL_DIR, "io_pin.npz"))
+    feed = {k[len("feed_"):]: pin[k] for k in pin.files
+            if k.startswith("feed_")}
+    return feed, pin["expected"]
+
+
+def test_committed_saved_model_serves_via_executor():
+    feed, expected = _pin()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        program, feed_names, fetch_vars = fluid.io.load_inference_model(
+            MODEL_DIR, exe)
+        assert sorted(feed_names) == sorted(feed)
+        (got,) = exe.run(program, feed=feed, fetch_list=fetch_vars)
+    np.testing.assert_allclose(np.asarray(got), expected,
+                               rtol=2e-4, atol=2e-5,
+                               err_msg="the committed saved model no "
+                                       "longer reproduces its pin")
+
+
+def test_committed_saved_model_serves_via_cpp():
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    from paddle_tpu.inference import NativeConfig, create_paddle_predictor
+
+    feed, expected = _pin()
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=MODEL_DIR, use_tpu=False))
+    got = predictor.run_native_reference(feed)
+    np.testing.assert_allclose(np.asarray(got), expected,
+                               rtol=1e-3, atol=1e-4)
