@@ -34,6 +34,19 @@ _NEG_INF = -1e30
 _MASKED_ROW_LSE = -1e29
 
 
+def _mosaic_params(interpret, dimension_semantics):
+    """compiler_params kwargs for a pallas_call: declare which grid dims
+    are order-independent ("parallel") vs reductions ("arbitrary") so
+    Mosaic can pipeline independent tiles. Omitted in interpret mode
+    (the CPU interpreter has no Mosaic compiler to parameterize)."""
+    if interpret:
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    return {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=dimension_semantics)}
+
+
 def _is_tpu_target():
     """Pinned-Place-aware backend test (core/lowering.is_tpu_target);
     falls back to default_backend for standalone (non-executor) use."""
@@ -260,6 +273,10 @@ def _flash_forward(q, k, v, kv_mask, causal, sm_scale, block_q, block_k,
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
+        # (b, h, qi) tiles are independent — only the kj reduction is
+        # order-dependent. Declaring that lets Mosaic pipeline/reorder
+        # the independent tiles instead of running the grid serially.
+        **_mosaic_params(interpret, ("parallel",) * 3 + ("arbitrary",)),
     )(qp, kp, vp, kvm)
     out, lse = out
     return out[:, :, :T, :], lse
@@ -501,6 +518,10 @@ def _flash_backward(q, k, v, kv_mask, out, lse, dout, causal, sm_scale,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
+        # dk/dv accumulate over the (gi, qi) inner dims; (b, hk, kj)
+        # tiles are independent
+        **_mosaic_params(interpret,
+                         ("parallel",) * 3 + ("arbitrary",) * 2),
     )(qp, kp, vp, dop, lse, delta, kvm)
 
     q_spec2 = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
@@ -527,6 +548,8 @@ def _flash_backward(q, k, v, kv_mask, out, lse, dout, causal, sm_scale,
         out_shape=jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
+        # dq accumulates over kj only; (b, h, qi) tiles independent
+        **_mosaic_params(interpret, ("parallel",) * 3 + ("arbitrary",)),
     )(qp, kp, vp, dop, lse, delta, kvm)
 
     return dq[:, :, :T, :], dk[:, :, :S, :], dv[:, :, :S, :]

@@ -16,7 +16,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.kernels.lstm_cell import _ACTS, _is_tpu
+from paddle_tpu.kernels.lstm_cell import _ACTS, _is_tpu, _mosaic_params
 
 
 def gru_reference(xw, w_gate, w_cand, bias, h0, mask,
@@ -121,6 +121,8 @@ def _gru_pallas_forward(xw, w_gate, w_cand, bias, mask, gate_act, cand_act,
         out_shape=jax.ShapeDtypeStruct((t, bp, d), xw.dtype),
         scratch_shapes=[pltpu.VMEM((block_b, d), jnp.float32)],
         interpret=interpret,
+        # batch blocks are independent; time is the recurrence
+        **_mosaic_params(interpret, ("parallel", "arbitrary")),
     )(xs, w_gate, w_cand, jnp.reshape(bias, (1, d3)), m_arr)
     return jnp.moveaxis(hidden, 0, 1)[:b]
 

@@ -43,6 +43,10 @@ def _is_tpu():
     return is_tpu_target()
 
 
+# shared Pallas helper (grid dimension-semantics kwargs)
+from paddle_tpu.kernels.flash_attention import _mosaic_params  # noqa: E402
+
+
 def lstm_reference(xw, w_h, bias, peephole, h0, c0, mask,
                    gate_act="sigmoid", cell_act="tanh", cand_act="tanh"):
     """XLA scan reference. xw: [B, T, 4D] pre-projected inputs (+bias NOT
@@ -184,6 +188,8 @@ def _lstm_pallas_forward(xw, w_h, bias, peep_arr, has_peep, mask, gate_act,
             pltpu.VMEM((block_b, d), jnp.float32),
         ],
         interpret=interpret,
+        # batch blocks are independent; time is the recurrence
+        **_mosaic_params(interpret, ("parallel", "arbitrary")),
     )(xs, w_h, jnp.reshape(bias, (1, d4)), peep_arr, m_arr)
     return (jnp.moveaxis(hidden, 0, 1)[:b],
             jnp.moveaxis(cell, 0, 1)[:b])
