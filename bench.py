@@ -485,21 +485,44 @@ def main():
     elif tpu_kind is None:
         errors["tpu"] = "tpu-unavailable (probe failed or timed out); " \
                         "values are cpu proxies"
-        # surface the most recent on-chip capture so a degraded round
+        # surface the most recent on-chip captures so a degraded round
         # record still carries the hardware numbers (the tunnel wedges
-        # unpredictably; BENCH_NOTES.md documents each window)
+        # unpredictably; BENCH_NOTES.md documents each window). Newest
+        # wins PER MODEL: the best chip numbers for different models can
+        # live in different capture files (r3: ResNet in _manual,
+        # Transformer in _transformer).
         import glob
+        import re
+
+        def _round_of(path):
+            m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+            return int(m.group(1)) if m else -1
 
         here = os.path.dirname(os.path.abspath(__file__))
-        manuals = sorted(glob.glob(
-            os.path.join(here, "BENCH_r*_manual.json")))
-        if manuals:
+        per_model = {}
+        # numeric round sort: lexicographic would put r10 before r9
+        for path in sorted(
+            glob.glob(os.path.join(here, "BENCH_r*_manual.json"))
+            + glob.glob(os.path.join(here, "BENCH_r*_transformer.json")),
+            key=lambda p: (_round_of(p), os.path.basename(p)),
+        ):
             try:
-                with open(manuals[-1]) as f:
-                    out["last_tpu_capture"] = json.load(f)
-                out["last_tpu_capture_file"] = os.path.basename(manuals[-1])
+                with open(path) as f:
+                    cap = json.load(f)
             except (OSError, ValueError):
-                pass
+                continue
+            tpu_models = {
+                name: m for name, m in (cap.get("models") or {}).items()
+                if isinstance(m, dict) and m.get("platform") == "tpu"
+            }
+            if not tpu_models:
+                continue  # a proxy file must not pose as a TPU capture
+            out["last_tpu_capture"] = cap
+            out["last_tpu_capture_file"] = os.path.basename(path)
+            for name, m in tpu_models.items():
+                per_model[name] = dict(m, source=os.path.basename(path))
+        if per_model:
+            out["last_tpu_capture_models"] = per_model
     elif primary is not None and primary.get("platform") == "tpu":
         # only label the capture with the chip when the HEADLINE result
         # actually ran there — CPU-proxy retries must not masquerade as
