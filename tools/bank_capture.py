@@ -3,7 +3,7 @@
 VERDICT r3 Weak #5: three rounds running, the driver's end-of-round
 BENCH_r{N}.json degraded to a CPU proxy while fresher chip numbers sat in
 manual capture files. Fix: every successful capture immediately rewrites
-``BENCH_r04_manual.json`` at the repo root in the driver's own format, so
+``BENCH_r05_manual.json`` at the repo root in the driver's own format, so
 bench.py's degraded path (which embeds the newest ``BENCH_r*_manual.json``
 as ``last_tpu_capture``) and any human reader always see the latest
 hardware truth.
@@ -20,8 +20,10 @@ Behavior:
   *-default tag (the driver configuration), and always records the
   capture under "experiments"[TAG] with a UTC timestamp + git rev;
 * recomputes the headline (resnet50 if banked, else first model);
-* commits the bank file — but only when nothing else is staged, so a
-  concurrent interactive commit can never swallow the watcher's change.
+* commits the bank file through a private index (tools/commit_path.py),
+  so the shared index is never written mid-flight (ADVICE r4: the
+  check-then-add form was a TOCTOU race; a plain pathspec commit still
+  contaminated the shared index).
 """
 
 import json
@@ -31,7 +33,11 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BANK = os.path.join(ROOT, "BENCH_r04_manual.json")
+_BANK_NAME = os.environ.get("BENCH_BANK", "BENCH_r05_manual.json")
+if os.path.basename(_BANK_NAME) != _BANK_NAME:
+    raise SystemExit("bank_capture: BENCH_BANK must be a bare filename "
+                     "(repo root), got %r" % _BANK_NAME)
+BANK = os.path.join(ROOT, _BANK_NAME)
 
 
 def _last_json_line(path):
@@ -120,15 +126,18 @@ def main():
     os.replace(tmp, BANK)
     print("banked %s -> %s" % (tag, os.path.basename(BANK)))
 
-    # commit only when the index is otherwise clean: a human mid-commit
-    # must never have the watcher's `git add` swept into their commit
-    if _git("diff", "--cached", "--quiet").returncode == 0:
-        _git("add", os.path.basename(BANK))
-        r = _git("commit", "-m",
-                 "Bank on-chip capture %s into BENCH_r04_manual" % tag)
-        print(r.stdout.strip() or r.stderr.strip())
-    else:
-        print("bank_capture: index busy; bank file left for the next "
+    # private-index commit (tools/commit_path.py): never touches the
+    # shared index mid-flight, so neither direction of the interactive/
+    # watcher commit race can mix files
+    from commit_path import commit_path
+    rc, out = commit_path(
+        os.path.basename(BANK),
+        "Bank on-chip capture %s into %s" % (tag, os.path.basename(BANK)))
+    print(out)
+    if rc != 0:
+        # the bank file itself is written (what banked() checks); a
+        # failed commit just rides the next commit instead
+        print("bank_capture: commit failed; bank file left for the next "
               "commit", file=sys.stderr)
     return 0
 

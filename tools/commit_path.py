@@ -1,0 +1,85 @@
+"""Commit exactly one repo-relative path without touching the shared index.
+
+The hardware-window watcher commits bank/artifact files while an
+interactive session may be mid-commit in the same repo. Two races exist
+with naive staging (ADVICE r4 + round-5 review):
+
+* check-then-add: the watcher's ``git add`` lands between a human's
+  check and commit, sweeping the watcher file into an unrelated commit;
+* pathspec-commit-only fixes the watcher's own commit but still stages
+  the file in the shared index, contaminating the human's NEXT commit.
+
+Fix: build the commit in a private ``GIT_INDEX_FILE`` seeded from HEAD,
+so the shared index is never written mid-flight. After the commit, the
+shared index is synced (``git add`` of the now-committed path) so the
+path does not appear as a staged deletion against the new HEAD; its
+staged content then equals HEAD, so a concurrent commit sweeping it in
+is a no-op by content.
+
+Residual race (unavoidable with any concurrent use of one git repo): a
+session that ran ``git add -A`` BEFORE this commit and commits AFTER it
+snapshots the pre-bank blob and reverts the path. Nothing watcher-side
+can prevent another actor committing stale staged content; interactive
+sessions here stage explicit paths, never ``-A``.
+
+Usage:  python tools/commit_path.py RELPATH MESSAGE
+Exit 0 on commit or nothing-to-commit; 1 on hard git failure.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _git(extra_env, *args):
+    env = dict(os.environ)
+    env.update(extra_env)
+    return subprocess.run(["git", "-C", ROOT] + list(args),
+                          capture_output=True, text=True, env=env)
+
+
+def commit_path(relpath, message):
+    """Commit the working-tree state of ``relpath`` on top of HEAD."""
+    if os.path.isabs(relpath):
+        return 1, "commit_path: need a repo-relative path, got %r" % relpath
+    fd, idx = tempfile.mkstemp(prefix="ptpu_index_")
+    os.close(fd)
+    os.remove(idx)  # git must create its own index file
+    penv = {"GIT_INDEX_FILE": idx}
+    try:
+        r = _git(penv, "read-tree", "HEAD")
+        if r.returncode:
+            return 1, "read-tree failed: %s" % r.stderr.strip()
+        r = _git(penv, "add", "--", relpath)
+        if r.returncode:
+            return 1, "add failed: %s" % r.stderr.strip()
+        r = _git(penv, "commit", "-m", message)
+        out = (r.stdout + r.stderr).strip()
+        if r.returncode and "nothing to commit" not in out \
+                and "nothing added" not in out \
+                and "no changes added" not in out:
+            return 1, "commit failed: %s" % out
+    finally:
+        if os.path.exists(idx):
+            os.remove(idx)
+    # sync the shared index so the path isn't a staged deletion vs the
+    # new HEAD; content now equals HEAD, so this cannot contaminate a
+    # concurrent commit with anything that isn't already in history
+    _git({}, "add", "--", relpath)
+    return 0, out
+
+
+def main():
+    if len(sys.argv) != 3:
+        print(__doc__, file=sys.stderr)
+        return 2
+    rc, out = commit_path(sys.argv[1], sys.argv[2])
+    print(out)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,12 +5,12 @@
 # and round 3's first window died mid-Transformer). Captures land in
 # $HW_LOG (default /tmp/hw_window) as one JSON file per experiment, and
 # every successful bench capture is immediately banked into the
-# driver-format BENCH_r04_manual.json + committed (tools/bank_capture.py)
+# driver-format BENCH_r05_manual.json + committed (tools/bank_capture.py)
 # so the round-end snapshot can never be staler than the newest window
 # (VERDICT r3 Weak #5).
 #
 # Legs are idempotent and individually tracked: a leg that already banked
-# (its tag in BENCH_r04_manual.json / its artifact committed non-empty)
+# (its tag in BENCH_r05_manual.json / its artifact committed non-empty)
 # is skipped, and the watcher keeps polling until EVERY leg has banked —
 # a window that dies mid-capture costs the remaining legs only until the
 # next window, not the round.
@@ -22,6 +22,13 @@ cd "$(dirname "$0")/.."
 LOG=${HW_LOG:-/tmp/hw_window}
 mkdir -p "$LOG"
 
+# fail fast on an override bank_capture.py would reject after a 45-min
+# capture: the bank file must be a bare filename at the repo root
+case "${BENCH_BANK:-}" in
+  */*) echo "hw_window: BENCH_BANK must be a bare filename, got '$BENCH_BANK'" >&2
+       exit 2 ;;
+esac
+
 probe() {
   # the wedged plugin can ignore SIGTERM mid-enumeration: -k SIGKILLs
   timeout -k 10 90 python - >/dev/null 2>&1 <<'EOF'
@@ -32,9 +39,10 @@ EOF
 
 banked() {  # has experiment tag $1 already banked?
   python - "$1" <<'EOF'
-import json, sys
+import json, os, sys
+name = os.environ.get("BENCH_BANK", "BENCH_r05_manual.json")
 try:
-    bank = json.load(open("BENCH_r04_manual.json"))
+    bank = json.load(open(name))
     sys.exit(0 if sys.argv[1] in bank.get("experiments", {}) else 1)
 except Exception:
     sys.exit(1)
@@ -102,16 +110,16 @@ artifact() {
   fi
   mkdir -p "$(dirname "$dest")"
   cp "$tmp" "$dest"
-  if git diff --cached --quiet; then
-    git add "$dest" && git commit -m \
-      "Hardware artifact: $(basename "$dest") (window capture)" \
-      >>"$LOG/log" 2>&1
-  fi
+  # private-index commit (tools/commit_path.py): cannot mix with a
+  # concurrent interactive commit in either direction
+  python tools/commit_path.py "$dest" \
+    "Hardware artifact: $(basename "$dest") (window capture)" \
+    >>"$LOG/log" 2>&1
 }
 
 capture() {
   echo "tunnel up $(date -u +%FT%TZ); capturing" | tee -a "$LOG/log"
-  # Round-4 priority (VERDICT r3 Next #1-#3, #5, #9). The round-3 banked
+  # Round-5 priority (VERDICT r4 Next #2 standing order; queue order from ROUND4.md). The round-3 banked
   # Transformer number predates the lse-layout fix + factored CE + flash
   # backward (+19% CPU proxy); re-capture is the round's top deliverable.
   # A leg returning 2 means the tunnel died mid-leg: abort the pass (the
@@ -134,16 +142,16 @@ capture() {
   # 5. Pallas-vs-XLA kernel verdicts — crashed in the r3 window on the
   #    pre-fix LSTM block spec (fixed in a2f4042; tests/test_tpu_lowering.py
   #    now guards the whole class); flag defaults depend on this table
-  artifact docs/artifacts/kernel_bench_r04.jsonl \
+  artifact docs/artifacts/kernel_bench_r05.jsonl \
     timeout -k 120 2700 python tools/kernel_bench.py; [ $? -eq 2 ] && return
   # 6. xprof per-HLO breakdown, both models (VERDICT Next #2: the MFU
   #    plan must be justified from this table)
-  artifact docs/artifacts/step_breakdown_resnet50_r04.jsonl \
+  artifact docs/artifacts/step_breakdown_resnet50_r05.jsonl \
     timeout -k 120 2700 python tools/step_breakdown.py --model resnet50 --xprof; [ $? -eq 2 ] && return
-  artifact docs/artifacts/step_breakdown_transformer_r04.jsonl \
+  artifact docs/artifacts/step_breakdown_transformer_r05.jsonl \
     timeout -k 120 2700 python tools/step_breakdown.py --model transformer --xprof; [ $? -eq 2 ] && return
   # 7. convergence-on-chip proof (VERDICT Next #9): MNIST to threshold
-  artifact docs/artifacts/convergence_mnist_r04.json \
+  artifact docs/artifacts/convergence_mnist_r05.json \
     timeout -k 120 2700 python tools/convergence_run.py; [ $? -eq 2 ] && return
   # 8. seq4096 stretch leg (flash memory regime; skipped quickly if OOM)
   bench transformer-seq4096 BENCH_MODELS=transformer BENCH_SEQ=4096 BENCH_BS=4
@@ -159,10 +167,10 @@ all_done() {
         || return 1
     fi
   done
-  for dest in docs/artifacts/kernel_bench_r04.jsonl \
-              docs/artifacts/step_breakdown_resnet50_r04.jsonl \
-              docs/artifacts/step_breakdown_transformer_r04.jsonl \
-              docs/artifacts/convergence_mnist_r04.json; do
+  for dest in docs/artifacts/kernel_bench_r05.jsonl \
+              docs/artifacts/step_breakdown_resnet50_r05.jsonl \
+              docs/artifacts/step_breakdown_transformer_r05.jsonl \
+              docs/artifacts/convergence_mnist_r05.json; do
     if ! [ -s "$dest" ]; then  # same predicate artifact() skips on
       [ "$(cat "$LOG/$(basename "$dest").attempts" 2>/dev/null \
            || echo 0)" -ge 3 ] || return 1
