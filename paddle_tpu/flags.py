@@ -52,6 +52,13 @@ _DEFS = {
     # dkv/dq kernels, O(block) memory) or "reference" (recompute through
     # the XLA-composed path — materializes the [T, S] score matrix)
     "flash_backward": ("pallas", str),
+    # route the transformer's label-smoothed CE head through the fused
+    # single-pass op (ops/loss_ops.py fused_label_smooth_ce): bf16
+    # logits with f32-accumulated reductions, hand-written one-pass
+    # backward. MFU lever #1 from docs/MFU_PLAN.md (the composed head
+    # moves ~10 GB/step of f32 logits-shaped traffic at bench shapes);
+    # opt-in until the chip A/B (watcher leg transformer-ce-fused) lands
+    "fused_ce": (False, bool),
 }
 
 

@@ -5,6 +5,7 @@ from paddle_tpu.layer_helper import LayerHelper
 __all__ = [
     "cross_entropy",
     "softmax_with_cross_entropy",
+    "fused_label_smooth_ce",
     "sigmoid_cross_entropy_with_logits",
     "square_error_cost",
     "smooth_l1",
@@ -52,6 +53,26 @@ def softmax_with_cross_entropy(
     )
     if return_softmax:
         return loss, softmax
+    return loss
+
+
+def fused_label_smooth_ce(logits, label, epsilon=0.0, name=None):
+    """Label-smoothed cross entropy in ONE fused pass over the vocab dim
+    (ops/loss_ops.py fused_label_smooth_ce): factored smoothing — no
+    soft-label tensor, no second log-softmax pass — with the logits kept
+    in their network dtype (bf16 under AMP) and f32-accumulated
+    reductions. Returns f32 [N, 1] loss. The MFU lever-#1 form of the
+    composed softmax_with_cross_entropy + log_softmax head
+    (docs/MFU_PLAN.md); enable in the bundled transformer with
+    FLAGS_fused_ce=1."""
+    helper = LayerHelper("fused_label_smooth_ce", name=name)
+    loss = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="fused_label_smooth_ce",
+        inputs={"Logits": [logits], "Label": [label]},
+        outputs={"Loss": [loss]},
+        attrs={"epsilon": float(epsilon)},
+    )
     return loss
 
 

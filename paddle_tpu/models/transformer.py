@@ -153,20 +153,30 @@ def build(
     # soft labels, e.g. distillation targets.
     flat_logits = fluid.layers.reshape(logits, shape=[-1, trg_vocab_size])
     flat_label = fluid.layers.reshape(label, shape=[-1, 1])
-    cost = fluid.layers.softmax_with_cross_entropy(flat_logits, flat_label)
-    if label_smooth_eps:
-        neg_sum_logp = fluid.layers.scale(
-            fluid.layers.reduce_sum(
-                fluid.layers.log_softmax(flat_logits), dim=-1, keep_dim=True
-            ),
-            scale=-1.0,
-        )
-        cost = fluid.layers.elementwise_add(
-            fluid.layers.scale(cost, scale=1.0 - label_smooth_eps),
-            fluid.layers.scale(
-                neg_sum_logp, scale=label_smooth_eps / trg_vocab_size
-            ),
-        )
+    from paddle_tpu import flags as _flags
+    if _flags.get("fused_ce"):
+        # MFU lever #1 (docs/MFU_PLAN.md): one fused pass, bf16 logits,
+        # f32-accumulated reductions, hand-written one-pass backward —
+        # algebraically identical to the composed head below
+        cost = fluid.layers.fused_label_smooth_ce(
+            flat_logits, flat_label, epsilon=label_smooth_eps)
+    else:
+        cost = fluid.layers.softmax_with_cross_entropy(
+            flat_logits, flat_label)
+        if label_smooth_eps:
+            neg_sum_logp = fluid.layers.scale(
+                fluid.layers.reduce_sum(
+                    fluid.layers.log_softmax(flat_logits), dim=-1,
+                    keep_dim=True
+                ),
+                scale=-1.0,
+            )
+            cost = fluid.layers.elementwise_add(
+                fluid.layers.scale(cost, scale=1.0 - label_smooth_eps),
+                fluid.layers.scale(
+                    neg_sum_logp, scale=label_smooth_eps / trg_vocab_size
+                ),
+            )
 
     # Mask loss on padded target positions.
     trg_len = fluid.layers.data("trg_len", shape=[1], dtype="int64")

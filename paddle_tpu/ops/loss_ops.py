@@ -44,6 +44,71 @@ register_op(
 )
 
 
+def _lower_fused_label_smooth_ce(ctx, ins, attrs):
+    """Single-pass label-smoothed cross entropy over the vocab dim.
+
+    The composed head (softmax_with_cross_entropy + log_softmax +
+    scale/add, models/transformer.py) makes ~5 logits-shaped passes and
+    — because those ops are AMP-blacklisted — materializes them in f32:
+    ~10 GB/step of HBM traffic at bench shapes (docs/MFU_PLAN.md lever
+    #1, from the committed cost-model artifacts). This op keeps the
+    logits in their network dtype (bf16 under AMP) and uses the
+    factored identity
+
+        L = lse - (1-eps) * x_y - (eps/V) * sum_i x_i
+
+    so the smoothing term needs only sum(x) — no second log-softmax
+    pass — with every reduction f32-accumulated (fused into one pass by
+    XLA; no f32 logits-shaped tensor exists). The hand-written backward
+    is the single fused expression
+
+        dL/dx_i = (softmax_i - eps/V - (1-eps) * 1[i=y]) * g
+
+    (exact: d lse = softmax, d x_y = onehot, d sum = 1). One bf16
+    [N, V] write instead of the composed head's f32 chain.
+
+    Reference capability anchor: softmax_with_cross_entropy_op.cc +
+    label_smooth_op.cc composed; the fusion itself is TPU-motivated.
+    """
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    eps = float(attrs.get("epsilon", 0.0))
+    vocab = int(jnp.shape(logits)[-1])
+    lbl = _label_to_int(label)
+
+    def fwd(x, l):
+        m = jnp.max(x, axis=-1, keepdims=True)
+        s = jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True,
+                    dtype=jnp.float32)
+        lse = m.astype(jnp.float32) + jnp.log(s)
+        xy = jnp.take_along_axis(x, l[..., None], axis=-1)
+        sumx = jnp.sum(x, axis=-1, keepdims=True, dtype=jnp.float32)
+        loss = (lse - (1.0 - eps) * xy.astype(jnp.float32)
+                - (eps / vocab) * sumx)
+        return loss, (x, l, m, s)
+
+    def bwd(res, g):
+        x, l, m, s = res
+        softmax = jnp.exp(x - m) / s.astype(x.dtype)
+        onehot = jax.nn.one_hot(l, vocab, dtype=x.dtype)
+        dx = (softmax - eps / vocab - (1.0 - eps) * onehot) \
+            * g.astype(x.dtype)
+        return (dx, None)
+
+    f = jax.custom_vjp(lambda x, l: fwd(x, l)[0])
+    f.defvjp(fwd, bwd)
+    return {"Loss": f(logits, lbl)}
+
+
+register_op(
+    "fused_label_smooth_ce",
+    inputs=["Logits", "Label"],
+    outputs=["Loss"],
+    attrs={"epsilon": 0.0},
+    lower=_lower_fused_label_smooth_ce,
+    no_grad_inputs=("Label",),
+)
+
+
 def _lower_cross_entropy(ctx, ins, attrs):
     x, label = ins["X"][0], ins["Label"][0]
     eps = 1e-8

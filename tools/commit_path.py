@@ -41,35 +41,74 @@ def _git(extra_env, *args):
                           capture_output=True, text=True, env=env)
 
 
-def commit_path(relpath, message):
-    """Commit the working-tree state of ``relpath`` on top of HEAD."""
+def commit_path(relpath, message, retries=3):
+    """Commit the working-tree state of ``relpath`` on top of HEAD.
+
+    Plumbing-level with compare-and-swap: the commit object is built
+    from a private index seeded from the OBSERVED head, and the branch
+    ref only advances if it still points at that head
+    (``update-ref <ref> <new> <old>``) — a concurrent interactive commit
+    landing mid-flight makes the swap fail and the whole attempt retries
+    against the new head, so neither side's tree can be silently
+    reverted."""
     if os.path.isabs(relpath):
         return 1, "commit_path: need a repo-relative path, got %r" % relpath
-    fd, idx = tempfile.mkstemp(prefix="ptpu_index_")
-    os.close(fd)
-    os.remove(idx)  # git must create its own index file
-    penv = {"GIT_INDEX_FILE": idx}
-    try:
-        r = _git(penv, "read-tree", "HEAD")
-        if r.returncode:
-            return 1, "read-tree failed: %s" % r.stderr.strip()
-        r = _git(penv, "add", "--", relpath)
-        if r.returncode:
-            return 1, "add failed: %s" % r.stderr.strip()
-        r = _git(penv, "commit", "-m", message)
-        out = (r.stdout + r.stderr).strip()
-        if r.returncode and "nothing to commit" not in out \
-                and "nothing added" not in out \
-                and "no changes added" not in out:
-            return 1, "commit failed: %s" % out
-    finally:
-        if os.path.exists(idx):
-            os.remove(idx)
+    last = ""
+    for _ in range(retries):
+        head = _git({}, "rev-parse", "HEAD").stdout.strip()
+        ref = _git({}, "symbolic-ref", "-q", "HEAD").stdout.strip() or "HEAD"
+        fd, idx = tempfile.mkstemp(prefix="ptpu_index_")
+        os.close(fd)
+        os.remove(idx)  # git must create its own index file
+        penv = {"GIT_INDEX_FILE": idx}
+        try:
+            r = _git(penv, "read-tree", head)
+            if r.returncode:
+                return 1, "read-tree failed: %s" % r.stderr.strip()
+            r = _git(penv, "add", "--", relpath)
+            if r.returncode:
+                return 1, "add failed: %s" % r.stderr.strip()
+            tree = _git(penv, "write-tree").stdout.strip()
+            if not tree:
+                return 1, "write-tree failed"
+            base_tree = _git({}, "rev-parse",
+                             head + "^{tree}").stdout.strip()
+            if tree == base_tree:
+                last = "nothing to commit (path matches HEAD)"
+                break
+            r = _git({}, "commit-tree", tree, "-p", head, "-m", message)
+            if r.returncode:
+                return 1, "commit-tree failed: %s" % r.stderr.strip()
+            new = r.stdout.strip()
+            r = _git({}, "update-ref", ref, new, head)
+            if r.returncode:
+                last = "head moved during commit; retrying"
+                continue   # CAS failed: a concurrent commit landed
+            last = "committed %s" % new[:12]
+            break
+        finally:
+            if os.path.exists(idx):
+                os.remove(idx)
+    else:
+        return 1, "gave up after %d CAS retries: %s" % (retries, last)
     # sync the shared index so the path isn't a staged deletion vs the
-    # new HEAD; content now equals HEAD, so this cannot contaminate a
-    # concurrent commit with anything that isn't already in history
-    _git({}, "add", "--", relpath)
-    return 0, out
+    # new HEAD; content now equals HEAD, so a concurrent commit sweeping
+    # it in is a no-op by content. A failed sync (index.lock held) must
+    # not pass silently: the stale staged blob would ride the next
+    # interactive commit.
+    import time
+    for delay in (0, 2, 5, 10):
+        if delay:
+            time.sleep(delay)   # index.lock is typically held seconds
+        r = _git({}, "add", "--", relpath)
+        if r.returncode == 0:
+            break
+    if r.returncode:
+        last += ("; WARNING: shared-index sync failed (%s) — run "
+                 "`git add -- %s` before the next commit"
+                 % (r.stderr.strip(), relpath))
+        print(last, file=sys.stderr)
+    return 0, last
 
 
 def main():
