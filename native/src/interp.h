@@ -1260,7 +1260,9 @@ class Interpreter {
     float alpha = FloatAttr(op, "alpha", 1e-4f);
     float beta = FloatAttr(op, "beta", 0.75f);
     if (n <= 0) return "bad window";
-    int64_t half = n / 2;
+    // reference lrn_op.cc: start = -(n-1)/2 (biased toward higher
+    // channels for even n); ops/nn_ops.py matches
+    int64_t half = (n - 1) / 2;
     int64_t b = x->dims[0], c = x->dims[1], h = x->dims[2], w = x->dims[3];
     HostTensor out = MakeF32(x->dims);
     const float* xa = F32(*x);
@@ -1771,30 +1773,40 @@ class Interpreter {
         }
         float mx = -1e30f;
         int64_t len = enc_lens[b];
-        for (int64_t s = 0; s < S; ++s) {
-          if (s < len) {
-            float dot = 0.0f;
-            for (int64_t j = 0; j < D; ++j) {
-              dot += epa[(b * S + s) * D + j] * waa[j];
-            }
-            e[s] = std::tanh(dot + sp_scalar);
-            mx = std::max(mx, e[s]);
-          } else {
-            e[s] = -1e30f;
+        if (len <= 0) {
+          // zero-length encoder row: uniform-over-padding would be a
+          // silent degenerate result; emit zero weights and zero
+          // context (ops/seq2seq_ops.py _attend mirrors this)
+          std::fill(ctx.begin(), ctx.end(), 0.0f);
+          for (int64_t s = 0; s < S; ++s) {
+            awa[(b * T + t) * S + s] = 0.0f;
           }
-        }
-        float denom = 0.0f;
-        for (int64_t s = 0; s < S; ++s) {
-          e[s] = std::exp(e[s] - mx);
-          denom += e[s];
-        }
-        if (denom <= 0.0f) denom = 1.0f;
-        std::fill(ctx.begin(), ctx.end(), 0.0f);
-        for (int64_t s = 0; s < S; ++s) {
-          float alpha = e[s] / denom;
-          awa[(b * T + t) * S + s] = alpha;
-          const float* evr = eva + (b * S + s) * C;
-          for (int64_t j = 0; j < C; ++j) ctx[j] += alpha * evr[j];
+        } else {
+          for (int64_t s = 0; s < S; ++s) {
+            if (s < len) {
+              float dot = 0.0f;
+              for (int64_t j = 0; j < D; ++j) {
+                dot += epa[(b * S + s) * D + j] * waa[j];
+              }
+              e[s] = std::tanh(dot + sp_scalar);
+              mx = std::max(mx, e[s]);
+            } else {
+              e[s] = -1e30f;
+            }
+          }
+          float denom = 0.0f;
+          for (int64_t s = 0; s < S; ++s) {
+            e[s] = std::exp(e[s] - mx);
+            denom += e[s];
+          }
+          if (denom <= 0.0f) denom = 1.0f;
+          std::fill(ctx.begin(), ctx.end(), 0.0f);
+          for (int64_t s = 0; s < S; ++s) {
+            float alpha = e[s] / denom;
+            awa[(b * T + t) * S + s] = alpha;
+            const float* evr = eva + (b * S + s) * C;
+            for (int64_t j = 0; j < C; ++j) ctx[j] += alpha * evr[j];
+          }
         }
         // gates = [h, context, x_t] @ CellW + CellB
         const float* xrow = xa + (b * T + t) * M;

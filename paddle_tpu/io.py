@@ -246,8 +246,11 @@ def save_compiled_inference_model(
 
     feed_shapes: {feed name: (shape tuple, dtype str)} — exported
     executables are shape-specialized, like any XLA executable.
-    platforms: lowering platforms (default: the current backend); pass
-    ("cpu", "tpu") to emit one artifact servable on both.
+    platforms: a single lowering platform, e.g. ("tpu",) (default: the
+    current backend). One artifact per platform: kernel selection
+    (flash attention / Pallas RNN vs XLA reference) is keyed on the
+    export target, so a multi-platform list is rejected — export once
+    per platform instead.
 
     Writes ``__compiled__.bin`` (serialized export) + ``__compiled__.json``
     (feed order/shapes + fetch names). Returns the fetch names.

@@ -461,8 +461,12 @@ def _lower_lrn(ctx, ins, attrs):
     alpha = attrs.get("alpha", 1e-4)
     beta = attrs.get("beta", 0.75)
     sq = jnp.square(x)
-    half = n // 2
-    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    # reference lrn_op.cc window: start = -(n-1)/2, i.e. offsets
+    # [-(n-1)//2, n-1-(n-1)//2] — biased toward HIGHER channels for
+    # even n (ADVICE r4: n//2 biased low; odd n, incl. the default 5,
+    # is unaffected). native/src/interp.h mirrors this exactly.
+    lo = (n - 1) // 2
+    pad = jnp.pad(sq, [(0, 0), (lo, n - 1 - lo), (0, 0), (0, 0)])
     acc = sum(
         pad[:, i : i + jnp.shape(x)[1]] for i in range(n)
     )

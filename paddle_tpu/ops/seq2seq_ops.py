@@ -42,6 +42,13 @@ def _attend(h, enc_vec, enc_proj, w_state, w_attn, mask):
     if mask is not None:
         e = jnp.where(mask > 0, e, _NEG_INF)
     alpha = jax.nn.softmax(e, axis=1)
+    if mask is not None:
+        # a row with EncoderLen==0 would otherwise degrade to UNIFORM
+        # attention over pure padding (softmax of an all-masked row);
+        # emit zero weights -> zero context instead (ADVICE r4; the C++
+        # interpreter mirrors this)
+        valid = jnp.any(mask > 0, axis=1, keepdims=True)
+        alpha = jnp.where(valid, alpha, jnp.zeros_like(alpha))
     context = jnp.einsum("bs,bsc->bc", alpha, enc_vec)
     return context, alpha
 

@@ -645,3 +645,49 @@ def test_lod_tensor_to_array_round_trip_trains():
     # d(mean(x*w))/dw_j = sum over (b, t) of x[b, t, j] / (B*T*D)
     np.testing.assert_allclose(
         np.asarray(gw), xv.sum(axis=(0, 1)) / xv.size, rtol=1e-5)
+
+
+def test_attention_lstm_zero_length_row_zero_context():
+    """ADVICE r4: a row with EncoderLen==0 must yield ZERO attention
+    weights (and thus zero context), not uniform attention over
+    padding. The C++ interpreter mirrors this (covered by the
+    differential fuzz harness for nonzero lengths; this pins the
+    zero-length corner on the XLA engine)."""
+    rng = _RNG(75)
+    B, T, S, D, C, M = 2, 3, 4, 3, 4, 3
+    ins = {
+        "X": rng.randn(B, T, M).astype("float32") * 0.3,
+        "EncoderVec": rng.randn(B, S, C).astype("float32"),
+        "EncoderProj": rng.randn(B, S, D).astype("float32"),
+        "H0": np.zeros((B, D), "float32"),
+        "C0": np.zeros((B, D), "float32"),
+        "StateProjW": (0.3 * rng.randn(D, D)).astype("float32"),
+        "AttnW": (0.3 * rng.randn(2 * D, 1)).astype("float32"),
+        "CellW": (0.3 * rng.randn(D + C + M, 4 * D)).astype("float32"),
+        "CellB": np.zeros((1, 4 * D), "float32"),
+        "EncoderLen": np.asarray([S, 0], "int32"),
+    }
+    hid, attn = _run("attention_lstm", ins, ["Hidden", "AttentionWeight"])
+    np.testing.assert_allclose(attn[0].sum(-1), np.ones(T), rtol=1e-5)
+    assert np.abs(attn[1]).max() == 0.0, "zero-length row must have zero weights"
+    assert np.isfinite(hid).all()
+
+
+def test_lrn_even_n_reference_window():
+    """ADVICE r4: for even n the reference window is start=-(n-1)/2 —
+    biased toward HIGHER channels. n=4 at channel c must average
+    squares over [c-1, c+2], not [c-2, c+1]."""
+    rng = _RNG(40)
+    x = rng.randn(1, 6, 2, 2).astype("float32")
+    n, k, alpha, beta = 4, 2.0, 0.5, 0.75
+    (out,) = _run("lrn", {"X": x}, ["Out"],
+                  {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    sq = x ** 2
+    want = np.empty_like(x)
+    C = x.shape[1]
+    lo_off = (n - 1) // 2
+    for c in range(C):
+        lo, hi = max(0, c - lo_off), min(C - 1, c + (n - 1 - lo_off))
+        acc = sq[:, lo:hi + 1].sum(axis=1)
+        want[:, c] = x[:, c] / (k + alpha * acc) ** beta
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
