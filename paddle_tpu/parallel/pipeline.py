@@ -95,7 +95,7 @@ def _gpipe_shard(params, x, stage_fn, axis_name):
 
 
 def gpipe(stage_fn, stage_params, x, mesh, axis_name="pipe",
-          batch_axis=None):
+          batch_axis=None, param_specs=None):
     """Run x through S pipelined stages.
 
     Args:
@@ -109,6 +109,12 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name="pipe",
       batch_axis: optional second mesh axis to keep the microbatch batch
         dim sharded over (pipeline x data parallel on a 2-D mesh). Without
         it the activations are replicated across the other axes.
+      param_specs: optional pytree of PartitionSpec matching stage_params,
+        for sharding stage weights over FURTHER mesh axes (tensor
+        parallelism inside a stage — dp x tp x pp on a 3-D mesh). Every
+        spec's dim 0 must be ``axis_name``; inside ``stage_fn`` the
+        model-axis collectives (e.g. ``jax.lax.psum(.., "model")`` after
+        a row-parallel matmul) are explicit, shard_map-style.
 
     Returns [M, B, ...]: the pipeline output, differentiable w.r.t. both
     stage_params and x; with batch_axis it stays batch-sharded.
@@ -125,9 +131,21 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name="pipe",
                 "per device; stack with stack_stage_params, fold deeper "
                 "networks into stage_fn)" % (n, l.shape))
     shard_map = _compat.shard_map()
-    param_specs = jax.tree_util.tree_map(
-        lambda _: P(axis_name), stage_params
-    )
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda _: P(axis_name), stage_params
+        )
+    else:
+        for spec in jax.tree_util.tree_leaves(
+                param_specs, is_leaf=lambda s: isinstance(s, P)):
+            # dim-0 entries may be a bare axis name or an axis tuple
+            # (P(("pipe", "data"), ...)); require pipe among them
+            first = spec[0] if spec else None
+            axes0 = first if isinstance(first, tuple) else (first,)
+            if axis_name not in axes0:
+                raise ValueError(
+                    "gpipe: every param_specs entry must shard dim 0 over "
+                    "the pipe axis %r, got %s" % (axis_name, spec))
     if batch_axis is not None:
         if batch_axis not in mesh.shape or batch_axis == axis_name:
             raise ValueError(
