@@ -59,7 +59,11 @@ class MasterService(object):
     """In-process task-queue service; optionally served over TCP."""
 
     def __init__(self, chunks_per_task=1, timeout_s=5.0, failure_max=3,
-                 snapshot_path=None):
+                 snapshot_path=None, snapshot_interval_s=0.5):
+        """snapshot_interval_s: write-throttle window for per-lease
+        snapshot churn (see _snapshot); structural transitions always
+        force a write. Crash-recovery tests raise it to pin exactly
+        which state a simulated kill -9 loses."""
         self._chunks_per_task = max(1, int(chunks_per_task))
         self._timeout_s = timeout_s
         self._failure_max = failure_max
@@ -74,7 +78,7 @@ class MasterService(object):
         self._server = None
         self._watcher = None
         self._closed = threading.Event()
-        self._snapshot_interval_s = 0.5
+        self._snapshot_interval_s = float(snapshot_interval_s)
         self._last_snapshot = 0.0
         self._snapshot_dirty = False
         if snapshot_path and os.path.exists(snapshot_path):

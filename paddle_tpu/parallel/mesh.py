@@ -15,6 +15,7 @@ Reference-capability map:
 """
 
 import logging
+import os
 
 import numpy as np
 
@@ -43,10 +44,16 @@ def build_mesh(num_devices=None, data=None, model=1, pipe=1, devices=None):
     return Mesh(arr, ("data", "model", "pipe"))
 
 
-def init_distributed(coordinator_address=None, num_processes=None, process_id=None):
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, heartbeat_timeout_s=None):
     """Multi-host bootstrap — the gen_nccl_id_op.cc:31 equivalent. On a TPU
     pod slice, jax.distributed discovers peers from the TPU runtime; on
     CPU/GPU, pass coordinator address + ranks (PADDLE_TRAINER_* env style).
+
+    heartbeat_timeout_s bounds how long survivors wait before a dead
+    peer is declared failed (the ExceptionHolder promptness knob,
+    reference framework/details/exception_holder.h); default is jax's
+    100s. Overridable via PADDLE_HEARTBEAT_TIMEOUT seconds in env.
     """
     kwargs = {}
     if coordinator_address:
@@ -55,6 +62,11 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
             num_processes=num_processes,
             process_id=process_id,
         )
+    if heartbeat_timeout_s is None and os.environ.get(
+            "PADDLE_HEARTBEAT_TIMEOUT"):
+        heartbeat_timeout_s = int(os.environ["PADDLE_HEARTBEAT_TIMEOUT"])
+    if heartbeat_timeout_s is not None:
+        kwargs["heartbeat_timeout_seconds"] = int(heartbeat_timeout_s)
     jax.distributed.initialize(**kwargs)
 
 

@@ -17,6 +17,10 @@ STEPS = 5
 SEED = 23
 
 
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
 def build_model(fluid):
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = SEED
@@ -69,8 +73,16 @@ def run_trainer(num_trainers, trainer_id, reduce_strategy="all_reduce"):
     shard = GLOBAL_BATCH // num_trainers
     lo, hi = trainer_id * shard, (trainer_id + 1) * shard
     losses = []
-    for step in range(STEPS):
-        xs, ys = global_batch(step)
+    steps = _env_int("DIST_STEPS", STEPS)
+    die_at = _env_int("DIST_DIE_AT_STEP", -1)
+    for step in range(steps):
+        if step == die_at:
+            # simulate a worker host dying mid-training (failure-path
+            # test): hard exit, no cleanup, like a kill -9
+            print("trainer %d dying at step %d" % (trainer_id, step),
+                  flush=True)
+            os._exit(42)
+        xs, ys = global_batch(step % STEPS)
         lv, = pe.run(fetch_list=[loss], feed={"x": xs[lo:hi], "y": ys[lo:hi]})
         losses.append(float(np.ravel(lv)[0]))
     return losses

@@ -29,8 +29,11 @@ def _free_port():
     return port
 
 
-def _launch_cluster(nprocs, tmp_path, reduce_strategy="all_reduce",
-                    script="dist_trainer_mlp.py"):
+def _spawn_cluster(nprocs, tmp_path, reduce_strategy="all_reduce",
+                   script="dist_trainer_mlp.py", extra_env=None,
+                   per_rank_env=None):
+    """Start nprocs trainer processes; returns (procs, out_files).
+    extra_env applies to every rank; per_rank_env maps rank -> dict."""
     port = _free_port()
     procs, out_files = [], []
     for rank in range(nprocs):
@@ -49,6 +52,8 @@ def _launch_cluster(nprocs, tmp_path, reduce_strategy="all_reduce",
             DIST_REDUCE=reduce_strategy,
             PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
         )
+        env.update(extra_env or {})
+        env.update((per_rank_env or {}).get(rank, {}))
         procs.append(
             subprocess.Popen(
                 [sys.executable, os.path.join(HERE, script)],
@@ -57,6 +62,13 @@ def _launch_cluster(nprocs, tmp_path, reduce_strategy="all_reduce",
                 stderr=subprocess.STDOUT,
             )
         )
+    return procs, out_files
+
+
+def _launch_cluster(nprocs, tmp_path, reduce_strategy="all_reduce",
+                    script="dist_trainer_mlp.py"):
+    procs, out_files = _spawn_cluster(nprocs, tmp_path, reduce_strategy,
+                                      script)
     outs = [p.communicate(timeout=300)[0] for p in procs]
     for p, o in zip(procs, outs):
         assert p.returncode == 0, o.decode(errors="replace")[-2000:]
@@ -146,3 +158,86 @@ def test_sharding_fallback_is_logged_and_planned(caplog):
     buf = io.StringIO()
     debugger.dump_sharding_plan(policy, file=buf)
     assert "odd" in buf.getvalue() and "fallback" in buf.getvalue()
+
+
+def test_worker_death_fails_fast_then_elastic_restart_recovers(tmp_path):
+    """Failure path (VERDICT r4 Next #8). Phase A: one trainer hard-dies
+    mid-step (os._exit, a kill -9 stand-in); the survivor must error out
+    PROMPTLY — bounded by the configured heartbeat timeout measured from
+    the peer's death, not a hang — with a diagnosable message naming the
+    dead peer (the ExceptionHolder role, reference
+    framework/details/exception_holder.h). Phase B: the master itself
+    dies WITHOUT a flush (kill -9 semantics: the throttled snapshot is
+    all that survives); a restarted master recovers it and the restarted
+    run finishes the pass — lost leases and unflushed finishes are
+    re-dispatched, the documented at-least-once/bounded-staleness
+    contract (go/master/service.go:313 role)."""
+    import time
+
+    # ---- phase A: kill one worker mid-step, survivor fails fast
+    procs, _outs = _spawn_cluster(
+        2, tmp_path,
+        extra_env={"DIST_STEPS": "1000",          # >> the kill step
+                   "PADDLE_HEARTBEAT_TIMEOUT": "10"},
+        per_rank_env={1: {"DIST_DIE_AT_STEP": "3"}},
+    )
+    out1, _ = procs[1].communicate(timeout=120)
+    assert procs[1].returncode == 42, out1.decode(errors="replace")[-800:]
+    t_death = time.time()   # promptness is measured from the DEATH
+    out0, _ = procs[0].communicate(timeout=120)
+    detect_s = time.time() - t_death
+    text0 = out0.decode(errors="replace")
+    assert procs[0].returncode not in (0, None), (
+        "survivor exited clean despite a dead peer:\n" + text0[-800:])
+    assert detect_s < 60, (
+        "survivor took %.0fs after the peer died to fail (heartbeat 10s)"
+        % detect_s)
+    assert ("heartbeat timeout" in text0 or "has failed" in text0
+            or "crashed" in text0), (
+        "survivor's failure is not diagnosable:\n" + text0[-1200:])
+
+    # ---- phase B: master kill -9, restarted run recovers the snapshot
+    from paddle_tpu.distributed.master import (
+        MasterClient, MasterService, task_reader)
+
+    snap = str(tmp_path / "master.snap")
+    chunks = ["c%d" % i for i in range(6)]
+    # huge throttle window: only structural writes (set_dataset) reach
+    # disk, so the crash deterministically loses the lease AND the
+    # finish below — the worst case the staleness contract allows
+    s1 = MasterService(timeout_s=0.3, failure_max=5, snapshot_path=snap,
+                       snapshot_interval_s=1000.0)
+    s1.set_dataset(chunks)
+    addr1 = s1.serve()
+    doomed = MasterClient(addr1)
+    t_done = doomed.get_task()
+    t_lost = doomed.get_task()
+    assert t_done and t_lost
+    doomed.task_finished(t_done.task_id)
+    doomed.close()
+    # kill -9 the master: drop the in-memory state without close()'s
+    # forced flush; the on-disk snapshot is the set_dataset one
+    crash_state = open(snap).read()
+    s1.close()
+    with open(snap, "w") as f:
+        f.write(crash_state)
+
+    s2 = MasterService(timeout_s=0.3, failure_max=5, snapshot_path=snap)
+    addr2 = s2.serve()
+    seen = []
+    c = MasterClient(addr2)
+
+    def load_chunk(chunk):
+        seen.append(chunk)
+        yield np.float32(1.0)
+
+    reader = task_reader(c, load_chunk, poll_s=0.1, max_polls=200)
+    for _ in reader():      # one full pass completes the interrupted one
+        pass
+    c.close()
+    s2.close()
+    # at-least-once: EVERY chunk re-dispatches (the finish was lost with
+    # the crash — that is the documented bounded-staleness trade), each
+    # exactly once within the recovered pass
+    assert sorted(seen) == sorted(chunks), (
+        "recovered pass mismatch: seen=%r" % (seen,))
