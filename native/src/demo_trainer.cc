@@ -8,11 +8,14 @@
 // loss). The XLA executor is the production path; this proves the native
 // runtime executes the full training IR (forward + grads + update) end to end.
 //
-//   ptpu_demo_trainer <dir> <loss_var> [steps] [batch]
+//   ptpu_demo_trainer <dir> <loss_var> [steps] [batch] [feed_mode]
 //
 // <dir> holds main.ptpb + startup.ptpb (paddle_tpu.core.program_bin
 // serialize_program bytes). Feeds are fixed by the demo contract:
-// "img" float32 [batch, 784], "label" int64 [batch, 1].
+//   feed_mode "mlp"  (default): "img" float32 [batch, 784]
+//   feed_mode "conv": "pixel" float32 [batch, 1, 28, 28]
+// plus "label" int64 [batch, 1] in both modes — the MLP and MNIST-conv
+// book models' surfaces (reference train/demo/demo_trainer.cc role).
 
 #include <cmath>
 #include <cstdint>
@@ -62,6 +65,12 @@ int main(int argc, char** argv) {
   std::string loss_name = argv[2];
   int steps = argc > 3 ? std::atoi(argv[3]) : 40;
   int batch = argc > 4 ? std::atoi(argv[4]) : 32;
+  std::string feed_mode = argc > 5 ? argv[5] : "mlp";
+  if (feed_mode != "mlp" && feed_mode != "conv") {
+    std::fprintf(stderr, "unknown feed_mode %s (mlp|conv)\n",
+                 feed_mode.c_str());
+    return 2;
+  }
 
   ptpu::ProgramDesc main_prog, startup_prog;
   if (!LoadProgram(dir + "/main.ptpb", &main_prog) ||
@@ -92,7 +101,11 @@ int main(int argc, char** argv) {
   for (int step = 0; step < steps; ++step) {
     ptpu::HostTensor img;
     img.dtype = "float32";
-    img.dims = {batch, kDim};
+    if (feed_mode == "conv") {
+      img.dims = {batch, 1, 28, 28};  // same 784 pixels, NCHW
+    } else {
+      img.dims = {batch, kDim};
+    }
     img.data.resize(static_cast<size_t>(batch) * kDim * sizeof(float));
     float* ia = reinterpret_cast<float*>(img.data.data());
     ptpu::HostTensor label;
@@ -110,7 +123,7 @@ int main(int argc, char** argv) {
             1.0f;
       }
     }
-    scope.Set("img", std::move(img));
+    scope.Set(feed_mode == "conv" ? "pixel" : "img", std::move(img));
     scope.Set("label", std::move(label));
 
     err = trainer.Run(0, &scope);

@@ -10,6 +10,17 @@
 // to end without Python and (b) cross-check XLA lowerings from C++ parity
 // tests (SURVEY.md §2.9 item 7). Float32, core op subset; unsupported ops
 // report an error rather than mis-executing.
+//
+// TRAINING grad table (what the C++ trainer can differentiate; the op
+// set of the MLP and MNIST-conv book models):
+//   mean_grad, relu_grad, softmax_grad, cross_entropy_grad,
+//   softmax_with_cross_entropy_grad, elementwise_add_grad (incl. the
+//   broadcast bias axis), mul_grad, conv2d_grad (strides/paddings/
+//   dilations/groups, same envelope as the forward), pool2d_grad
+//   (max + avg/exclusive; ceil_mode/adaptive refused like the forward),
+//   plus sgd and the startup initializers (fill_constant,
+//   uniform_random, gaussian_random). Anything else errors explicitly —
+//   the serving op table above is much wider than the training one.
 
 #include <algorithm>
 #include <cctype>
@@ -186,6 +197,13 @@ class Interpreter {
     if (op.type == "accuracy") return RunAccuracy(op, scope);
     if (op.type == "mean_grad") return RunMeanGrad(op, scope);
     if (op.type == "relu_grad") return RunReluGrad(op, scope);
+    if (op.type == "softmax_grad") return RunSoftmaxGrad(op, scope);
+    if (op.type == "cross_entropy_grad") return RunXentGrad(op, scope);
+    if (op.type == "conv2d_grad" || op.type == "depthwise_conv2d_grad") {
+      return RunConv2dGrad(op, scope);
+    }
+    if (op.type == "pool2d_grad") return RunPool2dGrad(op, scope);
+    if (op.type == "gaussian_random") return RunGaussianRandom(op, scope);
     if (op.type == "softmax_with_cross_entropy_grad") {
       return RunSCEGrad(op, scope);
     }
@@ -2285,6 +2303,311 @@ class Interpreter {
     const float* ga = F32(*g);
     float* oa = MutF32(&out);
     for (int64_t i = 0; i < n; ++i) oa[i] = pa[i] - rate * ga[i];
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // dX = (dOut - sum_j dOut_j * Out_j) * Out per row (softmax vjp)
+  std::string RunSoftmaxGrad(const OpDesc& op, Scope* scope) {
+    const std::string* on = OneName(op, "Out");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (on == nullptr || ogn == nullptr || gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* out = scope->Find(*on);
+    const HostTensor* og = scope->Find(*ogn);
+    if (out == nullptr || og == nullptr) return "input not in scope";
+    if (!IsF32(*out) || !IsF32(*og) || out->dims.size() < 1) {
+      return "bad input";
+    }
+    int64_t n = NumElements(out->dims);
+    if (n != NumElements(og->dims)) return "shape mismatch";
+    int64_t c = out->dims.back();
+    if (c <= 0 || n % c != 0) return "bad last dim";
+    HostTensor grad = MakeF32(out->dims);
+    const float* oa = F32(*out);
+    const float* ga = F32(*og);
+    float* ra = MutF32(&grad);
+    for (int64_t row = 0; row < n / c; ++row) {
+      const float* orow = oa + row * c;
+      const float* grow = ga + row * c;
+      float dot = 0.0f;
+      for (int64_t j = 0; j < c; ++j) dot += grow[j] * orow[j];
+      for (int64_t j = 0; j < c; ++j) {
+        ra[row * c + j] = (grow[j] - dot) * orow[j];
+      }
+    }
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  // hard-label cross_entropy: dX[i, gold] = -dY[i] / max(X[i, gold], eps)
+  // (matches the forward's log(max(x, eps)) clamp, ops/loss_ops.py)
+  std::string RunXentGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* labn = OneName(op, "Label");
+    const std::string* ogn = OneName(op, "Y@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (xn == nullptr || labn == nullptr || ogn == nullptr ||
+        gn == nullptr) {
+      return "missing io";
+    }
+    if (IntAttr(op, "soft_label", 0) != 0) return "soft_label unsupported";
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* label = scope->Find(*labn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || label == nullptr || og == nullptr) {
+      return "input not in scope";
+    }
+    if (!IsF32(*x) || x->dims.size() != 2) return "bad input";
+    int64_t n = x->dims[0], c = x->dims[1];
+    if (NumElements(og->dims) < n) return "loss grad too small";
+    std::vector<int64_t> gold;
+    std::string lerr = ReadIds(*label, &gold);
+    if (!lerr.empty()) return lerr;
+    if (static_cast<int64_t>(gold.size()) != n) return "label count";
+    HostTensor grad = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    const float* ga = F32(*og);
+    float* ra = MutF32(&grad);
+    std::fill(ra, ra + n * c, 0.0f);
+    const float kEps = 1e-8f;
+    for (int64_t i = 0; i < n; ++i) {
+      if (gold[i] < 0 || gold[i] >= c) return "label out of range";
+      float p = xa[i * c + gold[i]];
+      ra[i * c + gold[i]] = -ga[i] / (p > kEps ? p : kEps);
+    }
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  // conv2d backward: dInput (transposed conv of dOut with the filter)
+  // and dFilter (correlation of Input with dOut), same geometry attrs
+  // the forward kernel supports (strides/paddings/dilations/groups)
+  std::string RunConv2dGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "Input");
+    const std::string* wn = OneName(op, "Filter");
+    const std::string* ogn = OneName(op, "Output@GRAD");
+    if (xn == nullptr || wn == nullptr || ogn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* w = scope->Find(*wn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || w == nullptr || og == nullptr) {
+      return "input not in scope";
+    }
+    if (!IsF32(*x) || !IsF32(*w) || !IsF32(*og)) return "non-f32 dtype";
+    if (x->dims.size() != 4 || w->dims.size() != 4 ||
+        og->dims.size() != 4) {
+      return "rank != 4";
+    }
+    auto strides = IntsAttr(op, "strides", {1, 1});
+    auto pads = IntsAttr(op, "paddings", {0, 0});
+    auto dil = IntsAttr(op, "dilations", {1, 1});
+    if (strides.size() != 2 || pads.size() != 2 || dil.size() != 2) {
+      return "bad geometry attrs";
+    }
+    int64_t groups = IntAttr(op, "groups", 1);
+    if (groups <= 0) groups = 1;
+    int64_t n = x->dims[0], ci = x->dims[1], h = x->dims[2],
+            wd = x->dims[3];
+    int64_t co = w->dims[0], cig = w->dims[1], kh = w->dims[2],
+            kw = w->dims[3];
+    if (groups > ci || ci % groups != 0 || ci / groups != cig ||
+        co < groups || co % groups != 0) {
+      return "group/channel mismatch";
+    }
+    // dOut spatial dims must match the forward geometry exactly (same
+    // discipline as RunPool2dGrad): out-of-range positions would have
+    // every tap bounds-skipped and mis-execute silently
+    int64_t oh = (h + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) /
+                     strides[0] + 1;
+    int64_t ow = (wd + 2 * pads[1] - (dil[1] * (kw - 1) + 1)) /
+                     strides[1] + 1;
+    if (og->dims != std::vector<int64_t>({n, co, oh, ow})) {
+      return "dOut shape";
+    }
+    const float* xa = F32(*x);
+    const float* wa = F32(*w);
+    const float* ga = F32(*og);
+    int64_t co_g = co / groups;
+    const std::string* xgn = OneName(op, "Input@GRAD", false);
+    const std::string* wgn = OneName(op, "Filter@GRAD", false);
+    HostTensor xg, wg;
+    float* xga = nullptr;
+    float* wga = nullptr;
+    if (xgn != nullptr) {
+      xg = MakeF32(x->dims);
+      xga = MutF32(&xg);
+      std::fill(xga, xga + NumElements(x->dims), 0.0f);
+    }
+    if (wgn != nullptr) {
+      wg = MakeF32(w->dims);
+      wga = MutF32(&wg);
+      std::fill(wga, wga + NumElements(w->dims), 0.0f);
+    }
+    // scatter each dOut element back through the taps the forward read:
+    // one loop nest, both grads, exact adjoint of RunConv2d's gather
+    for (int64_t b = 0; b < n; ++b) {
+      for (int64_t oc = 0; oc < co; ++oc) {
+        int64_t g = oc / co_g;
+        for (int64_t i = 0; i < oh; ++i) {
+          for (int64_t j = 0; j < ow; ++j) {
+            float go = ga[((b * co + oc) * oh + i) * ow + j];
+            if (go == 0.0f) continue;
+            for (int64_t icg = 0; icg < cig; ++icg) {
+              int64_t ic = g * cig + icg;
+              for (int64_t r = 0; r < kh; ++r) {
+                int64_t yy = i * strides[0] - pads[0] + r * dil[0];
+                if (yy < 0 || yy >= h) continue;
+                for (int64_t s = 0; s < kw; ++s) {
+                  int64_t xx = j * strides[1] - pads[1] + s * dil[1];
+                  if (xx < 0 || xx >= wd) continue;
+                  int64_t xi = ((b * ci + ic) * h + yy) * wd + xx;
+                  int64_t wi = ((oc * cig + icg) * kh + r) * kw + s;
+                  if (xga != nullptr) xga[xi] += go * wa[wi];
+                  if (wga != nullptr) wga[wi] += go * xa[xi];
+                }
+              }
+            }
+          }
+        }
+      }
+    }
+    if (xgn != nullptr) scope->Set(*xgn, std::move(xg));
+    if (wgn != nullptr) scope->Set(*wgn, std::move(wg));
+    return "";
+  }
+
+  // pool2d backward. max: route dOut to the argmax tap (first-max on
+  // ties, matching a deterministic forward scan); avg: spread dOut over
+  // the window (exclusive: only in-bounds taps share it)
+  std::string RunPool2dGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (xn == nullptr || on == nullptr || ogn == nullptr ||
+        gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || og == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.size() != 4 || !IsF32(*og)) {
+      return "bad input";
+    }
+    std::string ptype = StrAttr(op, "pooling_type", "max");
+    bool global = IntAttr(op, "global_pooling", 0) != 0;
+    bool exclusive = IntAttr(op, "exclusive", 1) != 0;
+    if (IntAttr(op, "ceil_mode", 0) != 0) return "ceil_mode unsupported";
+    if (IntAttr(op, "adaptive", 0) != 0) return "adaptive unsupported";
+    auto ks = IntsAttr(op, "ksize", {2, 2});
+    auto st = IntsAttr(op, "strides", {1, 1});
+    auto pd = IntsAttr(op, "paddings", {0, 0});
+    if (ks.size() != 2 || st.size() != 2 || pd.size() != 2) {
+      return "bad geometry attrs";
+    }
+    int64_t n = x->dims[0], c = x->dims[1], h = x->dims[2],
+            wd = x->dims[3];
+    if (global) {
+      ks = {h, wd};
+      st = {h, wd};
+      pd = {0, 0};
+    }
+    int64_t oh = (h + 2 * pd[0] - ks[0]) / st[0] + 1;
+    int64_t ow = (wd + 2 * pd[1] - ks[1]) / st[1] + 1;
+    if (og->dims != std::vector<int64_t>({n, c, oh, ow})) {
+      return "dOut shape";
+    }
+    HostTensor grad = MakeF32(x->dims);
+    float* ra = MutF32(&grad);
+    std::fill(ra, ra + NumElements(x->dims), 0.0f);
+    const float* xa = F32(*x);
+    const float* ga = F32(*og);
+    for (int64_t b = 0; b < n; ++b) {
+      for (int64_t ch = 0; ch < c; ++ch) {
+        const float* plane = xa + (b * c + ch) * h * wd;
+        float* gplane = ra + (b * c + ch) * h * wd;
+        for (int64_t i = 0; i < oh; ++i) {
+          for (int64_t j = 0; j < ow; ++j) {
+            float go = ga[((b * c + ch) * oh + i) * ow + j];
+            if (ptype == "max") {
+              float best = -INFINITY;
+              int64_t bi = -1;
+              for (int64_t r = 0; r < ks[0]; ++r) {
+                int64_t yy = i * st[0] - pd[0] + r;
+                if (yy < 0 || yy >= h) continue;
+                for (int64_t s = 0; s < ks[1]; ++s) {
+                  int64_t xx = j * st[1] - pd[1] + s;
+                  if (xx < 0 || xx >= wd) continue;
+                  float v = plane[yy * wd + xx];
+                  if (v > best) {
+                    best = v;
+                    bi = yy * wd + xx;
+                  }
+                }
+              }
+              if (bi >= 0) gplane[bi] += go;
+            } else {
+              int64_t cnt = 0;
+              for (int64_t r = 0; r < ks[0]; ++r) {
+                int64_t yy = i * st[0] - pd[0] + r;
+                if (yy < 0 || yy >= h) continue;
+                for (int64_t s = 0; s < ks[1]; ++s) {
+                  int64_t xx = j * st[1] - pd[1] + s;
+                  if (xx < 0 || xx >= wd) continue;
+                  ++cnt;
+                }
+              }
+              int64_t denom = exclusive ? cnt : ks[0] * ks[1];
+              if (denom <= 0) continue;
+              float share = go / static_cast<float>(denom);
+              for (int64_t r = 0; r < ks[0]; ++r) {
+                int64_t yy = i * st[0] - pd[0] + r;
+                if (yy < 0 || yy >= h) continue;
+                for (int64_t s = 0; s < ks[1]; ++s) {
+                  int64_t xx = j * st[1] - pd[1] + s;
+                  if (xx < 0 || xx >= wd) continue;
+                  gplane[yy * wd + xx] += share;
+                }
+              }
+            }
+          }
+        }
+      }
+    }
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  // Box-Muller over the uniform_random seed discipline (seed 0 mixes
+  // the output name so same-shape params get distinct streams)
+  std::string RunGaussianRandom(const OpDesc& op, Scope* scope) {
+    const std::string* on = OneName(op, "Out", false);
+    if (on == nullptr) return "missing io";
+    HostTensor out = MakeF32(IntsAttr(op, "shape", {1}));
+    float mean = FloatAttr(op, "mean", 0.0f);
+    float stddev = FloatAttr(op, "std", 1.0f);
+    uint64_t seed = static_cast<uint64_t>(IntAttr(op, "seed", 0));
+    if (seed == 0) {
+      seed = std::hash<std::string>{}(*on) | 1;
+    }
+    XorShiftRng rng(seed);
+    float* oa = MutF32(&out);
+    int64_t n = NumElements(out.dims);
+    for (int64_t i = 0; i < n; i += 2) {
+      float u1 = rng.uniform();
+      float u2 = rng.uniform();
+      if (u1 < 1e-12f) u1 = 1e-12f;
+      float mag = std::sqrt(-2.0f * std::log(u1));
+      oa[i] = mean + stddev * mag * std::cos(6.28318530718f * u2);
+      if (i + 1 < n) {
+        oa[i + 1] = mean + stddev * mag * std::sin(6.28318530718f * u2);
+      }
+    }
     scope->Set(*on, std::move(out));
     return "";
   }

@@ -424,3 +424,99 @@ def test_native_interp_metric_heads(tmp_path):
     }
     _serve_parity(tmp_path, ["x", "label"], out, feed, main, exe)
 
+
+
+def test_demo_trainer_binary_trains_conv_book_model(tmp_path):
+    """VERDICT r4 Next #4: the C++ trainer runs the MNIST CONV book
+    model (reference test_recognize_digits.py conv variant) end to end
+    — conv2d/pool2d forwards AND backwards, gaussian_random startup
+    init, cross_entropy/softmax grads — loss falls, no Python in the
+    training process."""
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.models import mnist
+
+    binary = _demo_binary("ptpu_demo_trainer")
+    if binary is None:
+        pytest.skip("cmake/ninja unavailable to build the demo binary")
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss, _feeds, _outs = mnist.build()
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    (tmp_path / "main.ptpb").write_bytes(serialize_program(main))
+    (tmp_path / "startup.ptpb").write_bytes(serialize_program(startup))
+    res = subprocess.run(
+        [binary, str(tmp_path), loss.name, "25", "16", "conv"],
+        capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr + res.stdout
+    last_line = res.stdout.strip().splitlines()[-1]
+    first, last = float(last_line.split()[1]), float(last_line.split()[3])
+    assert last < 0.5 * first, res.stdout
+
+
+def test_conv_train_step_parity_cpp_vs_xla(tmp_path):
+    """Golden-pinned first step (VERDICT r4 Next #4): ONE training step
+    of the conv book model on a fixed feed, run by both engines from
+    identical deterministic parameters — loss and the updated conv
+    filter must agree. This pins every kernel in the C++ conv training
+    path (conv2d/pool2d fwd+bwd, softmax/xent grads, broadcast bias
+    grad, sgd) against the XLA lowering numerics."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.models import mnist
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss, _feeds, _outs = mnist.build()
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    rng = np.random.RandomState(77)
+    feed = {
+        "pixel": rng.rand(4, 1, 28, 28).astype("float32"),
+        "label": rng.randint(0, 10, (4, 1)).astype("int64"),
+    }
+    # engine 1: XLA executor over deterministic params
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        conv_w_xla = np.asarray(scope.get_value("conv2d_0.w_0"))
+
+    # engine 2: C++ interpreter on the same program bytes + params
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        conv_w_cpp = ns.get("conv2d_0.w_0")
+    finally:
+        lib.ptpu_program_destroy(prog)
+
+    np.testing.assert_allclose(
+        np.ravel(cpp_loss)[0], np.ravel(np.asarray(xla_loss))[0],
+        rtol=1e-4, atol=1e-5,
+        err_msg="first-step loss diverged between engines")
+    np.testing.assert_allclose(
+        conv_w_cpp, conv_w_xla, rtol=1e-3, atol=1e-5,
+        err_msg="updated conv filter diverged between engines")
