@@ -1,0 +1,73 @@
+// C++ serving main for the AOT GENERATION artifact.
+//
+// Reference parity: inference/api/api_impl.cc serving +
+// RecurrentGradientMachine's generation role (SURVEY.md §2.8), fused
+// the TPU way: transformer.save_compiled_generator compiles the ENTIRE
+// KV-cached greedy decode (encoder prepare + lax.scan over the cached
+// step) into one serialized XLA executable with the parameters baked
+// in. This main embeds CPython (the binding route this project uses
+// instead of pybind11) purely to deserialize and execute that
+// artifact — io.load_compiled_inference_model performs NO tracing, NO
+// program IR interpretation and reads NO parameter files; the artifact
+// IS the model. One process, one executable call, token ids out.
+//
+//   ptpu_aot_generator <artifact_dir> <src.npy> <src_len.npy> <out.npy>
+//
+// src.npy int32 [B, T], src_len.npy int32 [B, 1] -> out.npy int32
+// [B, T] generated token ids. PYTHONPATH must reach the repo root and
+// the Python env's site-packages (same contract as
+// ptpu_compiled_predictor).
+
+#include <Python.h>
+
+#include <cstdio>
+#include <string>
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    std::fprintf(stderr,
+                 "usage: %s <artifact_dir> <src.npy> <src_len.npy> "
+                 "<out.npy>\n",
+                 argv[0]);
+    return 2;
+  }
+  std::string model_dir = argv[1];
+  std::string src = argv[2];
+  std::string src_len = argv[3];
+  std::string output = argv[4];
+  // argv is spliced into generated Python source: strings must not
+  // break out of the r''' literals
+  for (const std::string* s : {&model_dir, &src, &src_len, &output}) {
+    if (s->find("'''") != std::string::npos ||
+        (!s->empty() && s->back() == '\\')) {
+      std::fprintf(stderr,
+                   "argument %s cannot contain ''' or end in a "
+                   "backslash\n",
+                   s->c_str());
+      return 2;
+    }
+  }
+
+  Py_Initialize();
+
+  std::string script;
+  script += "import jax\n";
+  script += "jax.config.update('jax_platforms', 'cpu')\n";
+  script += "import numpy as np\n";
+  script += "import paddle_tpu as fluid\n";
+  script += "model = fluid.io.load_compiled_inference_model(\n";
+  script += "    r'''" + model_dir + "''')\n";
+  script += "src = np.load(r'''" + src + "''')\n";
+  script += "src_len = np.load(r'''" + src_len + "''')\n";
+  script += "(tokens,) = model.run("
+            "{'src_word': src, 'src_len': src_len})\n";
+  script += "np.save(r'''" + output + "''', np.asarray(tokens))\n";
+  script += "print('ok aot tokens', np.asarray(tokens).shape)\n";
+
+  int rc = PyRun_SimpleString(script.c_str());
+  if (rc != 0) {
+    std::fprintf(stderr, "embedded aot generator failed\n");
+  }
+  if (Py_FinalizeEx() < 0 && rc == 0) rc = 1;
+  return rc == 0 ? 0 : 1;
+}

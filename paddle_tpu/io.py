@@ -322,6 +322,18 @@ def save_compiled_inference_model(
     if platforms is not None:
         kwargs["platforms"] = list(platforms)
     exported = jax.export.export(jax.jit(serve), **kwargs)(*specs)
+    _write_compiled_artifact(dirname, exported, feed_names,
+                             feed_shapes, target_names)
+    return target_names
+
+
+def _write_compiled_artifact(dirname, exported, feed_names, feed_shapes,
+                             fetch_names):
+    """The AOT artifact's on-disk format — one writer, shared by every
+    exporter (save_compiled_inference_model, the transformer's
+    save_compiled_generator), so the schema CompiledInferenceModel
+    loads can never drift per producer."""
+    import json
 
     os.makedirs(dirname, exist_ok=True)
     with open(os.path.join(dirname, "__compiled__.bin"), "wb") as f:
@@ -329,17 +341,16 @@ def save_compiled_inference_model(
     with open(os.path.join(dirname, "__compiled__.json"), "w") as f:
         json.dump(
             {
-                "feed_names": feed_names,
+                "feed_names": list(feed_names),
                 "feed_shapes": {
                     n: [list(feed_shapes[n][0]), str(feed_shapes[n][1])]
                     for n in feed_names
                 },
-                "fetch_names": target_names,
+                "fetch_names": list(fetch_names),
                 "platforms": list(exported.platforms),
             },
             f,
         )
-    return target_names
 
 
 class CompiledInferenceModel(object):

@@ -590,3 +590,131 @@ def cached_beam_generate(exe, prepare_prog, step_prog, reorder_prog,
             break
     return _pick_best_beam(trg, pre_scores, bs, K, max_length, eos_id,
                            len_penalty)
+
+
+def save_compiled_generator(dirname, batch_size, src_vocab_size,
+                            trg_vocab_size, max_length, n_layer, n_head,
+                            d_model, d_inner, scope=None, bos_id=1,
+                            eos_id=2, platforms=None):
+    """AOT artifact for GENERATION serving (the level users deploy):
+    the entire KV-cached greedy decode — encoder prepare plus a
+    lax.scan over the cached step, caches as loop carry — compiled into
+    ONE XLA executable with the trained parameters baked in as
+    constants. Written in io.save_compiled_inference_model's on-disk
+    format, so io.load_compiled_inference_model (and the C++
+    ptpu_aot_generator main) serve it with no program IR, no parameter
+    files, no per-token host round trip and no tracing at serve time.
+
+    Feeds: src_word int32 [B, max_length], src_len int32 [B, 1].
+    Fetch: generated_tokens int32 [B, max_length] — the exact token
+    stream cached_greedy_generate produces (pinned by
+    tests/test_aot_generation.py against the committed generation
+    golden). Reference anchor: inference/api/api_impl.cc serving +
+    RecurrentGradientMachine's generation role (SURVEY §2.8), fused
+    into one compiled program the TPU way.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.core.lowering import BlockLowerer, build_step_fn
+    from paddle_tpu.executor import Executor, global_scope
+    from paddle_tpu.io import _write_compiled_artifact
+
+    scope = scope or global_scope()
+    prepare, step, logits_name = build_cached_decoder(
+        batch_size, src_vocab_size, trg_vocab_size, max_length,
+        n_layer, n_head, d_model, d_inner)
+    B, T, D = int(batch_size), int(max_length), int(d_model)
+    # kernel lowering is platform-keyed (same invariant
+    # save_compiled_inference_model enforces): one artifact per platform
+    if platforms is not None and len(platforms) > 1:
+        raise ValueError(
+            "save_compiled_generator: kernel lowering is platform-keyed; "
+            "export one artifact per platform instead of %r"
+            % (platforms,))
+    platform = (list(platforms)[0] if platforms
+                else jax.default_backend())
+
+    gen_names = {"gen_src_mask"}
+    for i in range(n_layer):
+        for kind in ("kcross", "vcross", "kcache", "vcache"):
+            gen_names.add("gen_%s_%d" % (kind, i))
+    cache_names = {n for n in gen_names if "cache" in n}
+
+    scope_names = Executor._scope_names(scope)  # walks parent scopes
+    prep_lower = BlockLowerer(prepare, 0, is_test=True)
+    p_in, p_out = prep_lower.analyze(scope_names,
+                                     {"src_word", "src_len"})
+    prep_fn = build_step_fn(prepare, ["src_word", "src_len"], [], p_in,
+                            p_out, is_test=True, platform=platform)
+    # the step program reads the gen_* vars prepare wrote: analyze with
+    # them present, exactly as the scope looks after a prepare run
+    step_lower = BlockLowerer(step, 0, is_test=True)
+    s_in, s_out = step_lower.analyze(
+        scope_names | gen_names, {"cur_tok", "pe_row", "gen_pos"})
+    step_fn = build_step_fn(step, ["cur_tok", "pe_row", "gen_pos"],
+                            [logits_name], s_in, s_out, is_test=True,
+                            platform=platform)
+
+    params = {}
+    for n in sorted(set(p_in) | (set(s_in) - gen_names)):
+        val = scope.get_value(n)
+        if val is None:
+            raise RuntimeError(
+                "save_compiled_generator: parameter %r not in scope "
+                "(train or load params first)" % n)
+        params[n] = jnp.asarray(val)
+
+    pe_table = jnp.asarray(np.concatenate(
+        [position_encoding_row(t, D) for t in range(T)], axis=0))
+
+    def generate(src_word, src_len):
+        key = jax.random.PRNGKey(0)
+        prep_state, _ = prep_fn(
+            dict(params), {"src_word": src_word, "src_len": src_len},
+            key)
+        frozen = dict(params)
+        caches0 = {}
+        for n in gen_names:
+            (caches0 if n in cache_names else frozen)[n] = prep_state[n]
+        trg0 = jnp.full((B, T), eos_id, jnp.int32).at[:, 0].set(bos_id)
+        done0 = jnp.zeros((B,), jnp.bool_)
+
+        def body(carry, t):
+            caches, trg, done = carry
+            state = dict(frozen)
+            state.update(caches)
+            cur = jax.lax.dynamic_slice(trg, (0, t), (B, 1))
+            pe = jax.lax.dynamic_slice(pe_table, (t, 0), (1, D))
+            pe = jnp.broadcast_to(pe[None], (B, 1, D))
+            new_state, fetches = step_fn(
+                state,
+                {"cur_tok": cur, "pe_row": pe,
+                 "gen_pos": jnp.reshape(t, (1,))},
+                key)
+            nxt = jnp.argmax(fetches[0][:, 0, :], axis=-1)
+            nxt = nxt.astype(jnp.int32)
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            trg = jax.lax.dynamic_update_slice(trg, nxt[:, None],
+                                               (0, t + 1))
+            done = done | (nxt == eos_id)
+            caches = {n: new_state[n] for n in caches}
+            return (caches, trg, done), None
+
+        (_, trg, _), _ = jax.lax.scan(
+            body, (caches0, trg0, done0),
+            jnp.arange(T - 1, dtype=jnp.int32))
+        # tuple, not bare array: CompiledInferenceModel.run iterates
+        # the call result as the fetch list
+        return (trg,)
+
+    specs = (jax.ShapeDtypeStruct((B, T), jnp.int32),
+             jax.ShapeDtypeStruct((B, 1), jnp.int32))
+    kwargs = {"platforms": list(platforms)} if platforms else {}
+    exported = jax.export.export(jax.jit(generate), **kwargs)(*specs)
+    _write_compiled_artifact(
+        dirname, exported, ["src_word", "src_len"],
+        {"src_word": ((B, T), "int32"), "src_len": ((B, 1), "int32")},
+        ["generated_tokens"])
+    return logits_name

@@ -1,0 +1,99 @@
+"""AOT generation serving (VERDICT r4 Next #5): the whole KV-cached
+greedy decode compiled into ONE executable artifact
+(transformer.save_compiled_generator) must emit the SAME token ids the
+committed generation golden pins (tests/golden/transformer_greedy.npz)
+— from Python via load_compiled_inference_model, and from C++ via the
+ptpu_aot_generator main (no Python tracing at serve time)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GOLDEN = os.path.join(HERE, "golden", "transformer_greedy.npz")
+
+BS, SEQ, VOCAB = 2, 10, 50
+N_LAYER, N_HEAD, D_MODEL, D_INNER = 1, 2, 32, 64
+
+
+def _trained_scope_and_artifact(tmp_path):
+    """Same recipe as the generation golden: deterministic params on the
+    bs2/seq10/vocab50 model, then export the AOT generator."""
+    from paddle_tpu import unique_name
+    from paddle_tpu.models import transformer
+    from paddle_tpu.testing import set_deterministic_params
+
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        transformer.build(
+            src_vocab_size=VOCAB, trg_vocab_size=VOCAB, max_length=SEQ,
+            n_layer=N_LAYER, n_head=N_HEAD, d_model=D_MODEL,
+            d_inner=D_INNER, dropout=0.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    set_deterministic_params(main, fluid.global_scope())
+    path = str(tmp_path / "aot_gen")
+    transformer.save_compiled_generator(
+        path, batch_size=BS, src_vocab_size=VOCAB, trg_vocab_size=VOCAB,
+        max_length=SEQ, n_layer=N_LAYER, n_head=N_HEAD,
+        d_model=D_MODEL, d_inner=D_INNER, eos_id=0)
+    return path
+
+
+def _golden():
+    assert os.path.exists(GOLDEN), (
+        "missing committed generation golden %s" % GOLDEN)
+    g = np.load(GOLDEN)
+    return g["src"], g["src_len"], g["tokens"]
+
+
+def test_aot_generator_matches_generation_golden(tmp_path):
+    src, src_len, want = _golden()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        path = _trained_scope_and_artifact(tmp_path)
+    model = fluid.io.load_compiled_inference_model(path)
+    (tokens,) = model.run({"src_word": src, "src_len": src_len})
+    np.testing.assert_array_equal(
+        np.asarray(tokens), want.astype(np.int32),
+        err_msg="AOT generator token stream diverged from the "
+                "committed generation golden")
+
+
+def test_aot_generator_cpp_main_matches_golden(tmp_path):
+    """The C++ serving main: load the artifact, decode, dump tokens —
+    the pinned ids with no Python tracing in the serve path."""
+    sys.path.insert(0, os.path.dirname(HERE))
+    from test_cpp_predictor import _demo_binary
+
+    binary = _demo_binary("ptpu_aot_generator")
+    if binary is None:
+        pytest.skip("cmake/ninja or embeddable Python unavailable")
+    src, src_len, want = _golden()
+    with fluid.scope_guard(fluid.executor.Scope()):
+        path = _trained_scope_and_artifact(tmp_path)
+    np.save(str(tmp_path / "src.npy"), src.astype(np.int32))
+    np.save(str(tmp_path / "src_len.npy"), src_len.astype(np.int32))
+    outp = str(tmp_path / "tokens.npy")
+    import sysconfig
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(HERE), sysconfig.get_paths()["purelib"]]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    res = subprocess.run(
+        [binary, path, str(tmp_path / "src.npy"),
+         str(tmp_path / "src_len.npy"), outp],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "ok aot tokens" in res.stdout
+    got = np.load(outp)
+    np.testing.assert_array_equal(
+        got, want.astype(np.int32),
+        err_msg="C++ AOT generator diverged from the committed golden")
