@@ -39,10 +39,10 @@ int main(int argc, char** argv) {
   // break out of the r''' literals
   for (const std::string* s : {&model_dir, &src, &src_len, &output}) {
     if (s->find("'''") != std::string::npos ||
-        (!s->empty() && s->back() == '\\')) {
+        (!s->empty() && (s->back() == '\\' || s->back() == '\''))) {
       std::fprintf(stderr,
                    "argument %s cannot contain ''' or end in a "
-                   "backslash\n",
+                   "backslash or quote\n",
                    s->c_str());
       return 2;
     }

@@ -41,11 +41,11 @@ int main(int argc, char** argv) {
   // actual integer and strings must not break out of the r''' literals
   long fetch_index = argc > 5 ? std::strtol(argv[5], nullptr, 10) : 0;
   for (const std::string* s : {&model_dir, &input, &output, &feed}) {
-    if (s->find("'''") != std::string::npos || !s->empty() &&
-        s->back() == '\\') {
+    if (s->find("'''") != std::string::npos ||
+        (!s->empty() && (s->back() == '\\' || s->back() == '\''))) {
       std::fprintf(stderr,
                    "argument %s cannot contain ''' or end in a "
-                   "backslash\n", s->c_str());
+                   "backslash or quote\n", s->c_str());
       return 2;
     }
   }

@@ -153,7 +153,8 @@ class Interpreter {
     if (op.type == "pool2d") return RunPool2d(op, scope);
     if (op.type == "batch_norm") return RunBatchNorm(op, scope);
     if (op.type == "softmax_with_cross_entropy") return RunSCE(op, scope);
-    if (op.type == "reshape" || op.type == "flatten") {
+    if (op.type == "reshape" || op.type == "flatten" ||
+        op.type == "squeeze" || op.type == "unsqueeze") {
       return RunReshape(op, scope);
     }
     if (op.type == "mean") return RunMean(op, scope);
@@ -204,6 +205,7 @@ class Interpreter {
     }
     if (op.type == "pool2d_grad") return RunPool2dGrad(op, scope);
     if (op.type == "gaussian_random") return RunGaussianRandom(op, scope);
+    if (op.type == "moe_ffn") return RunMoeFFN(op, scope);
     if (op.type == "softmax_with_cross_entropy_grad") {
       return RunSCEGrad(op, scope);
     }
@@ -777,6 +779,47 @@ class Interpreter {
         (static_cast<int64_t>(d) < ax ? rows : cols) *= x->dims[d];
       }
       shape = {rows, cols};
+    } else if (op.type == "unsqueeze") {
+      // insert size-1 dims at the (normalized) target axes, like
+      // jnp.expand_dims(ops/tensor_ops.py)
+      auto axes = IntsAttr(op, "axes", {});
+      int64_t out_rank =
+          static_cast<int64_t>(x->dims.size() + axes.size());
+      std::vector<int64_t> norm;
+      for (int64_t a : axes) {
+        norm.push_back(a < 0 ? a + out_rank : a);
+      }
+      shape.assign(out_rank, 0);
+      for (int64_t a : norm) {
+        if (a < 0 || a >= out_rank) return "axis out of range";
+        if (shape[a] != 0) return "duplicate axes";
+        shape[a] = 1;
+      }
+      size_t src = 0;
+      for (int64_t i = 0; i < out_rank; ++i) {
+        if (shape[i] == 0) shape[i] = x->dims[src++];
+      }
+      if (src != x->dims.size()) return "axes/rank mismatch";
+    } else if (op.type == "squeeze") {
+      auto axes = IntsAttr(op, "axes", {});
+      int64_t rank = static_cast<int64_t>(x->dims.size());
+      std::vector<uint8_t> drop(x->dims.size(), 0);
+      if (axes.empty()) {
+        for (size_t d = 0; d < x->dims.size(); ++d) {
+          drop[d] = x->dims[d] == 1;
+        }
+      } else {
+        for (int64_t a : axes) {
+          a = a < 0 ? a + rank : a;
+          if (a < 0 || a >= rank) return "axis out of range";
+          // only size-1 axes squeeze (ops/tensor_ops.py _squeeze)
+          if (x->dims[a] == 1) drop[a] = 1;
+        }
+      }
+      for (size_t d = 0; d < x->dims.size(); ++d) {
+        if (!drop[d]) shape.push_back(x->dims[d]);
+      }
+      if (shape.empty()) shape.push_back(1);
     } else {
       shape = IntsAttr(op, "shape", {});
       int64_t known = 1, infer = -1;
@@ -2580,6 +2623,221 @@ class Interpreter {
       }
     }
     scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  // Switch-style MoE FFN (ops/moe_ops.py _lower_moe_ffn): softmax
+  // router, top-k routing with per-expert capacity queues advanced in
+  // token order (over-capacity routes dropped but still advancing the
+  // queue, exactly like the XLA einsum formulation), GShard gate
+  // renormalization by the SELECTED raw gates, expert FFNs, and the
+  // Switch load-balancing aux loss over pre-drop top-1 assignments.
+  std::string RunMoeFFN(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* gwn = OneName(op, "GateW");
+    const std::string* w1n = OneName(op, "ExpertW1");
+    const std::string* b1n = OneName(op, "ExpertB1");
+    const std::string* w2n = OneName(op, "ExpertW2");
+    const std::string* b2n = OneName(op, "ExpertB2");
+    const std::string* on = OneName(op, "Out", false);
+    const std::string* auxn = OneName(op, "AuxLoss", false);
+    if (xn == nullptr || gwn == nullptr || w1n == nullptr ||
+        b1n == nullptr || w2n == nullptr || b2n == nullptr ||
+        on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* gw = scope->Find(*gwn);
+    const HostTensor* w1 = scope->Find(*w1n);
+    const HostTensor* b1 = scope->Find(*b1n);
+    const HostTensor* w2 = scope->Find(*w2n);
+    const HostTensor* b2 = scope->Find(*b2n);
+    if (x == nullptr || gw == nullptr || w1 == nullptr ||
+        b1 == nullptr || w2 == nullptr || b2 == nullptr) {
+      return "input not in scope";
+    }
+    for (const HostTensor* t : {x, gw, w1, b1, w2, b2}) {
+      if (!IsF32(*t)) return "non-f32 dtype";
+    }
+    if (gw->dims.size() != 2 || w1->dims.size() != 3 ||
+        w2->dims.size() != 3 || x->dims.empty()) {
+      return "bad ranks";
+    }
+    int64_t d = x->dims.back();
+    int64_t n = NumElements(x->dims) / (d == 0 ? 1 : d);
+    int64_t e = gw->dims[1];
+    int64_t hdim = w1->dims[2];
+    if (gw->dims[0] != d || w1->dims[0] != e || w1->dims[1] != d ||
+        w2->dims[0] != e || w2->dims[1] != hdim || w2->dims[2] != d ||
+        NumElements(b1->dims) != e * hdim ||
+        NumElements(b2->dims) != e * d) {
+      return "weight shape mismatch";
+    }
+    int64_t top_k = IntAttr(op, "top_k", 1);
+    float cap_factor = FloatAttr(op, "capacity_factor", 1.25f);
+    std::string act = StrAttr(op, "act", "gelu");
+    if (act != "gelu" && act != "relu" && act != "sigmoid" &&
+        act != "tanh" && act != "identity") {
+      return "unsupported activation";
+    }
+    if (top_k < 1) return "bad top_k";
+    // double arithmetic to truncate on the same integer as the Python
+    // reference's int(cap_factor * n * top_k / e) — f32 rounding can
+    // land a fractional boundary on a different side
+    int64_t capacity = std::max<int64_t>(
+        1, static_cast<int64_t>(
+               static_cast<double>(cap_factor) * static_cast<double>(n) *
+               static_cast<double>(top_k) / static_cast<double>(e)));
+
+    // optional [B, T] token validity
+    std::vector<float> valid(n, 1.0f);
+    bool has_mask = false;
+    const std::string* mn = OneName(op, "Mask", false);
+    if (mn != nullptr) {
+      const HostTensor* m = scope->Find(*mn);
+      if (m == nullptr) return "mask not in scope";
+      if (!IsF32(*m) || NumElements(m->dims) != n) return "bad mask";
+      const float* ma = F32(*m);
+      for (int64_t i = 0; i < n; ++i) valid[i] = ma[i] > 0 ? 1.f : 0.f;
+      has_mask = true;
+    }
+
+    const float* xa = F32(*x);
+    const float* ga = F32(*gw);
+    // router probs [N, E]
+    std::vector<float> probs(n * e);
+    for (int64_t i = 0; i < n; ++i) {
+      float mx = -INFINITY;
+      for (int64_t j = 0; j < e; ++j) {
+        float acc = 0.0f;
+        for (int64_t t = 0; t < d; ++t) acc += xa[i * d + t] * ga[t * e + j];
+        probs[i * e + j] = acc;
+        mx = std::max(mx, acc);
+      }
+      float denom = 0.0f;
+      for (int64_t j = 0; j < e; ++j) {
+        probs[i * e + j] = std::exp(probs[i * e + j] - mx);
+        denom += probs[i * e + j];
+      }
+      for (int64_t j = 0; j < e; ++j) {
+        probs[i * e + j] = probs[i * e + j] / denom * valid[i];
+      }
+    }
+
+    // top-k routing with capacity queues in token order
+    std::vector<float> kept_gate(n * top_k, 0.0f);
+    std::vector<float> raw_gate(n * top_k, 0.0f);
+    std::vector<int64_t> route(n * top_k, -1);
+    std::vector<uint8_t> used(n * e, 0);
+    std::vector<int64_t> queue(e, 0);
+    for (int64_t r = 0; r < top_k; ++r) {
+      for (int64_t i = 0; i < n; ++i) {
+        int64_t best = 0;
+        float bv = -INFINITY;
+        for (int64_t j = 0; j < e; ++j) {
+          float v = used[i * e + j] ? 0.0f : probs[i * e + j];
+          if (v > bv) {
+            bv = v;
+            best = j;
+          }
+        }
+        used[i * e + best] = 1;
+        raw_gate[i * top_k + r] = bv;
+        if (valid[i] <= 0.0f) continue;  // no queue slot, no output
+        int64_t pos = queue[best]++;
+        if (pos < capacity) {
+          route[i * top_k + r] = best;
+          kept_gate[i * top_k + r] = bv;
+        }
+      }
+    }
+    if (top_k > 1) {
+      for (int64_t i = 0; i < n; ++i) {
+        float total = 1e-9f;
+        for (int64_t r = 0; r < top_k; ++r) {
+          total += raw_gate[i * top_k + r];
+        }
+        for (int64_t r = 0; r < top_k; ++r) {
+          kept_gate[i * top_k + r] /= total;
+        }
+      }
+    }
+
+    const float* w1a = F32(*w1);
+    const float* b1a = F32(*b1);
+    const float* w2a = F32(*w2);
+    const float* b2a = F32(*b2);
+    HostTensor out = MakeF32(x->dims);
+    float* oa = MutF32(&out);
+    std::fill(oa, oa + n * d, 0.0f);
+    std::vector<float> h(hdim);
+    for (int64_t i = 0; i < n; ++i) {
+      for (int64_t r = 0; r < top_k; ++r) {
+        int64_t ex = route[i * top_k + r];
+        float g = kept_gate[i * top_k + r];
+        if (ex < 0 || g == 0.0f) continue;
+        const float* ew1 = w1a + ex * d * hdim;
+        const float* eb1 = b1a + ex * hdim;
+        const float* ew2 = w2a + ex * hdim * d;
+        const float* eb2 = b2a + ex * d;
+        for (int64_t j = 0; j < hdim; ++j) {
+          float acc = eb1[j];
+          for (int64_t t = 0; t < d; ++t) {
+            acc += xa[i * d + t] * ew1[t * hdim + j];
+          }
+          if (act == "relu") {
+            acc = std::max(acc, 0.0f);
+          } else if (act == "sigmoid") {
+            acc = 1.0f / (1.0f + std::exp(-acc));
+          } else if (act == "tanh") {
+            acc = std::tanh(acc);
+          } else if (act == "gelu") {
+            // jax.nn.gelu default (approximate=True, tanh form)
+            float c = 0.7978845608028654f;  // sqrt(2/pi)
+            acc = 0.5f * acc *
+                  (1.0f + std::tanh(c * (acc + 0.044715f * acc * acc * acc)));
+          }
+          h[j] = acc;
+        }
+        for (int64_t t = 0; t < d; ++t) {
+          float acc = eb2[t];
+          for (int64_t j = 0; j < hdim; ++j) {
+            acc += h[j] * ew2[j * d + t];
+          }
+          oa[i * d + t] += g * acc;
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+
+    if (auxn != nullptr) {
+      // E * sum_e f_e * P_e over pre-drop top-1 assignments
+      std::vector<double> f(e, 0.0), p(e, 0.0);
+      double denom = 0.0;
+      for (int64_t i = 0; i < n; ++i) {
+        denom += valid[i];
+        if (has_mask && valid[i] <= 0.0f) continue;
+        int64_t best = 0;
+        float bv = -INFINITY;
+        for (int64_t j = 0; j < e; ++j) {
+          if (probs[i * e + j] > bv) {
+            bv = probs[i * e + j];
+            best = j;
+          }
+        }
+        f[best] += 1.0;
+        for (int64_t j = 0; j < e; ++j) p[j] += probs[i * e + j];
+      }
+      if (!has_mask) denom = static_cast<double>(n);
+      denom = std::max(denom, 1.0);
+      double aux = 0.0;
+      for (int64_t j = 0; j < e; ++j) {
+        aux += (f[j] / denom) * (p[j] / denom);
+      }
+      HostTensor at = MakeF32({1});
+      MutF32(&at)[0] = static_cast<float>(aux * static_cast<double>(e));
+      scope->Set(*auxn, std::move(at));
+    }
     return "";
   }
 

@@ -69,7 +69,7 @@ def test_aot_generator_matches_generation_golden(tmp_path):
 def test_aot_generator_cpp_main_matches_golden(tmp_path):
     """The C++ serving main: load the artifact, decode, dump tokens —
     the pinned ids with no Python tracing in the serve path."""
-    sys.path.insert(0, os.path.dirname(HERE))
+    sys.path.insert(0, HERE)  # tests/ dir, where test_cpp_predictor lives
     from test_cpp_predictor import _demo_binary
 
     binary = _demo_binary("ptpu_aot_generator")
