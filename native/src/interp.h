@@ -206,6 +206,7 @@ class Interpreter {
     if (op.type == "pool2d_grad") return RunPool2dGrad(op, scope);
     if (op.type == "gaussian_random") return RunGaussianRandom(op, scope);
     if (op.type == "moe_ffn") return RunMoeFFN(op, scope);
+    if (op.type == "expand") return RunExpand(op, scope);
     if (op.type == "softmax_with_cross_entropy_grad") {
       return RunSCEGrad(op, scope);
     }
@@ -2626,6 +2627,55 @@ class Interpreter {
     return "";
   }
 
+  // np.tile semantics (ops/tensor_ops.py expand): repeat each dim by
+  // expand_times; a times vector longer than the input rank prepends
+  // broadcast dims (numpy tile rule)
+  std::string RunExpand(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x)) return "non-f32 dtype";
+    auto times = IntsAttr(op, "expand_times", {});
+    if (times.empty()) return "empty expand_times";
+    for (int64_t t : times) {
+      if (t <= 0) return "bad expand_times";
+    }
+    std::vector<int64_t> in_dims = x->dims;
+    while (in_dims.size() < times.size()) {
+      in_dims.insert(in_dims.begin(), 1);
+    }
+    while (times.size() < in_dims.size()) {
+      times.insert(times.begin(), 1);
+    }
+    size_t rank = in_dims.size();
+    std::vector<int64_t> out_dims(rank);
+    for (size_t d = 0; d < rank; ++d) out_dims[d] = in_dims[d] * times[d];
+    HostTensor out = MakeF32(out_dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    int64_t total = NumElements(out_dims);
+    std::vector<int64_t> in_strides(rank, 1);
+    for (size_t d = rank - 1; d > 0; --d) {
+      in_strides[d - 1] = in_strides[d] * in_dims[d];
+    }
+    std::vector<int64_t> idx(rank, 0);
+    for (int64_t i = 0; i < total; ++i) {
+      int64_t src = 0;
+      for (size_t d = 0; d < rank; ++d) {
+        src += (idx[d] % in_dims[d]) * in_strides[d];
+      }
+      oa[i] = xa[src];
+      for (size_t d = rank; d-- > 0;) {
+        if (++idx[d] < out_dims[d]) break;
+        idx[d] = 0;
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
   // Switch-style MoE FFN (ops/moe_ops.py _lower_moe_ffn): softmax
   // router, top-k routing with per-expert capacity queues advanced in
   // token order (over-capacity routes dropped but still advancing the
@@ -2692,7 +2742,9 @@ class Interpreter {
     // optional [B, T] token validity
     std::vector<float> valid(n, 1.0f);
     bool has_mask = false;
-    const std::string* mn = OneName(op, "Mask", false);
+    // NB: OneName's third arg selects inputs-vs-outputs, NOT
+    // optionality — Mask is an (optional) INPUT
+    const std::string* mn = OneName(op, "Mask");
     if (mn != nullptr) {
       const HostTensor* m = scope->Find(*mn);
       if (m == nullptr) return "mask not in scope";

@@ -368,6 +368,35 @@ def case_cast_chain(rng):
     return v, {"x": _feedval(rng, shape)}
 
 
+def case_moe_ffn(rng):
+    """The interpreter's newest and most intricate kernel (r5): Switch
+    routing with capacity queues — fuzz expert count, top_k, fractional
+    capacity factors (the f32-vs-double truncation corner), activation,
+    and the optional token mask."""
+    b, t = 2, int(rng.randint(2, 5))
+    d = int(rng.choice([4, 8]))
+    experts = int(rng.choice([2, 3, 4]))
+    top_k = int(rng.randint(1, min(3, experts) + 1))
+    cap = float(rng.choice([0.7, 1.0, 1.25, 2.0]))
+    act = str(rng.choice(["gelu", "relu", "tanh", "sigmoid"]))
+    x = _data("x", (b, t, d))
+    feed = {"x": _feedval(rng, (b, t, d))}
+    kwargs = {}
+    if rng.rand() < 0.4:
+        mask = _data("mask", (b, t))
+        kwargs["mask"] = mask
+        feed["mask"] = rng.randint(0, 2, (b, t)).astype("float32")
+    y, aux = fluid.layers.moe_ffn(
+        x, num_experts=experts, d_hidden=int(rng.choice([4, 8])),
+        top_k=top_k, capacity_factor=cap, act=act, **kwargs)
+    out = fluid.layers.elementwise_add(
+        fluid.layers.reduce_mean(y, dim=[2]),
+        fluid.layers.expand(
+            fluid.layers.reshape(aux, shape=[1, 1]),
+            expand_times=[b, t]))
+    return out, feed
+
+
 def case_sequence_mask(rng):
     bs = int(rng.randint(1, 4))
     maxlen = int(rng.randint(2, 7))
@@ -381,6 +410,7 @@ CASES = [
     case_conv_transpose, case_pool, case_norm, case_reduce,
     case_shape_ops, case_embedding, case_xent, case_topk, case_sdpa,
     case_gru, case_lstm, case_cast_chain, case_sequence_mask,
+    case_moe_ffn,
 ]
 
 
