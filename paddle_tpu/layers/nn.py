@@ -683,9 +683,11 @@ def transpose(x, perm, name=None):
 
 
 def split(input, num_or_sections, dim=-1, name=None):
+    from paddle_tpu.ops.common import normalize_axis
+
     helper = LayerHelper("split", name=name)
     ndim = len(input.shape)
-    dim = dim % ndim
+    dim = normalize_axis(dim, ndim, "split dim")
     if isinstance(num_or_sections, int):
         num = num_or_sections
         sections = []

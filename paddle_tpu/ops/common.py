@@ -25,12 +25,23 @@ def to_dtype(x, dtype):
     return jnp.asarray(x, device_dtype(dtype))
 
 
+def normalize_axis(a, ndim, what="axis"):
+    """Python-style negative wrapping ONLY: a plain modulo silently
+    redirects out-of-range axes to a DIFFERENT axis (found by the
+    cross-engine fuzz: the C++ interpreter refused an out-of-range
+    reduce dim while the XLA lowering reduced axis dim%ndim)."""
+    if not -ndim <= a < ndim:
+        raise ValueError(
+            "%s %d out of range for rank-%d input" % (what, a, ndim))
+    return a % ndim
+
+
 def reduce_axes(ndim, dim, reduce_all):
     if reduce_all or dim is None:
         return tuple(range(ndim))
     if isinstance(dim, int):
         dim = [dim]
-    return tuple(d % ndim for d in dim)
+    return tuple(normalize_axis(d, ndim, "reduce dim") for d in dim)
 
 
 def flatten_to_2d(x, num_col_dims):

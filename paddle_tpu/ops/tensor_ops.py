@@ -196,9 +196,12 @@ register_op(
 
 
 def _squeeze(x, axes):
+    from paddle_tpu.ops.common import normalize_axis
+
     if not axes:
         return jnp.squeeze(x)
-    axes = tuple(a % jnp.ndim(x) for a in axes)
+    axes = tuple(
+        normalize_axis(a, jnp.ndim(x), "squeeze axis") for a in axes)
     axes = tuple(a for a in axes if jnp.shape(x)[a] == 1)
     return jnp.squeeze(x, axis=axes)
 

@@ -279,7 +279,11 @@ def case_embedding(rng):
     emb = fluid.layers.embedding(
         ids, size=[vocab, dim],
         padding_idx=None if padding_idx is None else int(padding_idx))
-    v = fluid.layers.reduce_sum(emb, dim=[2])
+    # lookup_table squeezes a trailing singleton id dim: seq==1 yields a
+    # rank-2 emb, so reduce over the LAST axis, not a hardcoded one
+    # (the hardcoded dim=[2] variant exposed a real lowering bug: see
+    # reduce_axes' out-of-range validation)
+    v = fluid.layers.reduce_sum(emb, dim=[-1])
     feed_ids = rng.randint(0, vocab, (bs, seq)).astype("int64")
     return v, {"ids": feed_ids}
 

@@ -486,3 +486,19 @@ def test_dynamic_update_slice_output_and_grad():
     _shapes("dynamic_update_slice", {"X": x, "Update": u, "Index": idx},
             {"Out": (5, 3)}, {"axis": 0}).check_grad(
         ["X", "Update"], "Out")
+
+
+def test_reduce_dim_out_of_range_errors():
+    """Cross-engine fuzz finding (r5): an out-of-range reduce dim was
+    silently wrapped modulo rank onto a DIFFERENT axis by the XLA
+    lowering while the C++ interpreter refused. Both engines must now
+    reject it; negative python-style dims stay legal."""
+    x = _RNG(41).randn(2, 3).astype("float32")
+    t = _shapes("reduce_sum", {"X": x}, {"Out": (3,)}, {"dim": [2]})
+    main = t._build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(Exception, match="out of range"):
+        exe.run(main, feed=t._feed, fetch_list=[])
+    # negative dim still works
+    t2 = _shapes("reduce_sum", {"X": x}, {"Out": (2,)}, {"dim": [-1]})
+    t2.check_grad(["X"], "Out")
