@@ -38,6 +38,24 @@ namespace ptpu {
 
 namespace interp {
 
+// One pooled output dim. ceil_mode uses python-style ceil division
+// (valid for negative numerators too, matching the XLA lowering's
+// -(-num // s) + 1) plus the Caffe/reference clamp: the last window
+// must START inside input+low-pad, so no window lies entirely in
+// high-side padding (which would read as -inf/0-count).
+inline int64_t PoolOutDim(int64_t size, int64_t k, int64_t s, int64_t p,
+                          bool ceil_mode) {
+  int64_t num = size + 2 * p - k;
+  if (!ceil_mode) {
+    return num < 0 ? 0 : num / s + 1;
+  }
+  int64_t q = -num;  // ceil(num/s) = -floor(-num/s)
+  int64_t fd = q >= 0 ? q / s : -((-q + s - 1) / s);
+  int64_t out = -fd + 1;
+  if ((out - 1) * s >= size + p) --out;
+  return out;
+}
+
 inline int64_t NumElements(const std::vector<int64_t>& dims) {
   int64_t n = 1;
   for (int64_t d : dims) n *= d;
@@ -604,7 +622,7 @@ class Interpreter {
     std::string ptype = StrAttr(op, "pooling_type", "max");
     bool global = IntAttr(op, "global_pooling", 0) != 0;
     bool exclusive = IntAttr(op, "exclusive", 1) != 0;
-    if (IntAttr(op, "ceil_mode", 0) != 0) return "ceil_mode unsupported";
+    bool ceil = IntAttr(op, "ceil_mode", 0) != 0;
     if (IntAttr(op, "adaptive", 0) != 0) return "adaptive unsupported";
     auto ks = IntsAttr(op, "ksize", {2, 2});
     auto st = IntsAttr(op, "strides", {1, 1});
@@ -617,9 +635,10 @@ class Interpreter {
       ks = {h, wd};
       st = {h, wd};
       pd = {0, 0};
+      ceil = false;
     }
-    int64_t oh = (h + 2 * pd[0] - ks[0]) / st[0] + 1;
-    int64_t ow = (wd + 2 * pd[1] - ks[1]) / st[1] + 1;
+    int64_t oh = PoolOutDim(h, ks[0], st[0], pd[0], ceil);
+    int64_t ow = PoolOutDim(wd, ks[1], st[1], pd[1], ceil);
     if (oh <= 0 || ow <= 0) return "empty output";
     HostTensor out = MakeF32({n, c, oh, ow});
     const float* xa = F32(*x);
@@ -2546,7 +2565,7 @@ class Interpreter {
     std::string ptype = StrAttr(op, "pooling_type", "max");
     bool global = IntAttr(op, "global_pooling", 0) != 0;
     bool exclusive = IntAttr(op, "exclusive", 1) != 0;
-    if (IntAttr(op, "ceil_mode", 0) != 0) return "ceil_mode unsupported";
+    bool ceil = IntAttr(op, "ceil_mode", 0) != 0;
     if (IntAttr(op, "adaptive", 0) != 0) return "adaptive unsupported";
     auto ks = IntsAttr(op, "ksize", {2, 2});
     auto st = IntsAttr(op, "strides", {1, 1});
@@ -2560,9 +2579,10 @@ class Interpreter {
       ks = {h, wd};
       st = {h, wd};
       pd = {0, 0};
+      ceil = false;
     }
-    int64_t oh = (h + 2 * pd[0] - ks[0]) / st[0] + 1;
-    int64_t ow = (wd + 2 * pd[1] - ks[1]) / st[1] + 1;
+    int64_t oh = PoolOutDim(h, ks[0], st[0], pd[0], ceil);
+    int64_t ow = PoolOutDim(wd, ks[1], st[1], pd[1], ceil);
     if (og->dims != std::vector<int64_t>({n, c, oh, ow})) {
       return "dOut shape";
     }

@@ -246,6 +246,13 @@ def _pool_geometry(x, attrs, nd):
             size = int(jnp.shape(x)[2 + i])
             k, s, p = ksize[i], strides[i], paddings[i]
             out_ceil = -(-(size + 2 * p - k) // s) + 1
+            # Caffe/reference rule: the last window must START inside
+            # input+low-pad; without this clamp a window lying entirely
+            # in high-side padding poisons max pooling with the -inf
+            # init (and exclusive-avg with 0/0). The C++ interpreter's
+            # PoolOutDim mirrors this exactly.
+            if (out_ceil - 1) * s >= size + p:
+                out_ceil -= 1
             needed = (out_ceil - 1) * s + k - (size + 2 * p)
             pads.append((p, p + max(0, int(needed))))
     else:

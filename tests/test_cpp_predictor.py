@@ -520,3 +520,71 @@ def test_conv_train_step_parity_cpp_vs_xla(tmp_path):
     np.testing.assert_allclose(
         conv_w_cpp, conv_w_xla, rtol=1e-3, atol=1e-5,
         err_msg="updated conv filter diverged between engines")
+
+
+def test_pool_ceil_mode_train_step_parity_cpp_vs_xla(tmp_path):
+    """ceil_mode pooling was a C++ refusal until r5; now both engines
+    implement it, INCLUDING the backward (the fuzz covers the forward;
+    this pins pool2d_grad's ceil geometry): one SGD step of a tiny
+    conv+ceil-pool net, loss and updated filter must match."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2, 7, 7], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        v = fluid.layers.conv2d(x, num_filters=3, filter_size=3,
+                                padding=1, act="relu")
+        v = fluid.layers.pool2d(v, pool_size=2, pool_stride=2,
+                                pool_type="max", ceil_mode=True)
+        v = fluid.layers.pool2d(v, pool_size=3, pool_stride=2,
+                                pool_type="avg", ceil_mode=True,
+                                pool_padding=1)
+        logits = fluid.layers.fc(v, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    rng = np.random.RandomState(5)
+    feed = {"x": rng.rand(3, 2, 7, 7).astype("float32"),
+            "label": rng.randint(0, 4, (3, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        w_xla = np.asarray(scope.get_value("conv2d_0.w_0"))
+
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        w_cpp = ns.get("conv2d_0.w_0")
+    finally:
+        lib.ptpu_program_destroy(prog)
+    np.testing.assert_allclose(np.ravel(cpp_loss)[0],
+                               np.ravel(np.asarray(xla_loss))[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(w_cpp, w_xla, rtol=1e-3, atol=1e-5)

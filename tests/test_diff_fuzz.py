@@ -193,7 +193,8 @@ def case_pool(rng):
         pool_type=str(rng.choice(["max", "avg"])),
         pool_stride=int(rng.choice([1, 2])),
         pool_padding=int(rng.choice([0, 1])),
-        ceil_mode=bool(rng.rand() < 0.3),   # corner: C++ must refuse
+        ceil_mode=bool(rng.rand() < 0.3),   # corner attr (r5: now a
+        # PARITY corner — both engines implement ceil_mode)
         global_pooling=bool(rng.rand() < 0.2),
     )
     return v, {"x": _feedval(rng, (2, c, hw, hw))}
