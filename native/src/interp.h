@@ -225,6 +225,7 @@ class Interpreter {
     if (op.type == "gaussian_random") return RunGaussianRandom(op, scope);
     if (op.type == "moe_ffn") return RunMoeFFN(op, scope);
     if (op.type == "expand") return RunExpand(op, scope);
+    if (IsUnaryType(op.type)) return RunUnary(op, scope);
     if (op.type == "softmax_with_cross_entropy_grad") {
       return RunSCEGrad(op, scope);
     }
@@ -2644,6 +2645,125 @@ class Interpreter {
       }
     }
     scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  // Elementwise unary family (ops/activation_ops.py + math unaries):
+  // every op maps 1:1 onto a scalar function of (x, attrs). Semantics
+  // mirror the XLA lowerings exactly — incl. jnp.round's half-to-even
+  // (std::nearbyint under the default rounding mode), jax.nn.softplus's
+  // stable form, and jax.nn.gelu's default tanh approximation.
+  static bool IsUnaryType(const std::string& t) {
+    static const std::map<std::string, int>& tbl = UnaryTable();
+    return tbl.count(t) != 0;
+  }
+
+  static const std::map<std::string, int>& UnaryTable() {
+    static const std::map<std::string, int> tbl = {
+        {"exp", 0},          {"log", 1},           {"sqrt", 2},
+        {"rsqrt", 3},        {"abs", 4},           {"square", 5},
+        {"reciprocal", 6},   {"floor", 7},         {"ceil", 8},
+        {"round", 9},        {"sign", 10},         {"softplus", 11},
+        {"softsign", 12},    {"tanh_shrink", 13},  {"logsigmoid", 14},
+        {"gelu", 15},        {"sin", 16},          {"cos", 17},
+        {"leaky_relu", 18},  {"elu", 19},          {"relu6", 20},
+        {"pow", 21},         {"stanh", 22},        {"hard_sigmoid", 23},
+        {"thresholded_relu", 24},                  {"soft_relu", 25},
+        {"brelu", 26},       {"swish", 27},        {"softshrink", 28},
+        {"hard_shrink", 29},
+    };
+    return tbl;
+  }
+
+  std::string RunUnary(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x)) return "non-f32 dtype";
+    int kind = UnaryTable().at(op.type);
+    float a0 = 0.0f, a1 = 0.0f;
+    switch (kind) {
+      case 18: a0 = FloatAttr(op, "alpha", 0.02f); break;
+      case 19: a0 = FloatAttr(op, "alpha", 1.0f); break;
+      case 20: a0 = FloatAttr(op, "threshold", 6.0f); break;
+      case 21: a0 = FloatAttr(op, "factor", 1.0f); break;
+      case 22:
+        a0 = FloatAttr(op, "scale_a", 2.0f / 3.0f);
+        a1 = FloatAttr(op, "scale_b", 1.7159f);
+        break;
+      case 23:
+        a0 = FloatAttr(op, "slope", 0.2f);
+        a1 = FloatAttr(op, "offset", 0.5f);
+        break;
+      case 24: a0 = FloatAttr(op, "threshold", 1.0f); break;
+      case 25: a0 = FloatAttr(op, "threshold", 40.0f); break;
+      case 26:
+        a0 = FloatAttr(op, "t_min", 0.0f);
+        a1 = FloatAttr(op, "t_max", 24.0f);
+        break;
+      case 27: a0 = FloatAttr(op, "beta", 1.0f); break;
+      case 28: a0 = FloatAttr(op, "lambda", 0.5f); break;
+      case 29: a0 = FloatAttr(op, "threshold", 0.5f); break;
+      default: break;
+    }
+    auto softplus = [](float v) {
+      // jax.nn.softplus's stable form
+      return v > 0.0f ? v + std::log1p(std::exp(-v))
+                      : std::log1p(std::exp(v));
+    };
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    int64_t n = NumElements(x->dims);
+    for (int64_t i = 0; i < n; ++i) {
+      float v = xa[i], r;
+      switch (kind) {
+        case 0: r = std::exp(v); break;
+        case 1: r = std::log(v); break;
+        case 2: r = std::sqrt(v); break;
+        case 3: r = 1.0f / std::sqrt(v); break;
+        case 4: r = std::fabs(v); break;
+        case 5: r = v * v; break;
+        case 6: r = 1.0f / v; break;
+        case 7: r = std::floor(v); break;
+        case 8: r = std::ceil(v); break;
+        case 9: r = static_cast<float>(std::nearbyint(v)); break;
+        case 10: r = v > 0 ? 1.0f : (v < 0 ? -1.0f : 0.0f); break;
+        case 11: r = softplus(v); break;
+        case 12: r = v / (1.0f + std::fabs(v)); break;
+        case 13: r = v - std::tanh(v); break;
+        case 14: r = -softplus(-v); break;
+        case 15: {
+          float c = 0.7978845608028654f;  // sqrt(2/pi), tanh-approx gelu
+          r = 0.5f * v * (1.0f + std::tanh(c * (v + 0.044715f * v * v * v)));
+          break;
+        }
+        case 16: r = std::sin(v); break;
+        case 17: r = std::cos(v); break;
+        case 18: r = v >= 0 ? v : a0 * v; break;
+        case 19: r = v > 0 ? v : a0 * (std::exp(v) - 1.0f); break;
+        case 20: r = std::min(std::max(v, 0.0f), a0); break;
+        case 21: r = std::pow(v, a0); break;
+        case 22: r = a1 * std::tanh(v * a0); break;
+        case 23: r = std::min(std::max(v * a0 + a1, 0.0f), 1.0f); break;
+        case 24: r = v > a0 ? v : 0.0f; break;
+        case 25: r = std::log1p(std::exp(std::min(std::max(v, -a0), a0)));
+                 break;
+        case 26: r = std::min(std::max(v, a0), a1); break;
+        case 27: r = v / (1.0f + std::exp(-a0 * v)); break;
+        case 28: {
+          float m = std::fabs(v) - a0;
+          r = m > 0.0f ? (v > 0 ? m : -m) : 0.0f;
+          break;
+        }
+        case 29: r = std::fabs(v) > a0 ? v : 0.0f; break;
+        default: return "unknown unary";
+      }
+      oa[i] = r;
+    }
+    scope->Set(*on, std::move(out));
     return "";
   }
 

@@ -402,6 +402,63 @@ def case_moe_ffn(rng):
     return out, feed
 
 
+def case_unary(rng):
+    """The r5 C++ unary/activation batch: every op maps to a scalar
+    function of (x, attrs); random attrs hit the parameterized ones
+    through the generated layer wrappers (which pass attr kwargs
+    straight through to the op)."""
+    shape = (2, int(rng.randint(2, 7)))
+    which = str(rng.choice([
+        "exp", "log", "sqrt", "rsqrt", "abs", "square", "reciprocal",
+        "floor", "ceil", "round", "sign", "softplus", "softsign",
+        "tanh_shrink", "logsigmoid", "gelu", "sin", "cos", "leaky_relu",
+        "elu", "relu6", "pow", "stanh", "hard_sigmoid",
+        "thresholded_relu", "soft_relu", "brelu", "swish", "softshrink",
+        "hard_shrink"]))
+    x = _data("x", shape)
+    fx = _feedval(rng, shape, low=-2.0, high=2.0)
+    if which in ("log", "sqrt", "rsqrt"):
+        fx = np.abs(fx) + 0.1
+    if which == "reciprocal":
+        fx = np.sign(fx) * (np.abs(fx) + 0.3)
+    attrs = {}
+    if which == "leaky_relu":
+        attrs["alpha"] = float(rng.uniform(0.01, 0.3))
+    elif which == "elu":
+        attrs["alpha"] = float(rng.uniform(0.5, 2.0))
+    elif which == "pow":
+        attrs["factor"] = float(rng.choice([2.0, 3.0, 0.5]))
+        fx = np.abs(fx) + 0.1
+    elif which in ("thresholded_relu", "hard_shrink", "soft_relu"):
+        attrs["threshold"] = float(rng.uniform(0.2, 1.5))
+    elif which == "softshrink":
+        attrs["lambda"] = float(rng.uniform(0.1, 1.0))
+    elif which == "brelu":
+        attrs["t_min"] = float(rng.uniform(-1.0, 0.0))
+        attrs["t_max"] = float(rng.uniform(0.5, 2.0))
+    elif which == "swish":
+        attrs["beta"] = float(rng.uniform(0.5, 2.0))
+    elif which == "stanh":
+        attrs["scale_a"] = float(rng.uniform(0.4, 1.0))
+        attrs["scale_b"] = float(rng.uniform(1.0, 2.0))
+    elif which == "hard_sigmoid":
+        attrs["slope"] = float(rng.uniform(0.1, 0.4))
+        attrs["offset"] = float(rng.uniform(0.3, 0.7))
+    elif which == "relu6":
+        attrs["threshold"] = float(rng.uniform(3.0, 8.0))
+    layer = getattr(fluid.layers, which)
+    v = layer(x, **attrs)
+    # the attrs must actually land on the op (a wrapper silently
+    # dropping kwargs would turn this family into defaults-only)
+    if attrs:
+        op = fluid.default_main_program().global_block().ops[-1]
+        for k, val in attrs.items():
+            got = op.attrs.get(k)
+            assert got is not None and abs(float(got) - val) < 1e-6, (
+                "layer wrapper dropped attr %r for %s" % (k, which))
+    return v, {"x": fx}
+
+
 def case_sequence_mask(rng):
     bs = int(rng.randint(1, 4))
     maxlen = int(rng.randint(2, 7))
@@ -415,7 +472,7 @@ CASES = [
     case_conv_transpose, case_pool, case_norm, case_reduce,
     case_shape_ops, case_embedding, case_xent, case_topk, case_sdpa,
     case_gru, case_lstm, case_cast_chain, case_sequence_mask,
-    case_moe_ffn,
+    case_moe_ffn, case_unary,
 ]
 
 
