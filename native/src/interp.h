@@ -150,6 +150,10 @@ class Interpreter {
       return RunBinary(op, scope,
                        [](float a, float b) { return std::min(a, b); });
     }
+    if (op.type == "elementwise_pow") {
+      return RunBinary(op, scope,
+                       [](float a, float b) { return std::pow(a, b); });
+    }
     if (op.type == "relu") return RunUnary(op, scope, [](float v) {
       return v > 0.0f ? v : 0.0f;
     });
@@ -226,6 +230,14 @@ class Interpreter {
     if (op.type == "moe_ffn") return RunMoeFFN(op, scope);
     if (op.type == "expand") return RunExpand(op, scope);
     if (IsUnaryType(op.type)) return RunUnary(op, scope);
+    if (op.type == "slice") return RunSlice(op, scope);
+    if (op.type == "gather") return RunGather(op, scope);
+    if (op.type == "stack") return RunStack(op, scope);
+    if (op.type == "pad") return RunPad(op, scope);
+    if (op.type == "one_hot") return RunOneHot(op, scope);
+    if (op.type == "matmul") return RunMatmul(op, scope);
+    if (op.type == "clip") return RunClip(op, scope);
+    if (op.type == "cumsum") return RunCumsum(op, scope);
     if (op.type == "softmax_with_cross_entropy_grad") {
       return RunSCEGrad(op, scope);
     }
@@ -2762,6 +2774,326 @@ class Interpreter {
         default: return "unknown unary";
       }
       oa[i] = r;
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+
+  // python slice semantics per axis (ops/tensor_ops.py _lower_slice):
+  // negative starts/ends wrap, then clamp to [0, dim]
+  std::string RunSlice(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "Input");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x)) return "non-f32 dtype";
+    auto axes = IntsAttr(op, "axes", {});
+    auto starts = IntsAttr(op, "starts", {});
+    auto ends = IntsAttr(op, "ends", {});
+    if (axes.size() != starts.size() || axes.size() != ends.size()) {
+      return "bad slice attrs";
+    }
+    if (x->dims.empty()) return "rank-0 input";
+    size_t rank = x->dims.size();
+    std::vector<int64_t> lo(rank, 0), hi = x->dims;
+    for (size_t i = 0; i < axes.size(); ++i) {
+      int64_t ax = axes[i];
+      if (ax < 0) ax += rank;
+      if (ax < 0 || ax >= static_cast<int64_t>(rank)) {
+        return "slice axis out of range";
+      }
+      int64_t d = x->dims[ax];
+      int64_t st = starts[i] < 0 ? starts[i] + d : starts[i];
+      int64_t en = ends[i] < 0 ? ends[i] + d : ends[i];
+      lo[ax] = std::min(std::max<int64_t>(st, 0), d);
+      hi[ax] = std::min(std::max<int64_t>(en, 0), d);
+      if (hi[ax] <= lo[ax]) return "empty slice";
+    }
+    std::vector<int64_t> odims(rank);
+    for (size_t d = 0; d < rank; ++d) odims[d] = hi[d] - lo[d];
+    HostTensor out = MakeF32(odims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    std::vector<int64_t> xstride(rank, 1);
+    for (size_t d = rank - 1; d > 0; --d) {
+      xstride[d - 1] = xstride[d] * x->dims[d];
+    }
+    std::vector<int64_t> idx(rank, 0);
+    int64_t total = NumElements(odims);
+    for (int64_t i = 0; i < total; ++i) {
+      int64_t src = 0;
+      for (size_t d = 0; d < rank; ++d) src += (lo[d] + idx[d]) * xstride[d];
+      oa[i] = xa[src];
+      for (size_t d = rank; d-- > 0;) {
+        if (++idx[d] < odims[d]) break;
+        idx[d] = 0;
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // rows of X at Index along dim 0 (jnp.take axis=0)
+  std::string RunGather(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* in = OneName(op, "Index");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || in == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* it = scope->Find(*in);
+    if (x == nullptr || it == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.empty()) return "bad input";
+    std::vector<int64_t> ids;
+    std::string err = ReadIds(*it, &ids);
+    if (!err.empty()) return err;
+    int64_t rows = x->dims[0];
+    int64_t inner = NumElements(x->dims) / (rows == 0 ? 1 : rows);
+    std::vector<int64_t> odims = it->dims;
+    // a trailing singleton index dim gathers whole rows, like take
+    // over flat ids then reshape
+    for (size_t d = 1; d < x->dims.size(); ++d) odims.push_back(x->dims[d]);
+    HostTensor out = MakeF32(odims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (size_t i = 0; i < ids.size(); ++i) {
+      int64_t r = ids[i];
+      if (r < 0 || r >= rows) return "gather index out of range";
+      std::copy(xa + r * inner, xa + (r + 1) * inner, oa + i * inner);
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // jnp.stack over the variadic X inputs at attr axis
+  std::string RunStack(const OpDesc& op, Scope* scope) {
+    auto it = op.inputs.find("X");
+    const std::string* on = OneName(op, "Y", false);
+    if (it == op.inputs.end() || it->second.empty() || on == nullptr) {
+      return "missing io";
+    }
+    std::vector<const HostTensor*> xs;
+    for (const std::string& nme : it->second) {
+      const HostTensor* t = scope->Find(nme);
+      if (t == nullptr) return "input not in scope";
+      if (!IsF32(*t)) return "non-f32 dtype";
+      if (!xs.empty() && t->dims != xs[0]->dims) return "shape mismatch";
+      xs.push_back(t);
+    }
+    int64_t k = static_cast<int64_t>(xs.size());
+    int64_t rank = static_cast<int64_t>(xs[0]->dims.size());
+    int64_t axis = IntAttr(op, "axis", 0);
+    if (axis < 0) axis += rank + 1;
+    if (axis < 0 || axis > rank) return "axis out of range";
+    std::vector<int64_t> odims = xs[0]->dims;
+    odims.insert(odims.begin() + axis, k);
+    int64_t outer = 1, inner = 1;
+    for (int64_t d = 0; d < axis; ++d) outer *= xs[0]->dims[d];
+    for (int64_t d = axis; d < rank; ++d) inner *= xs[0]->dims[d];
+    HostTensor out = MakeF32(odims);
+    float* oa = MutF32(&out);
+    for (int64_t o = 0; o < outer; ++o) {
+      for (int64_t j = 0; j < k; ++j) {
+        const float* src = F32(*xs[j]) + o * inner;
+        std::copy(src, src + inner, oa + (o * k + j) * inner);
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // jnp.pad with constant value; paddings attr is [lo0, hi0, lo1, ...]
+  std::string RunPad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x)) return "non-f32 dtype";
+    auto pads = IntsAttr(op, "paddings", {});
+    if (x->dims.empty()) return "rank-0 input";
+    size_t rank = x->dims.size();
+    if (pads.size() != 2 * rank) return "bad paddings";
+    for (int64_t p : pads) {
+      if (p < 0) return "negative padding";
+    }
+    float value = FloatAttr(op, "pad_value", 0.0f);
+    std::vector<int64_t> odims(rank);
+    for (size_t d = 0; d < rank; ++d) {
+      odims[d] = x->dims[d] + pads[2 * d] + pads[2 * d + 1];
+    }
+    HostTensor out = MakeF32(odims);
+    float* oa = MutF32(&out);
+    int64_t total = NumElements(odims);
+    std::fill(oa, oa + total, value);
+    std::vector<int64_t> xstride(rank, 1), ostride(rank, 1);
+    for (size_t d = rank - 1; d > 0; --d) {
+      xstride[d - 1] = xstride[d] * x->dims[d];
+      ostride[d - 1] = ostride[d] * odims[d];
+    }
+    const float* xa = F32(*x);
+    std::vector<int64_t> idx(rank, 0);
+    int64_t nin = NumElements(x->dims);
+    for (int64_t i = 0; i < nin; ++i) {
+      int64_t dst = 0;
+      for (size_t d = 0; d < rank; ++d) {
+        dst += (idx[d] + pads[2 * d]) * ostride[d];
+      }
+      oa[dst] = xa[i];
+      for (size_t d = rank; d-- > 0;) {
+        if (++idx[d] < x->dims[d]) break;
+        idx[d] = 0;
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // jax.nn.one_hot over int ids (trailing singleton id dim squeezed,
+  // like lookup_table); out-of-range ids produce all-zero rows
+  std::string RunOneHot(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    std::vector<int64_t> ids;
+    std::string err = ReadIds(*x, &ids);
+    if (!err.empty()) return err;
+    int64_t depth = IntAttr(op, "depth", 1);
+    if (depth <= 0) return "bad depth";
+    std::vector<int64_t> odims = x->dims;
+    if (odims.size() > 1 && odims.back() == 1) odims.pop_back();
+    odims.push_back(depth);
+    HostTensor out = MakeF32(odims);
+    float* oa = MutF32(&out);
+    std::fill(oa, oa + NumElements(odims), 0.0f);
+    for (size_t i = 0; i < ids.size(); ++i) {
+      if (ids[i] >= 0 && ids[i] < depth) oa[i * depth + ids[i]] = 1.0f;
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // jnp.matmul with transpose_X/transpose_Y/alpha (ops/math_ops.py):
+  // rank 2 or batched rank 3 (3x3 with equal batch, or 3x2 / 2x3
+  // numpy-style broadcast of the rank-2 side)
+  std::string RunMatmul(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* yn = OneName(op, "Y");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || yn == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* y = scope->Find(*yn);
+    if (x == nullptr || y == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*y)) return "non-f32 dtype";
+    size_t rx = x->dims.size(), ry = y->dims.size();
+    if (rx < 2 || rx > 3 || ry < 2 || ry > 3) return "rank unsupported";
+    bool tx = IntAttr(op, "transpose_X", 0) != 0;
+    bool ty = IntAttr(op, "transpose_Y", 0) != 0;
+    float alpha = FloatAttr(op, "alpha", 1.0f);
+    int64_t bx = rx == 3 ? x->dims[0] : 1;
+    int64_t by = ry == 3 ? y->dims[0] : 1;
+    if (bx != by && bx != 1 && by != 1) return "batch mismatch";
+    int64_t batch = std::max(bx, by);
+    int64_t xr = x->dims[rx - 2], xc = x->dims[rx - 1];
+    int64_t yr = y->dims[ry - 2], yc = y->dims[ry - 1];
+    int64_t m = tx ? xc : xr, kx = tx ? xr : xc;
+    int64_t ky = ty ? yc : yr, nn = ty ? yr : yc;
+    if (kx != ky) return "contraction mismatch";
+    std::vector<int64_t> odims;
+    if (rx == 3 || ry == 3) odims.push_back(batch);
+    odims.push_back(m);
+    odims.push_back(nn);
+    HostTensor out = MakeF32(odims);
+    float* oa = MutF32(&out);
+    const float* xa = F32(*x);
+    const float* ya = F32(*y);
+    for (int64_t b = 0; b < batch; ++b) {
+      const float* xb = xa + (bx == 1 ? 0 : b) * xr * xc;
+      const float* yb = ya + (by == 1 ? 0 : b) * yr * yc;
+      float* ob = oa + (rx == 3 || ry == 3 ? b : 0) * m * nn;
+      for (int64_t i = 0; i < m; ++i) {
+        for (int64_t j = 0; j < nn; ++j) {
+          float acc = 0.0f;
+          for (int64_t t = 0; t < kx; ++t) {
+            float xv = tx ? xb[t * xc + i] : xb[i * xc + t];
+            float yv = ty ? yb[j * yc + t] : yb[t * yc + j];
+            acc += xv * yv;
+          }
+          ob[i * nn + j] = alpha * acc;
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunClip(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x)) return "non-f32 dtype";
+    float lo = FloatAttr(op, "min", 0.0f);
+    float hi = FloatAttr(op, "max", 0.0f);
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    int64_t n = NumElements(x->dims);
+    for (int64_t i = 0; i < n; ++i) {
+      oa[i] = std::min(std::max(xa[i], lo), hi);
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // cumsum along axis with exclusive/reverse (ops/math_ops.py _cumsum)
+  std::string RunCumsum(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x)) return "non-f32 dtype";
+    size_t rank = x->dims.size();
+    int64_t axis = IntAttr(op, "axis", -1);
+    if (axis < 0) axis += rank;
+    if (axis < 0 || axis >= static_cast<int64_t>(rank)) {
+      return "axis out of range";
+    }
+    bool exclusive = IntAttr(op, "exclusive", 0) != 0;
+    bool reverse = IntAttr(op, "reverse", 0) != 0;
+    int64_t len = x->dims[axis];
+    int64_t inner = 1;
+    for (size_t d = axis + 1; d < rank; ++d) inner *= x->dims[d];
+    int64_t outer = NumElements(x->dims) / (len * inner == 0 ? 1 : len * inner);
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t o = 0; o < outer; ++o) {
+      for (int64_t in2 = 0; in2 < inner; ++in2) {
+        const float* base = xa + o * len * inner + in2;
+        float* ob = oa + o * len * inner + in2;
+        float acc = 0.0f;
+        for (int64_t p = 0; p < len; ++p) {
+          int64_t q = reverse ? len - 1 - p : p;
+          float v = base[q * inner];
+          if (exclusive) {
+            ob[q * inner] = acc;
+            acc += v;
+          } else {
+            acc += v;
+            ob[q * inner] = acc;
+          }
+        }
+      }
     }
     scope->Set(*on, std::move(out));
     return "";

@@ -459,6 +459,93 @@ def case_unary(rng):
     return v, {"x": fx}
 
 
+def case_indexing(rng):
+    """r5 C++ batch 2: slice/gather/stack/pad/one_hot/matmul/clip/
+    cumsum/elementwise_pow with randomized attrs."""
+    which = str(rng.choice(["slice", "gather", "stack", "pad", "one_hot",
+                            "matmul", "clip", "cumsum",
+                            "elementwise_pow"]))
+    if which == "slice":
+        shape = (3, int(rng.randint(3, 7)), int(rng.randint(3, 7)))
+        x = _data("x", shape)
+        ax = int(rng.choice([1, 2]))
+        st = int(rng.randint(0, shape[ax] - 1))
+        en = int(rng.randint(st + 1, shape[ax] + 1))
+        if rng.rand() < 0.3:
+            st, en = st - shape[ax], en - shape[ax]  # negative indexing
+            if en == 0:
+                en = shape[ax]  # slice(st, 0) would be empty
+        v = fluid.layers.slice(x, axes=[ax], starts=[st], ends=[en])
+        return v, {"x": _feedval(rng, shape)}
+    if which == "gather":
+        rows, d = int(rng.randint(3, 8)), int(rng.randint(2, 5))
+        k = int(rng.randint(1, 6))
+        x = _data("x", (rows, d))
+        idx = _data("idx", (k,), dtype="int64")
+        v = fluid.layers.gather(x, idx)
+        return v, {"x": _feedval(rng, (rows, d)),
+                   "idx": rng.randint(0, rows, (k,)).astype("int64")}
+    if which == "stack":
+        shape = (2, int(rng.randint(2, 5)))
+        xs = [_data("x%d" % i, shape) for i in range(int(rng.randint(2, 4)))]
+        axis = int(rng.choice([0, 1, -1]))
+        v = fluid.layers.stack(xs, axis=axis)
+        return v, {"x%d" % i: _feedval(rng, shape)
+                   for i in range(len(xs))}
+    if which == "pad":
+        shape = (2, int(rng.randint(2, 5)), int(rng.randint(2, 5)))
+        x = _data("x", shape)
+        pads = [int(rng.randint(0, 3)) for _ in range(6)]
+        v = fluid.layers.pad(x, paddings=pads,
+                             pad_value=float(rng.uniform(-1, 1)))
+        return v, {"x": _feedval(rng, shape)}
+    if which == "one_hot":
+        bs, depth = int(rng.randint(2, 5)), int(rng.randint(3, 9))
+        ids = _data("ids", (bs, 1), dtype="int64")
+        v = fluid.layers.one_hot(ids, depth=depth)
+        return v, {"ids": rng.randint(0, depth, (bs, 1)).astype("int64")}
+    if which == "matmul":
+        b = 2
+        m, k, n = (int(rng.randint(1, 5)) for _ in range(3))
+        tx, ty = bool(rng.rand() < 0.5), bool(rng.rand() < 0.5)
+        # independent per-side batching covers the mixed-rank broadcast
+        # paths (3D x 2D and 2D x 3D) RunMatmul implements
+        bx, by = bool(rng.rand() < 0.5), bool(rng.rand() < 0.5)
+        xs = ((b,) if bx else ()) + ((k, m) if tx else (m, k))
+        ys = ((b,) if by else ()) + ((n, k) if ty else (k, n))
+        x = _data("x", xs if bx else (1,) + xs)
+        y = _data("y", ys if by else (1,) + ys)
+        if not bx:
+            x = fluid.layers.reshape(x, list(xs))
+        if not by:
+            y = fluid.layers.reshape(y, list(ys))
+        v = fluid.layers.matmul(x, y, transpose_x=tx, transpose_y=ty,
+                                alpha=float(rng.choice([1.0, 0.5, 2.0])))
+        feed = {"x": _feedval(rng, xs if bx else (1,) + xs),
+                "y": _feedval(rng, ys if by else (1,) + ys)}
+        return v, feed
+    if which == "clip":
+        shape = (2, int(rng.randint(2, 7)))
+        x = _data("x", shape)
+        lo = float(rng.uniform(-1.0, 0.0))
+        v = fluid.layers.clip(x, min=lo, max=float(rng.uniform(0.0, 1.0)))
+        return v, {"x": _feedval(rng, shape, low=-2.0, high=2.0)}
+    if which == "cumsum":
+        shape = (2, int(rng.randint(2, 6)), int(rng.randint(2, 5)))
+        x = _data("x", shape)
+        v = fluid.layers.cumsum(
+            x, axis=int(rng.choice([1, 2, -1])),
+            exclusive=bool(rng.rand() < 0.5),
+            reverse=bool(rng.rand() < 0.5))
+        return v, {"x": _feedval(rng, shape)}
+    shape = (2, int(rng.randint(2, 5)))
+    x = _data("x", shape)
+    y = _data("y", shape)
+    v = fluid.layers.elementwise_pow(x, y)
+    return v, {"x": np.abs(_feedval(rng, shape)) + 0.2,
+               "y": _feedval(rng, shape, low=-2.0, high=2.0)}
+
+
 def case_sequence_mask(rng):
     bs = int(rng.randint(1, 4))
     maxlen = int(rng.randint(2, 7))
@@ -472,7 +559,7 @@ CASES = [
     case_conv_transpose, case_pool, case_norm, case_reduce,
     case_shape_ops, case_embedding, case_xent, case_topk, case_sdpa,
     case_gru, case_lstm, case_cast_chain, case_sequence_mask,
-    case_moe_ffn, case_unary,
+    case_moe_ffn, case_unary, case_indexing,
 ]
 
 
