@@ -97,3 +97,45 @@ def test_aot_generator_cpp_main_matches_golden(tmp_path):
     np.testing.assert_array_equal(
         got, want.astype(np.int32),
         err_msg="C++ AOT generator diverged from the committed golden")
+
+
+def test_aot_generator_exports_for_tpu(tmp_path):
+    """Cross-platform: a CPU build host must be able to emit a
+    TPU-target generation artifact — the kernel selection keys on the
+    export platform, so this runs the full Mosaic lowering of the
+    cached-decode attention path in CI (same gate class as
+    tests/test_tpu_lowering.py)."""
+    import json
+
+    from paddle_tpu.models import transformer
+
+    with fluid.scope_guard(fluid.executor.Scope()):
+        from paddle_tpu import unique_name
+        from paddle_tpu.testing import set_deterministic_params
+
+        unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 13
+        with fluid.program_guard(main, startup):
+            transformer.build(
+                src_vocab_size=VOCAB, trg_vocab_size=VOCAB,
+                max_length=SEQ, n_layer=N_LAYER, n_head=N_HEAD,
+                d_model=D_MODEL, d_inner=D_INNER, dropout=0.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        set_deterministic_params(main, fluid.global_scope())
+        path = str(tmp_path / "aot_tpu")
+        transformer.save_compiled_generator(
+            path, batch_size=BS, src_vocab_size=VOCAB,
+            trg_vocab_size=VOCAB, max_length=SEQ, n_layer=N_LAYER,
+            n_head=N_HEAD, d_model=D_MODEL, d_inner=D_INNER, eos_id=0,
+            platforms=("tpu",))
+    meta = json.load(open(path + "/__compiled__.json"))
+    assert meta["platforms"] == ["tpu"]
+    # multi-platform stays rejected (kernel selection is platform-keyed)
+    with pytest.raises(ValueError, match="platform-keyed"):
+        transformer.save_compiled_generator(
+            str(tmp_path / "nope"), batch_size=BS, src_vocab_size=VOCAB,
+            trg_vocab_size=VOCAB, max_length=SEQ, n_layer=N_LAYER,
+            n_head=N_HEAD, d_model=D_MODEL, d_inner=D_INNER,
+            platforms=("cpu", "tpu"))
