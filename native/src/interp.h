@@ -238,6 +238,14 @@ class Interpreter {
     if (op.type == "matmul") return RunMatmul(op, scope);
     if (op.type == "clip") return RunClip(op, scope);
     if (op.type == "cumsum") return RunCumsum(op, scope);
+    if (op.type == "scatter") return RunScatter(op, scope);
+    if (op.type == "arg_max" || op.type == "arg_min") {
+      return RunArgMax(op, scope, op.type == "arg_min");
+    }
+    if (op.type == "assign") return RunAssign(op, scope);
+    if (op.type == "fill_zeros_like") return RunFillZerosLike(op, scope);
+    if (op.type == "shape") return RunShapeOp(op, scope);
+    if (op.type == "prelu") return RunPrelu(op, scope);
     if (op.type == "softmax_with_cross_entropy_grad") {
       return RunSCEGrad(op, scope);
     }
@@ -2779,6 +2787,212 @@ class Interpreter {
     return "";
   }
 
+
+
+  // x.at[ids].set/add(updates) over dim 0 (ops/tensor_ops.py scatter)
+  std::string RunScatter(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* idn = OneName(op, "Ids");
+    const std::string* un = OneName(op, "Updates");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || idn == nullptr || un == nullptr ||
+        on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* it = scope->Find(*idn);
+    const HostTensor* u = scope->Find(*un);
+    if (x == nullptr || it == nullptr || u == nullptr) {
+      return "input not in scope";
+    }
+    if (!IsF32(*x) || !IsF32(*u) || x->dims.empty()) return "bad input";
+    std::vector<int64_t> ids;
+    std::string err = ReadIds(*it, &ids);
+    if (!err.empty()) return err;
+    int64_t rows = x->dims[0];
+    int64_t inner = NumElements(x->dims) / (rows == 0 ? 1 : rows);
+    if (NumElements(u->dims) !=
+        static_cast<int64_t>(ids.size()) * inner) {
+      return "updates shape mismatch";
+    }
+    bool overwrite = IntAttr(op, "overwrite", 1) != 0;
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    std::copy(xa, xa + NumElements(x->dims), oa);
+    const float* ua = F32(*u);
+    for (size_t i = 0; i < ids.size(); ++i) {
+      int64_t r = ids[i];
+      if (r < 0 || r >= rows) return "scatter index out of range";
+      for (int64_t j = 0; j < inner; ++j) {
+        if (overwrite) {
+          oa[r * inner + j] = ua[i * inner + j];
+        } else {
+          oa[r * inner + j] += ua[i * inner + j];
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // jnp.argmax/argmin along attr axis, int64 out (first max on ties)
+  std::string RunArgMax(const OpDesc& op, Scope* scope, bool is_min) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.empty()) return "bad input";
+    size_t rank = x->dims.size();
+    int64_t axis = IntAttr(op, "axis", 0);
+    if (axis < 0) axis += rank;
+    if (axis < 0 || axis >= static_cast<int64_t>(rank)) {
+      return "axis out of range";
+    }
+    int64_t len = x->dims[axis];
+    if (len <= 0) return "empty axis";
+    int64_t inner = 1;
+    for (size_t d = axis + 1; d < rank; ++d) inner *= x->dims[d];
+    int64_t outer = NumElements(x->dims) / (len * inner);
+    std::vector<int64_t> odims;
+    for (size_t d = 0; d < rank; ++d) {
+      if (static_cast<int64_t>(d) != axis) odims.push_back(x->dims[d]);
+    }
+    if (odims.empty()) {
+      // the XLA lowering returns a rank-0 scalar here; refuse rather
+      // than silently emitting a different shape
+      return "scalar (rank-0) output unsupported";
+    }
+    HostTensor out;
+    out.dtype = "int64";
+    out.dims = odims;
+    out.data.resize(NumElements(odims) * sizeof(int64_t));
+    int64_t* oa = reinterpret_cast<int64_t*>(out.data.data());
+    const float* xa = F32(*x);
+    for (int64_t o = 0; o < outer; ++o) {
+      for (int64_t in2 = 0; in2 < inner; ++in2) {
+        const float* base = xa + o * len * inner + in2;
+        int64_t best = 0;
+        float bv = base[0];
+        for (int64_t p = 1; p < len; ++p) {
+          float v = base[p * inner];
+          // numpy/jnp argmax+argmin both propagate NaN: the FIRST NaN
+          // wins over any number (a plain comparison would skip NaNs)
+          bool take;
+          if (std::isnan(bv)) {
+            take = false;
+          } else if (std::isnan(v)) {
+            take = true;
+          } else {
+            take = is_min ? v < bv : v > bv;
+          }
+          if (take) {
+            bv = v;
+            best = p;
+          }
+        }
+        oa[o * inner + in2] = best;
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunAssign(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    HostTensor out = *x;  // value copy, any dtype
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunFillZerosLike(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x)) return "non-f32 dtype";
+    HostTensor out = MakeF32(x->dims);
+    float* oa = MutF32(&out);
+    std::fill(oa, oa + NumElements(x->dims), 0.0f);
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // int32 shape vector (ops/tensor_ops.py shape)
+  std::string RunShapeOp(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "Input");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    HostTensor out;
+    out.dtype = "int32";
+    out.dims = {static_cast<int64_t>(x->dims.size())};
+    out.data.resize(x->dims.size() * sizeof(int32_t));
+    int32_t* oa = reinterpret_cast<int32_t*>(out.data.data());
+    for (size_t d = 0; d < x->dims.size(); ++d) {
+      oa[d] = static_cast<int32_t>(x->dims[d]);
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // prelu modes all/channel/element (ops/activation_ops.py)
+  std::string RunPrelu(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* an = OneName(op, "Alpha");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || an == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* a = scope->Find(*an);
+    if (x == nullptr || a == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*a)) return "non-f32 dtype";
+    std::string mode = StrAttr(op, "mode", "all");
+    int64_t n = NumElements(x->dims);
+    int64_t na = NumElements(a->dims);
+    int64_t chans = x->dims.size() > 1 ? x->dims[1] : 1;
+    int64_t inner = 1;
+    for (size_t d = 2; d < x->dims.size(); ++d) inner *= x->dims[d];
+    int64_t batch = x->dims.empty() ? 1 : x->dims[0];
+    int64_t per_sample = n / (batch == 0 ? 1 : batch);
+    if (mode == "all") {
+      if (na != 1) return "alpha size";
+    } else if (mode == "channel") {
+      if (na != chans) return "alpha size";
+    } else if (mode == "element") {
+      // one alpha per non-batch element, broadcast over the batch
+      // (the layer creates Alpha with shape x.shape[1:])
+      if (na != per_sample) return "alpha size";
+    } else {
+      return "unknown mode";
+    }
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    const float* aa = F32(*a);
+    float* oa = MutF32(&out);
+    for (int64_t i = 0; i < n; ++i) {
+      float v = xa[i];
+      float alpha;
+      if (mode == "all") {
+        alpha = aa[0];
+      } else if (mode == "channel") {
+        alpha = aa[(i / inner) % chans];
+      } else {
+        alpha = aa[i % per_sample];
+      }
+      oa[i] = v >= 0.0f ? v : alpha * v;
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
 
   // python slice semantics per axis (ops/tensor_ops.py _lower_slice):
   // negative starts/ends wrap, then clamp to [0, dim]

@@ -166,7 +166,11 @@ def _prelu_shape(ins, attrs):
         return (1,) * jnp.ndim(x)
     if mode == "channel":
         return (1, -1) + (1,) * (jnp.ndim(x) - 2)
-    return jnp.shape(x)
+    # element: the layer creates Alpha with shape x.shape[1:] (one value
+    # per non-batch element) — broadcast it over the batch dim; the old
+    # jnp.shape(x) reshape could never match the layer's alpha for
+    # batch > 1, making element mode dead code in both engines
+    return (1,) + tuple(jnp.shape(x)[1:])
 
 
 register_op(

@@ -546,6 +546,57 @@ def case_indexing(rng):
                "y": _feedval(rng, shape, low=-2.0, high=2.0)}
 
 
+def case_misc(rng):
+    """r5 C++ batch 3: scatter/argmax/assign/shape/prelu."""
+    which = str(rng.choice(["scatter", "argmax", "assign", "shape",
+                            "prelu", "fill_zeros_like"]))
+    if which == "scatter":
+        rows, d = int(rng.randint(3, 7)), int(rng.randint(2, 5))
+        k = int(rng.randint(1, rows + 1))
+        x = _data("x", (rows, d))
+        # distinct ids: overwrite-mode result is order-dependent on
+        # duplicates (XLA .at[].set picks one arbitrarily)
+        ids_val = rng.permutation(rows)[:k].astype("int64")
+        ids = _data("ids", (k,), dtype="int64")
+        upd = _data("upd", (k, d))
+        v = fluid.layers.scatter(x, ids, upd,
+                                 overwrite=bool(rng.rand() < 0.5))
+        return v, {"x": _feedval(rng, (rows, d)), "ids": ids_val,
+                   "upd": _feedval(rng, (k, d))}
+    if which == "argmax":
+        shape = (2, int(rng.randint(2, 6)), int(rng.randint(2, 5)))
+        x = _data("x", shape)
+        v = fluid.layers.argmax(x, axis=int(rng.choice([1, 2, -1])))
+        v = fluid.layers.cast(v, "float32")
+        return v, {"x": _feedval(rng, shape)}
+    if which == "assign":
+        shape = (2, int(rng.randint(2, 5)))
+        x = _data("x", shape)
+        v = fluid.layers.assign(fluid.layers.scale(x, scale=2.0))
+        return v, {"x": _feedval(rng, shape)}
+    if which == "shape":
+        shape = (2, int(rng.randint(2, 6)), int(rng.randint(2, 4)))
+        x = _data("x", shape)
+        v = fluid.layers.cast(fluid.layers.shape(x), "float32")
+        return v, {"x": _feedval(rng, shape)}
+    if which == "fill_zeros_like":
+        shape = (2, int(rng.randint(2, 5)))
+        x = _data("x", shape)
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("fill_zeros_like")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type="fill_zeros_like", inputs={"X": [x]},
+                         outputs={"Out": [out]}, attrs={})
+        v = fluid.layers.elementwise_add(out, x)
+        return v, {"x": _feedval(rng, shape)}
+    c = int(rng.randint(2, 5))
+    shape = (2, c, 3, 3)
+    mode = str(rng.choice(["all", "channel", "element"]))
+    x = _data("x", shape)
+    v = fluid.layers.prelu(x, mode=mode)
+    return v, {"x": _feedval(rng, shape, low=-2.0, high=2.0)}
+
+
 def case_sequence_mask(rng):
     bs = int(rng.randint(1, 4))
     maxlen = int(rng.randint(2, 7))
@@ -559,7 +610,7 @@ CASES = [
     case_conv_transpose, case_pool, case_norm, case_reduce,
     case_shape_ops, case_embedding, case_xent, case_topk, case_sdpa,
     case_gru, case_lstm, case_cast_chain, case_sequence_mask,
-    case_moe_ffn, case_unary, case_indexing,
+    case_moe_ffn, case_unary, case_indexing, case_misc,
 ]
 
 
