@@ -246,6 +246,14 @@ class Interpreter {
     if (op.type == "fill_zeros_like") return RunFillZerosLike(op, scope);
     if (op.type == "shape") return RunShapeOp(op, scope);
     if (op.type == "prelu") return RunPrelu(op, scope);
+    if (op.type == "group_norm") return RunGroupNorm(op, scope);
+    if (op.type == "sequence_softmax") return RunSeqSoftmax(op, scope);
+    if (op.type == "norm" || op.type == "l2_normalize") {
+      return RunL2Norm(op, scope);
+    }
+    if (op.type == "huber_loss") return RunHuberLoss(op, scope);
+    if (op.type == "log_loss") return RunLogLoss(op, scope);
+    if (op.type == "maxout") return RunMaxout(op, scope);
     if (op.type == "softmax_with_cross_entropy_grad") {
       return RunSCEGrad(op, scope);
     }
@@ -2788,6 +2796,242 @@ class Interpreter {
   }
 
 
+
+
+  // per-(sample, group) normalization + per-channel affine
+  // (ops/nn_ops.py _lower_group_norm)
+  std::string RunGroupNorm(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Y", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.size() < 2) return "bad input";
+    int64_t groups = IntAttr(op, "groups", 1);
+    float eps = FloatAttr(op, "epsilon", 1e-5f);
+    int64_t n = x->dims[0], c = x->dims[1];
+    if (groups <= 0 || c % groups != 0) return "bad groups";
+    int64_t rest = 1;
+    for (size_t d = 2; d < x->dims.size(); ++d) rest *= x->dims[d];
+    int64_t cg = c / groups;
+    int64_t glen = cg * rest;
+    const HostTensor* scale = nullptr;
+    const HostTensor* bias = nullptr;
+    const std::string* sn = OneName(op, "Scale");
+    const std::string* bn = OneName(op, "Bias");
+    if (sn != nullptr) {
+      scale = scope->Find(*sn);
+      if (scale == nullptr || NumElements(scale->dims) != c) {
+        return "bad scale";
+      }
+    }
+    if (bn != nullptr) {
+      bias = scope->Find(*bn);
+      if (bias == nullptr || NumElements(bias->dims) != c) {
+        return "bad bias";
+      }
+    }
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t b = 0; b < n; ++b) {
+      for (int64_t g = 0; g < groups; ++g) {
+        const float* base = xa + (b * c + g * cg) * rest;
+        double mean = 0.0;
+        for (int64_t i = 0; i < glen; ++i) mean += base[i];
+        mean /= static_cast<double>(glen);
+        double var = 0.0;
+        for (int64_t i = 0; i < glen; ++i) {
+          double d2 = base[i] - mean;
+          var += d2 * d2;
+        }
+        var /= static_cast<double>(glen);
+        float inv = 1.0f / std::sqrt(static_cast<float>(var) + eps);
+        float* ob = oa + (b * c + g * cg) * rest;
+        for (int64_t i = 0; i < glen; ++i) {
+          int64_t ch = g * cg + i / rest;
+          float v = (base[i] - static_cast<float>(mean)) * inv;
+          if (scale != nullptr) v *= F32(*scale)[ch];
+          if (bias != nullptr) v += F32(*bias)[ch];
+          ob[i] = v;
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // masked softmax over [batch, max_len] with optional Length
+  // (ops/sequence_ops.py _lower_sequence_softmax; invalid positions 0)
+  std::string RunSeqSoftmax(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.size() != 2) return "bad input";
+    int64_t b = x->dims[0], t = x->dims[1];
+    std::vector<int64_t> lens(b, t);
+    const std::string* ln = OneName(op, "Length");
+    if (ln != nullptr) {
+      const HostTensor* lt = scope->Find(*ln);
+      if (lt == nullptr) return "length not in scope";
+      std::vector<int64_t> raw;
+      std::string err = ReadIds(*lt, &raw);
+      if (!err.empty()) return err;
+      if (static_cast<int64_t>(raw.size()) != b) return "length count";
+      lens = raw;
+    }
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t i = 0; i < b; ++i) {
+      int64_t len = std::min<int64_t>(std::max<int64_t>(lens[i], 0), t);
+      const float* row = xa + i * t;
+      float* orow = oa + i * t;
+      if (len == 0) {
+        // all-masked row: softmax over all -1e38 = uniform, then
+        // zeroed by the where — matches the XLA lowering exactly
+        std::fill(orow, orow + t, 0.0f);
+        continue;
+      }
+      float mx = -INFINITY;
+      for (int64_t j = 0; j < len; ++j) mx = std::max(mx, row[j]);
+      float denom = 0.0f;
+      for (int64_t j = 0; j < len; ++j) denom += std::exp(row[j] - mx);
+      for (int64_t j = 0; j < t; ++j) {
+        orow[j] = j < len ? std::exp(row[j] - mx) / denom : 0.0f;
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // l2_normalize along attr axis (ops/math_ops.py norm)
+  std::string RunL2Norm(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.empty()) return "bad input";
+    size_t rank = x->dims.size();
+    int64_t axis = IntAttr(op, "axis", op.type == "norm" ? 1 : -1);
+    if (axis < 0) axis += rank;
+    if (axis < 0 || axis >= static_cast<int64_t>(rank)) {
+      return "axis out of range";
+    }
+    float eps = FloatAttr(op, "epsilon", 1e-10f);
+    int64_t len = x->dims[axis];
+    int64_t inner = 1;
+    for (size_t d = axis + 1; d < rank; ++d) inner *= x->dims[d];
+    int64_t outer = NumElements(x->dims) / (len * inner);
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t o = 0; o < outer; ++o) {
+      for (int64_t in2 = 0; in2 < inner; ++in2) {
+        const float* base = xa + o * len * inner + in2;
+        float* ob = oa + o * len * inner + in2;
+        float acc = eps;
+        for (int64_t p = 0; p < len; ++p) {
+          acc += base[p * inner] * base[p * inner];
+        }
+        float inv = 1.0f / std::sqrt(acc);
+        for (int64_t p = 0; p < len; ++p) {
+          ob[p * inner] = base[p * inner] * inv;
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // huber: d = Y - X; |d|<=delta -> d^2/2 else delta*(|d|-delta/2)
+  std::string RunHuberLoss(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* yn = OneName(op, "Y");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || yn == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* y = scope->Find(*yn);
+    if (x == nullptr || y == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*y) || x->dims != y->dims) return "bad input";
+    float delta = FloatAttr(op, "delta", 1.0f);
+    HostTensor out = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    const float* ya = F32(*y);
+    float* oa = MutF32(&out);
+    int64_t n = NumElements(x->dims);
+    for (int64_t i = 0; i < n; ++i) {
+      float d = ya[i] - xa[i];
+      float ad = std::fabs(d);
+      oa[i] = ad <= delta ? 0.5f * d * d : delta * (ad - 0.5f * delta);
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  std::string RunLogLoss(const OpDesc& op, Scope* scope) {
+    const std::string* pn = OneName(op, "Predicted");
+    const std::string* ln = OneName(op, "Labels");
+    const std::string* on = OneName(op, "Loss", false);
+    if (pn == nullptr || ln == nullptr || on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* p = scope->Find(*pn);
+    const HostTensor* l = scope->Find(*ln);
+    if (p == nullptr || l == nullptr) return "input not in scope";
+    if (!IsF32(*p) || !IsF32(*l) || p->dims != l->dims) return "bad input";
+    float eps = FloatAttr(op, "epsilon", 1e-4f);
+    HostTensor out = MakeF32(p->dims);
+    const float* pa = F32(*p);
+    const float* la = F32(*l);
+    float* oa = MutF32(&out);
+    int64_t n = NumElements(p->dims);
+    for (int64_t i = 0; i < n; ++i) {
+      oa[i] = -la[i] * std::log(pa[i] + eps) -
+              (1.0f - la[i]) * std::log(1.0f - pa[i] + eps);
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
+
+  // max over `groups` consecutive channels (ops/activation_ops.py
+  // _maxout: reshape (n, c/g, g, h, w), max over the g axis)
+  std::string RunMaxout(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* on = OneName(op, "Out", false);
+    if (xn == nullptr || on == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    if (x == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.size() != 4) return "bad input";
+    int64_t groups = IntAttr(op, "groups", 1);
+    int64_t n = x->dims[0], c = x->dims[1], h = x->dims[2],
+            w = x->dims[3];
+    if (groups <= 0 || c % groups != 0) return "bad groups";
+    int64_t co = c / groups;
+    int64_t hw = h * w;
+    HostTensor out = MakeF32({n, co, h, w});
+    const float* xa = F32(*x);
+    float* oa = MutF32(&out);
+    for (int64_t b = 0; b < n; ++b) {
+      for (int64_t oc = 0; oc < co; ++oc) {
+        for (int64_t p = 0; p < hw; ++p) {
+          float best = -INFINITY;
+          for (int64_t g = 0; g < groups; ++g) {
+            best = std::max(
+                best, xa[((b * c + oc * groups + g) * hw) + p]);
+          }
+          oa[(b * co + oc) * hw + p] = best;
+        }
+      }
+    }
+    scope->Set(*on, std::move(out));
+    return "";
+  }
 
   // x.at[ids].set/add(updates) over dim 0 (ops/tensor_ops.py scatter)
   std::string RunScatter(const OpDesc& op, Scope* scope) {
