@@ -13,14 +13,16 @@
 //
 // TRAINING grad table (what the C++ trainer can differentiate; the op
 // set of the MLP and MNIST-conv book models):
-//   mean_grad, relu_grad, softmax_grad, cross_entropy_grad,
-//   softmax_with_cross_entropy_grad, elementwise_add_grad (incl. the
-//   broadcast bias axis), mul_grad, conv2d_grad (strides/paddings/
-//   dilations/groups, same envelope as the forward), pool2d_grad
-//   (max + avg/exclusive; ceil_mode/adaptive refused like the forward),
-//   plus sgd and the startup initializers (fill_constant,
-//   uniform_random, gaussian_random). Anything else errors explicitly —
-//   the serving op table above is much wider than the training one.
+//   mean_grad, relu_grad, tanh_grad, sigmoid_grad, softmax_grad,
+//   cross_entropy_grad, softmax_with_cross_entropy_grad,
+//   elementwise_add_grad (incl. the broadcast bias axis), mul_grad,
+//   conv2d_grad (strides/paddings/dilations/groups, same envelope as
+//   the forward), pool2d_grad (max + avg/exclusive + ceil_mode;
+//   adaptive refused like the forward), optimizers sgd / momentum
+//   (incl. nesterov) / adam (beta pows ride the scale kernel), and the
+//   startup initializers (fill_constant, uniform_random,
+//   gaussian_random). Anything else errors explicitly — the serving op
+//   table above is much wider than the training one.
 
 #include <algorithm>
 #include <cctype>
@@ -260,6 +262,10 @@ class Interpreter {
     if (op.type == "elementwise_add_grad") return RunAddGrad(op, scope);
     if (op.type == "mul_grad") return RunMulGrad(op, scope);
     if (op.type == "sgd") return RunSgd(op, scope);
+    if (op.type == "adam") return RunAdam(op, scope);
+    if (op.type == "momentum") return RunMomentum(op, scope);
+    if (op.type == "tanh_grad") return RunTanhGrad(op, scope);
+    if (op.type == "sigmoid_grad") return RunSigmoidGrad(op, scope);
     return "unsupported op type";
   }
 
@@ -2189,27 +2195,8 @@ class Interpreter {
   }
 
   std::string RunReluGrad(const OpDesc& op, Scope* scope) {
-    const std::string* on = OneName(op, "Out");
-    const std::string* ogn = OneName(op, "Out@GRAD");
-    const std::string* gn = OneName(op, "X@GRAD", false);
-    if (on == nullptr || ogn == nullptr || gn == nullptr) {
-      return "missing io";
-    }
-    const HostTensor* out = scope->Find(*on);
-    const HostTensor* og = scope->Find(*ogn);
-    if (out == nullptr || og == nullptr) return "input not in scope";
-    if (!IsF32(*out) || !IsF32(*og)) return "non-f32 dtype";
-    int64_t n = NumElements(out->dims);
-    if (n != NumElements(og->dims)) return "shape mismatch";
-    HostTensor grad = MakeF32(out->dims);
-    const float* oa = F32(*out);
-    const float* ga = F32(*og);
-    float* ra = MutF32(&grad);
-    for (int64_t i = 0; i < n; ++i) {
-      ra[i] = oa[i] > 0.0f ? ga[i] : 0.0f;
-    }
-    scope->Set(*gn, std::move(grad));
-    return "";
+    return RunActGradFromOut(
+        op, scope, [](float o) { return o > 0.0f ? 1.0f : 0.0f; });
   }
 
   std::string RunSCEGrad(const OpDesc& op, Scope* scope) {
@@ -2389,6 +2376,7 @@ class Interpreter {
     if (!IsF32(*p) || !IsF32(*g) || !IsF32(*lr)) return "non-f32 dtype";
     int64_t n = NumElements(p->dims);
     if (n != NumElements(g->dims)) return "shape mismatch";
+    if (NumElements(lr->dims) < 1) return "empty scalar input";
     float rate = F32(*lr)[0];
     HostTensor out = MakeF32(p->dims);
     const float* pa = F32(*p);
@@ -2396,6 +2384,156 @@ class Interpreter {
     float* oa = MutF32(&out);
     for (int64_t i = 0; i < n; ++i) oa[i] = pa[i] - rate * ga[i];
     scope->Set(*on, std::move(out));
+    return "";
+  }
+
+
+  // ops/optimizer_ops.py _lower_adam: bias-corrected lr, beta pows
+  // advanced by separate scale ops the optimizer appends
+  std::string RunAdam(const OpDesc& op, Scope* scope) {
+    const std::string* pn = OneName(op, "Param");
+    const std::string* gn = OneName(op, "Grad");
+    const std::string* lrn = OneName(op, "LearningRate");
+    const std::string* m1n = OneName(op, "Moment1");
+    const std::string* m2n = OneName(op, "Moment2");
+    const std::string* b1n = OneName(op, "Beta1Pow");
+    const std::string* b2n = OneName(op, "Beta2Pow");
+    const std::string* pon = OneName(op, "ParamOut", false);
+    const std::string* m1on = OneName(op, "Moment1Out", false);
+    const std::string* m2on = OneName(op, "Moment2Out", false);
+    if (pn == nullptr || gn == nullptr || lrn == nullptr ||
+        m1n == nullptr || m2n == nullptr || b1n == nullptr ||
+        b2n == nullptr || pon == nullptr || m1on == nullptr ||
+        m2on == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* p = scope->Find(*pn);
+    const HostTensor* g = scope->Find(*gn);
+    const HostTensor* lr = scope->Find(*lrn);
+    const HostTensor* m1 = scope->Find(*m1n);
+    const HostTensor* m2 = scope->Find(*m2n);
+    const HostTensor* b1p = scope->Find(*b1n);
+    const HostTensor* b2p = scope->Find(*b2n);
+    for (const HostTensor* t : {p, g, lr, m1, m2, b1p, b2p}) {
+      if (t == nullptr) return "input not in scope";
+      if (!IsF32(*t)) return "non-f32 dtype";
+    }
+    int64_t n = NumElements(p->dims);
+    if (NumElements(g->dims) != n || NumElements(m1->dims) != n ||
+        NumElements(m2->dims) != n) {
+      return "shape mismatch";
+    }
+    if (NumElements(lr->dims) < 1 || NumElements(b1p->dims) < 1 ||
+        NumElements(b2p->dims) < 1) {
+      return "empty scalar input";
+    }
+    float beta1 = FloatAttr(op, "beta1", 0.9f);
+    float beta2 = FloatAttr(op, "beta2", 0.999f);
+    float eps = FloatAttr(op, "epsilon", 1e-8f);
+    float rate = F32(*lr)[0];
+    float b1pow = F32(*b1p)[0];
+    float b2pow = F32(*b2p)[0];
+    float lr_t = rate * std::sqrt(1.0f - b2pow) / (1.0f - b1pow);
+    HostTensor po = MakeF32(p->dims);
+    HostTensor m1o = MakeF32(p->dims);
+    HostTensor m2o = MakeF32(p->dims);
+    const float* pa = F32(*p);
+    const float* ga = F32(*g);
+    const float* m1a = F32(*m1);
+    const float* m2a = F32(*m2);
+    float* poa = MutF32(&po);
+    float* m1oa = MutF32(&m1o);
+    float* m2oa = MutF32(&m2o);
+    for (int64_t i = 0; i < n; ++i) {
+      float nm1 = beta1 * m1a[i] + (1.0f - beta1) * ga[i];
+      float nm2 = beta2 * m2a[i] + (1.0f - beta2) * ga[i] * ga[i];
+      m1oa[i] = nm1;
+      m2oa[i] = nm2;
+      poa[i] = pa[i] - lr_t * nm1 / (std::sqrt(nm2) + eps);
+    }
+    scope->Set(*pon, std::move(po));
+    scope->Set(*m1on, std::move(m1o));
+    scope->Set(*m2on, std::move(m2o));
+    return "";
+  }
+
+  // ops/optimizer_ops.py _lower_momentum (plain + nesterov)
+  std::string RunMomentum(const OpDesc& op, Scope* scope) {
+    const std::string* pn = OneName(op, "Param");
+    const std::string* gn = OneName(op, "Grad");
+    const std::string* vn = OneName(op, "Velocity");
+    const std::string* lrn = OneName(op, "LearningRate");
+    const std::string* pon = OneName(op, "ParamOut", false);
+    const std::string* von = OneName(op, "VelocityOut", false);
+    if (pn == nullptr || gn == nullptr || vn == nullptr ||
+        lrn == nullptr || pon == nullptr || von == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* p = scope->Find(*pn);
+    const HostTensor* g = scope->Find(*gn);
+    const HostTensor* v = scope->Find(*vn);
+    const HostTensor* lr = scope->Find(*lrn);
+    for (const HostTensor* t : {p, g, v, lr}) {
+      if (t == nullptr) return "input not in scope";
+      if (!IsF32(*t)) return "non-f32 dtype";
+    }
+    int64_t n = NumElements(p->dims);
+    if (NumElements(g->dims) != n || NumElements(v->dims) != n) {
+      return "shape mismatch";
+    }
+    if (NumElements(lr->dims) < 1) return "empty scalar input";
+    float mu = FloatAttr(op, "mu", 0.0f);
+    bool nesterov = IntAttr(op, "use_nesterov", 0) != 0;
+    float rate = F32(*lr)[0];
+    HostTensor po = MakeF32(p->dims);
+    HostTensor vo = MakeF32(p->dims);
+    const float* pa = F32(*p);
+    const float* ga = F32(*g);
+    const float* va = F32(*v);
+    float* poa = MutF32(&po);
+    float* voa = MutF32(&vo);
+    for (int64_t i = 0; i < n; ++i) {
+      float nv = mu * va[i] + ga[i];
+      voa[i] = nv;
+      poa[i] = nesterov ? pa[i] - (ga[i] + mu * nv) * rate
+                        : pa[i] - rate * nv;
+    }
+    scope->Set(*pon, std::move(po));
+    scope->Set(*von, std::move(vo));
+    return "";
+  }
+
+  // d tanh = (1 - out^2) * dOut; d sigmoid = out * (1 - out) * dOut
+  std::string RunTanhGrad(const OpDesc& op, Scope* scope) {
+    return RunActGradFromOut(
+        op, scope, [](float o) { return 1.0f - o * o; });
+  }
+
+  std::string RunSigmoidGrad(const OpDesc& op, Scope* scope) {
+    return RunActGradFromOut(
+        op, scope, [](float o) { return o * (1.0f - o); });
+  }
+
+  template <typename Fn>
+  std::string RunActGradFromOut(const OpDesc& op, Scope* scope, Fn dfn) {
+    const std::string* on = OneName(op, "Out");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (on == nullptr || ogn == nullptr || gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* out = scope->Find(*on);
+    const HostTensor* og = scope->Find(*ogn);
+    if (out == nullptr || og == nullptr) return "input not in scope";
+    if (!IsF32(*out) || !IsF32(*og)) return "non-f32 dtype";
+    int64_t n = NumElements(out->dims);
+    if (n != NumElements(og->dims)) return "shape mismatch";
+    HostTensor grad = MakeF32(out->dims);
+    const float* oa = F32(*out);
+    const float* ga = F32(*og);
+    float* ra = MutF32(&grad);
+    for (int64_t i = 0; i < n; ++i) ra[i] = dfn(oa[i]) * ga[i];
+    scope->Set(*gn, std::move(grad));
     return "";
   }
 

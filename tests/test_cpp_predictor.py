@@ -588,3 +588,72 @@ def test_pool_ceil_mode_train_step_parity_cpp_vs_xla(tmp_path):
                                np.ravel(np.asarray(xla_loss))[0],
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(w_cpp, w_xla, rtol=1e-3, atol=1e-5)
+
+
+def test_adam_tanh_sigmoid_train_step_parity_cpp_vs_xla(tmp_path):
+    """r5: the C++ trainer gains adam/momentum optimizer kernels and
+    tanh/sigmoid grads. One Adam step of a tanh+sigmoid MLP from
+    identical params: loss, updated weight AND updated Adam moment must
+    match the XLA executor (the beta-pow scale ops ride the existing
+    scale kernel)."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 10, act="tanh")
+        h = fluid.layers.fc(h, 8, act="sigmoid")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    rng = np.random.RandomState(21)
+    feed = {"x": rng.randn(5, 6).astype("float32"),
+            "label": rng.randint(0, 4, (5, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        w_xla = np.asarray(scope.get_value("fc_0.w_0"))
+        m_name = [n for n in scope.local_var_names()
+                  if n.startswith("fc_0.w_0_moment1")][0]
+        m_xla = np.asarray(scope.get_value(m_name))
+
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        w_cpp = ns.get("fc_0.w_0")
+        m_cpp = ns.get(m_name)
+    finally:
+        lib.ptpu_program_destroy(prog)
+    np.testing.assert_allclose(np.ravel(cpp_loss)[0],
+                               np.ravel(np.asarray(xla_loss))[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(w_cpp, w_xla, rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(m_cpp, m_xla, rtol=1e-3, atol=1e-5)
