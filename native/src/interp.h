@@ -16,6 +16,8 @@
 //   mean_grad, relu_grad, tanh_grad, sigmoid_grad, softmax_grad,
 //   cross_entropy_grad, softmax_with_cross_entropy_grad,
 //   elementwise_add_grad (incl. the broadcast bias axis), mul_grad,
+//   elementwise_{sub,mul,div}_grad (same broadcast geometry as the
+//   forwards, dY reduced), square/exp/log/sqrt grads,
 //   conv2d_grad (strides/paddings/dilations/groups, same envelope as
 //   the forward), pool2d_grad (max + avg/exclusive + ceil_mode;
 //   adaptive refused like the forward), optimizers sgd / momentum
@@ -110,6 +112,45 @@ inline const std::string* OneName(const OpDesc& op, const std::string& slot,
     if (!n.empty()) return &n;
   }
   return nullptr;
+}
+
+// Paddle axis-aligned broadcast geometry (elementwise_op_function.h):
+// default axis from the UNTRIMMED y rank, then trailing 1-dims trimmed,
+// y matching x over [ax, ax+y_rank); y's element for flat x index i is
+// ya[(i / *inner) % ny]. Shared by the forward and both grad kernels so
+// the three can never disagree (a trim-before-axis divergence in the
+// grad copy produced silently wrong broadcast gradients).
+inline std::string ResolveBroadcast(const OpDesc& op,
+                                    const std::vector<int64_t>& xdims,
+                                    const std::vector<int64_t>& ydims_in,
+                                    int64_t* inner) {
+  int64_t ax = -1;
+  auto ax_it = op.attrs.find("axis");
+  if (ax_it != op.attrs.end() && ax_it->second.tag == AttrValue::kInt) {
+    ax = ax_it->second.i;
+  }
+  if (ax < 0) {
+    ax = static_cast<int64_t>(xdims.size()) -
+         static_cast<int64_t>(ydims_in.size());
+  }
+  std::vector<int64_t> ydims = ydims_in;
+  while (ydims.size() > 1 && ydims.back() == 1) ydims.pop_back();
+  if (ax < 0 || ax + ydims.size() > xdims.size()) {
+    return "broadcast axis out of range";
+  }
+  for (size_t d = 0; d < ydims.size(); ++d) {
+    if (ydims[d] != xdims[ax + d]) return "broadcast shape mismatch";
+  }
+  int64_t nx = 1, ny = 1;
+  for (int64_t v : xdims) nx *= v;
+  for (int64_t v : ydims_in) ny *= v;
+  if (ny == 0 || nx % ny != 0) return "broadcast mismatch";
+  *inner = 1;
+  for (size_t d = ax + ydims.size(); d < xdims.size(); ++d) {
+    *inner *= xdims[d];
+  }
+  if (*inner <= 0) return "broadcast mismatch";
+  return "";
 }
 
 class Interpreter {
@@ -260,12 +301,33 @@ class Interpreter {
       return RunSCEGrad(op, scope);
     }
     if (op.type == "elementwise_add_grad") return RunAddGrad(op, scope);
+    if (op.type == "elementwise_sub_grad" ||
+        op.type == "elementwise_mul_grad" ||
+        op.type == "elementwise_div_grad") {
+      return RunEwGrad(op, scope);
+    }
     if (op.type == "mul_grad") return RunMulGrad(op, scope);
     if (op.type == "sgd") return RunSgd(op, scope);
     if (op.type == "adam") return RunAdam(op, scope);
     if (op.type == "momentum") return RunMomentum(op, scope);
     if (op.type == "tanh_grad") return RunTanhGrad(op, scope);
     if (op.type == "sigmoid_grad") return RunSigmoidGrad(op, scope);
+    if (op.type == "square_grad") {
+      return RunActGradFromX(
+          op, scope, [](float x2, float g) { return 2.0f * x2 * g; });
+    }
+    if (op.type == "exp_grad") {
+      return RunActGradFromOut(
+          op, scope, [](float o) { return o; });
+    }
+    if (op.type == "log_grad") {
+      return RunActGradFromX(
+          op, scope, [](float x2, float g) { return g / x2; });
+    }
+    if (op.type == "sqrt_grad") {
+      return RunActGradFromOut(
+          op, scope, [](float o) { return 0.5f / o; });
+    }
     return "unsupported op type";
   }
 
@@ -987,36 +1049,11 @@ class Interpreter {
     const HostTensor* y = scope->Find(*yn);
     if (x == nullptr || y == nullptr) return "input not in scope";
     if (!IsF32(*x) || !IsF32(*y)) return "non-f32 dtype";
-    // Paddle broadcast: y's dims align with x starting at `axis`
-    // (elementwise_op_function.h). inner = x dims after the aligned span.
-    int64_t ax = -1;
-    auto ax_it = op.attrs.find("axis");
-    if (ax_it != op.attrs.end() && ax_it->second.tag == AttrValue::kInt) {
-      ax = ax_it->second.i;
-    }
-    if (ax < 0) {
-      ax = static_cast<int64_t>(x->dims.size()) -
-           static_cast<int64_t>(y->dims.size());
-    }
-    // Paddle trims y's trailing 1-dims, then y must match x exactly over
-    // the aligned span [ax, ax + y_rank).
-    std::vector<int64_t> ydims = y->dims;
-    while (ydims.size() > 1 && ydims.back() == 1) ydims.pop_back();
-    if (ax < 0 ||
-        ax + ydims.size() > x->dims.size()) {
-      return "broadcast axis out of range";
-    }
-    for (size_t d = 0; d < ydims.size(); ++d) {
-      if (ydims[d] != x->dims[ax + d]) return "broadcast shape mismatch";
-    }
     int64_t nx = NumElements(x->dims);
     int64_t ny = NumElements(y->dims);
-    if (ny == 0 || nx % ny != 0) return "broadcast mismatch";
     int64_t inner = 1;
-    for (size_t d = ax + ydims.size(); d < x->dims.size(); ++d) {
-      inner *= x->dims[d];
-    }
-    if (inner <= 0) return "broadcast mismatch";
+    std::string berr = ResolveBroadcast(op, x->dims, y->dims, &inner);
+    if (!berr.empty()) return berr;
     HostTensor out = MakeF32(x->dims);
     const float* xa = F32(*x);
     const float* ya = F32(*y);
@@ -2195,8 +2232,34 @@ class Interpreter {
   }
 
   std::string RunReluGrad(const OpDesc& op, Scope* scope) {
-    return RunActGradFromOut(
-        op, scope, [](float o) { return o > 0.0f ? 1.0f : 0.0f; });
+    // select form, not multiply: inactive units mask a NaN/Inf upstream
+    // gradient to exact 0, matching jnp.where in the XLA vjp
+    return RunActGradMaskFromOut(
+        op, scope, [](float o) { return o > 0.0f; });
+  }
+
+  template <typename Pred>
+  std::string RunActGradMaskFromOut(const OpDesc& op, Scope* scope,
+                                    Pred keep) {
+    const std::string* on = OneName(op, "Out");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (on == nullptr || ogn == nullptr || gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* out = scope->Find(*on);
+    const HostTensor* og = scope->Find(*ogn);
+    if (out == nullptr || og == nullptr) return "input not in scope";
+    if (!IsF32(*out) || !IsF32(*og)) return "non-f32 dtype";
+    int64_t n = NumElements(out->dims);
+    if (n != NumElements(og->dims)) return "shape mismatch";
+    HostTensor grad = MakeF32(out->dims);
+    const float* oa = F32(*out);
+    const float* ga = F32(*og);
+    float* ra = MutF32(&grad);
+    for (int64_t i = 0; i < n; ++i) ra[i] = keep(oa[i]) ? ga[i] : 0.0f;
+    scope->Set(*gn, std::move(grad));
+    return "";
   }
 
   std::string RunSCEGrad(const OpDesc& op, Scope* scope) {
@@ -2240,6 +2303,72 @@ class Interpreter {
     return "";
   }
 
+
+  // sub/mul/div backward with the same broadcast mapping the forward
+  // uses (y index = (i / inner) %% ny); dY reduces over the broadcast.
+  // max/min grads stay unimplemented (tie semantics differ by backend)
+  // and refuse explicitly through the unsupported-op path.
+  std::string RunEwGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* yn = OneName(op, "Y");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    if (xn == nullptr || yn == nullptr || ogn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* y = scope->Find(*yn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || y == nullptr || og == nullptr) {
+      return "input not in scope";
+    }
+    if (!IsF32(*x) || !IsF32(*y) || !IsF32(*og)) return "non-f32 dtype";
+    int64_t n = NumElements(og->dims);
+    if (NumElements(x->dims) != n) return "shape mismatch";
+    int64_t ny = NumElements(y->dims);
+    int64_t inner = 1;
+    std::string berr = ResolveBroadcast(op, x->dims, y->dims, &inner);
+    if (!berr.empty()) return berr;
+    int kind = op.type == "elementwise_sub_grad"
+                   ? 0
+                   : (op.type == "elementwise_mul_grad" ? 1 : 2);
+    const float* xa = F32(*x);
+    const float* ya = F32(*y);
+    const float* ga = F32(*og);
+    const std::string* xgn = OneName(op, "X@GRAD", false);
+    if (xgn != nullptr) {
+      HostTensor xg = MakeF32(x->dims);
+      float* ra = MutF32(&xg);
+      for (int64_t i = 0; i < n; ++i) {
+        float yv = ya[ny == n ? i : (i / inner) % ny];
+        float g = ga[i];
+        ra[i] = kind == 0 ? g : (kind == 1 ? g * yv : g / yv);
+      }
+      scope->Set(*xgn, std::move(xg));
+    }
+    const std::string* ygn = OneName(op, "Y@GRAD", false);
+    if (ygn != nullptr) {
+      HostTensor yg = MakeF32(y->dims);
+      float* ra = MutF32(&yg);
+      std::fill(ra, ra + ny, 0.0f);
+      for (int64_t i = 0; i < n; ++i) {
+        int64_t yi = ny == n ? i : (i / inner) % ny;
+        float yv = ya[yi];
+        float g = ga[i];
+        float contrib;
+        if (kind == 0) {
+          contrib = -g;
+        } else if (kind == 1) {
+          contrib = g * xa[i];
+        } else {
+          contrib = -g * xa[i] / (yv * yv);
+        }
+        ra[yi] += contrib;
+      }
+      scope->Set(*ygn, std::move(yg));
+    }
+    return "";
+  }
+
   std::string RunAddGrad(const OpDesc& op, Scope* scope) {
     const std::string* xn = OneName(op, "X");
     const std::string* yn = OneName(op, "Y");
@@ -2265,28 +2394,10 @@ class Interpreter {
     if (ygn != nullptr) {
       // reduce dOut onto y with the SAME index mapping the forward
       // broadcast used: y element of out[i] is (i / inner) % ny
-      int64_t ax = IntAttr(op, "axis", -1);
-      if (ax < 0) {
-        ax = static_cast<int64_t>(x->dims.size()) -
-             static_cast<int64_t>(y->dims.size());
-      }
-      std::vector<int64_t> ydims = y->dims;
-      while (ydims.size() > 1 && ydims.back() == 1) ydims.pop_back();
-      if (ax < 0 || ax + ydims.size() > x->dims.size()) {
-        return "broadcast axis out of range";
-      }
-      for (size_t d = 0; d < ydims.size(); ++d) {
-        if (ydims[d] != x->dims[ax + d]) {
-          return "broadcast shape mismatch";
-        }
-      }
       int64_t yn_elems = NumElements(y->dims);
-      if (yn_elems == 0 || n % yn_elems != 0) return "bad broadcast";
       int64_t inner = 1;
-      for (size_t d = ax + ydims.size(); d < x->dims.size(); ++d) {
-        inner *= x->dims[d];
-      }
-      if (inner <= 0) return "bad broadcast";
+      std::string berr = ResolveBroadcast(op, x->dims, y->dims, &inner);
+      if (!berr.empty()) return berr;
       HostTensor yg = MakeF32(y->dims);
       float* ya = MutF32(&yg);
       std::fill(ya, ya + yn_elems, 0.0f);
@@ -2512,6 +2623,31 @@ class Interpreter {
   std::string RunSigmoidGrad(const OpDesc& op, Scope* scope) {
     return RunActGradFromOut(
         op, scope, [](float o) { return o * (1.0f - o); });
+  }
+
+
+  // grads expressed in terms of the forward INPUT (square, log, ...)
+  template <typename Fn>
+  std::string RunActGradFromX(const OpDesc& op, Scope* scope, Fn dfn) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (xn == nullptr || ogn == nullptr || gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || og == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*og)) return "non-f32 dtype";
+    int64_t n = NumElements(x->dims);
+    if (n != NumElements(og->dims)) return "shape mismatch";
+    HostTensor grad = MakeF32(x->dims);
+    const float* xa = F32(*x);
+    const float* ga = F32(*og);
+    float* ra = MutF32(&grad);
+    for (int64_t i = 0; i < n; ++i) ra[i] = dfn(xa[i], ga[i]);
+    scope->Set(*gn, std::move(grad));
+    return "";
   }
 
   template <typename Fn>

@@ -657,3 +657,139 @@ def test_adam_tanh_sigmoid_train_step_parity_cpp_vs_xla(tmp_path):
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(w_cpp, w_xla, rtol=1e-3, atol=1e-5)
     np.testing.assert_allclose(m_cpp, m_xla, rtol=1e-3, atol=1e-5)
+
+
+def test_elementwise_grads_train_step_parity_cpp_vs_xla(tmp_path):
+    """r5: sub/mul/div backward in C++ (broadcast-reducing dY like the
+    add grad). One SGD step of a net exercising all three with a
+    broadcast scale parameter: loss + updated scale must match XLA."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3, 4], dtype="float32")
+        t = fluid.layers.data(name="t", shape=[3, 4], dtype="float32")
+        h = fluid.layers.fc(x, 4, num_flatten_dims=2, act="tanh",
+                            name="ew_fc")
+        scale = fluid.layers.create_parameter(
+            [4], "float32", name="ew_scale",
+            default_initializer=fluid.initializer.Constant(1.5))
+        h = fluid.layers.elementwise_mul(h, scale, axis=2)
+        h = fluid.layers.elementwise_div(
+            h, fluid.layers.scale(t, scale=0.5, bias=2.0))
+        d = fluid.layers.elementwise_sub(h, t)
+        loss = fluid.layers.mean(fluid.layers.square(d))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    rng = np.random.RandomState(33)
+    feed = {"x": rng.randn(2, 3, 4).astype("float32"),
+            "t": rng.randn(2, 3, 4).astype("float32")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        s_xla = np.asarray(scope.get_value("ew_scale.w_0"))
+        w_xla = np.asarray(scope.get_value("ew_fc.w_0"))
+
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        s_cpp = ns.get("ew_scale.w_0")
+        w_cpp = ns.get("ew_fc.w_0")
+    finally:
+        lib.ptpu_program_destroy(prog)
+    np.testing.assert_allclose(np.ravel(cpp_loss)[0],
+                               np.ravel(np.asarray(xla_loss))[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(s_cpp, s_xla, rtol=1e-3, atol=1e-5,
+                               err_msg="broadcast dY reduction diverged")
+    np.testing.assert_allclose(w_cpp, w_xla, rtol=1e-3, atol=1e-5)
+
+
+def test_elementwise_grad_trailing_one_broadcast_parity(tmp_path):
+    """Review-found geometry corner: y with a TRAILING 1 dim under the
+    default axis (x [B,4,1]-style). The grad must resolve the axis from
+    the untrimmed y rank exactly like the forward (shared
+    ResolveBroadcast); the divergent trim-first version computed dY
+    with the wrong index mapping."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4, 3], dtype="float32")
+        t = fluid.layers.data(name="t", shape=[4, 3], dtype="float32")
+        # rowscale [4, 1]: trailing-1 y, default axis -> aligns at dim 1
+        rows = fluid.layers.create_parameter(
+            [4, 1], "float32", name="rowscale",
+            default_initializer=fluid.initializer.Constant(1.2))
+        h = fluid.layers.elementwise_mul(x, rows)
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(h, t)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(9)
+    feed = {"x": rng.randn(2, 4, 3).astype("float32"),
+            "t": rng.randn(2, 4, 3).astype("float32")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        r_xla = np.asarray(scope.get_value("rowscale.w_0"))
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        r_cpp = ns.get("rowscale.w_0")
+    finally:
+        lib.ptpu_program_destroy(prog)
+    np.testing.assert_allclose(np.ravel(cpp_loss)[0],
+                               np.ravel(np.asarray(xla_loss))[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(r_cpp, r_xla, rtol=1e-3, atol=1e-5,
+                               err_msg="trailing-1 broadcast dY diverged")
