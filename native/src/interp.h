@@ -153,6 +153,43 @@ inline std::string ResolveBroadcast(const OpDesc& op,
   return "";
 }
 
+// Shared reduce geometry for RunReduce / RunReduceGrad (forward and
+// backward must parse dims identically — the ResolveBroadcast lesson):
+// fills the reduced mask and the reduced-element count.
+inline std::string ResolveReduce(const OpDesc& op,
+                                 const std::vector<int64_t>& xdims,
+                                 std::vector<bool>* reduced,
+                                 int64_t* denom) {
+  size_t rank = xdims.size();
+  reduced->assign(rank, false);
+  std::vector<int64_t> dims;
+  auto it = op.attrs.find("dim");
+  if (it != op.attrs.end() && it->second.tag == AttrValue::kInts) {
+    dims = it->second.ints;
+  } else {
+    dims = {0};
+  }
+  bool all = false;
+  auto ra = op.attrs.find("reduce_all");
+  if (ra != op.attrs.end() && ra->second.tag == AttrValue::kInt) {
+    all = ra->second.i != 0;
+  }
+  if (all) {
+    reduced->assign(rank, true);
+  } else {
+    for (int64_t d : dims) {
+      if (d < 0) d += rank;
+      if (d < 0 || d >= static_cast<int64_t>(rank)) return "bad dim";
+      (*reduced)[d] = true;
+    }
+  }
+  *denom = 1;
+  for (size_t d = 0; d < rank; ++d) {
+    if ((*reduced)[d]) *denom *= xdims[d];
+  }
+  return "";
+}
+
 class Interpreter {
  public:
   explicit Interpreter(const ProgramDesc& prog) : prog_(prog) {}
@@ -308,6 +345,13 @@ class Interpreter {
     }
     if (op.type == "mul_grad") return RunMulGrad(op, scope);
     if (op.type == "sgd") return RunSgd(op, scope);
+    if (op.type == "dynamic_lstm_grad") {
+      return RunDynamicLstmGrad(op, scope);
+    }
+    if (op.type == "reduce_mean_grad" || op.type == "reduce_sum_grad") {
+      return RunReduceGrad(op, scope,
+                           op.type == "reduce_mean_grad");
+    }
     if (op.type == "adam") return RunAdam(op, scope);
     if (op.type == "momentum") return RunMomentum(op, scope);
     if (op.type == "tanh_grad") return RunTanhGrad(op, scope);
@@ -592,18 +636,11 @@ class Interpreter {
     const HostTensor* x = scope->Find(*xn);
     if (x == nullptr || !IsF32(*x)) return "bad input";
     size_t rank = x->dims.size();
-    std::vector<int64_t> dims = IntsAttr(op, "dim", {0});
     bool keep = IntAttr(op, "keep_dim", 0) != 0;
-    std::vector<bool> reduced(rank, false);
-    if (IntAttr(op, "reduce_all", 0) != 0) {
-      reduced.assign(rank, true);
-    } else {
-      for (int64_t d : dims) {
-        if (d < 0) d += rank;
-        if (d < 0 || d >= static_cast<int64_t>(rank)) return "bad dim";
-        reduced[d] = true;
-      }
-    }
+    std::vector<bool> reduced;
+    int64_t rdenom = 1;
+    std::string rerr = ResolveReduce(op, x->dims, &reduced, &rdenom);
+    if (!rerr.empty()) return rerr;
     std::vector<int64_t> odims;
     for (size_t d = 0; d < rank; ++d) {
       if (!reduced[d]) {
@@ -2074,6 +2111,294 @@ class Interpreter {
       }
     }
     scope->Set(*hn, std::move(hidden));
+    return "";
+  }
+
+
+
+  // reduce_{sum,mean} backward: broadcast dOut back over the reduced
+  // dims (divided by the reduced count for mean) — adjoint of RunReduce
+  std::string RunReduceGrad(const OpDesc& op, Scope* scope, bool mean) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (xn == nullptr || ogn == nullptr || gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || og == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*og)) return "non-f32 dtype";
+    size_t rank = x->dims.size();
+    std::vector<bool> reduced;
+    int64_t denom = 1;
+    std::string rerr = ResolveReduce(op, x->dims, &reduced, &denom);
+    if (!rerr.empty()) return rerr;
+    // flat index mapping: out strides over non-reduced dims only
+    std::vector<int64_t> ostride(rank, 0);
+    int64_t run = 1;
+    for (size_t d = rank; d-- > 0;) {
+      if (!reduced[d]) {
+        ostride[d] = run;
+        run *= x->dims[d];
+      }
+    }
+    if (NumElements(og->dims) != run) return "dOut size mismatch";
+    HostTensor grad = MakeF32(x->dims);
+    const float* ga = F32(*og);
+    float* ra = MutF32(&grad);
+    float scale = mean ? 1.0f / static_cast<float>(denom) : 1.0f;
+    std::vector<int64_t> idx(rank, 0);
+    int64_t total = NumElements(x->dims);
+    for (int64_t i = 0; i < total; ++i) {
+      int64_t oi = 0;
+      for (size_t d = 0; d < rank; ++d) oi += idx[d] * ostride[d];
+      ra[i] = ga[oi] * scale;
+      for (size_t d = rank; d-- > 0;) {
+        if (++idx[d] < x->dims[d]) break;
+        idx[d] = 0;
+      }
+    }
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  // derivative of an activation expressed via its OUTPUT value
+  static std::function<float(float)> ActDeriv(const std::string& name,
+                                              bool* ok) {
+    *ok = true;
+    if (name == "sigmoid") return [](float a) { return a * (1.0f - a); };
+    if (name == "tanh") return [](float a) { return 1.0f - a * a; };
+    if (name == "relu") return [](float a) { return a > 0.0f ? 1.0f : 0.0f; };
+    if (name == "identity") return [](float a) { return 1.0f; };
+    *ok = false;
+    return [](float a) { return 0.0f; };
+  }
+
+  // BPTT for dynamic_lstm (adjoint of RunDynamicLstm's recurrence):
+  // gates recomputed from Input/Weight/Bias + the stored Hidden/Cell
+  // sequences (h_prev/c_prev are the PREVIOUS ITERATION index's stored
+  // rows — invalid padded steps store the carried state, so the lookup
+  // is uniform); padded steps pass dh/dc straight through, exactly the
+  // masked-scan vjp of the XLA lowering. Peepholes included.
+  std::string RunDynamicLstmGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "Input");
+    const std::string* wn = OneName(op, "Weight");
+    const std::string* hn = OneName(op, "Hidden");
+    const std::string* cn = OneName(op, "Cell");
+    const std::string* hgn = OneName(op, "Hidden@GRAD");
+    if (xn == nullptr || wn == nullptr || hn == nullptr ||
+        cn == nullptr) {
+      return "missing io";
+    }
+    if (OneName(op, "H0") != nullptr || OneName(op, "C0") != nullptr) {
+      return "H0/C0 initial state not supported";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* w = scope->Find(*wn);
+    const HostTensor* hid = scope->Find(*hn);
+    const HostTensor* cel = scope->Find(*cn);
+    // Hidden@GRAD is optional like Cell@GRAD (a loss can touch only
+    // the cell output); missing means zero incoming rows
+    const HostTensor* hg = hgn != nullptr ? scope->Find(*hgn) : nullptr;
+    for (const HostTensor* tt : {x, w, hid, cel}) {
+      if (tt == nullptr) return "input not in scope";
+      if (!IsF32(*tt)) return "non-f32 dtype";
+    }
+    if (hgn != nullptr && hg == nullptr) return "input not in scope";
+    if (hg != nullptr && !IsF32(*hg)) return "non-f32 dtype";
+    if (x->dims.size() != 3 || w->dims.size() != 2) return "bad ranks";
+    int64_t b = x->dims[0], t = x->dims[1], d = w->dims[0];
+    if (x->dims[2] != 4 * d || w->dims[1] != 4 * d) return "gate dims";
+    if (hid->dims != std::vector<int64_t>({b, t, d}) ||
+        cel->dims != hid->dims ||
+        (hg != nullptr && hg->dims != hid->dims)) {
+      return "stored state shape";
+    }
+    bool peephole = IntAttr(op, "use_peepholes", 1) != 0;
+    bool reverse = IntAttr(op, "is_reverse", 0) != 0;
+    bool ok1 = true, ok2 = true, ok3 = true, ok4 = true, ok5 = true,
+         ok6 = true;
+    std::string gname = StrAttr(op, "gate_activation", "sigmoid");
+    std::string cname = StrAttr(op, "cell_activation", "tanh");
+    std::string dname = StrAttr(op, "candidate_activation", "tanh");
+    auto gate_act = ActFn(gname, &ok1);
+    auto cell_act = ActFn(cname, &ok2);
+    auto cand_act = ActFn(dname, &ok3);
+    auto gate_der = ActDeriv(gname, &ok4);
+    auto cell_der = ActDeriv(cname, &ok5);
+    auto cand_der = ActDeriv(dname, &ok6);
+    if (!ok1 || !ok2 || !ok3 || !ok4 || !ok5 || !ok6) {
+      return "unsupported activation";
+    }
+    const float* bias = nullptr;
+    const std::string* bn = OneName(op, "Bias");
+    if (bn != nullptr) {
+      const HostTensor* bt = scope->Find(*bn);
+      if (bt == nullptr) return "Bias not in scope";
+      if (!IsF32(*bt)) return "non-f32 bias";
+      int64_t need = peephole ? 7 * d : 4 * d;
+      if (NumElements(bt->dims) < need) return "bias too small";
+      bias = F32(*bt);
+    }
+    const HostTensor* cg_t = nullptr;
+    const std::string* cgn = OneName(op, "Cell@GRAD");
+    if (cgn != nullptr) {
+      cg_t = scope->Find(*cgn);
+      if (cg_t != nullptr &&
+          (!IsF32(*cg_t) || cg_t->dims != hid->dims)) {
+        return "cell grad shape";
+      }
+    }
+    std::vector<int64_t> lens;
+    std::string err = RowLengths(op, scope, b, t, &lens);
+    if (!err.empty()) return err;
+
+    const float* xa = F32(*x);
+    const float* wa = F32(*w);
+    const float* ha = F32(*hid);
+    const float* ca = F32(*cel);
+    const float* hga = hg != nullptr ? F32(*hg) : nullptr;
+    const float* cga = cg_t != nullptr ? F32(*cg_t) : nullptr;
+
+    const std::string* xgn = OneName(op, "Input@GRAD", false);
+    const std::string* wgn = OneName(op, "Weight@GRAD", false);
+    const std::string* bgn = OneName(op, "Bias@GRAD", false);
+    HostTensor xg, wg, bg;
+    float* xga = nullptr;
+    float* wga = nullptr;
+    float* bga = nullptr;
+    if (xgn != nullptr) {
+      xg = MakeF32(x->dims);
+      xga = MutF32(&xg);
+      std::fill(xga, xga + NumElements(x->dims), 0.0f);
+    }
+    if (wgn != nullptr) {
+      wg = MakeF32(w->dims);
+      wga = MutF32(&wg);
+      std::fill(wga, wga + NumElements(w->dims), 0.0f);
+    }
+    if (bgn != nullptr) {
+      int64_t blen = peephole ? 7 * d : 4 * d;
+      bg = MakeF32({1, blen});
+      bga = MutF32(&bg);
+      std::fill(bga, bga + blen, 0.0f);
+      if (bias == nullptr) return "Bias@GRAD without Bias";
+    }
+
+    // iterate the forward's iteration order BACKWARD
+    std::vector<float> dh(b * d, 0.0f), dc(b * d, 0.0f);
+    std::vector<float> dgates(4 * d), gates(4 * d);
+    for (int64_t step = t - 1; step >= 0; --step) {
+      int64_t s = reverse ? t - 1 - step : step;           // data index
+      int64_t sp = reverse ? t - step : step - 1;          // prev iter's
+      for (int64_t i = 0; i < b; ++i) {
+        bool valid = s < lens[i];
+        float* dhr = dh.data() + i * d;
+        float* dcr = dc.data() + i * d;
+        const float* hg_row = hga != nullptr ? hga + (i * t + s) * d
+                                             : nullptr;
+        const float* cg_row = cga != nullptr ? cga + (i * t + s) * d
+                                             : nullptr;
+        if (!valid) {
+          // padded step: output was the carried state, so its grad
+          // joins the carried adjoints unchanged
+          for (int64_t k = 0; k < d; ++k) {
+            if (hg_row != nullptr) dhr[k] += hg_row[k];
+            if (cg_row != nullptr) dcr[k] += cg_row[k];
+          }
+          continue;
+        }
+        bool has_prev = step > 0;
+        const float* hprev = has_prev ? ha + (i * t + sp) * d : nullptr;
+        const float* cprev = has_prev ? ca + (i * t + sp) * d : nullptr;
+        const float* xrow = xa + (i * t + s) * 4 * d;
+        const float* crow = ca + (i * t + s) * d;
+        // recompute pre-activation gates exactly like the forward
+        for (int64_t g = 0; g < 4 * d; ++g) {
+          float acc = xrow[g] + (bias != nullptr ? bias[g] : 0.0f);
+          if (has_prev) {
+            for (int64_t k = 0; k < d; ++k) {
+              acc += hprev[k] * wa[k * 4 * d + g];
+            }
+          }
+          gates[g] = acc;
+        }
+        for (int64_t k = 0; k < d; ++k) {
+          float cpv = has_prev ? cprev[k] : 0.0f;
+          float gi = gates[0 * d + k];
+          float gf = gates[1 * d + k];
+          float gc = gates[2 * d + k];
+          float go = gates[3 * d + k];
+          if (peephole && bias != nullptr) {
+            gi += cpv * bias[4 * d + k];
+            gf += cpv * bias[5 * d + k];
+          }
+          float iv = gate_act(gi);
+          float fv = gate_act(gf);
+          float gv = cand_act(gc);
+          float cv = crow[k];
+          if (peephole && bias != nullptr) go += cv * bias[6 * d + k];
+          float ov = gate_act(go);
+          float tc = cell_act(cv);
+
+          float dh_k = dhr[k] + (hg_row != nullptr ? hg_row[k] : 0.0f);
+          float dc_k = dcr[k] + (cg_row != nullptr ? cg_row[k] : 0.0f);
+          float dov = dh_k * tc;
+          float dgo = dov * gate_der(ov);
+          dc_k += dh_k * ov * cell_der(tc);
+          if (peephole && bias != nullptr) {
+            dc_k += dgo * bias[6 * d + k];
+            if (bga != nullptr) bga[6 * d + k] += dgo * cv;
+          }
+          float div = dc_k * gv;
+          float dgv = dc_k * iv;
+          float dfv = dc_k * cpv;
+          float dgi = div * gate_der(iv);
+          float dgf = dfv * gate_der(fv);
+          float dgc = dgv * cand_der(gv);
+          // carried adjoints for the previous iteration step
+          float dc_prev = dc_k * fv;
+          if (peephole && bias != nullptr) {
+            dc_prev += dgi * bias[4 * d + k] + dgf * bias[5 * d + k];
+            if (bga != nullptr) {
+              bga[4 * d + k] += dgi * cpv;
+              bga[5 * d + k] += dgf * cpv;
+            }
+          }
+          dcr[k] = dc_prev;
+          dgates[0 * d + k] = dgi;
+          dgates[1 * d + k] = dgf;
+          dgates[2 * d + k] = dgc;
+          dgates[3 * d + k] = dgo;
+        }
+        // dInput, dBias, dW, and dh for the previous iteration step
+        if (xga != nullptr) {
+          float* xgr = xga + (i * t + s) * 4 * d;
+          for (int64_t g = 0; g < 4 * d; ++g) xgr[g] += dgates[g];
+        }
+        if (bga != nullptr) {
+          for (int64_t g = 0; g < 4 * d; ++g) bga[g] += dgates[g];
+        }
+        if (wga != nullptr && has_prev) {
+          for (int64_t k = 0; k < d; ++k) {
+            for (int64_t g = 0; g < 4 * d; ++g) {
+              wga[k * 4 * d + g] += hprev[k] * dgates[g];
+            }
+          }
+        }
+        for (int64_t k = 0; k < d; ++k) {
+          float acc = 0.0f;
+          for (int64_t g = 0; g < 4 * d; ++g) {
+            acc += wa[k * 4 * d + g] * dgates[g];
+          }
+          dhr[k] = has_prev ? acc : 0.0f;
+        }
+      }
+    }
+    if (xgn != nullptr) scope->Set(*xgn, std::move(xg));
+    if (wgn != nullptr) scope->Set(*wgn, std::move(wg));
+    if (bgn != nullptr) scope->Set(*bgn, std::move(bg));
     return "";
   }
 

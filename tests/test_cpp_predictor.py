@@ -793,3 +793,88 @@ def test_elementwise_grad_trailing_one_broadcast_parity(tmp_path):
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(r_cpp, r_xla, rtol=1e-3, atol=1e-5,
                                err_msg="trailing-1 broadcast dY diverged")
+
+
+@pytest.mark.parametrize("peephole", [False, True])
+@pytest.mark.parametrize("reverse", [False, True])
+@pytest.mark.parametrize("with_len", [False, True])
+def test_lstm_train_step_parity_cpp_vs_xla(tmp_path, peephole, reverse,
+                                           with_len):
+    """r5: BPTT for dynamic_lstm in C++ (adjoint of the forward
+    recurrence, peepholes + reverse + padded-step pass-through). One
+    SGD step from identical params: loss, updated recurrent weight AND
+    updated bias (incl. peephole diagonals) must match the XLA
+    executor's scan vjp."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    D, B, T = 3, 2, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, 4 * D],
+                              dtype="float32")
+        t = fluid.layers.data(name="t", shape=[D], dtype="float32")
+        kwargs = {}
+        if with_len:
+            length = fluid.layers.data(name="len", shape=[1],
+                                       dtype="int64")
+            kwargs["length"] = length
+        h, _c = fluid.layers.dynamic_lstm(
+            x, size=4 * D, use_peepholes=peephole, is_reverse=reverse,
+            **kwargs)
+        pooled = fluid.layers.reduce_mean(h, dim=[1])
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pooled, t)))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+
+    rng = np.random.RandomState(4)
+    feed = {"x": rng.randn(B, T, 4 * D).astype("float32") * 0.5,
+            "t": rng.randn(B, D).astype("float32")}
+    if with_len:
+        feed["len"] = np.asarray([[T], [T - 2]], "int64")
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        w_xla = np.asarray(scope.get_value("lstm_0.w_0"))
+        b_xla = np.asarray(scope.get_value("lstm_0.w_1"))
+
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        w_cpp = ns.get("lstm_0.w_0")
+        b_cpp = ns.get("lstm_0.w_1")
+    finally:
+        lib.ptpu_program_destroy(prog)
+    np.testing.assert_allclose(np.ravel(cpp_loss)[0],
+                               np.ravel(np.asarray(xla_loss))[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        w_cpp, w_xla, rtol=2e-3, atol=1e-5,
+        err_msg="LSTM recurrent weight grad diverged")
+    np.testing.assert_allclose(
+        np.ravel(b_cpp), np.ravel(b_xla), rtol=2e-3, atol=1e-5,
+        err_msg="LSTM bias (incl. peephole) grad diverged")
