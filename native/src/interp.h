@@ -171,8 +171,14 @@ inline std::string ResolveReduce(const OpDesc& op,
   }
   bool all = false;
   auto ra = op.attrs.find("reduce_all");
-  if (ra != op.attrs.end() && ra->second.tag == AttrValue::kInt) {
-    all = ra->second.i != 0;
+  if (ra != op.attrs.end()) {
+    // the attr is serialized as BOOL (missing the kBool arm here is
+    // exactly how the MT golden caught a silent reduce_all regression)
+    if (ra->second.tag == AttrValue::kInt) {
+      all = ra->second.i != 0;
+    } else if (ra->second.tag == AttrValue::kBool) {
+      all = ra->second.b;
+    }
   }
   if (all) {
     reduced->assign(rank, true);
@@ -352,6 +358,13 @@ class Interpreter {
       return RunReduceGrad(op, scope,
                            op.type == "reduce_mean_grad");
     }
+    if (op.type == "lookup_table_grad") {
+      return RunLookupTableGrad(op, scope);
+    }
+    if (op.type == "sequence_pool_grad") {
+      return RunSeqPoolGrad(op, scope);
+    }
+    if (op.type == "sum_grad") return RunSumGrad(op, scope);
     if (op.type == "adam") return RunAdam(op, scope);
     if (op.type == "momentum") return RunMomentum(op, scope);
     if (op.type == "tanh_grad") return RunTanhGrad(op, scope);
@@ -1307,6 +1320,15 @@ class Interpreter {
       }
     }
     scope->Set(*on, std::move(out));
+    const std::string* min = OneName(op, "MaxIndex", false);
+    if (min != nullptr) {
+      // dummy like the XLA lowering (the grad recomputes its routing)
+      HostTensor mi;
+      mi.dtype = "int32";
+      mi.dims = {1};
+      mi.data.assign(sizeof(int32_t), 0);
+      scope->Set(*min, std::move(mi));
+    }
     return "";
   }
 
@@ -3070,6 +3092,138 @@ class Interpreter {
       ra[i * c + gold[i]] = -ga[i] / (p > kEps ? p : kEps);
     }
     scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+
+  // scatter-add of dOut rows into W@GRAD (padding_idx rows skipped —
+  // the forward zeroed them, so their vjp is zero)
+  std::string RunLookupTableGrad(const OpDesc& op, Scope* scope) {
+    const std::string* wn = OneName(op, "W");
+    const std::string* idn = OneName(op, "Ids");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "W@GRAD", false);
+    if (wn == nullptr || idn == nullptr || ogn == nullptr ||
+        gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* w = scope->Find(*wn);
+    const HostTensor* it = scope->Find(*idn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (w == nullptr || it == nullptr || og == nullptr) {
+      return "input not in scope";
+    }
+    if (!IsF32(*w) || !IsF32(*og) || w->dims.size() != 2) {
+      return "bad input";
+    }
+    std::vector<int64_t> ids;
+    std::string err = ReadIds(*it, &ids);
+    if (!err.empty()) return err;
+    int64_t rows = w->dims[0], d2 = w->dims[1];
+    if (NumElements(og->dims) !=
+        static_cast<int64_t>(ids.size()) * d2) {
+      return "dOut shape mismatch";
+    }
+    int64_t pad = IntAttr(op, "padding_idx", -1);
+    HostTensor grad = MakeF32(w->dims);
+    float* ra = MutF32(&grad);
+    std::fill(ra, ra + rows * d2, 0.0f);
+    const float* ga = F32(*og);
+    for (size_t i = 0; i < ids.size(); ++i) {
+      int64_t r = ids[i];
+      if (r < 0 || r >= rows) return "id out of range";
+      if (pad >= 0 && r == pad) continue;
+      for (int64_t j = 0; j < d2; ++j) {
+        ra[r * d2 + j] += ga[i * d2 + j];
+      }
+    }
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  // adjoint of RunSequencePool per pooltype; MAX routes to the first
+  // max among valid steps (continuous inputs make ties measure-zero)
+  std::string RunSeqPoolGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (xn == nullptr || ogn == nullptr || gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || og == nullptr) return "input not in scope";
+    if (!IsF32(*x) || x->dims.size() != 3 || !IsF32(*og)) {
+      return "bad input";
+    }
+    int64_t b = x->dims[0], t = x->dims[1], d2 = x->dims[2];
+    if (og->dims != std::vector<int64_t>({b, d2})) return "dOut shape";
+    std::vector<int64_t> lens;
+    std::string err = RowLengths(op, scope, b, t, &lens);
+    if (!err.empty()) return err;
+    std::string ptype = StrAttr(op, "pooltype", "AVERAGE");
+    for (char& c : ptype) c = std::toupper(c);
+    if (ptype != "MAX" && ptype != "LAST" && ptype != "FIRST" &&
+        ptype != "SUM" && ptype != "AVERAGE" && ptype != "SQRT") {
+      return "unknown pooltype " + ptype;
+    }
+    HostTensor grad = MakeF32(x->dims);
+    float* ra = MutF32(&grad);
+    std::fill(ra, ra + b * t * d2, 0.0f);
+    const float* xa = F32(*x);
+    const float* ga = F32(*og);
+    for (int64_t i = 0; i < b; ++i) {
+      int64_t len = lens[i];
+      for (int64_t j = 0; j < d2; ++j) {
+        float g = ga[i * d2 + j];
+        if (ptype == "MAX") {
+          if (len <= 0) continue;
+          int64_t best = 0;
+          float bv = xa[(i * t + 0) * d2 + j];
+          for (int64_t s2 = 1; s2 < len; ++s2) {
+            float v = xa[(i * t + s2) * d2 + j];
+            if (v > bv) {
+              bv = v;
+              best = s2;
+            }
+          }
+          ra[(i * t + best) * d2 + j] += g;
+        } else if (ptype == "LAST") {
+          ra[(i * t + std::max<int64_t>(len - 1, 0)) * d2 + j] += g;
+        } else if (ptype == "FIRST") {
+          ra[(i * t + 0) * d2 + j] += g;
+        } else {
+          float denom = 1.0f;
+          if (ptype == "AVERAGE") {
+            denom = static_cast<float>(std::max<int64_t>(len, 1));
+          } else if (ptype == "SQRT") {
+            denom = std::sqrt(
+                static_cast<float>(std::max<int64_t>(len, 1)));
+          }
+          for (int64_t s2 = 0; s2 < len; ++s2) {
+            ra[(i * t + s2) * d2 + j] += g / denom;
+          }
+        }
+      }
+    }
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  // d(sum of inputs): copy dOut to every requested X@GRAD
+  std::string RunSumGrad(const OpDesc& op, Scope* scope) {
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    if (ogn == nullptr) return "missing io";
+    const HostTensor* og = scope->Find(*ogn);
+    if (og == nullptr) return "input not in scope";
+    if (!IsF32(*og)) return "non-f32 dtype";
+    auto it = op.outputs.find("X@GRAD");
+    if (it == op.outputs.end()) return "missing io";
+    for (const std::string& nme : it->second) {
+      if (nme.empty()) continue;
+      HostTensor copy = *og;
+      scope->Set(nme, std::move(copy));
+    }
     return "";
   }
 

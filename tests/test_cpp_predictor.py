@@ -878,3 +878,68 @@ def test_lstm_train_step_parity_cpp_vs_xla(tmp_path, peephole, reverse,
     np.testing.assert_allclose(
         np.ravel(b_cpp), np.ravel(b_xla), rtol=2e-3, atol=1e-5,
         err_msg="LSTM bias (incl. peephole) grad diverged")
+
+
+def test_stacked_lstm_book_model_train_step_parity_cpp_vs_xla(tmp_path):
+    """Capstone for C++ training breadth (r5): ONE SGD step of the
+    stacked-LSTM book model — embedding, two LSTM layers, MAX sequence
+    pooling, softmax head — from identical deterministic params. Loss,
+    the embedding table grad (lookup_table_grad scatter) and an LSTM
+    weight must match the XLA executor."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.models import stacked_lstm
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss, feeds, _outs = stacked_lstm.build(
+            seq_len=6, dict_size=30, emb_dim=8, hid_dim=8,
+            stacked_num=2, class_num=3)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(15)
+    feed = {"words": rng.randint(0, 30, (3, 6)).astype("int64"),
+            "length": np.asarray([[6], [4], [2]], "int64"),
+            "label": rng.randint(0, 3, (3, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        emb_xla = np.asarray(scope.get_value("embedding_0.w_0"))
+        w_xla = np.asarray(scope.get_value("lstm_0.w_0"))
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        emb_cpp = ns.get("embedding_0.w_0")
+        w_cpp = ns.get("lstm_0.w_0")
+    finally:
+        lib.ptpu_program_destroy(prog)
+    np.testing.assert_allclose(np.ravel(cpp_loss)[0],
+                               np.ravel(np.asarray(xla_loss))[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(emb_cpp, emb_xla, rtol=2e-3, atol=1e-5,
+                               err_msg="embedding grad diverged")
+    np.testing.assert_allclose(w_cpp, w_xla, rtol=2e-3, atol=1e-5,
+                               err_msg="stacked-LSTM weight diverged")

@@ -230,6 +230,12 @@ def case_reduce(rng):
     shape = tuple(int(rng.randint(1, 5)) for _ in range(nd))
     x = _data("x", shape)
     op = rng.choice(["reduce_sum", "reduce_mean"])
+    if rng.rand() < 0.25:
+        # reduce_all path (dim=None): the attr is a BOOL — a missed
+        # kBool arm in the C++ geometry once silently reduced dim 0
+        # instead (caught by the MT golden, now pinned here)
+        v = getattr(fluid.layers, op)(x, dim=None)
+        return v, {"x": _feedval(rng, shape)}
     dims = sorted(rng.choice(nd, size=int(rng.randint(1, nd)),
                              replace=False).tolist())
     v = getattr(fluid.layers, op)(
