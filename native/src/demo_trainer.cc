@@ -14,8 +14,12 @@
 // serialize_program bytes). Feeds are fixed by the demo contract:
 //   feed_mode "mlp"  (default): "img" float32 [batch, 784]
 //   feed_mode "conv": "pixel" float32 [batch, 1, 28, 28]
-// plus "label" int64 [batch, 1] in both modes — the MLP and MNIST-conv
-// book models' surfaces (reference train/demo/demo_trainer.cc role).
+//   feed_mode "seq":  "words" int64 [batch, 16] (two token-band
+//                     classes over a 50-word vocab) + "length" int64
+//                     [batch, 1] (all 16)
+// plus "label" int64 [batch, 1] in every mode — the MLP, MNIST-conv
+// and stacked-LSTM book models' surfaces (train/demo/demo_trainer.cc
+// role).
 
 #include <cmath>
 #include <cstdint>
@@ -58,7 +62,9 @@ using Rng = ptpu::interp::XorShiftRng;
 int main(int argc, char** argv) {
   if (argc < 3) {
     std::fprintf(stderr,
-                 "usage: %s <dir> <loss_var> [steps] [batch]\n", argv[0]);
+                 "usage: %s <dir> <loss_var> [steps] [batch] "
+                 "[feed_mode mlp|conv|seq]\n",
+                 argv[0]);
     return 2;
   }
   std::string dir = argv[1];
@@ -66,8 +72,8 @@ int main(int argc, char** argv) {
   int steps = argc > 3 ? std::atoi(argv[3]) : 40;
   int batch = argc > 4 ? std::atoi(argv[4]) : 32;
   std::string feed_mode = argc > 5 ? argv[5] : "mlp";
-  if (feed_mode != "mlp" && feed_mode != "conv") {
-    std::fprintf(stderr, "unknown feed_mode %s (mlp|conv)\n",
+  if (feed_mode != "mlp" && feed_mode != "conv" && feed_mode != "seq") {
+    std::fprintf(stderr, "unknown feed_mode %s (mlp|conv|seq)\n",
                  feed_mode.c_str());
     return 2;
   }
@@ -98,7 +104,41 @@ int main(int argc, char** argv) {
   ptpu::interp::Interpreter trainer(main_prog);
   Rng rng(7);
   float first_loss = 0.0f, last_loss = 0.0f;
+  const int kSeqLen = 16, kVocab = 50;
   for (int step = 0; step < steps; ++step) {
+    if (feed_mode == "seq") {
+      // two learnable classes: tokens drawn from disjoint vocab bands
+      ptpu::HostTensor words;
+      words.dtype = "int64";
+      words.dims = {batch, kSeqLen};
+      words.data.resize(static_cast<size_t>(batch) * kSeqLen *
+                        sizeof(int64_t));
+      int64_t* wa2 = reinterpret_cast<int64_t*>(words.data.data());
+      ptpu::HostTensor lens;
+      lens.dtype = "int64";
+      lens.dims = {batch, 1};
+      lens.data.resize(static_cast<size_t>(batch) * sizeof(int64_t));
+      int64_t* la2 = reinterpret_cast<int64_t*>(lens.data.data());
+      ptpu::HostTensor label;
+      label.dtype = "int64";
+      label.dims = {batch, 1};
+      label.data.resize(static_cast<size_t>(batch) * sizeof(int64_t));
+      int64_t* lb2 = reinterpret_cast<int64_t*>(label.data.data());
+      for (int b2 = 0; b2 < batch; ++b2) {
+        int64_t cls = static_cast<int64_t>(rng.next() % 2);
+        lb2[b2] = cls;
+        la2[b2] = kSeqLen;
+        int64_t lo = cls == 0 ? 1 : kVocab / 2;
+        int64_t band = kVocab / 2 - 1;
+        for (int t2 = 0; t2 < kSeqLen; ++t2) {
+          wa2[b2 * kSeqLen + t2] =
+              lo + static_cast<int64_t>(rng.next() % band);
+        }
+      }
+      scope.Set("words", std::move(words));
+      scope.Set("length", std::move(lens));
+      scope.Set("label", std::move(label));
+    } else {
     ptpu::HostTensor img;
     img.dtype = "float32";
     if (feed_mode == "conv") {
@@ -125,6 +165,7 @@ int main(int argc, char** argv) {
     }
     scope.Set(feed_mode == "conv" ? "pixel" : "img", std::move(img));
     scope.Set("label", std::move(label));
+    }
 
     err = trainer.Run(0, &scope);
     if (!err.empty()) {

@@ -943,3 +943,32 @@ def test_stacked_lstm_book_model_train_step_parity_cpp_vs_xla(tmp_path):
                                err_msg="embedding grad diverged")
     np.testing.assert_allclose(w_cpp, w_xla, rtol=2e-3, atol=1e-5,
                                err_msg="stacked-LSTM weight diverged")
+
+
+def test_demo_trainer_binary_trains_stacked_lstm(tmp_path):
+    """The C++-only trainer binary now covers the SEQUENCE book-model
+    family: the stacked-LSTM sentiment model (embedding + LSTMs + MAX
+    pooling) trains loss-down on synthetic token-band classes with no
+    Python in the process."""
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.models import stacked_lstm
+
+    binary = _demo_binary("ptpu_demo_trainer")
+    if binary is None:
+        pytest.skip("cmake/ninja unavailable to build the demo binary")
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss, _feeds, _outs = stacked_lstm.build(
+            seq_len=16, dict_size=50, emb_dim=12, hid_dim=12,
+            stacked_num=2, class_num=2)
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    (tmp_path / "main.ptpb").write_bytes(serialize_program(main))
+    (tmp_path / "startup.ptpb").write_bytes(serialize_program(startup))
+    res = subprocess.run(
+        [binary, str(tmp_path), loss.name, "30", "16", "seq"],
+        capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr + res.stdout
+    last_line = res.stdout.strip().splitlines()[-1]
+    first, last = float(last_line.split()[1]), float(last_line.split()[3])
+    assert last < 0.6 * first, res.stdout
