@@ -139,31 +139,31 @@ int main(int argc, char** argv) {
       scope.Set("length", std::move(lens));
       scope.Set("label", std::move(label));
     } else {
-    ptpu::HostTensor img;
-    img.dtype = "float32";
-    if (feed_mode == "conv") {
-      img.dims = {batch, 1, 28, 28};  // same 784 pixels, NCHW
-    } else {
-      img.dims = {batch, kDim};
-    }
-    img.data.resize(static_cast<size_t>(batch) * kDim * sizeof(float));
-    float* ia = reinterpret_cast<float*>(img.data.data());
-    ptpu::HostTensor label;
-    label.dtype = "int64";
-    label.dims = {batch, 1};
-    label.data.resize(static_cast<size_t>(batch) * sizeof(int64_t));
-    int64_t* la = reinterpret_cast<int64_t*>(label.data.data());
-    for (int b = 0; b < batch; ++b) {
-      int64_t cls = static_cast<int64_t>(rng.next() % kClasses);
-      la[b] = cls;
-      for (int d = 0; d < kDim; ++d) {
-        float noise = rng.uniform();
-        ia[b * kDim + d] =
-            (0.75f * templates[cls * kDim + d] + 0.25f * noise) * 2.0f -
-            1.0f;
+      ptpu::HostTensor img;
+      img.dtype = "float32";
+      if (feed_mode == "conv") {
+        img.dims = {batch, 1, 28, 28};  // same 784 pixels, NCHW
+      } else {
+        img.dims = {batch, kDim};
       }
-    }
-    scope.Set(feed_mode == "conv" ? "pixel" : "img", std::move(img));
+      img.data.resize(static_cast<size_t>(batch) * kDim * sizeof(float));
+      float* ia = reinterpret_cast<float*>(img.data.data());
+      ptpu::HostTensor label;
+      label.dtype = "int64";
+      label.dims = {batch, 1};
+      label.data.resize(static_cast<size_t>(batch) * sizeof(int64_t));
+      int64_t* la = reinterpret_cast<int64_t*>(label.data.data());
+      for (int b = 0; b < batch; ++b) {
+        int64_t cls = static_cast<int64_t>(rng.next() % kClasses);
+        la[b] = cls;
+        for (int d = 0; d < kDim; ++d) {
+          float noise = rng.uniform();
+          ia[b * kDim + d] =
+              (0.75f * templates[cls * kDim + d] + 0.25f * noise) * 2.0f -
+              1.0f;
+        }
+      }
+      scope.Set(feed_mode == "conv" ? "pixel" : "img", std::move(img));
     scope.Set("label", std::move(label));
     }
 

@@ -354,6 +354,9 @@ class Interpreter {
     if (op.type == "dynamic_lstm_grad") {
       return RunDynamicLstmGrad(op, scope);
     }
+    if (op.type == "dynamic_gru_grad") {
+      return RunDynamicGruGrad(op, scope);
+    }
     if (op.type == "reduce_mean_grad" || op.type == "reduce_sum_grad") {
       return RunReduceGrad(op, scope,
                            op.type == "reduce_mean_grad");
@@ -365,6 +368,13 @@ class Interpreter {
       return RunSeqPoolGrad(op, scope);
     }
     if (op.type == "sum_grad") return RunSumGrad(op, scope);
+    if (op.type == "reshape_grad" || op.type == "flatten_grad" ||
+        op.type == "reshape2_grad" || op.type == "flatten2_grad") {
+      return RunReshapeGrad(op, scope);
+    }
+    if (op.type == "transpose_grad" || op.type == "transpose2_grad") {
+      return RunTransposeGrad(op, scope);
+    }
     if (op.type == "adam") return RunAdam(op, scope);
     if (op.type == "momentum") return RunMomentum(op, scope);
     if (op.type == "tanh_grad") return RunTanhGrad(op, scope);
@@ -2197,6 +2207,191 @@ class Interpreter {
     return [](float a) { return 0.0f; };
   }
 
+
+  // BPTT for dynamic_gru (adjoint of RunDynamicGru's recurrence);
+  // padded steps pass dh through like the LSTM grad
+  std::string RunDynamicGruGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "Input");
+    const std::string* wn = OneName(op, "Weight");
+    const std::string* hn = OneName(op, "Hidden");
+    const std::string* hgn = OneName(op, "Hidden@GRAD");
+    if (xn == nullptr || wn == nullptr || hn == nullptr) {
+      return "missing io";
+    }
+    if (OneName(op, "H0") != nullptr) {
+      return "H0 initial state not supported";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* w = scope->Find(*wn);
+    const HostTensor* hid = scope->Find(*hn);
+    const HostTensor* hg = hgn != nullptr ? scope->Find(*hgn) : nullptr;
+    for (const HostTensor* tt : {x, w, hid}) {
+      if (tt == nullptr) return "input not in scope";
+      if (!IsF32(*tt)) return "non-f32 dtype";
+    }
+    if (hgn != nullptr && hg == nullptr) return "input not in scope";
+    if (hg != nullptr && !IsF32(*hg)) return "non-f32 dtype";
+    if (x->dims.size() != 3 || w->dims.size() != 2) return "bad ranks";
+    int64_t b = x->dims[0], t = x->dims[1], d = w->dims[0];
+    if (x->dims[2] != 3 * d || w->dims[1] != 3 * d) return "gate dims";
+    if (hid->dims != std::vector<int64_t>({b, t, d}) ||
+        (hg != nullptr && hg->dims != hid->dims)) {
+      return "stored state shape";
+    }
+    bool reverse = IntAttr(op, "is_reverse", 0) != 0;
+    bool ok1 = true, ok2 = true, ok3 = true, ok4 = true;
+    std::string gname = StrAttr(op, "gate_activation", "sigmoid");
+    std::string cname = StrAttr(op, "activation", "tanh");
+    auto gate_act = ActFn(gname, &ok1);
+    auto cand_act = ActFn(cname, &ok2);
+    auto gate_der = ActDeriv(gname, &ok3);
+    auto cand_der = ActDeriv(cname, &ok4);
+    if (!ok1 || !ok2 || !ok3 || !ok4) return "unsupported activation";
+    const float* bias = nullptr;
+    const std::string* bn = OneName(op, "Bias");
+    if (bn != nullptr) {
+      const HostTensor* bt = scope->Find(*bn);
+      if (bt == nullptr) return "Bias not in scope";
+      if (!IsF32(*bt) || NumElements(bt->dims) < 3 * d) return "bad bias";
+      bias = F32(*bt);
+    }
+    std::vector<int64_t> lens;
+    std::string err = RowLengths(op, scope, b, t, &lens);
+    if (!err.empty()) return err;
+
+    const float* xa = F32(*x);
+    const float* wa = F32(*w);
+    const float* ha = F32(*hid);
+    const float* hga = hg != nullptr ? F32(*hg) : nullptr;
+
+    const std::string* xgn = OneName(op, "Input@GRAD", false);
+    const std::string* wgn = OneName(op, "Weight@GRAD", false);
+    const std::string* bgn = OneName(op, "Bias@GRAD", false);
+    HostTensor xg, wg, bg;
+    float* xga = nullptr;
+    float* wga = nullptr;
+    float* bga = nullptr;
+    if (xgn != nullptr) {
+      xg = MakeF32(x->dims);
+      xga = MutF32(&xg);
+      std::fill(xga, xga + NumElements(x->dims), 0.0f);
+    }
+    if (wgn != nullptr) {
+      wg = MakeF32(w->dims);
+      wga = MutF32(&wg);
+      std::fill(wga, wga + NumElements(w->dims), 0.0f);
+    }
+    if (bgn != nullptr) {
+      bg = MakeF32({1, 3 * d});
+      bga = MutF32(&bg);
+      std::fill(bga, bga + 3 * d, 0.0f);
+      if (bias == nullptr) return "Bias@GRAD without Bias";
+    }
+
+    std::vector<float> dh(b * d, 0.0f);
+    std::vector<float> g2(2 * d), rh(d), cpre(d), cval(d), uval(d),
+        rval(d), dg(2 * d), dcpre(d), drh(d);
+    for (int64_t step = t - 1; step >= 0; --step) {
+      int64_t s = reverse ? t - 1 - step : step;
+      int64_t sp = reverse ? t - step : step - 1;
+      for (int64_t i = 0; i < b; ++i) {
+        bool valid = s < lens[i];
+        float* dhr = dh.data() + i * d;
+        const float* hg_row = hga != nullptr ? hga + (i * t + s) * d
+                                             : nullptr;
+        if (!valid) {
+          if (hg_row != nullptr) {
+            for (int64_t k = 0; k < d; ++k) dhr[k] += hg_row[k];
+          }
+          continue;
+        }
+        bool has_prev = step > 0;
+        const float* hprev = has_prev ? ha + (i * t + sp) * d : nullptr;
+        const float* xrow = xa + (i * t + s) * 3 * d;
+        // recompute forward intermediates
+        for (int64_t j = 0; j < 2 * d; ++j) {
+          float acc = xrow[j] + (bias != nullptr ? bias[j] : 0.0f);
+          if (has_prev) {
+            for (int64_t k = 0; k < d; ++k) {
+              acc += hprev[k] * wa[k * 3 * d + j];
+            }
+          }
+          g2[j] = acc;
+        }
+        for (int64_t k = 0; k < d; ++k) {
+          uval[k] = gate_act(g2[k]);
+          rval[k] = gate_act(g2[d + k]);
+          rh[k] = rval[k] * (has_prev ? hprev[k] : 0.0f);
+        }
+        for (int64_t k = 0; k < d; ++k) {
+          float acc = xrow[2 * d + k] +
+                      (bias != nullptr ? bias[2 * d + k] : 0.0f);
+          for (int64_t m2 = 0; m2 < d; ++m2) {
+            acc += rh[m2] * wa[m2 * 3 * d + 2 * d + k];
+          }
+          cpre[k] = acc;
+          cval[k] = cand_act(acc);
+        }
+        // backward
+        for (int64_t k = 0; k < d; ++k) {
+          float hp = has_prev ? hprev[k] : 0.0f;
+          float dh_k = dhr[k] + (hg_row != nullptr ? hg_row[k] : 0.0f);
+          float du = dh_k * (hp - cval[k]);
+          float dc = dh_k * (1.0f - uval[k]);
+          dhr[k] = dh_k * uval[k];  // carry: u * dh
+          dcpre[k] = dc * cand_der(cval[k]);
+          dg[k] = du * gate_der(uval[k]);
+        }
+        // through the candidate matmul: drh, dWc, dbc, dxc
+        for (int64_t m2 = 0; m2 < d; ++m2) {
+          float acc = 0.0f;
+          for (int64_t k = 0; k < d; ++k) {
+            acc += dcpre[k] * wa[m2 * 3 * d + 2 * d + k];
+            if (wga != nullptr) {
+              wga[m2 * 3 * d + 2 * d + k] += rh[m2] * dcpre[k];
+            }
+          }
+          drh[m2] = acc;
+        }
+        for (int64_t k = 0; k < d; ++k) {
+          if (xga != nullptr) {
+            xga[(i * t + s) * 3 * d + 2 * d + k] += dcpre[k];
+          }
+          if (bga != nullptr) bga[2 * d + k] += dcpre[k];
+          float hp = has_prev ? hprev[k] : 0.0f;
+          float dr = drh[k] * hp;
+          dhr[k] += drh[k] * rval[k];
+          dg[d + k] = dr * gate_der(rval[k]);
+        }
+        // through the gate matmul: dW[:, :2d], db, dx, dh_prev
+        if (wga != nullptr && has_prev) {
+          for (int64_t k = 0; k < d; ++k) {
+            for (int64_t j = 0; j < 2 * d; ++j) {
+              wga[k * 3 * d + j] += hprev[k] * dg[j];
+            }
+          }
+        }
+        for (int64_t j = 0; j < 2 * d; ++j) {
+          if (xga != nullptr) xga[(i * t + s) * 3 * d + j] += dg[j];
+          if (bga != nullptr) bga[j] += dg[j];
+        }
+        if (has_prev) {
+          for (int64_t k = 0; k < d; ++k) {
+            float acc = 0.0f;
+            for (int64_t j = 0; j < 2 * d; ++j) {
+              acc += wa[k * 3 * d + j] * dg[j];
+            }
+            dhr[k] += acc;
+          }
+        }
+      }
+    }
+    if (xgn != nullptr) scope->Set(*xgn, std::move(xg));
+    if (wgn != nullptr) scope->Set(*wgn, std::move(wg));
+    if (bgn != nullptr) scope->Set(*bgn, std::move(bg));
+    return "";
+  }
+
   // BPTT for dynamic_lstm (adjoint of RunDynamicLstm's recurrence):
   // gates recomputed from Input/Weight/Bias + the stored Hidden/Cell
   // sequences (h_prev/c_prev are the PREVIOUS ITERATION index's stored
@@ -3095,6 +3290,83 @@ class Interpreter {
     return "";
   }
 
+
+
+  // dX = reshape(dOut, X.shape) — pure metadata
+  std::string RunReshapeGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (xn == nullptr || ogn == nullptr || gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || og == nullptr) return "input not in scope";
+    if (NumElements(x->dims) != NumElements(og->dims)) {
+      return "size mismatch";
+    }
+    HostTensor grad = *og;
+    grad.dims = x->dims;
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+  // dX = transpose(dOut, argsort(perm)) (inverse permutation)
+  std::string RunTransposeGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (xn == nullptr || ogn == nullptr || gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* og = scope->Find(*ogn);
+    if (x == nullptr || og == nullptr) return "input not in scope";
+    if (!IsF32(*og)) return "non-f32 dtype";
+    auto perm = IntsAttr(op, "axis", {});
+    size_t rank = x->dims.size();
+    if (rank == 0) return "rank-0 input";
+    if (perm.size() != rank || og->dims.size() != rank) {
+      return "bad perm";
+    }
+    // the gather loop below re-derives the inverse mapping through
+    // idx[perm[d]]; here we just validate perm is a permutation and
+    // that dOut's dims really are x's dims permuted
+    std::vector<bool> seen(rank, false);
+    for (size_t d = 0; d < rank; ++d) {
+      int64_t p = perm[d];
+      if (p < 0 || p >= static_cast<int64_t>(rank) || seen[p]) {
+        return "bad perm";
+      }
+      seen[p] = true;
+      if (og->dims[d] != x->dims[p]) return "dOut shape mismatch";
+    }
+    HostTensor grad = MakeF32(x->dims);
+    float* ra = MutF32(&grad);
+    const float* ga = F32(*og);
+    std::vector<int64_t> gstride(rank, 1);
+    for (size_t d = rank - 1; d > 0; --d) {
+      gstride[d - 1] = gstride[d] * og->dims[d];
+    }
+    std::vector<int64_t> idx(rank, 0);  // index into x/grad space
+    int64_t total = NumElements(x->dims);
+    for (int64_t i = 0; i < total; ++i) {
+      // dOut index: out dim d corresponds to x dim perm[d], so
+      // og_idx[d] = idx[perm[d]] -> flat via inverse mapping
+      int64_t src = 0;
+      for (size_t d = 0; d < rank; ++d) {
+        src += idx[perm[d]] * gstride[d];
+      }
+      ra[i] = ga[src];
+      for (size_t d = rank; d-- > 0;) {
+        if (++idx[d] < x->dims[d]) break;
+        idx[d] = 0;
+      }
+    }
+    scope->Set(*gn, std::move(grad));
+    return "";
+  }
 
   // scatter-add of dOut rows into W@GRAD (padding_idx rows skipped —
   // the forward zeroed them, so their vjp is zero)

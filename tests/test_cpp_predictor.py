@@ -972,3 +972,143 @@ def test_demo_trainer_binary_trains_stacked_lstm(tmp_path):
     last_line = res.stdout.strip().splitlines()[-1]
     first, last = float(last_line.split()[1]), float(last_line.split()[3])
     assert last < 0.6 * first, res.stdout
+
+
+def test_structural_grads_train_step_parity_cpp_vs_xla(tmp_path):
+    """reshape/transpose grads in C++: one SGD step of a net that
+    reshapes and transposes between fc layers matches XLA."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2, 6], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 6, num_flatten_dims=2, act="tanh",
+                            name="sg_fc1")
+        h = fluid.layers.transpose(h, perm=[0, 2, 1])
+        h = fluid.layers.reshape(h, shape=[-1, 12])
+        logits = fluid.layers.fc(h, 3, name="sg_fc2")
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(8)
+    feed = {"x": rng.randn(4, 2, 6).astype("float32"),
+            "label": rng.randint(0, 3, (4, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        w_xla = np.asarray(scope.get_value("sg_fc1.w_0"))
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        w_cpp = ns.get("sg_fc1.w_0")
+    finally:
+        lib.ptpu_program_destroy(prog)
+    np.testing.assert_allclose(np.ravel(cpp_loss)[0],
+                               np.ravel(np.asarray(xla_loss))[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(w_cpp, w_xla, rtol=1e-3, atol=1e-5,
+                               err_msg="grad through transpose/reshape "
+                                       "diverged")
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+@pytest.mark.parametrize("with_len", [False, True])
+def test_gru_train_step_parity_cpp_vs_xla(tmp_path, reverse, with_len):
+    """r5: BPTT for dynamic_gru in C++. One SGD step from identical
+    params: loss, updated recurrent weight AND bias match the XLA
+    scan vjp (reverse x length grid)."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    D, B, T = 3, 2, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, 3 * D],
+                              dtype="float32")
+        t = fluid.layers.data(name="t", shape=[D], dtype="float32")
+        kwargs = {}
+        if with_len:
+            length = fluid.layers.data(name="len", shape=[1],
+                                       dtype="int64")
+            kwargs["length"] = length
+        h = fluid.layers.dynamic_gru(x, size=D, is_reverse=reverse,
+                                     **kwargs)
+        pooled = fluid.layers.reduce_mean(h, dim=[1])
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pooled, t)))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    rng = np.random.RandomState(6)
+    feed = {"x": rng.randn(B, T, 3 * D).astype("float32") * 0.5,
+            "t": rng.randn(B, D).astype("float32")}
+    if with_len:
+        feed["len"] = np.asarray([[T], [T - 2]], "int64")
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        w_xla = np.asarray(scope.get_value("gru_0.w_0"))
+        b_xla = np.asarray(scope.get_value("gru_0.w_1"))
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        w_cpp = ns.get("gru_0.w_0")
+        b_cpp = ns.get("gru_0.w_1")
+    finally:
+        lib.ptpu_program_destroy(prog)
+    np.testing.assert_allclose(np.ravel(cpp_loss)[0],
+                               np.ravel(np.asarray(xla_loss))[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(w_cpp, w_xla, rtol=2e-3, atol=1e-5,
+                               err_msg="GRU recurrent weight diverged")
+    np.testing.assert_allclose(np.ravel(b_cpp), np.ravel(b_xla),
+                               rtol=2e-3, atol=1e-5,
+                               err_msg="GRU bias diverged")
