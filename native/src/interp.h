@@ -2289,7 +2289,7 @@ class Interpreter {
     }
 
     std::vector<float> dh(b * d, 0.0f);
-    std::vector<float> g2(2 * d), rh(d), cpre(d), cval(d), uval(d),
+    std::vector<float> g2(2 * d), rh(d), cval(d), uval(d),
         rval(d), dg(2 * d), dcpre(d), drh(d);
     for (int64_t step = t - 1; step >= 0; --step) {
       int64_t s = reverse ? t - 1 - step : step;
@@ -2329,7 +2329,6 @@ class Interpreter {
           for (int64_t m2 = 0; m2 < d; ++m2) {
             acc += rh[m2] * wa[m2 * 3 * d + 2 * d + k];
           }
-          cpre[k] = acc;
           cval[k] = cand_act(acc);
         }
         // backward
@@ -3312,7 +3311,11 @@ class Interpreter {
     return "";
   }
 
-  // dX = transpose(dOut, argsort(perm)) (inverse permutation)
+  // dX = transpose(dOut, argsort(perm)) (inverse permutation).
+  // NB: this odometer-walk and RunTranspose's stride-division walk are
+  // two implementations of the same permuted copy; a fix to either's
+  // index math must be mirrored in the other (behavior pinned by the
+  // structural-grad parity test + the fuzz transpose family).
   std::string RunTransposeGrad(const OpDesc& op, Scope* scope) {
     const std::string* xn = OneName(op, "X");
     const std::string* ogn = OneName(op, "Out@GRAD");
