@@ -196,6 +196,37 @@ inline std::string ResolveReduce(const OpDesc& op,
   return "";
 }
 
+// Per-row mean + 1/sqrt(var+eps), double accumulation — shared by
+// layer_norm forward and backward so the recomputed normalization can
+// never drift from what the forward produced.
+inline void RowMeanInv(const float* src, int64_t inner, float eps,
+                       float* mean_out, float* inv_out) {
+  double mean = 0.0;
+  for (int64_t i = 0; i < inner; ++i) mean += src[i];
+  mean /= inner;
+  double var = 0.0;
+  for (int64_t i = 0; i < inner; ++i) {
+    double dv = src[i] - mean;
+    var += dv * dv;
+  }
+  var /= inner;
+  *mean_out = static_cast<float>(mean);
+  *inv_out = 1.0f / std::sqrt(static_cast<float>(var) + eps);
+}
+
+// SDPA attention validity predicate — shared by RunSDPA and
+// RunSDPAGrad (causal, sliding window, optional [B,S] key mask).
+inline bool SdpaValid(int64_t t, int64_t j, bool causal, int64_t window,
+                      const float* mask_row) {
+  if (causal && j > t) return false;
+  if (window != 0) {
+    if (t - j >= window) return false;
+    if (!causal && j - t >= window) return false;
+  }
+  if (mask_row != nullptr && mask_row[j] <= 0.0f) return false;
+  return true;
+}
+
 class Interpreter {
  public:
   explicit Interpreter(const ProgramDesc& prog) : prog_(prog) {}
@@ -357,6 +388,10 @@ class Interpreter {
     if (op.type == "dynamic_gru_grad") {
       return RunDynamicGruGrad(op, scope);
     }
+    if (op.type == "layer_norm_grad") return RunLayerNormGrad(op, scope);
+    if (op.type == "scaled_dot_product_attention_grad") {
+      return RunSDPAGrad(op, scope);
+    }
     if (op.type == "reduce_mean_grad" || op.type == "reduce_sum_grad") {
       return RunReduceGrad(op, scope,
                            op.type == "reduce_mean_grad");
@@ -468,18 +503,10 @@ class Interpreter {
     for (int64_t r = 0; r < rows; ++r) {
       const float* src = xa + r * inner;
       float* dst = oa + r * inner;
-      double mean = 0.0;
-      for (int64_t i = 0; i < inner; ++i) mean += src[i];
-      mean /= inner;
-      double var = 0.0;
+      float mean, inv;
+      RowMeanInv(src, inner, eps, &mean, &inv);
       for (int64_t i = 0; i < inner; ++i) {
-        double dv = src[i] - mean;
-        var += dv * dv;
-      }
-      var /= inner;
-      float inv = 1.0f / std::sqrt(static_cast<float>(var) + eps);
-      for (int64_t i = 0; i < inner; ++i) {
-        float v = (src[i] - static_cast<float>(mean)) * inv;
+        float v = (src[i] - mean) * inv;
         if (sc != nullptr) v *= F32(*sc)[i];
         if (bi != nullptr) v += F32(*bi)[i];
         dst[i] = v;
@@ -607,12 +634,9 @@ class Interpreter {
           const float* qr = qa + ((b * H + h) * T + t) * d;
           float mx = -1e30f;
           bool any_valid = false;
+          const float* mrow = ma != nullptr ? ma + b * S : nullptr;
           for (int64_t j = 0; j < S; ++j) {
-            bool valid = (!causal || j <= t) &&
-                         (window == 0 ||
-                          (t - j < window && (causal || j - t < window))) &&
-                         (ma == nullptr || ma[b * S + j] > 0.0f);
-            if (valid) {
+            if (SdpaValid(t, j, causal, window, mrow)) {
               any_valid = true;
               float dot = 0.0f;
               for (int64_t c = 0; c < d; ++c) dot += qr[c] * kb[j * d + c];
@@ -2207,6 +2231,246 @@ class Interpreter {
     return [](float a) { return 0.0f; };
   }
 
+
+
+  // layer_norm backward (classic adjoint over the flattened rows the
+  // forward normalizes): with yhat = (x - mu)/sigma and G = dy*gamma,
+  // dx = (G - mean(G) - yhat * mean(G*yhat)) / sigma;
+  // dgamma = sum_rows(dy * yhat); dbeta = sum_rows(dy)
+  std::string RunLayerNormGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* ygn = OneName(op, "Y@GRAD");
+    if (xn == nullptr || ygn == nullptr) return "missing io";
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* yg = scope->Find(*ygn);
+    if (x == nullptr || yg == nullptr) return "input not in scope";
+    if (!IsF32(*x) || !IsF32(*yg) || x->dims != yg->dims) {
+      return "bad input";
+    }
+    int64_t begin = IntAttr(op, "begin_norm_axis", 1);
+    float eps = FloatAttr(op, "epsilon", 1e-5f);
+    if (begin < 1 || begin >= static_cast<int64_t>(x->dims.size())) {
+      return "bad begin_norm_axis";
+    }
+    int64_t rows = 1, inner = 1;
+    for (int64_t d = 0; d < begin; ++d) rows *= x->dims[d];
+    for (size_t d = begin; d < x->dims.size(); ++d) inner *= x->dims[d];
+    const std::string* sn = OneName(op, "Scale");
+    const HostTensor* sc = sn != nullptr ? scope->Find(*sn) : nullptr;
+    if (sc != nullptr && NumElements(sc->dims) != inner) {
+      return "bad scale";
+    }
+    const std::string* xgn = OneName(op, "X@GRAD", false);
+    const std::string* sgn = OneName(op, "Scale@GRAD", false);
+    const std::string* bgn = OneName(op, "Bias@GRAD", false);
+    if (sgn != nullptr && sc == nullptr) return "Scale@GRAD w/o Scale";
+    HostTensor xg, sg, bgt;
+    float* xga = nullptr;
+    float* sga = nullptr;
+    float* bga = nullptr;
+    if (xgn != nullptr) {
+      xg = MakeF32(x->dims);
+      xga = MutF32(&xg);
+    }
+    if (sgn != nullptr) {
+      sg = MakeF32({inner});
+      sga = MutF32(&sg);
+      std::fill(sga, sga + inner, 0.0f);
+    }
+    if (bgn != nullptr) {
+      bgt = MakeF32({inner});
+      bga = MutF32(&bgt);
+      std::fill(bga, bga + inner, 0.0f);
+    }
+    const float* xa = F32(*x);
+    const float* ga = F32(*yg);
+    std::vector<float> yhat(inner), gg(inner);
+    for (int64_t r = 0; r < rows; ++r) {
+      const float* src = xa + r * inner;
+      const float* grow = ga + r * inner;
+      float mean, inv;
+      RowMeanInv(src, inner, eps, &mean, &inv);
+      double mg = 0.0, mgy = 0.0;
+      for (int64_t i = 0; i < inner; ++i) {
+        yhat[i] = (src[i] - mean) * inv;
+        float gscaled = grow[i] * (sc != nullptr ? F32(*sc)[i] : 1.0f);
+        gg[i] = gscaled;
+        mg += gscaled;
+        mgy += static_cast<double>(gscaled) * yhat[i];
+        if (sga != nullptr) sga[i] += grow[i] * yhat[i];
+        if (bga != nullptr) bga[i] += grow[i];
+      }
+      mg /= inner;
+      mgy /= inner;
+      if (xga != nullptr) {
+        float* dst = xga + r * inner;
+        for (int64_t i = 0; i < inner; ++i) {
+          dst[i] = (gg[i] - static_cast<float>(mg) -
+                    yhat[i] * static_cast<float>(mgy)) * inv;
+        }
+      }
+    }
+    if (xgn != nullptr) scope->Set(*xgn, std::move(xg));
+    if (sgn != nullptr) scope->Set(*sgn, std::move(sg));
+    if (bgn != nullptr) scope->Set(*bgn, std::move(bgt));
+    return "";
+  }
+
+  // attention backward (adjoint of RunSDPA's reference math, same
+  // validity predicate incl. causal/window/key-mask/GQA): per row,
+  // dV_j += p_j g, dp_j = g.v_j, ds = p*(dp - sum(p*dp)),
+  // dQ += scale * ds K, dK_j += scale * ds_j q. Fully-masked rows
+  // contributed 0 forward and contribute 0 here.
+  std::string RunSDPAGrad(const OpDesc& op, Scope* scope) {
+    const std::string* qn = OneName(op, "Q");
+    const std::string* kn = OneName(op, "K");
+    const std::string* vn = OneName(op, "V");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    if (qn == nullptr || kn == nullptr || vn == nullptr ||
+        ogn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* q = scope->Find(*qn);
+    const HostTensor* k = scope->Find(*kn);
+    const HostTensor* v = scope->Find(*vn);
+    const HostTensor* og = scope->Find(*ogn);
+    for (const HostTensor* tt : {q, k, v, og}) {
+      if (tt == nullptr) return "input not in scope";
+      if (!IsF32(*tt)) return "non-f32";
+    }
+    if (q->dims.size() != 4 || k->dims.size() != 4) {
+      return "needs [B,H,T,d]";
+    }
+    if (!StrAttr(op, "seq_parallel_axis", "").empty()) {
+      return "seq_parallel_axis needs the XLA path";
+    }
+    int64_t B = q->dims[0], H = q->dims[1], T = q->dims[2],
+            d = q->dims[3];
+    int64_t S = k->dims[2];
+    int64_t g = IntAttr(op, "kv_group", 1);
+    if (g < 1 || H % g != 0) return "bad kv_group";
+    int64_t Hkv = H / g;
+    if (k->dims[0] != B || k->dims[1] != Hkv || k->dims[3] != d) {
+      return "K shape mismatch";
+    }
+    if (v->dims != k->dims || og->dims != q->dims) {
+      return "shape mismatch";
+    }
+    bool causal = IntAttr(op, "causal", 0) != 0;
+    int64_t window = IntAttr(op, "window", 0);
+    if (window < 0) return "bad window";
+    float scale = FloatAttr(op, "sm_scale", 0.0f);
+    if (scale == 0.0f) scale = 1.0f / std::sqrt(static_cast<float>(d));
+    const std::string* mn = OneName(op, "Mask");
+    const HostTensor* mask = mn != nullptr ? scope->Find(*mn) : nullptr;
+    if (mask != nullptr &&
+        (mask->dims.size() != 2 || mask->dims[0] != B ||
+         mask->dims[1] != S)) {
+      return "only [B, S] key-validity masks in the C++ path";
+    }
+    const std::string* qgn = OneName(op, "Q@GRAD", false);
+    const std::string* kgn = OneName(op, "K@GRAD", false);
+    const std::string* vgn = OneName(op, "V@GRAD", false);
+    HostTensor qg, kg, vg;
+    float* qga = nullptr;
+    float* kga = nullptr;
+    float* vga = nullptr;
+    if (qgn != nullptr) {
+      qg = MakeF32(q->dims);
+      qga = MutF32(&qg);
+      std::fill(qga, qga + NumElements(q->dims), 0.0f);
+    }
+    if (kgn != nullptr) {
+      kg = MakeF32(k->dims);
+      kga = MutF32(&kg);
+      std::fill(kga, kga + NumElements(k->dims), 0.0f);
+    }
+    if (vgn != nullptr) {
+      vg = MakeF32(v->dims);
+      vga = MutF32(&vg);
+      std::fill(vga, vga + NumElements(v->dims), 0.0f);
+    }
+    const float* qa = F32(*q);
+    const float* ka = F32(*k);
+    const float* va = F32(*v);
+    const float* ga = F32(*og);
+    const float* ma = mask != nullptr ? F32(*mask) : nullptr;
+    std::vector<float> p(S), dp(S);
+    for (int64_t b = 0; b < B; ++b) {
+      for (int64_t h = 0; h < H; ++h) {
+        const float* kb = ka + (b * Hkv + h / g) * S * d;
+        const float* vb = va + (b * Hkv + h / g) * S * d;
+        float* kgb = kga != nullptr ? kga + (b * Hkv + h / g) * S * d
+                                    : nullptr;
+        float* vgb = vga != nullptr ? vga + (b * Hkv + h / g) * S * d
+                                    : nullptr;
+        for (int64_t t = 0; t < T; ++t) {
+          const float* qr = qa + ((b * H + h) * T + t) * d;
+          const float* grow = ga + ((b * H + h) * T + t) * d;
+          // recompute the softmax row with the forward's predicate
+          float mx = -1e30f;
+          bool any_valid = false;
+          const float* mrow = ma != nullptr ? ma + b * S : nullptr;
+          for (int64_t j = 0; j < S; ++j) {
+            if (SdpaValid(t, j, causal, window, mrow)) {
+              any_valid = true;
+              float dot = 0.0f;
+              for (int64_t c = 0; c < d; ++c) {
+                dot += qr[c] * kb[j * d + c];
+              }
+              p[j] = dot * scale;
+              if (p[j] > mx) mx = p[j];
+            } else {
+              p[j] = -1e30f;
+            }
+          }
+          if (!any_valid) continue;  // forward emitted 0, grads are 0
+          float denom = 0.0f;
+          for (int64_t j = 0; j < S; ++j) {
+            p[j] = std::exp(p[j] - mx);
+            denom += p[j];
+          }
+          if (denom <= 0.0f) denom = 1.0f;
+          double pdp = 0.0;
+          for (int64_t j = 0; j < S; ++j) {
+            p[j] /= denom;
+            float acc = 0.0f;
+            for (int64_t c = 0; c < d; ++c) {
+              acc += grow[c] * vb[j * d + c];
+            }
+            dp[j] = acc;
+            pdp += static_cast<double>(p[j]) * acc;
+            if (vgb != nullptr) {
+              for (int64_t c = 0; c < d; ++c) {
+                vgb[j * d + c] += p[j] * grow[c];
+              }
+            }
+          }
+          float* qgr = qga != nullptr
+                           ? qga + ((b * H + h) * T + t) * d
+                           : nullptr;
+          for (int64_t j = 0; j < S; ++j) {
+            float ds = p[j] * (dp[j] - static_cast<float>(pdp)) * scale;
+            if (ds == 0.0f) continue;
+            if (qgr != nullptr) {
+              for (int64_t c = 0; c < d; ++c) {
+                qgr[c] += ds * kb[j * d + c];
+              }
+            }
+            if (kgb != nullptr) {
+              for (int64_t c = 0; c < d; ++c) {
+                kgb[j * d + c] += ds * qr[c];
+              }
+            }
+          }
+        }
+      }
+    }
+    if (qgn != nullptr) scope->Set(*qgn, std::move(qg));
+    if (kgn != nullptr) scope->Set(*kgn, std::move(kg));
+    if (vgn != nullptr) scope->Set(*vgn, std::move(vg));
+    return "";
+  }
 
   // BPTT for dynamic_gru (adjoint of RunDynamicGru's recurrence);
   // padded steps pass dh through like the LSTM grad

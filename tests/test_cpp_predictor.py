@@ -1112,3 +1112,96 @@ def test_gru_train_step_parity_cpp_vs_xla(tmp_path, reverse, with_len):
     np.testing.assert_allclose(np.ravel(b_cpp), np.ravel(b_xla),
                                rtol=2e-3, atol=1e-5,
                                err_msg="GRU bias diverged")
+
+
+@pytest.mark.parametrize("causal,window,kv_group",
+                         [(True, 0, 1), (False, 0, 1), (True, 3, 1),
+                          (True, 0, 2)])
+def test_transformer_block_train_step_parity_cpp_vs_xla(
+        tmp_path, causal, window, kv_group):
+    """Transformer-block training in C++ (r5 capstone #2): one SGD step
+    of a pre-norm attention block — fc projections, fused SDPA
+    (causal/window/GQA grid), layer_norm, residual — matches the XLA
+    executor on loss and the QKV projection weight."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    B, T, H, dh = 2, 4, 4, 4
+    D = H * dh
+    Hkv = H // kv_group
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, D], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+
+        def heads(tv, nh):
+            tv = fluid.layers.reshape(tv, [-1, T, nh, dh])
+            return fluid.layers.transpose(tv, [0, 2, 1, 3])
+
+        nx = fluid.layers.layer_norm(x, begin_norm_axis=2,
+                                     name="blk_ln")
+        q = heads(fluid.layers.fc(nx, D, num_flatten_dims=2,
+                                  bias_attr=False, name="blk_q"), H)
+        k = heads(fluid.layers.fc(nx, Hkv * dh, num_flatten_dims=2,
+                                  bias_attr=False, name="blk_k"), Hkv)
+        v = heads(fluid.layers.fc(nx, Hkv * dh, num_flatten_dims=2,
+                                  bias_attr=False, name="blk_v"), Hkv)
+        att = fluid.layers.scaled_dot_product_attention(
+            q, k, v, causal=causal, window=window, kv_group=kv_group,
+            impl="reference")
+        att = fluid.layers.reshape(
+            fluid.layers.transpose(att, [0, 2, 1, 3]), [-1, T, D])
+        h = fluid.layers.elementwise_add(
+            x, fluid.layers.fc(att, D, num_flatten_dims=2,
+                               bias_attr=False, name="blk_o"))
+        pooled = fluid.layers.reduce_mean(h, dim=[1])
+        logits = fluid.layers.fc(pooled, 3, name="blk_head")
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(12)
+    feed = {"x": rng.randn(B, T, D).astype("float32") * 0.5,
+            "label": rng.randint(0, 3, (B, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        w_xla = np.asarray(scope.get_value("blk_q.w_0"))
+        ln_xla = np.asarray(scope.get_value("blk_ln.w_0"))
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        w_cpp = ns.get("blk_q.w_0")
+        ln_cpp = ns.get("blk_ln.w_0")
+    finally:
+        lib.ptpu_program_destroy(prog)
+    np.testing.assert_allclose(np.ravel(cpp_loss)[0],
+                               np.ravel(np.asarray(xla_loss))[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(w_cpp, w_xla, rtol=2e-3, atol=1e-5,
+                               err_msg="attention-path weight diverged")
+    np.testing.assert_allclose(ln_cpp, ln_xla, rtol=2e-3, atol=1e-5,
+                               err_msg="layer_norm scale grad diverged")
