@@ -40,7 +40,7 @@ class CppRefusal(Exception):
 def _random_body(rng, x, feed, B):
     """Random trunk over the training-safe layer menu; returns a 2-D
     [B, n] tensor."""
-    kind = rng.choice(["mlp", "conv", "gru", "lstm", "embed"])
+    kind = rng.choice(["mlp", "conv", "gru", "lstm", "embed", "attn"])
     if kind == "mlp":
         h = x
         for _ in range(int(rng.randint(1, 3))):
@@ -87,6 +87,34 @@ def _random_body(rng, x, feed, B):
                 use_peepholes=bool(rng.rand() < 0.5),
                 is_reverse=bool(rng.rand() < 0.5), **kwargs)
         return fluid.layers.reduce_mean(h, dim=[1])
+    if kind == "attn":
+        T, H, dh = int(rng.choice([3, 4])), int(rng.choice([2, 4])), 4
+        kvg = int(rng.choice([1, 2])) if H == 4 else 1
+        D = H * dh
+        seqx = fluid.layers.data(name="ax", shape=[T, D],
+                                 dtype="float32")
+        feed["ax"] = (rng.randn(B, T, D) * 0.5).astype("float32")
+        nx = fluid.layers.layer_norm(seqx, begin_norm_axis=2)
+
+        def heads(tv, nh):
+            tv = fluid.layers.reshape(tv, [-1, T, nh, dh])
+            return fluid.layers.transpose(tv, [0, 2, 1, 3])
+
+        q = heads(fluid.layers.fc(nx, D, num_flatten_dims=2,
+                                  bias_attr=False), H)
+        k = heads(fluid.layers.fc(nx, (H // kvg) * dh,
+                                  num_flatten_dims=2,
+                                  bias_attr=False), H // kvg)
+        v = heads(fluid.layers.fc(nx, (H // kvg) * dh,
+                                  num_flatten_dims=2,
+                                  bias_attr=False), H // kvg)
+        att = fluid.layers.scaled_dot_product_attention(
+            q, k, v, causal=bool(rng.rand() < 0.5),
+            window=int(rng.choice([0, 2])), kv_group=kvg,
+            impl="reference")
+        att = fluid.layers.reshape(
+            fluid.layers.transpose(att, [0, 2, 1, 3]), [-1, T, D])
+        return fluid.layers.reduce_mean(att, dim=[1])
     vocab = int(rng.randint(8, 20))
     T = int(rng.randint(2, 5))
     ids = fluid.layers.data(name="ids", shape=[T], dtype="int64")
