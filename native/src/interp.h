@@ -11,20 +11,27 @@
 // tests (SURVEY.md §2.9 item 7). Float32, core op subset; unsupported ops
 // report an error rather than mis-executing.
 //
-// TRAINING grad table (what the C++ trainer can differentiate; the op
-// set of the MLP and MNIST-conv book models):
-//   mean_grad, relu_grad, tanh_grad, sigmoid_grad, softmax_grad,
-//   cross_entropy_grad, softmax_with_cross_entropy_grad,
-//   elementwise_add_grad (incl. the broadcast bias axis), mul_grad,
-//   elementwise_{sub,mul,div}_grad (same broadcast geometry as the
-//   forwards, dY reduced), square/exp/log/sqrt grads,
-//   conv2d_grad (strides/paddings/dilations/groups, same envelope as
-//   the forward), pool2d_grad (max + avg/exclusive + ceil_mode;
-//   adaptive refused like the forward), optimizers sgd / momentum
-//   (incl. nesterov) / adam (beta pows ride the scale kernel), and the
+// TRAINING grad table (what the C++ trainer can differentiate — the
+// MLP, MNIST-conv, stacked-LSTM book models and a pre-norm
+// transformer attention block; every kernel pinned one-step against
+// the XLA vjp and the whole surface fuzzed by
+// tests/test_train_fuzz.py):
+//   mean_grad, relu/tanh/sigmoid/square/exp/log/sqrt grads,
+//   softmax_grad, cross_entropy_grad,
+//   softmax_with_cross_entropy_grad, elementwise_add_grad and
+//   elementwise_{sub,mul,div}_grad (shared ResolveBroadcast geometry,
+//   dY reduced), mul_grad, conv2d_grad (strides/paddings/dilations/
+//   groups), pool2d_grad (max + avg/exclusive + ceil_mode),
+//   reduce_{sum,mean}_grad (shared ResolveReduce geometry),
+//   reshape/flatten(+2)/transpose(+2) grads, sum_grad,
+//   lookup_table_grad (padding-skipping scatter), sequence_pool_grad
+//   (all six pooltypes), dynamic_lstm_grad (BPTT incl. peepholes/
+//   reverse/lengths), dynamic_gru_grad (BPTT), layer_norm_grad
+//   (shared RowMeanInv stats), scaled_dot_product_attention_grad
+//   (shared SdpaValid predicate; causal/window/key-mask/GQA),
+//   optimizers sgd / momentum (incl. nesterov) / adam, and the
 //   startup initializers (fill_constant, uniform_random,
-//   gaussian_random). Anything else errors explicitly — the serving op
-//   table above is much wider than the training one.
+//   gaussian_random). Anything else errors explicitly.
 
 #include <algorithm>
 #include <cctype>
