@@ -396,6 +396,9 @@ class Interpreter {
       return RunDynamicGruGrad(op, scope);
     }
     if (op.type == "layer_norm_grad") return RunLayerNormGrad(op, scope);
+    if (op.type == "attention_lstm_grad") {
+      return RunAttentionLstmGrad(op, scope);
+    }
     if (op.type == "scaled_dot_product_attention_grad") {
       return RunSDPAGrad(op, scope);
     }
@@ -2239,6 +2242,343 @@ class Interpreter {
   }
 
 
+
+
+  // Adjoint of the fused attention_lstm decoder (RunAttentionLstm):
+  // per step backward through the LSTM cell (i,f,g,o; sigma/tanh) and
+  // the additive-attention read (stored AttentionWeight rows are the
+  // exact softmax probs; the tanh scores are recomputed). Zero-length
+  // encoder rows skipped the attention forward (ctx = 0), so their
+  // adjoint flows only through the cell. H0 grads supported; C0 and
+  // EncoderLen-variable programs follow the forward's zero-c0
+  // convention.
+  std::string RunAttentionLstmGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* evn = OneName(op, "EncoderVec");
+    const std::string* epn = OneName(op, "EncoderProj");
+    const std::string* hn = OneName(op, "Hidden");
+    const std::string* cn = OneName(op, "Cell");
+    const std::string* awn = OneName(op, "AttentionWeight");
+    const std::string* h0n = OneName(op, "H0");
+    const std::string* wsn = OneName(op, "StateProjW");
+    const std::string* wan = OneName(op, "AttnW");
+    const std::string* cwn = OneName(op, "CellW");
+    const std::string* cbn = OneName(op, "CellB");
+    const std::string* hgn = OneName(op, "Hidden@GRAD");
+    if (xn == nullptr || evn == nullptr || epn == nullptr ||
+        hn == nullptr || cn == nullptr || awn == nullptr ||
+        h0n == nullptr || wsn == nullptr || wan == nullptr ||
+        cwn == nullptr || cbn == nullptr || hgn == nullptr) {
+      return "missing io";
+    }
+    if (OneName(op, "C0") != nullptr) {
+      return "C0 initial cell not supported";
+    }
+    // losses touching the Cell or AttentionWeight outputs would feed
+    // adjoints this kernel does not propagate: refuse rather than
+    // train on silently wrong gradients (RunDynamicLstmGrad handles
+    // its Cell@GRAD; this fused kernel only supports Hidden losses)
+    if (OneName(op, "Cell@GRAD") != nullptr) {
+      return "Cell@GRAD not supported (loss through Cell)";
+    }
+    if (OneName(op, "AttentionWeight@GRAD") != nullptr) {
+      return "AttentionWeight@GRAD not supported";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* ev = scope->Find(*evn);
+    const HostTensor* ep = scope->Find(*epn);
+    const HostTensor* hid = scope->Find(*hn);
+    const HostTensor* cel = scope->Find(*cn);
+    const HostTensor* aw = scope->Find(*awn);
+    const HostTensor* h0 = scope->Find(*h0n);
+    const HostTensor* ws = scope->Find(*wsn);
+    const HostTensor* wat = scope->Find(*wan);
+    const HostTensor* cw = scope->Find(*cwn);
+    const HostTensor* cb = scope->Find(*cbn);
+    const HostTensor* hg = scope->Find(*hgn);
+    for (const HostTensor* tt :
+         {x, ev, ep, hid, cel, aw, h0, ws, wat, cw, cb, hg}) {
+      if (tt == nullptr) return "input not in scope";
+      if (!IsF32(*tt)) return "non-f32 dtype";
+    }
+    if (x->dims.size() != 3 || ev->dims.size() != 3 ||
+        ep->dims.size() != 3) {
+      return "bad ranks";
+    }
+    int64_t B = x->dims[0], T = x->dims[1], M = x->dims[2];
+    int64_t S = ev->dims[1], C = ev->dims[2];
+    if (ev->dims[0] != B) return "EncoderVec batch mismatch";
+    int64_t D = ws->dims.size() == 2 ? ws->dims[0] : 0;
+    if (ws->dims != std::vector<int64_t>({D, D}) ||
+        NumElements(wat->dims) != 2 * D ||
+        cw->dims != std::vector<int64_t>({D + C + M, 4 * D}) ||
+        NumElements(cb->dims) != 4 * D ||
+        hid->dims != std::vector<int64_t>({B, T, D}) ||
+        cel->dims != hid->dims || hg->dims != hid->dims ||
+        aw->dims != std::vector<int64_t>({B, T, S}) ||
+        h0->dims != std::vector<int64_t>({B, D}) ||
+        ep->dims != std::vector<int64_t>({B, S, D})) {
+      return "shape mismatch";
+    }
+    std::vector<int64_t> lens(B, S);
+    const std::string* eln = OneName(op, "EncoderLen");
+    if (eln != nullptr) {
+      const HostTensor* lt = scope->Find(*eln);
+      if (lt == nullptr) return "EncoderLen not in scope";
+      std::vector<int64_t> raw;
+      std::string e2 = ReadIds(*lt, &raw);
+      if (!e2.empty()) return e2;
+      if (static_cast<int64_t>(raw.size()) != B) return "len count";
+      for (int64_t i = 0; i < B; ++i) {
+        lens[i] = std::min<int64_t>(std::max<int64_t>(raw[i], 0), S);
+      }
+    }
+    const float* xa = F32(*x);
+    const float* eva = F32(*ev);
+    const float* epa = F32(*ep);
+    const float* ha = F32(*hid);
+    const float* ca = F32(*cel);
+    const float* awa = F32(*aw);
+    const float* h0a = F32(*h0);
+    const float* wsa = F32(*ws);
+    const float* waa = F32(*wat);
+    const float* cwa = F32(*cw);
+    const float* cba = F32(*cb);
+    const float* hga = F32(*hg);
+
+    auto out_buf = [&](const char* slot, std::vector<int64_t> dims,
+                       HostTensor* t, float** p) -> bool {
+      const std::string* nm = OneName(op, slot, false);
+      if (nm == nullptr) return false;
+      *t = MakeF32(dims);
+      *p = MutF32(t);
+      std::fill(*p, *p + NumElements(dims), 0.0f);
+      return true;
+    };
+    HostTensor xg, evg, epg, h0g, wsg, wag, cwg, cbg;
+    float* xga = nullptr;
+    float* evga = nullptr;
+    float* epga = nullptr;
+    float* h0ga = nullptr;
+    float* wsga = nullptr;
+    float* waga = nullptr;
+    float* cwga = nullptr;
+    float* cbga = nullptr;
+    bool want_x = out_buf("X@GRAD", x->dims, &xg, &xga);
+    bool want_ev = out_buf("EncoderVec@GRAD", ev->dims, &evg, &evga);
+    bool want_ep = out_buf("EncoderProj@GRAD", ep->dims, &epg, &epga);
+    bool want_h0 = out_buf("H0@GRAD", h0->dims, &h0g, &h0ga);
+    bool want_ws = out_buf("StateProjW@GRAD", ws->dims, &wsg, &wsga);
+    bool want_wa = out_buf("AttnW@GRAD", wat->dims, &wag, &waga);
+    bool want_cw = out_buf("CellW@GRAD", cw->dims, &cwg, &cwga);
+    bool want_cb = out_buf("CellB@GRAD", cb->dims, &cbg, &cbga);
+
+    std::vector<float> dh(B * D, 0.0f), dc(B * D, 0.0f);
+    std::vector<float> gates(4 * D), dgates(4 * D), ctx(C), dctx(C),
+        sp(D), dsp(D), dalpha(S);
+    for (int64_t t = T - 1; t >= 0; --t) {
+      for (int64_t b = 0; b < B; ++b) {
+        const float* hprev = t > 0 ? ha + (b * T + t - 1) * D
+                                   : h0a + b * D;
+        const float* cprev_row =
+            t > 0 ? ca + (b * T + t - 1) * D : nullptr;
+        const float* crow = ca + (b * T + t) * D;
+        const float* xrow = xa + (b * T + t) * M;
+        const float* arow = awa + (b * T + t) * S;
+        int64_t len = lens[b];
+        // recompute sp, sp_scalar and ctx (from stored alphas)
+        float sp_scalar = 0.0f;
+        for (int64_t j = 0; j < D; ++j) {
+          float acc = 0.0f;
+          for (int64_t k2 = 0; k2 < D; ++k2) {
+            acc += hprev[k2] * wsa[k2 * D + j];
+          }
+          sp[j] = acc;
+          sp_scalar += acc * waa[D + j];
+        }
+        for (int64_t j = 0; j < C; ++j) ctx[j] = 0.0f;
+        for (int64_t s2 = 0; s2 < len; ++s2) {
+          const float* evr = eva + (b * S + s2) * C;
+          for (int64_t j = 0; j < C; ++j) {
+            ctx[j] += arow[s2] * evr[j];
+          }
+        }
+        // recompute cell pre-activations
+        for (int64_t g2 = 0; g2 < 4 * D; ++g2) {
+          float acc = cba[g2];
+          for (int64_t j = 0; j < D; ++j) {
+            acc += hprev[j] * cwa[j * 4 * D + g2];
+          }
+          for (int64_t j = 0; j < C; ++j) {
+            acc += ctx[j] * cwa[(D + j) * 4 * D + g2];
+          }
+          for (int64_t j = 0; j < M; ++j) {
+            acc += xrow[j] * cwa[(D + C + j) * 4 * D + g2];
+          }
+          gates[g2] = acc;
+        }
+        // cell backward
+        float* dhr = dh.data() + b * D;
+        float* dcr = dc.data() + b * D;
+        const float* hg_row = hga + (b * T + t) * D;
+        for (int64_t k2 = 0; k2 < D; ++k2) {
+          float cpv = cprev_row != nullptr ? cprev_row[k2] : 0.0f;
+          float iv = Sigmoid(gates[0 * D + k2]);
+          float fv = Sigmoid(gates[1 * D + k2]);
+          float gv = std::tanh(gates[2 * D + k2]);
+          float ov = Sigmoid(gates[3 * D + k2]);
+          float cv = crow[k2];
+          float tc = std::tanh(cv);
+          float dh_k = dhr[k2] + hg_row[k2];
+          float dc_k = dcr[k2];
+          float dov = dh_k * tc;
+          float dgo = dov * ov * (1.0f - ov);
+          dc_k += dh_k * ov * (1.0f - tc * tc);
+          float div2 = dc_k * gv;
+          float dgv = dc_k * iv;
+          float dfv = dc_k * cpv;
+          dgates[0 * D + k2] = div2 * iv * (1.0f - iv);
+          dgates[1 * D + k2] = dfv * fv * (1.0f - fv);
+          dgates[2 * D + k2] = dgv * (1.0f - gv * gv);
+          dgates[3 * D + k2] = dgo;
+          dcr[k2] = dc_k * fv;
+        }
+        if (cbga != nullptr) {
+          for (int64_t g2 = 0; g2 < 4 * D; ++g2) cbga[g2] += dgates[g2];
+        }
+        if (cwga != nullptr) {
+          for (int64_t j = 0; j < D; ++j) {
+            for (int64_t g2 = 0; g2 < 4 * D; ++g2) {
+              cwga[j * 4 * D + g2] += hprev[j] * dgates[g2];
+            }
+          }
+          for (int64_t j = 0; j < C; ++j) {
+            for (int64_t g2 = 0; g2 < 4 * D; ++g2) {
+              cwga[(D + j) * 4 * D + g2] += ctx[j] * dgates[g2];
+            }
+          }
+          for (int64_t j = 0; j < M; ++j) {
+            for (int64_t g2 = 0; g2 < 4 * D; ++g2) {
+              cwga[(D + C + j) * 4 * D + g2] += xrow[j] * dgates[g2];
+            }
+          }
+        }
+        if (xga != nullptr) {
+          float* xgr = xga + (b * T + t) * M;
+          for (int64_t j = 0; j < M; ++j) {
+            float acc = 0.0f;
+            for (int64_t g2 = 0; g2 < 4 * D; ++g2) {
+              acc += cwa[(D + C + j) * 4 * D + g2] * dgates[g2];
+            }
+            xgr[j] += acc;
+          }
+        }
+        for (int64_t j = 0; j < C; ++j) {
+          float acc = 0.0f;
+          for (int64_t g2 = 0; g2 < 4 * D; ++g2) {
+            acc += cwa[(D + j) * 4 * D + g2] * dgates[g2];
+          }
+          dctx[j] = acc;
+        }
+        // dh from the cell's h_prev rows (overwrite carry)
+        for (int64_t j = 0; j < D; ++j) {
+          float acc = 0.0f;
+          for (int64_t g2 = 0; g2 < 4 * D; ++g2) {
+            acc += cwa[j * 4 * D + g2] * dgates[g2];
+          }
+          dhr[j] = acc;
+        }
+        // attention backward (skipped for zero-length rows: ctx was a
+        // constant 0 there, exactly like the forward)
+        if (len > 0) {
+          double adot = 0.0;
+          for (int64_t s2 = 0; s2 < len; ++s2) {
+            const float* evr = eva + (b * S + s2) * C;
+            float acc = 0.0f;
+            for (int64_t j = 0; j < C; ++j) acc += dctx[j] * evr[j];
+            dalpha[s2] = acc;
+            adot += static_cast<double>(arow[s2]) * acc;
+            if (evga != nullptr) {
+              float* evgr = evga + (b * S + s2) * C;
+              for (int64_t j = 0; j < C; ++j) {
+                evgr[j] += arow[s2] * dctx[j];
+              }
+            }
+          }
+          float dsp_scalar = 0.0f;
+          for (int64_t s2 = 0; s2 < len; ++s2) {
+            // softmax adjoint, then tanh: recompute the score u_s
+            float de = arow[s2] * (dalpha[s2] -
+                                   static_cast<float>(adot));
+            const float* epr = epa + (b * S + s2) * D;
+            float dot = 0.0f;
+            for (int64_t j = 0; j < D; ++j) dot += epr[j] * waa[j];
+            float e2 = std::tanh(dot + sp_scalar);
+            float du_s = de * (1.0f - e2 * e2);
+            dsp_scalar += du_s;
+            if (waga != nullptr) {
+              for (int64_t j = 0; j < D; ++j) {
+                waga[j] += du_s * epr[j];
+              }
+            }
+            if (epga != nullptr) {
+              float* epgr = epga + (b * S + s2) * D;
+              for (int64_t j = 0; j < D; ++j) {
+                epgr[j] += du_s * waa[j];
+              }
+            }
+          }
+          for (int64_t j = 0; j < D; ++j) {
+            dsp[j] = dsp_scalar * waa[D + j];
+            if (waga != nullptr) waga[D + j] += dsp_scalar * sp[j];
+          }
+          if (wsga != nullptr) {
+            for (int64_t k2 = 0; k2 < D; ++k2) {
+              for (int64_t j = 0; j < D; ++j) {
+                wsga[k2 * D + j] += hprev[k2] * dsp[j];
+              }
+            }
+          }
+          for (int64_t k2 = 0; k2 < D; ++k2) {
+            float acc = 0.0f;
+            for (int64_t j = 0; j < D; ++j) {
+              acc += wsa[k2 * D + j] * dsp[j];
+            }
+            dhr[k2] += acc;
+          }
+        }
+        if (t == 0 && h0ga != nullptr) {
+          for (int64_t j = 0; j < D; ++j) {
+            h0ga[b * D + j] += dhr[j];
+          }
+        }
+      }
+    }
+    if (want_x) scope->Set(*OneName(op, "X@GRAD", false), std::move(xg));
+    if (want_ev) {
+      scope->Set(*OneName(op, "EncoderVec@GRAD", false), std::move(evg));
+    }
+    if (want_ep) {
+      scope->Set(*OneName(op, "EncoderProj@GRAD", false),
+                 std::move(epg));
+    }
+    if (want_h0) {
+      scope->Set(*OneName(op, "H0@GRAD", false), std::move(h0g));
+    }
+    if (want_ws) {
+      scope->Set(*OneName(op, "StateProjW@GRAD", false), std::move(wsg));
+    }
+    if (want_wa) {
+      scope->Set(*OneName(op, "AttnW@GRAD", false), std::move(wag));
+    }
+    if (want_cw) {
+      scope->Set(*OneName(op, "CellW@GRAD", false), std::move(cwg));
+    }
+    if (want_cb) {
+      scope->Set(*OneName(op, "CellB@GRAD", false), std::move(cbg));
+    }
+    return "";
+  }
 
   // layer_norm backward (classic adjoint over the flattened rows the
   // forward normalizes): with yhat = (x - mu)/sigma and G = dy*gamma,

@@ -1205,3 +1205,105 @@ def test_transformer_block_train_step_parity_cpp_vs_xla(
                                err_msg="attention-path weight diverged")
     np.testing.assert_allclose(ln_cpp, ln_xla, rtol=2e-3, atol=1e-5,
                                err_msg="layer_norm scale grad diverged")
+
+
+@pytest.mark.parametrize("with_len", [False, True])
+def test_attention_lstm_train_step_parity_cpp_vs_xla(tmp_path, with_len):
+    """Final sequence family (r5): the fused attention_lstm decoder
+    trains in C++ — one SGD step through attention (stored-alpha
+    softmax adjoint, tanh scores, state projection) and the LSTM cell,
+    H0 grads included, matches the XLA executor on loss and every
+    attention/cell parameter."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    B, T, S, D, C, M = 2, 3, 4, 3, 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, M], dtype="float32")
+        ev = fluid.layers.data(name="ev", shape=[S, C], dtype="float32")
+        ep = fluid.layers.data(name="ep", shape=[S, D], dtype="float32")
+        t = fluid.layers.data(name="t", shape=[D], dtype="float32")
+        h0 = fluid.layers.reduce_mean(
+            fluid.layers.fc(x, D, num_flatten_dims=2, name="al_h0"),
+            dim=[1])
+        wsa = fluid.layers.create_parameter([D, D], "float32",
+                                            name="al_wsa")
+        waa = fluid.layers.create_parameter([2 * D, 1], "float32",
+                                            name="al_waa")
+        cw = fluid.layers.create_parameter([D + C + M, 4 * D],
+                                           "float32", name="al_cw")
+        cb = fluid.layers.create_parameter([1, 4 * D], "float32",
+                                           name="al_cb")
+        helper = LayerHelper("al")
+        hid = helper.create_variable_for_type_inference("float32")
+        cell = helper.create_variable_for_type_inference("float32")
+        aw = helper.create_variable_for_type_inference("float32")
+        inputs = {"X": [x.name], "EncoderVec": [ev.name],
+                  "EncoderProj": [ep.name], "H0": [h0.name],
+                  "StateProjW": [wsa.name], "AttnW": [waa.name],
+                  "CellW": [cw.name], "CellB": [cb.name]}
+        feed = {}
+        if with_len:
+            el = fluid.layers.data(name="el", shape=[1], dtype="int64")
+            inputs["EncoderLen"] = [el.name]
+            feed["el"] = np.asarray([[S], [S - 2]], "int64")
+        helper.append_op(type="attention_lstm", inputs=inputs,
+                         outputs={"Hidden": [hid.name],
+                                  "Cell": [cell.name],
+                                  "AttentionWeight": [aw.name]})
+        pooled = fluid.layers.reduce_mean(hid, dim=[1])
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pooled, t)))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    rng = np.random.RandomState(2)
+    feed.update({
+        "x": (rng.randn(B, T, M) * 0.4).astype("float32"),
+        "ev": rng.randn(B, S, C).astype("float32"),
+        "ep": (rng.randn(B, S, D) * 0.4).astype("float32"),
+        "t": rng.randn(B, D).astype("float32"),
+    })
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        want = {n: np.asarray(scope.get_value(n))
+                for n in ("al_wsa.w_0", "al_waa.w_0", "al_cw.w_0",
+                          "al_cb.w_0", "al_h0.w_0")}
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        got = {n: ns.get(n) for n in want}
+    finally:
+        lib.ptpu_program_destroy(prog)
+    np.testing.assert_allclose(np.ravel(cpp_loss)[0],
+                               np.ravel(np.asarray(xla_loss))[0],
+                               rtol=1e-4, atol=1e-5)
+    for n in sorted(want):
+        np.testing.assert_allclose(
+            got[n], want[n], rtol=2e-3, atol=1e-5,
+            err_msg="attention_lstm param %s diverged" % n)
