@@ -413,6 +413,7 @@ class Interpreter {
       return RunSeqPoolGrad(op, scope);
     }
     if (op.type == "sum_grad") return RunSumGrad(op, scope);
+    if (op.type == "concat_grad") return RunConcatGrad(op, scope);
     if (op.type == "reshape_grad" || op.type == "flatten_grad" ||
         op.type == "reshape2_grad" || op.type == "flatten2_grad") {
       return RunReshapeGrad(op, scope);
@@ -4093,6 +4094,70 @@ class Interpreter {
       }
     }
     scope->Set(*gn, std::move(grad));
+    return "";
+  }
+
+
+  // concat backward: split dOut back into the inputs' spans along axis
+  std::string RunConcatGrad(const OpDesc& op, Scope* scope) {
+    auto xs_it = op.inputs.find("X");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    auto gs_it = op.outputs.find("X@GRAD");
+    if (xs_it == op.inputs.end() || ogn == nullptr ||
+        gs_it == op.outputs.end()) {
+      return "missing io";
+    }
+    const HostTensor* og = scope->Find(*ogn);
+    if (og == nullptr) return "input not in scope";
+    if (!IsF32(*og) || og->dims.empty()) return "bad dOut";
+    size_t rank = og->dims.size();
+    int64_t axis = IntAttr(op, "axis", 0);
+    if (axis < 0) axis += rank;
+    if (axis < 0 || axis >= static_cast<int64_t>(rank)) {
+      return "axis out of range";
+    }
+    int64_t outer = 1, inner = 1;
+    for (int64_t d = 0; d < axis; ++d) outer *= og->dims[d];
+    for (size_t d = axis + 1; d < rank; ++d) inner *= og->dims[d];
+    const float* ga = F32(*og);
+    int64_t offset = 0;
+    int64_t og_axis = og->dims[axis];
+    if (xs_it->second.size() != gs_it->second.size()) {
+      return "X/X@GRAD arity mismatch";
+    }
+    // NB: this axis-split copy mirrors RunSplit's; a fix to either's
+    // span/offset math must be mirrored in the other
+    for (size_t i = 0; i < xs_it->second.size(); ++i) {
+      if (xs_it->second[i].empty()) {
+        // forward RunConcat skips empty entries; mirror it (and keep
+        // the offset accounting aligned with the forward's sum)
+        continue;
+      }
+      const HostTensor* x = scope->Find(xs_it->second[i]);
+      if (x == nullptr) return "input not in scope";
+      if (x->dims.size() != rank) return "rank mismatch";
+      for (size_t d = 0; d < rank; ++d) {
+        if (static_cast<int64_t>(d) != axis &&
+            x->dims[d] != og->dims[d]) {
+          return "shape mismatch off the concat axis";
+        }
+      }
+      int64_t span = x->dims[axis];
+      if (offset + span > og_axis) return "axis spans exceed dOut";
+      const std::string& gname =
+          i < gs_it->second.size() ? gs_it->second[i] : std::string();
+      if (!gname.empty()) {
+        HostTensor grad = MakeF32(x->dims);
+        float* ra = MutF32(&grad);
+        for (int64_t o = 0; o < outer; ++o) {
+          const float* src = ga + (o * og_axis + offset) * inner;
+          std::copy(src, src + span * inner, ra + o * span * inner);
+        }
+        scope->Set(gname, std::move(grad));
+      }
+      offset += span;
+    }
+    if (offset != og_axis) return "axis spans do not cover dOut";
     return "";
   }
 
