@@ -399,6 +399,9 @@ class Interpreter {
     if (op.type == "attention_lstm_grad") {
       return RunAttentionLstmGrad(op, scope);
     }
+    if (op.type == "batch_norm_grad") {
+      return RunBatchNormGrad(op, scope);
+    }
     if (op.type == "scaled_dot_product_attention_grad") {
       return RunSDPAGrad(op, scope);
     }
@@ -893,10 +896,13 @@ class Interpreter {
       return "input not in scope";
     }
     if (!IsF32(*x) || x->dims.size() < 2) return "bad input";
-    if (IntAttr(op, "is_test", 0) == 0) {
-      return "training-mode batch_norm unsupported (clone for_test first)";
-    }
+    bool is_test = IntAttr(op, "is_test", 0) != 0 ||
+                   IntAttr(op, "use_global_stats", 0) != 0;
     float eps = FloatAttr(op, "epsilon", 1e-5f);
+    float momentum = FloatAttr(op, "momentum", 0.9f);
+    if (StrAttr(op, "data_layout", "NCHW") != "NCHW") {
+      return "only NCHW";
+    }
     int64_t n = x->dims[0], c = x->dims[1];
     if (n <= 0 || c <= 0) return "empty input";
     if (!IsF32(*sc) || !IsF32(*bi) || !IsF32(*me) || !IsF32(*va)) {
@@ -914,17 +920,161 @@ class Interpreter {
     const float* ba = F32(*bi);
     const float* ma = F32(*me);
     const float* vaa = F32(*va);
+    // training mode: batch statistics per channel (double accumulation
+    // like the XLA f32 reduce; ops/nn_ops.py _lower_batch_norm) plus
+    // the running-stat momentum update and the Saved* intermediates
+    // the grad op consumes
+    std::vector<float> bmean(c), bvar(c);
+    if (!is_test) {
+      int64_t cnt = n * spatial;
+      if (cnt <= 0) return "empty input";
+      for (int64_t ch = 0; ch < c; ++ch) {
+        double mean = 0.0, sq = 0.0;
+        for (int64_t b = 0; b < n; ++b) {
+          const float* src = xa + (b * c + ch) * spatial;
+          for (int64_t i = 0; i < spatial; ++i) {
+            mean += src[i];
+            sq += static_cast<double>(src[i]) * src[i];
+          }
+        }
+        mean /= cnt;
+        bmean[ch] = static_cast<float>(mean);
+        bvar[ch] = static_cast<float>(sq / cnt - mean * mean);
+      }
+      auto emit_vec = [&](const char* slot, const float* vals,
+                          const std::vector<int64_t>& dims) {
+        const std::string* nm = OneName(op, slot, false);
+        if (nm == nullptr) return;
+        HostTensor t2 = MakeF32(dims);
+        std::copy(vals, vals + c, MutF32(&t2));
+        scope->Set(*nm, std::move(t2));
+      };
+      std::vector<float> mout(c), vout(c);
+      for (int64_t ch = 0; ch < c; ++ch) {
+        mout[ch] = ma[ch] * momentum + bmean[ch] * (1.0f - momentum);
+        vout[ch] = vaa[ch] * momentum + bvar[ch] * (1.0f - momentum);
+      }
+      emit_vec("MeanOut", mout.data(), me->dims);
+      emit_vec("VarianceOut", vout.data(), va->dims);
+      emit_vec("SavedMean", bmean.data(), {c});
+      emit_vec("SavedVariance", bvar.data(), {c});
+    }
     for (int64_t b = 0; b < n; ++b) {
       for (int64_t ch = 0; ch < c; ++ch) {
-        float inv = 1.0f / std::sqrt(vaa[ch] + eps);
+        float mu = is_test ? ma[ch] : bmean[ch];
+        float vv = is_test ? vaa[ch] : bvar[ch];
+        float inv = 1.0f / std::sqrt(vv + eps);
         const float* src = xa + (b * c + ch) * spatial;
         float* dst = oa + (b * c + ch) * spatial;
         for (int64_t i = 0; i < spatial; ++i) {
-          dst[i] = sa[ch] * (src[i] - ma[ch]) * inv + ba[ch];
+          dst[i] = sa[ch] * (src[i] - mu) * inv + ba[ch];
         }
       }
     }
     scope->Set(*yn, std::move(out));
+    return "";
+  }
+
+  // batch_norm training backward (classic per-channel adjoint over the
+  // SavedMean/SavedVariance batch stats the forward emitted):
+  // dScale = sum(g*xhat), dBias = sum(g),
+  // dx = inv/N * (N*g*scale - sum(g*scale) - xhat*sum(g*scale*xhat))
+  std::string RunBatchNormGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* sn = OneName(op, "Scale");
+    const std::string* smn = OneName(op, "SavedMean");
+    const std::string* svn = OneName(op, "SavedVariance");
+    const std::string* ygn = OneName(op, "Y@GRAD");
+    if (xn == nullptr || sn == nullptr || smn == nullptr ||
+        svn == nullptr || ygn == nullptr) {
+      return "missing io";
+    }
+    // frozen-BN (use_global_stats / is_test clones used in training):
+    // the stats are constants, so dx = g*scale*inv with no batch-mean
+    // correction terms. SavedMean/SavedVariance hold the global stats
+    // in that mode (the forward set saved = running).
+    bool frozen = IntAttr(op, "is_test", 0) != 0 ||
+                  IntAttr(op, "use_global_stats", 0) != 0;
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* sc = scope->Find(*sn);
+    const HostTensor* sm = scope->Find(*smn);
+    const HostTensor* sv = scope->Find(*svn);
+    const HostTensor* yg = scope->Find(*ygn);
+    for (const HostTensor* tt : {x, sc, sm, sv, yg}) {
+      if (tt == nullptr) return "input not in scope";
+      if (!IsF32(*tt)) return "non-f32 dtype";
+    }
+    if (x->dims.size() < 2 || yg->dims != x->dims) return "bad input";
+    float eps = FloatAttr(op, "epsilon", 1e-5f);
+    int64_t n = x->dims[0], c = x->dims[1];
+    if (n <= 0 || c <= 0) return "empty input";
+    if (NumElements(sc->dims) < c || NumElements(sm->dims) < c ||
+        NumElements(sv->dims) < c) {
+      return "bn param too small";
+    }
+    int64_t spatial = NumElements(x->dims) / (n * c);
+    int64_t cnt = n * spatial;
+    const float* xa = F32(*x);
+    const float* sa = F32(*sc);
+    const float* sma = F32(*sm);
+    const float* sva = F32(*sv);
+    const float* ga = F32(*yg);
+    const std::string* xgn = OneName(op, "X@GRAD", false);
+    const std::string* sgn = OneName(op, "Scale@GRAD", false);
+    const std::string* bgn = OneName(op, "Bias@GRAD", false);
+    HostTensor xg, sg, bg;
+    float* xga = nullptr;
+    float* sga = nullptr;
+    float* bga = nullptr;
+    if (xgn != nullptr) {
+      xg = MakeF32(x->dims);
+      xga = MutF32(&xg);
+    }
+    if (sgn != nullptr) {
+      sg = MakeF32({c});
+      sga = MutF32(&sg);
+    }
+    if (bgn != nullptr) {
+      bg = MakeF32({c});
+      bga = MutF32(&bg);
+    }
+    for (int64_t ch = 0; ch < c; ++ch) {
+      float mu = sma[ch];
+      float inv = 1.0f / std::sqrt(sva[ch] + eps);
+      double sum_g = 0.0, sum_gx = 0.0;
+      for (int64_t b = 0; b < n; ++b) {
+        const float* src = xa + (b * c + ch) * spatial;
+        const float* grow = ga + (b * c + ch) * spatial;
+        for (int64_t i = 0; i < spatial; ++i) {
+          sum_g += grow[i];
+          sum_gx += static_cast<double>(grow[i]) * (src[i] - mu) * inv;
+        }
+      }
+      if (sga != nullptr) sga[ch] = static_cast<float>(sum_gx);
+      if (bga != nullptr) bga[ch] = static_cast<float>(sum_g);
+      if (xga != nullptr) {
+        float scale = sa[ch];
+        float mean_g = static_cast<float>(sum_g / cnt);
+        float mean_gx = static_cast<float>(sum_gx / cnt);
+        for (int64_t b = 0; b < n; ++b) {
+          const float* src = xa + (b * c + ch) * spatial;
+          const float* grow = ga + (b * c + ch) * spatial;
+          float* dst = xga + (b * c + ch) * spatial;
+          for (int64_t i = 0; i < spatial; ++i) {
+            if (frozen) {
+              dst[i] = scale * inv * grow[i];
+            } else {
+              float xhat = (src[i] - mu) * inv;
+              dst[i] = scale * inv *
+                       (grow[i] - mean_g - xhat * mean_gx);
+            }
+          }
+        }
+      }
+    }
+    if (xgn != nullptr) scope->Set(*xgn, std::move(xg));
+    if (sgn != nullptr) scope->Set(*sgn, std::move(sg));
+    if (bgn != nullptr) scope->Set(*bgn, std::move(bg));
     return "";
   }
 

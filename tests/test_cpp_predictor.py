@@ -1391,3 +1391,139 @@ def test_machine_translation_full_train_step_parity_cpp_vs_xla(tmp_path):
             "only %d params changed — the step didn't train" % changed)
     finally:
         lib.ptpu_program_destroy(prog)
+
+
+def test_batch_norm_train_step_parity_cpp_vs_xla(tmp_path):
+    """r5: TRAINING-mode batch_norm in C++ (batch stats, running-stat
+    momentum update, classic adjoint). One SGD step of a conv+BN+relu
+    block: loss, conv filter, BN scale/bias AND the updated running
+    mean/variance must match the XLA executor."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2, 6, 6], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        v = fluid.layers.conv2d(x, 4, 3, padding=1, bias_attr=False)
+        v = fluid.layers.batch_norm(v, act="relu")   # TRAIN mode
+        logits = fluid.layers.fc(v, 3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(19)
+    feed = {"x": rng.randn(3, 2, 6, 6).astype("float32"),
+            "label": rng.randint(0, 3, (3, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        want = {n: np.asarray(scope.get_value(n))
+                for n in params}
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        np.testing.assert_allclose(
+            np.ravel(cpp_loss)[0], np.ravel(np.asarray(xla_loss))[0],
+            rtol=1e-4, atol=1e-5)
+        for name in sorted(want):
+            if want[name].dtype.kind != "f":
+                continue
+            got = ns.get(name)
+            assert got is not None, "missing %r" % name
+            np.testing.assert_allclose(
+                got, want[name], rtol=2e-3, atol=1e-5,
+                err_msg="BN-block var %s diverged (incl. running "
+                        "stats)" % name)
+    finally:
+        lib.ptpu_program_destroy(prog)
+
+
+def test_resnet_cifar_train_step_parity_cpp_vs_xla(tmp_path):
+    """With training-mode batch_norm, a REAL ResNet (resnet_cifar10
+    depth-8: conv+BN residual blocks with projection shortcuts) trains
+    one SGD step in C++ with loss and every parameter incl. BN running
+    stats matching the XLA executor."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.models import resnet
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="pixel", shape=[3, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        pred = resnet.resnet_cifar10(img, class_dim=4, depth=8)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    rng = np.random.RandomState(23)
+    feed = {"pixel": rng.rand(2, 3, 8, 8).astype("float32"),
+            "label": rng.randint(0, 4, (2, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        want = {n: np.asarray(scope.get_value(n)) for n in params}
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        np.testing.assert_allclose(
+            np.ravel(cpp_loss)[0], np.ravel(np.asarray(xla_loss))[0],
+            rtol=1e-4, atol=1e-5)
+        for name in sorted(want):
+            if want[name].dtype.kind != "f":
+                continue
+            got = ns.get(name)
+            assert got is not None, "missing %r" % name
+            np.testing.assert_allclose(
+                got, want[name], rtol=3e-3, atol=2e-5,
+                err_msg="resnet var %s diverged" % name)
+    finally:
+        lib.ptpu_program_destroy(prog)
