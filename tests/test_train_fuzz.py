@@ -40,7 +40,8 @@ class CppRefusal(Exception):
 def _random_body(rng, x, feed, B):
     """Random trunk over the training-safe layer menu; returns a 2-D
     [B, n] tensor."""
-    kind = rng.choice(["mlp", "conv", "gru", "lstm", "embed", "attn"])
+    kind = rng.choice(["mlp", "conv", "gru", "lstm", "embed", "attn",
+                       "convbn"])
     if kind == "mlp":
         h = x
         for _ in range(int(rng.randint(1, 3))):
@@ -87,6 +88,20 @@ def _random_body(rng, x, feed, B):
                 use_peepholes=bool(rng.rand() < 0.5),
                 is_reverse=bool(rng.rand() < 0.5), **kwargs)
         return fluid.layers.reduce_mean(h, dim=[1])
+    if kind == "convbn":
+        hw = int(rng.choice([6, 8]))
+        img = fluid.layers.data(name="bimg", shape=[2, hw, hw],
+                                dtype="float32")
+        feed["bimg"] = rng.rand(B, 2, hw, hw).astype("float32")
+        v = fluid.layers.conv2d(
+            img, num_filters=int(rng.randint(2, 5)), filter_size=3,
+            padding=1, bias_attr=False)
+        v = fluid.layers.batch_norm(v, act="relu")   # TRAINING mode
+        if rng.rand() < 0.5:
+            sc = fluid.layers.conv2d(img, num_filters=v.shape[1],
+                                     filter_size=1, bias_attr=False)
+            v = fluid.layers.elementwise_add(v, sc, act="relu")
+        return fluid.layers.fc(v, int(rng.randint(3, 7)), act="tanh")
     if kind == "attn":
         T, H, dh = int(rng.choice([3, 4])), int(rng.choice([2, 4])), 4
         kvg = int(rng.choice([1, 2])) if H == 4 else 1
