@@ -402,6 +402,7 @@ class Interpreter {
     if (op.type == "batch_norm_grad") {
       return RunBatchNormGrad(op, scope);
     }
+    if (op.type == "lrn_grad") return RunLrnGrad(op, scope);
     if (op.type == "scaled_dot_product_attention_grad") {
       return RunSDPAGrad(op, scope);
     }
@@ -972,6 +973,70 @@ class Interpreter {
       }
     }
     scope->Set(*yn, std::move(out));
+    return "";
+  }
+
+
+  // lrn backward over the reference's -(n-1)/2 channel window:
+  // out_i = x_i * mid_i^-beta, mid_i = k + alpha * sum_{j in W(i)} x_j^2
+  // dx_j = g_j*mid_j^-beta
+  //        - 2*alpha*beta*x_j * sum_{i: j in W(i)} g_i*x_i*mid_i^(-beta-1)
+  // (scatter form: iterate i, add its contribution to every j in W(i))
+  std::string RunLrnGrad(const OpDesc& op, Scope* scope) {
+    const std::string* xn = OneName(op, "X");
+    const std::string* mon = OneName(op, "MidOut");
+    const std::string* ogn = OneName(op, "Out@GRAD");
+    const std::string* gn = OneName(op, "X@GRAD", false);
+    if (xn == nullptr || mon == nullptr || ogn == nullptr ||
+        gn == nullptr) {
+      return "missing io";
+    }
+    const HostTensor* x = scope->Find(*xn);
+    const HostTensor* mo = scope->Find(*mon);
+    const HostTensor* og = scope->Find(*ogn);
+    for (const HostTensor* tt : {x, mo, og}) {
+      if (tt == nullptr) return "input not in scope";
+      if (!IsF32(*tt)) return "non-f32 dtype";
+    }
+    if (x->dims.size() != 4 || mo->dims != x->dims ||
+        og->dims != x->dims) {
+      return "bad input";
+    }
+    int64_t n = IntAttr(op, "n", 5);
+    float alpha = FloatAttr(op, "alpha", 1e-4f);
+    float beta = FloatAttr(op, "beta", 0.75f);
+    if (n <= 0) return "bad window";
+    int64_t half = (n - 1) / 2;  // reference window, same as forward
+    int64_t b = x->dims[0], c = x->dims[1], h = x->dims[2],
+            wd = x->dims[3];
+    int64_t hw = h * wd;
+    HostTensor grad = MakeF32(x->dims);
+    float* ra = MutF32(&grad);
+    std::fill(ra, ra + NumElements(x->dims), 0.0f);
+    const float* xa = F32(*x);
+    const float* moa = F32(*mo);
+    const float* ga = F32(*og);
+    for (int64_t bi = 0; bi < b; ++bi) {
+      for (int64_t ci = 0; ci < c; ++ci) {
+        int64_t lo = std::max<int64_t>(0, ci - half);
+        int64_t hi = std::min<int64_t>(c - 1, ci + (n - 1 - half));
+        for (int64_t p = 0; p < hw; ++p) {
+          int64_t idx = (bi * c + ci) * hw + p;
+          float mid = moa[idx];
+          float mb = std::pow(mid, -beta);
+          float g = ga[idx];
+          // direct term
+          ra[idx] += g * mb;
+          // scatter the cross term into every window member
+          float common = 2.0f * alpha * beta * g * xa[idx] * mb / mid;
+          for (int64_t cj = lo; cj <= hi; ++cj) {
+            int64_t jdx = (bi * c + cj) * hw + p;
+            ra[jdx] -= common * xa[jdx];
+          }
+        }
+      }
+    }
+    scope->Set(*gn, std::move(grad));
     return "";
   }
 
@@ -1674,6 +1739,14 @@ class Interpreter {
     HostTensor out = MakeF32(x->dims);
     const float* xa = F32(*x);
     float* oa = MutF32(&out);
+    // MidOut (k + alpha*acc) is the intermediate the grad op consumes
+    const std::string* midn = OneName(op, "MidOut", false);
+    HostTensor midt;
+    float* mida = nullptr;
+    if (midn != nullptr) {
+      midt = MakeF32(x->dims);
+      mida = MutF32(&midt);
+    }
     int64_t hw = h * w;
     for (int64_t bi = 0; bi < b; ++bi) {
       for (int64_t ci = 0; ci < c; ++ci) {
@@ -1686,12 +1759,14 @@ class Interpreter {
             acc += v * v;
           }
           float mid = k + alpha * acc;
+          if (mida != nullptr) mida[(bi * c + ci) * hw + p] = mid;
           oa[(bi * c + ci) * hw + p] =
               xa[(bi * c + ci) * hw + p] / std::pow(mid, beta);
         }
       }
     }
     scope->Set(*on, std::move(out));
+    if (midn != nullptr) scope->Set(*midn, std::move(midt));
     return "";
   }
 

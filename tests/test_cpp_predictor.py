@@ -1527,3 +1527,74 @@ def test_resnet_cifar_train_step_parity_cpp_vs_xla(tmp_path):
                 err_msg="resnet var %s diverged" % name)
     finally:
         lib.ptpu_program_destroy(prog)
+
+
+def test_alexnet_style_train_step_parity_cpp_vs_xla(tmp_path):
+    """lrn_grad completes the classic-CNN family: one SGD step of an
+    AlexNet-style conv+lrn+pool stack matches XLA on loss and every
+    parameter (the cross-channel lrn adjoint exercised at n=5 and even
+    n=4)."""
+    from paddle_tpu import native
+    from paddle_tpu.core.program_bin import serialize_program
+    from paddle_tpu.testing import set_deterministic_params
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable: %s"
+                    % native.last_error())
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        v = fluid.layers.conv2d(x, 6, 3, padding=1, act="relu")
+        v = fluid.layers.lrn(v, n=5)
+        v = fluid.layers.pool2d(v, pool_size=2, pool_stride=2,
+                                pool_type="max")
+        v = fluid.layers.conv2d(v, 8, 3, padding=1, act="relu")
+        v = fluid.layers.lrn(v, n=4)   # even-n window corner
+        logits = fluid.layers.fc(v, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    rng = np.random.RandomState(27)
+    feed = {"x": rng.rand(2, 3, 8, 8).astype("float32"),
+            "label": rng.randint(0, 4, (2, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.executor.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope = fluid.executor.global_scope()
+        set_deterministic_params(main, scope)
+        params = {n: np.asarray(scope.get_value(n))
+                  for n in scope.local_var_names()
+                  if scope.get_value(n) is not None}
+        (xla_loss,) = exe.run(main, feed=feed, fetch_list=[loss])
+        want = {n: np.asarray(scope.get_value(n)) for n in params}
+    lib = native.get_lib()
+    blob = serialize_program(main)
+    prog = lib.ptpu_program_parse(bytes(blob), len(blob))
+    assert prog, native.last_error()
+    try:
+        ns = native.NativeScope()
+        for name, val in params.items():
+            arr = np.asarray(val)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ns.set(name, arr)
+        for name, val in feed.items():
+            ns.set(name, val)
+        rc = lib.ptpu_interp_run(prog, ns._h, 0)
+        assert rc == 0, native.last_error()
+        cpp_loss = ns.get(loss.name)
+        np.testing.assert_allclose(
+            np.ravel(cpp_loss)[0], np.ravel(np.asarray(xla_loss))[0],
+            rtol=1e-4, atol=1e-5)
+        for name in sorted(want):
+            if want[name].dtype.kind != "f":
+                continue
+            got = ns.get(name)
+            assert got is not None, "missing %r" % name
+            np.testing.assert_allclose(
+                got, want[name], rtol=3e-3, atol=2e-5,
+                err_msg="alexnet-style var %s diverged" % name)
+    finally:
+        lib.ptpu_program_destroy(prog)
