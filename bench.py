@@ -336,6 +336,23 @@ def _worker_main():
         result["mfu"] = (
             round(rate * gflop * 1e9 / (peak * 1e12), 4) if peak else None
         )
+        # compile-tax telemetry (core/exec_cache.py): cold = seconds in
+        # fresh XLA compiles, warm = seconds loading cached executables.
+        # With FLAGS_exec_cache_dir set to a warm dir, cold drops to ~0 —
+        # the bench trajectory tracks the compile tax either way.
+        from paddle_tpu.core import exec_cache
+
+        cache = exec_cache.stats()
+        result["compile_seconds_cold"] = round(
+            cache["compile_seconds_cold"], 3)
+        result["compile_seconds_warm"] = round(
+            cache["compile_seconds_warm"], 3)
+        result["exec_cache"] = {
+            "enabled": cache["enabled"],
+            "fresh_compiles": cache["fresh_compiles"],
+            "persistent_hits": cache["persistent_hits"],
+            "aot_hits": cache["aot_hits"],
+        }
         # both models' gflop_per_unit now count 2 FLOPs per MAC, matching
         # the peak's convention; pre-r5 ResNet records used GMACs and
         # read 2x low (see TRAIN_GFLOP_PER_IMG note)

@@ -1,0 +1,396 @@
+"""Persistent cross-process executable cache + compile-tax telemetry.
+
+Every process start (serving replica, bench run, CI shard) used to pay the
+full XLA compile from scratch. This module kills that tax in two layers,
+both keyed off the structural fingerprints in ``core/fingerprint.py`` and
+switched by ``FLAGS_exec_cache_dir`` (empty = disabled, zero overhead):
+
+1. **XLA compile cache** (``<dir>/xla``): JAX's persistent compilation
+   cache, enabled process-wide. A warm process still re-traces the program
+   to HLO, but the backend compile is replaced by a disk load (content
+   hash of the HLO module, so it also dedups across Executor instances
+   and structurally identical programs).
+2. **AOT executable images** (``<dir>/aot``): serialized
+   ``lower()``/``compile()`` output of the whole step function, keyed by
+   ``fingerprint.executable_key`` x argument avals x jax/jaxlib versions.
+   A warm process skips even the trace: the executable deserializes
+   straight into a callable.
+
+Corruption/eviction tolerance: every load path catches, counts, deletes
+the bad entry and falls back to a fresh compile — a bad cache entry can
+cost time, never correctness, and never a crash. ``FLAGS_exec_cache_max_bytes``
+bounds both layers (LRU on the XLA cache, oldest-mtime trim on AOT files).
+
+TRUST BOUNDARY: AOT images deserialize through pickle, so the cache dir
+must be writable only by principals you would let execute code in this
+process (dirs are created 0o700; never point the flag at a
+world-writable path).
+
+Stats: counters below are exported through ``profiler.exec_cache_stats()``
+and feed ``bench.py``'s ``compile_seconds_cold``/``compile_seconds_warm``
+fields. Backend compile time is observed via ``jax.monitoring`` events, so
+compiles that happen outside this module (stray helper jits) are counted
+too — the numbers are the process's whole compile tax, not just the
+executor's share.
+"""
+
+import hashlib
+import os
+import pickle
+import tempfile
+import threading
+import time
+
+import jax
+
+_lock = threading.Lock()
+_tls = threading.local()
+
+_STAT_KEYS = (
+    "trace_cache_hits",      # in-process CompiledProgram reuse (executor)
+    "trace_cache_misses",    # CompiledProgram constructions (re-traces)
+    "backend_compiles",      # XLA backend compile calls observed
+    "persistent_hits",       # backend compiles served from the disk cache
+    "persistent_misses",     # backend compiles that ran for real
+    "aot_hits",              # whole executables deserialized from disk
+    "aot_misses",
+    "aot_errors",            # corrupt/incompatible AOT entries tolerated
+)
+
+_stats = {k: 0 for k in _STAT_KEYS}
+_stats.update(
+    compile_seconds=0.0,         # total wall time inside backend compiles
+    compile_seconds_cold=0.0,    # ...attributable to fresh compiles
+    compile_seconds_warm=0.0,    # ...attributable to cache loads
+    cache_retrieval_seconds=0.0,
+)
+
+_configured = {"dir": None}
+
+
+# -- monitoring taps ---------------------------------------------------------
+def _on_event(name, **kw):
+    if name == "/jax/compilation_cache/compile_requests_use_cache":
+        # fires at the start of every cache-consulting compile: clearing
+        # here keeps a stale hit/miss verdict from a compile that never
+        # emitted its duration event out of the next attribution
+        _tls.last = None
+    elif name == "/jax/compilation_cache/cache_hits":
+        with _lock:
+            _stats["persistent_hits"] += 1
+        _tls.last = "hit"
+    elif name == "/jax/compilation_cache/cache_misses":
+        with _lock:
+            _stats["persistent_misses"] += 1
+        _tls.last = "miss"
+
+
+def _on_duration(name, secs, **kw):
+    if name == "/jax/core/compile/backend_compile_duration":
+        # the hit/miss event for THIS compile fired earlier on this same
+        # thread (jax records them synchronously inside the compile call),
+        # so a thread-local carries the attribution across the two taps
+        last = getattr(_tls, "last", None)
+        _tls.last = None
+        with _lock:
+            _stats["backend_compiles"] += 1
+            _stats["compile_seconds"] += secs
+            if last == "hit":
+                _stats["compile_seconds_warm"] += secs
+            else:
+                _stats["compile_seconds_cold"] += secs
+    elif name == "/jax/compilation_cache/cache_retrieval_time_sec":
+        with _lock:
+            _stats["cache_retrieval_seconds"] += secs
+
+
+jax.monitoring.register_event_listener(_on_event)
+jax.monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+def record_trace_hit():
+    with _lock:
+        _stats["trace_cache_hits"] += 1
+
+
+def record_trace_miss():
+    with _lock:
+        _stats["trace_cache_misses"] += 1
+
+
+def stats():
+    """Snapshot of the cache counters. ``fresh_compiles`` is the number of
+    XLA compiles no cache layer could serve — the warm-start smoke stage
+    asserts it is zero in a second process sharing the cache dir."""
+    with _lock:
+        snap = dict(_stats)
+    snap["enabled"] = _configured["dir"] is not None
+    snap["cache_dir"] = _configured["dir"]
+    snap["fresh_compiles"] = (
+        snap["persistent_misses"] if snap["enabled"]
+        else snap["backend_compiles"]
+    )
+    return snap
+
+
+def reset_stats():
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0.0 if isinstance(_stats[k], float) else 0
+
+
+# -- configuration -----------------------------------------------------------
+def configure(cache_dir=None):
+    """Point both cache layers at ``cache_dir`` (default: the
+    ``exec_cache_dir`` flag). Idempotent; safe to call per compile. An
+    empty dir disables persistence (and re-disables it if a previous test
+    or run had enabled it with a since-deleted temp dir)."""
+    if cache_dir is None:
+        from paddle_tpu import flags
+
+        cache_dir = flags.get("exec_cache_dir")
+    cache_dir = os.path.abspath(cache_dir) if cache_dir else None
+    if cache_dir == _configured["dir"]:
+        if cache_dir is not None:
+            _apply_max_bytes()  # a flag change must land without a dir change
+        return cache_dir
+    if cache_dir is None:
+        jax.config.update("jax_enable_compilation_cache", False)
+        _reset_jax_cache()
+        _configured["dir"] = None
+        return None
+    # 0o700: AOT images load via pickle, so the dir is code-execution
+    # trusted — keep it private to this user (see module docstring)
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    os.makedirs(os.path.join(cache_dir, "aot"), mode=0o700, exist_ok=True)
+    xla_dir = os.path.join(cache_dir, "xla")
+    os.makedirs(xla_dir, mode=0o700, exist_ok=True)
+    jax.config.update("jax_enable_compilation_cache", True)
+    jax.config.update("jax_compilation_cache_dir", xla_dir)
+    # the defaults skip "too fast / too small" entries; an executor cache
+    # exists to make every process start warm, so persist everything
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # a corrupt entry must degrade to a fresh compile, never a crash
+    jax.config.update("jax_raise_persistent_cache_errors", False)
+    _apply_max_bytes()
+    _reset_jax_cache()
+    _configured["dir"] = cache_dir
+    return cache_dir
+
+
+def _apply_max_bytes():
+    """The flag is the TOTAL budget for the cache dir: half to the XLA
+    layer (jax's LRU), half to the AOT image layer (_trim_aot_dir).
+    Always written — including back to -1/unbounded — so a stale cap from
+    an earlier configuration can't linger."""
+    max_bytes = _max_bytes()
+    jax.config.update(
+        "jax_compilation_cache_max_size",
+        max_bytes // 2 if max_bytes > 0 else -1,
+    )
+
+
+def _max_bytes():
+    from paddle_tpu import flags
+
+    try:
+        return int(flags.get("exec_cache_max_bytes"))
+    except (KeyError, TypeError, ValueError):
+        return -1
+
+
+def _reset_jax_cache():
+    """Drop jax's in-memory handle on the file cache so a dir change (or
+    disable) takes effect mid-process; internal API, so best-effort."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+
+
+def enabled():
+    return _configured["dir"] is not None
+
+
+# -- AOT executable images ---------------------------------------------------
+def _version_tag():
+    import jaxlib
+
+    return "%s|%s" % (jax.__version__, getattr(jaxlib, "__version__", "?"))
+
+
+def _args_signature(args):
+    """Digest of the argument pytree structure + leaf avals: the compiled
+    executable is only valid for exactly these shapes/dtypes."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    parts = [str(treedef)]
+    for leaf in leaves:
+        parts.append(
+            "%s%s" % (getattr(leaf, "dtype", type(leaf).__name__),
+                      tuple(getattr(leaf, "shape", ())))
+        )
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def _aot_path(disk_key, args):
+    full = hashlib.sha256(
+        ("%s|%s|%s" % (disk_key, _args_signature(args), _version_tag()))
+        .encode()
+    ).hexdigest()
+    return os.path.join(_configured["dir"], "aot", full + ".exe")
+
+
+def _remove_quiet(path):
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
+def _load_aot(path):
+    if not os.path.exists(path):
+        return None
+    t0 = time.perf_counter()
+    try:
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.load(f)
+        from jax.experimental import serialize_executable
+
+        loaded = serialize_executable.deserialize_and_load(
+            payload, in_tree, out_tree
+        )
+    except Exception:
+        # corrupt, truncated, or built by an incompatible runtime that
+        # slipped past the version tag: tolerate, delete, recompile
+        with _lock:
+            _stats["aot_errors"] += 1
+        _remove_quiet(path)
+        return None
+    dt = time.perf_counter() - t0
+    with _lock:
+        _stats["aot_hits"] += 1
+        _stats["compile_seconds"] += dt
+        _stats["compile_seconds_warm"] += dt
+    return loaded
+
+
+def _store_aot(path, compiled):
+    try:
+        from jax.experimental import serialize_executable
+
+        payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+        blob = pickle.dumps(
+            (payload, in_tree, out_tree), protocol=pickle.HIGHEST_PROTOCOL
+        )
+        d = os.path.dirname(path)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)  # atomic: readers see old or new, never torn
+        except BaseException:
+            _remove_quiet(tmp)
+            raise
+        _trim_aot_dir(d)
+    except Exception:
+        with _lock:
+            _stats["aot_errors"] += 1
+
+
+def _trim_aot_dir(d):
+    """Oldest-mtime eviction once the AOT layer exceeds its half of the
+    total byte budget (the XLA layer holds the other half)."""
+    budget = _max_bytes() // 2
+    if budget <= 0:
+        return
+    try:
+        entries = []
+        for name in os.listdir(d):
+            p = os.path.join(d, name)
+            st = os.stat(p)
+            entries.append((st.st_mtime, st.st_size, p))
+        total = sum(e[1] for e in entries)
+        for mtime, size, p in sorted(entries):
+            if total <= budget:
+                break
+            _remove_quiet(p)
+            total -= size
+    except OSError:
+        pass
+
+
+def _guarded(loaded, jitted, path):
+    """Wrap a prepared executable so failures degrade to the ordinary jit
+    path instead of poisoning the run: anything on the first call (device
+    topology drift, donation mismatch, a stale image) falls back
+    permanently; a later TypeError (an aval change — e.g. reshaped scope
+    state — that the pinned Compiled rejects but a jit retrace absorbs)
+    falls back per call."""
+    state = {"fn": None}
+
+    def call(*args):
+        fn = state["fn"]
+        if fn is jitted:
+            return jitted(*args)
+        if fn is not None:
+            try:
+                return fn(*args)
+            except TypeError:
+                return jitted(*args)
+        try:
+            out = loaded(*args)
+        except Exception:
+            with _lock:
+                _stats["aot_errors"] += 1
+            _remove_quiet(path)
+            state["fn"] = jitted
+            if any(
+                getattr(leaf, "is_deleted", lambda: False)()
+                for leaf in jax.tree_util.tree_leaves(args)
+            ):
+                # the failed dispatch already consumed donated buffers:
+                # a retry would crash on deleted arrays — propagate the
+                # real error instead of a confusing cascade
+                raise
+            return jitted(*args)
+        state["fn"] = loaded
+        return out
+
+    return call
+
+
+def prepare_executable(jitted, args, disk_key=None):
+    """First-call hook for CompiledProgram/MultiStepProgram: given the
+    jitted step function and the concrete call args, return the callable
+    to use from now on — a deserialized AOT image on a warm start, or the
+    (explicitly lowered+compiled, then serialized) fresh executable.
+    Returns ``jitted`` unchanged when persistence is off, so the default
+    path is byte-identical to before."""
+    if configure() is None or disk_key is None:
+        return jitted
+    if jax.process_count() > 1:
+        # multi-host executables bake in the global topology; the HLO-level
+        # cache layer still applies, the AOT image layer does not
+        return jitted
+    path = _aot_path(disk_key, args)
+    loaded = _load_aot(path)
+    if loaded is not None:
+        return _guarded(loaded, jitted, path)
+    with _lock:
+        _stats["aot_misses"] += 1
+    try:
+        compiled = jitted.lower(*args).compile()
+    except Exception:
+        # an AOT-path-only failure must not take down execution; the
+        # plain jit call compiles the same computation its own way
+        with _lock:
+            _stats["aot_errors"] += 1
+        return jitted
+    _store_aot(path, compiled)
+    # guarded: a Compiled is pinned to these exact avals, but the same
+    # CompiledProgram may later be called with reshaped scope state —
+    # the plain jit path retraces for that case, so fall back to it
+    return _guarded(compiled, jitted, path)

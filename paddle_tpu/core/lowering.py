@@ -10,6 +10,8 @@ XLA's job; gradient ops re-trace forward rules under jax.vjp and XLA CSE
 dedups the recompute.
 """
 
+import threading
+
 import jax
 import jax.numpy as jnp
 
@@ -213,7 +215,35 @@ def build_step_fn(program, feed_names, fetch_names, state_in, state_out,
     return step
 
 
-class CompiledProgram(object):
+class _LazyExecutable(object):
+    """First-call executable resolution through the persistent cache
+    (core/exec_cache.py): an AOT image on a warm start, a fresh (then
+    serialized) compile otherwise. The executor stamps _exec_cache_key
+    after construction; None keeps the plain jit path. Locked: the
+    process-global registry shares one instance across serving threads,
+    and two concurrent first calls must not both pay the compile."""
+
+    def _init_lazy_exec(self):
+        self._exec = None
+        self._exec_cache_key = None
+        self._exec_lock = threading.Lock()
+
+    def _resolve_exec(self, args):
+        fn = self._exec
+        if fn is None:
+            with self._exec_lock:
+                fn = self._exec
+                if fn is None:
+                    from paddle_tpu.core import exec_cache
+
+                    fn = exec_cache.prepare_executable(
+                        self.jitted, args, self._exec_cache_key
+                    )
+                    self._exec = fn
+        return fn
+
+
+class CompiledProgram(_LazyExecutable):
     """One jitted executable for a (program-version, shapes, fetches) key.
 
     With ``shardings`` (a ShardingPolicy from paddle_tpu.parallel), the jit
@@ -263,6 +293,7 @@ class CompiledProgram(object):
             return step(state, feeds, key)
 
         self.shardings = shardings
+        self._init_lazy_exec()
         if shardings is None:
             if device is not None:
                 # Pin the executable to the Place's device: with multiple
@@ -295,10 +326,11 @@ class CompiledProgram(object):
     def __call__(self, state, feeds, key):
         mut = {n: state[n] for n in self.mutable_state}
         frz = {n: state[n] for n in self.frozen_state}
-        return self.jitted(mut, frz, feeds, key)
+        fn = self._resolve_exec((mut, frz, feeds, key))
+        return fn(mut, frz, feeds, key)
 
 
-class MultiStepProgram(object):
+class MultiStepProgram(_LazyExecutable):
     """K training steps compiled into ONE XLA executable via lax.scan.
 
     SURVEY §7 hard part (c): per-step Python dispatch costs a host round
@@ -385,8 +417,10 @@ class MultiStepProgram(object):
             )
         else:
             self.jitted = jax.jit(multi, donate_argnums=(0,))
+        self._init_lazy_exec()
 
     def __call__(self, state, feeds, key):
         mut = {n: state[n] for n in self.mutable_state}
         frz = {n: state[n] for n in self.frozen_state}
-        return self.jitted(mut, frz, feeds, key)
+        fn = self._resolve_exec((mut, frz, feeds, key))
+        return fn(mut, frz, feeds, key)

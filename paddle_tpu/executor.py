@@ -12,11 +12,21 @@ through the step function with buffer donation (in-place semantics without
 mutation).
 """
 
+import threading
+from collections import OrderedDict
+
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from paddle_tpu import framework
+from paddle_tpu.core import exec_cache
+from paddle_tpu.core.fingerprint import (
+    executable_key,
+    program_fingerprint,
+    trace_flags_key,
+)
 from paddle_tpu.core.lod import LoDTensor
 from paddle_tpu.core.lowering import CompiledProgram
 from paddle_tpu.core.scope import Scope
@@ -24,6 +34,18 @@ from paddle_tpu.core.types import Place, TPUPlace, np_dtype
 
 _global_scope = Scope()
 _scope_stack = [_global_scope]
+
+# Process-global executable registry. Keys are content-addressed
+# (core/fingerprint.py), so structurally identical programs share ONE
+# compile across Executor instances, scopes with identical var-name
+# signatures, and Predictor.Clone() serving threads — where the old
+# id(program)/id(scope) keys forced a recompile per instance (and could
+# alias a dead program's reused id() to a live one after GC). LRU-bounded:
+# eviction drops only the shared handle; executors that already hold an
+# entry in their instance cache keep using it.
+_shared_executables = OrderedDict()
+_shared_lock = threading.Lock()
+_SHARED_CAP = 128
 
 
 def global_scope():
@@ -53,22 +75,71 @@ def _as_feed_array(value, place):
     untouched — np.asarray would block on the in-flight transfer and
     round-trip the data through the host."""
     if isinstance(value, LoDTensor):
-        return np.asarray(value.numpy()), value.lod() or None
+        # .numpy() IS the backing ndarray; re-wrapping it in np.asarray
+        # added a per-feed copy whenever the holder wasn't already a plain
+        # contiguous ndarray — pass it through untouched instead
+        return value.numpy(), value.lod() or None
     if isinstance(value, jax.Array):
         return value, None
     return np.asarray(value), None
 
 
-# Flags whose value changes what the block lowers TO (not just runtime
-# behavior); they join the executable cache key so toggling recompiles.
-_TRACE_FLAGS = ("use_pallas_lstm", "use_pallas_gru", "remat_gradients",
-                "conv_nhwc", "attention_impl")
+# On-device finiteness scan for FLAGS_check_nan_inf: one fused executable
+# of lax reductions per value-list structure; only the [n] bool vector
+# crosses to the host, never the checked values.
+_finite_stack = jax.jit(
+    lambda vals: jnp.stack([jnp.all(jnp.isfinite(v)) for v in vals])
+)
 
 
-def _trace_flags_key():
-    from paddle_tpu import flags
+class FetchHandle(object):
+    """Live results of an async dispatch (``Executor.run_async``).
 
-    return tuple((n, flags.get(n)) for n in _TRACE_FLAGS)
+    The fetched values are in-flight device arrays; the handle never
+    forces a host sync until asked:
+
+      ``arrays()``             the live device arrays (non-blocking)
+      ``done()``               True when every fetch has materialized
+      ``block_until_ready()``  wait on device completion, no transfer
+      ``result()``             numpy values (blocks; memoized) — matches
+                               the equivalent ``run(...)`` bit-for-bit
+    """
+
+    def __init__(self, arrays, fetch_names, nan_check=None):
+        self._arrays = list(arrays)
+        self.fetch_names = list(fetch_names)
+        self._nan_check = nan_check
+        self._numpy = None
+
+    def __len__(self):
+        return len(self._arrays)
+
+    def arrays(self):
+        return list(self._arrays)
+
+    def done(self):
+        for a in self._arrays:
+            is_ready = getattr(a, "is_ready", None)
+            if is_ready is not None and not is_ready():
+                return False
+        return True
+
+    def block_until_ready(self):
+        for a in self._arrays:
+            if hasattr(a, "block_until_ready"):
+                a.block_until_ready()
+        return self
+
+    def result(self):
+        if self._numpy is None:
+            if self._nan_check is not None:
+                # disarm only AFTER a clean pass: a caller that catches
+                # the NaN error and retries must get the error again,
+                # not the bad values
+                self._nan_check()
+                self._nan_check = None
+            self._numpy = [np.asarray(a) for a in self._arrays]
+        return self._numpy
 
 
 class Executor(object):
@@ -81,34 +152,64 @@ class Executor(object):
         self._base_seed = np.random.randint(0, 2**31 - 1)
 
     # -- compilation cache --------------------------------------------------
-    def _get_compiled(self, program, feed_specs, fetch_names, scope):
+    def _get_compiled(self, program, feed_specs, fetch_names, scope,
+                      refresh=False):
         scope_names = self._scope_names(scope)
+        device = self.place.jax_device()
         key = (
-            id(program),
-            program._version,
+            # content hash, not id(program): CPython reuses id() after GC,
+            # and structurally identical programs should share the compile
+            program_fingerprint(program),
             tuple(sorted((n, s, d) for n, (s, d) in feed_specs.items())),
             tuple(fetch_names),
-            id(scope),
             # Scope contents shape the step signature (state_in): a var
-            # initialized later (e.g. startup program ran) must recompile.
-            hash(frozenset(scope_names)),
+            # initialized later (e.g. startup program ran) must recompile;
+            # the NAME SET is the signature, so scopes holding the same
+            # vars share executables (not id(scope))
+            frozenset(scope_names),
             program._is_test,
             getattr(program, "_amp_dtype", None),
             # trace-time flags alter the lowered computation; toggling one
             # must recompile, not reuse the stale executable
-            _trace_flags_key(),
+            trace_flags_key(),
+            (device.platform, device.id),
         )
-        cp = self._cache.get(key)
-        if cp is None:
-            cp = CompiledProgram(
-                program,
-                feed_specs,
-                fetch_names,
-                scope_names,
-                is_test=program._is_test,
-                device=self.place.jax_device(),
-            )
-            self._cache[key] = cp
+        cp = None if refresh else self._cache.get(key)
+        if cp is not None:
+            exec_cache.record_trace_hit()
+            return cp
+        with _shared_lock:
+            # refresh (use_program_cache=False) bypasses the lookup so
+            # THIS run re-traces, but still publishes the fresh compile —
+            # evicting instead would yank a live executable out from
+            # under unrelated executors / Predictor clones
+            cp = None if refresh else _shared_executables.get(key)
+            if cp is None:
+                exec_cache.record_trace_miss()
+                exec_cache.configure()
+                cp = CompiledProgram(
+                    program,
+                    feed_specs,
+                    fetch_names,
+                    scope_names,
+                    is_test=program._is_test,
+                    device=device,
+                )
+                # stable cross-process key for the on-disk AOT image
+                # layer; device.id included so executors pinned to
+                # different local devices never share one baked image
+                cp._exec_cache_key = executable_key(
+                    program, feed_specs, fetch_names, scope_names,
+                    extra=("single", device.platform, device.id,
+                           getattr(device, "device_kind", "")),
+                )
+                _shared_executables[key] = cp
+                while len(_shared_executables) > _SHARED_CAP:
+                    _shared_executables.popitem(last=False)
+            else:
+                _shared_executables.move_to_end(key)
+                exec_cache.record_trace_hit()
+        self._cache[key] = cp
         return cp
 
     def run(
@@ -129,12 +230,15 @@ class Executor(object):
         device = self.place.jax_device()
         if not use_program_cache:
             # reference use_program_cache=False semantics: drop this
-            # program's cached single-run executables so the next run
-            # retraces (multi-step scan executables are keyed separately
-            # and survive — they are expensive compiles run() never uses)
+            # program's cached single-run executables from THIS executor
+            # so this run re-traces; the process-global registry is
+            # bypassed (not purged) via refresh — see _get_compiled
+            # (multi-step scan executables are keyed separately and
+            # survive — they are expensive compiles run() never uses)
+            fp = program_fingerprint(program)
             self._cache = {
                 k: v for k, v in self._cache.items()
-                if k[0] == "multi" or k[0] != id(program)
+                if k[0] == "multi" or k[0] != fp
             }
         # Everything below (feed transfer, key creation, dispatch) stays on
         # the Place's device: with several backends loaded (TPU plugin +
@@ -142,7 +246,8 @@ class Executor(object):
         # platform — wrong device, and unsafe under concurrent serving.
         with jax.default_device(device):
             return self._run_on_device(
-                program, feed, fetch_list, scope, device, return_numpy
+                program, feed, fetch_list, scope, device, return_numpy,
+                refresh_cache=not use_program_cache,
             )
 
     # -- shared run plumbing -------------------------------------------------
@@ -201,42 +306,108 @@ class Executor(object):
         )
 
     @staticmethod
-    def _check_nan_inf(new_state, fetch_names, fetches):
+    def _nan_check_start(new_state, fetch_names, fetches):
+        """FLAGS_check_nan_inf (operator.cc:754) in two phases: the scan
+        is an on-device lax reduction fused into one tiny executable,
+        DISPATCHED NOW — while the checked arrays are still live; a later
+        step may donate these very buffers — and only an [n] bool vector
+        crosses to the host when the returned ``finish`` callable runs
+        (the old implementation np.asarray'd EVERY output, a full host
+        transfer + sync per checked run). Returns None when the flag is
+        off."""
         from paddle_tpu import flags as _flags
 
         if not _flags.get("check_nan_inf"):
-            return
-        # FLAGS_check_nan_inf (operator.cc:754): scan every produced
-        # value host-side and fail loudly on the first bad one.
+            return None
+        names, vals, host_bad = [], [], None
         for name, val in list(new_state.items()) + list(
             zip(fetch_names, fetches)
         ):
-            arr = np.asarray(val)
-            if np.issubdtype(arr.dtype, np.floating) and not np.all(
-                np.isfinite(arr)
+            if isinstance(val, jax.Array) and jnp.issubdtype(
+                val.dtype, jnp.floating
             ):
+                names.append(name)
+                vals.append(val)
+                continue
+            arr = np.asarray(val)  # host-side values (rare): check directly
+            if host_bad is None and np.issubdtype(
+                arr.dtype, np.floating
+            ) and not np.all(np.isfinite(arr)):
+                host_bad = name
+        flags_dev = _finite_stack(vals) if vals else None
+
+        def finish():
+            if host_bad is not None:
                 raise RuntimeError(
                     "NaN/Inf detected in variable %r after program run "
-                    "(FLAGS_check_nan_inf)" % name
+                    "(FLAGS_check_nan_inf)" % host_bad
+                )
+            if flags_dev is None:
+                return
+            finite = np.asarray(flags_dev)
+            if not finite.all():
+                bad = names[int(np.argmin(finite))]
+                raise RuntimeError(
+                    "NaN/Inf detected in variable %r after program run "
+                    "(FLAGS_check_nan_inf)" % bad
                 )
 
+        return finish
+
+    @staticmethod
+    def _check_nan_inf(new_state, fetch_names, fetches):
+        finish = Executor._nan_check_start(new_state, fetch_names, fetches)
+        if finish is not None:
+            finish()
+
     def _run_on_device(self, program, feed, fetch_list, scope, device,
-                       return_numpy):
+                       return_numpy, as_handle=False, refresh_cache=False):
         feeds, feed_specs = self._prepare_feeds(program, feed, device)
         fetch_names = [
             v.name if isinstance(v, framework.Variable) else str(v)
             for v in fetch_list
         ]
-        cp = self._get_compiled(program, feed_specs, fetch_names, scope)
+        cp = self._get_compiled(program, feed_specs, fetch_names, scope,
+                                refresh=refresh_cache)
         state = self._gather_state(cp.state_in, scope, device)
         key = self._step_key(program)
         new_state, fetches = cp(state, feeds, key)
         for n, val in new_state.items():
             scope.set_value(n, val)
+        if as_handle:
+            # dispatch complete, nothing synced: the (optional) nan/inf
+            # reductions are already in flight on device, but reading
+            # their verdict waits for .result()
+            return FetchHandle(
+                fetches, cp.fetch_names,
+                nan_check=self._nan_check_start(
+                    new_state, cp.fetch_names, fetches
+                ),
+            )
         self._check_nan_inf(new_state, cp.fetch_names, fetches)
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
+
+    def run_async(self, program=None, feed=None, fetch_list=None,
+                  feed_var_name="feed", fetch_var_name="fetch", scope=None):
+        """``run`` without the host sync: dispatches one step and returns
+        a :class:`FetchHandle` of live device arrays immediately — the
+        XLA execution proceeds asynchronously and ``.result()``
+        materializes numpy lazily, matching ``run(...)`` bit-for-bit.
+        Scope state is updated with live (also non-blocking) arrays, so
+        back-to-back dispatches chain on device without host round trips.
+        """
+        program = program or framework.default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+        device = self.place.jax_device()
+        with jax.default_device(device):
+            return self._run_on_device(
+                program, feed, fetch_list, scope, device,
+                return_numpy=False, as_handle=True,
+            )
 
     def run_multi_step(self, program, steps, feed=None, fetch_list=None,
                        scope=None, return_numpy=True, stack_fetches=False):
@@ -262,19 +433,30 @@ class Executor(object):
             ]
             scope_names = self._scope_names(scope)
             key_id = (
-                "multi", id(program), program._version, int(steps),
+                "multi", program_fingerprint(program), int(steps),
                 tuple(sorted(feed_specs.items())), tuple(fetch_names),
-                id(scope), hash(frozenset(scope_names)), program._is_test,
+                frozenset(scope_names), program._is_test,
                 getattr(program, "_amp_dtype", None), bool(stack_fetches),
+                trace_flags_key(), (device.platform, device.id),
             )
             cp = self._cache.get(key_id)
             if cp is None:
+                exec_cache.record_trace_miss()
+                exec_cache.configure()
                 cp = MultiStepProgram(
                     program, steps, feed_specs, fetch_names, scope_names,
                     is_test=program._is_test, device=device,
                     stack_fetches=stack_fetches,
                 )
+                cp._exec_cache_key = executable_key(
+                    program, feed_specs, fetch_names, scope_names,
+                    extra=("multi", int(steps), bool(stack_fetches),
+                           device.platform, device.id,
+                           getattr(device, "device_kind", "")),
+                )
                 self._cache[key_id] = cp
+            else:
+                exec_cache.record_trace_hit()
             state = self._gather_state(cp.state_in, scope, device)
             key = self._step_key(program)
             new_state, fetches = cp(state, feeds, key)

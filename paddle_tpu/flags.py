@@ -52,6 +52,14 @@ _DEFS = {
     # dkv/dq kernels, O(block) memory) or "reference" (recompute through
     # the XLA-composed path — materializes the [T, S] score matrix)
     "flash_backward": ("pallas", str),
+    # persistent executable cache root (core/exec_cache.py): XLA compile
+    # cache + AOT executable images live under it, shared across
+    # processes; empty disables persistence (in-memory caching stays on)
+    "exec_cache_dir": ("", str),
+    # TOTAL byte budget for the persistent cache dir (-1 = unbounded),
+    # split evenly: LRU eviction on the XLA layer, oldest-first trim on
+    # the AOT image layer
+    "exec_cache_max_bytes": (-1, int),
     # route the transformer's label-smoothed CE head through the fused
     # single-pass op (ops/loss_ops.py fused_label_smooth_ce): bf16
     # logits with f32-accumulated reductions, hand-written one-pass

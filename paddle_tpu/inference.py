@@ -102,18 +102,20 @@ class Predictor(object):
             n: str(gvars[n].dtype) for n in self._feed_names if n in gvars
         }
 
+    def _as_feed_dict(self, inputs):
+        if isinstance(inputs, dict):
+            return inputs
+        if len(inputs) != len(self._feed_names):
+            raise ValueError(
+                "expected %d inputs (%s), got %d"
+                % (len(self._feed_names), self._feed_names, len(inputs))
+            )
+        return dict(zip(self._feed_names, inputs))
+
     def run(self, inputs):
         """inputs: dict feed-name -> ndarray, or list matching the saved
         feed order. Returns list of ndarrays (fetch order)."""
-        import paddle_tpu as fluid
-
-        if not isinstance(inputs, dict):
-            if len(inputs) != len(self._feed_names):
-                raise ValueError(
-                    "expected %d inputs (%s), got %d"
-                    % (len(self._feed_names), self._feed_names, len(inputs))
-                )
-            inputs = dict(zip(self._feed_names, inputs))
+        inputs = self._as_feed_dict(inputs)
         with self._lock:  # executor cache mutation is not thread-safe
             # Scope passed explicitly: the scope_guard stack is a process
             # global, unsafe when several predictors serve concurrently.
@@ -122,6 +124,19 @@ class Predictor(object):
                 scope=self._scope,
             )
         return [np.asarray(o) for o in outs]
+
+    def run_async(self, inputs):
+        """Non-blocking ``run``: dispatches the request and returns an
+        ``executor.FetchHandle`` whose ``.result()`` materializes the
+        numpy outputs lazily. The serving thread holds the predictor lock
+        only for the dispatch, not for the device execution — overlapping
+        requests from Clone() handles queue on device, not on the host."""
+        inputs = self._as_feed_dict(inputs)
+        with self._lock:
+            return self._exe.run_async(
+                self._program, feed=inputs, fetch_list=self._fetch_vars,
+                scope=self._scope,
+            )
 
     def clone(self):
         """A predictor sharing this one's weights for another serving

@@ -20,9 +20,15 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu import framework
+from paddle_tpu.core import exec_cache
+from paddle_tpu.core.fingerprint import (
+    executable_key,
+    program_fingerprint,
+    trace_flags_key,
+)
 from paddle_tpu.core.lod import LoDTensor
 from paddle_tpu.core.lowering import CompiledProgram
-from paddle_tpu.executor import _trace_flags_key, global_scope
+from paddle_tpu.executor import global_scope
 from paddle_tpu.parallel.mesh import ShardingPolicy, build_mesh
 
 
@@ -216,15 +222,21 @@ class ParallelExecutor(object):
 
     def _get_compiled(self, feed_specs, fetch_names):
         scope_names = set(self._scope.local_var_names())
+        mesh_sig = tuple(sorted(self.mesh.shape.items()))
         key = (
-            self._program._version,
+            # content hash (core/fingerprint.py), not _version alone: two
+            # structurally identical programs share the sharded compile
+            program_fingerprint(self._program),
             tuple(sorted((n, s, d) for n, (s, d) in feed_specs.items())),
             tuple(fetch_names),
-            hash(frozenset(scope_names)),
-            _trace_flags_key(),
+            frozenset(scope_names),
+            trace_flags_key(),
+            mesh_sig,
         )
         cp = self._cache.get(key)
         if cp is None:
+            exec_cache.record_trace_miss()
+            exec_cache.configure()
             state_shapes = self._collect_state_shapes()
             cp = CompiledProgram(
                 self._program,
@@ -234,7 +246,19 @@ class ParallelExecutor(object):
                 is_test=self._program._is_test,
                 shardings=self._policy(state_shapes),
             )
+            cp._exec_cache_key = executable_key(
+                self._program, feed_specs, fetch_names, scope_names,
+                extra=("gspmd", mesh_sig,
+                       self._build_strategy.reduce_strategy,
+                       tuple(sorted(self._model_sharded_vars)),
+                       tuple(sorted(
+                           (k, str(v))
+                           for k, v in self._sharding_overrides.items()
+                       ))),
+            )
             self._cache[key] = cp
+        else:
+            exec_cache.record_trace_hit()
         return cp
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
@@ -345,8 +369,8 @@ class ParallelExecutor(object):
             )
             feeds[name] = arr
             feed_specs[name] = (tuple(arr.shape), str(arr.dtype))
-        sig = (self._program._version, tuple(sorted(feed_specs.items())),
-               _trace_flags_key())
+        sig = (program_fingerprint(self._program),
+               tuple(sorted(feed_specs.items())), trace_flags_key())
         entry = self._pipeline_entry
         if entry is None or entry["sig"] != sig:
             if entry is not None:

@@ -19,6 +19,7 @@ __all__ = [
     "start_profiler",
     "stop_profiler",
     "RecordEvent",
+    "exec_cache_stats",
 ]
 
 _state = {
@@ -70,7 +71,33 @@ def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
         jax.profiler.stop_trace()
         _state["jax_trace_dir"] = None
     _print_report(sorted_key)
+    _print_exec_cache_report()
     _write_chrome_trace(profile_path)
+
+
+def exec_cache_stats():
+    """Executable-cache counters (core/exec_cache.py): compile seconds
+    split cold/warm, persistent-cache and AOT-image hit/miss counts, and
+    ``fresh_compiles`` — the XLA compiles no cache layer could serve."""
+    from paddle_tpu.core import exec_cache
+
+    return exec_cache.stats()
+
+
+def _print_exec_cache_report():
+    st = exec_cache_stats()
+    if not (st["backend_compiles"] or st["aot_hits"] or st["aot_misses"]):
+        return
+    print(
+        "Executable cache: %d fresh compile(s), %d persistent hit(s), "
+        "%d AOT image hit(s); compile %.3fs cold / %.3fs warm%s"
+        % (
+            st["fresh_compiles"], st["persistent_hits"], st["aot_hits"],
+            st["compile_seconds_cold"], st["compile_seconds_warm"],
+            " (dir: %s)" % st["cache_dir"] if st["enabled"] else
+            " (persistence off: FLAGS_exec_cache_dir unset)",
+        )
+    )
 
 
 def _print_report(sorted_key):

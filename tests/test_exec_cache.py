@@ -1,0 +1,334 @@
+"""Executable-cache correctness: structural fingerprints, the process-
+global registry, the persistent on-disk layers, and async dispatch.
+
+Satellite coverage from the compile-tax PR: every trace flag toggle
+recompiles, program mutation recompiles, structurally identical programs
+share one executable, a corrupted on-disk entry degrades to a fresh
+compile (asserted through the exec_cache stats counters), and
+run_async(...).result() matches run(...) bit-for-bit. The cross-PROCESS
+warm start is proven by tools/run_ci.sh `warm` (tools/warm_start_smoke.py);
+here the same disk layers are exercised in-process by purging the
+in-memory registries between runs.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, unique_name
+from paddle_tpu.core import exec_cache
+from paddle_tpu.core.fingerprint import (
+    TRACE_FLAGS,
+    program_fingerprint,
+)
+import paddle_tpu.executor as executor_mod
+
+
+def _build_mlp():
+    unique_name.switch({})
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6])
+        hid = fluid.layers.fc(x, size=8, act="relu")
+        out = fluid.layers.reduce_sum(fluid.layers.fc(hid, size=2))
+    return main, startup, out
+
+
+def _feed(bs=3):
+    return {"x": np.arange(bs * 6, dtype="float32").reshape(bs, 6) / 10.0}
+
+
+def _trace_misses():
+    return exec_cache.stats()["trace_cache_misses"]
+
+
+# -- fingerprint scheme ------------------------------------------------------
+
+def test_fingerprint_stable_and_memoized():
+    main, _, _ = _build_mlp()
+    fp1 = program_fingerprint(main)
+    fp2 = program_fingerprint(main)
+    assert fp1 == fp2
+    # memo is version-keyed: no structural change, no re-hash needed
+    assert main._fingerprint_memo[0] == main._version
+
+
+def test_fingerprint_identical_builds_match():
+    m1, _, _ = _build_mlp()
+    m2, _, _ = _build_mlp()
+    assert m1 is not m2
+    assert program_fingerprint(m1) == program_fingerprint(m2)
+
+
+def test_fingerprint_changes_on_mutation():
+    main, _, _ = _build_mlp()
+    fp = program_fingerprint(main)
+    op = main.global_block().ops[-1]
+    op.set_attr("some_knob", 42)  # bumps _version through the framework API
+    assert program_fingerprint(main) != fp
+
+
+def test_fingerprint_differs_for_different_programs():
+    m1, _, _ = _build_mlp()
+    unique_name.switch({})
+    m2, s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m2, s2):
+        x = fluid.layers.data("x", [6])
+        fluid.layers.reduce_sum(fluid.layers.fc(x, size=8))
+    assert program_fingerprint(m1) != program_fingerprint(m2)
+
+
+# -- in-memory executable sharing -------------------------------------------
+
+def test_identical_programs_share_one_executable():
+    m1, s1, o1 = _build_mlp()
+    m2, _, o2 = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(s1)
+    r1 = exe.run(m1, feed=_feed(), fetch_list=[o1])
+    misses = _trace_misses()
+    # same structure, same scope signature -> ZERO new traces, on either
+    # the same executor or a brand-new instance
+    r1b = exe.run(m2, feed=_feed(), fetch_list=[o2])
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    r2 = exe2.run(m2, feed=_feed(), fetch_list=[o2])
+    assert _trace_misses() == misses
+    np.testing.assert_array_equal(np.asarray(r1[0]), np.asarray(r1b[0]))
+    np.testing.assert_array_equal(np.asarray(r1[0]), np.asarray(r2[0]))
+
+
+def test_program_mutation_recompiles():
+    main, startup, out = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed=_feed(), fetch_list=[out])
+    misses = _trace_misses()
+    with fluid.program_guard(main, startup):
+        out2 = fluid.layers.scale(out, scale=2.0)  # graph surgery
+    exe.run(main, feed=_feed(), fetch_list=[out2])
+    assert _trace_misses() == misses + 1
+
+
+def test_each_trace_flag_toggle_recompiles():
+    main, startup, out = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed=_feed(), fetch_list=[out])
+    for name in TRACE_FLAGS:
+        old = flags.get(name)
+        flip = {"attention_impl": "reference",
+                "flash_backward": "reference"}.get(name, True)
+        assert flip != old, "flag %s: test flip value equals default" % name
+        misses = _trace_misses()
+        flags.set_flag(name, flip)
+        try:
+            exe.run(main, feed=_feed(), fetch_list=[out])
+            assert _trace_misses() == misses + 1, (
+                "toggling %s did not recompile" % name)
+            # ...and toggling BACK is a pure cache hit, not a re-trace
+            flags.set_flag(name, old)
+            exe.run(main, feed=_feed(), fetch_list=[out])
+            assert _trace_misses() == misses + 1
+        finally:
+            flags.set_flag(name, old)
+
+
+def test_use_program_cache_false_retraces_without_evicting_others():
+    main, startup, out = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed=_feed(), fetch_list=[out])
+    misses = _trace_misses()
+    exe.run(main, feed=_feed(), fetch_list=[out], use_program_cache=False)
+    assert _trace_misses() == misses + 1  # this run really re-traced
+    # ...but the registry still serves everyone else (bypass, not purge)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(main, feed=_feed(), fetch_list=[out])
+    assert _trace_misses() == misses + 1
+
+
+# -- async dispatch ----------------------------------------------------------
+
+def test_run_async_matches_run_bit_for_bit():
+    main, startup, out = _build_mlp()
+    main.random_seed = 5  # deterministic step keys across the two runs
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (sync_out,) = exe.run(main, feed=_feed(), fetch_list=[out])
+    handle = exe.run_async(main, feed=_feed(), fetch_list=[out])
+    assert handle.fetch_names == [out.name]
+    arrays = handle.arrays()
+    assert len(arrays) == 1  # live device arrays, no host materialization
+    handle.block_until_ready()
+    assert handle.done()
+    (async_out,) = handle.result()
+    np.testing.assert_array_equal(np.asarray(sync_out), async_out)
+    assert handle.result() is handle.result()  # memoized
+
+
+def test_run_async_nan_check_survives_back_to_back_donation():
+    """The deferred nan scan must be DISPATCHED at run_async time: a
+    later step donates the very state buffers being checked, so a scan
+    started lazily at .result() would read deleted arrays."""
+    unique_name.switch({})
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6])
+        loss = fluid.layers.mean(fluid.layers.fc(x, size=1))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    flags.set_flag("check_nan_inf", True)
+    try:
+        h1 = exe.run_async(main, feed=_feed(), fetch_list=[loss])
+        h2 = exe.run_async(main, feed=_feed(), fetch_list=[loss])
+        (l1,) = h1.result()  # h2's dispatch donated h1's checked state
+        (l2,) = h2.result()
+        assert np.isfinite(l1).all() and np.isfinite(l2).all()
+    finally:
+        flags.set_flag("check_nan_inf", False)
+
+
+def test_run_async_nan_failure_raises_on_every_result_call():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("xr", [4])
+        out = fluid.layers.log(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    bad = np.array([[-1.0, 1.0, 2.0, 3.0]], "float32")
+    flags.set_flag("check_nan_inf", True)
+    try:
+        handle = exe.run_async(main, feed={"xr": bad}, fetch_list=[out])
+        for _ in range(2):  # a retry must NOT silently return the NaNs
+            with pytest.raises(RuntimeError, match="NaN/Inf"):
+                handle.result()
+    finally:
+        flags.set_flag("check_nan_inf", False)
+
+
+def test_run_async_defers_nan_check_to_result():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        out = fluid.layers.log(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    bad = np.array([[-1.0, 1.0, 2.0, 3.0]], "float32")
+    flags.set_flag("check_nan_inf", True)
+    try:
+        handle = exe.run_async(main, feed={"x": bad}, fetch_list=[out])
+        with pytest.raises(RuntimeError, match="NaN/Inf"):
+            handle.result()
+    finally:
+        flags.set_flag("check_nan_inf", False)
+
+
+def test_predictor_clone_shares_executable(tmp_path):
+    main, startup, out = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            str(tmp_path / "model"), ["x"], [out], exe, main_program=main)
+    config = fluid.inference.NativeConfig(
+        model_dir=str(tmp_path / "model"), use_tpu=False)
+    pred = fluid.inference.create_paddle_predictor(config)
+    r1 = pred.run([_feed()["x"]])
+    misses = _trace_misses()
+    clone = pred.clone()
+    r2 = clone.run([_feed()["x"]])
+    assert _trace_misses() == misses, "Clone() recompiled the model"
+    np.testing.assert_array_equal(r1[0], r2[0])
+    h = clone.run_async([_feed()["x"]])
+    np.testing.assert_array_equal(r1[0], h.result()[0])
+
+
+# -- persistent on-disk layers ----------------------------------------------
+
+def _purge_in_memory():
+    """Simulate a fresh process: drop every in-memory executable handle so
+    the next run can only be served by the on-disk layers."""
+    executor_mod._shared_executables.clear()
+    exec_cache._reset_jax_cache()
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    d = str(tmp_path / "exec_cache")
+    old = flags.get("exec_cache_dir")
+    flags.set_flag("exec_cache_dir", d)
+    exec_cache.configure()
+    # executables compiled by EARLIER tests (while persistence was off)
+    # share the same structural keys; drop them so this test's cold run
+    # actually compiles and persists
+    _purge_in_memory()
+    try:
+        yield d
+    finally:
+        flags.set_flag("exec_cache_dir", old)
+        exec_cache.configure()  # re-disable persistence for later tests
+
+
+def test_warm_start_loads_aot_image(cache_dir):
+    main, startup, out = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (cold,) = exe.run(main, feed=_feed(), fetch_list=[out])
+    aot_dir = os.path.join(cache_dir, "aot")
+    assert os.listdir(aot_dir), "no AOT images written"
+    _purge_in_memory()
+    before = exec_cache.stats()["aot_hits"]
+    m2, _, o2 = _build_mlp()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    (warm,) = exe2.run(m2, feed=_feed(), fetch_list=[o2])
+    assert exec_cache.stats()["aot_hits"] > before, (
+        "warm run did not deserialize the stored executable")
+    # params untouched between runs -> identical math through the image
+    np.testing.assert_array_equal(np.asarray(cold), np.asarray(warm))
+
+
+def test_corrupted_cache_entry_degrades_to_fresh_compile(cache_dir):
+    main, startup, out = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (good,) = exe.run(main, feed=_feed(), fetch_list=[out])
+    # trash EVERY on-disk entry in both layers
+    for sub in ("aot", "xla"):
+        root = os.path.join(cache_dir, sub)
+        for dirpath, _, files in os.walk(root):
+            for f in files:
+                with open(os.path.join(dirpath, f), "wb") as fh:
+                    fh.write(b"corrupt garbage, not an executable")
+    _purge_in_memory()
+    errors_before = exec_cache.stats()["aot_errors"]
+    m2, _, o2 = _build_mlp()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    (recovered,) = exe2.run(m2, feed=_feed(), fetch_list=[o2])  # must not crash
+    st = exec_cache.stats()
+    assert st["aot_errors"] > errors_before, (
+        "corrupt AOT image was not detected")
+    np.testing.assert_array_equal(np.asarray(good), np.asarray(recovered))
+    # the bad entries were evicted and replaced by fresh ones on the way
+    for f in os.listdir(os.path.join(cache_dir, "aot")):
+        with open(os.path.join(cache_dir, "aot", f), "rb") as fh:
+            assert fh.read(32) != b"corrupt garbage, not an executa"
+
+
+def test_cache_stats_exported_through_profiler(cache_dir):
+    main, startup, out = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed=_feed(), fetch_list=[out])
+    st = fluid.profiler.exec_cache_stats()
+    assert st["enabled"] and st["cache_dir"] == os.path.abspath(cache_dir)
+    for k in ("fresh_compiles", "persistent_hits", "persistent_misses",
+              "aot_hits", "aot_misses", "aot_errors",
+              "compile_seconds_cold", "compile_seconds_warm"):
+        assert k in st
+    assert st["compile_seconds_cold"] + st["compile_seconds_warm"] >= 0

@@ -3,7 +3,7 @@
 # repo): native C++ build + its unit tests, the Python suite on the
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
-# (native|python|dryrun|bench) to run a subset.
+# (native|python|warm|dryrun|bench) to run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -11,7 +11,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-ALL_STAGES=(native python dryrun bench)
+ALL_STAGES=(native python warm dryrun bench)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -51,6 +51,23 @@ if want python; then
   # CPU-only stages must not depend on tunnel health
   XLA_FLAGS="$merged" env -u PALLAS_AXON_POOL_IPS \
     python -m pytest tests/ -q
+fi
+
+if want warm; then
+  echo "== warm-start smoke (persistent executable cache) =="
+  # two subprocesses share one exec_cache_dir; the second must execute
+  # the same tiny program with ZERO fresh XLA compiles (asserted via the
+  # exec_cache stats counters inside warm_start_smoke.py)
+  cache_dir="$(mktemp -d)"
+  trap 'rm -rf "$cache_dir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$cache_dir" \
+    python tools/warm_start_smoke.py cold
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$cache_dir" \
+    python tools/warm_start_smoke.py warm
+  rm -rf "$cache_dir"
+  trap - EXIT
 fi
 
 if want dryrun; then

@@ -1,0 +1,88 @@
+"""Warm-start smoke: prove the persistent executable cache kills the
+compile tax across process boundaries.
+
+Run twice in two subprocesses sharing FLAGS_exec_cache_dir (tools/
+run_ci.sh `warm` stage does exactly that):
+
+    FLAGS_exec_cache_dir=$D python tools/warm_start_smoke.py cold
+    FLAGS_exec_cache_dir=$D python tools/warm_start_smoke.py warm
+
+The cold pass populates the cache (and asserts it really compiled).
+The warm pass builds the SAME program from scratch — new process, new
+Program/Scope objects, so only the structural fingerprint can connect it
+to the cold pass's executables — and asserts ZERO fresh XLA compiles
+plus at least one AOT executable image loaded. It also asserts
+run_async().result() matches run() bit-for-bit while the dispatch call
+returns before the fetches have materialized.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_and_run():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        hid = fluid.layers.fc(x, size=16, act="relu")
+        y = fluid.layers.fc(hid, size=4)
+        out = fluid.layers.reduce_sum(y, dim=[1])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.arange(32, dtype="float32").reshape(4, 8) / 32.0}
+    (sync_out,) = exe.run(main, feed=feed, fetch_list=[out])
+    handle = exe.run_async(main, feed=feed, fetch_list=[out])
+    (async_out,) = handle.result()
+    assert np.array_equal(np.asarray(sync_out), async_out), (
+        "run_async().result() diverged from run(): %r vs %r"
+        % (sync_out, async_out)
+    )
+    return handle
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "cold"
+    if not os.environ.get("FLAGS_exec_cache_dir"):
+        print("warm_start_smoke: FLAGS_exec_cache_dir not set", file=sys.stderr)
+        return 2
+    build_and_run()
+    from paddle_tpu.core import exec_cache
+
+    st = exec_cache.stats()
+    print("warm_start_smoke[%s]: %s" % (mode, json.dumps({
+        k: st[k] for k in (
+            "fresh_compiles", "persistent_hits", "persistent_misses",
+            "aot_hits", "aot_misses", "aot_errors",
+            "compile_seconds_cold", "compile_seconds_warm",
+        )
+    })))
+    assert st["enabled"], "exec cache did not enable from the flag"
+    if mode == "cold":
+        assert st["fresh_compiles"] > 0 or st["persistent_hits"] > 0, (
+            "cold pass neither compiled nor hit a pre-warmed cache"
+        )
+    else:
+        assert st["fresh_compiles"] == 0, (
+            "warm process paid %d fresh XLA compile(s); the persistent "
+            "cache failed to serve them" % st["fresh_compiles"]
+        )
+        assert st["aot_hits"] >= 1, (
+            "warm process loaded no AOT executable images (re-traced "
+            "everything): aot_misses=%d aot_errors=%d"
+            % (st["aot_misses"], st["aot_errors"])
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
