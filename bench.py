@@ -357,6 +357,28 @@ def _worker_main():
         # the peak's convention; pre-r5 ResNet records used GMACs and
         # read 2x low (see TRAIN_GFLOP_PER_IMG note)
         result["flop_convention"] = "2-per-mac"
+        # flight-recorder view (observability/telemetry.py): step-time
+        # percentiles + the telemetry-side MFU estimate, present only
+        # when FLAGS_telemetry=1 (default off keeps the timed loop
+        # untouched — the <2% overhead acceptance gate). Best-effort in
+        # its own try: an observability failure (bad FLAGS_metrics_path
+        # etc.) must never discard a fully measured bench result.
+        try:
+            from paddle_tpu.observability import telemetry
+
+            if telemetry.ENABLED:
+                st = telemetry.step_stats(
+                    peak=peak * 1e12 if peak else None)
+                result["step_ms"] = {
+                    "p50": round(st["p50_ms"], 3) if st["p50_ms"] else None,
+                    "p95": round(st["p95_ms"], 3) if st["p95_ms"] else None,
+                    "p99": round(st["p99_ms"], 3) if st["p99_ms"] else None,
+                }
+                result["mfu_telemetry"] = (
+                    round(st["mfu"], 4) if st["mfu"] else None)
+                telemetry.flush()  # FLAGS_metrics_path scrape, if set
+        except Exception as e:  # noqa: BLE001
+            result["telemetry_error"] = "%s: %s" % (type(e).__name__, e)
     except Exception as e:  # noqa: BLE001 - report, never crash the capture
         result = {"metric": model, "error": "%s: %s" % (type(e).__name__, e)}
     else:

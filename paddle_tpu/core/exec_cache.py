@@ -99,9 +99,26 @@ def _on_duration(name, secs, **kw):
                 _stats["compile_seconds_warm"] += secs
             else:
                 _stats["compile_seconds_cold"] += secs
+        _record_compile_span("xla_backend_compile", secs,
+                             "warm" if last == "hit" else "cold")
     elif name == "/jax/compilation_cache/cache_retrieval_time_sec":
         with _lock:
             _stats["cache_retrieval_seconds"] += secs
+
+
+def _record_compile_span(name, secs, kind):
+    """Land the compile in the profiler's unified trace stream (cat
+    ``compile``). The duration event fires at compile END, so the span is
+    back-dated by its length; no-op when the profiler is off."""
+    try:
+        from paddle_tpu import profiler
+
+        if profiler.enabled():
+            end = time.perf_counter()
+            profiler.record_span(name, end - secs, end, cat="compile",
+                                 args={"kind": kind})
+    except Exception:
+        pass
 
 
 jax.monitoring.register_event_listener(_on_event)
@@ -274,6 +291,7 @@ def _load_aot(path):
         _stats["aot_hits"] += 1
         _stats["compile_seconds"] += dt
         _stats["compile_seconds_warm"] += dt
+    _record_compile_span("aot_image_load", dt, "warm")
     return loaded
 
 

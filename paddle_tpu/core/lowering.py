@@ -234,11 +234,22 @@ class _LazyExecutable(object):
             with self._exec_lock:
                 fn = self._exec
                 if fn is None:
+                    import time as _time
+
+                    from paddle_tpu import profiler
                     from paddle_tpu.core import exec_cache
 
+                    t0 = _time.perf_counter()
                     fn = exec_cache.prepare_executable(
                         self.jitted, args, self._exec_cache_key
                     )
+                    # first-call resolution (AOT deserialize or lower+
+                    # compile+serialize) in the unified trace; the inner
+                    # backend compile appears as its own span via the
+                    # jax.monitoring taps
+                    profiler.record_span(
+                        "executable_resolve", t0, _time.perf_counter(),
+                        cat="compile")
                     self._exec = fn
         return fn
 

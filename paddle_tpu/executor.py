@@ -13,6 +13,7 @@ mutation).
 """
 
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
@@ -21,7 +22,10 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu import framework
+from paddle_tpu import profiler as _profiler
 from paddle_tpu.core import exec_cache
+from paddle_tpu.observability import explain as _explain
+from paddle_tpu.observability import telemetry as _telemetry
 from paddle_tpu.core.fingerprint import (
     executable_key,
     program_fingerprint,
@@ -105,11 +109,17 @@ class FetchHandle(object):
                                the equivalent ``run(...)`` bit-for-bit
     """
 
-    def __init__(self, arrays, fetch_names, nan_check=None):
+    def __init__(self, arrays, fetch_names, nan_check=None, track=None,
+                 t_dispatch=None):
         self._arrays = list(arrays)
         self.fetch_names = list(fetch_names)
         self._nan_check = nan_check
         self._numpy = None
+        # observability, both None on the undisturbed hot path: _track is
+        # the profiler's async-span record, _t_dispatch the telemetry
+        # dispatch timestamp (set only when telemetry was ENABLED)
+        self._track = track
+        self._t_dispatch = t_dispatch
 
     def __len__(self):
         return len(self._arrays)
@@ -138,7 +148,18 @@ class FetchHandle(object):
                 # not the bad values
                 self._nan_check()
                 self._nan_check = None
+            track = self._track
+            if track is not None:
+                # split device-ready from host-transfer for the trace:
+                # block first (marks "ready"), then materialize
+                self.block_until_ready()
+                _profiler.async_fetch_ready(track)
             self._numpy = [np.asarray(a) for a in self._arrays]
+            if track is not None:
+                _profiler.async_fetch_end(track)
+            if self._t_dispatch is not None:
+                _telemetry.record_fetch_materialize(
+                    time.perf_counter() - self._t_dispatch)
         return self._numpy
 
 
@@ -187,6 +208,18 @@ class Executor(object):
             if cp is None:
                 exec_cache.record_trace_miss()
                 exec_cache.configure()
+                # one structured "why did this retrace" event per fresh
+                # compile, diffed against the nearest cached key
+                _explain.record_compile({
+                    "program": key[0],
+                    "feed_specs": tuple(sorted(
+                        (n, (s, d)) for n, (s, d) in feed_specs.items())),
+                    "fetch_names": tuple(fetch_names),
+                    "scope_signature": key[3],
+                    "flags": key[6],
+                    "device": "%s:%d" % (device.platform, device.id),
+                    "mode": "single",
+                }, forced=refresh)
                 cp = CompiledProgram(
                     program,
                     feed_specs,
@@ -362,7 +395,13 @@ class Executor(object):
 
     def _run_on_device(self, program, feed, fetch_list, scope, device,
                        return_numpy, as_handle=False, refresh_cache=False):
+        # flight-recorder guards: one module-bool load each; both False
+        # leaves the hot path identical to the uninstrumented executor
+        telem = _telemetry.ENABLED
+        prof = _profiler.enabled()
+        t0 = time.perf_counter() if (telem or prof) else 0.0
         feeds, feed_specs = self._prepare_feeds(program, feed, device)
+        t_feed = time.perf_counter() if telem else 0.0
         fetch_names = [
             v.name if isinstance(v, framework.Variable) else str(v)
             for v in fetch_list
@@ -371,6 +410,12 @@ class Executor(object):
                                 refresh=refresh_cache)
         state = self._gather_state(cp.state_in, scope, device)
         key = self._step_key(program)
+        # per-EXECUTABLE key: two feed shapes of one program do different
+        # FLOPs, so the program fingerprint alone would mis-price steps
+        fingerprint = (_telemetry.executable_fingerprint(cp, program)
+                       if telem else None)
+        flops_avals = (_telemetry.capture_step_avals(cp, state, feeds, key)
+                       if telem else None)
         new_state, fetches = cp(state, feeds, key)
         for n, val in new_state.items():
             scope.set_value(n, val)
@@ -378,15 +423,51 @@ class Executor(object):
             # dispatch complete, nothing synced: the (optional) nan/inf
             # reductions are already in flight on device, but reading
             # their verdict waits for .result()
-            return FetchHandle(
+            handle = FetchHandle(
                 fetches, cp.fetch_names,
                 nan_check=self._nan_check_start(
                     new_state, cp.fetch_names, fetches
                 ),
+                track=_profiler.async_fetch_begin(cp.fetch_names)
+                if prof else None,
+                t_dispatch=t0 if telem else None,
             )
+            if telem or prof:
+                t1 = time.perf_counter()
+                if telem:
+                    # dispatch_only: this wall is host dispatch latency,
+                    # not step duration — kept out of percentiles/MFU
+                    _telemetry.record_step(
+                        "async", t1 - t0,
+                        feed_bytes=sum(
+                            getattr(a, "nbytes", 0)
+                            for a in feeds.values()),
+                        h2d_seconds=t_feed - t0, fingerprint=fingerprint,
+                        dispatch_only=True)
+                    if flops_avals is not None:
+                        _telemetry.register_flops_from_avals(
+                            cp, fingerprint, flops_avals)
+                if prof:
+                    _profiler.record_span("executor.dispatch", t0, t1)
+            return handle
         self._check_nan_inf(new_state, cp.fetch_names, fetches)
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
+        if telem or prof:
+            t1 = time.perf_counter()
+            if telem:
+                _telemetry.record_step(
+                    "single", t1 - t0,
+                    feed_bytes=sum(
+                        getattr(a, "nbytes", 0) for a in feeds.values()),
+                    fetch_bytes=sum(
+                        getattr(f, "nbytes", 0) for f in fetches),
+                    h2d_seconds=t_feed - t0, fingerprint=fingerprint)
+                if flops_avals is not None:
+                    _telemetry.register_flops_from_avals(
+                        cp, fingerprint, flops_avals)
+            if prof:
+                _profiler.record_span("executor.run", t0, t1)
         return fetches
 
     def run_async(self, program=None, feed=None, fetch_list=None,
@@ -443,6 +524,16 @@ class Executor(object):
             if cp is None:
                 exec_cache.record_trace_miss()
                 exec_cache.configure()
+                _explain.record_compile({
+                    "program": key_id[1],
+                    "feed_specs": tuple(sorted(
+                        (n, (s, d)) for n, (s, d) in feed_specs.items())),
+                    "fetch_names": tuple(fetch_names),
+                    "scope_signature": frozenset(scope_names),
+                    "flags": trace_flags_key(),
+                    "device": "%s:%d" % (device.platform, device.id),
+                    "mode": "multi_step[%d]" % int(steps),
+                })
                 cp = MultiStepProgram(
                     program, steps, feed_specs, fetch_names, scope_names,
                     is_test=program._is_test, device=device,
@@ -459,12 +550,37 @@ class Executor(object):
                 exec_cache.record_trace_hit()
             state = self._gather_state(cp.state_in, scope, device)
             key = self._step_key(program)
+            telem = _telemetry.ENABLED
+            prof = _profiler.enabled()
+            t0 = time.perf_counter() if (telem or prof) else 0.0
+            fingerprint = (_telemetry.executable_fingerprint(cp, program)
+                           if telem else None)
+            flops_avals = (_telemetry.capture_step_avals(
+                cp, state, feeds, key) if telem else None)
             new_state, fetches = cp(state, feeds, key)
             for n, val in new_state.items():
                 scope.set_value(n, val)
             self._check_nan_inf(new_state, cp.fetch_names, fetches)
             if return_numpy:
                 fetches = [np.asarray(f) for f in fetches]
+            if telem or prof:
+                t1 = time.perf_counter()
+                if telem:
+                    _telemetry.record_step(
+                        "multi_step", t1 - t0, steps=int(steps),
+                        feed_bytes=sum(
+                            getattr(a, "nbytes", 0)
+                            for a in feeds.values()),
+                        fetch_bytes=sum(
+                            getattr(f, "nbytes", 0) for f in fetches),
+                        fingerprint=fingerprint)
+                    if flops_avals is not None:
+                        _telemetry.register_flops_from_avals(
+                            cp, fingerprint, flops_avals,
+                            steps=int(steps))
+                if prof:
+                    _profiler.record_span(
+                        "executor.run_multi_step[%d]" % int(steps), t0, t1)
             return fetches
 
     def close(self):

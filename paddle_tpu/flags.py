@@ -60,6 +60,18 @@ _DEFS = {
     # split evenly: LRU eviction on the XLA layer, oldest-first trim on
     # the AOT image layer
     "exec_cache_max_bytes": (-1, int),
+    # step telemetry (observability/telemetry.py): per-step wall time,
+    # feed/fetch bytes, transfer seconds, device memory and MFU recorded
+    # by every executor run; off = zero hot-path overhead (module bool)
+    "telemetry": (False, bool),
+    # where the Prometheus scrape + step JSONL land at exit / flush():
+    # <path> gets the text-format metrics, <path>.steps.jsonl the per-step
+    # records; empty disables the files (in-memory registry stays live)
+    "metrics_path": ("", str),
+    # MFU accounting override, TFLOP/s: 0 = auto from device_kind (the
+    # chip table); set explicitly on hardware the table doesn't know
+    # (or to make CPU-proxy MFU numbers comparable run-to-run)
+    "peak_tflops": (0.0, float),
     # route the transformer's label-smoothed CE head through the fused
     # single-pass op (ops/loss_ops.py fused_label_smooth_ce): bf16
     # logits with f32-accumulated reductions, hand-written one-pass

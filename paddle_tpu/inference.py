@@ -12,11 +12,26 @@ matching the reference's multi-threaded serving contract.
 """
 
 import threading
+import time
 
 import numpy as np
 
+from paddle_tpu.observability import telemetry as _telemetry
+from paddle_tpu.observability.metrics_registry import REGISTRY as _REGISTRY
+
 __all__ = ["NativeConfig", "AnalysisConfig", "Predictor",
            "create_paddle_predictor"]
+
+# Serving-side metrics, distinct from the executor's step series so a
+# dashboard can tell "requests served" from "training steps run". The
+# underlying exe.run still records its own step when telemetry is on.
+_requests_total = _REGISTRY.counter(
+    "paddle_tpu_predictor_requests_total", "predictor requests served",
+    labels=("api",))
+_request_seconds = _REGISTRY.histogram(
+    "paddle_tpu_predictor_request_seconds",
+    "predictor request latency (run: full; run_async: dispatch only)",
+    labels=("api",))
 
 
 class NativeConfig(object):
@@ -116,6 +131,10 @@ class Predictor(object):
         """inputs: dict feed-name -> ndarray, or list matching the saved
         feed order. Returns list of ndarrays (fetch order)."""
         inputs = self._as_feed_dict(inputs)
+        # captured once: enable() flipping mid-request must not pair an
+        # unset t0 with a taken exit branch
+        telem = _telemetry.ENABLED
+        t0 = time.perf_counter() if telem else 0.0
         with self._lock:  # executor cache mutation is not thread-safe
             # Scope passed explicitly: the scope_guard stack is a process
             # global, unsafe when several predictors serve concurrently.
@@ -123,7 +142,11 @@ class Predictor(object):
                 self._program, feed=inputs, fetch_list=self._fetch_vars,
                 scope=self._scope,
             )
-        return [np.asarray(o) for o in outs]
+        outs = [np.asarray(o) for o in outs]
+        if telem:
+            _requests_total.inc(api="run")
+            _request_seconds.observe(time.perf_counter() - t0, api="run")
+        return outs
 
     def run_async(self, inputs):
         """Non-blocking ``run``: dispatches the request and returns an
@@ -132,11 +155,18 @@ class Predictor(object):
         only for the dispatch, not for the device execution — overlapping
         requests from Clone() handles queue on device, not on the host."""
         inputs = self._as_feed_dict(inputs)
+        telem = _telemetry.ENABLED
+        t0 = time.perf_counter() if telem else 0.0
         with self._lock:
-            return self._exe.run_async(
+            handle = self._exe.run_async(
                 self._program, feed=inputs, fetch_list=self._fetch_vars,
                 scope=self._scope,
             )
+        if telem:
+            _requests_total.inc(api="run_async")
+            _request_seconds.observe(time.perf_counter() - t0,
+                                     api="run_async")
+        return handle
 
     def clone(self):
         """A predictor sharing this one's weights for another serving

@@ -1,0 +1,67 @@
+"""Observability: the flight recorder for the XLA execution engine.
+
+PR 1 added three cache layers plus async dispatch whose behavior was
+visible only through one hand-rolled print report. This package makes the
+framework self-describing in production instead:
+
+* ``metrics_registry`` — a process-global, thread-safe registry of
+  counters / gauges / fixed-bucket histograms with label support,
+  exported as Prometheus text format and JSONL snapshots
+  (``FLAGS_metrics_path``). The executable-cache counters
+  (``core/exec_cache.py``) are absorbed via a collector, so one scrape
+  carries the whole compile-tax story.
+* ``telemetry`` — per-step flight data recorded by ``Executor.run`` /
+  ``run_async`` / ``run_multi_step`` and ``ParallelExecutor.run``: wall
+  time, feed/fetch bytes, host->device transfer time, device memory in
+  use, and an MFU/roofline estimate from per-fingerprint FLOP counts.
+  Surfaced through ``profiler.step_stats()`` percentiles and a
+  ``StepTimer`` callback API. Switched by ``FLAGS_telemetry`` (module
+  bool guard: zero overhead when off).
+* ``explain`` — the recompile explainer: every fresh XLA trace logs a
+  structured event naming which cache-key component changed vs. the
+  nearest cached entry, so "why did it retrace" is one log line.
+
+``docs/OBSERVABILITY.md`` is the operator's guide (metric catalog, how
+to read the explainer, loading the merged trace in perfetto).
+"""
+
+from paddle_tpu.observability import explain  # noqa: F401
+from paddle_tpu.observability import metrics_registry  # noqa: F401
+from paddle_tpu.observability import telemetry  # noqa: F401
+from paddle_tpu.observability.metrics_registry import REGISTRY  # noqa: F401
+
+
+def _exec_cache_collector():
+    """Scrape-time view of the executable-cache counters: the single
+    source of truth stays core/exec_cache.py (bench.py and the warm-start
+    smoke read it directly); the registry mirrors it so one Prometheus
+    scrape carries compile-tax data without double bookkeeping."""
+    from paddle_tpu.core import exec_cache
+
+    st = exec_cache.stats()
+    yield ("paddle_tpu_fresh_compiles_total", "counter",
+           "XLA compiles no cache layer could serve",
+           [({}, st["fresh_compiles"])])
+    yield ("paddle_tpu_backend_compiles_total", "counter",
+           "XLA backend compile calls observed (jax.monitoring)",
+           [({}, st["backend_compiles"])])
+    yield ("paddle_tpu_exec_cache_hits_total", "counter",
+           "executable-cache hits by layer",
+           [({"layer": "trace"}, st["trace_cache_hits"]),
+            ({"layer": "persistent"}, st["persistent_hits"]),
+            ({"layer": "aot"}, st["aot_hits"])])
+    yield ("paddle_tpu_exec_cache_misses_total", "counter",
+           "executable-cache misses by layer",
+           [({"layer": "trace"}, st["trace_cache_misses"]),
+            ({"layer": "persistent"}, st["persistent_misses"]),
+            ({"layer": "aot"}, st["aot_misses"])])
+    yield ("paddle_tpu_exec_cache_errors_total", "counter",
+           "corrupt/incompatible persistent entries tolerated",
+           [({"layer": "aot"}, st["aot_errors"])])
+    yield ("paddle_tpu_compile_seconds_total", "counter",
+           "wall seconds inside XLA compiles, split cold/warm",
+           [({"kind": "cold"}, st["compile_seconds_cold"]),
+            ({"kind": "warm"}, st["compile_seconds_warm"])])
+
+
+REGISTRY.register_collector(_exec_cache_collector)

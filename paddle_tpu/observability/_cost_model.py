@@ -1,0 +1,32 @@
+"""Loader for tools/hlo_cost_model.py (the repo's jaxpr FLOP counter).
+
+``tools/`` is deliberately not a package — its scripts insert the repo
+root on sys.path and parse argv at import-adjacent points, so a plain
+``import`` from library code is wrong. This loads the module once by
+file path and caches it; telemetry reuses its ``optimize_jaxpr`` /
+``sum_flops_recursive`` instead of maintaining a second FLOP table.
+"""
+
+import importlib.util
+import os
+import threading
+
+_lock = threading.Lock()
+_mod = None
+
+
+def load():
+    global _mod
+    if _mod is None:
+        with _lock:
+            if _mod is None:
+                path = os.path.join(
+                    os.path.dirname(os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__)))),
+                    "tools", "hlo_cost_model.py")
+                spec = importlib.util.spec_from_file_location(
+                    "paddle_tpu_hlo_cost_model", path)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                _mod = mod
+    return _mod

@@ -1,0 +1,163 @@
+"""Recompile explainer: "why did it retrace" as one structured log line.
+
+Every executable-cache key is a tuple of independent components (program
+structure fingerprint, feed shape/dtype specs, fetch set, scope
+signature, trace-time flags, device). When a run misses every in-memory
+cache layer and pays a fresh XLA trace, the executor calls
+:func:`record_compile` with those components; the explainer diffs them
+against the NEAREST previously-compiled entry (most components in
+common) and emits a structured event naming exactly which component(s)
+forced the recompile — the debugging session TensorFlow-era retrace
+hunts used to cost, reduced to reading a log line.
+
+Events go to the ``paddle_tpu.observability.explain`` logger as JSON, to
+the metrics registry (``paddle_tpu_recompiles_total{changed=...}``), and
+to a bounded in-process list (:func:`events`) for tests and tooling.
+Always on: the cost is one dict diff per *compile*, never per step.
+"""
+
+import collections
+import json
+import logging
+import threading
+import time
+
+from paddle_tpu.observability.metrics_registry import REGISTRY
+
+__all__ = ["record_compile", "events", "reset", "COMPONENTS"]
+
+logger = logging.getLogger("paddle_tpu.observability.explain")
+
+# Diffable cache-key components, in blame-priority order: when several
+# differ vs. the nearest entry, all are reported, first is the headline.
+COMPONENTS = ("program", "feed_specs", "fetch_names", "scope_signature",
+              "flags", "device", "mode")
+
+_MAX_EVENTS = 512
+# Bounded diff window: nearest-entry search is O(len) under the lock on
+# every compile, and this module is always on — a serving process
+# compiling many distinct feed shapes must not accumulate component
+# dicts forever. 256 recent compiles is plenty of context to blame
+# against; older ones age out (a miss against an aged-out entry reads
+# as first_compile-ish blame on whichever components differ).
+_MAX_ENTRIES = 256
+
+_lock = threading.Lock()
+_entries = collections.deque(maxlen=_MAX_ENTRIES)  # recent compile keys
+_events = []     # bounded structured event log
+_compile_count = [0]
+
+_recompiles = REGISTRY.counter(
+    "paddle_tpu_recompiles_total",
+    "fresh XLA traces by blamed cache-key component",
+    labels=("changed",))
+
+
+def _canon(components):
+    out = {}
+    for k in COMPONENTS:
+        v = components.get(k)
+        if isinstance(v, (set, frozenset)):
+            v = tuple(sorted(v))
+        elif isinstance(v, list):
+            v = tuple(v)
+        out[k] = v
+    return out
+
+
+def _describe_change(key, old, new):
+    """Human detail for the headline components; terse repr otherwise."""
+    if key == "feed_specs":
+        old_d, new_d = dict(old or ()), dict(new or ())
+        parts = []
+        for name in sorted(set(old_d) | set(new_d)):
+            a, b = old_d.get(name), new_d.get(name)
+            if a != b:
+                parts.append("%s: %s -> %s" % (name, a, b))
+        return "; ".join(parts) or "feed set changed"
+    if key == "flags":
+        old_d, new_d = dict(old or ()), dict(new or ())
+        return "; ".join(
+            "%s: %r -> %r" % (n, old_d.get(n), new_d.get(n))
+            for n in sorted(set(old_d) | set(new_d))
+            if old_d.get(n) != new_d.get(n))
+    if key == "program":
+        return "program structure changed (fingerprint %s -> %s)" % (
+            str(old)[:12], str(new)[:12])
+    if key == "scope_signature":
+        old_s, new_s = set(old or ()), set(new or ())
+        added, gone = sorted(new_s - old_s), sorted(old_s - new_s)
+        bits = []
+        if added:
+            bits.append("vars added: %s" % ", ".join(added[:6]))
+        if gone:
+            bits.append("vars removed: %s" % ", ".join(gone[:6]))
+        return "; ".join(bits) or "scope signature changed"
+    return "%r -> %r" % (old, new)
+
+
+def record_compile(components, forced=False):
+    """One fresh XLA trace. ``components`` maps COMPONENTS keys to the
+    new cache-key pieces; ``forced`` marks use_program_cache=False
+    bypasses (nothing to blame — the caller asked). Returns the event."""
+    comp = _canon(components)
+    now = time.time()
+    with _lock:
+        nearest = None
+        nearest_score = -1
+        for entry in _entries:
+            score = sum(1 for k in COMPONENTS if entry[k] == comp[k])
+            if score > nearest_score:
+                nearest, nearest_score = entry, score
+        _entries.append(comp)
+        _compile_count[0] += 1
+        n_compiles = _compile_count[0]
+    if forced:
+        changed = ["forced_refresh"]
+        detail = {"forced_refresh": "use_program_cache=False bypass"}
+    elif nearest is None:
+        changed = ["first_compile"]
+        detail = {"first_compile":
+                  "no prior executable in this process to compare against"}
+    else:
+        changed = [k for k in COMPONENTS if nearest[k] != comp[k]]
+        detail = {k: _describe_change(k, nearest[k], comp[k])
+                  for k in changed}
+        if not changed:
+            # identical key components but the in-memory registry missed:
+            # an LRU eviction or a purged cache — name that, don't blame
+            # the program
+            changed = ["cache_evicted"]
+            detail = {"cache_evicted":
+                      "key matches a prior compile; the in-memory entry "
+                      "was evicted or purged"}
+    event = {
+        "event": "fresh_compile",
+        "ts": now,
+        "changed": changed,
+        "detail": detail,
+        "program_fingerprint": str(comp.get("program"))[:16],
+        "mode": comp.get("mode"),
+        "device": comp.get("device"),
+        "compiles_so_far": n_compiles,
+    }
+    with _lock:
+        _events.append(event)
+        del _events[:-_MAX_EVENTS]
+    _recompiles.inc(changed=changed[0])
+    logger.info("recompile: %s", json.dumps(event, sort_keys=True))
+    return event
+
+
+def events():
+    """The structured event log (oldest first, bounded)."""
+    with _lock:
+        return [dict(e) for e in _events]
+
+
+def reset():
+    """Forget prior compiles and events (tests)."""
+    with _lock:
+        _entries.clear()
+        del _events[:]
+        _compile_count[0] = 0

@@ -14,13 +14,18 @@ BuildStrategy.ReduceStrategy maps to the policy:
 num_trainers/trainer_id (NCCL2 multi-node) -> jax.distributed processes.
 """
 
+import time
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu import framework
+from paddle_tpu import profiler as _profiler
 from paddle_tpu.core import exec_cache
+from paddle_tpu.observability import explain as _explain
+from paddle_tpu.observability import telemetry as _telemetry
 from paddle_tpu.core.fingerprint import (
     executable_key,
     program_fingerprint,
@@ -237,6 +242,16 @@ class ParallelExecutor(object):
         if cp is None:
             exec_cache.record_trace_miss()
             exec_cache.configure()
+            _explain.record_compile({
+                "program": key[0],
+                "feed_specs": tuple(sorted(
+                    (n, (s, d)) for n, (s, d) in feed_specs.items())),
+                "fetch_names": tuple(fetch_names),
+                "scope_signature": frozenset(scope_names),
+                "flags": key[4],
+                "device": "mesh:%s" % (mesh_sig,),
+                "mode": "gspmd",
+            })
             state_shapes = self._collect_state_shapes()
             cp = CompiledProgram(
                 self._program,
@@ -262,9 +277,17 @@ class ParallelExecutor(object):
         return cp
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        telem = _telemetry.ENABLED
+        prof = _profiler.enabled()
+        t0 = time.perf_counter() if (telem or prof) else 0.0
         feed = feed if feed is not None else (feed_dict or {})
         if self._pipeline_stages:
-            return self._run_pipeline(fetch_list, feed, return_numpy)
+            fetches = self._run_pipeline(fetch_list, feed, return_numpy)
+            if telem:
+                _telemetry.record_step(
+                    "pipeline", time.perf_counter() - t0,
+                    fingerprint=program_fingerprint(self._program))
+            return fetches
         if isinstance(feed, list):
             # per-device feed dicts (fluid API) -> concat along batch.
             merged = {}
@@ -332,11 +355,33 @@ class ParallelExecutor(object):
             jax.random.PRNGKey(self._program.random_seed or self._base_seed),
             self._run_counter,
         )
+        flops_avals = None
+        if telem:
+            fingerprint = _telemetry.executable_fingerprint(
+                cp, self._program)
+            flops_avals = _telemetry.capture_step_avals(
+                cp, state, feeds, key)
         new_state, fetches = cp(state, feeds, key)
         for n, val in new_state.items():
             self._scope.set_value(n, val)
         if return_numpy:
             fetches = [self._fetch_to_numpy(f) for f in fetches]
+        if telem or prof:
+            t1 = time.perf_counter()
+            if telem:
+                _telemetry.record_step(
+                    "parallel", t1 - t0,
+                    feed_bytes=sum(
+                        getattr(a, "nbytes", 0) for a in feeds.values()),
+                    fetch_bytes=sum(
+                        getattr(f, "nbytes", 0) for f in fetches
+                        if hasattr(f, "nbytes")),
+                    fingerprint=fingerprint)
+                if flops_avals is not None:
+                    _telemetry.register_flops_from_avals(
+                        cp, fingerprint, flops_avals)
+            if prof:
+                _profiler.record_span("parallel_executor.run", t0, t1)
         return fetches
 
     # -- program-level pipeline path ---------------------------------------

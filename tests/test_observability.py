@@ -1,0 +1,457 @@
+"""Flight-recorder coverage: metrics registry under threads, Prometheus
+text golden, step-percentile math, the recompile explainer's
+one-event-per-fresh-compile contract, and the unified chrome trace.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, profiler, unique_name
+from paddle_tpu.observability import explain, telemetry
+from paddle_tpu.observability.metrics_registry import (
+    REGISTRY,
+    MetricsRegistry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _quiet_observability():
+    """Telemetry off unless a test enables it; explainer memory scoped to
+    the test so nearest-entry diffs see only this test's compiles. The
+    process-global executable registry is purged so a structurally
+    identical program from an earlier test can't serve this test's run
+    (explainer events only fire on real trace misses)."""
+    import paddle_tpu.executor as executor_mod
+
+    executor_mod._shared_executables.clear()
+    telemetry.enable(False)
+    telemetry.reset(flops=True)
+    explain.reset()
+    yield
+    telemetry.enable(False)
+    telemetry.reset(flops=True)
+    explain.reset()
+
+
+def _build_mlp(width=8):
+    unique_name.switch({})
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6])
+        hid = fluid.layers.fc(x, size=width, act="relu")
+        loss = fluid.layers.mean(fluid.layers.fc(hid, size=2))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(bs=3):
+    return {"x": np.arange(bs * 6, dtype="float32").reshape(bs, 6) / 10.0}
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_counter_exact_under_threads():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "x", labels=("worker",))
+    h = reg.histogram("t_lat", "x", buckets=(0.5, 1.5))
+    n_threads, n_iter = 8, 500
+
+    def work(i):
+        for _ in range(n_iter):
+            c.inc(worker="w%d" % (i % 2))
+            h.observe(1.0)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = c.value(worker="w0") + c.value(worker="w1")
+    assert total == n_threads * n_iter
+    snap = h.snapshot()
+    assert snap["count"] == n_threads * n_iter
+    assert snap["sum"] == pytest.approx(n_threads * n_iter * 1.0)
+    # every 1.0 observation lands in the le=1.5 bucket, none in le=0.5
+    assert snap["buckets"] == [0, n_threads * n_iter]
+
+
+def test_registry_rejects_conflicting_reregistration():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "x", labels=("k",))
+    assert reg.counter("a_total", "x", labels=("k",)) is not None  # idempotent
+    with pytest.raises(ValueError):
+        reg.gauge("a_total", "x")
+    with pytest.raises(ValueError):
+        reg.counter("a_total", "x", labels=("other",))
+    with pytest.raises(ValueError):
+        reg.counter("b_total").inc(-1)
+
+
+def test_prometheus_text_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("steps_total", "steps run", labels=("executor",))
+    g = reg.gauge("mem_bytes", "bytes in use")
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    c.inc(3, executor="single")
+    c.inc(2, executor="async")
+    g.set(1024)
+    h.observe(0.0625)
+    h.observe(0.5)
+    h.observe(5.0)
+    golden = "\n".join([
+        "# HELP steps_total steps run",
+        "# TYPE steps_total counter",
+        'steps_total{executor="async"} 2',
+        'steps_total{executor="single"} 3',
+        "# HELP mem_bytes bytes in use",
+        "# TYPE mem_bytes gauge",
+        "mem_bytes 1024",
+        "# HELP lat_seconds latency",
+        "# TYPE lat_seconds histogram",
+        'lat_seconds_bucket{le="0.1"} 1',
+        'lat_seconds_bucket{le="1.0"} 2',
+        'lat_seconds_bucket{le="+Inf"} 3',
+        "lat_seconds_sum 5.5625",
+        "lat_seconds_count 3",
+        "",
+    ])
+    assert reg.to_prometheus() == golden
+
+
+def test_registry_jsonl_snapshot_round_trip(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(7)
+    path = str(tmp_path / "snap.jsonl")
+    reg.write_jsonl(path)
+    reg.counter("c_total").inc(1)
+    reg.write_jsonl(path)
+    with open(path) as f:
+        snaps = [json.loads(line) for line in f]
+    assert len(snaps) == 2
+    assert snaps[0]["metrics"]["c_total"]["series"][0]["value"] == 7
+    assert snaps[1]["metrics"]["c_total"]["series"][0]["value"] == 8
+
+
+def test_global_registry_carries_exec_cache_collector():
+    text = REGISTRY.to_prometheus()
+    assert "paddle_tpu_fresh_compiles_total" in text
+    assert "# TYPE paddle_tpu_exec_cache_hits_total counter" in text
+
+
+# -- step telemetry ----------------------------------------------------------
+
+def test_step_percentile_math():
+    telemetry.enable(True)
+    for ms in range(1, 101):  # 1..100 ms
+        telemetry.record_step("single", ms / 1000.0)
+    st = telemetry.step_stats()
+    assert st["count"] == 100
+    assert st["p50_ms"] == pytest.approx(50.0)
+    assert st["p95_ms"] == pytest.approx(95.0)
+    assert st["p99_ms"] == pytest.approx(99.0)
+    assert st["mean_ms"] == pytest.approx(50.5)
+    assert st["total_s"] == pytest.approx(5.05)
+
+
+def test_step_stats_mfu_weights_by_fingerprint():
+    telemetry.enable(True)
+    telemetry.register_flops("fpA", 2e9)
+    telemetry.record_step("single", 0.1, fingerprint="fpA")
+    telemetry.record_step("single", 0.1, fingerprint="unknown")
+    st = telemetry.step_stats(peak=100e9)
+    # only the known-fingerprint record enters the MFU accounting
+    assert st["flops_per_sec"] == pytest.approx(2e10)
+    assert st["mfu"] == pytest.approx(0.2)
+    assert st["count"] == 2
+
+
+def test_async_dispatch_excluded_from_percentiles_and_mfu():
+    """run_async records host dispatch latency (microseconds) — letting
+    it into the MFU denominator would report MFU >> 1."""
+    telemetry.enable(True)
+    telemetry.register_flops("fp", 1e9)
+    telemetry.record_step("single", 1.0, fingerprint="fp")
+    telemetry.record_step("async", 1e-6, fingerprint="fp",
+                          dispatch_only=True)
+    st = telemetry.step_stats(peak=10e9)
+    assert st["count"] == 2                      # both count as steps
+    assert st["p50_ms"] == pytest.approx(1000.0)  # dispatch excluded
+    assert st["mfu"] == pytest.approx(0.1)        # 1e9/1.0/10e9, not 1e6x
+    recs = telemetry.step_records()
+    assert [r["dispatch_only"] for r in recs] == [False, True]
+
+
+def test_telemetry_reset_keeps_flop_table():
+    """Phase-scoped reset() (tools/step_breakdown.py) must not lose the
+    per-fingerprint FLOPs: executables register them only once."""
+    telemetry.enable(True)
+    telemetry.register_flops("fp", 1e9)
+    telemetry.record_step("single", 1.0, fingerprint="fp")
+    telemetry.reset()
+    telemetry.record_step("single", 1.0, fingerprint="fp")
+    assert telemetry.step_stats(peak=1e9)["mfu"] == pytest.approx(1.0)
+    telemetry.reset(flops=True)
+    telemetry.record_step("single", 1.0, fingerprint="fp")
+    assert telemetry.step_stats(peak=1e9)["mfu"] is None
+
+
+def test_registry_reset_keeps_module_handles_alive():
+    reg = MetricsRegistry()
+    c = reg.counter("h_total", "x")
+    c.inc(5)
+    reg.reset()
+    assert c.value() == 0
+    c.inc(2)  # the pre-reset handle still feeds the scrape
+    assert "h_total 2" in reg.to_prometheus()
+
+
+def test_multi_step_record_divides_per_step():
+    telemetry.enable(True)
+    telemetry.record_step("multi_step", 1.0, steps=10)
+    st = telemetry.step_stats()
+    assert st["count"] == 10
+    assert st["p50_ms"] == pytest.approx(100.0)
+
+
+def test_step_timer_and_callbacks():
+    telemetry.enable(True)
+    seen = []
+    telemetry.add_step_callback(seen.append)
+    try:
+        with telemetry.StepTimer("trainer", feed_bytes=64):
+            pass
+    finally:
+        telemetry.remove_step_callback(seen.append)
+    assert len(seen) == 1
+    assert seen[0]["executor"] == "trainer"
+    assert seen[0]["feed_bytes"] == 64
+    assert telemetry.step_stats()["count"] == 1
+
+
+def test_executor_records_steps_and_bytes():
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    telemetry.enable(True)
+    telemetry.reset()
+    for _ in range(4):
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+    recs = telemetry.step_records()
+    assert len(recs) == 4
+    assert all(r["executor"] == "single" for r in recs)
+    assert all(r["feed_bytes"] == 18 * 4 for r in recs)  # 3x6 f32
+    assert all(r["fetch_bytes"] == 4 for r in recs)      # scalar f32 loss
+    assert all(r["wall_s"] > 0 and r["h2d_seconds"] >= 0 for r in recs)
+    st = profiler.step_stats(peak=1e12)  # the profiler-surface alias
+    assert st["count"] == 4 and st["p50_ms"] is not None
+    assert st["mfu"] is not None and st["mfu"] > 0
+
+
+def test_flops_keyed_per_executable_not_per_program():
+    """Two feed shapes of one program compile to two executables with
+    different FLOP counts; a program-level key would let the second
+    overwrite the first and mis-price every step."""
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    telemetry.enable(True)
+    telemetry.reset(flops=True)
+    exe.run(main, feed=_feed(bs=3), fetch_list=[loss])
+    exe.run(main, feed=_feed(bs=6), fetch_list=[loss])
+    recs = telemetry.step_records()
+    fps = [r["fingerprint"] for r in recs]
+    assert fps[0] != fps[1]
+    from paddle_tpu.observability.telemetry import _flops
+
+    assert fps[0] in _flops and fps[1] in _flops
+    # both estimates survive side by side, and the bigger batch does
+    # more work (not exactly 2x: the optimizer update is batch-free)
+    assert _flops[fps[1]] > _flops[fps[0]]
+
+
+def test_fetch_handle_records_materialize_histogram():
+    from paddle_tpu.observability.telemetry import _fetch_materialize
+
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    telemetry.enable(True)
+    before = _fetch_materialize.snapshot()["count"]
+    handle = exe.run_async(main, feed=_feed(), fetch_list=[loss])
+    handle.result()
+    handle.result()  # memoized: no second observation
+    assert _fetch_materialize.snapshot()["count"] == before + 1
+    # telemetry off -> hot path untouched, nothing recorded
+    telemetry.enable(False)
+    h2 = exe.run_async(main, feed=_feed(), fetch_list=[loss])
+    assert h2._t_dispatch is None and h2._track is None
+    h2.result()
+    assert _fetch_materialize.snapshot()["count"] == before + 1
+
+
+# -- recompile explainer -----------------------------------------------------
+
+def test_explainer_fires_once_per_fresh_compile_and_stays_quiet_warm():
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed=_feed(), fetch_list=[loss])
+    n = len(explain.events())
+    assert n >= 1  # startup + train compiles, one event each
+    for _ in range(3):  # warm reruns: zero new events
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+    assert len(explain.events()) == n
+
+
+def test_explainer_names_feed_spec_change():
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed=_feed(bs=3), fetch_list=[loss])
+    n = len(explain.events())
+    exe.run(main, feed=_feed(bs=5), fetch_list=[loss])  # induced shape change
+    events = explain.events()
+    assert len(events) == n + 1
+    ev = events[-1]
+    assert ev["changed"] == ["feed_specs"]
+    assert "(3, 6)" in ev["detail"]["feed_specs"]
+    assert "(5, 6)" in ev["detail"]["feed_specs"]
+
+
+def test_explainer_names_flag_change():
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed=_feed(), fetch_list=[loss])
+    n = len(explain.events())
+    flags.set_flag("remat_gradients", True)
+    try:
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+    finally:
+        flags.set_flag("remat_gradients", False)
+    events = explain.events()
+    assert len(events) == n + 1
+    assert events[-1]["changed"] == ["flags"]
+    assert "remat_gradients" in events[-1]["detail"]["flags"]
+
+
+def test_explainer_counts_in_registry():
+    from paddle_tpu.observability.explain import _recompiles
+
+    before = _recompiles.value(changed="feed_specs")
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed=_feed(bs=2), fetch_list=[loss])
+    exe.run(main, feed=_feed(bs=7), fetch_list=[loss])
+    assert _recompiles.value(changed="feed_specs") == before + 1
+
+
+# -- unified chrome trace ----------------------------------------------------
+
+def test_chrome_trace_merges_threads_compiles_and_async(tmp_path):
+    main, startup, loss = _build_mlp(width=16)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    trace_path = str(tmp_path / "trace.json")
+    with profiler.profiler(profile_path=trace_path):
+        with profiler.RecordEvent("main_work"):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+
+        def side():
+            with profiler.RecordEvent("side_work"):
+                pass
+
+        t = threading.Thread(target=side)
+        t.start()
+        t.join()
+        handle = exe.run_async(main, feed=_feed(), fetch_list=[loss])
+        handle.result()
+    with open(trace_path) as f:
+        trace = json.load(f)  # round-trips through json.load
+    events = trace["traceEvents"]
+    host = [e for e in events if e.get("cat") == "host"]
+    names = {e["name"] for e in host}
+    assert {"main_work", "side_work"} <= names
+    # thread-correct: the two RecordEvents ran on different threads
+    tid_of = {e["name"]: e["tid"] for e in host}
+    assert tid_of["main_work"] != tid_of["side_work"]
+    # every span carries a unique id
+    span_ids = [e["args"]["span_id"] for e in events if e["ph"] == "X"]
+    assert len(span_ids) == len(set(span_ids))
+    # compile spans from the exec-cache monitoring taps are in-stream
+    assert any(e.get("cat") == "compile" for e in events)
+    # async-fetch lifetime: nestable begin/instant/end sharing one id
+    fetch = [e for e in events if e.get("cat") == "async_fetch"]
+    phases = sorted(e["ph"] for e in fetch)
+    assert phases == ["b", "e", "n"]
+    assert len({e["id"] for e in fetch}) == 1
+    # thread metadata rows name every referenced tid
+    meta_tids = {e["tid"] for e in events if e["ph"] == "M"}
+    assert {e["tid"] for e in host} <= meta_tids
+
+
+def test_stop_profiler_quiet_by_default(capsys):
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with profiler.profiler(profile_path="/dev/null"):
+        with profiler.RecordEvent("quiet_step"):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+    assert capsys.readouterr().out == ""
+    with profiler.profiler(profile_path="/dev/null", print_report=True):
+        with profiler.RecordEvent("loud_step"):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out and "loud_step" in out
+
+
+def test_profiler_event_appends_race_free():
+    """Concurrent RecordEvents from many threads must all land (the old
+    plain-list append dropped events under the GIL's mercy and exported
+    every span as tid=0)."""
+    profiler.start_profiler()
+    n_threads, n_events = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def work():
+        barrier.wait()  # all threads alive at once -> distinct idents
+        for i in range(n_events):
+            with profiler.RecordEvent("race"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with profiler._lock:
+        count = sum(1 for e in profiler._state["events"]
+                    if e["name"] == "race")
+        tids = {e["tid"] for e in profiler._state["events"]
+                if e["name"] == "race"}
+    profiler.stop_profiler(profile_path="/dev/null")
+    assert count == n_threads * n_events
+    assert len(tids) == n_threads
+
+
+# -- flush / files -----------------------------------------------------------
+
+def test_flush_writes_prometheus_and_steps_jsonl(tmp_path):
+    telemetry.enable(True)
+    telemetry.record_step("single", 0.01)
+    path = str(tmp_path / "metrics.prom")
+    assert telemetry.flush(path) == path
+    with open(path) as f:
+        text = f.read()
+    assert "paddle_tpu_steps_total" in text
+    with open(path + ".steps.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    assert recs and recs[-1]["executor"] == "single"

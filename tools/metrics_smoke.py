@@ -1,0 +1,121 @@
+"""Metrics smoke: prove the flight recorder produces a parseable scrape.
+
+Run twice in two subprocesses sharing FLAGS_exec_cache_dir (tools/
+run_ci.sh `metrics` stage does exactly that), both with FLAGS_telemetry=1
+and FLAGS_metrics_path set:
+
+    FLAGS_telemetry=1 FLAGS_metrics_path=$M FLAGS_exec_cache_dir=$D \
+        python tools/metrics_smoke.py cold
+    FLAGS_telemetry=1 FLAGS_metrics_path=$M FLAGS_exec_cache_dir=$D \
+        python tools/metrics_smoke.py warm
+
+Each pass trains a 3-step MLP, flushes the registry, then re-reads its
+own Prometheus file with a strict line parser and asserts:
+
+* the file parses (every non-comment line is ``name{labels} value``);
+* ``paddle_tpu_steps_total`` summed over labels is nonzero;
+* ``paddle_tpu_step_seconds`` histogram count matches the steps run;
+* the step JSONL snapshot exists and every line json-parses;
+* warm only: ``paddle_tpu_fresh_compiles_total`` is ZERO — the compile
+  telemetry and the persistent executable cache agree that the second
+  process paid no XLA compile.
+"""
+
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+STEPS = 3
+
+_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(-?[0-9.e+-]+|[+-]Inf|NaN)$")
+
+
+def parse_prometheus(path):
+    """{metric_name: {label_blob_or_'': float}} with strict line checks."""
+    out = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.rstrip("\n")
+            if not line or line.startswith("#"):
+                continue
+            m = _LINE.match(line)
+            assert m, "unparseable line %d: %r" % (lineno, line)
+            name, labels, value = m.groups()
+            out.setdefault(name, {})[labels or ""] = float(value)
+    return out
+
+
+def train_three_steps():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        hid = fluid.layers.fc(x, size=16, act="relu")
+        loss = fluid.layers.mean(fluid.layers.fc(hid, size=1))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.arange(32, dtype="float32").reshape(4, 8) / 32.0}
+    for _ in range(STEPS):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(np.asarray(lv)).all()
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "cold"
+    metrics_path = os.environ.get("FLAGS_metrics_path")
+    if not metrics_path:
+        print("metrics_smoke: FLAGS_metrics_path not set", file=sys.stderr)
+        return 2
+    train_three_steps()
+
+    from paddle_tpu.observability import explain, telemetry
+
+    assert telemetry.ENABLED, "FLAGS_telemetry=1 did not enable telemetry"
+    telemetry.flush()
+
+    metrics = parse_prometheus(metrics_path)
+    steps = sum(metrics.get("paddle_tpu_steps_total", {}).values())
+    fresh = sum(metrics.get("paddle_tpu_fresh_compiles_total", {}).values())
+    hist_count = sum(
+        v for k, v in metrics.get("paddle_tpu_step_seconds_count",
+                                  {}).items())
+    with open(metrics_path + ".steps.jsonl") as f:
+        step_lines = [json.loads(line) for line in f if line.strip()]
+
+    print("metrics_smoke[%s]: %s" % (mode, json.dumps({
+        "steps_total": steps, "fresh_compiles_total": fresh,
+        "step_seconds_count": hist_count, "jsonl_records": len(step_lines),
+        "explainer_events": len(explain.events()),
+    })))
+
+    # startup + 3 train steps all record; the histogram sees the same
+    assert steps >= STEPS, "steps_total=%r, expected >= %d" % (steps, STEPS)
+    assert hist_count == steps, (
+        "histogram count %r disagrees with steps_total %r"
+        % (hist_count, steps))
+    assert step_lines and all("step_s" in r for r in step_lines), (
+        "step JSONL snapshot missing or malformed")
+    if mode == "warm":
+        assert fresh == 0, (
+            "warm process scrape shows %d fresh XLA compile(s); the "
+            "persistent cache and the metrics disagree" % fresh)
+    else:
+        assert fresh > 0, "cold process scrape shows no compiles at all"
+        # one explainer event per fresh trace, never more
+        assert len(explain.events()) >= 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

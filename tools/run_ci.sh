@@ -11,7 +11,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-ALL_STAGES=(native python warm dryrun bench)
+ALL_STAGES=(native python warm metrics dryrun bench)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -67,6 +67,26 @@ if want warm; then
     FLAGS_exec_cache_dir="$cache_dir" \
     python tools/warm_start_smoke.py warm
   rm -rf "$cache_dir"
+  trap - EXIT
+fi
+
+if want metrics; then
+  echo "== metrics smoke (flight recorder scrape) =="
+  # two processes share one exec cache dir; each runs a 3-step MLP with
+  # telemetry on and must leave a parseable Prometheus file with nonzero
+  # paddle_tpu_steps_total; the warm one additionally proves the scrape
+  # shows ZERO fresh compiles (metrics_smoke.py asserts all of it)
+  mdir="$(mktemp -d)"
+  trap 'rm -rf "$mdir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_telemetry=1 FLAGS_metrics_path="$mdir/cold.prom" \
+    FLAGS_exec_cache_dir="$mdir/cache" \
+    python tools/metrics_smoke.py cold
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_telemetry=1 FLAGS_metrics_path="$mdir/warm.prom" \
+    FLAGS_exec_cache_dir="$mdir/cache" \
+    python tools/metrics_smoke.py warm
+  rm -rf "$mdir"
   trap - EXIT
 fi
 

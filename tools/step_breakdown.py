@@ -28,7 +28,6 @@ import json
 import os
 import sys
 import tempfile
-import time
 from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -189,7 +188,13 @@ def _transformer_feed(on_tpu):
 
 
 def _time_phase(fluid, model, on_tpu, mode, steps, warmup, use_amp):
+    """Phase timing via the step-telemetry JSONL snapshot: the executors
+    already record per-step wall time (observability/telemetry.py), so
+    this tool stopped carrying its own perf_counter loop — it runs the
+    steps, dumps the snapshot, and averages the records. One instrument,
+    one truth; the same numbers land in the Prometheus scrape."""
     import numpy as np
+    from paddle_tpu.observability import telemetry
     from paddle_tpu.transpiler import rewrite_program_amp
     from paddle_tpu import unique_name
 
@@ -198,6 +203,7 @@ def _time_phase(fluid, model, on_tpu, mode, steps, warmup, use_amp):
     if use_amp:
         rewrite_program_amp(main, "bfloat16")
     feed = _transformer_feed(on_tpu) if model == "transformer" else {}
+    telemetry.enable(True)
     with fluid.scope_guard(fluid.executor.Scope()):
         exe = fluid.Executor(fluid.TPUPlace() if on_tpu
                              else fluid.CPUPlace())
@@ -205,12 +211,21 @@ def _time_phase(fluid, model, on_tpu, mode, steps, warmup, use_amp):
         for _ in range(warmup):
             exe.run(main, feed=feed, fetch_list=[])
         exe.run(main, feed=feed, fetch_list=[loss])
-        t0 = time.perf_counter()
+        telemetry.reset()  # timed window starts here
         for _ in range(steps - 1):
             exe.run(main, feed=feed, fetch_list=[])
         out = exe.run(main, feed=feed, fetch_list=[loss])
-        dt = (time.perf_counter() - t0) / steps
+        with tempfile.TemporaryDirectory(prefix="step_tel_") as d:
+            snap = os.path.join(d, "steps.jsonl")
+            n = telemetry.write_steps_jsonl(snap)
+            with open(snap) as f:
+                recs = [json.loads(line) for line in f if line.strip()]
+        telemetry.reset()
     assert np.isfinite(float(np.ravel(np.asarray(out[0]))[0]))
+    assert len(recs) == n == steps, (
+        "telemetry snapshot has %d records for %d timed steps"
+        % (len(recs), steps))
+    dt = sum(r["wall_s"] for r in recs) / sum(r["steps"] for r in recs)
     return dt, denom
 
 
