@@ -59,6 +59,7 @@ from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import transpiler  # noqa: F401
 from paddle_tpu import flags  # noqa: F401
 from paddle_tpu import debugger  # noqa: F401
+from paddle_tpu import analysis  # noqa: F401
 from paddle_tpu.core import passes  # noqa: F401
 from paddle_tpu.transpiler import memory_optimize, release_memory  # noqa: F401
 from paddle_tpu.transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401

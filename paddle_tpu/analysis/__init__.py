@@ -1,0 +1,58 @@
+"""Static analysis over the Program IR: verify, lint, liveness.
+
+The correctness substrate for every pass that rewrites or compiles a
+``Program`` (the role ``framework/ir`` + the op registry's
+``InferShape``/``VarDesc`` checks play in the C++ reference, and the
+pre-execution dataflow validation TensorFlow ships — Abadi et al., 2016):
+
+* ``analysis.verify`` — structural verifier (def-before-use with
+  parent-block visibility, fetch targets, output clobbers, registry
+  schema/dtype/shape consistency, orphaned gradients, parameter
+  invariants). Runs before lowering (``FLAGS_verify_program``) and after
+  every transpiler; surfaced as ``Program.verify(level=...)``.
+* ``analysis.lint`` — retrace-hazard linter: statically flags the
+  patterns that defeat the PR 1 executable caches (dynamic feed shapes,
+  literal step-varying attrs, nondeterministic unique_name counters,
+  fetch churn), each wired to the PR 2 recompile explainer so a hot
+  recompile loop names the rule that predicted it.
+* ``analysis.liveness`` — per-var live ranges and unreachable ops,
+  reported through the metrics registry and reused by
+  ``memory_optimization_transpiler``.
+
+Findings are structured :class:`Diagnostic` objects (rule id, severity,
+block/op location, vars, fix hint) instead of deep XLA tracebacks;
+``tools/plint.py`` is the CLI and ``docs/ANALYSIS.md`` the rule catalog.
+"""
+
+from paddle_tpu.analysis.diagnostics import (  # noqa: F401
+    Diagnostic,
+    ProgramVerifyError,
+    format_diagnostics,
+)
+# NOTE: the bare pass functions are re-exported under *_program names so
+# the package attributes `analysis.verify` / `analysis.lint` keep naming
+# the submodules (a `from .verify import verify` would shadow them).
+from paddle_tpu.analysis.verify import (  # noqa: F401
+    check_program,
+    verify_after_transpile,
+)
+from paddle_tpu.analysis.verify import verify as verify_program  # noqa: F401
+from paddle_tpu.analysis.lint import lint as lint_program  # noqa: F401
+from paddle_tpu.analysis.lint import lint_events  # noqa: F401
+from paddle_tpu.analysis.liveness import analyze as analyze_liveness  # noqa: F401
+from paddle_tpu.analysis import verify  # noqa: F401
+from paddle_tpu.analysis import lint  # noqa: F401
+from paddle_tpu.analysis import liveness  # noqa: F401
+from paddle_tpu.analysis import diagnostics  # noqa: F401
+
+__all__ = [
+    "Diagnostic",
+    "ProgramVerifyError",
+    "format_diagnostics",
+    "verify_program",
+    "check_program",
+    "verify_after_transpile",
+    "lint_program",
+    "lint_events",
+    "analyze_liveness",
+]

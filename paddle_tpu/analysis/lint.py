@@ -1,0 +1,224 @@
+"""Retrace-hazard linter: flag compile-cache poison before it costs money.
+
+PR 1 made compiles content-addressed and PR 2 made every recompile
+explain itself; this linter closes the loop by flagging the model
+patterns that *predict* those recompiles statically, before the first
+trace. Each rule maps onto an executable-cache-key component, and the
+recompile explainer (observability/explain.py) stamps its events with
+the rule id that predicted the miss — a hot recompile loop in production
+names the lint rule to run down.
+
+Rule catalog (docs/ANALYSIS.md has examples and fixes):
+
+  L001 dynamic-feed-shape       warning  feed var shapes that force a fresh
+                                         XLA compile per distinct shape
+                                         (cache-key component: feed_specs)
+  L002 literal-scalar-attr      warning  Python scalars baked into op attrs
+                                         that typically vary per step —
+                                         literal learning rates instead of
+                                         LR-scheduler vars (component:
+                                         program)
+  L003 nondeterministic-names   warning  unique_name counters that didn't
+                                         start at zero: rebuilding the model
+                                         in another process yields different
+                                         var names, a different fingerprint,
+                                         and a cold persistent cache
+                                         (component: program)
+  L004 fetch-list-churn         warning  fetch sets that vary run-to-run
+                                         recompile per distinct set; only
+                                         observable at runtime, reported
+                                         from recompile-explainer events
+                                         (component: fetch_names)
+
+Entry points: :func:`lint` (static pass over a Program) and
+:func:`lint_events` (turn recent recompile-explainer events into the
+runtime-confirmed diagnostics, L004 included).
+"""
+
+import re
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, filter_diagnostics
+
+__all__ = ["lint", "lint_events", "RULES"]
+
+RULES = {
+    "L001": ("dynamic-feed-shape", "warning"),
+    "L002": ("literal-scalar-attr", "warning"),
+    "L003": ("nondeterministic-names", "warning"),
+    "L004": ("fetch-list-churn", "warning"),
+}
+
+
+def _diag(rule, message, severity=None, **kwargs):
+    name, default_sev = RULES[rule]
+    return Diagnostic(rule, name, severity or default_sev, message,
+                      **kwargs)
+
+
+# -- L001 -------------------------------------------------------------------
+
+def _lint_feed_shapes(program, out):
+    for block in program.blocks:
+        for name in sorted(block.vars):
+            v = block.vars[name]
+            if not getattr(v, "is_data", False):
+                continue
+            shape = v.shape
+            if shape is None:
+                out.append(_diag(
+                    "L001",
+                    "feed var %r has no declared shape: every concrete "
+                    "feed shape compiles a fresh executable" % name,
+                    block_idx=block.idx, var_names=(name,),
+                    hint="declare the shape on layers.data (use -1 only "
+                         "for the batch dim) or pad/bucket the input"))
+                continue
+            dyn = [i for i, d in enumerate(shape) if d < 0]
+            if len(shape) > 1 and len(dyn) == len(shape):
+                out.append(_diag(
+                    "L001",
+                    "feed var %r is fully dynamic %s: each distinct "
+                    "shape pays a fresh XLA compile" % (name, list(shape)),
+                    block_idx=block.idx, var_names=(name,),
+                    hint="fix every non-batch dim; bucket or pad "
+                         "variable-length inputs"))
+            elif any(i != 0 for i in dyn):
+                out.append(_diag(
+                    "L001",
+                    "feed var %r has dynamic non-batch dim(s) %s in "
+                    "shape %s: each distinct length recompiles — the "
+                    "classic retrace loop on variable-length text"
+                    % (name, dyn, list(shape)),
+                    block_idx=block.idx, var_names=(name,),
+                    hint="pad to a fixed length or a small set of "
+                         "bucketed lengths (see docs/LONG_CONTEXT.md)"))
+            elif dyn:
+                out.append(_diag(
+                    "L001",
+                    "feed var %r has a dynamic batch dim: each distinct "
+                    "batch size compiles once (usually fine; keep batch "
+                    "sizes stable)" % name,
+                    severity="info",
+                    block_idx=block.idx, var_names=(name,)))
+
+
+# -- L002 -------------------------------------------------------------------
+
+# Attr names that, holding a literal, typically encode a per-step value.
+_STEP_VARYING_ATTRS = ("learning_rate", "lr", "global_step", "iteration",
+                       "epoch", "step_id")
+
+
+def _lint_literal_attrs(program, out):
+    from paddle_tpu.core import op_registry
+
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            opdef = (op_registry.get_op_def(op.type)
+                     if op_registry.has_op(op.type) else None)
+            if (opdef is not None and "LearningRate" in opdef.input_slots()
+                    and not any(op.input("LearningRate"))):
+                out.append(_diag(
+                    "L002",
+                    "optimizer op %r has no LearningRate input var — a "
+                    "literal rate baked into the program re-fingerprints "
+                    "(and recompiles) on every change" % op.type,
+                    block_idx=block.idx, op_idx=i, op_type=op.type,
+                    hint="feed the rate through a persistable var (the "
+                         "Optimizer classes and layers."
+                         "learning_rate_scheduler do this for you)"))
+            defaults = opdef.attrs if opdef is not None else {}
+            for aname in _STEP_VARYING_ATTRS:
+                val = op.attrs.get(aname)
+                if (isinstance(val, (int, float))
+                        and not isinstance(val, bool)
+                        and val != defaults.get(aname)):
+                    out.append(_diag(
+                        "L002",
+                        "op %r bakes %s=%r as a literal attr: changing "
+                        "it per step changes the program fingerprint "
+                        "and forces a recompile" % (op.type, aname, val),
+                        block_idx=block.idx, op_idx=i, op_type=op.type,
+                        hint="move step-varying scalars into scope vars "
+                             "(persistable [1] tensors) the step "
+                             "function reads"))
+
+
+# -- L003 -------------------------------------------------------------------
+
+_SEG = re.compile(r"^(.*?)_(\d+)$")
+
+
+def _lint_name_determinism(program, out):
+    """unique_name counters bake build ORDER into var names: a model built
+    after other programs in one process gets e.g. fc_17/tmp_203 where a
+    fresh process gets fc_0/tmp_0 — same structure, different fingerprint,
+    so the PR 1 persistent cache cold-starts in every new process. Detect
+    it statically: per counter family (each dot-separated name segment's
+    ``prefix_N``), a minimum suffix above zero means the counters did not
+    start fresh for this program."""
+    families = {}  # family prefix -> (min suffix seen, example var name)
+    for block in program.blocks:
+        for name in block.vars:
+            for seg in re.split(r"[.@]", name):
+                m = _SEG.match(seg)
+                if m and m.group(1):
+                    fam, n = m.group(1), int(m.group(2))
+                    if fam not in families or n < families[fam][0]:
+                        families[fam] = (n, name)
+    shifted = sorted(f for f, (n, _v) in families.items() if n > 0)
+    if shifted:
+        examples = tuple(families[f][1] for f in shifted[:6])
+        out.append(_diag(
+            "L003",
+            "var name counters did not start at zero (%s): names "
+            "depend on what was built earlier in this process, so the "
+            "fingerprint — and the persistent executable cache key — "
+            "differs across processes"
+            % ", ".join("%s starts at %s_%d" % (v, f, families[f][0])
+                        for f, v in zip(shifted[:6], examples)),
+            var_names=examples,
+            hint="build the model inside `with unique_name.guard():` so "
+                 "counters (and fingerprints) are reproducible"))
+
+
+# -- entry points -----------------------------------------------------------
+
+def lint(program, suppress=()):
+    """Static retrace-hazard pass; returns a list of Diagnostics."""
+    out = []
+    _lint_feed_shapes(program, out)
+    _lint_literal_attrs(program, out)
+    _lint_name_determinism(program, out)
+    return filter_diagnostics(out, suppress)
+
+
+def lint_events(events=None, min_count=2, suppress=()):
+    """Runtime confirmation: fold recent recompile-explainer events into
+    lint diagnostics. An event stream where >= ``min_count`` fresh
+    compiles blame the same cache-key component yields one diagnostic
+    carrying the matching rule id — including L004 (fetch-list churn),
+    which has no static signature. Defaults to the live event log."""
+    from paddle_tpu.observability import explain
+
+    if events is None:
+        events = explain.events()
+    by_rule = {}
+    for ev in events:
+        for rule in ev.get("lint_rules") or ():
+            by_rule.setdefault(rule, []).append(ev)
+    out = []
+    for rule in sorted(by_rule):
+        evs = by_rule[rule]
+        if len(evs) < min_count or rule not in RULES:
+            continue
+        components = sorted({c for ev in evs for c in ev["changed"]})
+        out.append(_diag(
+            rule,
+            "%d fresh compiles this process blamed cache-key "
+            "component(s) %s — the retrace hazard this rule predicts "
+            "is live (last detail: %s)"
+            % (len(evs), components, evs[-1].get("detail")),
+            hint="run analysis.lint over the program and fix the "
+                 "flagged pattern; docs/ANALYSIS.md has the catalog"))
+    return filter_diagnostics(out, suppress)

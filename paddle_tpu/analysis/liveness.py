@@ -1,0 +1,144 @@
+"""Dead-code / liveness analysis over Program blocks.
+
+Reference parity: ``transpiler/memory_optimization_transpiler.py:112``
+(ControlFlowGraph) computed per-var liveness to drive buffer reuse during
+the op-by-op interpreter walk. Under whole-program XLA, buffer reuse is
+the compiler's job — but the *analysis* is still the substrate: the
+verifier and linter consume structure, ``memory_optimize`` consumes live
+grad-op counts, and dead ops in a program are wasted trace/compile time
+even when XLA DCEs them later (and wasted interpreter time in the native
+C++ path, which does not).
+
+For every block: per-var live ranges ``(def op idx, last use op idx)``
+and the set of unreachable (dead) ops — ops whose outputs transitively
+never reach a fetch target, persistable state, or another block.
+Results are mirrored into the metrics registry
+(``paddle_tpu_liveness_dead_ops`` / ``_analyses_total``) so a serving
+process's scrape shows whether it is tracing dead weight.
+"""
+
+from paddle_tpu.observability.metrics_registry import REGISTRY
+
+__all__ = ["analyze", "BlockLiveness", "LivenessInfo"]
+
+_analyses_total = REGISTRY.counter(
+    "paddle_tpu_liveness_analyses_total", "liveness passes run")
+_dead_ops_gauge = REGISTRY.gauge(
+    "paddle_tpu_liveness_dead_ops",
+    "dead (unreachable) ops found by the most recent liveness pass")
+
+
+class BlockLiveness(object):
+    """One block's result.
+
+    live_ranges: {var name -> (def_idx, last_use_idx)} — def_idx is the
+      first writing op index (None for block inputs: feeds, params,
+      implicit control-flow bindings); last_use_idx is the last reading
+      op index, or ``n_ops`` when the value escapes the block (fetched,
+      persistable, or consumed by another block).
+    dead_ops: sorted op indices whose outputs never transitively reach an
+      escaping value.
+    """
+
+    def __init__(self, block_idx, n_ops, live_ranges, dead_ops):
+        self.block_idx = block_idx
+        self.n_ops = n_ops
+        self.live_ranges = live_ranges
+        self.dead_ops = sorted(dead_ops)
+        self._dead_set = frozenset(dead_ops)
+
+    def is_dead(self, op_idx):
+        return op_idx in self._dead_set
+
+
+class LivenessInfo(object):
+    def __init__(self, blocks):
+        self.blocks = blocks  # idx -> BlockLiveness
+
+    @property
+    def dead_op_count(self):
+        return sum(len(b.dead_ops) for b in self.blocks.values())
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+
+def _escaping_names(program, block, fetch_names):
+    """Names whose values must survive the block: fetch targets,
+    persistable state (params, optimizer accumulators), and vars read by
+    ops in OTHER blocks (control-flow sub-blocks capture parent vars)."""
+    escaping = set(fetch_names or ())
+    for name, v in block.vars.items():
+        if v.persistable:
+            escaping.add(name)
+    for other in program.blocks:
+        if other.idx == block.idx:
+            continue
+        for op in other.ops:
+            escaping.update(n for n in op.input_arg_names() if n)
+            # owner ops also bind sub-block vars through name-list attrs
+            for val in op.attrs.values():
+                if isinstance(val, str):
+                    escaping.add(val)
+                elif isinstance(val, (list, tuple)):
+                    escaping.update(
+                        x for x in val if isinstance(x, str))
+    return escaping
+
+
+def analyze(program, fetch_names=()):
+    """Compute liveness for every block; returns a :class:`LivenessInfo`.
+
+    ``fetch_names`` anchor the global block's live-out set; persistable
+    writes (optimizer updates, BN stats) always count as live.
+    """
+    blocks = {}
+    for block in program.blocks:
+        n_ops = len(block.ops)
+        escaping = _escaping_names(program, block, fetch_names)
+
+        # Reverse mark-sweep: an op is live iff any of its outputs is
+        # needed (escapes, or feeds a later live op).
+        needed = set(escaping)
+        dead = []
+        for i in range(n_ops - 1, -1, -1):
+            op = block.ops[i]
+            outs = [n for n in op.output_arg_names() if n]
+            live = any(n in needed for n in outs)
+            if not live:
+                for n in outs:
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.persistable:
+                        live = True
+                        break
+            if live:
+                needed.update(n for n in op.input_arg_names() if n)
+            else:
+                dead.append(i)
+
+        # Live ranges from a forward walk.
+        first_def = {}
+        last_use = {}
+        for i, op in enumerate(block.ops):
+            for n in op.input_arg_names():
+                if n:
+                    last_use[n] = i
+            for n in op.output_arg_names():
+                if n and n not in first_def:
+                    first_def[n] = i
+        live_ranges = {}
+        for name in block.vars:
+            d = first_def.get(name)
+            u = last_use.get(name)
+            if name in escaping:
+                u = n_ops
+            if d is None and u is None:
+                continue
+            live_ranges[name] = (d, u)
+        blocks[block.idx] = BlockLiveness(block.idx, n_ops, live_ranges,
+                                          dead)
+
+    info = LivenessInfo(blocks)
+    _analyses_total.inc()
+    _dead_ops_gauge.set(info.dead_op_count)
+    return info

@@ -26,8 +26,24 @@ def _fmt_var(v):
     )
 
 
-def program_to_code(program, skip_op_callstack=True):
-    """Readable text dump of every block (debugger.pprint_program_codes)."""
+def _diag_index(diagnostics):
+    """(block_idx, op_idx) -> [Diagnostic], plus flagged var names."""
+    by_op = {}
+    var_names = set()
+    for d in diagnostics or ():
+        if d.block_idx is not None and d.op_idx is not None:
+            by_op.setdefault((d.block_idx, d.op_idx), []).append(d)
+        var_names.update(d.var_names)
+    return by_op, var_names
+
+
+def program_to_code(program, skip_op_callstack=True, diagnostics=None):
+    """Readable text dump of every block (debugger.pprint_program_codes),
+    op attrs included. With ``diagnostics`` (from ``Program.verify`` /
+    ``analysis.lint``), flagged ops get a ``!`` prefix and a trailing
+    ``!rule`` marker so a dump shows at a glance where the graph is
+    broken."""
+    by_op, _flagged_vars = _diag_index(diagnostics)
     lines = []
     for block in program.blocks:
         lines.append(
@@ -50,17 +66,24 @@ def program_to_code(program, skip_op_callstack=True):
                 if not k.startswith("__") and k not in ("op_role",
                                                         "op_role_var")
             )
-            lines.append(
-                "  [%3d] %s(%s) -> %s  {%s}" % (i, op.type, ins, outs,
-                                                attrs)
-            )
+            flags_here = by_op.get((block.idx, i), ())
+            mark = "!" if flags_here else " "
+            line = " %s[%3d] %s(%s) -> %s  {%s}" % (mark, i, op.type, ins,
+                                                    outs, attrs)
+            if flags_here:
+                line += "  !%s" % ",".join(
+                    sorted({d.rule for d in flags_here}))
+            lines.append(line)
     return "\n".join(lines)
 
 
-def draw_block_graphviz(block, highlights=None, path="/tmp/program.dot"):
+def draw_block_graphviz(block, highlights=None, path="/tmp/program.dot",
+                        diagnostics=None):
     """Emit a graphviz dot file of a block's op/var dataflow
-    (graph_viz_pass.cc / debugger.draw_block_graphviz parity)."""
-    highlights = set(highlights or ())
+    (graph_viz_pass.cc / debugger.draw_block_graphviz parity). Ops and
+    vars named by ``diagnostics`` render red, labeled with the rule ids."""
+    by_op, flagged_vars = _diag_index(diagnostics)
+    highlights = set(highlights or ()) | flagged_vars
     lines = ["digraph G {", "  rankdir=TB;"]
     var_nodes = set()
 
@@ -80,9 +103,16 @@ def draw_block_graphviz(block, highlights=None, path="/tmp/program.dot"):
 
     for i, op in enumerate(block.ops):
         op_id = "op_%d" % i
+        flags_here = by_op.get((block.idx, i), ())
+        if flags_here:
+            label = "%s\\n%s" % (op.type, ",".join(
+                sorted({d.rule for d in flags_here})))
+            fill, border = "#ff9d9d", ', color="#b00020"'
+        else:
+            label, fill, border = op.type, "#d2e3fc", ""
         lines.append(
             '  %s [label="%s", shape=box, style=filled, '
-            'fillcolor="#d2e3fc"];' % (op_id, op.type)
+            'fillcolor="%s"%s];' % (op_id, label, fill, border)
         )
         for name in op.input_arg_names():
             if name:

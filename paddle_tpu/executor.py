@@ -88,6 +88,29 @@ def _as_feed_array(value, place):
     return np.asarray(value), None
 
 
+def _maybe_verify(program, feed_specs, fetch_names, origin):
+    """FLAGS_verify_program gate: run the structural verifier with the
+    concrete feed shapes (resolving deferred shape inference) before a
+    fresh compile. Raises analysis.ProgramVerifyError on error-severity
+    findings; warnings go to the analysis logger."""
+    from paddle_tpu import flags as _flags
+
+    if not _flags.get("verify_program"):
+        return
+    import logging
+
+    from paddle_tpu.analysis import check_program
+
+    diags = check_program(
+        program, level="error", fetch_names=fetch_names,
+        feed_shapes={n: s for n, (s, _d) in feed_specs.items()},
+        origin=origin)
+    if diags:
+        logging.getLogger("paddle_tpu.analysis").info(
+            "verify (%s): %d non-error diagnostic(s): %s", origin,
+            len(diags), "; ".join(str(d) for d in diags[:5]))
+
+
 # On-device finiteness scan for FLAGS_check_nan_inf: one fused executable
 # of lax reductions per value-list structure; only the [n] bool vector
 # crosses to the host, never the checked values.
@@ -175,6 +198,13 @@ class Executor(object):
     # -- compilation cache --------------------------------------------------
     def _get_compiled(self, program, feed_specs, fetch_names, scope,
                       refresh=False):
+        # Deferred shape inference must resolve BEFORE the fingerprint is
+        # taken: filling shapes afterwards would change the content hash
+        # and bust this very cache on the next run. No-op unless the
+        # program still carries deferrals (reader pipelines).
+        if getattr(program, "_deferred_infer", None):
+            program.infer_deferred_shapes(
+                feed_shapes={n: s for n, (s, _d) in feed_specs.items()})
         scope_names = self._scope_names(scope)
         device = self.place.jax_device()
         key = (
@@ -208,6 +238,12 @@ class Executor(object):
             if cp is None:
                 exec_cache.record_trace_miss()
                 exec_cache.configure()
+                # FLAGS_verify_program: structural verification on the
+                # fresh-compile path only (never per step) — a bad graph
+                # fails here with rule-tagged diagnostics instead of an
+                # eval_shape traceback inside CompiledProgram
+                _maybe_verify(program, feed_specs, fetch_names,
+                              origin="Executor.run")
                 # one structured "why did this retrace" event per fresh
                 # compile, diffed against the nearest cached key
                 _explain.record_compile({
@@ -512,6 +548,10 @@ class Executor(object):
                 v.name if isinstance(v, framework.Variable) else str(v)
                 for v in fetch_list
             ]
+            if getattr(program, "_deferred_infer", None):
+                program.infer_deferred_shapes(
+                    feed_shapes={n: s
+                                 for n, (s, _d) in feed_specs.items()})
             scope_names = self._scope_names(scope)
             key_id = (
                 "multi", program_fingerprint(program), int(steps),
@@ -524,6 +564,8 @@ class Executor(object):
             if cp is None:
                 exec_cache.record_trace_miss()
                 exec_cache.configure()
+                _maybe_verify(program, feed_specs, fetch_names,
+                              origin="Executor.run_multi_step")
                 _explain.record_compile({
                     "program": key_id[1],
                     "feed_specs": tuple(sorted(

@@ -72,6 +72,12 @@ _DEFS = {
     # chip table); set explicitly on hardware the table doesn't know
     # (or to make CPU-proxy MFU numbers comparable run-to-run)
     "peak_tflops": (0.0, float),
+    # run the structural program verifier (analysis/verify.py) before
+    # every fresh compile in Executor.run/run_multi_step, at Predictor
+    # load, and after every transpiler: malformed graphs fail with
+    # structured diagnostics instead of XLA tracebacks. Opt-in — the
+    # verifier walk is O(ops) per fresh compile, never per step.
+    "verify_program": (False, bool),
     # route the transformer's label-smoothed CE head through the fused
     # single-pass op (ops/loss_ops.py fused_label_smooth_ce): bf16
     # logits with f32-accumulated reductions, hand-written one-pass

@@ -270,8 +270,13 @@ class Block(object):
                 _infer_op_shapes(self, op)
             except Exception:
                 # Shape inference is best-effort at build time; execution
-                # re-derives exact shapes from concrete feeds.
-                pass
+                # re-derives exact shapes from concrete feeds. Record the
+                # deferral so infer_deferred_shapes can retry once feed
+                # shapes are known (reader pipelines declare shapes late)
+                # instead of leaving Variable.shape=None forever.
+                self.program._defer_shape_inference(self.idx, op)
+        else:
+            self.program._defer_shape_inference(self.idx, op)
         for name in op.output_arg_names():
             v = self.vars.get(name)
             if v is not None and v.op is None:
@@ -285,7 +290,7 @@ class Block(object):
         try:
             _infer_op_shapes(self, op)
         except Exception:
-            pass
+            self.program._defer_shape_inference(self.idx, op)
         self.program._bump_version()
         return op
 
@@ -325,6 +330,9 @@ class Program(object):
         self._amp_dtype = None
         self._op_role = OpRole.Forward
         self._op_role_var = []
+        # (block idx, op) pairs whose build-time shape inference was
+        # skipped or failed; infer_deferred_shapes retries them.
+        self._deferred_infer = []
 
     # -- structure ----------------------------------------------------------
     def global_block(self):
@@ -352,6 +360,73 @@ class Program(object):
 
     def _bump_version(self):
         self._version += 1
+
+    def _defer_shape_inference(self, block_idx, op):
+        # getattr: Programs deserialized from old pickles predate the slot
+        if not hasattr(self, "_deferred_infer"):
+            self._deferred_infer = []
+        self._deferred_infer.append((block_idx, op))
+
+    def infer_deferred_shapes(self, feed_shapes=None):
+        """Retry shape inference for ops deferred at append time.
+
+        ``append_op(infer_shape=False)`` and build-time inference
+        failures (inputs whose shapes were unknown when the op was
+        appended — reader pipelines, decoupled graph surgery) leave
+        ``Variable.shape=None``. Once feed shapes are known, this re-runs
+        the registry inference in append order: ``feed_shapes`` maps var
+        name -> shape for data vars still missing one. Ops that succeed
+        leave the deferred list; returns ``[(block_idx, op, error)]`` for
+        those that still fail (the verifier turns these into V011
+        diagnostics instead of letting them crash the XLA trace)."""
+        pending = getattr(self, "_deferred_infer", None)
+        if not pending:
+            return []
+        # Memoized per (version, feed shapes): ops that keep failing must
+        # not re-run eval_shape on every Executor.run of a steady-state
+        # program — only when the graph or the feed signature changes.
+        memo_key = (self._version, tuple(sorted(
+            (n, tuple(int(d) for d in s))
+            for n, s in (feed_shapes or {}).items())))
+        memo = getattr(self, "_deferred_infer_memo", None)
+        if memo is not None and memo[0] == memo_key:
+            return memo[1]
+        for name, shape in (feed_shapes or {}).items():
+            v = self.global_block()._find_var_recursive(name)
+            if v is not None and v.shape is None:
+                v.shape = tuple(int(d) for d in shape)
+                self._bump_version()
+        failures, remaining, resolved = [], [], False
+        for block_idx, op in pending:
+            block = self.blocks[block_idx] if block_idx < len(
+                self.blocks) else None
+            if block is None or not any(o is op for o in block.ops):
+                continue  # op was pruned/removed since the deferral
+            try:
+                _infer_op_shapes(block, op)
+                resolved = True
+            except Exception as e:
+                failures.append((block_idx, op, str(e)))
+                remaining.append((block_idx, op))
+        self._deferred_infer = remaining
+        if resolved:
+            self._bump_version()
+        self._deferred_infer_memo = (
+            (self._version, memo_key[1]), failures)
+        return failures
+
+    def verify(self, level="error", fetch_names=None, feed_shapes=None,
+               feed_names=None, suppress=()):
+        """Run the structural verifier (analysis/verify.py) over this
+        program. Raises ``analysis.ProgramVerifyError`` when any
+        diagnostic sits at or above ``level`` (pass level=None to only
+        collect); returns the full diagnostics list otherwise."""
+        from paddle_tpu.analysis import check_program
+
+        return check_program(
+            self, level=level, fetch_names=fetch_names,
+            feed_shapes=feed_shapes, feed_names=feed_names,
+            suppress=suppress)
 
     def _next_rng_id(self):
         self._rng_counter += 1

@@ -107,6 +107,17 @@ class Predictor(object):
                 # passes may return a rebuilt program: re-resolve fetches
                 gb = self._program.global_block()
                 self._fetch_vars = [gb.vars[n] for n in fetch_names]
+        if _shared is None and fluid.flags.get("verify_program"):
+            # verify at load (and after the pass pipeline ran), so a
+            # corrupted model dir or a pass bug fails here with
+            # rule-tagged diagnostics, not inside the first request;
+            # Clone() shares an already-verified program
+            from paddle_tpu.analysis import check_program
+
+            check_program(
+                self._program, level="error",
+                fetch_names=[v.name for v in self._fetch_vars],
+                origin="Predictor load")
         place = fluid.TPUPlace() if config.use_tpu else fluid.CPUPlace()
         self._exe = fluid.Executor(place)
         self._lock = threading.Lock()

@@ -24,7 +24,8 @@ import time
 
 from paddle_tpu.observability.metrics_registry import REGISTRY
 
-__all__ = ["record_compile", "events", "reset", "COMPONENTS"]
+__all__ = ["record_compile", "events", "reset", "COMPONENTS",
+           "COMPONENT_LINT_RULES"]
 
 logger = logging.getLogger("paddle_tpu.observability.explain")
 
@@ -32,6 +33,20 @@ logger = logging.getLogger("paddle_tpu.observability.explain")
 # differ vs. the nearest entry, all are reported, first is the headline.
 COMPONENTS = ("program", "feed_specs", "fetch_names", "scope_signature",
               "flags", "device", "mode")
+
+# Blamed component -> the retrace-hazard lint rule(s) (analysis/lint.py)
+# that statically predict that kind of miss. Events carry the ids so a
+# hot recompile loop in a log names the rule to run the linter for:
+#   feed_specs   churn <- L001 dynamic-feed-shape
+#   program      churn <- L002 literal-scalar-attr (attr literals re-baked
+#                 per step) / L003 nondeterministic-names (fingerprint
+#                 drifts with unique_name counters)
+#   fetch_names  churn <- L004 fetch-list-churn
+COMPONENT_LINT_RULES = {
+    "feed_specs": ("L001",),
+    "program": ("L002", "L003"),
+    "fetch_names": ("L004",),
+}
 
 _MAX_EVENTS = 512
 # Bounded diff window: nearest-entry search is O(len) under the lock on
@@ -131,11 +146,15 @@ def record_compile(components, forced=False):
             detail = {"cache_evicted":
                       "key matches a prior compile; the in-memory entry "
                       "was evicted or purged"}
+    lint_rules = [r for c in changed
+                  for r in COMPONENT_LINT_RULES.get(c, ())]
     event = {
         "event": "fresh_compile",
         "ts": now,
         "changed": changed,
         "detail": detail,
+        "lint_rules": lint_rules,
+        "lint_rule": lint_rules[0] if lint_rules else None,
         "program_fingerprint": str(comp.get("program"))[:16],
         "mode": comp.get("mode"),
         "device": comp.get("device"),

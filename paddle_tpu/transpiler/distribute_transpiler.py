@@ -163,6 +163,10 @@ class DistributeTranspiler(object):
         """The trainer keeps the whole graph: under the mesh, GSPMD inserts
         the gradient collectives the reference's send/recv ops performed."""
         assert self._transpiled, "call transpile() first"
+        from paddle_tpu.analysis import verify_after_transpile
+
+        verify_after_transpile(self.origin_program,
+                               "DistributeTranspiler.get_trainer_program")
         return self.origin_program
 
     def build_sharding_policy(self, mesh, state_shapes=None,

@@ -91,6 +91,9 @@ class GradientMergeTranspiler(object):
         self._reset_accumulators(block)
         program._gradient_merge_k = k_steps
         program._bump_version()
+        from paddle_tpu.analysis import verify_after_transpile
+
+        verify_after_transpile(program, "GradientMergeTranspiler")
         return program
 
     # -- pieces -------------------------------------------------------------

@@ -68,6 +68,9 @@ class InferenceTranspiler(object):
             self._fold(block, scope, op, bias_op, nxt, j)
             i += 1
         program._bump_version()
+        from paddle_tpu.analysis import verify_after_transpile
+
+        verify_after_transpile(program, "InferenceTranspiler")
         return program
 
     @staticmethod

@@ -10,6 +10,11 @@ marking the program so every synthesized grad op recomputes its forward
 values inside ``jax.checkpoint`` instead of letting XLA keep activations
 live from the forward pass — trading FLOPs for peak HBM exactly like the
 reference trades copies for reuse.
+
+The liveness substrate itself now lives in ``analysis/liveness.py`` (the
+ControlFlowGraph role, shared with the verifier and the metrics
+registry); this transpiler consumes it instead of re-scanning the op
+list, so the remat count excludes grad ops that are dead anyway.
 """
 
 from paddle_tpu import framework
@@ -23,20 +28,29 @@ def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
 
     skip_opt_set: var names whose producing ops must NOT be rematerialized
     (kept for API parity; matching grad ops keep stored activations).
-    Returns the number of grad ops that will rematerialize."""
+    Returns the number of live grad ops that will rematerialize."""
+    from paddle_tpu.analysis import liveness as _liveness
+
     program = input_program or framework.default_main_program()
     program._remat = True
     program._remat_skip = set(skip_opt_set or ())
-    count = sum(
-        1
-        for block in program.blocks
-        for op in block.ops
-        if op.type.endswith("_grad")
-    )
+    info = _liveness.analyze(program)
+    count = 0
+    dead_grad = 0
+    for block in program.blocks:
+        bl = info.block(block.idx)
+        for i, op in enumerate(block.ops):
+            if not op.type.endswith("_grad"):
+                continue
+            if bl.is_dead(i):
+                dead_grad += 1
+            else:
+                count += 1
     if print_log:
         print(
             "memory_optimize: %d grad ops set to rematerialize "
-            "(jax.checkpoint)" % count
+            "(jax.checkpoint); %d dead grad ops excluded"
+            % (count, dead_grad)
         )
     program._bump_version()
     return count

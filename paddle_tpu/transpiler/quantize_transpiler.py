@@ -74,6 +74,9 @@ class QuantizeTranspiler(object):
         for block in program.blocks:  # sub-blocks (while/cond bodies) too
             self._transpile_block(block, startup_program, params)
         program._bump_version()
+        from paddle_tpu.analysis import verify_after_transpile
+
+        verify_after_transpile(program, "QuantizeTranspiler.training_transpile")
         return program
 
     def _transpile_block(self, block, startup_program, params):

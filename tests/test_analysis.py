@@ -1,0 +1,572 @@
+"""Golden diagnostics for the static-analysis subsystem (PR 3).
+
+Each verifier rule and each lint rule gets a minimal bad Program that
+must trigger it (asserting the rule id and location) and a clean twin
+that must not; every registry model verifies clean at level="error"; and
+a deliberately cache-busting program trips the retrace-hazard linter AND
+the recompile explainer stamps the same rule id on its event.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis, unique_name
+from paddle_tpu.analysis import ProgramVerifyError
+from paddle_tpu.analysis import lint as lint_mod
+from paddle_tpu.analysis import liveness as liveness_mod
+from paddle_tpu.analysis import verify as verify_mod
+from paddle_tpu.framework import Operator, Parameter
+from paddle_tpu.observability import explain
+
+
+def _rules(diags):
+    return sorted({d.rule for d in diags})
+
+
+def _empty_prog():
+    return fluid.Program()
+
+
+def _simple_chain():
+    """a (data) -> relu -> t -> relu -> out; verifies clean."""
+    prog = fluid.Program()
+    b = prog.global_block()
+    b.create_var(name="a", shape=(2, 3), dtype="float32", is_data=True)
+    b.create_var(name="t", shape=(2, 3), dtype="float32")
+    b.create_var(name="out", shape=(2, 3), dtype="float32")
+    b.append_op("relu", inputs={"X": ["a"]}, outputs={"Out": ["t"]})
+    b.append_op("relu", inputs={"X": ["t"]}, outputs={"Out": ["out"]})
+    return prog
+
+
+def _verify_all(prog, **kw):
+    """Collect every diagnostic without raising."""
+    return verify_mod.verify(prog, **kw)
+
+
+# ---------------------------------------------------------------------------
+# verifier rules: one bad program + one clean twin each
+# ---------------------------------------------------------------------------
+
+
+class TestVerifierRules(object):
+    def test_clean_program_has_no_diagnostics(self):
+        assert _verify_all(_simple_chain(), fetch_names=["out"]) == []
+
+    def test_v001_undefined_input(self):
+        prog = _simple_chain()
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["nope"]}, outputs={"Out": ["t"]},
+            infer_shape=False)
+        with pytest.raises(ProgramVerifyError) as ei:
+            prog.verify()
+        d = [x for x in ei.value.diagnostics if x.rule == "V001"][0]
+        assert d.block_idx == 0 and d.op_idx == 2
+        assert "nope" in d.var_names
+
+    def test_v002_use_before_write(self):
+        prog = fluid.Program()
+        b = prog.global_block()
+        b.create_var(name="x", shape=(2,), dtype="float32")
+        b.create_var(name="y", shape=(2,), dtype="float32")
+        # reads x before the fill that produces it
+        b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]},
+                    infer_shape=False)
+        b.append_op("fill_constant", outputs={"Out": ["x"]},
+                    attrs={"shape": [2], "dtype": "float32", "value": 1.0})
+        diags = _verify_all(prog)
+        d = [x for x in diags if x.rule == "V002"][0]
+        assert d.op_idx == 0 and "x" in d.var_names
+        # clean twin: producer first
+        prog2 = fluid.Program()
+        b2 = prog2.global_block()
+        b2.create_var(name="x", shape=(2,), dtype="float32")
+        b2.create_var(name="y", shape=(2,), dtype="float32")
+        b2.append_op("fill_constant", outputs={"Out": ["x"]},
+                     attrs={"shape": [2], "dtype": "float32", "value": 1.0})
+        b2.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+        assert "V002" not in _rules(_verify_all(prog2))
+
+    def test_v002_feed_names_count_as_written(self):
+        prog = fluid.Program()
+        b = prog.global_block()
+        # not marked is_data (a pserver-style runtime feed)
+        b.create_var(name="g", shape=(2,), dtype="float32")
+        b.create_var(name="o", shape=(2,), dtype="float32")
+        b.append_op("relu", inputs={"X": ["g"]}, outputs={"Out": ["o"]})
+        assert "V002" in _rules(_verify_all(prog))
+        assert "V002" not in _rules(_verify_all(prog, feed_names=["g"]))
+
+    def test_v003_dangling_and_unwritten_fetch(self):
+        prog = _simple_chain()
+        prog.global_block().create_var(
+            name="never_written", shape=(1,), dtype="float32")
+        diags = _verify_all(
+            prog, fetch_names=["missing", "never_written", "out"])
+        v3 = [d for d in diags if d.rule == "V003"]
+        assert sorted(n for d in v3 for n in d.var_names) == [
+            "missing", "never_written"]
+
+    def test_v004_duplicate_output(self):
+        prog = _simple_chain()
+        prog.global_block().append_op(
+            "dropout", inputs={"X": ["t"]},
+            outputs={"Out": ["o2"], "Mask": ["o2"]}, infer_shape=False)
+        prog.global_block().create_var(
+            name="o2", shape=(2, 3), dtype="float32")
+        diags = _verify_all(prog)
+        d = [x for x in diags if x.rule == "V004"][0]
+        assert d.op_type == "dropout" and "o2" in d.var_names
+
+    def test_v005_overwritten_before_read(self):
+        prog = fluid.Program()
+        b = prog.global_block()
+        b.create_var(name="a", shape=(2,), dtype="float32", is_data=True)
+        b.create_var(name="t", shape=(2,), dtype="float32")
+        b.append_op("relu", inputs={"X": ["a"]}, outputs={"Out": ["t"]})
+        b.append_op("sigmoid", inputs={"X": ["a"]}, outputs={"Out": ["t"]})
+        diags = _verify_all(prog)
+        assert "V005" in _rules(diags)
+        # clean twin: the first write is read before the second write
+        prog2 = fluid.Program()
+        b2 = prog2.global_block()
+        b2.create_var(name="a", shape=(2,), dtype="float32", is_data=True)
+        b2.create_var(name="t", shape=(2,), dtype="float32")
+        b2.create_var(name="u", shape=(2,), dtype="float32")
+        b2.append_op("relu", inputs={"X": ["a"]}, outputs={"Out": ["t"]})
+        b2.append_op("relu", inputs={"X": ["t"]}, outputs={"Out": ["u"]})
+        b2.append_op("sigmoid", inputs={"X": ["a"]},
+                     outputs={"Out": ["t"]})
+        assert "V005" not in _rules(_verify_all(prog2))
+
+    def test_v006_unknown_op(self):
+        prog = _simple_chain()
+        b = prog.global_block()
+        op = Operator.__new__(Operator)  # the deserialization path
+        op.block, op.type = b, "no_such_op"
+        op.inputs, op.outputs, op.attrs = {}, {}, {}
+        b.ops.append(op)
+        diags = _verify_all(prog)
+        d = [x for x in diags if x.rule == "V006"][0]
+        assert d.op_idx == 2 and d.op_type == "no_such_op"
+
+    def test_v007_unknown_slot(self):
+        prog = _simple_chain()
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["t"], "Bogus": ["a"]},
+            outputs={"Out": ["out"]}, infer_shape=False)
+        diags = _verify_all(prog)
+        assert any(d.rule == "V007" and "Bogus" in d.message
+                   for d in diags)
+
+    def test_v008_slot_arity(self):
+        prog = _simple_chain()
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["a", "t"]}, outputs={"Out": ["out"]},
+            infer_shape=False)
+        diags = _verify_all(prog)
+        assert any(d.rule == "V008" and d.op_idx == 2 for d in diags)
+
+    def test_v009_bad_dtype(self):
+        prog = _simple_chain()
+        prog.global_block().vars["t"].dtype = "float37"
+        diags = _verify_all(prog)
+        assert any(d.rule == "V009" and "t" in d.var_names for d in diags)
+
+    def test_v010_v011_unknown_shape(self):
+        prog = fluid.Program()
+        b = prog.global_block()
+        b.create_var(name="u", shape=None, dtype="float32", is_data=True)
+        b.create_var(name="o", shape=None, dtype="float32")
+        b.append_op("relu", inputs={"X": ["u"]}, outputs={"Out": ["o"]})
+        b.append_op("sigmoid", inputs={"X": ["o"]}, outputs={"Out": ["o"]},
+                    infer_shape=False)
+        diags = _verify_all(prog)
+        assert "V010" in _rules(diags) and "V011" in _rules(diags)
+        # feed shapes resolve the deferral -> clean
+        diags = _verify_all(prog, feed_shapes={"u": (2, 3)})
+        assert "V010" not in _rules(diags)
+        assert "V011" not in _rules(diags)
+        assert b.vars["o"].shape == (2, 3)
+
+    def test_v012_orphaned_grad(self):
+        prog = _simple_chain()
+        prog.global_block().create_var(
+            name="w@GRAD", shape=(2,), dtype="float32")
+        diags = _verify_all(prog)
+        assert any(d.rule == "V012" and "w@GRAD" in d.var_names
+                   for d in diags)
+
+    def test_v013_param_not_persistable(self):
+        prog = _simple_chain()
+        p = prog.global_block().create_parameter(
+            "w", shape=[2], dtype="float32")
+        p.persistable = False
+        diags = _verify_all(prog)
+        assert any(d.rule == "V013" for d in diags)
+
+    def test_v014_v015_subblock_invariants(self):
+        prog = _simple_chain()
+        sub = prog.create_block()
+        prog.rollback()
+        p = Parameter(sub, "sub_w", (2,), "float32")
+        sub.vars["sub_w"] = p
+        sub.create_var(name="sub_state", shape=(2,), dtype="float32",
+                       persistable=True)
+        diags = _verify_all(prog)
+        assert any(d.rule == "V014" and d.block_idx == 1 for d in diags)
+        assert any(d.rule == "V015" and "sub_state" in d.var_names
+                   for d in diags)
+
+    def test_v016_bad_sub_block(self):
+        prog = _simple_chain()
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["t"]}, outputs={"Out": ["out"]},
+            attrs={"sub_block": 99}, infer_shape=False)
+        diags = _verify_all(prog)
+        assert any(d.rule == "V016" and d.op_idx == 2 for d in diags)
+
+    def test_suppress_and_level_gate(self):
+        prog = _simple_chain()
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["nope"]}, outputs={"Out": ["t"]},
+            infer_shape=False)
+        assert "V001" not in _rules(
+            verify_mod.verify(prog, suppress=("V001",)))
+        assert "V001" not in _rules(
+            verify_mod.verify(prog, suppress=("undefined-input",)))
+        # level=None collects without raising
+        diags = prog.verify(level=None)
+        assert "V001" in _rules(diags)
+
+    def test_control_flow_models_verify_clean(self):
+        """StaticRNN sub-block implicit inputs (rnn_step_in / rnn_mem are
+        written by the scan machinery, not by ops) must not trip V002."""
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data("x", shape=[4, 8], dtype="float32")
+            from paddle_tpu.layers.control_flow import StaticRNN
+
+            rnn = StaticRNN()
+            with rnn.step():
+                x_t = rnn.step_input(x)
+                h_prev = rnn.memory(shape=[-1, 8], batch_ref=x)
+                h = fluid.layers.elementwise_add(x_t, h_prev)
+                rnn.update_memory(h_prev, h)
+                rnn.step_output(h)
+            out = rnn()
+        diags = main.verify(level="error", fetch_names=[out.name])
+        assert "V002" not in _rules(diags)
+        assert "V001" not in _rules(diags)
+
+
+# ---------------------------------------------------------------------------
+# lint rules
+# ---------------------------------------------------------------------------
+
+
+class TestLintRules(object):
+    def test_l001_dynamic_feed_shapes(self):
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            fluid.layers.data("ids", shape=[-1], dtype="int64")  # (-1,-1)
+            fluid.layers.data("img", shape=[3, 8, 8], dtype="float32")
+        diags = lint_mod.lint(prog)
+        dyn = [d for d in diags if d.rule == "L001"
+               and d.severity == "warning"]
+        assert any("ids" in d.var_names for d in dyn)
+        # static-feed program only gets the info-level batch-dim note
+        assert not any("img" in d.var_names and d.severity == "warning"
+                       for d in diags)
+
+    def test_l002_literal_scalar_attrs(self):
+        prog = fluid.Program()
+        b = prog.global_block()
+        b.create_var(name="p", shape=(2,), dtype="float32",
+                     persistable=True)
+        b.create_var(name="g", shape=(2,), dtype="float32", is_data=True)
+        # hand-rolled sgd with no LearningRate var and a baked literal
+        b.append_op("sgd", inputs={"Param": ["p"], "Grad": ["g"]},
+                    outputs={"ParamOut": ["p"]},
+                    attrs={"learning_rate": 0.1}, infer_shape=False)
+        diags = lint_mod.lint(prog)
+        assert [d for d in diags if d.rule == "L002"]
+        # the Optimizer classes route the rate through a var: clean
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.fc(input=x, size=3)
+            loss = fluid.layers.reduce_mean(y)
+            fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+        assert "L002" not in _rules(lint_mod.lint(main))
+
+    def test_l003_nondeterministic_names(self):
+        with unique_name.guard():
+            for _ in range(5):
+                unique_name.generate("fc")  # simulate earlier builds
+            prog = fluid.Program()
+            with fluid.program_guard(prog, fluid.Program()):
+                x = fluid.layers.data("x", shape=[4], dtype="float32")
+                fluid.layers.fc(input=x, size=3)
+        diags = [d for d in lint_mod.lint(prog) if d.rule == "L003"]
+        assert diags and "unique_name.guard" in diags[0].hint
+        # fresh counters: clean
+        with unique_name.guard():
+            prog2 = fluid.Program()
+            with fluid.program_guard(prog2, fluid.Program()):
+                x = fluid.layers.data("x", shape=[4], dtype="float32")
+                fluid.layers.fc(input=x, size=3)
+        assert "L003" not in _rules(lint_mod.lint(prog2))
+
+    def test_l004_fetch_churn_from_events(self):
+        explain.reset()
+        base = {"program": "f" * 64, "feed_specs": (), "scope_signature":
+                frozenset(), "flags": (), "device": "cpu:0",
+                "mode": "single"}
+        explain.record_compile(dict(base, fetch_names=("a",)))
+        explain.record_compile(dict(base, fetch_names=("b",)))
+        explain.record_compile(dict(base, fetch_names=("c",)))
+        evs = explain.events()
+        assert evs[-1]["changed"] == ["fetch_names"]
+        assert evs[-1]["lint_rule"] == "L004"
+        diags = lint_mod.lint_events(min_count=2)
+        assert [d for d in diags if d.rule == "L004"]
+        explain.reset()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: cache-busting program -> linter AND explainer agree
+# ---------------------------------------------------------------------------
+
+
+def test_cache_busting_program_trips_linter_and_explainer():
+    explain.reset()
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4, -1], dtype="float32")
+        out = fluid.layers.relu(x)
+    # static lint predicts the retrace hazard
+    diags = [d for d in lint_mod.lint(prog)
+             if d.rule == "L001" and d.severity == "warning"]
+    assert diags and "x" in diags[0].var_names
+    # ... and running with churning shapes produces explainer events
+    # naming the SAME rule id
+    exe = fluid.Executor(fluid.CPUPlace())
+    for width in (3, 5):
+        exe.run(prog,
+                feed={"x": np.zeros((2, 4, width), dtype="float32")},
+                fetch_list=[out])
+    evs = explain.events()
+    assert len(evs) >= 2
+    assert "feed_specs" in evs[-1]["changed"]
+    assert evs[-1]["lint_rule"] == "L001"
+    assert [d for d in lint_mod.lint_events(min_count=1)
+            if d.rule == "L001"]
+    explain.reset()
+
+
+# ---------------------------------------------------------------------------
+# every registry model verifies clean at level="error"
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(__import__(
+    "golden_models").GOLDEN_MODELS))
+def test_golden_models_verify_clean(name):
+    from golden_models import GOLDEN_MODELS
+
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feed_names, fetch, _feed = GOLDEN_MODELS[name]()
+    fetch_name = fetch.name if hasattr(fetch, "name") else str(fetch)
+    # raises ProgramVerifyError on any error-severity diagnostic
+    main.verify(level="error", fetch_names=[fetch_name],
+                feed_names=list(feed_names))
+    startup.verify(level="error")
+
+
+# ---------------------------------------------------------------------------
+# liveness
+# ---------------------------------------------------------------------------
+
+
+class TestLiveness(object):
+    def test_dead_op_detection_and_ranges(self):
+        prog = _simple_chain()
+        b = prog.global_block()
+        b.create_var(name="orphan", shape=(2, 3), dtype="float32")
+        b.append_op("relu", inputs={"X": ["a"]},
+                    outputs={"Out": ["orphan"]})
+        info = liveness_mod.analyze(prog, fetch_names=["out"])
+        bl = info.block(0)
+        assert bl.dead_ops == [2]
+        assert info.dead_op_count == 1
+        # a: block input (def None), read by ops 0 and 2
+        assert bl.live_ranges["a"] == (None, 2)
+        # t: defined by op 0, last read by op 1
+        assert bl.live_ranges["t"] == (0, 1)
+        # out: escapes (fetched) -> last_use == n_ops
+        assert bl.live_ranges["out"] == (1, bl.n_ops)
+
+    def test_persistable_writes_are_live(self):
+        prog = fluid.Program()
+        b = prog.global_block()
+        b.create_var(name="state", shape=(2,), dtype="float32",
+                     persistable=True)
+        b.append_op("fill_constant", outputs={"Out": ["state"]},
+                    attrs={"shape": [2], "dtype": "float32", "value": 0.0})
+        info = liveness_mod.analyze(prog)
+        assert info.block(0).dead_ops == []
+
+    def test_memory_optimize_counts_live_grad_ops(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.fc(input=x, size=3)
+            loss = fluid.layers.reduce_mean(y)
+            fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+        n = fluid.memory_optimize(main)
+        assert n > 0
+        assert main._remat is True
+
+
+# ---------------------------------------------------------------------------
+# deferred shape inference (satellite) + executor/flag integration
+# ---------------------------------------------------------------------------
+
+
+class TestDeferredShapes(object):
+    def test_infer_shape_false_is_deferred_then_resolved(self):
+        prog = fluid.Program()
+        b = prog.global_block()
+        b.create_var(name="u", shape=None, dtype="float32", is_data=True)
+        b.create_var(name="o", shape=None, dtype="float32")
+        b.append_op("relu", inputs={"X": ["u"]}, outputs={"Out": ["o"]},
+                    infer_shape=False)
+        assert b.vars["o"].shape is None
+        failures = prog.infer_deferred_shapes(feed_shapes={"u": (2, 5)})
+        assert failures == []
+        assert b.vars["o"].shape == (2, 5)
+        assert prog._deferred_infer == []
+
+    def test_executor_resolves_deferred_shapes_from_feeds(self):
+        prog = fluid.Program()
+        b = prog.global_block()
+        b.create_var(name="u", shape=None, dtype="float32", is_data=True)
+        b.create_var(name="o", shape=None, dtype="float32")
+        b.append_op("relu", inputs={"X": ["u"]}, outputs={"Out": ["o"]},
+                    infer_shape=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        x = np.array([[-1.0, 2.0]], dtype="float32")
+        (res,) = exe.run(prog, feed={"u": x}, fetch_list=["o"])
+        np.testing.assert_allclose(res, np.maximum(x, 0.0))
+        assert b.vars["o"].shape == (1, 2)
+
+    def test_verify_flag_gates_executor(self):
+        prog = fluid.Program()
+        b = prog.global_block()
+        b.create_var(name="a", shape=(2,), dtype="float32", is_data=True)
+        b.create_var(name="o", shape=(2,), dtype="float32")
+        b.append_op("relu", inputs={"X": ["missing_input"]},
+                    outputs={"Out": ["o"]}, infer_shape=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        fluid.flags.set_flag("verify_program", True)
+        try:
+            with pytest.raises(ProgramVerifyError):
+                exe.run(prog, feed={"a": np.zeros(2, "float32")},
+                        fetch_list=["o"])
+        finally:
+            fluid.flags.set_flag("verify_program", False)
+
+    def test_transpiler_hook_verifies_output(self):
+        from paddle_tpu.transpiler import GradientMergeTranspiler
+
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.fc(input=x, size=3)
+            loss = fluid.layers.reduce_mean(y)
+            fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+        fluid.flags.set_flag("verify_program", True)
+        try:
+            # a healthy transpile passes the post-transpile verifier
+            GradientMergeTranspiler().transpile(main, k_steps=2)
+        finally:
+            fluid.flags.set_flag("verify_program", False)
+
+
+# ---------------------------------------------------------------------------
+# debugger rendering of diagnostics
+# ---------------------------------------------------------------------------
+
+
+class TestDebuggerRendering(object):
+    def _flagged(self):
+        prog = _simple_chain()
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["nope"]}, outputs={"Out": ["t"]},
+            infer_shape=False)
+        return prog, prog.verify(level=None)
+
+    def test_program_to_code_marks_flagged_ops(self):
+        from paddle_tpu import debugger
+
+        prog, diags = self._flagged()
+        code = debugger.program_to_code(prog, diagnostics=diags)
+        flagged = [ln for ln in code.splitlines() if ln.startswith(" !")]
+        assert flagged and "V001" in flagged[0]
+        # attrs are part of the dump
+        assert "{" in flagged[0]
+        clean = debugger.program_to_code(prog)
+        assert not [ln for ln in clean.splitlines()
+                    if ln.startswith(" !")]
+
+    def test_graphviz_colors_diagnostics_red(self, tmp_path):
+        from paddle_tpu import debugger
+
+        prog, diags = self._flagged()
+        dot = debugger.draw_block_graphviz(
+            prog.global_block(), path=str(tmp_path / "g.dot"),
+            diagnostics=diags)
+        assert "#ff9d9d" in dot and "V001" in dot
+        dot_clean = debugger.draw_block_graphviz(
+            prog.global_block(), path=str(tmp_path / "g2.dot"))
+        assert "#ff9d9d" not in dot_clean
+
+
+# ---------------------------------------------------------------------------
+# plint CLI
+# ---------------------------------------------------------------------------
+
+
+def test_plint_cli_over_saved_model(tmp_path):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import plint
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(
+        str(tmp_path / "model"), ["x"], [y], exe, main_program=main)
+    assert plint.main([str(tmp_path / "model")]) == 0
+    # corrupt the saved graph: dangling input -> nonzero exit
+    from paddle_tpu.core.program_bin import (
+        deserialize_program,
+        serialize_program,
+    )
+
+    with open(str(tmp_path / "model" / "__model__"), "rb") as f:
+        prog = deserialize_program(f.read())
+    prog.global_block().ops[0].inputs["X"] = ["gone"]
+    with open(str(tmp_path / "model" / "__model__"), "wb") as f:
+        f.write(serialize_program(prog))
+    assert plint.main([str(tmp_path / "model")]) == 1

@@ -1,0 +1,133 @@
+"""plint: verify + lint saved (or golden) Programs from the command line.
+
+The CLI front-end of paddle_tpu/analysis/ — the role the reference's
+C++ validation played at graph-load time, usable offline:
+
+    python tools/plint.py path/to/model_dir            # dir with __model__
+    python tools/plint.py path/to/program.ptpb         # raw PTPB binary
+    python tools/plint.py --goldens                    # all registry models
+    python tools/plint.py --golden transformer         # one registry model
+    python tools/plint.py model_dir --fail-on=warning  # stricter gate
+
+Prints every diagnostic (rule id, severity, location, fix hint) and
+exits nonzero when any finding sits at/above ``--fail-on`` (default
+"error" — what CI's `tools/run_ci.sh lint` stage enforces over the
+golden models). ``--dump`` additionally prints the annotated
+program_to_code listing with verifier-flagged ops marked ``!``.
+"""
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load_saved(path):
+    """A model dir (containing __model__) or a raw PTPB file -> Program
+    plus feed/fetch names when the save recorded them."""
+    from paddle_tpu.core.program_bin import deserialize_program
+
+    model_file = path
+    if os.path.isdir(path):
+        model_file = os.path.join(path, "__model__")
+    with open(model_file, "rb") as f:
+        program = deserialize_program(f.read())
+    feed_names = [
+        v.name for v in program.global_block().vars.values()
+        if getattr(v, "is_data", False)
+    ]
+    return program, feed_names, None
+
+
+def _build_golden(name):
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "tests"))
+    from golden_models import GOLDEN_MODELS
+
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+
+    unique_name.switch()  # deterministic names, as tools/make_goldens.py
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feed_names, fetch, _feed = GOLDEN_MODELS[name]()
+    fetch_name = fetch.name if hasattr(fetch, "name") else str(fetch)
+    return main, list(feed_names), [fetch_name]
+
+
+def _run_one(label, program, feed_names, fetch_names, args):
+    import paddle_tpu.analysis.diagnostics as diag_mod
+    import paddle_tpu.analysis.lint as lint_mod
+    import paddle_tpu.analysis.verify as verify_mod
+
+    diags = verify_mod.verify(
+        program, fetch_names=fetch_names, feed_names=feed_names,
+        suppress=args.suppress)
+    if not args.no_lint:
+        diags += lint_mod.lint(program, suppress=args.suppress)
+    print(diag_mod.format_diagnostics(
+        diags, header="== %s ==" % label))
+    if args.dump:
+        from paddle_tpu import debugger
+
+        print(debugger.program_to_code(program, diagnostics=diags))
+    failing = diag_mod.at_or_above(diags, args.fail_on)
+    return len(failing)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="plint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*",
+                        help="model dirs (with __model__) or .ptpb files")
+    parser.add_argument("--goldens", action="store_true",
+                        help="lint every tests/golden_models.py model")
+    parser.add_argument("--golden", action="append", default=[],
+                        help="lint one registry model by name (repeatable)")
+    parser.add_argument("--fail-on", default="error",
+                        choices=("info", "warning", "error"),
+                        help="exit nonzero when any finding is at/above "
+                             "this severity (default: error)")
+    parser.add_argument("--suppress", action="append", default=[],
+                        help="rule id or name to ignore (repeatable)")
+    parser.add_argument("--no-lint", action="store_true",
+                        help="verifier only, skip retrace-hazard lint")
+    parser.add_argument("--dump", action="store_true",
+                        help="print the annotated program listing")
+    args = parser.parse_args(argv)
+
+    targets = []
+    for p in args.paths:
+        targets.append(("load", p))
+    if args.goldens:
+        sys.path.insert(0, os.path.join(_REPO_ROOT, "tests"))
+        from golden_models import GOLDEN_MODELS
+
+        targets.extend(("golden", n) for n in sorted(GOLDEN_MODELS))
+    targets.extend(("golden", n) for n in args.golden)
+    if not targets:
+        parser.error("nothing to lint: pass paths, --goldens or --golden")
+
+    failing = 0
+    for kind, name in targets:
+        if kind == "load":
+            program, feed_names, fetch_names = _load_saved(name)
+        else:
+            program, feed_names, fetch_names = _build_golden(name)
+        failing += _run_one(name, program, feed_names, fetch_names, args)
+    if failing:
+        print("plint: %d finding(s) at/above --fail-on=%s"
+              % (failing, args.fail_on))
+        return 1
+    print("plint: clean at --fail-on=%s (%d target(s))"
+          % (args.fail_on, len(targets)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,13 @@ MODULES = [
     "paddle_tpu.framework",
     "paddle_tpu.executor",
     "paddle_tpu.core.lod",
+    # PR 3: the static-analysis surface (verifier / linter / liveness)
+    "paddle_tpu.analysis",
+    "paddle_tpu.analysis.diagnostics",
+    "paddle_tpu.analysis.verify",
+    "paddle_tpu.analysis.lint",
+    "paddle_tpu.analysis.liveness",
+    "paddle_tpu.debugger",
 ]
 
 

@@ -7,11 +7,12 @@
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
+#   tools/run_ci.sh lint                 # verifier+linter over goldens
 #   BENCH_PLATFORM= tools/run_ci.sh bench   # on a TPU host: real-chip bench
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-ALL_STAGES=(native python warm metrics dryrun bench)
+ALL_STAGES=(native python lint warm metrics dryrun bench)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -51,6 +52,14 @@ if want python; then
   # CPU-only stages must not depend on tunnel health
   XLA_FLAGS="$merged" env -u PALLAS_AXON_POOL_IPS \
     python -m pytest tests/ -q
+fi
+
+if want lint; then
+  echo "== program verifier + retrace-hazard lint (golden models) =="
+  # every registry model must verify structurally clean; warnings print
+  # but only error-severity findings (bad graphs) fail the stage
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/plint.py --goldens --fail-on=error
 fi
 
 if want warm; then
