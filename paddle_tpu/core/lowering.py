@@ -238,11 +238,16 @@ class _LazyExecutable(object):
 
                     from paddle_tpu import profiler
                     from paddle_tpu.core import exec_cache
+                    from paddle_tpu.observability import watchdog
 
                     t0 = _time.perf_counter()
-                    fn = exec_cache.prepare_executable(
-                        self.jitted, args, self._exec_cache_key
-                    )
+                    # a fresh compile can legitimately run minutes while
+                    # the watchdog's step-derived timeout is seconds —
+                    # slow-but-alive host work must not read as a hang
+                    with watchdog.suspend():
+                        fn = exec_cache.prepare_executable(
+                            self.jitted, args, self._exec_cache_key
+                        )
                     # first-call resolution (AOT deserialize or lower+
                     # compile+serialize) in the unified trace; the inner
                     # backend compile appears as its own span via the

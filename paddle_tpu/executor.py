@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from paddle_tpu import framework
 from paddle_tpu import profiler as _profiler
 from paddle_tpu.core import exec_cache
+from paddle_tpu.observability import blackbox as _blackbox
 from paddle_tpu.observability import explain as _explain
 from paddle_tpu.observability import telemetry as _telemetry
 from paddle_tpu.core.fingerprint import (
@@ -165,24 +166,28 @@ class FetchHandle(object):
 
     def result(self):
         if self._numpy is None:
-            if self._nan_check is not None:
-                # disarm only AFTER a clean pass: a caller that catches
-                # the NaN error and retries must get the error again,
-                # not the bad values
-                self._nan_check()
-                self._nan_check = None
-            track = self._track
-            if track is not None:
-                # split device-ready from host-transfer for the trace:
-                # block first (marks "ready"), then materialize
-                self.block_until_ready()
-                _profiler.async_fetch_ready(track)
-            self._numpy = [np.asarray(a) for a in self._arrays]
-            if track is not None:
-                _profiler.async_fetch_end(track)
-            if self._t_dispatch is not None:
-                _telemetry.record_fetch_materialize(
-                    time.perf_counter() - self._t_dispatch)
+            # a fetch that never materializes is the canonical silent
+            # hang (wedged tunnel, dead peer): the guard arms the
+            # watchdog so a stall here is named in the black box
+            with _blackbox.guard("FetchHandle.result"):
+                if self._nan_check is not None:
+                    # disarm only AFTER a clean pass: a caller that catches
+                    # the NaN error and retries must get the error again,
+                    # not the bad values
+                    self._nan_check()
+                    self._nan_check = None
+                track = self._track
+                if track is not None:
+                    # split device-ready from host-transfer for the trace:
+                    # block first (marks "ready"), then materialize
+                    self.block_until_ready()
+                    _profiler.async_fetch_ready(track)
+                self._numpy = [np.asarray(a) for a in self._arrays]
+                if track is not None:
+                    _profiler.async_fetch_end(track)
+                if self._t_dispatch is not None:
+                    _telemetry.record_fetch_materialize(
+                        time.perf_counter() - self._t_dispatch)
         return self._numpy
 
 
@@ -429,8 +434,55 @@ class Executor(object):
         if finish is not None:
             finish()
 
+    @staticmethod
+    def _nan_snapshot(cp, state):
+        """Pre-step snapshot for the NaN-provenance replay: the step is
+        pure, so (state, feeds, key) reproduce it exactly — but dispatch
+        DONATES the mutable state buffers, so those are copied on device
+        first (frozen state and feeds survive by reference). None unless
+        both FLAGS_check_nan_inf and FLAGS_nan_provenance are on."""
+        from paddle_tpu import flags as _flags
+
+        if not (_flags.get("check_nan_inf")
+                and _flags.get("nan_provenance")):
+            return None
+        snap = {n: state[n] for n in cp.frozen_state}
+        for n in cp.mutable_state:
+            v = state[n]
+            snap[n] = jnp.array(v, copy=True) if isinstance(
+                v, jax.Array) else v
+        return snap
+
+    @staticmethod
+    def _nan_blame(exc, program, snapshot, feeds, key, device, steps=1,
+                   mutable_state=(), multi=False):
+        """The scanner tripped: replay from the snapshot and raise the
+        enriched NonFiniteError naming the first bad op; without a
+        snapshot (provenance off) the plain scanner error passes
+        through. ``multi`` routes through the scan-body replay (per-step
+        fold_in keys) even for steps == 1."""
+        if snapshot is None:
+            raise exc
+        from paddle_tpu.observability import nan_provenance as _nanprov
+
+        _nanprov.enrich_and_raise(
+            exc, program, snapshot, feeds, key, steps=steps,
+            mutable_state=mutable_state, is_test=program._is_test,
+            platform=getattr(device, "platform", None), multi=multi)
+
     def _run_on_device(self, program, feed, fetch_list, scope, device,
                        return_numpy, as_handle=False, refresh_cache=False):
+        # forensics shell: the watchdog sees one armed unit of blocking
+        # work; any escaping exception lands in the black box before it
+        # propagates
+        with _blackbox.guard("Executor.run"):
+            return self._run_on_device_impl(
+                program, feed, fetch_list, scope, device, return_numpy,
+                as_handle=as_handle, refresh_cache=refresh_cache)
+
+    def _run_on_device_impl(self, program, feed, fetch_list, scope, device,
+                            return_numpy, as_handle=False,
+                            refresh_cache=False):
         # flight-recorder guards: one module-bool load each; both False
         # leaves the hot path identical to the uninstrumented executor
         telem = _telemetry.ENABLED
@@ -452,6 +504,14 @@ class Executor(object):
                        if telem else None)
         flops_avals = (_telemetry.capture_step_avals(cp, state, feeds, key)
                        if telem else None)
+        if _blackbox.ENABLED:
+            # the event a crash dump's last entry points at: what was
+            # about to run, with the shapes that ran it
+            _blackbox.record_dispatch(
+                "Executor.run_async" if as_handle else "Executor.run",
+                feed_specs=feed_specs, fetch_names=fetch_names,
+                fingerprint=getattr(cp, "_exec_cache_key", None))
+        nan_snapshot = self._nan_snapshot(cp, state)
         new_state, fetches = cp(state, feeds, key)
         for n, val in new_state.items():
             scope.set_value(n, val)
@@ -459,11 +519,20 @@ class Executor(object):
             # dispatch complete, nothing synced: the (optional) nan/inf
             # reductions are already in flight on device, but reading
             # their verdict waits for .result()
+            raw_check = self._nan_check_start(
+                new_state, cp.fetch_names, fetches)
+            if raw_check is not None and nan_snapshot is not None:
+                def nan_check(_raw=raw_check):
+                    try:
+                        _raw()
+                    except RuntimeError as e:
+                        Executor._nan_blame(e, program, nan_snapshot,
+                                            feeds, key, device)
+            else:
+                nan_check = raw_check
             handle = FetchHandle(
                 fetches, cp.fetch_names,
-                nan_check=self._nan_check_start(
-                    new_state, cp.fetch_names, fetches
-                ),
+                nan_check=nan_check,
                 track=_profiler.async_fetch_begin(cp.fetch_names)
                 if prof else None,
                 t_dispatch=t0 if telem else None,
@@ -486,7 +555,10 @@ class Executor(object):
                 if prof:
                     _profiler.record_span("executor.dispatch", t0, t1)
             return handle
-        self._check_nan_inf(new_state, cp.fetch_names, fetches)
+        try:
+            self._check_nan_inf(new_state, cp.fetch_names, fetches)
+        except RuntimeError as e:
+            self._nan_blame(e, program, nan_snapshot, feeds, key, device)
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         if telem or prof:
@@ -599,12 +671,28 @@ class Executor(object):
                            if telem else None)
             flops_avals = (_telemetry.capture_step_avals(
                 cp, state, feeds, key) if telem else None)
-            new_state, fetches = cp(state, feeds, key)
-            for n, val in new_state.items():
-                scope.set_value(n, val)
-            self._check_nan_inf(new_state, cp.fetch_names, fetches)
-            if return_numpy:
-                fetches = [np.asarray(f) for f in fetches]
+            if _blackbox.ENABLED:
+                _blackbox.record_dispatch(
+                    "Executor.run_multi_step", feed_specs=feed_specs,
+                    fetch_names=fetch_names, steps=int(steps),
+                    fingerprint=getattr(cp, "_exec_cache_key", None))
+            nan_snapshot = self._nan_snapshot(cp, state)
+            # scale: one dispatch legitimately blocks ~K× the per-step
+            # p95 the watchdog's auto timeout is derived from
+            with _blackbox.guard("Executor.run_multi_step",
+                                 scale=int(steps)):
+                new_state, fetches = cp(state, feeds, key)
+                for n, val in new_state.items():
+                    scope.set_value(n, val)
+                try:
+                    self._check_nan_inf(new_state, cp.fetch_names, fetches)
+                except RuntimeError as e:
+                    self._nan_blame(e, program, nan_snapshot, feeds, key,
+                                    device, steps=int(steps),
+                                    mutable_state=cp.mutable_state,
+                                    multi=True)
+                if return_numpy:
+                    fetches = [np.asarray(f) for f in fetches]
             if telem or prof:
                 t1 = time.perf_counter()
                 if telem:

@@ -78,6 +78,34 @@ _DEFS = {
     # structured diagnostics instead of XLA tracebacks. Opt-in — the
     # verifier walk is O(ops) per fresh compile, never per step.
     "verify_program": (False, bool),
+    # crash black box (observability/blackbox.py): the post-mortem role of
+    # the reference's FLAGS_call_stack_level + glog FATAL dumps — a JSON
+    # file of the recent flight events (dispatches, recompiles, exceptions,
+    # flag snapshot) written on unhandled executor/Predictor exceptions,
+    # fatal signals (SIGTERM/SIGABRT), the watchdog, or blackbox.dump();
+    # empty disables the recorder (zero hot-path overhead)
+    "blackbox_path": ("", str),
+    # hang watchdog (observability/watchdog.py): start the background
+    # progress monitor at import — the ExceptionHolder-promptness role
+    # (framework/details/exception_holder.h) for hangs XLA never surfaces
+    # (a stuck collective, a wedged fetch). Opt-in; watchdog.start() is
+    # the programmatic switch.
+    "watchdog": (False, bool),
+    # seconds without executor/fetch progress before the watchdog declares
+    # a hang (dumps thread stacks + black box); 0 = auto — a multiple of
+    # telemetry's p95 step time when available, else 300s
+    "watchdog_timeout": (0.0, float),
+    # after a declared hang: dump, then abort the process (os.abort) so a
+    # supervisor restarts it instead of burning TPU-hours wedged — the
+    # fail-fast discipline of the reference's PADDLE_ENFORCE FATALs
+    "watchdog_abort": (False, bool),
+    # NaN provenance (observability/nan_provenance.py): when the
+    # FLAGS_check_nan_inf on-device scan trips, replay the step per-op
+    # from a pre-step state snapshot and blame the FIRST op whose output
+    # is non-finite (operator.cc:754's per-op check, paid only after a
+    # trip instead of every step). Costs one device-side copy of the
+    # mutable state per step while check_nan_inf is on.
+    "nan_provenance": (True, bool),
     # route the transformer's label-smoothed CE head through the fused
     # single-pass op (ops/loss_ops.py fused_label_smooth_ce): bf16
     # logits with f32-accumulated reductions, hand-written one-pass

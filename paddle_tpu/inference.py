@@ -16,6 +16,7 @@ import time
 
 import numpy as np
 
+from paddle_tpu.observability import blackbox as _blackbox
 from paddle_tpu.observability import telemetry as _telemetry
 from paddle_tpu.observability.metrics_registry import REGISTRY as _REGISTRY
 
@@ -145,15 +146,20 @@ class Predictor(object):
         # captured once: enable() flipping mid-request must not pair an
         # unset t0 with a taken exit branch
         telem = _telemetry.ENABLED
-        t0 = time.perf_counter() if telem else 0.0
-        with self._lock:  # executor cache mutation is not thread-safe
-            # Scope passed explicitly: the scope_guard stack is a process
-            # global, unsafe when several predictors serve concurrently.
-            outs = self._exe.run(
-                self._program, feed=inputs, fetch_list=self._fetch_vars,
-                scope=self._scope,
-            )
-        outs = [np.asarray(o) for o in outs]
+        # arm=False: the inner Executor.run already arms the watchdog;
+        # this layer only adds the serving origin to a crash's event
+        # ring (the dump itself is written once per exception object)
+        with _blackbox.guard("Predictor.run", arm=False):
+            t0 = time.perf_counter() if telem else 0.0
+            with self._lock:  # executor cache mutation is not thread-safe
+                # Scope passed explicitly: the scope_guard stack is a
+                # process global, unsafe when several predictors serve
+                # concurrently.
+                outs = self._exe.run(
+                    self._program, feed=inputs,
+                    fetch_list=self._fetch_vars, scope=self._scope,
+                )
+            outs = [np.asarray(o) for o in outs]
         if telem:
             _requests_total.inc(api="run")
             _request_seconds.observe(time.perf_counter() - t0, api="run")
@@ -168,11 +174,12 @@ class Predictor(object):
         inputs = self._as_feed_dict(inputs)
         telem = _telemetry.ENABLED
         t0 = time.perf_counter() if telem else 0.0
-        with self._lock:
-            handle = self._exe.run_async(
-                self._program, feed=inputs, fetch_list=self._fetch_vars,
-                scope=self._scope,
-            )
+        with _blackbox.guard("Predictor.run_async", arm=False):
+            with self._lock:
+                handle = self._exe.run_async(
+                    self._program, feed=inputs,
+                    fetch_list=self._fetch_vars, scope=self._scope,
+                )
         if telem:
             _requests_total.inc(api="run_async")
             _request_seconds.observe(time.perf_counter() - t0,

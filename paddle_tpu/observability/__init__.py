@@ -21,13 +21,34 @@ framework self-describing in production instead:
   structured event naming which cache-key component changed vs. the
   nearest cached entry, so "why did it retrace" is one log line.
 
+The failure-forensics layer (this PR) covers the moments the healthy-path
+recorder can't:
+
+* ``blackbox`` — a bounded ring of flight events (dispatches with feed
+  specs/fetch lists, exceptions, notes) dumped as one JSON file on
+  unhandled executor/Predictor exceptions, fatal signals, watchdog
+  hangs, or demand (``FLAGS_blackbox_path``;
+  ``tools/blackbox_dump.py`` pretty-prints it).
+* ``watchdog`` — opt-in background hang detector: no executor/fetch
+  progress within ``FLAGS_watchdog_timeout`` (default: a multiple of
+  telemetry's p95 step time) dumps all thread stacks + the black box,
+  then optionally aborts (``FLAGS_watchdog_abort``).
+* ``nan_provenance`` — when ``FLAGS_check_nan_inf``'s on-device scan
+  trips, the step is replayed per-op from a pre-step snapshot and the
+  FIRST op with a non-finite output is blamed as an
+  ``analysis.diagnostics.Diagnostic`` (rule N001) with a fix hint.
+
 ``docs/OBSERVABILITY.md`` is the operator's guide (metric catalog, how
-to read the explainer, loading the merged trace in perfetto).
+to read the explainer, loading the merged trace in perfetto, failure
+forensics).
 """
 
+from paddle_tpu.observability import blackbox  # noqa: F401
 from paddle_tpu.observability import explain  # noqa: F401
 from paddle_tpu.observability import metrics_registry  # noqa: F401
+from paddle_tpu.observability import nan_provenance  # noqa: F401
 from paddle_tpu.observability import telemetry  # noqa: F401
+from paddle_tpu.observability import watchdog  # noqa: F401
 from paddle_tpu.observability.metrics_registry import REGISTRY  # noqa: F401
 
 

@@ -33,7 +33,9 @@ __all__ = [
     "remove_step_callback", "StepTimer", "record_fetch_materialize",
     "flush", "estimate_flops", "device_memory_bytes", "peak_flops",
     "executable_fingerprint", "capture_step_avals",
-    "register_flops_from_avals",
+    "register_flops_from_avals", "record_device_steps",
+    "record_device_transfer", "record_pipeline_occupancy",
+    "device_step_times", "device_label",
 ]
 
 ENABLED = False
@@ -72,7 +74,31 @@ _fetch_materialize = REGISTRY.histogram(
     "paddle_tpu_fetch_materialize_seconds",
     "async-fetch dispatch-to-numpy latency", buckets=_FETCH_BUCKETS)
 _device_mem = REGISTRY.gauge(
-    "paddle_tpu_device_bytes_in_use", "device memory in use (bytes)")
+    "paddle_tpu_device_bytes_in_use",
+    "device memory in use, summed over all local devices (bytes)")
+# -- per-device series (the multichip incident-response surface): one
+# labeled series per local device, plus a straggler ratio. All written
+# only from the telemetry-guarded paths — zero cost with the flag off.
+_device_mem_per = REGISTRY.gauge(
+    "paddle_tpu_device_bytes_in_use_per_device",
+    "device memory in use, one series per local device (bytes)",
+    labels=("device",))
+_device_step_seconds = REGISTRY.gauge(
+    "paddle_tpu_device_step_seconds",
+    "last dispatch->shard-ready latency per device (seconds)",
+    labels=("device",))
+_device_transfer = REGISTRY.counter(
+    "paddle_tpu_device_transfer_bytes_total",
+    "feed bytes landed per device (addressable shard sizes)",
+    labels=("device",))
+_straggler = REGISTRY.gauge(
+    "paddle_tpu_device_step_imbalance",
+    "straggler ratio: max/median per-device step time of the last "
+    "recorded parallel step (1.0 = perfectly balanced)")
+_stage_occupancy = REGISTRY.gauge(
+    "paddle_tpu_pipeline_stage_occupancy",
+    "fraction of schedule ticks each pipeline stage does useful work "
+    "(M/(M+S-1) for a GPipe schedule)", labels=("stage",))
 
 
 def enable(on=True):
@@ -174,22 +200,112 @@ def remove_step_callback(fn):
             _callbacks.remove(fn)
 
 
-def device_memory_bytes():
-    """Bytes in use on the first local device, or None when the backend
+def device_label(d):
+    """THE stable per-device metric label ('tpu:3', 'cpu:0'), matching
+    the explainer's device component. Single definition — mesh.py
+    re-exports it — so per-device series from telemetry, transfer and
+    mesh metrics always join on the same key."""
+    return "%s:%d" % (d.platform, d.id)
+
+
+_device_label = device_label
+
+
+def device_memory_bytes(per_device=False):
+    """Bytes in use summed over ALL local devices (the old behavior
+    sampled only device 0 — on a multichip mesh that under-reported by
+    the device count and hid per-chip OOM pressure). ``per_device=True``
+    returns a {label: bytes} dict instead. None / {} when the backend
     does not report (CPU, older runtimes)."""
+    out = {}
     try:
         import jax
 
-        stats = jax.local_devices()[0].memory_stats()
-        if stats:
-            return int(stats.get("bytes_in_use", 0)) or None
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats:
+                out[_device_label(d)] = int(stats.get("bytes_in_use", 0))
     except Exception:
         pass
-    return None
+    if per_device:
+        return out
+    return sum(out.values()) or None
+
+
+def device_step_times(arrays, t_dispatch):
+    """Per-device dispatch->ready latency of one parallel step.
+
+    Walks the first fetched/state array that has addressable shards and
+    blocks on each device's shard in turn, recording the elapsed time at
+    which it became ready. A healthy mesh returns near-identical times;
+    a straggling chip shows up as the max. (Sequential blocking means a
+    device that finished earlier than the one before it reads as that
+    earlier wall — the MAX and the imbalance ratio are exact, the
+    per-device floor is an upper bound. Good enough to NAME the
+    straggler, which is the incident-response question.)"""
+    import jax
+
+    times = {}
+    for a in arrays:
+        if not isinstance(a, jax.Array):
+            continue
+        try:
+            shards = a.addressable_shards
+        except Exception:
+            continue
+        if len(shards) < 2:
+            continue
+        for sh in shards:
+            label = _device_label(sh.device)
+            if label not in times:
+                sh.data.block_until_ready()
+                times[label] = time.perf_counter() - t_dispatch
+        if times:
+            break
+    return times
+
+
+def record_device_steps(times):
+    """File one parallel step's per-device ready times (seconds) into
+    the labeled gauge, and refresh the straggler ratio (max/median)."""
+    if not times:
+        return None
+    for label, t in times.items():
+        _device_step_seconds.set(t, device=label)
+    vals = sorted(times.values())
+    mid = len(vals) // 2
+    median = vals[mid] if len(vals) % 2 else (vals[mid - 1] + vals[mid]) / 2.0
+    ratio = (vals[-1] / median) if median > 0 else 1.0
+    _straggler.set(ratio)
+    return ratio
+
+
+def record_device_transfer(bytes_by_device):
+    """Count feed bytes against the device that received them
+    (``{label: bytes}`` — how much of the host->device transfer each
+    chip actually took, the lens that catches a feed pipeline sending a
+    replicated tensor it meant to shard)."""
+    for label, b in (bytes_by_device or {}).items():
+        if b:
+            _device_transfer.inc(int(b), device=label)
+
+
+def record_pipeline_occupancy(n_stages, n_micro):
+    """GPipe schedule occupancy: each stage does useful work on M of the
+    M+S-1 ticks. One labeled series per stage so dashboards show the
+    bubble fraction next to the per-device series."""
+    n_stages, n_micro = int(n_stages), int(n_micro)
+    if n_stages <= 0 or n_micro <= 0:
+        return None
+    occ = float(n_micro) / float(n_micro + n_stages - 1)
+    for s in range(n_stages):
+        _stage_occupancy.set(occ, stage="%d" % s)
+    return occ
 
 
 def record_step(executor, wall_s, steps=1, feed_bytes=0, fetch_bytes=0,
-                h2d_seconds=0.0, fingerprint=None, dispatch_only=False):
+                h2d_seconds=0.0, fingerprint=None, dispatch_only=False,
+                device_times=None):
     """One executed dispatch: ``steps`` program steps in ``wall_s``
     seconds (run_multi_step dispatches K at once). ``dispatch_only``
     marks async dispatches whose wall time is host latency, NOT step
@@ -212,10 +328,15 @@ def record_step(executor, wall_s, steps=1, feed_bytes=0, fetch_bytes=0,
         "fingerprint": fingerprint,
         "dispatch_only": bool(dispatch_only),
     }
-    mem = device_memory_bytes()
-    if mem is not None:
-        rec["device_bytes_in_use"] = mem
-        _device_mem.set(mem)
+    if device_times:
+        rec["device_times"] = {k: float(v) for k, v in device_times.items()}
+        record_device_steps(device_times)
+    mem_per = device_memory_bytes(per_device=True)
+    if mem_per:
+        for label, b in mem_per.items():
+            _device_mem_per.set(b, device=label)
+        rec["device_bytes_in_use"] = sum(mem_per.values())
+        _device_mem.set(rec["device_bytes_in_use"])
     with _lock:
         _records.append(rec)
         callbacks = list(_callbacks)

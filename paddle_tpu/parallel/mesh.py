@@ -41,7 +41,54 @@ def build_mesh(num_devices=None, data=None, model=1, pipe=1, devices=None):
     if data is None:
         data = n // (model * pipe)
     arr = np.asarray(devices).reshape(data, model, pipe)
-    return Mesh(arr, ("data", "model", "pipe"))
+    mesh = Mesh(arr, ("data", "model", "pipe"))
+    record_mesh(mesh)
+    return mesh
+
+
+# one label definition process-wide: per-device series from mesh,
+# telemetry and transfer metrics must join on the same key
+from paddle_tpu.observability.telemetry import device_label  # noqa: E402
+
+
+def mesh_device_labels(mesh):
+    """Labels of every device in the mesh, flat, mesh order."""
+    return [device_label(d) for d in mesh.devices.flat]
+
+
+def record_mesh(mesh):
+    """One gauge series per mesh axis (size), plus the device count —
+    the topology half of the per-device observability story. Always on:
+    the cost is one gauge write per mesh CONSTRUCTION, never per step."""
+    from paddle_tpu.observability.metrics_registry import REGISTRY
+
+    g = REGISTRY.gauge(
+        "paddle_tpu_mesh_axis_size",
+        "mesh axis sizes of the most recent build_mesh", labels=("axis",))
+    for axis, size in mesh.shape.items():
+        g.set(int(size), axis=str(axis))
+    REGISTRY.gauge(
+        "paddle_tpu_mesh_devices",
+        "total devices in the most recent build_mesh",
+    ).set(int(np.prod(list(mesh.shape.values()))))
+    return mesh
+
+
+def mesh_memory_by_device(mesh):
+    """{device label: bytes_in_use} over the mesh's ADDRESSABLE devices
+    ({} when the backend doesn't report, e.g. CPU). The per-chip OOM
+    lens: a single device trending away from its peers is the canary."""
+    out = {}
+    for d in mesh.devices.flat:
+        if getattr(d, "process_index", 0) != jax.process_index():
+            continue
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[device_label(d)] = int(stats.get("bytes_in_use", 0))
+    return out
 
 
 def init_distributed(coordinator_address=None, num_processes=None,

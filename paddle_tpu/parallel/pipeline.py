@@ -165,6 +165,11 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name="pipe",
         in_specs=(param_specs, x_spec),
         out_specs=out_spec,
     )
+    from paddle_tpu.observability import telemetry as _telemetry
+
+    if _telemetry.ENABLED:
+        # bubble fraction of this schedule: M useful ticks of M+S-1
+        _telemetry.record_pipeline_occupancy(n, x.shape[0])
     stacked = fn(stage_params, x)  # [S*M, B, ...], last block is real
     m = x.shape[0]
     return stacked[(n - 1) * m:]

@@ -231,7 +231,33 @@ class PipelinedProgram(object):
         self._build_segments(fwd_ops, set(feed_specs))
         self._classify_optimizer(opt_ops, lrsched_ops, block)
         self.layouts, self.row_len = _pack_layout(self.segments, block)
+        self._record_stage_metrics()
         self._build_step(feed_specs)
+
+    def _record_stage_metrics(self):
+        """Per-stage balance + occupancy gauges, one series per stage.
+        Recorded once per BUILD (never per step): an imbalanced cut —
+        one stage holding most of the ops/params — is the pipeline's
+        straggler, visible here before a single tick runs."""
+        from paddle_tpu.observability import telemetry
+        from paddle_tpu.observability.metrics_registry import REGISTRY
+
+        telemetry.record_pipeline_occupancy(self.n_stages, self.n_micro)
+        ops_g = REGISTRY.gauge(
+            "paddle_tpu_pipeline_stage_ops",
+            "forward ops per pipeline stage (cut balance)",
+            labels=("stage",))
+        bytes_g = REGISTRY.gauge(
+            "paddle_tpu_pipeline_stage_param_bytes",
+            "packed parameter bytes per pipeline stage",
+            labels=("stage",))
+        for s, seg in enumerate(self.segments):
+            ops_g.set(len(seg.ops), stage="%d" % s)
+            bytes_g.set(
+                sum(_var_bytes(self.block._find_var_recursive(n))
+                    for n in seg.param_names
+                    if self.block._find_var_recursive(n) is not None),
+                stage="%d" % s)
 
     # -- analysis ----------------------------------------------------------
     @staticmethod

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from paddle_tpu import framework
 from paddle_tpu import profiler as _profiler
 from paddle_tpu.core import exec_cache
+from paddle_tpu.observability import blackbox as _blackbox
 from paddle_tpu.observability import explain as _explain
 from paddle_tpu.observability import telemetry as _telemetry
 from paddle_tpu.core.fingerprint import (
@@ -277,6 +278,15 @@ class ParallelExecutor(object):
         return cp
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        # forensics shell (same contract as Executor.run): armed for the
+        # watchdog — a multichip step that never returns is THE hang this
+        # layer exists for — and any escaping exception lands in the
+        # black box with this origin before propagating
+        with _blackbox.guard("ParallelExecutor.run"):
+            return self._run_impl(fetch_list, feed, feed_dict, return_numpy)
+
+    def _run_impl(self, fetch_list, feed=None, feed_dict=None,
+                  return_numpy=True):
         telem = _telemetry.ENABLED
         prof = _profiler.enabled()
         t0 = time.perf_counter() if (telem or prof) else 0.0
@@ -284,6 +294,10 @@ class ParallelExecutor(object):
         if self._pipeline_stages:
             fetches = self._run_pipeline(fetch_list, feed, return_numpy)
             if telem:
+                # per-stage occupancy: the bubble fraction of the GPipe
+                # schedule, one labeled series per stage
+                _telemetry.record_pipeline_occupancy(
+                    self._pipeline_stages, self._pipeline_micro)
                 _telemetry.record_step(
                     "pipeline", time.perf_counter() - t0,
                     fingerprint=program_fingerprint(self._program))
@@ -361,9 +375,28 @@ class ParallelExecutor(object):
                 cp, self._program)
             flops_avals = _telemetry.capture_step_avals(
                 cp, state, feeds, key)
+            _telemetry.record_device_transfer(
+                self._feed_bytes_by_device(cp, feeds))
+        if _blackbox.ENABLED:
+            _blackbox.record_dispatch(
+                "ParallelExecutor.run", feed_specs=feed_specs,
+                fetch_names=fetch_names,
+                fingerprint=getattr(cp, "_exec_cache_key", None),
+                mesh=dict(self.mesh.shape))
+        t_disp = time.perf_counter() if telem else 0.0
         new_state, fetches = cp(state, feeds, key)
         for n, val in new_state.items():
             self._scope.set_value(n, val)
+        device_times = None
+        if telem and return_numpy:
+            # per-device dispatch->ready latency, measured on the live
+            # global arrays BEFORE any host materialization — the
+            # straggler/imbalance signal. Only on the return_numpy path,
+            # which syncs anyway: blocking per-shard under
+            # return_numpy=False would turn an async dispatch into a
+            # full per-step device sync and distort the thing measured
+            device_times = _telemetry.device_step_times(
+                list(fetches) + list(new_state.values()), t_disp)
         if return_numpy:
             fetches = [self._fetch_to_numpy(f) for f in fetches]
         if telem or prof:
@@ -376,13 +409,46 @@ class ParallelExecutor(object):
                     fetch_bytes=sum(
                         getattr(f, "nbytes", 0) for f in fetches
                         if hasattr(f, "nbytes")),
-                    fingerprint=fingerprint)
+                    fingerprint=fingerprint,
+                    device_times=device_times)
                 if flops_avals is not None:
                     _telemetry.register_flops_from_avals(
                         cp, fingerprint, flops_avals)
             if prof:
                 _profiler.record_span("parallel_executor.run", t0, t1)
         return fetches
+
+    def _feed_bytes_by_device(self, cp, feeds):
+        """{device label: feed bytes} for one step. Global jax arrays
+        report their real addressable shards; host numpy feeds (the
+        single-process path — jit shards them at dispatch) are priced
+        from the policy's feed sharding, which is what jit applies."""
+        from paddle_tpu.parallel.mesh import device_label
+
+        per_dev = {}
+        for name, arr in feeds.items():
+            if isinstance(arr, jax.Array):
+                try:
+                    for sh in arr.addressable_shards:
+                        lbl = device_label(sh.device)
+                        per_dev[lbl] = per_dev.get(lbl, 0) + int(
+                            getattr(sh.data, "nbytes", 0))
+                    continue
+                except Exception:
+                    pass
+            try:
+                sharding = cp.shardings.feed_sharding(
+                    name, shape=tuple(arr.shape))
+                shard_shape = sharding.shard_shape(tuple(arr.shape))
+                nbytes = int(np.prod(shard_shape, dtype=np.int64)
+                             ) * arr.dtype.itemsize if shard_shape else \
+                    arr.dtype.itemsize
+                for d in sharding.addressable_devices:
+                    lbl = device_label(d)
+                    per_dev[lbl] = per_dev.get(lbl, 0) + nbytes
+            except Exception:
+                continue
+        return per_dev
 
     # -- program-level pipeline path ---------------------------------------
     def _run_pipeline(self, fetch_list, feed, return_numpy):

@@ -1,0 +1,175 @@
+"""Pretty-print a black box file (observability/blackbox.py dump).
+
+The incident-response reader: given the JSON a crashed/hung/killed
+process left behind, show what the engineer asks first — what was the
+process doing (last flight events + step tail), why did it last
+recompile, where was every thread (if the dump carries stacks), and did
+a NaN diagnostic fire (and on which op).
+
+Exit codes (CI-gateable, used by the ``forensics`` stage):
+  0  dump read, no NaN diagnostic recorded
+  2  file missing / unreadable / not a black box
+  3  the dump records a NaN-provenance diagnostic (rule N001)
+
+Usage:
+  python tools/blackbox_dump.py /path/box.json [--steps 10] [--events 15]
+  python tools/blackbox_dump.py /path/box.json --json   # raw payload
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _fmt_ts(ts):
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+    except Exception:
+        return str(ts)
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except OSError as e:
+        print("blackbox_dump: cannot read %s (%s) — did the process run "
+              "with FLAGS_blackbox_path set?" % (path, e.strerror or e))
+        raise SystemExit(2)
+    except ValueError:
+        print("blackbox_dump: %s is not valid JSON (torn write? wrong "
+              "file?)" % path)
+        raise SystemExit(2)
+    if not isinstance(snap, dict) or "blackbox_version" not in snap:
+        print("blackbox_dump: %s parses but is not a black box dump "
+              "(no blackbox_version field)" % path)
+        raise SystemExit(2)
+    return snap
+
+
+def _print_steps(snap, n):
+    steps = snap.get("steps") or []
+    print("\n-- last %d of %d telemetry steps --" % (min(n, len(steps)),
+                                                     len(steps)))
+    if not steps:
+        print("  (none — FLAGS_telemetry was off or no step completed)")
+    for r in steps[-n:]:
+        extras = ""
+        if r.get("device_times"):
+            worst = max(r["device_times"], key=r["device_times"].get)
+            extras = "  slowest_device=%s(%.1fms)" % (
+                worst, r["device_times"][worst] * 1e3)
+        print("  %s  %-10s %6.1fms  steps=%-3d feed=%dB fetch=%dB%s"
+              % (_fmt_ts(r.get("ts", 0)), r.get("executor"),
+                 r.get("step_s", 0) * 1e3, r.get("steps", 1),
+                 r.get("feed_bytes", 0), r.get("fetch_bytes", 0), extras))
+
+
+def _print_recompiles(snap):
+    evs = snap.get("recompiles") or []
+    print("\n-- recompiles: %d recorded --" % len(evs))
+    if evs:
+        last = evs[-1]
+        print("  last: changed=%s mode=%s device=%s (compile #%s)"
+              % (",".join(last.get("changed", [])), last.get("mode"),
+                 last.get("device"), last.get("compiles_so_far")))
+        for k, v in (last.get("detail") or {}).items():
+            print("    %s: %s" % (k, v))
+        if last.get("lint_rule"):
+            print("    lint rule: %s (run tools/plint.py)"
+                  % last["lint_rule"])
+
+
+def _print_events(snap, n):
+    evs = snap.get("events") or []
+    print("\n-- last %d of %d flight events --" % (min(n, len(evs)),
+                                                  len(evs)))
+    for e in evs[-n:]:
+        kind = e.get("kind")
+        line = "  %s  %-12s" % (_fmt_ts(e.get("ts", 0)), kind)
+        if kind == "dispatch":
+            line += " %s fetch=%s" % (e.get("origin"),
+                                      ",".join(e.get("fetch_names", [])))
+        elif kind == "exception":
+            line += " %s: %s: %s" % (e.get("origin"), e.get("exc_type"),
+                                     (e.get("exc_message") or "")[:120])
+        elif kind == "fatal_signal":
+            line += " %s" % e.get("signal")
+        elif kind == "watchdog_hang":
+            line += " stalled=%s waited=%.1fs" % (
+                ",".join(s.get("tag", "?") for s in e.get("stalled", [])),
+                e.get("waited_s", 0))
+        elif kind == "nan_diagnostic":
+            line += " %s at block %s op %s (%s)" % (
+                e.get("rule"), e.get("block_idx"), e.get("op_idx"),
+                e.get("op_type"))
+        print(line)
+
+
+def _print_stacks(snap):
+    stacks = snap.get("thread_stacks")
+    if not stacks:
+        return
+    print("\n-- thread stacks (%d threads) --" % len(stacks))
+    for label, frames in sorted(stacks.items()):
+        print("  [%s]" % label)
+        for fr in frames[-6:]:
+            for ln in fr.rstrip().splitlines():
+                print("    " + ln)
+
+
+def _print_nan(snap):
+    d = snap.get("nan_diagnostic")
+    if not d:
+        return False
+    print("\n-- NaN diagnostic (%s %s) --" % (d.get("rule"),
+                                              d.get("name")))
+    print("  %s" % d.get("message"))
+    print("  location: block %s op %s (%s), vars: %s"
+          % (d.get("block_idx"), d.get("op_idx"), d.get("op_type"),
+             ", ".join(d.get("var_names", []))))
+    if d.get("hint"):
+        print("  hint: %s" % d["hint"])
+    return True
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="pretty-print a paddle_tpu black box dump")
+    ap.add_argument("path")
+    ap.add_argument("--steps", type=int, default=10,
+                    help="telemetry step records to show")
+    ap.add_argument("--events", type=int, default=15,
+                    help="flight events to show")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw JSON payload instead")
+    args = ap.parse_args(argv)
+
+    snap = _load(args.path)
+    if args.json:
+        json.dump(snap, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 3 if snap.get("nan_diagnostic") else 0
+
+    print("black box: %s" % args.path)
+    print("  reason: %s" % snap.get("reason"))
+    print("  when:   %s   pid: %s" % (_fmt_ts(snap.get("ts", 0)),
+                                      snap.get("pid")))
+    print("  argv:   %s" % " ".join(snap.get("argv", [])))
+    _print_steps(snap, args.steps)
+    _print_recompiles(snap)
+    _print_events(snap, args.events)
+    _print_stacks(snap)
+    has_nan = _print_nan(snap)
+    return 3 if has_nan else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `blackbox_dump box.json | head` is normal
+        os_devnull = open(os.devnull, "w")
+        sys.stdout = os_devnull
+        sys.exit(0)

@@ -75,6 +75,11 @@ MODULES = [
     "paddle_tpu.analysis.lint",
     "paddle_tpu.analysis.liveness",
     "paddle_tpu.debugger",
+    # PR 4: the failure-forensics surface (black box / watchdog / NaN
+    # provenance) — incident-response APIs are surface too
+    "paddle_tpu.observability.blackbox",
+    "paddle_tpu.observability.watchdog",
+    "paddle_tpu.observability.nan_provenance",
 ]
 
 

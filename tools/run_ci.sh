@@ -12,7 +12,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-ALL_STAGES=(native python lint warm metrics dryrun bench)
+ALL_STAGES=(native python lint warm metrics forensics dryrun bench)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -97,6 +97,16 @@ if want metrics; then
     python tools/metrics_smoke.py warm
   rm -rf "$mdir"
   trap - EXIT
+fi
+
+if want forensics; then
+  echo "== forensics smoke (black box + NaN provenance) =="
+  # two child processes crash on purpose: one goes NaN under
+  # FLAGS_check_nan_inf (the black box must blame the exact op and
+  # blackbox_dump.py must exit non-zero on it), one SIGTERMs itself
+  # mid-run (must die BY the signal and still leave a readable dump)
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/forensics_smoke.py
 fi
 
 if want dryrun; then

@@ -8,7 +8,13 @@ Two independent views, printed as JSON lines:
 1. Phase timing — the model's program is compiled and timed three ways
    (forward only; forward+backward via append_backward; the full train
    step with the optimizer), so bwd and optimizer cost are the deltas.
-2. ``--xprof`` — run the full step under ``jax.profiler.trace`` and
+2. ``--from-jsonl PATH`` — skip the model runs entirely and summarize an
+   EXISTING telemetry snapshot (the ``<FLAGS_metrics_path>.steps.jsonl``
+   a training/serving process left behind); ``--per-device`` adds the
+   per-device view over the labeled step records (dispatch->ready time
+   per device and the straggler ratio) that the multichip telemetry
+   writes into each record.
+3. ``--xprof`` — run the full step under ``jax.profiler.trace`` and
    aggregate XLA op self-times from the xplane.pb the profiler writes.
    The xplane wire format is decoded directly (a ~60-line generic
    protobuf reader; the tensorboard_plugin_profile converter in this
@@ -222,11 +228,107 @@ def _time_phase(fluid, model, on_tpu, mode, steps, warmup, use_amp):
                 recs = [json.loads(line) for line in f if line.strip()]
         telemetry.reset()
     assert np.isfinite(float(np.ravel(np.asarray(out[0]))[0]))
-    assert len(recs) == n == steps, (
-        "telemetry snapshot has %d records for %d timed steps"
-        % (len(recs), steps))
+    if len(recs) != steps or n != steps:
+        # friendly, actionable — not a bare AssertionError traceback
+        sys.exit(
+            "step_breakdown: telemetry recorded %d step(s) for %d timed "
+            "steps — something disabled telemetry mid-run (check that "
+            "nothing calls telemetry.enable(False) or reset() while the "
+            "phase loop runs)" % (len(recs), steps))
     dt = sum(r["wall_s"] for r in recs) / sum(r["steps"] for r in recs)
     return dt, denom
+
+
+# ---------------------------------------------------------------------------
+# offline view over an existing telemetry snapshot
+# ---------------------------------------------------------------------------
+
+
+def _load_steps_jsonl(path):
+    """Records from a telemetry steps JSONL, or a friendly exit — a
+    missing/empty snapshot is an operator mistake (telemetry was off or
+    the path is wrong), not a crash."""
+    if not os.path.exists(path):
+        sys.exit(
+            "step_breakdown: %s does not exist.\nRun the workload with "
+            "FLAGS_telemetry=1 and FLAGS_metrics_path=<p> (the snapshot "
+            "lands at <p>.steps.jsonl), or pass that .steps.jsonl path "
+            "here." % path)
+    recs = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    pass
+    if not recs:
+        sys.exit(
+            "step_breakdown: %s is empty — the process wrote no step "
+            "records (was FLAGS_telemetry=1? did any step complete?)"
+            % path)
+    return recs
+
+
+def _percentile(vals, q):
+    if not vals:
+        return None
+    vals = sorted(vals)
+    import math
+
+    k = max(0, min(len(vals) - 1,
+                   int(math.ceil(q / 100.0 * len(vals))) - 1))
+    return vals[k]
+
+
+def _summarize_jsonl(recs, per_device=False):
+    timed = [r for r in recs if not r.get("dispatch_only")]
+    per_step = [r["step_s"] for r in timed]
+    print(json.dumps({
+        "records": len(recs),
+        "steps": sum(r.get("steps", 1) for r in recs),
+        "executors": sorted({r.get("executor") for r in recs}),
+        "p50_ms": round((_percentile(per_step, 50) or 0) * 1e3, 3),
+        "p95_ms": round((_percentile(per_step, 95) or 0) * 1e3, 3),
+        "p99_ms": round((_percentile(per_step, 99) or 0) * 1e3, 3),
+        "feed_mb": round(sum(r.get("feed_bytes", 0)
+                             for r in recs) / 1e6, 3),
+        "fetch_mb": round(sum(r.get("fetch_bytes", 0)
+                              for r in recs) / 1e6, 3),
+    }))
+    if not per_device:
+        return
+    with_dev = [r for r in recs if r.get("device_times")]
+    if not with_dev:
+        print(json.dumps({
+            "per_device": None,
+            "note": "no record carries device_times — the snapshot came "
+                    "from a single-device executor (per-device step "
+                    "times are recorded by ParallelExecutor runs)"}))
+        return
+    agg = defaultdict(list)
+    for r in with_dev:
+        for dev, t in r["device_times"].items():
+            agg[dev].append(t)
+    rows = {
+        dev: {"steps": len(ts),
+              "mean_ms": round(sum(ts) / len(ts) * 1e3, 3),
+              "max_ms": round(max(ts) * 1e3, 3)}
+        for dev, ts in sorted(agg.items())
+    }
+    worst = [max(r["device_times"], key=r["device_times"].get)
+             for r in with_dev]
+    straggler = max(set(worst), key=worst.count)
+    means = sorted(v["mean_ms"] for v in rows.values())
+    mid = len(means) // 2
+    med = means[mid] if len(means) % 2 else (
+        means[mid - 1] + means[mid]) / 2.0
+    print(json.dumps({
+        "per_device": rows,
+        "most_frequent_straggler": straggler,
+        "imbalance_max_over_median": round(
+            max(means) / med, 4) if med else None,
+    }))
 
 
 def main():
@@ -238,7 +340,18 @@ def main():
     ap.add_argument("--xprof", action="store_true",
                     help="also capture + aggregate an xprof trace")
     ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--from-jsonl", metavar="PATH", default=None,
+                    help="summarize an existing telemetry steps JSONL "
+                         "instead of running the model")
+    ap.add_argument("--per-device", action="store_true",
+                    help="with --from-jsonl: per-device step-time table "
+                         "over the labeled step records")
     args = ap.parse_args()
+
+    if args.from_jsonl:
+        _summarize_jsonl(_load_steps_jsonl(args.from_jsonl),
+                         per_device=args.per_device)
+        return
 
     import jax
 
