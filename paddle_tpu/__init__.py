@@ -58,6 +58,7 @@ from paddle_tpu import io  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import transpiler  # noqa: F401
 from paddle_tpu import flags  # noqa: F401
+from paddle_tpu import resilience  # noqa: F401
 from paddle_tpu import debugger  # noqa: F401
 from paddle_tpu import analysis  # noqa: F401
 from paddle_tpu.core import passes  # noqa: F401

@@ -16,10 +16,12 @@ switched by ``FLAGS_exec_cache_dir`` (empty = disabled, zero overhead):
    A warm process skips even the trace: the executable deserializes
    straight into a callable.
 
-Corruption/eviction tolerance: every load path catches, counts, deletes
-the bad entry and falls back to a fresh compile — a bad cache entry can
-cost time, never correctness, and never a crash. ``FLAGS_exec_cache_max_bytes``
-bounds both layers (LRU on the XLA cache, oldest-mtime trim on AOT files).
+Corruption/eviction tolerance: every load path catches, counts,
+*quarantines* the bad entry (``<aot>/quarantine/`` — moved aside for
+autopsy, never re-read) and falls back to a fresh compile — a bad cache
+entry can cost time, never correctness, and never a crash.
+``FLAGS_exec_cache_max_bytes`` bounds both layers (LRU on the XLA cache,
+oldest-mtime trim on AOT files).
 
 TRUST BOUNDARY: AOT images deserialize through pickle, so the cache dir
 must be writable only by principals you would let execute code in this
@@ -267,11 +269,50 @@ def _remove_quiet(path):
         pass
 
 
+def _quarantine_aot(path):
+    """A corrupt AOT image is moved into ``<aot>/quarantine/``, not
+    deleted: execution already degraded safely to a fresh compile, and
+    quarantining both preserves the bytes for autopsy (was it a torn
+    write? a bad disk? an incompatible producer?) and guarantees the
+    same poisoned entry can never be re-read — deletion invites the
+    writer that produced it to reproduce it. Falls back to deletion when
+    the rename itself fails (e.g. a full disk)."""
+    qdir = os.path.join(os.path.dirname(path), "quarantine")
+    try:
+        os.makedirs(qdir, mode=0o700, exist_ok=True)
+        os.replace(path, os.path.join(qdir, os.path.basename(path)))
+        # bounded evidence locker: a host with a flaky disk quarantines
+        # on every bad read — keep the newest few, or recurring
+        # corruption grows outside the FLAGS_exec_cache_max_bytes budget
+        entries = sorted(
+            (os.stat(p).st_mtime, p)
+            for p in (os.path.join(qdir, n) for n in os.listdir(qdir))
+            if os.path.isfile(p))
+        for _, p in entries[:-8]:
+            _remove_quiet(p)
+    except OSError:
+        _remove_quiet(path)
+        return None
+    try:
+        from paddle_tpu.observability import blackbox
+
+        if blackbox.ENABLED:
+            blackbox.record("exec_cache_quarantine",
+                            entry=os.path.basename(path))
+    except Exception:
+        pass
+    return qdir
+
+
 def _load_aot(path):
     if not os.path.exists(path):
         return None
     t0 = time.perf_counter()
     try:
+        from paddle_tpu.resilience import chaos as _chaos
+
+        if _chaos.ENABLED:
+            _chaos.fault("aot.read")
         with open(path, "rb") as f:
             payload, in_tree, out_tree = pickle.load(f)
         from jax.experimental import serialize_executable
@@ -281,10 +322,10 @@ def _load_aot(path):
         )
     except Exception:
         # corrupt, truncated, or built by an incompatible runtime that
-        # slipped past the version tag: tolerate, delete, recompile
+        # slipped past the version tag: tolerate, quarantine, recompile
         with _lock:
             _stats["aot_errors"] += 1
-        _remove_quiet(path)
+        _quarantine_aot(path)
         return None
     dt = time.perf_counter() - t0
     with _lock:
@@ -328,6 +369,8 @@ def _trim_aot_dir(d):
         entries = []
         for name in os.listdir(d):
             p = os.path.join(d, name)
+            if not os.path.isfile(p):
+                continue  # the quarantine subdir is not budget-evictable
             st = os.stat(p)
             entries.append((st.st_mtime, st.st_size, p))
         total = sum(e[1] for e in entries)
@@ -363,7 +406,7 @@ def _guarded(loaded, jitted, path):
         except Exception:
             with _lock:
                 _stats["aot_errors"] += 1
-            _remove_quiet(path)
+            _quarantine_aot(path)
             state["fn"] = jitted
             if any(
                 getattr(leaf, "is_deleted", lambda: False)()

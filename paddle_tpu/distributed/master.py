@@ -316,17 +316,36 @@ class MasterClient(object):
             self._rfile = self._sock.makefile("rb")
 
     def _call(self, **req):
-        self._connect()
-        try:
-            self._sock.sendall((json.dumps(req) + "\n").encode("utf-8"))
-            line = self._rfile.readline()
-        except OSError:
-            self.close()
-            raise
-        if not line:
-            self.close()
-            raise ConnectionError("master closed connection")
-        return json.loads(line)
+        """One RPC, surviving a master restart: on ConnectionError /
+        EOFError / a raw socket error the client reconnects and retries
+        ONCE (with the resilience backoff+accounting) before surfacing
+        the failure. The master's snapshot/recover path means a restarted
+        master answers the retried call with consistent task state; every
+        method here is either idempotent (get_task leases a fresh epoch,
+        status/set_dataset) or safely re-reportable (task_finished /
+        task_failed on an unknown lease returns ok=False, it doesn't
+        corrupt)."""
+        from paddle_tpu.resilience import retry as _retry
+
+        def once():
+            from paddle_tpu.resilience import chaos as _chaos
+
+            if _chaos.ENABLED:
+                _chaos.fault("master.call")
+            self._connect()
+            try:
+                self._sock.sendall(
+                    (json.dumps(req) + "\n").encode("utf-8"))
+                line = self._rfile.readline()
+            except OSError:
+                self.close()
+                raise
+            if not line:
+                self.close()
+                raise ConnectionError("master closed connection")
+            return json.loads(line)
+
+        return _retry.call(once, origin="MasterClient._call", retries=1)
 
     def get_task(self, sync_pass=True):
         """Returns a Task or None. With sync_pass (default), a client

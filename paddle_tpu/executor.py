@@ -25,6 +25,8 @@ from paddle_tpu import framework
 from paddle_tpu import profiler as _profiler
 from paddle_tpu.core import exec_cache
 from paddle_tpu.observability import blackbox as _blackbox
+from paddle_tpu.resilience import chaos as _chaos
+from paddle_tpu.resilience import retry as _retry
 from paddle_tpu.observability import explain as _explain
 from paddle_tpu.observability import telemetry as _telemetry
 from paddle_tpu.core.fingerprint import (
@@ -261,14 +263,23 @@ class Executor(object):
                     "device": "%s:%d" % (device.platform, device.id),
                     "mode": "single",
                 }, forced=refresh)
-                cp = CompiledProgram(
-                    program,
-                    feed_specs,
-                    fetch_names,
-                    scope_names,
-                    is_test=program._is_test,
-                    device=device,
-                )
+                def _build():
+                    if _chaos.ENABLED:
+                        _chaos.fault("exec.compile")
+                    return CompiledProgram(
+                        program,
+                        feed_specs,
+                        fetch_names,
+                        scope_names,
+                        is_test=program._is_test,
+                        device=device,
+                    )
+
+                # classified-transient failures on the fresh-compile
+                # path (flaky cache reads, preempted backend compiles)
+                # retry under FLAGS_dispatch_retries; verifier/user
+                # errors surface immediately
+                cp = _retry.call(_build, origin="Executor.compile")
                 # stable cross-process key for the on-disk AOT image
                 # layer; device.id included so executors pinned to
                 # different local devices never share one baked image
@@ -378,6 +389,29 @@ class Executor(object):
             jax.random.PRNGKey(program.random_seed or self._base_seed),
             self._run_counter,
         )
+
+    @staticmethod
+    def _dispatch(cp, state, feeds, key, origin="Executor.dispatch"):
+        """The XLA dispatch, under the resilience shell: the chaos
+        ``exec.dispatch`` kill-point fires first (so injected faults are
+        indistinguishable from real transient ones), and with
+        ``FLAGS_dispatch_retries`` set, classified-transient failures
+        back off and retry — vetoed the moment a failed attempt has
+        already consumed the donated state buffers (retrying would crash
+        on deleted arrays and mask the real error). Both subsystems off:
+        two module-bool/flag reads around the plain call."""
+        chaos_on = _chaos.ENABLED
+        if not _retry.retries_enabled():
+            if chaos_on:
+                _chaos.fault("exec.dispatch")
+            return cp(state, feeds, key)
+
+        def _run():
+            if chaos_on:
+                _chaos.fault("exec.dispatch")
+            return cp(state, feeds, key)
+
+        return _retry.call(_run, origin=origin, donated=state)
 
     @staticmethod
     def _nan_check_start(new_state, fetch_names, fetches):
@@ -512,7 +546,8 @@ class Executor(object):
                 feed_specs=feed_specs, fetch_names=fetch_names,
                 fingerprint=getattr(cp, "_exec_cache_key", None))
         nan_snapshot = self._nan_snapshot(cp, state)
-        new_state, fetches = cp(state, feeds, key)
+        new_state, fetches = self._dispatch(cp, state, feeds, key,
+                                            origin="Executor.dispatch")
         for n, val in new_state.items():
             scope.set_value(n, val)
         if as_handle:
@@ -648,11 +683,16 @@ class Executor(object):
                     "device": "%s:%d" % (device.platform, device.id),
                     "mode": "multi_step[%d]" % int(steps),
                 })
-                cp = MultiStepProgram(
-                    program, steps, feed_specs, fetch_names, scope_names,
-                    is_test=program._is_test, device=device,
-                    stack_fetches=stack_fetches,
-                )
+                def _build():
+                    if _chaos.ENABLED:
+                        _chaos.fault("exec.compile")
+                    return MultiStepProgram(
+                        program, steps, feed_specs, fetch_names,
+                        scope_names, is_test=program._is_test,
+                        device=device, stack_fetches=stack_fetches,
+                    )
+
+                cp = _retry.call(_build, origin="Executor.compile")
                 cp._exec_cache_key = executable_key(
                     program, feed_specs, fetch_names, scope_names,
                     extra=("multi", int(steps), bool(stack_fetches),
@@ -681,7 +721,9 @@ class Executor(object):
             # p95 the watchdog's auto timeout is derived from
             with _blackbox.guard("Executor.run_multi_step",
                                  scale=int(steps)):
-                new_state, fetches = cp(state, feeds, key)
+                new_state, fetches = self._dispatch(
+                    cp, state, feeds, key,
+                    origin="Executor.run_multi_step")
                 for n, val in new_state.items():
                     scope.set_value(n, val)
                 try:

@@ -106,6 +106,34 @@ _DEFS = {
     # trip instead of every step). Costs one device-side copy of the
     # mutable state per step while check_nan_inf is on.
     "nan_provenance": (True, bool),
+    # periodic checkpointing cadence for resilience.TrainSession, in
+    # steps (reference: io.py CheckpointConfig.save_interval_secs role,
+    # step-keyed here because TPU steps are the natural clock); 0 = only
+    # explicit/final/signal checkpoints
+    "checkpoint_interval_steps": (0, int),
+    # same cadence on a wall-clock basis, seconds; whichever of the two
+    # intervals fires first wins, 0 disables this one
+    "checkpoint_interval_secs": (0.0, float),
+    # checkpoint retention for resilience.CheckpointManager (reference:
+    # CheckpointConfig.max_num_checkpoints); older complete serials
+    # beyond this count are pruned after each successful save
+    "checkpoint_max_to_keep": (3, int),
+    # classified-transient retry budget (resilience/retry.py) applied to
+    # the executor fresh-compile/dispatch paths — the listen_and_serv/
+    # grpc retry discipline the reference buries in brpc channel
+    # options; 0 disables dispatch retrying (zero hot-path overhead
+    # beyond one flag read). MasterClient's reconnect-and-retry-once
+    # across a master restart is fixed, not governed by this flag.
+    "dispatch_retries": (0, int),
+    # base of the exponential backoff between retries, seconds (each
+    # attempt waits base * 2^attempt plus up to 50% jitter)
+    "retry_backoff_s": (0.05, float),
+    # deterministic fault injection (resilience/chaos.py): a spec like
+    # "seed=7;kill@step=12;io@site=ckpt.write,p=0.5" arms seeded
+    # kill-points and injected IO/compile/slow faults at named sites —
+    # the chaos-monkey harness the crash/resume CI stage drives; empty
+    # disables (module-bool guard, zero overhead)
+    "chaos_spec": ("", str),
     # route the transformer's label-smoothed CE head through the fused
     # single-pass op (ops/loss_ops.py fused_label_smooth_ce): bf16
     # logits with f32-accumulated reductions, hand-written one-pass

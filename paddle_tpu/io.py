@@ -558,13 +558,51 @@ def load_sharded_persistables(executor, dirname, main_program=None,
                 scope.set_value(v.name, np.load(path))
 
 
-def _checkpoint_serials(checkpoint_dir):
-    """Sorted numeric checkpoint serials; non-numeric suffixes (e.g. a
-    user's checkpoint_best symlink) are ignored, not fatal."""
+_CKPT_MANIFEST = "__manifest__.json"
+_warned_incomplete = set()  # marker-less dirs already warned about
+
+
+def _checkpoint_complete(step_dir):
+    """A serial counts only when its writer got all the way to the end:
+    the fsynced ``__manifest__.json`` (this writer, and resilience's
+    CheckpointManager) or the ``__sharding__.json`` a legacy sharded save
+    wrote last. A dir with neither is a torn write from a crashed saver
+    — returning it as "latest" hands load_checkpoint corrupt state."""
+    return (
+        os.path.exists(os.path.join(step_dir, _CKPT_MANIFEST))
+        or os.path.exists(os.path.join(step_dir, "__sharding__.json"))
+    )
+
+
+def _checkpoint_serials(checkpoint_dir, require_complete=True):
+    """Sorted numeric checkpoint serials; temp dirs
+    (``checkpoint_N.tmp-<pid>``), quarantined dirs and non-numeric
+    suffixes (a user's checkpoint_best symlink) are ignored, not fatal;
+    serials without a completion marker are skipped unless asked."""
     out = []
     for d in os.listdir(checkpoint_dir):
-        if d.startswith("checkpoint_") and d.split("_")[-1].isdigit():
-            out.append(int(d.split("_")[-1]))
+        if not d.startswith("checkpoint_"):
+            continue
+        suffix = d[len("checkpoint_"):]
+        if not suffix.isdigit():
+            continue  # .tmp-<pid> / .corrupt-<n> / named symlinks
+        if require_complete and not _checkpoint_complete(
+                os.path.join(checkpoint_dir, d)):
+            # loud, not silent (but once per dir): a marker-less dir is
+            # indistinguishable from a torn write, but it may also be a
+            # pre-manifest-era plain save a user expects to resume from
+            path = os.path.join(checkpoint_dir, d)
+            if path not in _warned_incomplete:
+                _warned_incomplete.add(path)
+                import logging
+
+                logging.getLogger("paddle_tpu.io").warning(
+                    "checkpoint dir %s has no completion marker "
+                    "(__manifest__.json/__sharding__.json) and is "
+                    "skipped; if it is a complete legacy save, load it "
+                    "explicitly with load_persistables", path)
+            continue
+        out.append(int(suffix))
     return sorted(out)
 
 
@@ -572,14 +610,41 @@ def save_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
                     serial=0, max_num_checkpoints=3, sharded=True):
     """Numbered checkpoint dirs + retention (reference io.py CheckpointConfig
     capability): checkpoint_dir/checkpoint_<serial>/ with sharded (or plain)
-    persistables; old serials beyond max_num_checkpoints are pruned."""
+    persistables; old serials beyond max_num_checkpoints are pruned.
+
+    Atomicity contract: vars land in ``checkpoint_<serial>.tmp-<pid>``
+    first, a manifest naming every file is written and fsynced, then the
+    dir is atomically renamed — a crash at ANY point leaves either the
+    previous complete serial or a temp dir every reader ignores, never a
+    half-written "latest". (resilience/checkpoint.py's CheckpointManager
+    layers digests, async writes and quarantine-on-corruption on top.)"""
+    import json as _json
     import shutil
 
     step_dir = os.path.join(checkpoint_dir, "checkpoint_%d" % serial)
+    tmp_dir = "%s.tmp-%d" % (step_dir, os.getpid())
+    shutil.rmtree(tmp_dir, ignore_errors=True)
     saver = (
         save_sharded_persistables if sharded else save_persistables
     )
-    saver(executor, step_dir, main_program=main_program, scope=scope)
+    try:
+        saver(executor, tmp_dir, main_program=main_program, scope=scope)
+        manifest = {
+            "manifest_version": 1,
+            "serial": int(serial),
+            "files": sorted(
+                f for f in os.listdir(tmp_dir) if f != _CKPT_MANIFEST),
+        }
+        mpath = os.path.join(tmp_dir, _CKPT_MANIFEST)
+        with open(mpath, "w") as f:
+            _json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        shutil.rmtree(step_dir, ignore_errors=True)  # re-save same serial
+        os.replace(tmp_dir, step_dir)
+    except BaseException:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise
     keep = max(int(max_num_checkpoints), 1)
     serials = _checkpoint_serials(checkpoint_dir)
     # Never prune the serial just written, whatever its ordering.
@@ -595,8 +660,10 @@ def save_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
 
 def load_checkpoint(executor, checkpoint_dir, main_program=None, scope=None,
                     serial=None):
-    """Load the given (default: latest) checkpoint serial; returns the
-    serial loaded or None when the directory holds no checkpoints."""
+    """Load the given (default: latest) *complete* checkpoint serial;
+    returns the serial loaded or None when the directory holds no
+    complete checkpoints. Temp dirs and serials whose save never wrote
+    its manifest are never candidates."""
     if not os.path.isdir(checkpoint_dir):
         return None
     serials = _checkpoint_serials(checkpoint_dir)

@@ -32,6 +32,7 @@ from paddle_tpu.observability.metrics_registry import REGISTRY
 __all__ = [
     "ENABLED", "start", "stop", "arm", "disarm", "progress",
     "effective_timeout", "is_running", "last_hang", "suspend",
+    "register_on_hang", "unregister_on_hang",
 ]
 
 ENABLED = False
@@ -52,6 +53,27 @@ _state = {
     "abort": None,       # None = follow FLAGS_watchdog_abort
     "last_hang": None,
 }
+
+_on_hang_extra = []  # registered callbacks, called AFTER start()'s on_hang
+
+
+def register_on_hang(fn):
+    """Add a hang callback without displacing ``start(on_hang=...)``'s —
+    how TrainSession banks an emergency checkpoint before
+    ``FLAGS_watchdog_abort`` kills the process. Returns ``fn`` (the
+    deregistration handle)."""
+    with _lock:
+        _on_hang_extra.append(fn)
+    return fn
+
+
+def unregister_on_hang(fn):
+    with _lock:
+        try:
+            _on_hang_extra.remove(fn)
+        except ValueError:
+            pass
+
 
 _fires = REGISTRY.counter(
     "paddle_tpu_watchdog_fires_total", "hangs declared by the watchdog")
@@ -172,6 +194,7 @@ def _fire(stalled, waited, timeout):
         _state["last_hang"] = report
         on_hang = _state["on_hang"]
         abort = _state["abort"]
+        extra_cbs = list(_on_hang_extra)
     _fires.inc()
     _stalled_gauge.set(1)
     stacks = blackbox.thread_stacks()
@@ -187,9 +210,11 @@ def _fire(stalled, waited, timeout):
         "watchdog: no progress for %.1fs (timeout %.1fs); stalled: %s; "
         "black box: %s", waited, timeout,
         ", ".join(s["tag"] for s in report["stalled"]), dump_path)
-    if on_hang is not None:
+    for cb in [on_hang] + extra_cbs:
+        if cb is None:
+            continue
         try:
-            on_hang(report)
+            cb(report)
         except Exception:
             pass
     if abort is None:

@@ -27,6 +27,8 @@ from paddle_tpu.core import exec_cache
 from paddle_tpu.observability import blackbox as _blackbox
 from paddle_tpu.observability import explain as _explain
 from paddle_tpu.observability import telemetry as _telemetry
+from paddle_tpu.resilience import chaos as _chaos
+from paddle_tpu.resilience import retry as _retry
 from paddle_tpu.core.fingerprint import (
     executable_key,
     program_fingerprint,
@@ -254,14 +256,20 @@ class ParallelExecutor(object):
                 "mode": "gspmd",
             })
             state_shapes = self._collect_state_shapes()
-            cp = CompiledProgram(
-                self._program,
-                feed_specs,
-                fetch_names,
-                scope_names,
-                is_test=self._program._is_test,
-                shardings=self._policy(state_shapes),
-            )
+
+            def _build():
+                if _chaos.ENABLED:
+                    _chaos.fault("exec.compile")
+                return CompiledProgram(
+                    self._program,
+                    feed_specs,
+                    fetch_names,
+                    scope_names,
+                    is_test=self._program._is_test,
+                    shardings=self._policy(state_shapes),
+                )
+
+            cp = _retry.call(_build, origin="ParallelExecutor.compile")
             cp._exec_cache_key = executable_key(
                 self._program, feed_specs, fetch_names, scope_names,
                 extra=("gspmd", mesh_sig,
@@ -384,7 +392,10 @@ class ParallelExecutor(object):
                 fingerprint=getattr(cp, "_exec_cache_key", None),
                 mesh=dict(self.mesh.shape))
         t_disp = time.perf_counter() if telem else 0.0
-        new_state, fetches = cp(state, feeds, key)
+        from paddle_tpu.executor import Executor as _Executor
+
+        new_state, fetches = _Executor._dispatch(
+            cp, state, feeds, key, origin="ParallelExecutor.dispatch")
         for n, val in new_state.items():
             self._scope.set_value(n, val)
         device_times = None

@@ -1,0 +1,37 @@
+"""Resilience: the layer that turns failure detection into recovery.
+
+PR 4's observability stack (black box, watchdog, NaN provenance) made
+failures *explained*; this package makes them *survived* — the fault-
+tolerance contract (consistent checkpointing + automatic recovery) the
+TensorFlow system paper (Abadi et al., 2016) names as table stakes for
+production training on preemptible fleets, and the recovery half the
+elastic master (``distributed/master.py``) has always assumed exists:
+
+* ``checkpoint`` — :class:`CheckpointManager`: atomic (temp dir +
+  fsynced manifest + rename), digest-verified, asynchronously written
+  checkpoints capturing scope state AND the executor RNG stream; on
+  load, corrupt serials are quarantined and the scan falls back to the
+  newest *complete* one.
+* ``session`` — :class:`TrainSession`: owns the training loop's
+  resilience — periodic checkpoints, SIGTERM/SIGINT = finish the step,
+  checkpoint, die by the signal; auto-resume with a bit-identical loss
+  trajectory; emergency checkpoint on a watchdog-declared hang.
+* ``retry`` — classified retry policy: transient IO/RPC/exec-cache
+  failures backed off and retried (``FLAGS_dispatch_retries``),
+  user/verifier errors never; every retry counted
+  (``paddle_tpu_retries_total``) and filed to the black box.
+* ``chaos`` — seeded, deterministic fault injection
+  (``FLAGS_chaos_spec``): kill-points and injected IO/compile/slow
+  faults at named sites, the harness the crash/resume tests and the CI
+  ``chaos`` stage drive.
+
+``docs/RESILIENCE.md`` is the operator's guide (checkpoint format,
+retry classification table, chaos grammar, metrics catalog).
+"""
+
+from paddle_tpu.resilience import chaos  # noqa: F401
+from paddle_tpu.resilience import checkpoint  # noqa: F401
+from paddle_tpu.resilience import retry  # noqa: F401
+from paddle_tpu.resilience import session  # noqa: F401
+from paddle_tpu.resilience.checkpoint import CheckpointManager  # noqa: F401
+from paddle_tpu.resilience.session import TrainSession  # noqa: F401

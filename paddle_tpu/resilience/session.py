@@ -1,0 +1,269 @@
+"""Preemption-safe training sessions: periodic checkpoints, graceful
+signal death, automatic resume.
+
+The elastic master (``distributed/master.py``) already assumes workers
+die and come back — leased tasks time out and requeue. What it cannot do
+is give a returned worker its *model state* back. :class:`TrainSession`
+is that other half: a thin loop owner around ``Executor.run`` that
+
+* **auto-resumes** on construction from the newest *verified* serial in
+  ``checkpoint_dir`` (corrupt ones quarantined by the manager), restoring
+  parameters, optimizer accumulators, LR counters AND the executor's RNG
+  stream — a killed-and-restarted process continues at the right step
+  with a loss trajectory bit-identical to the run that never died;
+* **checkpoints periodically** (``FLAGS_checkpoint_interval_steps`` /
+  ``_secs``, or constructor args), asynchronously — the step pays for a
+  device→host snapshot, never for disk;
+* **dies gracefully**: a SIGTERM/SIGINT (the preemption notice) lets the
+  in-flight step finish, writes a final checkpoint, then restores the
+  previous handler and re-delivers the signal — composing with the black
+  box's handler chain (blackbox dumps, then the process still dies BY
+  the signal, as supervisors require);
+* **saves on hangs**: registered with the watchdog, a declared hang
+  triggers an emergency checkpoint *before* ``FLAGS_watchdog_abort``
+  kills the process — the stall costs a restart, not the training run.
+
+Usage::
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)                       # init scope FIRST
+    with TrainSession(exe, "ckpt/", main_program=main) as sess:
+        while sess.step < total_steps:
+            loss, = sess.run(feed=next_batch(sess.step),
+                             fetch_list=[loss_var])
+
+``sess.run`` is also a chaos kill-point (``session.step``): the CI chaos
+stage SIGKILLs a child at a seeded step and asserts the restarted child
+reproduces the uninterrupted run exactly.
+"""
+
+import signal
+import threading
+import time
+
+from paddle_tpu import framework
+from paddle_tpu.resilience import chaos
+from paddle_tpu.resilience.checkpoint import CheckpointManager
+
+__all__ = ["TrainSession"]
+
+_HANDLED_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+class TrainSession(object):
+    def __init__(self, executor, checkpoint_dir, main_program=None,
+                 scope=None, interval_steps=None, interval_secs=None,
+                 max_to_keep=None, auto_resume=True,
+                 install_signal_handlers=True, emergency_on_hang=True):
+        from paddle_tpu import flags
+
+        self._exe = executor
+        self._program = main_program or framework.default_main_program()
+        self._scope = scope
+        if interval_steps is None:
+            interval_steps = int(flags.get("checkpoint_interval_steps"))
+        if interval_secs is None:
+            interval_secs = float(flags.get("checkpoint_interval_secs"))
+        self.interval_steps = int(interval_steps)
+        self.interval_secs = float(interval_secs)
+        self.manager = CheckpointManager(
+            checkpoint_dir, executor=executor, main_program=self._program,
+            scope=scope, max_to_keep=max_to_keep)
+        self.step = 0
+        self.resumed_serial = None
+        if auto_resume:
+            manifest = self.manager.restore()
+            if manifest is not None:
+                self.step = int(manifest.get("step", 0))
+                self.resumed_serial = int(manifest["serial"])
+        self._last_save_step = self.step
+        self._last_save_time = time.monotonic()
+        self._stop_signum = None
+        self._in_step = False
+        self._closed = False
+        self._prev_handlers = {}
+        self._hang_cb = None
+        if install_signal_handlers:
+            self._install_signal_handlers()
+        if emergency_on_hang:
+            from paddle_tpu.observability import watchdog
+
+            self._hang_cb = watchdog.register_on_hang(self._on_hang)
+
+    # -- the step -----------------------------------------------------------
+
+    def run(self, feed=None, fetch_list=None, program=None, **kwargs):
+        """One training step: ``Executor.run`` plus session bookkeeping.
+        After the step completes, a pending preemption signal finalizes
+        (final checkpoint, handler restored, signal re-delivered) — the
+        step in flight when SIGTERM lands is never torn."""
+        if self._closed:
+            raise RuntimeError("TrainSession is closed")
+        if chaos.ENABLED:
+            chaos.fault("session.step", step=self.step)
+        self._in_step = True
+        try:
+            out = self._exe.run(
+                program or self._program, feed=feed,
+                fetch_list=fetch_list, scope=self._scope, **kwargs)
+            # the step-counter bump is part of the "in step" window: a
+            # signal landing between the executor returning and the bump
+            # must defer to the post-step finalize below, or the handler
+            # would checkpoint step N-1's count over step N's state and
+            # RNG counter — a torn manifest that breaks exact resume
+            self.step += 1
+        finally:
+            self._in_step = False
+            import sys
+
+            if (self._stop_signum is not None
+                    and sys.exc_info()[0] is not None):
+                # the step the preemption deferred to has RAISED: the
+                # signal must not be swallowed by the exception path —
+                # bank the pre-step state and die by the signal (step
+                # counter was never bumped, so the checkpoint is
+                # consistent with the last completed step)
+                self._finalize_and_reraise()
+        if self._stop_signum is not None:
+            self._finalize_and_reraise()
+        elif self._checkpoint_due():
+            self.save(final=False)
+        return out
+
+    def _checkpoint_due(self):
+        if (self.interval_steps > 0
+                and self.step - self._last_save_step
+                >= self.interval_steps):
+            return True
+        if (self.interval_secs > 0
+                and time.monotonic() - self._last_save_time
+                >= self.interval_secs):
+            return True
+        return False
+
+    # -- checkpointing ------------------------------------------------------
+
+    def save(self, final=True):
+        """Write a checkpoint at the current step: synchronously when
+        ``final`` (the caller is about to exit — the write must land),
+        asynchronously otherwise. Returns the serial."""
+        if final:
+            self.manager.save(self.step)
+        else:
+            self.manager.save_async(self.step)
+        self._last_save_step = self.step
+        self._last_save_time = time.monotonic()
+        return self.step
+
+    def should_stop(self):
+        """True once a preemption signal has been received (readable from
+        data-loading code between steps)."""
+        return self._stop_signum is not None
+
+    # -- preemption plumbing ------------------------------------------------
+
+    def _install_signal_handlers(self):
+        if threading.current_thread() is not threading.main_thread():
+            return  # signal.signal raises off-main; sessions there skip it
+        for sig in _HANDLED_SIGNALS:
+            try:
+                self._prev_handlers[sig] = signal.signal(
+                    sig, self._signal_handler)
+            except (ValueError, OSError):
+                pass
+
+    def _uninstall_signal_handlers(self):
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError, TypeError):
+                pass
+        self._prev_handlers = {}
+
+    def _signal_handler(self, signum, frame):
+        self._stop_signum = signum
+        from paddle_tpu.observability import blackbox
+
+        if blackbox.ENABLED:
+            blackbox.record("preemption_signal", signal=int(signum),
+                            step=self.step, in_step=self._in_step)
+        if not self._in_step:
+            # idle (between steps / in data loading): nothing to finish,
+            # finalize right here in handler context
+            self._finalize_and_reraise()
+        # else: run() finalizes after the in-flight step returns
+
+    def _finalize_and_reraise(self):
+        signum = self._stop_signum
+        try:
+            self.manager.save(self.step)
+        except Exception:
+            # the signal must still propagate even if the final save
+            # failed (metrics/blackbox already recorded the failure)
+            pass
+        self.close(save=False)
+        # re-deliver through the PREVIOUS handler chain: the black box's
+        # handler (if armed) dumps and re-raises, supervisors still see
+        # a death by signal / KeyboardInterrupt semantics for SIGINT
+        import os
+
+        os.kill(os.getpid(), signum)
+
+    def _on_hang(self, report):
+        """Watchdog thread: the main thread is wedged, FLAGS_watchdog_abort
+        may be about to kill the process — bank the training state first.
+        ONLY when the hang is outside a step (a deadlocked input
+        pipeline, wedged user code): mid-dispatch the scope's mutable
+        state is donated to the stuck executable — its buffers may
+        already be deleted, and a 'successful' save would bank a
+        parameter-less checkpoint that wins as newest serial. In that
+        case the last periodic checkpoint is the best consistent state
+        there is, and skipping also keeps this thread from blocking on
+        the wedged runtime and holding off the abort."""
+        from paddle_tpu.observability import blackbox
+
+        if self._in_step:
+            if blackbox.ENABLED:
+                blackbox.record(
+                    "emergency_checkpoint_skipped", step=self.step,
+                    reason="hang is mid-dispatch; scope state is donated")
+            return
+        try:
+            if blackbox.ENABLED:
+                blackbox.record("emergency_checkpoint", step=self.step,
+                                reason="watchdog_hang")
+            self.manager.save(self.step)
+        except Exception:
+            pass  # a failed emergency save must not mask the hang report
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self, save=True):
+        """Detach handlers and (by default) write a final synchronous
+        checkpoint. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if save:
+            try:
+                self.manager.save(self.step)
+            except Exception:
+                pass
+        else:
+            self.manager.wait()
+        self._uninstall_signal_handlers()
+        if self._hang_cb is not None:
+            from paddle_tpu.observability import watchdog
+
+            watchdog.unregister_on_hang(self._hang_cb)
+            self._hang_cb = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # clean exit banks the final state; an exception keeps the last
+        # periodic checkpoint (saving mid-exception could bank a step
+        # that never logically completed)
+        self.close(save=exc_type is None)
+        return False

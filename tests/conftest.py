@@ -17,6 +17,15 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # "slow": excluded from the time-budgeted tier-1 run (-m 'not slow');
+    # still executed by tools/run_ci.sh's python stage, which runs the
+    # whole suite unfiltered
+    config.addinivalue_line(
+        "markers", "slow: long-running (subprocess-spawning) tests "
+        "excluded from the tier-1 budget")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Give every test fresh default programs, scope and name counters."""

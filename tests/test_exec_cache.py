@@ -314,10 +314,21 @@ def test_corrupted_cache_entry_degrades_to_fresh_compile(cache_dir):
     assert st["aot_errors"] > errors_before, (
         "corrupt AOT image was not detected")
     np.testing.assert_array_equal(np.asarray(good), np.asarray(recovered))
-    # the bad entries were evicted and replaced by fresh ones on the way
-    for f in os.listdir(os.path.join(cache_dir, "aot")):
-        with open(os.path.join(cache_dir, "aot", f), "rb") as fh:
+    # the bad entries were QUARANTINED (kept for autopsy, never re-read)
+    # and replaced by fresh ones on the way
+    aot = os.path.join(cache_dir, "aot")
+    for f in os.listdir(aot):
+        path = os.path.join(aot, f)
+        if not os.path.isfile(path):
+            continue  # the quarantine subdir itself
+        with open(path, "rb") as fh:
             assert fh.read(32) != b"corrupt garbage, not an executa"
+    qdir = os.path.join(aot, "quarantine")
+    assert os.path.isdir(qdir) and os.listdir(qdir), (
+        "corrupt entries should be moved to quarantine/, not deleted")
+    for f in os.listdir(qdir):
+        with open(os.path.join(qdir, f), "rb") as fh:
+            assert fh.read(32).startswith(b"corrupt garbage")
 
 
 def test_cache_stats_exported_through_profiler(cache_dir):

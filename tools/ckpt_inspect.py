@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Checkpoint inspector: print and verify a checkpoint manifest.
+
+Works on a single ``checkpoint_<serial>`` dir or a checkpoint root (then
+every complete serial is listed and the newest inspected). Deliberately
+jax-free — this is the tool an operator runs on a corrupt-checkpoint
+page, possibly on a machine with no accelerator stack at all.
+
+    python tools/ckpt_inspect.py CKPT_DIR [--verify] [--json]
+
+Exit codes:  0 ok · 1 usage/unreadable · 2 verification failed (digest
+mismatch / missing file / no complete checkpoint) — the code the chaos
+CI stage and restore-time tooling gate on.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+MANIFEST_NAME = "__manifest__.json"
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _read_manifest(step_dir):
+    try:
+        with open(os.path.join(step_dir, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _verify(step_dir, manifest):
+    problems = []
+    for name, meta in sorted(manifest.get("vars", {}).items()):
+        path = os.path.join(step_dir, meta["file"])
+        if not os.path.exists(path):
+            problems.append("missing file for var %r: %s"
+                            % (name, meta["file"]))
+            continue
+        want = meta.get("sha256")
+        if want and _sha256_file(path) != want:
+            problems.append("digest mismatch: var %r (%s)"
+                            % (name, meta["file"]))
+    for fname in manifest.get("files", []):
+        if not os.path.exists(os.path.join(step_dir, fname)):
+            problems.append("missing file %s" % fname)
+    return problems
+
+
+def _serial_dirs(root):
+    out = []
+    for d in sorted(os.listdir(root)):
+        if not d.startswith("checkpoint_"):
+            continue
+        suffix = d[len("checkpoint_"):]
+        if suffix.isdigit():
+            out.append((int(suffix), os.path.join(root, d)))
+    return sorted(out)
+
+
+def _summarize(step_dir, manifest, verify):
+    vars_meta = manifest.get("vars", {})
+    info = {
+        "dir": step_dir,
+        "manifest_version": manifest.get("manifest_version"),
+        "serial": manifest.get("serial"),
+        "step": manifest.get("step"),
+        "num_vars": len(vars_meta) or len(manifest.get("files", [])),
+        "bytes": sum(v.get("bytes", 0) for v in vars_meta.values()),
+        "rng": manifest.get("rng"),
+        "has_digests": any(v.get("sha256") for v in vars_meta.values()),
+    }
+    info["problems"] = _verify(step_dir, manifest) if verify else None
+    return info
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="checkpoint_<n> dir or checkpoint root")
+    ap.add_argument("--verify", action="store_true",
+                    help="re-hash every var file against the manifest")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.path):
+        print("ckpt_inspect: not a directory: %s" % args.path,
+              file=sys.stderr)
+        return 1
+    manifest = _read_manifest(args.path)
+    if manifest is not None:
+        targets = [(manifest.get("serial"), args.path)]
+    else:
+        targets = [(s, d) for s, d in _serial_dirs(args.path)
+                   if _read_manifest(d) is not None]
+        if not targets:
+            print("ckpt_inspect: no complete checkpoint under %s "
+                  "(no readable %s)" % (args.path, MANIFEST_NAME),
+                  file=sys.stderr)
+            return 2
+    rc = 0
+    reports = []
+    for serial, step_dir in targets:
+        m = _read_manifest(step_dir)
+        info = _summarize(step_dir, m, args.verify)
+        reports.append(info)
+        if info["problems"]:
+            rc = 2
+    if args.as_json:
+        print(json.dumps(reports, indent=2, sort_keys=True))
+    else:
+        for info in reports:
+            print("checkpoint serial=%s step=%s  vars=%d  %.1f MiB  "
+                  "manifest v%s%s" % (
+                      info["serial"], info["step"], info["num_vars"],
+                      info["bytes"] / 1048576.0,
+                      info["manifest_version"],
+                      "  rng=%(base_seed)d@%(run_counter)d"
+                      % info["rng"] if info["rng"] else ""))
+            if args.verify:
+                if info["problems"]:
+                    for p in info["problems"]:
+                        print("  FAIL %s" % p)
+                elif info["has_digests"]:
+                    print("  verified: all digests match")
+                else:
+                    print("  verified: files present (v1 manifest, "
+                          "no digests)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,12 @@ MODULES = [
     "paddle_tpu.observability.blackbox",
     "paddle_tpu.observability.watchdog",
     "paddle_tpu.observability.nan_provenance",
+    # PR 5: the recovery surface (checkpoint v2 / sessions / retry /
+    # chaos) — what operators script disaster drills against
+    "paddle_tpu.resilience.checkpoint",
+    "paddle_tpu.resilience.session",
+    "paddle_tpu.resilience.retry",
+    "paddle_tpu.resilience.chaos",
 ]
 
 

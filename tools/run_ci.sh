@@ -3,7 +3,8 @@
 # repo): native C++ build + its unit tests, the Python suite on the
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
-# (native|python|warm|dryrun|bench) to run a subset.
+# (native|python|lint|warm|metrics|forensics|chaos|dryrun|bench) to run
+# a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -12,7 +13,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-ALL_STAGES=(native python lint warm metrics forensics dryrun bench)
+ALL_STAGES=(native python lint warm metrics forensics chaos dryrun bench)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -107,6 +108,18 @@ if want forensics; then
   # mid-run (must die BY the signal and still leave a readable dump)
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python tools/forensics_smoke.py
+fi
+
+if want chaos; then
+  echo "== chaos smoke (crash/resume + retry + corruption) =="
+  # three child legs: a SIGKILLed trainer must resume from the newest
+  # COMPLETE checkpoint with a bit-identical loss trajectory; a run with
+  # injected transient dispatch faults must finish with
+  # paddle_tpu_retries_total > 0 and retry events in the black box; a
+  # corrupted latest checkpoint must be quarantined and the previous
+  # serial loaded (chaos_smoke.py asserts all of it)
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/chaos_smoke.py
 fi
 
 if want dryrun; then
