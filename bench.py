@@ -376,6 +376,15 @@ def _worker_main():
                 }
                 result["mfu_telemetry"] = (
                     round(st["mfu"], 4) if st["mfu"] else None)
+                # HBM trajectory (observability/memory.py): measured
+                # ledger watermark + the planner's prediction, so
+                # BENCH_*.json tracks footprint alongside MFU and
+                # tools/perf_diff.py can gate regressions on it
+                from paddle_tpu import profiler as _profiler
+
+                ms = _profiler.memory_stats()
+                result["peak_hbm_bytes"] = ms["measured_peak_bytes"]
+                result["predicted_peak_bytes"] = ms["predicted_peak_bytes"]
                 telemetry.flush()  # FLAGS_metrics_path scrape, if set
         except Exception as e:  # noqa: BLE001
             result["telemetry_error"] = "%s: %s" % (type(e).__name__, e)

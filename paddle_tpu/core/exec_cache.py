@@ -333,6 +333,18 @@ def _load_aot(path):
         _stats["compile_seconds"] += dt
         _stats["compile_seconds_warm"] += dt
     _record_compile_span("aot_image_load", dt, "warm")
+    try:
+        # HBM ledger (observability/memory.py): a deserialized image's
+        # program+constants occupy device memory for the process's life —
+        # the 'cache' kind on the live-bytes gauge. Serialized size is
+        # the accountable proxy; the true on-device footprint is XLA's.
+        from paddle_tpu.observability import memory as _memory
+
+        if _memory.ENABLED:
+            _memory.track("aot:" + os.path.basename(path),
+                          os.path.getsize(path), "cache")
+    except Exception:
+        pass
     return loaded
 
 

@@ -28,6 +28,7 @@ from paddle_tpu.observability import blackbox as _blackbox
 from paddle_tpu.resilience import chaos as _chaos
 from paddle_tpu.resilience import retry as _retry
 from paddle_tpu.observability import explain as _explain
+from paddle_tpu.observability import memory as _memory
 from paddle_tpu.observability import telemetry as _telemetry
 from paddle_tpu.core.fingerprint import (
     executable_key,
@@ -91,6 +92,21 @@ def _as_feed_array(value, place):
     return np.asarray(value), None
 
 
+def _materialize_fetches(arrays, origin):
+    """Host-materialize fetched device arrays. With async dispatch the
+    allocator's RESOURCE_EXHAUSTED often surfaces at the first host read
+    rather than inside the dispatch call, so every materialize site —
+    sync return, multi-step return, FetchHandle.result — routes through
+    the same M001 enrichment as the dispatch path."""
+    try:
+        return [np.asarray(a) for a in arrays]
+    except Exception as exc:
+        if _memory.is_oom(exc) and not isinstance(
+                exc, _memory.MemoryExhaustedError):
+            _memory.enrich_and_raise(exc, origin=origin)
+        raise
+
+
 def _maybe_verify(program, feed_specs, fetch_names, origin):
     """FLAGS_verify_program gate: run the structural verifier with the
     concrete feed shapes (resolving deferred shape inference) before a
@@ -136,16 +152,19 @@ class FetchHandle(object):
     """
 
     def __init__(self, arrays, fetch_names, nan_check=None, track=None,
-                 t_dispatch=None):
+                 t_dispatch=None, mem_device=None):
         self._arrays = list(arrays)
         self.fetch_names = list(fetch_names)
         self._nan_check = nan_check
         self._numpy = None
-        # observability, both None on the undisturbed hot path: _track is
+        # observability, all None on the undisturbed hot path: _track is
         # the profiler's async-span record, _t_dispatch the telemetry
-        # dispatch timestamp (set only when telemetry was ENABLED)
+        # dispatch timestamp, _mem_device the ledger label whose
+        # 'activation' entries this handle releases at materialize
+        # (all set only when their subsystem was ENABLED)
         self._track = track
         self._t_dispatch = t_dispatch
+        self._mem_device = mem_device
 
     def __len__(self):
         return len(self._arrays)
@@ -184,9 +203,16 @@ class FetchHandle(object):
                     # block first (marks "ready"), then materialize
                     self.block_until_ready()
                     _profiler.async_fetch_ready(track)
-                self._numpy = [np.asarray(a) for a in self._arrays]
+                self._numpy = _materialize_fetches(
+                    self._arrays, "FetchHandle.result")
                 if track is not None:
                     _profiler.async_fetch_end(track)
+                if self._mem_device is not None:
+                    # the device copies of the fetches are released once
+                    # numpy is in hand — balance the dispatch-time entries
+                    _memory.drop_fetches(self.fetch_names,
+                                         self._mem_device)
+                    self._mem_device = None
                 if self._t_dispatch is not None:
                     _telemetry.record_fetch_materialize(
                         time.perf_counter() - self._t_dispatch)
@@ -399,19 +425,29 @@ class Executor(object):
         back off and retry — vetoed the moment a failed attempt has
         already consumed the donated state buffers (retrying would crash
         on deleted arrays and mask the real error). Both subsystems off:
-        two module-bool/flag reads around the plain call."""
+        two module-bool/flag reads around the plain call. A
+        RESOURCE_EXHAUSTED/OOM escaping any path — deterministic, so
+        never retried — is upgraded to the M001 diagnostic (black-box
+        dump with the ledger's top holders + the predicted peak) on the
+        way out; one substring check, paid only on the failure path."""
         chaos_on = _chaos.ENABLED
-        if not _retry.retries_enabled():
-            if chaos_on:
-                _chaos.fault("exec.dispatch")
-            return cp(state, feeds, key)
+        try:
+            if not _retry.retries_enabled():
+                if chaos_on:
+                    _chaos.fault("exec.dispatch")
+                return cp(state, feeds, key)
 
-        def _run():
-            if chaos_on:
-                _chaos.fault("exec.dispatch")
-            return cp(state, feeds, key)
+            def _run():
+                if chaos_on:
+                    _chaos.fault("exec.dispatch")
+                return cp(state, feeds, key)
 
-        return _retry.call(_run, origin=origin, donated=state)
+            return _retry.call(_run, origin=origin, donated=state)
+        except Exception as exc:
+            if _memory.is_oom(exc) and not isinstance(
+                    exc, _memory.MemoryExhaustedError):
+                _memory.enrich_and_raise(exc, origin=origin)
+            raise
 
     @staticmethod
     def _nan_check_start(new_state, fetch_names, fetches):
@@ -538,6 +574,13 @@ class Executor(object):
                        if telem else None)
         flops_avals = (_telemetry.capture_step_avals(cp, state, feeds, key)
                        if telem else None)
+        mem_dev = _telemetry.device_label(device) if telem else None
+        if telem:
+            # HBM ledger: feeds enter the device here; the predicted
+            # plan is filed once per executable so the step records and
+            # any OOM dump carry predicted-vs-measured peak
+            _memory.track_feeds(feeds, mem_dev)
+            _memory.register_plan_for(cp, program, feed_specs, fingerprint)
         if _blackbox.ENABLED:
             # the event a crash dump's last entry points at: what was
             # about to run, with the shapes that ran it
@@ -550,6 +593,14 @@ class Executor(object):
                                             origin="Executor.dispatch")
         for n, val in new_state.items():
             scope.set_value(n, val)
+        if telem:
+            # scope binding: the step's outputs replace the donated
+            # inputs under the same ledger keys; feeds leave with the
+            # host references, fetched activations stay live until
+            # materialized (below / FetchHandle.result)
+            _memory.track_state(cp, program, new_state, mem_dev)
+            _memory.track_fetches(cp.fetch_names, fetches, mem_dev)
+            _memory.drop_feeds(feeds, mem_dev)
         if as_handle:
             # dispatch complete, nothing synced: the (optional) nan/inf
             # reductions are already in flight on device, but reading
@@ -571,6 +622,7 @@ class Executor(object):
                 track=_profiler.async_fetch_begin(cp.fetch_names)
                 if prof else None,
                 t_dispatch=t0 if telem else None,
+                mem_device=mem_dev,
             )
             if telem or prof:
                 t1 = time.perf_counter()
@@ -595,7 +647,11 @@ class Executor(object):
         except RuntimeError as e:
             self._nan_blame(e, program, nan_snapshot, feeds, key, device)
         if return_numpy:
-            fetches = [np.asarray(f) for f in fetches]
+            fetches = _materialize_fetches(fetches, "Executor.run")
+        if telem:
+            # sync return: the fetch buffers are the caller's now (numpy
+            # in hand, or live arrays the executor no longer owns)
+            _memory.drop_fetches(cp.fetch_names, mem_dev)
         if telem or prof:
             t1 = time.perf_counter()
             if telem:
@@ -711,6 +767,11 @@ class Executor(object):
                            if telem else None)
             flops_avals = (_telemetry.capture_step_avals(
                 cp, state, feeds, key) if telem else None)
+            mem_dev = _telemetry.device_label(device) if telem else None
+            if telem:
+                _memory.track_feeds(feeds, mem_dev)
+                _memory.register_plan_for(cp, program, feed_specs,
+                                          fingerprint)
             if _blackbox.ENABLED:
                 _blackbox.record_dispatch(
                     "Executor.run_multi_step", feed_specs=feed_specs,
@@ -726,6 +787,11 @@ class Executor(object):
                     origin="Executor.run_multi_step")
                 for n, val in new_state.items():
                     scope.set_value(n, val)
+                if telem:
+                    _memory.track_state(cp, program, new_state, mem_dev)
+                    _memory.track_fetches(cp.fetch_names, fetches,
+                                          mem_dev)
+                    _memory.drop_feeds(feeds, mem_dev)
                 try:
                     self._check_nan_inf(new_state, cp.fetch_names, fetches)
                 except RuntimeError as e:
@@ -734,7 +800,10 @@ class Executor(object):
                                     mutable_state=cp.mutable_state,
                                     multi=True)
                 if return_numpy:
-                    fetches = [np.asarray(f) for f in fetches]
+                    fetches = _materialize_fetches(
+                        fetches, "Executor.run_multi_step")
+                if telem:
+                    _memory.drop_fetches(cp.fetch_names, mem_dev)
             if telem or prof:
                 t1 = time.perf_counter()
                 if telem:

@@ -428,6 +428,19 @@ class Program(object):
             feed_shapes=feed_shapes, feed_names=feed_names,
             suppress=suppress)
 
+    def memory_plan(self, feed_shapes=None, fetch_names=None):
+        """Predict this program's per-step HBM high-water mark
+        (observability/memory.py): walks the liveness analysis with byte
+        accounting and returns a :class:`observability.memory.MemoryPlan`
+        — peak bytes, the op where the peak occurs, and the top live
+        tensors there. ``feed_shapes`` (name -> shape) resolves dynamic
+        (-1) dims; ``fetch_names`` anchor the live-out set."""
+        from paddle_tpu.observability import memory as _memory
+
+        return _memory.plan_program(
+            self, feed_shapes=feed_shapes,
+            fetch_names=tuple(fetch_names or ()))
+
     def _next_rng_id(self):
         self._rng_counter += 1
         return self._rng_counter

@@ -38,13 +38,26 @@ recorder can't:
   FIRST op with a non-finite output is blamed as an
   ``analysis.diagnostics.Diagnostic`` (rule N001) with a fix hint.
 
+The memory layer (this PR) makes HBM first-class alongside time and
+failures:
+
+* ``memory`` — a live-buffer ledger the executors/feed/fetch/cache/
+  checkpoint paths write (``paddle_tpu_hbm_live_bytes{device,kind}``,
+  per-step ``peak_hbm_bytes`` watermarks in the telemetry records), a
+  predicted-memory planner over the PR 3 liveness analysis
+  (``Program.memory_plan``, ``profiler.memory_stats()`` for
+  predicted-vs-measured), and OOM forensics: RESOURCE_EXHAUSTED dispatch
+  deaths become rule **M001** diagnostics — never retried — whose
+  black-box dump names the top holders and the predicted peak.
+
 ``docs/OBSERVABILITY.md`` is the operator's guide (metric catalog, how
 to read the explainer, loading the merged trace in perfetto, failure
-forensics).
+forensics, the memory ledger).
 """
 
 from paddle_tpu.observability import blackbox  # noqa: F401
 from paddle_tpu.observability import explain  # noqa: F401
+from paddle_tpu.observability import memory  # noqa: F401
 from paddle_tpu.observability import metrics_registry  # noqa: F401
 from paddle_tpu.observability import nan_provenance  # noqa: F401
 from paddle_tpu.observability import telemetry  # noqa: F401

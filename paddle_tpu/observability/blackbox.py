@@ -31,9 +31,9 @@ import traceback
 
 __all__ = [
     "ENABLED", "enable", "disable", "record", "record_dispatch",
-    "record_exception", "record_nan_diagnostic", "dump", "snapshot",
-    "thread_stacks", "events", "path", "install_handlers", "reset",
-    "guard",
+    "record_exception", "record_nan_diagnostic", "record_oom_diagnostic",
+    "dump", "snapshot", "thread_stacks", "events", "path",
+    "install_handlers", "reset", "guard",
 ]
 
 ENABLED = False
@@ -45,6 +45,7 @@ _lock = threading.Lock()
 _events = collections.deque(maxlen=_RING_CAP)
 _path = [""]
 _nan_diagnostic = [None]
+_oom_diagnostic = [None]
 _failure_dumped = [False]    # a failure dump exists: the atexit/benign
                              # dump must not overwrite the crash artifact
 
@@ -99,10 +100,11 @@ def disable():
 
 
 def reset():
-    """Drop recorded events and the NaN diagnostic (tests)."""
+    """Drop recorded events and the NaN/OOM diagnostics (tests)."""
     with _lock:
         _events.clear()
         _nan_diagnostic[0] = None
+        _oom_diagnostic[0] = None
         _failure_dumped[0] = False
 
 
@@ -158,6 +160,22 @@ def record_nan_diagnostic(diag):
     return d
 
 
+def record_oom_diagnostic(diag, top_holders=None, predicted_peak_bytes=None,
+                          live_bytes=None):
+    """File the M001 OOM finding (observability/memory.py) with the
+    ledger evidence — top live-buffer holders and the predicted peak —
+    so the dump answers 'who held the memory' without a live process.
+    tools/blackbox_dump.py exits 4 on it (distinct from NaN's 3)."""
+    d = diag.as_dict() if hasattr(diag, "as_dict") else dict(diag)
+    d["top_holders"] = list(top_holders or ())
+    d["predicted_peak_bytes"] = predicted_peak_bytes
+    d["live_bytes"] = live_bytes
+    with _lock:
+        _oom_diagnostic[0] = d
+    record("oom_diagnostic", **d)
+    return d
+
+
 def events():
     with _lock:
         return [dict(e) for e in _events]
@@ -204,11 +222,12 @@ def snapshot(reason="on_demand", stacks=False, extra=None,
     from paddle_tpu import flags
     from paddle_tpu.observability import explain, telemetry
 
-    ring, nan = _read_locked(
+    ring, nan, oom = _read_locked(
         _lock,
         lambda: ([dict(e) for e in _events],
-                 dict(_nan_diagnostic[0]) if _nan_diagnostic[0] else None),
-        ([], None), lock_timeout)
+                 dict(_nan_diagnostic[0]) if _nan_diagnostic[0] else None,
+                 dict(_oom_diagnostic[0]) if _oom_diagnostic[0] else None),
+        ([], None, None), lock_timeout)
     snap = {
         "blackbox_version": 1,
         "ts": time.time(),
@@ -226,6 +245,7 @@ def snapshot(reason="on_demand", stacks=False, extra=None,
             [], lock_timeout),
         "flags": flags.all_flags(),
         "nan_diagnostic": nan,
+        "oom_diagnostic": oom,
     }
     try:
         # fold the live explainer log back to lint diagnostics (PR 3) so

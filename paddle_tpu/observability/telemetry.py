@@ -25,6 +25,7 @@ import collections
 import threading
 import time
 
+from paddle_tpu.observability import memory as _memory
 from paddle_tpu.observability.metrics_registry import REGISTRY
 
 __all__ = [
@@ -99,13 +100,20 @@ _stage_occupancy = REGISTRY.gauge(
     "paddle_tpu_pipeline_stage_occupancy",
     "fraction of schedule ticks each pipeline stage does useful work "
     "(M/(M+S-1) for a GPipe schedule)", labels=("stage",))
+_hbm_peak = REGISTRY.gauge(
+    "paddle_tpu_hbm_peak_bytes",
+    "per-step high-water mark of ledger-tracked live bytes "
+    "(observability/memory.py watermark of the last recorded step)")
 
 
 def enable(on=True):
     """Flip telemetry at runtime (tests, notebooks). The flag only sets
-    the import-time default."""
+    the import-time default. The live-buffer ledger
+    (observability/memory.py) switches in lockstep — memory accounting
+    is part of the same flight recorder and the same overhead contract."""
     global ENABLED
     ENABLED = bool(on)
+    _memory.enable(ENABLED)
     return ENABLED
 
 
@@ -331,6 +339,20 @@ def record_step(executor, wall_s, steps=1, feed_bytes=0, fetch_bytes=0,
     if device_times:
         rec["device_times"] = {k: float(v) for k, v in device_times.items()}
         record_device_steps(device_times)
+    # HBM trajectory: the ledger's per-step watermark (measured), the
+    # registered plan's prediction, and the top holders — so the step
+    # JSONL carries the memory story tools/step_breakdown.py --memory
+    # reads offline
+    peak = _memory.take_step_peak()
+    if peak:
+        rec["peak_hbm_bytes"] = int(peak)
+        _hbm_peak.set(peak)
+    pred = _memory.predicted_peak(fingerprint)
+    if pred:
+        rec["predicted_peak_bytes"] = int(pred)
+    top = _memory.top_holders(3)
+    if top:
+        rec["hbm_top"] = [[h["name"], h["kind"], h["bytes"]] for h in top]
     mem_per = device_memory_bytes(per_device=True)
     if mem_per:
         for label, b in mem_per.items():

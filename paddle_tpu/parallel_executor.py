@@ -26,6 +26,7 @@ from paddle_tpu import profiler as _profiler
 from paddle_tpu.core import exec_cache
 from paddle_tpu.observability import blackbox as _blackbox
 from paddle_tpu.observability import explain as _explain
+from paddle_tpu.observability import memory as _memory
 from paddle_tpu.observability import telemetry as _telemetry
 from paddle_tpu.resilience import chaos as _chaos
 from paddle_tpu.resilience import retry as _retry
@@ -378,6 +379,7 @@ class ParallelExecutor(object):
             self._run_counter,
         )
         flops_avals = None
+        mem_dev = None
         if telem:
             fingerprint = _telemetry.executable_fingerprint(
                 cp, self._program)
@@ -385,6 +387,14 @@ class ParallelExecutor(object):
                 cp, state, feeds, key)
             _telemetry.record_device_transfer(
                 self._feed_bytes_by_device(cp, feeds))
+            # HBM ledger over the GLOBAL (sharded) arrays, under one
+            # 'mesh' label: per-chip residency is the measured story the
+            # per-device gauges already tell; the ledger names WHO holds
+            # the bytes, which is mesh-wide by construction
+            mem_dev = "mesh"
+            _memory.track_feeds(feeds, mem_dev)
+            _memory.register_plan_for(cp, self._program, feed_specs,
+                                      fingerprint)
         if _blackbox.ENABLED:
             _blackbox.record_dispatch(
                 "ParallelExecutor.run", feed_specs=feed_specs,
@@ -398,6 +408,10 @@ class ParallelExecutor(object):
             cp, state, feeds, key, origin="ParallelExecutor.dispatch")
         for n, val in new_state.items():
             self._scope.set_value(n, val)
+        if telem:
+            _memory.track_state(cp, self._program, new_state, mem_dev)
+            _memory.track_fetches(cp.fetch_names, fetches, mem_dev)
+            _memory.drop_feeds(feeds, mem_dev)
         device_times = None
         if telem and return_numpy:
             # per-device dispatch->ready latency, measured on the live
@@ -409,7 +423,18 @@ class ParallelExecutor(object):
             device_times = _telemetry.device_step_times(
                 list(fetches) + list(new_state.values()), t_disp)
         if return_numpy:
-            fetches = [self._fetch_to_numpy(f) for f in fetches]
+            try:
+                fetches = [self._fetch_to_numpy(f) for f in fetches]
+            except Exception as exc:
+                # allocator deaths can surface at the host read, not the
+                # dispatch — same M001 forensics as Executor._dispatch
+                if _memory.is_oom(exc) and not isinstance(
+                        exc, _memory.MemoryExhaustedError):
+                    _memory.enrich_and_raise(
+                        exc, origin="ParallelExecutor.fetch")
+                raise
+        if telem:
+            _memory.drop_fetches(cp.fetch_names, mem_dev)
         if telem or prof:
             t1 = time.perf_counter()
             if telem:

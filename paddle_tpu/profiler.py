@@ -39,6 +39,7 @@ __all__ = [
     "RecordEvent",
     "exec_cache_stats",
     "step_stats",
+    "memory_stats",
     "record_span",
 ]
 
@@ -202,6 +203,42 @@ def step_stats(peak=None):
     from paddle_tpu.observability import telemetry
 
     return telemetry.step_stats(peak=peak)
+
+
+def memory_stats():
+    """Predicted-vs-measured HBM report (observability/memory.py).
+
+    ``measured_peak_bytes`` is the high-water mark of ledger-tracked
+    bytes over the recorded step window (max of the per-record
+    watermarks, falling back to the current live total);
+    ``predicted_peak_bytes`` is the largest registered memory-plan peak,
+    with the plan detail (op, top tensors) under ``predicted_plan``.
+    Needs FLAGS_telemetry=1 (or telemetry.enable()) while the steps ran;
+    with telemetry off this is a pull-based read of empty state — zero
+    hot-path overhead either way."""
+    from paddle_tpu.observability import memory, telemetry
+
+    recs = telemetry.step_records()
+    measured = max(
+        (r.get("peak_hbm_bytes", 0) for r in recs), default=0)
+    measured = measured or memory.live_bytes() or None
+    plans = memory.plans()
+    predicted = max(
+        (p["peak_bytes"] for p in plans.values()), default=0) or None
+    out = {
+        "live_bytes": memory.live_bytes(),
+        "live_by_kind": memory.live_by_kind(),
+        "live_by_device": memory.live_by_device(),
+        "measured_peak_bytes": measured,
+        "predicted_peak_bytes": predicted,
+        "predicted_plan": memory.last_plan(),
+        "top_holders": memory.top_holders(5),
+        "plans_registered": len(plans),
+    }
+    if measured and predicted:
+        out["predicted_over_measured"] = round(
+            float(predicted) / float(measured), 4)
+    return out
 
 
 def _emit_exec_cache_report(print_report):

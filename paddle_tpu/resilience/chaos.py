@@ -12,12 +12,16 @@ Spec grammar (``FLAGS_chaos_spec``)::
     spec    := clause (';' clause)*
     clause  := 'seed=' INT                      -- RNG seed for p= draws
              | kind '@' param (',' param)*
-    kind    := 'kill' | 'io' | 'compile' | 'slow'
+    kind    := 'kill' | 'io' | 'compile' | 'slow' | 'oom'
     param   := 'site=' NAME    -- site to arm (default: kind's home site)
              | 'step=' INT     -- fire exactly when the caller's step == N
              | 'p=' FLOAT      -- fire probability per visit (seeded draw)
              | 'n=' INT        -- total fire budget (default: kill 1, else
                                   unlimited)
+             | 'skip=' INT     -- ignore the first K visits to the site
+                                  (deterministic "fail LATER" at sites
+                                  that don't pass a step number, e.g.
+                                  exec.dispatch after warmup steps)
              | 'secs=' FLOAT   -- sleep length (slow only, default 0.1)
 
 Examples::
@@ -44,7 +48,11 @@ resume behavior instead of flaky approximations.
 Injected faults raise :class:`ChaosIOError` (an ``IOError``) or
 :class:`ChaosTransientError` — both classified retryable by
 ``resilience/retry.py``, so a run with retries enabled must *survive*
-them and a run without must die loudly. Every fire is counted
+them and a run without must die loudly. The ``oom`` kind raises
+:class:`ChaosOOMError`, a RESOURCE_EXHAUSTED-style failure classified
+NEVER-transient: a run with retries enabled must die on the FIRST
+attempt (no budget burned on a deterministic allocator death) and leave
+an M001 black-box dump (observability/memory.py). Every fire is counted
 (``paddle_tpu_chaos_faults_total{site,kind}``) and filed to the black
 box, so a test can prove the fault actually happened rather than pass
 vacuously. ``ENABLED`` is a module bool: with the flag unset every
@@ -60,8 +68,8 @@ import time
 from paddle_tpu.observability.metrics_registry import REGISTRY
 
 __all__ = [
-    "ENABLED", "ChaosIOError", "ChaosTransientError", "configure",
-    "disable", "fault", "clauses", "fires",
+    "ENABLED", "ChaosIOError", "ChaosTransientError", "ChaosOOMError",
+    "configure", "disable", "fault", "clauses", "fires",
 ]
 
 ENABLED = False
@@ -75,7 +83,12 @@ class ChaosTransientError(RuntimeError):
     """Injected transient runtime failure (compile/dispatch/RPC)."""
 
 
-_KINDS = ("kill", "io", "compile", "slow")
+class ChaosOOMError(RuntimeError):
+    """Injected RESOURCE_EXHAUSTED: deterministic, classified
+    never-transient (observability/memory.py M001 path)."""
+
+
+_KINDS = ("kill", "io", "compile", "slow", "oom")
 _HOME_SITE = {"kill": "session.step", "compile": "exec.compile"}
 
 _lock = threading.Lock()
@@ -95,6 +108,7 @@ def _parse_clause(text, index, seed):
             % (kind, ", ".join(_KINDS)))
     c = {"kind": kind, "site": _HOME_SITE.get(kind), "step": None,
          "p": None, "n": 1 if kind == "kill" else None, "secs": 0.1,
+         "skip": 0, "visits": 0,
          # int-mixed per-clause stream: deterministic across processes
          # (unlike tuple seeding, which hashes) and independent per clause
          "rng": random.Random(seed * 1000003 + index), "fired": 0}
@@ -109,6 +123,8 @@ def _parse_clause(text, index, seed):
             c["p"] = float(v)
         elif k == "n":
             c["n"] = int(v)
+        elif k == "skip":
+            c["skip"] = int(v)
         elif k == "secs":
             c["secs"] = float(v)
         else:
@@ -186,6 +202,9 @@ def fault(site, step=None):
                 continue
             if c["n"] is not None and c["fired"] >= c["n"]:
                 continue
+            c["visits"] += 1
+            if c["visits"] <= c["skip"]:
+                continue
             if c["step"] is not None:
                 if step is None or int(step) != c["step"]:
                     continue
@@ -207,6 +226,13 @@ def fault(site, step=None):
     elif kind == "compile":
         raise ChaosTransientError(
             "chaos: injected transient failure at %s" % site)
+    elif kind == "oom":
+        # the XLA allocator's status wording, so every layer that keys
+        # on RESOURCE_EXHAUSTED (retry veto, M001 enrichment) treats the
+        # injected fault exactly like the real one
+        raise ChaosOOMError(
+            "RESOURCE_EXHAUSTED: chaos: injected out-of-memory at %s"
+            % site)
     elif kind == "slow":
         time.sleep(secs)
 

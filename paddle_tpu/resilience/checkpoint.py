@@ -237,6 +237,33 @@ class CheckpointManager(object):
 
     # -- save ---------------------------------------------------------------
 
+    # HBM ledger: the snapshot's host copies are live bytes this process
+    # holds until the (possibly async) write completes — visible on the
+    # 'cache' series. Tracked AFTER wait() joins any previous writer
+    # (whose finally drops the shared key — tracking earlier would let
+    # that drop erase the new entry), released in _write_guarded / save.
+
+    @staticmethod
+    def _track_snapshot_ledger(snap):
+        try:
+            from paddle_tpu.observability import memory as _memory
+
+            if _memory.ENABLED:
+                _memory.track("checkpoint_snapshot",
+                              sum(a.nbytes for a in snap.values()),
+                              "cache")
+        except Exception:
+            pass
+
+    @staticmethod
+    def _drop_snapshot_ledger():
+        try:
+            from paddle_tpu.observability import memory as _memory
+
+            _memory.drop("checkpoint_snapshot", "cache")
+        except Exception:
+            pass
+
     def save(self, step, serial=None, extra=None):
         """Synchronous save: snapshot + write + rename before returning.
         Returns the final checkpoint path. Raises on failure (async saves
@@ -244,9 +271,13 @@ class CheckpointManager(object):
         snap = self._snapshot(self._live_scope())
         rng = self._rng_state()
         self.wait()
-        return self._write(snap, rng, int(step),
-                           int(serial if serial is not None else step),
-                           extra or {})
+        self._track_snapshot_ledger(snap)
+        try:
+            return self._write(snap, rng, int(step),
+                               int(serial if serial is not None else step),
+                               extra or {})
+        finally:
+            self._drop_snapshot_ledger()
 
     def save_async(self, step, serial=None, extra=None):
         """Snapshot on the calling thread, write on a background one.
@@ -256,6 +287,7 @@ class CheckpointManager(object):
         rng = self._rng_state()
         serial = int(serial if serial is not None else step)
         self.wait()
+        self._track_snapshot_ledger(snap)
         t = threading.Thread(
             target=self._write_guarded,
             args=(snap, rng, int(step), serial, extra or {}),
@@ -276,6 +308,8 @@ class CheckpointManager(object):
             self._write(snap, rng, step, serial, extra)
         except Exception as exc:  # noqa: BLE001 - async: report, don't kill
             self.last_error = exc
+        finally:
+            self._drop_snapshot_ledger()
 
     def _write(self, snap, rng, step, serial, extra):
         t0 = time.perf_counter()

@@ -37,9 +37,13 @@ __all__ = [
 ]
 
 # substrings of RPC-ish status messages worth retrying when they arrive
-# wrapped in a backend RuntimeError instead of a typed OSError
+# wrapped in a backend RuntimeError instead of a typed OSError.
+# RESOURCE_EXHAUSTED is deliberately NOT here: an XLA allocator OOM is
+# deterministic for a given program and batch — retrying replays the
+# same death N times, burning the budget AND the accelerator-hours
+# (observability/memory.py classifies it, rule M001).
 _TRANSIENT_MARKERS = (
-    "UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE", "DEADLINE_EXCEEDED",
     "connection reset", "temporarily unavailable",
 )
 
@@ -71,15 +75,23 @@ def is_transient(exc):
               not-a-directory), RuntimeErrors carrying RPC status markers
               (UNAVAILABLE...)
     never     ProgramVerifyError, NaN/Inf trips (deterministic replays),
+              RESOURCE_EXHAUSTED/OOM (deterministic allocator deaths —
+              rule M001, observability/memory.py),
               ValueError/TypeError/KeyError/AssertionError (user errors),
               FileNotFoundError/PermissionError and kin, everything else
     """
+    from paddle_tpu.observability.memory import is_oom
     from paddle_tpu.resilience.chaos import (
         ChaosIOError, ChaosTransientError)
 
     if isinstance(exc, (TransientError, ChaosIOError,
                         ChaosTransientError)):
         return True
+    if is_oom(exc):
+        # checked BEFORE the marker scan: the same program at the same
+        # batch OOMs the same way every attempt — a retry budget spent
+        # here masks the real fix (donate, shrink, shard)
+        return False
     if isinstance(exc, (ValueError, TypeError, KeyError, AssertionError)):
         return False
     try:

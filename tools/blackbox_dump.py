@@ -6,10 +6,13 @@ process doing (last flight events + step tail), why did it last
 recompile, where was every thread (if the dump carries stacks), and did
 a NaN diagnostic fire (and on which op).
 
-Exit codes (CI-gateable, used by the ``forensics`` stage):
-  0  dump read, no NaN diagnostic recorded
+Exit codes (CI-gateable, used by the ``forensics``/``chaos`` stages):
+  0  dump read, no NaN/OOM diagnostic recorded
   2  file missing / unreadable / not a black box
   3  the dump records a NaN-provenance diagnostic (rule N001)
+  4  the dump records an OOM diagnostic (rule M001 — top live-buffer
+     holders + predicted peak; takes precedence over 3 when both exist,
+     the allocator death being the step that actually killed the run)
 
 Usage:
   python tools/blackbox_dump.py /path/box.json [--steps 10] [--events 15]
@@ -105,6 +108,11 @@ def _print_events(snap, n):
             line += " %s at block %s op %s (%s)" % (
                 e.get("rule"), e.get("block_idx"), e.get("op_idx"),
                 e.get("op_type"))
+        elif kind == "oom_diagnostic":
+            line += " %s live=%s holders=%s" % (
+                e.get("rule"), e.get("live_bytes"),
+                ",".join(h.get("name", "?")
+                         for h in e.get("top_holders") or []))
         print(line)
 
 
@@ -135,6 +143,30 @@ def _print_nan(snap):
     return True
 
 
+def _print_oom(snap):
+    d = snap.get("oom_diagnostic")
+    if not d:
+        return False
+    print("\n-- OOM diagnostic (%s %s) --" % (d.get("rule"),
+                                              d.get("name")))
+    print("  %s" % d.get("message"))
+    holders = d.get("top_holders") or []
+    if holders:
+        print("  top live-buffer holders:")
+        for h in holders:
+            print("    %-32s %-10s %-8s %12d bytes"
+                  % (h.get("name"), h.get("kind"), h.get("device"),
+                     h.get("bytes", 0)))
+    if d.get("predicted_peak_bytes"):
+        print("  predicted peak: %d bytes (memory plan)"
+              % d["predicted_peak_bytes"])
+    if d.get("live_bytes") is not None:
+        print("  ledger live at death: %d bytes" % d["live_bytes"])
+    if d.get("hint"):
+        print("  hint: %s" % d["hint"])
+    return True
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="pretty-print a paddle_tpu black box dump")
@@ -151,6 +183,8 @@ def main(argv=None):
     if args.json:
         json.dump(snap, sys.stdout, indent=2, sort_keys=True)
         print()
+        if snap.get("oom_diagnostic"):
+            return 4
         return 3 if snap.get("nan_diagnostic") else 0
 
     print("black box: %s" % args.path)
@@ -163,6 +197,9 @@ def main(argv=None):
     _print_events(snap, args.events)
     _print_stacks(snap)
     has_nan = _print_nan(snap)
+    has_oom = _print_oom(snap)
+    if has_oom:
+        return 4
     return 3 if has_nan else 0
 
 

@@ -1,7 +1,7 @@
 """Chaos smoke (CI ``chaos`` stage): kill training the way production
 does, then prove recovery is exact — not approximate.
 
-Three legs, all asserted from the parent:
+Four legs, all asserted from the parent:
 
 1. **Preemption leg** — a TrainSession child is SIGKILLed by a seeded
    chaos kill-point mid-run (no cleanup, like a real preemption). A
@@ -18,6 +18,13 @@ Three legs, all asserted from the parent:
 3. **Corruption leg** — the parent flips bytes in the newest checkpoint;
    the next child must quarantine it (``.corrupt-`` dir kept for
    autopsy) and resume from the previous complete serial.
+4. **OOM leg** — a child with retries ENABLED hits an injected
+   ``oom`` fault at ``exec.dispatch`` (a RESOURCE_EXHAUSTED allocator
+   death, deterministic). It must die on the FIRST attempt — zero
+   retries in the scrape, no budget burned replaying a deterministic
+   failure — and leave a black box whose M001 diagnostic names the
+   top-3 live-buffer holders; ``tools/blackbox_dump.py`` must surface
+   it with its distinct exit code (4).
 
 The ``child`` subcommand is the training worker (also driven directly by
 ``tests/test_resilience.py``): a deterministic 2-layer MLP + dropout
@@ -205,6 +212,49 @@ def _corruption_leg(tmp):
           "from step %d" % (latest, quarantined[0], res["resumed_step"]))
 
 
+def _oom_leg(tmp):
+    prom = os.path.join(tmp, "oom.prom")
+    box = os.path.join(tmp, "oom.box.json")
+    # skip=3: startup dispatch + two clean train steps pass (populating
+    # the ledger: params, opt state, feeds), the third step's dispatch
+    # dies RESOURCE_EXHAUSTED — deterministic, like a real allocator OOM
+    rc, _out = _run_child(
+        tmp, "oom", "train", 8,
+        _env(chaos_spec="oom@site=exec.dispatch,skip=3,n=1",
+             FLAGS_dispatch_retries=3, FLAGS_retry_backoff_s=0.01,
+             FLAGS_telemetry=1, FLAGS_metrics_path=prom,
+             FLAGS_blackbox_path=box))
+    assert rc > 0, (
+        "an injected OOM is deterministic and never retried: the run "
+        "must die by the exception (got rc=%d)" % rc)
+    with open(prom) as f:
+        scrape = f.read()
+    retr = [line for line in scrape.splitlines()
+            if line.startswith("paddle_tpu_retries_total")]
+    total = sum(float(line.rsplit(None, 1)[-1]) for line in retr)
+    assert total == 0, (
+        "OOM must be classified never-transient — %d retry(ies) burned "
+        "their budget on a deterministic death: %r" % (int(total), retr))
+    with open(box) as f:
+        snap = json.load(f)
+    diag = snap.get("oom_diagnostic")
+    assert diag and diag.get("rule") == "M001", (
+        "black box must carry the M001 diagnostic, got %r" % (diag,))
+    holders = diag.get("top_holders") or []
+    assert len(holders) >= 3, (
+        "M001 must name the top-3 live-buffer holders, got %r" % holders)
+    kinds = [e["kind"] for e in snap["events"]]
+    assert "chaos_fault" in kinds and "oom_diagnostic" in kinds, kinds
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "blackbox_dump.py"),
+         box], stdout=subprocess.DEVNULL)
+    assert proc.returncode == 4, (
+        "blackbox_dump must exit 4 on an M001 dump, got %d"
+        % proc.returncode)
+    print("chaos oom leg OK: died first attempt, 0 retries, M001 names "
+          "%s" % ", ".join(h["name"] for h in holders[:3]))
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "child":
         p = argparse.ArgumentParser()
@@ -222,6 +272,7 @@ def main():
         _preemption_leg(tmp)
         _retry_leg(tmp)
         _corruption_leg(tmp)
+        _oom_leg(tmp)
     print("chaos smoke OK")
 
 

@@ -86,6 +86,9 @@ MODULES = [
     "paddle_tpu.resilience.session",
     "paddle_tpu.resilience.retry",
     "paddle_tpu.resilience.chaos",
+    # PR 6: the memory surface (live-buffer ledger / memory plan / OOM
+    # forensics) — what capacity planning scripts against
+    "paddle_tpu.observability.memory",
 ]
 
 

@@ -3,8 +3,8 @@
 # repo): native C++ build + its unit tests, the Python suite on the
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
-# (native|python|lint|warm|metrics|forensics|chaos|dryrun|bench) to run
-# a subset.
+# (native|python|lint|warm|metrics|forensics|chaos|dryrun|bench|perfgate)
+# to run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -13,7 +13,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-ALL_STAGES=(native python lint warm metrics forensics chaos dryrun bench)
+ALL_STAGES=(native python lint warm metrics forensics chaos dryrun bench
+            perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -147,6 +148,25 @@ missing = [m for m in want if m not in models]
 assert not missing, "bench missing results for %s: %s" % (
     missing, rec.get("error"))
 '
+fi
+
+if want perfgate; then
+  echo "== perf/memory regression gate (CPU mini-bench vs budgets) =="
+  # the CPU mini-bench runs with telemetry ON so the capture carries
+  # step_ms percentiles + the HBM trajectory (peak_hbm_bytes measured by
+  # the live-buffer ledger, predicted_peak_bytes from the memory plan);
+  # tools/perf_diff.py gates it against the checked-in budgets —
+  # deterministic counters (fresh compiles, predicted peak) fail on ANY
+  # increase, timings get the budgets' noise band
+  gdir="$(mktemp -d)"
+  trap 'rm -rf "$gdir"' EXIT
+  BENCH_PLATFORM=cpu FLAGS_telemetry=1 python bench.py \
+    | tail -1 > "$gdir/candidate.json"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/perf_diff.py "$gdir/candidate.json" \
+      --budgets benchmark/budgets.json
+  rm -rf "$gdir"
+  trap - EXIT
 fi
 
 echo "CI OK"

@@ -13,7 +13,10 @@ Two independent views, printed as JSON lines:
    a training/serving process left behind); ``--per-device`` adds the
    per-device view over the labeled step records (dispatch->ready time
    per device and the straggler ratio) that the multichip telemetry
-   writes into each record.
+   writes into each record; ``--memory`` adds the HBM view — per-step
+   peak watermark trajectory, predicted-vs-measured peak, and the top
+   ledger holders (observability/memory.py writes all three into the
+   records).
 3. ``--xprof`` — run the full step under ``jax.profiler.trace`` and
    aggregate XLA op self-times from the xplane.pb the profiler writes.
    The xplane wire format is decoded directly (a ~60-line generic
@@ -281,7 +284,42 @@ def _percentile(vals, q):
     return vals[k]
 
 
-def _summarize_jsonl(recs, per_device=False):
+def _summarize_memory(recs):
+    """The HBM view over a telemetry snapshot: watermark trajectory,
+    predicted-vs-measured, top holders — same friendly degradation as
+    --per-device when the records carry no memory fields."""
+    with_mem = [r for r in recs if r.get("peak_hbm_bytes")]
+    if not with_mem:
+        print(json.dumps({
+            "memory": None,
+            "note": "no record carries peak_hbm_bytes — the snapshot "
+                    "predates the memory ledger or telemetry ran "
+                    "without any executor step (the ledger is written "
+                    "by Executor/ParallelExecutor runs)"}))
+        return
+    peaks = [r["peak_hbm_bytes"] for r in with_mem]
+    preds = [r["predicted_peak_bytes"] for r in with_mem
+             if r.get("predicted_peak_bytes")]
+    last = with_mem[-1]
+    out = {
+        "records_with_memory": len(with_mem),
+        "peak_hbm_mb": {
+            "max": round(max(peaks) / 1e6, 3),
+            "p95": round((_percentile(peaks, 95) or 0) / 1e6, 3),
+            "last": round(peaks[-1] / 1e6, 3),
+        },
+        "predicted_peak_mb": (round(max(preds) / 1e6, 3) if preds
+                              else None),
+        "predicted_over_measured": (round(max(preds) / max(peaks), 3)
+                                    if preds and max(peaks) else None),
+        "top_holders": [
+            {"name": n, "kind": k, "mb": round(b / 1e6, 3)}
+            for n, k, b in (last.get("hbm_top") or [])],
+    }
+    print(json.dumps(out))
+
+
+def _summarize_jsonl(recs, per_device=False, memory=False):
     timed = [r for r in recs if not r.get("dispatch_only")]
     per_step = [r["step_s"] for r in timed]
     print(json.dumps({
@@ -296,6 +334,8 @@ def _summarize_jsonl(recs, per_device=False):
         "fetch_mb": round(sum(r.get("fetch_bytes", 0)
                               for r in recs) / 1e6, 3),
     }))
+    if memory:
+        _summarize_memory(recs)
     if not per_device:
         return
     with_dev = [r for r in recs if r.get("device_times")]
@@ -346,12 +386,20 @@ def main():
     ap.add_argument("--per-device", action="store_true",
                     help="with --from-jsonl: per-device step-time table "
                          "over the labeled step records")
+    ap.add_argument("--memory", action="store_true",
+                    help="with --from-jsonl: peak-HBM trajectory, "
+                         "predicted-vs-measured peak, top ledger holders")
     args = ap.parse_args()
 
     if args.from_jsonl:
         _summarize_jsonl(_load_steps_jsonl(args.from_jsonl),
-                         per_device=args.per_device)
+                         per_device=args.per_device, memory=args.memory)
         return
+    if args.memory:
+        sys.exit(
+            "step_breakdown: --memory reads a telemetry snapshot — pass "
+            "--from-jsonl <p>.steps.jsonl (run the workload with "
+            "FLAGS_telemetry=1 and FLAGS_metrics_path=<p> to produce one)")
 
     import jax
 
