@@ -40,6 +40,8 @@ from paddle_tpu.analysis.verify import verify as verify_program  # noqa: F401
 from paddle_tpu.analysis.lint import lint as lint_program  # noqa: F401
 from paddle_tpu.analysis.lint import lint_events  # noqa: F401
 from paddle_tpu.analysis.liveness import analyze as analyze_liveness  # noqa: F401
+from paddle_tpu.analysis.shard_check import check_sharding  # noqa: F401
+from paddle_tpu.analysis import shard_check  # noqa: F401
 from paddle_tpu.analysis import verify  # noqa: F401
 from paddle_tpu.analysis import lint  # noqa: F401
 from paddle_tpu.analysis import liveness  # noqa: F401
@@ -55,4 +57,5 @@ __all__ = [
     "lint_program",
     "lint_events",
     "analyze_liveness",
+    "check_sharding",
 ]

@@ -1,0 +1,161 @@
+"""Rule S001: validate sharding specs against the program and the mesh.
+
+A hand-written ``tp_layout``/``sharding_overrides`` entry (or a derived
+spec) that names an unknown var, is longer than the var's rank, or
+references a mesh axis that does not exist would otherwise surface as an
+opaque XLA shape error minutes into the first compile. This module turns
+each of those into a rule-tagged :class:`Diagnostic` at *transpile* time,
+the same contract the V/L rules give the verifier and linter
+(docs/ANALYSIS.md has the catalog entry).
+
+Checks, per (var name, spec):
+
+* **unknown-var** — the name resolves in no block of the program;
+* **rank-excess** — the spec has more entries than the var has dims;
+* **unknown-axis** — the spec names an axis absent from the mesh;
+* **non-divisible** — a dim's size is not a multiple of the product of
+  the mesh-axis sizes sharding it (jax rejects uneven NamedShardings at
+  compile time with a far less actionable message).
+
+All four are severity "error": every one of them is a guaranteed
+compile-time death or a silently wrong layout.
+"""
+
+from paddle_tpu.analysis.diagnostics import Diagnostic
+
+__all__ = ["RULE", "RULE_NAME", "check_sharding", "normalize_spec",
+           "spec_axes", "spec_shard_factor"]
+
+RULE = "S001"
+RULE_NAME = "bad-sharding-spec"
+
+
+def normalize_spec(spec):
+    """Canonical tuple form of one sharding spec.
+
+    Accepts a ``jax.sharding.PartitionSpec``, a plain tuple/list, a bare
+    axis string, or None (replicated). Entries are None, an axis name,
+    or a tuple of axis names (a dim sharded over several axes at once).
+    Raises ValueError on anything else — the caller maps that to S001.
+    """
+    if spec is None:
+        return ()
+    # PartitionSpec is a tuple subclass in modern jax; duck-type on
+    # iterability so plain tuples/lists and PartitionSpec all normalize
+    if isinstance(spec, str):
+        spec = (spec,)
+    entries = []
+    for e in tuple(spec):
+        if e is None:
+            entries.append(None)
+        elif isinstance(e, str):
+            entries.append(e)
+        elif isinstance(e, (tuple, list)):
+            if not all(isinstance(a, str) for a in e):
+                raise ValueError("nested spec entry %r mixes non-axis "
+                                 "values" % (e,))
+            entries.append(tuple(e))
+        else:
+            raise ValueError("spec entry %r is not None, an axis name, "
+                             "or a tuple of axis names" % (e,))
+    return tuple(entries)
+
+
+def _entry_axes(entry):
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def spec_axes(spec):
+    """Flat tuple of every axis name a (normalized) spec references."""
+    out = []
+    for e in normalize_spec(spec):
+        out.extend(_entry_axes(e))
+    return tuple(out)
+
+
+def spec_shard_factor(spec, mesh_axes):
+    """How many ways the spec splits the array: the product of the sizes
+    of every referenced mesh axis (1 for a replicated/empty spec)."""
+    factor = 1
+    for a in spec_axes(spec):
+        factor *= int(mesh_axes.get(a, 1))
+    return factor
+
+
+def _mesh_axes_dict(mesh_axes):
+    shape = getattr(mesh_axes, "shape", None)
+    if shape is not None and not isinstance(mesh_axes, dict):
+        return {str(a): int(s) for a, s in dict(shape).items()}
+    return {str(a): int(s) for a, s in dict(mesh_axes).items()}
+
+
+def _find_var(program, name):
+    for block in program.blocks:
+        v = block.vars.get(name)
+        if v is not None:
+            return v
+    return None
+
+
+def check_sharding(program, mesh_axes, specs, origin="sharding spec"):
+    """Validate ``specs`` ({var name -> PartitionSpec/tuple}) against
+    ``program`` and ``mesh_axes`` (a Mesh or {axis: size} dict). Returns
+    a list of S001 :class:`Diagnostic` findings (empty when clean)."""
+    axes = _mesh_axes_dict(mesh_axes)
+    diags = []
+
+    def _flag(message, name, hint):
+        diags.append(Diagnostic(
+            RULE, RULE_NAME, "error", "%s: %s" % (origin, message),
+            var_names=(name,), hint=hint))
+
+    for name in sorted(specs or {}):
+        raw = specs[name]
+        try:
+            spec = normalize_spec(raw)
+        except ValueError as e:
+            _flag("spec for %r is malformed (%s)" % (name, e), name,
+                  "use None, an axis name, or a tuple of axis names per "
+                  "dim, e.g. ('fsdp', 'tp')")
+            continue
+        v = _find_var(program, name)
+        if v is None:
+            _flag("spec names unknown var %r" % name, name,
+                  "check the spelling against the program's parameters "
+                  "(debugger.program_to_code lists them)")
+            continue
+        shape = getattr(v, "shape", None)
+        if shape is not None and len(spec) > len(shape):
+            _flag("spec %s for %r has %d entries but the var is rank %d"
+                  % (spec, name, len(spec), len(shape)), name,
+                  "trim the spec to one entry per dim (trailing dims "
+                  "default to replicated)")
+            continue
+        bad_axis = [a for a in spec_axes(spec) if a not in axes]
+        if bad_axis:
+            _flag("spec %s for %r references mesh axis %s absent from "
+                  "the mesh (axes: %s)"
+                  % (spec, name, "/".join(sorted(set(bad_axis))),
+                     sorted(axes)), name,
+                  "build the mesh with that axis "
+                  "(parallel.build_mesh(data=..., fsdp=..., tp=...)) or "
+                  "rename the spec's axis")
+            continue
+        if shape is not None:
+            for i, entry in enumerate(spec):
+                factor = 1
+                for a in _entry_axes(entry):
+                    factor *= axes.get(a, 1)
+                dim = int(shape[i])
+                if factor > 1 and dim > 0 and dim % factor:
+                    _flag("dim %d of %r (size %d) is not divisible by "
+                          "the %s-way split of spec entry %r"
+                          % (i, name, dim, factor, entry), name,
+                          "pad the dim to a multiple of %d or shard a "
+                          "different dim" % factor)
+                    break
+    return diags

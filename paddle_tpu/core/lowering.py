@@ -33,6 +33,7 @@ class BlockLowerer(object):
         self.program = program
         self.block = program.block(block_idx)
         self.is_test = is_test
+        self._reshard_names = None  # lazy: vars carrying a reshard_spec
 
     def analyze(self, scope_names, feed_names):
         """Classify variable usage for the compiled step signature.
@@ -124,7 +125,55 @@ class BlockLowerer(object):
             names = op.output(slot)
             for name, val in zip(names, arrs):
                 if name and val is not None:
-                    env[name] = val
+                    env[name] = self._apply_reshard(name, val)
+
+    def _apply_reshard(self, name, val):
+        """Explicit resharding point: a var the sharding transpiler
+        (parallel/sharding.py) marked with ``reshard_spec`` — a
+        tp-partial activation flowing into an op with no tp story — gets
+        a ``with_sharding_constraint`` at its producer, so the conflict
+        resolves as ONE visible collective instead of silent replication
+        of the producing weight. Applies only under a mesh compile whose
+        axes cover the spec (a later single-device or legacy-mesh compile
+        of the same annotated program is untouched)."""
+        names = self._reshard_names
+        if names is None:
+            # one sweep over the block chain; the common (unannotated)
+            # case then skips the per-output recursive var lookup
+            names = set()
+            b = self.block
+            while b is not None:
+                for n, bv in b.vars.items():
+                    if getattr(bv, "reshard_spec", None) is not None:
+                        names.add(n)
+                b = b.parent_block
+            self._reshard_names = names
+        if name not in names:
+            return val
+        v = self.block._find_var_recursive(name)
+        spec = getattr(v, "reshard_spec", None)
+        if spec is None:
+            return val
+        mesh = ambient_mesh()
+        if mesh is None:
+            return val
+        axes = set()
+        for entry in spec:
+            if isinstance(entry, str):
+                axes.add(entry)
+            elif entry is not None:
+                axes.update(entry)
+        if not axes.issubset(set(mesh.shape)):
+            return val
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        try:
+            return jax.lax.with_sharding_constraint(
+                val, NamedSharding(mesh, PartitionSpec(*spec)))
+        except Exception:
+            # rank drift between annotation and trace (reshaped program):
+            # the constraint is an optimization hint, never a hard failure
+            return val
 
     def lower_sub_block(self, block_idx, env, step_key):
         """Lower a nested block (control-flow mega-ops) in-place on env."""

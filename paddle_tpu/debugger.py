@@ -8,6 +8,24 @@ the op graph).
 __all__ = ["program_to_code", "draw_block_graphviz", "dump_sharding_plan"]
 
 
+def _spec_label(v):
+    """'P(fsdp, tp)'-style label for a var the sharding transpiler
+    annotated (parallel/sharding.py stamps ``partition_spec`` /
+    ``reshard_spec``); None when unannotated."""
+    spec = getattr(v, "partition_spec", None)
+    reshard = getattr(v, "reshard_spec", None)
+    if spec is None and reshard is None:
+        return None
+    from paddle_tpu.parallel.sharding import _spec_str
+
+    parts = []
+    if spec is not None:
+        parts.append(_spec_str(spec))
+    if reshard is not None:
+        parts.append("reshard->%s" % _spec_str(reshard))
+    return " ".join(parts)
+
+
 def _fmt_var(v):
     from paddle_tpu.framework import Parameter
 
@@ -19,10 +37,12 @@ def _fmt_var(v):
         extras.append("persist")
     if v.stop_gradient:
         extras.append("stop_grad")
-    return "%s %s : %s%s %s" % (
+    spec = _spec_label(v)
+    return "%s %s : %s%s %s%s" % (
         kind, v.name, v.dtype,
         list(v.shape) if v.shape is not None else "?",
         ",".join(extras),
+        "  @" + spec if spec else "",
     )
 
 
@@ -96,8 +116,11 @@ def draw_block_graphviz(block, highlights=None, path="/tmp/program.dot",
             color = ', style=filled, fillcolor="#ffd2d2"' if (
                 name in highlights
             ) else ""
+            v = block._find_var_recursive(name)
+            spec = _spec_label(v) if v is not None else None
+            label = "%s\\n%s" % (name, spec) if spec else name
             lines.append(
-                '  %s [label="%s", shape=oval%s];' % (nid, name, color)
+                '  %s [label="%s", shape=oval%s];' % (nid, label, color)
             )
         return nid
 
@@ -128,11 +151,28 @@ def draw_block_graphviz(block, highlights=None, path="/tmp/program.dot",
 
 
 def dump_sharding_plan(policy, file=None):
-    """Print a ShardingPolicy's var->PartitionSpec plan (parallel/mesh.py),
-    flagging vars that fell back to replication ("no silent caps")."""
+    """Print a sharding plan's var->PartitionSpec table, flagging vars
+    that fell back to replication ("no silent caps"). Accepts a
+    ShardingPolicy / DerivedShardingPolicy (parallel) or a raw derived
+    :class:`parallel.sharding.ShardingPlan`."""
     import sys
 
+    from paddle_tpu.parallel.sharding import ShardingPlan, _spec_str
+
     out = file or sys.stdout
+    if isinstance(policy, ShardingPlan):
+        print("derived sharding plan (mesh=%s):" % (policy.mesh_axes,),
+              file=out)
+        for name in sorted(policy.specs):
+            note = policy.notes.get(name, "")
+            print("  %-40s %s%s" % (name, _spec_str(policy.specs[name]),
+                                    "  [" + note + "]" if note else ""),
+                  file=out)
+        for r in policy.reshard_points:
+            print("  reshard %-32s at op %s (%s) -> %s"
+                  % (r["var"], r["op_idx"], r["op_type"], r["spec"]),
+                  file=out)
+        return
     print("sharding plan (mesh=%s, strategy=%s):"
           % (dict(policy.mesh.shape), policy.strategy), file=out)
     for name, (spec, note) in policy.plan().items():

@@ -428,18 +428,40 @@ class Program(object):
             feed_shapes=feed_shapes, feed_names=feed_names,
             suppress=suppress)
 
-    def memory_plan(self, feed_shapes=None, fetch_names=None):
+    def memory_plan(self, feed_shapes=None, fetch_names=None,
+                    shard_factors=None):
         """Predict this program's per-step HBM high-water mark
         (observability/memory.py): walks the liveness analysis with byte
         accounting and returns a :class:`observability.memory.MemoryPlan`
         — peak bytes, the op where the peak occurs, and the top live
         tensors there. ``feed_shapes`` (name -> shape) resolves dynamic
-        (-1) dims; ``fetch_names`` anchor the live-out set."""
+        (-1) dims; ``fetch_names`` anchor the live-out set.
+        ``shard_factors`` ({var -> ways split}, e.g. from
+        ``parallel.sharding.plan_shard_factors``) divides those vars'
+        bytes so the predicted peak is PER-DEVICE residency under a
+        sharding plan, not logical bytes."""
         from paddle_tpu.observability import memory as _memory
 
         return _memory.plan_program(
             self, feed_shapes=feed_shapes,
-            fetch_names=tuple(fetch_names or ()))
+            fetch_names=tuple(fetch_names or ()),
+            shard_factors=shard_factors)
+
+    def derive_sharding(self, mesh_axes, overrides=None, feed_shapes=None,
+                        **kwargs):
+        """Derive a GSPMD :class:`parallel.sharding.ShardingPlan` for this
+        program over ``mesh_axes`` (a ``jax.sharding.Mesh`` or an
+        ``{axis: size}`` dict with the ``data``/``fsdp``/``tp`` axis
+        vocabulary): walks the op graph, annotates every var's
+        ``partition_spec`` (canonical rules for matmul/conv/embedding/
+        norm, propagation through elementwise/reshape ops, explicit
+        reshard points on conflicts). ``overrides`` (the old hand-written
+        ``tp_layout`` surface) take precedence and are validated by
+        analysis rule S001 at transpile time."""
+        from paddle_tpu.parallel.sharding import derive_sharding
+
+        return derive_sharding(self, mesh_axes, overrides=overrides,
+                               feed_shapes=feed_shapes, **kwargs)
 
     def _next_rng_id(self):
         self._rng_counter += 1

@@ -44,6 +44,7 @@ from paddle_tpu.observability.metrics_registry import REGISTRY
 __all__ = [
     "ENABLED", "enable", "reset", "KINDS", "track", "drop",
     "live_bytes", "live_by_kind", "live_by_device", "top_holders",
+    "track_state_sharded",
     "take_step_peak", "register_plan", "predicted_peak", "last_plan",
     "plan_program", "MemoryPlan", "is_oom", "MemoryExhaustedError",
     "enrich_and_raise", "RULE", "RULE_NAME",
@@ -212,6 +213,35 @@ def track_state(cp, program, new_state, device):
               kinds.get(name, "opt_state"), device)
 
 
+def track_state_sharded(cp, program, new_state, fallback_device="mesh"):
+    """Mesh-path scope binding: book each state var's REAL per-device
+    shard bytes under per-device labels, not one mesh-wide logical entry.
+    A param sharded over a 4-way ``fsdp`` axis shows ~1/4 of its bytes on
+    each device's ``paddle_tpu_hbm_live_bytes{device,kind}`` series while
+    replicated state shows full bytes on every device — the measured half
+    of the derived-plan story (the predicted half is ``memory_plan`` with
+    ``shard_factors``)."""
+    from paddle_tpu.observability.telemetry import device_label
+
+    kinds = _state_kinds(cp, program, list(new_state))
+    for name, val in new_state.items():
+        kind = kinds.get(name, "opt_state")
+        try:
+            shards = val.addressable_shards
+        except Exception:
+            shards = None
+        if not shards:
+            track(name, getattr(val, "nbytes", 0), kind, fallback_device)
+            continue
+        per_dev = {}
+        for sh in shards:
+            lbl = device_label(sh.device)
+            per_dev[lbl] = per_dev.get(lbl, 0) + int(
+                getattr(sh.data, "nbytes", 0))
+        for lbl, nb in per_dev.items():
+            track(name, nb, kind, lbl)
+
+
 def track_fetches(fetch_names, fetches, device):
     for name, val in zip(fetch_names, fetches):
         track(name, getattr(val, "nbytes", 0), "activation", device)
@@ -289,7 +319,8 @@ def _var_nbytes(block, name, feed_shapes, default_batch):
     return size * item
 
 
-def plan_program(program, feed_shapes=None, fetch_names=()):
+def plan_program(program, feed_shapes=None, fetch_names=(),
+                 shard_factors=None):
     """Predict one step's HBM high-water mark from the liveness analysis.
 
     Sweeps block 0's live ranges (analysis/liveness.py): every var is
@@ -299,6 +330,11 @@ def plan_program(program, feed_shapes=None, fetch_names=()):
     maximum is the predicted peak; XLA's scheduler can only do better
     than this program-order bound by reordering, and worse only through
     fragmentation — so it brackets the measured watermark.
+
+    ``shard_factors`` ({var name -> ways split}, from a derived
+    GSPMD plan via ``parallel.sharding.plan_shard_factors``) divides
+    those vars' bytes, making the predicted peak PER-DEVICE residency
+    under the plan instead of logical bytes.
     """
     from paddle_tpu.analysis import liveness
 
@@ -315,8 +351,10 @@ def plan_program(program, feed_shapes=None, fetch_names=()):
     # sweep: +bytes at first-def (block inputs at 0), -bytes after last use
     deltas = [0] * (n_ops + 1)
     sizes = {}
+    shard_factors = shard_factors or {}
     for name, (d, u) in b0.live_ranges.items():
         nb = _var_nbytes(block, name, feed_shapes, default_batch)
+        nb //= max(1, int(shard_factors.get(name, 1)))
         if nb <= 0:
             continue
         start = 0 if d is None else min(d, n_ops - 1)
@@ -362,11 +400,16 @@ def register_plan(fingerprint, plan):
             _plans.pop(next(iter(_plans)))
 
 
-def register_plan_for(cp, program, feed_specs, fingerprint):
+def register_plan_for(cp, program, feed_specs, fingerprint,
+                      shard_factors=None, mesh_devices=None):
     """One-shot per compiled executable (executor call sites, guarded on
     telemetry): compute and file the program's predicted plan under its
-    telemetry fingerprint. Best-effort — planning must never break a
-    step."""
+    telemetry fingerprint. ``shard_factors`` (derived GSPMD plan) makes
+    the prediction per-device; pass ``mesh_devices`` alongside so
+    ``profiler.memory_stats()`` can scale the per-device peak back to
+    the mesh-wide total the measured watermark sums (exact for sharded
+    vars, an underestimate for replicated ones — it brackets).
+    Best-effort — planning must never break a step."""
     if getattr(cp, "_memory_plan_done", False):
         return None
     cp._memory_plan_done = True
@@ -374,10 +417,14 @@ def register_plan_for(cp, program, feed_specs, fingerprint):
         plan = plan_program(
             program,
             feed_shapes={n: s for n, (s, _d) in feed_specs.items()},
-            fetch_names=cp.fetch_names)
+            fetch_names=cp.fetch_names,
+            shard_factors=shard_factors)
     except Exception:
         return None
-    register_plan(fingerprint, plan)
+    d = plan.as_dict()
+    if mesh_devices and int(mesh_devices) > 1 and shard_factors:
+        d["mesh_devices"] = int(mesh_devices)
+    register_plan(fingerprint, d)
     return plan
 
 

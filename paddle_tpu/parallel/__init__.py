@@ -14,6 +14,13 @@ from paddle_tpu.parallel.mesh import (  # noqa: F401
     build_mesh,
     init_distributed,
 )
+from paddle_tpu.parallel.sharding import (  # noqa: F401
+    DerivedShardingPolicy,
+    ShardingPlan,
+    derive_sharding,
+    plan_shard_factors,
+    record_collective_bytes,
+)
 from paddle_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,

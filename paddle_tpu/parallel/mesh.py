@@ -33,15 +33,42 @@ class MeshConfig(object):
         self.axis_names = axis_names
 
 
-def build_mesh(num_devices=None, data=None, model=1, pipe=1, devices=None):
-    """Build a Mesh; default = pure data-parallel over all local devices."""
+def build_mesh(num_devices=None, data=None, model=None, pipe=None,
+               devices=None, fsdp=None, tp=None):
+    """Build a Mesh; default = pure data-parallel over all local devices.
+
+    Two axis vocabularies:
+
+    * legacy ``("data", "model", "pipe")`` — when ``fsdp``/``tp`` are not
+      given; the hand-annotation surface (``sharding_overrides``,
+      ``model_sharded_vars``) names these axes.
+    * planning ``("data", "fsdp", "tp")`` — when ``fsdp=`` or ``tp=`` is
+      given; the axes the sharding transpiler
+      (``parallel/sharding.derive_sharding``) derives PartitionSpecs
+      over: batch dims shard over ``data x fsdp``, parameters/optimizer
+      state shard over ``fsdp`` (ZeRO-ish), Megatron column/row splits
+      ride ``tp``. ``data`` defaults to whatever devices remain.
+    """
     devices = devices if devices is not None else jax.devices()
     n = num_devices or len(devices)
     devices = devices[:n]
-    if data is None:
-        data = n // (model * pipe)
-    arr = np.asarray(devices).reshape(data, model, pipe)
-    mesh = Mesh(arr, ("data", "model", "pipe"))
+    if fsdp is not None or tp is not None:
+        if model not in (None, 1) or pipe not in (None, 1):
+            raise ValueError(
+                "build_mesh: fsdp/tp axes do not compose with the legacy "
+                "model/pipe axes — pick one vocabulary (got model=%r "
+                "pipe=%r fsdp=%r tp=%r)" % (model, pipe, fsdp, tp))
+        fsdp, tp = int(fsdp or 1), int(tp or 1)
+        if data is None:
+            data = n // (fsdp * tp)
+        arr = np.asarray(devices).reshape(int(data), fsdp, tp)
+        mesh = Mesh(arr, ("data", "fsdp", "tp"))
+    else:
+        model, pipe = int(model or 1), int(pipe or 1)
+        if data is None:
+            data = n // (model * pipe)
+        arr = np.asarray(devices).reshape(int(data), model, pipe)
+        mesh = Mesh(arr, ("data", "model", "pipe"))
     record_mesh(mesh)
     return mesh
 

@@ -10,8 +10,19 @@ per-device program plus ICI/DCN collectives, and runs it on all devices
 
 BuildStrategy.ReduceStrategy maps to the policy:
   AllReduce -> replicated params (grad allreduce), build_strategy.h:55
-  Reduce    -> dim-0-sharded params/opt-state (reduce-scatter, ZeRO-ish)
+  Reduce    -> fsdp over the DERIVED sharding plan: the sharding
+               transpiler (parallel/sharding.derive_sharding) walks the
+               op graph and picks a per-var PartitionSpec over the
+               (data, fsdp, tp) mesh — reduce-scatter + all-gather,
+               ZeRO-ish — instead of the old blanket dim-0 sharding.
+               Hand-written ``sharding_overrides`` naming the legacy
+               "model"/"pipe" axes keep the legacy blanket policy.
 num_trainers/trainer_id (NCCL2 multi-node) -> jax.distributed processes.
+
+Tensor parallelism needs NO hand-written layout: pass ``tp=`` (and/or
+``fsdp=``) and the transpiler derives Megatron column/row splits from
+the graph; ``sharding_overrides`` remain an *override* on top of the
+derived plan, validated by analysis rule S001 at transpile time.
 """
 
 import time
@@ -78,6 +89,25 @@ class BuildStrategy(object):
         self.fuse_elewise_add_act_ops = False
 
 
+def _names_legacy_axes(sharding_overrides):
+    """True when any hand-written override references a legacy-mesh axis
+    ("model"/"pipe", or "data" — which the Reduce planning mesh shrinks
+    to size 1, so an old `('data', …)` layout would silently stop
+    sharding there). Those layouts predate the planning (data, fsdp, tp)
+    vocabulary and keep the legacy blanket policy. Malformed specs
+    return False so the planning path's S001 validation names the actual
+    problem."""
+    from paddle_tpu.analysis.shard_check import spec_axes
+
+    for spec in (sharding_overrides or {}).values():
+        try:
+            if set(spec_axes(spec)) & {"model", "pipe", "data"}:
+                return True
+        except ValueError:
+            pass
+    return False
+
+
 def _warn_noop_strategy_knobs(build_strategy, exec_strategy):
     """Tell the user, once, when they set a knob the XLA execution model
     makes meaningless (docs/XLA_EXECUTION.md has the per-knob rationale)."""
@@ -122,6 +152,8 @@ class ParallelExecutor(object):
         sharding_overrides=None,
         pipeline_stages=None,
         pipeline_microbatches=None,
+        fsdp=None,
+        tp=None,
     ):
         self._program = main_program or framework.default_main_program()
         self._scope = scope or global_scope()
@@ -189,17 +221,43 @@ class ParallelExecutor(object):
                     "pipeline_stages does not yet compose with "
                     "num_trainers>1 (multi-host feed assembly is only "
                     "wired for the data-parallel path)")
+            if fsdp is not None or tp is not None:
+                raise NotImplementedError(
+                    "pipeline_stages does not yet compose with a "
+                    "fsdp/tp planning mesh (pipe-axis composition is an "
+                    "open ROADMAP item); drop fsdp=/tp= or the pipeline")
             self.mesh = build_mesh(
                 num_devices=n, data=n // pipeline_stages,
                 pipe=pipeline_stages, devices=pool)
+        elif fsdp is not None or tp is not None:
+            # explicit planning mesh: the sharding transpiler derives the
+            # full var->PartitionSpec plan over (data, fsdp, tp)
+            self.mesh = build_mesh(
+                num_devices=n, fsdp=fsdp, tp=tp, devices=pool)
+        elif (self._build_strategy.reduce_strategy
+              == BuildStrategy.ReduceStrategy.Reduce
+              and not model_sharded_vars
+              and not _names_legacy_axes(sharding_overrides)):
+            # Reduce = "fsdp over the derived plan": batch shards over the
+            # fsdp axis exactly as it sharded over "data" before, but the
+            # per-var layouts now come from the op graph (conv filters
+            # out-channel-sharded, norm stats replicated, tiny biases
+            # whole) instead of blanket dim-0 sharding. Legacy-axis
+            # overrides / model_sharded_vars keep the old policy.
+            self.mesh = build_mesh(num_devices=n, fsdp=n, devices=pool)
         else:
             self.mesh = build_mesh(num_devices=n, devices=pool)
         self._model_sharded_vars = set(model_sharded_vars or ())
         # Tensor-parallel layout control: var name -> PartitionSpec (or a
         # plain tuple of axis names / None). GSPMD inserts the matching
         # collectives (all-gather for column-parallel, psum for
-        # row-parallel) — the scaling-book recipe.
+        # row-parallel) — the scaling-book recipe. Under a planning mesh
+        # these are OVERRIDES on top of the derived plan (S001-validated);
+        # under a legacy mesh they are the whole tensor-parallel story.
         self._sharding_overrides = dict(sharding_overrides or {})
+        self._derived_plans = {}  # plan cache: one derivation per compile key
+        self._active_plan = None  # plan of the latest compiled executable
+        self._overrides_checked = set()  # S001 once per (mesh sig)
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
 
@@ -207,7 +265,10 @@ class ParallelExecutor(object):
     def device_count(self):
         return int(np.prod(list(self.mesh.shape.values())))
 
-    def _policy(self, state_shapes):
+    def _policy(self, state_shapes, feed_specs=None):
+        if "fsdp" in self.mesh.shape or "tp" in self.mesh.shape:
+            return self._derived_policy(state_shapes, feed_specs)
+        self._check_overrides_s001()
         strategy = (
             "reduce"
             if self._build_strategy.reduce_strategy
@@ -228,6 +289,81 @@ class ParallelExecutor(object):
             model_sharded_vars=self._model_sharded_vars,
             overrides=overrides,
         )
+
+    def _check_overrides_s001(self):
+        """Rule S001 on the hand-written override surface (legacy path;
+        the derived path validates inside derive_sharding): an override
+        naming an unknown var, exceeding its rank, or referencing an axis
+        absent from the mesh dies HERE as a rule-tagged Diagnostic, not
+        as an opaque XLA shape error minutes into the compile."""
+        if not self._sharding_overrides:
+            return
+        mesh_sig = tuple(sorted(self.mesh.shape.items()))
+        if mesh_sig in self._overrides_checked:
+            return
+        from paddle_tpu.analysis.diagnostics import (
+            ProgramVerifyError, at_or_above)
+        from paddle_tpu.analysis.shard_check import check_sharding
+
+        diags = check_sharding(
+            self._program, self.mesh, self._sharding_overrides,
+            origin="sharding_overrides")
+        errors = at_or_above(diags, "error")
+        if errors:
+            raise ProgramVerifyError(errors, origin="ParallelExecutor")
+        self._overrides_checked.add(mesh_sig)
+
+    def _derived_policy(self, state_shapes, feed_specs=None):
+        """The sharding transpiler path: derive (and cache) the plan for
+        this (program, mesh, feed shapes, overrides) key, export its
+        per-axis collective-byte gauges, and wrap it in the policy
+        interface the CompiledProgram consumes."""
+        from paddle_tpu.parallel.sharding import (
+            DerivedShardingPolicy,
+            derive_sharding,
+            record_collective_bytes,
+        )
+
+        feed_shapes = {n: s for n, (s, _d) in (feed_specs or {}).items()}
+        key = (
+            program_fingerprint(self._program),
+            tuple(sorted(self.mesh.shape.items())),
+            tuple(sorted(feed_shapes.items())),
+            tuple(sorted((k, str(v))
+                         for k, v in self._sharding_overrides.items())),
+        )
+        plan = self._derived_plans.get(key)
+        if plan is None:
+            plan = derive_sharding(
+                self._program, self.mesh,
+                overrides=self._sharding_overrides or None,
+                feed_shapes=feed_shapes)
+            record_collective_bytes(plan)
+            # bounded FIFO: evict oldest, keep the hot rotation (same
+            # idiom as observability.memory's plan registry)
+            while len(self._derived_plans) >= 16:
+                self._derived_plans.pop(next(iter(self._derived_plans)))
+            self._derived_plans[key] = plan
+        return DerivedShardingPolicy(self.mesh, plan,
+                                     state_shapes=state_shapes)
+
+    def sharding_plan(self, feed_shapes=None):
+        """The derived :class:`parallel.sharding.ShardingPlan` this
+        executor compiled with — or, before the first run, the plan it
+        *would* compile with (planning meshes only; None under a legacy
+        mesh) — inspectable without running anything:
+        ``debugger.program_to_code`` shows the stamped per-var specs.
+        After a run, the no-argument form returns the compiled plan
+        verbatim; pass ``feed_shapes`` to derive a what-if plan for
+        different feeds (this re-stamps the program annotations)."""
+        if not ("fsdp" in self.mesh.shape or "tp" in self.mesh.shape):
+            return None
+        if feed_shapes is None and self._active_plan is not None:
+            return self._active_plan
+        feed_specs = {n: (tuple(s), "") for n, s in
+                      (feed_shapes or {}).items()}
+        return self._derived_policy(
+            self._collect_state_shapes(), feed_specs).derived
 
     def _get_compiled(self, feed_specs, fetch_names):
         scope_names = set(self._scope.local_var_names())
@@ -257,6 +393,8 @@ class ParallelExecutor(object):
                 "mode": "gspmd",
             })
             state_shapes = self._collect_state_shapes()
+            policy = self._policy(state_shapes, feed_specs)
+            self._active_plan = getattr(policy, "derived", None)
 
             def _build():
                 if _chaos.ENABLED:
@@ -267,10 +405,14 @@ class ParallelExecutor(object):
                     fetch_names,
                     scope_names,
                     is_test=self._program._is_test,
-                    shardings=self._policy(state_shapes),
+                    shardings=policy,
                 )
 
             cp = _retry.call(_build, origin="ParallelExecutor.compile")
+            # the derived plan rides the executable: memory planning
+            # divides predicted bytes by each var's shard factor, and
+            # captures/benches read the summary without re-deriving
+            cp._sharding_plan = getattr(policy, "derived", None)
             cp._exec_cache_key = executable_key(
                 self._program, feed_specs, fetch_names, scope_names,
                 extra=("gspmd", mesh_sig,
@@ -387,14 +529,24 @@ class ParallelExecutor(object):
                 cp, state, feeds, key)
             _telemetry.record_device_transfer(
                 self._feed_bytes_by_device(cp, feeds))
-            # HBM ledger over the GLOBAL (sharded) arrays, under one
-            # 'mesh' label: per-chip residency is the measured story the
-            # per-device gauges already tell; the ledger names WHO holds
-            # the bytes, which is mesh-wide by construction
+            # HBM ledger: feeds/fetches (global sharded arrays) book
+            # under one 'mesh' label; STATE books per device from real
+            # shard sizes below, so the ledger shows each chip's
+            # param/opt_state residency under the derived plan
             mem_dev = "mesh"
             _memory.track_feeds(feeds, mem_dev)
-            _memory.register_plan_for(cp, self._program, feed_specs,
-                                      fingerprint)
+            if not getattr(cp, "_memory_plan_done", False):
+                shard_factors = mesh_devices = None
+                if getattr(cp, "_sharding_plan", None) is not None:
+                    from paddle_tpu.parallel.sharding import (
+                        plan_shard_factors)
+
+                    shard_factors = plan_shard_factors(cp._sharding_plan)
+                    mesh_devices = self.device_count
+                _memory.register_plan_for(cp, self._program, feed_specs,
+                                          fingerprint,
+                                          shard_factors=shard_factors,
+                                          mesh_devices=mesh_devices)
         if _blackbox.ENABLED:
             _blackbox.record_dispatch(
                 "ParallelExecutor.run", feed_specs=feed_specs,
@@ -409,7 +561,13 @@ class ParallelExecutor(object):
         for n, val in new_state.items():
             self._scope.set_value(n, val)
         if telem:
-            _memory.track_state(cp, self._program, new_state, mem_dev)
+            # per-device ledger entries from the REAL shard sizes: a
+            # param fsdp-sharded 4 ways books ~1/4 of its bytes on each
+            # device label; replicated state books full bytes on every
+            # device — paddle_tpu_hbm_live_bytes{device,kind} shows the
+            # derived plan's memory win directly
+            _memory.track_state_sharded(cp, self._program, new_state,
+                                        fallback_device=mem_dev)
             _memory.track_fetches(cp.fetch_names, fetches, mem_dev)
             _memory.drop_feeds(feeds, mem_dev)
         device_times = None

@@ -225,6 +225,14 @@ def memory_stats():
     plans = memory.plans()
     predicted = max(
         (p["peak_bytes"] for p in plans.values()), default=0) or None
+    # a derived-sharding plan predicts PER-DEVICE residency
+    # (shard_factors divide each var); the measured watermark sums every
+    # ledger label across the mesh — scale by the plan's device count so
+    # the ratio stays apples-to-apples (exact for sharded vars, an
+    # underestimate for replicated ones)
+    predicted_scaled = max(
+        (p["peak_bytes"] * p.get("mesh_devices", 1)
+         for p in plans.values()), default=0) or None
     out = {
         "live_bytes": memory.live_bytes(),
         "live_by_kind": memory.live_by_kind(),
@@ -235,9 +243,9 @@ def memory_stats():
         "top_holders": memory.top_holders(5),
         "plans_registered": len(plans),
     }
-    if measured and predicted:
+    if measured and predicted_scaled:
         out["predicted_over_measured"] = round(
-            float(predicted) / float(measured), 4)
+            float(predicted_scaled) / float(measured), 4)
     return out
 
 

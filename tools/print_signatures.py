@@ -89,6 +89,12 @@ MODULES = [
     # PR 6: the memory surface (live-buffer ledger / memory plan / OOM
     # forensics) — what capacity planning scripts against
     "paddle_tpu.observability.memory",
+    # PR 7: the sharding-transpiler surface (derived GSPMD plans + the
+    # S001 spec validator) — what distributed recipes script against
+    "paddle_tpu.parallel",
+    "paddle_tpu.parallel.mesh",
+    "paddle_tpu.parallel.sharding",
+    "paddle_tpu.analysis.shard_check",
 ]
 
 

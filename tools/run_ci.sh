@@ -3,8 +3,8 @@
 # repo): native C++ build + its unit tests, the Python suite on the
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
-# (native|python|lint|warm|metrics|forensics|chaos|dryrun|bench|perfgate)
-# to run a subset.
+# (native|python|lint|warm|metrics|forensics|chaos|shard|dryrun|bench|
+# perfgate) to run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -13,8 +13,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-ALL_STAGES=(native python lint warm metrics forensics chaos dryrun bench
-            perfgate)
+ALL_STAGES=(native python lint warm metrics forensics chaos shard dryrun
+            bench perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -121,6 +121,27 @@ if want chaos; then
   # serial loaded (chaos_smoke.py asserts all of it)
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python tools/chaos_smoke.py
+fi
+
+if want shard; then
+  echo "== sharding transpiler smoke (derived data x fsdp x tp plan) =="
+  # two processes share one exec cache dir on the 8-virtual-device CPU
+  # mesh; each proves derived-plan loss parity with the single-device
+  # run (ZERO hand-written tp_layout entries) and 1/N per-device
+  # param+opt_state ledger bytes under the fsdp x tp split; the second
+  # must additionally execute the SHARDED executable with zero fresh
+  # XLA compiles via the persistent exec cache (shard_smoke.py asserts
+  # all of it)
+  sdir="$(mktemp -d)"
+  trap 'rm -rf "$sdir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$sdir" \
+    python tools/shard_smoke.py cold
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$sdir" \
+    python tools/shard_smoke.py warm
+  rm -rf "$sdir"
+  trap - EXIT
 fi
 
 if want dryrun; then
