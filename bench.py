@@ -306,6 +306,44 @@ def _bench_transformer(fluid, on_tpu, use_amp):
     }
 
 
+def _bench_serving(fluid, on_tpu):
+    """Serving-throughput leg: the deterministic mixed-batch-size load
+    from serving/loadgen.py (the SAME code path tools/serve_smoke.py
+    smoke-tests) replayed through a warm BatchingServer — so the bench
+    trajectory tracks requests/sec, batch occupancy and latency p50/p99
+    alongside training MFU, and benchmark/budgets.json gates all three.
+    """
+    import shutil
+    import tempfile
+
+    from paddle_tpu.inference import NativeConfig, create_paddle_predictor
+    from paddle_tpu.serving import BatchingServer, loadgen
+
+    model_dir = tempfile.mkdtemp(prefix="bench_serving_")
+    try:
+        loadgen.build_demo_model(model_dir)
+        predictor = create_paddle_predictor(
+            NativeConfig(model_dir=model_dir, use_tpu=on_tpu))
+        server = BatchingServer(predictor, max_batch=8, workers=2,
+                                batch_linger_s=0.002)
+        try:
+            server.warmup()
+            wall, ok, errors = loadgen.replay(
+                server, loadgen.demo_requests(48), concurrency=4)
+            assert ok == 48 and not errors, \
+                "replay errors: %r" % errors[:3]
+            rec = loadgen.serving_capture(server, ok, wall)
+        finally:
+            server.close()
+    finally:
+        shutil.rmtree(model_dir, ignore_errors=True)
+    rec["metric"] = "serving_throughput" + ("" if on_tpu else "_cpu_proxy")
+    # requests aren't FLOP-accounted: rate feeds throughput, mfu stays None
+    rec["rate"] = rec["value"]
+    rec["gflop_per_unit"] = 0.0
+    return rec
+
+
 def _worker_main():
     """One model bench in this process. Prints one JSON line.
 
@@ -328,13 +366,16 @@ def _worker_main():
         use_amp = os.environ.get("BENCH_AMP", "1" if on_tpu else "0") == "1"
         if model == "transformer":
             result = _bench_transformer(fluid, on_tpu, use_amp)
+        elif model == "serving":
+            result = _bench_serving(fluid, on_tpu)
         else:
             result = _bench_resnet(fluid, on_tpu, use_amp)
         peak = _peak_tflops(jax.devices()[0]) if on_tpu else None
         rate = result.pop("rate")
         gflop = result.pop("gflop_per_unit")
         result["mfu"] = (
-            round(rate * gflop * 1e9 / (peak * 1e12), 4) if peak else None
+            round(rate * gflop * 1e9 / (peak * 1e12), 4)
+            if peak and gflop else None
         )
         # compile-tax telemetry (core/exec_cache.py): cold = seconds in
         # fresh XLA compiles, warm = seconds loading cached executables.
@@ -514,11 +555,12 @@ def main():
     # BENCH_MODELS overrides with an explicit list
     models_env = os.environ.get(
         "BENCH_MODELS",
-        os.environ.get("BENCH_MODEL", "resnet50,transformer"))
+        os.environ.get("BENCH_MODEL", "resnet50,transformer,serving"))
     models = {}
     for model in [m.strip() for m in models_env.split(",") if m.strip()]:
-        if model not in ("resnet50", "transformer"):
-            errors[model] = "unknown model (valid: resnet50, transformer)"
+        if model not in ("resnet50", "transformer", "serving"):
+            errors[model] = ("unknown model (valid: resnet50, "
+                             "transformer, serving)")
             continue
         result = err = None
         if tpu_kind is not None:

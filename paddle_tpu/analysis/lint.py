@@ -30,16 +30,20 @@ Rule catalog (docs/ANALYSIS.md has examples and fixes):
                                          from recompile-explainer events
                                          (component: fetch_names)
 
-Entry points: :func:`lint` (static pass over a Program) and
+Entry points: :func:`lint` (static pass over a Program),
 :func:`lint_events` (turn recent recompile-explainer events into the
-runtime-confirmed diagnostics, L004 included).
+runtime-confirmed diagnostics, L004 included), and
+:func:`suggest_buckets` — L001's *mitigation*: turn the shapes a
+deployment actually observes into the small bucket ladder the serving
+layer (``paddle_tpu.serving.BatchingServer``) pads requests into, so a
+dynamic user-shape stream resolves to a finite executable set.
 """
 
 import re
 
 from paddle_tpu.analysis.diagnostics import Diagnostic, filter_diagnostics
 
-__all__ = ["lint", "lint_events", "RULES"]
+__all__ = ["lint", "lint_events", "suggest_buckets", "RULES"]
 
 RULES = {
     "L001": ("dynamic-feed-shape", "warning"),
@@ -55,7 +59,76 @@ def _diag(rule, message, severity=None, **kwargs):
                       **kwargs)
 
 
-# -- L001 -------------------------------------------------------------------
+# -- L001 + its mitigation --------------------------------------------------
+
+def _pow2_at_least(n):
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _ladder(sizes, max_buckets):
+    """Ascending power-of-two ladder covering [min(sizes), max(sizes)],
+    at most ``max_buckets`` rungs. When thinning is needed the SMALL
+    rungs are dropped: a small request padding up a level wastes a
+    little compute; a missing top rung would be a fresh compile."""
+    lo, hi = min(sizes), max(sizes)
+    if lo < 1 or hi < 1:
+        raise ValueError("bucket sizes must be positive, got %r"
+                         % sorted(set(sizes))[:8])
+    rungs = []
+    p = _pow2_at_least(lo)
+    while p < hi:
+        rungs.append(p)
+        p *= 2
+    rungs.append(_pow2_at_least(hi))
+    if len(rungs) > max_buckets:
+        rungs = rungs[-max_buckets:]
+    return tuple(rungs)
+
+
+def suggest_buckets(observed, max_buckets=4):
+    """L001's fix, computed: distill the shapes a workload actually sees
+    into the bucket ladder that bounds its executable count.
+
+    ``observed`` is one of
+
+    * an iterable of ints — sizes of one dynamic dim (batch sizes,
+      sequence lengths): returns an ascending tuple of at most
+      ``max_buckets`` power-of-two bucket sizes covering them;
+    * an iterable of same-rank shape tuples — concrete feed shapes of
+      one var: returns a tuple of per-dim ladders (a 1-tuple for dims
+      that never varied);
+    * a dict ``{feed_name: either-of-the-above}``: returns the same
+      dict shape with each value distilled.
+
+    A request of size ``s`` resolves to the smallest rung ``>= s``
+    (requests above the top rung are a deliberate admission question,
+    not a silent compile). ``BatchingServer`` consumes exactly this
+    structure as its ``batch_buckets``/``pad_buckets`` config, padding
+    each request up its rung so every live shape comes from the finite
+    ladder and the warm persistent exec cache serves it without a
+    fresh compile.
+    """
+    if isinstance(observed, dict):
+        return {k: suggest_buckets(v, max_buckets)
+                for k, v in observed.items()}
+    vals = list(observed)
+    if not vals:
+        raise ValueError("suggest_buckets: no observed shapes")
+    if all(isinstance(v, int) and not isinstance(v, bool) for v in vals):
+        return _ladder(vals, max_buckets)
+    shapes = [tuple(int(d) for d in s) for s in vals]
+    if len({len(s) for s in shapes}) != 1:
+        raise ValueError(
+            "suggest_buckets: mixed ranks %s — one var's shapes only"
+            % sorted({len(s) for s in shapes}))
+    return tuple(
+        (dim_vals[0],) if len(set(dim_vals)) == 1
+        else _ladder(dim_vals, max_buckets)
+        for dim_vals in zip(*shapes))
+
 
 def _lint_feed_shapes(program, out):
     for block in program.blocks:
@@ -71,7 +144,9 @@ def _lint_feed_shapes(program, out):
                     "feed shape compiles a fresh executable" % name,
                     block_idx=block.idx, var_names=(name,),
                     hint="declare the shape on layers.data (use -1 only "
-                         "for the batch dim) or pad/bucket the input"))
+                         "for the batch dim), or serve it through "
+                         "serving.BatchingServer with a ladder from "
+                         "analysis.lint.suggest_buckets(observed_shapes)"))
                 continue
             dyn = [i for i, d in enumerate(shape) if d < 0]
             if len(shape) > 1 and len(dyn) == len(shape):
@@ -80,8 +155,9 @@ def _lint_feed_shapes(program, out):
                     "feed var %r is fully dynamic %s: each distinct "
                     "shape pays a fresh XLA compile" % (name, list(shape)),
                     block_idx=block.idx, var_names=(name,),
-                    hint="fix every non-batch dim; bucket or pad "
-                         "variable-length inputs"))
+                    hint="fix every non-batch dim, or bucket the inputs: "
+                         "suggest_buckets(observed_shapes) emits the "
+                         "ladder serving.BatchingServer pads into"))
             elif any(i != 0 for i in dyn):
                 out.append(_diag(
                     "L001",
@@ -91,7 +167,10 @@ def _lint_feed_shapes(program, out):
                     % (name, dyn, list(shape)),
                     block_idx=block.idx, var_names=(name,),
                     hint="pad to a fixed length or a small set of "
-                         "bucketed lengths (see docs/LONG_CONTEXT.md)"))
+                         "bucketed lengths — analysis.lint."
+                         "suggest_buckets(observed_lengths) builds the "
+                         "ladder and serving.BatchingServer applies it "
+                         "(see docs/LONG_CONTEXT.md)"))
             elif dyn:
                 out.append(_diag(
                     "L001",

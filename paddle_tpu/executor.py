@@ -138,6 +138,21 @@ _finite_stack = jax.jit(
 )
 
 
+class FetchTimeoutError(RuntimeError):
+    """``FetchHandle.result(timeout=...)`` expired before the fetches
+    materialized. The handle itself is untouched: nothing was consumed,
+    so a later ``result()`` (with or without a timeout) still returns
+    the full values — the serving deadline path rejects the REQUEST,
+    not the computation."""
+
+    def __init__(self, timeout, fetch_names):
+        super(FetchTimeoutError, self).__init__(
+            "async fetch of %s did not materialize within %.3fs"
+            % (list(fetch_names), timeout))
+        self.timeout = timeout
+        self.fetch_names = list(fetch_names)
+
+
 class FetchHandle(object):
     """Live results of an async dispatch (``Executor.run_async``).
 
@@ -149,6 +164,12 @@ class FetchHandle(object):
       ``block_until_ready()``  wait on device completion, no transfer
       ``result()``             numpy values (blocks; memoized) — matches
                                the equivalent ``run(...)`` bit-for-bit
+      ``result(timeout=s)``    same, but raise :class:`FetchTimeoutError`
+                               (leaving the handle reusable) if the
+                               device work isn't done within ``s`` —
+                               the deadline primitive the batching
+                               server builds on, independent of the
+                               watchdog
     """
 
     def __init__(self, arrays, fetch_names, nan_check=None, track=None,
@@ -185,7 +206,22 @@ class FetchHandle(object):
                 a.block_until_ready()
         return self
 
-    def result(self):
+    def result(self, timeout=None):
+        if self._numpy is None and timeout is not None:
+            # Poll, don't block: jax arrays expose readiness but no timed
+            # wait, and a blocking block_until_ready() here would make the
+            # timeout a lie exactly when it matters (a wedged device).
+            # Nothing is consumed before the readiness check, so a timed-
+            # out handle can be asked again.
+            deadline = time.monotonic() + float(timeout)
+            pause = 5e-4
+            while not self.done():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise FetchTimeoutError(float(timeout),
+                                            self.fetch_names)
+                time.sleep(min(pause, remaining))
+                pause = min(pause * 2, 0.05)
         if self._numpy is None:
             # a fetch that never materializes is the canonical silent
             # hang (wedged tunnel, dead peer): the guard arms the

@@ -199,6 +199,29 @@ class Predictor(object):
     def feed_names(self):
         return list(self._feed_names)
 
+    @property
+    def feed_shapes(self):
+        """Declared feed shapes ``{name: tuple}`` (``-1`` = dynamic, dim
+        0 is the batch dim) — the shape vocabulary linter rule L001
+        inspects, and what ``serving.BatchingServer`` derives its
+        bucket/padding plan from."""
+        gvars = self._program.global_block().vars
+        return {
+            n: tuple(gvars[n].shape) if gvars[n].shape is not None
+            else None
+            for n in self._feed_names if n in gvars
+        }
+
+    @property
+    def feed_dtypes(self):
+        """Declared feed dtypes ``{name: str}`` (fixed at load time) —
+        what the serving warmup synthesizes typed batches from."""
+        return dict(self._feed_dtypes)
+
+    @property
+    def fetch_names(self):
+        return [v.name for v in self._fetch_vars]
+
     def run_native_reference(self, inputs, fetch_index=0):
         """Run the C++ reference interpreter (native/src/interp.h) on this
         model: host-only execution of the PTPB program, used to cross-check

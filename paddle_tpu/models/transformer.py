@@ -592,6 +592,205 @@ def cached_beam_generate(exe, prepare_prog, step_prog, reorder_prog,
                            len_penalty)
 
 
+def build_slot_decoder(
+    num_slots,
+    src_vocab_size=1000,
+    trg_vocab_size=1000,
+    max_length=64,
+    n_layer=2,
+    n_head=4,
+    d_model=128,
+    d_inner=512,
+):
+    """Continuous-batching decode: the KV caches become a SLOT-PAGED
+    pool (dim 0 = slot, one in-flight sequence per slot) so admissions
+    and completions happen mid-flight while ONE fixed-shape step
+    executable advances every active sequence — the ragged-paged-
+    attention serving shape, built from this op set.
+
+    Returns ``(init_prog, admit_prog, step_prog, logits_name)``:
+
+    * ``init_prog`` (run once): allocates the zeroed cache pools —
+      per-layer self K/V ``[num_slots, H, T, dh]``, cross K/V pools,
+      and the per-slot source mask ``[num_slots, T]`` (column 0 seeded
+      valid so an unoccupied slot's cross-attention row is never fully
+      masked — softmax over an all-masked row is NaN bait).
+    * ``admit_prog`` (once per admitted sequence): encoder forward for
+      ONE sequence (feeds ``src_word [1, T]``, ``src_len [1, 1]``,
+      ``slot_idx [1]``), then scatters its cross K/V + mask into the
+      slot's pool rows and zeroes the slot's self caches — all via
+      ``dynamic_update_slice`` along the slot axis. Fixed shapes, so
+      every admission reuses one executable.
+    * ``step_prog`` (per token): feeds ``cur_tok [S, 1]``,
+      ``pe_row [S, 1, D]``, ``gen_pos [S, 1]`` — PER-SLOT positions,
+      unlike ``build_cached_decoder``'s single shared position. Each
+      slot's new K/V row lands at ITS position via a one-hot
+      select-and-add (bit-exact: written positions get exactly the new
+      row, others keep exactly the old bits), and each slot's
+      attention validity mask derives from its own position in-graph.
+      Fetches ``[S, 1, V]`` logits.
+
+    Rows are independent end to end (attention, norms and projections
+    are per-slot), so a sequence's tokens do not depend on which other
+    slots are live — the parity contract tests/test_serving.py pins
+    against the dedicated-batch decoders. Build it under the same
+    fresh ``unique_name`` scope as the training ``build()``; parameters
+    bind through the shared scope by name. Host-side slot management
+    lives in ``serving.generation.SlotDecodeSession``.
+    """
+    from paddle_tpu import unique_name
+
+    nn = fluid.layers
+    S, T, D = int(num_slots), int(max_length), int(d_model)
+    dh = D // n_head
+
+    def heads(x):
+        return nn.transpose(
+            nn.reshape(x, shape=[0, 0, n_head, dh]), perm=[0, 2, 1, 3])
+
+    with unique_name.guard({}):
+        init = fluid.Program()
+        init_startup = fluid.Program()
+        with fluid.program_guard(init, init_startup):
+            blk = init.global_block()
+
+            def persist(name, value):
+                out = blk.create_var(name=name, shape=None,
+                                     dtype="float32", persistable=True)
+                nn.assign(value, output=out)
+
+            mask0 = nn.fill_constant([S, T], "float32", 0.0)
+            mask0 = nn.dynamic_update_slice(
+                mask0, nn.fill_constant([S, 1], "float32", 1.0),
+                nn.fill_constant([1], "int64", 0), axis=1)
+            persist("gen_src_mask", mask0)
+            for i in range(n_layer):
+                for kind in ("kcross", "vcross", "kcache", "vcache"):
+                    persist("gen_%s_%d" % (kind, i),
+                            nn.fill_constant([S, n_head, T, dh],
+                                             "float32", 0.0))
+
+        admit = fluid.Program()
+        admit_startup = fluid.Program()
+        with fluid.program_guard(admit, admit_startup):
+            blk = admit.global_block()
+            src = nn.data("src_word", shape=[T], dtype="int64")
+            src_len = nn.data("src_len", shape=[1], dtype="int64")
+            slot = nn.data("slot_idx", shape=[1], dtype="int64",
+                           append_batch_size=False)
+            src_mask = nn.sequence_mask(src_len, maxlen=T,
+                                        dtype="float32")  # [1, T]
+            emb = nn.embedding(
+                input=src, size=[src_vocab_size, D],
+                param_attr=fluid.ParamAttr(name="src_emb"))
+            enc = nn.add_position_encoding(nn.scale(emb, scale=D ** 0.5))
+            for i in range(n_layer):
+                enc = encoder_layer(enc, src_mask, n_head, D, d_inner,
+                                    0.0, True, "enc_%d" % i)
+            enc = _prenorm(enc, "enc_final")
+
+            def pool(name):
+                return blk.create_var(name=name,
+                                      shape=[S, n_head, T, dh],
+                                      dtype="float32", persistable=True)
+
+            mask_pool = blk.create_var(name="gen_src_mask", shape=[S, T],
+                                       dtype="float32", persistable=True)
+            nn.dynamic_update_slice(mask_pool, src_mask, slot, axis=0,
+                                    out=mask_pool)
+            zeros_row = nn.fill_constant([1, n_head, T, dh], "float32",
+                                         0.0)
+            for i in range(n_layer):
+                kc = heads(nn.fc(enc, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False,
+                                 name="dec_%d_cmha_k" % i))
+                vc = heads(nn.fc(enc, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False,
+                                 name="dec_%d_cmha_v" % i))
+                for pname, row in (("gen_kcross_%d" % i, kc),
+                                   ("gen_vcross_%d" % i, vc),
+                                   ("gen_kcache_%d" % i, zeros_row),
+                                   ("gen_vcache_%d" % i, zeros_row)):
+                    p = pool(pname)
+                    nn.dynamic_update_slice(p, row, slot, axis=0, out=p)
+
+        step = fluid.Program()
+        step_startup = fluid.Program()
+        with fluid.program_guard(step, step_startup):
+            blk = step.global_block()
+            cur = nn.data("cur_tok", shape=[1], dtype="int64")
+            pe_row = nn.data("pe_row", shape=[1, D], dtype="float32")
+            pos = nn.data("gen_pos", shape=[1], dtype="int64")  # [S, 1]
+            # per-slot validity: positions <= this slot's own pos
+            cache_mask = nn.sequence_mask(
+                fluid.layers.increment(pos, value=1, in_place=False),
+                maxlen=T, dtype="float32")  # [S, T]
+            # one-hot of each slot's write position, shaped to select
+            # along the cache's T axis: [S, 1, T, 1]
+            write_sel = nn.reshape(nn.one_hot(pos, depth=T),
+                                   shape=[-1, 1, T, 1])
+            keep_sel = nn.scale(write_sel, scale=-1.0, bias=1.0)
+
+            def pvar(name, shape):
+                return blk.create_var(name=name, shape=shape,
+                                      dtype="float32", persistable=True)
+
+            src_mask = pvar("gen_src_mask", [S, T])
+            emb = nn.embedding(
+                input=cur, size=[trg_vocab_size, D],
+                param_attr=fluid.ParamAttr(name="trg_emb"))
+            emb = nn.reshape(emb, shape=[0, 1, D])
+            h = nn.elementwise_add(nn.scale(emb, scale=D ** 0.5), pe_row)
+            for i in range(n_layer):
+                name = "dec_%d" % i
+                kcache = pvar("gen_kcache_%d" % i, [S, n_head, T, dh])
+                vcache = pvar("gen_vcache_%d" % i, [S, n_head, T, dh])
+                nx = _prenorm(h, name + "_sattn")
+                q = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                bias_attr=False, name=name + "_smha_q"))
+                k1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False, name=name + "_smha_k"))
+                v1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False, name=name + "_smha_v"))
+                # per-slot scatter: row i writes at ITS gen_pos[i]; the
+                # select-and-add keeps untouched positions bit-identical
+                knew = nn.elementwise_add(
+                    nn.elementwise_mul(kcache, keep_sel),
+                    nn.elementwise_mul(k1, write_sel))
+                vnew = nn.elementwise_add(
+                    nn.elementwise_mul(vcache, keep_sel),
+                    nn.elementwise_mul(v1, write_sel))
+                nn.assign(knew, output=kcache)
+                nn.assign(vnew, output=vcache)
+                att = fluid.layers.scaled_dot_product_attention(
+                    q, knew, vnew, mask=cache_mask, sm_scale=dh ** -0.5)
+                att = nn.reshape(nn.transpose(att, perm=[0, 2, 1, 3]),
+                                 shape=[0, 0, n_head * dh])
+                h = nn.elementwise_add(h, nn.fc(
+                    att, D, num_flatten_dims=2, bias_attr=False,
+                    name=name + "_smha_o"))
+                nx2 = _prenorm(h, name + "_cattn")
+                q2 = heads(nn.fc(nx2, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False,
+                                 name=name + "_cmha_q"))
+                ctx = fluid.layers.scaled_dot_product_attention(
+                    q2, pvar("gen_kcross_%d" % i, [S, n_head, T, dh]),
+                    pvar("gen_vcross_%d" % i, [S, n_head, T, dh]),
+                    mask=src_mask, sm_scale=dh ** -0.5)
+                ctx = nn.reshape(nn.transpose(ctx, perm=[0, 2, 1, 3]),
+                                 shape=[0, 0, n_head * dh])
+                h = nn.elementwise_add(h, nn.fc(
+                    ctx, D, num_flatten_dims=2, bias_attr=False,
+                    name=name + "_cmha_o"))
+                ff = _ffn(_prenorm(h, name + "_ffn"), D, d_inner,
+                          name + "_ffn")
+                h = nn.elementwise_add(h, ff)
+            h = _prenorm(h, "dec_final")
+            logits = nn.fc(h, trg_vocab_size, num_flatten_dims=2,
+                           name="proj_logits")
+    return init, admit, step, logits.name
+
+
 def save_compiled_generator(dirname, batch_size, src_vocab_size,
                             trg_vocab_size, max_length, n_layer, n_head,
                             d_model, d_inner, scope=None, bos_id=1,

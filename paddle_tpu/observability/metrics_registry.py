@@ -29,13 +29,21 @@ import time
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-    "DEFAULT_BUCKETS",
+    "DEFAULT_BUCKETS", "SERVING_BUCKETS",
 ]
 
 # Latency-ish default buckets (seconds): 100us .. 60s, roughly x3 steps.
 DEFAULT_BUCKETS = (
     0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0,
     30.0, 60.0,
+)
+
+# Request-SLO buckets (seconds) for the serving layer: finer in the
+# 0.5ms-250ms band where inference p99s live, so a histogram scrape can
+# localize an SLO breach the coarse DEFAULT_BUCKETS would smear.
+SERVING_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
 )
 
 

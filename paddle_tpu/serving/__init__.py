@@ -1,0 +1,47 @@
+"""Serving: continuous request batching over the Predictor.
+
+``inference.Predictor`` gives one caller a compiled executable;
+"millions of users" need the executable AMORTIZED: many concurrent
+callers, each with their own small, oddly-shaped request, served by a
+bounded set of warm executables. This package is that layer:
+
+* ``server.BatchingServer`` — a request queue plus a background
+  dispatch loop that coalesces concurrent requests into batches, pads
+  each batch up a small ladder of bucketed shapes (the ladder
+  ``analysis.lint.suggest_buckets`` derives from the shapes L001
+  inspects), and runs them through ``Predictor.run_async`` clones. A
+  warm process over one ``FLAGS_exec_cache_dir`` serves ANY mix of
+  request shapes with **zero fresh compiles**, and padding rows are
+  sliced away so batched results are bit-identical to per-request
+  ``Predictor.run``. Admission control (bounded queue depth,
+  per-request deadlines) rejects overload with typed errors instead of
+  wedging; latency / queue-depth / batch-occupancy metrics land in the
+  process metrics registry.
+* ``generation.SlotDecodeSession`` — continuous batching for
+  generation: the KV-cached decoder's caches become a slot-paged pool
+  (``models.transformer.build_slot_decoder``) where each in-flight
+  sequence owns one slot row, admissions scatter a new sequence's
+  encoder state into a free slot mid-flight, and ONE fixed-shape step
+  executable advances every active sequence per token — the
+  ragged-paged-attention serving shape, sized to this repo.
+* ``loadgen`` — the deterministic load generator behind
+  ``tools/serve_smoke.py`` (CI ``serve`` stage) and bench.py's serving
+  leg, so the gated numbers and the smoke-tested behavior come from
+  one code path.
+
+``docs/SERVING.md`` ("Batching server") is the operator's guide.
+"""
+
+from paddle_tpu.serving import generation  # noqa: F401
+from paddle_tpu.serving import loadgen  # noqa: F401
+from paddle_tpu.serving import server  # noqa: F401
+from paddle_tpu.serving.generation import SlotDecodeSession  # noqa: F401
+from paddle_tpu.serving.server import (  # noqa: F401
+    BatchingServer,
+    DeadlineExceededError,
+    QueueFullError,
+    ServerClosedError,
+    ServingError,
+    ServingFuture,
+    WaitTimeoutError,
+)

@@ -1,0 +1,166 @@
+"""SlotDecodeSession: continuous batching for KV-cached generation.
+
+``models.transformer.build_slot_decoder`` turns the KV caches into a
+slot-paged pool; this module is the host-side slot manager. One
+fixed-shape step executable advances every in-flight sequence per
+token; sequences are admitted into free slots MID-FLIGHT (one
+fixed-shape admission executable scatters the new sequence's encoder
+state into its slot rows) and release their slot the moment they
+finish — the serving property that matters: a long sequence no longer
+holds the whole batch hostage, and a new request never waits for the
+current batch to drain. Token streams are identical to running each
+sequence through a dedicated-batch decoder (rows are independent;
+tests/test_serving.py pins the staggered-admission parity).
+"""
+
+import numpy as np
+
+from paddle_tpu.observability.metrics_registry import REGISTRY as _REGISTRY
+from paddle_tpu.serving.server import ServingError
+
+__all__ = ["SlotDecodeSession", "NoFreeSlotError"]
+
+
+class NoFreeSlotError(ServingError):
+    """admit() with every slot occupied — the generation-side admission
+    reject; retry after a step() frees slots."""
+
+
+_active_slots = _REGISTRY.gauge(
+    "paddle_tpu_serving_active_slots",
+    "in-flight sequences in the slot-paged decode session")
+_sequences_total = _REGISTRY.counter(
+    "paddle_tpu_serving_sequences_total",
+    "slot-decode sequences by lifecycle event",
+    labels=("event",))  # admitted | completed
+
+
+class SlotDecodeSession(object):
+    """Greedy continuous-batching decode over a slot-paged cache pool.
+
+    Build it with the trained scope live (parameters bind by name, the
+    ``build_cached_decoder`` convention) — typically under the same
+    ``scope_guard`` the training/loading session used::
+
+        sess = SlotDecodeSession(exe, num_slots=8, max_length=seq,
+                                 d_model=D, src_vocab_size=V,
+                                 trg_vocab_size=V, n_layer=2, n_head=2,
+                                 d_inner=64)
+        slot = sess.admit(src_row, src_len)   # anytime, mid-flight
+        finished = sess.step()                # {slot: tokens} as they end
+
+    ``decoder_cfg`` forwards to ``build_slot_decoder``
+    (``src_vocab_size``, ``trg_vocab_size``, ``n_layer``, ``n_head``,
+    ``d_inner``).
+    """
+
+    def __init__(self, exe, num_slots, max_length=64, d_model=128,
+                 bos_id=1, eos_id=2, scope=None, **decoder_cfg):
+        from paddle_tpu.models import transformer
+
+        self._transformer = transformer
+        self._exe = exe
+        self._scope = scope
+        self._S, self._T, self._D = int(num_slots), int(max_length), \
+            int(d_model)
+        self._bos, self._eos = int(bos_id), int(eos_id)
+        (self._init_prog, self._admit_prog, self._step_prog,
+         self._logits_name) = transformer.build_slot_decoder(
+            num_slots, max_length=max_length, d_model=d_model,
+            **decoder_cfg)
+        self._run(self._init_prog, {}, [])
+        self._free = list(range(self._S - 1, -1, -1))
+        self._live = {}  # slot -> {"trg": [T] int64, "pos": int}
+
+    def _run(self, prog, feed, fetch_list):
+        return self._exe.run(prog, feed=feed, fetch_list=fetch_list,
+                             scope=self._scope)
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    @property
+    def active_slots(self):
+        return sorted(self._live)
+
+    def admit(self, src, src_len=None):
+        """Claim a free slot for one source sequence (``src``: [T] or
+        [1, T] int ids; ``src_len``: its true length, default T) and run
+        the admission program — encoder forward + scatter into the
+        slot's pool rows. Returns the slot id. Raises
+        :class:`NoFreeSlotError` when every slot is occupied."""
+        if not self._free:
+            raise NoFreeSlotError(
+                "all %d slots occupied; step() until one frees"
+                % self._S)
+        src = np.asarray(src, dtype="int64").reshape(1, self._T)
+        length = self._T if src_len is None else int(np.ravel(src_len)[0])
+        slot = self._free.pop()
+        self._run(self._admit_prog, {
+            "src_word": src,
+            "src_len": np.asarray([[length]], dtype="int64"),
+            "slot_idx": np.asarray([slot], dtype="int64"),
+        }, [])
+        trg = np.full(self._T, self._eos, dtype="int64")
+        trg[0] = self._bos
+        self._live[slot] = {"trg": trg, "pos": 0}
+        _sequences_total.inc(event="admitted")
+        _active_slots.set(len(self._live))
+        return slot
+
+    def step(self):
+        """Advance every in-flight sequence one token through the single
+        step executable. Returns ``{slot: [T] int64 tokens}`` for the
+        sequences that finished this step (their slots are free again).
+        No-op ({}) when nothing is in flight."""
+        if not self._live:
+            return {}
+        cur = np.full((self._S, 1), self._eos, dtype="int64")
+        pos = np.zeros((self._S, 1), dtype="int64")
+        pe = np.zeros((self._S, 1, self._D), dtype="float32")
+        for slot, st in self._live.items():
+            cur[slot, 0] = st["trg"][st["pos"]]
+            pos[slot, 0] = st["pos"]
+            pe[slot] = self._transformer.position_encoding_row(
+                st["pos"], self._D)
+        (lg,) = self._run(self._step_prog, {
+            "cur_tok": cur, "pe_row": pe, "gen_pos": pos,
+        }, [self._logits_name])
+        lg = np.asarray(lg)  # [S, 1, V]
+        finished = {}
+        for slot in list(self._live):
+            st = self._live[slot]
+            t = st["pos"]
+            nxt = int(lg[slot, 0].argmax())
+            st["trg"][t + 1] = nxt
+            st["pos"] = t + 1
+            if nxt == self._eos or t + 1 == self._T - 1:
+                finished[slot] = st["trg"]
+                del self._live[slot]
+                self._free.append(slot)
+                _sequences_total.inc(event="completed")
+        _active_slots.set(len(self._live))
+        return finished
+
+    def generate(self, src, src_len=None):
+        """Batch convenience: run every row of ``src`` ([B, T] int ids,
+        ``src_len`` [B] or [B, 1]) through the slot pool — admitting as
+        slots free up, which exercises the continuous-batching path even
+        for B > num_slots — and return the [B, T] token matrix
+        (greedy, bos-led, eos-padded)."""
+        src = np.asarray(src, dtype="int64")
+        lengths = (np.full(len(src), self._T, dtype="int64")
+                   if src_len is None
+                   else np.ravel(np.asarray(src_len, dtype="int64")))
+        out = np.full((len(src), self._T), self._eos, dtype="int64")
+        pending = list(range(len(src)))
+        owner = {}  # slot -> request index
+        while pending or owner:
+            while pending and self._free:
+                idx = pending.pop(0)
+                owner[self.admit(src[idx], lengths[idx])] = idx
+            for slot, tokens in self.step().items():
+                out[owner.pop(slot)] = tokens
+        return out

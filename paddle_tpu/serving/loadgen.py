@@ -1,0 +1,128 @@
+"""Deterministic serving load generator.
+
+One code path produces both the CI smoke's assertions and the bench
+capture's numbers (``tools/serve_smoke.py`` — the ``serve`` stage — and
+bench.py's serving leg), so the budgets in ``benchmark/budgets.json``
+gate exactly the behavior the smoke proves: a warm process replaying a
+MIXED-shape request stream with zero fresh compiles and a p99 inside
+budget.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["build_demo_model", "demo_requests", "replay",
+           "serving_capture", "DEMO_FEATURES", "DEMO_CLASSES"]
+
+DEMO_FEATURES = 12
+DEMO_CLASSES = 3
+# request batch-size mix: deliberately NOT the bucket rungs — the point
+# is that odd user sizes resolve to the finite ladder
+DEMO_BATCH_MIX = (1, 2, 3, 5, 7, 8, 4, 6)
+
+
+def build_demo_model(dirname, seed=3, train_steps=30):
+    """Train + save the tiny softmax MLP the serving smoke/bench serve.
+    Deterministic per seed (fixed program seeds, fresh name counters, a
+    seeded data stream), so the cold and warm smoke processes agree on
+    every cache key."""
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.scope import Scope
+
+    with unique_name.guard({}):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = seed
+        startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[DEMO_FEATURES],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(input=x, size=24, act="relu")
+            pred = fluid.layers.fc(input=h, size=DEMO_CLASSES,
+                                   act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred, label=y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        rng = np.random.RandomState(seed)
+        base = rng.randn(DEMO_CLASSES, DEMO_FEATURES).astype("float32")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(train_steps):
+                lbl = rng.randint(0, DEMO_CLASSES, 32)
+                xb = base[lbl] + 0.2 * rng.randn(
+                    32, DEMO_FEATURES).astype("float32")
+                exe.run(main, feed={"x": xb, "y": lbl.reshape(-1, 1)},
+                        fetch_list=[loss])
+            fluid.io.save_inference_model(dirname, ["x"], [pred], exe,
+                                          main_program=main)
+    return dirname
+
+
+def demo_requests(n, seed=17):
+    """``n`` deterministic requests with a mixed batch-size stream —
+    every size in DEMO_BATCH_MIX appears, none above the default
+    ladder top."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        rows = DEMO_BATCH_MIX[i % len(DEMO_BATCH_MIX)]
+        out.append({"x": rng.randn(rows, DEMO_FEATURES).astype("float32")})
+    return out
+
+
+def replay(server, requests, concurrency=4, deadline_s=None):
+    """Closed-loop replay: ``concurrency`` client threads round-robin
+    the request list, each submitting and blocking on its future (what a
+    fleet of synchronous callers looks like, and what makes the
+    dispatcher's coalescing window matter). Returns
+    ``(wall_seconds, ok_count, error_list)``."""
+    errors = []
+    ok = [0] * concurrency
+
+    def client(cid):
+        for req in requests[cid::concurrency]:
+            try:
+                server.submit(req, deadline_s=deadline_s).result()
+                ok[cid] += 1
+            except Exception as exc:  # noqa: BLE001 - collected
+                errors.append(exc)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return wall, sum(ok), errors
+
+
+def serving_capture(server, n_ok, wall_s):
+    """The bench/smoke record for the serving leg: requests/sec plus the
+    SLO numbers ``tools/perf_diff.py`` gates (latency_ms_p50/p99,
+    batch_occupancy)."""
+    st = server.stats()
+    lat = st["latency_ms"]
+
+    def r(v, nd=3):
+        return round(v, nd) if v is not None else None
+
+    return {
+        "metric": "serving_throughput",
+        "value": round(n_ok / wall_s, 2) if wall_s else None,
+        "unit": "requests/sec",
+        "vs_baseline": None,
+        "latency_ms_p50": r(lat["p50_ms"]),
+        "latency_ms_p99": r(lat["p99_ms"]),
+        "batch_occupancy": r(st["mean_occupancy"], 4),
+        "batches": st["batches"],
+        "batch_buckets": st["batch_buckets"],
+        "requests_ok": n_ok,
+        "requests_rejected": st["queue_full"] + st["deadline"],
+    }

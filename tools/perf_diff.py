@@ -49,6 +49,10 @@ METRICS = {
     "throughput": ("higher", "timing"),
     "mfu": ("higher", "timing"),
     "mfu_telemetry": ("higher", "timing"),
+    # serving SLOs (tools/serve_smoke.py + bench.py serving leg)
+    "latency_ms_p50": ("lower", "timing"),
+    "latency_ms_p99": ("lower", "timing"),
+    "batch_occupancy": ("higher", "timing"),
 }
 
 
@@ -64,6 +68,9 @@ def _bench_model_metrics(m):
     sm = m.get("step_ms") or {}
     out["step_ms_p50"] = sm.get("p50")
     out["step_ms_p95"] = sm.get("p95")
+    out["latency_ms_p50"] = m.get("latency_ms_p50")
+    out["latency_ms_p99"] = m.get("latency_ms_p99")
+    out["batch_occupancy"] = m.get("batch_occupancy")
     ec = m.get("exec_cache") or {}
     out["fresh_compiles"] = ec.get("fresh_compiles",
                                    m.get("fresh_compiles"))
@@ -207,6 +214,10 @@ def main(argv=None):
                          "the budgets file carries its own)")
     ap.add_argument("--json", action="store_true",
                     help="emit the full result table as one JSON line")
+    ap.add_argument("--models", default=None,
+                    help="comma list: gate only these models (a partial "
+                         "capture — e.g. the serve smoke's — isn't "
+                         "failed for the models it never measured)")
     args = ap.parse_args(argv)
 
     if not args.baseline and not args.budgets:
@@ -219,9 +230,19 @@ def main(argv=None):
             ap.error("need --baseline and/or --budgets")
 
     candidate = load_artifact(args.candidate)
+    only = None
+    if args.models:
+        only = {m.strip() for m in args.models.split(",") if m.strip()}
+        candidate = {k: v for k, v in candidate.items() if k in only}
+        if not candidate:
+            print("perf_diff: candidate carries none of --models %s"
+                  % sorted(only))
+            raise SystemExit(2)
     results = []
     if args.baseline:
         baseline = load_artifact(args.baseline)
+        if only is not None:
+            baseline = {k: v for k, v in baseline.items() if k in only}
         compare(candidate, baseline, args.band, "baseline", results)
     if args.budgets:
         try:
@@ -232,6 +253,8 @@ def main(argv=None):
                   % (args.budgets, e))
             raise SystemExit(2)
         ref, band = budget_reference(budgets)
+        if only is not None:
+            ref = {k: v for k, v in ref.items() if k in only}
         compare(candidate, ref, band, "budget", results,
                 require_all=True)
 

@@ -3,8 +3,8 @@
 # repo): native C++ build + its unit tests, the Python suite on the
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
-# (native|python|lint|warm|metrics|forensics|chaos|shard|dryrun|bench|
-# perfgate) to run a subset.
+# (native|python|lint|warm|metrics|forensics|chaos|shard|serve|dryrun|
+# bench|perfgate) to run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -13,8 +13,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-ALL_STAGES=(native python lint warm metrics forensics chaos shard dryrun
-            bench perfgate)
+ALL_STAGES=(native python lint warm metrics forensics chaos shard serve
+            dryrun bench perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -144,6 +144,29 @@ if want shard; then
   trap - EXIT
 fi
 
+if want serve; then
+  echo "== serving smoke (continuous batching, 0 steady-state compiles) =="
+  # two processes share one exec cache dir: the cold pass trains + saves
+  # the demo model and warms the bucket-ladder executables; the warm one
+  # replays a MIXED batch-size load and must scrape ZERO fresh compiles
+  # from the metrics registry, prove batched == per-request bit-for-bit,
+  # and land a latency capture that perf_diff gates against the
+  # committed serving budgets (p99, throughput, occupancy)
+  svdir="$(mktemp -d)"
+  trap 'rm -rf "$svdir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$svdir/cache" FLAGS_telemetry=1 \
+    python tools/serve_smoke.py cold "$svdir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$svdir/cache" FLAGS_telemetry=1 \
+    python tools/serve_smoke.py warm "$svdir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/perf_diff.py "$svdir/serve.json" \
+      --budgets benchmark/budgets.json --models serving
+  rm -rf "$svdir"
+  trap - EXIT
+fi
+
 if want dryrun; then
   echo "== multichip dryrun (dp+ZeRO / tp / sp / pp) =="
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -160,7 +183,7 @@ if want bench; then
   # line must parse and at least one model must have produced a number.
   out="$(BENCH_PLATFORM="${BENCH_PLATFORM-cpu}" python bench.py)"
   echo "$out"
-  echo "$out" | BENCH_EXPECT="${BENCH_MODELS-${BENCH_MODEL-resnet50,transformer}}" python -c '
+  echo "$out" | BENCH_EXPECT="${BENCH_MODELS-${BENCH_MODEL-resnet50,transformer,serving}}" python -c '
 import json, os, sys
 rec = json.loads(sys.stdin.readline())
 models = rec.get("models") or {}

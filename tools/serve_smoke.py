@@ -1,0 +1,148 @@
+"""Serving smoke: prove the continuous-batching server gives a warm
+process a mixed-shape steady state with ZERO fresh compiles, bit-exact
+batched results, and a gated p99.
+
+Run twice in two subprocesses sharing FLAGS_exec_cache_dir (tools/
+run_ci.sh `serve` stage does exactly that):
+
+    FLAGS_exec_cache_dir=$D/cache python tools/serve_smoke.py cold $D
+    FLAGS_exec_cache_dir=$D/cache python tools/serve_smoke.py warm $D
+
+The cold pass trains + saves the demo model into $D/model, then warms
+the executable cache through the server's bucket ladder and a replay.
+The warm pass — new process, the model loaded from disk, only the
+structural fingerprints connecting it to the cold pass — replays a
+MIXED batch-size load and asserts, in order:
+
+  * the metrics-registry scrape reports **0 fresh compiles** for the
+    whole warm process (`paddle_tpu_fresh_compiles_total 0`) — every
+    bucket executable came from the persistent cache;
+  * batched responses are bit-identical to the per-request
+    `Predictor.run` oracle (raw for on-rung row counts, pad-to-rung
+    `run_reference` for the rest);
+  * a capture (`$D/serve.json`) carrying requests/sec, latency
+    p50/p99, and batch occupancy, which the CI stage gates via
+    `tools/perf_diff.py --budgets benchmark/budgets.json
+    --models serving`.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N_REQUESTS = 48
+CONCURRENCY = 4
+
+
+def _make_server(model_dir, predictor=None):
+    from paddle_tpu.inference import NativeConfig, create_paddle_predictor
+    from paddle_tpu.serving import BatchingServer
+
+    predictor = predictor or create_paddle_predictor(
+        NativeConfig(model_dir=model_dir, use_tpu=False))
+    return predictor, BatchingServer(predictor, max_batch=8, workers=2,
+                                     batch_linger_s=0.002)
+
+
+def _scraped_fresh_compiles():
+    """The acceptance-criteria source: the metrics registry's scrape,
+    not a private counter."""
+    from paddle_tpu.observability import REGISTRY
+
+    for line in REGISTRY.to_prometheus().splitlines():
+        if line.startswith("paddle_tpu_fresh_compiles_total "):
+            return int(float(line.split()[-1]))
+    raise AssertionError("scrape carries no paddle_tpu_fresh_compiles_total")
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "cold"
+    workdir = sys.argv[2] if len(sys.argv) > 2 else None
+    if mode not in ("cold", "warm") or not workdir:
+        print("usage: serve_smoke.py cold|warm <workdir>", file=sys.stderr)
+        return 2
+    if not os.environ.get("FLAGS_exec_cache_dir"):
+        print("serve_smoke: FLAGS_exec_cache_dir not set", file=sys.stderr)
+        return 2
+    model_dir = os.path.join(workdir, "model")
+
+    from paddle_tpu.core import exec_cache
+    from paddle_tpu.observability import telemetry
+    from paddle_tpu.serving import loadgen
+
+    # the capture gates memory (predicted/measured peak) alongside the
+    # SLOs, so the ledger must be on even when the flag wasn't set
+    telemetry.enable(True)
+
+    if mode == "cold":
+        loadgen.build_demo_model(model_dir)
+    predictor, server = _make_server(model_dir)
+    try:
+        server.warmup()
+        wall, ok, errors = loadgen.replay(
+            server, loadgen.demo_requests(N_REQUESTS),
+            concurrency=CONCURRENCY)
+        assert ok == N_REQUESTS and not errors, (
+            "replay failed: ok=%d errors=%r" % (ok, errors[:3]))
+
+        if mode == "warm":
+            # steady state FIRST: the whole warm process — warmup
+            # included — must have been served from the persistent cache
+            scraped = _scraped_fresh_compiles()
+            st = exec_cache.stats()
+            assert scraped == 0, (
+                "warm process scrape shows %d fresh compile(s) under a "
+                "mixed-shape load; the bucket ladder failed its job "
+                "(aot_hits=%d aot_misses=%d)"
+                % (scraped, st["aot_hits"], st["aot_misses"]))
+            assert st["aot_hits"] >= 1, (
+                "warm process loaded no AOT images (re-traced): %r" % st)
+
+        # bit-exact parity (rung-sized raw comparisons only add already-
+        # compiled shapes, so the warm zero-compile claim stays intact)
+        rungs = set(server.stats()["batch_buckets"])
+        for req in loadgen.demo_requests(8, seed=23):
+            got = server.run(req)
+            want = server.run_reference(req)
+            for g, w in zip(got, want):
+                assert np.array_equal(g, w), "padded-oracle parity broke"
+            if req["x"].shape[0] in rungs:
+                for g, w in zip(got, predictor.run(req)):
+                    assert np.array_equal(g, np.asarray(w)), (
+                        "raw per-request parity broke at rung size %d"
+                        % req["x"].shape[0])
+        if mode == "warm":
+            assert _scraped_fresh_compiles() == 0, (
+                "parity replay itself recompiled — rung shapes drifted")
+
+        rec = loadgen.serving_capture(server, ok, wall)
+        from paddle_tpu import profiler
+
+        ms = profiler.memory_stats()
+        rec["predicted_peak_bytes"] = ms["predicted_peak_bytes"]
+        rec["peak_hbm_bytes"] = ms["measured_peak_bytes"]
+        st = exec_cache.stats()
+        rec["compile_seconds_cold"] = round(st["compile_seconds_cold"], 3)
+        rec["exec_cache"] = {
+            "enabled": st["enabled"],
+            "fresh_compiles": st["fresh_compiles"],
+            "persistent_hits": st["persistent_hits"],
+            "aot_hits": st["aot_hits"],
+        }
+        rec["platform"] = "cpu"
+        print("serve_smoke[%s]: %s" % (mode, json.dumps(rec)))
+        if mode == "warm":
+            with open(os.path.join(workdir, "serve.json"), "w") as f:
+                json.dump({"models": {"serving": rec}}, f)
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
