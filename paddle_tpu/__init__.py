@@ -78,6 +78,7 @@ from paddle_tpu.executor import Scope  # noqa: F401
 from paddle_tpu.layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401,E501
 from paddle_tpu.layers.control_flow import LoDTensorArray  # noqa: F401
 from paddle_tpu import serving  # noqa: F401
+from paddle_tpu import elastic  # noqa: F401
 
 __version__ = "0.1.0"
 

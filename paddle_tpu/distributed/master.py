@@ -23,7 +23,264 @@ import socketserver
 import threading
 import time
 
-__all__ = ["Task", "MasterService", "MasterClient", "task_reader"]
+__all__ = [
+    "Task", "MasterService", "MasterClient", "task_reader",
+    "serve_json_lines", "close_json_server", "JsonLineClient",
+    "ThrottledSnapshot",
+]
+
+
+# ---------------------------------------------------------------------------
+# shared transport + snapshot substrate (also used by elastic/coordinator.py)
+# ---------------------------------------------------------------------------
+
+
+def serve_json_lines(dispatch, host="127.0.0.1", port=0):
+    """Start a threading TCP endpoint speaking newline-delimited JSON:
+    every request line is parsed and handed to ``dispatch(dict) -> dict``;
+    exceptions become ``{"ok": False, "error": str(exc)}``. Returns
+    ``(server, (host, port))`` — the caller owns shutdown/server_close.
+    This is the one wire protocol every control-plane service in the
+    repo shares (master task queue, fleet coordinator): Python workers
+    need no RPC deps, and a line is a complete framed message."""
+
+    class Handler(socketserver.StreamRequestHandler):
+        def setup(self):
+            socketserver.StreamRequestHandler.setup(self)
+            with self.server._conn_mu:
+                self.server._live_conns.add(self.connection)
+
+        def finish(self):
+            with self.server._conn_mu:
+                self.server._live_conns.discard(self.connection)
+            socketserver.StreamRequestHandler.finish(self)
+
+        def handle(self):
+            for line in self.rfile:
+                try:
+                    req = json.loads(line)
+                    resp = dispatch(req)
+                except Exception as e:  # noqa: BLE001
+                    resp = {"ok": False, "error": str(e)}
+                self.wfile.write(
+                    (json.dumps(resp) + "\n").encode("utf-8"))
+                self.wfile.flush()
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    server = Server((host, port), Handler)
+    server._conn_mu = threading.Lock()
+    server._live_conns = set()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, server.server_address
+
+
+def close_json_server(server):
+    """Full shutdown of a serve_json_lines endpoint: stop accepting,
+    close the listener AND sever every established client connection —
+    ``server_close`` alone leaves accepted sockets alive, so a
+    'restarted' service would keep answering from the dead instance's
+    threads and clients would never exercise their reconnect path."""
+    if server is None:
+        return
+    server.shutdown()
+    server.server_close()
+    with server._conn_mu:
+        conns = list(server._live_conns)
+        server._live_conns.clear()
+    for conn in conns:
+        try:
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+class JsonLineClient(object):
+    """Shared client shell for the JSON-lines protocol: one persistent
+    socket, reconnect-and-retry-once across a service restart (the
+    resilience backoff+accounting), per-request chaos site hook. The
+    retried call is safe because every service speaking this protocol
+    follows the snapshot/recover pattern: a restarted service answers
+    with consistent state and unknown-id requests return a typed error
+    instead of corrupting."""
+
+    #: metrics/blackbox origin for retry accounting; subclasses override
+    origin = "JsonLineClient._call"
+
+    def __init__(self, addr, timeout_s=10.0):
+        if isinstance(addr, str):
+            host, _, port = addr.rpartition(":")
+            addr = (host or "127.0.0.1", int(port))
+        self._addr = (addr[0], int(addr[1]))
+        self._timeout_s = timeout_s
+        self._sock = None
+        self._rfile = None
+
+    def _chaos_site(self, req):
+        """Chaos site to arm for this request (None = uninstrumented)."""
+        return None
+
+    def _connect(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self._addr, timeout=self._timeout_s)
+            self._rfile = self._sock.makefile("rb")
+
+    def _call(self, **req):
+        """One RPC, surviving a service restart: on ConnectionError /
+        EOFError / a raw socket error the client reconnects and retries
+        ONCE (with the resilience backoff+accounting) before surfacing
+        the failure."""
+        from paddle_tpu.resilience import retry as _retry
+
+        def once():
+            from paddle_tpu.resilience import chaos as _chaos
+
+            if _chaos.ENABLED:
+                site = self._chaos_site(req)
+                if site:
+                    _chaos.fault(site)
+            self._connect()
+            try:
+                self._sock.sendall(
+                    (json.dumps(req) + "\n").encode("utf-8"))
+                line = self._rfile.readline()
+            except OSError:
+                self.close()
+                raise
+            if not line:
+                self.close()
+                raise ConnectionError(
+                    "%s: service closed connection" % type(self).__name__)
+            return json.loads(line)
+
+        return _retry.call(once, origin=self.origin, retries=1)
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+                self._rfile = None
+
+
+class ThrottledSnapshot(object):
+    """Crash-recovery snapshots with the disk write OFF the service
+    lock. ``capture(state)`` — called *while holding* the owner's lock —
+    only stamps the serialized state into a sequence-numbered pending
+    slot (plus the original write throttle: per-mutation churn coalesces
+    to one capture per ``interval_s``; ``force=True`` for structural
+    transitions). ``flush()`` — called with the owner's lock *released*
+    — lands the newest capture atomically (tmp file + rename).
+
+    Two guarantees the old write-under-the-lock scheme lacked:
+
+    * an RPC (a heartbeat, a get_task) never waits behind a slow
+      ``json.dump``+disk write happening under the service mutex — the
+      serialization and IO run on whichever thread calls flush, lock
+      free;
+    * commits are sequence-ordered: a slow stale writer racing a newer
+      one loses (its tmp file is discarded), so the *final* capture —
+      e.g. the forced one in ``close()`` — can never be clobbered by an
+      older in-flight write persisting a task as ``todo`` that is
+      actually leased or done.
+    """
+
+    def __init__(self, path, interval_s=0.5):
+        self.path = path
+        self.interval_s = float(interval_s)
+        self._mu = threading.Lock()  # guards pending/seq bookkeeping only
+        self._pending = None         # (seq, state): newest unflushed capture
+        self._seq = 0
+        self._written_seq = 0
+        self._last_capture = 0.0
+        self.dirty = False           # a throttled-away capture is owed
+
+    def capture(self, state, force=False):
+        """``state`` may be the state dict itself or a zero-arg callable
+        producing it — pass the callable from per-mutation hot paths, so
+        a throttled-away capture costs a clock read, not an O(n) state
+        serialization under the owner's lock."""
+        if not self.path:
+            return
+        with self._mu:
+            now = time.time()
+            if (not force
+                    and now - self._last_capture < self.interval_s):
+                self.dirty = True
+                return
+            self._last_capture = now
+            self.dirty = False
+            self._seq += 1
+            self._pending = (self._seq,
+                             state() if callable(state) else state)
+
+    def flush(self):
+        """Write the newest pending capture; a no-op when none. Never
+        call while holding the owner's service lock (defeats the point).
+        """
+        if not self.path:
+            return
+        with self._mu:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        seq, state = pending
+        tmp = "%s.tmp-%d-%d" % (self.path, os.getpid(), seq)
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        stale = None
+        with self._mu:
+            # the rename commits under the bookkeeping lock (it is an
+            # atomic metadata op, unlike the dump above): seq order is
+            # decided and acted on indivisibly, so a paused stale writer
+            # can never replace a newer snapshot after losing the check
+            if seq > self._written_seq:
+                self._written_seq = seq
+                os.replace(tmp, self.path)
+            else:
+                stale = tmp
+        if stale:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+
+    def load(self):
+        """Parsed snapshot state, or None. A MISSING file is a normal
+        cold start (silent); an existing-but-unreadable one is a loud
+        event — it is quarantined (``.corrupt-<n>``, kept for autopsy,
+        the checkpoint-layer discipline) and logged, because a service
+        silently coming up empty is indistinguishable from data loss."""
+        if not self.path or not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as exc:
+            n = 0
+            dst = "%s.corrupt-%d" % (self.path, n)
+            while os.path.exists(dst):
+                n += 1
+                dst = "%s.corrupt-%d" % (self.path, n)
+            try:
+                os.replace(self.path, dst)
+            except OSError:
+                dst = None
+            import logging
+
+            logging.getLogger("paddle_tpu.distributed").warning(
+                "snapshot %s exists but is unreadable (%s); quarantined "
+                "to %s — the service recovers NOTHING and starts empty",
+                self.path, exc, dst)
+            return None
 
 
 class Task(object):
@@ -78,9 +335,8 @@ class MasterService(object):
         self._server = None
         self._watcher = None
         self._closed = threading.Event()
-        self._snapshot_interval_s = float(snapshot_interval_s)
-        self._last_snapshot = 0.0
-        self._snapshot_dirty = False
+        self._snap = ThrottledSnapshot(snapshot_path,
+                                       interval_s=snapshot_interval_s)
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover()
 
@@ -94,6 +350,7 @@ class MasterService(object):
             if not self._todo and not self._pending and not self._done:
                 self._todo = self._partition(self._all_chunks)
                 self._snapshot(force=True)
+        self._snap.flush()
 
     def _partition(self, chunks):
         tasks = []
@@ -119,41 +376,48 @@ class MasterService(object):
             self._pending[t.task_id] = (t, time.time() + self._timeout_s)
             self._snapshot()
             self._ensure_watcher()
-            return Task(t.task_id, t.chunks, t.epoch, t.num_failures), None
+            leased = Task(t.task_id, t.chunks, t.epoch, t.num_failures)
+        self._snap.flush()
+        return leased, None
 
     def task_finished(self, task_id):
         with self._mu:
             ent = self._pending.pop(task_id, None)
-            if ent is None:
-                return False
-            self._done.append(ent[0])
-            rolled = False
-            if not self._todo and not self._pending:
-                self._next_pass()
-                rolled = True
-            self._snapshot(force=rolled)
-            return True
+            if ent is not None:
+                self._done.append(ent[0])
+                rolled = False
+                if not self._todo and not self._pending:
+                    self._next_pass()
+                    rolled = True
+                self._snapshot(force=rolled)
+        self._snap.flush()
+        return ent is not None
 
     def task_failed(self, task_id, epoch=None):
         """Report failure (worker crash detected, bad data...). Requeues the
         task until failure_max, then discards it (service.go:455)."""
         with self._mu:
-            ent = self._pending.get(task_id)
-            if ent is None:
-                return False
-            t, _ = ent
-            if epoch is not None and epoch != t.epoch:
-                return False  # stale report from a previous lease
-            del self._pending[task_id]
-            t.num_failures += 1
-            if t.num_failures >= self._failure_max:
-                self._failed.append(t)
-            else:
-                self._todo.append(t)
-            if not self._todo and not self._pending and self._done:
-                self._next_pass()
-            self._snapshot()
-            return True
+            ok = self._task_failed_locked(task_id, epoch)
+        self._snap.flush()
+        return ok
+
+    def _task_failed_locked(self, task_id, epoch):
+        ent = self._pending.get(task_id)
+        if ent is None:
+            return False
+        t, _ = ent
+        if epoch is not None and epoch != t.epoch:
+            return False  # stale report from a previous lease
+        del self._pending[task_id]
+        t.num_failures += 1
+        if t.num_failures >= self._failure_max:
+            self._failed.append(t)
+        else:
+            self._todo.append(t)
+        if not self._todo and not self._pending and self._done:
+            self._next_pass()
+        self._snapshot()
+        return True
 
     def _next_pass(self):
         self._cur_pass += 1
@@ -180,10 +444,20 @@ class MasterService(object):
                     (tid, t.epoch) for tid, (t, dl) in self._pending.items()
                     if dl <= now
                 ]
-                for tid, epoch in expired:
-                    self.task_failed(tid, epoch)
+            # fail the leases via the PUBLIC method, outside our own lock
+            # hold: it re-validates (pending membership + epoch) under the
+            # lock and flushes the snapshot off-lock
+            for tid, epoch in expired:
+                self.task_failed(tid, epoch)
+            with self._mu:
                 if not self._pending:
-                    return  # watcher exits when nothing is leased
+                    # exit decision and watcher-slot release are ONE
+                    # atomic step: a lease taken after this point sees
+                    # the slot empty and _ensure_watcher spawns a fresh
+                    # watcher instead of trusting this dying thread
+                    if self._watcher is threading.current_thread():
+                        self._watcher = None
+                    return
             self._closed.wait(min(self._timeout_s / 4.0, 0.25))
 
     # -- introspection / persistence ----------------------------------------
@@ -199,38 +473,31 @@ class MasterService(object):
             }
 
     def _snapshot(self, force=False):
-        """Write-throttled persistence: per-lease churn is coalesced (at
-        most one write per _snapshot_interval_s); structural transitions
-        (dataset set, pass rollover, close) force a write. Bounded
-        staleness is the TPU-rebuild trade vs the reference's
-        every-mutation etcd write (service.go:207) — on recovery a
-        slightly-stale snapshot only re-dispatches already-done tasks."""
-        if not self._snapshot_path:
-            return
-        now = time.time()
-        if not force and now - self._last_snapshot < self._snapshot_interval_s:
-            self._snapshot_dirty = True
-            return
-        self._last_snapshot = now
-        self._snapshot_dirty = False
-        state = {
+        """Capture-only persistence (call with ``_mu`` held): the state
+        dict is stamped into the ThrottledSnapshot's pending slot —
+        per-lease churn coalesced to one capture per interval, structural
+        transitions (dataset set, pass rollover, close) forced — and the
+        actual ``json.dump`` + disk write happens in ``_snap.flush()``
+        AFTER the caller releases ``_mu``, so concurrent RPCs never queue
+        behind the serialization work. Bounded staleness is the
+        TPU-rebuild trade vs the reference's every-mutation etcd write
+        (service.go:207) — on recovery a slightly-stale snapshot only
+        re-dispatches already-done tasks."""
+        self._snap.capture(lambda: {
             "todo": [t.to_json() for t in self._todo],
             "pending": [t.to_json() for t, _ in self._pending.values()],
             "done": [t.to_json() for t in self._done],
             "failed": [t.to_json() for t in self._failed],
             "cur_pass": self._cur_pass,
             "chunks": self._all_chunks,
-        }
-        tmp = self._snapshot_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, self._snapshot_path)
+        }, force=force)
 
     def _recover(self):
         """service.go:166 — a restarted master resumes from the snapshot;
         tasks that were pending at crash time go back to todo."""
-        with open(self._snapshot_path) as f:
-            state = json.load(f)
+        state = self._snap.load()
+        if state is None:
+            return
         self._todo = [Task.from_json(d) for d in state["todo"]]
         self._todo += [Task.from_json(d) for d in state["pending"]]
         self._done = [Task.from_json(d) for d in state["done"]]
@@ -242,28 +509,8 @@ class MasterService(object):
 
     def serve(self, host="127.0.0.1", port=0):
         """Start the TCP endpoint; returns (host, port)."""
-        service = self
-
-        class Handler(socketserver.StreamRequestHandler):
-            def handle(self):
-                for line in self.rfile:
-                    try:
-                        req = json.loads(line)
-                        resp = service._dispatch(req)
-                    except Exception as e:  # noqa: BLE001
-                        resp = {"ok": False, "error": str(e)}
-                    self.wfile.write(
-                        (json.dumps(resp) + "\n").encode("utf-8"))
-                    self.wfile.flush()
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = Server((host, port), Handler)
-        threading.Thread(
-            target=self._server.serve_forever, daemon=True).start()
-        return self._server.server_address
+        self._server, addr = serve_json_lines(self._dispatch, host, port)
+        return addr
 
     def _dispatch(self, req):
         method = req.get("method")
@@ -286,66 +533,41 @@ class MasterService(object):
 
     def close(self):
         with self._mu:
-            if self._snapshot_dirty:
+            if self._snap.dirty:
                 self._snapshot(force=True)
+        # the final flush is sequence-ordered: even if an older capture's
+        # write is still in flight on another thread, this newest state
+        # wins — close() can never leave a leased/done task persisted in
+        # a stale 'todo' position
+        self._snap.flush()
         self._closed.set()
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
+        close_json_server(self._server)
+        self._server = None
 
 
-class MasterClient(object):
+class MasterClient(JsonLineClient):
     """Worker-side client (go/master/client.go role): fetch/finish/fail
-    tasks over the JSON-lines TCP protocol, with pass tracking."""
+    tasks over the JSON-lines TCP protocol, with pass tracking.
+
+    Every ``_call`` survives a master restart (reconnect-and-retry-once,
+    inherited from :class:`JsonLineClient`): the master's snapshot/
+    recover path means a restarted master answers the retried call with
+    consistent task state, and every method here is either idempotent
+    (get_task leases a fresh epoch, status/set_dataset) or safely
+    re-reportable (task_finished / task_failed on an unknown lease
+    returns ok=False, it doesn't corrupt)."""
+
+    origin = "MasterClient._call"
 
     def __init__(self, addr, timeout_s=10.0):
-        self._addr = addr
-        self._timeout_s = timeout_s
-        self._sock = None
-        self._rfile = None
+        super(MasterClient, self).__init__(addr, timeout_s=timeout_s)
         self.pass_id = 0
         # set when the master reports our pass is over (PASS_BEFORE with
         # sync_pass=False); task_reader uses it as the end-of-epoch signal
         self.pass_ended = False
 
-    def _connect(self):
-        if self._sock is None:
-            self._sock = socket.create_connection(
-                self._addr, timeout=self._timeout_s)
-            self._rfile = self._sock.makefile("rb")
-
-    def _call(self, **req):
-        """One RPC, surviving a master restart: on ConnectionError /
-        EOFError / a raw socket error the client reconnects and retries
-        ONCE (with the resilience backoff+accounting) before surfacing
-        the failure. The master's snapshot/recover path means a restarted
-        master answers the retried call with consistent task state; every
-        method here is either idempotent (get_task leases a fresh epoch,
-        status/set_dataset) or safely re-reportable (task_finished /
-        task_failed on an unknown lease returns ok=False, it doesn't
-        corrupt)."""
-        from paddle_tpu.resilience import retry as _retry
-
-        def once():
-            from paddle_tpu.resilience import chaos as _chaos
-
-            if _chaos.ENABLED:
-                _chaos.fault("master.call")
-            self._connect()
-            try:
-                self._sock.sendall(
-                    (json.dumps(req) + "\n").encode("utf-8"))
-                line = self._rfile.readline()
-            except OSError:
-                self.close()
-                raise
-            if not line:
-                self.close()
-                raise ConnectionError("master closed connection")
-            return json.loads(line)
-
-        return _retry.call(once, origin="MasterClient._call", retries=1)
+    def _chaos_site(self, req):
+        return "master.call"
 
     def get_task(self, sync_pass=True):
         """Returns a Task or None. With sync_pass (default), a client
@@ -382,14 +604,6 @@ class MasterClient(object):
 
     def set_dataset(self, chunks):
         return self._call(method="set_dataset", chunks=chunks).get("ok")
-
-    def close(self):
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            finally:
-                self._sock = None
-                self._rfile = None
 
 
 def task_reader(client, load_chunk, poll_s=0.1, max_polls=600):

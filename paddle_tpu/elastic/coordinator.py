@@ -1,0 +1,409 @@
+"""Fleet membership coordinator: heartbeat leases, rank assignment,
+membership generations, snapshot recovery.
+
+``distributed/master.py`` made the *data* plane elastic (leased chunks
+requeue when a worker dies); nothing owned the *worker* plane — who is
+in the fleet, what rank each worker holds, and when the mesh shape has
+to change. :class:`FleetCoordinator` is that owner, the go/master +
+pserver membership role ("TensorFlow: a system for large-scale machine
+learning" frames exactly this — a cluster runtime that tolerates worker
+churn — as table stakes), rebuilt on the repo's shared control-plane
+substrate: the JSON-lines TCP transport and the off-lock
+:class:`~paddle_tpu.distributed.master.ThrottledSnapshot` pattern.
+
+Contract (the "generation protocol", docs/RESILIENCE.md):
+
+* ``register(worker_id)`` admits a worker, assigns the next rank and
+  bumps the **membership generation** — a monotonically increasing
+  integer naming one exact fleet composition. Ranks are dense
+  ``0..world-1``, ordered by admission; rank 0 is the *chief*.
+* ``heartbeat(worker_id, step)`` renews the worker's lease and returns
+  the current ``(generation, world, rank)`` plus the reshard-serial map
+  — the step-barrier poll :class:`~paddle_tpu.elastic.worker.
+  ElasticTrainSession` acts on. A worker whose lease expired gets the
+  typed ``unknown_worker`` error and must re-register (it rejoins as a
+  NEW member at the next generation).
+* a watcher thread **evicts** workers that miss heartbeats for
+  ``lease_s`` (``paddle_tpu_fleet_evictions_total``), compacts the
+  surviving ranks and bumps the generation — one bump per eviction
+  sweep, so a host failure taking several workers is one reshape, not
+  many.
+* ``report_reshard(generation, serial)`` — the chief of a new
+  generation publishes which checkpoint serial that generation restores
+  from; joiners poll it off the heartbeat response (the barrier that
+  keeps a rejoining worker from restoring a stale serial).
+* crash recovery: membership, generation and the reshard map persist
+  through the throttled snapshot; a restarted coordinator re-admits the
+  recorded members with fresh leases at the SAME generation, so
+  surviving workers' heartbeats (which retry once across the restart,
+  the shared JsonLineClient contract) resume without a spurious
+  reshape.
+
+Chaos sites ``fleet.register`` / ``fleet.heartbeat`` (and
+``fleet.<method>`` generally) arm on the client side, so churn is
+injectable with the seeded ``FLAGS_chaos_spec`` grammar.
+"""
+
+import threading
+import time
+
+from paddle_tpu.distributed.master import (
+    JsonLineClient,
+    ThrottledSnapshot,
+    close_json_server,
+    serve_json_lines,
+)
+from paddle_tpu.observability.metrics_registry import REGISTRY
+
+__all__ = [
+    "FleetCoordinator", "FleetClient", "FleetEvictedError",
+    "UNKNOWN_WORKER",
+]
+
+UNKNOWN_WORKER = "unknown_worker"
+
+_fleet_size = REGISTRY.gauge(
+    "paddle_tpu_fleet_size",
+    "live workers in the fleet (coordinator truth; workers mirror it "
+    "from heartbeat responses)")
+_fleet_generation = REGISTRY.gauge(
+    "paddle_tpu_fleet_generation",
+    "membership generation — bumps on every join/evict/leave; one "
+    "generation names one exact fleet composition")
+_evictions_total = REGISTRY.counter(
+    "paddle_tpu_fleet_evictions_total",
+    "workers evicted for missing heartbeats past their lease")
+
+
+class FleetEvictedError(RuntimeError):
+    """This worker is no longer a fleet member (lease expired and the
+    coordinator evicted it, or the coordinator restarted from a snapshot
+    that predates the registration). Recovery: re-register — the worker
+    rejoins as a new member at the next generation."""
+
+
+class FleetCoordinator(object):
+    """See module docstring. In-process service; ``serve()`` exposes it
+    over the shared JSON-lines TCP transport."""
+
+    def __init__(self, lease_s=5.0, min_workers=1, snapshot_path=None,
+                 snapshot_interval_s=0.5, max_reshard_history=8):
+        self._lease_s = float(lease_s)
+        self._min_workers = max(1, int(min_workers))
+        self._max_reshard_history = max(1, int(max_reshard_history))
+        self._mu = threading.RLock()
+        self._members = {}   # worker_id -> {rank, join, deadline, step, meta}
+        self._generation = 0
+        self._reshard = {}   # generation -> checkpoint serial
+        self._next_join = 0  # admission counter: rank order, never reused
+        self._next_auto_id = 0
+        self._server = None
+        self._watcher = None
+        self._closed = threading.Event()
+        self._snap = ThrottledSnapshot(snapshot_path,
+                                       interval_s=snapshot_interval_s)
+        if snapshot_path:
+            self._recover()
+        self._export_gauges()
+
+    # -- membership ---------------------------------------------------------
+
+    def register(self, worker_id=None, meta=None):
+        """Admit a worker (or re-admit a returning one — a live entry
+        under the same id is replaced, still one generation bump: the
+        old incarnation's state is gone either way). Returns the full
+        membership view the worker boots from."""
+        with self._mu:
+            if worker_id is None:
+                worker_id = "w-%d" % self._next_auto_id
+                self._next_auto_id += 1
+            worker_id = str(worker_id)
+            self._members.pop(worker_id, None)
+            self._members[worker_id] = {
+                "rank": -1,  # assigned by the compaction below
+                "join": self._next_join,
+                "deadline": time.time() + self._lease_s,
+                "step": None,
+                "meta": meta or {},
+            }
+            self._next_join += 1
+            self._recompute_ranks()
+            self._bump_generation()
+            self._ensure_watcher()
+            resp = self._membership_view(worker_id)
+            resp["worker_id"] = worker_id
+            self._snapshot(force=True)
+        self._snap.flush()
+        return resp
+
+    def heartbeat(self, worker_id, step=None):
+        """Renew the lease; returns the membership view (or the typed
+        ``unknown_worker`` error via ``None`` — the TCP dispatch maps it).
+        Pure lease refresh: no generation change, no snapshot churn."""
+        with self._mu:
+            m = self._members.get(str(worker_id))
+            if m is None:
+                return None
+            m["deadline"] = time.time() + self._lease_s
+            if step is not None:
+                m["step"] = int(step)
+            return self._membership_view(str(worker_id))
+
+    def leave(self, worker_id):
+        """Voluntary departure (clean shutdown): same membership effect
+        as an eviction, minus the eviction counter and the lease wait."""
+        with self._mu:
+            removed = self._members.pop(str(worker_id), None)
+            if removed is not None:
+                self._recompute_ranks()
+                self._bump_generation()
+                self._snapshot(force=True)
+        self._snap.flush()
+        return removed is not None
+
+    def report_reshard(self, generation, serial):
+        """The chief of ``generation`` publishes the checkpoint serial
+        that generation restores from (the join/reshape barrier)."""
+        with self._mu:
+            self._reshard[int(generation)] = int(serial)
+            for g in sorted(self._reshard)[:-self._max_reshard_history]:
+                del self._reshard[g]
+            self._snapshot(force=True)
+        self._snap.flush()
+        return True
+
+    def status(self):
+        with self._mu:
+            return {
+                "world": len(self._members),
+                "generation": self._generation,
+                "ready": len(self._members) >= self._min_workers,
+                "min_workers": self._min_workers,
+                "members": {
+                    wid: {"rank": m["rank"], "step": m["step"]}
+                    for wid, m in self._members.items()
+                },
+                # int keys in process; the JSON wire stringifies them and
+                # FleetClient maps them back
+                "reshard": dict(self._reshard),
+            }
+
+    # -- internals (call with _mu held) -------------------------------------
+
+    def _membership_view(self, worker_id):
+        return {
+            "generation": self._generation,
+            "world": len(self._members),
+            "rank": self._members[worker_id]["rank"],
+            "ready": len(self._members) >= self._min_workers,
+            "lease_s": self._lease_s,
+            "reshard": dict(self._reshard),
+        }
+
+    def _recompute_ranks(self):
+        """Dense ranks 0..n-1 in admission order: survivors keep their
+        relative order, so the chief role (rank 0) moves to the oldest
+        surviving member when the old chief dies."""
+        for rank, (wid, m) in enumerate(
+                sorted(self._members.items(), key=lambda kv: kv[1]["join"])):
+            m["rank"] = rank
+
+    def _bump_generation(self):
+        self._generation += 1
+        self._export_gauges()
+
+    def _export_gauges(self):
+        _fleet_size.set(len(self._members))
+        _fleet_generation.set(self._generation)
+
+    # -- lease watcher -------------------------------------------------------
+
+    def _ensure_watcher(self):
+        if self._watcher is None or not self._watcher.is_alive():
+            self._watcher = threading.Thread(
+                target=self._watch_loop, daemon=True,
+                name="paddle-tpu-fleet-watcher")
+            self._watcher.start()
+
+    def _watch_loop(self):
+        while not self._closed.is_set():
+            now = time.time()
+            with self._mu:
+                expired = [wid for wid, m in self._members.items()
+                           if m["deadline"] <= now]
+                if expired:
+                    for wid in expired:
+                        del self._members[wid]
+                        _evictions_total.inc()
+                    self._recompute_ranks()
+                    # one bump per sweep: a host failure killing several
+                    # workers is ONE reshape for the survivors
+                    self._bump_generation()
+                    self._snapshot(force=True)
+                empty = not self._members
+            if expired:
+                self._snap.flush()
+                from paddle_tpu.observability import blackbox
+
+                if blackbox.ENABLED:
+                    blackbox.record("fleet_eviction", workers=expired,
+                                    generation=self._generation)
+            if empty:
+                # re-check AND release the watcher slot under the lock:
+                # a register() that landed while the flush above ran must
+                # either be seen here (keep watching) or find the slot
+                # empty and spawn a fresh watcher — a dying thread that
+                # still owned the slot would leave live members with no
+                # eviction sweep
+                with self._mu:
+                    if self._members:
+                        continue
+                    if self._watcher is threading.current_thread():
+                        self._watcher = None
+                    return
+            self._closed.wait(min(self._lease_s / 4.0, 0.25))
+
+    # -- persistence ---------------------------------------------------------
+
+    def _snapshot(self, force=False):
+        self._snap.capture(lambda: {
+            "generation": self._generation,
+            "next_join": self._next_join,
+            "next_auto_id": self._next_auto_id,
+            "reshard": {str(g): s for g, s in self._reshard.items()},
+            "members": [
+                {"worker_id": wid, "rank": m["rank"], "join": m["join"],
+                 "step": m["step"], "meta": m["meta"]}
+                for wid, m in self._members.items()
+            ],
+        }, force=force)
+
+    def _recover(self):
+        """A restarted coordinator resumes at the SAME generation with
+        the recorded members on fresh leases: surviving workers'
+        retrying heartbeats simply resume, no spurious reshape. Members
+        that registered after the last snapshot heartbeat into
+        ``unknown_worker`` and re-register — bounded staleness, same
+        trade the master's snapshot documents."""
+        state = self._snap.load()
+        if state is None:
+            return
+        self._generation = int(state.get("generation", 0))
+        self._next_join = int(state.get("next_join", 0))
+        self._next_auto_id = int(state.get("next_auto_id", 0))
+        self._reshard = {int(g): int(s)
+                        for g, s in (state.get("reshard") or {}).items()}
+        deadline = time.time() + self._lease_s
+        for m in state.get("members", ()):
+            self._members[str(m["worker_id"])] = {
+                "rank": int(m["rank"]),
+                "join": int(m["join"]),
+                "deadline": deadline,
+                "step": m.get("step"),
+                "meta": m.get("meta") or {},
+            }
+        if self._members:
+            self._ensure_watcher()
+
+    # -- TCP front-end --------------------------------------------------------
+
+    def serve(self, host="127.0.0.1", port=0):
+        """Start the JSON-lines TCP endpoint; returns (host, port)."""
+        self._server, addr = serve_json_lines(self._dispatch, host, port)
+        return addr
+
+    def _dispatch(self, req):
+        method = req.get("method")
+        if method == "register":
+            return {"ok": True,
+                    "view": self.register(req.get("worker_id"),
+                                          req.get("meta"))}
+        if method == "heartbeat":
+            view = self.heartbeat(req["worker_id"], req.get("step"))
+            if view is None:
+                return {"ok": False, "error": UNKNOWN_WORKER}
+            return {"ok": True, "view": view}
+        if method == "leave":
+            return {"ok": self.leave(req["worker_id"])}
+        if method == "report_reshard":
+            return {"ok": self.report_reshard(req["generation"],
+                                              req["serial"])}
+        if method == "status":
+            return {"ok": True, "status": self.status()}
+        return {"ok": False, "error": "unknown method %r" % method}
+
+    def close(self):
+        with self._mu:
+            if self._snap.dirty:
+                self._snapshot(force=True)
+        self._snap.flush()
+        self._closed.set()
+        close_json_server(self._server)
+        self._server = None
+
+
+class FleetClient(JsonLineClient):
+    """Worker-side coordinator client. Every call reconnects-and-retries
+    once across a coordinator restart (the recovered coordinator answers
+    with consistent membership), with coordinator RPC failures
+    classified by ``resilience.retry`` — transient transport errors back
+    off, a typed eviction surfaces immediately as
+    :class:`FleetEvictedError`. Chaos sites: ``fleet.<method>``
+    (``fleet.heartbeat`` and ``fleet.register`` are the documented churn
+    injection points)."""
+
+    origin = "FleetClient._call"
+
+    def _chaos_site(self, req):
+        return "fleet.%s" % req.get("method")
+
+    def register(self, worker_id=None, meta=None):
+        if worker_id is None:
+            # the identity is minted CLIENT-side: the transport retries
+            # once across a coordinator restart, and a retried register
+            # carrying the same id is absorbed as a replacement (one
+            # member) — a server-minted id would turn that retry into a
+            # ghost member that inflates the world and can squat on the
+            # chief rank
+            import uuid
+
+            worker_id = "w-%s" % uuid.uuid4().hex[:10]
+        resp = self._call(method="register", worker_id=worker_id, meta=meta)
+        if not resp.get("ok"):
+            raise RuntimeError("fleet register failed: %s"
+                               % resp.get("error"))
+        return _int_reshard(resp["view"])
+
+    def heartbeat(self, worker_id, step=None):
+        resp = self._call(method="heartbeat", worker_id=worker_id, step=step)
+        if not resp.get("ok"):
+            if resp.get("error") == UNKNOWN_WORKER:
+                raise FleetEvictedError(
+                    "worker %r is no longer a fleet member (lease "
+                    "expired or coordinator recovered an older snapshot)"
+                    % worker_id)
+            raise RuntimeError("fleet heartbeat failed: %s"
+                               % resp.get("error"))
+        return _int_reshard(resp["view"])
+
+    def leave(self, worker_id):
+        return self._call(method="leave", worker_id=worker_id).get("ok")
+
+    def report_reshard(self, generation, serial):
+        return self._call(method="report_reshard",
+                          generation=int(generation),
+                          serial=int(serial)).get("ok")
+
+    def status(self):
+        status = self._call(method="status").get("status")
+        if status is not None:
+            _int_reshard(status)
+        return status
+
+
+def _int_reshard(view):
+    """JSON round-trips the reshard map's generation keys as strings;
+    hand workers back real ints."""
+    view["reshard"] = {int(g): int(s)
+                      for g, s in (view.get("reshard") or {}).items()}
+    return view

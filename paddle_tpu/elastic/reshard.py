@@ -1,0 +1,249 @@
+"""Checkpoint resharding: move training state between mesh shapes.
+
+A PR 5 :class:`~paddle_tpu.resilience.checkpoint.CheckpointManager`
+checkpoint freezes state as whole host arrays — correct, but blind to
+the mesh it came from. When the fleet reshapes (a worker dies, one
+joins), the surviving mesh has a *different* ``ShardingPlan``, and the
+next save/restore cycle must move every var between layouts without a
+human in the loop. This module is that mover:
+
+* :class:`ShardedCheckpointManager` writes each var as **per-shard
+  files** laid out by the plan's dim-0 split factors
+  (``<var>.shard-00-of-04.npy`` …), with per-shard sha256 digests and
+  the mesh shape + factors recorded in the manifest
+  (``extra["sharding"]``) — so a checkpoint *names* the mesh it was
+  written under and ``tools/ckpt_inspect.py`` can cross-check shard
+  bytes offline.
+* :func:`reassemble_checkpoint` verifies and reassembles a sharded (or
+  plain) checkpoint back to full host arrays.
+* :func:`reshard_checkpoint` re-splits one checkpoint dir under a new
+  plan's ``plan_shard_factors`` — the 4→2→1→4 round trip the elastic
+  runtime and its tests drive.
+
+Reshard rules (the table in docs/RESILIENCE.md):
+
+=====================  ====================================================
+layout                 rule
+=====================  ====================================================
+replicated (factor 1)  copied through verbatim
+data-parallel          params replicate under pure data parallelism →
+                       copied through; only feeds shard the data axis and
+                       feeds are never checkpointed
+fsdp / dim-0 sharded   reassembled by axis-0 concat, re-split by the new
+                       plan's factor (the plan only shards divisible dims)
+anything else          :class:`ReshardError` naming the var — a tp
+(tp column splits,     column split or a multi-dim shard cannot be
+dim>0, multi-dim)      re-split by axis-0 surgery, and silently
+                       replicating it would corrupt the optimizer state
+                       it is sharded against. NEVER silent.
+=====================  ====================================================
+"""
+
+import os
+import time
+
+import numpy as np
+
+from paddle_tpu.observability.metrics_registry import REGISTRY
+from paddle_tpu.resilience.checkpoint import (
+    CheckpointManager,
+    _safe_name,
+    _sha256_file,
+    assemble_var,
+    read_manifest,
+    verify_checkpoint_dir,
+)
+
+__all__ = [
+    "ReshardError", "ShardedCheckpointManager", "shard_factors_for",
+    "reassemble_checkpoint", "reshard_checkpoint", "checkpoint_sharding",
+]
+
+_reshard_seconds = REGISTRY.histogram(
+    "paddle_tpu_reshard_seconds",
+    "wall seconds per checkpoint reshard (verify + reassemble + "
+    "re-split + write), and per elastic worker mesh rebuild")
+
+
+class ReshardError(RuntimeError):
+    """A var's layout cannot be moved between mesh shapes by this
+    resharder. Always names the var (``.var_name``) — the operator's
+    first question — and never degrades to silent replication."""
+
+    def __init__(self, var_name, why):
+        self.var_name = var_name
+        super(ReshardError, self).__init__(
+            "cannot reshard var %r: %s" % (var_name, why))
+
+
+def shard_factors_for(plan, names=None):
+    """``{var name -> dim-0 split factor}`` for every persistable var a
+    :class:`~paddle_tpu.parallel.sharding.ShardingPlan` shards —
+    *validated for reshardability*: a spec that shards any dim other
+    than 0 (a Megatron column split, a multi-dim layout) raises
+    :class:`ReshardError` naming the var. ``names`` optionally restricts
+    the sweep (e.g. to the vars actually being checkpointed)."""
+    factors = {}
+    for name, spec in plan.specs.items():
+        if names is not None and name not in names:
+            continue
+        if plan.kinds.get(name) != "param":
+            continue  # feeds/activations are never checkpointed
+        for dim, entry in enumerate(spec):
+            axes = (entry,) if isinstance(entry, str) else tuple(entry or ())
+            if not axes:
+                continue
+            if dim != 0:
+                raise ReshardError(
+                    name, "dim %d is sharded over %s — only dim-0 "
+                    "(fsdp/data) layouts reshard; re-derive the plan "
+                    "without tensor parallelism or restore it at the "
+                    "original mesh shape" % (dim, list(axes)))
+        f = plan.shard_factor(name)
+        if f > 1:
+            factors[name] = int(f)
+    return factors
+
+
+def checkpoint_sharding(manifest):
+    """The sharding record a manifest carries (``extra["sharding"]``:
+    ``{"mesh_axes": {...}, "factors": {...}}``), or None for a plain
+    pre-elastic checkpoint."""
+    return ((manifest or {}).get("extra") or {}).get("sharding")
+
+
+class ShardedCheckpointManager(CheckpointManager):
+    """A CheckpointManager whose var files are laid out by a sharding
+    plan: vars with a dim-0 split factor land as ``factor`` shard files
+    (each digest-verified on its own), everything else as the plain
+    single file. Atomicity, quarantine, async writes, RNG capture and
+    retention are all inherited; restore reassembles either dialect
+    (``checkpoint.assemble_var``). Pass either a derived ``plan`` (the
+    factors are extracted and *validated* — tp layouts raise
+    :class:`ReshardError` at construction, not mid-save) or explicit
+    ``factors`` + ``mesh_axes``."""
+
+    def __init__(self, checkpoint_dir, plan=None, factors=None,
+                 mesh_axes=None, **kwargs):
+        super(ShardedCheckpointManager, self).__init__(
+            checkpoint_dir, **kwargs)
+        if plan is not None:
+            factors = shard_factors_for(plan)
+            mesh_axes = dict(plan.mesh_axes)
+        self.factors = {str(k): int(v) for k, v in (factors or {}).items()}
+        self.mesh_axes = {str(k): int(v)
+                          for k, v in (mesh_axes or {}).items()}
+
+    def _write_one_var(self, tmp_dir, name, arr):
+        k = int(self.factors.get(name, 1))
+        if k <= 1:
+            return super(ShardedCheckpointManager, self)._write_one_var(
+                tmp_dir, name, arr)
+        if arr.ndim == 0 or arr.shape[0] % k:
+            # the plan promised a divisible dim-0; a mismatch means the
+            # live state and the plan disagree — save loudly, never a
+            # silently-unsharded file the next reshard misreads
+            raise ReshardError(
+                name, "plan factor %d does not divide dim 0 of shape %s"
+                % (k, tuple(arr.shape)))
+        rows = arr.shape[0] // k
+        shards = []
+        total = 0
+        for i in range(k):
+            fname = "%s.shard-%02d-of-%02d.npy" % (_safe_name(name), i, k)
+            path = os.path.join(tmp_dir, fname)
+            piece = np.ascontiguousarray(arr[i * rows:(i + 1) * rows])
+            np.save(path, piece)
+            shards.append({
+                "file": fname,
+                "sha256": _sha256_file(path),
+                "shape": list(piece.shape),
+                "bytes": int(piece.nbytes),
+            })
+            total += int(piece.nbytes)
+        return {
+            "shards": shards,
+            "shard_axis": 0,
+            "factor": k,
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "bytes": total,
+        }
+
+    def _write(self, snap, rng, step, serial, extra):
+        extra = dict(extra or {})
+        extra["sharding"] = {
+            "mesh_axes": dict(self.mesh_axes),
+            "factors": {n: f for n, f in sorted(self.factors.items())
+                        if n in snap},
+        }
+        return super(ShardedCheckpointManager, self)._write(
+            snap, rng, step, serial, extra)
+
+    def write_state(self, snap, rng=None, step=0, serial=None, extra=None):
+        """Land an explicit ``{name: host array}`` state dict as one
+        complete checkpoint (atomic + digest-verified, like every save)
+        without going through a scope — the reshard path's writer."""
+        return self._write(dict(snap), rng, int(step),
+                           int(serial if serial is not None else step),
+                           extra or {})
+
+
+def reassemble_checkpoint(step_dir, manifest=None, verify=True):
+    """Full host arrays from one ``checkpoint_<serial>`` dir, either
+    dialect. Returns ``({name: np.ndarray}, manifest)``. With ``verify``
+    (default) every file is re-hashed first; any problem raises
+    :class:`ReshardError` naming the first offending var — resharding
+    from a corrupt source must die before it writes anything."""
+    manifest = manifest or read_manifest(step_dir)
+    if manifest is None:
+        raise ReshardError(
+            "<manifest>", "no readable manifest under %s" % step_dir)
+    if verify:
+        problems = verify_checkpoint_dir(step_dir, manifest)
+        if problems:
+            raise ReshardError("<verification>", "; ".join(problems[:3]))
+    snap = {}
+    for name, meta in sorted(manifest.get("vars", {}).items()):
+        if meta.get("shards") and int(meta.get("shard_axis", 0)) != 0:
+            raise ReshardError(
+                name, "recorded shard axis %d — only axis-0 shard files "
+                "reassemble" % int(meta["shard_axis"]))
+        arr = assemble_var(step_dir, meta)
+        want_shape = meta.get("shape")
+        if want_shape is not None and list(arr.shape) != list(want_shape):
+            raise ReshardError(
+                name, "reassembled shape %s != manifest shape %s"
+                % (list(arr.shape), list(want_shape)))
+        snap[name] = arr
+    return snap, manifest
+
+
+def reshard_checkpoint(src_step_dir, dst_dir, plan=None, factors=None,
+                       mesh_axes=None, serial=None, verify=True):
+    """Rewrite one checkpoint dir under a new mesh's layout: reassemble
+    every var from its shard files, re-split per the new plan's
+    ``plan_shard_factors`` (validated dim-0-only — unsupported layouts
+    raise :class:`ReshardError` naming the var), and land the result as
+    a complete, digest-verified checkpoint under ``dst_dir`` (same
+    serial by default). Returns the final checkpoint path. Observes
+    ``paddle_tpu_reshard_seconds``."""
+    t0 = time.perf_counter()
+    snap, manifest = reassemble_checkpoint(src_step_dir, verify=verify)
+    mgr = ShardedCheckpointManager(dst_dir, plan=plan, factors=factors,
+                                   mesh_axes=mesh_axes)
+    # a factor naming a var the checkpoint lacks is a plan/state mismatch
+    for name in mgr.factors:
+        if name not in snap:
+            raise ReshardError(
+                name, "new plan shards it but the source checkpoint "
+                "has no such var")
+    extra = {k: v for k, v in (manifest.get("extra") or {}).items()
+             if k != "sharding"}
+    path = mgr.write_state(
+        snap, rng=manifest.get("rng"),
+        step=int(manifest.get("step", 0)),
+        serial=serial if serial is not None else manifest.get("serial", 0),
+        extra=extra)
+    _reshard_seconds.observe(time.perf_counter() - t0)
+    return path

@@ -529,8 +529,26 @@ def load_sharded_persistables(executor, dirname, main_program=None,
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
+    # the elastic fleet dialect (elastic/reshard.py) records its shard
+    # files in the v2 manifest instead; vars stored that way have no
+    # plain <var>.npy, and skipping them silently would hand back a
+    # half-restored model
+    v2_vars = {}
+    v2_path = os.path.join(dirname, _CKPT_MANIFEST)
+    if os.path.exists(v2_path):
+        try:
+            with open(v2_path) as f:
+                v2_vars = json.load(f).get("vars") or {}
+        except (OSError, ValueError):
+            v2_vars = {}
     for v in main_program.list_vars():
         if not v.persistable:
+            continue
+        if v.name not in meta and (v2_vars.get(v.name) or {}).get("shards"):
+            from paddle_tpu.resilience.checkpoint import assemble_var
+
+            scope.set_value(
+                v.name, assemble_var(dirname, v2_vars[v.name]))
             continue
         if v.name in meta:
             m = meta[v.name]

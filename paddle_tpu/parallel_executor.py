@@ -25,7 +25,9 @@ the graph; ``sharding_overrides`` remain an *override* on top of the
 derived plan, validated by analysis rule S001 at transpile time.
 """
 
+import threading
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -50,6 +52,18 @@ from paddle_tpu.core.lod import LoDTensor
 from paddle_tpu.core.lowering import CompiledProgram
 from paddle_tpu.executor import global_scope
 from paddle_tpu.parallel.mesh import ShardingPolicy, build_mesh
+
+
+# Process-global GSPMD executable registry (the executor.py shared-
+# registry idiom, mesh-aware): content-addressed keys extended with the
+# mesh's device identity and every policy input, so a ParallelExecutor
+# REBUILT over the same devices — the elastic runtime tears one down and
+# rebuilds per membership generation — reuses the compiled sharded
+# executable instead of paying a fresh XLA compile. A fleet that
+# reshapes 2 -> 1 -> 2 compiles twice, not three times.
+_shared_compiled = OrderedDict()
+_shared_lock = threading.Lock()
+_SHARED_CAP = 32
 
 
 class ExecutionStrategy(object):
@@ -379,53 +393,92 @@ class ParallelExecutor(object):
             mesh_sig,
         )
         cp = self._cache.get(key)
-        if cp is None:
-            exec_cache.record_trace_miss()
-            exec_cache.configure()
-            _explain.record_compile({
-                "program": key[0],
-                "feed_specs": tuple(sorted(
-                    (n, (s, d)) for n, (s, d) in feed_specs.items())),
-                "fetch_names": tuple(fetch_names),
-                "scope_signature": frozenset(scope_names),
-                "flags": key[4],
-                "device": "mesh:%s" % (mesh_sig,),
-                "mode": "gspmd",
-            })
-            state_shapes = self._collect_state_shapes()
-            policy = self._policy(state_shapes, feed_specs)
-            self._active_plan = getattr(policy, "derived", None)
-
-            def _build():
-                if _chaos.ENABLED:
-                    _chaos.fault("exec.compile")
-                return CompiledProgram(
-                    self._program,
-                    feed_specs,
-                    fetch_names,
-                    scope_names,
-                    is_test=self._program._is_test,
-                    shardings=policy,
-                )
-
-            cp = _retry.call(_build, origin="ParallelExecutor.compile")
-            # the derived plan rides the executable: memory planning
-            # divides predicted bytes by each var's shard factor, and
-            # captures/benches read the summary without re-deriving
-            cp._sharding_plan = getattr(policy, "derived", None)
-            cp._exec_cache_key = executable_key(
-                self._program, feed_specs, fetch_names, scope_names,
-                extra=("gspmd", mesh_sig,
-                       self._build_strategy.reduce_strategy,
-                       tuple(sorted(self._model_sharded_vars)),
-                       tuple(sorted(
-                           (k, str(v))
-                           for k, v in self._sharding_overrides.items()
-                       ))),
-            )
-            self._cache[key] = cp
-        else:
+        if cp is not None:
             exec_cache.record_trace_hit()
+            return cp
+        # instance miss: consult the process-global registry under a key
+        # extended with the mesh's device identity and every policy
+        # input the instance key could hold constant — a REBUILT
+        # executor (elastic reshape back to a seen world size, Predictor
+        # clones, tests constructing fresh PEs) must only reuse an
+        # executable whose shardings were derived from identical inputs
+        state_shapes = self._collect_state_shapes()
+        shared_key = key + (
+            tuple(d.id for d in self.mesh.devices.flat),
+            self._build_strategy.reduce_strategy,
+            tuple(sorted(self._model_sharded_vars)),
+            tuple(sorted((k, str(v))
+                         for k, v in self._sharding_overrides.items())),
+            tuple(sorted(state_shapes.items())),
+        )
+        with _shared_lock:
+            cp = _shared_compiled.get(shared_key)
+            if cp is not None:
+                _shared_compiled.move_to_end(shared_key)
+        if cp is not None:
+            exec_cache.record_trace_hit()
+            # the reused executable carries the plan it compiled with —
+            # this instance adopts it as its active plan
+            self._active_plan = getattr(cp, "_sharding_plan", None)
+            self._cache[key] = cp
+            return cp
+        # compile OUTSIDE the registry lock: an XLA compile (plus any
+        # retry backoff) must never stall other executors' unrelated
+        # cache misses. Two threads racing the same key pay a duplicate
+        # compile — exactly what the old per-instance caching always
+        # paid — and the loser adopts the winner's entry below.
+        exec_cache.record_trace_miss()
+        exec_cache.configure()
+        _explain.record_compile({
+            "program": key[0],
+            "feed_specs": tuple(sorted(
+                (n, (s, d)) for n, (s, d) in feed_specs.items())),
+            "fetch_names": tuple(fetch_names),
+            "scope_signature": frozenset(scope_names),
+            "flags": key[4],
+            "device": "mesh:%s" % (mesh_sig,),
+            "mode": "gspmd",
+        })
+        policy = self._policy(state_shapes, feed_specs)
+        self._active_plan = getattr(policy, "derived", None)
+
+        def _build():
+            if _chaos.ENABLED:
+                _chaos.fault("exec.compile")
+            return CompiledProgram(
+                self._program,
+                feed_specs,
+                fetch_names,
+                scope_names,
+                is_test=self._program._is_test,
+                shardings=policy,
+            )
+
+        cp = _retry.call(_build, origin="ParallelExecutor.compile")
+        # the derived plan rides the executable: memory planning divides
+        # predicted bytes by each var's shard factor, and captures/
+        # benches read the summary without re-deriving
+        cp._sharding_plan = getattr(policy, "derived", None)
+        cp._exec_cache_key = executable_key(
+            self._program, feed_specs, fetch_names, scope_names,
+            extra=("gspmd", mesh_sig,
+                   self._build_strategy.reduce_strategy,
+                   tuple(sorted(self._model_sharded_vars)),
+                   tuple(sorted(
+                       (k, str(v))
+                       for k, v in self._sharding_overrides.items()
+                   ))),
+        )
+        with _shared_lock:
+            existing = _shared_compiled.get(shared_key)
+            if existing is not None:
+                cp = existing  # a concurrent builder won; use its entry
+                self._active_plan = getattr(cp, "_sharding_plan", None)
+            else:
+                _shared_compiled[shared_key] = cp
+                while len(_shared_compiled) > _SHARED_CAP:
+                    _shared_compiled.popitem(last=False)
+        self._cache[key] = cp
         return cp
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):

@@ -37,7 +37,10 @@ Sites instrumented today: ``session.step`` (kill-point at the top of every
 manifest/rename — a kill here leaves a temp dir a restart must ignore),
 ``exec.compile`` (fresh-compile path), ``exec.dispatch`` (executor step
 dispatch), ``master.call`` (MasterClient RPC), ``aot.read`` (persistent
-exec-cache image load).
+exec-cache image load), and the fleet coordinator RPCs as
+``fleet.<method>`` — ``fleet.heartbeat`` and ``fleet.register`` are the
+documented churn-injection points (a seeded fault at either exercises
+the eviction/rejoin path the elastic runtime recovers through).
 
 Determinism: each clause owns a ``random.Random`` seeded by
 ``(seed, clause index)``, advanced once per visit to its site — a fixed

@@ -54,7 +54,7 @@ from paddle_tpu.observability.metrics_registry import REGISTRY
 from paddle_tpu.resilience import chaos
 
 __all__ = ["CheckpointManager", "MANIFEST_NAME", "read_manifest",
-           "verify_checkpoint_dir", "complete_serials"]
+           "verify_checkpoint_dir", "complete_serials", "assemble_var"]
 
 MANIFEST_NAME = "__manifest__.json"
 MANIFEST_VERSION = 2
@@ -110,25 +110,65 @@ def read_manifest(step_dir):
 def verify_checkpoint_dir(step_dir, manifest=None):
     """Re-hash every var file against the manifest. Returns a list of
     human-readable problems (empty = verified). Manifests without digests
-    (io.save_checkpoint's marker manifests) verify file presence only."""
+    (io.save_checkpoint's marker manifests) verify file presence only.
+    Vars stored as shard files (elastic/reshard.py's sharded dialect,
+    ``meta["shards"]``) verify every shard's digest AND that the shard
+    bytes sum back to the var's logical bytes — a dropped shard must
+    fail verification, never reassemble short."""
     manifest = manifest or read_manifest(step_dir)
     if manifest is None:
         return ["no readable %s" % MANIFEST_NAME]
     problems = []
     for name, meta in sorted(manifest.get("vars", {}).items()):
-        path = os.path.join(step_dir, meta["file"])
-        if not os.path.exists(path):
-            problems.append("missing file for var %r: %s"
-                            % (name, meta["file"]))
-            continue
-        want = meta.get("sha256")
-        if want and _sha256_file(path) != want:
-            problems.append("digest mismatch for var %r (%s)"
-                            % (name, meta["file"]))
+        shards = meta.get("shards")
+        entries = shards if shards else [meta]
+        shard_bytes = 0
+        broken = False
+        for ent in entries:
+            fname = ent.get("file")
+            if not fname:
+                problems.append("no file recorded for var %r" % name)
+                broken = True
+                continue
+            path = os.path.join(step_dir, fname)
+            if not os.path.exists(path):
+                problems.append("missing file for var %r: %s"
+                                % (name, fname))
+                broken = True
+                continue
+            want = ent.get("sha256")
+            if want and _sha256_file(path) != want:
+                problems.append("digest mismatch for var %r (%s)"
+                                % (name, fname))
+                broken = True
+            shard_bytes += int(ent.get("bytes", 0))
+        if (shards and not broken and meta.get("bytes") is not None
+                and shard_bytes != int(meta["bytes"])):
+            problems.append(
+                "shard bytes for var %r sum to %d, manifest records %d"
+                % (name, shard_bytes, int(meta["bytes"])))
     for fname in manifest.get("files", []):
         if not os.path.exists(os.path.join(step_dir, fname)):
             problems.append("missing file %s" % fname)
     return problems
+
+
+def assemble_var(step_dir, meta):
+    """One var's full host array from its manifest meta: a plain
+    single-file var loads directly; a sharded var (``meta["shards"]``,
+    written by elastic/reshard.py's ShardedCheckpointManager)
+    concatenates its shard files along the recorded split axis. Both
+    dialects load through every restore path — a checkpoint written
+    under a 4-way mesh restores into a 1-device scope unchanged."""
+    shards = meta.get("shards")
+    if not shards:
+        return np.load(os.path.join(step_dir, meta["file"]),
+                       allow_pickle=False)
+    pieces = [np.load(os.path.join(step_dir, s["file"]),
+                      allow_pickle=False) for s in shards]
+    if len(pieces) == 1:
+        return pieces[0]
+    return np.concatenate(pieces, axis=int(meta.get("shard_axis", 0)))
 
 
 def complete_serials(checkpoint_dir):
@@ -170,6 +210,10 @@ class CheckpointManager(object):
             except (KeyError, TypeError, ValueError):
                 max_to_keep = 3
         self.max_to_keep = max(1, int(max_to_keep))
+        # serials retention must NEVER delete, regardless of age: the
+        # elastic runtime pins a published reshape-barrier serial here
+        # while late joiners may still be restoring it
+        self.pinned_serials = set()
         self._write_lock = threading.Lock()   # one writer at a time
         self._thread = None
         self.last_error = None
@@ -311,6 +355,21 @@ class CheckpointManager(object):
         finally:
             self._drop_snapshot_ledger()
 
+    def _write_one_var(self, tmp_dir, name, arr):
+        """Write one var's file(s) into ``tmp_dir``; returns its manifest
+        meta. The seam the elastic layer's ShardedCheckpointManager
+        overrides to lay a var out as per-shard files instead."""
+        fname = _safe_name(name) + ".npy"
+        path = os.path.join(tmp_dir, fname)
+        np.save(path, arr)
+        return {
+            "file": fname,
+            "sha256": _sha256_file(path),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "bytes": int(arr.nbytes),
+        }
+
     def _write(self, snap, rng, step, serial, extra):
         t0 = time.perf_counter()
         os.makedirs(self.checkpoint_dir, exist_ok=True)
@@ -325,21 +384,12 @@ class CheckpointManager(object):
             chaos_on = chaos.ENABLED
             for name in sorted(snap):
                 arr = snap[name]
-                fname = _safe_name(name) + ".npy"
-                path = os.path.join(tmp_dir, fname)
-                np.save(path, arr)
+                vars_meta[name] = self._write_one_var(tmp_dir, name, arr)
                 if chaos_on:
                     # the mid-write kill/IO-fault point: var files exist,
                     # no manifest yet — a crash here MUST be invisible to
                     # the next restore
                     chaos.fault("ckpt.write")
-                vars_meta[name] = {
-                    "file": fname,
-                    "sha256": _sha256_file(path),
-                    "shape": list(arr.shape),
-                    "dtype": str(arr.dtype),
-                    "bytes": int(arr.nbytes),
-                }
                 total_bytes += int(arr.nbytes)
             manifest = {
                 "manifest_version": MANIFEST_VERSION,
@@ -387,7 +437,8 @@ class CheckpointManager(object):
 
     def _prune(self, keep_serial=None):
         serials = complete_serials(self.checkpoint_dir)
-        prune = [s for s in serials if s != keep_serial]
+        prune = [s for s in serials
+                 if s != keep_serial and s not in self.pinned_serials]
         excess = len(serials) - self.max_to_keep
         for s in prune[:max(excess, 0)]:
             shutil.rmtree(
@@ -499,9 +550,7 @@ class CheckpointManager(object):
     def _load_into_scope(self, step_dir, manifest):
         scope = self._live_scope()
         for name, meta in manifest.get("vars", {}).items():
-            arr = np.load(os.path.join(step_dir, meta["file"]),
-                          allow_pickle=False)
-            scope.set_value(name, arr)
+            scope.set_value(name, assemble_var(step_dir, meta))
 
     def _restore_rng(self, rng):
         exe = self._executor

@@ -54,7 +54,8 @@ class TrainSession(object):
     def __init__(self, executor, checkpoint_dir, main_program=None,
                  scope=None, interval_steps=None, interval_secs=None,
                  max_to_keep=None, auto_resume=True,
-                 install_signal_handlers=True, emergency_on_hang=True):
+                 install_signal_handlers=True, emergency_on_hang=True,
+                 manager=None):
         from paddle_tpu import flags
 
         self._exe = executor
@@ -66,7 +67,11 @@ class TrainSession(object):
             interval_secs = float(flags.get("checkpoint_interval_secs"))
         self.interval_steps = int(interval_steps)
         self.interval_secs = float(interval_secs)
-        self.manager = CheckpointManager(
+        # an injected manager (e.g. elastic/reshard.py's
+        # ShardedCheckpointManager, whose var files are laid out by the
+        # mesh's sharding plan) replaces the default; it must already be
+        # bound to this executor/program/scope
+        self.manager = manager if manager is not None else CheckpointManager(
             checkpoint_dir, executor=executor, main_program=self._program,
             scope=scope, max_to_keep=max_to_keep)
         self.step = 0

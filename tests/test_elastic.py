@@ -1,5 +1,8 @@
 """Elastic training tests: lease timeout requeue, failure discard, worker
-kill mid-epoch, master snapshot recovery, training-through-failure.
+kill mid-epoch, master snapshot recovery, training-through-failure — plus
+the PR 9 fleet runtime: FleetCoordinator membership/generations/eviction,
+ElasticTrainSession reshapes with bit-identical trajectories, chaos sites
+fleet.heartbeat/fleet.register, and the master snapshot-race hardening.
 
 Reference: go/master/service_internal_test.go + the fault-tolerance design
 (go/master/service.go:368,411,455; snapshot :207, recover :166).
@@ -13,6 +16,11 @@ import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.distributed import MasterClient, MasterService, task_reader
+from paddle_tpu.elastic.coordinator import (
+    FleetClient,
+    FleetCoordinator,
+    FleetEvictedError,
+)
 
 
 def _service(**kw):
@@ -206,3 +214,629 @@ def test_task_reader_trains_through_worker_failure(tmp_path):
     assert np.mean(losses[-3:]) < np.mean(losses[:3])
     c.close()
     s.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet coordinator: membership, generations, eviction, recovery
+# ---------------------------------------------------------------------------
+
+
+def _coordinator(**kw):
+    kw.setdefault("lease_s", 0.6)
+    return FleetCoordinator(**kw)
+
+
+def test_register_assigns_dense_ranks_and_bumps_generation():
+    c = _coordinator()
+    try:
+        a = c.register("a")
+        b = c.register("b")
+        assert (a["rank"], a["generation"], a["world"]) == (0, 1, 1)
+        assert (b["rank"], b["generation"], b["world"]) == (1, 2, 2)
+        # heartbeat reflects the CURRENT membership, not the join-time one
+        view = c.heartbeat("a", step=5)
+        assert view["rank"] == 0 and view["world"] == 2
+        assert view["generation"] == 2
+        assert c.status()["members"]["a"]["step"] == 5
+    finally:
+        c.close()
+
+
+def test_eviction_compacts_ranks_and_moves_chief():
+    c = _coordinator(lease_s=0.4)
+    try:
+        c.register("chief")
+        c.register("second")
+        c.register("third")
+        gen = c.status()["generation"]
+        # the chief stops heartbeating; others stay alive
+        deadline = time.time() + 5
+        while "chief" in c.status()["members"] and time.time() < deadline:
+            c.heartbeat("second")
+            c.heartbeat("third")
+            time.sleep(0.05)
+        st = c.status()
+        assert "chief" not in st["members"], st
+        # survivors keep their relative order; the OLDEST survivor is the
+        # new chief (rank 0)
+        assert st["members"]["second"]["rank"] == 0
+        assert st["members"]["third"]["rank"] == 1
+        assert st["generation"] > gen
+        # the dead worker's heartbeat gets the typed eviction signal
+        assert c.heartbeat("chief") is None
+    finally:
+        c.close()
+
+
+def test_batched_eviction_is_one_generation_bump():
+    c = _coordinator(lease_s=0.3)
+    try:
+        c.register("keep")
+        c.register("die1")
+        c.register("die2")
+        gen = c.status()["generation"]
+        deadline = time.time() + 5
+        while c.status()["world"] > 1 and time.time() < deadline:
+            c.heartbeat("keep")
+            time.sleep(0.05)
+        st = c.status()
+        assert st["world"] == 1
+        # two workers died in one sweep: survivors see ONE reshape
+        assert st["generation"] == gen + 1, st
+    finally:
+        c.close()
+
+
+def test_reshard_serial_registry_and_history_bound():
+    c = _coordinator(max_reshard_history=3)
+    try:
+        c.register("a")
+        for g in range(1, 6):
+            c.report_reshard(g, 100 + g)
+        view = c.heartbeat("a")
+        assert view["reshard"] == {3: 103, 4: 104, 5: 105}
+    finally:
+        c.close()
+
+
+def test_eviction_watcher_survives_fleet_emptying():
+    """The eviction watcher exits with the last member but releases its
+    slot atomically with that decision — members admitted afterwards
+    must still be evicted (a dying thread must never be trusted to keep
+    sweeping)."""
+    c = _coordinator(lease_s=0.3)
+    try:
+        c.register("a")
+        deadline = time.time() + 5
+        while c.status()["world"] and time.time() < deadline:
+            time.sleep(0.05)
+        assert c.status()["world"] == 0
+        c.register("b")  # fleet was empty: a fresh watcher must spawn
+        deadline = time.time() + 5
+        while c.status()["world"] and time.time() < deadline:
+            time.sleep(0.05)
+        assert c.status()["world"] == 0, (
+            "member admitted after the fleet emptied was never evicted")
+    finally:
+        c.close()
+
+
+def test_corrupt_snapshot_is_quarantined_not_silently_eaten(tmp_path):
+    """An existing-but-unreadable snapshot must not make recovery look
+    like a clean cold start: the file is quarantined for autopsy and the
+    reset is logged."""
+    import logging
+
+    snap = tmp_path / "fleet.json"
+    snap.write_text("{definitely not json")
+    with _caplog_at_warning() as records:
+        c = _coordinator(snapshot_path=str(snap))
+        c.close()
+    assert not snap.exists()
+    assert any(".corrupt-" in d.name for d in tmp_path.iterdir())
+    assert any("unreadable" in r.getMessage() for r in records)
+
+
+class _caplog_at_warning(object):
+    """Tiny handler context: collect WARNING+ records from the
+    paddle_tpu.distributed logger without pytest's caplog (which the
+    surrounding threaded tests can race)."""
+
+    def __enter__(self):
+        import logging
+
+        self.records = []
+        self.handler = logging.Handler()
+        self.handler.emit = self.records.append
+        self.logger = logging.getLogger("paddle_tpu.distributed")
+        self.logger.addHandler(self.handler)
+        return self.records
+
+    def __exit__(self, *exc):
+        self.logger.removeHandler(self.handler)
+        return False
+
+
+def test_coordinator_snapshot_recovery_preserves_membership(tmp_path):
+    snap = str(tmp_path / "fleet.json")
+    c = _coordinator(snapshot_path=snap)
+    c.register("a")
+    c.register("b")
+    c.report_reshard(2, 17)
+    gen = c.status()["generation"]
+    c.close()
+
+    c2 = _coordinator(snapshot_path=snap)
+    try:
+        st = c2.status()
+        # same generation (no spurious reshape for survivors), same ranks,
+        # reshard map intact; recovered members run on fresh leases
+        assert st["generation"] == gen
+        assert st["members"]["a"]["rank"] == 0
+        assert st["members"]["b"]["rank"] == 1
+        assert st["reshard"] == {2: 17}
+        view = c2.heartbeat("a")
+        assert view["rank"] == 0 and view["world"] == 2
+        # a NEW registration continues the generation sequence
+        v = c2.register("c")
+        assert v["generation"] == gen + 1 and v["rank"] == 2
+    finally:
+        c2.close()
+
+
+def test_fleet_client_over_tcp_and_eviction_error():
+    c = _coordinator(lease_s=0.5)
+    addr = c.serve()
+    cl = FleetClient(addr)
+    try:
+        view = cl.register("w")
+        assert view["worker_id"] == "w" and view["rank"] == 0
+        cl.report_reshard(view["generation"], 9)
+        hb = cl.heartbeat("w", step=2)
+        assert hb["reshard"] == {view["generation"]: 9}  # int keys back
+        with pytest.raises(FleetEvictedError):
+            cl.heartbeat("ghost")
+    finally:
+        cl.close()
+        c.close()
+
+
+def test_client_minted_ids_make_register_retry_safe():
+    """FleetClient mints the worker identity, so a register retried
+    across a coordinator restart replaces the committed member instead
+    of minting a ghost that inflates the world (and could squat on the
+    chief rank)."""
+    c = _coordinator()
+    addr = c.serve()
+    cl = FleetClient(addr)
+    try:
+        view = cl.register()
+        wid = view["worker_id"]
+        assert wid.startswith("w-") and len(wid) > 6
+        # the retry scenario: the same identity registers again — one
+        # member, not two
+        view2 = cl.register(wid)
+        assert view2["world"] == 1 and view2["rank"] == 0
+        assert view2["generation"] > view["generation"]
+    finally:
+        cl.close()
+        c.close()
+
+
+def test_failed_session_construction_leaves_no_zombie_member(tmp_path):
+    """A constructor that cannot finish (fleet never ready) must
+    deregister and stop heartbeating — not leave a lease-renewing ghost
+    inflating the fleet forever."""
+    from paddle_tpu.elastic.worker import ElasticTrainSession
+
+    c = _coordinator(min_workers=2)
+    addr = c.serve()
+    try:
+        with pytest.raises(TimeoutError):
+            ElasticTrainSession(
+                addr, str(tmp_path / "ckpt"),
+                lambda world, rank: (_ for _ in ()).throw(
+                    AssertionError("build_fn must not run")),
+                heartbeat_interval_s=0.1, ready_timeout_s=0.5)
+        deadline = time.time() + 5
+        while c.status()["world"] and time.time() < deadline:
+            time.sleep(0.05)
+        assert c.status()["world"] == 0, (
+            "the failed worker is still a member: %s" % c.status())
+    finally:
+        c.close()
+
+
+def test_fleet_client_status_maps_reshard_keys_to_ints():
+    c = _coordinator()
+    addr = c.serve()
+    cl = FleetClient(addr)
+    try:
+        cl.register("w")
+        cl.report_reshard(1, 5)
+        st = cl.status()
+        assert st["reshard"] == {1: 5}  # ints over TCP, like every view
+    finally:
+        cl.close()
+        c.close()
+
+
+def test_pinned_serial_survives_retention(tmp_path):
+    """A published barrier serial is pinned on the manager: periodic
+    saves must never prune it while a slow joiner may still be
+    restoring it."""
+    import numpy as np
+
+    from paddle_tpu.elastic.reshard import ShardedCheckpointManager
+
+    m = ShardedCheckpointManager(str(tmp_path / "ck"), max_to_keep=1)
+    m.pinned_serials.add(0)
+    for s in range(4):
+        m.write_state({"w": np.full((2, 2), s, "float32")}, step=s,
+                      serial=s)
+    left = sorted(d for d in (tmp_path / "ck").iterdir()
+                  if d.name.startswith("checkpoint_"))
+    names = [d.name for d in left]
+    assert "checkpoint_0" in names, names   # pinned: kept beyond the cap
+    assert "checkpoint_3" in names, names   # newest always kept
+
+
+@pytest.mark.slow
+def test_failed_reshape_deregisters_instead_of_wedging(tmp_path):
+    """A build_fn that dies during a reshape must not leave a lease-
+    renewing zombie: the worker deregisters (the fleet reshapes around
+    it) and the error surfaces to the caller."""
+    from paddle_tpu.elastic.worker import ElasticTrainSession
+
+    co = FleetCoordinator(lease_s=1.0, min_workers=1)
+    addr = co.serve()
+    dummy = FleetClient(addr)
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            build_fn, holder = _elastic_model()
+            calls = []
+
+            def flaky_build(world, rank):
+                calls.append(world)
+                if len(calls) > 1:
+                    raise RuntimeError("rebuild exploded")
+                return build_fn(world, rank)
+
+            sess = ElasticTrainSession(
+                addr, str(tmp_path / "ckpt"), flaky_build,
+                worker_id="w0", heartbeat_interval_s=0.1)
+            sess.run(feed=_elastic_feed(0), fetch_list=[holder["loss"]])
+            dummy.register("joiner")  # forces a reshape -> flaky rebuild
+            deadline = time.time() + 5
+            while ((sess._hb.latest or {}).get("world") != 2
+                   and time.time() < deadline):
+                dummy.heartbeat("joiner")
+                time.sleep(0.05)
+            with pytest.raises(RuntimeError, match="rebuild exploded"):
+                sess.run(feed=_elastic_feed(1),
+                         fetch_list=[holder["loss"]])
+            # the failed worker LEFT: only the joiner remains, no zombie
+            deadline = time.time() + 5
+            while ("w0" in co.status()["members"]
+                   and time.time() < deadline):
+                time.sleep(0.05)
+            assert "w0" not in co.status()["members"]
+            with pytest.raises(RuntimeError, match="closed"):
+                sess.run(feed=_elastic_feed(2),
+                         fetch_list=[holder["loss"]])
+    finally:
+        dummy.close()
+        co.close()
+
+
+def test_fleet_metrics_exported():
+    from paddle_tpu.observability.metrics_registry import REGISTRY
+
+    c = _coordinator(lease_s=0.3)
+    try:
+        c.register("a")
+        c.register("b")
+        scrape = REGISTRY.to_prometheus()
+        assert "paddle_tpu_fleet_size 2" in scrape
+        deadline = time.time() + 5
+        while c.status()["world"] > 1 and time.time() < deadline:
+            c.heartbeat("a")
+            time.sleep(0.05)
+        scrape = REGISTRY.to_prometheus()
+        assert "paddle_tpu_fleet_size 1" in scrape
+        gen = c.status()["generation"]
+        assert ("paddle_tpu_fleet_generation %d" % gen) in scrape
+        evs = [line for line in scrape.splitlines()
+               if line.startswith("paddle_tpu_fleet_evictions_total")]
+        assert evs and float(evs[0].rsplit(None, 1)[-1]) >= 1
+    finally:
+        c.close()
+
+
+def test_chaos_sites_fleet_heartbeat_and_register():
+    """Satellite: churn is injectable with the seeded FLAGS_chaos_spec
+    grammar at fleet.register / fleet.heartbeat; the client's
+    reconnect-retry-once absorbs a single injected fault."""
+    from paddle_tpu.resilience import chaos
+
+    c = _coordinator()
+    addr = c.serve()
+    cl = FleetClient(addr)
+    try:
+        chaos.configure("seed=3;io@site=fleet.register,n=1;"
+                        "io@site=fleet.heartbeat,n=1")
+        view = cl.register("w")  # survives the injected register fault
+        assert view["rank"] == 0
+        assert chaos.fires("fleet.register") == 1
+        hb = cl.heartbeat("w")  # survives the injected heartbeat fault
+        assert hb["world"] == 1
+        assert chaos.fires("fleet.heartbeat") == 1
+    finally:
+        chaos.disable()
+        cl.close()
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# ElasticTrainSession: reshapes with a bit-identical trajectory
+# ---------------------------------------------------------------------------
+
+
+def _elastic_model():
+    """Deterministic 2-layer MLP + dropout (RNG-dependent on purpose),
+    built ONCE and reused across executor rebuilds — rebuilding the
+    program would advance the unique-name counters and break restore
+    name matching (the documented build_fn contract)."""
+    holder = {}
+
+    def build_fn(world_size, rank):
+        if "main" not in holder:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", [4], stop_gradient=False)
+                y = fluid.layers.data("y", [1])
+                h = fluid.layers.fc(x, 8, act="relu")
+                h = fluid.layers.dropout(h, 0.3)
+                pred = fluid.layers.fc(h, 1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(0.05).minimize(loss)
+            main.random_seed = 17
+            startup.random_seed = 17
+            holder.update(main=main, startup=startup, loss=loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(holder["startup"])
+        return exe, holder["main"]
+
+    return build_fn, holder
+
+
+def _elastic_feed(step):
+    r = np.random.RandomState(1000 + step)
+    return {"x": r.rand(8, 4).astype("float32"),
+            "y": r.rand(8, 1).astype("float32")}
+
+
+def _run_elastic(tmp_path, churn, steps=12):
+    from paddle_tpu.elastic.worker import ElasticTrainSession
+
+    co = FleetCoordinator(lease_s=1.0, min_workers=1)
+    addr = co.serve()
+    dummy = FleetClient(addr)
+    losses, gens = [], []
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            build_fn, holder = _elastic_model()
+            sess = ElasticTrainSession(
+                addr, str(tmp_path / "ckpt"), build_fn,
+                worker_id="real", heartbeat_interval_s=0.1)
+            joined = stopped = False
+            while sess.step < steps:
+                out = sess.run(feed=_elastic_feed(sess.step),
+                               fetch_list=[holder["loss"]])
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+                gens.append(sess.generation)
+                if not churn:
+                    continue
+                if sess.step == 4 and not joined:
+                    # a second member joins: world 1 -> 2 at the barrier
+                    dummy.register("joiner")
+                    joined = True
+                    deadline = time.time() + 5
+                    while ((sess._hb.latest or {}).get("world") != 2
+                           and time.time() < deadline):
+                        dummy.heartbeat("joiner")
+                        time.sleep(0.05)
+                elif joined and not stopped and sess.step < 8:
+                    dummy.heartbeat("joiner")
+                elif sess.step == 8 and not stopped:
+                    # the joiner goes silent: eviction, world 2 -> 1
+                    stopped = True
+                    deadline = time.time() + 6
+                    while ((sess._hb.latest or {}).get("world") != 1
+                           and time.time() < deadline):
+                        time.sleep(0.05)
+            reshapes = list(sess.reshapes)
+            sess.close()
+    finally:
+        dummy.close()
+        co.close()
+    return losses, reshapes, gens
+
+
+@pytest.mark.slow
+def test_elastic_session_reshapes_with_bit_identical_trajectory(tmp_path):
+    """The tentpole contract, in-process: a fleet that reshapes
+    1 -> 2 -> 1 mid-run (join at the step barrier, eviction by lease
+    timeout) produces EXACTLY the losses of an undisturbed run — the
+    reshard-restore re-seats state, RNG stream and step counter."""
+    ref, ref_reshapes, _ = _run_elastic(tmp_path / "ref", churn=False)
+    # the undisturbed run still pays exactly one build (cold start)
+    assert len(ref_reshapes) == 1
+    churned, reshapes, gens = _run_elastic(tmp_path / "churn", churn=True)
+    assert churned == ref, (
+        "trajectory diverged across reshapes:\nref: %s\nchurn: %s"
+        % (ref, churned))
+    # cold start + join reshape + eviction reshape
+    assert len(reshapes) == 3, reshapes
+    assert [r["world"] for r in reshapes] == [1, 2, 1]
+    assert gens[-1] > gens[0]
+    # every reshape restored the serial the chief banked at its barrier
+    for r in reshapes[1:]:
+        assert r["serial"] == r["step"]
+
+
+@pytest.mark.slow
+def test_elastic_session_rejoins_after_eviction(tmp_path):
+    """A worker whose lease lapses (e.g. a long stall) is evicted; its
+    next step barrier re-registers it as a NEW member at the next
+    generation and training continues from the published serial."""
+    from paddle_tpu.elastic.worker import ElasticTrainSession
+
+    co = FleetCoordinator(lease_s=0.4, min_workers=1)
+    addr = co.serve()
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            build_fn, holder = _elastic_model()
+            sess = ElasticTrainSession(
+                addr, str(tmp_path / "ckpt"), build_fn,
+                worker_id="w0", heartbeat_interval_s=0.1)
+            for _ in range(3):
+                sess.run(feed=_elastic_feed(sess.step),
+                         fetch_list=[holder["loss"]])
+            gen_before = sess.generation
+            # wedge the heartbeats past the lease: eviction
+            sess._hb.evicted = True  # simulate the latched typed signal
+            deadline = time.time() + 5
+            while "w0" in co.status()["members"] and time.time() < deadline:
+                time.sleep(0.05)
+            assert "w0" not in co.status()["members"]
+            sess.run(feed=_elastic_feed(sess.step),
+                     fetch_list=[holder["loss"]])
+            assert sess.worker_id != "w0"  # rejoined as a new member
+            assert sess.generation > gen_before
+            assert co.status()["world"] == 1
+            sess.close()
+    finally:
+        co.close()
+
+
+# ---------------------------------------------------------------------------
+# master.py hardening (satellite): snapshot writes off the service lock
+# ---------------------------------------------------------------------------
+
+
+def test_stale_snapshot_write_loses_to_newer_commit(tmp_path):
+    """The seq-ordered commit, white box: a writer that grabbed an older
+    capture and stalled must NOT clobber a newer snapshot that committed
+    while it slept — its tmp file is discarded instead."""
+    import json
+
+    from paddle_tpu.distributed.master import ThrottledSnapshot
+
+    path = str(tmp_path / "s.json")
+    snap = ThrottledSnapshot(path, interval_s=0.0)
+    snap.capture({"state": "old", "todo": ["leased-task"]})
+    # thread A's flush grabs the pending capture... then stalls
+    with snap._mu:
+        stalled, snap._pending = snap._pending, None
+    assert stalled[0] == 1
+    # meanwhile the service mutates and a newer flush commits (close())
+    snap.capture({"state": "final", "todo": []})
+    snap.flush()
+    with open(path) as f:
+        assert json.load(f)["state"] == "final"
+    # thread A wakes up and finishes its flush with the STALE capture
+    with snap._mu:
+        snap._pending = stalled
+    snap.flush()
+    with open(path) as f:
+        assert json.load(f)["state"] == "final", (
+            "stale seq-1 write clobbered the final snapshot")
+    # and it cleaned up after losing: no orphaned tmp files
+    assert [d for d in tmp_path.iterdir() if ".tmp-" in d.name] == []
+
+
+class _SlowSnapshotService(MasterService):
+    """Test shim: makes the FIRST snapshot disk write block until
+    released, from the flush (off-lock) path."""
+
+    def __init__(self, *a, **kw):
+        super(_SlowSnapshotService, self).__init__(*a, **kw)
+        self.release = threading.Event()
+        self.first_write_started = threading.Event()
+        self._slowed = [False]
+        snap = self._snap
+        orig_flush = snap.flush
+        mu = threading.Lock()
+
+        def slow_flush():
+            with mu:
+                first, self._slowed[0] = not self._slowed[0], True
+            if first:
+                self.first_write_started.set()
+                self.release.wait(10)
+            orig_flush()
+
+        snap.flush = slow_flush
+
+
+def test_rpcs_do_not_block_behind_snapshot_write(tmp_path):
+    """Hardening (a): a slow snapshot write must not hold the service
+    lock — a concurrent get_task completes while the write is stuck."""
+    s = _SlowSnapshotService(
+        timeout_s=5.0, snapshot_path=str(tmp_path / "m.json"),
+        snapshot_interval_s=0.0)
+    try:
+        stuck = threading.Thread(
+            target=s.set_dataset, args=(["a", "b", "c"],), daemon=True)
+        stuck.start()
+        assert s.first_write_started.wait(5)
+        # the writer is wedged INSIDE its flush; the lease path must not
+        # queue behind it
+        t0 = time.time()
+        task, err = s.get_task(0)
+        elapsed = time.time() - t0
+        assert task is not None and err is None
+        assert elapsed < 1.0, (
+            "get_task blocked %.1fs behind a snapshot write" % elapsed)
+    finally:
+        s.release.set()
+        s.close()
+
+
+def test_close_snapshot_never_resurrects_finished_task(tmp_path):
+    """Hardening (b): a stale in-flight snapshot write losing the race
+    to close()'s final capture must NOT win the disk — recovery must see
+    the finish, not re-dispatch the task as todo."""
+    snap = str(tmp_path / "m.json")
+    s = _SlowSnapshotService(timeout_s=5.0, snapshot_path=snap,
+                             snapshot_interval_s=0.0)
+    # set_dataset's flush wedges on another thread holding the OLD state
+    # (todo=[t0]); meanwhile the task is leased AND finished, then the
+    # service closes — its final capture must be the one that lands even
+    # though the stale writer finishes afterwards
+    stuck = threading.Thread(
+        target=s.set_dataset, args=(["only"],), daemon=True)
+    stuck.start()
+    assert s.first_write_started.wait(5)
+    task, err = s.get_task(0)
+    assert err is None
+    assert s.task_finished(task.task_id)
+    closer = threading.Thread(target=s.close, daemon=True)
+    closer.start()
+    time.sleep(0.2)          # close() reaches its (ordered) final flush
+    s.release.set()          # NOW the stale writer finishes... and loses
+    stuck.join(5)
+    closer.join(5)
+    assert not stuck.is_alive() and not closer.is_alive()
+
+    s2 = MasterService(snapshot_path=snap)
+    try:
+        st = s2.status()
+        # the finish rolled the pass (single task): recovery must show
+        # the rolled state, not the stale pre-lease todo of pass 0
+        assert st["cur_pass"] == 1, (
+            "stale snapshot won the disk; recovered state: %s" % st)
+    finally:
+        s2.close()

@@ -202,3 +202,41 @@ def test_dryrun_multichip_16_devices():
     is untouched."""
     import __graft_entry__
     __graft_entry__.dryrun_multichip(16)
+
+
+@pytest.mark.slow
+def test_rebuilt_executor_reuses_shared_gspmd_executable():
+    """The elastic runtime tears a ParallelExecutor down and rebuilds it
+    per membership generation; a rebuild over the SAME devices / program
+    / policy inputs must reuse the process-global compiled executable —
+    a 2 -> 1 -> 2 fleet reshape pays two compiles, not three."""
+    from paddle_tpu.core import exec_cache
+
+    main, startup, loss = _build_mlp_program(seed=321)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {
+        "x": np.random.RandomState(0).rand(8, 32).astype("float32"),
+        "label": np.zeros((8, 1), "int64"),
+    }
+
+    def build():
+        return ParallelExecutor(
+            loss_name=loss.name, main_program=main, use_tpu=False,
+            num_devices=2)
+
+    pe1 = build()
+    pe1.run(fetch_list=[loss], feed=feed)
+    misses_after_first = exec_cache.stats()["trace_cache_misses"]
+    pe2 = build()  # fresh instance, same mesh devices + policy inputs
+    out2 = pe2.run(fetch_list=[loss], feed=feed)
+    assert exec_cache.stats()["trace_cache_misses"] == misses_after_first, (
+        "a rebuilt ParallelExecutor re-traced an executable the shared "
+        "registry already held")
+    assert np.isfinite(np.asarray(out2[0])).all()
+    # a different world size is a different executable, never aliased
+    pe3 = ParallelExecutor(
+        loss_name=loss.name, main_program=main, use_tpu=False,
+        num_devices=1)
+    pe3.run(fetch_list=[loss], feed=feed)
+    assert exec_cache.stats()["trace_cache_misses"] == misses_after_first + 1

@@ -41,15 +41,37 @@ def _read_manifest(step_dir):
 def _verify(step_dir, manifest):
     problems = []
     for name, meta in sorted(manifest.get("vars", {}).items()):
-        path = os.path.join(step_dir, meta["file"])
-        if not os.path.exists(path):
-            problems.append("missing file for var %r: %s"
-                            % (name, meta["file"]))
-            continue
-        want = meta.get("sha256")
-        if want and _sha256_file(path) != want:
-            problems.append("digest mismatch: var %r (%s)"
-                            % (name, meta["file"]))
+        shards = meta.get("shards")
+        entries = shards if shards else [meta]
+        shard_bytes = 0
+        broken = False
+        for ent in entries:
+            fname = ent.get("file")
+            if not fname:
+                problems.append("no file recorded for var %r" % name)
+                broken = True
+                continue
+            path = os.path.join(step_dir, fname)
+            if not os.path.exists(path):
+                problems.append("missing file for var %r: %s"
+                                % (name, fname))
+                broken = True
+                continue
+            want = ent.get("sha256")
+            if want and _sha256_file(path) != want:
+                problems.append("digest mismatch: var %r (%s)"
+                                % (name, fname))
+                broken = True
+            shard_bytes += int(ent.get("bytes", 0))
+        # per-var shard-byte cross-check: a dropped/truncated shard whose
+        # digest still matches its (short) manifest entry would otherwise
+        # reassemble silently short — reshard bugs must be diagnosable
+        # OFFLINE, before a restore trips on them
+        if (shards and not broken and meta.get("bytes") is not None
+                and shard_bytes != int(meta["bytes"])):
+            problems.append(
+                "shard bytes of var %r sum to %d, manifest records %d"
+                % (name, shard_bytes, int(meta["bytes"])))
     for fname in manifest.get("files", []):
         if not os.path.exists(os.path.join(step_dir, fname)):
             problems.append("missing file %s" % fname)
@@ -69,6 +91,7 @@ def _serial_dirs(root):
 
 def _summarize(step_dir, manifest, verify):
     vars_meta = manifest.get("vars", {})
+    sharding = (manifest.get("extra") or {}).get("sharding")
     info = {
         "dir": step_dir,
         "manifest_version": manifest.get("manifest_version"),
@@ -77,7 +100,15 @@ def _summarize(step_dir, manifest, verify):
         "num_vars": len(vars_meta) or len(manifest.get("files", [])),
         "bytes": sum(v.get("bytes", 0) for v in vars_meta.values()),
         "rng": manifest.get("rng"),
-        "has_digests": any(v.get("sha256") for v in vars_meta.values()),
+        "has_digests": any(
+            v.get("sha256") or any(s.get("sha256")
+                                   for s in v.get("shards", []))
+            for v in vars_meta.values()),
+        # the elastic dialect (elastic/reshard.py): which mesh this
+        # checkpoint was written under and which vars are shard files
+        "sharding": sharding,
+        "sharded_vars": sorted(n for n, v in vars_meta.items()
+                               if v.get("shards")),
     }
     info["problems"] = _verify(step_dir, manifest) if verify else None
     return info
@@ -126,6 +157,16 @@ def main(argv=None):
                       info["manifest_version"],
                       "  rng=%(base_seed)d@%(run_counter)d"
                       % info["rng"] if info["rng"] else ""))
+            sharding = info.get("sharding")
+            if sharding:
+                mesh = sharding.get("mesh_axes") or {}
+                factors = sharding.get("factors") or {}
+                print("  mesh: %s" % (" x ".join(
+                    "%s=%d" % (a, mesh[a]) for a in sorted(mesh))
+                    or "(unrecorded)"))
+                print("  shard factors: %s" % (", ".join(
+                    "%s/%d" % (n, factors[n]) for n in sorted(factors))
+                    or "(all vars whole)"))
             if args.verify:
                 if info["problems"]:
                     for p in info["problems"]:

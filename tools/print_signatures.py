@@ -54,6 +54,9 @@ MODULES = [
     "paddle_tpu.evaluator",
     "paddle_tpu.recordio_writer",
     "paddle_tpu.distributed.master",
+    "paddle_tpu.elastic.coordinator",
+    "paddle_tpu.elastic.reshard",
+    "paddle_tpu.elastic.worker",
     "paddle_tpu.dataset.common",
     "paddle_tpu.core.passes",
     # VERDICT r3 Weak #6: the generated unary-activation wrappers and the

@@ -3,8 +3,8 @@
 # repo): native C++ build + its unit tests, the Python suite on the
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
-# (native|python|lint|warm|metrics|forensics|chaos|shard|serve|dryrun|
-# bench|perfgate) to run a subset.
+# (native|python|lint|warm|metrics|forensics|chaos|shard|serve|elastic|
+# dryrun|bench|perfgate) to run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -14,7 +14,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ALL_STAGES=(native python lint warm metrics forensics chaos shard serve
-            dryrun bench perfgate)
+            elastic dryrun bench perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -165,6 +165,23 @@ if want serve; then
       --budgets benchmark/budgets.json --models serving
   rm -rf "$svdir"
   trap - EXIT
+fi
+
+if want elastic; then
+  echo "== elastic smoke (fleet churn: SIGKILL -> evict -> reshard) =="
+  # two worker subprocesses + an in-parent FleetCoordinator: worker 1 is
+  # SIGKILLed mid-epoch and must be evicted within the lease timeout;
+  # the survivor reshards its checkpoint to world 1 and its loss segment
+  # must be BIT-identical to a fresh process restored from the same
+  # barrier checkpoint; a re-admitted worker joins at the next
+  # generation and matches the survivor exactly; the fleet gauges +
+  # reshard timings must land in the metrics scrape and the final
+  # sharded checkpoint must pass ckpt_inspect --verify. A second leg
+  # restarts the coordinator from its snapshot mid-run: heartbeats
+  # retry through it with no spurious reshape (elastic_smoke.py asserts
+  # all of it)
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/elastic_smoke.py
 fi
 
 if want dryrun; then
