@@ -344,6 +344,86 @@ def _bench_serving(fluid, on_tpu):
     return rec
 
 
+def _bench_decode(fluid, on_tpu):
+    """Paged-decode A/B leg (ROADMAP item 3 / ragged paged attention):
+    steady-state decode tokens/sec and per-token latency at MIXED slot
+    lengths and LOW pool occupancy (4 requests in an 8-slot pool), the
+    PR 8 dense slot decoder vs the block-paged session (page-table KV
+    pool, ragged attention, steps=8 on-device token loop). The paged
+    session's tokens are asserted equal to the dense oracle's inside
+    the leg, so the gated speedup can never come from decoding less.
+    ``predicted_hbm_bytes`` is the paged kernel's grid accounting at
+    the leg's canonical mixed-length state — deterministic, gated hard:
+    decode traffic must stay proportional to RESIDENT pages.
+    """
+    from paddle_tpu.kernels import paged_attention as pk
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving.generation import SlotDecodeSession
+
+    vocab, seq, dm, n_head, S, K, ps = 50, 32, 32, 2, 8, 8, 8
+    cfg = dict(src_vocab_size=vocab, trg_vocab_size=vocab, n_layer=1,
+               n_head=n_head, d_inner=64)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main_prog, startup):
+        transformer.build(dropout=0.0, label_smooth_eps=0.0,
+                          max_length=seq, d_model=dm, **cfg)
+    exe = fluid.Executor(fluid.TPUPlace() if on_tpu else fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(11)
+    B = 4  # half the pool stays empty: the raggedness regime
+    src = rng.randint(3, vocab, (B, seq)).astype("int64")
+    mixed = [seq, seq // 2, seq // 4, 3]
+    src_len = np.asarray(mixed, "int64")[:, None]
+
+    def tokens_of(out):
+        # decoded tokens per row: through the first eos, else the full
+        # T-1 budget (deterministic — seeded weights, greedy decode)
+        total = 0
+        for row in out:
+            hits = np.where(row[1:] == 2)[0]
+            total += (int(hits[0]) + 1) if hits.size else (seq - 1)
+        return total
+
+    def timed(sess):
+        sess.generate(src, src_len)  # warm every executable
+        t0 = time.perf_counter()
+        out = sess.generate(src, src_len)
+        return tokens_of(out), time.perf_counter() - t0, out
+
+    dense = SlotDecodeSession(exe, num_slots=S, max_length=seq,
+                              d_model=dm, **cfg)
+    d_tok, d_dt, d_out = timed(dense)
+    paged = SlotDecodeSession(exe, num_slots=S, max_length=seq,
+                              d_model=dm, paged=True, page_size=ps,
+                              steps=K, **cfg)
+    p_tok, p_dt, p_out = timed(paged)
+    assert np.array_equal(d_out, p_out), \
+        "paged decode diverged from the dense oracle"
+    d_tps = d_tok / d_dt
+    p_tps = p_tok / p_dt
+    acc = pk.grid_accounting(mixed + [0] * (S - B), ps, n_head,
+                             dm // n_head, seq)
+    return {
+        "metric": "decode_tokens_per_sec" + ("" if on_tpu
+                                             else "_cpu_proxy"),
+        "value": round(p_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "dense_tokens_per_sec": round(d_tps, 1),
+        "paged_speedup": round(p_tps / d_tps, 3),
+        "token_latency_ms": round(1000.0 * p_dt / p_tok, 3),
+        "predicted_hbm_bytes": acc["hbm_bytes"],
+        "hbm_vs_dense_ratio": round(
+            acc["hbm_bytes"] / acc["dense_hbm_bytes"], 4),
+        "decode_steps_per_dispatch": K,
+        "pool_occupancy": B / S,
+        "rate": p_tps,
+        "gflop_per_unit": 0.0,
+    }
+
+
 def _worker_main():
     """One model bench in this process. Prints one JSON line.
 
@@ -368,6 +448,8 @@ def _worker_main():
             result = _bench_transformer(fluid, on_tpu, use_amp)
         elif model == "serving":
             result = _bench_serving(fluid, on_tpu)
+        elif model == "decode":
+            result = _bench_decode(fluid, on_tpu)
         else:
             result = _bench_resnet(fluid, on_tpu, use_amp)
         peak = _peak_tflops(jax.devices()[0]) if on_tpu else None
@@ -555,12 +637,12 @@ def main():
     # BENCH_MODELS overrides with an explicit list
     models_env = os.environ.get(
         "BENCH_MODELS",
-        os.environ.get("BENCH_MODEL", "resnet50,transformer,serving"))
+        os.environ.get("BENCH_MODEL", "resnet50,transformer,serving,decode"))
     models = {}
     for model in [m.strip() for m in models_env.split(",") if m.strip()]:
-        if model not in ("resnet50", "transformer", "serving"):
+        if model not in ("resnet50", "transformer", "serving", "decode"):
             errors[model] = ("unknown model (valid: resnet50, "
-                             "transformer, serving)")
+                             "transformer, serving, decode)")
             continue
         result = err = None
         if tpu_kind is not None:

@@ -48,6 +48,12 @@ _DEFS = {
     # (XLA-composed attention) — the escape hatch when the Pallas compile
     # path is unavailable/slow on a given rig
     "attention_impl": ("auto", str),
+    # ragged paged-attention decode (kernels/paged_attention.py) impl
+    # resolution for paged_attention's impl="auto": "auto" (Pallas kernel
+    # on TPU targets, composed gather+softmax reference on CPU), "pallas"
+    # (force the kernel — interpret mode on CPU, the test path),
+    # "reference" (force the composed path everywhere)
+    "paged_attention": ("auto", str),
     # backward pass of the flash kernel: "pallas" (FlashAttention-2-style
     # dkv/dq kernels, O(block) memory) or "reference" (recompute through
     # the XLA-composed path — materializes the [T, S] score matrix)

@@ -1,0 +1,256 @@
+"""Ragged paged-attention decode: block-paged KV pool + Pallas kernel.
+
+The serving decode residual (ROADMAP item 3, PAPERS.md "Ragged Paged
+Attention", arxiv 2604.15464): ``SlotDecodeSession``'s dense slot pool
+attends over all ``max_length`` positions for every slot regardless of
+how many tokens a slot actually holds, so decode FLOPs/HBM traffic
+scale with ``num_slots x max_length``. Here the KV cache is a PAGE
+POOL — fixed-size pages ``[num_pages, H, page_size, dh]`` plus a
+per-slot page-index table ``[S, pages_per_slot]`` and a length vector
+``[S]`` — and the decode kernel is ragged over it:
+
+* Grid ``(slot, page)`` with the page table scalar-prefetched
+  (``pltpu.PrefetchScalarGridSpec``): the K/V block index maps resolve
+  ``table[s, p]`` BEFORE the kernel body runs, so each grid step DMAs
+  exactly one resident page — the classic TPU paged-attention shape.
+* Per-slot lengths bound the scan: pages at ``p * page_size >=
+  length[s]`` skip their compute entirely (``pl.when``), and the host
+  fills a slot's unprovisioned table tail with its LAST valid page id,
+  so the skipped steps' index maps repeat the previous block and the
+  Pallas pipeline elides the copy (revolving-buffer rule: a repeated
+  block index issues no new DMA). Decode traffic is proportional to
+  pages actually RESIDENT, not ``S x max_length`` —
+  ``grid_accounting`` models exactly that contract and the bench/CI
+  legs pin it.
+* Empty slots (length 0) produce exactly 0 (the flash kernel's
+  fully-masked-row contract extended to decode); an unoccupied slot is
+  never NaN bait.
+
+``interpret=True`` runs the same kernel on CPU for tests; the composed
+XLA reference (gather pages through the table, masked softmax) is the
+fallback behind ``FLAGS_paged_attention=reference`` and the default on
+CPU targets, mirroring ``flash_attention``'s routing.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Pinned-Place-aware backend test, shared with the flash kernel so the
+# two kernels' impl routing can never diverge.
+from paddle_tpu.kernels.flash_attention import _is_tpu_target
+
+_NEG_INF = -1e30
+# a slot whose running max never rose above this saw no visible key
+# (length 0): its output is zeroed, matching flash_attention's
+# fully-masked-row contract
+_MASKED_ROW_M = -1e29
+
+
+def pages_for(length, page_size):
+    """Pages a slot with ``length`` resident tokens occupies."""
+    return -(-int(length) // int(page_size))
+
+
+def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,
+                              sm_scale=None):
+    """Composed XLA path: gather each slot's pages through the table
+    into a dense ``[S, H, pages_per_slot * page_size, dh]`` view, mask
+    positions past the slot's length, softmax, weighted sum. Empty
+    slots (length 0) return 0, matching the kernel.
+
+    q: [S, H, dh]; k_pool/v_pool: [P, H, page_size, dh];
+    page_table: [S, npp] int; lengths: [S] int. Returns [S, H, dh].
+    """
+    S, H, dh = q.shape
+    ps = k_pool.shape[2]
+    npp = page_table.shape[1]
+    if sm_scale is None:
+        sm_scale = dh ** -0.5
+    # [S, npp, H, ps, dh] -> [S, H, npp*ps, dh]
+    ks = jnp.transpose(k_pool[page_table], (0, 2, 1, 3, 4)).reshape(
+        S, H, npp * ps, dh)
+    vs = jnp.transpose(v_pool[page_table], (0, 2, 1, 3, 4)).reshape(
+        S, H, npp * ps, dh)
+    s = jnp.einsum("shd,shtd->sht", q.astype(jnp.float32) * sm_scale,
+                   ks.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    pos = jnp.arange(npp * ps)[None, None, :]
+    valid = pos < lengths[:, None, None]
+    s = jnp.where(valid, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("sht,shtd->shd", p, vs.astype(jnp.float32))
+    dead = (lengths <= 0)[:, None, None]
+    return jnp.where(dead, 0.0, out).astype(q.dtype)
+
+
+def _paged_decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, page_size, n_pages,
+                         sm_scale):
+    """One (slot, page) grid step: absorb one resident K/V page into the
+    slot's online-softmax state (running max / sum / acc in VMEM
+    scratch, persisting across the page dimension). ``table_ref`` and
+    ``len_ref`` are the scalar-prefetch operands — the page table
+    already steered the K/V index maps; the kernel only needs the
+    length for the validity test and the empty-page skip."""
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[s]
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # [H, dh]
+        k = k_ref[0].astype(jnp.float32)                 # [H, ps, dh]
+        v = v_ref[0].astype(jnp.float32)
+        sc = jnp.einsum("hd,htd->ht", q, k,
+                        preferred_element_type=jnp.float32)  # [H, ps]
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, sc.shape, 1)
+        sc = jnp.where(pos < length, sc, _NEG_INF)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        pexp = jnp.exp(sc - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1,
+                                              keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.einsum(
+            "ht,htd->hd", pexp, v, preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    # the ragged bound: a page past the slot's resident length runs NO
+    # compute (and, with the host's last-valid-page table aliasing, no
+    # fresh DMA either — the repeated index elides the copy)
+    pl.when(p * page_size < length)(_compute)
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        dead = m_ref[...] <= _MASKED_ROW_M
+        o_ref[0] = jnp.where(
+            dead, 0.0,
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _paged_pallas(q, k_pool, v_pool, page_table, lengths, sm_scale,
+                  interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, H, dh = q.shape
+    ps = k_pool.shape[2]
+    npp = page_table.shape[1]
+    kv_spec = pl.BlockSpec(
+        (1, H, ps, dh), lambda s, p, table, lens: (table[s, p], 0, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, npp),
+        in_specs=[
+            pl.BlockSpec((1, H, dh), lambda s, p, table, lens: (s, 0, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, H, dh), lambda s, p, table, lens: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, dh), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, page_size=ps, n_pages=npp,
+            sm_scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, dh), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+def paged_attention(q, k_pool, v_pool, page_table, lengths, sm_scale=None,
+                    force_reference=False, force_pallas=False):
+    """Ragged paged-attention decode over a block-paged KV pool.
+
+    q: [S, H, dh] (one query token per slot); k_pool/v_pool:
+    [num_pages, H, page_size, dh]; page_table: [S, pages_per_slot] int
+    page ids into the pool; lengths: [S] int resident tokens per slot.
+    Returns [S, H, dh]. Slots with length 0 return exactly 0.
+
+    Routing mirrors ``flash_attention``: the Pallas kernel on TPU
+    targets (``interpret=True`` when forced on CPU), the composed
+    gather+softmax reference elsewhere or under
+    ``FLAGS_paged_attention=reference``.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    use_pallas = force_pallas or (not force_reference and _is_tpu_target())
+    if not use_pallas:
+        return paged_attention_reference(
+            q, k_pool, v_pool, page_table, lengths, sm_scale=sm_scale)
+    return _paged_pallas(q, k_pool, v_pool, page_table, lengths, sm_scale,
+                         interpret=not _is_tpu_target())
+
+
+def paged_kv_write(k_pool, v_pool, k_new, v_new, page_table, positions):
+    """O(page) cache write: scatter each slot's new K/V row into its
+    resident page at ``positions[s]`` — page id resolved through the
+    table (``table[s, pos // page_size]``), offset ``pos % page_size``.
+    Replaces the dense path's one-hot select-and-add over the whole T
+    axis. k_new/v_new: [S, H, dh]; returns the updated pools.
+
+    Slots whose table row points at the reserved trash page (page 0 by
+    the session's convention) scatter harmlessly there — an unoccupied
+    slot's write can never corrupt a live slot's page.
+    """
+    ps = k_pool.shape[2]
+    S = k_new.shape[0]
+    pos = positions.astype(jnp.int32)
+    page_ids = page_table[jnp.arange(S), pos // ps]
+    offsets = pos % ps
+    k_pool = k_pool.at[page_ids, :, offsets, :].set(
+        k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[page_ids, :, offsets, :].set(
+        v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def grid_accounting(lengths, page_size, num_heads, head_dim,
+                    max_length, itemsize=4):
+    """Model the decode kernel's HBM traffic from its own grid
+    semantics: one K page + one V page DMA'd per RESIDENT page (the
+    ``pl.when`` skip + last-valid-page table aliasing elide both
+    compute and copy for pages past a slot's length), plus the
+    [S, H, dh] query/output blocks. ``dense_hbm_bytes`` is what the
+    dense slot pool moves for the same step — every slot's full
+    ``[H, max_length, dh]`` K and V regardless of occupancy — so the
+    ratio IS the raggedness: bytes proportional to tokens actually
+    resident, not ``S x max_length``.
+    """
+    lengths = [int(x) for x in lengths]
+    S = len(lengths)
+    page_bytes = num_heads * int(page_size) * head_dim * itemsize
+    valid_pages = sum(pages_for(ln, page_size) for ln in lengths)
+    total_page_slots = S * pages_for(max_length, page_size)
+    qo_bytes = 2 * S * num_heads * head_dim * itemsize
+    kv_bytes = 2 * valid_pages * page_bytes
+    dense_kv = 2 * S * num_heads * int(max_length) * head_dim * itemsize
+    return {
+        "valid_pages": valid_pages,
+        "total_page_slots": total_page_slots,
+        "page_bytes": page_bytes,
+        "hbm_bytes": kv_bytes + qo_bytes,
+        "dense_hbm_bytes": dense_kv + qo_bytes,
+        "resident_tokens": sum(lengths),
+        "dense_tokens": S * int(max_length),
+    }

@@ -11,6 +11,9 @@ from paddle_tpu.layer_helper import LayerHelper
 __all__ = [
     "scaled_dot_product_attention",
     "multi_head_attention",
+    "paged_attention",
+    "paged_kv_write",
+    "slot_decode_sample",
     "label_smooth",
     "add_position_encoding",
     "rotary_position_embedding",
@@ -155,6 +158,84 @@ def rotary_position_embedding(q, k, position=None, base=10000.0,
         attrs={"base": float(base)},
     )
     return q_out, k_out
+
+
+def paged_attention(query, k_pool, v_pool, page_table, lengths,
+                    sm_scale=None, impl="auto", name=None):
+    """Ragged paged-attention decode (kernels/paged_attention.py).
+
+    ``query`` [S, H, 1, dh] (one token per slot), ``k_pool``/``v_pool``
+    [num_pages, H, page_size, dh], ``page_table`` [S, pages_per_slot]
+    int page ids, ``lengths`` [S] (or [S, 1]) resident tokens per slot.
+    Per-slot cost is bounded by the slot's OWN length — empty pages and
+    unoccupied slots are skipped, so decode traffic scales with tokens
+    actually resident, not ``S x max_length``."""
+    helper = LayerHelper("paged_attention", name=name)
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(
+        type="paged_attention",
+        inputs={"Q": [query], "KPool": [k_pool], "VPool": [v_pool],
+                "PageTable": [page_table], "Lengths": [lengths]},
+        outputs={"Out": [out]},
+        attrs={"sm_scale": float(sm_scale or 0.0), "impl": impl},
+    )
+    return out
+
+
+def paged_kv_write(k_pool, v_pool, k_new, v_new, page_table, pos,
+                   name=None):
+    """O(page) KV-pool write: each slot's new K/V row ``[S, H, 1, dh]``
+    lands at (``page_table[s, pos // page_size]``, ``pos % page_size``).
+    Pass the pool vars as both input and output (the optimizer-style
+    in-place state convention): this layer binds ``KOut``/``VOut`` back
+    onto the pool vars, so the executor threads the update."""
+    helper = LayerHelper("paged_kv_write", name=name)
+    helper.append_op(
+        type="paged_kv_write",
+        inputs={"KPool": [k_pool], "VPool": [v_pool], "KNew": [k_new],
+                "VNew": [v_new], "PageTable": [page_table], "Pos": [pos]},
+        outputs={"KOut": [k_pool], "VOut": [v_pool]},
+    )
+    return k_pool, v_pool
+
+
+def slot_decode_sample(logits, pos, done=None, strategy="greedy",
+                       temperature=1.0, top_k=0, base_seed=0, eos_id=2,
+                       max_length=0, name=None):
+    """Per-slot token selection + slot lifecycle step for the decode
+    loop: sample (greedy / temperature / top-k; PRNG keyed on
+    ``(base_seed, slot, position)`` so seeded replays are bit-identical
+    at any dispatch granularity), force eos on finished slots, advance
+    positions with the max-length clamp, latch the done flag. Returns
+    ``(token [S, 1], new_pos [S, 1], new_done [S, 1])``.
+    ``max_length`` is the decode budget (the slot pool's ``T``) and is
+    REQUIRED: the position clamp is ``min(pos + 1, max_length - 1)``,
+    so an unset budget would pin every slot to position -1."""
+    if int(max_length) < 2:
+        raise ValueError(
+            "slot_decode_sample needs max_length >= 2 (the decode "
+            "budget; positions clamp to max_length - 1), got %r"
+            % (max_length,))
+    if strategy == "top_k" and int(top_k) < 1:
+        raise ValueError(
+            "slot_decode_sample strategy 'top_k' needs top_k >= 1 — "
+            "0 would silently sample the full vocabulary")
+    helper = LayerHelper("slot_decode_sample", name=name)
+    tok = helper.create_variable_for_type_inference("int64")
+    new_pos = helper.create_variable_for_type_inference("int64")
+    new_done = helper.create_variable_for_type_inference("int64")
+    inputs = {"Logits": [logits], "Pos": [pos]}
+    if done is not None:
+        inputs["Done"] = [done]
+    helper.append_op(
+        type="slot_decode_sample",
+        inputs=inputs,
+        outputs={"Out": [tok], "PosOut": [new_pos], "DoneOut": [new_done]},
+        attrs={"strategy": strategy, "temperature": float(temperature),
+               "top_k": int(top_k), "base_seed": int(base_seed),
+               "eos_id": int(eos_id), "max_length": int(max_length)},
+    )
+    return tok, new_pos, new_done
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):

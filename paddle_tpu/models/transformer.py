@@ -327,6 +327,17 @@ def position_encoding_row(t, d_model, dtype="float32"):
         dtype)[None, :]
 
 
+def position_encoding_table(max_length, d_model, dtype="float32"):
+    """The full [max_length, d_model] sinusoid table, row-exact with
+    ``position_encoding_row`` — fed once to the paged decoder's init
+    program (and usable anywhere a whole-table mirror is needed)."""
+    import numpy as np
+
+    return np.concatenate(
+        [position_encoding_row(t, d_model, dtype=dtype)
+         for t in range(int(max_length))], axis=0)
+
+
 def build_cached_decoder(
     batch_size,
     src_vocab_size=1000,
@@ -592,6 +603,36 @@ def cached_beam_generate(exe, prepare_prog, step_prog, reorder_prog,
                            len_penalty)
 
 
+def _sampler_attrs(sampler):
+    """Normalize a sampler spec (None, dict, or an object with
+    strategy/temperature/top_k/seed attributes — serving.Sampler) into
+    the slot_decode_sample op's attrs."""
+    if sampler is None:
+        return {"strategy": "greedy", "temperature": 1.0, "top_k": 0,
+                "base_seed": 0}
+    if isinstance(sampler, dict):
+        src = dict(sampler)
+    else:
+        src = {"strategy": getattr(sampler, "strategy", "greedy"),
+               "temperature": getattr(sampler, "temperature", 1.0),
+               "top_k": getattr(sampler, "top_k", 0),
+               "base_seed": getattr(sampler, "seed",
+                                    getattr(sampler, "base_seed", 0))}
+    strategy = src.get("strategy", "greedy")
+    if strategy not in ("greedy", "temperature", "top_k"):
+        raise ValueError(
+            "sampler strategy must be greedy/temperature/top_k, got %r"
+            % (strategy,))
+    if strategy == "top_k" and int(src.get("top_k", 0)) < 1:
+        raise ValueError(
+            "sampler strategy 'top_k' needs top_k >= 1 — 0 would "
+            "silently sample the full vocabulary")
+    return {"strategy": strategy,
+            "temperature": float(src.get("temperature", 1.0)),
+            "top_k": int(src.get("top_k", 0)),
+            "base_seed": int(src.get("base_seed", src.get("seed", 0)))}
+
+
 def build_slot_decoder(
     num_slots,
     src_vocab_size=1000,
@@ -601,6 +642,8 @@ def build_slot_decoder(
     n_head=4,
     d_model=128,
     d_inner=512,
+    eos_id=2,
+    sampler=None,
 ):
     """Continuous-batching decode: the KV caches become a SLOT-PAGED
     pool (dim 0 = slot, one in-flight sequence per slot) so admissions
@@ -608,7 +651,7 @@ def build_slot_decoder(
     executable advances every active sequence — the ragged-paged-
     attention serving shape, built from this op set.
 
-    Returns ``(init_prog, admit_prog, step_prog, logits_name)``:
+    Returns ``(init_prog, admit_prog, step_prog, token_name)``:
 
     * ``init_prog`` (run once): allocates the zeroed cache pools —
       per-layer self K/V ``[num_slots, H, T, dh]``, cross K/V pools,
@@ -628,7 +671,11 @@ def build_slot_decoder(
       select-and-add (bit-exact: written positions get exactly the new
       row, others keep exactly the old bits), and each slot's
       attention validity mask derives from its own position in-graph.
-      Fetches ``[S, 1, V]`` logits.
+      Token selection (``sampler``: greedy default, or a
+      temperature/top-k spec with per-slot PRNG streams keyed on
+      ``(base_seed, slot, position)``) runs ON DEVICE — the fetch is
+      the ``[S, 1]`` int token ids, never the ``[S, 1, V]`` logits, so
+      the host round trip per token is vocab-independent.
 
     Rows are independent end to end (attention, norms and projections
     are per-slot), so a sequence's tokens do not depend on which other
@@ -788,7 +835,261 @@ def build_slot_decoder(
             h = _prenorm(h, "dec_final")
             logits = nn.fc(h, trg_vocab_size, num_flatten_dims=2,
                            name="proj_logits")
-    return init, admit, step, logits.name
+            tok, _, _ = fluid.layers.slot_decode_sample(
+                logits, pos, eos_id=eos_id, max_length=T,
+                **_sampler_attrs(sampler))
+    return init, admit, step, tok.name
+
+
+def build_paged_slot_decoder(
+    num_slots,
+    src_vocab_size=1000,
+    trg_vocab_size=1000,
+    max_length=64,
+    n_layer=2,
+    n_head=4,
+    d_model=128,
+    d_inner=512,
+    page_size=8,
+    num_pages=None,
+    bos_id=1,
+    eos_id=2,
+    sampler=None,
+):
+    """Block-paged continuous-batching decode: the slot pool's dense
+    per-slot self caches (``[S, H, T, dh]``) become a PAGE POOL —
+    fixed-size KV pages ``[num_pages, H, page_size, dh]`` shared by
+    every slot through a per-slot page-index table — and the step
+    program becomes a SELF-CONTAINED loop body (token selection,
+    position advance and the next token's embedding input all live on
+    device), so ``Executor.run_multi_step(step_prog, steps=K)``
+    dispatches K decode tokens per host round trip and fetches
+    ``[K, S, 1]`` int ids instead of per-token ``[S, 1, V]`` logits.
+
+    Returns ``(init_prog, admit_prog, step_prog, table_prog,
+    token_name)``:
+
+    * ``init_prog`` (once; feeds ``pe_table [T, D]`` — the host's exact
+      ``position_encoding_row`` table, so in-graph rows are bit-equal
+      to the dense session's fed rows): allocates the zeroed page
+      pools, cross K/V pools, the per-slot source mask (column 0
+      seeded valid), the page table (all rows -> the reserved TRASH
+      page 0, where unoccupied slots' writes land harmlessly), and the
+      per-slot loop state ``pgd_tok``/``pgd_pos``/``pgd_done``.
+    * ``admit_prog`` (per admission; feeds ``src_word``, ``src_len``,
+      ``slot_idx``, ``page_row [1, pages_per_slot]`` — the host
+      allocator's page ids for this slot, unprovisioned tail entries
+      aliasing the last valid page): encoder forward for ONE sequence,
+      cross K/V + mask scattered into the slot's rows, page-table row
+      installed, loop state reset (tok=bos, pos=0, done=0). The self
+      pages are NOT zeroed — every position a slot attends over was
+      written by that slot first, so stale page bits are never read.
+    * ``step_prog`` (K per dispatch, NO feeds): O(page)
+      ``paged_kv_write`` at each slot's own position, ragged
+      ``paged_attention`` bounded by per-slot lengths (empty pages and
+      unoccupied slots are skipped), cross attention over the dense
+      cross pools, and ``slot_decode_sample`` (greedy / temperature /
+      top-k per ``sampler``; finished slots emit eos and freeze).
+      Fetch ``token_name`` for the per-step ``[S, 1]`` sampled ids.
+    * ``table_prog`` (feeds ``slot_idx``, ``page_row``): rewrite one
+      slot's page-table row — mid-flight page extension before a
+      dispatch, and the release path's reset to the trash page.
+
+    Build under the training ``build()``'s fresh ``unique_name`` scope;
+    parameters bind by name. All decode state is ``pgd_``-prefixed, so
+    a paged and a dense session can coexist in one scope. Host-side
+    page allocation lives in ``serving.generation.SlotDecodeSession``.
+    """
+    from paddle_tpu import unique_name
+
+    from paddle_tpu.kernels.paged_attention import pages_for
+
+    nn = fluid.layers
+    S, T, D = int(num_slots), int(max_length), int(d_model)
+    dh = D // n_head
+    ps = int(page_size)
+    npp = pages_for(T, ps)  # pages per slot at full length
+    P = int(num_pages) if num_pages else 1 + S * npp
+
+    def heads(x):
+        return nn.transpose(
+            nn.reshape(x, shape=[0, 0, n_head, dh]), perm=[0, 2, 1, 3])
+
+    samp = _sampler_attrs(sampler)
+
+    with unique_name.guard({}):
+        init = fluid.Program()
+        init_startup = fluid.Program()
+        with fluid.program_guard(init, init_startup):
+            blk = init.global_block()
+
+            def persist(name, value, dtype="float32"):
+                out = blk.create_var(name=name, shape=None, dtype=dtype,
+                                     persistable=True)
+                nn.assign(value, output=out)
+
+            pe = nn.data("pe_table", shape=[T, D], dtype="float32",
+                         append_batch_size=False)
+            persist("pgd_pe_table", pe)
+            mask0 = nn.fill_constant([S, T], "float32", 0.0)
+            mask0 = nn.dynamic_update_slice(
+                mask0, nn.fill_constant([S, 1], "float32", 1.0),
+                nn.fill_constant([1], "int64", 0), axis=1)
+            persist("pgd_src_mask", mask0)
+            for i in range(n_layer):
+                for kind in ("kcross", "vcross"):
+                    persist("pgd_%s_%d" % (kind, i),
+                            nn.fill_constant([S, n_head, T, dh],
+                                             "float32", 0.0))
+                for kind in ("kpool", "vpool"):
+                    persist("pgd_%s_%d" % (kind, i),
+                            nn.fill_constant([P, n_head, ps, dh],
+                                             "float32", 0.0))
+            persist("pgd_table",
+                    nn.fill_constant([S, npp], "int64", 0), "int64")
+            persist("pgd_pos",
+                    nn.fill_constant([S, 1], "int64", 0), "int64")
+            persist("pgd_tok",
+                    nn.fill_constant([S, 1], "int64", bos_id), "int64")
+            persist("pgd_done",
+                    nn.fill_constant([S, 1], "int64", 1), "int64")
+
+        admit = fluid.Program()
+        admit_startup = fluid.Program()
+        with fluid.program_guard(admit, admit_startup):
+            blk = admit.global_block()
+            src = nn.data("src_word", shape=[T], dtype="int64")
+            src_len = nn.data("src_len", shape=[1], dtype="int64")
+            slot = nn.data("slot_idx", shape=[1], dtype="int64",
+                           append_batch_size=False)
+            page_row = nn.data("page_row", shape=[npp], dtype="int64")
+            src_mask = nn.sequence_mask(src_len, maxlen=T,
+                                        dtype="float32")  # [1, T]
+            emb = nn.embedding(
+                input=src, size=[src_vocab_size, D],
+                param_attr=fluid.ParamAttr(name="src_emb"))
+            enc = nn.add_position_encoding(nn.scale(emb, scale=D ** 0.5))
+            for i in range(n_layer):
+                enc = encoder_layer(enc, src_mask, n_head, D, d_inner,
+                                    0.0, True, "enc_%d" % i)
+            enc = _prenorm(enc, "enc_final")
+
+            def prow(name, shape, value, dtype="float32"):
+                p = blk.create_var(name=name, shape=shape, dtype=dtype,
+                                   persistable=True)
+                nn.dynamic_update_slice(p, value, slot, axis=0, out=p)
+
+            prow("pgd_src_mask", [S, T], src_mask)
+            for i in range(n_layer):
+                kc = heads(nn.fc(enc, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False,
+                                 name="dec_%d_cmha_k" % i))
+                vc = heads(nn.fc(enc, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False,
+                                 name="dec_%d_cmha_v" % i))
+                prow("pgd_kcross_%d" % i, [S, n_head, T, dh], kc)
+                prow("pgd_vcross_%d" % i, [S, n_head, T, dh], vc)
+            prow("pgd_table", [S, npp], page_row, "int64")
+            prow("pgd_tok", [S, 1],
+                 nn.fill_constant([1, 1], "int64", bos_id), "int64")
+            prow("pgd_pos", [S, 1],
+                 nn.fill_constant([1, 1], "int64", 0), "int64")
+            prow("pgd_done", [S, 1],
+                 nn.fill_constant([1, 1], "int64", 0), "int64")
+
+        table = fluid.Program()
+        table_startup = fluid.Program()
+        with fluid.program_guard(table, table_startup):
+            blk = table.global_block()
+            slot = nn.data("slot_idx", shape=[1], dtype="int64",
+                           append_batch_size=False)
+            page_row = nn.data("page_row", shape=[npp], dtype="int64")
+            t = blk.create_var(name="pgd_table", shape=[S, npp],
+                               dtype="int64", persistable=True)
+            nn.dynamic_update_slice(t, page_row, slot, axis=0, out=t)
+
+        step = fluid.Program()
+        step_startup = fluid.Program()
+        with fluid.program_guard(step, step_startup):
+            blk = step.global_block()
+
+            def pvar(name, shape, dtype="float32"):
+                return blk.create_var(name=name, shape=shape, dtype=dtype,
+                                      persistable=True)
+
+            tok = pvar("pgd_tok", [S, 1], "int64")
+            pos = pvar("pgd_pos", [S, 1], "int64")
+            done = pvar("pgd_done", [S, 1], "int64")
+            ptable = pvar("pgd_table", [S, npp], "int64")
+            pe_table = pvar("pgd_pe_table", [T, D])
+            src_mask = pvar("pgd_src_mask", [S, T])
+            # resident tokens per slot AFTER this step's write: pos + 1
+            # for LIVE slots, 0 for done/unoccupied ones — a zero length
+            # makes the ragged kernel skip the slot outright (its logits
+            # are garbage either way: the sampler forces eos on done
+            # slots), so empty slots cost neither FLOPs nor page traffic
+            # and the grid accounting models exactly what the step runs
+            lengths = nn.elementwise_mul(
+                fluid.layers.increment(pos, value=1, in_place=False),
+                nn.elementwise_sub(
+                    nn.fill_constant([S, 1], "int64", 1), done))
+            emb = nn.embedding(
+                input=tok, size=[trg_vocab_size, D],
+                param_attr=fluid.ParamAttr(name="trg_emb"))
+            emb = nn.reshape(emb, shape=[0, 1, D])  # [S, 1, D]
+            pe_row = nn.reshape(
+                nn.gather(pe_table, nn.reshape(pos, shape=[-1])),
+                shape=[0, 1, D])
+            h = nn.elementwise_add(nn.scale(emb, scale=D ** 0.5), pe_row)
+            for i in range(n_layer):
+                name = "dec_%d" % i
+                kpool = pvar("pgd_kpool_%d" % i, [P, n_head, ps, dh])
+                vpool = pvar("pgd_vpool_%d" % i, [P, n_head, ps, dh])
+                nx = _prenorm(h, name + "_sattn")
+                q = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                bias_attr=False, name=name + "_smha_q"))
+                k1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False, name=name + "_smha_k"))
+                v1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False, name=name + "_smha_v"))
+                kpool, vpool = fluid.layers.paged_kv_write(
+                    kpool, vpool, k1, v1, ptable, pos)
+                att = fluid.layers.paged_attention(
+                    q, kpool, vpool, ptable, lengths,
+                    sm_scale=dh ** -0.5)
+                att = nn.reshape(nn.transpose(att, perm=[0, 2, 1, 3]),
+                                 shape=[0, 0, n_head * dh])
+                h = nn.elementwise_add(h, nn.fc(
+                    att, D, num_flatten_dims=2, bias_attr=False,
+                    name=name + "_smha_o"))
+                nx2 = _prenorm(h, name + "_cattn")
+                q2 = heads(nn.fc(nx2, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False,
+                                 name=name + "_cmha_q"))
+                ctx = fluid.layers.scaled_dot_product_attention(
+                    q2, pvar("pgd_kcross_%d" % i, [S, n_head, T, dh]),
+                    pvar("pgd_vcross_%d" % i, [S, n_head, T, dh]),
+                    mask=src_mask, sm_scale=dh ** -0.5)
+                ctx = nn.reshape(nn.transpose(ctx, perm=[0, 2, 1, 3]),
+                                 shape=[0, 0, n_head * dh])
+                h = nn.elementwise_add(h, nn.fc(
+                    ctx, D, num_flatten_dims=2, bias_attr=False,
+                    name=name + "_cmha_o"))
+                ff = _ffn(_prenorm(h, name + "_ffn"), D, d_inner,
+                          name + "_ffn")
+                h = nn.elementwise_add(h, ff)
+            h = _prenorm(h, "dec_final")
+            logits = nn.fc(h, trg_vocab_size, num_flatten_dims=2,
+                           name="proj_logits")
+            tok_new, pos_new, done_new = fluid.layers.slot_decode_sample(
+                logits, pos, done=done, eos_id=eos_id, max_length=T,
+                **samp)
+            # thread the loop state: the NEXT scan iteration embeds the
+            # token sampled here, no host in the loop
+            nn.assign(tok_new, output=tok)
+            nn.assign(pos_new, output=pos)
+            nn.assign(done_new, output=done)
+    return init, admit, step, table, tok_new.name
 
 
 def save_compiled_generator(dirname, batch_size, src_vocab_size,
@@ -865,8 +1166,7 @@ def save_compiled_generator(dirname, batch_size, src_vocab_size,
                 "(train or load params first)" % n)
         params[n] = jnp.asarray(val)
 
-    pe_table = jnp.asarray(np.concatenate(
-        [position_encoding_row(t, D) for t in range(T)], axis=0))
+    pe_table = jnp.asarray(position_encoding_table(T, D))
 
     def generate(src_word, src_len):
         key = jax.random.PRNGKey(0)

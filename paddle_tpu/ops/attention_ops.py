@@ -113,6 +113,82 @@ def _sdpa_infer_shape(block, op):
             out.dtype = q.dtype
 
 
+def _lower_paged_attention(ctx, ins, attrs):
+    """Ragged paged-attention decode (kernels/paged_attention.py): one
+    query token per slot attends over its block-paged KV pages, cost
+    bounded by the slot's OWN resident length — the serving decode
+    analog of the flash kernel's "[T, S] never materializes" contract."""
+    from paddle_tpu.kernels.paged_attention import paged_attention
+
+    q = ins["Q"][0]  # [S, H, 1, dh]
+    k_pool = ins["KPool"][0]  # [P, H, page_size, dh]
+    v_pool = ins["VPool"][0]
+    table = jnp.reshape(ins["PageTable"][0],
+                        (q.shape[0], -1)).astype(jnp.int32)
+    lengths = jnp.reshape(ins["Lengths"][0], (-1,)).astype(jnp.int32)
+    sm_scale = attrs.get("sm_scale", 0.0) or None
+    impl = attrs.get("impl", "auto")
+    if impl == "auto":
+        from paddle_tpu import flags
+
+        impl = flags.get("paged_attention")
+    out = paged_attention(
+        q[:, :, 0, :], k_pool, v_pool, table, lengths, sm_scale=sm_scale,
+        force_reference=(impl == "reference"),
+        force_pallas=(impl == "pallas"),
+    )
+    return out[:, :, None, :]
+
+
+def _paged_attention_infer_shape(block, op):
+    q = block._find_var_recursive(op.input("Q")[0])
+    for name in op.output("Out"):
+        out = block._find_var_recursive(name)
+        if out is not None and q is not None:
+            out.shape = list(q.shape) if q.shape is not None else None
+            out.dtype = q.dtype
+
+
+register_op(
+    "paged_attention",
+    inputs=["Q", "KPool", "VPool", "PageTable", "Lengths"],
+    outputs=["Out"],
+    attrs={"sm_scale": 0.0, "impl": "auto"},
+    lower=_lower_paged_attention,
+    grad=None,  # decode-only op: no training path attends paged
+    no_grad_inputs=("PageTable", "Lengths"),
+    infer_shape=_paged_attention_infer_shape,
+)
+
+
+def _lower_paged_kv_write(ctx, ins, attrs):
+    """O(page) KV-cache write: each slot's new K/V row lands at
+    (table[s, pos // page_size], pos % page_size) — replaces the dense
+    slot pool's one-hot select-and-add over the whole T axis."""
+    from paddle_tpu.kernels.paged_attention import paged_kv_write
+
+    k_pool = ins["KPool"][0]
+    v_pool = ins["VPool"][0]
+    k_new = ins["KNew"][0]  # [S, H, 1, dh]
+    v_new = ins["VNew"][0]
+    pos = jnp.reshape(ins["Pos"][0], (-1,))
+    table = jnp.reshape(ins["PageTable"][0],
+                        (k_new.shape[0], -1)).astype(jnp.int32)
+    k_out, v_out = paged_kv_write(
+        k_pool, v_pool, k_new[:, :, 0, :], v_new[:, :, 0, :], table, pos)
+    return {"KOut": k_out, "VOut": v_out}
+
+
+register_op(
+    "paged_kv_write",
+    inputs=["KPool", "VPool", "KNew", "VNew", "PageTable", "Pos"],
+    outputs=["KOut", "VOut"],
+    lower=_lower_paged_kv_write,
+    grad=None,
+    no_grad_inputs=("PageTable", "Pos"),
+)
+
+
 def _lower_label_smooth(ctx, ins, attrs):
     x = ins["X"][0]
     eps = attrs.get("epsilon", 0.0)

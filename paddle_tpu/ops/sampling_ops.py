@@ -118,3 +118,78 @@ register_op(
     no_grad_inputs=("Label",),
     intermediate_outputs=("PreOut",),
 )
+
+
+def _lower_slot_decode_sample(ctx, ins, attrs):
+    """Batched per-slot token selection for the serving decode loop
+    (serving/generation.py): greedy argmax, temperature, or top-k
+    sampling over ``[S, 1, V]`` logits — plus the slot lifecycle
+    arithmetic that lets a ``steps=K`` on-device scan advance every
+    slot without host intervention (eos forcing for finished slots,
+    clamped position advance, the done latch).
+
+    Determinism contract: the PRNG stream is keyed on
+    ``fold_in(fold_in(PRNGKey(base_seed), slot), position)`` — NOT the
+    executor's per-dispatch step key — so a seeded replay is
+    bit-identical regardless of how the token loop is partitioned into
+    dispatches (K=1 host stepping and K=8 on-device scans sample the
+    same tokens).
+    """
+    lg = ins["Logits"][0][:, 0, :].astype(jnp.float32)  # [S, V]
+    pos = ins["Pos"][0]
+    pos_flat = jnp.reshape(pos, (-1,))
+    done_in = ins.get("Done", [None])[0]
+    S = lg.shape[0]
+    strategy = attrs.get("strategy", "greedy")
+    temperature = float(attrs.get("temperature", 1.0))
+    top_k = int(attrs.get("top_k", 0))
+    eos = int(attrs.get("eos_id", 2))
+    max_len = int(attrs.get("max_length", 0))
+    if max_len < 2:
+        raise ValueError(
+            "slot_decode_sample: max_length attr must be >= 2 (the "
+            "decode budget; positions clamp to max_length - 1), got %d"
+            % max_len)
+    idt = device_dtype("int64")
+    if strategy == "greedy" or temperature <= 0.0:
+        tok = jnp.argmax(lg, axis=-1).astype(idt)
+    else:
+        scaled = lg / temperature
+        if strategy == "top_k" and top_k > 0:
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        base = jax.random.PRNGKey(int(attrs.get("base_seed", 0)))
+        keys = jax.vmap(
+            lambda i, p: jax.random.fold_in(jax.random.fold_in(base, i), p)
+        )(jnp.arange(S), pos_flat.astype(jnp.int32))
+        tok = jax.vmap(jax.random.categorical)(keys, scaled).astype(idt)
+    if done_in is not None:
+        was_done = jnp.reshape(done_in, (-1,)) > 0
+        tok = jnp.where(was_done, jnp.asarray(eos, idt), tok)
+    else:
+        was_done = jnp.zeros((S,), jnp.bool_)
+    # position advance mirrors the host slot manager exactly: a live
+    # slot moves to pos+1 (clamped so the KV write for a max-length
+    # slot stays in bounds); a finished slot freezes
+    nxt_pos = jnp.minimum(pos_flat + 1, max_len - 1)
+    new_pos = jnp.where(was_done, pos_flat, nxt_pos)
+    new_done = (was_done | (tok == eos)
+                | (pos_flat + 1 >= max_len - 1))
+    return {
+        "Out": tok[:, None],
+        "PosOut": jnp.reshape(new_pos, jnp.shape(pos)).astype(
+            pos_flat.dtype),
+        "DoneOut": new_done.astype(idt)[:, None],
+    }
+
+
+register_op(
+    "slot_decode_sample",
+    inputs=["Logits", "Pos", "Done"],
+    outputs=["Out", "PosOut", "DoneOut"],
+    attrs={"strategy": "greedy", "temperature": 1.0, "top_k": 0,
+           "base_seed": 0, "eos_id": 2, "max_length": 0},
+    lower=_lower_slot_decode_sample,
+    grad=None,
+    no_grad_inputs=("Pos", "Done"),
+)

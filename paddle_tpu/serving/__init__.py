@@ -35,7 +35,12 @@ bounded set of warm executables. This package is that layer:
 from paddle_tpu.serving import generation  # noqa: F401
 from paddle_tpu.serving import loadgen  # noqa: F401
 from paddle_tpu.serving import server  # noqa: F401
-from paddle_tpu.serving.generation import SlotDecodeSession  # noqa: F401
+from paddle_tpu.serving.generation import (  # noqa: F401
+    NoFreePageError,
+    NoFreeSlotError,
+    Sampler,
+    SlotDecodeSession,
+)
 from paddle_tpu.serving.server import (  # noqa: F401
     BatchingServer,
     DeadlineExceededError,

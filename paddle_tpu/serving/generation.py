@@ -11,19 +11,74 @@ holds the whole batch hostage, and a new request never waits for the
 current batch to drain. Token streams are identical to running each
 sequence through a dedicated-batch decoder (rows are independent;
 tests/test_serving.py pins the staggered-admission parity).
+
+``paged=True`` swaps the dense per-slot caches for the BLOCK-PAGED
+layout (``build_paged_slot_decoder`` + ``kernels/paged_attention.py``):
+self K/V lives in fixed-size pages shared by every slot through a
+per-slot page table this session allocates from a free list (page 0 is
+the reserved trash page unoccupied slots write into), decode attention
+is ragged — per-step cost scales with tokens actually RESIDENT, not
+``num_slots x max_length`` — and the step program is a self-contained
+loop body, so one ``run_multi_step(steps=K)`` dispatch advances every
+slot K tokens and fetches ``[K, S, 1]`` int ids instead of per-token
+``[S, 1, V]`` logits. Token selection (greedy / temperature / top-k,
+``Sampler``) runs on device in BOTH layouts; the dense path too now
+fetches token ids, never vocab-sized logits.
 """
+
+import time
 
 import numpy as np
 
 from paddle_tpu.observability.metrics_registry import REGISTRY as _REGISTRY
 from paddle_tpu.serving.server import ServingError
 
-__all__ = ["SlotDecodeSession", "NoFreeSlotError"]
+__all__ = ["SlotDecodeSession", "Sampler", "NoFreeSlotError",
+           "NoFreePageError"]
 
 
 class NoFreeSlotError(ServingError):
     """admit() with every slot occupied — the generation-side admission
     reject; retry after a step() frees slots."""
+
+
+class NoFreePageError(ServingError):
+    """The paged KV pool cannot RESERVE a new sequence's worst-case
+    pages (``num_pages`` sized below worst-case occupancy) — the
+    page-level admission reject; retry after a step() completes
+    sequences and releases their reservations. Raised only at
+    ``admit()`` (reservation-based admission control): a sequence that
+    was admitted can always be provisioned mid-flight, so an
+    oversubscribed pool degrades to fewer concurrent slots, never to a
+    wedged session."""
+
+
+class Sampler(object):
+    """Token-selection spec for the on-device decode loop.
+
+    ``strategy``: ``"greedy"`` (argmax, the default), ``"temperature"``
+    (softmax sampling at ``temperature``), or ``"top_k"`` (restrict to
+    the ``top_k`` highest logits, then temperature-sample). Stochastic
+    strategies draw from per-slot PRNG streams keyed on
+    ``(seed, slot, position)`` — never the dispatch key — so a session
+    rebuilt with the same ``seed`` replays bit-identical tokens
+    regardless of slot assignment timing or how many tokens each
+    dispatch advances."""
+
+    def __init__(self, strategy="greedy", temperature=1.0, top_k=0,
+                 seed=0):
+        if strategy not in ("greedy", "temperature", "top_k"):
+            raise ValueError(
+                "Sampler strategy must be greedy/temperature/top_k, "
+                "got %r" % (strategy,))
+        if strategy == "top_k" and int(top_k) < 1:
+            raise ValueError(
+                "Sampler(strategy='top_k') needs top_k >= 1 — top_k=0 "
+                "would silently sample the full vocabulary")
+        self.strategy = strategy
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
 
 
 _active_slots = _REGISTRY.gauge(
@@ -33,10 +88,19 @@ _sequences_total = _REGISTRY.counter(
     "paddle_tpu_serving_sequences_total",
     "slot-decode sequences by lifecycle event",
     labels=("event",))  # admitted | completed
+_pages_in_use = _REGISTRY.gauge(
+    "paddle_tpu_serving_kv_pages_in_use",
+    "KV pages currently allocated to live slots (paged sessions)")
+_pages_per_slot = _REGISTRY.gauge(
+    "paddle_tpu_serving_pages_per_slot",
+    "mean KV pages held per live slot (paged sessions)")
+_decode_tps = _REGISTRY.gauge(
+    "paddle_tpu_serving_decode_tokens_per_sec",
+    "decode tokens consumed per second of step() dispatch wall time")
 
 
 class SlotDecodeSession(object):
-    """Greedy continuous-batching decode over a slot-paged cache pool.
+    """Continuous-batching decode over a slot-paged cache pool.
 
     Build it with the trained scope live (parameters bind by name, the
     ``build_cached_decoder`` convention) — typically under the same
@@ -49,13 +113,20 @@ class SlotDecodeSession(object):
         slot = sess.admit(src_row, src_len)   # anytime, mid-flight
         finished = sess.step()                # {slot: tokens} as they end
 
-    ``decoder_cfg`` forwards to ``build_slot_decoder``
-    (``src_vocab_size``, ``trg_vocab_size``, ``n_layer``, ``n_head``,
-    ``d_inner``).
+    ``paged=True`` uses the block-paged KV pool + ragged
+    paged-attention kernel (``page_size`` tokens per page,
+    ``num_pages`` total — default one trash page plus full-occupancy
+    worst case) and advances ``steps`` tokens per host dispatch.
+    ``sampler`` is a :class:`Sampler` (or dict) selecting greedy /
+    temperature / top-k, identical semantics in both layouts.
+    ``decoder_cfg`` forwards to the builder (``src_vocab_size``,
+    ``trg_vocab_size``, ``n_layer``, ``n_head``, ``d_inner``).
     """
 
     def __init__(self, exe, num_slots, max_length=64, d_model=128,
-                 bos_id=1, eos_id=2, scope=None, **decoder_cfg):
+                 bos_id=1, eos_id=2, scope=None, paged=False,
+                 page_size=8, num_pages=None, steps=1, sampler=None,
+                 **decoder_cfg):
         from paddle_tpu.models import transformer
 
         self._transformer = transformer
@@ -64,17 +135,112 @@ class SlotDecodeSession(object):
         self._S, self._T, self._D = int(num_slots), int(max_length), \
             int(d_model)
         self._bos, self._eos = int(bos_id), int(eos_id)
-        (self._init_prog, self._admit_prog, self._step_prog,
-         self._logits_name) = transformer.build_slot_decoder(
-            num_slots, max_length=max_length, d_model=d_model,
-            **decoder_cfg)
-        self._run(self._init_prog, {}, [])
+        self._paged = bool(paged)
+        self._steps = max(1, int(steps))
+        self._sampler = sampler
+        if self._paged:
+            from paddle_tpu.kernels.paged_attention import pages_for
+
+            self._pages_for = pages_for
+            self._ps = int(page_size)
+            self._npp = pages_for(self._T, self._ps)
+            self._P = (int(num_pages) if num_pages
+                       else 1 + self._S * self._npp)
+            if self._P < 1 + self._npp:
+                raise ValueError(
+                    "num_pages=%d cannot cover even ONE sequence: the "
+                    "pool needs 1 trash page + ceil(max_length / "
+                    "page_size) = %d pages, or every admit() would "
+                    "fail its reservation" % (self._P, 1 + self._npp))
+            (self._init_prog, self._admit_prog, self._step_prog,
+             self._table_prog, self._fetch_name) = \
+                transformer.build_paged_slot_decoder(
+                    num_slots, max_length=max_length, d_model=d_model,
+                    page_size=self._ps, num_pages=self._P,
+                    bos_id=bos_id, eos_id=eos_id, sampler=sampler,
+                    **decoder_cfg)
+            pe = transformer.position_encoding_table(self._T, self._D)
+            self._run(self._init_prog, {"pe_table": pe}, [])
+            # page 0 is the trash page: never allocated, every
+            # unoccupied slot's table row points at it
+            self._free_pages = list(range(self._P - 1, 0, -1))
+            self._slot_pages = {}  # slot -> [page ids], ordered by index
+            # reservation-based admission control: every live slot has
+            # its WORST-CASE pages reserved (a counter, not physical
+            # pages — allocation stays lazy), so mid-flight _provision
+            # can never fail and an oversubscribed pool rejects at
+            # admit() instead of wedging at step()
+            self._reserved_pages = 0
+        else:
+            if steps != 1:
+                raise ValueError(
+                    "multi-token dispatch (steps > 1) needs paged=True "
+                    "— the dense step program is not a self-contained "
+                    "loop body")
+            (self._init_prog, self._admit_prog, self._step_prog,
+             self._fetch_name) = transformer.build_slot_decoder(
+                num_slots, max_length=max_length, d_model=d_model,
+                eos_id=eos_id, sampler=sampler, **decoder_cfg)
+            self._run(self._init_prog, {}, [])
         self._free = list(range(self._S - 1, -1, -1))
         self._live = {}  # slot -> {"trg": [T] int64, "pos": int}
 
     def _run(self, prog, feed, fetch_list):
         return self._exe.run(prog, feed=feed, fetch_list=fetch_list,
                              scope=self._scope)
+
+    # -- paged pool management ----------------------------------------------
+    def _page_row(self, pages):
+        """A slot's [npp] table row: its pages, the tail aliased to the
+        LAST valid page so the kernel's skipped grid steps repeat the
+        previous block index (the DMA-elision contract) — or the trash
+        page for a row with no pages."""
+        row = list(pages) if pages else [0]
+        row = row + [row[-1]] * (self._npp - len(row))
+        return np.asarray([row], dtype="int64")
+
+    def _provision(self, slot, length):
+        """Grow ``slot``'s page list to cover ``length`` resident
+        tokens; returns True when the table row changed. Cannot fail:
+        admit() reserved the slot's worst-case pages up front."""
+        pages = self._slot_pages[slot]
+        need = self._pages_for(min(int(length), self._T), self._ps)
+        grew = False
+        while len(pages) < need:
+            pages.append(self._free_pages.pop())
+            grew = True
+        return grew
+
+    def _write_table_row(self, slot, pages):
+        self._run(self._table_prog, {
+            "slot_idx": np.asarray([slot], dtype="int64"),
+            "page_row": self._page_row(pages),
+        }, [])
+
+    def _update_pool_gauges(self):
+        in_use = (self._P - 1) - len(self._free_pages)
+        _pages_in_use.set(in_use)
+        _pages_per_slot.set(in_use / len(self._live) if self._live
+                            else 0.0)
+
+    def _release_pages(self, slot):
+        """Recycle a finished slot's pages: the table row is pointed
+        back at the trash page FIRST (the still-stepping done slot's
+        writes must never land in a recycled page), then the pages
+        return to the free list."""
+        self._write_table_row(slot, [])
+        self._free_pages.extend(reversed(self._slot_pages.pop(slot)))
+        self._reserved_pages -= self._pages_for(self._T, self._ps)
+
+    @property
+    def free_pages(self):
+        """Unallocated KV pages (paged sessions; trash page excluded)."""
+        return len(self._free_pages) if self._paged else 0
+
+    @property
+    def pages_in_use(self):
+        return ((self._P - 1) - len(self._free_pages) if self._paged
+                else 0)
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -90,7 +256,9 @@ class SlotDecodeSession(object):
         [1, T] int ids; ``src_len``: its true length, default T) and run
         the admission program — encoder forward + scatter into the
         slot's pool rows. Returns the slot id. Raises
-        :class:`NoFreeSlotError` when every slot is occupied."""
+        :class:`NoFreeSlotError` when every slot is occupied (and, for
+        paged sessions, :class:`NoFreePageError` when the KV pool
+        cannot cover the first dispatch)."""
         if not self._free:
             raise NoFreeSlotError(
                 "all %d slots occupied; step() until one frees"
@@ -98,25 +266,57 @@ class SlotDecodeSession(object):
         src = np.asarray(src, dtype="int64").reshape(1, self._T)
         length = self._T if src_len is None else int(np.ravel(src_len)[0])
         slot = self._free.pop()
-        self._run(self._admit_prog, {
+        feed = {
             "src_word": src,
             "src_len": np.asarray([[length]], dtype="int64"),
             "slot_idx": np.asarray([slot], dtype="int64"),
-        }, [])
+        }
+        if self._paged:
+            worst = self._pages_for(self._T, self._ps)
+            if self._reserved_pages + worst > self._P - 1:
+                self._free.append(slot)
+                raise NoFreePageError(
+                    "KV pool cannot reserve %d pages for a new sequence "
+                    "(%d of %d already reserved); step() until a "
+                    "sequence completes"
+                    % (worst, self._reserved_pages, self._P - 1))
+            self._reserved_pages += worst
+            self._slot_pages[slot] = []
+            self._provision(slot, self._steps)
+            feed["page_row"] = self._page_row(self._slot_pages[slot])
+        try:
+            self._run(self._admit_prog, feed, [])
+        except BaseException:
+            # a failed admission dispatch (transient OOM, chaos fault,
+            # interrupt) must not leak the slot or its reservation —
+            # each leak would shrink the pool by one sequence forever
+            self._free.append(slot)
+            if self._paged:
+                self._free_pages.extend(
+                    reversed(self._slot_pages.pop(slot)))
+                self._reserved_pages -= worst
+            raise
         trg = np.full(self._T, self._eos, dtype="int64")
         trg[0] = self._bos
         self._live[slot] = {"trg": trg, "pos": 0}
         _sequences_total.inc(event="admitted")
         _active_slots.set(len(self._live))
+        if self._paged:
+            self._update_pool_gauges()
         return slot
 
     def step(self):
-        """Advance every in-flight sequence one token through the single
-        step executable. Returns ``{slot: [T] int64 tokens}`` for the
-        sequences that finished this step (their slots are free again).
-        No-op ({}) when nothing is in flight."""
+        """Advance every in-flight sequence through the step
+        executable — one token (dense layout) or ``steps`` tokens (one
+        on-device scan dispatch, paged layout) — and return
+        ``{slot: [T] int64 tokens}`` for the sequences that finished
+        (their slots, and pages, are free again). No-op ({}) when
+        nothing is in flight."""
         if not self._live:
             return {}
+        return self._step_paged() if self._paged else self._step_dense()
+
+    def _step_dense(self):
         cur = np.full((self._S, 1), self._eos, dtype="int64")
         pos = np.zeros((self._S, 1), dtype="int64")
         pe = np.zeros((self._S, 1, self._D), dtype="float32")
@@ -125,22 +325,63 @@ class SlotDecodeSession(object):
             pos[slot, 0] = st["pos"]
             pe[slot] = self._transformer.position_encoding_row(
                 st["pos"], self._D)
-        (lg,) = self._run(self._step_prog, {
+        t0 = time.perf_counter()
+        (toks,) = self._run(self._step_prog, {
             "cur_tok": cur, "pe_row": pe, "gen_pos": pos,
-        }, [self._logits_name])
-        lg = np.asarray(lg)  # [S, 1, V]
+        }, [self._fetch_name])
+        elapsed = time.perf_counter() - t0
+        # [S, 1] device-selected token ids — the vocab-sized logits
+        # never leave the device
+        toks = np.asarray(toks).reshape(-1)
+        live_before = len(self._live)
+        finished = self._consume_tokens(toks[None, :, None])
+        if elapsed > 0:
+            _decode_tps.set(live_before / elapsed)
+        return finished
+
+    def _step_paged(self):
+        # pre-provision every live slot for the whole dispatch: step j
+        # writes K/V at position pos + j, so the table must cover
+        # pos + steps resident tokens before the scan launches
+        for slot, st in self._live.items():
+            if self._provision(slot, st["pos"] + self._steps):
+                self._write_table_row(slot, self._slot_pages[slot])
+        self._update_pool_gauges()
+        t0 = time.perf_counter()
+        (toks,) = self._exe.run_multi_step(
+            self._step_prog, self._steps, feed={},
+            fetch_list=[self._fetch_name], scope=self._scope,
+            stack_fetches=True)
+        elapsed = time.perf_counter() - t0
+        toks = np.asarray(toks)  # [K, S, 1]
+        live_before = len(self._live)
+        finished = self._consume_tokens(toks)
+        if elapsed > 0:
+            _decode_tps.set(live_before * self._steps / elapsed)
+        self._update_pool_gauges()
+        return finished
+
+    def _consume_tokens(self, toks):
+        """Apply a ``[K, S, 1]`` token trajectory to the live slots —
+        the host mirror of the on-device loop: each live slot consumes
+        one token per scan step until it finishes (eos or max length);
+        post-finish steps for that slot are the device's forced-eos
+        padding and are ignored."""
         finished = {}
-        for slot in list(self._live):
-            st = self._live[slot]
-            t = st["pos"]
-            nxt = int(lg[slot, 0].argmax())
-            st["trg"][t + 1] = nxt
-            st["pos"] = t + 1
-            if nxt == self._eos or t + 1 == self._T - 1:
-                finished[slot] = st["trg"]
-                del self._live[slot]
-                self._free.append(slot)
-                _sequences_total.inc(event="completed")
+        for j in range(toks.shape[0]):
+            for slot in list(self._live):
+                st = self._live[slot]
+                t = st["pos"]
+                nxt = int(toks[j, slot, 0])
+                st["trg"][t + 1] = nxt
+                st["pos"] = t + 1
+                if nxt == self._eos or t + 1 == self._T - 1:
+                    finished[slot] = st["trg"]
+                    del self._live[slot]
+                    self._free.append(slot)
+                    if self._paged:
+                        self._release_pages(slot)
+                    _sequences_total.inc(event="completed")
         _active_slots.set(len(self._live))
         return finished
 
@@ -149,7 +390,8 @@ class SlotDecodeSession(object):
         ``src_len`` [B] or [B, 1]) through the slot pool — admitting as
         slots free up, which exercises the continuous-batching path even
         for B > num_slots — and return the [B, T] token matrix
-        (greedy, bos-led, eos-padded)."""
+        (bos-led, eos-padded; greedy unless the session's sampler says
+        otherwise)."""
         src = np.asarray(src, dtype="int64")
         lengths = (np.full(len(src), self._T, dtype="int64")
                    if src_len is None
@@ -160,7 +402,15 @@ class SlotDecodeSession(object):
         while pending or owner:
             while pending and self._free:
                 idx = pending.pop(0)
-                owner[self.admit(src[idx], lengths[idx])] = idx
+                try:
+                    owner[self.admit(src[idx], lengths[idx])] = idx
+                except NoFreePageError:
+                    # pool reservations exhausted: defer this request
+                    # and let in-flight sequences release pages —
+                    # guaranteed progress, since the constructor
+                    # requires the pool to cover at least one sequence
+                    pending.insert(0, idx)
+                    break
             for slot, tokens in self.step().items():
                 out[owner.pop(slot)] = tokens
         return out

@@ -1,0 +1,144 @@
+"""Paged-decode smoke: prove the ragged paged-attention serving path
+holds its two steady-state invariants under CHURN, then land a gated
+capture.
+
+    python tools/decode_smoke.py $DIR     # writes $DIR/decode.json
+
+Asserted, in order:
+
+  * **Zero fresh compiles across churn.** After one warmup wave
+    (which compiles the paged session's init/admit/table executables
+    and the single ``steps=K`` multi-step scan), a churny
+    admit/release/step sequence — staggered admissions into freed
+    slots, mixed source lengths, sequences completing mid-wave and
+    recycling their pages — adds ZERO fresh compiles, read from the
+    same metrics-registry scrape the serve stage trusts
+    (``paddle_tpu_fresh_compiles_total``) and cross-checked against
+    ``exec_cache.stats()``. The decode hot path is a fixed executable
+    set; occupancy changes may never recompile it.
+  * **Bit-exact churn decode.** The churned token streams equal the
+    dense slot decoder's (the PR 8 oracle) for every request.
+  * **Page hygiene.** After the pool drains, every page is back on the
+    free list and the ``paddle_tpu_serving_kv_pages_in_use`` gauge
+    reads 0.
+
+The capture (``$DIR/decode.json``) is bench.py's decode A/B leg — the
+SAME code path the BENCH trajectory tracks — and the CI ``decode``
+stage gates it via ``tools/perf_diff.py --budgets
+benchmark/budgets.json --models decode`` (tokens/sec, paged-vs-dense
+speedup, per-token latency, grid-accounted HBM bytes).
+"""
+
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _scrape_fresh_compiles():
+    from paddle_tpu.observability import REGISTRY
+
+    text = REGISTRY.to_prometheus()
+    m = re.search(r"^paddle_tpu_fresh_compiles_total (\d+)", text,
+                  re.MULTILINE)
+    return int(m.group(1)) if m else None
+
+
+def churn_invariants():
+    import paddle_tpu as fluid
+    from paddle_tpu.core import exec_cache
+    from paddle_tpu.models import transformer
+    from paddle_tpu.observability import REGISTRY
+    from paddle_tpu.serving.generation import SlotDecodeSession
+
+    vocab, seq, dm, S = 40, 16, 32, 4
+    cfg = dict(src_vocab_size=vocab, trg_vocab_size=vocab, n_layer=1,
+               n_head=2, d_inner=64)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 13
+    startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        transformer.build(dropout=0.0, label_smooth_eps=0.0,
+                          max_length=seq, d_model=dm, **cfg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(17)
+    n = 12  # 12 requests through a 4-slot pool: constant churn
+    src = rng.randint(3, vocab, (n, seq)).astype("int64")
+    src_len = np.asarray(
+        [seq, 2, seq - 1, 5, seq, 3, seq - 2, seq, 4, seq, 2, seq],
+        "int64")[:, None]
+
+    dense = SlotDecodeSession(exe, num_slots=S, max_length=seq,
+                              d_model=dm, **cfg)
+    want = dense.generate(src, src_len)
+
+    sess = SlotDecodeSession(exe, num_slots=S, max_length=seq,
+                             d_model=dm, paged=True, page_size=4,
+                             steps=4, **cfg)
+    # warmup wave: compiles admit/table/multi-step once
+    warm = sess.generate(src[:2], src_len[:2])
+    np.testing.assert_array_equal(warm, want[:2])
+
+    before_stats = exec_cache.stats()["fresh_compiles"]
+    before_scrape = _scrape_fresh_compiles()
+    got = sess.generate(src, src_len)  # the churny wave: 12 reqs, 4 slots
+    np.testing.assert_array_equal(got, want)
+    after_stats = exec_cache.stats()["fresh_compiles"]
+    after_scrape = _scrape_fresh_compiles()
+
+    assert after_stats == before_stats, (
+        "churny admit/release/step paid %d fresh compiles"
+        % (after_stats - before_stats))
+    if before_scrape is not None:
+        assert after_scrape == before_scrape, (
+            "metrics scrape shows %d fresh compiles during churn"
+            % (after_scrape - before_scrape))
+    assert sess.pages_in_use == 0 and sess.free_slots == S
+
+    text = REGISTRY.to_prometheus()
+    assert "paddle_tpu_serving_kv_pages_in_use 0" in text, \
+        "pages_in_use gauge did not return to 0"
+    assert "paddle_tpu_serving_decode_tokens_per_sec" in text
+    print("decode_smoke: churn OK — 0 fresh compiles over 12 requests / "
+          "4 slots, tokens == dense oracle, pool drained clean")
+
+
+def main():
+    if len(sys.argv) != 2:
+        sys.exit("usage: decode_smoke.py OUTPUT_DIR")
+    out_dir = sys.argv[1]
+    churn_invariants()
+
+    # the capture comes from bench.py's decode worker in its OWN
+    # process — the same leg (and the same compile-count accounting)
+    # the BENCH trajectory and budgets track; this process's churn
+    # compiles must not pollute the worker's fresh_compiles budget
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, BENCH_MODEL="decode", BENCH_PLATFORM="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "--worker"],
+        env=env, capture_output=True, text=True, timeout=900,
+        cwd=root, check=True)
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if "error" in rec:
+        sys.exit("decode_smoke: bench worker failed: %s" % rec["error"])
+    capture = {"models": {"decode": rec}}
+    path = os.path.join(out_dir, "decode.json")
+    with open(path, "w") as f:
+        json.dump(capture, f)
+    print("decode_smoke: capture -> %s (%.0f tok/s paged, %.2fx vs "
+          "dense, %d fresh compiles)"
+          % (path, rec["value"], rec["paged_speedup"],
+             rec["exec_cache"]["fresh_compiles"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,11 @@ METRICS = {
     "latency_ms_p50": ("lower", "timing"),
     "latency_ms_p99": ("lower", "timing"),
     "batch_occupancy": ("higher", "timing"),
+    # paged decode (bench.py decode leg + tools/decode_smoke.py):
+    # throughput carries paged tokens/sec; the A/B ratio and per-token
+    # latency gate the raggedness win itself
+    "paged_speedup": ("higher", "timing"),
+    "token_latency_ms": ("lower", "timing"),
 }
 
 
@@ -71,6 +76,9 @@ def _bench_model_metrics(m):
     out["latency_ms_p50"] = m.get("latency_ms_p50")
     out["latency_ms_p99"] = m.get("latency_ms_p99")
     out["batch_occupancy"] = m.get("batch_occupancy")
+    out["paged_speedup"] = m.get("paged_speedup")
+    out["token_latency_ms"] = m.get("token_latency_ms")
+    out["predicted_hbm_bytes"] = m.get("predicted_hbm_bytes")
     ec = m.get("exec_cache") or {}
     out["fresh_compiles"] = ec.get("fresh_compiles",
                                    m.get("fresh_compiles"))

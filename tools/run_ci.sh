@@ -3,8 +3,8 @@
 # repo): native C++ build + its unit tests, the Python suite on the
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
-# (native|python|lint|warm|metrics|forensics|chaos|shard|serve|elastic|
-# dryrun|bench|perfgate) to run a subset.
+# (native|python|lint|warm|metrics|forensics|chaos|shard|serve|decode|
+# elastic|dryrun|bench|perfgate) to run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -14,7 +14,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ALL_STAGES=(native python lint warm metrics forensics chaos shard serve
-            elastic dryrun bench perfgate)
+            decode elastic dryrun bench perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -167,6 +167,26 @@ if want serve; then
   trap - EXIT
 fi
 
+if want decode; then
+  echo "== paged decode smoke (ragged paged attention, 0 churn compiles) =="
+  # one process: churny admit/release/step over the paged slot session
+  # must add ZERO fresh compiles after warmup (metrics-registry scrape +
+  # exec-cache counters), decode tokens must equal the dense oracle's,
+  # and the drained pool must return every KV page; then the bench
+  # decode worker lands an A/B capture (paged vs dense tokens/sec at
+  # mixed lengths / low occupancy) that perf_diff gates against the
+  # committed decode budgets (speedup, latency, grid-accounted HBM)
+  dcdir="$(mktemp -d)"
+  trap 'rm -rf "$dcdir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu FLAGS_telemetry=1 \
+    python tools/decode_smoke.py "$dcdir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/perf_diff.py "$dcdir/decode.json" \
+      --budgets benchmark/budgets.json --models decode
+  rm -rf "$dcdir"
+  trap - EXIT
+fi
+
 if want elastic; then
   echo "== elastic smoke (fleet churn: SIGKILL -> evict -> reshard) =="
   # two worker subprocesses + an in-parent FleetCoordinator: worker 1 is
@@ -200,7 +220,7 @@ if want bench; then
   # line must parse and at least one model must have produced a number.
   out="$(BENCH_PLATFORM="${BENCH_PLATFORM-cpu}" python bench.py)"
   echo "$out"
-  echo "$out" | BENCH_EXPECT="${BENCH_MODELS-${BENCH_MODEL-resnet50,transformer,serving}}" python -c '
+  echo "$out" | BENCH_EXPECT="${BENCH_MODELS-${BENCH_MODEL-resnet50,transformer,serving,decode}}" python -c '
 import json, os, sys
 rec = json.loads(sys.stdin.readline())
 models = rec.get("models") or {}
