@@ -355,10 +355,22 @@ def _bench_decode(fluid, on_tpu):
     ``predicted_hbm_bytes`` is the paged kernel's grid accounting at
     the leg's canonical mixed-length state — deterministic, gated hard:
     decode traffic must stay proportional to RESIDENT pages.
+
+    PR 12 adds the cross-request-reuse legs: (a) a prefix-cache
+    exercise (cold forced-prefix prefill, then a hit that must decode
+    bit-identical — ``prefix_hit_rate``/``prefill_tokens_saved``), and
+    (b) the best-of-N A/B — two sources x best-of-4 through
+    ``admit_group`` (ONE encoder forward + one chunked prefill + joins
+    per source, group-pooled cross K/V at ``num_groups=2``) vs eight
+    UNSHARED solo admissions of the same members; both decode
+    bit-identical token matrices (asserted, so ``bestofn_speedup``
+    can never come from decoding less), and ``cross_kv_bytes`` is the
+    grouped cross-pool footprint gated deterministically against the
+    per-slot dense layout.
     """
     from paddle_tpu.kernels import paged_attention as pk
     from paddle_tpu.models import transformer
-    from paddle_tpu.serving.generation import SlotDecodeSession
+    from paddle_tpu.serving.generation import Sampler, SlotDecodeSession
 
     vocab, seq, dm, n_head, S, K, ps = 50, 32, 32, 2, 8, 8, 8
     cfg = dict(src_vocab_size=vocab, trg_vocab_size=vocab, n_layer=1,
@@ -397,14 +409,70 @@ def _bench_decode(fluid, on_tpu):
     d_tok, d_dt, d_out = timed(dense)
     paged = SlotDecodeSession(exe, num_slots=S, max_length=seq,
                               d_model=dm, paged=True, page_size=ps,
-                              steps=K, **cfg)
+                              steps=K, prefix_cache_pages=16, **cfg)
     p_tok, p_dt, p_out = timed(paged)
     assert np.array_equal(d_out, p_out), \
         "paged decode diverged from the dense oracle"
     d_tps = d_tok / d_dt
     p_tps = p_tok / p_dt
+
+    # --- prefix-cache exercise (greedy => slot-independent tokens):
+    # a repeated forced prefix provisions by reference; the hit MUST
+    # decode bit-identical to the cold prefill that cached the pages
+    pfx = [int(t) for t in src[0][: 3 * seq // 4]]
+    cold = paged.generate_best_of(src[0], 1, src_len=seq,
+                                  prefix_tokens=pfx)
+    hit = paged.generate_best_of(src[0], 1, src_len=seq,
+                                 prefix_tokens=pfx)
+    assert np.array_equal(cold, hit), \
+        "prefix-cache hit diverged from the cold prefill"
+    pstats = paged.prefix_cache_stats()
+
+    # --- best-of-N shared vs unshared A/B: same members, same slots,
+    # same (seed, slot, position) PRNG streams — bit-identical tokens,
+    # so the ratio is pure admission/prefill amortization + group-
+    # pooled cross K/V
+    smp = Sampler(strategy="top_k", top_k=4, temperature=0.9, seed=13)
+    N = 8  # best-of-N members, filling the pool from ONE source
+    src_bo = rng.randint(3, vocab, (seq,)).astype("int64")
+    pfx_bo = [int(t) for t in src_bo[: 3 * seq // 4]]
+
+    def drain(sess, slots):
+        outs = {}
+        while len(outs) < len(slots):
+            outs.update(sess.step())
+        return np.stack([outs[s] for s in slots])
+
+    def shared_wave(sess):
+        return drain(sess, sess.admit_group(
+            src_bo, N, src_len=seq, prefix_tokens=pfx_bo))
+
+    def unshared_wave(sess):
+        slots = [sess.admit(src_bo, seq, prefix_tokens=pfx_bo)
+                 for _ in range(N)]
+        return drain(sess, slots)
+
+    mk = lambda groups: SlotDecodeSession(  # noqa: E731
+        exe, num_slots=S, max_length=seq, d_model=dm, paged=True,
+        page_size=ps, steps=K, num_groups=groups, sampler=smp, **cfg)
+    sh, un = mk(2), mk(S)
+    shared_wave(sh)  # warm every executable (admit/join/prefill/copy)
+    unshared_wave(un)
+    t0 = time.perf_counter()
+    sh_out = shared_wave(sh)
+    sh_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    un_out = unshared_wave(un)
+    un_dt = time.perf_counter() - t0
+    assert np.array_equal(sh_out, un_out), \
+        "shared-KV best-of-N diverged from the unshared replay"
+    bo_tok = tokens_of(sh_out)
+    sh_tps = bo_tok / sh_dt
+    un_tps = bo_tok / un_dt
+
     acc = pk.grid_accounting(mixed + [0] * (S - B), ps, n_head,
-                             dm // n_head, seq)
+                             dm // n_head, seq, num_groups=2,
+                             n_layer=cfg["n_layer"])
     return {
         "metric": "decode_tokens_per_sec" + ("" if on_tpu
                                              else "_cpu_proxy"),
@@ -419,6 +487,17 @@ def _bench_decode(fluid, on_tpu):
             acc["hbm_bytes"] / acc["dense_hbm_bytes"], 4),
         "decode_steps_per_dispatch": K,
         "pool_occupancy": B / S,
+        # cross-request reuse (PR 12): best-of-4 x 2 sources, shared
+        # (admit_group: 1 encoder + 1 prefill + joins per source) vs
+        # unshared (8 solo admissions), bit-identical token matrices
+        "bestofn_speedup": round(sh_tps / un_tps, 3),
+        "bestofn_tokens_per_sec": round(sh_tps, 1),
+        "prefix_hit_rate": round(pstats["hit_rate"], 3),
+        "prefill_tokens_saved": pstats["tokens_saved"],
+        # grouped cross-pool footprint: [G=2, H, T, dh] per layer vs
+        # the per-slot dense layout — deterministic, gated
+        "cross_kv_bytes": acc["cross_hbm_bytes"],
+        "cross_kv_dense_bytes": acc["cross_dense_hbm_bytes"],
         "rate": p_tps,
         "gflop_per_unit": 0.0,
     }

@@ -226,7 +226,8 @@ def paged_kv_write(k_pool, v_pool, k_new, v_new, page_table, positions):
 
 
 def grid_accounting(lengths, page_size, num_heads, head_dim,
-                    max_length, itemsize=4):
+                    max_length, itemsize=4, num_groups=None,
+                    n_layer=1, src_length=None):
     """Model the decode kernel's HBM traffic from its own grid
     semantics: one K page + one V page DMA'd per RESIDENT page (the
     ``pl.when`` skip + last-valid-page table aliasing elide both
@@ -236,6 +237,15 @@ def grid_accounting(lengths, page_size, num_heads, head_dim,
     ``[H, max_length, dh]`` K and V regardless of occupancy — so the
     ratio IS the raggedness: bytes proportional to tokens actually
     resident, not ``S x max_length``.
+
+    With ``num_groups`` set, the dict also models the GROUP-POOLED
+    cross-attention K/V (PR 12's cross-request reuse): cross state is
+    ``[G, H, T_src, dh]`` per layer, priced per GROUP
+    (``cross_hbm_bytes``) against the per-slot dense layout
+    (``cross_dense_hbm_bytes`` — what ``S`` unshared rows cost), so
+    the accounted bytes scale with admitted SOURCES, not decoding
+    slots. ``n_layer`` multiplies both cross terms (each decoder layer
+    holds its own pools); ``src_length`` defaults to ``max_length``.
     """
     lengths = [int(x) for x in lengths]
     S = len(lengths)
@@ -245,7 +255,7 @@ def grid_accounting(lengths, page_size, num_heads, head_dim,
     qo_bytes = 2 * S * num_heads * head_dim * itemsize
     kv_bytes = 2 * valid_pages * page_bytes
     dense_kv = 2 * S * num_heads * int(max_length) * head_dim * itemsize
-    return {
+    out = {
         "valid_pages": valid_pages,
         "total_page_slots": total_page_slots,
         "page_bytes": page_bytes,
@@ -254,3 +264,9 @@ def grid_accounting(lengths, page_size, num_heads, head_dim,
         "resident_tokens": sum(lengths),
         "dense_tokens": S * int(max_length),
     }
+    if num_groups is not None:
+        t_src = int(src_length if src_length is not None else max_length)
+        cross_row = 2 * num_heads * t_src * head_dim * itemsize
+        out["cross_hbm_bytes"] = int(n_layer) * int(num_groups) * cross_row
+        out["cross_dense_hbm_bytes"] = int(n_layer) * S * cross_row
+    return out

@@ -13,6 +13,9 @@ __all__ = [
     "multi_head_attention",
     "paged_attention",
     "paged_kv_write",
+    "paged_kv_prefill",
+    "paged_copy_page",
+    "grouped_cross_attention",
     "slot_decode_sample",
     "label_smooth",
     "add_position_encoding",
@@ -197,6 +200,64 @@ def paged_kv_write(k_pool, v_pool, k_new, v_new, page_table, pos,
         outputs={"KOut": [k_pool], "VOut": [v_pool]},
     )
     return k_pool, v_pool
+
+
+def paged_kv_prefill(k_pool, v_pool, k_new, v_new, page_row, write_from,
+                     length, name=None):
+    """Chunked-prefill KV scatter: land a forced prefix's whole
+    ``[1, H, T, dh]`` K/V rows into the slot's pages in one op —
+    position ``p`` writes at ``(page_row[p // page_size],
+    p % page_size)`` for ``write_from <= p < length - 1``; positions a
+    prefix-cache hit already covers, and the pad tail, route to the
+    trash page. In-place state convention: binds ``KOut``/``VOut`` back
+    onto the pool vars."""
+    helper = LayerHelper("paged_kv_prefill", name=name)
+    helper.append_op(
+        type="paged_kv_prefill",
+        inputs={"KPool": [k_pool], "VPool": [v_pool], "KNew": [k_new],
+                "VNew": [v_new], "PageRow": [page_row],
+                "WriteFrom": [write_from], "Len": [length]},
+        outputs={"KOut": [k_pool], "VOut": [v_pool]},
+    )
+    return k_pool, v_pool
+
+
+def paged_copy_page(k_pool, v_pool, src_page, dst_page, name=None):
+    """On-device page copy (the COW primitive): ``pool[dst] =
+    pool[src]`` for both the K and V pool in one op. The serving
+    session dispatches this before repointing a forked slot's table
+    row at the private copy. In-place state convention on the pool
+    vars."""
+    helper = LayerHelper("paged_copy_page", name=name)
+    helper.append_op(
+        type="paged_copy_page",
+        inputs={"KPool": [k_pool], "VPool": [v_pool], "Src": [src_page],
+                "Dst": [dst_page]},
+        outputs={"KOut": [k_pool], "VOut": [v_pool]},
+    )
+    return k_pool, v_pool
+
+
+def grouped_cross_attention(query, k_pool, v_pool, group_of, mask,
+                            sm_scale=None, impl="auto", name=None):
+    """Group-indexed cross attention for the paged decode step.
+
+    ``query`` [S, H, 1, dh]; ``k_pool``/``v_pool`` [G, H, T_src, dh] —
+    one cross K/V row per admitted SOURCE, not per slot; ``group_of``
+    [S, 1] (or [S]) int group ids; ``mask`` [G, T_src] validity rows.
+    Each slot attends over its group's row, so N slots decoding
+    continuations of one source cost one group's HBM instead of N
+    dense rows."""
+    helper = LayerHelper("grouped_cross_attention", name=name)
+    out = helper.create_variable_for_type_inference(query.dtype)
+    helper.append_op(
+        type="grouped_cross_attention",
+        inputs={"Q": [query], "KPool": [k_pool], "VPool": [v_pool],
+                "GroupOf": [group_of], "Mask": [mask]},
+        outputs={"Out": [out]},
+        attrs={"sm_scale": float(sm_scale or 0.0), "impl": impl},
+    )
+    return out
 
 
 def slot_decode_sample(logits, pos, done=None, strategy="greedy",

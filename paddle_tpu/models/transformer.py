@@ -852,6 +852,7 @@ def build_paged_slot_decoder(
     d_inner=512,
     page_size=8,
     num_pages=None,
+    num_groups=None,
     bos_id=1,
     eos_id=2,
     sampler=None,
@@ -866,39 +867,79 @@ def build_paged_slot_decoder(
     dispatches K decode tokens per host round trip and fetches
     ``[K, S, 1]`` int ids instead of per-token ``[S, 1, V]`` logits.
 
-    Returns ``(init_prog, admit_prog, step_prog, table_prog,
-    token_name)``:
+    Cross-request KV reuse (PR 12): cross-attention K/V is pooled per
+    GROUP — ``[num_groups, H, T, dh]`` rows plus a per-slot
+    ``group_of`` index — so N slots decoding sampled continuations of
+    one source (``SlotDecodeSession.admit_group``) run ONE encoder
+    forward and cost one group's cross HBM instead of N dense rows.
+    Self-KV pages are refcount-shared host-side; the programs below
+    give the host the on-device levers (join a group without an
+    encoder run, chunked-prefill a forced prefix, copy-on-write a
+    shared page).
+
+    Returns ``(init_prog, admit_prog, join_prog, prefill_prog,
+    copy_prog, table_prog, step_prog, token_name)``:
 
     * ``init_prog`` (once; feeds ``pe_table [T, D]`` — the host's exact
       ``position_encoding_row`` table, so in-graph rows are bit-equal
       to the dense session's fed rows): allocates the zeroed page
-      pools, cross K/V pools, the per-slot source mask (column 0
-      seeded valid), the page table (all rows -> the reserved TRASH
-      page 0, where unoccupied slots' writes land harmlessly), and the
-      per-slot loop state ``pgd_tok``/``pgd_pos``/``pgd_done``.
-    * ``admit_prog`` (per admission; feeds ``src_word``, ``src_len``,
-      ``slot_idx``, ``page_row [1, pages_per_slot]`` — the host
-      allocator's page ids for this slot, unprovisioned tail entries
-      aliasing the last valid page): encoder forward for ONE sequence,
-      cross K/V + mask scattered into the slot's rows, page-table row
-      installed, loop state reset (tok=bos, pos=0, done=0). The self
-      pages are NOT zeroed — every position a slot attends over was
-      written by that slot first, so stale page bits are never read.
+      pools, the GROUP cross K/V pools ``[G, H, T, dh]``, the
+      per-group source mask (column 0 seeded valid), ``group_of [S,1]``
+      (all slots -> group 0), the page table (all rows -> the reserved
+      TRASH page 0, where unoccupied slots' writes land harmlessly),
+      and the per-slot loop state ``pgd_tok``/``pgd_pos``/``pgd_done``.
+    * ``admit_prog`` (once per admitted SOURCE; feeds ``src_word``,
+      ``src_len``, ``slot_idx``, ``group_idx``,
+      ``page_row [1, pages_per_slot]`` — the host allocator's page ids
+      for this slot, unprovisioned tail entries aliasing the last
+      valid page — and ``start_tok``/``start_pos [1, 1]``, bos/0
+      without a forced prefix): encoder forward for ONE sequence,
+      cross K/V + mask scattered into the GROUP's rows, the slot's
+      group id, page-table row and loop state installed
+      (tok=start_tok, pos=start_pos, done=0). The self pages are NOT
+      zeroed — every position a slot attends over was written by that
+      slot (or its fork parent) first, so stale page bits are never
+      read.
+    * ``join_prog`` (per extra group member; feeds ``slot_idx``,
+      ``group_idx``, ``page_row``, ``start_tok``, ``start_pos``):
+      registers another slot onto an EXISTING group — no encoder
+      forward, no cross write; just group id, table row and loop
+      state. This is the fork: the member's table row references the
+      parent's pages until copy-on-write splits them.
+    * ``prefill_prog`` (per uncached forced prefix; feeds
+      ``prefix_word [1, T]``, ``prefix_len``, ``write_from [1, 1]``,
+      ``slot_idx``, ``group_idx``): ONE causal decoder forward over
+      the whole prefix, cross-attending the group's rows, with each
+      layer's K/V scattered into the slot's pages by
+      ``paged_kv_prefill`` — only positions in
+      ``[write_from, prefix_len - 1)`` are written (a prefix-cache hit
+      sets ``write_from`` past the cached pages; pad positions route
+      to the trash page), replacing token-by-token prefix stepping
+      with one dispatch.
+    * ``copy_prog`` (per COW; feeds ``src_page``, ``dst_page``,
+      ``slot_idx``, ``page_row``): copies one K/V page in every
+      layer's pools (``paged_copy_page``) and installs the repointed
+      table row — a fork's first write to a shared page runs this
+      first, so shared (and prefix-cached) page bits are immutable.
     * ``step_prog`` (K per dispatch, NO feeds): O(page)
       ``paged_kv_write`` at each slot's own position, ragged
       ``paged_attention`` bounded by per-slot lengths (empty pages and
-      unoccupied slots are skipped), cross attention over the dense
-      cross pools, and ``slot_decode_sample`` (greedy / temperature /
-      top-k per ``sampler``; finished slots emit eos and freeze).
-      Fetch ``token_name`` for the per-step ``[S, 1]`` sampled ids.
+      unoccupied slots are skipped), GROUP-indexed cross attention
+      (``grouped_cross_attention`` gathers each slot's group row), and
+      ``slot_decode_sample`` (greedy / temperature / top-k per
+      ``sampler``; finished slots emit eos and freeze). Fetch
+      ``token_name`` for the per-step ``[S, 1]`` sampled ids.
     * ``table_prog`` (feeds ``slot_idx``, ``page_row``): rewrite one
       slot's page-table row — mid-flight page extension before a
-      dispatch, and the release path's reset to the trash page.
+      dispatch, and the release/rollback paths' reset to the trash
+      page.
 
     Build under the training ``build()``'s fresh ``unique_name`` scope;
     parameters bind by name. All decode state is ``pgd_``-prefixed, so
     a paged and a dense session can coexist in one scope. Host-side
-    page allocation lives in ``serving.generation.SlotDecodeSession``.
+    page/group/cache allocation lives in
+    ``serving.generation.SlotDecodeSession`` +
+    ``serving.kv_pool``.
     """
     from paddle_tpu import unique_name
 
@@ -910,6 +951,7 @@ def build_paged_slot_decoder(
     ps = int(page_size)
     npp = pages_for(T, ps)  # pages per slot at full length
     P = int(num_pages) if num_pages else 1 + S * npp
+    G = int(num_groups) if num_groups else S
 
     def heads(x):
         return nn.transpose(
@@ -931,20 +973,22 @@ def build_paged_slot_decoder(
             pe = nn.data("pe_table", shape=[T, D], dtype="float32",
                          append_batch_size=False)
             persist("pgd_pe_table", pe)
-            mask0 = nn.fill_constant([S, T], "float32", 0.0)
+            mask0 = nn.fill_constant([G, T], "float32", 0.0)
             mask0 = nn.dynamic_update_slice(
-                mask0, nn.fill_constant([S, 1], "float32", 1.0),
+                mask0, nn.fill_constant([G, 1], "float32", 1.0),
                 nn.fill_constant([1], "int64", 0), axis=1)
             persist("pgd_src_mask", mask0)
             for i in range(n_layer):
                 for kind in ("kcross", "vcross"):
                     persist("pgd_%s_%d" % (kind, i),
-                            nn.fill_constant([S, n_head, T, dh],
+                            nn.fill_constant([G, n_head, T, dh],
                                              "float32", 0.0))
                 for kind in ("kpool", "vpool"):
                     persist("pgd_%s_%d" % (kind, i),
                             nn.fill_constant([P, n_head, ps, dh],
                                              "float32", 0.0))
+            persist("pgd_group_of",
+                    nn.fill_constant([S, 1], "int64", 0), "int64")
             persist("pgd_table",
                     nn.fill_constant([S, npp], "int64", 0), "int64")
             persist("pgd_pos",
@@ -954,15 +998,41 @@ def build_paged_slot_decoder(
             persist("pgd_done",
                     nn.fill_constant([S, 1], "int64", 1), "int64")
 
+        def slot_state_feeds():
+            """The feeds admit/join share for one member's registration."""
+            slot = nn.data("slot_idx", shape=[1], dtype="int64",
+                           append_batch_size=False)
+            gidx = nn.data("group_idx", shape=[1], dtype="int64",
+                           append_batch_size=False)
+            page_row = nn.data("page_row", shape=[npp], dtype="int64")
+            start_tok = nn.data("start_tok", shape=[1], dtype="int64")
+            start_pos = nn.data("start_pos", shape=[1], dtype="int64")
+            return slot, gidx, page_row, start_tok, start_pos
+
+        def register_member(blk, slot, gidx, page_row, start_tok,
+                            start_pos):
+            """Install one slot's group id, table row and loop state."""
+            def srow(name, value, dtype="int64"):
+                p = blk.create_var(name=name,
+                                   shape=[S, npp] if name == "pgd_table"
+                                   else [S, 1],
+                                   dtype=dtype, persistable=True)
+                nn.dynamic_update_slice(p, value, slot, axis=0, out=p)
+
+            srow("pgd_group_of", nn.reshape(gidx, shape=[1, 1]))
+            srow("pgd_table", page_row)
+            srow("pgd_tok", start_tok)
+            srow("pgd_pos", start_pos)
+            srow("pgd_done", nn.fill_constant([1, 1], "int64", 0))
+
         admit = fluid.Program()
         admit_startup = fluid.Program()
         with fluid.program_guard(admit, admit_startup):
             blk = admit.global_block()
             src = nn.data("src_word", shape=[T], dtype="int64")
             src_len = nn.data("src_len", shape=[1], dtype="int64")
-            slot = nn.data("slot_idx", shape=[1], dtype="int64",
-                           append_batch_size=False)
-            page_row = nn.data("page_row", shape=[npp], dtype="int64")
+            slot, gidx, page_row, start_tok, start_pos = \
+                slot_state_feeds()
             src_mask = nn.sequence_mask(src_len, maxlen=T,
                                         dtype="float32")  # [1, T]
             emb = nn.embedding(
@@ -974,12 +1044,12 @@ def build_paged_slot_decoder(
                                     0.0, True, "enc_%d" % i)
             enc = _prenorm(enc, "enc_final")
 
-            def prow(name, shape, value, dtype="float32"):
+            def grow(name, shape, value, dtype="float32"):
                 p = blk.create_var(name=name, shape=shape, dtype=dtype,
                                    persistable=True)
-                nn.dynamic_update_slice(p, value, slot, axis=0, out=p)
+                nn.dynamic_update_slice(p, value, gidx, axis=0, out=p)
 
-            prow("pgd_src_mask", [S, T], src_mask)
+            grow("pgd_src_mask", [G, T], src_mask)
             for i in range(n_layer):
                 kc = heads(nn.fc(enc, dh * n_head, num_flatten_dims=2,
                                  bias_attr=False,
@@ -987,15 +1057,121 @@ def build_paged_slot_decoder(
                 vc = heads(nn.fc(enc, dh * n_head, num_flatten_dims=2,
                                  bias_attr=False,
                                  name="dec_%d_cmha_v" % i))
-                prow("pgd_kcross_%d" % i, [S, n_head, T, dh], kc)
-                prow("pgd_vcross_%d" % i, [S, n_head, T, dh], vc)
-            prow("pgd_table", [S, npp], page_row, "int64")
-            prow("pgd_tok", [S, 1],
-                 nn.fill_constant([1, 1], "int64", bos_id), "int64")
-            prow("pgd_pos", [S, 1],
-                 nn.fill_constant([1, 1], "int64", 0), "int64")
-            prow("pgd_done", [S, 1],
-                 nn.fill_constant([1, 1], "int64", 0), "int64")
+                grow("pgd_kcross_%d" % i, [G, n_head, T, dh], kc)
+                grow("pgd_vcross_%d" % i, [G, n_head, T, dh], vc)
+            register_member(blk, slot, gidx, page_row, start_tok,
+                            start_pos)
+
+        join = fluid.Program()
+        join_startup = fluid.Program()
+        with fluid.program_guard(join, join_startup):
+            blk = join.global_block()
+            slot, gidx, page_row, start_tok, start_pos = \
+                slot_state_feeds()
+            register_member(blk, slot, gidx, page_row, start_tok,
+                            start_pos)
+
+        prefill = fluid.Program()
+        prefill_startup = fluid.Program()
+        # the prefill program re-creates the decoder's param-owning
+        # layers (norms/fcs) exactly like the step program will; a
+        # FRESH name scope gives both the training build's .w_0/.w_1
+        # parameter suffixes instead of shifting each other's counters
+        with unique_name.guard({}), \
+                fluid.program_guard(prefill, prefill_startup):
+            blk = prefill.global_block()
+            pword = nn.data("prefix_word", shape=[T], dtype="int64")
+            plen = nn.data("prefix_len", shape=[1], dtype="int64")
+            wfrom = nn.data("write_from", shape=[1], dtype="int64")
+            slot = nn.data("slot_idx", shape=[1], dtype="int64",
+                           append_batch_size=False)
+            gidx = nn.data("group_idx", shape=[1], dtype="int64",
+                           append_batch_size=False)
+
+            def pvar(name, shape, dtype="float32"):
+                return blk.create_var(name=name, shape=shape, dtype=dtype,
+                                      persistable=True)
+
+            row = nn.gather(pvar("pgd_table", [S, npp], "int64"),
+                            slot)  # [1, npp]
+            mask_row = nn.gather(pvar("pgd_src_mask", [G, T]),
+                                 gidx)  # [1, T]
+            pe_all = nn.reshape(pvar("pgd_pe_table", [T, D]),
+                                shape=[1, T, D])
+            emb = nn.embedding(
+                input=pword, size=[trg_vocab_size, D],
+                param_attr=fluid.ParamAttr(name="trg_emb"))  # [1, T, D]
+            h = nn.elementwise_add(nn.scale(emb, scale=D ** 0.5), pe_all)
+            for i in range(n_layer):
+                name = "dec_%d" % i
+                kpool = pvar("pgd_kpool_%d" % i, [P, n_head, ps, dh])
+                vpool = pvar("pgd_vpool_%d" % i, [P, n_head, ps, dh])
+                nx = _prenorm(h, name + "_sattn")
+                k1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False, name=name + "_smha_k"))
+                v1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False, name=name + "_smha_v"))
+                # every layer's K/V for the whole prefix lands in one op;
+                # positions below write_from (prefix-cache hits) and the
+                # pad tail route to the trash page
+                fluid.layers.paged_kv_prefill(
+                    kpool, vpool, k1, v1, row, wfrom, plen)
+                if i == n_layer - 1:
+                    break  # deeper layers don't exist: the rest of this
+                    # block's compute feeds nothing
+                q = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                bias_attr=False, name=name + "_smha_q"))
+                att = fluid.layers.scaled_dot_product_attention(
+                    q, k1, v1, causal=True, sm_scale=dh ** -0.5)
+                att = nn.reshape(nn.transpose(att, perm=[0, 2, 1, 3]),
+                                 shape=[0, 0, n_head * dh])
+                h = nn.elementwise_add(h, nn.fc(
+                    att, D, num_flatten_dims=2, bias_attr=False,
+                    name=name + "_smha_o"))
+                nx2 = _prenorm(h, name + "_cattn")
+                q2 = heads(nn.fc(nx2, dh * n_head, num_flatten_dims=2,
+                                 bias_attr=False,
+                                 name=name + "_cmha_q"))
+                kc = nn.gather(pvar("pgd_kcross_%d" % i,
+                                    [G, n_head, T, dh]), gidx)
+                vc = nn.gather(pvar("pgd_vcross_%d" % i,
+                                    [G, n_head, T, dh]), gidx)
+                ctx = fluid.layers.scaled_dot_product_attention(
+                    q2, kc, vc, mask=mask_row, sm_scale=dh ** -0.5)
+                ctx = nn.reshape(nn.transpose(ctx, perm=[0, 2, 1, 3]),
+                                 shape=[0, 0, n_head * dh])
+                h = nn.elementwise_add(h, nn.fc(
+                    ctx, D, num_flatten_dims=2, bias_attr=False,
+                    name=name + "_cmha_o"))
+                ff = _ffn(_prenorm(h, name + "_ffn"), D, d_inner,
+                          name + "_ffn")
+                h = nn.elementwise_add(h, ff)
+
+        copy = fluid.Program()
+        copy_startup = fluid.Program()
+        with fluid.program_guard(copy, copy_startup):
+            blk = copy.global_block()
+            src_page = nn.data("src_page", shape=[1], dtype="int64",
+                               append_batch_size=False)
+            dst_page = nn.data("dst_page", shape=[1], dtype="int64",
+                               append_batch_size=False)
+            slot = nn.data("slot_idx", shape=[1], dtype="int64",
+                           append_batch_size=False)
+            page_row = nn.data("page_row", shape=[npp], dtype="int64")
+            for i in range(n_layer):
+                fluid.layers.paged_copy_page(
+                    blk.create_var(name="pgd_kpool_%d" % i,
+                                   shape=[P, n_head, ps, dh],
+                                   dtype="float32", persistable=True),
+                    blk.create_var(name="pgd_vpool_%d" % i,
+                                   shape=[P, n_head, ps, dh],
+                                   dtype="float32", persistable=True),
+                    src_page, dst_page)
+            # the repointed row rides the same dispatch: device state is
+            # never visible mid-COW (copy before repoint, atomically)
+            t = blk.create_var(name="pgd_table", shape=[S, npp],
+                               dtype="int64", persistable=True)
+            nn.dynamic_update_slice(t, page_row, slot, axis=0, out=t)
 
         table = fluid.Program()
         table_startup = fluid.Program()
@@ -1021,8 +1197,9 @@ def build_paged_slot_decoder(
             pos = pvar("pgd_pos", [S, 1], "int64")
             done = pvar("pgd_done", [S, 1], "int64")
             ptable = pvar("pgd_table", [S, npp], "int64")
+            group_of = pvar("pgd_group_of", [S, 1], "int64")
             pe_table = pvar("pgd_pe_table", [T, D])
-            src_mask = pvar("pgd_src_mask", [S, T])
+            src_mask = pvar("pgd_src_mask", [G, T])
             # resident tokens per slot AFTER this step's write: pos + 1
             # for LIVE slots, 0 for done/unoccupied ones — a zero length
             # makes the ragged kernel skip the slot outright (its logits
@@ -1066,10 +1243,12 @@ def build_paged_slot_decoder(
                 q2 = heads(nn.fc(nx2, dh * n_head, num_flatten_dims=2,
                                  bias_attr=False,
                                  name=name + "_cmha_q"))
-                ctx = fluid.layers.scaled_dot_product_attention(
-                    q2, pvar("pgd_kcross_%d" % i, [S, n_head, T, dh]),
-                    pvar("pgd_vcross_%d" % i, [S, n_head, T, dh]),
-                    mask=src_mask, sm_scale=dh ** -0.5)
+                # group-indexed cross attention: each slot's row is its
+                # GROUP's — N forked slots read one [H, T, dh] row
+                ctx = fluid.layers.grouped_cross_attention(
+                    q2, pvar("pgd_kcross_%d" % i, [G, n_head, T, dh]),
+                    pvar("pgd_vcross_%d" % i, [G, n_head, T, dh]),
+                    group_of, src_mask, sm_scale=dh ** -0.5)
                 ctx = nn.reshape(nn.transpose(ctx, perm=[0, 2, 1, 3]),
                                  shape=[0, 0, n_head * dh])
                 h = nn.elementwise_add(h, nn.fc(
@@ -1089,7 +1268,7 @@ def build_paged_slot_decoder(
             nn.assign(tok_new, output=tok)
             nn.assign(pos_new, output=pos)
             nn.assign(done_new, output=done)
-    return init, admit, step, table, tok_new.name
+    return init, admit, join, prefill, copy, table, step, tok_new.name
 
 
 def save_compiled_generator(dirname, batch_size, src_vocab_size,

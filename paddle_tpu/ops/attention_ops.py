@@ -161,6 +161,119 @@ register_op(
 )
 
 
+def _lower_grouped_cross_attention(ctx, ins, attrs):
+    """Group-indexed cross attention for the paged decode step: the
+    cross K/V pools are laid out per GROUP (``[G, H, T_src, dh]`` — one
+    row per admitted source, however many slots decode continuations of
+    it) and each slot reaches its group's row through ``group_of[s]``.
+    N best-of-N slots cost ONE group's HBM instead of N dense rows; the
+    gather is index arithmetic XLA fuses into the attention, so no
+    per-slot copy materializes as pool state."""
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    q = ins["Q"][0]  # [S, H, 1, dh]
+    k_pool = ins["KPool"][0]  # [G, H, T_src, dh]
+    v_pool = ins["VPool"][0]
+    gof = jnp.reshape(ins["GroupOf"][0], (-1,)).astype(jnp.int32)  # [S]
+    mask = ins["Mask"][0]  # [G, T_src] validity rows
+    sm_scale = attrs.get("sm_scale", 0.0) or None
+    impl = attrs.get("impl", "auto")
+    if impl == "auto":
+        from paddle_tpu import flags
+
+        impl = flags.get("attention_impl")
+    k = k_pool[gof]  # [S, H, T_src, dh]
+    v = v_pool[gof]
+    m = mask[gof][:, None, None, :].astype(bool)  # [S, 1, 1, T_src]
+    return flash_attention(
+        q, k, v, mask=m, sm_scale=sm_scale,
+        force_reference=(impl == "reference"),
+        force_pallas=(impl == "pallas"),
+    )
+
+
+register_op(
+    "grouped_cross_attention",
+    inputs=["Q", "KPool", "VPool", "GroupOf", "Mask"],
+    outputs=["Out"],
+    attrs={"sm_scale": 0.0, "impl": "auto"},
+    lower=_lower_grouped_cross_attention,
+    grad=None,  # decode-only op: no training path attends grouped
+    no_grad_inputs=("GroupOf", "Mask"),
+    infer_shape=_paged_attention_infer_shape,
+)
+
+
+def _lower_paged_copy_page(ctx, ins, attrs):
+    """On-device page copy — the copy half of copy-on-write: duplicate
+    one K and one V page (``pool[dst] = pool[src]``) so a forked slot
+    whose write position enters a SHARED page (refcount > 1) gets a
+    private bit-identical copy before its table row repoints. Both
+    pools move in one op so a COW is one fused dispatch per layer, not
+    two."""
+    k_pool = ins["KPool"][0]  # [P, H, page_size, dh]
+    v_pool = ins["VPool"][0]
+    src = jnp.reshape(ins["Src"][0], ()).astype(jnp.int32)
+    dst = jnp.reshape(ins["Dst"][0], ()).astype(jnp.int32)
+
+    def copy(pool):
+        row = jax.lax.dynamic_slice_in_dim(pool, src, 1, axis=0)
+        return jax.lax.dynamic_update_slice_in_dim(pool, row, dst, axis=0)
+
+    return {"KOut": copy(k_pool), "VOut": copy(v_pool)}
+
+
+register_op(
+    "paged_copy_page",
+    inputs=["KPool", "VPool", "Src", "Dst"],
+    outputs=["KOut", "VOut"],
+    lower=_lower_paged_copy_page,
+    grad=None,
+    no_grad_inputs=("Src", "Dst"),
+)
+
+
+def _lower_paged_kv_prefill(ctx, ins, attrs):
+    """Chunked-prefill KV scatter: land a whole forced prefix's per-layer
+    K/V rows (``[1, H, T, dh]``, computed by ONE decoder forward) into
+    the slot's pages in one op, instead of one ``paged_kv_write`` per
+    token. Position ``p`` goes to ``(page_row[p // page_size],
+    p % page_size)`` when ``write_from <= p < len - 1`` — positions a
+    prefix-cache hit already covers (below ``write_from``) and pad/tail
+    positions route to the trash page (page 0), so a hit prefills ONLY
+    the uncached suffix and cached page bits are never touched."""
+    k_pool = ins["KPool"][0]  # [P, H, page_size, dh]
+    v_pool = ins["VPool"][0]
+    k_new = ins["KNew"][0]  # [1, H, T, dh]
+    v_new = ins["VNew"][0]
+    row = jnp.reshape(ins["PageRow"][0], (-1,)).astype(jnp.int32)  # [npp]
+    wf = jnp.reshape(ins["WriteFrom"][0], ()).astype(jnp.int32)
+    ln = jnp.reshape(ins["Len"][0], ()).astype(jnp.int32)
+    ps = k_pool.shape[2]
+    T = k_new.shape[2]
+    p = jnp.arange(T, dtype=jnp.int32)
+    live = (p >= wf) & (p < ln - 1)
+    pages = jnp.where(live, row[p // ps], 0)
+    offs = p % ps
+    kt = jnp.transpose(k_new[0], (1, 0, 2))  # [T, H, dh]
+    vt = jnp.transpose(v_new[0], (1, 0, 2))
+    return {
+        "KOut": k_pool.at[pages, :, offs, :].set(kt.astype(k_pool.dtype)),
+        "VOut": v_pool.at[pages, :, offs, :].set(vt.astype(v_pool.dtype)),
+    }
+
+
+register_op(
+    "paged_kv_prefill",
+    inputs=["KPool", "VPool", "KNew", "VNew", "PageRow", "WriteFrom",
+            "Len"],
+    outputs=["KOut", "VOut"],
+    lower=_lower_paged_kv_prefill,
+    grad=None,
+    no_grad_inputs=("PageRow", "WriteFrom", "Len"),
+)
+
+
 def _lower_paged_kv_write(ctx, ins, attrs):
     """O(page) KV-cache write: each slot's new K/V row lands at
     (table[s, pos // page_size], pos % page_size) — replaces the dense

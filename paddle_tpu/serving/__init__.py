@@ -33,13 +33,19 @@ bounded set of warm executables. This package is that layer:
 """
 
 from paddle_tpu.serving import generation  # noqa: F401
+from paddle_tpu.serving import kv_pool  # noqa: F401
 from paddle_tpu.serving import loadgen  # noqa: F401
 from paddle_tpu.serving import server  # noqa: F401
 from paddle_tpu.serving.generation import (  # noqa: F401
+    NoFreeGroupError,
     NoFreePageError,
     NoFreeSlotError,
     Sampler,
     SlotDecodeSession,
+)
+from paddle_tpu.serving.kv_pool import (  # noqa: F401
+    PagePool,
+    PrefixCache,
 )
 from paddle_tpu.serving.server import (  # noqa: F401
     BatchingServer,

@@ -15,42 +15,62 @@ tests/test_serving.py pins the staggered-admission parity).
 ``paged=True`` swaps the dense per-slot caches for the BLOCK-PAGED
 layout (``build_paged_slot_decoder`` + ``kernels/paged_attention.py``):
 self K/V lives in fixed-size pages shared by every slot through a
-per-slot page table this session allocates from a free list (page 0 is
-the reserved trash page unoccupied slots write into), decode attention
-is ragged — per-step cost scales with tokens actually RESIDENT, not
-``num_slots x max_length`` — and the step program is a self-contained
-loop body, so one ``run_multi_step(steps=K)`` dispatch advances every
-slot K tokens and fetches ``[K, S, 1]`` int ids instead of per-token
-``[S, 1, V]`` logits. Token selection (greedy / temperature / top-k,
-``Sampler``) runs on device in BOTH layouts; the dense path too now
-fetches token ids, never vocab-sized logits.
+per-slot page table this session allocates from a REFCOUNTED
+``kv_pool.PagePool`` (page 0 is the reserved trash page unoccupied
+slots write into), decode attention is ragged — per-step cost scales
+with tokens actually RESIDENT, not ``num_slots x max_length`` — and
+the step program is a self-contained loop body, so one
+``run_multi_step(steps=K)`` dispatch advances every slot K tokens and
+fetches ``[K, S, 1]`` int ids instead of per-token ``[S, 1, V]``
+logits. Token selection (greedy / temperature / top-k, ``Sampler``)
+runs on device in BOTH layouts; the dense path too now fetches token
+ids, never vocab-sized logits.
+
+Cross-request KV reuse (the PR 12 layer over the page table):
+
+* ``admit_group(src, n=N)`` admits N sampled continuations of ONE
+  source that run one encoder forward and reference one group-pooled
+  set of cross-attention K/V rows (``[G, H, T, dh]`` + ``group_of``) —
+  N slots cost one group's cross HBM, not N dense rows.
+* Self-KV pages are shared by REFERENCE (refcount > 1) until a slot's
+  write position enters a shared page; the session then runs the
+  on-device ``copy_prog`` (page copy + table-row repoint in one
+  dispatch) first — copy-on-write, so shared page bits are immutable
+  and a fork's greedy member is bit-identical to a solo admission.
+* ``admit(src, prefix_tokens=[...])`` forces a decoder prefix
+  (few-shot/system preamble) through ONE chunked-prefill dispatch
+  instead of token-by-token stepping, and a ``kv_pool.PrefixCache``
+  keyed by (source fingerprint, prefix tokens) maps repeated prefixes
+  to refcounted full pages — a hit provisions the table row by
+  reference and prefills only the uncached suffix.
+
+Everything stays inside the zero-recompile contract: shapes are fixed;
+only table rows, group ids and refcounts change between dispatches.
+``docs/SERVING.md`` "KV reuse" has the lifecycle diagrams.
 """
 
+import hashlib
 import time
+from collections import deque
 
 import numpy as np
 
 from paddle_tpu.observability.metrics_registry import REGISTRY as _REGISTRY
+from paddle_tpu.serving.kv_pool import (
+    NoFreeGroupError,
+    NoFreePageError,
+    PagePool,
+    PrefixCache,
+)
 from paddle_tpu.serving.server import ServingError
 
 __all__ = ["SlotDecodeSession", "Sampler", "NoFreeSlotError",
-           "NoFreePageError"]
+           "NoFreePageError", "NoFreeGroupError"]
 
 
 class NoFreeSlotError(ServingError):
     """admit() with every slot occupied — the generation-side admission
     reject; retry after a step() frees slots."""
-
-
-class NoFreePageError(ServingError):
-    """The paged KV pool cannot RESERVE a new sequence's worst-case
-    pages (``num_pages`` sized below worst-case occupancy) — the
-    page-level admission reject; retry after a step() completes
-    sequences and releases their reservations. Raised only at
-    ``admit()`` (reservation-based admission control): a sequence that
-    was admitted can always be provisioned mid-flight, so an
-    oversubscribed pool degrades to fewer concurrent slots, never to a
-    wedged session."""
 
 
 class Sampler(object):
@@ -90,13 +110,29 @@ _sequences_total = _REGISTRY.counter(
     labels=("event",))  # admitted | completed
 _pages_in_use = _REGISTRY.gauge(
     "paddle_tpu_serving_kv_pages_in_use",
-    "KV pages currently allocated to live slots (paged sessions)")
+    "KV pages currently referenced (live slots + prefix cache; paged "
+    "sessions)")
 _pages_per_slot = _REGISTRY.gauge(
     "paddle_tpu_serving_pages_per_slot",
     "mean KV pages held per live slot (paged sessions)")
 _decode_tps = _REGISTRY.gauge(
     "paddle_tpu_serving_decode_tokens_per_sec",
     "decode tokens consumed per second of step() dispatch wall time")
+_pages_shared = _REGISTRY.gauge(
+    "paddle_tpu_serving_kv_pages_shared",
+    "KV pages with refcount > 1 (fork/prefix sharing in flight)")
+_dedup_bytes = _REGISTRY.gauge(
+    "paddle_tpu_serving_kv_dedup_bytes",
+    "HBM bytes deduplicated by sharing: extra page references and "
+    "extra group members that would each be a physical copy unshared")
+_prefix_hit_rate = _REGISTRY.gauge(
+    "paddle_tpu_serving_prefix_hit_rate",
+    "prefix-cache lookups that reused at least one full page / all "
+    "lookups (session lifetime)")
+_prefill_saved = _REGISTRY.counter(
+    "paddle_tpu_serving_prefill_tokens_saved_total",
+    "forced-prefix positions provisioned by reference (prefix-cache "
+    "hits + group-fork joins) instead of being prefilled")
 
 
 class SlotDecodeSession(object):
@@ -117,16 +153,20 @@ class SlotDecodeSession(object):
     paged-attention kernel (``page_size`` tokens per page,
     ``num_pages`` total — default one trash page plus full-occupancy
     worst case) and advances ``steps`` tokens per host dispatch.
-    ``sampler`` is a :class:`Sampler` (or dict) selecting greedy /
-    temperature / top-k, identical semantics in both layouts.
-    ``decoder_cfg`` forwards to the builder (``src_vocab_size``,
-    ``trg_vocab_size``, ``n_layer``, ``n_head``, ``d_inner``).
+    ``num_groups`` sizes the group-pooled cross-attention K/V (default
+    ``num_slots``: every solo admission gets its own group);
+    ``prefix_cache_pages`` > 0 enables the forced-prefix page cache
+    with that page capacity. ``sampler`` is a :class:`Sampler` (or
+    dict) selecting greedy / temperature / top-k, identical semantics
+    in both layouts. ``decoder_cfg`` forwards to the builder
+    (``src_vocab_size``, ``trg_vocab_size``, ``n_layer``, ``n_head``,
+    ``d_inner``).
     """
 
     def __init__(self, exe, num_slots, max_length=64, d_model=128,
                  bos_id=1, eos_id=2, scope=None, paged=False,
-                 page_size=8, num_pages=None, steps=1, sampler=None,
-                 **decoder_cfg):
+                 page_size=8, num_pages=None, num_groups=None, steps=1,
+                 sampler=None, prefix_cache_pages=0, **decoder_cfg):
         from paddle_tpu.models import transformer
 
         self._transformer = transformer
@@ -138,6 +178,8 @@ class SlotDecodeSession(object):
         self._paged = bool(paged)
         self._steps = max(1, int(steps))
         self._sampler = sampler
+        self._n_layer = int(decoder_cfg.get("n_layer", 2))
+        self._n_head = int(decoder_cfg.get("n_head", 4))
         if self._paged:
             from paddle_tpu.kernels.paged_attention import pages_for
 
@@ -146,37 +188,60 @@ class SlotDecodeSession(object):
             self._npp = pages_for(self._T, self._ps)
             self._P = (int(num_pages) if num_pages
                        else 1 + self._S * self._npp)
+            self._G = int(num_groups) if num_groups else self._S
             if self._P < 1 + self._npp:
                 raise ValueError(
                     "num_pages=%d cannot cover even ONE sequence: the "
                     "pool needs 1 trash page + ceil(max_length / "
                     "page_size) = %d pages, or every admit() would "
                     "fail its reservation" % (self._P, 1 + self._npp))
-            (self._init_prog, self._admit_prog, self._step_prog,
-             self._table_prog, self._fetch_name) = \
+            (self._init_prog, self._admit_prog, self._join_prog,
+             self._prefill_prog, self._copy_prog, self._table_prog,
+             self._step_prog, self._fetch_name) = \
                 transformer.build_paged_slot_decoder(
                     num_slots, max_length=max_length, d_model=d_model,
                     page_size=self._ps, num_pages=self._P,
-                    bos_id=bos_id, eos_id=eos_id, sampler=sampler,
-                    **decoder_cfg)
+                    num_groups=self._G, bos_id=bos_id, eos_id=eos_id,
+                    sampler=sampler, **decoder_cfg)
             pe = transformer.position_encoding_table(self._T, self._D)
             self._run(self._init_prog, {"pe_table": pe}, [])
             # page 0 is the trash page: never allocated, every
-            # unoccupied slot's table row points at it
-            self._free_pages = list(range(self._P - 1, 0, -1))
+            # unoccupied slot's table row points at it. Pages carry
+            # refcounts (kv_pool.PagePool): shared pages free only when
+            # the LAST reference drops, and a refcount > 1 means
+            # read-only — writes copy first (_cow_copies).
+            self._pool = PagePool(self._P)
+            self._prefix_cache = (
+                PrefixCache(self._pool, self._ps,
+                            max_pages=int(prefix_cache_pages))
+                if prefix_cache_pages else None)
             self._slot_pages = {}  # slot -> [page ids], ordered by index
+            self._slot_group = {}  # slot -> group id
+            self._free_groups = list(range(self._G - 1, -1, -1))
+            self._group_members = {}  # group id -> set(slot)
             # reservation-based admission control: every live slot has
             # its WORST-CASE pages reserved (a counter, not physical
             # pages — allocation stays lazy), so mid-flight _provision
-            # can never fail and an oversubscribed pool rejects at
-            # admit() instead of wedging at step()
+            # and COW copies can never fail and an oversubscribed pool
+            # rejects at admit() instead of wedging at step(). Pages
+            # held only by the prefix cache don't count against
+            # reservations: the cache evicts under free-list pressure
+            # (PagePool.acquire's reclaim hook). Pages LEAKED by failed
+            # rollback/COW dispatches (kept allocated so a possibly-
+            # committed device row can never corrupt a recycled page)
+            # are not reclaimable, so they shrink the capacity bound.
             self._reserved_pages = 0
+            self._leaked_pages = 0
         else:
             if steps != 1:
                 raise ValueError(
                     "multi-token dispatch (steps > 1) needs paged=True "
                     "— the dense step program is not a self-contained "
                     "loop body")
+            if prefix_cache_pages or num_groups:
+                raise ValueError(
+                    "prefix_cache_pages / num_groups need paged=True — "
+                    "the dense layout has no shareable KV state")
             (self._init_prog, self._admit_prog, self._step_prog,
              self._fetch_name) = transformer.build_slot_decoder(
                 num_slots, max_length=max_length, d_model=d_model,
@@ -199,6 +264,11 @@ class SlotDecodeSession(object):
         row = row + [row[-1]] * (self._npp - len(row))
         return np.asarray([row], dtype="int64")
 
+    def _acquire_page(self):
+        reclaim = (self._prefix_cache.reclaim
+                   if self._prefix_cache is not None else None)
+        return self._pool.acquire(reclaim)
+
     def _provision(self, slot, length):
         """Grow ``slot``'s page list to cover ``length`` resident
         tokens; returns True when the table row changed. Cannot fail:
@@ -207,9 +277,55 @@ class SlotDecodeSession(object):
         need = self._pages_for(min(int(length), self._T), self._ps)
         grew = False
         while len(pages) < need:
-            pages.append(self._free_pages.pop())
+            pages.append(self._acquire_page())
             grew = True
         return grew
+
+    def _cow_copies(self, slot, pos):
+        """Copy-on-write scan for one dispatch: every page this slot
+        will WRITE in positions ``[pos, pos + steps)`` that is still
+        shared (refcount > 1 — a fork sibling or the prefix cache
+        holds it) is swapped for a freshly acquired private page.
+        Returns [(src, dst)] pairs to copy; the slot's page list is
+        already repointed. Shared pages are thereby immutable: no slot
+        ever writes a page another reference can read."""
+        pages = self._slot_pages[slot]
+        first = int(pos) // self._ps
+        last = min(int(pos) + self._steps - 1, self._T - 1) // self._ps
+        copies = []
+        for i in range(first, min(last + 1, len(pages))):
+            if self._pool.refcount(pages[i]) > 1:
+                dst = self._acquire_page()
+                copies.append((pages[i], dst))
+                pages[i] = dst
+        return copies
+
+    def _dispatch_cow(self, slot, copies):
+        """Run one copy_prog dispatch per COW pair (page copy + table
+        repoint land atomically in one dispatch), then drop the source
+        reference. A FAILED copy dispatch may or may not have committed
+        device-side, so the host restores the shared source in its row
+        (consistent with an uncommitted dispatch) and LEAKS the
+        destination page (never freed — if the dispatch DID commit, the
+        device row points at it, and recycling it would hand a future
+        sequence a page the stale row still writes; if it didn't, the
+        copy's writes can only ever land in a page nobody else owns).
+        Same corruption-beats-capacity rule as ``_rollback_admission``;
+        leaked pages shrink the admission capacity bound."""
+        pages = self._slot_pages[slot]
+        for src_pg, dst_pg in copies:
+            try:
+                self._run(self._copy_prog, {
+                    "src_page": np.asarray([src_pg], dtype="int64"),
+                    "dst_page": np.asarray([dst_pg], dtype="int64"),
+                    "slot_idx": np.asarray([slot], dtype="int64"),
+                    "page_row": self._page_row(pages),
+                }, [])
+            except BaseException:
+                pages[pages.index(dst_pg)] = src_pg
+                self._leaked_pages += 1  # dst_pg stays allocated forever
+                raise
+            self._pool.deref(src_pg)
 
     def _write_table_row(self, slot, pages):
         self._run(self._table_prog, {
@@ -218,29 +334,83 @@ class SlotDecodeSession(object):
         }, [])
 
     def _update_pool_gauges(self):
-        in_use = (self._P - 1) - len(self._free_pages)
+        in_use = self._pool.allocated_count
         _pages_in_use.set(in_use)
         _pages_per_slot.set(in_use / len(self._live) if self._live
                             else 0.0)
+        _pages_shared.set(self._pool.shared_count)
+        dh = self._D // self._n_head
+        page_bytes = 2 * self._n_layer * self._n_head * self._ps * dh * 4
+        cross_bytes = 2 * self._n_layer * self._n_head * self._T * dh * 4
+        extra_members = sum(
+            len(m) - 1 for m in self._group_members.values())
+        _dedup_bytes.set(self._pool.extra_refs * page_bytes
+                         + extra_members * cross_bytes)
+        if self._prefix_cache is not None:
+            _prefix_hit_rate.set(self._prefix_cache.hit_rate)
 
     def _release_pages(self, slot):
-        """Recycle a finished slot's pages: the table row is pointed
-        back at the trash page FIRST (the still-stepping done slot's
-        writes must never land in a recycled page), then the pages
-        return to the free list."""
+        """Recycle a finished slot's references: the table row is
+        pointed back at the trash page FIRST (the still-stepping done
+        slot's writes must never land in a recycled page), then every
+        page reference drops — a page frees only when its LAST
+        reference (fork sibling or prefix-cache entry) goes. The
+        slot's group loses a member; the group id frees with its last
+        member."""
         self._write_table_row(slot, [])
-        self._free_pages.extend(reversed(self._slot_pages.pop(slot)))
+        for pg in self._slot_pages.pop(slot):
+            self._pool.deref(pg)
+        gid = self._slot_group.pop(slot, None)
+        members = self._group_members.get(gid)
+        if members is not None:
+            members.discard(slot)
+            if not members:
+                del self._group_members[gid]
+                self._free_groups.append(gid)
         self._reserved_pages -= self._pages_for(self._T, self._ps)
 
     @property
     def free_pages(self):
         """Unallocated KV pages (paged sessions; trash page excluded)."""
-        return len(self._free_pages) if self._paged else 0
+        return self._pool.free_count if self._paged else 0
 
     @property
     def pages_in_use(self):
-        return ((self._P - 1) - len(self._free_pages) if self._paged
-                else 0)
+        """Pages referenced by live slots or the prefix cache."""
+        return self._pool.allocated_count if self._paged else 0
+
+    @property
+    def shared_pages(self):
+        """Pages with refcount > 1 (fork/prefix sharing in flight)."""
+        return self._pool.shared_count if self._paged else 0
+
+    @property
+    def cached_pages(self):
+        """Distinct pages the prefix cache holds references on."""
+        return (self._prefix_cache.pages
+                if self._paged and self._prefix_cache is not None else 0)
+
+    @property
+    def free_groups(self):
+        return len(self._free_groups) if self._paged else 0
+
+    def prefix_cache_stats(self):
+        """{'lookups', 'hits', 'hit_rate', 'tokens_saved', 'pages'} —
+        zeros when the cache is disabled."""
+        c = self._prefix_cache if self._paged else None
+        if c is None:
+            return {"lookups": 0, "hits": 0, "hit_rate": 0.0,
+                    "tokens_saved": 0, "pages": 0}
+        return {"lookups": c.lookups, "hits": c.hits,
+                "hit_rate": c.hit_rate, "tokens_saved": c.tokens_saved,
+                "pages": c.pages}
+
+    def clear_prefix_cache(self):
+        """Drop every cached prefix page (references released; pages
+        free once no live slot shares them)."""
+        if self._paged and self._prefix_cache is not None:
+            self._prefix_cache.clear()
+            self._update_pool_gauges()
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -251,14 +421,46 @@ class SlotDecodeSession(object):
     def active_slots(self):
         return sorted(self._live)
 
-    def admit(self, src, src_len=None):
+    @staticmethod
+    def _src_fp(src, length):
+        """Prefix-cache source fingerprint: prefix K/V past layer 0
+        depends on the source (cross attention feeds every decoder
+        layer), so cached pages are keyed by source content too."""
+        h = hashlib.sha256(np.ascontiguousarray(src).tobytes())
+        h.update(str(int(length)).encode())
+        return h.hexdigest()
+
+    def _full_prefix(self, prefix_tokens):
+        prefix = [self._bos] + [int(t) for t in (prefix_tokens or ())]
+        if len(prefix) > self._T - 1:
+            raise ValueError(
+                "prefix_tokens too long: bos + %d forced tokens leave "
+                "no position to sample (max_length=%d)"
+                % (len(prefix) - 1, self._T))
+        return prefix
+
+    def admit(self, src, src_len=None, prefix_tokens=None):
         """Claim a free slot for one source sequence (``src``: [T] or
-        [1, T] int ids; ``src_len``: its true length, default T) and run
-        the admission program — encoder forward + scatter into the
-        slot's pool rows. Returns the slot id. Raises
-        :class:`NoFreeSlotError` when every slot is occupied (and, for
-        paged sessions, :class:`NoFreePageError` when the KV pool
-        cannot cover the first dispatch)."""
+        [1, T] int ids; ``src_len``: its true length, default T) and
+        run the admission program — encoder forward + scatter into the
+        slot's pool rows. ``prefix_tokens`` (paged sessions) forces a
+        decoder prefix: the slot starts sampling AFTER the forced
+        tokens, whose K/V is provisioned from the prefix cache where
+        possible and chunked-prefilled otherwise. Returns the slot id.
+        Raises :class:`NoFreeSlotError` when every slot is occupied
+        (and, for paged sessions, :class:`NoFreePageError` /
+        :class:`NoFreeGroupError` when the KV pool or group pool
+        cannot cover the admission)."""
+        if not self._paged:
+            if prefix_tokens is not None:
+                raise ValueError(
+                    "prefix_tokens needs paged=True — the dense layout "
+                    "has no prefill program")
+            return self._admit_dense(src, src_len)
+        return self.admit_group(src, n=1, src_len=src_len,
+                                prefix_tokens=prefix_tokens)[0]
+
+    def _admit_dense(self, src, src_len):
         if not self._free:
             raise NoFreeSlotError(
                 "all %d slots occupied; step() until one frees"
@@ -271,47 +473,196 @@ class SlotDecodeSession(object):
             "src_len": np.asarray([[length]], dtype="int64"),
             "slot_idx": np.asarray([slot], dtype="int64"),
         }
-        if self._paged:
-            worst = self._pages_for(self._T, self._ps)
-            if self._reserved_pages + worst > self._P - 1:
-                self._free.append(slot)
-                raise NoFreePageError(
-                    "KV pool cannot reserve %d pages for a new sequence "
-                    "(%d of %d already reserved); step() until a "
-                    "sequence completes"
-                    % (worst, self._reserved_pages, self._P - 1))
-            self._reserved_pages += worst
-            self._slot_pages[slot] = []
-            self._provision(slot, self._steps)
-            feed["page_row"] = self._page_row(self._slot_pages[slot])
         try:
             self._run(self._admit_prog, feed, [])
         except BaseException:
             # a failed admission dispatch (transient OOM, chaos fault,
-            # interrupt) must not leak the slot or its reservation —
-            # each leak would shrink the pool by one sequence forever
+            # interrupt) must not leak the slot
             self._free.append(slot)
-            if self._paged:
-                self._free_pages.extend(
-                    reversed(self._slot_pages.pop(slot)))
-                self._reserved_pages -= worst
             raise
         trg = np.full(self._T, self._eos, dtype="int64")
         trg[0] = self._bos
         self._live[slot] = {"trg": trg, "pos": 0}
         _sequences_total.inc(event="admitted")
         _active_slots.set(len(self._live))
-        if self._paged:
-            self._update_pool_gauges()
         return slot
+
+    def admit_group(self, src, n=1, src_len=None, prefix_tokens=None):
+        """Admit ``n`` sampled continuations of ONE source as a fork
+        group (paged sessions): one encoder forward, one group-pooled
+        set of cross-attention K/V rows shared by every member, and —
+        with a forced prefix — one chunked prefill whose pages every
+        member references until copy-on-write splits their tails.
+        Members are admitted into consecutively popped slots, so a
+        seeded sampled member is bit-identical to an unshared session
+        admitting the same members solo (same slot => same
+        ``(seed, slot, position)`` PRNG stream). Returns the member
+        slot ids in admission order. Any mid-admission failure rolls
+        the whole group back (table rows to the trash page FIRST, then
+        references, slots, group and reservations)."""
+        if not self._paged:
+            raise ValueError(
+                "admit_group needs paged=True — the dense layout has "
+                "no shareable KV state")
+        n = int(n)
+        if n < 1:
+            raise ValueError("admit_group needs n >= 1, got %d" % n)
+        if len(self._free) < n:
+            raise NoFreeSlotError(
+                "admit_group(n=%d): only %d of %d slots free; step() "
+                "until more free" % (n, len(self._free), self._S))
+        if not self._free_groups:
+            raise NoFreeGroupError(
+                "all %d cross-K/V groups occupied; step() until a "
+                "group's last member completes" % self._G)
+        src = np.asarray(src, dtype="int64").reshape(1, self._T)
+        length = self._T if src_len is None else int(np.ravel(src_len)[0])
+        prefix = self._full_prefix(prefix_tokens)
+        L = len(prefix)
+        worst = self._pages_for(self._T, self._ps)
+        capacity = self._P - 1 - self._leaked_pages
+        if self._reserved_pages + n * worst > capacity:
+            raise NoFreePageError(
+                "KV pool cannot reserve %d pages for %d new "
+                "sequence(s) (%d of %d already reserved); step() until "
+                "a sequence completes"
+                % (n * worst, n, self._reserved_pages, capacity))
+        self._reserved_pages += n * worst
+        gid = self._free_groups.pop()
+        slots = []
+        start_feed = {
+            "group_idx": np.asarray([gid], dtype="int64"),
+            "start_tok": np.asarray([[prefix[-1]]], dtype="int64"),
+            "start_pos": np.asarray([[L - 1]], dtype="int64"),
+        }
+        # decode-ahead coverage for the first dispatch: prefill writes
+        # positions [0, L-1), the first step() writes [L-1, L-1+steps)
+        cover = min(L - 1 + self._steps, self._T)
+        k_full = (L - 1) // self._ps  # prefix pages that end up FULL
+        try:
+            # -- member 0: encoder forward + (any) prefill ------------------
+            slot0 = self._free.pop()
+            slots.append(slot0)
+            cached = []
+            if self._prefix_cache is not None and L > 1:
+                cached = self._prefix_cache.lookup(
+                    self._src_fp(src, length), prefix)[:k_full]
+            pages = []
+            for pg in cached:
+                self._pool.ref(pg)
+                pages.append(pg)
+            self._slot_pages[slot0] = pages
+            self._slot_group[slot0] = gid
+            self._provision(slot0, cover)
+            feed = {
+                "src_word": src,
+                "src_len": np.asarray([[length]], dtype="int64"),
+                "slot_idx": np.asarray([slot0], dtype="int64"),
+                "page_row": self._page_row(pages),
+            }
+            feed.update(start_feed)
+            self._run(self._admit_prog, feed, [])
+            write_from = len(cached) * self._ps
+            if write_from:
+                self._prefix_cache.tokens_saved += write_from
+                _prefill_saved.inc(write_from)
+            if write_from < L - 1:
+                pw = np.full((1, self._T), self._eos, dtype="int64")
+                pw[0, :L] = prefix
+                self._run(self._prefill_prog, {
+                    "prefix_word": pw,
+                    "prefix_len": np.asarray([[L]], dtype="int64"),
+                    "write_from": np.asarray([[write_from]],
+                                             dtype="int64"),
+                    "slot_idx": np.asarray([slot0], dtype="int64"),
+                    "group_idx": np.asarray([gid], dtype="int64"),
+                }, [])
+            if (self._prefix_cache is not None
+                    and k_full > len(cached)):
+                # newly-full pages join the trie (one cache ref each);
+                # insert only after the prefill landed their bits
+                self._prefix_cache.insert(
+                    self._src_fp(src, length), prefix, pages[:k_full])
+            # -- members 1..n-1: fork by reference --------------------------
+            # shared: exactly the pages holding PREFIX content (full
+            # pages + the partial tail). Decode-ahead pages past the
+            # prefix are private per member — sharing an empty page
+            # would only buy a guaranteed COW copy.
+            shared = pages[:self._pages_for(max(L - 1, 0), self._ps)]
+            for _ in range(1, n):
+                s = self._free.pop()
+                slots.append(s)
+                mpages = []
+                for pg in shared:
+                    self._pool.ref(pg)
+                    mpages.append(pg)
+                self._slot_pages[s] = mpages
+                self._slot_group[s] = gid
+                self._provision(s, cover)
+                jfeed = {
+                    "slot_idx": np.asarray([s], dtype="int64"),
+                    "page_row": self._page_row(mpages),
+                }
+                jfeed.update(start_feed)
+                self._run(self._join_prog, jfeed, [])
+                if L > 1:
+                    _prefill_saved.inc(L - 1)
+        except BaseException:
+            self._rollback_admission(slots, gid, n)
+            raise
+        self._group_members[gid] = set(slots)
+        for s in slots:
+            trg = np.full(self._T, self._eos, dtype="int64")
+            trg[:L] = prefix
+            self._live[s] = {"trg": trg, "pos": L - 1}
+            _sequences_total.inc(event="admitted")
+        _active_slots.set(len(self._live))
+        self._update_pool_gauges()
+        return slots
+
+    def _rollback_admission(self, slots, gid, n):
+        """A failed admission dispatch must leave NO device table row
+        pointing at pages that return to the free list: repoint each
+        admitted slot's row at the trash page FIRST (the same order
+        ``_release_pages`` uses), THEN drop the page references — the
+        admit dispatch may have committed device-side before the host
+        raised (post-dispatch chaos fault, fetch failure), and a
+        recycled page receiving a stale row's writes is silent
+        corruption of whichever sequence owns it next. If even the
+        repoint dispatch fails, the pages are deliberately LEAKED
+        (kept allocated, never freed, and subtracted from the
+        reservation capacity so provisioning can still never fail):
+        a smaller pool is recoverable, corruption is not."""
+        for s in slots:
+            pages = self._slot_pages.pop(s, None)
+            self._slot_group.pop(s, None)
+            leak = False
+            if pages is not None:
+                try:
+                    self._write_table_row(s, [])
+                except BaseException:
+                    leak = True
+                if leak:
+                    self._leaked_pages += len(set(pages))
+                else:
+                    for pg in pages:
+                        self._pool.deref(pg)
+        # restore the free stack exactly (pop order == re-pop order, so
+        # a retried admission lands in the same slots => same PRNG
+        # streams)
+        for s in reversed(slots):
+            self._free.append(s)
+        self._free_groups.append(gid)
+        self._reserved_pages -= n * self._pages_for(self._T, self._ps)
+        self._update_pool_gauges()
 
     def step(self):
         """Advance every in-flight sequence through the step
         executable — one token (dense layout) or ``steps`` tokens (one
         on-device scan dispatch, paged layout) — and return
         ``{slot: [T] int64 tokens}`` for the sequences that finished
-        (their slots, and pages, are free again). No-op ({}) when
-        nothing is in flight."""
+        (their slots, and page references, are free again). No-op ({})
+        when nothing is in flight."""
         if not self._live:
             return {}
         return self._step_paged() if self._paged else self._step_dense()
@@ -342,9 +693,15 @@ class SlotDecodeSession(object):
     def _step_paged(self):
         # pre-provision every live slot for the whole dispatch: step j
         # writes K/V at position pos + j, so the table must cover
-        # pos + steps resident tokens before the scan launches
+        # pos + steps resident tokens before the scan launches — and
+        # any page the dispatch will WRITE that is still shared must be
+        # copy-on-write split first (shared pages are read-only)
         for slot, st in self._live.items():
-            if self._provision(slot, st["pos"] + self._steps):
+            grew = self._provision(slot, st["pos"] + self._steps)
+            copies = self._cow_copies(slot, st["pos"])
+            if copies:
+                self._dispatch_cow(slot, copies)  # repoints the row too
+            elif grew:
                 self._write_table_row(slot, self._slot_pages[slot])
         self._update_pool_gauges()
         t0 = time.perf_counter()
@@ -391,26 +748,51 @@ class SlotDecodeSession(object):
         slots free up, which exercises the continuous-batching path even
         for B > num_slots — and return the [B, T] token matrix
         (bos-led, eos-padded; greedy unless the session's sampler says
-        otherwise)."""
+        otherwise). Requests are served strictly in row order: a
+        deferred admission (pool/group reservations exhausted) goes
+        back to the FRONT of the pending queue."""
         src = np.asarray(src, dtype="int64")
         lengths = (np.full(len(src), self._T, dtype="int64")
                    if src_len is None
                    else np.ravel(np.asarray(src_len, dtype="int64")))
         out = np.full((len(src), self._T), self._eos, dtype="int64")
-        pending = list(range(len(src)))
+        # deque: popleft/appendleft are O(1) — a list's pop(0)/insert(0)
+        # made this loop O(B^2) over a large request batch
+        pending = deque(range(len(src)))
         owner = {}  # slot -> request index
         while pending or owner:
             while pending and self._free:
-                idx = pending.pop(0)
+                idx = pending.popleft()
                 try:
                     owner[self.admit(src[idx], lengths[idx])] = idx
-                except NoFreePageError:
+                except (NoFreePageError, NoFreeGroupError):
                     # pool reservations exhausted: defer this request
-                    # and let in-flight sequences release pages —
-                    # guaranteed progress, since the constructor
-                    # requires the pool to cover at least one sequence
-                    pending.insert(0, idx)
+                    # (back to the FRONT — admission order is the
+                    # service contract) and let in-flight sequences
+                    # release pages — guaranteed progress, since the
+                    # constructor requires the pool to cover at least
+                    # one sequence
+                    pending.appendleft(idx)
                     break
             for slot, tokens in self.step().items():
                 out[owner.pop(slot)] = tokens
+        return out
+
+    def generate_best_of(self, src, n, src_len=None, prefix_tokens=None):
+        """Best-of-N convenience over ``admit_group``: decode ``n``
+        continuations of ONE source ([T] or [1, T] ids) to completion
+        and return them as an [n, T] matrix in member order. Intended
+        for a dedicated session (it steps until the group drains;
+        other in-flight slots finishing meanwhile are returned to
+        nobody)."""
+        slots = self.admit_group(src, n=n, src_len=src_len,
+                                 prefix_tokens=prefix_tokens)
+        order = {s: i for i, s in enumerate(slots)}
+        out = np.full((int(n), self._T), self._eos, dtype="int64")
+        remaining = set(slots)
+        while remaining:
+            for slot, tokens in self.step().items():
+                if slot in remaining:
+                    out[order[slot]] = tokens
+                    remaining.discard(slot)
         return out

@@ -1,0 +1,277 @@
+"""Refcounted KV page pool + prefix cache: the host side of
+cross-request KV reuse.
+
+PR 11's free-list allocator gave every page exactly one owner, so the
+page-table indirection bought raggedness but never SHARING. This module
+is the allocator the indirection was built for (PAPERS.md "Ragged Paged
+Attention", arxiv 2604.15464: identical KV content stored once,
+referenced many times):
+
+* :class:`PagePool` — pages carry a REFCOUNT instead of an owner bit.
+  ``acquire()`` hands out a private page (refcount 1), ``ref()`` lets a
+  second slot point its table row at the same physical page, and
+  ``deref()`` frees only when the last reference drops. A page with
+  refcount > 1 is read-shared and MUST NOT be written: the session
+  copy-on-writes it (``paged_copy_page`` + a table-row repoint) before
+  a slot's write position enters it. Conservation is the allocator's
+  law: ``free_count + allocated_count == num_pages - 1`` at every
+  step (page 0 is the reserved trash page and never circulates),
+  pinned by the seeded property test in tests/test_kv_pool.py.
+* :class:`PrefixCache` — a host-side token trie keyed by
+  ``(source fingerprint, prefix tokens)`` mapping to refcounted FULL
+  pages. A forced decoder prefix (few-shot/system preamble) that was
+  prefilled once provisions later admissions by reference: the table
+  row points at the cached pages and only the uncached suffix runs
+  through the chunked-prefill program. Entries hold one pool reference
+  per page, so cached content survives the slots that wrote it;
+  ``reclaim()`` is the free-list pressure valve (LRU eviction until a
+  page actually frees), wired into ``PagePool.acquire`` by the
+  session, so cached pages never starve live admissions.
+
+The decode-side consumer is ``serving.generation.SlotDecodeSession``
+(``admit_group`` forks, COW, chunked prefill); ``docs/SERVING.md``
+"KV reuse" documents the lifecycle.
+"""
+
+from paddle_tpu.serving.server import ServingError
+
+__all__ = ["PagePool", "PrefixCache", "NoFreePageError",
+           "NoFreeGroupError"]
+
+
+class NoFreePageError(ServingError):
+    """The paged KV pool cannot RESERVE a new sequence's worst-case
+    pages (``num_pages`` sized below worst-case occupancy) — the
+    page-level admission reject; retry after a step() completes
+    sequences and releases their reservations. Raised only at
+    ``admit()``/``admit_group()`` (reservation-based admission
+    control): a sequence that was admitted can always be provisioned
+    mid-flight, so an oversubscribed pool degrades to fewer concurrent
+    slots, never to a wedged session. The reject is a clean rollback —
+    slot, group, page and reservation counts are exactly what they
+    were before the call."""
+
+
+class NoFreeGroupError(ServingError):
+    """Every cross-attention K/V group row is occupied (``num_groups``
+    sized below the concurrent-source worst case) — the group-level
+    admission reject; retry after a step() drains a group's last
+    member. Like :class:`NoFreePageError`, raised only at admission
+    with full rollback."""
+
+
+class PagePool(object):
+    """Refcounted allocator over pages ``1..num_pages-1`` (page 0 is
+    the caller's reserved trash page and never enters circulation).
+
+    The free list is LIFO (highest page first, matching the PR 11
+    allocator) so recycling behavior — and therefore every
+    bit-exactness test that depends on which physical page a sequence
+    lands in — is deterministic.
+    """
+
+    def __init__(self, num_pages):
+        self._P = int(num_pages)
+        if self._P < 2:
+            raise ValueError(
+                "PagePool needs num_pages >= 2 (page 0 is the trash "
+                "page), got %d" % self._P)
+        self._free = list(range(self._P - 1, 0, -1))
+        self._ref = {}  # page id -> refcount (> 0)
+
+    @property
+    def num_pages(self):
+        return self._P
+
+    @property
+    def free_count(self):
+        return len(self._free)
+
+    @property
+    def allocated_count(self):
+        """Distinct pages with at least one reference."""
+        return len(self._ref)
+
+    @property
+    def shared_count(self):
+        """Distinct pages with refcount > 1 — the ``kv_pages_shared``
+        gauge's source."""
+        return sum(1 for c in self._ref.values() if c > 1)
+
+    @property
+    def extra_refs(self):
+        """Sum of (refcount - 1): references that would each be a full
+        physical page copy without sharing — the dedup-bytes gauge's
+        page term."""
+        return sum(c - 1 for c in self._ref.values())
+
+    def refcount(self, page):
+        return self._ref.get(int(page), 0)
+
+    def acquire(self, reclaim=None):
+        """Allocate a private page (refcount 1). With the free list
+        empty, ``reclaim`` (the prefix cache's pressure valve) is given
+        one chance to evict; still empty raises
+        :class:`NoFreePageError` — which reservation-based admission
+        control guarantees never happens for an admitted sequence."""
+        if not self._free and reclaim is not None:
+            reclaim()
+        if not self._free:
+            raise NoFreePageError(
+                "KV page pool exhausted (%d pages, all referenced) — "
+                "admission reservations should have prevented this; "
+                "an unreserved caller must admit() first" % (self._P - 1))
+        page = self._free.pop()
+        self._ref[page] = 1
+        return page
+
+    def ref(self, page):
+        """Add a reference to an ALLOCATED page (share it)."""
+        page = int(page)
+        if page not in self._ref:
+            raise ValueError(
+                "PagePool.ref(%d): page is not allocated — only live "
+                "pages can be shared" % page)
+        self._ref[page] += 1
+
+    def deref(self, page):
+        """Drop one reference; the page returns to the free list only
+        at refcount 0. Returns the remaining refcount."""
+        page = int(page)
+        c = self._ref.get(page, 0)
+        if c <= 0:
+            raise ValueError(
+                "PagePool.deref(%d): page is not allocated (double "
+                "free?)" % page)
+        if c == 1:
+            del self._ref[page]
+            self._free.append(page)
+            return 0
+        self._ref[page] = c - 1
+        return c - 1
+
+
+class PrefixCache(object):
+    """Token trie from (source fingerprint, forced-prefix tokens) to
+    refcounted FULL KV pages.
+
+    Only fully-written pages are cached: page ``k`` holds positions
+    ``[k*page_size, (k+1)*page_size)`` and its content is a pure
+    function of the source (cross-attention flows into every decoder
+    layer past the first) and the first ``(k+1)*page_size`` forced
+    tokens — exactly the trie key. The partial tail page is never
+    cached: the admitted slot keeps writing into it. Cached pages are
+    immutable by the COW contract (any writer sees refcount > 1 and
+    copies first), so a hit is bit-identical to a cold prefill.
+
+    Keys are stored chain-flat: an entry per page depth
+    (``tokens[:page_size]``, ``tokens[:2*page_size]``, ...). Eviction
+    is LRU and chain-aware — evicting a page orphans every deeper
+    entry that extends it, so those are evicted with it (an orphaned
+    deeper page would hold a reference lookup() can never reach).
+    """
+
+    def __init__(self, pool, page_size, max_pages=64):
+        self._pool = pool
+        self._ps = int(page_size)
+        self._max = int(max_pages)
+        self._entries = {}  # (fp, tokens tuple) -> page id
+        self._lru = {}      # same keys -> last-use seq
+        self._seq = 0
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_saved = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    @property
+    def pages(self):
+        """Distinct pages the cache holds references on."""
+        return len(set(self._entries.values()))
+
+    @property
+    def hit_rate(self):
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def _touch(self, key):
+        self._seq += 1
+        self._lru[key] = self._seq
+
+    def lookup(self, fp, tokens):
+        """Longest cached run: the consecutive full pages covering
+        ``tokens[:r*page_size]``. Takes NO references (the caller refs
+        exactly what it provisions). Counts one lookup, and a hit when
+        at least one page matched."""
+        self.lookups += 1
+        pages = []
+        depth = self._ps
+        tokens = tuple(int(t) for t in tokens)
+        while depth <= len(tokens):
+            page = self._entries.get((fp, tokens[:depth]))
+            if page is None:
+                break
+            self._touch((fp, tokens[:depth]))
+            pages.append(page)
+            depth += self._ps
+        if pages:
+            self.hits += 1
+        return pages
+
+    def insert(self, fp, tokens, pages):
+        """Cache ``pages`` (``pages[k]`` = positions ``k*ps..(k+1)*ps-1``
+        of this prefix, all fully written), one pool reference per NEW
+        entry. Capacity pressure evicts LRU chains first; if the cache
+        cannot make room the remaining pages simply stay uncached.
+        A depth is only inserted while its PREDECESSOR depth is present
+        (lookup walks the chain shallow-to-deep, so a deeper entry
+        without its predecessor is unreachable and would pin a page
+        reference forever) — eviction during this very insert can take
+        the chain's own shallower entries, so the predecessor is
+        re-checked after making room."""
+        tokens = tuple(int(t) for t in tokens)
+        for k, page in enumerate(pages):
+            prev = (fp, tokens[:k * self._ps])
+            if k and prev not in self._entries:
+                return  # chain broken: deeper entries are unreachable
+            key = (fp, tokens[:(k + 1) * self._ps])
+            if key in self._entries:
+                self._touch(key)
+                continue
+            while len(self._entries) >= self._max:
+                if not self._evict_lru():
+                    return
+            if k and prev not in self._entries:
+                return  # eviction consumed this chain's own prefix
+            self._pool.ref(page)
+            self._entries[key] = page
+            self._touch(key)
+
+    def _evict_lru(self):
+        if not self._entries:
+            return False
+        key = min(self._lru, key=self._lru.get)
+        self._evict_chain(key)
+        return True
+
+    def _evict_chain(self, key):
+        fp, toks = key
+        doomed = [k for k in self._entries
+                  if k[0] == fp and len(k[1]) >= len(toks)
+                  and k[1][:len(toks)] == toks]
+        for k in doomed:
+            self._pool.deref(self._entries.pop(k))
+            self._lru.pop(k, None)
+
+    def reclaim(self):
+        """Free-list pressure valve (wired into ``PagePool.acquire``):
+        evict LRU chains until a page actually frees — an entry whose
+        page is still referenced by a live slot frees nothing, so
+        eviction continues past it — or the cache is empty."""
+        while self._entries and self._pool.free_count == 0:
+            self._evict_lru()
+
+    def clear(self):
+        """Drop every entry (and its page references)."""
+        while self._entries:
+            self._evict_lru()

@@ -1,0 +1,270 @@
+"""PagePool / PrefixCache unit + property tests (no device, no jax):
+the refcounted allocator is proved as a UNIT under seeded random
+admit/fork/release/prefix-hit drive — page conservation
+(free + unique allocated == P - 1) at every step, copy-on-write
+exclusivity (no page referenced by two sequences that both wrote past
+the fork point), and NoFreePageError rollback leaving every count
+unchanged. The device-level twin (real programs, real tokens) lives in
+tests/test_kv_reuse.py."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving.kv_pool import (
+    NoFreePageError,
+    PagePool,
+    PrefixCache,
+)
+
+PS = 4  # page size for the host model
+
+
+def test_acquire_ref_deref_conservation():
+    pool = PagePool(8)  # 7 allocatable
+    a = pool.acquire()
+    b = pool.acquire()
+    assert a != b and pool.free_count == 5 and pool.allocated_count == 2
+    pool.ref(a)
+    assert pool.refcount(a) == 2 and pool.shared_count == 1
+    assert pool.extra_refs == 1
+    assert pool.free_count + pool.allocated_count == 7  # sharing is free
+    assert pool.deref(a) == 1
+    assert pool.refcount(a) == 1 and pool.shared_count == 0
+    assert pool.deref(a) == 0 and pool.refcount(a) == 0
+    pool.deref(b)
+    assert pool.free_count == 7 and pool.allocated_count == 0
+
+
+def test_misuse_is_loud():
+    pool = PagePool(3)
+    with pytest.raises(ValueError):
+        pool.ref(1)  # not allocated
+    p = pool.acquire()
+    pool.deref(p)
+    with pytest.raises(ValueError):
+        pool.deref(p)  # double free
+    with pytest.raises(ValueError):
+        PagePool(1)  # no allocatable page beside trash
+    pool.acquire()
+    pool.acquire()
+    with pytest.raises(NoFreePageError):
+        pool.acquire()
+
+
+def test_acquire_reclaim_hook_evicts_cache():
+    pool = PagePool(3)
+    cache = PrefixCache(pool, PS, max_pages=4)
+    a = pool.acquire()
+    cache.insert("fp", (1, 2, 3, 4), [a])
+    pool.deref(a)  # only the cache holds it now
+    b = pool.acquire(cache.reclaim)  # free page exists: no eviction
+    assert len(cache) == 1
+    c = pool.acquire(cache.reclaim)  # pressure: cache page evicted
+    assert len(cache) == 0 and {b, c} == {a, 2} or {b, c} == {1, 2}
+    assert pool.free_count == 0 and pool.allocated_count == 2
+
+
+def test_prefix_cache_trie_and_chain_eviction():
+    pool = PagePool(16)
+    cache = PrefixCache(pool, PS, max_pages=8)
+    toks = (1, 5, 6, 7, 8, 9, 10, 11)  # two full pages at PS=4
+    p0, p1 = pool.acquire(), pool.acquire()
+    cache.insert("fp", toks, [p0, p1])
+    assert cache.lookup("fp", toks) == [p0, p1]
+    # a shorter prefix reuses only the chain it covers
+    assert cache.lookup("fp", toks[:6]) == [p0]
+    # a diverging prefix shares the first page, not the second
+    assert cache.lookup("fp", toks[:4] + (99, 99, 99, 99)) == [p0]
+    # another SOURCE shares nothing (prefix K/V depends on cross attn)
+    assert cache.lookup("fp2", toks) == []
+    # evicting the shallow entry evicts the orphaned deeper chain too
+    cache._evict_chain(("fp", toks[:4]))
+    assert len(cache) == 0
+    assert pool.refcount(p0) == 1 and pool.refcount(p1) == 1  # ours
+
+
+class _HostModel(object):
+    """Host-side mirror of SlotDecodeSession's allocator discipline:
+    sequences admit (reserve worst case), fork (reference a parent's
+    prefix pages), write (COW any shared page first), release (deref).
+    Tracks which sequences WROTE each page past their fork point so
+    the exclusivity law is checkable."""
+
+    def __init__(self, pool, npp):
+        self.pool = pool
+        self.npp = npp
+        self.seqs = {}  # sid -> {"pages": [...], "written": set(idx)}
+        self.reserved = 0
+        self.next = 0
+        self.writers = {}  # page -> set(sid) that wrote while owning
+
+    def admit(self, cached=()):
+        if self.reserved + self.npp > self.pool.num_pages - 1:
+            raise NoFreePageError("reservation")
+        self.reserved += self.npp
+        sid = self.next
+        self.next += 1
+        pages = []
+        for pg in cached:
+            self.pool.ref(pg)
+            pages.append(pg)
+        self.seqs[sid] = {"pages": pages, "written": set()}
+        return sid
+
+    def fork(self, parent, upto):
+        if self.reserved + self.npp > self.pool.num_pages - 1:
+            raise NoFreePageError("reservation")
+        self.reserved += self.npp
+        sid = self.next
+        self.next += 1
+        pages = []
+        for pg in self.seqs[parent]["pages"][:upto]:
+            self.pool.ref(pg)
+            pages.append(pg)
+        self.seqs[sid] = {"pages": pages, "written": set()}
+        return sid
+
+    def write(self, sid, idx):
+        st = self.seqs[sid]
+        while len(st["pages"]) <= idx:
+            if len(st["pages"]) >= self.npp:
+                return
+            st["pages"].append(self.pool.acquire())
+        pg = st["pages"][idx]
+        if self.pool.refcount(pg) > 1:  # COW
+            dst = self.pool.acquire()
+            st["pages"][idx] = dst
+            self.pool.deref(pg)
+            pg = dst
+        st["written"].add(pg)
+        self.writers.setdefault(pg, set()).add(sid)
+
+    def release(self, sid):
+        st = self.seqs.pop(sid)
+        for pg in st["pages"]:
+            if self.pool.deref(pg) == 0:
+                self.writers.pop(pg, None)
+        self.reserved -= self.npp
+
+    def check(self):
+        pool = self.pool
+        assert pool.free_count + pool.allocated_count == \
+            pool.num_pages - 1, "page conservation broken"
+        # refcount integrity: every reference is accounted for
+        refs = {}
+        for st in self.seqs.values():
+            for pg in st["pages"]:
+                refs[pg] = refs.get(pg, 0) + 1
+        for pg, c in refs.items():
+            assert pool.refcount(pg) >= c
+        # COW exclusivity: a page was never written by two sequences
+        # (each live writer owned it privately at write time)
+        for pg, sids in self.writers.items():
+            live = sids & set(self.seqs)
+            assert len(sids) <= 1 or len(live) <= 1, \
+                "page %d written by concurrent sequences %s" % (pg, sids)
+        # stronger: a LIVE slot never holds a written page another live
+        # slot also wrote
+        for sid, st in self.seqs.items():
+            for other, ot in self.seqs.items():
+                if other <= sid:
+                    continue
+                both = st["written"] & ot["written"]
+                assert not both, \
+                    "pages %s written past the fork by %d AND %d" \
+                    % (both, sid, other)
+
+
+def test_insert_never_creates_unreachable_chain_entries():
+    """A cache smaller than a prefix's full-page count must degrade to
+    caching the SHALLOW part of the chain, never a deeper entry whose
+    predecessor was evicted (lookup could never reach it, so its page
+    reference would be pinned forever)."""
+    pool = PagePool(16)
+    cache = PrefixCache(pool, PS, max_pages=2)
+    toks = tuple(range(1, 13))  # three full pages at PS=4
+    pages = [pool.acquire() for _ in range(3)]
+    cache.insert("fp", toks, pages)
+    # every surviving entry's predecessor chain is intact...
+    for fp, t in list(cache._entries):
+        depth = len(t)
+        while depth > PS:
+            depth -= PS
+            assert (fp, t[:depth]) in cache._entries, \
+                "unreachable entry (%s, depth %d)" % (fp, len(t))
+    # ...and whatever was kept is actually reachable through lookup
+    assert cache.lookup("fp", toks) == [
+        cache._entries[k] for k in sorted(cache._entries,
+                                          key=lambda k: len(k[1]))]
+    # reference accounting: only reachable entries hold refs
+    held = set(cache._entries.values())
+    for pg in pages:
+        assert pool.refcount(pg) == (2 if pg in held else 1)
+
+
+def test_property_random_admit_fork_release_prefix():
+    """Seeded random drive: 400 ops over a small pool + cache; the
+    conservation/exclusivity/rollback laws hold after every op."""
+    rng = np.random.RandomState(1234)
+    pool = PagePool(12)  # 11 allocatable
+    npp = 3
+    cache = PrefixCache(pool, PS, max_pages=4)
+    model = _HostModel(pool, npp)
+    cached_keys = []  # (fp, tokens) inserted so far
+    for opno in range(400):
+        op = rng.randint(5)
+        live = sorted(model.seqs)
+        try:
+            if op == 0:  # admit, maybe through a prefix-cache hit
+                pages = []
+                if cached_keys and rng.rand() < 0.5:
+                    fp, toks = cached_keys[rng.randint(len(cached_keys))]
+                    pages = cache.lookup(fp, toks)
+                model.admit(pages)
+            elif op == 1 and live:  # fork a live sequence
+                parent = live[rng.randint(len(live))]
+                upto = rng.randint(npp + 1)
+                model.fork(parent, upto)
+            elif op == 2 and live:  # write (forces COW on shared)
+                sid = live[rng.randint(len(live))]
+                model.write(sid, rng.randint(npp))
+            elif op == 3 and live:  # release
+                model.release(live[rng.randint(len(live))])
+            elif op == 4 and live:  # cache a full page of a live seq
+                sid = live[rng.randint(len(live))]
+                st = model.seqs[sid]
+                if st["pages"]:
+                    fp = "fp%d" % rng.randint(3)
+                    toks = tuple(rng.randint(2, 20, PS))
+                    cache.insert(fp, toks, st["pages"][:1])
+                    cached_keys.append((fp, toks))
+        except NoFreePageError:
+            # the reject IS the property: counts must be unchanged by a
+            # failed admission (checked below like every other op)
+            pass
+        model.check()
+    # drain: release everything, clear the cache -> full free list
+    for sid in sorted(model.seqs):
+        model.release(sid)
+    cache.clear()
+    assert pool.free_count == pool.num_pages - 1
+    assert pool.allocated_count == 0 and pool.extra_refs == 0
+
+
+def test_reservation_rollback_leaves_counts_unchanged():
+    pool = PagePool(7)  # 6 allocatable, npp=3 -> two sequences max
+    model = _HostModel(pool, 3)
+    a = model.admit()
+    model.write(a, 0)
+    b = model.admit()
+    free, alloc, reserved = (pool.free_count, pool.allocated_count,
+                             model.reserved)
+    with pytest.raises(NoFreePageError):
+        model.admit()
+    assert (pool.free_count, pool.allocated_count, model.reserved) == \
+        (free, alloc, reserved)
+    model.release(a)
+    model.release(b)
+    c = model.admit()  # and the pool serves again after release
+    model.write(c, 2)
+    model.check()

@@ -21,6 +21,17 @@ Asserted, in order:
   * **Page hygiene.** After the pool drains, every page is back on the
     free list and the ``paddle_tpu_serving_kv_pages_in_use`` gauge
     reads 0.
+  * **Cross-request reuse churn (PR 12).** Best-of-N fork groups over
+    a forced prefix (admit_group -> one encoder + one chunked prefill
+    + joins; the top-k sampler forces member divergence, so the
+    shared tail page copy-on-writes), release, re-admission of the
+    SAME prefix through the prefix cache (a hit that must decode
+    bit-identical to its own cold wave replay at the same slots), and
+    a different source (a forced miss) — all after a warmup wave that
+    compiled admit/join/prefill/copy/table/step once, adding ZERO
+    fresh compiles. At drain the REFCOUNTS conserve: allocated pages
+    == cache-held pages, no page is shared, and clearing the cache
+    returns the free list to full.
 
 The capture (``$DIR/decode.json``) is bench.py's decode A/B leg — the
 SAME code path the BENCH trajectory tracks — and the CI ``decode``
@@ -109,11 +120,91 @@ def churn_invariants():
           "4 slots, tokens == dense oracle, pool drained clean")
 
 
+def bestofn_prefix_churn():
+    """Fork/prefix reuse under churn: groups, divergence (COW), release
+    and prefix re-admission keep the zero-recompile contract and the
+    allocator's conservation law."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import exec_cache
+    from paddle_tpu.models import transformer
+    from paddle_tpu.observability import REGISTRY
+    from paddle_tpu.serving.generation import Sampler, SlotDecodeSession
+
+    vocab, seq, dm, S = 40, 16, 32, 4
+    cfg = dict(src_vocab_size=vocab, trg_vocab_size=vocab, n_layer=1,
+               n_head=2, d_inner=64)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = 23
+    startup.random_seed = 23
+    with fluid.program_guard(main_prog, startup):
+        transformer.build(dropout=0.0, label_smooth_eps=0.0,
+                          max_length=seq, d_model=dm, **cfg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(29)
+    srcs = rng.randint(3, vocab, (3, seq)).astype("int64")
+    # 7 forced tokens + bos: the first sampled write lands INSIDE the
+    # shared tail page (7 % 4 != 0), so the fork's copy-on-write path
+    # actually fires and its executable is part of the warmed set
+    pfx = [[int(t) for t in row[:7]] for row in srcs]
+    sess = SlotDecodeSession(
+        exe, num_slots=S, max_length=seq, d_model=dm, paged=True,
+        page_size=4, steps=2, num_groups=2, prefix_cache_pages=8,
+        sampler=Sampler(strategy="top_k", top_k=4, temperature=0.9,
+                        seed=3),
+        **cfg)
+
+    def wave(i, n=3):
+        return sess.generate_best_of(srcs[i], n, src_len=seq,
+                                     prefix_tokens=pfx[i])
+
+    # warmup wave: compiles init/admit/join/prefill/copy/table/step
+    warm = wave(0)
+    assert not (np.array_equal(warm[0], warm[1])
+                and np.array_equal(warm[1], warm[2])), \
+        "sampled fork members never diverged — COW untested"
+
+    before_stats = exec_cache.stats()["fresh_compiles"]
+    before_scrape = _scrape_fresh_compiles()
+    hits0 = sess.prefix_cache_stats()["hits"]
+    wave(0)           # prefix HIT + fork + COW
+    wave(1)           # different source: forced MISS + insert
+    wave(1)           # ... and its hit
+    wave(2, n=2)      # third source through the recycled group/pages
+    wave(0)           # original prefix still cached
+    assert exec_cache.stats()["fresh_compiles"] == before_stats, (
+        "best-of-N / prefix churn paid %d fresh compiles"
+        % (exec_cache.stats()["fresh_compiles"] - before_stats))
+    after_scrape = _scrape_fresh_compiles()
+    if before_scrape is not None:
+        assert after_scrape == before_scrape, \
+            "metrics scrape shows fresh compiles during reuse churn"
+    st = sess.prefix_cache_stats()
+    assert st["hits"] >= hits0 + 3 and st["tokens_saved"] > 0, st
+
+    # refcount conservation at drain: every live reference released,
+    # only the cache still holds pages; clearing it empties the pool
+    assert sess.free_slots == S and sess.free_groups == 2
+    assert sess.shared_pages == 0
+    assert sess.pages_in_use == sess.cached_pages > 0
+    sess.clear_prefix_cache()
+    assert sess.pages_in_use == 0 and sess.free_pages == sess._P - 1
+    text = REGISTRY.to_prometheus()
+    assert "paddle_tpu_serving_kv_pages_shared 0" in text
+    assert "paddle_tpu_serving_prefix_hit_rate" in text
+    assert "paddle_tpu_serving_prefill_tokens_saved_total" in text
+    print("decode_smoke: reuse churn OK — 0 fresh compiles across "
+          "fork/COW/prefix-hit/release waves, hit rate %.2f, %d "
+          "prefill tokens saved, refcounts conserved at drain"
+          % (st["hit_rate"], st["tokens_saved"]))
+
+
 def main():
     if len(sys.argv) != 2:
         sys.exit("usage: decode_smoke.py OUTPUT_DIR")
     out_dir = sys.argv[1]
     churn_invariants()
+    bestofn_prefix_churn()
 
     # the capture comes from bench.py's decode worker in its OWN
     # process — the same leg (and the same compile-count accounting)

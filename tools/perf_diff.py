@@ -58,6 +58,13 @@ METRICS = {
     # latency gate the raggedness win itself
     "paged_speedup": ("higher", "timing"),
     "token_latency_ms": ("lower", "timing"),
+    # cross-request KV reuse (PR 12): shared-vs-unshared best-of-N
+    # ratio, prefix-cache effectiveness, and the grouped cross-K/V
+    # pool footprint (a pure function of [G, H, T, dh] x layers —
+    # deterministic: growth means cross state scales with slots again)
+    "bestofn_speedup": ("higher", "timing"),
+    "prefix_hit_rate": ("higher", "timing"),
+    "cross_kv_bytes": ("lower", "deterministic"),
 }
 
 
@@ -79,6 +86,9 @@ def _bench_model_metrics(m):
     out["paged_speedup"] = m.get("paged_speedup")
     out["token_latency_ms"] = m.get("token_latency_ms")
     out["predicted_hbm_bytes"] = m.get("predicted_hbm_bytes")
+    out["bestofn_speedup"] = m.get("bestofn_speedup")
+    out["prefix_hit_rate"] = m.get("prefix_hit_rate")
+    out["cross_kv_bytes"] = m.get("cross_kv_bytes")
     ec = m.get("exec_cache") or {}
     out["fresh_compiles"] = ec.get("fresh_compiles",
                                    m.get("fresh_compiles"))

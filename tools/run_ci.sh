@@ -172,10 +172,14 @@ if want decode; then
   # one process: churny admit/release/step over the paged slot session
   # must add ZERO fresh compiles after warmup (metrics-registry scrape +
   # exec-cache counters), decode tokens must equal the dense oracle's,
-  # and the drained pool must return every KV page; then the bench
+  # and the drained pool must return every KV page; a second leg churns
+  # the CROSS-REQUEST reuse paths (best-of-N fork groups + forced
+  # divergence/COW + prefix-cache hits + release/re-admit) asserting 0
+  # fresh compiles and refcount conservation at drain; then the bench
   # decode worker lands an A/B capture (paged vs dense tokens/sec at
-  # mixed lengths / low occupancy) that perf_diff gates against the
-  # committed decode budgets (speedup, latency, grid-accounted HBM)
+  # mixed lengths / low occupancy, plus the shared-vs-unshared
+  # best-of-N ratio, prefix hit rate and grouped cross-K/V bytes) that
+  # perf_diff gates against the committed decode budgets
   dcdir="$(mktemp -d)"
   trap 'rm -rf "$dcdir"' EXIT
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu FLAGS_telemetry=1 \
