@@ -41,6 +41,56 @@ import jax.numpy as jnp
 # two kernels' impl routing can never diverge.
 from paddle_tpu.kernels.flash_attention import _is_tpu_target
 
+# graceful kernel degradation: a Pallas compile/trace failure trips a
+# ONCE-per-process fallback to the composed reference path instead of
+# killing the request — a serving fleet on a rig with a broken Pallas
+# toolchain degrades to slower attention, not to an outage. The trip is
+# loud (warning log + counter + black-box note) so operators see the
+# perf cliff for what it is.
+_FALLBACK = {"tripped": False}
+
+
+def kernel_fallback_tripped():
+    """True once this process abandoned the Pallas paged kernel."""
+    return _FALLBACK["tripped"]
+
+
+def reset_kernel_fallback():
+    """Re-arm the Pallas path (tests; a production process stays
+    degraded until restart — the failure is deterministic per build)."""
+    _FALLBACK["tripped"] = False
+
+
+def _trip_kernel_fallback(exc):
+    if _FALLBACK["tripped"]:
+        return
+    _FALLBACK["tripped"] = True
+    import logging
+
+    logging.getLogger("paddle_tpu.kernels.paged_attention").warning(
+        "Pallas paged_attention kernel failed (%s: %s); falling back to "
+        "the FLAGS_paged_attention=reference path for the rest of this "
+        "process — decode keeps serving, slower",
+        type(exc).__name__, exc)
+    try:
+        from paddle_tpu.observability.metrics_registry import REGISTRY
+
+        REGISTRY.counter(
+            "paddle_tpu_kernel_fallbacks_total",
+            "Pallas kernels abandoned for their reference path this "
+            "process (once per kernel)", labels=("kernel",)
+        ).inc(kernel="paged_attention")
+        from paddle_tpu.observability import blackbox
+
+        if blackbox.ENABLED:
+            blackbox.record(
+                "kernel_fallback", kernel="paged_attention",
+                exc_type=type(exc).__name__,
+                exc_message=str(exc)[:500])
+    except Exception:
+        pass  # degradation bookkeeping must never mask the serve path
+
+
 _NEG_INF = -1e30
 # a slot whose running max never rose above this saw no visible key
 # (length 0): its output is zeroed, matching flash_attention's
@@ -195,11 +245,20 @@ def paged_attention(q, k_pool, v_pool, page_table, lengths, sm_scale=None,
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     use_pallas = force_pallas or (not force_reference and _is_tpu_target())
-    if not use_pallas:
+    if not use_pallas or _FALLBACK["tripped"]:
         return paged_attention_reference(
             q, k_pool, v_pool, page_table, lengths, sm_scale=sm_scale)
-    return _paged_pallas(q, k_pool, v_pool, page_table, lengths, sm_scale,
-                         interpret=not _is_tpu_target())
+    try:
+        return _paged_pallas(q, k_pool, v_pool, page_table, lengths,
+                             sm_scale, interpret=not _is_tpu_target())
+    except Exception as exc:  # noqa: BLE001 - degraded, not dead
+        # Pallas failed at trace/compile time (broken toolchain, an
+        # unsupported shape on this backend): degrade ONCE for the
+        # whole process and serve the request through the composed
+        # reference path — same bits, more HBM traffic
+        _trip_kernel_fallback(exc)
+        return paged_attention_reference(
+            q, k_pool, v_pool, page_table, lengths, sm_scale=sm_scale)
 
 
 def paged_kv_write(k_pool, v_pool, k_new, v_new, page_table, positions):

@@ -37,10 +37,20 @@ Sites instrumented today: ``session.step`` (kill-point at the top of every
 manifest/rename — a kill here leaves a temp dir a restart must ignore),
 ``exec.compile`` (fresh-compile path), ``exec.dispatch`` (executor step
 dispatch), ``master.call`` (MasterClient RPC), ``aot.read`` (persistent
-exec-cache image load), and the fleet coordinator RPCs as
+exec-cache image load), the fleet coordinator RPCs as
 ``fleet.<method>`` — ``fleet.heartbeat`` and ``fleet.register`` are the
 documented churn-injection points (a seeded fault at either exercises
-the eviction/rejoin path the elastic runtime recovers through).
+the eviction/rejoin path the elastic runtime recovers through) — and
+the serving sites: ``serve.dispatch`` (the BatchingServer batch
+dispatch AND the decode session's step dispatch, which passes
+``step=steps_done`` so ``kill@site=serve.dispatch,step=N`` SIGKILLs a
+decoding process deterministically — the servechaos CI leg),
+``serve.admit`` (inside a slot admission, after slots/pages are claimed
+and before the dispatch — a fault here must roll the whole group back
+and, under retry, re-admit bit-identically), ``pool.acquire`` (the KV
+page allocator), and ``snapshot.write`` (between a decode snapshot's
+var files, beside the inherited ``ckpt.write`` — a kill mid-snapshot
+must be invisible to the next restore).
 
 Determinism: each clause owns a ``random.Random`` seeded by
 ``(seed, clause index)``, advanced once per visit to its site — a fixed

@@ -28,14 +28,30 @@ bounded set of warm executables. This package is that layer:
   ``tools/serve_smoke.py`` (CI ``serve`` stage) and bench.py's serving
   leg, so the gated numbers and the smoke-tested behavior come from
   one code path.
+* ``snapshot.DecodeSnapshotManager`` — preemption-safe decode:
+  atomic, digest-verified snapshot/restore of a live
+  ``SlotDecodeSession`` (live KV pages gathered through the page
+  table, allocator/prefix-trie/pending-queue state, SIGTERM ->
+  finish dispatch -> final snapshot -> die by the signal); a restored
+  process's tokens are bit-identical to the uninterrupted run's.
+* ``degradation.HealthMonitor`` — the healthy -> brownout -> shed
+  state machine both the server (queue depth) and the decode session
+  (page occupancy) shed load through; refusals are typed retriable
+  ``DegradedError``\\ s with retry-after hints, never wedged callers.
 
 ``docs/SERVING.md`` ("Batching server") is the operator's guide.
 """
 
+from paddle_tpu.serving import degradation  # noqa: F401
 from paddle_tpu.serving import generation  # noqa: F401
 from paddle_tpu.serving import kv_pool  # noqa: F401
 from paddle_tpu.serving import loadgen  # noqa: F401
 from paddle_tpu.serving import server  # noqa: F401
+from paddle_tpu.serving import snapshot  # noqa: F401
+from paddle_tpu.serving.degradation import (  # noqa: F401
+    DegradedError,
+    HealthMonitor,
+)
 from paddle_tpu.serving.generation import (  # noqa: F401
     NoFreeGroupError,
     NoFreePageError,
@@ -55,4 +71,8 @@ from paddle_tpu.serving.server import (  # noqa: F401
     ServingError,
     ServingFuture,
     WaitTimeoutError,
+)
+from paddle_tpu.serving.snapshot import (  # noqa: F401
+    DecodeSnapshotManager,
+    SnapshotMismatchError,
 )

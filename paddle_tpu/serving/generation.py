@@ -56,6 +56,8 @@ from collections import deque
 import numpy as np
 
 from paddle_tpu.observability.metrics_registry import REGISTRY as _REGISTRY
+from paddle_tpu.resilience import chaos as _chaos
+from paddle_tpu.resilience import retry as _retry
 from paddle_tpu.serving.kv_pool import (
     NoFreeGroupError,
     NoFreePageError,
@@ -166,7 +168,8 @@ class SlotDecodeSession(object):
     def __init__(self, exe, num_slots, max_length=64, d_model=128,
                  bos_id=1, eos_id=2, scope=None, paged=False,
                  page_size=8, num_pages=None, num_groups=None, steps=1,
-                 sampler=None, prefix_cache_pages=0, **decoder_cfg):
+                 sampler=None, prefix_cache_pages=0, degradation=None,
+                 **decoder_cfg):
         from paddle_tpu.models import transformer
 
         self._transformer = transformer
@@ -232,6 +235,11 @@ class SlotDecodeSession(object):
             # are not reclaimable, so they shrink the capacity bound.
             self._reserved_pages = 0
             self._leaked_pages = 0
+            # which pages the leak count abandoned (refcounts held but
+            # no slot/trie holder): the decode snapshot records them so
+            # offline refcount verification (ckpt_inspect --verify) can
+            # tell a by-design leak from a torn snapshot
+            self._leaked_page_ids = set()
         else:
             if steps != 1:
                 raise ValueError(
@@ -249,10 +257,95 @@ class SlotDecodeSession(object):
             self._run(self._init_prog, {}, [])
         self._free = list(range(self._S - 1, -1, -1))
         self._live = {}  # slot -> {"trg": [T] int64, "pos": int}
+        # session-level request queue: generate() drains it, snapshot
+        # captures it — a preempted process restores WITH its backlog
+        self._pending = deque()  # {"id", "src" [1,T], "len", "prefix"}
+        self._owner = {}         # slot -> request id
+        self._results = {}       # request id -> [T] tokens, until taken
+        self._next_req = 0
+        self.steps_done = 0      # step() dispatches completed (chaos key)
+        # preemption plumbing: public ops run inside a dispatch window;
+        # serving/snapshot.py's manager defers a SIGTERM snapshot until
+        # the window closes (host mirrors and device state consistent)
+        self._dispatch_depth = 0
+        self._after_dispatch = None
+        # graceful degradation (serving/degradation.py), opt-in: None
+        # keeps the hard typed rejects (NoFreeSlot/NoFreePage) as the
+        # only admission control, exactly the pre-PR-13 behavior
+        if degradation is not None:
+            from paddle_tpu.serving.degradation import HealthMonitor
+
+            cfg = dict(degradation) if isinstance(degradation, dict) \
+                else {}
+            cfg.setdefault("on_transition", self._on_health_transition)
+            self._monitor = HealthMonitor("decode", **cfg)
+        else:
+            self._monitor = None
 
     def _run(self, prog, feed, fetch_list):
         return self._exe.run(prog, feed=feed, fetch_list=fetch_list,
                              scope=self._scope)
+
+    # -- preemption / degradation plumbing ----------------------------------
+    def _begin_op(self):
+        self._dispatch_depth += 1
+
+    def _end_op(self):
+        self._dispatch_depth -= 1
+        if self._dispatch_depth == 0 and self._after_dispatch is not None:
+            # the quiesce point: the snapshot manager banks a final
+            # snapshot / runs a periodic one here, never mid-dispatch
+            self._after_dispatch()
+
+    @property
+    def in_dispatch(self):
+        """True while a public op (admit/step) is mutating state — the
+        window a preemption snapshot must NOT land inside."""
+        return self._dispatch_depth > 0
+
+    def _health_load(self):
+        """Load fraction the degradation monitor keys on: page
+        occupancy (reservations over the leak-shrunk capacity) and slot
+        occupancy, whichever is tighter."""
+        slot_load = len(self._live) / float(self._S)
+        if not self._paged:
+            return slot_load
+        cap = max(1, self._P - 1 - self._leaked_pages)
+        return max(slot_load, self._reserved_pages / float(cap))
+
+    def _on_health_transition(self, frm, to):
+        from paddle_tpu.serving.degradation import BROWNOUT, HEALTHY
+
+        if frm == HEALTHY and to == BROWNOUT:
+            # brownout's first act: give cached-but-idle pages back to
+            # the free list so live admissions stop competing with the
+            # prefix cache for capacity
+            self.clear_prefix_cache()
+
+    def _gate_admission(self, n):
+        """Degradation gate, BEFORE any slot/page/queue mutation (a
+        degraded reject is never a partial admission) and OUTSIDE the
+        classified-retry wrap (a shed session must answer the caller
+        immediately with the retry-after hint, not burn the in-process
+        retry budget sleeping on itself)."""
+        if self._monitor is None:
+            return
+        from paddle_tpu.serving.degradation import BROWNOUT, SHED
+
+        state = self._monitor.observe(self._health_load())
+        if state == SHED:
+            raise self._monitor.reject("admission (draining in-flight)")
+        if state == BROWNOUT and n > 1:
+            raise self._monitor.reject(
+                "fork admission (n=%d) — brownout serves n=1 only" % n)
+
+    @property
+    def health(self):
+        """Degradation state ('healthy' when the monitor is off)."""
+        from paddle_tpu.serving.degradation import HEALTHY
+
+        return self._monitor.state if self._monitor is not None \
+            else HEALTHY
 
     # -- paged pool management ----------------------------------------------
     def _page_row(self, pages):
@@ -324,6 +417,7 @@ class SlotDecodeSession(object):
             except BaseException:
                 pages[pages.index(dst_pg)] = src_pg
                 self._leaked_pages += 1  # dst_pg stays allocated forever
+                self._leaked_page_ids.add(dst_pg)
                 raise
             self._pool.deref(src_pg)
 
@@ -461,6 +555,16 @@ class SlotDecodeSession(object):
                                 prefix_tokens=prefix_tokens)[0]
 
     def _admit_dense(self, src, src_len):
+        self._gate_admission(1)
+        self._begin_op()
+        try:
+            return _retry.call(
+                lambda: self._admit_dense_attempt(src, src_len),
+                origin="serve.admit")
+        finally:
+            self._end_op()
+
+    def _admit_dense_attempt(self, src, src_len):
         if not self._free:
             raise NoFreeSlotError(
                 "all %d slots occupied; step() until one frees"
@@ -474,10 +578,14 @@ class SlotDecodeSession(object):
             "slot_idx": np.asarray([slot], dtype="int64"),
         }
         try:
+            if _chaos.ENABLED:
+                _chaos.fault("serve.admit")
             self._run(self._admit_prog, feed, [])
         except BaseException:
             # a failed admission dispatch (transient OOM, chaos fault,
-            # interrupt) must not leak the slot
+            # interrupt) must not leak the slot — and the restored pop
+            # order means a classified retry re-admits into the SAME
+            # slot, keeping (seed, slot, position) PRNG streams intact
             self._free.append(slot)
             raise
         trg = np.full(self._T, self._eos, dtype="int64")
@@ -507,6 +615,23 @@ class SlotDecodeSession(object):
         n = int(n)
         if n < 1:
             raise ValueError("admit_group needs n >= 1, got %d" % n)
+        self._gate_admission(n)
+        self._begin_op()
+        try:
+            # classified retry around the whole admission attempt: a
+            # transient fault mid-admission rolls the group back (free
+            # stacks restored in pop order), so the retried attempt
+            # lands in the SAME slots/pages — bit-exact with a run that
+            # never saw the fault. Typed rejects (NoFreeSlot/NoFreePage/
+            # NoFreeGroup) are not transient and surface immediately.
+            return _retry.call(
+                lambda: self._admit_group_attempt(
+                    src, n, src_len, prefix_tokens),
+                origin="serve.admit")
+        finally:
+            self._end_op()
+
+    def _admit_group_attempt(self, src, n, src_len, prefix_tokens):
         if len(self._free) < n:
             raise NoFreeSlotError(
                 "admit_group(n=%d): only %d of %d slots free; step() "
@@ -561,6 +686,12 @@ class SlotDecodeSession(object):
                 "page_row": self._page_row(pages),
             }
             feed.update(start_feed)
+            if _chaos.ENABLED:
+                # the serve.admit kill/fault point: slots popped, pages
+                # provisioned, nothing dispatched — a fault here MUST
+                # roll the whole group back (repoint-then-deref) and,
+                # under classified retry, re-admit bit-identically
+                _chaos.fault("serve.admit")
             self._run(self._admit_prog, feed, [])
             write_from = len(cached) * self._ps
             if write_from:
@@ -644,6 +775,7 @@ class SlotDecodeSession(object):
                     leak = True
                 if leak:
                     self._leaked_pages += len(set(pages))
+                    self._leaked_page_ids.update(pages)
                 else:
                     for pg in pages:
                         self._pool.deref(pg)
@@ -665,7 +797,22 @@ class SlotDecodeSession(object):
         when nothing is in flight."""
         if not self._live:
             return {}
-        return self._step_paged() if self._paged else self._step_dense()
+        self._begin_op()
+        try:
+            if _chaos.ENABLED:
+                # the decode-side serving dispatch site: kill@step=N
+                # SIGKILLs entering the Nth step dispatch (the
+                # servechaos CI leg), io/compile faults exercise the
+                # classified-retry shell the executor dispatch wears
+                _chaos.fault("serve.dispatch", step=self.steps_done)
+            out = (self._step_paged() if self._paged
+                   else self._step_dense())
+            self.steps_done += 1
+        finally:
+            self._end_op()
+        if self._monitor is not None:
+            self._monitor.observe(self._health_load())
+        return out
 
     def _step_dense(self):
         cur = np.full((self._S, 1), self._eos, dtype="int64")
@@ -742,40 +889,122 @@ class SlotDecodeSession(object):
         _active_slots.set(len(self._live))
         return finished
 
+    # -- request queue -------------------------------------------------------
+    @property
+    def pending_requests(self):
+        """Queued request ids not yet admitted (the backlog a snapshot
+        preserves)."""
+        return [r["id"] for r in self._pending]
+
+    def enqueue(self, src, src_len=None, prefix_tokens=None):
+        """Queue one request ([T] or [1, T] int ids) without admitting
+        it; :meth:`pump` admits queued requests as capacity frees.
+        Returns a request id (monotonic per session — a restored
+        session continues the numbering, so ids name the same requests
+        across a preemption). The queue is part of the decode snapshot:
+        a preempted process restores with its backlog intact."""
+        rid = self._next_req
+        self._next_req += 1
+        src = np.asarray(src, dtype="int64").reshape(1, self._T)
+        length = self._T if src_len is None else int(np.ravel(src_len)[0])
+        self._pending.append({
+            "id": rid, "src": src, "len": length,
+            "prefix": (None if prefix_tokens is None
+                       else [int(t) for t in prefix_tokens]),
+        })
+        return rid
+
+    def pump(self):
+        """One scheduler round: admit queued requests in order while
+        capacity allows (a pool/group reservation reject — or a
+        degradation reject, when the monitor is armed — defers the
+        request back to the FRONT; admission order is the service
+        contract), then one :meth:`step`. Returns ``{request_id: [T]
+        tokens}`` for requests that finished this round; every finished
+        result is ALSO banked until :meth:`take_result` claims it, so
+        concurrent consumers (a ``generate()`` call draining the pool
+        for its own rows while other requests ride along) never lose a
+        request another consumer's pump happened to complete. Slots
+        finished that no queued request owns are dropped
+        (``generate_best_of``'s documented behavior). An IDLE session
+        (nothing queued, nothing live) returns ``{}`` immediately — a
+        caller looping "until request X finishes" should guard on
+        ``pending_requests`` / ``active_slots``, or it will spin."""
+        from paddle_tpu.serving.degradation import DegradedError
+
+        while self._pending and self._free:
+            # the pop -> admit -> owner-record sequence is ONE dispatch
+            # window: a quiesce-point snapshot (or deferred SIGTERM)
+            # firing inside admit's own window would otherwise see the
+            # request in neither _pending nor _owner — a request lost
+            # across the restore
+            self._begin_op()
+            deferred = False
+            try:
+                req = self._pending.popleft()
+                try:
+                    slot = self.admit(req["src"], req["len"],
+                                      prefix_tokens=req["prefix"])
+                except (NoFreePageError, NoFreeGroupError,
+                        DegradedError):
+                    # capacity/degradation reject: defer and let
+                    # in-flight sequences drain — guaranteed progress,
+                    # since the constructor requires the pool to cover
+                    # one sequence and a shed monitor relaxes as the
+                    # pool empties
+                    self._pending.appendleft(req)
+                    deferred = True
+                else:
+                    self._owner[slot] = req["id"]
+            finally:
+                self._end_op()
+            if deferred:
+                break
+        finished = {}
+        for slot, tokens in self.step().items():
+            rid = self._owner.pop(slot, None)
+            if rid is not None:
+                finished[rid] = tokens
+                self._results[rid] = tokens
+        return finished
+
+    def take_result(self, request_id):
+        """Claim (and remove) a finished request's ``[T]`` tokens from
+        the result bank, or None if it hasn't finished. Results stay
+        banked — and ride the decode snapshot, so a completed-but-
+        unclaimed request survives a preemption — until taken; a
+        long-lived caller that consumes :meth:`pump`'s return directly
+        should still take (or this bank grows one entry per request)."""
+        return self._results.pop(int(request_id), None)
+
     def generate(self, src, src_len=None):
         """Batch convenience: run every row of ``src`` ([B, T] int ids,
         ``src_len`` [B] or [B, 1]) through the slot pool — admitting as
         slots free up, which exercises the continuous-batching path even
         for B > num_slots — and return the [B, T] token matrix
         (bos-led, eos-padded; greedy unless the session's sampler says
-        otherwise). Requests are served strictly in row order: a
-        deferred admission (pool/group reservations exhausted) goes
-        back to the FRONT of the pending queue."""
+        otherwise). Requests are served strictly in row order through
+        the session's persistent queue (:meth:`enqueue` +
+        :meth:`pump`), so a snapshot taken mid-generate carries the
+        backlog."""
         src = np.asarray(src, dtype="int64")
         lengths = (np.full(len(src), self._T, dtype="int64")
                    if src_len is None
                    else np.ravel(np.asarray(src_len, dtype="int64")))
         out = np.full((len(src), self._T), self._eos, dtype="int64")
-        # deque: popleft/appendleft are O(1) — a list's pop(0)/insert(0)
-        # made this loop O(B^2) over a large request batch
-        pending = deque(range(len(src)))
-        owner = {}  # slot -> request index
-        while pending or owner:
-            while pending and self._free:
-                idx = pending.popleft()
-                try:
-                    owner[self.admit(src[idx], lengths[idx])] = idx
-                except (NoFreePageError, NoFreeGroupError):
-                    # pool reservations exhausted: defer this request
-                    # (back to the FRONT — admission order is the
-                    # service contract) and let in-flight sequences
-                    # release pages — guaranteed progress, since the
-                    # constructor requires the pool to cover at least
-                    # one sequence
-                    pending.appendleft(idx)
-                    break
-            for slot, tokens in self.step().items():
-                out[owner.pop(slot)] = tokens
+        order = {self.enqueue(src[i], lengths[i]): i
+                 for i in range(len(src))}
+        want = set(order)
+        while want:
+            self.pump()
+            # claim ONLY this call's rows from the result bank: a
+            # request some other consumer enqueued stays claimable by
+            # its owner instead of being consumed-and-dropped here
+            for rid in list(want):
+                tokens = self.take_result(rid)
+                if tokens is not None:
+                    out[order[rid]] = tokens
+                    want.discard(rid)
         return out
 
     def generate_best_of(self, src, n, src_len=None, prefix_tokens=None):

@@ -33,6 +33,7 @@ The decode-side consumer is ``serving.generation.SlotDecodeSession``
 "KV reuse" documents the lifecycle.
 """
 
+from paddle_tpu.resilience import chaos as _chaos
 from paddle_tpu.serving.server import ServingError
 
 __all__ = ["PagePool", "PrefixCache", "NoFreePageError",
@@ -113,7 +114,13 @@ class PagePool(object):
         empty, ``reclaim`` (the prefix cache's pressure valve) is given
         one chance to evict; still empty raises
         :class:`NoFreePageError` — which reservation-based admission
-        control guarantees never happens for an admitted sequence."""
+        control guarantees never happens for an admitted sequence.
+        ``pool.acquire`` is a chaos site: an injected fault here lands
+        in whatever admission/COW path asked for the page, which must
+        roll back without leaking it (the allocation below never
+        happened)."""
+        if _chaos.ENABLED:
+            _chaos.fault("pool.acquire")
         if not self._free and reclaim is not None:
             reclaim()
         if not self._free:
@@ -149,6 +156,38 @@ class PagePool(object):
             return 0
         self._ref[page] = c - 1
         return c - 1
+
+    # -- snapshot dialect (serving/snapshot.py) -----------------------------
+    def state_dict(self):
+        """JSON-serializable allocator state: the exact free-list ORDER
+        (LIFO recycling determinism is part of the bit-exactness
+        contract — a restored pool must hand out the same physical
+        pages a never-interrupted one would) plus every live
+        refcount."""
+        return {"num_pages": self._P,
+                "free": list(self._free),
+                "ref": {str(p): c for p, c in self._ref.items()}}
+
+    @classmethod
+    def from_state(cls, state):
+        """Rebuild a pool from :meth:`state_dict` output, re-checking
+        the conservation law (free + unique-allocated == P - 1) so a
+        tampered/torn snapshot fails loud at restore, not as silent
+        corruption three admissions later."""
+        pool = cls(int(state["num_pages"]))
+        free = [int(p) for p in state["free"]]
+        ref = {int(p): int(c) for p, c in state["ref"].items()}
+        if (len(free) + len(ref) != pool._P - 1
+                or set(free) & set(ref)
+                or not all(1 <= p < pool._P for p in list(free) + list(ref))
+                or not all(c > 0 for c in ref.values())):
+            raise ValueError(
+                "PagePool state violates conservation: %d free + %d "
+                "allocated != %d allocatable pages (or overlapping/"
+                "out-of-range ids)" % (len(free), len(ref), pool._P - 1))
+        pool._free = free
+        pool._ref = ref
+        return pool
 
 
 class PrefixCache(object):
@@ -275,3 +314,49 @@ class PrefixCache(object):
         """Drop every entry (and its page references)."""
         while self._entries:
             self._evict_lru()
+
+    # -- snapshot dialect (serving/snapshot.py) -----------------------------
+    def state_dict(self):
+        """JSON-serializable trie state: entries with their LRU
+        sequence (eviction order must survive a restore) and the
+        lifetime hit counters the gauges are derived from. Page
+        REFERENCES are not transferable — the restoring side re-refs
+        each entry's page against its own pool."""
+        return {
+            "page_size": self._ps,
+            "max_pages": self._max,
+            "entries": [[fp, list(toks), int(page), self._lru[(fp, toks)]]
+                        for (fp, toks), page
+                        in sorted(self._entries.items(),
+                                  key=lambda kv: self._lru[kv[0]])],
+            "seq": self._seq,
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "tokens_saved": self.tokens_saved,
+        }
+
+    @classmethod
+    def from_state(cls, pool, state):
+        """Rebuild a cache over ``pool`` from :meth:`state_dict` output.
+        Takes NO new pool references: the allocator state serialized
+        beside this trie already counts one reference per entry (the
+        pool and cache snapshot together, restore together), so
+        re-referencing here would inflate every cached page's refcount
+        by one per restore. Entries pointing at unallocated pages are a
+        torn snapshot and fail loud."""
+        cache = cls(pool, int(state["page_size"]),
+                    max_pages=int(state["max_pages"]))
+        for fp, toks, page, seq in state["entries"]:
+            key = (fp, tuple(int(t) for t in toks))
+            if pool.refcount(int(page)) < 1:
+                raise ValueError(
+                    "PrefixCache state references page %d which the "
+                    "restored pool does not hold allocated — torn "
+                    "snapshot" % int(page))
+            cache._entries[key] = int(page)
+            cache._lru[key] = int(seq)
+        cache._seq = int(state["seq"])
+        cache.lookups = int(state["lookups"])
+        cache.hits = int(state["hits"])
+        cache.tokens_saved = int(state["tokens_saved"])
+        return cache

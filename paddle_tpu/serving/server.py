@@ -38,10 +38,13 @@ import numpy as np
 
 from paddle_tpu.analysis.lint import suggest_buckets
 from paddle_tpu.executor import FetchTimeoutError
+from paddle_tpu.observability import watchdog as _watchdog
 from paddle_tpu.observability.metrics_registry import (
     REGISTRY as _REGISTRY,
     SERVING_BUCKETS,
 )
+from paddle_tpu.resilience import chaos as _chaos
+from paddle_tpu.resilience import retry as _retry
 
 __all__ = [
     "BatchingServer", "ServingFuture", "ServingError", "QueueFullError",
@@ -78,7 +81,8 @@ _queue_depth = _REGISTRY.gauge(
 _requests_total = _REGISTRY.counter(
     "paddle_tpu_serving_requests_total",
     "batching-server requests by outcome",
-    labels=("outcome",))  # ok | queue_full | deadline | error | closed
+    labels=("outcome",))  # ok | queue_full | deadline | error | closed |
+#                           degraded (typed retriable shed reject)
 _request_seconds = _REGISTRY.histogram(
     "paddle_tpu_serving_request_seconds",
     "submit->completion latency (the caller-visible SLO)",
@@ -195,10 +199,24 @@ class BatchingServer(object):
     def __init__(self, predictor, max_batch=8, batch_buckets=None,
                  pad_buckets=None, pad_value=0, max_queue_depth=64,
                  batch_linger_s=0.002, default_deadline_s=None,
-                 workers=1):
+                 workers=1, degradation=None):
         if max_batch < 1 or workers < 1 or max_queue_depth < 1:
             raise ValueError("max_batch, workers and max_queue_depth "
                              "must be >= 1")
+        # graceful degradation (serving/degradation.py), opt-in: a dict
+        # of HealthMonitor thresholds arms the healthy->brownout->shed
+        # machine over queue-depth fraction — shed answers submit()
+        # with a typed retriable DegradedError (retry-after hint)
+        # INSTEAD of letting callers ride the queue to the QueueFull
+        # cliff; None keeps the exact pre-PR-13 admission behavior
+        if degradation is not None:
+            from paddle_tpu.serving.degradation import HealthMonitor
+
+            self._monitor = HealthMonitor(
+                "server", **(dict(degradation)
+                             if isinstance(degradation, dict) else {}))
+        else:
+            self._monitor = None
         self._predictor = predictor
         self._feed_names = list(predictor.feed_names)
         self._feed_shapes = dict(predictor.feed_shapes)
@@ -245,7 +263,8 @@ class BatchingServer(object):
         self._stats_lock = threading.Lock()
         self._counts = {"submitted": 0, "ok": 0, "queue_full": 0,
                         "deadline": 0, "error": 0, "closed": 0,
-                        "batches": 0, "padded_rows": 0, "real_rows": 0}
+                        "degraded": 0, "batches": 0, "padded_rows": 0,
+                        "real_rows": 0}
         self._workers = [
             threading.Thread(
                 target=self._worker, name="paddle-tpu-serve-%d" % i,
@@ -350,6 +369,24 @@ class BatchingServer(object):
                     self._counts["closed"] += 1
                 _requests_total.inc(outcome="closed")
                 raise ServerClosedError("server is closed")
+            if self._monitor is not None:
+                from paddle_tpu.serving.degradation import SHED
+
+                state = self._monitor.observe(
+                    len(self._queue) / float(self._max_queue_depth))
+                if state == SHED:
+                    # shed: refuse BEFORE the queue mutates — the
+                    # in-flight/queued work drains, the caller gets a
+                    # typed retriable answer with a retry-after hint
+                    # sized to the drain (a full queue at the linger
+                    # cadence), never a wedged future
+                    with self._stats_lock:
+                        self._counts["degraded"] = \
+                            self._counts.get("degraded", 0) + 1
+                    _requests_total.inc(outcome="degraded")
+                    raise self._monitor.reject(
+                        "admission (queue at %d/%d, draining)"
+                        % (len(self._queue), self._max_queue_depth))
             if len(self._queue) >= self._max_queue_depth:
                 with self._stats_lock:
                     self._counts["queue_full"] += 1
@@ -489,6 +526,13 @@ class BatchingServer(object):
                     _queue_depth.set(0)
                     return
                 batch, total = self._take_batch_locked(ready)
+                if self._monitor is not None:
+                    # the drain side of the state machine: dispatching
+                    # a batch is what shrinks the queue, so recovery
+                    # (shed -> brownout -> healthy, one level per
+                    # crossing) is observed here
+                    self._monitor.observe(
+                        len(self._queue) / float(self._max_queue_depth))
             if batch:
                 self._execute(predictor, batch, total)
 
@@ -511,8 +555,27 @@ class BatchingServer(object):
             off += req.rows
         deadlines = [r.deadline for r in batch if r.deadline is not None]
         timeout = (max(deadlines) - time.monotonic()) if deadlines else None
+        # the PR 4 watchdog brackets the whole blocking dispatch (the
+        # run_async resolve/compile AND the result wait): a hung
+        # serving dispatch produces thread stacks + a black-box dump
+        # exactly like a hung executor step, instead of a silently
+        # wedged worker thread
+        wd_token = (_watchdog.arm("serve.dispatch")
+                    if _watchdog.ENABLED else None)
         try:
-            handle = predictor.run_async(feeds)
+
+            def _dispatch():
+                # serve.dispatch chaos site + classified retry: an
+                # injected (or real) transient fault between batches is
+                # retried with backoff — rollback-safe, because the
+                # batch's feeds are host arrays and nothing was
+                # delivered yet; a deterministic failure (verifier,
+                # OOM, user error) surfaces to every caller at once
+                if _chaos.ENABLED:
+                    _chaos.fault("serve.dispatch")
+                return predictor.run_async(feeds)
+
+            handle = _retry.call(_dispatch, origin="serve.dispatch")
             # dispatch accounting happens HERE, not after the results
             # land: a batch whose every request later times out still
             # occupied the device at this bucket shape, and an operator
@@ -552,6 +615,9 @@ class BatchingServer(object):
             for req in batch:
                 self._finish(req, exc=exc, outcome="error")
             return
+        finally:
+            if wd_token is not None:
+                _watchdog.disarm(wd_token)
         bad = _misaligned_fetches(outs, bucket)
         if bad is not None:
             exc = ServingError(
@@ -672,6 +738,8 @@ class BatchingServer(object):
         return dict(
             counts,
             queue_depth=depth,
+            health=(self._monitor.state if self._monitor is not None
+                    else "healthy"),
             batch_buckets=list(self._ladder),
             mean_occupancy=(counts["real_rows"] / float(dispatched)
                             if dispatched else None),

@@ -203,16 +203,21 @@ def test_insert_never_creates_unreachable_chain_entries():
 
 
 def test_property_random_admit_fork_release_prefix():
-    """Seeded random drive: 400 ops over a small pool + cache; the
-    conservation/exclusivity/rollback laws hold after every op."""
+    """Seeded random drive: 400 ops over a small pool + cache — now
+    with SNAPSHOT/RESTORE interleaved (op 5: the allocator + trie are
+    serialized through the decode-snapshot dialect's state_dict/
+    from_state and the drive continues on the restored objects) — the
+    conservation/exclusivity/rollback laws hold after every op AND
+    across every restore."""
     rng = np.random.RandomState(1234)
     pool = PagePool(12)  # 11 allocatable
     npp = 3
     cache = PrefixCache(pool, PS, max_pages=4)
     model = _HostModel(pool, npp)
     cached_keys = []  # (fp, tokens) inserted so far
+    restores = 0
     for opno in range(400):
-        op = rng.randint(5)
+        op = rng.randint(6)
         live = sorted(model.seqs)
         try:
             if op == 0:  # admit, maybe through a prefix-cache hit
@@ -238,17 +243,60 @@ def test_property_random_admit_fork_release_prefix():
                     toks = tuple(rng.randint(2, 20, PS))
                     cache.insert(fp, toks, st["pages"][:1])
                     cached_keys.append((fp, toks))
+            elif op == 5:  # snapshot/restore mid-drive: the allocator
+                # and trie round-trip through the decode-snapshot
+                # dialect (pool state carries ALL refcounts, including
+                # the trie's; from_state re-refs nothing) and the drive
+                # continues on the restored objects
+                pool = PagePool.from_state(pool.state_dict())
+                cache = PrefixCache.from_state(pool, cache.state_dict())
+                model.pool = pool
+                restores += 1
         except NoFreePageError:
             # the reject IS the property: counts must be unchanged by a
             # failed admission (checked below like every other op)
             pass
         model.check()
+    assert restores > 0, "the drive never exercised a restore"
     # drain: release everything, clear the cache -> full free list
     for sid in sorted(model.seqs):
         model.release(sid)
     cache.clear()
     assert pool.free_count == pool.num_pages - 1
     assert pool.allocated_count == 0 and pool.extra_refs == 0
+
+
+def test_state_dict_round_trip_is_exact_and_json_safe():
+    """The decode-snapshot dialect: pool + trie serialize to plain JSON
+    and rebuild EXACTLY — free-list order (recycling determinism),
+    refcounts, LRU sequence, hit counters. A torn state (conservation
+    broken, trie pointing at an unallocated page) fails loud."""
+    import json
+
+    pool = PagePool(8)
+    a, b, c = pool.acquire(), pool.acquire(), pool.acquire()
+    pool.ref(a)
+    pool.deref(b)  # free-list order now non-trivial: [7..4, b]
+    cache = PrefixCache(pool, PS, max_pages=4)
+    cache.insert("fp", (1, 2, 3, 4), [c])
+    cache.lookup("fp", (1, 2, 3, 4))
+    cache.lookup("fp", (9, 9, 9, 9))
+
+    pstate = json.loads(json.dumps(pool.state_dict()))
+    cstate = json.loads(json.dumps(cache.state_dict()))
+    pool2 = PagePool.from_state(pstate)
+    cache2 = PrefixCache.from_state(pool2, cstate)
+    assert pool2.state_dict() == pool.state_dict()
+    assert cache2.state_dict() == cache.state_dict()
+    assert pool2._free == pool._free  # exact order, not just set
+    assert cache2.hit_rate == cache.hit_rate  # counters survive
+    assert cache2.lookup("fp", (1, 2, 3, 4)) == [c]
+
+    broken = dict(pstate, free=pstate["free"] + [a])  # conservation
+    with pytest.raises(ValueError):
+        PagePool.from_state(broken)
+    with pytest.raises(ValueError):  # trie points at a free page
+        PrefixCache.from_state(PagePool(8), cstate)
 
 
 def test_reservation_rollback_leaves_counts_unchanged():

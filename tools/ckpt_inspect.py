@@ -6,6 +6,14 @@ every complete serial is listed and the newest inspected). Deliberately
 jax-free — this is the tool an operator runs on a corrupt-checkpoint
 page, possibly on a machine with no accelerator stack at all.
 
+Knows all three dialects: plain training checkpoints
+(resilience/checkpoint.py), the elastic sharded dialect
+(elastic/reshard.py — mesh + per-shard digests + shard-byte sums), and
+decode snapshots (serving/snapshot.py — slots/pages/refcounts/prefix
+trie printed; ``--verify`` additionally re-checks page conservation
+``free + unique-allocated == num_pages - 1`` and the refcount
+accounting against the slot page lists + prefix trie).
+
     python tools/ckpt_inspect.py CKPT_DIR [--verify] [--json]
 
 Exit codes:  0 ok · 1 usage/unreadable · 2 verification failed (digest
@@ -78,6 +86,82 @@ def _verify(step_dir, manifest):
     return problems
 
 
+def _decode_summary(ds):
+    """Operator summary of a decode-snapshot manifest's dialect block
+    (serving/snapshot.py): slots, pages, refcounts, prefix trie,
+    backlog."""
+    cfg = ds.get("config") or {}
+    pool = ds.get("pool") or {}
+    ref = pool.get("ref") or {}
+    cache = ds.get("prefix_cache")
+    return {
+        "config": cfg,
+        "steps_done": ds.get("steps_done"),
+        "live_slots": sorted(int(k) for k in (ds.get("live") or {})),
+        "free_slots": len(ds.get("free_slots") or []),
+        "pages_free": len(pool.get("free") or []),
+        "pages_allocated": len(ref),
+        "pages_shared": sum(1 for c in ref.values() if int(c) > 1),
+        "reserved_pages": ds.get("reserved_pages"),
+        "leaked_pages": ds.get("leaked_pages"),
+        "prefix_entries": (len(cache.get("entries") or [])
+                           if cache else 0),
+        "pending_requests": len(ds.get("pending") or []),
+    }
+
+
+def _decode_verify(ds):
+    """Re-check the allocator laws a decode snapshot must satisfy:
+    page conservation (free + unique-allocated == num_pages - 1, the
+    seeded property test's invariant) and reference accounting (every
+    page's refcount equals the references the slot page lists and the
+    prefix trie actually hold on it). A torn/tampered dialect block
+    must fail OFFLINE, before a restore builds a session on it."""
+    problems = []
+    cfg = ds.get("config") or {}
+    pool = ds.get("pool") or {}
+    num_pages = int(pool.get("num_pages", cfg.get("num_pages", 0)))
+    free = [int(p) for p in pool.get("free") or []]
+    ref = {int(p): int(c) for p, c in (pool.get("ref") or {}).items()}
+    if len(free) + len(ref) != num_pages - 1:
+        problems.append(
+            "page conservation broken: %d free + %d allocated != %d "
+            "(num_pages - 1)" % (len(free), len(ref), num_pages - 1))
+    if set(free) & set(ref):
+        problems.append("pages %s are both free and allocated"
+                        % sorted(set(free) & set(ref)))
+    held = {}
+    for slot, pages in (ds.get("slot_pages") or {}).items():
+        for p in pages:
+            held[int(p)] = held.get(int(p), 0) + 1
+    cache = ds.get("prefix_cache")
+    for entry in (cache.get("entries") if cache else []) or []:
+        page = int(entry[2])
+        held[page] = held.get(page, 0) + 1
+    # deliberately-LEAKED pages (failed rollback/COW dispatches keep
+    # their pages allocated forever — corruption beats capacity) hold
+    # refcounts with no slot/trie holder by DESIGN: they only need
+    # ref >= visible holds, everything else must account exactly
+    leaked = set(int(p) for p in ds.get("leaked_page_ids") or [])
+    bad = sorted(
+        p for p in set(held) | set(ref)
+        if (ref.get(p, 0) < held.get(p, 0) if p in leaked
+            else held.get(p, 0) != ref.get(p, 0)))
+    if bad:
+        problems.append(
+            "refcount accounting broken at pages %s: slot lists + "
+            "prefix trie hold %s, pool records %s (leaked: %s)"
+            % (bad[:8], {p: held.get(p, 0) for p in bad[:8]},
+               {p: ref.get(p, 0) for p in bad[:8]},
+               sorted(leaked)[:8]))
+    live_pages = sorted(int(p) for p in ds.get("live_pages") or [])
+    if live_pages != sorted(ref):
+        problems.append(
+            "gathered live_pages %s disagree with pool refcounts %s"
+            % (live_pages[:8], sorted(ref)[:8]))
+    return problems
+
+
 def _serial_dirs(root):
     out = []
     for d in sorted(os.listdir(root)):
@@ -110,7 +194,17 @@ def _summarize(step_dir, manifest, verify):
         "sharded_vars": sorted(n for n, v in vars_meta.items()
                                if v.get("shards")),
     }
-    info["problems"] = _verify(step_dir, manifest) if verify else None
+    # the decode-snapshot dialect (serving/snapshot.py): a live
+    # SlotDecodeSession image — slots/pages/refcounts/prefix trie
+    decode = (manifest.get("extra") or {}).get("decode_snapshot")
+    info["decode"] = _decode_summary(decode) if decode else None
+    if verify:
+        problems = _verify(step_dir, manifest)
+        if decode:
+            problems = problems + _decode_verify(decode)
+        info["problems"] = problems
+    else:
+        info["problems"] = None
     return info
 
 
@@ -157,6 +251,24 @@ def main(argv=None):
                       info["manifest_version"],
                       "  rng=%(base_seed)d@%(run_counter)d"
                       % info["rng"] if info["rng"] else ""))
+            decode = info.get("decode")
+            if decode:
+                cfg = decode.get("config") or {}
+                print("  decode snapshot: step %s  slots live=%s "
+                      "free=%d/%d" % (
+                          decode["steps_done"],
+                          decode["live_slots"], decode["free_slots"],
+                          cfg.get("num_slots", 0)))
+                print("  pages: %d allocated (%d shared) / %d free of "
+                      "%s;  reserved=%s leaked=%s" % (
+                          decode["pages_allocated"],
+                          decode["pages_shared"], decode["pages_free"],
+                          cfg.get("num_pages"),
+                          decode["reserved_pages"],
+                          decode["leaked_pages"]))
+                print("  prefix trie: %d entries;  pending requests: %d"
+                      % (decode["prefix_entries"],
+                         decode["pending_requests"]))
             sharding = info.get("sharding")
             if sharding:
                 mesh = sharding.get("mesh_axes") or {}

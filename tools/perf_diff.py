@@ -65,6 +65,9 @@ METRICS = {
     "bestofn_speedup": ("higher", "timing"),
     "prefix_hit_rate": ("higher", "timing"),
     "cross_kv_bytes": ("lower", "deterministic"),
+    # serving resilience (tools/serve_chaos_smoke.py): wall seconds of
+    # one synchronous decode snapshot in the restored warm process
+    "snapshot_seconds": ("lower", "timing"),
 }
 
 
@@ -89,6 +92,7 @@ def _bench_model_metrics(m):
     out["bestofn_speedup"] = m.get("bestofn_speedup")
     out["prefix_hit_rate"] = m.get("prefix_hit_rate")
     out["cross_kv_bytes"] = m.get("cross_kv_bytes")
+    out["snapshot_seconds"] = m.get("snapshot_seconds")
     ec = m.get("exec_cache") or {}
     out["fresh_compiles"] = ec.get("fresh_compiles",
                                    m.get("fresh_compiles"))

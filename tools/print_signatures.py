@@ -103,6 +103,9 @@ MODULES = [
     "paddle_tpu.serving.server",
     "paddle_tpu.serving.generation",
     "paddle_tpu.serving.loadgen",
+    # PR 13: serving resilience — decode snapshots + degradation
+    "paddle_tpu.serving.snapshot",
+    "paddle_tpu.serving.degradation",
 ]
 
 

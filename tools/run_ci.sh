@@ -4,7 +4,7 @@
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
 # (native|python|lint|warm|metrics|forensics|chaos|shard|serve|decode|
-# elastic|dryrun|bench|perfgate) to run a subset.
+# servechaos|elastic|dryrun|bench|perfgate) to run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -14,7 +14,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ALL_STAGES=(native python lint warm metrics forensics chaos shard serve
-            decode elastic dryrun bench perfgate)
+            decode servechaos elastic dryrun bench perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -188,6 +188,28 @@ if want decode; then
     python tools/perf_diff.py "$dcdir/decode.json" \
       --budgets benchmark/budgets.json --models decode
   rm -rf "$dcdir"
+  trap - EXIT
+fi
+
+if want servechaos; then
+  echo "== serving chaos smoke (SIGKILL mid-decode restore + overload) =="
+  # leg 1: three subprocesses share one exec cache dir — an oracle
+  # decodes a backlog uninterrupted, a snapshotting victim is SIGKILLed
+  # entering a seeded step dispatch, and a restored process must re-emit
+  # the remaining token streams BIT-identical to the oracle's with ZERO
+  # fresh compiles scraped from its metrics registry; leg 2 floods a
+  # degradation-armed BatchingServer past shed and asserts only typed
+  # retriable rejects, no wedged futures, and a brownout->healthy round
+  # trip in the health gauge. The capture (snapshot_seconds +
+  # fresh_compiles) gates against the committed servechaos budgets.
+  scdir="$(mktemp -d)"
+  trap 'rm -rf "$scdir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu FLAGS_telemetry=1 \
+    python tools/serve_chaos_smoke.py "$scdir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/perf_diff.py "$scdir/servechaos.json" \
+      --budgets benchmark/budgets.json --models servechaos
+  rm -rf "$scdir"
   trap - EXIT
 fi
 
