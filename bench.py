@@ -344,6 +344,94 @@ def _bench_serving(fluid, on_tpu):
     return rec
 
 
+def _bench_frontend(fluid, on_tpu):
+    """Network front-end leg (serving/frontend.py): the SAME mixed
+    unary load as the serving leg, but replayed over a REAL loopback
+    socket through ``ServingClient``s — so the bench trajectory tracks
+    wire-level requests/sec and CLIENT-side latency p50/p99 (socket,
+    framing and base64 codec included), plus the stream
+    time-to-first-token of the decode endpoint. ``tools/run_ci.sh net``
+    smoke-tests the same path cross-process with a warm cache;
+    benchmark/budgets.json gates ttft_ms / latency_ms_p99 / throughput.
+    """
+    import shutil
+    import tempfile
+
+    from paddle_tpu.inference import NativeConfig, create_paddle_predictor
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import (
+        BatchingServer,
+        ServingClient,
+        ServingFrontend,
+        loadgen,
+    )
+    from paddle_tpu.serving.generation import Sampler, SlotDecodeSession
+
+    fcfg = dict(src_vocab_size=40, trg_vocab_size=40, n_layer=1,
+                n_head=2, d_inner=64)
+    seq, dmodel = 16, 32
+    model_dir = tempfile.mkdtemp(prefix="bench_frontend_")
+    try:
+        loadgen.build_demo_model(model_dir)
+        predictor = create_paddle_predictor(
+            NativeConfig(model_dir=model_dir, use_tpu=on_tpu))
+        server = BatchingServer(predictor, max_batch=8, workers=2,
+                                batch_linger_s=0.002)
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 13
+        startup.random_seed = 13
+        with fluid.program_guard(main, startup):
+            transformer.build(dropout=0.0, label_smooth_eps=0.0,
+                              max_length=seq, d_model=dmodel, **fcfg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sess = SlotDecodeSession(
+            exe, num_slots=4, max_length=seq, d_model=dmodel,
+            paged=True, page_size=4, steps=2, sampler=Sampler(seed=3),
+            **fcfg)
+        fe = ServingFrontend(server=server, session=sess)
+        try:
+            server.warmup()
+            rng = np.random.RandomState(17)
+            src = rng.randint(3, 40, (4, seq)).astype("int64")
+            warm_cl = ServingClient(fe.address)
+            warm_cl.generate_full(src[0], src_len=seq)  # decode warmup
+            # wire unary replay: one connection per synchronous caller
+            latencies = []
+            wall, ok, errors = loadgen.replay(
+                lambda: ServingClient(fe.address),
+                loadgen.demo_requests(48), concurrency=4,
+                latencies=latencies)
+            assert ok == 48 and not errors, \
+                "wire replay errors: %r" % errors[:3]
+            # stream ttft: request sent -> first token chunk received
+            ttfts = []
+            for i in range(4):
+                t0 = time.perf_counter()
+                first = []
+
+                def see(ev, t0=t0, first=first):
+                    if ev.get("event") == "tokens" and not first:
+                        first.append(time.perf_counter() - t0)
+
+                warm_cl.generate_full(src[i], src_len=seq,
+                                      on_event=see)
+                ttfts.extend(first)
+            warm_cl.close()
+            rec = loadgen.wire_capture(ok, wall, latencies, ttfts)
+        finally:
+            fe.close()
+            server.close()
+    finally:
+        shutil.rmtree(model_dir, ignore_errors=True)
+    rec["metric"] = ("frontend_throughput"
+                     + ("" if on_tpu else "_cpu_proxy"))
+    # wire requests aren't FLOP-accounted: rate feeds throughput only
+    rec["rate"] = rec["value"]
+    rec["gflop_per_unit"] = 0.0
+    return rec
+
+
 def _bench_decode(fluid, on_tpu):
     """Paged-decode A/B leg (ROADMAP item 3 / ragged paged attention):
     steady-state decode tokens/sec and per-token latency at MIXED slot
@@ -527,6 +615,8 @@ def _worker_main():
             result = _bench_transformer(fluid, on_tpu, use_amp)
         elif model == "serving":
             result = _bench_serving(fluid, on_tpu)
+        elif model == "frontend":
+            result = _bench_frontend(fluid, on_tpu)
         elif model == "decode":
             result = _bench_decode(fluid, on_tpu)
         else:
@@ -716,12 +806,14 @@ def main():
     # BENCH_MODELS overrides with an explicit list
     models_env = os.environ.get(
         "BENCH_MODELS",
-        os.environ.get("BENCH_MODEL", "resnet50,transformer,serving,decode"))
+        os.environ.get("BENCH_MODEL",
+                       "resnet50,transformer,serving,frontend,decode"))
     models = {}
     for model in [m.strip() for m in models_env.split(",") if m.strip()]:
-        if model not in ("resnet50", "transformer", "serving", "decode"):
+        if model not in ("resnet50", "transformer", "serving",
+                         "frontend", "decode"):
             errors[model] = ("unknown model (valid: resnet50, "
-                             "transformer, serving, decode)")
+                             "transformer, serving, frontend, decode)")
             continue
         result = err = None
         if tpu_kind is not None:

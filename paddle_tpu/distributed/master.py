@@ -25,8 +25,8 @@ import time
 
 __all__ = [
     "Task", "MasterService", "MasterClient", "task_reader",
-    "serve_json_lines", "close_json_server", "JsonLineClient",
-    "ThrottledSnapshot",
+    "serve_json_lines", "close_json_server", "JsonConn",
+    "JsonLineClient", "ThrottledSnapshot",
 ]
 
 
@@ -35,36 +35,152 @@ __all__ = [
 # ---------------------------------------------------------------------------
 
 
-def serve_json_lines(dispatch, host="127.0.0.1", port=0):
+class JsonConn(object):
+    """Per-connection context handed to connection-aware dispatchers
+    (``serve_json_lines(..., pass_conn=True)``) and to the
+    ``on_open``/``on_close`` callbacks. ``state`` is a scratch dict the
+    service owns (the serving frontend keys its live streams there so a
+    disconnect can tear them down); ``sock``/``rfile`` let a STREAMING
+    dispatcher poll the connection for an in-band cancel line or EOF
+    while it is producing messages (the client sends nothing else
+    mid-stream, so peeking the raw socket is race-free)."""
+
+    __slots__ = ("id", "sock", "rfile", "state")
+
+    def __init__(self, conn_id, sock, rfile):
+        self.id = conn_id
+        self.sock = sock
+        self.rfile = rfile
+        self.state = {}
+
+
+def serve_json_lines(dispatch, host="127.0.0.1", port=0, pass_conn=False,
+                     on_open=None, on_close=None):
     """Start a threading TCP endpoint speaking newline-delimited JSON:
-    every request line is parsed and handed to ``dispatch(dict) -> dict``;
-    exceptions become ``{"ok": False, "error": str(exc)}``. Returns
+    every request line is parsed and handed to ``dispatch(dict) -> dict``
+    (or ``dispatch(dict, conn)`` with ``pass_conn=True``); exceptions
+    become ``{"ok": False, "error": str(exc)}``. Returns
     ``(server, (host, port))`` — the caller owns shutdown/server_close.
     This is the one wire protocol every control-plane service in the
-    repo shares (master task queue, fleet coordinator): Python workers
-    need no RPC deps, and a line is a complete framed message."""
+    repo shares (master task queue, fleet coordinator, serving
+    frontend): Python workers need no RPC deps, and a line is a
+    complete framed message.
+
+    Streaming responses: when ``dispatch`` returns an ITERATOR (any
+    non-dict iterable — a generator, typically) instead of a dict, each
+    yielded dict is written as its own line and flushed immediately, so
+    a client can consume a response incrementally (the serving
+    frontend's token streams). The END of a stream is the dispatcher's
+    protocol to mark in-band (a terminal message); an exception raised
+    mid-iteration becomes a terminal ``{"ok": False, "error": ...}``
+    line, and the iterator is always ``close()``d — abandoning a stream
+    because the client disconnected runs the dispatcher's cleanup
+    (``finally`` blocks / ``GeneratorExit``), which is how per-stream
+    resources get reclaimed.
+
+    ``on_open(conn)`` / ``on_close(conn)`` fire when a connection is
+    established / torn down (either side closing), with the same
+    :class:`JsonConn` the dispatcher saw — the close callback is the
+    disconnect-reclamation hook. Both default to None and the default
+    ``pass_conn=False`` keeps the exact one-request/one-response
+    contract the master task queue and fleet coordinator were built on.
+
+    Chaos sites (armed only via ``FLAGS_chaos_spec``, zero cost
+    otherwise): ``net.accept`` severs a just-accepted connection before
+    any request is read; ``net.send`` fails a response write, severing
+    the connection mid-(stream) — both exercise client reconnect /
+    typed-error paths, never a wedge."""
 
     class Handler(socketserver.StreamRequestHandler):
         def setup(self):
             socketserver.StreamRequestHandler.setup(self)
             with self.server._conn_mu:
                 self.server._live_conns.add(self.connection)
+                self.server._next_conn_id += 1
+                cid = self.server._next_conn_id
+            self.ctx = JsonConn(cid, self.connection, self.rfile)
+            self._opened = False
+            if on_open is not None:
+                try:
+                    on_open(self.ctx)
+                    self._opened = True
+                except Exception:  # noqa: BLE001 - service hook, not wire
+                    import logging
+
+                    logging.getLogger("paddle_tpu.distributed").exception(
+                        "serve_json_lines on_open callback failed")
+            else:
+                self._opened = True
 
         def finish(self):
             with self.server._conn_mu:
                 self.server._live_conns.discard(self.connection)
+            if on_close is not None and self._opened:
+                try:
+                    on_close(self.ctx)
+                except Exception:  # noqa: BLE001 - service hook, not wire
+                    import logging
+
+                    logging.getLogger("paddle_tpu.distributed").exception(
+                        "serve_json_lines on_close callback failed")
             socketserver.StreamRequestHandler.finish(self)
 
+        def _send(self, resp):
+            payload = (json.dumps(resp) + "\n").encode("utf-8")
+            if self._chaos.ENABLED:
+                self._chaos.fault("net.send")
+            self.wfile.write(payload)
+            self.wfile.flush()
+            with self.server._conn_mu:
+                self.server.bytes_sent += len(payload)
+
         def handle(self):
-            for line in self.rfile:
+            # bound once per connection, not per message: _send sits on
+            # the per-line streaming hot path
+            from paddle_tpu.resilience import chaos as _chaos
+
+            self._chaos = _chaos
+            if _chaos.ENABLED:
                 try:
-                    req = json.loads(line)
-                    resp = dispatch(req)
-                except Exception as e:  # noqa: BLE001
-                    resp = {"ok": False, "error": str(e)}
-                self.wfile.write(
-                    (json.dumps(resp) + "\n").encode("utf-8"))
-                self.wfile.flush()
+                    _chaos.fault("net.accept")
+                except Exception:  # noqa: BLE001 - injected accept fault
+                    return  # sever: the client sees EOF and reconnects
+            try:
+                for line in self.rfile:
+                    with self.server._conn_mu:
+                        self.server.bytes_received += len(line)
+                    try:
+                        req = json.loads(line)
+                        resp = (dispatch(req, self.ctx) if pass_conn
+                                else dispatch(req))
+                    except Exception as e:  # noqa: BLE001
+                        resp = {"ok": False, "error": str(e)}
+                    if isinstance(resp, dict):
+                        self._send(resp)
+                        continue
+                    # streaming: one line per yielded message, flushed
+                    # as produced; a mid-stream dispatcher exception is
+                    # delivered as a terminal error line
+                    it = iter(resp)
+                    try:
+                        while True:
+                            try:
+                                msg = next(it)
+                            except StopIteration:
+                                break
+                            except Exception as e:  # noqa: BLE001
+                                self._send({"ok": False, "error": str(e)})
+                                break
+                            self._send(msg)
+                    finally:
+                        close = getattr(it, "close", None)
+                        if close is not None:
+                            close()
+            except OSError:
+                # severed connection (client gone, close_json_server,
+                # or an injected net.send fault): the dispatcher's
+                # stream cleanup already ran via the finally above
+                return
 
     class Server(socketserver.ThreadingTCPServer):
         allow_reuse_address = True
@@ -73,6 +189,9 @@ def serve_json_lines(dispatch, host="127.0.0.1", port=0):
     server = Server((host, port), Handler)
     server._conn_mu = threading.Lock()
     server._live_conns = set()
+    server._next_conn_id = 0
+    server.bytes_sent = 0
+    server.bytes_received = 0
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server, server.server_address
 
@@ -132,6 +251,31 @@ class JsonLineClient(object):
                 self._addr, timeout=self._timeout_s)
             self._rfile = self._sock.makefile("rb")
 
+    def _send_line(self, req):
+        """Connect (if needed) and write one framed request; a send
+        failure closes the socket so the next attempt reconnects."""
+        self._connect()
+        try:
+            self._sock.sendall((json.dumps(req) + "\n").encode("utf-8"))
+        except OSError:
+            self.close()
+            raise
+
+    def _recv_line(self):
+        """Read one framed response; EOF (the service closed or was
+        severed) and socket errors close the socket and raise — both
+        are classified transient, so retry shells reconnect."""
+        try:
+            line = self._rfile.readline()
+        except OSError:
+            self.close()
+            raise
+        if not line:
+            self.close()
+            raise ConnectionError(
+                "%s: service closed connection" % type(self).__name__)
+        return json.loads(line)
+
     def _call(self, **req):
         """One RPC, surviving a service restart: on ConnectionError /
         EOFError / a raw socket error the client reconnects and retries
@@ -146,19 +290,8 @@ class JsonLineClient(object):
                 site = self._chaos_site(req)
                 if site:
                     _chaos.fault(site)
-            self._connect()
-            try:
-                self._sock.sendall(
-                    (json.dumps(req) + "\n").encode("utf-8"))
-                line = self._rfile.readline()
-            except OSError:
-                self.close()
-                raise
-            if not line:
-                self.close()
-                raise ConnectionError(
-                    "%s: service closed connection" % type(self).__name__)
-            return json.loads(line)
+            self._send_line(req)
+            return self._recv_line()
 
         return _retry.call(once, origin=self.origin, retries=1)
 

@@ -48,9 +48,15 @@ decoding process deterministically — the servechaos CI leg),
 ``serve.admit`` (inside a slot admission, after slots/pages are claimed
 and before the dispatch — a fault here must roll the whole group back
 and, under retry, re-admit bit-identically), ``pool.acquire`` (the KV
-page allocator), and ``snapshot.write`` (between a decode snapshot's
+page allocator), ``snapshot.write`` (between a decode snapshot's
 var files, beside the inherited ``ckpt.write`` — a kill mid-snapshot
-must be invisible to the next restore).
+must be invisible to the next restore), and the network front end's
+wire sites in ``distributed/master.py``'s ``serve_json_lines``:
+``net.accept`` (sever a just-accepted connection before any request is
+read — the client must reconnect) and ``net.send`` (fail a response
+write mid-stream, severing the connection — arm the ``io`` kind; the
+client must retry a unary call / surface a typed StreamBrokenError on
+a broken stream, never hang).
 
 Determinism: each clause owns a ``random.Random`` seeded by
 ``(seed, clause index)``, advanced once per visit to its site — a fixed

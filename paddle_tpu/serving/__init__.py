@@ -38,16 +38,32 @@ bounded set of warm executables. This package is that layer:
   state machine both the server (queue depth) and the decode session
   (page occupancy) shed load through; refusals are typed retriable
   ``DegradedError``\\ s with retry-after hints, never wedged callers.
+* ``frontend.ServingFrontend`` / ``client.ServingClient`` — the
+  NETWORK serving plane: the whole stack above behind a socket on the
+  shared JSON-lines substrate — unary ``predict`` with wire deadlines
+  mapped to the typed admission errors, STREAMING ``generate`` (token
+  chunks flushed per decode dispatch; ``admit_group`` best-of-N and
+  prefix reuse work remotely), ``metrics``/``health`` endpoints,
+  disconnect-safe reclamation (a killed client's slot and KV pages
+  return to the pool), and a client that re-raises the same typed
+  errors with classified retry + reconnect across frontend restarts.
 
-``docs/SERVING.md`` ("Batching server") is the operator's guide.
+``docs/SERVING.md`` ("Batching server" / "Network front end") is the
+operator's guide.
 """
 
+from paddle_tpu.serving import client  # noqa: F401
 from paddle_tpu.serving import degradation  # noqa: F401
+from paddle_tpu.serving import frontend  # noqa: F401
 from paddle_tpu.serving import generation  # noqa: F401
 from paddle_tpu.serving import kv_pool  # noqa: F401
 from paddle_tpu.serving import loadgen  # noqa: F401
 from paddle_tpu.serving import server  # noqa: F401
 from paddle_tpu.serving import snapshot  # noqa: F401
+from paddle_tpu.serving.client import (  # noqa: F401
+    ServingClient,
+    StreamBrokenError,
+)
 from paddle_tpu.serving.degradation import (  # noqa: F401
     DegradedError,
     HealthMonitor,
@@ -72,6 +88,7 @@ from paddle_tpu.serving.server import (  # noqa: F401
     ServingFuture,
     WaitTimeoutError,
 )
+from paddle_tpu.serving.frontend import ServingFrontend  # noqa: F401
 from paddle_tpu.serving.snapshot import (  # noqa: F401
     DecodeSnapshotManager,
     SnapshotMismatchError,

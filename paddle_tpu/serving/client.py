@@ -1,0 +1,400 @@
+"""ServingClient: the wire side of the network serving plane.
+
+``frontend.ServingFrontend`` puts the serving stack behind a socket;
+this is the client that talks to it, built on the same JSON-lines
+substrate every control-plane service in the repo shares
+(``distributed.master.JsonLineClient``) and mirroring ``FleetClient``'s
+posture: one persistent connection, reconnect-and-retry across a
+frontend restart, classified retry with backoff for transient failures.
+
+Contract points:
+
+* **Typed errors round-trip.** A frontend reject serializes as a wire
+  error carrying its exception TYPE (and, for ``DegradedError``, the
+  ``retry_after_s``/``state`` payload); this client re-raises the SAME
+  exception classes the in-process server would — ``QueueFullError``,
+  ``DeadlineExceededError``, ``DegradedError`` (still
+  ``retry.TransientError``, so classified retry loops back off —
+  honoring the server's retry-after hint — and re-ask), ``NoFreeSlot/
+  Page/GroupError``... Code written against ``BatchingServer`` /
+  ``SlotDecodeSession`` keeps its except clauses over the wire.
+* **Bit-exact arrays.** Feeds and fetches travel as base64-encoded raw
+  buffers with dtype+shape (:func:`encode_array`), so a remote
+  ``predict`` is byte-for-byte the in-process result — including NaN
+  payloads JSON floats would mangle.
+* **Streaming decode.** :meth:`ServingClient.generate` yields token
+  chunks AS THE FRONTEND FLUSHES THEM (one event per decode dispatch),
+  not at end-of-stream; abandoning the generator sends an in-band
+  cancel so the frontend tears the generation down and returns its
+  slot/pages. A connection severed BEFORE the stream began (no event
+  consumed yet) is retried (the frontend's disconnect reclamation
+  makes re-admission safe); severed any later, it surfaces a typed
+  :class:`StreamBrokenError` — never a silent re-decode that could
+  splice two divergent streams, and never a hang (socket timeout +
+  the PR 4 watchdog armed around every blocking read).
+
+``docs/SERVING.md`` ("Network front end") documents the wire protocol.
+"""
+
+import base64
+import time
+
+import numpy as np
+
+from paddle_tpu.distributed.master import JsonLineClient
+from paddle_tpu.observability import watchdog as _watchdog
+from paddle_tpu.serving.degradation import DegradedError
+from paddle_tpu.serving.generation import (
+    NoFreeGroupError,
+    NoFreePageError,
+    NoFreeSlotError,
+)
+from paddle_tpu.serving.server import (
+    DeadlineExceededError,
+    QueueFullError,
+    ServerClosedError,
+    ServingError,
+    WaitTimeoutError,
+)
+
+__all__ = [
+    "ServingClient", "StreamBrokenError",
+    "encode_array", "decode_array", "error_to_wire", "error_from_wire",
+]
+
+
+class StreamBrokenError(ServingError):
+    """The connection died after the stream began. The
+    frontend's disconnect hook has torn the generation down (slot and
+    pages reclaimed); re-issue the request — the client will NOT retry
+    it silently, because a fresh generation under a stochastic sampler
+    is a different stream and splicing the two would corrupt the
+    caller's sequence."""
+
+
+def encode_array(arr):
+    """Wire form of one ndarray: raw buffer base64 + dtype + shape —
+    bit-exact (JSON floats round-trip, but raw bytes don't even have
+    to argue about NaN payloads) and cheap to decode."""
+    arr = np.asarray(arr)
+    # shape before ascontiguousarray: it promotes 0-d to 1-d
+    shape = list(arr.shape)
+    raw = np.ascontiguousarray(arr).tobytes()
+    return {"dtype": str(arr.dtype), "shape": shape,
+            "b64": base64.b64encode(raw).decode("ascii")}
+
+
+def decode_array(obj):
+    """Inverse of :func:`encode_array`; returns a WRITABLE host array
+    (frombuffer views are read-only, and callers slice/assign)."""
+    flat = np.frombuffer(base64.b64decode(obj["b64"]),
+                         dtype=np.dtype(str(obj["dtype"])))
+    return flat.reshape([int(d) for d in obj["shape"]]).copy()
+
+
+#: wire ``etype`` -> exception class; the client re-raises these VERBATIM
+#: so except clauses written against the in-process server keep working
+_WIRE_ERRORS = {
+    cls.__name__: cls for cls in (
+        ServingError, QueueFullError, DeadlineExceededError,
+        ServerClosedError, WaitTimeoutError, NoFreeSlotError,
+        NoFreePageError, NoFreeGroupError, StreamBrokenError,
+    )
+}
+
+
+def error_to_wire(exc):
+    """Serialize a serving exception as a typed wire error message."""
+    wire = {"ok": False, "error": str(exc), "etype": type(exc).__name__}
+    if isinstance(exc, DegradedError):
+        wire["retry_after_s"] = exc.retry_after_s
+        wire["state"] = exc.state
+    return wire
+
+
+def error_from_wire(msg):
+    """Rebuild the typed exception a wire error message carries;
+    unknown types degrade to :class:`ServingError` with the type name
+    preserved in the text."""
+    etype = msg.get("etype")
+    text = msg.get("error", "frontend error")
+    if etype == "DegradedError":
+        return DegradedError(
+            text, state=msg.get("state", "brownout"),
+            retry_after_s=float(msg.get("retry_after_s", 0.05)))
+    cls = _WIRE_ERRORS.get(etype)
+    if cls is not None:
+        return cls(text)
+    return ServingError("%s: %s" % (etype, text) if etype else text)
+
+
+class ServingClient(JsonLineClient):
+    """Client for one :class:`serving.frontend.ServingFrontend`.
+
+    ``addr``: ``(host, port)`` or ``"host:port"``. ``timeout_s`` bounds
+    every blocking socket read (a dead frontend surfaces as a transient
+    ``socket.timeout``, never a wedge). Retries follow the resilience
+    policy (``FLAGS_dispatch_retries`` budget; 0 = surface the first
+    typed failure — the mode the overload tests assert typed
+    ``DegradedError`` under).
+    """
+
+    origin = "ServingClient._call"
+
+    # -- transport shell -----------------------------------------------------
+
+    def _recv_line(self):
+        # every blocking read wears the watchdog (on top of the socket
+        # timeout): a frontend that stops answering produces thread
+        # stacks + a black-box dump, not a silently stuck client
+        token = _watchdog.arm("net.recv") if _watchdog.ENABLED else None
+        try:
+            return super(ServingClient, self)._recv_line()
+        except ValueError as exc:
+            # a torn frame (frontend killed mid-write leaves a partial
+            # JSON line): surface as the CONNECTION failure it is —
+            # transient for the classified-retry shell, StreamBroken
+            # for an in-flight stream — never a raw decode error
+            self.close()
+            raise ConnectionError(
+                "ServingClient: torn frame from the frontend "
+                "(killed mid-write?): %s" % (exc,))
+        finally:
+            if token is not None:
+                _watchdog.disarm(token)
+
+    def _request(self, **req):
+        """One RPC (reconnect-retry-once inherited); wire errors come
+        back as their original typed exceptions."""
+        resp = self._call(**req)
+        if not resp.get("ok", False):
+            raise error_from_wire(resp)
+        return resp
+
+    def _retrying(self, fn, origin):
+        """The classified-retry shell (``resilience.retry``): transient
+        failures — connection drops across a frontend restart, injected
+        net faults, and ``DegradedError`` (retriable BY TYPE) — back
+        off and re-ask; a shed frontend's ``retry_after_s`` hint is
+        honored before the classified backoff re-asks."""
+        from paddle_tpu.resilience import retry as _retry
+
+        def attempt():
+            try:
+                return fn()
+            except DegradedError as exc:
+                if exc.retry_after_s > 0 and _retry.retries_enabled():
+                    time.sleep(exc.retry_after_s)
+                raise
+
+        return _retry.call(attempt, origin=origin)
+
+    # -- unary ---------------------------------------------------------------
+
+    def predict(self, inputs, deadline_s=None):
+        """Remote ``BatchingServer`` round trip: ``inputs`` is a dict
+        (feed name -> array) or a list in feed order; returns the fetch
+        list as numpy arrays, bit-identical to the in-process server's.
+        ``deadline_s`` rides the wire and maps to the server's typed
+        admission errors (``DeadlineExceededError`` et al.)."""
+        if isinstance(inputs, dict):
+            wire_in = {str(k): encode_array(np.asarray(v))
+                       for k, v in inputs.items()}
+        else:
+            wire_in = [encode_array(np.asarray(v)) for v in inputs]
+
+        def once():
+            resp = self._request(
+                method="predict", inputs=wire_in,
+                deadline_s=(None if deadline_s is None
+                            else float(deadline_s)))
+            return [decode_array(o) for o in resp["outputs"]]
+
+        return self._retrying(once, origin="ServingClient.predict")
+
+    def run(self, inputs, deadline_s=None):
+        """``BatchingServer.run``-shaped alias of :meth:`predict`, so
+        the deterministic load generator (``serving/loadgen.py``)
+        drives an in-process server and a wire client through ONE code
+        path."""
+        return self.predict(inputs, deadline_s=deadline_s)
+
+    # -- streaming decode ----------------------------------------------------
+
+    def generate(self, src, src_len=None, n=1, prefix_tokens=None):
+        """Stream one generation (``n > 1``: a best-of-N fork group via
+        the session's ``admit_group``; ``prefix_tokens``: forced prefix
+        riding the prefix cache). Returns a GENERATOR of event dicts,
+        in wire order:
+
+        * ``{"event": "queued", "id": rid}`` — the request entered the
+          session's persistent backlog (EVERY solo request does, even
+          with free capacity — admission usually follows in the same
+          scheduler pass; the id survives a frontend preemption, see
+          ``take_result``)
+        * ``{"event": "admitted", "members", "prefix", "pos",
+          "max_length", "eos"}``
+        * ``{"event": "tokens", "member", "tokens"}`` — the NEW int64
+          tokens one decode dispatch appended for one member
+        * ``{"event": "end"}`` / ``{"event": "cancelled"}`` — terminal
+
+        Closing the generator before the terminal event sends an
+        in-band cancel (the frontend tears the generation down and
+        reclaims its slot/pages). Admission rejects raise typed errors
+        at CALL time; a connection severed before the first event is
+        retried under the classified policy, any later it raises
+        :class:`StreamBrokenError`."""
+        req = {"method": "generate",
+               "src": encode_array(
+                   np.asarray(src, dtype="int64")),
+               "n": int(n)}
+        if src_len is not None:
+            req["src_len"] = int(np.ravel(src_len)[0])
+        if prefix_tokens is not None:
+            req["prefix_tokens"] = [int(t) for t in prefix_tokens]
+
+        def opened():
+            # the open is retry-safe: until the first message lands, a
+            # severed attempt's admission (if it happened at all) is
+            # reclaimed by the frontend's disconnect hook
+            self._send_line(req)
+            first = self._recv_line()
+            if not first.get("ok", False):
+                raise error_from_wire(first)
+            return first
+
+        first = self._retrying(opened, origin="ServingClient.generate")
+        return self._stream_events(first)
+
+    def _stream_events(self, first):
+        finished = False
+        try:
+            msg = first
+            while True:
+                if not msg.get("ok", False):
+                    raise error_from_wire(msg)
+                ev = dict(msg)
+                ev.pop("ok", None)
+                if ev.get("event") == "tokens":
+                    ev["tokens"] = np.asarray(
+                        [int(t) for t in ev["tokens"]], dtype="int64")
+                if ev.get("event") in ("end", "cancelled"):
+                    finished = True
+                yield ev
+                if finished:
+                    return
+                try:
+                    msg = self._recv_line()
+                except (ConnectionError, EOFError, OSError) as exc:
+                    finished = True  # the connection is gone: no cancel
+                    # the retry unit is the OPEN (before any event was
+                    # consumed); once the stream began, every sever is
+                    # the same typed break — the caller has already
+                    # consumed events a silent re-admission could not
+                    # replay consistently
+                    raise StreamBrokenError(
+                        "connection severed after the stream began "
+                        "(%s); the frontend reclaims the generation — "
+                        "re-issue the request" % (exc,))
+        finally:
+            if not finished:
+                # the consumer abandoned the stream: cancel in-band so
+                # the frontend frees the slot/pages NOW, keeping the
+                # connection reusable; failing that, drop the
+                # connection (the frontend's close hook reclaims)
+                self._cancel_stream()
+
+    def _cancel_stream(self):
+        if self._sock is None:
+            # the connection is already gone (caller close()d it, or a
+            # read error dropped it): there is nothing to cancel on —
+            # the frontend's close callback reclaims the stream, and
+            # reconnecting here would only leak a fresh socket to send
+            # a cancel no stream can match
+            return
+        # the frontend answers every cancel line EXACTLY once: either
+        # the in-flight stream's handler consumes it (terminal
+        # ``cancelled`` event) or — when the stream ended first — the
+        # substrate answers it as an idle cancel ack (also event
+        # ``cancelled``). Draining until that event resynchronizes the
+        # connection whatever the race resolved to; stream events
+        # produced before the cancel landed are skipped on the floor.
+        try:
+            self._send_line({"method": "cancel"})
+            deadline = time.monotonic() + self._timeout_s
+            while time.monotonic() < deadline:
+                # ONLY the cancelled event ends the drain: a terminal
+                # stream ERROR line racing the cancel still leaves the
+                # frontend's cancel ack in flight — stopping early
+                # would leave it buffered and desynchronize the next
+                # RPC on this connection
+                if self._recv_line().get("event") == "cancelled":
+                    return
+        except Exception:  # noqa: BLE001 - fall through to the hard drop
+            pass
+        self.close()
+
+    def generate_full(self, src, src_len=None, n=1, prefix_tokens=None,
+                      on_event=None):
+        """Convenience: consume the whole stream and return the
+        ``[n, max_length]`` int64 token matrix in member order —
+        bos-led, eos-padded, bit-identical to the in-process
+        ``SlotDecodeSession.generate`` / ``generate_best_of`` rows
+        (reassembled from the incremental chunks, so the streaming
+        framing itself is covered by every parity assertion).
+        ``on_event`` (optional) sees every raw stream event before it
+        is folded in — the hook the smoke/bench use to time the first
+        token without re-implementing the reassembly."""
+        rows = fill = None
+        for ev in self.generate(src, src_len=src_len, n=n,
+                                prefix_tokens=prefix_tokens):
+            if on_event is not None:
+                on_event(ev)
+            kind = ev.get("event")
+            if kind == "admitted":
+                members = int(ev["members"])
+                length = int(ev["max_length"])
+                prefix = [int(t) for t in ev["prefix"]]
+                rows = np.full((members, length), int(ev["eos"]),
+                               dtype="int64")
+                rows[:, :len(prefix)] = prefix
+                fill = [len(prefix)] * members
+            elif kind == "tokens":
+                m = int(ev.get("member", 0))
+                toks = ev["tokens"]
+                rows[m, fill[m]:fill[m] + len(toks)] = toks
+                fill[m] += len(toks)
+        if rows is None:
+            raise ServingError("stream ended without an admission")
+        return rows
+
+    def take_result(self, request_id):
+        """Claim a banked ``[T]`` token row by request id (requests a
+        preempted-and-restored frontend finished headless land in the
+        session's result bank); None if unknown/unfinished."""
+
+        def once():
+            resp = self._request(method="take_result",
+                                 id=int(request_id))
+            tokens = resp.get("tokens")
+            return None if tokens is None else decode_array(tokens)
+
+        return self._retrying(once, origin="ServingClient.take_result")
+
+    # -- observability -------------------------------------------------------
+
+    def metrics(self):
+        """The frontend process's Prometheus scrape text — the remote
+        twin of ``REGISTRY.to_prometheus()`` (what the CI net stage
+        greps its 0-fresh-compiles gate from)."""
+        return self._request(method="metrics")["text"]
+
+    def health(self):
+        """Degradation state per component, e.g. ``{"server":
+        "healthy", "decode": "brownout"}`` (``HealthMonitor`` states)."""
+        return self._request(method="health")["health"]
+
+    def stats(self):
+        """Frontend counter snapshot (requests by endpoint/outcome,
+        active connections, stream/byte counters)."""
+        return self._request(method="stats")["stats"]

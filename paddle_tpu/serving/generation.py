@@ -488,6 +488,17 @@ class SlotDecodeSession(object):
     def free_groups(self):
         return len(self._free_groups) if self._paged else 0
 
+    @property
+    def pool_conserved(self):
+        """The page-pool conservation law, live: ``free +
+        unique-allocated == P - 1`` (True for dense sessions, which
+        have no pool). The number every teardown path — release,
+        rollback, disconnect cancellation — must leave intact."""
+        if not self._paged:
+            return True
+        return (self._pool.free_count + self._pool.allocated_count
+                == self._pool.num_pages - 1)
+
     def prefix_cache_stats(self):
         """{'lookups', 'hits', 'hit_rate', 'tokens_saved', 'pages'} —
         zeros when the cache is disabled."""
@@ -788,6 +799,61 @@ class SlotDecodeSession(object):
         self._reserved_pages -= n * self._pages_for(self._T, self._ps)
         self._update_pool_gauges()
 
+    def cancel(self, slot):
+        """Abort one in-flight sequence — the disconnect/cancel
+        teardown a network front end needs: the slot frees, its page
+        references drop (the table row is repointed at the trash page
+        FIRST, the ``_release_pages`` discipline, so recycled pages can
+        never receive a stale row's writes), its group loses a member
+        and any request ownership is dropped WITHOUT banking a result.
+        Returns True when the slot was live. Call between dispatches
+        (never mid-``step``); :attr:`pool_conserved` holds afterwards —
+        a killed client costs capacity nothing."""
+        slot = int(slot)
+        if slot not in self._live:
+            return False
+        self._begin_op()
+        try:
+            del self._live[slot]
+            if self._paged:
+                try:
+                    self._release_pages(slot)
+                except BaseException:
+                    if slot not in self._slot_pages:
+                        raise  # deref-path invariant break: a real bug
+                    # the trash-repoint dispatch failed: the device row
+                    # may still point at these pages — LEAK them
+                    # (recorded, so ckpt_inspect --verify exempts them)
+                    # instead of freeing pages a stale row could write;
+                    # the group/reservation books still close, so the
+                    # slot re-admits cleanly. Same corruption-beats-
+                    # capacity rule as _rollback_admission.
+                    pages = self._slot_pages.pop(slot)
+                    self._leaked_pages += len(set(pages))
+                    self._leaked_page_ids.update(pages)
+                    gid = self._slot_group.pop(slot, None)
+                    members = self._group_members.get(gid)
+                    if members is not None:
+                        members.discard(slot)
+                        if not members:
+                            del self._group_members[gid]
+                            self._free_groups.append(gid)
+                    self._reserved_pages -= self._pages_for(self._T,
+                                                            self._ps)
+            self._free.append(slot)
+            # inside the op window: a quiesce snapshot at _end_op must
+            # never bank a freed slot with a stale owner entry (a later
+            # occupant of the slot would finish into the cancelled
+            # request's result id)
+            self._owner.pop(slot, None)
+        finally:
+            self._end_op()
+        _sequences_total.inc(event="cancelled")
+        _active_slots.set(len(self._live))
+        if self._paged:
+            self._update_pool_gauges()
+        return True
+
     def step(self):
         """Advance every in-flight sequence through the step
         executable — one token (dense layout) or ``steps`` tokens (one
@@ -914,24 +980,29 @@ class SlotDecodeSession(object):
         })
         return rid
 
-    def pump(self):
-        """One scheduler round: admit queued requests in order while
-        capacity allows (a pool/group reservation reject — or a
-        degradation reject, when the monitor is armed — defers the
+    def drop_pending(self, request_id):
+        """Remove one not-yet-admitted request from the backlog (the
+        disconnect path for a queued wire request). Returns True when
+        it was still queued."""
+        rid = int(request_id)
+        for i, req in enumerate(self._pending):
+            if req["id"] == rid:
+                del self._pending[i]
+                return True
+        return False
+
+    def admit_pending(self):
+        """The admission half of :meth:`pump`: admit queued requests in
+        order while capacity allows (a pool/group reservation reject —
+        or a degradation reject, when the monitor is armed — defers the
         request back to the FRONT; admission order is the service
-        contract), then one :meth:`step`. Returns ``{request_id: [T]
-        tokens}`` for requests that finished this round; every finished
-        result is ALSO banked until :meth:`take_result` claims it, so
-        concurrent consumers (a ``generate()`` call draining the pool
-        for its own rows while other requests ride along) never lose a
-        request another consumer's pump happened to complete. Slots
-        finished that no queued request owns are dropped
-        (``generate_best_of``'s documented behavior). An IDLE session
-        (nothing queued, nothing live) returns ``{}`` immediately — a
-        caller looping "until request X finishes" should guard on
-        ``pending_requests`` / ``active_slots``, or it will spin."""
+        contract). Returns ``{slot: request_id}`` for the requests
+        admitted THIS call — what a streaming front end needs to map
+        slots back to their wire streams before the next step
+        dispatch."""
         from paddle_tpu.serving.degradation import DegradedError
 
+        admitted = {}
         while self._pending and self._free:
             # the pop -> admit -> owner-record sequence is ONE dispatch
             # window: a quiesce-point snapshot (or deferred SIGTERM)
@@ -956,10 +1027,27 @@ class SlotDecodeSession(object):
                     deferred = True
                 else:
                     self._owner[slot] = req["id"]
+                    admitted[slot] = req["id"]
             finally:
                 self._end_op()
             if deferred:
                 break
+        return admitted
+
+    def pump(self):
+        """One scheduler round: :meth:`admit_pending`, then one
+        :meth:`step`. Returns ``{request_id: [T]
+        tokens}`` for requests that finished this round; every finished
+        result is ALSO banked until :meth:`take_result` claims it, so
+        concurrent consumers (a ``generate()`` call draining the pool
+        for its own rows while other requests ride along) never lose a
+        request another consumer's pump happened to complete. Slots
+        finished that no queued request owns are dropped
+        (``generate_best_of``'s documented behavior). An IDLE session
+        (nothing queued, nothing live) returns ``{}`` immediately — a
+        caller looping "until request X finishes" should guard on
+        ``pending_requests`` / ``active_slots``, or it will spin."""
+        self.admit_pending()
         finished = {}
         for slot, tokens in self.step().items():
             rid = self._owner.pop(slot, None)

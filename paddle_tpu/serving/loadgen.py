@@ -14,7 +14,8 @@ import time
 import numpy as np
 
 __all__ = ["build_demo_model", "demo_requests", "replay",
-           "serving_capture", "DEMO_FEATURES", "DEMO_CLASSES"]
+           "serving_capture", "wire_capture",
+           "DEMO_FEATURES", "DEMO_CLASSES"]
 
 DEMO_FEATURES = 12
 DEMO_CLASSES = 3
@@ -75,22 +76,50 @@ def demo_requests(n, seed=17):
     return out
 
 
-def replay(server, requests, concurrency=4, deadline_s=None):
+def replay(server, requests, concurrency=4, deadline_s=None,
+           latencies=None):
     """Closed-loop replay: ``concurrency`` client threads round-robin
-    the request list, each submitting and blocking on its future (what a
+    the request list, each running its request synchronously (what a
     fleet of synchronous callers looks like, and what makes the
     dispatcher's coalescing window matter). Returns
-    ``(wall_seconds, ok_count, error_list)``."""
+    ``(wall_seconds, ok_count, error_list)``.
+
+    SOCKET mode — the one deterministic wire load generator CI smoke
+    and bench share: pass a zero-arg CALLABLE for ``server`` and each
+    client thread builds (and closes) its OWN target from it, e.g.
+    ``lambda: ServingClient(frontend.address)`` — one connection per
+    synchronous caller, the closed-loop shape a real fleet presents.
+    Both ``BatchingServer`` and ``ServingClient`` expose the shared
+    ``run(inputs, deadline_s=...)`` entry this drives, so the same
+    replay exercises the in-process server or the wire.
+
+    ``latencies``: optional list; per-request wall seconds (successful
+    requests only) are appended — client-side numbers for the wire SLO
+    gates (``latency_ms_p99`` over real sockets)."""
     errors = []
     ok = [0] * concurrency
+    per_req = [[] for _ in range(concurrency)]
 
     def client(cid):
-        for req in requests[cid::concurrency]:
-            try:
-                server.submit(req, deadline_s=deadline_s).result()
-                ok[cid] += 1
-            except Exception as exc:  # noqa: BLE001 - collected
-                errors.append(exc)
+        try:
+            # factory failures (refused connection, restarted frontend)
+            # must land in the error list, not die with the thread
+            target = server() if callable(server) else server
+        except Exception as exc:  # noqa: BLE001 - collected
+            errors.append(exc)
+            return
+        try:
+            for req in requests[cid::concurrency]:
+                try:
+                    t0 = time.perf_counter()
+                    target.run(req, deadline_s=deadline_s)
+                    per_req[cid].append(time.perf_counter() - t0)
+                    ok[cid] += 1
+                except Exception as exc:  # noqa: BLE001 - collected
+                    errors.append(exc)
+        finally:
+            if callable(server):
+                target.close()
 
     threads = [threading.Thread(target=client, args=(i,))
                for i in range(concurrency)]
@@ -100,6 +129,9 @@ def replay(server, requests, concurrency=4, deadline_s=None):
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+    if latencies is not None:
+        for chunk in per_req:
+            latencies.extend(chunk)
     return wall, sum(ok), errors
 
 
@@ -125,4 +157,36 @@ def serving_capture(server, n_ok, wall_s):
         "batch_buckets": st["batch_buckets"],
         "requests_ok": n_ok,
         "requests_rejected": st["queue_full"] + st["deadline"],
+    }
+
+
+def wire_capture(n_ok, wall_s, latencies, ttft_s=None):
+    """The bench/smoke record for the NETWORK front-end leg:
+    wire-level requests/sec plus CLIENT-side latency percentiles (the
+    replay's ``latencies`` out-param — what the user actually waited,
+    socket included) and the stream time-to-first-token
+    (``ttft_s``: one measurement or a list; the median lands as
+    ``ttft_ms``). ``tools/perf_diff.py`` gates all three against the
+    ``frontend`` budgets."""
+    window = sorted(latencies or ())
+
+    def pct(p):
+        if not window:
+            return None
+        idx = min(len(window) - 1, int(round(p * (len(window) - 1))))
+        return round(window[idx] * 1000.0, 3)
+
+    if ttft_s is not None and not np.isscalar(ttft_s):
+        seq = sorted(float(t) for t in ttft_s)
+        ttft_s = seq[len(seq) // 2] if seq else None
+    return {
+        "metric": "frontend_throughput",
+        "value": round(n_ok / wall_s, 2) if wall_s else None,
+        "unit": "requests/sec",
+        "vs_baseline": None,
+        "latency_ms_p50": pct(0.50),
+        "latency_ms_p99": pct(0.99),
+        "ttft_ms": (round(float(ttft_s) * 1000.0, 3)
+                    if ttft_s is not None else None),
+        "requests_ok": n_ok,
     }

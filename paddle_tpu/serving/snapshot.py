@@ -478,6 +478,21 @@ class DecodeSnapshotManager(CheckpointManager):
         self._prev_handlers = {}
 
     def _signal_handler(self, signum, frame):
+        if self._closed:
+            # already finalized — necessarily on a NON-main thread (a
+            # quiesce hook on a serving frontend's decode worker),
+            # where restoring the handlers was impossible
+            # (signal.signal raises off the main thread), so the
+            # re-raised signal landed back here. This handler DOES run
+            # on the main thread: restore the default disposition and
+            # die by the signal instead of re-entering the finalize
+            # chain forever.
+            try:
+                signal.signal(signum, signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+            os.kill(os.getpid(), signum)
+            return
         self._stop_signum = signum
         from paddle_tpu.observability import blackbox
 

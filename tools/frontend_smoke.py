@@ -1,0 +1,376 @@
+"""Network front-end smoke: prove the socket serving plane gives a warm
+process a mixed unary+streaming steady state with ZERO fresh compiles,
+wire token streams bit-identical to in-process decode, disconnect-safe
+reclamation, and typed retriable overload rejects.
+
+Run twice in two subprocesses sharing FLAGS_exec_cache_dir
+(tools/run_ci.sh ``net`` stage does exactly that):
+
+    FLAGS_exec_cache_dir=$D/cache python tools/frontend_smoke.py cold $D
+    FLAGS_exec_cache_dir=$D/cache python tools/frontend_smoke.py warm $D
+
+The COLD pass trains + saves the demo MLP (unary), builds the seeded
+decode transformer, warms every executable the wire path will need
+(bucket ladder, admit/step/prefill/join), and banks the IN-PROCESS
+oracle: per-request predict outputs and token streams for the whole
+mixed load — solo generations, an ``admit_group`` best-of-2 fork with a
+forced prefix, and the SAME prefix again (the cache-hit case).
+
+The WARM pass — new process, only structural fingerprints connecting it
+to the cold one — binds a ``ServingFrontend`` on a real socket and
+replays the same load through ``ServingClient``s, asserting in order:
+
+  * unary replay over the wire: every response BIT-identical to the
+    cold pass's oracle outputs (base64 raw-buffer framing, so this is
+    byte equality, not tolerance);
+  * streaming: every token stream — including the best-of-N fork and
+    the prefix-cache hit — bit-identical to the cold in-process oracle,
+    delivered in per-dispatch chunks (time-to-first-token measured
+    client-side);
+  * disconnect reclamation: a client severed mid-stream leaves the pool
+    at refcount conservation (free + unique-allocated == P - 1), every
+    slot free, and the next admission succeeds;
+  * THE gate: the metrics scrape — fetched OVER THE WIRE via the
+    ``metrics`` endpoint — reports **0 fresh compiles** for the whole
+    warm process;
+  * overload: a degradation-armed server flooded past shed answers the
+    wire client with typed retriable ``DegradedError`` (retry-after
+    hint) — and with the classified budget armed the same flood rides
+    through.
+
+The capture (``$D/frontend.json``: requests/sec, wire latency p50/p99,
+ttft_ms) gates via ``tools/perf_diff.py --budgets benchmark/budgets.json
+--models frontend``.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N_REQUESTS = 48
+CONCURRENCY = 4
+VOCAB, SEQ, D, S = 40, 16, 32, 4
+N_STREAMS = 4
+CFG = dict(src_vocab_size=VOCAB, trg_vocab_size=VOCAB, n_layer=1,
+           n_head=2, d_inner=64)
+
+
+def _build_decode_session():
+    """The one seeded decode model + session both passes build
+    identically (cross-process determinism: both programs carry the
+    seed, so every executable fingerprint matches the cold pass's)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving.generation import Sampler, SlotDecodeSession
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 13
+    startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        transformer.build(dropout=0.0, label_smooth_eps=0.0,
+                          max_length=SEQ, d_model=D, **CFG)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return SlotDecodeSession(
+        exe, num_slots=S, max_length=SEQ, d_model=D, paged=True,
+        page_size=4, steps=2, num_groups=2, prefix_cache_pages=8,
+        sampler=Sampler(strategy="top_k", top_k=4, temperature=0.9,
+                        seed=3), **CFG)
+
+
+def _decode_load():
+    """(src rows, lens, prefix) — the deterministic streaming mix."""
+    rng = np.random.RandomState(17)
+    src = rng.randint(3, VOCAB, (N_STREAMS + 1, SEQ)).astype("int64")
+    lens = [SEQ, 5, SEQ - 1, 7, SEQ]
+    prefix = [int(t) for t in src[N_STREAMS][:6]]
+    return src, lens, prefix
+
+
+def _scraped_fresh_compiles(text):
+    for line in text.splitlines():
+        if line.startswith("paddle_tpu_fresh_compiles_total "):
+            return int(float(line.split()[-1]))
+    raise AssertionError(
+        "scrape carries no paddle_tpu_fresh_compiles_total")
+
+
+def _oracle_streams(sess):
+    """The in-process decode oracle: what the wire streams must equal
+    bit-for-bit. Order matters — the wire pass replays admissions in
+    this exact order, so slot assignment (and the (seed, slot,
+    position) PRNG streams) line up."""
+    src, lens, prefix = _decode_load()
+    out = {}
+    for i in range(N_STREAMS):
+        out["solo_%d" % i] = sess.generate(
+            src[i][None, :], [lens[i]]).tolist()
+    out["bestof"] = sess.generate_best_of(
+        src[N_STREAMS], 2, src_len=lens[N_STREAMS],
+        prefix_tokens=prefix).tolist()
+    out["prefix_hit"] = sess.generate_best_of(
+        src[N_STREAMS], 2, src_len=lens[N_STREAMS],
+        prefix_tokens=prefix).tolist()
+    return out
+
+
+def cold(workdir):
+    from paddle_tpu.inference import NativeConfig, create_paddle_predictor
+    from paddle_tpu.serving import BatchingServer, loadgen
+
+    model_dir = os.path.join(workdir, "model")
+    loadgen.build_demo_model(model_dir)
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=model_dir, use_tpu=False))
+    server = BatchingServer(predictor, max_batch=8, workers=2,
+                            batch_linger_s=0.002)
+    try:
+        server.warmup()
+        predict_oracle = [
+            [np.asarray(o).tolist()
+             for o in server.run_reference(req)]
+            for req in loadgen.demo_requests(N_REQUESTS)]
+    finally:
+        server.close()
+    sess = _build_decode_session()
+    streams = _oracle_streams(sess)
+    with open(os.path.join(workdir, "oracle.json"), "w") as f:
+        json.dump({"predict": predict_oracle, "streams": streams}, f)
+    print("frontend_smoke[cold]: banked %d predict oracles + %d "
+          "stream oracles, executables warmed"
+          % (len(predict_oracle), len(streams)))
+    return 0
+
+
+def _assert_stream_parity(client, oracle):
+    src, lens, prefix = _decode_load()
+    ttfts = []
+
+    def timed_full(*args, **kw):
+        t0 = time.perf_counter()
+        first = [None]
+
+        def see(ev):
+            if ev.get("event") == "tokens" and first[0] is None:
+                first[0] = time.perf_counter() - t0
+
+        rows = client.generate_full(*args, on_event=see, **kw)
+        ttfts.append(first[0])
+        return rows
+
+    for i in range(N_STREAMS):
+        rows = timed_full(src[i], src_len=lens[i])
+        assert rows.tolist() == oracle["solo_%d" % i], (
+            "wire stream %d diverged from the in-process oracle" % i)
+    rows = timed_full(src[N_STREAMS], src_len=lens[N_STREAMS], n=2,
+                      prefix_tokens=prefix)
+    assert rows.tolist() == oracle["bestof"], \
+        "wire best-of-2 fork diverged from the in-process oracle"
+    rows = timed_full(src[N_STREAMS], src_len=lens[N_STREAMS], n=2,
+                      prefix_tokens=prefix)
+    assert rows.tolist() == oracle["prefix_hit"], \
+        "wire prefix-cache-hit stream diverged from the oracle"
+    return [t for t in ttfts if t is not None]
+
+
+def _assert_disconnect_reclaims(fe, sess):
+    from paddle_tpu.serving import ServingClient
+
+    src, lens, _ = _decode_load()
+    victim = ServingClient(fe.address)
+    gen = victim.generate(src[0], src_len=SEQ)
+    next(gen)
+    victim.close()  # killed client: no cancel line, just a dead socket
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if (not sess.active_slots and not sess.pending_requests
+                and sess.free_slots == S and sess.pool_conserved):
+            break
+        time.sleep(0.02)
+    assert sess.pool_conserved, (
+        "conservation broken after client kill: free=%d allocated=%d "
+        "P=%d" % (sess.free_pages, sess.pages_in_use, sess._P))
+    assert sess.free_slots == S, (
+        "slot leaked after client kill: %d of %d free"
+        % (sess.free_slots, S))
+    # the pool serves the very next admission
+    probe = ServingClient(fe.address)
+    rows = probe.generate_full(src[1], src_len=SEQ)
+    assert rows.shape == (1, SEQ)
+    probe.close()
+
+
+def _assert_overload_typed(workdir):
+    from paddle_tpu import flags
+    from paddle_tpu.inference import NativeConfig, create_paddle_predictor
+    from paddle_tpu.serving import (
+        BatchingServer,
+        DegradedError,
+        ServingClient,
+        ServingFrontend,
+        loadgen,
+    )
+
+    predictor = create_paddle_predictor(NativeConfig(
+        model_dir=os.path.join(workdir, "model"), use_tpu=False))
+    server = BatchingServer(
+        predictor, max_batch=8, workers=1, max_queue_depth=8,
+        batch_linger_s=0.05,
+        degradation=dict(brownout_at=0.5, shed_at=0.75,
+                         recover_at=0.25, retry_after_s=0.1))
+    rejects, okays = [], []
+    with server, ServingFrontend(server=server) as fe:
+
+        def one(req):
+            cl = ServingClient(fe.address)
+            try:
+                cl.run(req)
+                okays.append(1)
+            except DegradedError as exc:
+                assert exc.retry_after_s > 0, \
+                    "wire DegradedError lost its retry-after hint"
+                rejects.append(exc)
+            finally:
+                cl.close()
+
+        threads = [threading.Thread(target=one, args=(req,))
+                   for req in loadgen.demo_requests(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert rejects, "the overload flood never tripped shed"
+        assert okays, "shed refused everything, including the drain"
+        # the same flood with the classified budget armed rides the
+        # retry-after hints through the drain: zero surfaced rejects
+        flags.set_flag("dispatch_retries", 8)
+        try:
+            rejects2 = []
+            cl = ServingClient(fe.address)
+            for req in loadgen.demo_requests(8):
+                try:
+                    cl.run(req)
+                except DegradedError as exc:
+                    rejects2.append(exc)
+            cl.close()
+            assert not rejects2, (
+                "classified retry failed to absorb shed rejects: %r"
+                % rejects2[:2])
+        finally:
+            flags.set_flag("dispatch_retries", 0)
+    return len(rejects)
+
+
+def warm(workdir):
+    from paddle_tpu.core import exec_cache
+    from paddle_tpu.inference import NativeConfig, create_paddle_predictor
+    from paddle_tpu.observability import telemetry
+    from paddle_tpu.serving import (
+        BatchingServer,
+        ServingClient,
+        ServingFrontend,
+        loadgen,
+    )
+
+    telemetry.enable(True)
+    with open(os.path.join(workdir, "oracle.json")) as f:
+        oracle = json.load(f)
+    model_dir = os.path.join(workdir, "model")
+    predictor = create_paddle_predictor(
+        NativeConfig(model_dir=model_dir, use_tpu=False))
+    server = BatchingServer(predictor, max_batch=8, workers=2,
+                            batch_linger_s=0.002)
+    sess = _build_decode_session()
+    fe = ServingFrontend(server=server, session=sess)
+    try:
+        server.warmup()
+        # -- unary replay over real sockets (one client per caller) ---------
+        latencies = []
+        wall, ok, errors = loadgen.replay(
+            lambda: ServingClient(fe.address),
+            loadgen.demo_requests(N_REQUESTS), concurrency=CONCURRENCY,
+            latencies=latencies)
+        assert ok == N_REQUESTS and not errors, (
+            "wire replay failed: ok=%d errors=%r" % (ok, errors[:3]))
+        # bit-exact vs the COLD pass's in-process oracle
+        checker = ServingClient(fe.address)
+        for req, want in zip(loadgen.demo_requests(N_REQUESTS),
+                             oracle["predict"]):
+            got = checker.predict(req)
+            for g, w in zip(got, want):
+                assert np.array_equal(g, np.asarray(
+                    w, dtype=g.dtype)), \
+                    "wire predict diverged from the cold oracle"
+        # -- streaming parity (incl. best-of-N fork + prefix hit) -----------
+        ttfts = _assert_stream_parity(checker, oracle["streams"])
+        assert ttfts, "no stream produced a first token"
+        hits = sess.prefix_cache_stats()
+        assert hits["hits"] >= 1, (
+            "the repeated forced prefix never hit the cache: %r" % hits)
+        # -- disconnect-safe reclamation ------------------------------------
+        _assert_disconnect_reclaims(fe, sess)
+        # -- THE gate: scrape over the wire, 0 fresh compiles ---------------
+        scrape = checker.metrics()
+        fresh = _scraped_fresh_compiles(scrape)
+        st = exec_cache.stats()
+        assert fresh == 0, (
+            "warm frontend process paid %d fresh compile(s) under the "
+            "mixed unary+streaming wire load (aot_hits=%d "
+            "aot_misses=%d)" % (fresh, st["aot_hits"], st["aot_misses"]))
+        assert st["aot_hits"] >= 1, (
+            "warm process loaded no AOT images (re-traced): %r" % st)
+        health = checker.health()
+        assert health == {"server": "healthy", "decode": "healthy"}, \
+            health
+        checker.close()
+    finally:
+        fe.close()
+        server.close()
+    # -- overload: typed retriable rejects reach the wire client ------------
+    shed_rejects = _assert_overload_typed(workdir)
+
+    rec = loadgen.wire_capture(ok, wall, latencies, ttfts)
+    from paddle_tpu import profiler
+
+    rec["predicted_peak_bytes"] = \
+        profiler.memory_stats()["predicted_peak_bytes"]
+    st = exec_cache.stats()
+    rec["fresh_compiles"] = fresh
+    rec["compile_seconds_cold"] = round(st["compile_seconds_cold"], 3)
+    rec["exec_cache"] = {
+        "enabled": st["enabled"],
+        "fresh_compiles": st["fresh_compiles"],
+        "persistent_hits": st["persistent_hits"],
+        "aot_hits": st["aot_hits"],
+    }
+    rec["shed_rejects"] = shed_rejects
+    rec["platform"] = "cpu"
+    print("frontend_smoke[warm]: %s" % json.dumps(rec))
+    with open(os.path.join(workdir, "frontend.json"), "w") as f:
+        json.dump({"models": {"frontend": rec}}, f)
+    return 0
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else None
+    workdir = sys.argv[2] if len(sys.argv) > 2 else None
+    if mode not in ("cold", "warm") or not workdir:
+        print("usage: frontend_smoke.py cold|warm <workdir>",
+              file=sys.stderr)
+        return 2
+    if not os.environ.get("FLAGS_exec_cache_dir"):
+        print("frontend_smoke: FLAGS_exec_cache_dir not set",
+              file=sys.stderr)
+        return 2
+    return cold(workdir) if mode == "cold" else warm(workdir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

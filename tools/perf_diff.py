@@ -68,6 +68,10 @@ METRICS = {
     # serving resilience (tools/serve_chaos_smoke.py): wall seconds of
     # one synchronous decode snapshot in the restored warm process
     "snapshot_seconds": ("lower", "timing"),
+    # network front end (tools/frontend_smoke.py + bench.py frontend
+    # leg): stream time-to-first-token over a real socket — the
+    # latency_ms_* twins above carry the wire unary SLOs
+    "ttft_ms": ("lower", "timing"),
 }
 
 
@@ -93,6 +97,7 @@ def _bench_model_metrics(m):
     out["prefix_hit_rate"] = m.get("prefix_hit_rate")
     out["cross_kv_bytes"] = m.get("cross_kv_bytes")
     out["snapshot_seconds"] = m.get("snapshot_seconds")
+    out["ttft_ms"] = m.get("ttft_ms")
     ec = m.get("exec_cache") or {}
     out["fresh_compiles"] = ec.get("fresh_compiles",
                                    m.get("fresh_compiles"))

@@ -106,6 +106,9 @@ MODULES = [
     # PR 13: serving resilience — decode snapshots + degradation
     "paddle_tpu.serving.snapshot",
     "paddle_tpu.serving.degradation",
+    # PR 14: the network front end — socket serving plane + wire client
+    "paddle_tpu.serving.frontend",
+    "paddle_tpu.serving.client",
 ]
 
 

@@ -4,7 +4,7 @@
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
 # (native|python|lint|warm|metrics|forensics|chaos|shard|serve|decode|
-# servechaos|elastic|dryrun|bench|perfgate) to run a subset.
+# servechaos|net|elastic|dryrun|bench|perfgate) to run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -14,7 +14,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ALL_STAGES=(native python lint warm metrics forensics chaos shard serve
-            decode servechaos elastic dryrun bench perfgate)
+            decode servechaos net elastic dryrun bench perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -213,6 +213,35 @@ if want servechaos; then
   trap - EXIT
 fi
 
+if want net; then
+  echo "== network front-end smoke (wire serving plane, 0 warm compiles) =="
+  # two processes share one exec cache dir: the cold leg trains the
+  # demo model, warms every executable and banks the IN-PROCESS oracle
+  # (predict outputs + token streams incl. a best-of-2 fork and a
+  # prefix-cache hit); the warm leg binds a ServingFrontend on a real
+  # socket, replays the mixed unary+streaming load through
+  # ServingClients and must prove: byte-identical responses/streams vs
+  # the oracle, a client killed mid-stream leaves the KV pool at
+  # refcount conservation, ZERO fresh compiles in the metrics scrape
+  # fetched OVER THE WIRE, and overload shed reaching the client as
+  # typed retriable DegradedError with a retry-after hint. The capture
+  # (requests/sec, wire p50/p99, ttft_ms) gates against the committed
+  # frontend budgets.
+  ndir="$(mktemp -d)"
+  trap 'rm -rf "$ndir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$ndir/cache" FLAGS_telemetry=1 \
+    python tools/frontend_smoke.py cold "$ndir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$ndir/cache" FLAGS_telemetry=1 \
+    python tools/frontend_smoke.py warm "$ndir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/perf_diff.py "$ndir/frontend.json" \
+      --budgets benchmark/budgets.json --models frontend
+  rm -rf "$ndir"
+  trap - EXIT
+fi
+
 if want elastic; then
   echo "== elastic smoke (fleet churn: SIGKILL -> evict -> reshard) =="
   # two worker subprocesses + an in-parent FleetCoordinator: worker 1 is
@@ -246,7 +275,7 @@ if want bench; then
   # line must parse and at least one model must have produced a number.
   out="$(BENCH_PLATFORM="${BENCH_PLATFORM-cpu}" python bench.py)"
   echo "$out"
-  echo "$out" | BENCH_EXPECT="${BENCH_MODELS-${BENCH_MODEL-resnet50,transformer,serving,decode}}" python -c '
+  echo "$out" | BENCH_EXPECT="${BENCH_MODELS-${BENCH_MODEL-resnet50,transformer,serving,frontend,decode}}" python -c '
 import json, os, sys
 rec = json.loads(sys.stdin.readline())
 models = rec.get("models") or {}
