@@ -455,6 +455,21 @@ def _bench_decode(fluid, on_tpu):
     can never come from decoding less), and ``cross_kv_bytes`` is the
     grouped cross-pool footprint gated deterministically against the
     per-slot dense layout.
+
+    PR 15 adds the BEAM A/B: ``beam_width=4`` decode with the
+    zero-copy reorder (per-step parent permutation = in-graph
+    page-table row gather + host refcount rebinds) vs the SAME session
+    geometry under ``FLAGS_beam_reorder=reference`` (every survivor
+    physically copies its parent's resident pages — the
+    pre-paged-attention baseline). Both sessions share one program set
+    (identical geometry, content-addressed executables) and decode
+    bit-identical n-best matrices + scores (asserted), so
+    ``beam_speedup`` is pure reorder mechanics. ``beam_reorder_bytes``
+    is the rebind session's physically-moved reorder bytes, page-
+    geometry-accounted (reorder copies — zero for pure permutations —
+    plus write-page COW splits x page bytes); deterministic under
+    greedy decode, gated hard: growth means reorders started copying
+    or COW stopped being write-page-only.
     """
     from paddle_tpu.kernels import paged_attention as pk
     from paddle_tpu.models import transformer
@@ -558,6 +573,55 @@ def _bench_decode(fluid, on_tpu):
     sh_tps = bo_tok / sh_dt
     un_tps = bo_tok / un_dt
 
+    # --- beam A/B: zero-copy rebind reorder vs the copy-reorder
+    # oracle. One geometry (the oracle's transient copies need page
+    # headroom, so BOTH sessions get it — identical programs, shared
+    # content-addressed executables), bit-identical n-bests asserted.
+    from paddle_tpu import flags as _flags
+
+    bw = 4
+    beam_pages = 1 + 2 * S * (seq // ps)  # oracle copy headroom
+    src_beam = rng.randint(3, vocab, (2, seq)).astype("int64")
+
+    def mk_beam():
+        return SlotDecodeSession(
+            exe, num_slots=S, max_length=seq, d_model=dm, paged=True,
+            page_size=ps, beam_width=bw, num_pages=beam_pages, **cfg)
+
+    def beam_wave(sess):
+        outs = [sess.generate_beam(r, seq) for r in src_beam]
+        return outs
+
+    rb = mk_beam()
+    beam_wave(rb)  # warm (admit/join/beam-step/cow-batch executables)
+    rb.beam_reorder_pages = 0
+    rb.cow_pairs = 0
+    t0 = time.perf_counter()
+    rb_out = beam_wave(rb)
+    rb_dt = time.perf_counter() - t0
+    rb_moved = rb.beam_reorder_pages  # MUST stay 0: pure rebinds
+    rb_cow = rb.cow_pairs
+    assert rb_moved == 0, (
+        "rebind beam reorder physically copied %d pages" % rb_moved)
+    _flags.set_flag("beam_reorder", "reference")
+    try:
+        ref = mk_beam()
+        beam_wave(ref)  # warm (same content-addressed programs)
+        ref.beam_reorder_pages = 0
+        t0 = time.perf_counter()
+        ref_out = beam_wave(ref)
+        ref_dt = time.perf_counter() - t0
+        ref_moved = ref.beam_reorder_pages
+    finally:
+        _flags.set_flag("beam_reorder", "rebind")
+    assert ref_moved > 0, "the copy oracle never copied a page"
+    for (rt, rs), (ct, cs) in zip(rb_out, ref_out):
+        assert np.array_equal(rt, ct) and np.array_equal(rs, cs), \
+            "rebind beam diverged from the copy-reorder oracle"
+    beam_tok = sum(tokens_of(rt) for rt, _ in rb_out)
+    page_bytes = 2 * cfg["n_layer"] * n_head * ps * (dm // n_head) * 4
+    beam_speedup = (beam_tok / rb_dt) / (beam_tok / ref_dt)
+
     acc = pk.grid_accounting(mixed + [0] * (S - B), ps, n_head,
                              dm // n_head, seq, num_groups=2,
                              n_layer=cfg["n_layer"])
@@ -586,6 +650,15 @@ def _bench_decode(fluid, on_tpu):
         # the per-slot dense layout — deterministic, gated
         "cross_kv_bytes": acc["cross_hbm_bytes"],
         "cross_kv_dense_bytes": acc["cross_dense_hbm_bytes"],
+        # beam A/B (PR 15): rebind-vs-copy tokens/sec ratio over
+        # bit-identical n-bests, and the rebind wave's physically-moved
+        # bytes (reorder copies — zero — plus write-page COW splits,
+        # page-geometry-accounted). ref_reorder_bytes is the oracle's
+        # O(resident) traffic for scale.
+        "beam_speedup": round(beam_speedup, 3),
+        "beam_tokens_per_sec": round(beam_tok / rb_dt, 1),
+        "beam_reorder_bytes": (rb_moved + rb_cow) * page_bytes,
+        "beam_ref_reorder_bytes": ref_moved * page_bytes,
         "rate": p_tps,
         "gflop_per_unit": 0.0,
     }

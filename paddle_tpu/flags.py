@@ -54,6 +54,17 @@ _DEFS = {
     # (force the kernel — interpret mode on CPU, the test path),
     # "reference" (force the composed path everywhere)
     "paged_attention": ("auto", str),
+    # beam-decode hypothesis reorder over the paged slot pool
+    # (serving/generation.py SlotDecodeSession(beam_width=K)):
+    # "rebind" (default) executes the per-step parent permutation as
+    # page-table row rebinds + host refcount moves — a pure permutation
+    # copies ZERO KV bytes; "reference" is the in-tree copy-reorder
+    # oracle (every surviving hypothesis physically copies its parent's
+    # resident pages, the pre-paged-attention baseline) — bit-identical
+    # tokens, O(T) bytes per reorder, the A/B bench.py's beam_speedup
+    # gates. The oracle needs ~beam_width * pages_per_slot free-page
+    # headroom for its transient copies; size num_pages accordingly.
+    "beam_reorder": ("rebind", str),
     # backward pass of the flash kernel: "pallas" (FlashAttention-2-style
     # dkv/dq kernels, O(block) memory) or "reference" (recompute through
     # the XLA-composed path — materializes the [T, S] score matrix)

@@ -856,6 +856,7 @@ def build_paged_slot_decoder(
     bos_id=1,
     eos_id=2,
     sampler=None,
+    beam_width=1,
 ):
     """Block-paged continuous-batching decode: the slot pool's dense
     per-slot self caches (``[S, H, T, dh]``) become a PAGE POOL —
@@ -878,7 +879,7 @@ def build_paged_slot_decoder(
     shared page).
 
     Returns ``(init_prog, admit_prog, join_prog, prefill_prog,
-    copy_prog, table_prog, step_prog, token_name)``:
+    table_prog, step_prog, token_name)``:
 
     * ``init_prog`` (once; feeds ``pe_table [T, D]`` — the host's exact
       ``position_encoding_row`` table, so in-graph rows are bit-equal
@@ -916,11 +917,12 @@ def build_paged_slot_decoder(
       sets ``write_from`` past the cached pages; pad positions route
       to the trash page), replacing token-by-token prefix stepping
       with one dispatch.
-    * ``copy_prog`` (per COW; feeds ``src_page``, ``dst_page``,
-      ``slot_idx``, ``page_row``): copies one K/V page in every
-      layer's pools (``paged_copy_page``) and installs the repointed
-      table row — a fork's first write to a shared page runs this
-      first, so shared (and prefix-cached) page bits are immutable.
+    * copy-on-write dispatches are NOT built here: a fork's first
+      write to a shared page runs :func:`build_cow_batch_prog` (the
+      bucket-laddered batch program the session builds per rung —
+      copies land before any repoint, so shared and prefix-cached page
+      bits are immutable; one executable covers a whole step window's
+      pairs).
     * ``step_prog`` (K per dispatch, NO feeds): O(page)
       ``paged_kv_write`` at each slot's own position, ragged
       ``paged_attention`` bounded by per-slot lengths (empty pages and
@@ -933,6 +935,24 @@ def build_paged_slot_decoder(
       slot's page-table row — mid-flight page extension before a
       dispatch, and the release/rollback paths' reset to the trash
       page.
+
+    ``beam_width=K`` (K >= 2) builds the BEAM variant: the slots become
+    ``S / K`` beam LANES of K aligned hypotheses, the step program runs
+    ``slot_beam_search`` instead of the sampler — one ``lax.top_k``
+    lattice per lane, the same ``beam_step`` the dense
+    ``beam_search`` op uses — and the per-step hypothesis reorder is
+    executed IN-GRAPH as a parent gather of the page-table rows (plus
+    tok/pos/done/score), so the host's only reorder work is refcount
+    rebinds: a pure parent permutation moves ZERO KV bytes. Beam adds
+    the ``pgd_score [S, 1]`` accumulated-log-prob state (admit/join
+    gain a ``start_score [1, 1]`` feed: 0 for the lane's hypothesis 0,
+    -1e9 for the rest — the first-step duplicate suppression the dense
+    lattice convention uses), done hypotheses' KV writes are routed to
+    the trash page in-graph (a frozen hypothesis must never write a
+    page a survivor may share), and the last return value is a dict of
+    fetch names — ``{"token", "parent", "score", "logits"}`` — instead
+    of the single token name (the session fetches the first three;
+    ``logits`` is the offline-lattice test hook).
 
     Build under the training ``build()``'s fresh ``unique_name`` scope;
     parameters bind by name. All decode state is ``pgd_``-prefixed, so
@@ -952,12 +972,25 @@ def build_paged_slot_decoder(
     npp = pages_for(T, ps)  # pages per slot at full length
     P = int(num_pages) if num_pages else 1 + S * npp
     G = int(num_groups) if num_groups else S
+    K = int(beam_width)
+    if K < 1:
+        raise ValueError("beam_width must be >= 1, got %d" % K)
+    beam = K > 1
+    if beam and S % K:
+        raise ValueError(
+            "beam_width=%d does not tile num_slots=%d into aligned "
+            "beam lanes" % (K, S))
 
     def heads(x):
         return nn.transpose(
             nn.reshape(x, shape=[0, 0, n_head, dh]), perm=[0, 2, 1, 3])
 
     samp = _sampler_attrs(sampler)
+    if beam and samp["strategy"] != "greedy":
+        raise ValueError(
+            "beam_width > 1 replaces token sampling with the beam "
+            "lattice — a stochastic sampler (%r) cannot compose with "
+            "it" % (samp["strategy"],))
 
     with unique_name.guard({}):
         init = fluid.Program()
@@ -997,6 +1030,9 @@ def build_paged_slot_decoder(
                     nn.fill_constant([S, 1], "int64", bos_id), "int64")
             persist("pgd_done",
                     nn.fill_constant([S, 1], "int64", 1), "int64")
+            if beam:
+                persist("pgd_score",
+                        nn.fill_constant([S, 1], "float32", 0.0))
 
         def slot_state_feeds():
             """The feeds admit/join share for one member's registration."""
@@ -1007,10 +1043,17 @@ def build_paged_slot_decoder(
             page_row = nn.data("page_row", shape=[npp], dtype="int64")
             start_tok = nn.data("start_tok", shape=[1], dtype="int64")
             start_pos = nn.data("start_pos", shape=[1], dtype="int64")
-            return slot, gidx, page_row, start_tok, start_pos
+            if not beam:
+                return slot, gidx, page_row, start_tok, start_pos
+            # the lane's accumulated log-prob seed: 0 for hypothesis 0,
+            # -1e9 for the rest (first-step duplicate suppression)
+            start_score = nn.data("start_score", shape=[1],
+                                  dtype="float32")
+            return (slot, gidx, page_row, start_tok, start_pos,
+                    start_score)
 
         def register_member(blk, slot, gidx, page_row, start_tok,
-                            start_pos):
+                            start_pos, start_score=None):
             """Install one slot's group id, table row and loop state."""
             def srow(name, value, dtype="int64"):
                 p = blk.create_var(name=name,
@@ -1024,6 +1067,8 @@ def build_paged_slot_decoder(
             srow("pgd_tok", start_tok)
             srow("pgd_pos", start_pos)
             srow("pgd_done", nn.fill_constant([1, 1], "int64", 0))
+            if start_score is not None:
+                srow("pgd_score", start_score, "float32")
 
         admit = fluid.Program()
         admit_startup = fluid.Program()
@@ -1031,8 +1076,8 @@ def build_paged_slot_decoder(
             blk = admit.global_block()
             src = nn.data("src_word", shape=[T], dtype="int64")
             src_len = nn.data("src_len", shape=[1], dtype="int64")
-            slot, gidx, page_row, start_tok, start_pos = \
-                slot_state_feeds()
+            member_feeds = slot_state_feeds()
+            gidx = member_feeds[1]
             src_mask = nn.sequence_mask(src_len, maxlen=T,
                                         dtype="float32")  # [1, T]
             emb = nn.embedding(
@@ -1059,17 +1104,13 @@ def build_paged_slot_decoder(
                                  name="dec_%d_cmha_v" % i))
                 grow("pgd_kcross_%d" % i, [G, n_head, T, dh], kc)
                 grow("pgd_vcross_%d" % i, [G, n_head, T, dh], vc)
-            register_member(blk, slot, gidx, page_row, start_tok,
-                            start_pos)
+            register_member(blk, *member_feeds)
 
         join = fluid.Program()
         join_startup = fluid.Program()
         with fluid.program_guard(join, join_startup):
             blk = join.global_block()
-            slot, gidx, page_row, start_tok, start_pos = \
-                slot_state_feeds()
-            register_member(blk, slot, gidx, page_row, start_tok,
-                            start_pos)
+            register_member(blk, *slot_state_feeds())
 
         prefill = fluid.Program()
         prefill_startup = fluid.Program()
@@ -1147,32 +1188,6 @@ def build_paged_slot_decoder(
                           name + "_ffn")
                 h = nn.elementwise_add(h, ff)
 
-        copy = fluid.Program()
-        copy_startup = fluid.Program()
-        with fluid.program_guard(copy, copy_startup):
-            blk = copy.global_block()
-            src_page = nn.data("src_page", shape=[1], dtype="int64",
-                               append_batch_size=False)
-            dst_page = nn.data("dst_page", shape=[1], dtype="int64",
-                               append_batch_size=False)
-            slot = nn.data("slot_idx", shape=[1], dtype="int64",
-                           append_batch_size=False)
-            page_row = nn.data("page_row", shape=[npp], dtype="int64")
-            for i in range(n_layer):
-                fluid.layers.paged_copy_page(
-                    blk.create_var(name="pgd_kpool_%d" % i,
-                                   shape=[P, n_head, ps, dh],
-                                   dtype="float32", persistable=True),
-                    blk.create_var(name="pgd_vpool_%d" % i,
-                                   shape=[P, n_head, ps, dh],
-                                   dtype="float32", persistable=True),
-                    src_page, dst_page)
-            # the repointed row rides the same dispatch: device state is
-            # never visible mid-COW (copy before repoint, atomically)
-            t = blk.create_var(name="pgd_table", shape=[S, npp],
-                               dtype="int64", persistable=True)
-            nn.dynamic_update_slice(t, page_row, slot, axis=0, out=t)
-
         table = fluid.Program()
         table_startup = fluid.Program()
         with fluid.program_guard(table, table_startup):
@@ -1206,10 +1221,25 @@ def build_paged_slot_decoder(
             # are garbage either way: the sampler forces eos on done
             # slots), so empty slots cost neither FLOPs nor page traffic
             # and the grid accounting models exactly what the step runs
+            live_row = nn.elementwise_sub(
+                nn.fill_constant([S, 1], "int64", 1), done)
             lengths = nn.elementwise_mul(
                 fluid.layers.increment(pos, value=1, in_place=False),
-                nn.elementwise_sub(
-                    nn.fill_constant([S, 1], "int64", 1), done))
+                live_row)
+            if beam:
+                score = pvar("pgd_score", [S, 1])
+                # a DONE hypothesis's KV write routes to the trash
+                # page: after a reorder it may share its write page
+                # with a survivor (both adopted one parent's rows), and
+                # frozen hypotheses are never attended past their last
+                # live write — so the masked write is pure hygiene that
+                # keeps shared page bits immutable without a COW
+                write_table = nn.elementwise_mul(ptable, live_row)
+            else:
+                # sampler slots COW their write page while live and are
+                # released before any sharing can alias a done slot's
+                # frozen position — the dense write path is unchanged
+                write_table = ptable
             emb = nn.embedding(
                 input=tok, size=[trg_vocab_size, D],
                 param_attr=fluid.ParamAttr(name="trg_emb"))
@@ -1230,7 +1260,7 @@ def build_paged_slot_decoder(
                 v1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
                                  bias_attr=False, name=name + "_smha_v"))
                 kpool, vpool = fluid.layers.paged_kv_write(
-                    kpool, vpool, k1, v1, ptable, pos)
+                    kpool, vpool, k1, v1, write_table, pos)
                 att = fluid.layers.paged_attention(
                     q, kpool, vpool, ptable, lengths,
                     sm_scale=dh ** -0.5)
@@ -1260,15 +1290,107 @@ def build_paged_slot_decoder(
             h = _prenorm(h, "dec_final")
             logits = nn.fc(h, trg_vocab_size, num_flatten_dims=2,
                            name="proj_logits")
-            tok_new, pos_new, done_new = fluid.layers.slot_decode_sample(
-                logits, pos, done=done, eos_id=eos_id, max_length=T,
-                **samp)
+            if beam:
+                (tok_new, pos_new, done_new, score_new,
+                 parent) = fluid.layers.slot_beam_search(
+                    logits, tok, pos, done, score, beam_width=K,
+                    eos_id=eos_id, max_length=T)
+                # THE zero-copy reorder: each surviving hypothesis
+                # adopts its parent's page-table ROW in-graph (the op
+                # already parent-gathered pos/done and selected the
+                # survivor's token/score), so the device-side cost of a
+                # hypothesis reshuffle is an [S, npp] int gather — the
+                # host only rebinds refcounts, and COW fires later only
+                # if a duplicated parent's WRITE page gets written
+                nn.assign(nn.gather(ptable,
+                                    nn.reshape(parent, shape=[-1])),
+                          output=ptable)
+                nn.assign(score_new, output=score)
+            else:
+                tok_new, pos_new, done_new = \
+                    fluid.layers.slot_decode_sample(
+                        logits, pos, done=done, eos_id=eos_id,
+                        max_length=T, **samp)
             # thread the loop state: the NEXT scan iteration embeds the
             # token sampled here, no host in the loop
             nn.assign(tok_new, output=tok)
             nn.assign(pos_new, output=pos)
             nn.assign(done_new, output=done)
-    return init, admit, join, prefill, copy, table, step, tok_new.name
+    if beam:
+        fetches = {"token": tok_new.name, "parent": parent.name,
+                   "score": score_new.name, "logits": logits.name}
+        return init, admit, join, prefill, table, step, fetches
+    return init, admit, join, prefill, table, step, tok_new.name
+
+
+def build_cow_batch_prog(num_slots, max_length, n_layer, n_head,
+                         d_model, page_size, num_pages, pairs):
+    """One COALESCED copy-on-write dispatch: copy ``pairs`` KV page
+    pairs across every layer's pools and install the affected slots'
+    repointed table rows — all in ONE executable, where the per-pair
+    ``copy_prog`` would cost ``pairs`` dispatches (beam reorders
+    multiply COW pairs per step, so the dispatch count is the hot-path
+    number; tests pin it).
+
+    Feeds: ``src_pages``/``dst_pages``/``slot_idxs`` ``[pairs]`` int64
+    and ``page_rows [pairs, npp]`` — each pair's slot with that slot's
+    FINAL row (a slot with several pairs in one window repeats its
+    final row; the repeated scatter is idempotent). Pad short windows
+    with ``(src=0, dst=0)`` trash-page self-copies bound to a live
+    slot's unchanged row — bit-neutral by construction. Copies all run
+    before any repoint (the copy-before-repoint COW discipline, batch
+    edition). ``pairs`` is a bucket-ladder rung
+    (``analysis.lint.suggest_buckets`` discipline): the session builds
+    one program per rung and pads up, so the executable set stays
+    finite and warm. Built under a fresh ``unique_name`` scope so the
+    structural fingerprint is identical whenever the geometry is —
+    rung programs are content-addressed across sessions."""
+    from paddle_tpu import unique_name
+
+    from paddle_tpu.kernels.paged_attention import pages_for
+
+    nn = fluid.layers
+    S, T = int(num_slots), int(max_length)
+    dh = int(d_model) // int(n_head)
+    ps = int(page_size)
+    npp = pages_for(T, ps)
+    P = int(num_pages)
+    n = int(pairs)
+    if n < 1:
+        raise ValueError("build_cow_batch_prog needs pairs >= 1")
+    with unique_name.guard({}):
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            blk = prog.global_block()
+            src_pages = nn.data("src_pages", shape=[n], dtype="int64",
+                                append_batch_size=False)
+            dst_pages = nn.data("dst_pages", shape=[n], dtype="int64",
+                                append_batch_size=False)
+            slot_idxs = nn.data("slot_idxs", shape=[n], dtype="int64",
+                                append_batch_size=False)
+            page_rows = nn.data("page_rows", shape=[n, npp],
+                                dtype="int64", append_batch_size=False)
+            idxs = [nn.fill_constant([1], "int64", i) for i in range(n)]
+            for i in range(n_layer):
+                kpool = blk.create_var(name="pgd_kpool_%d" % i,
+                                       shape=[P, n_head, ps, dh],
+                                       dtype="float32", persistable=True)
+                vpool = blk.create_var(name="pgd_vpool_%d" % i,
+                                       shape=[P, n_head, ps, dh],
+                                       dtype="float32", persistable=True)
+                for j in range(n):
+                    fluid.layers.paged_copy_page(
+                        kpool, vpool,
+                        nn.gather(src_pages, idxs[j]),
+                        nn.gather(dst_pages, idxs[j]))
+            t = blk.create_var(name="pgd_table", shape=[S, npp],
+                               dtype="int64", persistable=True)
+            for j in range(n):
+                nn.dynamic_update_slice(
+                    t, nn.gather(page_rows, idxs[j]),
+                    nn.gather(slot_idxs, idxs[j]), axis=0, out=t)
+    return prog
 
 
 def save_compiled_generator(dirname, batch_size, src_vocab_size,

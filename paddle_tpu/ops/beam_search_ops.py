@@ -134,3 +134,102 @@ register_op(
     lower=_lower_beam_search_decode,
     grad=None,
 )
+
+
+def _lower_slot_beam_search(ctx, ins, attrs):
+    """Batched beam selection over the serving SLOT POOL
+    (``serving.generation.SlotDecodeSession(beam_width=K)``): the
+    ``S = B * K`` slots are beam LANES of K aligned hypotheses each
+    (slot ``s`` is hypothesis ``s % K`` of lane ``s // K``), and one
+    ``beam_step`` call runs every lane's [K, vocab] lattice — the same
+    dense top-k selection ``beam_search``/``cached_beam_generate`` use,
+    so the in-graph path is bit-exact against the lattice replayed
+    offline (tests/test_beam_decode.py pins it).
+
+    Beyond selection, this op performs the PARENT GATHER that makes the
+    reorder zero-copy: each surviving hypothesis adopts its parent's
+    position/done state here (and the session's step program gathers
+    the page-TABLE rows by the same parent indices), so the only thing
+    the host has to move is refcounts — no KV bytes. Finished
+    hypotheses are frozen the ``beam_step`` way (their one candidate is
+    ``(end_id, score)``); length-capped hypotheses (done without an eos
+    token — the ``max_length`` budget ran out) are forced to ``end_id``
+    BEFORE the lattice so they freeze identically. Lifecycle arithmetic
+    is ``sampling_ops.slot_lifecycle_advance`` — the exact formula the
+    sampler path and the host mirrors use.
+
+    Inputs: Logits [S, 1, V]; Tok/Pos/Done [S, 1] int (previous
+    selected token / position / done latch); Score [S, 1] float
+    accumulated log-prob. Outputs: Out [S, 1] selected tokens, PosOut /
+    DoneOut [S, 1], ScoreOut [S, 1], ParentOut [S, 1] — the GLOBAL
+    parent slot index (lane base + local parent), ready for a
+    table-row gather and for the host's refcount rebind.
+    """
+    from paddle_tpu.core.types import device_dtype
+    from paddle_tpu.ops.sampling_ops import slot_lifecycle_advance
+
+    lg = ins["Logits"][0][:, 0, :].astype(jnp.float32)  # [S, V]
+    tok = ins["Tok"][0]
+    pos = ins["Pos"][0]
+    done = ins["Done"][0]
+    score = ins["Score"][0]
+    K = int(attrs.get("beam_width", 0))
+    eos = int(attrs.get("eos_id", 2))
+    max_len = int(attrs.get("max_length", 0))
+    S = lg.shape[0]
+    if K < 2:
+        raise ValueError(
+            "slot_beam_search: beam_width attr must be >= 2 (width 1 "
+            "is the sampler path), got %d" % K)
+    if S % K:
+        raise ValueError(
+            "slot_beam_search: %d slots do not tile into beam lanes "
+            "of width %d" % (S, K))
+    if max_len < 2:
+        raise ValueError(
+            "slot_beam_search: max_length attr must be >= 2, got %d"
+            % max_len)
+    B = S // K
+    idt = device_dtype("int64")
+    done_flat = jnp.reshape(done, (-1,)) > 0
+    pos_flat = jnp.reshape(pos, (-1,))
+    # force done hypotheses to end_id so beam_step freezes them even
+    # when they finished by the length cap, not by sampling eos
+    pre_tok = jnp.where(done_flat, jnp.asarray(eos, idt),
+                        jnp.reshape(tok, (-1,)).astype(idt))
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    sel_tok, sel_score, parent = beam_step(
+        jnp.reshape(pre_tok, (B, K)).astype(jnp.int32),
+        jnp.reshape(score, (B, K)).astype(jnp.float32),
+        jnp.reshape(logp, (B, K, -1)),
+        eos, is_accumulated=False)  # beam_step adds score + logp
+    # local parent -> global slot index (lane base + local)
+    base = jnp.arange(B, dtype=jnp.int32)[:, None] * K
+    parent_global = jnp.reshape(base + parent, (-1,))
+    # parent gather: each surviving hypothesis continues its PARENT's
+    # lifecycle (the session's step program gathers the page-table rows
+    # by the same indices; the host gathers the refcounts)
+    p_pos = pos_flat[parent_global]
+    p_done = done_flat[parent_global]
+    tok_flat = jnp.reshape(sel_tok, (-1,)).astype(idt)
+    new_pos, new_done = slot_lifecycle_advance(
+        p_pos, p_done, tok_flat, eos, max_len)
+    return {
+        "Out": tok_flat[:, None],
+        "PosOut": jnp.reshape(new_pos, jnp.shape(pos)).astype(
+            pos_flat.dtype),
+        "DoneOut": new_done.astype(idt)[:, None],
+        "ScoreOut": jnp.reshape(sel_score, (-1, 1)).astype(jnp.float32),
+        "ParentOut": parent_global.astype(idt)[:, None],
+    }
+
+
+register_op(
+    "slot_beam_search",
+    inputs=["Logits", "Tok", "Pos", "Done", "Score"],
+    outputs=["Out", "PosOut", "DoneOut", "ScoreOut", "ParentOut"],
+    attrs={"beam_width": 0, "eos_id": 2, "max_length": 0},
+    lower=_lower_slot_beam_search,
+    grad=None,
+    no_grad_inputs=("Tok", "Pos", "Done"),
+)

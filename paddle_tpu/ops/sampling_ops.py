@@ -120,6 +120,24 @@ register_op(
 )
 
 
+def slot_lifecycle_advance(pos_flat, was_done, tok, eos, max_len):
+    """The slot-pool lifecycle arithmetic shared by the sampling decode
+    (``slot_decode_sample`` below) and the beam decode
+    (``beam_search_ops._lower_slot_beam_search``): a live slot advances
+    to ``pos + 1`` (clamped so the KV write for a max-length slot stays
+    in bounds), a finished slot freezes, and the done latch trips on
+    eos or on exhausting the ``max_len`` decode budget. All inputs are
+    flat ``[S]`` arrays; returns ``(new_pos, new_done)`` (bool done).
+    Keeping this ONE function is what makes a beam slot's lifecycle
+    bit-identical to a sampler slot's — the host mirrors in
+    ``serving.generation`` replay the same formula."""
+    nxt_pos = jnp.minimum(pos_flat + 1, max_len - 1)
+    new_pos = jnp.where(was_done, pos_flat, nxt_pos)
+    new_done = (was_done | (tok == eos)
+                | (pos_flat + 1 >= max_len - 1))
+    return new_pos, new_done
+
+
 def _lower_slot_decode_sample(ctx, ins, attrs):
     """Batched per-slot token selection for the serving decode loop
     (serving/generation.py): greedy argmax, temperature, or top-k
@@ -168,13 +186,10 @@ def _lower_slot_decode_sample(ctx, ins, attrs):
         tok = jnp.where(was_done, jnp.asarray(eos, idt), tok)
     else:
         was_done = jnp.zeros((S,), jnp.bool_)
-    # position advance mirrors the host slot manager exactly: a live
-    # slot moves to pos+1 (clamped so the KV write for a max-length
-    # slot stays in bounds); a finished slot freezes
-    nxt_pos = jnp.minimum(pos_flat + 1, max_len - 1)
-    new_pos = jnp.where(was_done, pos_flat, nxt_pos)
-    new_done = (was_done | (tok == eos)
-                | (pos_flat + 1 >= max_len - 1))
+    # position advance mirrors the host slot manager exactly (shared
+    # with the beam decode through slot_lifecycle_advance)
+    new_pos, new_done = slot_lifecycle_advance(
+        pos_flat, was_done, tok, eos, max_len)
     return {
         "Out": tok[:, None],
         "PosOut": jnp.reshape(new_pos, jnp.shape(pos)).astype(

@@ -221,7 +221,8 @@ class ServingClient(JsonLineClient):
 
     # -- streaming decode ----------------------------------------------------
 
-    def generate(self, src, src_len=None, n=1, prefix_tokens=None):
+    def generate(self, src, src_len=None, n=1, prefix_tokens=None,
+                 beam=False):
         """Stream one generation (``n > 1``: a best-of-N fork group via
         the session's ``admit_group``; ``prefix_tokens``: forced prefix
         riding the prefix cache). Returns a GENERATOR of event dicts,
@@ -238,6 +239,15 @@ class ServingClient(JsonLineClient):
           tokens one decode dispatch appended for one member
         * ``{"event": "end"}`` / ``{"event": "cancelled"}`` — terminal
 
+        ``beam=True`` (a session built with ``beam_width=K``) streams
+        the BEAM grammar instead: ``admitted`` carries ``beam``/
+        ``beam_width``/``id`` (the banked-result claim id), then one
+        ``{"event": "beam", "parents", "tokens", "scores", "done"}``
+        survivor chunk per decode dispatch (the parent permutation the
+        zero-copy reorder executed, with each survivor's selected token
+        and accumulated score), and a final ``{"event": "beam_end",
+        "tokens" [K x T], "scores" [K]}`` n-best before ``end``.
+
         Closing the generator before the terminal event sends an
         in-band cancel (the frontend tears the generation down and
         reclaims its slot/pages). Admission rejects raise typed errors
@@ -248,6 +258,8 @@ class ServingClient(JsonLineClient):
                "src": encode_array(
                    np.asarray(src, dtype="int64")),
                "n": int(n)}
+        if beam:
+            req["beam"] = True
         if src_len is not None:
             req["src_len"] = int(np.ravel(src_len)[0])
         if prefix_tokens is not None:
@@ -368,16 +380,78 @@ class ServingClient(JsonLineClient):
             raise ServingError("stream ended without an admission")
         return rows
 
+    def generate_beam(self, src, src_len=None, prefix_tokens=None,
+                      on_event=None):
+        """Consume one whole beam stream and return ``(tokens [K, T]
+        int64, scores [K] float32)`` in score-descending hypothesis
+        order — bit-identical to the in-process
+        ``SlotDecodeSession.generate_beam``. The incremental ``beam``
+        survivor chunks are REPLAYED client-side (each survivor adopts
+        its parent's row and appends its token — the same reorder the
+        server executed as table rebinds) and cross-checked against the
+        final ``beam_end`` n-best, so a framing bug in the chunk stream
+        can never pass silently. ``on_event`` sees every raw event."""
+        rows = fill = prev_done = None
+        final = None
+        for ev in self.generate(src, src_len=src_len,
+                                prefix_tokens=prefix_tokens, beam=True):
+            if on_event is not None:
+                on_event(ev)
+            kind = ev.get("event")
+            if kind == "admitted":
+                K = int(ev["beam_width"])
+                length = int(ev["max_length"])
+                prefix = [int(t) for t in ev["prefix"]]
+                rows = np.full((K, length), int(ev["eos"]),
+                               dtype="int64")
+                rows[:, :len(prefix)] = prefix
+                fill = [len(prefix) - 1] * K
+                prev_done = [False] * K
+            elif kind == "beam":
+                parents = [int(p) for p in ev["parents"]]
+                toks = [int(t) for t in ev["tokens"]]
+                nrows = np.empty_like(rows)
+                nfill, ndone = [], []
+                for k, p in enumerate(parents):
+                    nrows[k] = rows[p]
+                    if prev_done[p]:
+                        nfill.append(fill[p])
+                        ndone.append(True)
+                    else:
+                        pos = min(fill[p] + 1, rows.shape[1] - 1)
+                        nrows[k, pos] = toks[k]
+                        nfill.append(pos)
+                        ndone.append(bool(ev["done"][k]))
+                rows, fill, prev_done = nrows, nfill, ndone
+            elif kind == "beam_end":
+                final = (np.asarray(ev["tokens"], dtype="int64"),
+                         np.asarray(ev["scores"], dtype="float32"))
+        if final is None:
+            raise ServingError("beam stream ended without a beam_end")
+        if rows is not None and not np.array_equal(rows, final[0]):
+            raise ServingError(
+                "beam survivor chunks replay to a different n-best "
+                "than the server's beam_end — torn stream framing")
+        return final
+
     def take_result(self, request_id):
-        """Claim a banked ``[T]`` token row by request id (requests a
+        """Claim a banked result by request id (requests a
         preempted-and-restored frontend finished headless land in the
-        session's result bank); None if unknown/unfinished."""
+        session's result bank): a solo id yields its ``[T]`` token
+        row; a BEAM claim id (from the beam ``admitted`` event) yields
+        ``(tokens [K, T], scores [K])`` — the n-best of a beam whose
+        stream died before ``beam_end``. None if unknown/unfinished."""
 
         def once():
             resp = self._request(method="take_result",
                                  id=int(request_id))
             tokens = resp.get("tokens")
-            return None if tokens is None else decode_array(tokens)
+            if tokens is None:
+                return None
+            if resp.get("scores") is not None:
+                return (decode_array(tokens),
+                        decode_array(resp["scores"]))
+            return decode_array(tokens)
 
         return self._retrying(once, origin="ServingClient.take_result")
 

@@ -144,15 +144,18 @@ class _Stream(object):
     """One wire generate stream: the handler thread consumes ``q``;
     the decode worker produces into it and tracks the live slots."""
 
-    __slots__ = ("q", "spec", "cancelled", "live", "rid", "done")
+    __slots__ = ("q", "spec", "cancelled", "live", "rid", "done",
+                 "beam_lane", "beam_rid")
 
     def __init__(self, spec):
         self.q = queue.Queue()
-        self.spec = spec       # {"src", "src_len", "n", "prefix"}
+        self.spec = spec       # {"src", "src_len", "n", "prefix", "beam"}
         self.cancelled = threading.Event()
         self.live = {}         # slot -> member index
         self.rid = None        # session request id when deferred
         self.done = False
+        self.beam_lane = None  # beam streams: the lane this stream owns
+        self.beam_rid = None   # ... and its banked-result claim id
 
 
 class _DecodeWorker(object):
@@ -177,6 +180,7 @@ class _DecodeWorker(object):
         self._slot_stream = {}   # slot -> (stream, member)
         self._rid_stream = {}    # rid -> stream (queued, not yet admitted)
         self._prev_pos = {}      # slot -> last streamed position
+        self._beam_stream = {}   # lane -> stream (beam generations)
         self._max_backlog = int(max_backlog)
         self._thread = threading.Thread(
             target=self._loop, name="paddle-tpu-frontend-decode",
@@ -253,7 +257,7 @@ class _DecodeWorker(object):
                 progressed = True
             if (stop and drain and not s.active_slots
                     and not s.pending_requests and not self._slot_stream
-                    and not self._rid_stream):
+                    and not self._rid_stream and not self._beam_stream):
                 return
             if not progressed:
                 # a whole pass moved nothing — the backlog is
@@ -314,7 +318,9 @@ class _DecodeWorker(object):
 
     def _fail_tracked(self, exc):
         wire = error_to_wire(exc)
-        for stream in set(st for st, _m in self._slot_stream.values()):
+        for stream in set(
+                list(st for st, _m in self._slot_stream.values())
+                + list(self._beam_stream.values())):
             # teardown marks the stream done; the terminal error line
             # must still be delivered (a tracked stream has not yet
             # seen a terminal event — it was live until this failure)
@@ -325,7 +331,20 @@ class _DecodeWorker(object):
         s = self._s
         spec = stream.spec
         try:
-            if spec["n"] == 1:
+            if spec.get("beam"):
+                # beam request: admit-or-reject into one lane (the
+                # beam's K x worst-case reservation never queues);
+                # per-dispatch survivor chunks stream from _step_once,
+                # the final n-best from the session's result bank
+                lane = s.admit_beam(spec["src"], spec["src_len"],
+                                    prefix_tokens=spec["prefix"])
+                stream.beam_lane = lane
+                stream.beam_rid = s.register_beam_owner(lane)
+                self._beam_stream[lane] = stream
+                for k, slot in enumerate(s.beam_slots(lane)):
+                    stream.live[slot] = k
+                stream.q.put(self._admitted_event(stream))
+            elif spec["n"] == 1:
                 # the shed answer at the WIRE edge: a shed session
                 # refuses with the typed retriable DegradedError
                 # (retry-after hint) instead of silently parking the
@@ -380,10 +399,15 @@ class _DecodeWorker(object):
         prefix = [s._bos] + [int(t)
                              for t in (stream.spec["prefix"] or ())]
         slots = sorted(stream.live, key=lambda sl: stream.live[sl])
-        return {"ok": True, "event": "admitted",
-                "members": len(slots), "slots": [int(x) for x in slots],
-                "prefix": prefix, "pos": len(prefix) - 1,
-                "max_length": int(s._T), "eos": int(s._eos)}
+        ev = {"ok": True, "event": "admitted",
+              "members": len(slots), "slots": [int(x) for x in slots],
+              "prefix": prefix, "pos": len(prefix) - 1,
+              "max_length": int(s._T), "eos": int(s._eos)}
+        if stream.beam_lane is not None:
+            ev["beam"] = int(stream.beam_lane)
+            ev["beam_width"] = int(s.beam_width)
+            ev["id"] = int(stream.beam_rid)
+        return ev
 
     def _final_tokens(self, trg, prev):
         """Tokens a finished slot generated past ``prev``: through the
@@ -398,6 +422,44 @@ class _DecodeWorker(object):
     def _step_once(self):
         s = self._s
         finished = s.step()
+        # beam streams: one survivor chunk per dispatch (parents +
+        # selected tokens + scores + done flags — what a live client
+        # renders), the final n-best from the session's bank
+        for lane, ev in getattr(s, "last_beam_events", {}).items():
+            stream = self._beam_stream.get(lane)
+            if stream is None or stream.cancelled.is_set():
+                continue
+            stream.q.put({"ok": True, "event": "beam",
+                          "parents": [int(p) for p in ev["parents"]],
+                          "tokens": [int(t) for t in ev["tokens"]],
+                          "scores": [float(x) for x in ev["scores"]],
+                          "done": [bool(d) for d in ev["done"]]})
+        for lane, fin in getattr(s, "last_finished_beams", {}).items():
+            stream = self._beam_stream.pop(lane, None)
+            if stream is None:
+                continue  # orphaned beam (restored backlog): the
+                #           n-best stays banked for take_result claims
+            stream.live.clear()
+            res = s.take_beam_result(stream.beam_rid)
+            if res is None:
+                res = fin
+            stream.beam_lane = None
+            if not stream.cancelled.is_set():
+                # the final survivor chunk first (the step that ended
+                # the beam still moved tokens), then the n-best
+                stream.q.put({
+                    "ok": True, "event": "beam",
+                    "parents": [int(p) for p in fin["parents"]],
+                    "tokens": [int(t) for t in fin["step_tokens"]],
+                    "scores": [float(x) for x in fin["step_scores"]],
+                    "done": [True] * len(fin["parents"])})
+                stream.q.put({
+                    "ok": True, "event": "beam_end",
+                    "tokens": [[int(t) for t in row]
+                               for row in res["tokens"]],
+                    "scores": [float(x) for x in res["scores"]]})
+                stream.done = True
+                stream.q.put({"ok": True, "event": "end"})
         for slot in list(self._slot_stream):
             stream, member = self._slot_stream[slot]
             prev = self._prev_pos[slot]
@@ -458,9 +520,14 @@ class _DecodeWorker(object):
         after this), a queued request leaves the backlog."""
         s = self._s
         stream.done = True
+        if stream.beam_lane is not None:
+            self._beam_stream.pop(stream.beam_lane, None)
+            stream.beam_lane = None
         for slot in list(stream.live):
             self._slot_stream.pop(slot, None)
             self._prev_pos.pop(slot, None)
+            # on a beam session the FIRST cancel releases the whole
+            # lane; sibling cancels return False harmlessly
             self._safe_cancel(slot)
         stream.live.clear()
         if stream.rid is not None:
@@ -470,7 +537,9 @@ class _DecodeWorker(object):
 
     def _abort_all(self):
         closed = ServerClosedError("frontend closed before completion")
-        for stream in set(st for st, _m in self._slot_stream.values()):
+        for stream in set(
+                list(st for st, _m in self._slot_stream.values())
+                + list(self._beam_stream.values())):
             self._teardown(stream)
             stream.q.put(error_to_wire(closed))
         for stream in list(self._rid_stream.values()):
@@ -661,7 +730,14 @@ class ServingFrontend(object):
                             else int(req["src_len"])),
                 "n": int(req.get("n", 1)),
                 "prefix": req.get("prefix_tokens"),
+                "beam": bool(req.get("beam", False)),
             }
+            if spec["beam"] and spec["n"] != 1:
+                self._observe("generate", "error", t0)
+                yield error_to_wire(ServingError(
+                    "beam=true uses the session's beam_width; it does "
+                    "not compose with n > 1 fork groups"))
+                return
             stream = _Stream(spec)
             conn.state.setdefault("streams", set()).add(stream)
             with self._mu:
@@ -686,7 +762,8 @@ class ServingFrontend(object):
                     outcome = _outcome(error_from_wire(msg))
                     yield msg
                     return
-                if msg.get("event") == "tokens" and not first_token:
+                if (msg.get("event") in ("tokens", "beam")
+                        and not first_token):
                     first_token = True
                     _fe_ttft.observe(time.monotonic() - t0)
                 yield msg
@@ -752,10 +829,24 @@ class ServingFrontend(object):
             if self._session is None:
                 raise ServingError(
                     "this frontend serves no decode session")
-            tokens = self._session.take_result(int(req.get("id", -1)))
+            rid = int(req.get("id", -1))
+            tokens = self._session.take_result(rid)
             resp = {"ok": True,
                     "tokens": (None if tokens is None
                                else encode_array(np.asarray(tokens)))}
+            if tokens is None:
+                # the id may name a BANKED BEAM n-best (the claim id
+                # the beam 'admitted' event carried): a beam whose
+                # stream died — disconnect, or a preemption that
+                # orphaned the lane — finishes headless into the beam
+                # result bank, claimable here like solo rows
+                beam = self._session.take_beam_result(rid)
+                if beam is not None:
+                    resp = {"ok": True,
+                            "tokens": encode_array(
+                                np.asarray(beam["tokens"])),
+                            "scores": encode_array(
+                                np.asarray(beam["scores"]))}
         except Exception as exc:  # noqa: BLE001 - typed to the wire
             self._observe("take_result", _outcome(exc), t0)
             return error_to_wire(exc)

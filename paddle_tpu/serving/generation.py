@@ -44,9 +44,27 @@ Cross-request KV reuse (the PR 12 layer over the page table):
   to refcounted full pages — a hit provisions the table row by
   reference and prefills only the uncached suffix.
 
+Batched BEAM search (the PR 15 layer): ``beam_width=K`` partitions the
+slots into ``S / K`` beam LANES. Per step the program runs one
+``lax.top_k`` lattice per lane (``slot_beam_search`` — the same
+``beam_step`` the dense ``beam_search`` op uses) and executes the
+hypothesis reorder IN-GRAPH as a parent gather of the page-table rows;
+the host's only reorder work is REFCOUNT REBINDS — surviving parents'
+pages gain references, dropped hypotheses deref — so a pure parent
+permutation moves ZERO KV bytes in HBM, and copy-on-write fires only
+when a duplicated parent's in-progress WRITE page is next written.
+``FLAGS_beam_reorder=reference`` is the in-tree copy-reorder oracle
+(every survivor physically copies its parent's resident pages); token
+streams are bit-identical between the two, which is what makes the
+bench's ``beam_speedup`` an honest A/B. COW pairs are COALESCED: one
+bucket-laddered ``build_cow_batch_prog`` dispatch per step window
+covers every pair (and growth rebind) instead of one dispatch per
+pair.
+
 Everything stays inside the zero-recompile contract: shapes are fixed;
 only table rows, group ids and refcounts change between dispatches.
-``docs/SERVING.md`` "KV reuse" has the lifecycle diagrams.
+``docs/SERVING.md`` "KV reuse" / "Beam over the slot pool" have the
+lifecycle diagrams.
 """
 
 import hashlib
@@ -135,6 +153,23 @@ _prefill_saved = _REGISTRY.counter(
     "paddle_tpu_serving_prefill_tokens_saved_total",
     "forced-prefix positions provisioned by reference (prefix-cache "
     "hits + group-fork joins) instead of being prefilled")
+_active_beams = _REGISTRY.gauge(
+    "paddle_tpu_serving_active_beams",
+    "beam lanes currently decoding (beam sessions; occupancy is this "
+    "over num_slots / beam_width)")
+_beam_reorder_bytes = _REGISTRY.counter(
+    "paddle_tpu_serving_beam_reorder_bytes_total",
+    "KV bytes physically copied by beam hypothesis reorders: 0 under "
+    "the rebind path for pure parent permutations, O(resident pages) "
+    "per reorder under FLAGS_beam_reorder=reference")
+_beam_cow = _REGISTRY.counter(
+    "paddle_tpu_serving_beam_cow_copies_total",
+    "copy-on-write page copies triggered by beam decode (a duplicated "
+    "parent's write page splitting before the next token lands)")
+_cow_dispatches = _REGISTRY.counter(
+    "paddle_tpu_serving_cow_dispatches_total",
+    "coalesced COW/table-rebind dispatches (one bucket-laddered "
+    "executable per step window, however many pairs it carries)")
 
 
 class SlotDecodeSession(object):
@@ -169,7 +204,7 @@ class SlotDecodeSession(object):
                  bos_id=1, eos_id=2, scope=None, paged=False,
                  page_size=8, num_pages=None, num_groups=None, steps=1,
                  sampler=None, prefix_cache_pages=0, degradation=None,
-                 **decoder_cfg):
+                 beam_width=1, **decoder_cfg):
         from paddle_tpu.models import transformer
 
         self._transformer = transformer
@@ -183,6 +218,27 @@ class SlotDecodeSession(object):
         self._sampler = sampler
         self._n_layer = int(decoder_cfg.get("n_layer", 2))
         self._n_head = int(decoder_cfg.get("n_head", 4))
+        self._beam_width = int(beam_width)
+        if self._beam_width < 1:
+            raise ValueError("beam_width must be >= 1, got %d"
+                             % self._beam_width)
+        if self._beam_width > 1:
+            if not self._paged:
+                raise ValueError(
+                    "beam_width > 1 needs paged=True — the zero-copy "
+                    "reorder IS the page-table indirection")
+            if int(steps) != 1:
+                raise ValueError(
+                    "beam_width > 1 needs steps=1: the reorder's "
+                    "refcount rebinds (and COW of a duplicated "
+                    "parent's write page) happen on the host BETWEEN "
+                    "dispatches — a multi-token scan would write "
+                    "through unprovisioned, un-COWed rows")
+            if self._S % self._beam_width:
+                raise ValueError(
+                    "beam_width=%d does not tile num_slots=%d into "
+                    "aligned beam lanes"
+                    % (self._beam_width, self._S))
         if self._paged:
             from paddle_tpu.kernels.paged_attention import pages_for
 
@@ -199,13 +255,20 @@ class SlotDecodeSession(object):
                     "page_size) = %d pages, or every admit() would "
                     "fail its reservation" % (self._P, 1 + self._npp))
             (self._init_prog, self._admit_prog, self._join_prog,
-             self._prefill_prog, self._copy_prog, self._table_prog,
+             self._prefill_prog, self._table_prog,
              self._step_prog, self._fetch_name) = \
                 transformer.build_paged_slot_decoder(
                     num_slots, max_length=max_length, d_model=d_model,
                     page_size=self._ps, num_pages=self._P,
                     num_groups=self._G, bos_id=bos_id, eos_id=eos_id,
-                    sampler=sampler, **decoder_cfg)
+                    sampler=sampler, beam_width=self._beam_width,
+                    **decoder_cfg)
+            if self._beam_width > 1:
+                # the beam builder returns a fetch-name DICT (token /
+                # parent / score / logits); the session fetches the
+                # first three every step
+                self._beam_fetches = dict(self._fetch_name)
+                self._fetch_name = self._beam_fetches["token"]
             pe = transformer.position_encoding_table(self._T, self._D)
             self._run(self._init_prog, {"pe_table": pe}, [])
             # page 0 is the trash page: never allocated, every
@@ -240,6 +303,47 @@ class SlotDecodeSession(object):
             # offline refcount verification (ckpt_inspect --verify) can
             # tell a by-design leak from a torn snapshot
             self._leaked_page_ids = set()
+            # coalesced COW dispatch machinery: one bucket-laddered
+            # executable per step window (build_cow_batch_prog), rung =
+            # smallest ladder entry >= the window's pair count. Rung
+            # programs build lazily and content-address across
+            # sessions; the ladder follows the suggest_buckets rung
+            # discipline so the executable set is finite and warm.
+            from paddle_tpu.analysis.lint import suggest_buckets
+
+            worst_pairs = max(
+                1, self._S * (1 + (self._steps - 1) // self._ps + 1))
+            self._cow_rungs = suggest_buckets([1, worst_pairs],
+                                              max_buckets=4)
+            self._cow_progs = {}
+            self.cow_dispatches = 0   # coalesced dispatch count (tests)
+            self.cow_pairs = 0        # real COW pairs dispatched
+            # eager rung warmup: every ladder executable compiles (and
+            # lands in the exec cache) at session BUILD, via a pad-only
+            # window — trash-page self-copies bound to slot 0's (still
+            # trash) table row, bit-neutral by construction. The
+            # zero-recompile steady state must not depend on which
+            # window sizes churn happens to produce first.
+            for rung in self._cow_rungs:
+                self._run(self._cow_prog(rung), {
+                    "src_pages": np.zeros(rung, "int64"),
+                    "dst_pages": np.zeros(rung, "int64"),
+                    "slot_idxs": np.zeros(rung, "int64"),
+                    "page_rows": np.zeros((rung, self._npp), "int64"),
+                }, [])
+            # beam bookkeeping (beam_width > 1): lanes of K aligned
+            # slots; per-step parent permutations mirrored here
+            self._beam_live = {}      # lane -> {"slots": [...]}
+            self._free_lanes = list(
+                range(self._S // self._beam_width - 1, -1, -1)) \
+                if self._beam_width > 1 else []
+            self._last_parents = {}   # lane -> last local parent perm
+            self._beam_events = {}    # lane -> last step's wire event
+            self._last_finished_beams = {}  # lane -> n-best payload
+            self._beam_owner = {}     # lane -> request id (wire/bank)
+            self._beam_results = {}   # rid -> {"tokens", "scores"}
+            self.beam_reorder_pages = 0  # physical page copies, reorder
+            self.beam_cow_copies = 0     # COW splits charged to beam
         else:
             if steps != 1:
                 raise ValueError(
@@ -374,52 +478,98 @@ class SlotDecodeSession(object):
             grew = True
         return grew
 
-    def _cow_copies(self, slot, pos):
+    def _cow_copies(self, slot, pos, pending=None):
         """Copy-on-write scan for one dispatch: every page this slot
         will WRITE in positions ``[pos, pos + steps)`` that is still
         shared (refcount > 1 — a fork sibling or the prefix cache
         holds it) is swapped for a freshly acquired private page.
         Returns [(src, dst)] pairs to copy; the slot's page list is
         already repointed. Shared pages are thereby immutable: no slot
-        ever writes a page another reference can read."""
+        ever writes a page another reference can read.
+
+        ``pending`` maps src page -> derefs already PLANNED by earlier
+        pairs of the same coalesced window (the window derefs only
+        after its one dispatch lands): the LAST planned holder still
+        writes in place, exactly as the sequential per-pair path did —
+        N sharers cost N-1 copies, not N."""
         pages = self._slot_pages[slot]
         first = int(pos) // self._ps
         last = min(int(pos) + self._steps - 1, self._T - 1) // self._ps
         copies = []
+        pending = pending if pending is not None else {}
         for i in range(first, min(last + 1, len(pages))):
-            if self._pool.refcount(pages[i]) > 1:
+            pg = pages[i]
+            if self._pool.refcount(pg) - pending.get(pg, 0) > 1:
                 dst = self._acquire_page()
-                copies.append((pages[i], dst))
+                copies.append((pg, dst))
                 pages[i] = dst
+                pending[pg] = pending.get(pg, 0) + 1
         return copies
 
-    def _dispatch_cow(self, slot, copies):
-        """Run one copy_prog dispatch per COW pair (page copy + table
-        repoint land atomically in one dispatch), then drop the source
-        reference. A FAILED copy dispatch may or may not have committed
-        device-side, so the host restores the shared source in its row
-        (consistent with an uncommitted dispatch) and LEAKS the
-        destination page (never freed — if the dispatch DID commit, the
-        device row points at it, and recycling it would hand a future
-        sequence a page the stale row still writes; if it didn't, the
-        copy's writes can only ever land in a page nobody else owns).
-        Same corruption-beats-capacity rule as ``_rollback_admission``;
-        leaked pages shrink the admission capacity bound."""
-        pages = self._slot_pages[slot]
-        for src_pg, dst_pg in copies:
-            try:
-                self._run(self._copy_prog, {
-                    "src_page": np.asarray([src_pg], dtype="int64"),
-                    "dst_page": np.asarray([dst_pg], dtype="int64"),
-                    "slot_idx": np.asarray([slot], dtype="int64"),
-                    "page_row": self._page_row(pages),
-                }, [])
-            except BaseException:
+    def _cow_prog(self, rung):
+        prog = self._cow_progs.get(rung)
+        if prog is None:
+            prog = self._transformer.build_cow_batch_prog(
+                self._S, self._T, self._n_layer, self._n_head,
+                self._D, self._ps, self._P, rung)
+            self._cow_progs[rung] = prog
+        return prog
+
+    def _dispatch_cow(self, window):
+        """ONE coalesced dispatch for a step window's COW pairs and
+        growth rebinds. ``window`` is ``[(slot, src, dst)]`` —
+        ``(slot, 0, 0)`` entries are rebind-only (a provisioned slot
+        whose row grew; the trash-page self-copy they pad the bucket
+        with is bit-neutral). The window pads up the rung ladder, every
+        copy lands before any repoint, and each slot's FINAL row rides
+        the same executable — the per-pair copy_prog's atomicity,
+        without its per-pair dispatch tax.
+
+        A FAILED dispatch may or may not have committed device-side, so
+        the host restores every shared source in its slot's row
+        (consistent with an uncommitted dispatch) and LEAKS every
+        destination page of the window (never freed — if the dispatch
+        DID commit, the device rows point at them, and recycling would
+        hand a future sequence a page a stale row still writes; if it
+        didn't, the copies' writes can only land in pages nobody else
+        owns). Same corruption-beats-capacity rule as
+        ``_rollback_admission``; leaked pages shrink the admission
+        capacity bound."""
+        if not window:
+            return
+        n = len(window)
+        rung = next((r for r in self._cow_rungs if r >= n),
+                    self._cow_rungs[-1])
+        if rung < n:  # window above the top rung: split it
+            self._dispatch_cow(window[:rung])
+            self._dispatch_cow(window[rung:])
+            return
+        pad_slot = window[0][0]
+        entries = list(window) + [(pad_slot, 0, 0)] * (rung - n)
+        feed = {
+            "src_pages": np.asarray([e[1] for e in entries], "int64"),
+            "dst_pages": np.asarray([e[2] for e in entries], "int64"),
+            "slot_idxs": np.asarray([e[0] for e in entries], "int64"),
+            "page_rows": np.concatenate(
+                [self._page_row(self._slot_pages[e[0]])
+                 for e in entries], axis=0),
+        }
+        copies = [(s, src, dst) for s, src, dst in window
+                  if not (src == 0 and dst == 0)]
+        try:
+            self._run(self._cow_prog(rung), feed, [])
+        except BaseException:
+            for slot, src_pg, dst_pg in copies:
+                pages = self._slot_pages[slot]
                 pages[pages.index(dst_pg)] = src_pg
-                self._leaked_pages += 1  # dst_pg stays allocated forever
+                self._leaked_pages += 1  # stays allocated forever
                 self._leaked_page_ids.add(dst_pg)
-                raise
+            raise
+        for _slot, src_pg, _dst in copies:
             self._pool.deref(src_pg)
+        self.cow_dispatches += 1
+        self.cow_pairs += len(copies)
+        _cow_dispatches.inc()
 
     def _write_table_row(self, slot, pages):
         self._run(self._table_prog, {
@@ -623,6 +773,11 @@ class SlotDecodeSession(object):
             raise ValueError(
                 "admit_group needs paged=True — the dense layout has "
                 "no shareable KV state")
+        if self._beam_width > 1:
+            raise ValueError(
+                "this is a beam session (beam_width=%d): slots are "
+                "lane-tiled — admissions go through admit_beam()"
+                % self._beam_width)
         n = int(n)
         if n < 1:
             raise ValueError("admit_group needs n >= 1, got %d" % n)
@@ -642,11 +797,18 @@ class SlotDecodeSession(object):
         finally:
             self._end_op()
 
-    def _admit_group_attempt(self, src, n, src_len, prefix_tokens):
-        if len(self._free) < n:
+    def _admit_group_attempt(self, src, n, src_len, prefix_tokens,
+                             slots_override=None):
+        if slots_override is None and len(self._free) < n:
             raise NoFreeSlotError(
                 "admit_group(n=%d): only %d of %d slots free; step() "
                 "until more free" % (n, len(self._free), self._S))
+        # beam admission hands the LANE's aligned slots in; the caller
+        # already removed them from the free stack (and restores the
+        # lane if this attempt rolls back)
+        pending_slots = (deque(slots_override)
+                         if slots_override is not None else None)
+        beam = self._beam_width > 1
         if not self._free_groups:
             raise NoFreeGroupError(
                 "all %d cross-K/V groups occupied; step() until a "
@@ -677,7 +839,8 @@ class SlotDecodeSession(object):
         k_full = (L - 1) // self._ps  # prefix pages that end up FULL
         try:
             # -- member 0: encoder forward + (any) prefill ------------------
-            slot0 = self._free.pop()
+            slot0 = (pending_slots.popleft() if pending_slots is not None
+                     else self._free.pop())
             slots.append(slot0)
             cached = []
             if self._prefix_cache is not None and L > 1:
@@ -697,6 +860,11 @@ class SlotDecodeSession(object):
                 "page_row": self._page_row(pages),
             }
             feed.update(start_feed)
+            if beam:
+                # hypothesis 0 seeds the lane's lattice at score 0; the
+                # rest ride at -1e9 (first-step duplicate suppression,
+                # the dense beam convention)
+                feed["start_score"] = np.asarray([[0.0]], "float32")
             if _chaos.ENABLED:
                 # the serve.admit kill/fault point: slots popped, pages
                 # provisioned, nothing dispatched — a fault here MUST
@@ -732,7 +900,8 @@ class SlotDecodeSession(object):
             # would only buy a guaranteed COW copy.
             shared = pages[:self._pages_for(max(L - 1, 0), self._ps)]
             for _ in range(1, n):
-                s = self._free.pop()
+                s = (pending_slots.popleft() if pending_slots is not None
+                     else self._free.pop())
                 slots.append(s)
                 mpages = []
                 for pg in shared:
@@ -746,23 +915,30 @@ class SlotDecodeSession(object):
                     "page_row": self._page_row(mpages),
                 }
                 jfeed.update(start_feed)
+                if beam:
+                    jfeed["start_score"] = np.asarray([[-1e9]],
+                                                      "float32")
                 self._run(self._join_prog, jfeed, [])
                 if L > 1:
                     _prefill_saved.inc(L - 1)
         except BaseException:
-            self._rollback_admission(slots, gid, n)
+            self._rollback_admission(slots, gid, n,
+                                     restore_free=pending_slots is None)
             raise
         self._group_members[gid] = set(slots)
-        for s in slots:
+        for k, s in enumerate(slots):
             trg = np.full(self._T, self._eos, dtype="int64")
             trg[:L] = prefix
             self._live[s] = {"trg": trg, "pos": L - 1}
+            if beam:
+                self._live[s]["done"] = False
+                self._live[s]["score"] = 0.0 if k == 0 else -1e9
             _sequences_total.inc(event="admitted")
         _active_slots.set(len(self._live))
         self._update_pool_gauges()
         return slots
 
-    def _rollback_admission(self, slots, gid, n):
+    def _rollback_admission(self, slots, gid, n, restore_free=True):
         """A failed admission dispatch must leave NO device table row
         pointing at pages that return to the free list: repoint each
         admitted slot's row at the trash page FIRST (the same order
@@ -792,12 +968,318 @@ class SlotDecodeSession(object):
                         self._pool.deref(pg)
         # restore the free stack exactly (pop order == re-pop order, so
         # a retried admission lands in the same slots => same PRNG
-        # streams)
-        for s in reversed(slots):
-            self._free.append(s)
+        # streams). Beam-lane admissions own their slot bookkeeping
+        # (restore_free=False): the caller returns the lane wholesale.
+        if restore_free:
+            for s in reversed(slots):
+                self._free.append(s)
         self._free_groups.append(gid)
         self._reserved_pages -= n * self._pages_for(self._T, self._ps)
         self._update_pool_gauges()
+
+    # -- beam decode ---------------------------------------------------------
+    @property
+    def beam_width(self):
+        return self._beam_width
+
+    @property
+    def free_beams(self):
+        """Unoccupied beam lanes (beam sessions)."""
+        return len(self._free_lanes) if self._beam_width > 1 else 0
+
+    @property
+    def active_beams(self):
+        """Lane ids currently decoding (beam sessions)."""
+        return sorted(self._beam_live) if self._beam_width > 1 else []
+
+    def beam_slots(self, beam_id):
+        """The K aligned slots of one live beam lane, hypothesis
+        order == slot order (top-k keeps survivors score-sorted)."""
+        return list(self._beam_live[int(beam_id)]["slots"])
+
+    def admit_beam(self, src, src_len=None, prefix_tokens=None):
+        """Claim one beam LANE (``beam_width`` aligned slots) for one
+        source: ONE encoder forward into a fresh cross-K/V group, one
+        chunked prefill for any forced prefix (prefix-cache hits
+        provision by reference, and all K hypotheses share the prefix
+        pages — a beam's shared prefix costs ONE set of physical
+        pages), hypothesis 0 seeded at score 0 and the rest at -1e9.
+        Returns the beam id (the lane index). Raises
+        :class:`NoFreeSlotError` when every lane is occupied, plus the
+        page/group rejects of ``admit_group`` — all with full
+        rollback. Admission is admit-or-reject (beams never ride the
+        solo backlog: their K x worst-case reservation is too large to
+        head-of-line park)."""
+        if self._beam_width < 2:
+            raise ValueError(
+                "admit_beam needs a beam session — build with "
+                "beam_width >= 2")
+        K = self._beam_width
+        self._gate_admission(K)
+        self._begin_op()
+        try:
+            return _retry.call(
+                lambda: self._admit_beam_attempt(src, src_len,
+                                                 prefix_tokens),
+                origin="serve.admit")
+        finally:
+            self._end_op()
+
+    def _admit_beam_attempt(self, src, src_len, prefix_tokens):
+        if not self._free_lanes:
+            raise NoFreeSlotError(
+                "all %d beam lanes occupied; step() until one "
+                "finishes" % (self._S // self._beam_width))
+        K = self._beam_width
+        lane = self._free_lanes.pop()
+        slots = [lane * K + k for k in range(K)]
+        for s in slots:
+            self._free.remove(s)
+        try:
+            self._admit_group_attempt(src, K, src_len, prefix_tokens,
+                                      slots_override=slots)
+        except BaseException:
+            # _admit_group_attempt rolled the pages/group back but left
+            # the slot stack alone (restore_free=False): the lane is
+            # returned wholesale, slots re-enter the free mirror
+            for s in reversed(slots):
+                self._free.append(s)
+            self._free_lanes.append(lane)
+            raise
+        self._beam_live[lane] = {"slots": slots}
+        self._last_parents[lane] = list(range(K))
+        _active_beams.set(len(self._beam_live))
+        return lane
+
+    def register_beam_owner(self, beam_id):
+        """Attach a request id to a live beam (the wire front end's
+        bank hook): when the beam finishes, its n-best lands in the
+        beam result bank under this id — and both the binding and the
+        bank ride the decode snapshot, so a preempted process's beams
+        stay claimable. Returns the id (session-monotonic, the same
+        counter solo requests draw from)."""
+        lane = int(beam_id)
+        if lane not in self._beam_live:
+            raise ValueError("beam %d is not live" % lane)
+        rid = self._next_req
+        self._next_req += 1
+        self._beam_owner[lane] = rid
+        return rid
+
+    def take_beam_result(self, request_id):
+        """Claim (and remove) a finished beam's n-best by request id:
+        ``{"tokens": [K, T] int64 (score-descending), "scores": [K]
+        float32}`` — or None if unknown/unfinished. Banked results
+        survive a preemption (they ride the decode snapshot) until
+        taken. Safe on any session (a dense/sampler session simply has
+        no beam bank) — the wire ``take_result`` probes both banks."""
+        bank = getattr(self, "_beam_results", None)
+        if not bank:
+            return None
+        return bank.pop(int(request_id), None)
+
+    @property
+    def last_beam_events(self):
+        """Per-lane survivor info from the LAST step dispatch —
+        ``{lane: {"parents", "tokens", "scores", "done"}}`` (what a
+        streaming front end flushes per dispatch). Finished lanes
+        appear in :attr:`last_finished_beams` instead."""
+        return self._beam_events
+
+    @property
+    def last_finished_beams(self):
+        """Beams the LAST step completed: ``{lane: {"tokens" [K, T],
+        "scores" [K], "slots"}}`` in score-descending hypothesis
+        order."""
+        return self._last_finished_beams
+
+    def _reorder_lane(self, slots, perm):
+        """Execute one lane's parent permutation on the HOST side. The
+        device already gathered the page-table rows in-graph; here the
+        refcounts catch up: each survivor references its parent's
+        pages, every pre-reorder list derefs. A pure permutation nets
+        every refcount unchanged — zero pages move, zero pages free,
+        zero copies; duplicated parents leave their pages shared until
+        COW splits the write page. Under
+        ``FLAGS_beam_reorder=reference`` the permutation is instead
+        materialized the pre-paged way: every survivor with
+        ``perm[k] != k`` COPIES its parent's resident pages into fresh
+        private ones (one coalesced dispatch; bytes counted) — the
+        copy-reorder baseline the bench A/Bs against, bit-identical by
+        construction."""
+        from paddle_tpu import flags as _flags
+
+        K = len(slots)
+        old_pages = [self._slot_pages[s] for s in slots]
+        # ref new lists first, then deref old: no page transits 0
+        new_pages = []
+        for k in range(K):
+            lst = list(old_pages[perm[k]])
+            for pg in lst:
+                self._pool.ref(pg)
+            new_pages.append(lst)
+        for lst in old_pages:
+            for pg in lst:
+                self._pool.deref(pg)
+        for k, s in enumerate(slots):
+            self._slot_pages[s] = new_pages[k]
+        if _flags.get("beam_reorder") != "reference":
+            return
+        # the copy-reorder oracle: physically privatize every moved
+        # hypothesis (the in-graph row gather already happened; these
+        # copies + repoints overwrite the rows in one dispatch). Every
+        # destination page is acquired BEFORE any slot's list mutates:
+        # a NoFreePageError mid-plan must leave the rebound refcounts
+        # exactly as they stand (pages just go back), never a slot
+        # whose host row diverged from the device row.
+        window = []
+        fresh_lists = {}
+        try:
+            for k, s in enumerate(slots):
+                if perm[k] == k:
+                    continue
+                fresh = []
+                for pg in self._slot_pages[s]:
+                    dst = self._acquire_page()
+                    window.append((s, pg, dst))
+                    fresh.append(dst)
+                fresh_lists[s] = fresh
+        except BaseException:
+            for _s, _src, dst in window:
+                self._pool.deref(dst)  # acquired at refcount 1
+            raise
+        for s, fresh in fresh_lists.items():
+            self._slot_pages[s] = fresh
+        if window:
+            self._dispatch_cow(window)  # derefs the sources on success
+            self.beam_reorder_pages += len(window)
+            _beam_reorder_bytes.inc(len(window) * self._page_bytes())
+
+    def _page_bytes(self):
+        dh = self._D // self._n_head
+        return 2 * self._n_layer * self._n_head * self._ps * dh * 4
+
+    def _step_beam(self):
+        # pre-dispatch COW/provisioning for LIVE hypotheses only: done
+        # hypotheses' writes route to the trash page in-graph, so a
+        # frozen slot never needs a private write page
+        before_pairs = self.cow_pairs
+        self._dispatch_cow(self._cow_window(
+            [(s, st["pos"]) for s, st in self._live.items()
+             if not st["done"]]))
+        split = self.cow_pairs - before_pairs
+        if split:
+            # write-page splits charged to BEAM decode (duplicated
+            # parents diverging at the write position); the oracle's
+            # reorder copies are counted apart (beam_reorder_pages)
+            self.beam_cow_copies += split
+            _beam_cow.inc(split)
+        self._update_pool_gauges()
+        extras = list(getattr(self, "_extra_step_fetches", ()))
+        t0 = time.perf_counter()
+        out = self._run(
+            self._step_prog, {},
+            [self._beam_fetches["token"], self._beam_fetches["parent"],
+             self._beam_fetches["score"]] + extras)
+        elapsed = time.perf_counter() - t0
+        toks, parents, scores = out[0], out[1], out[2]
+        # test hook: extra fetch names (e.g. the step logits for the
+        # offline-lattice parity test) ride the same dispatch
+        self.last_extra_fetches = [np.asarray(x) for x in out[3:]]
+        toks = np.asarray(toks).reshape(self._S)
+        parents = np.asarray(parents).reshape(self._S)
+        scores = np.asarray(scores).reshape(self._S)
+        K = self._beam_width
+        live_before = sum(1 for st in self._live.values()
+                          if not st["done"])
+        finished = {}
+        self._beam_events = {}
+        self._last_finished_beams = {}
+        for lane in sorted(self._beam_live):
+            slots = self._beam_live[lane]["slots"]
+            perm = [int(parents[s]) - slots[0] for s in slots]
+            old = [self._live[s] for s in slots]
+            if perm != list(range(K)):
+                self._reorder_lane(slots, perm)
+            new_states = []
+            for k, s in enumerate(slots):
+                parent = old[perm[k]]
+                tok = int(toks[s])
+                sc = float(scores[s])
+                if parent["done"]:
+                    # frozen hypothesis carried forward untouched (its
+                    # beam_step candidate was (eos, score))
+                    st = {"trg": parent["trg"].copy(),
+                          "pos": parent["pos"], "done": True,
+                          "score": sc}
+                else:
+                    pos = min(parent["pos"] + 1, self._T - 1)
+                    trg = parent["trg"].copy()
+                    trg[pos] = tok
+                    st = {"trg": trg, "pos": pos,
+                          "done": (tok == self._eos
+                                   or parent["pos"] + 1
+                                   >= self._T - 1),
+                          "score": sc}
+                new_states.append(st)
+            for k, s in enumerate(slots):
+                self._live[s] = new_states[k]
+            self._last_parents[lane] = perm
+            if all(st["done"] for st in new_states):
+                tokens = np.stack([st["trg"] for st in new_states])
+                lane_scores = np.asarray(
+                    [st["score"] for st in new_states], "float32")
+                self._last_finished_beams[lane] = {
+                    "tokens": tokens, "scores": lane_scores,
+                    "slots": list(slots),
+                    # the FINAL survivor chunk (a streaming front end
+                    # flushes it before the n-best, so an incremental
+                    # client's replay covers every step)
+                    "parents": perm,
+                    "step_tokens": [int(toks[s]) for s in slots],
+                    "step_scores": [float(scores[s]) for s in slots],
+                }
+                for s in slots:
+                    finished[s] = self._live[s]["trg"]
+                    del self._live[s]
+                    self._free.append(s)
+                    self._release_pages(s)
+                    _sequences_total.inc(event="completed")
+                del self._beam_live[lane]
+                self._free_lanes.append(lane)
+                self._last_parents.pop(lane, None)
+                rid = self._beam_owner.pop(lane, None)
+                if rid is not None:
+                    self._beam_results[rid] = {
+                        "tokens": tokens, "scores": lane_scores}
+            else:
+                self._beam_events[lane] = {
+                    "parents": perm,
+                    "tokens": [int(toks[s]) for s in slots],
+                    "scores": [float(scores[s]) for s in slots],
+                    "done": [bool(st["done"]) for st in new_states],
+                }
+        _active_slots.set(len(self._live))
+        _active_beams.set(len(self._beam_live))
+        if elapsed > 0:
+            _decode_tps.set(live_before / elapsed)
+        self._update_pool_gauges()
+        return finished
+
+    def generate_beam(self, src, src_len=None, prefix_tokens=None):
+        """Dedicated-session convenience: run ONE beam to completion
+        and return ``(tokens [K, T] int64, scores [K] float32)`` in
+        score-descending hypothesis order (bos-led, eos-padded rows).
+        Other lanes finishing meanwhile are returned to nobody — use
+        :meth:`register_beam_owner` + :meth:`take_beam_result` for
+        concurrent consumers."""
+        lane = self.admit_beam(src, src_len=src_len,
+                               prefix_tokens=prefix_tokens)
+        rid = self.register_beam_owner(lane)
+        while lane in self._beam_live:
+            self.step()
+        out = self.take_beam_result(rid)
+        return out["tokens"], out["scores"]
 
     def cancel(self, slot):
         """Abort one in-flight sequence — the disconnect/cancel
@@ -808,10 +1290,33 @@ class SlotDecodeSession(object):
         and any request ownership is dropped WITHOUT banking a result.
         Returns True when the slot was live. Call between dispatches
         (never mid-``step``); :attr:`pool_conserved` holds afterwards —
-        a killed client costs capacity nothing."""
+        a killed client costs capacity nothing.
+
+        On a BEAM session a slot is one hypothesis of a lane, and a
+        lane is one request: cancelling any member releases the WHOLE
+        beam (every sibling slot, the lane, the owner binding — nothing
+        banks)."""
         slot = int(slot)
         if slot not in self._live:
             return False
+        if self._beam_width > 1:
+            lane = slot // self._beam_width
+            binfo = self._beam_live.pop(lane, None)
+            if binfo is None:
+                return False
+            ok = True
+            for s in binfo["slots"]:
+                if s in self._live:
+                    ok = self._cancel_one(s) and ok
+            self._free_lanes.append(lane)
+            self._last_parents.pop(lane, None)
+            self._beam_events.pop(lane, None)
+            self._beam_owner.pop(lane, None)  # cancelled, never banked
+            _active_beams.set(len(self._beam_live))
+            return ok
+        return self._cancel_one(slot)
+
+    def _cancel_one(self, slot):
         self._begin_op()
         try:
             del self._live[slot]
@@ -871,8 +1376,11 @@ class SlotDecodeSession(object):
                 # servechaos CI leg), io/compile faults exercise the
                 # classified-retry shell the executor dispatch wears
                 _chaos.fault("serve.dispatch", step=self.steps_done)
-            out = (self._step_paged() if self._paged
-                   else self._step_dense())
+            if self._beam_width > 1:
+                out = self._step_beam()
+            else:
+                out = (self._step_paged() if self._paged
+                       else self._step_dense())
             self.steps_done += 1
         finally:
             self._end_op()
@@ -903,19 +1411,30 @@ class SlotDecodeSession(object):
             _decode_tps.set(live_before / elapsed)
         return finished
 
+    def _cow_window(self, slots_positions):
+        """Assemble one dispatch window's COW pairs + growth rebinds
+        for ``[(slot, write_pos)]``; the page lists are repointed here,
+        the device catches up in ONE ``_dispatch_cow`` call."""
+        window = []
+        pending = {}  # src -> derefs planned by this window's pairs
+        for slot, pos in slots_positions:
+            grew = self._provision(slot, pos + self._steps)
+            copies = self._cow_copies(slot, pos, pending)
+            for src_pg, dst_pg in copies:
+                window.append((slot, src_pg, dst_pg))
+            if grew and not copies:
+                window.append((slot, 0, 0))  # rebind-only entry
+        return window
+
     def _step_paged(self):
         # pre-provision every live slot for the whole dispatch: step j
         # writes K/V at position pos + j, so the table must cover
         # pos + steps resident tokens before the scan launches — and
         # any page the dispatch will WRITE that is still shared must be
-        # copy-on-write split first (shared pages are read-only)
-        for slot, st in self._live.items():
-            grew = self._provision(slot, st["pos"] + self._steps)
-            copies = self._cow_copies(slot, st["pos"])
-            if copies:
-                self._dispatch_cow(slot, copies)  # repoints the row too
-            elif grew:
-                self._write_table_row(slot, self._slot_pages[slot])
+        # copy-on-write split first (shared pages are read-only). All
+        # of the window's pairs ride ONE coalesced dispatch.
+        self._dispatch_cow(self._cow_window(
+            [(slot, st["pos"]) for slot, st in self._live.items()]))
         self._update_pool_gauges()
         t0 = time.perf_counter()
         (toks,) = self._exe.run_multi_step(
@@ -969,6 +1488,11 @@ class SlotDecodeSession(object):
         session continues the numbering, so ids name the same requests
         across a preemption). The queue is part of the decode snapshot:
         a preempted process restores with its backlog intact."""
+        if self._beam_width > 1:
+            raise ValueError(
+                "beam sessions are admit-or-reject (admit_beam): a "
+                "beam's K x worst-case reservation is too large to "
+                "head-of-line park in the solo backlog")
         rid = self._next_req
         self._next_req += 1
         src = np.asarray(src, dtype="int64").reshape(1, self._T)

@@ -184,7 +184,16 @@ class DecodeSnapshotManager(CheckpointManager):
             "n_head": s._n_head, "bos_id": s._bos, "eos_id": s._eos,
             "prefix_cache": s._prefix_cache is not None,
             "sampler": _sampler_state(s._sampler),
+            # beam geometry is part of the snapshot contract: restoring
+            # a beam snapshot into a differently-tiled session would
+            # scramble every lane's lattice — SnapshotMismatchError
+            "beam_width": s._beam_width,
         }
+
+    def _small_vars(self):
+        s = self._session
+        return _SMALL_VARS + (("pgd_score",)
+                              if s._beam_width > 1 else ())
 
     def _capture(self):
         """(vars dict, dialect meta) — the consistent host+device image
@@ -206,7 +215,7 @@ class DecodeSnapshotManager(CheckpointManager):
         # snapshot whose digests verify (computed over the garbage).
         # The copy happens HERE, synchronously at the quiesce point,
         # before any further dispatch can touch the buffers.
-        for name in _SMALL_VARS:
+        for name in self._small_vars():
             snap[name] = np.array(np.asarray(scope.get_value(name)))
         live_pages = sorted(s._pool._ref)
         live_groups = sorted(s._group_members)
@@ -232,11 +241,20 @@ class DecodeSnapshotManager(CheckpointManager):
         for rid, tokens in s._results.items():
             # completed-but-unclaimed results survive the preemption too
             snap["req_%d_result" % rid] = np.asarray(tokens)
+        for rid, res in s._beam_results.items():
+            # banked beam n-bests (tokens + scores) survive too
+            snap["req_%d_beam_tokens" % rid] = np.asarray(res["tokens"])
+            snap["req_%d_beam_scores" % rid] = np.asarray(res["scores"])
         meta = {
             "version": DIALECT_VERSION,
             "config": self._config(),
-            "live": {str(slot): {"pos": int(st["pos"])}
-                     for slot, st in s._live.items()},
+            # beam slots carry their hypothesis lifecycle (done latch +
+            # accumulated score) beside the position
+            "live": {str(slot): (
+                {"pos": int(st["pos"]), "done": bool(st["done"]),
+                 "score": float(st["score"])}
+                if "done" in st else {"pos": int(st["pos"])})
+                for slot, st in s._live.items()},
             "free_slots": list(s._free),
             "slot_pages": {str(k): [int(p) for p in v]
                            for k, v in s._slot_pages.items()},
@@ -261,6 +279,23 @@ class DecodeSnapshotManager(CheckpointManager):
             "next_req": s._next_req,
             "steps_done": s.steps_done,
         }
+        if s._beam_width > 1:
+            # the hypothesis->slot binding, lane occupancy, last parent
+            # permutation and banked n-bests — mid-beam restores resume
+            # the lattice bit-exactly (scores ride pgd_score + live[])
+            meta["beam"] = {
+                "width": s._beam_width,
+                "lanes": {str(lane): {"slots": [int(x)
+                                                for x in b["slots"]]}
+                          for lane, b in s._beam_live.items()},
+                "free_lanes": [int(x) for x in s._free_lanes],
+                "last_parents": {str(lane): [int(p) for p in perm]
+                                 for lane, perm
+                                 in s._last_parents.items()},
+                "owner": {str(lane): int(rid)
+                          for lane, rid in s._beam_owner.items()},
+                "results": sorted(s._beam_results),
+            }
         return snap, meta
 
     # -- save ---------------------------------------------------------------
@@ -393,7 +428,7 @@ class DecodeSnapshotManager(CheckpointManager):
             return assemble_var(step_dir, vars_meta[name])
 
         # -- phase 1: load + validate (no session mutation) ---------------
-        small = {name: load(name) for name in _SMALL_VARS}
+        small = {name: load(name) for name in self._small_vars()}
         live_trg = load("live_trg")
         live_pages = [int(p) for p in meta["live_pages"]]
         live_groups = [int(g) for g in meta["live_groups"]]
@@ -420,9 +455,24 @@ class DecodeSnapshotManager(CheckpointManager):
         } for r in meta["pending"]]
         results = {int(r): np.asarray(load("req_%d_result" % int(r)))
                    for r in meta.get("results", ())}
-        live = {int(k): {"trg": np.array(live_trg[int(k)]),
-                         "pos": int(v["pos"])}
-                for k, v in meta["live"].items()}
+        beam_meta = meta.get("beam")
+        beam_results = {}
+        if beam_meta is not None:
+            beam_results = {
+                int(r): {
+                    "tokens": np.asarray(
+                        load("req_%d_beam_tokens" % int(r))),
+                    "scores": np.asarray(
+                        load("req_%d_beam_scores" % int(r))),
+                } for r in beam_meta.get("results", ())}
+        live = {}
+        for k, v in meta["live"].items():
+            st = {"trg": np.array(live_trg[int(k)]),
+                  "pos": int(v["pos"])}
+            if "done" in v:
+                st["done"] = bool(v["done"])
+                st["score"] = float(v["score"])
+            live[int(k)] = st
 
         # -- phase 2: commit ----------------------------------------------
         scope = self._session_scope()
@@ -452,6 +502,23 @@ class DecodeSnapshotManager(CheckpointManager):
         s._owner = {int(k): int(v) for k, v in meta["owner"].items()}
         s._next_req = int(meta["next_req"])
         s.steps_done = int(meta["steps_done"])
+        if beam_meta is not None:
+            from paddle_tpu.serving.generation import _active_beams
+
+            s._beam_live = {
+                int(lane): {"slots": [int(x) for x in b["slots"]]}
+                for lane, b in beam_meta["lanes"].items()}
+            s._free_lanes = [int(x) for x in beam_meta["free_lanes"]]
+            s._last_parents = {
+                int(lane): [int(p) for p in perm]
+                for lane, perm in beam_meta["last_parents"].items()}
+            s._beam_owner = {int(lane): int(rid)
+                             for lane, rid
+                             in beam_meta["owner"].items()}
+            s._beam_results = beam_results
+            s._beam_events = {}
+            s._last_finished_beams = {}
+            _active_beams.set(len(s._beam_live))
         s._update_pool_gauges()
         from paddle_tpu.serving.generation import _active_slots
 

@@ -418,6 +418,66 @@ def test_generate_best_of_and_prefix_reuse_over_the_wire(trained):
     assert stats["lookups"] >= 2 and stats["hits"] >= 1, stats
 
 
+def test_generate_beam_over_the_wire_matches_in_process(trained):
+    """Beam socket parity (PR 15): the wire grammar — ``admitted`` with
+    beam metadata, one ``beam`` survivor chunk per dispatch, a final
+    ``beam_end`` n-best — reassembles bit-identical to the in-process
+    ``generate_beam``, the client's incremental replay cross-checks the
+    chunks against the n-best, and a disconnected beam stream returns
+    every lane slot to conservation."""
+    src = trained["src"]
+    args = dict(num_slots=S, max_length=SEQ, d_model=D, paged=True,
+                page_size=4, beam_width=2,
+                scope=trained["scope"].new_scope())
+    args.update(CFG)
+    sess = SlotDecodeSession(trained["exe"], **args)
+    with ServingFrontend(session=sess) as fe:
+        cl = ServingClient(fe.address)
+        events = []
+        got_t, got_s = cl.generate_beam(src[0], src_len=SEQ,
+                                        on_event=events.append)
+        kinds = [e["event"] for e in events]
+        assert kinds[0] == "admitted" and kinds[-2:] == ["beam_end",
+                                                         "end"]
+        adm = events[0]
+        assert adm["beam_width"] == 2 and len(adm["slots"]) == 2
+        # one survivor chunk PER DISPATCH (parents + tokens + scores),
+        # not an end-of-beam lump
+        beam_events = [e for e in events if e["event"] == "beam"]
+        assert len(beam_events) >= 3
+        assert all(len(e["parents"]) == 2 and len(e["tokens"]) == 2
+                   for e in beam_events)
+        # beam=True composes with nothing: n > 1 is a typed reject
+        with pytest.raises(ServingError):
+            list(cl.generate(src[0], src_len=SEQ, n=2, beam=True))
+        # disconnect mid-beam: the whole lane reclaims
+        gen = cl.generate(src[1], src_len=SEQ, beam=True)
+        assert next(gen)["event"] == "admitted"
+        cl.close()  # severed socket: the close hook cancels the beam
+    assert _drained(sess)
+    assert sess.free_beams == S // 2 and sess.pool_conserved
+    # wire parity: the frontend is closed, the session is drained — the
+    # SAME session decoding the SAME source in-process must reproduce
+    # the wire n-best bit-for-bit (the greedy lattice is deterministic)
+    want_t, want_s = sess.generate_beam(src[0], SEQ)
+    np.testing.assert_array_equal(got_t, want_t)
+    np.testing.assert_array_equal(got_s, want_s)
+    # a beam that finishes with NO attached stream (the
+    # preemption-orphan shape) banks its n-best under the claim id —
+    # and the wire take_result reaches the beam bank
+    lane = sess.admit_beam(src[1], SEQ)
+    rid = sess.register_beam_owner(lane)
+    while lane in sess.active_beams:
+        sess.step()
+    with ServingFrontend(session=sess) as fe2:
+        cl2 = ServingClient(fe2.address)
+        bt, bs = cl2.take_result(rid)
+        cl2.close()
+    assert sess.take_beam_result(rid) is None  # claimed over the wire
+    np.testing.assert_array_equal(bt, sess.generate_beam(src[1], SEQ)[0])
+    assert bs.shape == (2,)
+
+
 def test_generate_backlog_exceeding_slots_completes_concurrently(
         trained):
     """6 concurrent wire streams over a 4-slot pool: the overflow rides

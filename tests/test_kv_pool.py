@@ -134,6 +134,13 @@ class _HostModel(object):
         if self.pool.refcount(pg) > 1:  # COW
             dst = self.pool.acquire()
             st["pages"][idx] = dst
+            # the writer moved off pg: it no longer HOLDS the page, so
+            # its write claim goes with it (the remaining holder may
+            # legitimately become sole owner and write in place later)
+            st["written"].discard(pg)
+            w = self.writers.get(pg)
+            if w is not None:
+                w.discard(sid)
             self.pool.deref(pg)
             pg = dst
         st["written"].add(pg)
@@ -145,6 +152,33 @@ class _HostModel(object):
             if self.pool.deref(pg) == 0:
                 self.writers.pop(pg, None)
         self.reserved -= self.npp
+
+    def reorder(self, sids, perm):
+        """Beam hypothesis reorder as REFCOUNT REBINDS (the PR 15
+        zero-copy path): hypothesis ``i`` adopts ``sids[perm[i]]``'s
+        page list by reference — ref every adopted page FIRST, then
+        deref every pre-reorder list, so no page transits refcount 0
+        mid-reorder. A pure permutation nets every count unchanged
+        (zero pages move, zero free); duplicated parents leave pages
+        shared until ``write`` COWs them; dropped hypotheses' private
+        tails free. Adopters continue their PARENT's lineage, so the
+        reordered sids' write ownership resets — future writes re-claim
+        pages one COW at a time."""
+        old = [list(self.seqs[s]["pages"]) for s in sids]
+        for p in perm:
+            for pg in old[p]:
+                self.pool.ref(pg)
+        for lst in old:
+            for pg in lst:
+                if self.pool.deref(pg) == 0:
+                    self.writers.pop(pg, None)
+        for i, s in enumerate(sids):
+            self.seqs[s]["pages"] = list(old[perm[i]])
+            for pg in self.seqs[s]["written"]:
+                w = self.writers.get(pg)
+                if w is not None:
+                    w.discard(s)
+            self.seqs[s]["written"] = set()
 
     def check(self):
         pool = self.pool
@@ -203,22 +237,34 @@ def test_insert_never_creates_unreachable_chain_entries():
 
 
 def test_property_random_admit_fork_release_prefix():
-    """Seeded random drive: 400 ops over a small pool + cache — now
-    with SNAPSHOT/RESTORE interleaved (op 5: the allocator + trie are
+    """Seeded random drive: 600 ops over a small pool + cache — with
+    SNAPSHOT/RESTORE interleaved (op 5: the allocator + trie are
     serialized through the decode-snapshot dialect's state_dict/
-    from_state and the drive continues on the restored objects) — the
-    conservation/exclusivity/rollback laws hold after every op AND
-    across every restore."""
+    from_state and the drive continues on the restored objects) and
+    the PR 15 BEAM ops (op 6 fork-K: a lane of K hypotheses
+    referencing one parent's pages; op 7 reorder-permutation: the
+    zero-copy rebind, with duplicating/dropping perms; op 8
+    drop-hypothesis: one lane member cancels) — the conservation/
+    exclusivity/rollback laws hold after every op AND across every
+    restore."""
     rng = np.random.RandomState(1234)
-    pool = PagePool(12)  # 11 allocatable
+    pool = PagePool(24)  # 23 allocatable
     npp = 3
     cache = PrefixCache(pool, PS, max_pages=4)
     model = _HostModel(pool, npp)
     cached_keys = []  # (fp, tokens) inserted so far
-    restores = 0
-    for opno in range(400):
-        op = rng.randint(6)
+    lanes = []        # beam lanes: lists of sids reordered together
+    restores = reorders = pure_perms = 0
+    for opno in range(600):
+        # beam ops weighted up: a lane must exist before a reorder can
+        # fire, and fork-K's K x npp reservation rejects often on a
+        # small pool — the drive needs the extra attempts
+        op = [0, 1, 2, 3, 4, 5, 6, 6, 7, 7, 7, 8][rng.randint(12)]
         live = sorted(model.seqs)
+        # a lane survives as its LIVE members (a released/cancelled
+        # hypothesis leaves the lattice; the rest keep reordering)
+        lanes = [[s for s in ln if s in model.seqs] for ln in lanes]
+        lanes = [ln for ln in lanes if len(ln) > 1]
         try:
             if op == 0:  # admit, maybe through a prefix-cache hit
                 pages = []
@@ -252,12 +298,46 @@ def test_property_random_admit_fork_release_prefix():
                 cache = PrefixCache.from_state(pool, cache.state_dict())
                 model.pool = pool
                 restores += 1
+            elif op == 6 and live:  # beam fork-K: K hypotheses off one
+                # parent (each a reservation-checked fork referencing
+                # the parent's whole list — the beam admission shape)
+                parent = live[rng.randint(len(live))]
+                upto = len(model.seqs[parent]["pages"])
+                K = 2 + rng.randint(2)
+                lane = [parent]
+                for _ in range(K - 1):
+                    lane.append(model.fork(parent, upto))
+                lanes.append(lane)
+            elif op == 7 and lanes:  # beam reorder: rebind refcounts
+                # along a random parent map (duplicates drop losers,
+                # repeats share winners; sometimes a pure permutation)
+                lane = lanes[rng.randint(len(lanes))]
+                K = len(lane)
+                if rng.rand() < 0.4:  # pure permutation: zero net moves
+                    perm = list(rng.permutation(K))
+                    free0, alloc0 = pool.free_count, pool.allocated_count
+                    model.reorder(lane, perm)
+                    # THE zero-copy law: a pure permutation allocates
+                    # nothing, frees nothing, copies nothing
+                    assert (pool.free_count, pool.allocated_count) == \
+                        (free0, alloc0)
+                    pure_perms += 1
+                else:
+                    perm = [rng.randint(K) for _ in range(K)]
+                    model.reorder(lane, perm)
+                reorders += 1
+            elif op == 8 and lanes:  # drop-hypothesis (cancel path)
+                lane = lanes[rng.randint(len(lanes))]
+                model.release(lane[rng.randint(len(lane))])
         except NoFreePageError:
             # the reject IS the property: counts must be unchanged by a
             # failed admission (checked below like every other op)
             pass
         model.check()
     assert restores > 0, "the drive never exercised a restore"
+    assert reorders > 5 and pure_perms > 0, \
+        "the drive never exercised beam reorders (%d/%d)" \
+        % (reorders, pure_perms)
     # drain: release everything, clear the cache -> full free list
     for sid in sorted(model.seqs):
         model.release(sid)

@@ -270,7 +270,9 @@ def test_cow_failure_leaks_destination_instead_of_freeing(trained):
     class _PostDispatchCopyFault(object):
         def run(self, prog, **kw):
             out = orig_exe.run(prog, **kw)
-            if state["armed"] and prog is sess._copy_prog:
+            # COW rides the coalesced bucket-ladder programs now (one
+            # dispatch per step window), not the per-pair copy_prog
+            if state["armed"] and prog in sess._cow_progs.values():
                 state["armed"] = False
                 raise ChaosTransientError(
                     "chaos: post-dispatch copy fault")

@@ -94,7 +94,8 @@ def _decode_summary(ds):
     pool = ds.get("pool") or {}
     ref = pool.get("ref") or {}
     cache = ds.get("prefix_cache")
-    return {
+    beam = ds.get("beam")
+    out = {
         "config": cfg,
         "steps_done": ds.get("steps_done"),
         "live_slots": sorted(int(k) for k in (ds.get("live") or {})),
@@ -108,6 +109,32 @@ def _decode_summary(ds):
                            if cache else 0),
         "pending_requests": len(ds.get("pending") or []),
     }
+    if beam:
+        # beam bookkeeping: width, live lanes with hypothesis->slot
+        # bindings, per-hypothesis scores/done (from the live map) and
+        # the last parent permutation
+        live = ds.get("live") or {}
+        lanes = {}
+        for lane, b in sorted((beam.get("lanes") or {}).items(),
+                              key=lambda kv: int(kv[0])):
+            slots = [int(x) for x in b.get("slots", [])]
+            lanes[int(lane)] = {
+                "slots": slots,
+                "scores": [live.get(str(s), {}).get("score")
+                           for s in slots],
+                "done": [live.get(str(s), {}).get("done")
+                         for s in slots],
+                "last_parents": [
+                    int(p) for p in (beam.get("last_parents") or {})
+                    .get(str(lane), [])],
+            }
+        out["beam"] = {
+            "width": beam.get("width"),
+            "lanes": lanes,
+            "free_lanes": len(beam.get("free_lanes") or []),
+            "banked_results": len(beam.get("results") or []),
+        }
+    return out
 
 
 def _decode_verify(ds):
@@ -159,6 +186,47 @@ def _decode_verify(ds):
         problems.append(
             "gathered live_pages %s disagree with pool refcounts %s"
             % (live_pages[:8], sorted(ref)[:8]))
+    beam = ds.get("beam")
+    if beam:
+        # beam-binding cross-check: every lane's hypothesis slots must
+        # be lane-aligned, LIVE, and hold a page list the refcounts
+        # above already accounted for — a lane pointing at a freed or
+        # foreign slot is a torn reorder
+        width = int(beam.get("width") or 0)
+        live = ds.get("live") or {}
+        slot_pages = ds.get("slot_pages") or {}
+        seen = set()
+        for lane, b in sorted((beam.get("lanes") or {}).items()):
+            slots = [int(x) for x in b.get("slots", [])]
+            if len(slots) != width or any(
+                    s // width != int(lane) for s in slots):
+                problems.append(
+                    "beam lane %s slots %s are not %d aligned "
+                    "hypotheses of that lane" % (lane, slots, width))
+            for s in slots:
+                if s in seen:
+                    problems.append(
+                        "slot %d bound to two beam lanes" % s)
+                seen.add(s)
+                if str(s) not in live:
+                    problems.append(
+                        "beam lane %s binds slot %d which is not "
+                        "live" % (lane, s))
+                if str(s) not in slot_pages:
+                    problems.append(
+                        "beam lane %s binds slot %d with no page "
+                        "list — its refcounts are unaccounted"
+                        % (lane, s))
+        lanes_total = (int((ds.get("config") or {})
+                           .get("num_slots", 0)) // width
+                       if width else 0)
+        if (width and len(beam.get("lanes") or {})
+                + len(beam.get("free_lanes") or []) != lanes_total):
+            problems.append(
+                "beam lane conservation broken: %d live + %d free != "
+                "%d lanes" % (len(beam.get("lanes") or {}),
+                              len(beam.get("free_lanes") or []),
+                              lanes_total))
     return problems
 
 
@@ -269,6 +337,20 @@ def main(argv=None):
                 print("  prefix trie: %d entries;  pending requests: %d"
                       % (decode["prefix_entries"],
                          decode["pending_requests"]))
+                beam = decode.get("beam")
+                if beam:
+                    print("  beam: width=%s  lanes live=%d free=%d  "
+                          "banked n-bests=%d" % (
+                              beam["width"], len(beam["lanes"]),
+                              beam["free_lanes"],
+                              beam["banked_results"]))
+                    for lane, b in sorted(beam["lanes"].items()):
+                        print("    lane %s: slots=%s scores=%s "
+                              "done=%s parents=%s" % (
+                                  lane, b["slots"],
+                                  ["%.3f" % s if s is not None
+                                   else "?" for s in b["scores"]],
+                                  b["done"], b["last_parents"]))
             sharding = info.get("sharding")
             if sharding:
                 mesh = sharding.get("mesh_axes") or {}

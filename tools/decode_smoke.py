@@ -21,6 +21,14 @@ Asserted, in order:
   * **Page hygiene.** After the pool drains, every page is back on the
     free list and the ``paddle_tpu_serving_kv_pages_in_use`` gauge
     reads 0.
+  * **Beam churn (PR 15).** Staggered ``beam_width=4`` admissions
+    through the zero-copy reorder path: per-step parent permutations
+    land as in-graph table-row gathers + host refcount rebinds (ZERO
+    pages physically copied — asserted), the whole staggered wave adds
+    ZERO fresh compiles after one warmup wave, the token streams and
+    n-best scores are BIT-identical to the copy-reorder oracle
+    (``FLAGS_beam_reorder=reference`` — same geometry, same
+    content-addressed executables), and the pool conserves at drain.
   * **Cross-request reuse churn (PR 12).** Best-of-N fork groups over
     a forced prefix (admit_group -> one encoder + one chunked prefill
     + joins; the top-k sampler forces member divergence, so the
@@ -199,12 +207,111 @@ def bestofn_prefix_churn():
           % (st["hit_rate"], st["tokens_saved"]))
 
 
+def beam_churn():
+    """Batched beam search over the slot pool (PR 15): staggered beam
+    admissions through the zero-copy reorder path hold the
+    zero-recompile contract, decode BIT-identical to the copy-reorder
+    reference oracle (``FLAGS_beam_reorder=reference``), copy zero
+    pages on pure parent permutations, and conserve the pool at
+    drain."""
+    import paddle_tpu as fluid
+    from paddle_tpu import flags as _flags
+    from paddle_tpu.core import exec_cache
+    from paddle_tpu.models import transformer
+    from paddle_tpu.observability import REGISTRY
+    from paddle_tpu.serving.generation import SlotDecodeSession
+
+    vocab, seq, dm, S, bw = 40, 16, 32, 8, 4
+    cfg = dict(src_vocab_size=vocab, trg_vocab_size=vocab, n_layer=1,
+               n_head=2, d_inner=64)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = 37
+    startup.random_seed = 37
+    with fluid.program_guard(main_prog, startup):
+        transformer.build(dropout=0.0, label_smooth_eps=0.0,
+                          max_length=seq, d_model=dm, **cfg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(41)
+    srcs = rng.randint(3, vocab, (4, seq)).astype("int64")
+    # both sessions share one geometry (and therefore one
+    # content-addressed program set): the oracle's transient full-copy
+    # reorders need page headroom, so both get it
+    pages = 1 + 2 * S * (seq // 4)
+
+    def mk():
+        return SlotDecodeSession(
+            exe, num_slots=S, max_length=seq, d_model=dm, paged=True,
+            page_size=4, beam_width=bw, num_pages=pages, **cfg)
+
+    def staggered_wave(sess):
+        """Two beams admitted 3 dispatches apart — the reorder, COW
+        and release paths all run at mixed lane ages."""
+        a = sess.admit_beam(srcs[0], seq)
+        ra = sess.register_beam_owner(a)
+        for _ in range(3):
+            sess.step()
+        b = sess.admit_beam(srcs[1], seq - 2)
+        rb = sess.register_beam_owner(b)
+        while sess.active_beams:
+            sess.step()
+        out = [sess.take_beam_result(ra), sess.take_beam_result(rb)]
+        out.append(sess.generate_beam(srcs[2], seq))
+        return out
+
+    swap = mk()
+    staggered_wave(swap)  # warmup: compiles the whole beam set once
+    before = exec_cache.stats()["fresh_compiles"]
+    before_scrape = _scrape_fresh_compiles()
+    got = staggered_wave(swap)
+    assert exec_cache.stats()["fresh_compiles"] == before, (
+        "staggered beam churn paid %d fresh compiles"
+        % (exec_cache.stats()["fresh_compiles"] - before))
+    after_scrape = _scrape_fresh_compiles()
+    if before_scrape is not None:
+        assert after_scrape == before_scrape, \
+            "metrics scrape shows fresh compiles during beam churn"
+    assert swap.beam_reorder_pages == 0, (
+        "rebind reorders physically copied %d pages"
+        % swap.beam_reorder_pages)
+
+    # swap-vs-copy bit equality: the copy-reorder oracle (same
+    # geometry, same executables — 0 extra compiles for the mode flip)
+    _flags.set_flag("beam_reorder", "reference")
+    try:
+        copy_sess = mk()
+        ref = staggered_wave(copy_sess)
+    finally:
+        _flags.set_flag("beam_reorder", "rebind")
+    assert copy_sess.beam_reorder_pages > 0, \
+        "the copy oracle never copied a page"
+    for g, r in zip(got, ref):
+        gt, gs = (g["tokens"], g["scores"]) if isinstance(g, dict) else g
+        rt, rs = (r["tokens"], r["scores"]) if isinstance(r, dict) else r
+        np.testing.assert_array_equal(gt, rt)
+        np.testing.assert_array_equal(gs, rs)
+
+    # drain hygiene: lanes free, pool conserved, gauges current
+    for sess in (swap, copy_sess):
+        assert sess.pool_conserved and sess.free_beams == S // bw
+        assert sess.pages_in_use == 0
+    text = REGISTRY.to_prometheus()
+    assert "paddle_tpu_serving_active_beams 0" in text
+    assert "paddle_tpu_serving_beam_reorder_bytes_total" in text
+    assert "paddle_tpu_serving_beam_cow_copies_total" in text
+    print("decode_smoke: beam churn OK — 0 fresh compiles across "
+          "staggered beam waves, swap == copy oracle bit-exact, 0 "
+          "pages moved by rebind reorders (%d by the oracle), pool "
+          "conserved at drain" % copy_sess.beam_reorder_pages)
+
+
 def main():
     if len(sys.argv) != 2:
         sys.exit("usage: decode_smoke.py OUTPUT_DIR")
     out_dir = sys.argv[1]
     churn_invariants()
     bestofn_prefix_churn()
+    beam_churn()
 
     # the capture comes from bench.py's decode worker in its OWN
     # process — the same leg (and the same compile-count accounting)

@@ -65,6 +65,14 @@ METRICS = {
     "bestofn_speedup": ("higher", "timing"),
     "prefix_hit_rate": ("higher", "timing"),
     "cross_kv_bytes": ("lower", "deterministic"),
+    # batched beam search over the slot pool (PR 15): rebind-vs-copy
+    # reorder tokens/sec ratio (bit-identical n-bests asserted in-leg)
+    # and the rebind wave's physically-moved reorder bytes (reorder
+    # copies + write-page COW, page-geometry-accounted; deterministic
+    # under greedy decode — growth means reorders started copying KV
+    # or COW stopped being write-page-only)
+    "beam_speedup": ("higher", "timing"),
+    "beam_reorder_bytes": ("lower", "deterministic"),
     # serving resilience (tools/serve_chaos_smoke.py): wall seconds of
     # one synchronous decode snapshot in the restored warm process
     "snapshot_seconds": ("lower", "timing"),
@@ -96,6 +104,8 @@ def _bench_model_metrics(m):
     out["bestofn_speedup"] = m.get("bestofn_speedup")
     out["prefix_hit_rate"] = m.get("prefix_hit_rate")
     out["cross_kv_bytes"] = m.get("cross_kv_bytes")
+    out["beam_speedup"] = m.get("beam_speedup")
+    out["beam_reorder_bytes"] = m.get("beam_reorder_bytes")
     out["snapshot_seconds"] = m.get("snapshot_seconds")
     out["ttft_ms"] = m.get("ttft_ms")
     ec = m.get("exec_cache") or {}

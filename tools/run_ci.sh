@@ -175,11 +175,16 @@ if want decode; then
   # and the drained pool must return every KV page; a second leg churns
   # the CROSS-REQUEST reuse paths (best-of-N fork groups + forced
   # divergence/COW + prefix-cache hits + release/re-admit) asserting 0
-  # fresh compiles and refcount conservation at drain; then the bench
-  # decode worker lands an A/B capture (paged vs dense tokens/sec at
-  # mixed lengths / low occupancy, plus the shared-vs-unshared
-  # best-of-N ratio, prefix hit rate and grouped cross-K/V bytes) that
-  # perf_diff gates against the committed decode budgets
+  # fresh compiles and refcount conservation at drain; a third leg (PR
+  # 15) churns staggered BEAM admissions — 0 fresh compiles at warm
+  # steady state, zero pages physically moved by rebind reorders, and
+  # token/score bit-equality against the FLAGS_beam_reorder=reference
+  # copy oracle; then the bench decode worker lands an A/B capture
+  # (paged vs dense tokens/sec at mixed lengths / low occupancy, the
+  # shared-vs-unshared best-of-N ratio, prefix hit rate, grouped
+  # cross-K/V bytes, plus beam_speedup / beam_reorder_bytes from the
+  # rebind-vs-copy beam A/B) that perf_diff gates against the
+  # committed decode budgets
   dcdir="$(mktemp -d)"
   trap 'rm -rf "$dcdir"' EXIT
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu FLAGS_telemetry=1 \
