@@ -470,6 +470,20 @@ def _bench_decode(fluid, on_tpu):
     plus write-page COW splits x page bytes); deterministic under
     greedy decode, gated hard: growth means reorders started copying
     or COW stopped being write-page-only.
+
+    PR 16 adds the SPECULATIVE A/B: ``speculative={"k": 3}`` decode
+    (ngram drafter, tree-attention verify — k + 1 tree nodes scored in
+    ONE target dispatch) vs the SAME session under
+    ``FLAGS_speculative=off`` (sequential ``steps=1`` decode, the
+    bit-exactness oracle). The arm runs the prompt-lookup regime the
+    drafter exists for: a briefly copy-trained model over periodic
+    sources behind a forced prefix that seeds the suffix lookup (the
+    drafter matches over emitted tokens + forced prefix). One session,
+    one program set, a flag flip between waves; both arms decode
+    bit-identical tokens (asserted), so ``speculative_speedup`` is
+    pure dispatch amortization — tokens committed per target
+    dispatch — and ``acceptance_rate`` is the drafter's measured
+    accepted/proposed ratio over the timed wave.
     """
     from paddle_tpu.kernels import paged_attention as pk
     from paddle_tpu.models import transformer
@@ -622,6 +636,72 @@ def _bench_decode(fluid, on_tpu):
     page_bytes = 2 * cfg["n_layer"] * n_head * ps * (dm // n_head) * 4
     beam_speedup = (beam_tok / rb_dt) / (beam_tok / ref_dt)
 
+    # --- speculative A/B (PR 16): draft-then-verify vs the sequential
+    # off-oracle on the SAME session — a flag flip between waves, so
+    # the ratio is pure dispatch amortization over identical tokens.
+    # The n-gram drafter only pays off when the decode stream actually
+    # repeats, so this arm runs the prompt-lookup regime speculative
+    # decoding exists for: a briefly copy-trained model over periodic
+    # sources. Training runs LAST, in its own programs — every
+    # deterministic budget above was captured before a weight moved.
+    tr_main, tr_startup = fluid.Program(), fluid.Program()
+    tr_main.random_seed = 21
+    tr_startup.random_seed = 21
+    # fresh unique_name scope: the training build must mint the SAME
+    # param names as the leg's first build (the names every decode
+    # session binds), or Adam would train a disconnected copy
+    with fluid.program_guard(tr_main, tr_startup), \
+            fluid.unique_name.guard({}):
+        loss, _feeds, _extras = transformer.build(
+            dropout=0.0, label_smooth_eps=0.0, max_length=seq,
+            d_model=dm, **cfg)
+        fluid.optimizer.Adam(learning_rate=0.003).minimize(loss)
+    exe.run(tr_startup)
+    trng = np.random.RandomState(22)
+    for _ in range(300):
+        ts = trng.randint(3, vocab, (16, seq)).astype("int64")
+        ttrg = np.full_like(ts, 1)
+        ttrg[:, 1:] = ts[:, :-1]
+        full = np.full((16, 1), seq, "int64")
+        exe.run(tr_main, feed={"src_word": ts, "src_len": full,
+                               "trg_word": ttrg, "trg_len": full,
+                               "label": ts}, fetch_list=[loss])
+    motif = trng.randint(3, vocab, (B, 4)).astype("int64")
+    src_sp = np.tile(motif, (1, seq // 4))
+    # two periods of forced prefix: the drafter suffix-matches over
+    # emitted tokens + forced prefix, so admission seeds the lookup
+    # and the first verify already speculates at full acceptance
+    pfx_sp = [[int(t) for t in row[:8]] for row in src_sp]
+
+    spec = SlotDecodeSession(
+        exe, num_slots=S, max_length=seq, d_model=dm, paged=True,
+        page_size=ps, steps=1,
+        speculative={"k": 3, "drafter": "ngram"}, **cfg)
+
+    def spec_wave(sess):
+        return drain(sess, [sess.admit(src_sp[i], seq,
+                                       prefix_tokens=pfx_sp[i])
+                            for i in range(B)])
+
+    spec_wave(spec)  # warm the draft/tree-verify set
+    _flags.set_flag("speculative", "off")
+    try:
+        spec_wave(spec)  # warm the sequential step too
+        t0 = time.perf_counter()
+        off_out = spec_wave(spec)
+        off_dt = time.perf_counter() - t0
+    finally:
+        _flags.set_flag("speculative", "on")
+    p0, a0 = spec.spec_proposed, spec.spec_accepted
+    t0 = time.perf_counter()
+    sp_out = spec_wave(spec)
+    sp_dt = time.perf_counter() - t0
+    assert np.array_equal(sp_out, off_out), \
+        "speculative decode diverged from the sequential off-oracle"
+    sp_tok = tokens_of(sp_out)
+    accept_rate = ((spec.spec_accepted - a0) / (spec.spec_proposed - p0)
+                   if spec.spec_proposed > p0 else 0.0)
+
     acc = pk.grid_accounting(mixed + [0] * (S - B), ps, n_head,
                              dm // n_head, seq, num_groups=2,
                              n_layer=cfg["n_layer"])
@@ -659,6 +739,14 @@ def _bench_decode(fluid, on_tpu):
         "beam_tokens_per_sec": round(beam_tok / rb_dt, 1),
         "beam_reorder_bytes": (rb_moved + rb_cow) * page_bytes,
         "beam_ref_reorder_bytes": ref_moved * page_bytes,
+        # speculative A/B (PR 16): draft-then-verify tokens/sec over
+        # the sequential steps=1 off-oracle on the SAME session
+        # (bit-identical tokens asserted), plus the drafter's measured
+        # acceptance over the timed wave
+        "speculative_speedup": round(
+            (sp_tok / sp_dt) / (sp_tok / off_dt), 3),
+        "speculative_tokens_per_sec": round(sp_tok / sp_dt, 1),
+        "acceptance_rate": round(accept_rate, 3),
         "rate": p_tps,
         "gflop_per_unit": 0.0,
     }

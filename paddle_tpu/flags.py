@@ -151,6 +151,21 @@ _DEFS = {
     # the chaos-monkey harness the crash/resume CI stage drives; empty
     # disables (module-bool guard, zero overhead)
     "chaos_spec": ("", str),
+    # speculative decoding over the paged slot pool
+    # (serving/generation.py SlotDecodeSession(speculative=...)): "on"
+    # (default) runs the draft/verify tree dispatch when the session was
+    # built speculative; "off" is the bit-exactness oracle — the session
+    # falls back to the plain one-token step program and the accepted
+    # token streams of the two modes must be BIT-identical (greedy exact,
+    # sampled via the (seed, slot, position) key scheme). Read at every
+    # step, so tests can flip it mid-session without rebuilding.
+    "speculative": ("on", str),
+    # tree-attention verify kernel (kernels/paged_attention.py
+    # paged_tree_attention) impl resolution for impl="auto": "auto"
+    # (Pallas kernel on TPU targets, composed gather+ancestor-mask
+    # reference on CPU), "pallas" (force the kernel — interpret mode on
+    # CPU, the test path), "reference" (force the composed path)
+    "tree_attention": ("auto", str),
     # route the transformer's label-smoothed CE head through the fused
     # single-pass op (ops/loss_ops.py fused_label_smooth_ce): bf16
     # logits with f32-accumulated reductions, hand-written one-pass

@@ -91,6 +91,52 @@ def _trip_kernel_fallback(exc):
         pass  # degradation bookkeeping must never mask the serve path
 
 
+# the tree-attention verify kernel (speculative decoding) degrades
+# independently of the decode kernel: a broken tree lowering must not
+# take the plain decode path down with it, and vice versa.
+_TREE_FALLBACK = {"tripped": False}
+
+
+def tree_kernel_fallback_tripped():
+    """True once this process abandoned the Pallas tree kernel."""
+    return _TREE_FALLBACK["tripped"]
+
+
+def reset_tree_kernel_fallback():
+    """Re-arm the Pallas tree-attention path (tests)."""
+    _TREE_FALLBACK["tripped"] = False
+
+
+def _trip_tree_fallback(exc):
+    if _TREE_FALLBACK["tripped"]:
+        return
+    _TREE_FALLBACK["tripped"] = True
+    import logging
+
+    logging.getLogger("paddle_tpu.kernels.paged_attention").warning(
+        "Pallas paged_tree_attention kernel failed (%s: %s); falling "
+        "back to the FLAGS_tree_attention=reference path for the rest "
+        "of this process — speculative verify keeps serving, slower",
+        type(exc).__name__, exc)
+    try:
+        from paddle_tpu.observability.metrics_registry import REGISTRY
+
+        REGISTRY.counter(
+            "paddle_tpu_kernel_fallbacks_total",
+            "Pallas kernels abandoned for their reference path this "
+            "process (once per kernel)", labels=("kernel",)
+        ).inc(kernel="paged_tree_attention")
+        from paddle_tpu.observability import blackbox
+
+        if blackbox.ENABLED:
+            blackbox.record(
+                "kernel_fallback", kernel="paged_tree_attention",
+                exc_type=type(exc).__name__,
+                exc_message=str(exc)[:500])
+    except Exception:
+        pass  # degradation bookkeeping must never mask the serve path
+
+
 _NEG_INF = -1e30
 # a slot whose running max never rose above this saw no visible key
 # (length 0): its output is zeroed, matching flash_attention's
@@ -281,6 +327,261 @@ def paged_kv_write(k_pool, v_pool, k_new, v_new, page_table, positions):
         k_new.astype(k_pool.dtype))
     v_pool = v_pool.at[page_ids, :, offsets, :].set(
         v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def paged_tree_attention_reference(q, k_pool, v_pool, page_table,
+                                   base_lens, anc, sm_scale=None,
+                                   max_length=None):
+    """Composed XLA path for speculative tree verify: each slot holds
+    ``base_lens[s]`` committed rows at storage positions ``0..base-1``
+    plus N speculation-tree nodes laid out LINEARLY in its write pages
+    at storage positions ``base..base+N-1`` (node 0 is the anchor
+    token). Query node ``n`` attends every committed row plus exactly
+    the tree rows on its own root path — ``anc[s, n, j]`` nonzero
+    (``anc`` includes the diagonal: a node sees its own just-written
+    row, the decode-step contract).
+
+    q: [S, H, N, dh]; k_pool/v_pool: [P, H, page_size, dh];
+    page_table: [S, npp] int; base_lens: [S] int (-1 marks a dead/done
+    slot — no visible key, output exactly 0); anc: [S, N, N] 0/1.
+    Tree rows whose storage position falls at/after ``max_length``
+    were trash-routed at write time and are masked here. Returns
+    [S, H, N, dh].
+    """
+    S, H, N, dh = q.shape
+    ps = k_pool.shape[2]
+    npp = page_table.shape[1]
+    L = npp * ps
+    if sm_scale is None:
+        sm_scale = dh ** -0.5
+    if max_length is None:
+        max_length = L
+    ks = jnp.transpose(k_pool[page_table], (0, 2, 1, 3, 4)).reshape(
+        S, H, L, dh)
+    vs = jnp.transpose(v_pool[page_table], (0, 2, 1, 3, 4)).reshape(
+        S, H, L, dh)
+    s = jnp.einsum("shnd,shtd->shnt", q.astype(jnp.float32) * sm_scale,
+                   ks.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)      # [S,H,N,L]
+    t = jnp.arange(L)[None, :]                              # [1, L]
+    base = base_lens.astype(jnp.int32)[:, None]             # [S, 1]
+    committed = (t < base)                                  # [S, L]
+    tj = t - base                                           # [S, L]
+    in_tree = (tj >= 0) & (tj < N) & (t < int(max_length)) & (base >= 0)
+    tj_c = jnp.clip(tj, 0, N - 1)
+    anc_g = (anc.astype(jnp.int32) > 0)[
+        jnp.arange(S)[:, None, None],
+        jnp.arange(N)[None, :, None],
+        tj_c[:, None, :]]                                   # [S, N, L]
+    visible = committed[:, None, :] | (in_tree[:, None, :] & anc_g)
+    vis4 = visible[:, None, :, :]                           # [S,1,N,L]
+    s = jnp.where(vis4, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("shnt,shtd->shnd", p, vs.astype(jnp.float32))
+    dead = jnp.logical_not(jnp.any(vis4, axis=-1))[..., None]
+    return jnp.where(dead, 0.0, out).astype(q.dtype)
+
+
+def _tree_decode_kernel(table_ref, blen_ref, q_ref, k_ref, v_ref,
+                        anc_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                        page_size, n_pages, n_nodes, max_len, sm_scale):
+    """One (slot, page) grid step of the tree verify: absorb one
+    resident page into N parallel online-softmax rows (one per tree
+    node). Same ragged discipline as ``_paged_decode_kernel`` — the
+    scan bound is ``base + N`` (capped at ``max_len``), pages past it
+    skip compute and (via table tail aliasing) DMA. The ancestor mask
+    is applied to in-tree storage positions with a one-hot contraction
+    (``anc @ onehot(t - base)``) instead of a gather — MXU-friendly
+    and Pallas-safe."""
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    base = blen_ref[s]
+    scan_len = jnp.where(base >= 0,
+                         jnp.minimum(base + n_nodes, max_len), 0)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # [H, N, dh]
+        k = k_ref[0].astype(jnp.float32)                 # [H, ps, dh]
+        v = v_ref[0].astype(jnp.float32)
+        sc = jnp.einsum("hnd,htd->hnt", q, k,
+                        preferred_element_type=jnp.float32)  # [H,N,ps]
+        jrow = jax.lax.broadcasted_iota(
+            jnp.int32, (n_nodes, page_size), 0)          # [N, ps] = j
+        tcol = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (n_nodes, page_size), 1)          # [N, ps] = t
+        tj = tcol - base
+        onehot = (tj == jrow).astype(jnp.float32)        # [N(j), ps]
+        anc = (anc_ref[0].astype(jnp.int32) > 0).astype(jnp.float32)
+        treevis = jnp.dot(anc, onehot,
+                          preferred_element_type=jnp.float32)  # [N(n),ps]
+        in_tree = (tj >= 0) & (tj < n_nodes) & (tcol < max_len)
+        visible = (tcol < base) | ((treevis > 0.5) & in_tree)
+        sc = jnp.where(visible[None, :, :], sc, _NEG_INF)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        pexp = jnp.exp(sc - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1,
+                                              keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.einsum(
+            "hnt,htd->hnd", pexp, v, preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    pl.when(p * page_size < scan_len)(_compute)
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        dead = m_ref[...] <= _MASKED_ROW_M
+        o_ref[0] = jnp.where(
+            dead, 0.0,
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _tree_pallas(q, k_pool, v_pool, page_table, base_lens, anc,
+                 sm_scale, max_length, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, H, N, dh = q.shape
+    ps = k_pool.shape[2]
+    npp = page_table.shape[1]
+    kv_spec = pl.BlockSpec(
+        (1, H, ps, dh), lambda s, p, table, lens: (table[s, p], 0, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, npp),
+        in_specs=[
+            pl.BlockSpec((1, H, N, dh),
+                         lambda s, p, table, lens: (s, 0, 0, 0)),
+            kv_spec,
+            kv_spec,
+            pl.BlockSpec((1, N, N), lambda s, p, table, lens: (s, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, H, N, dh), lambda s, p, table, lens: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, N, dh), jnp.float32),
+            pltpu.VMEM((H, N, 1), jnp.float32),
+            pltpu.VMEM((H, N, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _tree_decode_kernel, page_size=ps, n_pages=npp, n_nodes=N,
+            max_len=int(max_length), sm_scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, N, dh), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), base_lens.astype(jnp.int32),
+      q, k_pool, v_pool, anc.astype(jnp.int32))
+
+
+def paged_tree_attention(q, k_pool, v_pool, page_table, base_lens, anc,
+                         sm_scale=None, max_length=None,
+                         force_reference=False, force_pallas=False):
+    """Speculative tree verify over the paged pool: one dispatch scores
+    all N tree nodes of every slot against its committed rows plus the
+    node's own root path (see ``paged_tree_attention_reference`` for
+    the full layout contract). Routing mirrors ``paged_attention``:
+    Pallas on TPU targets, composed reference on CPU or under
+    ``FLAGS_tree_attention=reference``, with a once-per-process
+    fallback trip on Pallas failure."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if max_length is None:
+        max_length = page_table.shape[1] * k_pool.shape[2]
+    use_pallas = force_pallas or (not force_reference and _is_tpu_target())
+    if not use_pallas or _TREE_FALLBACK["tripped"]:
+        return paged_tree_attention_reference(
+            q, k_pool, v_pool, page_table, base_lens, anc,
+            sm_scale=sm_scale, max_length=max_length)
+    try:
+        return _tree_pallas(q, k_pool, v_pool, page_table, base_lens,
+                            anc, sm_scale, max_length,
+                            interpret=not _is_tpu_target())
+    except Exception as exc:  # noqa: BLE001 - degraded, not dead
+        _trip_tree_fallback(exc)
+        return paged_tree_attention_reference(
+            q, k_pool, v_pool, page_table, base_lens, anc,
+            sm_scale=sm_scale, max_length=max_length)
+
+
+def paged_kv_write_block(k_pool, v_pool, k_new, v_new, page_table,
+                         positions):
+    """Speculative tree write: scatter N K/V rows per slot into its
+    resident pages — row ``i`` of slot ``s`` lands at storage position
+    ``positions[s, i]`` through the table. Rows whose position falls
+    outside the table's coverage (``pos >= npp * page_size``) route to
+    the reserved trash page instead of clobbering a live row, the same
+    safety valve as a done slot's all-trash table row.
+
+    k_new/v_new: [S, H, N, dh]; positions: [S, N]. Returns the updated
+    pools.
+    """
+    ps = k_pool.shape[2]
+    S, H, N, dh = k_new.shape
+    npp = page_table.shape[1]
+    pos = positions.astype(jnp.int32)
+    in_range = pos < npp * ps
+    page_idx = jnp.clip(pos // ps, 0, npp - 1)
+    page_ids = jnp.where(in_range,
+                         page_table[jnp.arange(S)[:, None], page_idx], 0)
+    offsets = jnp.where(in_range, pos % ps, 0)
+    k_rows = jnp.transpose(k_new, (0, 2, 1, 3)).astype(k_pool.dtype)
+    v_rows = jnp.transpose(v_new, (0, 2, 1, 3)).astype(v_pool.dtype)
+    k_pool = k_pool.at[page_ids, :, offsets, :].set(k_rows)
+    v_pool = v_pool.at[page_ids, :, offsets, :].set(v_rows)
+    return k_pool, v_pool
+
+
+def paged_kv_compact(k_pool, v_pool, page_table, base, path, accept_len):
+    """Survivor commit of the accepted tree path: after the accept walk
+    picks node ``path[s, j]`` as the backer of committed token ``j``,
+    its K/V row moves from storage ``base + path[j]`` to the canonical
+    position ``base + j`` (an in-page row gather; page identity itself
+    is handled by the host's refcount rebinds). Rows at/after
+    ``accept_len`` and the anchor (j=0, already canonical) are
+    untouched — their writes route to the trash page. All gathers read
+    the pre-compaction pool (functional scatter), so an overlapping
+    src/dst pattern can never read a clobbered row.
+
+    base: [S] int (committed rows; -1 for dead slots), path: [S, N]
+    node indices, accept_len: [S] int. Returns the updated pools.
+    """
+    ps = k_pool.shape[2]
+    S, N = path.shape
+    npp = page_table.shape[1]
+    L = npp * ps
+    j_idx = jnp.arange(N)[None, :]
+    base_i = base.astype(jnp.int32)[:, None]
+    src_pos = base_i + path.astype(jnp.int32)
+    dst_pos = base_i + j_idx
+    active = ((j_idx >= 1) & (j_idx < accept_len.astype(jnp.int32)[:, None])
+              & (dst_pos < L) & (src_pos < L) & (base_i >= 0)
+              & (path.astype(jnp.int32) != j_idx))
+    sp = jnp.clip(src_pos, 0, L - 1)
+    s_page = page_table[jnp.arange(S)[:, None], sp // ps]
+    s_off = sp % ps
+    k_rows = k_pool[s_page, :, s_off, :]                    # [S,N,H,dh]
+    v_rows = v_pool[s_page, :, s_off, :]
+    dp = jnp.clip(dst_pos, 0, L - 1)
+    d_page = jnp.where(active,
+                       page_table[jnp.arange(S)[:, None], dp // ps], 0)
+    d_off = jnp.where(active, dp % ps, 0)
+    k_pool = k_pool.at[d_page, :, d_off, :].set(k_rows)
+    v_pool = v_pool.at[d_page, :, d_off, :].set(v_rows)
     return k_pool, v_pool
 
 

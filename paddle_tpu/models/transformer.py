@@ -247,19 +247,50 @@ def _log_softmax_rows(step):
     return step - mx - np.log(np.exp(step - mx).sum(-1, keepdims=True))
 
 
+def _gnmt_penalized_scores(trg_bk, scores, eos_id, len_penalty):
+    """GNMT length-penalty division: ``scores / ((5 + len) / 6) ** p``
+    over ``[..., K, T]`` hypothesis rows (length = through the first
+    eos after bos, or the full budget). float64, broadcast over any
+    leading batch dims."""
+    import numpy as np
+
+    tail = trg_bk[..., 1:]
+    has_eos = (tail == eos_id).any(-1)
+    first = (tail == eos_id).argmax(-1)
+    lengths = np.where(has_eos, first + 1,
+                       trg_bk.shape[-1]).astype(np.float64)
+    lp = ((5.0 + lengths) / 6.0) ** float(len_penalty)
+    return np.asarray(scores, np.float64) / lp
+
+
 def _pick_best_beam(trg, pre_scores, bs, K, max_length, eos_id,
                     len_penalty):
     """GNMT length-penalty selection over the final beams."""
     import numpy as np
 
     trg_bk = trg.reshape(bs, K, max_length)
-    tail = trg_bk[:, :, 1:]
-    has_eos = (tail == eos_id).any(-1)
-    first = (tail == eos_id).argmax(-1)
-    lengths = np.where(has_eos, first + 1, max_length).astype(np.float64)
-    lp = ((5.0 + lengths) / 6.0) ** len_penalty
-    best = (pre_scores.astype(np.float64) / lp).argmax(-1)
+    best = _gnmt_penalized_scores(
+        trg_bk, pre_scores, eos_id, len_penalty).argmax(-1)
     return trg_bk[np.arange(bs), best]
+
+
+def gnmt_rescore_nbest(tokens, scores, eos_id, len_penalty):
+    """Rescore one final beam n-best (``tokens [K, T]`` bos-led rows,
+    ``scores [K]`` accumulated log-probs) with the GNMT length penalty
+    ``_pick_best_beam`` applies, and reorder score-descending under the
+    penalized scores. Returns ``(order [K] int64, tokens[order],
+    penalized_scores[order] float32)`` — ``order`` is the permutation of
+    the INPUT hypothesis indices, which the wire protocol forwards so a
+    streaming client can realign its survivor-chunk replay with the
+    rescored ``beam_end``. The sort is stable: ``len_penalty = 0``
+    divides by 1 everywhere and returns the identity order."""
+    import numpy as np
+
+    tokens = np.asarray(tokens)
+    penalized = _gnmt_penalized_scores(tokens, scores, eos_id,
+                                       len_penalty)
+    order = np.argsort(-penalized, kind="stable").astype(np.int64)
+    return order, tokens[order], penalized[order].astype(np.float32)
 
 
 def beam_generate(exe, infer_prog, logits_var, src, src_len, max_length,
@@ -857,6 +888,7 @@ def build_paged_slot_decoder(
     eos_id=2,
     sampler=None,
     beam_width=1,
+    speculative=0,
 ):
     """Block-paged continuous-batching decode: the slot pool's dense
     per-slot self caches (``[S, H, T, dh]``) become a PAGE POOL —
@@ -954,6 +986,30 @@ def build_paged_slot_decoder(
     of the single token name (the session fetches the first three;
     ``logits`` is the offline-lattice test hook).
 
+    ``speculative=K`` (K >= 1, sampler mode only) ALSO builds the
+    speculative verify program — the tree-attention dispatch that
+    scores the anchor plus K host-drafted tokens in one target forward
+    and commits the longest accepted prefix in-graph:
+
+    * ``spec_step_prog`` (feeds ``spec_draft [S, K]`` draft tokens,
+      ``spec_parent [S, N]`` tree parents and ``spec_anc [S, N, N]``
+      ancestor mask, N = K + 1 with node 0 the anchor): embeds all N
+      tree nodes at their LOGICAL positions (``pos + depth``), writes
+      every node's K/V into the slot's write pages at storage
+      ``pos .. pos + N - 1`` (``paged_spec_kv_write``; done slots
+      trash-route), runs ``paged_tree_attention`` (committed prefix +
+      ancestor path per node), then ``slot_speculative_accept`` — the
+      sequential sampler replayed down the tree, sharing
+      ``sample_step_tokens`` + ``slot_lifecycle_advance`` so committed
+      streams are bit-identical to the plain step program — and
+      finally ``paged_spec_kv_compact`` per layer to gather the
+      accepted path's K/V rows into canonical storage positions.
+      The return value grows to ``(init, admit, join, prefill, table,
+      step, spec_step, fetches)`` with ``fetches = {"token":
+      <step tok>, "spec_token_seq": [S, N], "spec_accept_len":
+      [S, 1]}`` — the plain ``step_prog`` stays available as the
+      ``FLAGS_speculative=off`` oracle.
+
     Build under the training ``build()``'s fresh ``unique_name`` scope;
     parameters bind by name. All decode state is ``pgd_``-prefixed, so
     a paged and a dense session can coexist in one scope. Host-side
@@ -991,6 +1047,14 @@ def build_paged_slot_decoder(
             "beam_width > 1 replaces token sampling with the beam "
             "lattice — a stochastic sampler (%r) cannot compose with "
             "it" % (samp["strategy"],))
+    n_spec = int(speculative)
+    if n_spec < 0:
+        raise ValueError("speculative must be >= 0, got %d" % n_spec)
+    if n_spec and beam:
+        raise ValueError(
+            "speculative decode verifies the SAMPLER stream — it does "
+            "not compose with beam_width > 1 (the lattice already "
+            "scores full hypothesis sets per step)")
 
     with unique_name.guard({}):
         init = fluid.Program()
@@ -1316,11 +1380,289 @@ def build_paged_slot_decoder(
             nn.assign(tok_new, output=tok)
             nn.assign(pos_new, output=pos)
             nn.assign(done_new, output=done)
+
+        if n_spec:
+            Nn = n_spec + 1
+            spec = fluid.Program()
+            spec_startup = fluid.Program()
+            # like prefill: the spec program re-creates the decoder's
+            # param-owning layers, so a FRESH name scope keeps the
+            # .w_0/.w_1 parameter suffixes aligned with the training
+            # build instead of shifting the outer scope's counters
+            with unique_name.guard({}), \
+                    fluid.program_guard(spec, spec_startup):
+                blk = spec.global_block()
+
+                def pvar(name, shape, dtype="float32"):
+                    return blk.create_var(name=name, shape=shape,
+                                          dtype=dtype, persistable=True)
+
+                # concrete shapes (no -1 batch dim): the slot axis is
+                # fixed at S, and shape inference downstream (concat
+                # with [S, 1] vars, broadcasts against [S, 1] pos)
+                # needs it static
+                draft = nn.data("spec_draft", shape=[S, n_spec],
+                                dtype="int64",
+                                append_batch_size=False)  # [S, K]
+                par = nn.data("spec_parent", shape=[S, Nn],
+                              dtype="int64",
+                              append_batch_size=False)    # [S, N]
+                anc = nn.data("spec_anc", shape=[S, Nn, Nn],
+                              dtype="int64",
+                              append_batch_size=False)    # [S, N, N]
+                tok = pvar("pgd_tok", [S, 1], "int64")
+                pos = pvar("pgd_pos", [S, 1], "int64")
+                done = pvar("pgd_done", [S, 1], "int64")
+                ptable = pvar("pgd_table", [S, npp], "int64")
+                group_of = pvar("pgd_group_of", [S, 1], "int64")
+                pe_table = pvar("pgd_pe_table", [T, D])
+                src_mask = pvar("pgd_src_mask", [G, T])
+                live_row = nn.elementwise_sub(
+                    nn.fill_constant([S, 1], "int64", 1), done)
+                # the tree kernel's ragged bound: committed storage for
+                # a LIVE slot is [0, pos) and its tree occupies storage
+                # pos .. pos + N - 1; -1 marks a dead slot (zero output
+                # rows, no pages scanned)
+                base = nn.elementwise_sub(
+                    nn.elementwise_mul(
+                        fluid.layers.increment(pos, value=1,
+                                               in_place=False),
+                        live_row),
+                    nn.fill_constant([S, 1], "int64", 1))
+                # a done slot's whole tree writes to the trash page
+                write_table = nn.elementwise_mul(ptable, live_row)
+                nodes_tok = nn.concat([tok, draft], axis=1)  # [S, N]
+                # depth of node i = |ancestors| - 1 (anc carries the
+                # diagonal and the anchor column), so its LOGICAL
+                # sequence position is pos + depth — clamped into the
+                # PE table exactly like the sequential position clamp
+                depth = nn.elementwise_sub(
+                    nn.reduce_sum(anc, dim=2),               # [S, N]
+                    nn.fill_constant([1, 1], "int64", 1))
+                logical = nn.elementwise_min(
+                    nn.elementwise_add(pos, depth),
+                    nn.fill_constant([1, 1], "int64", T - 1))
+                pe_rows = nn.reshape(
+                    nn.gather(pe_table,
+                              nn.reshape(logical, shape=[-1])),
+                    shape=[S, Nn, D])
+                emb = nn.embedding(
+                    input=nodes_tok, size=[trg_vocab_size, D],
+                    param_attr=fluid.ParamAttr(name="trg_emb"))
+                h = nn.elementwise_add(nn.scale(emb, scale=D ** 0.5),
+                                       pe_rows)
+                spec_pools = []
+                for i in range(n_layer):
+                    name = "dec_%d" % i
+                    kpool = pvar("pgd_kpool_%d" % i,
+                                 [P, n_head, ps, dh])
+                    vpool = pvar("pgd_vpool_%d" % i,
+                                 [P, n_head, ps, dh])
+                    nx = _prenorm(h, name + "_sattn")
+                    q = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                                    bias_attr=False,
+                                    name=name + "_smha_q"))
+                    k1 = heads(nn.fc(nx, dh * n_head,
+                                     num_flatten_dims=2,
+                                     bias_attr=False,
+                                     name=name + "_smha_k"))
+                    v1 = heads(nn.fc(nx, dh * n_head,
+                                     num_flatten_dims=2,
+                                     bias_attr=False,
+                                     name=name + "_smha_v"))
+                    kpool, vpool = fluid.layers.paged_spec_kv_write(
+                        kpool, vpool, k1, v1, write_table, pos)
+                    spec_pools.append((kpool, vpool))
+                    att = fluid.layers.paged_tree_attention(
+                        q, kpool, vpool, ptable, base, anc,
+                        sm_scale=dh ** -0.5, max_length=T)
+                    att = nn.reshape(
+                        nn.transpose(att, perm=[0, 2, 1, 3]),
+                        shape=[0, 0, n_head * dh])
+                    h = nn.elementwise_add(h, nn.fc(
+                        att, D, num_flatten_dims=2, bias_attr=False,
+                        name=name + "_smha_o"))
+                    nx2 = _prenorm(h, name + "_cattn")
+                    q2 = heads(nn.fc(nx2, dh * n_head,
+                                     num_flatten_dims=2,
+                                     bias_attr=False,
+                                     name=name + "_cmha_q"))
+                    ctx = fluid.layers.grouped_cross_attention(
+                        q2,
+                        pvar("pgd_kcross_%d" % i, [G, n_head, T, dh]),
+                        pvar("pgd_vcross_%d" % i, [G, n_head, T, dh]),
+                        group_of, src_mask, sm_scale=dh ** -0.5)
+                    ctx = nn.reshape(
+                        nn.transpose(ctx, perm=[0, 2, 1, 3]),
+                        shape=[0, 0, n_head * dh])
+                    h = nn.elementwise_add(h, nn.fc(
+                        ctx, D, num_flatten_dims=2, bias_attr=False,
+                        name=name + "_cmha_o"))
+                    ff = _ffn(_prenorm(h, name + "_ffn"), D, d_inner,
+                              name + "_ffn")
+                    h = nn.elementwise_add(h, ff)
+                h = _prenorm(h, "dec_final")
+                spec_logits = nn.fc(h, trg_vocab_size,
+                                    num_flatten_dims=2,
+                                    name="proj_logits")  # [S, N, V]
+                (spec_anchor, spec_seq, spec_acc, spec_path, spec_pos,
+                 spec_done) = fluid.layers.slot_speculative_accept(
+                    spec_logits, nodes_tok, par, pos, done,
+                    eos_id=eos_id, max_length=T, **samp)
+                # survivor commit AFTER the walk (attention read the
+                # pre-commit tree layout) and BEFORE the state assigns
+                for kpool, vpool in spec_pools:
+                    fluid.layers.paged_spec_kv_compact(
+                        kpool, vpool, write_table, pos, spec_path,
+                        spec_acc)
+                nn.assign(spec_anchor, output=tok)
+                nn.assign(spec_pos, output=pos)
+                nn.assign(spec_done, output=done)
     if beam:
         fetches = {"token": tok_new.name, "parent": parent.name,
                    "score": score_new.name, "logits": logits.name}
         return init, admit, join, prefill, table, step, fetches
+    if n_spec:
+        fetches = {"token": tok_new.name,
+                   "spec_token_seq": spec_seq.name,
+                   "spec_accept_len": spec_acc.name}
+        return init, admit, join, prefill, table, step, spec, fetches
     return init, admit, join, prefill, table, step, tok_new.name
+
+
+def build_draft_decoder(
+    num_slots,
+    trg_vocab_size=1000,
+    max_length=64,
+    n_head=4,
+    d_model=128,
+    d_inner=None,
+    page_size=8,
+    num_pages=None,
+    eos_id=2,
+):
+    """The small DRAFT transformer for speculative decoding: a 1-layer
+    decoder-only LM (no cross attention — cheapness is the point) that
+    shares the target's token embedding (``trg_emb``) and position
+    table (``pgd_pe_table``) and runs over the SAME paged geometry —
+    its own K/V pools ``pgd_draft_{k,v}pool_0 [P, H, ps, dh]`` indexed
+    through the target's ``pgd_table`` row per slot, so draft cache
+    residency exactly tracks slot page residency with zero extra
+    bookkeeping.
+
+    Host-driven single-token steps: ``step_prog`` feeds
+    ``draft_tok``/``draft_pos``/``draft_live`` ``[S, 1]`` and fetches
+    the greedy next token ``[S, 1]`` (non-live rows write to the trash
+    page, attend nothing and emit eos). The serving drafter replays
+    each slot's committed tokens through this program to keep the
+    draft cache current, then rolls K draft steps ahead of the anchor.
+
+    Correctness is structurally independent of this model: the accept
+    walk re-samples every committed token from TARGET logits, so a
+    stale or even randomly-initialised draft (its ``draft_dec_*`` /
+    ``draft_proj_logits`` params are NOT part of the target training
+    build) only lowers the acceptance rate. For the same reason the
+    draft pools deliberately sit OUTSIDE copy-on-write: after a fork
+    repoints a page, the fork's draft rows for that page are garbage
+    until rewritten — harmless, never target-visible.
+
+    Returns ``(init_prog, step_prog, step_startup_prog, token_name)``;
+    ``init_prog`` zero-allocates the draft pools and must run after the
+    paged decoder's ``init_prog`` (it reuses the session scope).
+    ``step_startup_prog`` carries the initializers for EVERY param the
+    step program touches — including the shared ``trg_emb`` — so a
+    session must run it selectively (only vars the scope is missing),
+    the way ``serving.speculative.DraftModelDrafter`` does.
+    """
+    from paddle_tpu import unique_name
+
+    from paddle_tpu.kernels.paged_attention import pages_for
+
+    nn = fluid.layers
+    S, T, D = int(num_slots), int(max_length), int(d_model)
+    dh = D // int(n_head)
+    ps = int(page_size)
+    npp = pages_for(T, ps)
+    P = int(num_pages) if num_pages else 1 + S * npp
+    di = int(d_inner) if d_inner else 2 * D
+
+    def heads(x):
+        return nn.transpose(
+            nn.reshape(x, shape=[0, 0, n_head, dh]), perm=[0, 2, 1, 3])
+
+    with unique_name.guard({}):
+        init = fluid.Program()
+        init_startup = fluid.Program()
+        with fluid.program_guard(init, init_startup):
+            blk = init.global_block()
+            for kind in ("kpool", "vpool"):
+                out = blk.create_var(name="pgd_draft_%s_0" % kind,
+                                     shape=None, dtype="float32",
+                                     persistable=True)
+                nn.assign(nn.fill_constant([P, n_head, ps, dh],
+                                           "float32", 0.0), output=out)
+
+        step = fluid.Program()
+        step_startup = fluid.Program()
+        with fluid.program_guard(step, step_startup):
+            blk = step.global_block()
+
+            def pvar(name, shape, dtype="float32"):
+                return blk.create_var(name=name, shape=shape,
+                                      dtype=dtype, persistable=True)
+
+            dtok = nn.data("draft_tok", shape=[S, 1], dtype="int64",
+                           append_batch_size=False)
+            dpos = nn.data("draft_pos", shape=[S, 1], dtype="int64",
+                           append_batch_size=False)
+            dlive = nn.data("draft_live", shape=[S, 1], dtype="int64",
+                            append_batch_size=False)
+            ptable = pvar("pgd_table", [S, npp], "int64")
+            pe_table = pvar("pgd_pe_table", [T, D])
+            kpool = pvar("pgd_draft_kpool_0", [P, n_head, ps, dh])
+            vpool = pvar("pgd_draft_vpool_0", [P, n_head, ps, dh])
+            ddone = nn.elementwise_sub(
+                nn.fill_constant([S, 1], "int64", 1), dlive)
+            lengths = nn.elementwise_mul(
+                fluid.layers.increment(dpos, value=1, in_place=False),
+                dlive)
+            write_table = nn.elementwise_mul(ptable, dlive)
+            emb = nn.embedding(
+                input=dtok, size=[trg_vocab_size, D],
+                param_attr=fluid.ParamAttr(name="trg_emb"))
+            emb = nn.reshape(emb, shape=[0, 1, D])
+            pe_row = nn.reshape(
+                nn.gather(pe_table, nn.reshape(dpos, shape=[-1])),
+                shape=[0, 1, D])
+            h = nn.elementwise_add(nn.scale(emb, scale=D ** 0.5),
+                                   pe_row)
+            nx = _prenorm(h, "draft_dec_sattn")
+            q = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                            bias_attr=False, name="draft_dec_smha_q"))
+            k1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                             bias_attr=False, name="draft_dec_smha_k"))
+            v1 = heads(nn.fc(nx, dh * n_head, num_flatten_dims=2,
+                             bias_attr=False, name="draft_dec_smha_v"))
+            kpool, vpool = fluid.layers.paged_kv_write(
+                kpool, vpool, k1, v1, write_table, dpos)
+            att = fluid.layers.paged_attention(
+                q, kpool, vpool, ptable, lengths, sm_scale=dh ** -0.5)
+            att = nn.reshape(nn.transpose(att, perm=[0, 2, 1, 3]),
+                             shape=[0, 0, n_head * dh])
+            h = nn.elementwise_add(h, nn.fc(
+                att, D, num_flatten_dims=2, bias_attr=False,
+                name="draft_dec_smha_o"))
+            ff = _ffn(_prenorm(h, "draft_dec_ffn"), D, di,
+                      "draft_dec_ffn")
+            h = nn.elementwise_add(h, ff)
+            h = _prenorm(h, "draft_final")
+            logits = nn.fc(h, trg_vocab_size, num_flatten_dims=2,
+                           name="draft_proj_logits")
+            dtok_new, _dpos_new, _ddone_new = \
+                fluid.layers.slot_decode_sample(
+                    logits, dpos, done=ddone, eos_id=eos_id,
+                    max_length=T)
+    return init, step, step_startup, dtok_new.name
 
 
 def build_cow_batch_prog(num_slots, max_length, n_layer, n_head,

@@ -26,6 +26,7 @@ from paddle_tpu.ops import seq2seq_ops  # noqa: F401
 from paddle_tpu.ops import crf_ops  # noqa: F401
 from paddle_tpu.ops import ctc_ops  # noqa: F401
 from paddle_tpu.ops import sampling_ops  # noqa: F401
+from paddle_tpu.ops import speculative_ops  # noqa: F401
 from paddle_tpu.ops import vision_ops  # noqa: F401
 from paddle_tpu.ops import quantize_ops  # noqa: F401
 from paddle_tpu.ops import fused_ops  # noqa: F401

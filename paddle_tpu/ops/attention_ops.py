@@ -161,6 +161,48 @@ register_op(
 )
 
 
+def _lower_paged_tree_attention(ctx, ins, attrs):
+    """Speculative tree-verify attention (kernels/paged_attention.py
+    paged_tree_attention): N speculation-tree nodes per slot, laid out
+    linearly in the slot's write pages, each attending the committed
+    prefix plus its own ancestor path — K speculated tokens verified by
+    the target model in ONE dispatch."""
+    from paddle_tpu.kernels.paged_attention import paged_tree_attention
+
+    q = ins["Q"][0]  # [S, H, N, dh]
+    k_pool = ins["KPool"][0]  # [P, H, page_size, dh]
+    v_pool = ins["VPool"][0]
+    S, H, N, dh = q.shape
+    table = jnp.reshape(ins["PageTable"][0], (S, -1)).astype(jnp.int32)
+    base = jnp.reshape(ins["BaseLens"][0], (-1,)).astype(jnp.int32)
+    anc = jnp.reshape(ins["Anc"][0], (S, N, N)).astype(jnp.int32)
+    sm_scale = attrs.get("sm_scale", 0.0) or None
+    max_length = int(attrs.get("max_length", 0)) or None
+    impl = attrs.get("impl", "auto")
+    if impl == "auto":
+        from paddle_tpu import flags
+
+        impl = flags.get("tree_attention")
+    return paged_tree_attention(
+        q, k_pool, v_pool, table, base, anc, sm_scale=sm_scale,
+        max_length=max_length,
+        force_reference=(impl == "reference"),
+        force_pallas=(impl == "pallas"),
+    )
+
+
+register_op(
+    "paged_tree_attention",
+    inputs=["Q", "KPool", "VPool", "PageTable", "BaseLens", "Anc"],
+    outputs=["Out"],
+    attrs={"sm_scale": 0.0, "impl": "auto", "max_length": 0},
+    lower=_lower_paged_tree_attention,
+    grad=None,  # decode-only op: no training path attends speculation
+    no_grad_inputs=("PageTable", "BaseLens", "Anc"),
+    infer_shape=_paged_attention_infer_shape,
+)
+
+
 def _lower_grouped_cross_attention(ctx, ins, attrs):
     """Group-indexed cross attention for the paged decode step: the
     cross K/V pools are laid out per GROUP (``[G, H, T_src, dh]`` — one

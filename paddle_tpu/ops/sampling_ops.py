@@ -138,6 +138,33 @@ def slot_lifecycle_advance(pos_flat, was_done, tok, eos, max_len):
     return new_pos, new_done
 
 
+def sample_step_tokens(lg, pos_flat, strategy, temperature, top_k,
+                       base_seed):
+    """The token-choice core of ``slot_decode_sample``, shared with the
+    speculative accept walk (``speculative_ops``): greedy argmax, or
+    temperature/top-k sampling keyed on ``fold_in(fold_in(
+    PRNGKey(base_seed), slot), position)``. ``lg`` is ``[S, V]``
+    float32 logits, ``pos_flat`` the per-slot SEQUENCE position being
+    sampled at. Because the key depends only on (seed, slot, position)
+    — never on how the token loop is partitioned into dispatches — a
+    speculative verify that samples each accepted position through this
+    function emits tokens bit-identical to the sequential stream.
+    Returns flat ``[S]`` tokens (device int dtype, no done forcing)."""
+    idt = device_dtype("int64")
+    if strategy == "greedy" or temperature <= 0.0:
+        return jnp.argmax(lg, axis=-1).astype(idt)
+    S = lg.shape[0]
+    scaled = lg / temperature
+    if strategy == "top_k" and top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    base = jax.random.PRNGKey(int(base_seed))
+    keys = jax.vmap(
+        lambda i, p: jax.random.fold_in(jax.random.fold_in(base, i), p)
+    )(jnp.arange(S), pos_flat.astype(jnp.int32))
+    return jax.vmap(jax.random.categorical)(keys, scaled).astype(idt)
+
+
 def _lower_slot_decode_sample(ctx, ins, attrs):
     """Batched per-slot token selection for the serving decode loop
     (serving/generation.py): greedy argmax, temperature, or top-k
@@ -169,18 +196,8 @@ def _lower_slot_decode_sample(ctx, ins, attrs):
             "decode budget; positions clamp to max_length - 1), got %d"
             % max_len)
     idt = device_dtype("int64")
-    if strategy == "greedy" or temperature <= 0.0:
-        tok = jnp.argmax(lg, axis=-1).astype(idt)
-    else:
-        scaled = lg / temperature
-        if strategy == "top_k" and top_k > 0:
-            kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
-            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-        base = jax.random.PRNGKey(int(attrs.get("base_seed", 0)))
-        keys = jax.vmap(
-            lambda i, p: jax.random.fold_in(jax.random.fold_in(base, i), p)
-        )(jnp.arange(S), pos_flat.astype(jnp.int32))
-        tok = jax.vmap(jax.random.categorical)(keys, scaled).astype(idt)
+    tok = sample_step_tokens(lg, pos_flat, strategy, temperature, top_k,
+                             int(attrs.get("base_seed", 0)))
     if done_in is not None:
         was_done = jnp.reshape(done_in, (-1,)) > 0
         tok = jnp.where(was_done, jnp.asarray(eos, idt), tok)

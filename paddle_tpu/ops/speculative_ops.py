@@ -1,0 +1,198 @@
+"""Speculative-decoding ops: tree write, accept walk, survivor commit.
+
+The verify side of speculative decoding over the paged slot pool
+(serving/generation.py ``SlotDecodeSession(speculative=...)``): a host
+drafter proposes K tokens per slot as a speculation TREE (node 0 is the
+anchor — the slot's current token — and draft node ``i`` extends node
+``parent[i]``); the target model scores every node in one dispatch
+through ``paged_tree_attention``; then ``slot_speculative_accept``
+replays the EXACT sequential sampling rule down the tree and commits
+the longest draft prefix the target itself would have emitted, plus
+one correction/bonus token.
+
+Bit-exactness contract: the accept walk samples each position through
+``sampling_ops.sample_step_tokens`` — the same token-choice core, with
+the same (seed, slot, position) PRNG key scheme, that the plain
+``slot_decode_sample`` step uses — and advances the slot lifecycle
+through the shared ``slot_lifecycle_advance`` formula. The committed
+stream is therefore bit-identical to the ``FLAGS_speculative=off``
+sequential stream (greedy exact, sampled via the key scheme); the
+drafter only decides how MANY of those tokens land per dispatch, never
+WHICH tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.op_registry import register_op
+from paddle_tpu.core.types import device_dtype
+from paddle_tpu.ops.sampling_ops import (
+    sample_step_tokens,
+    slot_lifecycle_advance,
+)
+
+
+def _lower_paged_spec_kv_write(ctx, ins, attrs):
+    """Tree write: land all N tree nodes' K/V rows into the slot's
+    write pages at storage positions ``pos .. pos + N - 1`` (node 0 —
+    the anchor — at ``pos``, exactly where the plain step would write
+    it). Done slots pass an all-trash table row, and rows past the
+    table's coverage trash-route inside the kernel helper."""
+    from paddle_tpu.kernels.paged_attention import paged_kv_write_block
+
+    k_pool = ins["KPool"][0]
+    v_pool = ins["VPool"][0]
+    k_new = ins["KNew"][0]  # [S, H, N, dh]
+    v_new = ins["VNew"][0]
+    S, H, N, dh = k_new.shape
+    pos = jnp.reshape(ins["Pos"][0], (-1, 1)).astype(jnp.int32)
+    table = jnp.reshape(ins["PageTable"][0], (S, -1)).astype(jnp.int32)
+    positions = pos + jnp.arange(N, dtype=jnp.int32)[None, :]
+    k_out, v_out = paged_kv_write_block(
+        k_pool, v_pool, k_new, v_new, table, positions)
+    return {"KOut": k_out, "VOut": v_out}
+
+
+register_op(
+    "paged_spec_kv_write",
+    inputs=["KPool", "VPool", "KNew", "VNew", "PageTable", "Pos"],
+    outputs=["KOut", "VOut"],
+    lower=_lower_paged_spec_kv_write,
+    grad=None,
+    no_grad_inputs=("PageTable", "Pos"),
+)
+
+
+def _lower_paged_spec_kv_compact(ctx, ins, attrs):
+    """Survivor commit: move accepted path nodes' K/V rows to their
+    canonical storage positions (``base + j`` gets node ``path[j]``'s
+    row for ``1 <= j < accept_len``). Rejected branches' rows are
+    simply left behind past the new resident length — never attended
+    again, overwritten by the next dispatch's tree."""
+    from paddle_tpu.kernels.paged_attention import paged_kv_compact
+
+    k_pool = ins["KPool"][0]
+    v_pool = ins["VPool"][0]
+    path = ins["Path"][0]
+    S = path.shape[0]
+    table = jnp.reshape(ins["PageTable"][0], (S, -1)).astype(jnp.int32)
+    base = jnp.reshape(ins["Pos"][0], (-1,)).astype(jnp.int32)
+    acc = jnp.reshape(ins["AcceptLen"][0], (-1,)).astype(jnp.int32)
+    k_out, v_out = paged_kv_compact(
+        k_pool, v_pool, table, base, jnp.reshape(path, (S, -1)), acc)
+    return {"KOut": k_out, "VOut": v_out}
+
+
+register_op(
+    "paged_spec_kv_compact",
+    inputs=["KPool", "VPool", "PageTable", "Pos", "Path", "AcceptLen"],
+    outputs=["KOut", "VOut"],
+    lower=_lower_paged_spec_kv_compact,
+    grad=None,
+    no_grad_inputs=("PageTable", "Pos", "Path", "AcceptLen"),
+)
+
+
+def _lower_slot_speculative_accept(ctx, ins, attrs):
+    """The in-graph accept/reject walk. Per slot, starting at the
+    anchor (node 0, sequence position ``pos``):
+
+    1. sample token ``u`` from the current node's logits with the
+       sequential rule (``sample_step_tokens`` at the node's sequence
+       position);
+    2. commit ``u`` and advance the lifecycle via the shared
+       ``slot_lifecycle_advance`` (done latches on eos / budget);
+    3. if some draft child of the current node carries exactly ``u``
+       (and its storage position is inside the decode budget), descend
+       into it and repeat — otherwise stop: ``u`` was the correction
+       (or bonus) token and becomes the next dispatch's anchor.
+
+    Every live slot commits at least 1 token (the plain step's rate)
+    and at most N. Entries of ``TokSeq`` past ``AcceptLen`` are eos
+    padding, same as the multi-step fetch contract. ``Path[j]`` names
+    the tree node whose K/V row backs committed token ``j`` (for
+    ``1 <= j < AcceptLen``; identity elsewhere) — the
+    ``paged_spec_kv_compact`` gather map. ``Out`` is the new anchor
+    token (eos for done slots, the ``slot_decode_sample`` forcing
+    rule)."""
+    lg = ins["Logits"][0].astype(jnp.float32)  # [S, N, V]
+    S, N, _V = lg.shape
+    nodes = jnp.reshape(ins["Nodes"][0], (S, N))
+    parent = jnp.reshape(ins["Parent"][0], (S, N)).astype(jnp.int32)
+    pos = ins["Pos"][0]
+    pos_flat = jnp.reshape(pos, (-1,))
+    done_in = ins["Done"][0]
+    was_done = jnp.reshape(done_in, (-1,)) > 0
+    strategy = attrs.get("strategy", "greedy")
+    temperature = float(attrs.get("temperature", 1.0))
+    top_k = int(attrs.get("top_k", 0))
+    base_seed = int(attrs.get("base_seed", 0))
+    eos = int(attrs.get("eos_id", 2))
+    max_len = int(attrs.get("max_length", 0))
+    if max_len < 2:
+        raise ValueError(
+            "slot_speculative_accept: max_length attr must be >= 2 "
+            "(the decode budget), got %d" % max_len)
+    idt = device_dtype("int64")
+
+    cur = jnp.zeros((S,), jnp.int32)
+    posq = pos_flat
+    done_s = was_done
+    stopped = was_done  # a finished slot never walks
+    acc_len = jnp.zeros((S,), jnp.int32)
+    path = jnp.tile(jnp.arange(N, dtype=jnp.int32)[None, :], (S, 1))
+    j_idx = jnp.arange(N, dtype=jnp.int32)[None, :]
+    tok_cols = []
+    # N is small and static: unrolled walk, one sequential-sampling
+    # replay per level
+    for d in range(N):
+        active = jnp.logical_not(stopped)
+        lg_cur = lg[jnp.arange(S), cur]  # [S, V]
+        u = sample_step_tokens(lg_cur, posq, strategy, temperature,
+                               top_k, base_seed)
+        adv_pos, adv_done = slot_lifecycle_advance(
+            posq, done_s, u, eos, max_len)
+        new_posq = jnp.where(active, adv_pos, posq)
+        new_done = jnp.where(active, adv_done, done_s)
+        # draft child carrying the target's own token, storage in budget
+        match = ((parent == cur[:, None]) & (j_idx >= 1)
+                 & (nodes.astype(idt) == u[:, None])
+                 & (pos_flat.astype(jnp.int32)[:, None] + j_idx < max_len))
+        has_child = jnp.any(match, axis=1)
+        child = jnp.argmax(match, axis=1).astype(jnp.int32)
+        cont = active & jnp.logical_not(new_done) & has_child
+        if d + 1 < N:
+            path = path.at[:, d + 1].set(
+                jnp.where(cont, child, path[:, d + 1]))
+        tok_cols.append(jnp.where(active, u, jnp.asarray(eos, idt)))
+        acc_len = acc_len + active.astype(jnp.int32)
+        stopped = stopped | (active & jnp.logical_not(cont))
+        cur = jnp.where(cont, child, cur)
+        posq = new_posq
+        done_s = new_done
+
+    toks = jnp.stack(tok_cols, axis=1)  # [S, N]
+    last = jnp.clip(acc_len - 1, 0, N - 1)
+    anchor = jnp.where(acc_len > 0, toks[jnp.arange(S), last],
+                       jnp.asarray(eos, idt))
+    return {
+        "Out": anchor[:, None],
+        "TokSeq": toks,
+        "AcceptLen": acc_len.astype(idt)[:, None],
+        "Path": path.astype(idt),
+        "PosOut": jnp.reshape(posq, jnp.shape(pos)).astype(
+            pos_flat.dtype),
+        "DoneOut": done_s.astype(idt)[:, None],
+    }
+
+
+register_op(
+    "slot_speculative_accept",
+    inputs=["Logits", "Nodes", "Parent", "Pos", "Done"],
+    outputs=["Out", "TokSeq", "AcceptLen", "Path", "PosOut", "DoneOut"],
+    attrs={"strategy": "greedy", "temperature": 1.0, "top_k": 0,
+           "base_seed": 0, "eos_id": 2, "max_length": 0},
+    lower=_lower_slot_speculative_accept,
+    grad=None,
+    no_grad_inputs=("Nodes", "Parent", "Pos", "Done"),
+)

@@ -222,7 +222,7 @@ class ServingClient(JsonLineClient):
     # -- streaming decode ----------------------------------------------------
 
     def generate(self, src, src_len=None, n=1, prefix_tokens=None,
-                 beam=False):
+                 beam=False, len_penalty=None):
         """Stream one generation (``n > 1``: a best-of-N fork group via
         the session's ``admit_group``; ``prefix_tokens``: forced prefix
         riding the prefix cache). Returns a GENERATOR of event dicts,
@@ -247,6 +247,11 @@ class ServingClient(JsonLineClient):
         zero-copy reorder executed, with each survivor's selected token
         and accumulated score), and a final ``{"event": "beam_end",
         "tokens" [K x T], "scores" [K]}`` n-best before ``end``.
+        ``len_penalty`` (beam only) asks the frontend to rescore that
+        final n-best with the GNMT length penalty: ``beam_end`` comes
+        back reordered score-descending under the PENALIZED scores and
+        gains ``order`` (the permutation of raw hypothesis indices) +
+        the echoed ``len_penalty``.
 
         Closing the generator before the terminal event sends an
         in-band cancel (the frontend tears the generation down and
@@ -260,6 +265,8 @@ class ServingClient(JsonLineClient):
                "n": int(n)}
         if beam:
             req["beam"] = True
+        if len_penalty is not None:
+            req["len_penalty"] = float(len_penalty)
         if src_len is not None:
             req["src_len"] = int(np.ravel(src_len)[0])
         if prefix_tokens is not None:
@@ -381,20 +388,26 @@ class ServingClient(JsonLineClient):
         return rows
 
     def generate_beam(self, src, src_len=None, prefix_tokens=None,
-                      on_event=None):
+                      on_event=None, len_penalty=None):
         """Consume one whole beam stream and return ``(tokens [K, T]
         int64, scores [K] float32)`` in score-descending hypothesis
         order — bit-identical to the in-process
-        ``SlotDecodeSession.generate_beam``. The incremental ``beam``
-        survivor chunks are REPLAYED client-side (each survivor adopts
-        its parent's row and appends its token — the same reorder the
-        server executed as table rebinds) and cross-checked against the
-        final ``beam_end`` n-best, so a framing bug in the chunk stream
-        can never pass silently. ``on_event`` sees every raw event."""
+        ``SlotDecodeSession.generate_beam`` (including a requested
+        ``len_penalty``: the frontend rescores the final n-best with
+        the GNMT length penalty and returns PENALIZED scores). The
+        incremental ``beam`` survivor chunks are REPLAYED client-side
+        (each survivor adopts its parent's row and appends its token —
+        the same reorder the server executed as table rebinds) and
+        cross-checked against the final ``beam_end`` n-best (through
+        the server's ``order`` permutation when it rescored), so a
+        framing bug in the chunk stream can never pass silently.
+        ``on_event`` sees every raw event."""
         rows = fill = prev_done = None
         final = None
+        order = None
         for ev in self.generate(src, src_len=src_len,
-                                prefix_tokens=prefix_tokens, beam=True):
+                                prefix_tokens=prefix_tokens, beam=True,
+                                len_penalty=len_penalty):
             if on_event is not None:
                 on_event(ev)
             kind = ev.get("event")
@@ -426,12 +439,19 @@ class ServingClient(JsonLineClient):
             elif kind == "beam_end":
                 final = (np.asarray(ev["tokens"], dtype="int64"),
                          np.asarray(ev["scores"], dtype="float32"))
+                if ev.get("order") is not None:
+                    order = [int(i) for i in ev["order"]]
         if final is None:
             raise ServingError("beam stream ended without a beam_end")
-        if rows is not None and not np.array_equal(rows, final[0]):
-            raise ServingError(
-                "beam survivor chunks replay to a different n-best "
-                "than the server's beam_end — torn stream framing")
+        if rows is not None:
+            # a rescored beam_end is the RAW n-best permuted by
+            # ``order``; realign the replay before the framing check
+            replay = rows[order] if order is not None else rows
+            if not np.array_equal(replay, final[0]):
+                raise ServingError(
+                    "beam survivor chunks replay to a different "
+                    "n-best than the server's beam_end — torn stream "
+                    "framing")
         return final
 
     def take_result(self, request_id):

@@ -453,11 +453,29 @@ class _DecodeWorker(object):
                     "tokens": [int(t) for t in fin["step_tokens"]],
                     "scores": [float(x) for x in fin["step_scores"]],
                     "done": [True] * len(fin["parents"])})
-                stream.q.put({
+                end_ev = {
                     "ok": True, "event": "beam_end",
                     "tokens": [[int(t) for t in row]
                                for row in res["tokens"]],
-                    "scores": [float(x) for x in res["scores"]]})
+                    "scores": [float(x) for x in res["scores"]]}
+                lp = stream.spec.get("len_penalty")
+                if lp is not None:
+                    # GNMT length-penalty rescoring as a wire option:
+                    # the n-best reorders under the penalized scores;
+                    # ``order`` carries the permutation so the client's
+                    # survivor-chunk replay cross-check can realign
+                    from paddle_tpu.models.transformer import (
+                        gnmt_rescore_nbest,
+                    )
+
+                    order, toks, pscores = gnmt_rescore_nbest(
+                        res["tokens"], res["scores"], s._eos, lp)
+                    end_ev["tokens"] = [[int(t) for t in row]
+                                        for row in toks]
+                    end_ev["scores"] = [float(x) for x in pscores]
+                    end_ev["order"] = [int(i) for i in order]
+                    end_ev["len_penalty"] = float(lp)
+                stream.q.put(end_ev)
                 stream.done = True
                 stream.q.put({"ok": True, "event": "end"})
         for slot in list(self._slot_stream):
@@ -731,12 +749,21 @@ class ServingFrontend(object):
                 "n": int(req.get("n", 1)),
                 "prefix": req.get("prefix_tokens"),
                 "beam": bool(req.get("beam", False)),
+                "len_penalty": (None
+                                if req.get("len_penalty") is None
+                                else float(req["len_penalty"])),
             }
             if spec["beam"] and spec["n"] != 1:
                 self._observe("generate", "error", t0)
                 yield error_to_wire(ServingError(
                     "beam=true uses the session's beam_width; it does "
                     "not compose with n > 1 fork groups"))
+                return
+            if spec["len_penalty"] is not None and not spec["beam"]:
+                self._observe("generate", "error", t0)
+                yield error_to_wire(ServingError(
+                    "len_penalty rescores a beam n-best; it needs "
+                    "beam=true"))
                 return
             stream = _Stream(spec)
             conn.state.setdefault("streams", set()).add(stream)

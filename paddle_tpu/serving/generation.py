@@ -170,6 +170,19 @@ _cow_dispatches = _REGISTRY.counter(
     "paddle_tpu_serving_cow_dispatches_total",
     "coalesced COW/table-rebind dispatches (one bucket-laddered "
     "executable per step window, however many pairs it carries)")
+_spec_proposed = _REGISTRY.counter(
+    "paddle_tpu_serving_speculative_proposed_tokens_total",
+    "draft tokens proposed to the speculative verify dispatch (K per "
+    "live slot per dispatch)")
+_spec_accepted = _REGISTRY.counter(
+    "paddle_tpu_serving_speculative_accepted_tokens_total",
+    "draft tokens the target's accept walk committed (excludes the "
+    "per-slot correction/bonus token every dispatch commits anyway)")
+_spec_accept_rate = _REGISTRY.gauge(
+    "paddle_tpu_serving_speculative_acceptance_rate",
+    "accepted / proposed draft tokens, session lifetime — the lever "
+    "behind speculative_speedup: committed tokens per target dispatch "
+    "is 1 + rate * K")
 
 
 class SlotDecodeSession(object):
@@ -198,13 +211,23 @@ class SlotDecodeSession(object):
     in both layouts. ``decoder_cfg`` forwards to the builder
     (``src_vocab_size``, ``trg_vocab_size``, ``n_layer``, ``n_head``,
     ``d_inner``).
+
+    ``speculative=K`` (or ``{"k": K, "drafter": "ngram"|"model",
+    ...}``; paged sampler sessions, ``steps=1``) decodes by
+    draft-then-verify: a host drafter proposes K tokens per slot, ONE
+    tree-attention target dispatch verifies them and commits the
+    longest prefix the target itself would have sampled (1 to K + 1
+    tokens per dispatch). Token streams are BIT-identical to the same
+    session under ``FLAGS_speculative=off`` — the drafter only moves
+    throughput, never content. See ``serving/speculative.py`` and
+    docs/SERVING.md "Speculative decode".
     """
 
     def __init__(self, exe, num_slots, max_length=64, d_model=128,
                  bos_id=1, eos_id=2, scope=None, paged=False,
                  page_size=8, num_pages=None, num_groups=None, steps=1,
                  sampler=None, prefix_cache_pages=0, degradation=None,
-                 beam_width=1, **decoder_cfg):
+                 beam_width=1, speculative=None, **decoder_cfg):
         from paddle_tpu.models import transformer
 
         self._transformer = transformer
@@ -218,6 +241,37 @@ class SlotDecodeSession(object):
         self._sampler = sampler
         self._n_layer = int(decoder_cfg.get("n_layer", 2))
         self._n_head = int(decoder_cfg.get("n_head", 4))
+        # speculative decode config: int K (n-gram drafter) or a dict
+        # {"k": K, "drafter": "ngram"|"model", ...drafter kwargs}
+        if speculative is None:
+            spec_cfg = {}
+        elif isinstance(speculative, dict):
+            spec_cfg = dict(speculative)
+        else:
+            spec_cfg = {"k": int(speculative)}
+        self._spec_cfg = spec_cfg
+        self._spec_k = int(spec_cfg.get("k", 0) or 0)
+        self.spec_proposed = 0    # draft tokens offered
+        self.spec_accepted = 0    # draft tokens committed
+        self.spec_dispatches = 0  # verify dispatches run
+        if self._spec_k < 0:
+            raise ValueError("speculative k must be >= 0 (0 disables), "
+                             "got %d" % self._spec_k)
+        if self._spec_k:
+            if not self._paged:
+                raise ValueError(
+                    "speculative decode needs paged=True — the tree "
+                    "writes/compaction ARE page-table operations")
+            if int(steps) != 1:
+                raise ValueError(
+                    "speculative decode needs steps=1: drafting and "
+                    "accept bookkeeping happen on the host BETWEEN "
+                    "dispatches (each dispatch already advances up to "
+                    "k + 1 tokens)")
+            if int(beam_width) > 1:
+                raise ValueError(
+                    "speculative decode verifies the sampler stream — "
+                    "it does not compose with beam_width > 1")
         self._beam_width = int(beam_width)
         if self._beam_width < 1:
             raise ValueError("beam_width must be >= 1, got %d"
@@ -254,15 +308,22 @@ class SlotDecodeSession(object):
                     "pool needs 1 trash page + ceil(max_length / "
                     "page_size) = %d pages, or every admit() would "
                     "fail its reservation" % (self._P, 1 + self._npp))
-            (self._init_prog, self._admit_prog, self._join_prog,
-             self._prefill_prog, self._table_prog,
-             self._step_prog, self._fetch_name) = \
-                transformer.build_paged_slot_decoder(
-                    num_slots, max_length=max_length, d_model=d_model,
-                    page_size=self._ps, num_pages=self._P,
-                    num_groups=self._G, bos_id=bos_id, eos_id=eos_id,
-                    sampler=sampler, beam_width=self._beam_width,
-                    **decoder_cfg)
+            built = transformer.build_paged_slot_decoder(
+                num_slots, max_length=max_length, d_model=d_model,
+                page_size=self._ps, num_pages=self._P,
+                num_groups=self._G, bos_id=bos_id, eos_id=eos_id,
+                sampler=sampler, beam_width=self._beam_width,
+                speculative=self._spec_k, **decoder_cfg)
+            if self._spec_k:
+                (self._init_prog, self._admit_prog, self._join_prog,
+                 self._prefill_prog, self._table_prog, self._step_prog,
+                 self._spec_prog, spec_fetches) = built
+                self._spec_fetches = dict(spec_fetches)
+                self._fetch_name = self._spec_fetches["token"]
+            else:
+                (self._init_prog, self._admit_prog, self._join_prog,
+                 self._prefill_prog, self._table_prog,
+                 self._step_prog, self._fetch_name) = built
             if self._beam_width > 1:
                 # the beam builder returns a fetch-name DICT (token /
                 # parent / score / logits); the session fetches the
@@ -344,6 +405,41 @@ class SlotDecodeSession(object):
             self._beam_results = {}   # rid -> {"tokens", "scores"}
             self.beam_reorder_pages = 0  # physical page copies, reorder
             self.beam_cow_copies = 0     # COW splits charged to beam
+            # speculative decode plumbing: the drafter, the (static)
+            # chain-tree feeds, and the acceptance books. The plain
+            # step program stays built and warm — FLAGS_speculative is
+            # read at EVERY step, so the off-oracle flips mid-session
+            # with zero recompiles on either side.
+            self._spec_drafter = None
+            if self._spec_k:
+                from paddle_tpu.serving import speculative as _spec_mod
+
+                kind = str(spec_cfg.get("drafter", "ngram"))
+                if kind == "ngram":
+                    self._spec_drafter = _spec_mod.NgramDrafter(
+                        self._S, self._spec_k, eos_id=self._eos,
+                        order=int(spec_cfg.get("order", 3)))
+                elif kind == "model":
+                    self._spec_drafter = _spec_mod.DraftModelDrafter(
+                        exe, self._S, self._spec_k,
+                        trg_vocab_size=int(decoder_cfg.get(
+                            "trg_vocab_size", 1000)),
+                        max_length=self._T, n_head=self._n_head,
+                        d_model=self._D, page_size=self._ps,
+                        num_pages=self._P, eos_id=self._eos,
+                        scope=scope,
+                        d_inner=spec_cfg.get("draft_d_inner"))
+                else:
+                    raise ValueError(
+                        "speculative drafter must be 'ngram' or "
+                        "'model', got %r" % (kind,))
+                parent, anc = _spec_mod.chain_tree(self._spec_k)
+                n_nodes = self._spec_k + 1
+                self._spec_parent = np.tile(parent[None, :],
+                                            (self._S, 1))
+                self._spec_anc = np.tile(anc[None, :, :],
+                                         (self._S, 1, 1))
+                self._spec_nodes = n_nodes
         else:
             if steps != 1:
                 raise ValueError(
@@ -478,7 +574,7 @@ class SlotDecodeSession(object):
             grew = True
         return grew
 
-    def _cow_copies(self, slot, pos, pending=None):
+    def _cow_copies(self, slot, pos, pending=None, span=None):
         """Copy-on-write scan for one dispatch: every page this slot
         will WRITE in positions ``[pos, pos + steps)`` that is still
         shared (refcount > 1 — a fork sibling or the prefix cache
@@ -493,8 +589,9 @@ class SlotDecodeSession(object):
         writes in place, exactly as the sequential per-pair path did —
         N sharers cost N-1 copies, not N."""
         pages = self._slot_pages[slot]
+        span = self._steps if span is None else int(span)
         first = int(pos) // self._ps
-        last = min(int(pos) + self._steps - 1, self._T - 1) // self._ps
+        last = min(int(pos) + span - 1, self._T - 1) // self._ps
         copies = []
         pending = pending if pending is not None else {}
         for i in range(first, min(last + 1, len(pages))):
@@ -604,6 +701,11 @@ class SlotDecodeSession(object):
         self._write_table_row(slot, [])
         for pg in self._slot_pages.pop(slot):
             self._pool.deref(pg)
+        drafter = getattr(self, "_spec_drafter", None)
+        if drafter is not None:
+            # the slot's next occupant must not inherit this one's
+            # draft-cache watermark
+            drafter.forget(slot)
         gid = self._slot_group.pop(slot, None)
         members = self._group_members.get(gid)
         if members is not None:
@@ -666,6 +768,19 @@ class SlotDecodeSession(object):
         if self._paged and self._prefix_cache is not None:
             self._prefix_cache.clear()
             self._update_pool_gauges()
+
+    def _take_slot(self):
+        """Claim the LOWEST-numbered free slot. Deterministic placement
+        is part of the seeded-sampling story: the PRNG stream is keyed
+        on (seed, slot, position), so two runs that admit the same
+        requests in the same order must land them on the same slots for
+        their sampled tokens to be bit-identical (the
+        ``FLAGS_speculative`` on/off oracle relies on this). A plain
+        ``list.pop()`` would hand out slots in RELEASE order, which
+        depends on completion timing."""
+        slot = min(self._free)
+        self._free.remove(slot)
+        return slot
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -732,7 +847,7 @@ class SlotDecodeSession(object):
                 % self._S)
         src = np.asarray(src, dtype="int64").reshape(1, self._T)
         length = self._T if src_len is None else int(np.ravel(src_len)[0])
-        slot = self._free.pop()
+        slot = self._take_slot()
         feed = {
             "src_word": src,
             "src_len": np.asarray([[length]], dtype="int64"),
@@ -840,7 +955,7 @@ class SlotDecodeSession(object):
         try:
             # -- member 0: encoder forward + (any) prefill ------------------
             slot0 = (pending_slots.popleft() if pending_slots is not None
-                     else self._free.pop())
+                     else self._take_slot())
             slots.append(slot0)
             cached = []
             if self._prefix_cache is not None and L > 1:
@@ -901,7 +1016,7 @@ class SlotDecodeSession(object):
             shared = pages[:self._pages_for(max(L - 1, 0), self._ps)]
             for _ in range(1, n):
                 s = (pending_slots.popleft() if pending_slots is not None
-                     else self._free.pop())
+                     else self._take_slot())
                 slots.append(s)
                 mpages = []
                 for pg in shared:
@@ -1266,11 +1381,17 @@ class SlotDecodeSession(object):
         self._update_pool_gauges()
         return finished
 
-    def generate_beam(self, src, src_len=None, prefix_tokens=None):
+    def generate_beam(self, src, src_len=None, prefix_tokens=None,
+                      len_penalty=None):
         """Dedicated-session convenience: run ONE beam to completion
         and return ``(tokens [K, T] int64, scores [K] float32)`` in
         score-descending hypothesis order (bos-led, eos-padded rows).
-        Other lanes finishing meanwhile are returned to nobody — use
+        ``len_penalty`` (optional float) rescoring: the final n-best is
+        reordered under the GNMT length penalty
+        (``transformer.gnmt_rescore_nbest`` — the same formula the
+        offline ``beam_generate`` applies via ``_pick_best_beam``) and
+        the returned scores are the PENALIZED ones. Other lanes
+        finishing meanwhile are returned to nobody — use
         :meth:`register_beam_owner` + :meth:`take_beam_result` for
         concurrent consumers."""
         lane = self.admit_beam(src, src_len=src_len,
@@ -1279,7 +1400,14 @@ class SlotDecodeSession(object):
         while lane in self._beam_live:
             self.step()
         out = self.take_beam_result(rid)
-        return out["tokens"], out["scores"]
+        if len_penalty is None:
+            return out["tokens"], out["scores"]
+        from paddle_tpu.models import transformer
+
+        _order, tokens, scores = transformer.gnmt_rescore_nbest(
+            out["tokens"], out["scores"], self._eos,
+            float(len_penalty))
+        return tokens, scores
 
     def cancel(self, slot):
         """Abort one in-flight sequence — the disconnect/cancel
@@ -1411,15 +1539,19 @@ class SlotDecodeSession(object):
             _decode_tps.set(live_before / elapsed)
         return finished
 
-    def _cow_window(self, slots_positions):
+    def _cow_window(self, slots_positions, span=None):
         """Assemble one dispatch window's COW pairs + growth rebinds
         for ``[(slot, write_pos)]``; the page lists are repointed here,
-        the device catches up in ONE ``_dispatch_cow`` call."""
+        the device catches up in ONE ``_dispatch_cow`` call. ``span``
+        is the number of positions the dispatch will write per slot
+        (default ``steps``; a speculative verify dispatch writes its
+        whole k + 1 node tree)."""
         window = []
+        span = self._steps if span is None else int(span)
         pending = {}  # src -> derefs planned by this window's pairs
         for slot, pos in slots_positions:
-            grew = self._provision(slot, pos + self._steps)
-            copies = self._cow_copies(slot, pos, pending)
+            grew = self._provision(slot, pos + span)
+            copies = self._cow_copies(slot, pos, pending, span=span)
             for src_pg, dst_pg in copies:
                 window.append((slot, src_pg, dst_pg))
             if grew and not copies:
@@ -1427,6 +1559,14 @@ class SlotDecodeSession(object):
         return window
 
     def _step_paged(self):
+        if self._spec_k:
+            from paddle_tpu import flags as _flags
+
+            # the bit-exactness oracle: FLAGS_speculative=off routes
+            # this very session through the plain sequential step —
+            # both executables stay warm, the flag flips mid-stream
+            if _flags.get("speculative") != "off":
+                return self._step_speculative()
         # pre-provision every live slot for the whole dispatch: step j
         # writes K/V at position pos + j, so the table must cover
         # pos + steps resident tokens before the scan launches — and
@@ -1448,6 +1588,74 @@ class SlotDecodeSession(object):
         if elapsed > 0:
             _decode_tps.set(live_before * self._steps / elapsed)
         self._update_pool_gauges()
+        return finished
+
+    def _step_speculative(self):
+        """One draft-then-verify round: host drafting, ONE target
+        dispatch scoring the anchor + k draft tokens as a tree in the
+        slot's write pages, in-graph accept/commit, host bookkeeping
+        honoring the per-slot accept length. Commits 1 to k + 1 tokens
+        per live slot; token streams are bit-identical to the
+        sequential ``FLAGS_speculative=off`` path."""
+        # the verify dispatch writes the whole tree — storage positions
+        # [pos, pos + N) — so COW/provisioning covers the full span
+        # before any drafting touches the (shared) page tables
+        self._dispatch_cow(self._cow_window(
+            [(slot, st["pos"]) for slot, st in self._live.items()],
+            span=self._spec_nodes))
+        self._update_pool_gauges()
+        draft = self._spec_drafter.propose(self._live)
+        t0 = time.perf_counter()
+        out = self._run(self._spec_prog, {
+            "spec_draft": draft.astype("int64"),
+            "spec_parent": self._spec_parent,
+            "spec_anc": self._spec_anc,
+        }, [self._spec_fetches["spec_token_seq"],
+            self._spec_fetches["spec_accept_len"]])
+        elapsed = time.perf_counter() - t0
+        tok_seq = np.asarray(out[0]).reshape(self._S, self._spec_nodes)
+        acc_len = np.asarray(out[1]).reshape(self._S)
+        live_slots = list(self._live)
+        committed = int(sum(int(acc_len[s]) for s in live_slots))
+        accepted = int(sum(max(int(acc_len[s]) - 1, 0)
+                           for s in live_slots))
+        proposed = self._spec_k * len(live_slots)
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self.spec_dispatches += 1
+        _spec_proposed.inc(proposed)
+        _spec_accepted.inc(accepted)
+        if self.spec_proposed:
+            _spec_accept_rate.set(
+                self.spec_accepted / float(self.spec_proposed))
+        finished = self._consume_spec(tok_seq, acc_len)
+        if elapsed > 0:
+            _decode_tps.set(committed / elapsed)
+        self._update_pool_gauges()
+        return finished
+
+    def _consume_spec(self, tok_seq, acc_len):
+        """Apply one verify dispatch's commits to the live slots:
+        exactly ``acc_len[slot]`` tokens per slot (entries past that
+        are eos padding, NOT tokens — unlike ``_consume_tokens``'s
+        per-step trajectory, where padding only follows a terminal
+        token and is self-identifying)."""
+        finished = {}
+        for slot in list(self._live):
+            st = self._live[slot]
+            for j in range(int(acc_len[slot])):
+                t = st["pos"]
+                nxt = int(tok_seq[slot, j])
+                st["trg"][t + 1] = nxt
+                st["pos"] = t + 1
+                if nxt == self._eos or t + 1 == self._T - 1:
+                    finished[slot] = st["trg"]
+                    del self._live[slot]
+                    self._free.append(slot)
+                    self._release_pages(slot)
+                    _sequences_total.inc(event="completed")
+                    break
+        _active_slots.set(len(self._live))
         return finished
 
     def _consume_tokens(self, toks):

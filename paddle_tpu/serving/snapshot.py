@@ -188,6 +188,12 @@ class DecodeSnapshotManager(CheckpointManager):
             # a beam snapshot into a differently-tiled session would
             # scramble every lane's lattice — SnapshotMismatchError
             "beam_width": s._beam_width,
+            # speculative config too: a mid-speculation snapshot names
+            # draft-pool rows and a drafter watermark a non-speculative
+            # (or differently-drafted) session could not re-own
+            "speculative": (
+                {"k": int(s._spec_k), "drafter": s._spec_drafter.kind}
+                if getattr(s, "_spec_k", 0) else None),
         }
 
     def _small_vars(self):
@@ -279,6 +285,41 @@ class DecodeSnapshotManager(CheckpointManager):
             "next_req": s._next_req,
             "steps_done": s.steps_done,
         }
+        if getattr(s, "_spec_k", 0):
+            # speculative state: acceptance books + the drafter's own
+            # state (ngram: config only — its lookup state IS the
+            # emitted history; model: the per-slot cache watermark).
+            # The DRAFT K/V pools ride the live-page gather below:
+            # they index through the same page table, so the same live
+            # page ids name exactly the rows a restored drafter's
+            # replay relies on. Draft model PARAMETERS travel too:
+            # accepted CONTENT never depends on them (accepted tokens
+            # are target samples), but acceptance TIMING does, and
+            # timing decides which slot each backlog request lands in
+            # after the restore — the slot keys the sampler stream, so
+            # a drafter with different (freshly random) params would
+            # diverge the restored session's future content.
+            meta["speculative"] = {
+                "counters": {
+                    "proposed": int(s.spec_proposed),
+                    "accepted": int(s.spec_accepted),
+                    "dispatches": int(s.spec_dispatches),
+                },
+                "drafter": {"kind": s._spec_drafter.kind,
+                            "state": s._spec_drafter.state_dict()},
+            }
+            if s._spec_drafter.kind == "model":
+                dparams = s._spec_drafter.param_arrays()
+                meta["speculative"]["drafter"]["params"] = \
+                    sorted(dparams)
+                for pname, arr in dparams.items():
+                    snap["spec_dparam__" + pname] = arr
+                if live_pages:
+                    for kind in ("kpool", "vpool"):
+                        pool = np.asarray(
+                            scope.get_value("pgd_draft_%s_0" % kind))
+                        snap["pgd_draft_%s_0__live" % kind] = \
+                            pool[np.asarray(live_pages)]
         if s._beam_width > 1:
             # the hypothesis->slot binding, lane occupancy, last parent
             # permutation and banked n-bests — mid-beam restores resume
@@ -442,6 +483,19 @@ class DecodeSnapshotManager(CheckpointManager):
                 if live_groups:
                     gathered["pgd_%s_%d" % (kind, i)] = (
                         live_groups, load("pgd_%s_%d__live" % (kind, i)))
+        spec_meta = meta.get("speculative")
+        spec_dparams = {}
+        if spec_meta is not None:
+            if live_pages:
+                for kind in ("kpool", "vpool"):
+                    name = "pgd_draft_%s_0" % kind
+                    if name + "__live" in vars_meta:
+                        gathered[name] = (live_pages,
+                                          load(name + "__live"))
+            spec_dparams = {
+                pname: load("spec_dparam__" + pname)
+                for pname in (spec_meta.get("drafter") or {}).get(
+                    "params", ())}
         pool = PagePool.from_state(meta["pool"])
         cache = None
         if meta.get("prefix_cache") is not None:
@@ -502,6 +556,15 @@ class DecodeSnapshotManager(CheckpointManager):
         s._owner = {int(k): int(v) for k, v in meta["owner"].items()}
         s._next_req = int(meta["next_req"])
         s.steps_done = int(meta["steps_done"])
+        if spec_meta is not None:
+            counters = spec_meta.get("counters", {})
+            s.spec_proposed = int(counters.get("proposed", 0))
+            s.spec_accepted = int(counters.get("accepted", 0))
+            s.spec_dispatches = int(counters.get("dispatches", 0))
+            s._spec_drafter.load_state_dict(
+                (spec_meta.get("drafter") or {}).get("state") or {})
+            if spec_dparams:
+                s._spec_drafter.load_param_arrays(spec_dparams)
         if beam_meta is not None:
             from paddle_tpu.serving.generation import _active_beams
 

@@ -478,6 +478,52 @@ def test_generate_beam_over_the_wire_matches_in_process(trained):
     assert bs.shape == (2,)
 
 
+def test_beam_len_penalty_rescoring_wire_matches_in_process(trained):
+    """GNMT length-penalty rescoring as a wire option: ``len_penalty``
+    on a beam request makes the frontend rescore the final n-best
+    (``beam_end`` reorders under the penalized scores and carries the
+    ``order`` permutation the client replay-check realigns through);
+    the wire result is bit-identical to the in-process
+    ``generate_beam(len_penalty=...)``, which itself is exactly
+    ``gnmt_rescore_nbest`` over the raw n-best. ``len_penalty``
+    without ``beam`` is a typed reject."""
+    from paddle_tpu.models.transformer import gnmt_rescore_nbest
+
+    src = trained["src"]
+    args = dict(num_slots=S, max_length=SEQ, d_model=D, paged=True,
+                page_size=4, beam_width=2,
+                scope=trained["scope"].new_scope())
+    args.update(CFG)
+    sess = SlotDecodeSession(trained["exe"], **args)
+    with ServingFrontend(session=sess) as fe:
+        cl = ServingClient(fe.address)
+        events = []
+        got_t, got_s = cl.generate_beam(src[0], src_len=SEQ,
+                                        len_penalty=2.0,
+                                        on_event=events.append)
+        end = [e for e in events if e["event"] == "beam_end"][0]
+        assert end["len_penalty"] == 2.0
+        assert sorted(end["order"]) == [0, 1]
+        with pytest.raises(ServingError, match="beam=true"):
+            list(cl.generate(src[0], src_len=SEQ, len_penalty=0.6))
+        cl.close()
+    assert _drained(sess)
+    want_t, want_s = sess.generate_beam(src[0], SEQ, len_penalty=2.0)
+    np.testing.assert_array_equal(got_t, want_t)
+    np.testing.assert_array_equal(got_s, want_s)
+    # the in-process rescoring IS gnmt_rescore_nbest over the raw
+    # n-best (penalized scores, score-descending reorder)
+    raw_t, raw_s = sess.generate_beam(src[0], SEQ)
+    order, re_t, re_s = gnmt_rescore_nbest(raw_t, raw_s, sess._eos, 2.0)
+    np.testing.assert_array_equal(re_t, want_t)
+    np.testing.assert_array_equal(re_s, want_s)
+    assert sorted(int(i) for i in order) == [0, 1]
+    # len_penalty = 0 divides by 1: identity order, raw scores
+    z_t, z_s = sess.generate_beam(src[0], SEQ, len_penalty=0.0)
+    np.testing.assert_array_equal(z_t, raw_t)
+    np.testing.assert_allclose(z_s, raw_s, rtol=1e-6)
+
+
 def test_generate_backlog_exceeding_slots_completes_concurrently(
         trained):
     """6 concurrent wire streams over a 4-slot pool: the overflow rides

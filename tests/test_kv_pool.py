@@ -180,6 +180,40 @@ class _HostModel(object):
                     w.discard(s)
             self.seqs[s]["written"] = set()
 
+    def speculate(self, sid, span):
+        """Open a speculative verify window (PR 16): the k + 1 node
+        tree writes the sequence's CURRENT write page plus up to
+        ``span - 1`` grown pages — every page in the window is COWed /
+        acquired BEFORE the dispatch (shared pages are read-only), and
+        the indices grown FOR the window are remembered so
+        :meth:`resolve_speculation` can return rejected growth to the
+        free list. Growth is append-by-append, so a mid-window
+        ``NoFreePageError`` leaves a shorter (still accounted) window,
+        never a torn one."""
+        st = self.seqs[sid]
+        spec = st.setdefault("spec", [])
+        start = len(st["pages"])
+        if start:  # the anchor's write page is part of the window
+            self.write(sid, start - 1)
+        for idx in range(start, min(start + span - 1, self.npp)):
+            self.write(sid, idx)  # acquires the page, claims the write
+            spec.append(idx)
+
+    def resolve_speculation(self, sid, accepted):
+        """Close the window: keep ``accepted`` of the grown pages as
+        ordinary committed storage, return the REJECTED tail to the
+        free list — rejected branches' rows die with their pages."""
+        st = self.seqs[sid]
+        grown = st.pop("spec", [])
+        for _ in grown[accepted:]:
+            pg = st["pages"].pop()
+            st["written"].discard(pg)
+            w = self.writers.get(pg)
+            if w is not None:
+                w.discard(sid)
+            if self.pool.deref(pg) == 0:
+                self.writers.pop(pg, None)
+
     def check(self):
         pool = self.pool
         assert pool.free_count + pool.allocated_count == \
@@ -207,6 +241,19 @@ class _HostModel(object):
                 assert not both, \
                     "pages %s written past the fork by %d AND %d" \
                     % (both, sid, other)
+        # speculative windows: the grown pages are the sequence's
+        # contiguous TAIL and privately owned (the pre-dispatch COW
+        # discipline — tree rows never land on a shared page)
+        for sid, st in self.seqs.items():
+            spec = st.get("spec") or []
+            n = len(st["pages"])
+            assert spec == list(range(n - len(spec), n)), \
+                "speculation window of %d is not its page-list tail" \
+                % sid
+            for idx in spec:
+                assert pool.refcount(st["pages"][idx]) == 1, \
+                    "speculated page %d of %d is shared" \
+                    % (st["pages"][idx], sid)
 
 
 def test_insert_never_creates_unreachable_chain_entries():
@@ -244,9 +291,13 @@ def test_property_random_admit_fork_release_prefix():
     the PR 15 BEAM ops (op 6 fork-K: a lane of K hypotheses
     referencing one parent's pages; op 7 reorder-permutation: the
     zero-copy rebind, with duplicating/dropping perms; op 8
-    drop-hypothesis: one lane member cancels) — the conservation/
-    exclusivity/rollback laws hold after every op AND across every
-    restore."""
+    drop-hypothesis: one lane member cancels) AND the PR 16
+    SPECULATIVE ops (op 9 speculate: COW/grow a k + 1 page window
+    before the verify dispatch; op 10 resolve: commit a random prefix
+    of the window and return every rejected page to the free list) —
+    the conservation/exclusivity/rollback laws hold after every op AND
+    across every restore, rejected branches really do return to the
+    free list, and no page is ever double-written past a fork."""
     rng = np.random.RandomState(1234)
     pool = PagePool(24)  # 23 allocatable
     npp = 3
@@ -255,16 +306,26 @@ def test_property_random_admit_fork_release_prefix():
     cached_keys = []  # (fp, tokens) inserted so far
     lanes = []        # beam lanes: lists of sids reordered together
     restores = reorders = pure_perms = 0
+    speculations = rejected_pages = 0
     for opno in range(600):
         # beam ops weighted up: a lane must exist before a reorder can
         # fire, and fork-K's K x npp reservation rejects often on a
         # small pool — the drive needs the extra attempts
-        op = [0, 1, 2, 3, 4, 5, 6, 6, 7, 7, 7, 8][rng.randint(12)]
+        op = [0, 1, 2, 3, 4, 5, 6, 6, 7, 7, 7, 8,
+              9, 9, 9, 10, 10, 10][rng.randint(18)]
         live = sorted(model.seqs)
         # a lane survives as its LIVE members (a released/cancelled
         # hypothesis leaves the lattice; the rest keep reordering)
         lanes = [[s for s in ln if s in model.seqs] for ln in lanes]
         lanes = [ln for ln in lanes if len(ln) > 1]
+        # a slot with an OPEN speculation window is mid-dispatch: the
+        # session never interleaves host writes/forks/reorders with an
+        # in-flight verify (and beam never composes with speculation),
+        # so those ops draw from the spec-free live set
+        inflight = [s for s in live if model.seqs[s].get("spec")]
+        specfree = [s for s in live if not model.seqs[s].get("spec")]
+        idle = [s for s in specfree
+                if not any(s in ln for ln in lanes)]
         try:
             if op == 0:  # admit, maybe through a prefix-cache hit
                 pages = []
@@ -272,17 +333,17 @@ def test_property_random_admit_fork_release_prefix():
                     fp, toks = cached_keys[rng.randint(len(cached_keys))]
                     pages = cache.lookup(fp, toks)
                 model.admit(pages)
-            elif op == 1 and live:  # fork a live sequence
-                parent = live[rng.randint(len(live))]
+            elif op == 1 and specfree:  # fork a live sequence
+                parent = specfree[rng.randint(len(specfree))]
                 upto = rng.randint(npp + 1)
                 model.fork(parent, upto)
-            elif op == 2 and live:  # write (forces COW on shared)
-                sid = live[rng.randint(len(live))]
+            elif op == 2 and specfree:  # write (forces COW on shared)
+                sid = specfree[rng.randint(len(specfree))]
                 model.write(sid, rng.randint(npp))
-            elif op == 3 and live:  # release
+            elif op == 3 and live:  # release (cancel, even mid-window)
                 model.release(live[rng.randint(len(live))])
-            elif op == 4 and live:  # cache a full page of a live seq
-                sid = live[rng.randint(len(live))]
+            elif op == 4 and specfree:  # cache a page of a live seq
+                sid = specfree[rng.randint(len(specfree))]
                 st = model.seqs[sid]
                 if st["pages"]:
                     fp = "fp%d" % rng.randint(3)
@@ -298,10 +359,11 @@ def test_property_random_admit_fork_release_prefix():
                 cache = PrefixCache.from_state(pool, cache.state_dict())
                 model.pool = pool
                 restores += 1
-            elif op == 6 and live:  # beam fork-K: K hypotheses off one
-                # parent (each a reservation-checked fork referencing
-                # the parent's whole list — the beam admission shape)
-                parent = live[rng.randint(len(live))]
+            elif op == 6 and specfree:  # beam fork-K: K hypotheses off
+                # one parent (each a reservation-checked fork
+                # referencing the parent's whole list — the beam
+                # admission shape)
+                parent = specfree[rng.randint(len(specfree))]
                 upto = len(model.seqs[parent]["pages"])
                 K = 2 + rng.randint(2)
                 lane = [parent]
@@ -329,6 +391,20 @@ def test_property_random_admit_fork_release_prefix():
             elif op == 8 and lanes:  # drop-hypothesis (cancel path)
                 lane = lanes[rng.randint(len(lanes))]
                 model.release(lane[rng.randint(len(lane))])
+            elif op == 9 and idle:  # speculate: open a verify window
+                sid = idle[rng.randint(len(idle))]
+                model.speculate(sid, 1 + rng.randint(3))
+                speculations += 1
+            elif op == 10 and inflight:  # accept/reject resolution
+                sid = inflight[rng.randint(len(inflight))]
+                grown = len(model.seqs[sid]["spec"])
+                accepted = rng.randint(grown + 1)
+                free0 = pool.free_count
+                model.resolve_speculation(sid, accepted)
+                # every rejected page is back on the free list NOW —
+                # rejected branches never linger as allocated garbage
+                assert pool.free_count == free0 + (grown - accepted)
+                rejected_pages += grown - accepted
         except NoFreePageError:
             # the reject IS the property: counts must be unchanged by a
             # failed admission (checked below like every other op)
@@ -338,6 +414,9 @@ def test_property_random_admit_fork_release_prefix():
     assert reorders > 5 and pure_perms > 0, \
         "the drive never exercised beam reorders (%d/%d)" \
         % (reorders, pure_perms)
+    assert speculations > 5 and rejected_pages > 0, (
+        "the drive never exercised speculation (%d windows, %d pages "
+        "rejected)" % (speculations, rejected_pages))
     # drain: release everything, clear the cache -> full free list
     for sid in sorted(model.seqs):
         model.release(sid)

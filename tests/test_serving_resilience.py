@@ -237,6 +237,112 @@ def test_ckpt_inspect_knows_the_decode_dialect(trained, tmp_path):
     assert ckpt_inspect.main([step_dir]) == 0  # print-only still reads
 
 
+@pytest.mark.parametrize("drafter", ["ngram", "model"])
+def test_snapshot_restores_mid_speculation_bit_exact(trained, tmp_path,
+                                                     drafter):
+    """A snapshot taken BETWEEN speculative dispatches restores into a
+    fresh session that finishes every request bit-identically to the
+    uninterrupted victim: counters, drafter state and (for the model
+    drafter) the draft K/V pool rows all travel in the dialect."""
+    src, src_len = trained["src"], trained["src_len"]
+
+    def spec_sess():
+        return _paged(trained, steps=1,
+                      speculative={"k": 2, "drafter": drafter})
+
+    victim = spec_sess()
+    vrids = [victim.enqueue(src[i], int(src_len[i])) for i in range(5)]
+    vdone = {}
+    for _ in range(2):
+        vdone.update(victim.pump())
+    assert victim._live and victim.spec_dispatches > 0, \
+        "snapshot point is not mid-speculation"
+    snap = str(tmp_path / "snap")
+    mgr = DecodeSnapshotManager(victim, snap)
+    mgr.save()
+    mgr.close(save=False)
+
+    restored = spec_sess()
+    mgr2 = DecodeSnapshotManager(restored, snap)
+    assert mgr2.restore() is not None
+    assert restored.spec_proposed == victim.spec_proposed
+    assert restored.spec_accepted == victim.spec_accepted
+    assert restored.spec_dispatches == victim.spec_dispatches
+    assert (restored._spec_drafter.state_dict()
+            == victim._spec_drafter.state_dict())
+
+    if drafter == "model":
+        # the draft params must travel: victim and restored drafters
+        # are independently RANDOMLY initialised, and a weight delta
+        # shifts acceptance TIMING — which slot a backlog request
+        # lands in after restore — which keys the sampler stream.
+        # Without the snapshot carrying them this test only fails
+        # when the two random inits happen to disagree early enough.
+        vp = victim._spec_drafter.param_arrays()
+        rp = restored._spec_drafter.param_arrays()
+        assert sorted(vp) == sorted(rp) and vp
+        for n in vp:
+            np.testing.assert_array_equal(rp[n], vp[n], err_msg=n)
+
+    rdone, vdone2 = dict(vdone), dict(vdone)
+    for _ in range(40):
+        vdone2.update(victim.pump())
+        rdone.update(restored.pump())
+        if len(rdone) >= len(vrids) and len(vdone2) >= len(vrids):
+            break
+    for rid in vrids:
+        np.testing.assert_array_equal(rdone[rid], vdone2[rid])
+    mgr2.close(save=False)
+
+    # speculative config is part of the snapshot contract: a session
+    # without the drafter cannot re-own the watermark/draft rows
+    plain = _paged(trained, steps=1)
+    with pytest.raises(SnapshotMismatchError):
+        DecodeSnapshotManager(plain, snap).restore()
+
+
+def test_ckpt_inspect_crosschecks_speculative_bindings(trained,
+                                                       tmp_path,
+                                                       capsys):
+    """``--verify`` on a speculative snapshot cross-checks tree-page
+    bindings: a page laundered out of a slot's list (ref moved to the
+    free list so conservation and refcount accounting both still
+    balance) is exactly the tamper only the resident-coverage check
+    catches — exit 2."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    try:
+        import ckpt_inspect
+    finally:
+        sys.path.pop(0)
+    sess = _paged(trained, steps=1,
+                  speculative={"k": 2, "drafter": "ngram"})
+    sess.admit(trained["src"][0], SEQ)
+    while sess._live and all(
+            int(st["pos"]) < 5 for st in sess._live.values()):
+        sess.step()
+    assert sess._live, "request finished before spanning two pages"
+    snap = str(tmp_path / "snap")
+    DecodeSnapshotManager(sess, snap).save(serial=3)
+    step_dir = os.path.join(snap, "checkpoint_3")
+    assert ckpt_inspect.main([step_dir, "--verify"]) == 0
+    out = capsys.readouterr().out
+    assert "speculative: k=2 drafter=ngram" in out
+
+    mpath = os.path.join(step_dir, "__manifest__.json")
+    manifest = json.load(open(mpath))
+    ds = manifest["extra"]["decode_snapshot"]
+    slot = next(iter(ds["slot_pages"]))
+    page = int(ds["slot_pages"][slot].pop())
+    del ds["pool"]["ref"][str(page)]
+    ds["pool"]["free"].append(page)
+    ds["live_pages"] = [p for p in ds["live_pages"] if int(p) != page]
+    json.dump(manifest, open(mpath, "w"))
+    assert ckpt_inspect.main([step_dir, "--verify"]) == 2
+    out = capsys.readouterr().out
+    assert "speculative slot" in out
+
+
 # -- degradation -------------------------------------------------------------
 
 def test_health_monitor_hysteresis_and_metrics():

@@ -109,6 +109,24 @@ def _decode_summary(ds):
                            if cache else 0),
         "pending_requests": len(ds.get("pending") or []),
     }
+    spec = ds.get("speculative")
+    if spec or (cfg.get("speculative")):
+        counters = (spec or {}).get("counters") or {}
+        drafter = (spec or {}).get("drafter") or {}
+        proposed = int(counters.get("proposed", 0))
+        accepted = int(counters.get("accepted", 0))
+        out["speculative"] = {
+            "config": cfg.get("speculative"),
+            "draft_params": len(drafter.get("params") or []),
+            "proposed": proposed,
+            "accepted": accepted,
+            "dispatches": int(counters.get("dispatches", 0)),
+            "acceptance_rate": (accepted / proposed
+                                if proposed else None),
+            "drafter": drafter.get("kind"),
+            "draft_cached_slots": len(
+                (drafter.get("state") or {}).get("dpos") or {}),
+        }
     if beam:
         # beam bookkeeping: width, live lanes with hypothesis->slot
         # bindings, per-hypothesis scores/done (from the live map) and
@@ -137,7 +155,7 @@ def _decode_summary(ds):
     return out
 
 
-def _decode_verify(ds):
+def _decode_verify(ds, vars_meta=None):
     """Re-check the allocator laws a decode snapshot must satisfy:
     page conservation (free + unique-allocated == num_pages - 1, the
     seeded property test's invariant) and reference accounting (every
@@ -186,6 +204,79 @@ def _decode_verify(ds):
         problems.append(
             "gathered live_pages %s disagree with pool refcounts %s"
             % (live_pages[:8], sorted(ref)[:8]))
+    spec_cfg = cfg.get("speculative")
+    if spec_cfg:
+        # speculative cross-checks: the tree verifier reads every
+        # RESIDENT row of a live slot through its page list, so a
+        # tampered binding (a page dropped from the list, or rebound
+        # while its rows are still claimed resident) must fail offline
+        # even when it was laundered past the conservation and
+        # refcount checks above by editing free/ref to match.
+        spec = ds.get("speculative") or {}
+        counters = spec.get("counters") or {}
+        if int(counters.get("accepted", 0)) > int(
+                counters.get("proposed", 0)):
+            problems.append(
+                "speculative counters tampered: accepted %d > "
+                "proposed %d" % (int(counters.get("accepted", 0)),
+                                 int(counters.get("proposed", 0))))
+        ps = int(cfg.get("page_size") or 1)
+        live = ds.get("live") or {}
+        slot_pages = ds.get("slot_pages") or {}
+        for slot, st in sorted(live.items(), key=lambda kv: int(kv[0])):
+            pages = [int(p) for p in slot_pages.get(str(slot)) or []]
+            pos = int(st.get("pos", 0))
+            need = pos // ps + 1  # rows 0..pos the tree reads as base
+            if len(pages) < need:
+                problems.append(
+                    "speculative slot %s: %d bound pages cannot back "
+                    "%d resident rows (pos=%d page_size=%d) — tree "
+                    "reads would hit unbound pages"
+                    % (slot, len(pages), pos + 1, pos, ps))
+            for page in pages[:need]:
+                if ref.get(page, 0) < 1:
+                    problems.append(
+                        "speculative slot %s: resident page %d has no "
+                        "refcount — tree-page binding is dangling"
+                        % (slot, page))
+        drafter = spec.get("drafter") or {}
+        if drafter and drafter.get("kind") != spec_cfg.get("drafter"):
+            problems.append(
+                "speculative drafter state kind %r does not match "
+                "config %r" % (drafter.get("kind"),
+                               spec_cfg.get("drafter")))
+        if drafter.get("kind") == "model" and vars_meta is not None:
+            # the draft params steer acceptance timing, which binds
+            # future backlog requests to slots (and slots key the
+            # sampler) — a restore without them would silently change
+            # the restored session's future streams
+            for pname in drafter.get("params") or []:
+                if ("spec_dparam__" + pname) not in vars_meta:
+                    problems.append(
+                        "draft param %r listed in the speculative "
+                        "dialect but missing from the manifest vars"
+                        % pname)
+        dpos = (drafter.get("state") or {}).get("dpos") or {}
+        for slot, wm in sorted(dpos.items(), key=lambda kv: int(kv[0])):
+            if str(slot) not in live:
+                problems.append(
+                    "draft watermark on slot %s which is not live"
+                    % slot)
+                continue
+            pos = int(live[str(slot)].get("pos", 0))
+            if int(wm) > pos + 1:
+                problems.append(
+                    "draft watermark %d on slot %s runs past its "
+                    "anchor pos %d — draft rows claim pages the "
+                    "target never wrote" % (int(wm), slot, pos))
+            # draft rows [0, wm) live in the draft pools through the
+            # SAME page table — they need the same bound pages
+            need = ((int(wm) - 1) // ps + 1) if int(wm) > 0 else 0
+            pages = [int(p) for p in slot_pages.get(str(slot)) or []]
+            if len(pages) < need:
+                problems.append(
+                    "draft watermark %d on slot %s outruns its %d "
+                    "bound pages" % (int(wm), slot, len(pages)))
     beam = ds.get("beam")
     if beam:
         # beam-binding cross-check: every lane's hypothesis slots must
@@ -269,7 +360,7 @@ def _summarize(step_dir, manifest, verify):
     if verify:
         problems = _verify(step_dir, manifest)
         if decode:
-            problems = problems + _decode_verify(decode)
+            problems = problems + _decode_verify(decode, vars_meta)
         info["problems"] = problems
     else:
         info["problems"] = None
@@ -337,6 +428,21 @@ def main(argv=None):
                 print("  prefix trie: %d entries;  pending requests: %d"
                       % (decode["prefix_entries"],
                          decode["pending_requests"]))
+                spec = decode.get("speculative")
+                if spec:
+                    scfg = spec.get("config") or {}
+                    rate = spec.get("acceptance_rate")
+                    print("  speculative: k=%s drafter=%s  proposed=%d "
+                          "accepted=%d (%s)  dispatches=%d  draft "
+                          "cache slots=%d  draft params=%d" % (
+                              scfg.get("k"), spec.get("drafter")
+                              or scfg.get("drafter"),
+                              spec["proposed"], spec["accepted"],
+                              "%.2f accept" % rate
+                              if rate is not None else "no proposals",
+                              spec["dispatches"],
+                              spec["draft_cached_slots"],
+                              spec["draft_params"]))
                 beam = decode.get("beam")
                 if beam:
                     print("  beam: width=%s  lanes live=%d free=%d  "

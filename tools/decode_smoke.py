@@ -29,6 +29,17 @@ Asserted, in order:
     n-best scores are BIT-identical to the copy-reorder oracle
     (``FLAGS_beam_reorder=reference`` — same geometry, same
     content-addressed executables), and the pool conserves at drain.
+  * **Speculative churn (PR 16).** Staggered admissions through the
+    draft-then-verify path (ngram drafter, ``k=3`` speculation tree,
+    one tree-attention dispatch per verify): after one warmup wave
+    that compiled the speculative executables AND the sequential
+    ``FLAGS_speculative=off`` step, a churny 12-request / 4-slot wave
+    adds ZERO fresh compiles, the token streams are BIT-identical to
+    both the dense oracle and an off-oracle replay on the SAME
+    session (flag flip, same slots — the speedup mechanism can never
+    change what is decoded), the acceptance telemetry
+    (``paddle_tpu_serving_speculative_*``) is published and nonzero,
+    and the pool drains clean.
   * **Cross-request reuse churn (PR 12).** Best-of-N fork groups over
     a forced prefix (admit_group -> one encoder + one chunked prefill
     + joins; the top-k sampler forces member divergence, so the
@@ -305,6 +316,100 @@ def beam_churn():
           "conserved at drain" % copy_sess.beam_reorder_pages)
 
 
+def speculative_churn():
+    """Speculative decode over the slot pool (PR 16): churny
+    draft-then-verify admissions (12 requests / 4 slots, ngram
+    drafter, tree-attention verify) hold the zero-recompile contract,
+    decode BIT-identical to the dense oracle AND to a sequential
+    off-oracle replay on the SAME session (``FLAGS_speculative=off``
+    flag flip — same slots, same executables), publish nonzero
+    acceptance telemetry, and drain the pool clean."""
+    import paddle_tpu as fluid
+    from paddle_tpu import flags as _flags
+    from paddle_tpu.core import exec_cache
+    from paddle_tpu.models import transformer
+    from paddle_tpu.observability import REGISTRY
+    from paddle_tpu.serving.generation import SlotDecodeSession
+
+    vocab, seq, dm, S = 40, 16, 32, 4
+    cfg = dict(src_vocab_size=vocab, trg_vocab_size=vocab, n_layer=1,
+               n_head=2, d_inner=64)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = 43
+    startup.random_seed = 43
+    with fluid.program_guard(main_prog, startup):
+        transformer.build(dropout=0.0, label_smooth_eps=0.0,
+                          max_length=seq, d_model=dm, **cfg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(47)
+    n = 12  # 12 requests through a 4-slot pool: constant churn
+    src = rng.randint(3, vocab, (n, seq)).astype("int64")
+    src_len = np.asarray(
+        [seq, 2, seq - 1, 5, seq, 3, seq - 2, seq, 4, seq, 2, seq],
+        "int64")[:, None]
+
+    dense = SlotDecodeSession(exe, num_slots=S, max_length=seq,
+                              d_model=dm, **cfg)
+    want = dense.generate(src, src_len)
+
+    sess = SlotDecodeSession(exe, num_slots=S, max_length=seq,
+                             d_model=dm, paged=True, page_size=4,
+                             steps=1,
+                             speculative={"k": 3, "drafter": "ngram"},
+                             **cfg)
+    # warmup: the speculative wave compiles the draft/tree-verify set,
+    # the off wave compiles the sequential steps=1 step — BOTH paths
+    # must be in the warmed set before the churn measurement
+    np.testing.assert_array_equal(sess.generate(src[:2], src_len[:2]),
+                                  want[:2])
+    _flags.set_flag("speculative", "off")
+    try:
+        sess.generate(src[:2], src_len[:2])
+    finally:
+        _flags.set_flag("speculative", "on")
+
+    before = exec_cache.stats()["fresh_compiles"]
+    before_scrape = _scrape_fresh_compiles()
+    p0, a0 = sess.spec_proposed, sess.spec_accepted
+    got = sess.generate(src, src_len)  # churny speculative wave
+    np.testing.assert_array_equal(got, want)
+    assert sess.spec_proposed > p0 and sess.spec_dispatches > 0, \
+        "the churny wave never actually speculated"
+    # off-oracle replay on the SAME session: the flag flip routes the
+    # same slots through the sequential step — bit parity proves the
+    # speedup mechanism cannot change what is decoded
+    _flags.set_flag("speculative", "off")
+    try:
+        off = sess.generate(src, src_len)
+    finally:
+        _flags.set_flag("speculative", "on")
+    np.testing.assert_array_equal(got, off)
+    assert exec_cache.stats()["fresh_compiles"] == before, (
+        "speculative churn paid %d fresh compiles"
+        % (exec_cache.stats()["fresh_compiles"] - before))
+    after_scrape = _scrape_fresh_compiles()
+    if before_scrape is not None:
+        assert after_scrape == before_scrape, \
+            "metrics scrape shows fresh compiles during speculative churn"
+    assert sess.pages_in_use == 0 and sess.free_slots == S
+
+    text = REGISTRY.to_prometheus()
+    m = re.search(
+        r"^paddle_tpu_serving_speculative_proposed_tokens_total (\d+)",
+        text, re.MULTILINE)
+    assert m and int(m.group(1)) >= sess.spec_proposed > 0, \
+        "proposed-tokens counter not published"
+    assert "paddle_tpu_serving_speculative_accepted_tokens_total" in text
+    assert "paddle_tpu_serving_speculative_acceptance_rate" in text
+    rate = ((sess.spec_accepted - a0) / (sess.spec_proposed - p0)
+            if sess.spec_proposed > p0 else 0.0)
+    print("decode_smoke: speculative churn OK — 0 fresh compiles over "
+          "12 requests / 4 slots, tokens == dense oracle == off-oracle "
+          "replay, %.2f acceptance over %d dispatches, pool drained "
+          "clean" % (rate, sess.spec_dispatches))
+
+
 def main():
     if len(sys.argv) != 2:
         sys.exit("usage: decode_smoke.py OUTPUT_DIR")
@@ -312,6 +417,7 @@ def main():
     churn_invariants()
     bestofn_prefix_churn()
     beam_churn()
+    speculative_churn()
 
     # the capture comes from bench.py's decode worker in its OWN
     # process — the same leg (and the same compile-count accounting)

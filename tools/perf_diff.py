@@ -73,6 +73,15 @@ METRICS = {
     # or COW stopped being write-page-only)
     "beam_speedup": ("higher", "timing"),
     "beam_reorder_bytes": ("lower", "deterministic"),
+    # speculative decoding (PR 16): draft-then-verify tokens/sec over
+    # the sequential FLAGS_speculative=off oracle on the SAME session
+    # (bit-identical streams asserted in-leg — the ratio can only come
+    # from dispatch amortization), and the drafter's accepted/proposed
+    # ratio over the timed wave (deterministic under greedy decode
+    # with the leg's seeds, but gated as a timing metric so drafter
+    # tuning has headroom — the floor catches lookup regressions)
+    "speculative_speedup": ("higher", "timing"),
+    "acceptance_rate": ("higher", "timing"),
     # serving resilience (tools/serve_chaos_smoke.py): wall seconds of
     # one synchronous decode snapshot in the restored warm process
     "snapshot_seconds": ("lower", "timing"),
@@ -106,6 +115,8 @@ def _bench_model_metrics(m):
     out["cross_kv_bytes"] = m.get("cross_kv_bytes")
     out["beam_speedup"] = m.get("beam_speedup")
     out["beam_reorder_bytes"] = m.get("beam_reorder_bytes")
+    out["speculative_speedup"] = m.get("speculative_speedup")
+    out["acceptance_rate"] = m.get("acceptance_rate")
     out["snapshot_seconds"] = m.get("snapshot_seconds")
     out["ttft_ms"] = m.get("ttft_ms")
     ec = m.get("exec_cache") or {}

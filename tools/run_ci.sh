@@ -179,11 +179,16 @@ if want decode; then
   # 15) churns staggered BEAM admissions — 0 fresh compiles at warm
   # steady state, zero pages physically moved by rebind reorders, and
   # token/score bit-equality against the FLAGS_beam_reorder=reference
-  # copy oracle; then the bench decode worker lands an A/B capture
-  # (paged vs dense tokens/sec at mixed lengths / low occupancy, the
-  # shared-vs-unshared best-of-N ratio, prefix hit rate, grouped
-  # cross-K/V bytes, plus beam_speedup / beam_reorder_bytes from the
-  # rebind-vs-copy beam A/B) that perf_diff gates against the
+  # copy oracle; a fourth leg (PR 16) churns SPECULATIVE decode —
+  # draft/tree-verify/accept/reject waves add 0 fresh compiles after
+  # warmup and stream bit-identical to both the dense oracle and a
+  # FLAGS_speculative=off replay on the same session; then the bench
+  # decode worker lands an A/B capture (paged vs dense tokens/sec at
+  # mixed lengths / low occupancy, the shared-vs-unshared best-of-N
+  # ratio, prefix hit rate, grouped cross-K/V bytes, beam_speedup /
+  # beam_reorder_bytes from the rebind-vs-copy beam A/B, plus
+  # speculative_speedup / acceptance_rate from the draft-then-verify
+  # vs sequential-oracle A/B) that perf_diff gates against the
   # committed decode budgets
   dcdir="$(mktemp -d)"
   trap 'rm -rf "$dcdir"' EXIT
