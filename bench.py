@@ -404,21 +404,33 @@ def _bench_frontend(fluid, on_tpu):
                 latencies=latencies)
             assert ok == 48 and not errors, \
                 "wire replay errors: %r" % errors[:3]
-            # stream ttft: request sent -> first token chunk received
-            ttfts = []
-            for i in range(4):
-                t0 = time.perf_counter()
-                first = []
+            # stream ttft: request sent -> first token chunk received.
+            # Request tracing rides the stream portion: each request's
+            # completed trace (fetched back over the wire) feeds the
+            # ttft_breakdown split — queue wait vs prefill vs first
+            # decode dispatch — beside the raw client-side ttft_ms
+            from paddle_tpu.observability import tracing
 
-                def see(ev, t0=t0, first=first):
-                    if ev.get("event") == "tokens" and not first:
-                        first.append(time.perf_counter() - t0)
+            ttfts, traces = [], []
+            tracing.enable(True)
+            try:
+                for i in range(4):
+                    t0 = time.perf_counter()
+                    first = []
 
-                warm_cl.generate_full(src[i], src_len=seq,
-                                      on_event=see)
-                ttfts.extend(first)
+                    def see(ev, t0=t0, first=first):
+                        if ev.get("event") == "tokens" and not first:
+                            first.append(time.perf_counter() - t0)
+
+                    warm_cl.generate_full(src[i], src_len=seq,
+                                          on_event=see)
+                    ttfts.extend(first)
+                    traces.append(warm_cl.trace())
+            finally:
+                tracing.enable(False)
             warm_cl.close()
-            rec = loadgen.wire_capture(ok, wall, latencies, ttfts)
+            rec = loadgen.wire_capture(ok, wall, latencies, ttfts,
+                                       traces=traces)
         finally:
             fe.close()
             server.close()

@@ -93,6 +93,15 @@ def serve_json_lines(dispatch, host="127.0.0.1", port=0, pass_conn=False,
 
     class Handler(socketserver.StreamRequestHandler):
         def setup(self):
+            # streaming responses are many SMALL line writes in quick
+            # succession; Nagle+delayed-ACK batches them into ~20ms-late
+            # tails the wire SLOs (ttft, inter-token, trace coverage)
+            # would wrongly charge to the server — flush every line now
+            try:
+                self.request.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass  # non-TCP transports (tests) don't carry the opt
             socketserver.StreamRequestHandler.setup(self)
             with self.server._conn_mu:
                 self.server._live_conns.add(self.connection)
@@ -245,10 +254,24 @@ class JsonLineClient(object):
         """Chaos site to arm for this request (None = uninstrumented)."""
         return None
 
+    def _trace_context(self, req):
+        """Trace envelope for this request (None = untraced — the
+        default, so the wire bytes of an untracing client are identical
+        to pre-tracing builds). ServingClient overrides this to mint a
+        request-scoped trace id + send timestamp when
+        FLAGS_request_tracing is on (observability/tracing.py); any
+        JSON-lines service can adopt the same envelope field."""
+        return None
+
     def _connect(self):
         if self._sock is None:
             self._sock = socket.create_connection(
                 self._addr, timeout=self._timeout_s)
+            try:  # small-line protocol: never let Nagle sit on a frame
+                self._sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
             self._rfile = self._sock.makefile("rb")
 
     def _send_line(self, req):
@@ -282,6 +305,10 @@ class JsonLineClient(object):
         ONCE (with the resilience backoff+accounting) before surfacing
         the failure."""
         from paddle_tpu.resilience import retry as _retry
+
+        ctx = self._trace_context(req)
+        if ctx is not None:
+            req = dict(req, trace=ctx)
 
         def once():
             from paddle_tpu.resilience import chaos as _chaos

@@ -173,6 +173,15 @@ _DEFS = {
     # moves ~10 GB/step of f32 logits-shaped traffic at bench shapes);
     # opt-in until the chip A/B (watcher leg transformer-ce-fused) lands
     "fused_ce": (False, bool),
+    # request-scoped distributed tracing across the serving plane
+    # (observability/tracing.py): ServingClient mints a trace id that
+    # rides the JSON-lines envelope; frontend + decode session record
+    # per-request span waterfalls (queue/admit/prefill/dispatch/flush)
+    # into a bounded ring, exported as <metrics_path>.traces.jsonl and
+    # rendered by tools/trace_view.py. Module-bool guard, same contract
+    # as FLAGS_telemetry: off = zero per-request allocations, zero wire
+    # bytes, zero fresh-compile delta
+    "request_tracing": (False, bool),
 }
 
 

@@ -50,6 +50,18 @@ failures:
   deaths become rule **M001** diagnostics — never retried — whose
   black-box dump names the top holders and the predicted peak.
 
+The serving plane adds request-scoped attribution:
+
+* ``tracing`` — one trace per serving request (id minted by
+  ``ServingClient``, carried in the wire envelope, continued by the
+  frontend and decode session): span waterfalls covering queue wait,
+  admission, prefill, every decode dispatch and wire flush, with
+  derived SLO stats (TTFT, inter-token distribution, page-seconds,
+  speculation fraction). Completed traces land in a bounded ring +
+  ``<metrics_path>.traces.jsonl``; latency histograms carry trace-id
+  exemplars; blackbox dumps list in-flight ids. Switched by
+  ``FLAGS_request_tracing`` (module-bool guard, telemetry's contract).
+
 ``docs/OBSERVABILITY.md`` is the operator's guide (metric catalog, how
 to read the explainer, loading the merged trace in perfetto, failure
 forensics, the memory ledger).
@@ -61,6 +73,7 @@ from paddle_tpu.observability import memory  # noqa: F401
 from paddle_tpu.observability import metrics_registry  # noqa: F401
 from paddle_tpu.observability import nan_provenance  # noqa: F401
 from paddle_tpu.observability import telemetry  # noqa: F401
+from paddle_tpu.observability import tracing  # noqa: F401
 from paddle_tpu.observability import watchdog  # noqa: F401
 from paddle_tpu.observability.metrics_registry import REGISTRY  # noqa: F401
 

@@ -247,6 +247,20 @@ def snapshot(reason="on_demand", stacks=False, extra=None,
         "nan_diagnostic": nan,
         "oom_diagnostic": oom,
     }
+    # requests in flight at crash time, by trace id (the forensics
+    # question "WHOSE request died here" — resolve the ids against the
+    # victim's .traces.jsonl or a surviving peer's ring)
+    from paddle_tpu.observability import tracing
+
+    snap["inflight_traces"] = _read_locked(
+        tracing._lock,
+        lambda: [{"trace_id": t.id, "endpoint": t.endpoint,
+                  "origin": t.origin,
+                  "age_s": round(time.time() - t.t0, 3),
+                  "spans_open": sum(1 for sp in t.spans
+                                    if sp["t1"] is None)}
+                 for t in tracing._inflight.values()],
+        [], lock_timeout)
     try:
         # fold the live explainer log back to lint diagnostics (PR 3) so
         # the dump names the rule behind a recompile storm; skipped in

@@ -29,7 +29,7 @@ import time
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-    "DEFAULT_BUCKETS", "SERVING_BUCKETS",
+    "DEFAULT_BUCKETS", "SERVING_BUCKETS", "DECODE_BUCKETS",
 ]
 
 # Latency-ish default buckets (seconds): 100us .. 60s, roughly x3 steps.
@@ -44,6 +44,17 @@ DEFAULT_BUCKETS = (
 SERVING_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0,
+)
+
+# Decode-resolution buckets (seconds): the streaming plane's numbers —
+# inter-token gaps and per-dispatch decode latencies — live in the
+# 100us-10ms band (bench decode leg: ~0.33ms/token on the CPU proxy)
+# where even SERVING_BUCKETS' 0.5ms floor smears everything into two
+# buckets. Sub-ms ladder below, SERVING-compatible tail above so one
+# scrape still localizes an outlier stream.
+DECODE_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 1.0, 5.0,
 )
 
 
@@ -143,7 +154,13 @@ class Histogram(_Metric):
             raise ValueError("histogram needs at least one bucket bound")
         self.buckets = bs
 
-    def observe(self, value, **labels):
+    def observe(self, value, exemplar=None, **labels):
+        """``exemplar``: an opaque id (a trace id) remembered for the
+        NARROWEST bucket the value lands in — last writer wins per
+        bucket, so a scrape's p99 bucket names a recent replayable
+        request (observability/tracing.py resolves it against the
+        completed-trace ring). Exemplars ride the JSON snapshot only;
+        the text exposition stays plain 0.0.4."""
         key = _label_key(self.label_names, labels)
         value = float(value)
         with self._lock:
@@ -155,9 +172,26 @@ class Histogram(_Metric):
             st["count"] += 1
             st["sum"] += value
             counts = st["buckets"]
+            narrowest = len(self.buckets)  # +Inf overflow bucket
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     counts[i] += 1
+                    narrowest = min(narrowest, i)
+            if exemplar is not None:
+                st.setdefault("exemplars", {})[narrowest] = {
+                    "id": str(exemplar), "value": value,
+                    "ts": time.time()}
+
+    def exemplars(self, **labels):
+        """{bucket_index: {"id", "value", "ts"}} for one series —
+        index len(buckets) is the +Inf overflow bucket."""
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            st = self._values.get(key)
+            if st is None:
+                return {}
+            return {i: dict(e)
+                    for i, e in (st.get("exemplars") or {}).items()}
 
     def snapshot(self, **labels):
         key = _label_key(self.label_names, labels)
@@ -166,16 +200,24 @@ class Histogram(_Metric):
             if st is None:
                 return {"count": 0, "sum": 0.0,
                         "buckets": [0] * len(self.buckets)}
-            return {"count": st["count"], "sum": st["sum"],
-                    "buckets": list(st["buckets"])}
+            out = {"count": st["count"], "sum": st["sum"],
+                   "buckets": list(st["buckets"])}
+            if st.get("exemplars"):
+                out["exemplars"] = {i: dict(e)
+                                    for i, e in st["exemplars"].items()}
+            return out
 
     def _series(self):
         with self._lock:
-            return {
-                key: {"count": st["count"], "sum": st["sum"],
-                      "buckets": list(st["buckets"])}
-                for key, st in self._values.items()
-            }
+            out = {}
+            for key, st in self._values.items():
+                entry = {"count": st["count"], "sum": st["sum"],
+                         "buckets": list(st["buckets"])}
+                if st.get("exemplars"):
+                    entry["exemplars"] = {
+                        i: dict(e) for i, e in st["exemplars"].items()}
+                out[key] = entry
+            return out
 
 
 class MetricsRegistry(object):
@@ -294,6 +336,11 @@ class MetricsRegistry(object):
                 if m.kind == "histogram":
                     entry.update(count=v["count"], sum=v["sum"],
                                  buckets=list(v["buckets"]))
+                    if v.get("exemplars"):
+                        # JSON keys must be strings; bucket index keys
+                        # stringify (index == len(bounds) is +Inf)
+                        entry["exemplars"] = {
+                            str(i): e for i, e in v["exemplars"].items()}
                 else:
                     entry["value"] = v
                 series.append(entry)

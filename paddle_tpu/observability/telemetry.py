@@ -537,8 +537,11 @@ def write_steps_jsonl(path, mode="w"):
 
 def flush(metrics_path=None):
     """Write the Prometheus scrape to ``metrics_path`` (default:
-    ``FLAGS_metrics_path``) and the step JSONL next to it
-    (``<path>.steps.jsonl``). No-op when no path is configured."""
+    ``FLAGS_metrics_path``), the step JSONL next to it
+    (``<path>.steps.jsonl``), and — when request tracing banked any
+    completed traces — the trace JSONL (``<path>.traces.jsonl``, the
+    file tools/trace_view.py and step_breakdown --requests consume).
+    No-op when no path is configured."""
     if metrics_path is None:
         from paddle_tpu import flags
 
@@ -550,6 +553,10 @@ def flush(metrics_path=None):
         return None
     REGISTRY.write_prometheus(metrics_path)
     write_steps_jsonl(metrics_path + ".steps.jsonl")
+    from paddle_tpu.observability import tracing
+
+    if tracing.completed():
+        tracing.write_traces_jsonl(metrics_path + ".traces.jsonl")
     return metrics_path
 
 

@@ -42,6 +42,7 @@ import time
 import numpy as np
 
 from paddle_tpu.distributed.master import JsonLineClient
+from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.observability import watchdog as _watchdog
 from paddle_tpu.serving.degradation import DegradedError
 from paddle_tpu.serving.generation import (
@@ -141,7 +142,26 @@ class ServingClient(JsonLineClient):
 
     origin = "ServingClient._call"
 
+    #: trace id of the most recent traced request this client minted
+    #: (``FLAGS_request_tracing`` on); resolve it against the frontend
+    #: with :meth:`trace` after the response/stream completes
+    last_trace_id = None
+
     # -- transport shell -----------------------------------------------------
+
+    def _trace_context(self, req):
+        """Mint the request-scoped trace envelope
+        (observability/tracing.py): ``{"id", "t_send"}`` riding the
+        JSON line, so the frontend can continue the trace and account
+        the wire+queue time against the CLIENT-observed clock. Only
+        request-shaped methods trace; with tracing off this returns
+        None and the wire bytes are identical to untracing builds."""
+        if not _tracing.ENABLED:
+            return None
+        if req.get("method") not in ("predict", "generate"):
+            return None
+        self.last_trace_id = _tracing.mint_id()
+        return {"id": self.last_trace_id, "t_send": time.time()}
 
     def _recv_line(self):
         # every blocking read wears the watchdog (on top of the socket
@@ -271,6 +291,12 @@ class ServingClient(JsonLineClient):
             req["src_len"] = int(np.ravel(src_len)[0])
         if prefix_tokens is not None:
             req["prefix_tokens"] = [int(t) for t in prefix_tokens]
+        # generate streams outside _call's request/response shell, so
+        # the trace envelope attaches here; a retried open re-sends the
+        # SAME id — one logical request, one trace
+        ctx = self._trace_context(req)
+        if ctx is not None:
+            req["trace"] = ctx
 
         def opened():
             # the open is retry-safe: until the first message lands, a
@@ -476,6 +502,22 @@ class ServingClient(JsonLineClient):
         return self._retrying(once, origin="ServingClient.take_result")
 
     # -- observability -------------------------------------------------------
+
+    def trace(self, trace_id=None):
+        """Fetch one COMPLETED trace record from the frontend's
+        bounded ring (default: this client's most recent minted id —
+        ``last_trace_id``). Returns the record dict (spans + derived
+        stats, the same shape ``<metrics_path>.traces.jsonl`` carries)
+        or None when the id is unknown/aged out/still in flight."""
+        tid = trace_id if trace_id is not None else self.last_trace_id
+        if tid is None:
+            return None
+
+        def once():
+            return self._request(method="trace",
+                                 id=str(tid)).get("trace")
+
+        return self._retrying(once, origin="ServingClient.trace")
 
     def metrics(self):
         """The frontend process's Prometheus scrape text — the remote

@@ -73,6 +73,7 @@ from paddle_tpu.distributed.master import (
     close_json_server,
     serve_json_lines,
 )
+from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.observability.metrics_registry import (
     REGISTRY as _REGISTRY,
     SERVING_BUCKETS,
@@ -330,6 +331,8 @@ class _DecodeWorker(object):
     def _admit(self, stream):
         s = self._s
         spec = stream.spec
+        tid = spec.get("trace_id")
+        t_admit = time.time() if tid else 0.0
         try:
             if spec.get("beam"):
                 # beam request: admit-or-reject into one lane (the
@@ -343,6 +346,7 @@ class _DecodeWorker(object):
                 self._beam_stream[lane] = stream
                 for k, slot in enumerate(s.beam_slots(lane)):
                     stream.live[slot] = k
+                self._trace_admitted(stream, t_admit, kind="beam")
                 stream.q.put(self._admitted_event(stream))
             elif spec["n"] == 1:
                 # the shed answer at the WIRE edge: a shed session
@@ -366,11 +370,14 @@ class _DecodeWorker(object):
                         "decode backlog at max_stream_backlog %d"
                         % self._max_backlog)
                 rid = s.enqueue(spec["src"], spec["src_len"],
-                                prefix_tokens=spec["prefix"])
+                                prefix_tokens=spec["prefix"],
+                                trace_id=tid)
                 stream.rid = rid
                 self._rid_stream[rid] = stream
-                stream.q.put({"ok": True, "event": "queued",
-                              "id": int(rid)})
+                ev = {"ok": True, "event": "queued", "id": int(rid)}
+                if tid:
+                    ev["trace_id"] = tid
+                stream.q.put(ev)
             else:
                 # forks are admit-or-reject: their n x worst-case page
                 # reservation is too large to head-of-line park in the
@@ -380,10 +387,25 @@ class _DecodeWorker(object):
                     prefix_tokens=spec["prefix"])
                 self._track(stream,
                             {slot: m for m, slot in enumerate(slots)})
+                self._trace_admitted(stream, t_admit, kind="group")
                 stream.q.put(self._admitted_event(stream))
         except Exception as exc:  # noqa: BLE001 - typed to the wire
             stream.done = True
             stream.q.put(error_to_wire(exc))
+
+    def _trace_admitted(self, stream, t_admit, kind):
+        """Direct admissions (fork groups, beam lanes) bypass the
+        session queue, so their admit span and slot->trace binding are
+        emitted here; queued solos get both from ``admit_pending``."""
+        tid = stream.spec.get("trace_id")
+        if not tid:
+            return
+        tr = _tracing.inflight_get(tid)
+        if tr is not None:
+            tr.span("admit", t_admit, time.time(), kind=kind,
+                    members=len(stream.live))
+        for slot in stream.live:
+            self._s._slot_traces[slot] = tid
 
     def _track(self, stream, slots_members):
         s = self._s
@@ -403,6 +425,9 @@ class _DecodeWorker(object):
               "members": len(slots), "slots": [int(x) for x in slots],
               "prefix": prefix, "pos": len(prefix) - 1,
               "max_length": int(s._T), "eos": int(s._eos)}
+        tid = stream.spec.get("trace_id")
+        if tid:
+            ev["trace_id"] = tid
         if stream.beam_lane is not None:
             ev["beam"] = int(stream.beam_lane)
             ev["beam_width"] = int(s.beam_width)
@@ -486,7 +511,9 @@ class _DecodeWorker(object):
                 del self._slot_stream[slot]
                 del self._prev_pos[slot]
                 stream.live.pop(slot, None)
-                s._owner.pop(slot, None)  # streamed, not banked
+                rid = s._owner.pop(slot, None)  # streamed, not banked
+                if rid is not None:
+                    s._trace_ids.pop(rid, None)
                 if len(toks) and not stream.cancelled.is_set():
                     stream.q.put({
                         "ok": True, "event": "tokens",
@@ -516,6 +543,11 @@ class _DecodeWorker(object):
             rid = s._owner.pop(slot, None)
             if rid is not None:
                 s._results[rid] = trg
+                # a restored process's backlog finishes headless under
+                # its ORIGINAL trace id (session-origin continuation):
+                # the trace banks with the result, claimable metadata
+                # rides take_result
+                s._trace_bank(rid)
 
     def _safe_cancel(self, slot):
         """Session cancel that can never kill the worker thread: the
@@ -656,13 +688,13 @@ class ServingFrontend(object):
         if dr > 0:
             _fe_bytes_received.inc(dr)
 
-    def _observe(self, endpoint, outcome, t0):
+    def _observe(self, endpoint, outcome, t0, exemplar=None):
         dt = time.monotonic() - t0
         with self._mu:
             key = (endpoint, outcome)
             self._counts[key] = self._counts.get(key, 0) + 1
-        _fe_request_seconds.observe(dt, endpoint=endpoint,
-                                    outcome=outcome)
+        _fe_request_seconds.observe(dt, exemplar=exemplar,
+                                    endpoint=endpoint, outcome=outcome)
         if endpoint == "generate":
             _fe_streams_total.inc(outcome=outcome)
 
@@ -687,11 +719,24 @@ class ServingFrontend(object):
             return {"ok": True, "stats": self.stats()}
         if method == "take_result":
             return self._take_result(req)
+        if method == "trace":
+            # completed-trace lookup by id: ring-resident records only
+            # (in-flight ids surface through blackbox dumps instead)
+            return {"ok": True,
+                    "trace": _tracing.get(str(req.get("id", "")))}
         return error_to_wire(
             ServingError("unknown method %r" % (method,)))
 
     def _predict(self, req):
         t0 = time.monotonic()
+        tr = None
+        if _tracing.ENABLED:
+            # continue the client-minted trace (or mint a frontend one
+            # for traceless callers): covers wire arrival -> batching
+            # queue -> dispatch -> response
+            tenv = req.get("trace") or {}
+            tr = _tracing.start(tenv.get("id"), endpoint="predict",
+                                t_client_send=tenv.get("t_send"))
         try:
             if self._batching is None:
                 raise ServingError(
@@ -706,14 +751,23 @@ class ServingFrontend(object):
                 inputs = [decode_array(v) for v in wire_in]
             deadline_s = req.get("deadline_s")
             outs = self._batching.submit(
-                inputs, deadline_s=deadline_s).result()
+                inputs, deadline_s=deadline_s,
+                trace_id=(tr.id if tr is not None else None)).result()
             resp = {"ok": True,
                     "outputs": [encode_array(np.asarray(o))
                                 for o in outs]}
+            if tr is not None:
+                resp["trace_id"] = tr.id
         except Exception as exc:  # noqa: BLE001 - typed to the wire
-            self._observe("predict", _outcome(exc), t0)
+            if tr is not None:
+                _tracing.finish(tr, outcome=_outcome(exc))
+            self._observe("predict", _outcome(exc), t0,
+                          exemplar=(tr.id if tr is not None else None))
             return error_to_wire(exc)
-        self._observe("predict", "ok", t0)
+        if tr is not None:
+            _tracing.finish(tr, outcome="ok")
+        self._observe("predict", "ok", t0,
+                      exemplar=(tr.id if tr is not None else None))
         return resp
 
     def _generate(self, req, conn):
@@ -727,6 +781,16 @@ class ServingFrontend(object):
         outcome = "error"
         first_token = False
         stream = None
+        tr = None
+        if _tracing.ENABLED:
+            # continue the client-minted trace (or mint one for
+            # traceless callers). The root "request" span opened here
+            # closes at finish — it covers the whole server-side
+            # window, so span coverage vs client wall is the wire RTT
+            # plus parse, not an instrumentation lottery
+            tenv = req.get("trace") or {}
+            tr = _tracing.start(tenv.get("id"), endpoint="generate",
+                                t_client_send=tenv.get("t_send"))
         try:
             if self._decode is None:
                 self._observe("generate", "error", t0)
@@ -738,6 +802,7 @@ class ServingFrontend(object):
                 # got a stream — and a drain-watching operator needs
                 # exactly these post-close rejects in the per-outcome
                 # split
+                outcome = "closed"
                 self._observe("generate", "closed", t0)
                 yield error_to_wire(
                     ServerClosedError("frontend is closed"))
@@ -765,6 +830,7 @@ class ServingFrontend(object):
                     "len_penalty rescores a beam n-best; it needs "
                     "beam=true"))
                 return
+            spec["trace_id"] = tr.id if tr is not None else None
             stream = _Stream(spec)
             conn.state.setdefault("streams", set()).add(stream)
             with self._mu:
@@ -792,8 +858,21 @@ class ServingFrontend(object):
                 if (msg.get("event") in ("tokens", "beam")
                         and not first_token):
                     first_token = True
-                    _fe_ttft.observe(time.monotonic() - t0)
-                yield msg
+                    if tr is not None:
+                        tr.mark("first_token")
+                    _fe_ttft.observe(
+                        time.monotonic() - t0,
+                        exemplar=(tr.id if tr is not None else None))
+                if tr is not None and msg.get("event") in ("tokens",
+                                                           "beam"):
+                    # the span brackets the substrate's write+flush of
+                    # this chunk: t1 lands when the generator resumes
+                    sp = tr.begin("wire.flush",
+                                  tokens=len(msg.get("tokens", ())))
+                    yield msg
+                    tr.end(sp)
+                else:
+                    yield msg
                 if msg.get("event") == "end":
                     outcome = "ok"
                     return
@@ -811,7 +890,15 @@ class ServingFrontend(object):
                     streams.discard(stream)
                 with self._mu:
                     self._active_streams -= 1
-                self._observe("generate", outcome, t0)
+                self._observe("generate", outcome, t0,
+                              exemplar=(tr.id if tr is not None
+                                        else None))
+            if tr is not None:
+                # every exit path lands here — cancel, disconnect and
+                # error traces close their spans too (finish force-
+                # closes stragglers), so the ring never holds a trace
+                # with dangling open spans
+                _tracing.finish(tr, outcome=outcome)
 
     def _poll_conn(self, conn):
         """'cancel' when the client sent an in-band cancel line, 'eof'
@@ -857,10 +944,15 @@ class ServingFrontend(object):
                 raise ServingError(
                     "this frontend serves no decode session")
             rid = int(req.get("id", -1))
+            # the trace id must be read BEFORE the claim: take_result
+            # retires the session's rid->trace binding with the row
+            tid = self._session._trace_ids.get(rid)
             tokens = self._session.take_result(rid)
             resp = {"ok": True,
                     "tokens": (None if tokens is None
                                else encode_array(np.asarray(tokens)))}
+            if tokens is not None and tid:
+                resp["trace_id"] = tid
             if tokens is None:
                 # the id may name a BANKED BEAM n-best (the claim id
                 # the beam 'admitted' event carried): a beam whose

@@ -73,6 +73,7 @@ from collections import deque
 
 import numpy as np
 
+from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.observability.metrics_registry import REGISTRY as _REGISTRY
 from paddle_tpu.resilience import chaos as _chaos
 from paddle_tpu.resilience import retry as _retry
@@ -464,6 +465,14 @@ class SlotDecodeSession(object):
         self._results = {}       # request id -> [T] tokens, until taken
         self._next_req = 0
         self.steps_done = 0      # step() dispatches completed (chaos key)
+        # request tracing (observability/tracing.py): rid -> trace id
+        # rides the decode snapshot, so a restored process re-emits its
+        # banked streams under the ORIGINAL ids; slot -> trace id is
+        # runtime rebind state admissions rebuild. Both stay empty with
+        # FLAGS_request_tracing off — every hot-path hook gates on that.
+        self._trace_ids = {}
+        self._slot_traces = {}
+        self._trace_cow = {}     # slot -> COW copies this step window
         # preemption plumbing: public ops run inside a dispatch window;
         # serving/snapshot.py's manager defers a SIGTERM snapshot until
         # the window closes (host mirrors and device state consistent)
@@ -664,6 +673,11 @@ class SlotDecodeSession(object):
             raise
         for _slot, src_pg, _dst in copies:
             self._pool.deref(src_pg)
+        if self._slot_traces and copies:
+            # per-slot COW attribution for the step window's traces
+            # (cleared by step() before each dispatch window opens)
+            for slot, _src, _dst in copies:
+                self._trace_cow[slot] = self._trace_cow.get(slot, 0) + 1
         self.cow_dispatches += 1
         self.cow_pairs += len(copies)
         _cow_dispatches.inc()
@@ -1478,7 +1492,9 @@ class SlotDecodeSession(object):
             # never bank a freed slot with a stale owner entry (a later
             # occupant of the slot would finish into the cancelled
             # request's result id)
-            self._owner.pop(slot, None)
+            rid = self._owner.pop(slot, None)
+            if self._slot_traces or self._trace_ids:
+                self._trace_cancel(slot, rid)
         finally:
             self._end_op()
         _sequences_total.inc(event="cancelled")
@@ -1496,6 +1512,13 @@ class SlotDecodeSession(object):
         when nothing is in flight."""
         if not self._live:
             return {}
+        traced = bool(self._slot_traces) and _tracing.ENABLED
+        if traced:
+            t_step = time.time()
+            pre_pos = {s: self._live[s]["pos"]
+                       for s in self._slot_traces if s in self._live}
+            pre_spec = self.spec_dispatches if self._spec_k else 0
+            self._trace_cow.clear()
         self._begin_op()
         try:
             if _chaos.ENABLED:
@@ -1512,6 +1535,11 @@ class SlotDecodeSession(object):
             self.steps_done += 1
         finally:
             self._end_op()
+        if traced and pre_pos:
+            self._trace_step(
+                pre_pos, out, t_step, time.time(),
+                bool(self._spec_k
+                     and self.spec_dispatches > pre_spec))
         if self._monitor is not None:
             self._monitor.observe(self._health_load())
         return out
@@ -1689,13 +1717,17 @@ class SlotDecodeSession(object):
         preserves)."""
         return [r["id"] for r in self._pending]
 
-    def enqueue(self, src, src_len=None, prefix_tokens=None):
+    def enqueue(self, src, src_len=None, prefix_tokens=None,
+                trace_id=None):
         """Queue one request ([T] or [1, T] int ids) without admitting
         it; :meth:`pump` admits queued requests as capacity frees.
         Returns a request id (monotonic per session — a restored
         session continues the numbering, so ids name the same requests
         across a preemption). The queue is part of the decode snapshot:
-        a preempted process restores with its backlog intact."""
+        a preempted process restores with its backlog intact.
+        ``trace_id`` binds the request to an in-flight request trace
+        (observability/tracing.py); the binding rides the snapshot, so
+        a restored backlog re-emits under its ORIGINAL ids."""
         if self._beam_width > 1:
             raise ValueError(
                 "beam sessions are admit-or-reject (admit_beam): a "
@@ -1705,11 +1737,18 @@ class SlotDecodeSession(object):
         self._next_req += 1
         src = np.asarray(src, dtype="int64").reshape(1, self._T)
         length = self._T if src_len is None else int(np.ravel(src_len)[0])
-        self._pending.append({
+        entry = {
             "id": rid, "src": src, "len": length,
             "prefix": (None if prefix_tokens is None
                        else [int(t) for t in prefix_tokens]),
-        })
+        }
+        if trace_id:
+            # t_enq feeds the queue-wait span at admission; the key is
+            # runtime-only (a snapshot serializes the named keys), so a
+            # restored entry's queue span starts at its re-admission
+            self._trace_ids[rid] = str(trace_id)
+            entry["t_enq"] = time.time()
+        self._pending.append(entry)
         return rid
 
     def drop_pending(self, request_id):
@@ -1720,6 +1759,12 @@ class SlotDecodeSession(object):
         for i, req in enumerate(self._pending):
             if req["id"] == rid:
                 del self._pending[i]
+                if self._trace_ids:
+                    tid = self._trace_ids.pop(rid, None)
+                    tr = (_tracing.inflight_get(tid) if tid is not None
+                          else None)
+                    if tr is not None and tr.origin == "session":
+                        _tracing.finish(tr, outcome="dropped")
                 return True
         return False
 
@@ -1745,6 +1790,8 @@ class SlotDecodeSession(object):
             deferred = False
             try:
                 req = self._pending.popleft()
+                traced = req["id"] in self._trace_ids
+                t_admit = time.time() if traced else 0.0
                 try:
                     slot = self.admit(req["src"], req["len"],
                                       prefix_tokens=req["prefix"])
@@ -1760,6 +1807,8 @@ class SlotDecodeSession(object):
                 else:
                     self._owner[slot] = req["id"]
                     admitted[slot] = req["id"]
+                    if traced:
+                        self._trace_admitted(req, slot, t_admit)
             finally:
                 self._end_op()
             if deferred:
@@ -1786,6 +1835,7 @@ class SlotDecodeSession(object):
             if rid is not None:
                 finished[rid] = tokens
                 self._results[rid] = tokens
+                self._trace_bank(rid)
         return finished
 
     def take_result(self, request_id):
@@ -1794,8 +1844,123 @@ class SlotDecodeSession(object):
         banked — and ride the decode snapshot, so a completed-but-
         unclaimed request survives a preemption — until taken; a
         long-lived caller that consumes :meth:`pump`'s return directly
-        should still take (or this bank grows one entry per request)."""
-        return self._results.pop(int(request_id), None)
+        should still take (or this bank grows one entry per request).
+        Claiming retires the request's trace-id binding."""
+        rid = int(request_id)
+        out = self._results.pop(rid, None)
+        if out is not None and self._trace_ids:
+            self._trace_ids.pop(rid, None)
+        return out
+
+    # -- request tracing -----------------------------------------------------
+    def _trace_admitted(self, req, slot, t_admit):
+        """Admission-side trace hooks for a queued solo request: emit
+        the queue-wait span and the prefill span (the admission IS the
+        prefill in this design — encoder forward + chunked prefix
+        prefill in one dispatch window) and bind slot -> trace id for
+        the step loop. A restored backlog entry has a rid -> id binding
+        but no in-flight trace: the ORIGINAL id is continued here as a
+        session-origin trace, finished when the result banks."""
+        rid = req["id"]
+        tid = self._trace_ids.get(rid)
+        if tid is None:
+            return
+        tr = _tracing.inflight_get(tid)
+        if tr is None:
+            tr = _tracing.start(tid, endpoint="generate",
+                                origin="session")
+        t_enq = req.get("t_enq")
+        if t_enq is not None:
+            tr.span("queue", t_enq, t_admit, rid=int(rid))
+        hit_pages = (getattr(self._prefix_cache, "last_hit_pages", 0)
+                     if self._paged and self._prefix_cache is not None
+                     else 0)
+        tr.span("prefill", t_admit, time.time(), kind="solo",
+                slot=int(slot), rid=int(rid),
+                prefix_hit_pages=int(hit_pages))
+        self._slot_traces[slot] = tid
+
+    def _trace_bank(self, rid):
+        """Close a session-origin continuation trace when its result
+        banks (the restored-backlog / headless finish path). The
+        rid -> trace-id binding stays until :meth:`take_result` claims
+        the row, so the claim response can still name its trace."""
+        if not self._trace_ids:
+            return
+        tid = self._trace_ids.get(int(rid))
+        tr = _tracing.inflight_get(tid) if tid is not None else None
+        if tr is not None and tr.origin == "session":
+            _tracing.finish(tr, outcome="banked")
+
+    def _trace_cancel(self, slot, rid):
+        """Cancel-side trace teardown: unbind the slot, stop its page
+        integration, retire the rid binding, and close session-origin
+        traces — a cancelled request must never leave an open span in
+        flight (the ring sweep in tests/test_tracing.py pins this)."""
+        tid = self._slot_traces.pop(slot, None)
+        if rid is not None:
+            tid = self._trace_ids.pop(int(rid), None) or tid
+        tr = _tracing.inflight_get(tid) if tid is not None else None
+        if tr is None:
+            return
+        tr.sample_pages(0)
+        if tr.origin == "session":
+            _tracing.finish(tr, outcome="cancelled")
+
+    def _tokens_past(self, trg, prev):
+        """Tokens a finished row generated past position ``prev``
+        (through its terminal eos, or the max-length cap)."""
+        for idx in range(prev + 1, self._T):
+            if int(trg[idx]) == self._eos:
+                return idx - prev
+        return self._T - 1 - prev
+
+    def _trace_step(self, pre_pos, out, t0, t1, was_spec):
+        """Post-dispatch span emission for every traced slot that was
+        live when the step launched: one ``decode.step`` span per slot
+        (tokens committed, COW copies coalesced for it, speculative or
+        sequential), accumulator bumps for the derived stats, and a
+        page-seconds sample per trace (summed across a group's slots).
+        Runs OUTSIDE the dispatch window — host-only bookkeeping."""
+        touched = set()
+        for slot, prev in pre_pos.items():
+            tid = self._slot_traces.get(slot)
+            tr = (_tracing.inflight_get(tid) if tid is not None
+                  else None)
+            if tr is None:
+                continue
+            finished_here = slot not in self._live
+            if finished_here:
+                trg = out.get(slot)
+                delta = (self._tokens_past(trg, prev)
+                         if trg is not None else 0)
+            else:
+                delta = self._live[slot]["pos"] - prev
+            cow = self._trace_cow.pop(slot, 0)
+            tr.span("decode.step", t0, t1, slot=int(slot),
+                    tokens=int(delta), cow_copies=int(cow),
+                    speculative=bool(was_spec))
+            if delta > 0:
+                tr.bump("tokens", int(delta))
+                if was_spec:
+                    # one token per verify dispatch is the anchor the
+                    # sequential path would have produced anyway; the
+                    # rest came from accepted draft tokens
+                    tr.bump("tokens_from_spec", int(delta) - 1)
+            if cow:
+                tr.bump("cow_copies", int(cow))
+            if finished_here:
+                self._slot_traces.pop(slot, None)
+            touched.add(tid)
+        for tid in touched:
+            tr = _tracing.inflight_get(tid)
+            if tr is None:
+                continue
+            npages = (sum(len(self._slot_pages.get(s, ()))
+                          for s, t in self._slot_traces.items()
+                          if t == tid)
+                      if self._paged else 0)
+            tr.sample_pages(npages)
 
     def generate(self, src, src_len=None):
         """Batch convenience: run every row of ``src`` ([B, T] int ids,

@@ -220,6 +220,10 @@ class PrefixCache(object):
         self.lookups = 0
         self.hits = 0
         self.tokens_saved = 0
+        # pages the MOST RECENT lookup matched: per-request attribution
+        # (the admission's prefill trace span reads it right after its
+        # lookup; cumulative hit_rate can't say which request hit)
+        self.last_hit_pages = 0
 
     def __len__(self):
         return len(self._entries)
@@ -255,6 +259,7 @@ class PrefixCache(object):
             depth += self._ps
         if pages:
             self.hits += 1
+        self.last_hit_pages = len(pages)
         return pages
 
     def insert(self, fp, tokens, pages):

@@ -160,14 +160,20 @@ def serving_capture(server, n_ok, wall_s):
     }
 
 
-def wire_capture(n_ok, wall_s, latencies, ttft_s=None):
+def wire_capture(n_ok, wall_s, latencies, ttft_s=None, traces=None):
     """The bench/smoke record for the NETWORK front-end leg:
     wire-level requests/sec plus CLIENT-side latency percentiles (the
     replay's ``latencies`` out-param — what the user actually waited,
     socket included) and the stream time-to-first-token
     (``ttft_s``: one measurement or a list; the median lands as
     ``ttft_ms``). ``tools/perf_diff.py`` gates all three against the
-    ``frontend`` budgets."""
+    ``frontend`` budgets.
+
+    ``traces`` (optional): completed trace records
+    (``observability.tracing`` ring entries, one per streamed request)
+    — their derived stats land as ``ttft_breakdown``: the median split
+    of time-to-first-token into queue wait, prefill and the first
+    decode dispatch, the attribution a bare ttft_ms can't give."""
     window = sorted(latencies or ())
 
     def pct(p):
@@ -179,7 +185,7 @@ def wire_capture(n_ok, wall_s, latencies, ttft_s=None):
     if ttft_s is not None and not np.isscalar(ttft_s):
         seq = sorted(float(t) for t in ttft_s)
         ttft_s = seq[len(seq) // 2] if seq else None
-    return {
+    rec = {
         "metric": "frontend_throughput",
         "value": round(n_ok / wall_s, 2) if wall_s else None,
         "unit": "requests/sec",
@@ -190,3 +196,31 @@ def wire_capture(n_ok, wall_s, latencies, ttft_s=None):
                     if ttft_s is not None else None),
         "requests_ok": n_ok,
     }
+    traces = [t for t in (traces or ()) if t]
+    if traces:
+        def med(vals):
+            seq = sorted(v for v in vals if v is not None)
+            return seq[len(seq) // 2] if seq else 0.0
+
+        def first_dispatch_s(rec_t):
+            steps = [s for s in rec_t.get("spans", ())
+                     if s["name"] == "decode.step"
+                     and s["t1"] is not None]
+            if not steps:
+                return None
+            first = min(steps, key=lambda s: s["t0"])
+            return first["t1"] - first["t0"]
+
+        stats = [t.get("stats", {}) for t in traces]
+        rec["ttft_breakdown"] = {
+            "queue_ms": round(med([s.get("queue_s") for s in stats])
+                              * 1000.0, 3),
+            "prefill_ms": round(med([s.get("prefill_s")
+                                     for s in stats]) * 1000.0, 3),
+            "first_dispatch_ms": round(
+                med([first_dispatch_s(t) for t in traces])
+                * 1000.0, 3),
+        }
+        rec["span_coverage"] = round(
+            med([s.get("span_coverage") for s in stats]), 4)
+    return rec

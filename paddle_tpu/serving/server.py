@@ -38,8 +38,10 @@ import numpy as np
 
 from paddle_tpu.analysis.lint import suggest_buckets
 from paddle_tpu.executor import FetchTimeoutError
+from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.observability import watchdog as _watchdog
 from paddle_tpu.observability.metrics_registry import (
+    DECODE_BUCKETS,
     REGISTRY as _REGISTRY,
     SERVING_BUCKETS,
 )
@@ -85,8 +87,10 @@ _requests_total = _REGISTRY.counter(
 #                           degraded (typed retriable shed reject)
 _request_seconds = _REGISTRY.histogram(
     "paddle_tpu_serving_request_seconds",
-    "submit->completion latency (the caller-visible SLO)",
-    labels=("outcome",), buckets=SERVING_BUCKETS)
+    "submit->completion latency (the caller-visible SLO); "
+    "decode-resolution ladder — sub-millisecond buckets below the "
+    "coarse SERVING_BUCKETS band, trace-id exemplars per bucket",
+    labels=("outcome",), buckets=DECODE_BUCKETS)
 _batch_occupancy = _REGISTRY.histogram(
     "paddle_tpu_serving_batch_occupancy",
     "real rows / bucket rows per dispatched batch (1.0 = no padding)",
@@ -127,15 +131,18 @@ class ServingFuture(object):
 
 class _Request(object):
     __slots__ = ("inputs", "rows", "future", "t_submit", "deadline",
-                 "group")
+                 "group", "trace_id", "t_queue")
 
-    def __init__(self, inputs, rows, deadline, group):
+    def __init__(self, inputs, rows, deadline, group, trace_id=None):
         self.inputs = inputs
         self.rows = rows
         self.future = ServingFuture()
         self.t_submit = time.monotonic()
         self.deadline = deadline
         self.group = group
+        self.trace_id = trace_id      # request trace, or None
+        # wall-clock twin of t_submit: trace spans are wall-time
+        self.t_queue = time.time() if trace_id else 0.0
 
 
 def _round_up(value, ladder):
@@ -347,12 +354,16 @@ class BatchingServer(object):
                                      constant_values=self._pad_value)
         return feeds
 
-    def submit(self, inputs, deadline_s=None):
+    def submit(self, inputs, deadline_s=None, trace_id=None):
         """Queue one request (dict feed-name -> array, or list in feed
         order; leading dim = rows, up to ``max_batch``). Returns a
         :class:`ServingFuture`. Raises ``QueueFullError`` /
         ``ServerClosedError`` at admission; the future raises
-        ``DeadlineExceededError`` when the deadline lapses."""
+        ``DeadlineExceededError`` when the deadline lapses.
+        ``trace_id`` binds the request to an in-flight request trace
+        (observability/tracing.py): the batch worker emits queue-wait
+        and dispatch spans into it, and the completion latency
+        histogram carries it as an exemplar."""
         feeds, rows = self._normalize(inputs)
         feeds = self._pad_request(feeds)
         group = tuple(
@@ -362,7 +373,8 @@ class BatchingServer(object):
             deadline_s = self._default_deadline
         deadline = (time.monotonic() + float(deadline_s)
                     if deadline_s is not None else None)
-        req = _Request(feeds, rows, deadline, group)
+        req = _Request(feeds, rows, deadline, group,
+                       trace_id=trace_id)
         with self._cond:
             if self._closed:
                 with self._stats_lock:
@@ -439,7 +451,8 @@ class BatchingServer(object):
             if outcome == "ok":
                 self._latencies.append(latency)
         _requests_total.inc(outcome=outcome)
-        _request_seconds.observe(latency, outcome=outcome)
+        _request_seconds.observe(latency, exemplar=req.trace_id,
+                                 outcome=outcome)
 
     def _expire_locked(self, now):
         kept = deque()
@@ -536,7 +549,24 @@ class BatchingServer(object):
             if batch:
                 self._execute(predictor, batch, total)
 
+    def _trace_spans(self, batch, t_dispatch, t_done):
+        """Queue-wait + dispatch spans for every traced request of one
+        dispatched batch (they share the dispatch window — the batch is
+        the unit of execution)."""
+        for req in batch:
+            if not req.trace_id:
+                continue
+            tr = _tracing.inflight_get(req.trace_id)
+            if tr is None:
+                continue
+            tr.span("queue", req.t_queue, t_dispatch,
+                    rows=int(req.rows))
+            tr.span("dispatch", t_dispatch, t_done,
+                    rows=int(req.rows))
+
     def _execute(self, predictor, batch, total):
+        traced = any(r.trace_id for r in batch)
+        t_dispatch = time.time() if traced else 0.0
         bucket = _round_up(total, self._ladder) or self._max_batch
         feeds = {}
         for name in self._feed_names:
@@ -618,6 +648,8 @@ class BatchingServer(object):
         finally:
             if wd_token is not None:
                 _watchdog.disarm(wd_token)
+        if traced:
+            self._trace_spans(batch, t_dispatch, time.time())
         bad = _misaligned_fetches(outs, bucket)
         if bad is not None:
             exc = ServingError(

@@ -63,6 +63,7 @@ from collections import deque
 
 import numpy as np
 
+from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.resilience import chaos as _chaos
 from paddle_tpu.resilience.checkpoint import (
     CheckpointManager,
@@ -282,6 +283,11 @@ class DecodeSnapshotManager(CheckpointManager):
             "results": sorted(s._results),
             "owner": {str(slot): int(rid)
                       for slot, rid in s._owner.items()},
+            # request-trace bindings (observability/tracing.py): the
+            # restored process continues banked backlog + unclaimed
+            # results under their ORIGINAL trace ids
+            "trace_ids": {str(rid): str(tid)
+                          for rid, tid in s._trace_ids.items()},
             "next_req": s._next_req,
             "steps_done": s.steps_done,
         }
@@ -554,6 +560,25 @@ class DecodeSnapshotManager(CheckpointManager):
         s._pending = deque(pending)
         s._results = results
         s._owner = {int(k): int(v) for k, v in meta["owner"].items()}
+        s._trace_ids = {int(k): str(v)
+                        for k, v in meta.get("trace_ids", {}).items()}
+        s._slot_traces = {}
+        s._trace_cow = {}
+        if s._trace_ids and _tracing.ENABLED:
+            # requests LIVE at snapshot time: continue their traces as
+            # session-origin continuations under the ORIGINAL ids, so
+            # the restored process's remaining dispatches (and the
+            # eventual bank) attribute to the same trace the client
+            # holds. Queued entries re-bind at their re-admission.
+            by_rid = {rid: slot for slot, rid in s._owner.items()}
+            for rid, tid in s._trace_ids.items():
+                slot = by_rid.get(rid)
+                if slot is None or slot not in s._live:
+                    continue
+                if _tracing.inflight_get(tid) is None:
+                    _tracing.start(tid, endpoint="generate",
+                                   origin="session")
+                s._slot_traces[slot] = tid
         s._next_req = int(meta["next_req"])
         s.steps_done = int(meta["steps_done"])
         if spec_meta is not None:

@@ -1,0 +1,329 @@
+"""Request-scoped tracing (observability/tracing.py + the serving
+hooks):
+
+* trace lifecycle: root span covers the handling window, leaked spans
+  force-close (flagged), derived stats (TTFT, phase split, inter-token
+  distribution, span coverage) come out of the span timeline;
+* histogram exemplars land in the narrowest bucket, ride the JSON
+  snapshot, and resolve against the completed-trace ring;
+* Perfetto export is structurally valid Chrome trace JSON;
+* CONTINUITY across preemption: a session snapshotted mid-flight
+  restores with its ``rid -> trace_id`` bindings intact, re-banks its
+  backlogged streams under the ORIGINAL ids (session-origin
+  continuation records), and ``take_result`` still names the trace at
+  claim time — the frontend's post-restore claim path;
+* cancel / drop paths close every span: the ring sweep finds no open
+  or force-closed spans and the in-flight table drains to empty;
+* blackbox snapshots list in-flight trace ids.
+
+Tracing must also be FREE when off — that half (byte-identical wire
+streams, zero fresh compiles, no minted context) is proved over real
+sockets by tools/trace_smoke.py (CI ``trace`` stage).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import global_scope
+from paddle_tpu.observability import blackbox, tracing
+from paddle_tpu.observability.metrics_registry import (
+    DECODE_BUCKETS,
+    MetricsRegistry,
+)
+from paddle_tpu.serving.generation import Sampler, SlotDecodeSession
+from paddle_tpu.serving.snapshot import DecodeSnapshotManager
+
+VOCAB, SEQ, D, S = 24, 8, 32, 4
+CFG = dict(src_vocab_size=VOCAB, trg_vocab_size=VOCAB, n_layer=2,
+           n_head=2, d_inner=64)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """One tiny transformer shared by the module (the serving
+    resilience suite's pattern)."""
+    from paddle_tpu.models import transformer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 41
+    startup.random_seed = 41
+    scope = global_scope()
+    with fluid.program_guard(main, startup):
+        transformer.build(dropout=0.0, label_smooth_eps=0.0,
+                          max_length=SEQ, d_model=D, **CFG)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(7)
+    src = rng.randint(3, VOCAB, (8, SEQ)).astype("int64")
+    src_len = np.asarray([SEQ, 3, SEQ - 1, 5, SEQ, 4, SEQ - 2, SEQ],
+                         "int64")
+    return {"exe": exe, "scope": scope, "src": src, "src_len": src_len}
+
+
+def _paged(trained, **kw):
+    args = dict(num_slots=S, max_length=SEQ, d_model=D, paged=True,
+                page_size=4, steps=2, num_groups=2,
+                prefix_cache_pages=8,
+                sampler=Sampler(strategy="top_k", top_k=4,
+                                temperature=0.9, seed=11),
+                scope=trained["scope"].new_scope())
+    args.update(CFG)
+    args.update(kw)
+    return SlotDecodeSession(trained["exe"], **args)
+
+
+@pytest.fixture(autouse=True)
+def _tracing_reset():
+    tracing.reset()
+    tracing.enable(True)
+    yield
+    tracing.enable(False)
+    tracing.reset()
+
+
+def _sweep_ring(recs):
+    """The span-closure sweep: every span in every completed record is
+    closed, and none was force-closed at finish (a force-close means a
+    code path finished the trace with a span still open)."""
+    for rec in recs:
+        for sp in rec["spans"]:
+            assert sp["t1"] is not None, (
+                "open span %r in completed trace %s"
+                % (sp["name"], rec["trace_id"]))
+            assert not sp["meta"].get("force_closed"), (
+                "force-closed span %r leaked to finish in trace %s "
+                "(outcome=%s)" % (sp["name"], rec["trace_id"],
+                                  rec["outcome"]))
+
+
+# -- unit: lifecycle, stats, ring, exemplars, perfetto -----------------------
+
+def test_trace_lifecycle_and_derived_stats():
+    tr = tracing.start(endpoint="generate", t_client_send=None)
+    assert tr.id in tracing.inflight_ids()
+    tr.span("queue", tr.t0, tr.t0 + 0.001)
+    sp = tr.begin("prefill", prefix_hit_pages=2)
+    tr.end(sp)
+    for _ in range(3):
+        d = tr.begin("decode.step", tokens=2, cow_copies=1,
+                     speculative=True)
+        tr.end(d)
+        tr.bump("tokens", 2)
+        tr.bump("tokens_from_spec", 1)
+        tr.bump("cow_copies", 1)
+    tr.mark("first_token")
+    tr.mark("first_token")  # idempotent: first occurrence wins
+    rec = tracing.finish(tr, outcome="ok")
+    assert tr.id not in tracing.inflight_ids()
+    st = rec["stats"]
+    assert st["tokens"] == 6 and st["tokens_from_spec"] == 3
+    assert st["spec_fraction"] == 0.5 and st["cow_copies"] == 3
+    assert st["queue_s"] == pytest.approx(0.001, abs=5e-4)
+    assert st["ttft_s"] is not None and st["wall_s"] > 0
+    # the root "request" span spans the whole window -> full coverage
+    assert st["span_coverage"] == 1.0
+    assert tracing.get(tr.id) is rec and rec["outcome"] == "ok"
+    _sweep_ring([rec])
+
+
+def test_finish_force_closes_leaked_spans_and_flags_them():
+    tr = tracing.start(endpoint="generate")
+    tr.begin("decode.step")  # never ended
+    rec = tracing.finish(tr, outcome="error")
+    leaked = [sp for sp in rec["spans"]
+              if sp["meta"].get("force_closed")]
+    assert len(leaked) == 1 and leaked[0]["name"] == "decode.step"
+    # the root span closes at finish by design, never flagged
+    assert not any(sp["meta"].get("force_closed")
+                   for sp in rec["spans"] if sp["name"] == "request")
+
+
+def test_mint_ids_unique_and_ring_is_bounded():
+    ids = {tracing.mint_id() for _ in range(64)}
+    assert len(ids) == 64 and all(len(i) == 16 for i in ids)
+    for _ in range(tracing.RING + 5):
+        tracing.finish(tracing.start(endpoint="generate"))
+    assert len(tracing.completed()) == tracing.RING
+
+
+def test_histogram_exemplar_lands_in_narrowest_bucket():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_seconds", "t", buckets=DECODE_BUCKETS)
+    h.observe(0.0008, exemplar="aaaa")   # -> the 0.001 bucket (idx 3)
+    h.observe(0.0009, exemplar="bbbb")   # same bucket: last writer wins
+    h.observe(99.0, exemplar="cccc")     # -> +Inf overflow bucket
+    ex = h.exemplars()
+    assert ex[3]["id"] == "bbbb" and ex[3]["value"] == 0.0009
+    assert ex[len(DECODE_BUCKETS)]["id"] == "cccc"
+    snap = h.snapshot()
+    assert snap["exemplars"][3]["id"] == "bbbb"
+    # an untraced observation never allocates exemplar state
+    h2 = reg.histogram("p_seconds", "p", buckets=DECODE_BUCKETS)
+    h2.observe(0.001)
+    assert h2.exemplars() == {} and "exemplars" not in h2.snapshot()
+
+
+def test_exemplar_resolves_against_completed_ring():
+    tr = tracing.start(endpoint="generate")
+    rec = tracing.finish(tr)
+    assert tracing.get(tr.id) is rec
+    assert tracing.get("0000000000000000") is None
+
+
+def test_perfetto_events_are_valid_chrome_trace():
+    tr = tracing.start(endpoint="generate")
+    sp = tr.begin("decode.step", tokens=2)
+    tr.end(sp)
+    rec = tracing.finish(tr)
+    events = tracing.perfetto_events(rec, row=3, pid=9)
+    kinds = {e["ph"] for e in events}
+    assert kinds == {"M", "X"}
+    slices = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in slices} == {"request", "decode.step"}
+    for e in slices:
+        assert e["pid"] == 9 and e["tid"] == 3
+        assert e["dur"] >= 0 and e["ts"] > 0
+        assert e["args"]["trace_id"] == rec["trace_id"]
+
+
+def test_blackbox_snapshot_lists_inflight_traces():
+    tr = tracing.start(endpoint="generate")
+    entries = blackbox.snapshot(reason="test")["inflight_traces"]
+    mine = [e for e in entries if e["trace_id"] == tr.id]
+    assert mine and mine[0]["endpoint"] == "generate"
+    assert mine[0]["spans_open"] == 1  # the root span
+    tracing.finish(tr)
+    assert not [e for e in
+                blackbox.snapshot(reason="test")["inflight_traces"]
+                if e["trace_id"] == tr.id]
+
+
+# -- session integration: continuity, cancel, page accounting ----------------
+
+def test_traced_backlog_rides_snapshot_under_original_ids(trained,
+                                                          tmp_path):
+    """THE continuity property: a session snapshotted with traced
+    requests mid-flight restores with the rid -> trace-id bindings
+    intact, re-banks the backlog under the ORIGINAL ids, and
+    take_result still names each trace at claim time."""
+    src, src_len = trained["src"], trained["src_len"]
+    victim = _paged(trained)
+    tids = {}
+    for i in range(1, 6):
+        tid = tracing.mint_id()
+        rid = victim.enqueue(src[i], int(src_len[i]), trace_id=tid)
+        tids[rid] = tid
+    for _ in range(2):
+        victim.pump()
+    assert victim._pending, "snapshot point too late to carry backlog"
+    assert victim._trace_ids, "bindings already retired"
+    mgr = DecodeSnapshotManager(victim, str(tmp_path / "snap"))
+    mgr.save()
+    mgr.close(save=False)
+
+    # simulate the process boundary: the restored twin has no in-flight
+    # traces — continuation must START session-origin traces from the
+    # restored bindings, not find frontend ones
+    tracing.reset()
+    restored = _paged(trained)
+    mgr2 = DecodeSnapshotManager(restored, str(tmp_path / "snap"))
+    assert mgr2.restore() is not None
+    # the bindings survived the dialect round trip verbatim
+    assert restored._trace_ids == {
+        rid: tid for rid, tid in tids.items()
+        if rid in victim._trace_ids}
+    for _ in range(40):
+        restored.pump()
+        if not restored.pending_requests and not restored.active_slots:
+            break
+    banked = {rec["trace_id"]: rec for rec in tracing.completed()}
+    for rid in list(tids):
+        tokens = restored.take_result(rid)
+        if tokens is None:
+            continue  # claimed by the pre-snapshot victim pumps
+        tid = tids[rid]
+        rec = banked.get(tid)
+        assert rec is not None, (
+            "restored request %d re-banked under a NEW id, not its "
+            "original trace %s" % (rid, tid))
+        assert rec["origin"] == "session" and rec["outcome"] == "banked"
+        assert any(sp["name"] == "decode.step" for sp in rec["spans"])
+    # claims retired every binding
+    assert not restored._trace_ids
+    assert not tracing.inflight_ids()
+    _sweep_ring(tracing.completed())
+    mgr2.close(save=False)
+
+
+def test_cancel_and_drop_close_every_span(trained):
+    """Cancel (live slot) and drop (queued request) both finish their
+    traces with no open spans — swept across the whole ring — and the
+    in-flight table drains to empty."""
+    src, src_len = trained["src"], trained["src_len"]
+    sess = _paged(trained)
+    rids = {}
+    for i in range(6):
+        tid = tracing.mint_id()
+        rid = sess.enqueue(src[i % len(src)], int(src_len[i]),
+                           trace_id=tid)
+        rids[rid] = tid
+    admitted = sess.admit_pending()
+    assert admitted and sess._slot_traces
+    sess.step()  # one dispatch so cancelled traces carry decode spans
+    for slot in list(admitted):
+        sess.cancel(slot)
+    for rid in list(sess._trace_ids):
+        sess.drop_pending(rid)
+    assert not sess._slot_traces and not sess._trace_ids
+    assert not tracing.inflight_ids(), (
+        "cancel/drop leaked open traces: %r" % tracing.inflight_ids())
+    recs = tracing.completed()
+    # queued-never-admitted requests have no trace OBJECT yet (the
+    # session only continues traces at admission) — dropping them just
+    # retires the binding; admitted ones must finish as cancelled
+    assert {r["outcome"] for r in recs} <= {"cancelled", "banked"}
+    assert any(r["outcome"] == "cancelled" for r in recs)
+    _sweep_ring(recs)
+    assert sess.pool_conserved
+
+
+def test_traced_decode_accumulates_pages_and_tokens(trained):
+    """A traced request driven to completion accumulates tokens and
+    integrates page-seconds; its session-origin record derives a full
+    stats block."""
+    src, src_len = trained["src"], trained["src_len"]
+    sess = _paged(trained)
+    tid = tracing.mint_id()
+    rid = sess.enqueue(src[0], int(src_len[0]), trace_id=tid)
+    for _ in range(40):
+        sess.pump()
+        if sess.take_result(rid) is not None:
+            break
+    rec = tracing.get(tid)
+    assert rec is not None and rec["outcome"] == "banked"
+    st = rec["stats"]
+    assert st["tokens"] > 0
+    assert st["page_seconds"] > 0
+    assert st["queue_s"] >= 0 and st["prefill_s"] > 0
+    assert st["decode_s"] > 0
+    names = {sp["name"] for sp in rec["spans"]}
+    assert {"request", "queue", "prefill", "decode.step"} <= names
+    _sweep_ring([rec])
+
+
+def test_tracing_off_session_allocates_nothing(trained):
+    """With tracing off, the session's per-request maps stay empty —
+    the zero-allocation half of the overhead contract at the session
+    layer (the wire half is tools/trace_smoke.py's control leg)."""
+    tracing.enable(False)
+    src, src_len = trained["src"], trained["src_len"]
+    sess = _paged(trained)
+    rid = sess.enqueue(src[0], int(src_len[0]))
+    for _ in range(40):
+        sess.pump()
+        if sess.take_result(rid) is not None:
+            break
+    assert sess._trace_ids == {} and sess._slot_traces == {}
+    assert sess._trace_cow == {}
+    assert tracing.completed() == [] and not tracing.inflight_ids()

@@ -89,6 +89,10 @@ METRICS = {
     # leg): stream time-to-first-token over a real socket — the
     # latency_ms_* twins above carry the wire unary SLOs
     "ttft_ms": ("lower", "timing"),
+    # request tracing (tools/trace_smoke.py): worst per-request span
+    # coverage of the CLIENT-observed wall over real sockets — a drop
+    # means some serving phase stopped being attributed
+    "span_coverage": ("higher", "timing"),
 }
 
 
@@ -119,6 +123,7 @@ def _bench_model_metrics(m):
     out["acceptance_rate"] = m.get("acceptance_rate")
     out["snapshot_seconds"] = m.get("snapshot_seconds")
     out["ttft_ms"] = m.get("ttft_ms")
+    out["span_coverage"] = m.get("span_coverage")
     ec = m.get("exec_cache") or {}
     out["fresh_compiles"] = ec.get("fresh_compiles",
                                    m.get("fresh_compiles"))

@@ -4,7 +4,7 @@
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
 # (native|python|lint|warm|metrics|forensics|chaos|shard|serve|decode|
-# servechaos|net|elastic|dryrun|bench|perfgate) to run a subset.
+# servechaos|net|trace|elastic|dryrun|bench|perfgate) to run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -14,7 +14,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ALL_STAGES=(native python lint warm metrics forensics chaos shard serve
-            decode servechaos net elastic dryrun bench perfgate)
+            decode servechaos net trace elastic dryrun bench perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -249,6 +249,39 @@ if want net; then
     python tools/perf_diff.py "$ndir/frontend.json" \
       --budgets benchmark/budgets.json --models frontend
   rm -rf "$ndir"
+  trap - EXIT
+fi
+
+if want trace; then
+  echo "== request-tracing smoke (free when off, complete when on) =="
+  # three processes share one exec cache dir: the cold leg warms every
+  # decode executable and banks the in-process token-stream oracle; the
+  # OFF leg (control) replays the load over a real socket with tracing
+  # unset and must prove bit-identical streams, NO trace field on the
+  # wire and 0 fresh compiles; the ON leg replays with
+  # FLAGS_request_tracing=1 and must prove the streams and compile
+  # counters UNCHANGED, one wire-resolvable trace per request whose
+  # span union covers >=95% of the client-observed wall, a TTFT
+  # histogram exemplar resolving to a ring record, and
+  # trace_view/step_breakdown rendering the flushed JSONL (waterfall +
+  # valid Perfetto export). The capture (span_coverage,
+  # fresh_compiles) gates against the committed trace budgets.
+  tdir="$(mktemp -d)"
+  trap 'rm -rf "$tdir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$tdir/cache" FLAGS_telemetry=1 \
+    python tools/trace_smoke.py cold "$tdir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$tdir/cache" FLAGS_telemetry=1 \
+    python tools/trace_smoke.py off "$tdir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$tdir/cache" FLAGS_telemetry=1 \
+    FLAGS_request_tracing=1 \
+    python tools/trace_smoke.py on "$tdir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/perf_diff.py "$tdir/trace.json" \
+      --budgets benchmark/budgets.json --models trace
+  rm -rf "$tdir"
   trap - EXIT
 fi
 

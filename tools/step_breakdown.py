@@ -16,7 +16,12 @@ Two independent views, printed as JSON lines:
    writes into each record; ``--memory`` adds the HBM view — per-step
    peak watermark trajectory, predicted-vs-measured peak, and the top
    ledger holders (observability/memory.py writes all three into the
-   records).
+   records). ``--requests PATH`` is the serving twin: the per-REQUEST
+   view over a request-trace snapshot (the
+   ``<FLAGS_metrics_path>.traces.jsonl`` a FLAGS_request_tracing=1
+   serving process left behind) — fleet TTFT / queue / prefill /
+   decode split plus the top-N slowest requests by trace id
+   (``tools/trace_view.py`` renders any one of them as a waterfall).
 3. ``--xprof`` — run the full step under ``jax.profiler.trace`` and
    aggregate XLA op self-times from the xplane.pb the profiler writes.
    The xplane wire format is decoded directly (a ~60-line generic
@@ -273,6 +278,98 @@ def _load_steps_jsonl(path):
     return recs
 
 
+def _load_traces_jsonl(path):
+    """Records from a request-trace JSONL, or a friendly exit — same
+    contract as ``_load_steps_jsonl``: a missing/empty snapshot means
+    tracing was off or the path is wrong, not a crash."""
+    if not os.path.exists(path):
+        sys.exit(
+            "step_breakdown: %s does not exist.\nRun the serving "
+            "workload with FLAGS_request_tracing=1, FLAGS_telemetry=1 "
+            "and FLAGS_metrics_path=<p> (completed traces land at "
+            "<p>.traces.jsonl), or pass that .traces.jsonl path here."
+            % path)
+    recs = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    pass
+    if not recs:
+        sys.exit(
+            "step_breakdown: %s is empty — the process completed no "
+            "traced request (was FLAGS_request_tracing=1? did any "
+            "request finish before the telemetry flush?)" % path)
+    return recs
+
+
+def _summarize_requests(recs, top=5):
+    """The per-request serving view over a trace snapshot: where did
+    each request's wall time go (queue wait / prefill / decode /
+    wire flush), fleet TTFT and inter-token percentiles, and the top-N
+    slowest requests — the offline twin of the live ``trace`` wire
+    endpoint."""
+    stats = [r.get("stats") or {} for r in recs]
+
+    def col(key):
+        return [s[key] for s in stats if s.get(key) is not None]
+
+    def ms(v, nd=3):
+        return round(v * 1e3, nd) if v is not None else None
+
+    outcomes = {}
+    for r in recs:
+        o = r.get("outcome", "ok")
+        outcomes[o] = outcomes.get(o, 0) + 1
+    print(json.dumps({
+        "requests": len(recs),
+        "outcomes": outcomes,
+        "ttft_ms": {"p50": ms(_percentile(col("ttft_s"), 50)),
+                    "p95": ms(_percentile(col("ttft_s"), 95))},
+        "wall_ms": {"p50": ms(_percentile(col("wall_s"), 50)),
+                    "p95": ms(_percentile(col("wall_s"), 95))},
+        "split_ms_p50": {
+            "queue": ms(_percentile(col("queue_s"), 50)),
+            "prefill": ms(_percentile(col("prefill_s"), 50)),
+            "decode": ms(_percentile(col("decode_s"), 50)),
+            "flush": ms(_percentile(col("flush_s"), 50)),
+        },
+        "intertoken_ms": {
+            "p50": round(_percentile(col("intertoken_p50_ms"), 50)
+                         or 0, 3),
+            "p95": round(_percentile(col("intertoken_p95_ms"), 95)
+                         or 0, 3),
+        },
+        "tokens": sum(int(s.get("tokens", 0)) for s in stats),
+        "tokens_from_spec": sum(int(s.get("tokens_from_spec", 0))
+                                for s in stats),
+        "page_seconds": round(sum(s.get("page_seconds", 0.0)
+                                  for s in stats), 4),
+        "span_coverage_min": (round(min(col("span_coverage")), 4)
+                              if col("span_coverage") else None),
+    }))
+    slowest = sorted(recs, key=lambda r: -(r.get("stats") or {})
+                     .get("wall_s", 0.0))[:max(0, int(top))]
+    for r in slowest:
+        s = r.get("stats") or {}
+        print(json.dumps({
+            "slow_request": r.get("trace_id"),
+            "endpoint": r.get("endpoint"),
+            "outcome": r.get("outcome"),
+            "wall_ms": ms(s.get("wall_s")),
+            "ttft_ms": ms(s.get("ttft_s")),
+            "queue_ms": ms(s.get("queue_s")),
+            "prefill_ms": ms(s.get("prefill_s")),
+            "decode_ms": ms(s.get("decode_s")),
+            "flush_ms": ms(s.get("flush_s")),
+            "tokens": s.get("tokens"),
+            "spec_fraction": s.get("spec_fraction"),
+            "cow_copies": s.get("cow_copies"),
+        }))
+
+
 def _percentile(vals, q):
     if not vals:
         return None
@@ -389,8 +486,17 @@ def main():
     ap.add_argument("--memory", action="store_true",
                     help="with --from-jsonl: peak-HBM trajectory, "
                          "predicted-vs-measured peak, top ledger holders")
+    ap.add_argument("--requests", metavar="PATH", default=None,
+                    help="summarize a request-trace JSONL "
+                         "(<FLAGS_metrics_path>.traces.jsonl): fleet "
+                         "TTFT/queue/prefill/decode split + top-N "
+                         "slowest requests")
     args = ap.parse_args()
 
+    if args.requests:
+        _summarize_requests(_load_traces_jsonl(args.requests),
+                            top=args.top)
+        return
     if args.from_jsonl:
         _summarize_jsonl(_load_steps_jsonl(args.from_jsonl),
                          per_device=args.per_device, memory=args.memory)

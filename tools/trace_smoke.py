@@ -1,0 +1,310 @@
+"""Request-tracing smoke: prove tracing is FREE when off and COMPLETE
+when on, over real sockets.
+
+Run three times in three subprocesses sharing FLAGS_exec_cache_dir
+(tools/run_ci.sh ``trace`` stage does exactly that):
+
+    FLAGS_exec_cache_dir=$D/cache python tools/trace_smoke.py cold $D
+    FLAGS_exec_cache_dir=$D/cache python tools/trace_smoke.py off  $D
+    FLAGS_exec_cache_dir=$D/cache python tools/trace_smoke.py on   $D
+
+The COLD pass builds the seeded decode transformer, warms every
+executable the wire path needs, and banks the in-process token-stream
+oracle (solo generations, a best-of-2 fork with a forced prefix, the
+same prefix again — the cache-hit case).
+
+The OFF pass — the control leg — replays the whole load through
+``ServingClient``s over a real socket with ``FLAGS_request_tracing``
+unset and asserts the zero-overhead contract: every stream
+bit-identical to the cold oracle, the client minted NO trace (no trace
+field ever reaches the wire), and the wire scrape reports **0 fresh
+compiles** — the warm baseline the traced leg must not move.
+
+The ON pass replays the SAME load with tracing enabled and asserts:
+
+  * streams still bit-identical to the cold oracle (tracing observes,
+    never perturbs);
+  * the scrape still reports **0 fresh compiles** — the traced leg pays
+    the exact compile bill the control leg did: none;
+  * every request resolved a trace OVER THE WIRE (the ``trace``
+    endpoint) whose span union covers >= 95% of the CLIENT-observed
+    wall (root span + queue/prefill/decode/flush children);
+  * the TTFT histogram carries a trace-id exemplar that resolves to a
+    completed ring record over the wire;
+  * ``tools/trace_view.py`` renders the flushed
+    ``.traces.jsonl`` (waterfall + ``--perfetto``) and the exported
+    Chrome/Perfetto JSON is structurally valid;
+  * ``tools/step_breakdown.py --requests`` summarizes the same file.
+
+The capture (``$D/trace.json``: span_coverage, fresh_compiles) gates
+via ``tools/perf_diff.py --budgets benchmark/budgets.json --models
+trace``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+VOCAB, SEQ, D, S = 40, 16, 32, 4
+N_STREAMS = 4
+CFG = dict(src_vocab_size=VOCAB, trg_vocab_size=VOCAB, n_layer=1,
+           n_head=2, d_inner=64)
+COVERAGE_FLOOR = 0.95
+
+
+def _build_decode_session():
+    """The one seeded decode model + session every pass builds
+    identically (cross-process determinism: the programs carry the
+    seed, so every executable fingerprint matches the cold pass's)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving.generation import Sampler, SlotDecodeSession
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 13
+    startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        transformer.build(dropout=0.0, label_smooth_eps=0.0,
+                          max_length=SEQ, d_model=D, **CFG)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return SlotDecodeSession(
+        exe, num_slots=S, max_length=SEQ, d_model=D, paged=True,
+        page_size=4, steps=2, num_groups=2, prefix_cache_pages=8,
+        sampler=Sampler(strategy="top_k", top_k=4, temperature=0.9,
+                        seed=3), **CFG)
+
+
+def _decode_load():
+    """(src rows, lens, prefix) — the deterministic streaming mix."""
+    rng = np.random.RandomState(17)
+    src = rng.randint(3, VOCAB, (N_STREAMS + 1, SEQ)).astype("int64")
+    lens = [SEQ, 5, SEQ - 1, 7, SEQ]
+    prefix = [int(t) for t in src[N_STREAMS][:6]]
+    return src, lens, prefix
+
+
+def _scraped_fresh_compiles(text):
+    for line in text.splitlines():
+        if line.startswith("paddle_tpu_fresh_compiles_total "):
+            return int(float(line.split()[-1]))
+    raise AssertionError(
+        "scrape carries no paddle_tpu_fresh_compiles_total")
+
+
+def _oracle_streams(sess):
+    """The in-process decode oracle both wire legs must equal
+    bit-for-bit. Order matters — the wire legs replay admissions in
+    this exact order, so slot assignment (and the (seed, slot,
+    position) PRNG streams) line up."""
+    src, lens, prefix = _decode_load()
+    out = {}
+    for i in range(N_STREAMS):
+        out["solo_%d" % i] = sess.generate(
+            src[i][None, :], [lens[i]]).tolist()
+    out["bestof"] = sess.generate_best_of(
+        src[N_STREAMS], 2, src_len=lens[N_STREAMS],
+        prefix_tokens=prefix).tolist()
+    out["prefix_hit"] = sess.generate_best_of(
+        src[N_STREAMS], 2, src_len=lens[N_STREAMS],
+        prefix_tokens=prefix).tolist()
+    return out
+
+
+def cold(workdir):
+    sess = _build_decode_session()
+    streams = _oracle_streams(sess)
+    with open(os.path.join(workdir, "trace_oracle.json"), "w") as f:
+        json.dump({"streams": streams}, f)
+    print("trace_smoke[cold]: banked %d stream oracles, executables "
+          "warmed" % len(streams))
+    return 0
+
+
+def _replay_streams(client, oracle, collect=None):
+    """Replay the full streaming load, asserting bit parity per stream.
+    ``collect``: optional list; (trace_id, client_wall_s) per request
+    lands there — the traced leg's coverage input."""
+    src, lens, prefix = _decode_load()
+
+    def timed(key, *args, **kw):
+        t0 = time.time()
+        rows = client.generate_full(*args, **kw)
+        wall = time.time() - t0
+        assert rows.tolist() == oracle[key], (
+            "wire stream %r diverged from the cold oracle" % key)
+        if collect is not None:
+            collect.append((client.last_trace_id, wall))
+
+    for i in range(N_STREAMS):
+        timed("solo_%d" % i, src[i], src_len=lens[i])
+    timed("bestof", src[N_STREAMS], src_len=lens[N_STREAMS], n=2,
+          prefix_tokens=prefix)
+    timed("prefix_hit", src[N_STREAMS], src_len=lens[N_STREAMS], n=2,
+          prefix_tokens=prefix)
+
+
+def off(workdir):
+    """The control leg: tracing off, streams bit-identical, zero fresh
+    compiles, no trace field ever minted."""
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.serving import ServingClient, ServingFrontend
+
+    assert not tracing.ENABLED, \
+        "control leg started with FLAGS_request_tracing set"
+    with open(os.path.join(workdir, "trace_oracle.json")) as f:
+        oracle = json.load(f)["streams"]
+    sess = _build_decode_session()
+    fe = ServingFrontend(session=sess)
+    try:
+        cl = ServingClient(fe.address)
+        _replay_streams(cl, oracle)
+        assert cl.last_trace_id is None, (
+            "tracing-off client minted a trace id — the envelope grew "
+            "a trace field on the zero-overhead path")
+        fresh = _scraped_fresh_compiles(cl.metrics())
+        assert fresh == 0, (
+            "tracing-OFF control leg paid %d fresh compile(s)" % fresh)
+        assert not tracing.completed() and not tracing.inflight_ids(), \
+            "tracing-off process accumulated trace records"
+        cl.close()
+    finally:
+        fe.close()
+    with open(os.path.join(workdir, "trace_off.json"), "w") as f:
+        json.dump({"fresh_compiles": fresh}, f)
+    print("trace_smoke[off]: %d streams bit-identical, 0 fresh "
+          "compiles, no trace minted" % len(oracle))
+    return 0
+
+
+def _assert_tools_render(workdir, traces_path, n_traces):
+    """trace_view renders the flushed JSONL (waterfall + Perfetto) and
+    step_breakdown --requests summarizes it."""
+    tools = os.path.dirname(os.path.abspath(__file__))
+    pf = os.path.join(workdir, "perfetto.json")
+    view = subprocess.run(
+        [sys.executable, os.path.join(tools, "trace_view.py"),
+         traces_path, "--slowest", "3", "--perfetto", pf],
+        capture_output=True, text=True)
+    assert view.returncode == 0, (
+        "trace_view failed on the flushed traces: %s" % view.stderr)
+    assert "decode.step" in view.stdout and "coverage=" in view.stdout, \
+        "trace_view waterfall missing spans/stats"
+    with open(pf) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents")
+    assert events, "perfetto export carries no traceEvents"
+    for ev in events:
+        assert ev["ph"] in ("X", "M") and "ts" in ev and "pid" in ev, \
+            "malformed perfetto event: %r" % ev
+        assert ev["ph"] != "X" or ev["dur"] >= 0, \
+            "negative-duration perfetto slice: %r" % ev
+    brk = subprocess.run(
+        [sys.executable, os.path.join(tools, "step_breakdown.py"),
+         "--requests", traces_path, "--top", "2"],
+        capture_output=True, text=True)
+    assert brk.returncode == 0, (
+        "step_breakdown --requests failed: %s" % brk.stderr)
+    summary = json.loads(brk.stdout.splitlines()[0])
+    assert summary["requests"] >= n_traces, summary
+
+
+def on(workdir):
+    """The traced leg: same load, same bytes, same compile bill — plus
+    one complete trace per request, resolvable over the wire."""
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.serving import ServingClient, ServingFrontend
+    from paddle_tpu.serving.frontend import _fe_ttft
+
+    tracing.enable(True)
+    with open(os.path.join(workdir, "trace_oracle.json")) as f:
+        oracle = json.load(f)["streams"]
+    with open(os.path.join(workdir, "trace_off.json")) as f:
+        fresh_off = json.load(f)["fresh_compiles"]
+    sess = _build_decode_session()
+    fe = ServingFrontend(session=sess)
+    collected = []
+    try:
+        cl = ServingClient(fe.address)
+        _replay_streams(cl, oracle, collect=collected)
+        # -- compile counters unchanged vs the control leg ------------------
+        fresh = _scraped_fresh_compiles(cl.metrics())
+        assert fresh == fresh_off == 0, (
+            "tracing-ON leg moved the compile bill: %d fresh (control "
+            "leg paid %d)" % (fresh, fresh_off))
+        # -- every request: one wire-resolvable trace, >=95% coverage -------
+        coverages = []
+        for tid, wall in collected:
+            assert tid, "traced client minted no trace id"
+            rec = cl.trace(tid)
+            assert rec and rec["trace_id"] == tid, (
+                "trace %s unresolvable over the wire" % tid)
+            union = tracing._union_seconds(rec["spans"], rec["t1"])
+            cov = min(1.0, union / max(wall, 1e-9))
+            coverages.append(cov)
+            assert cov >= COVERAGE_FLOOR, (
+                "trace %s spans cover %.4f of the client-observed "
+                "%.1fms wall (< %.2f): %r"
+                % (tid, cov, wall * 1e3,
+                   COVERAGE_FLOOR, rec["stats"]))
+            assert rec["stats"]["span_coverage"] >= COVERAGE_FLOOR, (
+                "derived span_coverage below floor: %r" % rec["stats"])
+        # -- histogram exemplar resolves to a ring record over the wire -----
+        ex = _fe_ttft.exemplars()
+        assert ex, "TTFT histogram carries no trace-id exemplar"
+        ex_id = next(iter(ex.values()))["id"]
+        ex_rec = cl.trace(ex_id)
+        assert ex_rec and ex_rec["trace_id"] == ex_id, (
+            "exemplar %s does not resolve to a completed trace" % ex_id)
+        assert not tracing.inflight_ids(), (
+            "open traces leaked after all streams finished: %r"
+            % tracing.inflight_ids())
+        cl.close()
+    finally:
+        fe.close()
+    # -- offline tools over the flushed snapshot ----------------------------
+    traces_path = os.path.join(workdir, "m.traces.jsonl")
+    n = tracing.write_traces_jsonl(traces_path)
+    assert n >= len(collected), (
+        "ring flushed %d records for %d requests" % (n, len(collected)))
+    _assert_tools_render(workdir, traces_path, len(collected))
+
+    rec = {
+        "metric": "trace_span_coverage",
+        "value": round(min(coverages), 4),
+        "unit": "fraction of client-observed wall",
+        "vs_baseline": None,
+        "span_coverage": round(min(coverages), 4),
+        "fresh_compiles": fresh,
+        "requests_traced": len(collected),
+        "platform": "cpu",
+    }
+    print("trace_smoke[on]: %s" % json.dumps(rec))
+    with open(os.path.join(workdir, "trace.json"), "w") as f:
+        json.dump({"models": {"trace": rec}}, f)
+    return 0
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else None
+    workdir = sys.argv[2] if len(sys.argv) > 2 else None
+    if mode not in ("cold", "off", "on") or not workdir:
+        print("usage: trace_smoke.py cold|off|on <workdir>",
+              file=sys.stderr)
+        return 2
+    if not os.environ.get("FLAGS_exec_cache_dir"):
+        print("trace_smoke: FLAGS_exec_cache_dir not set",
+              file=sys.stderr)
+        return 2
+    return {"cold": cold, "off": off, "on": on}[mode](workdir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
