@@ -41,6 +41,14 @@ from paddle_tpu.analysis.lint import lint as lint_program  # noqa: F401
 from paddle_tpu.analysis.lint import lint_events  # noqa: F401
 from paddle_tpu.analysis.liveness import analyze as analyze_liveness  # noqa: F401
 from paddle_tpu.analysis.shard_check import check_sharding  # noqa: F401
+# NOTE: the host-plane concurrency pass re-exports under lint_*_source/
+# lint_*_paths-style names for the same reason as verify/lint above —
+# `analysis.concurrency` keeps naming the submodule.
+from paddle_tpu.analysis.concurrency import (  # noqa: F401
+    lint_source as lint_concurrency_source,
+    lint_paths as lint_concurrency_paths,
+)
+from paddle_tpu.analysis import concurrency  # noqa: F401
 from paddle_tpu.analysis import shard_check  # noqa: F401
 from paddle_tpu.analysis import verify  # noqa: F401
 from paddle_tpu.analysis import lint  # noqa: F401
@@ -58,4 +66,6 @@ __all__ = [
     "lint_events",
     "analyze_liveness",
     "check_sharding",
+    "lint_concurrency_source",
+    "lint_concurrency_paths",
 ]

@@ -1,0 +1,1015 @@
+"""Host-plane concurrency lint: the C rules.
+
+The analysis package checks Programs (V/L/S rules) because the dataflow
+core is where *graph* bugs live; this module checks the package's OWN
+source because the threaded host runtime around that core — dispatch
+workers, the decode-owner thread, watchdog, async checkpoint/snapshot
+writers, heartbeat loops, JSON-lines accept loops — is where *systems*
+bugs live, and every one shipped so far (stats-lock races, signal
+handlers blocking on held locks, zombie watchers) was found by hand in
+review. The C rules encode those reviews as a static AST pass:
+
+* **C001 lock-order-cycle** (error) — nested ``with <lock>:`` scopes
+  across the whole tree imply acquisition-order edges; a cycle in that
+  graph is a potential ABBA deadlock. Lock identities resolve
+  ``self.x`` to ``module.Class.x``, module globals to ``module.x``, and
+  foreign-object attributes (``srv._conn_mu``) to a ``~.attr`` wildcard
+  so the same lock reached from two modules unifies.
+* **C002 lock-held-across-blocking-call** (error) — a blocking call
+  (socket send/recv, untimed ``Thread.join``, ``FetchHandle.result``,
+  subprocess, jax dispatch / ``device_put``) inside a ``with lock:``
+  body stalls every peer of that lock for the call's duration.
+* **C003 signal-handler-blocking-acquire** (error) — an untimed lock
+  acquisition (``with lock:`` or ``.acquire()`` with no timeout)
+  reachable through the call graph from a function registered via
+  ``signal.signal``. A Python handler runs on the main thread between
+  bytecodes and may have interrupted that very thread while it HELD the
+  lock — a blocking acquire deadlocks the process short of dying.
+* **C004 unnamed-thread** (warning) — ``threading.Thread(...)`` without
+  ``name=``: witness reports, watchdog dumps and blackbox stacks
+  attribute by role only when threads are named.
+* **C005 unguarded-global-write** (warning, heuristic) — module-global
+  mutable state written from a thread-target function with no enclosing
+  lock.
+* **C006 condition-wait-without-predicate-loop** (warning) —
+  ``Condition.wait`` outside any enclosing ``while``: wakeups are
+  spurious and ``notify_all`` races; the predicate must be re-checked.
+
+Suppression grammar (parsed from raw source): an inline comment
+``# conclint: C002 reason=<why this is safe>`` on the finding's line or
+the line directly above suppresses the named rule(s) THERE. The reason
+is mandatory — a bare ``# conclint: C002`` is itself the error **C000
+suppression-missing-reason**, so every silenced finding documents its
+argument in place.
+
+Entry points: :func:`lint_source` (one module, tests) and
+:func:`lint_paths` (files/dirs; cross-module C001/C003 resolution).
+``tools/locklint.py`` is the CLI; ``tools/run_ci.sh conclint`` gates the
+tree at ``--fail-on=error``. Findings are the house
+:class:`~paddle_tpu.analysis.diagnostics.Diagnostic` objects with
+``file:line`` locations in the message. The runtime twin of this pass
+is ``observability/lock_witness.py`` — C001/C002 checked against what
+the process actually does instead of what the source says.
+"""
+
+import ast
+import os
+import re
+
+from paddle_tpu.analysis.diagnostics import Diagnostic
+
+__all__ = ["RULES", "lint_source", "lint_paths", "collect_files"]
+
+# rule id -> (slug, default severity)
+RULES = {
+    "C000": ("suppression-missing-reason", "error"),
+    "C001": ("lock-order-cycle", "error"),
+    "C002": ("lock-held-across-blocking-call", "error"),
+    "C003": ("signal-handler-blocking-acquire", "error"),
+    "C004": ("unnamed-thread", "warning"),
+    "C005": ("unguarded-global-write", "warning"),
+    "C006": ("condition-wait-without-predicate-loop", "warning"),
+}
+
+_HINTS = {
+    "C001": "acquire these locks in one global order (or collapse them "
+            "into a single lock)",
+    "C002": "move the blocking call off-lock: capture what it needs "
+            "under the lock, release, then block",
+    "C003": "use a timed acquire (lock.acquire(timeout=...)) and degrade "
+            "on failure — a partial dump beats a process that cannot die",
+    "C004": "pass name='paddle-tpu-<role>' so dumps and witness reports "
+            "attribute by role",
+    "C005": "guard the shared structure with a lock (or confine it to "
+            "one thread)",
+    "C006": "wrap the wait in `while not <predicate>:` — wakeups are "
+            "spurious and notify_all races the predicate",
+}
+
+# the lookbehind keeps prose that QUOTES the grammar (``# conclint: ...``
+# in docstrings) from registering as a live marker
+_MARKER_RE = re.compile(r"(?<![`\"'])#\s*conclint:")
+_SUPPRESS_RE = re.compile(
+    r"(?<![`\"'])#\s*conclint:\s*(?P<rules>C\d{3}(?:[\s,]+C\d{3})*)"
+    r"(?:\s+reason=(?P<reason>.*\S))?\s*$")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_WITNESS_CTORS = {"make_lock", "make_rlock", "make_condition"}
+_COND_CTORS = {"Condition", "make_condition"}
+_MUTABLE_CTORS = {"list", "dict", "set", "deque", "defaultdict",
+                  "OrderedDict", "Counter"}
+_LOCK_WORDS = ("lock", "mutex", "cond", "sem")
+
+_BLOCKING_ATTRS = {"sendall", "recv", "recvfrom", "accept", "connect",
+                   "block_until_ready", "result", "communicate",
+                   "check_call", "check_output", "getaddrinfo"}
+_SUBPROCESS_FUNCS = {"run", "call", "check_call", "check_output"}
+
+
+def _lockish_name(name):
+    low = name.lower()
+    return (low in ("mu", "_mu") or low.endswith("_mu")
+            or any(w in low for w in _LOCK_WORDS))
+
+
+def _diag(rule, message, severity=None, hint=True):
+    slug, default_sev = RULES[rule]
+    return Diagnostic(
+        rule=rule, name=slug, severity=severity or default_sev,
+        message=message, hint=_HINTS.get(rule) if hint else None)
+
+
+# -- suppressions ------------------------------------------------------------
+
+class _Suppressions(object):
+    """Per-file map of line -> {rule ids}; a rule suppressed on line N
+    covers findings on N and N+1 (comment-above style). Bare conclint
+    markers without a reason surface as C000 findings."""
+
+    def __init__(self, source, relpath):
+        self.by_line = {}
+        self.missing_reason = []
+        for lineno, line in enumerate(source.splitlines(), 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                if _MARKER_RE.search(line):
+                    # live marker with a malformed rule list or no
+                    # reason — it must not silently suppress nothing
+                    self.missing_reason.append((relpath, lineno))
+                continue
+            rules = set(re.findall(r"C\d{3}", m.group("rules")))
+            if not m.group("reason"):
+                self.missing_reason.append((relpath, lineno))
+                continue
+            for ln in (lineno, lineno + 1):
+                self.by_line.setdefault(ln, set()).update(rules)
+
+    def covers(self, lineno, rule):
+        return rule in self.by_line.get(lineno, ())
+
+    def c000_diagnostics(self):
+        return [
+            _diag("C000",
+                  "%s:%d: conclint suppression without a reason= string "
+                  "(the reason is the documentation)" % (path, ln),
+                  hint=False)
+            for path, ln in self.missing_reason
+        ]
+
+
+# -- per-module model --------------------------------------------------------
+
+def _set_parents(tree):
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node
+
+
+def _dotted(node):
+    """a.b.c Attribute/Name chain -> 'a.b.c' or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Module(object):
+    """One parsed source file: lock definitions, imports, classes,
+    with-nesting edges, per-module findings, call-graph raw material."""
+
+    def __init__(self, source, relpath, modname):
+        self.relpath = relpath
+        self.name = modname
+        self.tree = ast.parse(source, filename=relpath)
+        _set_parents(self.tree)
+        self.suppress = _Suppressions(source, relpath)
+        self.global_locks = {}    # name -> ctor ("Lock"/"RLock"/...)
+        self.attr_locks = {}      # (class, attr) -> ctor ; class may be None
+        self.conditions = set()   # lock ids that are Conditions
+        self.imports = {}         # alias -> dotted target
+        self.classes = {}         # class -> {method -> FunctionDef}
+        self.functions = {}       # name -> FunctionDef (module level)
+        self.attr_types = {}      # (class, attr) -> dotted ctor target
+        self.handler_roots = []   # (class_or_None, func_name, lineno)
+        self.edges = []           # (outer_id, inner_id, lineno)
+        self.findings = []        # local Diagnostics (C002/C004/C005/C006)
+        self._collect()
+
+    # -- phase 1: defs, imports, locks --------------------------------------
+
+    def _lock_ctor(self, value):
+        """'Lock'/'RLock'/'Condition'... when ``value`` constructs a
+        lock (threading.* or lock_witness factory), else None."""
+        if not isinstance(value, ast.Call):
+            return None
+        f = value.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name in _LOCK_CTORS or name in _WITNESS_CTORS:
+            return name
+        return None
+
+    def _collect(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.module:
+                    for a in node.names:
+                        self.imports[a.asname or a.name] = (
+                            node.module + "." + a.name)
+            elif isinstance(node, ast.ClassDef):
+                self.classes.setdefault(node.name, {})
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.classes[node.name][item.name] = item
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(node.parent, ast.Module):
+                    self.functions[node.name] = node
+            elif isinstance(node, ast.Assign):
+                self._collect_assign(node)
+        self._collect_handlers()
+
+    def _enclosing_class(self, node):
+        while node is not None and not isinstance(node, ast.Module):
+            if isinstance(node, ast.ClassDef):
+                return node.name
+            node = getattr(node, "parent", None)
+        return None
+
+    def _collect_assign(self, node):
+        ctor = self._lock_ctor(node.value)
+        for tgt in node.targets:
+            if ctor is not None:
+                if isinstance(tgt, ast.Name):
+                    if isinstance(node.parent, ast.Module):
+                        self.global_locks[tgt.id] = ctor
+                        if ctor in _COND_CTORS:
+                            self.conditions.add(self.name + "." + tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    attr = tgt.attr
+                    cls = None
+                    if (isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        cls = self._enclosing_class(node)
+                    self.attr_locks[(cls, attr)] = ctor
+                    if ctor in _COND_CTORS:
+                        self.conditions.add(self._attr_id(cls, attr))
+            elif (self._ctor_call(node.value) is not None
+                  and len(node.targets) == 1
+                  and isinstance(tgt, ast.Attribute)
+                  and isinstance(tgt.value, ast.Name)
+                  and tgt.value.id == "self"):
+                # self.x = Ctor(...): instance-attr type for cross-module
+                # call resolution (C003 chains like session -> manager);
+                # `x if x is not None else Ctor(...)` and `x or Ctor(...)`
+                # default-injection idioms type the attr by the default
+                target = _dotted(self._ctor_call(node.value).func)
+                if target:
+                    cls = self._enclosing_class(node)
+                    head = target.split(".")[0]
+                    resolved = self.imports.get(head)
+                    if resolved:
+                        target = resolved + target[len(head):]
+                    self.attr_types[(cls, tgt.attr)] = target
+
+    def _ctor_call(self, value):
+        """The Call node typing an assignment value: a direct Ctor(...),
+        or the Ctor branch of an IfExp / `or` default-injection idiom."""
+        if isinstance(value, ast.Call):
+            return value
+        if isinstance(value, ast.IfExp):
+            for branch in (value.body, value.orelse):
+                if isinstance(branch, ast.Call):
+                    return branch
+        if isinstance(value, ast.BoolOp):
+            for v in value.values:
+                if isinstance(v, ast.Call):
+                    return v
+        return None
+
+    def _attr_id(self, cls, attr):
+        if cls:
+            return "%s.%s.%s" % (self.name, cls, attr)
+        return "~." + attr
+
+    def _collect_handlers(self):
+        """Functions registered via signal.signal(sig, handler)."""
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "signal"
+                    and len(node.args) >= 2):
+                continue
+            base = node.func.value
+            if not (isinstance(base, ast.Name) and self.imports.get(
+                    base.id, base.id).split(".")[0] == "signal"):
+                continue
+            handler = node.args[1]
+            if isinstance(handler, ast.Name):
+                self.handler_roots.append(
+                    (None, handler.id, node.lineno))
+            elif (isinstance(handler, ast.Attribute)
+                  and isinstance(handler.value, ast.Name)
+                  and handler.value.id == "self"):
+                self.handler_roots.append(
+                    (self._enclosing_class(node), handler.attr,
+                     node.lineno))
+
+    # -- lock-expression resolution -----------------------------------------
+
+    def resolve_lock(self, expr, cls, known_attrs, known_globals):
+        """(lock_id or None, is_lockish). Identity scheme: module global
+        -> 'mod.name'; self attr with a known class def -> 'mod.Cls.attr';
+        any other attribute whose name is a known lock attr anywhere in
+        the tree (or merely lock-shaped) -> '~.attr' wildcard."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.global_locks:
+                return self.name + "." + expr.id, True
+            if expr.id in known_globals or _lockish_name(expr.id):
+                # unqualified local/param (e.g. a `lock` argument):
+                # lockish but identity-less — no graph edge
+                return None, _lockish_name(expr.id)
+            return None, False
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                if (cls, attr) in self.attr_locks:
+                    return "%s.%s.%s" % (self.name, cls, attr), True
+                if (None, attr) in self.attr_locks:
+                    return "~." + attr, True
+            if attr in known_attrs or _lockish_name(attr):
+                return "~." + attr, attr in known_attrs or _lockish_name(
+                    attr)
+        return None, False
+
+    def is_condition(self, lock_id, expr, cls, global_conds):
+        if lock_id is None:
+            return False
+        if lock_id in self.conditions or lock_id in global_conds:
+            return True
+        return False
+
+
+# -- the per-function walker (C001 edges, C002, C006) ------------------------
+
+class _FuncWalker(object):
+    def __init__(self, module, cls, known_attrs, known_globals,
+                 global_conds):
+        self.m = module
+        self.cls = cls
+        self.known_attrs = known_attrs
+        self.known_globals = known_globals
+        self.global_conds = global_conds
+
+    def walk(self, func):
+        self._body(func.body, held=[])
+
+    def _body(self, stmts, held):
+        for stmt in stmts:
+            self._stmt(stmt, held)
+
+    def _stmt(self, node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # nested defs run later, under their own holds
+        if isinstance(node, ast.With):
+            pushed = []
+            for item in node.items:
+                lock_id, lockish = self.m.resolve_lock(
+                    item.context_expr, self.cls, self.known_attrs,
+                    self.known_globals)
+                if not lockish:
+                    continue
+                entry = (lock_id, node.lineno, item.context_expr)
+                for outer_id, _ln, _e in held:
+                    if outer_id and lock_id:
+                        self.m.edges.append(
+                            (outer_id, lock_id, node.lineno))
+                pushed.append(entry)
+            held.extend(pushed)
+            self._body(node.body, held)
+            for _ in pushed:
+                held.pop()
+            return
+        # non-with statement: scan expressions for blocking calls /
+        # condition waits, then recurse into compound bodies
+        for call in self._calls_in(node):
+            self._check_call(call, held)
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(node, field, None)
+            if sub:
+                self._body(sub, held)
+        for handler in getattr(node, "handlers", ()):
+            self._body(handler.body, held)
+
+    def _calls_in(self, stmt):
+        """Call nodes belonging to this statement's own expressions
+        (not those inside its nested compound bodies — the recursion
+        owns them)."""
+        out = []
+        compound = (ast.With, ast.For, ast.While, ast.If, ast.Try)
+
+        def visit(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, ast.Call):
+                out.append(node)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, compound):
+                    continue
+                if isinstance(child, ast.stmt) and isinstance(
+                        node, compound):
+                    continue
+                visit(child)
+
+        visit(stmt)
+        return out
+
+    # -- C002 / C006 --------------------------------------------------------
+
+    def _check_call(self, call, held):
+        f = call.func
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+        # C006 first (needs no held lock)
+        if attr == "wait":
+            self._check_wait(call)
+        if not held:
+            return
+        label = self._blocking_label(call, attr)
+        if label is None:
+            return
+        lock_id, _ln, lock_expr = held[-1]
+        # Condition.wait on the held target releases the lock: exempt
+        if attr in ("wait", "wait_for") and self._same_expr(
+                f.value, lock_expr):
+            return
+        lineno = call.lineno
+        if self.m.suppress.covers(lineno, "C002"):
+            return
+        self.m.findings.append(_diag(
+            "C002",
+            "%s:%d: %s held across blocking call %s"
+            % (self.m.relpath, lineno,
+               lock_id or "a lock", label)))
+
+    def _blocking_label(self, call, attr):
+        f = call.func
+        if attr is None:
+            name = f.id if isinstance(f, ast.Name) else None
+            if name == "device_put":
+                return "device_put(...)"
+            return None
+        base = f.value
+        base_name = (base.id if isinstance(base, ast.Name)
+                     else base.attr if isinstance(base, ast.Attribute)
+                     else None)
+        if attr in _BLOCKING_ATTRS:
+            if attr == "result" and base_name in ("re", "m", "match"):
+                return None
+            return "%s.%s(...)" % (base_name or "?", attr)
+        if attr == "send" and base_name and any(
+                s in base_name.lower() for s in ("sock", "conn")):
+            return "%s.send(...)" % base_name
+        if attr in ("write", "flush") and base_name in ("wfile", "rfile"):
+            return "%s.%s(...)" % (base_name, attr)
+        if attr == "device_put":
+            return "device_put(...)"
+        if attr in _SUBPROCESS_FUNCS and base_name == "subprocess":
+            return "subprocess.%s(...)" % attr
+        if attr == "join":
+            # thread-join heuristic: untimed zero-arg join on a
+            # non-string base ("sep".join(x) / os.path.join are not
+            # blocking waits)
+            if call.args or call.keywords:
+                return None
+            if isinstance(base, ast.Constant):
+                return None
+            if base_name in ("os", "path"):
+                return None
+            return "%s.join()" % (base_name or "?")
+        if attr == "run" and base_name and "exe" in base_name.lower():
+            return "%s.run(...) [jax dispatch]" % base_name
+        return None
+
+    def _same_expr(self, a, b):
+        return ast.dump(a) == ast.dump(b) if (a is not None
+                                              and b is not None) else False
+
+    def _check_wait(self, call):
+        f = call.func
+        lock_id, lockish = self.m.resolve_lock(
+            f.value, self.cls, self.known_attrs, self.known_globals)
+        if not self.m.is_condition(lock_id, f.value, self.cls,
+                                   self.global_conds):
+            return
+        node = call
+        while node is not None and not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            if isinstance(node, ast.While):
+                return  # a surrounding loop re-checks the predicate
+            node = getattr(node, "parent", None)
+        lineno = call.lineno
+        if self.m.suppress.covers(lineno, "C006"):
+            return
+        self.m.findings.append(_diag(
+            "C006",
+            "%s:%d: %s.wait() outside any enclosing while loop"
+            % (self.m.relpath, lineno, lock_id)))
+
+
+# -- C004: unnamed threads ---------------------------------------------------
+
+def _check_threads(m):
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_thread = (
+            (isinstance(f, ast.Name) and f.id == "Thread"
+             and m.imports.get("Thread", "").startswith("threading."))
+            or (isinstance(f, ast.Attribute) and f.attr == "Thread"
+                and isinstance(f.value, ast.Name)
+                and m.imports.get(f.value.id, f.value.id) == "threading"))
+        if not is_thread:
+            continue
+        if any(kw.arg == "name" for kw in node.keywords):
+            continue
+        if m.suppress.covers(node.lineno, "C004"):
+            continue
+        target = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = _dotted(kw.value) or "<expr>"
+        m.findings.append(_diag(
+            "C004",
+            "%s:%d: threading.Thread(%s) without name="
+            % (m.relpath, node.lineno,
+               "target=%s" % target if target else "...")))
+
+
+# -- C005: unguarded global writes from thread targets -----------------------
+
+def _check_global_writes(m):
+    mutable_globals = set()
+    for node in m.tree.body:
+        if isinstance(node, ast.Assign):
+            value_ok = isinstance(node.value, (ast.List, ast.Dict,
+                                               ast.Set))
+            if isinstance(node.value, ast.Call):
+                f = node.value.func
+                name = (f.id if isinstance(f, ast.Name)
+                        else f.attr if isinstance(f, ast.Attribute)
+                        else None)
+                value_ok = name in _MUTABLE_CTORS
+            if value_ok:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        mutable_globals.add(tgt.id)
+    if not mutable_globals:
+        return
+
+    # thread-target functions: target=<f> in any Thread(...) call
+    targets = set()
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if attr != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                if isinstance(kw.value, ast.Name):
+                    targets.add((None, kw.value.id))
+                elif (isinstance(kw.value, ast.Attribute)
+                      and isinstance(kw.value.value, ast.Name)
+                      and kw.value.value.id == "self"):
+                    targets.add(("self", kw.value.attr))
+
+    _MUTATORS = {"append", "extend", "add", "update", "pop", "remove",
+                 "insert", "clear", "popleft", "appendleft", "setdefault"}
+
+    def fn_node(key):
+        kind, name = key
+        if kind is None:
+            return m.functions.get(name)
+        for methods in m.classes.values():
+            if name in methods:
+                return methods[name]
+        return None
+
+    for key in targets:
+        fn = fn_node(key)
+        if fn is None:
+            continue
+        for node in ast.walk(fn):
+            wrote = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = (node.targets if isinstance(node, ast.Assign)
+                        else [node.target])
+                for tgt in tgts:
+                    if (isinstance(tgt, ast.Subscript)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id in mutable_globals):
+                        wrote = tgt.value.id
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _MUTATORS
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id in mutable_globals):
+                wrote = node.func.value.id
+            if wrote is None:
+                continue
+            # guarded? any ancestor With whose item is lockish
+            anc, guarded = node, False
+            while anc is not None and anc is not fn:
+                if isinstance(anc, ast.With):
+                    for item in anc.items:
+                        _id, lockish = m.resolve_lock(
+                            item.context_expr, None, set(), set())
+                        if lockish:
+                            guarded = True
+                anc = getattr(anc, "parent", None)
+            if guarded:
+                continue
+            if m.suppress.covers(node.lineno, "C005"):
+                continue
+            m.findings.append(_diag(
+                "C005",
+                "%s:%d: module global %r written from thread target "
+                "%s without a guarding lock"
+                % (m.relpath, node.lineno, wrote, key[1])))
+
+
+# -- C003: handler-reachable blocking acquisition ----------------------------
+
+class _CallGraph(object):
+    """Cross-module, name-and-type-resolved call edges — only as deep as
+    C003 needs: self.meth, module functions, imported functions, and
+    one level of typed instance attrs (self.manager.save)."""
+
+    def __init__(self, modules):
+        self.mods = {m.name: m for m in modules}
+
+    def resolve(self, call, mod, cls):
+        f = call.func
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name in mod.functions:
+                return (mod.name, None, name)
+            target = mod.imports.get(name)
+            if target:
+                return self._by_dotted(target)
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        base, attr = f.value, f.attr
+        if isinstance(base, ast.Name):
+            if base.id == "self" and cls:
+                owner = self.mods.get(mod.name)
+                if owner and attr in owner.classes.get(cls, {}):
+                    return (mod.name, cls, attr)
+                return None
+            target = mod.imports.get(base.id)
+            if target:
+                return self._by_dotted(target + "." + attr)
+            return None
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and cls):
+            typed = mod.attr_types.get((cls, base.attr))
+            if typed:
+                return self._by_dotted(typed + "." + attr)
+        return None
+
+    def _by_dotted(self, dotted):
+        parts = dotted.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            modname = ".".join(parts[:split])
+            m = self.mods.get(modname)
+            if m is None:
+                continue
+            rest = parts[split:]
+            if len(rest) == 1:
+                if rest[0] in m.functions:
+                    return (modname, None, rest[0])
+                if rest[0] in m.classes:  # Ctor() -> __init__
+                    if "__init__" in m.classes[rest[0]]:
+                        return (modname, rest[0], "__init__")
+                return None
+            if len(rest) == 2 and rest[0] in m.classes:
+                if rest[1] in m.classes[rest[0]]:
+                    return (modname, rest[0], rest[1])
+            return None
+        return None
+
+    def node(self, key):
+        modname, cls, name = key
+        m = self.mods.get(modname)
+        if m is None:
+            return None, None
+        if cls is None:
+            return m, m.functions.get(name)
+        return m, m.classes.get(cls, {}).get(name)
+
+
+def _check_handler_reachability(modules, diagnostics):
+    graph = _CallGraph(modules)
+    known_attrs = set()
+    known_globals = set()
+    for m in modules:
+        known_globals.update(m.name + "." + g for g in m.global_locks)
+        known_attrs.update(a for (_c, a) in m.attr_locks)
+    for m in modules:
+        for cls, fname, _reg_line in m.handler_roots:
+            root_key = (m.name, cls, fname)
+            _m, fn = graph.node(root_key)
+            if fn is None:
+                continue
+            root_label = ("%s.%s" % (cls, fname)) if cls else fname
+            seen = {root_key}
+            queue = [(root_key, [root_label])]
+            while queue:
+                key, path = queue.pop(0)
+                cm, cfn = graph.node(key)
+                if cfn is None:
+                    continue
+                _scan_for_blocking_acquire(
+                    cm, key[1], cfn, m.relpath, root_label, path,
+                    known_attrs, diagnostics)
+                for node in _own_nodes(cfn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    nxt = graph.resolve(node, cm, key[1])
+                    if nxt is None or nxt in seen:
+                        continue
+                    seen.add(nxt)
+                    queue.append((nxt, path + [nxt[2]]))
+
+
+def _own_nodes(fn):
+    """fn's nodes excluding nested function/lambda bodies (those run
+    outside handler context unless separately reachable)."""
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        if node is not fn and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scan_for_blocking_acquire(m, cls, fn, root_file, root_label, path,
+                               known_attrs, diagnostics):
+    chain = " -> ".join(path)
+    for node in _own_nodes(fn):
+        site = None
+        if isinstance(node, ast.With):
+            for item in node.items:
+                lock_id, lockish = m.resolve_lock(
+                    item.context_expr, cls, known_attrs, set())
+                if lockish:
+                    site = "with %s:" % (lock_id or "<lock>")
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "acquire"):
+            lock_id, lockish = m.resolve_lock(
+                node.func.value, cls, known_attrs, set())
+            if not lockish:
+                continue
+            timed = any(kw.arg == "timeout" for kw in node.keywords)
+            nonblocking = (node.args and isinstance(
+                node.args[0], ast.Constant)
+                and not node.args[0].value)
+            if timed or nonblocking:
+                continue
+            site = "%s.acquire() [untimed]" % (lock_id or "<lock>")
+        if site is None:
+            continue
+        if m.suppress.covers(node.lineno, "C003"):
+            continue
+        diagnostics.append(_diag(
+            "C003",
+            "%s:%d: %s reachable from signal handler %s (%s) via %s"
+            % (m.relpath, node.lineno, site, root_label, root_file,
+               chain)))
+
+
+# -- C001: global lock-order cycles ------------------------------------------
+
+def _check_lock_cycles(modules, diagnostics):
+    edges = {}       # (a, b) -> [(relpath, lineno)]
+    self_edges = {}  # qualified non-reentrant self-nesting
+    for m in modules:
+        for a, b, lineno in m.edges:
+            if a == b:
+                if a.startswith("~."):
+                    continue  # wildcard: may be two distinct objects
+                ctor = _ctor_of(m, a)
+                if ctor in ("RLock", "make_rlock", "Condition",
+                            "make_condition"):
+                    continue
+                self_edges.setdefault(a, []).append((m, lineno))
+                continue
+            edges.setdefault((a, b), []).append((m, lineno))
+    for lock_id, sites in self_edges.items():
+        m, lineno = sites[0]
+        if m.suppress.covers(lineno, "C001"):
+            continue
+        diagnostics.append(_diag(
+            "C001",
+            "%s:%d: nested acquisition of non-reentrant lock %s "
+            "(self-deadlock)" % (m.relpath, lineno, lock_id)))
+    # SCCs over the order graph: any strongly-connected component with
+    # more than one lock is a set of opposite-order acquisitions
+    succ = {}
+    for (a, b) in edges:
+        succ.setdefault(a, set()).add(b)
+    for comp in _sccs(succ):
+        if len(comp) < 2:
+            continue
+        comp_sites = [
+            (m, lineno)
+            for (a, b), sites in edges.items()
+            if a in comp and b in comp
+            for (m, lineno) in sites
+        ]
+        if any(m.suppress.covers(lineno, "C001")
+               for m, lineno in comp_sites):
+            continue
+        where = ", ".join(sorted(
+            {"%s:%d" % (m.relpath, lineno) for m, lineno in comp_sites}))
+        diagnostics.append(_diag(
+            "C001",
+            "lock-order cycle among {%s} (nested-with sites: %s)"
+            % (", ".join(sorted(comp)), where)))
+
+
+def _ctor_of(m, lock_id):
+    if lock_id.startswith(m.name + "."):
+        rest = lock_id[len(m.name) + 1:].split(".")
+        if len(rest) == 1:
+            return m.global_locks.get(rest[0])
+        if len(rest) == 2:
+            return m.attr_locks.get((rest[0], rest[1]))
+    if lock_id.startswith("~."):
+        return m.attr_locks.get((None, lock_id[2:]))
+    return None
+
+
+def _sccs(succ):
+    """Tarjan, iterative."""
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    counter = [0]
+    out = []
+    nodes = set(succ)
+    for tos in succ.values():
+        nodes.update(tos)
+
+    def strongconnect(root):
+        work = [(root, iter(sorted(succ.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(succ.get(nxt, ())))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(comp)
+
+    for node in sorted(nodes):
+        if node not in index:
+            strongconnect(node)
+    return out
+
+
+# -- entry points ------------------------------------------------------------
+
+def _analyze(modules):
+    diagnostics = []
+    known_attrs = set()
+    known_globals = set()
+    global_conds = set()
+    for m in modules:
+        known_attrs.update(a for (_c, a) in m.attr_locks)
+        known_globals.update(m.global_locks)
+        global_conds.update(m.conditions)
+        global_conds.update(
+            "~." + a for (_c, a), ctor in m.attr_locks.items()
+            if ctor in _COND_CTORS)
+    for m in modules:
+        diagnostics.extend(m.suppress.c000_diagnostics())
+        # EVERY function def (module-level, methods, closures) is walked
+        # as its own root: a nested def's body runs later under its own
+        # holds, so the enclosing walker skips it and this loop owns it
+        for fn in ast.walk(m.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls = m._enclosing_class(fn)
+                _FuncWalker(m, cls, known_attrs, known_globals,
+                            global_conds).walk(fn)
+        _check_threads(m)
+        _check_global_writes(m)
+        diagnostics.extend(m.findings)
+    _check_lock_cycles(modules, diagnostics)
+    _check_handler_reachability(modules, diagnostics)
+    return diagnostics
+
+
+def lint_source(source, filename="<source>", module=None, suppress=()):
+    """Lint one module's source text (the test entry point). C001/C003
+    resolve within the module only."""
+    modname = module or os.path.splitext(os.path.basename(filename))[0]
+    m = _Module(source, filename, modname)
+    from paddle_tpu.analysis.diagnostics import filter_diagnostics
+
+    return filter_diagnostics(_analyze([m]), suppress)
+
+
+def collect_files(paths):
+    """Expand files/dirs into the sorted .py file list locklint walks."""
+    files = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__",)]
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        files.append(os.path.join(dirpath, fname))
+        elif path.endswith(".py"):
+            files.append(path)
+    return sorted(files)
+
+
+def _module_name(path):
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    if "paddle_tpu" in parts:
+        parts = parts[parts.index("paddle_tpu"):]
+    else:
+        parts = parts[-1:]
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    else:
+        parts[-1] = os.path.splitext(parts[-1])[0]
+    return ".".join(parts)
+
+
+def lint_paths(paths, suppress=()):
+    """Lint a file/directory set as ONE analysis unit: lock identities,
+    call graph and the C001 order graph span every module, so an ABBA
+    pair split across files still closes a cycle."""
+    modules = []
+    diagnostics = []
+    for path in collect_files(paths):
+        with open(path, "r") as f:
+            source = f.read()
+        rel = os.path.relpath(path)
+        try:
+            modules.append(_Module(source, rel, _module_name(path)))
+        except SyntaxError as exc:
+            diagnostics.append(Diagnostic(
+                rule="C000", name="parse-error", severity="error",
+                message="%s: %s" % (rel, exc)))
+    diagnostics.extend(_analyze(modules))
+    from paddle_tpu.analysis.diagnostics import filter_diagnostics
+
+    return filter_diagnostics(diagnostics, suppress)

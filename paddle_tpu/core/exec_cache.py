@@ -41,11 +41,13 @@ import os
 import pickle
 import tempfile
 import threading
+
+from paddle_tpu.observability import lock_witness
 import time
 
 import jax
 
-_lock = threading.Lock()
+_lock = lock_witness.make_lock("core.exec_cache")
 _tls = threading.local()
 
 _STAT_KEYS = (

@@ -15,6 +15,7 @@ import threading
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.core import op_registry
 from paddle_tpu.core.op_registry import LowerContext, normalize_outputs
 
@@ -275,7 +276,7 @@ class _LazyExecutable(object):
     def _init_lazy_exec(self):
         self._exec = None
         self._exec_cache_key = None
-        self._exec_lock = threading.Lock()
+        self._exec_lock = lock_witness.make_lock("core.lowering.exec")
 
     def _resolve_exec(self, args):
         fn = self._exec

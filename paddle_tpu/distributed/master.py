@@ -23,6 +23,8 @@ import socketserver
 import threading
 import time
 
+from paddle_tpu.observability import lock_witness
+
 __all__ = [
     "Task", "MasterService", "MasterClient", "task_reader",
     "serve_json_lines", "close_json_server", "JsonConn",
@@ -107,6 +109,10 @@ def serve_json_lines(dispatch, host="127.0.0.1", port=0, pass_conn=False,
                 self.server._live_conns.add(self.connection)
                 self.server._next_conn_id += 1
                 cid = self.server._next_conn_id
+            # ThreadingMixIn owns this thread's construction, so the
+            # role name lands here instead of a Thread(name=...) kwarg
+            threading.current_thread().name = (
+                "paddle-tpu-jsonl-conn-%d" % cid)
             self.ctx = JsonConn(cid, self.connection, self.rfile)
             self._opened = False
             if on_open is not None:
@@ -196,12 +202,14 @@ def serve_json_lines(dispatch, host="127.0.0.1", port=0, pass_conn=False,
         daemon_threads = True
 
     server = Server((host, port), Handler)
-    server._conn_mu = threading.Lock()
+    server._conn_mu = lock_witness.make_lock("distributed.jsonl.conn")
     server._live_conns = set()
     server._next_conn_id = 0
     server.bytes_sent = 0
     server.bytes_received = 0
-    threading.Thread(target=server.serve_forever, daemon=True).start()
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="paddle-tpu-jsonl-accept-%d"
+                          % server.server_address[1]).start()
     return server, server.server_address
 
 
@@ -356,7 +364,8 @@ class ThrottledSnapshot(object):
     def __init__(self, path, interval_s=0.5):
         self.path = path
         self.interval_s = float(interval_s)
-        self._mu = threading.Lock()  # guards pending/seq bookkeeping only
+        self._mu = lock_witness.make_lock(
+            "distributed.snapshot.throttle")  # pending/seq bookkeeping only
         self._pending = None         # (seq, state): newest unflushed capture
         self._seq = 0
         self._written_seq = 0
@@ -485,7 +494,7 @@ class MasterService(object):
         self._timeout_s = timeout_s
         self._failure_max = failure_max
         self._snapshot_path = snapshot_path
-        self._mu = threading.RLock()
+        self._mu = lock_witness.make_rlock("distributed.master")
         self._todo = []  # [Task]
         self._pending = {}  # task_id -> (Task, lease_deadline)
         self._done = []
@@ -593,7 +602,8 @@ class MasterService(object):
     def _ensure_watcher(self):
         if self._watcher is None or not self._watcher.is_alive():
             self._watcher = threading.Thread(
-                target=self._watch_loop, daemon=True)
+                target=self._watch_loop, daemon=True,
+                name="paddle-tpu-master-lease-watch")
             self._watcher.start()
 
     def _watch_loop(self):

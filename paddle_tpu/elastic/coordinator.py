@@ -53,6 +53,7 @@ from paddle_tpu.distributed.master import (
     close_json_server,
     serve_json_lines,
 )
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability.metrics_registry import REGISTRY
 
 __all__ = [
@@ -91,7 +92,7 @@ class FleetCoordinator(object):
         self._lease_s = float(lease_s)
         self._min_workers = max(1, int(min_workers))
         self._max_reshard_history = max(1, int(max_reshard_history))
-        self._mu = threading.RLock()
+        self._mu = lock_witness.make_rlock("elastic.coordinator")
         self._members = {}   # worker_id -> {rank, join, deadline, step, meta}
         self._generation = 0
         self._reshard = {}   # generation -> checkpoint serial

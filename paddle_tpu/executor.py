@@ -25,6 +25,7 @@ from paddle_tpu import framework
 from paddle_tpu import profiler as _profiler
 from paddle_tpu.core import exec_cache
 from paddle_tpu.observability import blackbox as _blackbox
+from paddle_tpu.observability import lock_witness as _lock_witness
 from paddle_tpu.resilience import chaos as _chaos
 from paddle_tpu.resilience import retry as _retry
 from paddle_tpu.observability import explain as _explain
@@ -52,7 +53,7 @@ _scope_stack = [_global_scope]
 # eviction drops only the shared handle; executors that already hold an
 # entry in their instance cache keep using it.
 _shared_executables = OrderedDict()
-_shared_lock = threading.Lock()
+_shared_lock = _lock_witness.make_lock("executor.shared_executables")
 _SHARED_CAP = 128
 
 
@@ -467,6 +468,9 @@ class Executor(object):
         dump with the ledger's top holders + the predicted peak) on the
         way out; one substring check, paid only on the failure path."""
         chaos_on = _chaos.ENABLED
+        if _lock_witness.ENABLED:
+            # a witnessed lock held right now spans this device dispatch
+            _lock_witness.note_dispatch()
         try:
             if not _retry.retries_enabled():
                 if chaos_on:

@@ -182,6 +182,17 @@ _DEFS = {
     # as FLAGS_telemetry: off = zero per-request allocations, zero wire
     # bytes, zero fresh-compile delta
     "request_tracing": (False, bool),
+    # runtime lock witness (observability/lock_witness.py): named-lock
+    # registration wrappers around every framework lock record per-thread
+    # acquisition-order edges into a global graph, flag lock-order cycles
+    # (potential deadlock) and holds spanning a device dispatch, and
+    # annotate blackbox/watchdog thread dumps with which named locks each
+    # thread holds. Module-bool guard read at lock CONSTRUCTION time: off
+    # (default) means every factory returns a plain threading primitive —
+    # zero wrapper allocations, zero per-acquire overhead. Arm via the
+    # environment (FLAGS_lock_witness=1) before import, or
+    # lock_witness.enable() before the subsystems under test build.
+    "lock_witness": (False, bool),
 }
 
 

@@ -17,6 +17,7 @@ import time
 import numpy as np
 
 from paddle_tpu.observability import blackbox as _blackbox
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability import telemetry as _telemetry
 from paddle_tpu.observability.metrics_registry import REGISTRY as _REGISTRY
 
@@ -121,7 +122,10 @@ class Predictor(object):
                 origin="Predictor load")
         place = fluid.TPUPlace() if config.use_tpu else fluid.CPUPlace()
         self._exe = fluid.Executor(place)
-        self._lock = threading.Lock()
+        # allow_dispatch: holding this across the jax dispatch is the
+        # per-Predictor serialization contract (see run())
+        self._lock = lock_witness.make_lock(
+            "inference.predictor", allow_dispatch=True)
         # feed name -> declared dtype, fixed at load time (used by
         # run_native_reference's cast policy)
         gvars = self._program.global_block().vars
@@ -155,6 +159,7 @@ class Predictor(object):
                 # Scope passed explicitly: the scope_guard stack is a
                 # process global, unsafe when several predictors serve
                 # concurrently.
+                # conclint: C002 reason=per-Predictor serialization IS the contract (executor cache mutates during run); clone() is the concurrency story
                 outs = self._exe.run(
                     self._program, feed=inputs,
                     fetch_list=self._fetch_vars, scope=self._scope,

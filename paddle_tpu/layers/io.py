@@ -168,8 +168,9 @@ class PyReader(object):
             for _ in range(passes):
                 threads = [
                     threading.Thread(target=_worker, args=(src,),
-                                     daemon=True)
-                    for src in sources
+                                     daemon=True,
+                                     name="paddle-tpu-feed-shard-%d" % i)
+                    for i, src in enumerate(sources)
                 ]
                 for t in threads:
                     t.start()
@@ -179,7 +180,8 @@ class PyReader(object):
                     return
             self.queue.close()
 
-        self._thread = threading.Thread(target=_coordinator, daemon=True)
+        self._thread = threading.Thread(target=_coordinator, daemon=True,
+                                        name="paddle-tpu-feed-coord")
         self._thread.start()
         if self.use_double_buffer and place is not None:
             self._start_prefetch(place)
@@ -220,7 +222,8 @@ class PyReader(object):
                 pq.put(None)
 
         self._prefetch_thread = threading.Thread(
-            target=_prefetcher, daemon=True)
+            target=_prefetcher, daemon=True,
+            name="paddle-tpu-feed-prefetch")
         self._prefetch_thread.start()
 
     def _pq_put(self, pq, feed):

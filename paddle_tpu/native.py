@@ -19,12 +19,14 @@ import os
 import subprocess
 import threading
 
+from paddle_tpu.observability import lock_witness
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libptpu_core.so")
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = lock_witness.make_lock("native.lib")
 _build_error = None
 
 

@@ -11,7 +11,9 @@ import importlib.util
 import os
 import threading
 
-_lock = threading.Lock()
+from paddle_tpu.observability import lock_witness
+
+_lock = lock_witness.make_lock("observability.cost_model")
 _mod = None
 
 

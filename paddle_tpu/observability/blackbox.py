@@ -26,6 +26,8 @@ import json
 import os
 import sys
 import threading
+
+from paddle_tpu.observability import lock_witness
 import time
 import traceback
 
@@ -41,7 +43,7 @@ ENABLED = False
 _RING_CAP = 512
 _TAIL = 64           # telemetry/explainer records carried into a dump
 
-_lock = threading.Lock()
+_lock = lock_witness.make_lock("observability.blackbox")
 _events = collections.deque(maxlen=_RING_CAP)
 _path = [""]
 _nan_diagnostic = [None]
@@ -110,11 +112,19 @@ def reset():
 
 def record(kind, **fields):
     """Append one compact flight event to the ring. Callers guard on
-    ``ENABLED``; calling directly always records."""
+    ``ENABLED``; calling directly always records. The append is a TIMED
+    acquire [C003]: record() is called straight from the preemption
+    signal handlers (TrainSession, DecodeSnapshotManager), which run on
+    the main thread and may have interrupted it mid-append — a blocking
+    acquire there deadlocks the process short of dying. On timeout the
+    event is dropped; a lost flight event beats a hung teardown."""
     ev = {"ts": time.time(), "kind": kind}
     ev.update(fields)
-    with _lock:
-        _events.append(ev)
+    if _lock.acquire(timeout=1.0):
+        try:
+            _events.append(ev)
+        finally:
+            _lock.release()
     return ev
 
 
@@ -184,11 +194,24 @@ def events():
 def thread_stacks():
     """Formatted stacks of every live Python thread — what the watchdog
     and fatal-signal dumps carry (sys._current_frames is the only
-    in-process view of where a hung thread actually is)."""
+    in-process view of where a hung thread actually is). With the lock
+    witness armed, each label carries the named locks that thread holds
+    RIGHT NOW — a "hung in acquire" stack plus a "[holds: x]" peer line
+    is a root cause, not a symptom."""
     names = {t.ident: t.name for t in threading.enumerate()}
+    held = {}
+    try:
+        from paddle_tpu.observability import lock_witness
+
+        if lock_witness.ENABLED:
+            held = lock_witness.held_by_thread()
+    except Exception:
+        pass  # annotation must never break a crash dump
     out = {}
     for ident, frame in sys._current_frames().items():
         label = "%s(%d)" % (names.get(ident, "thread"), ident)
+        if ident in held:
+            label += " [holds: %s]" % ", ".join(held[ident])
         out[label] = traceback.format_stack(frame)
     return out
 
@@ -202,6 +225,7 @@ def _read_locked(lock, read, default, timeout):
     die). On timeout the component degrades to ``default``; a partial
     dump beats a hung teardown."""
     if timeout is None:
+        # conclint: C003 reason=flow-insensitive hit — every handler-context caller passes lock_timeout (the timed branch below); this branch is the ordinary off-handler path
         with lock:
             return read()
     if lock.acquire(timeout=timeout):

@@ -22,6 +22,7 @@ import logging
 import threading
 import time
 
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability.metrics_registry import REGISTRY
 
 __all__ = ["record_compile", "events", "reset", "COMPONENTS",
@@ -57,7 +58,7 @@ _MAX_EVENTS = 512
 # as first_compile-ish blame on whichever components differ).
 _MAX_ENTRIES = 256
 
-_lock = threading.Lock()
+_lock = lock_witness.make_lock("observability.explain")
 _entries = collections.deque(maxlen=_MAX_ENTRIES)  # recent compile keys
 _events = []     # bounded structured event log
 _compile_count = [0]

@@ -39,6 +39,7 @@ failure path only.
 
 import threading
 
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability.metrics_registry import REGISTRY
 
 __all__ = [
@@ -57,7 +58,7 @@ KINDS = ("param", "opt_state", "activation", "feed", "cache")
 RULE = "M001"
 RULE_NAME = "hbm-exhausted"
 
-_lock = threading.Lock()
+_lock = lock_witness.make_lock("observability.memory")
 _live = {}          # (device, kind, name) -> bytes
 _totals = {}        # (device, kind) -> bytes (kept incrementally)
 _peak = [0]         # high-water mark of sum(_totals) since take_step_peak
@@ -105,15 +106,23 @@ def track(name, nbytes, kind, device="host"):
     ``ENABLED``; calling directly always records."""
     nbytes = int(nbytes)
     key = (device, kind, name)
-    with _lock:
-        old = _live.get(key, 0)
-        _live[key] = nbytes
-        tot = _totals.get((device, kind), 0) + nbytes - old
-        _totals[(device, kind)] = tot
-        _live_gauge.set(tot, device=device, kind=kind)
-        total = sum(_totals.values())
-        if total > _peak[0]:
-            _peak[0] = total
+    # Timed acquire [C003]: track/drop run inside the SIGTERM handler
+    # chain (snapshot ledger of the final checkpoint), where the signal
+    # may have interrupted this very thread mid-ledger-update; the
+    # ledger is advisory accounting, so a skipped entry beats a process
+    # that cannot die.
+    if _lock.acquire(timeout=1.0):
+        try:
+            old = _live.get(key, 0)
+            _live[key] = nbytes
+            tot = _totals.get((device, kind), 0) + nbytes - old
+            _totals[(device, kind)] = tot
+            _live_gauge.set(tot, device=device, kind=kind)
+            total = sum(_totals.values())
+            if total > _peak[0]:
+                _peak[0] = total
+        finally:
+            _lock.release()
     return key
 
 
@@ -122,13 +131,18 @@ def drop(name, kind, device="host"):
     can leave through more than one path — e.g. an async fetch whose
     handle materializes after the sync path already swept)."""
     key = (device, kind, name)
-    with _lock:
+    # timed for the same reason as track() [C003]
+    if not _lock.acquire(timeout=1.0):
+        return False
+    try:
         old = _live.pop(key, None)
         if old is None:
             return False
         tot = _totals.get((device, kind), 0) - old
         _totals[(device, kind)] = tot
         _live_gauge.set(tot, device=device, kind=kind)
+    finally:
+        _lock.release()
     return True
 
 

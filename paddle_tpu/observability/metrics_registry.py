@@ -91,6 +91,8 @@ class _Metric(object):
         self.name = name
         self.help = help_text
         self.label_names = tuple(label_names)
+        # deliberately NOT a lock_witness factory: the witness reports
+        # through these very metrics — wrapping them would recurse
         self._lock = threading.Lock()
         self._values = {}  # label key tuple -> value
 
@@ -222,6 +224,7 @@ class Histogram(_Metric):
 
 class MetricsRegistry(object):
     def __init__(self):
+        # plain on purpose — see the per-metric lock note above
         self._lock = threading.Lock()
         self._metrics = {}      # name -> metric, insertion-ordered
         self._order = []

@@ -26,6 +26,7 @@ import threading
 import time
 
 from paddle_tpu.observability import memory as _memory
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability.metrics_registry import REGISTRY
 
 __all__ = [
@@ -43,7 +44,7 @@ ENABLED = False
 
 _RING_CAP = 4096
 
-_lock = threading.Lock()
+_lock = lock_witness.make_lock("observability.telemetry")
 _records = collections.deque(maxlen=_RING_CAP)
 _flops = {}              # fingerprint -> flops per step
 _callbacks = []

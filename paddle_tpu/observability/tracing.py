@@ -39,6 +39,7 @@ import time
 from collections import deque
 
 from paddle_tpu import flags
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability.metrics_registry import (
     DECODE_BUCKETS,
     REGISTRY,
@@ -48,7 +49,7 @@ ENABLED = False
 
 RING = 512  # completed traces kept for exemplar resolution / trace_view
 
-_lock = threading.Lock()
+_lock = lock_witness.make_lock("observability.tracing")
 _inflight = {}                 # trace_id -> Trace
 _completed = deque(maxlen=RING)
 

@@ -27,6 +27,7 @@ import os
 import threading
 import time
 
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability.metrics_registry import REGISTRY
 
 __all__ = [
@@ -42,7 +43,7 @@ _AUTO_MULT = 30.0
 _AUTO_MIN = 10.0
 _AUTO_DEFAULT = 300.0
 
-_lock = threading.Lock()
+_lock = lock_witness.make_lock("observability.watchdog")
 _armed = {}              # token -> {"tag", "t_armed", "reported", "scale"}
 _token_counter = [0]
 _state = {
@@ -68,11 +69,17 @@ def register_on_hang(fn):
 
 
 def unregister_on_hang(fn):
-    with _lock:
+    # Timed acquire [C003]: reachable from TrainSession's SIGTERM
+    # handler via close(), which may have interrupted the very thread
+    # that holds _lock; a leaked callback beats a hung teardown.
+    if _lock.acquire(timeout=1.0):
         try:
-            _on_hang_extra.remove(fn)
-        except ValueError:
-            pass
+            try:
+                _on_hang_extra.remove(fn)
+            except ValueError:
+                pass
+        finally:
+            _lock.release()
 
 
 _fires = REGISTRY.counter(

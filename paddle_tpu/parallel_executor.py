@@ -39,6 +39,7 @@ from paddle_tpu import profiler as _profiler
 from paddle_tpu.core import exec_cache
 from paddle_tpu.observability import blackbox as _blackbox
 from paddle_tpu.observability import explain as _explain
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability import memory as _memory
 from paddle_tpu.observability import telemetry as _telemetry
 from paddle_tpu.resilience import chaos as _chaos
@@ -62,7 +63,7 @@ from paddle_tpu.parallel.mesh import ShardingPolicy, build_mesh
 # executable instead of paying a fresh XLA compile. A fleet that
 # reshapes 2 -> 1 -> 2 compiles twice, not three times.
 _shared_compiled = OrderedDict()
-_shared_lock = threading.Lock()
+_shared_lock = lock_witness.make_lock("parallel_executor.shared_cache")
 _SHARED_CAP = 32
 
 

@@ -27,6 +27,8 @@ import json
 import logging
 import os
 import threading
+
+from paddle_tpu.observability import lock_witness
 import time
 from collections import defaultdict
 
@@ -45,7 +47,7 @@ __all__ = [
 
 logger = logging.getLogger("paddle_tpu.profiler")
 
-_lock = threading.Lock()
+_lock = lock_witness.make_lock("profiler")
 _state = {
     "enabled": False,
     "events": [],   # dicts: name, start, end, tid, span_id, cat, args

@@ -98,7 +98,8 @@ def buffered(reader, size):
                 q.put(d)
             q.put(_End)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name="paddle-tpu-reader-buffered")
         t.start()
         while True:
             e = q.get()
@@ -144,9 +145,11 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 else:
                     out_q.put(mapper(sample))
 
-        threading.Thread(target=read_worker, daemon=True).start()
-        for _ in range(process_num):
-            threading.Thread(target=map_worker, daemon=True).start()
+        threading.Thread(target=read_worker, daemon=True,
+                         name="paddle-tpu-xmap-read").start()
+        for i in range(process_num):
+            threading.Thread(target=map_worker, daemon=True,
+                             name="paddle-tpu-xmap-map-%d" % i).start()
 
         finished = 0
         if order:
@@ -189,8 +192,9 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                 q.put(sample)
             q.put(end)
 
-        for r in readers:
-            threading.Thread(target=worker, args=(r,), daemon=True).start()
+        for i, r in enumerate(readers):
+            threading.Thread(target=worker, args=(r,), daemon=True,
+                             name="paddle-tpu-reader-fanin-%d" % i).start()
         finished = 0
         while finished < len(readers):
             item = q.get()

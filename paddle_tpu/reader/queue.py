@@ -5,6 +5,8 @@ Reference parity: paddle/fluid/operators/reader/lod_tensor_blocking_queue.h
 semantics match (close = graceful EOF, kill = abort)."""
 
 import threading
+
+from paddle_tpu.observability import lock_witness
 from collections import deque
 
 
@@ -16,7 +18,10 @@ class BlockingQueue(object):
     def __init__(self, capacity):
         self.capacity = capacity
         self._q = deque()
-        self._mutex = threading.Lock()
+        self._mutex = lock_witness.make_lock("reader.queue")
+        # both conditions share the one (witnessed) mutex — Condition
+        # delegates acquire/release through the wrapper, so every
+        # wait/notify hold is recorded under the reader.queue name
         self._not_full = threading.Condition(self._mutex)
         self._not_empty = threading.Condition(self._mutex)
         self._closed = False

@@ -84,6 +84,7 @@ import signal
 import threading
 import time
 
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability.metrics_registry import REGISTRY
 
 __all__ = [
@@ -110,7 +111,7 @@ class ChaosOOMError(RuntimeError):
 _KINDS = ("kill", "io", "compile", "slow", "oom")
 _HOME_SITE = {"kill": "session.step", "compile": "exec.compile"}
 
-_lock = threading.Lock()
+_lock = lock_witness.make_lock("resilience.chaos")
 _clauses = []  # [{"kind", "site", "step", "p", "n", "secs", "rng", "fired"}]
 
 _faults_total = REGISTRY.counter(
@@ -215,7 +216,14 @@ def fault(site, step=None):
     no-match visit costs one lock + list scan, paid only while chaos is
     configured."""
     fire = None
-    with _lock:
+    # Timed acquire [C003]: the ckpt.write site sits inside the SIGTERM
+    # handler chain chaos runs deliberately exercise, and the signal may
+    # have interrupted this very thread mid-scan. Uncontended (the only
+    # deterministic case the schedules rely on) the acquire is
+    # immediate; on timeout the visit is skipped rather than deadlock.
+    if not _lock.acquire(timeout=5.0):
+        return
+    try:
         for c in _clauses:
             if c["site"] != site:
                 continue
@@ -232,6 +240,8 @@ def fault(site, step=None):
             c["fired"] += 1
             fire = (c["kind"], c["secs"])
             break
+    finally:
+        _lock.release()
     if fire is None:
         return
     kind, secs = fire

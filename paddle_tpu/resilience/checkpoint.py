@@ -50,6 +50,7 @@ import time
 
 import numpy as np
 
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability.metrics_registry import REGISTRY
 from paddle_tpu.resilience import chaos
 
@@ -214,7 +215,8 @@ class CheckpointManager(object):
         # elastic runtime pins a published reshape-barrier serial here
         # while late joiners may still be restoring it
         self.pinned_serials = set()
-        self._write_lock = threading.Lock()   # one writer at a time
+        self._write_lock = lock_witness.make_lock(
+            "resilience.checkpoint.write")   # one writer at a time
         self._thread = None
         self.last_error = None
         self.last_saved_serial = None
@@ -405,9 +407,22 @@ class CheckpointManager(object):
                 json.dump(manifest, f, sort_keys=True)
                 f.flush()
                 os.fsync(f.fileno())
-            with self._write_lock:
+            # Timed acquire [C003]: _write runs inside the SIGTERM
+            # handler chain (TrainSession._signal_handler -> save), and
+            # the signal may have interrupted the async writer mid-
+            # publish on this very process — an untimed acquire would
+            # deadlock short of the final checkpoint. 30s bounds a
+            # wedged peer; the raise lands in save()/save_async()'s
+            # existing failure accounting and the tmp dir is swept.
+            if not self._write_lock.acquire(timeout=30.0):
+                raise RuntimeError(
+                    "checkpoint publish lock held >30s; aborting save "
+                    "of serial %d (peer writer wedged?)" % serial)
+            try:
                 shutil.rmtree(final_dir, ignore_errors=True)  # re-save
                 os.replace(tmp_dir, final_dir)
+            finally:
+                self._write_lock.release()
             _fsync_dir(self.checkpoint_dir)
         except BaseException:
             _failures.inc(stage="save")

@@ -73,6 +73,7 @@ from paddle_tpu.distributed.master import (
     close_json_server,
     serve_json_lines,
 )
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.observability.metrics_registry import (
     REGISTRY as _REGISTRY,
@@ -173,7 +174,7 @@ class _DecodeWorker(object):
 
     def __init__(self, session, max_backlog=64):
         self._s = session
-        self._cond = threading.Condition()
+        self._cond = lock_witness.make_condition("serving.frontend.decode")
         self._incoming = deque()
         self._cancels = deque()
         self._stop = False
@@ -637,7 +638,7 @@ class ServingFrontend(object):
                                       max_backlog=max_stream_backlog)
                         if session is not None else None)
         self._poll = float(stream_poll_s)
-        self._mu = threading.Lock()
+        self._mu = lock_witness.make_lock("serving.frontend.mu")
         self._closed = False
         self._counts = {}
         self._active_streams = 0

@@ -121,7 +121,8 @@ def replay(server, requests, concurrency=4, deadline_s=None,
             if callable(server):
                 target.close()
 
-    threads = [threading.Thread(target=client, args=(i,))
+    threads = [threading.Thread(target=client, args=(i,),
+                                name="paddle-tpu-loadgen-%d" % i)
                for i in range(concurrency)]
     t0 = time.perf_counter()
     for t in threads:

@@ -38,6 +38,7 @@ import numpy as np
 
 from paddle_tpu.analysis.lint import suggest_buckets
 from paddle_tpu.executor import FetchTimeoutError
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.observability import watchdog as _watchdog
 from paddle_tpu.observability.metrics_registry import (
@@ -259,7 +260,7 @@ class BatchingServer(object):
         self._linger = float(batch_linger_s)
         self._default_deadline = default_deadline_s
         self._queue = deque()
-        self._cond = threading.Condition()
+        self._cond = lock_witness.make_condition("serving.server.cond")
         self._closed = False
         self._drain = True
         self._latencies = deque(maxlen=4096)  # seconds, completed only
@@ -267,7 +268,7 @@ class BatchingServer(object):
         # _cond (expire/close paths) and outside it (dispatch workers),
         # so the counters need their own lock — always acquired LAST,
         # never while calling back into queue machinery
-        self._stats_lock = threading.Lock()
+        self._stats_lock = lock_witness.make_lock("serving.server.stats")
         self._counts = {"submitted": 0, "ok": 0, "queue_full": 0,
                         "deadline": 0, "error": 0, "closed": 0,
                         "degraded": 0, "batches": 0, "padded_rows": 0,

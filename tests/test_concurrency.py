@@ -1,0 +1,460 @@
+"""Host-plane concurrency analysis (PR 18).
+
+Each C rule gets a minimal bad module that must trigger it (asserting
+the rule id) and a clean twin that must not; the suppression grammar is
+exercised both ways (reasoned marker silences, bare marker is its own
+C000 finding and silences nothing); the runtime lock witness is proved
+on an ABBA order cycle, a hold spanning a (fake and real) device
+dispatch, and the zero-overhead-off contract; and the timed-acquire
+C003 fixes in blackbox/watchdog/memory get degrade regression tests.
+The whole-tree lint run at the bottom is the same gate CI's conclint
+stage applies.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.analysis import concurrency
+from paddle_tpu.analysis.concurrency import lint_source
+from paddle_tpu.observability import lock_witness as lw
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(diags):
+    return sorted({d.rule for d in diags})
+
+
+def _lint(src):
+    return lint_source(src, filename="m.py", module="m")
+
+
+# ---------------------------------------------------------------------------
+# lint rules: one bad module + one clean twin each
+# ---------------------------------------------------------------------------
+
+
+class TestLintRules(object):
+    def test_c001_lock_order_cycle(self):
+        ds = _lint("""
+import threading
+_a = threading.Lock()
+_b = threading.Lock()
+def f():
+    with _a:
+        with _b:
+            pass
+def g():
+    with _b:
+        with _a:
+            pass
+""")
+        assert _rules(ds) == ["C001"]
+        assert "m._a" in ds[0].message and "m._b" in ds[0].message
+
+    def test_c001_consistent_order_clean(self):
+        assert _lint("""
+import threading
+_a = threading.Lock()
+_b = threading.Lock()
+def f():
+    with _a:
+        with _b:
+            pass
+def g():
+    with _a:
+        with _b:
+            pass
+""") == []
+
+    def test_c002_blocking_call_under_lock(self):
+        ds = _lint("""
+import threading
+_lock = threading.Lock()
+def send(sock, data):
+    with _lock:
+        sock.sendall(data)
+""")
+        assert _rules(ds) == ["C002"]
+        assert "sendall" in ds[0].message
+
+    def test_c002_fetch_result_under_lock(self):
+        ds = _lint("""
+import threading
+_lock = threading.Lock()
+def wait(handle):
+    with _lock:
+        return handle.result()
+""")
+        assert _rules(ds) == ["C002"]
+
+    def test_c002_send_outside_lock_clean(self):
+        assert _lint("""
+import threading
+_lock = threading.Lock()
+def send(sock, data):
+    with _lock:
+        n = len(data)
+    sock.sendall(data)
+""") == []
+
+    def test_c003_untimed_acquire_reachable_from_handler(self):
+        ds = _lint("""
+import signal
+import threading
+_lock = threading.Lock()
+def _flush():
+    with _lock:
+        pass
+def _handler(signum, frame):
+    _flush()
+signal.signal(signal.SIGTERM, _handler)
+""")
+        assert _rules(ds) == ["C003"]
+        # the finding names the reach chain, not just the site
+        assert "_handler -> _flush" in ds[0].message
+
+    def test_c003_timed_acquire_clean(self):
+        assert _lint("""
+import signal
+import threading
+_lock = threading.Lock()
+def _flush():
+    if not _lock.acquire(timeout=1.0):
+        return
+    try:
+        pass
+    finally:
+        _lock.release()
+def _handler(signum, frame):
+    _flush()
+signal.signal(signal.SIGTERM, _handler)
+""") == []
+
+    def test_c004_unnamed_thread(self):
+        ds = _lint("""
+import threading
+def start(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+""")
+        assert _rules(ds) == ["C004"]
+
+    def test_c004_named_thread_clean(self):
+        assert _lint("""
+import threading
+def start(fn):
+    t = threading.Thread(target=fn, name="paddle-tpu-worker")
+    t.start()
+""") == []
+
+    def test_c005_unguarded_global_write_from_thread_target(self):
+        ds = _lint("""
+import threading
+_state = {}
+def _worker():
+    _state["k"] = 1
+def start():
+    threading.Thread(target=_worker, name="w").start()
+""")
+        assert _rules(ds) == ["C005"]
+        assert ds[0].severity == "warning"
+
+    def test_c005_guarded_write_clean(self):
+        assert _lint("""
+import threading
+_state = {}
+_lock = threading.Lock()
+def _worker():
+    with _lock:
+        _state["k"] = 1
+def start():
+    threading.Thread(target=_worker, name="w").start()
+""") == []
+
+    def test_c006_wait_without_predicate_loop(self):
+        ds = _lint("""
+import threading
+_cond = threading.Condition()
+def take():
+    with _cond:
+        if True:
+            _cond.wait()
+""")
+        assert _rules(ds) == ["C006"]
+
+    def test_c006_wait_in_while_clean(self):
+        assert _lint("""
+import threading
+_cond = threading.Condition()
+def take(ready):
+    with _cond:
+        while not ready():
+            _cond.wait()
+""") == []
+
+    def test_witness_factories_are_lock_ctors(self):
+        # locks built through the lock_witness factories participate in
+        # the same analysis as plain threading ctors
+        ds = _lint("""
+from paddle_tpu.observability import lock_witness
+_a = lock_witness.make_lock("m.a")
+_b = lock_witness.make_lock("m.b")
+def f():
+    with _a:
+        with _b:
+            pass
+def g():
+    with _b:
+        with _a:
+            pass
+""")
+        assert _rules(ds) == ["C001"]
+
+
+# ---------------------------------------------------------------------------
+# suppression grammar
+# ---------------------------------------------------------------------------
+
+
+class TestSuppressions(object):
+    _BAD = """
+import threading
+_lock = threading.Lock()
+def send(sock, data):
+    with _lock:
+        %s
+        sock.sendall(data)
+"""
+
+    def test_reasoned_marker_silences(self):
+        src = self._BAD % (
+            "# conclint: C002 reason=handshake frame, sub-ms by contract")
+        assert _lint(src) == []
+
+    def test_bare_marker_is_c000_and_silences_nothing(self):
+        ds = _lint(self._BAD % "# conclint: C002")
+        assert _rules(ds) == ["C000", "C002"]
+
+    def test_marker_for_other_rule_does_not_silence(self):
+        ds = _lint(self._BAD % "# conclint: C004 reason=wrong rule")
+        assert "C002" in _rules(ds)
+
+    def test_global_suppress_list(self):
+        src = self._BAD % "pass"
+        assert _rules(_lint(src)) == ["C002"]
+        assert lint_source(src, filename="m.py", module="m",
+                           suppress=("C002",)) == []
+
+
+# ---------------------------------------------------------------------------
+# runtime lock witness
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def witness():
+    lw.enable()
+    lw.reset()
+    try:
+        yield lw
+    finally:
+        lw.disable()
+        lw.reset()
+
+
+class TestLockWitness(object):
+    def test_zero_overhead_when_off(self):
+        assert not lw.ENABLED  # the suite runs with the flag unset
+        assert type(lw.make_lock("t.off")) is type(threading.Lock())
+        assert type(lw.make_rlock("t.off")) is type(threading.RLock())
+        assert isinstance(lw.make_condition("t.off"), threading.Condition)
+        # off-path construction registers nothing
+        assert "t.off" not in lw.registered_locks()
+
+    def test_abba_cycle_detected_without_deadlock(self, witness):
+        a = lw.make_lock("t.a")
+        b = lw.make_lock("t.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # opposite order: closes the cycle in the graph
+                pass
+        rep = lw.report()
+        assert not rep["degraded"]
+        assert rep["edges"]["t.a -> t.b"] == 1
+        assert rep["edges"]["t.b -> t.a"] == 1
+        assert len(rep["cycles"]) == 1
+        assert set(rep["cycles"][0]["cycle"]) == {"t.a", "t.b"}
+
+    def test_consistent_order_no_cycle(self, witness):
+        a = lw.make_lock("t.a")
+        b = lw.make_lock("t.b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        rep = lw.report()
+        assert rep["edges"] == {"t.a -> t.b": 3}
+        assert rep["cycles"] == []
+
+    def test_rlock_reacquire_records_no_edge(self, witness):
+        r = lw.make_rlock("t.r")
+        with r:
+            with r:  # reentrant: depth bump, not a t.r -> t.r edge
+                pass
+        assert lw.report()["edges"] == {}
+
+    def test_long_hold_across_dispatch(self, witness):
+        g = lw.make_lock("t.guard")
+        with g:
+            lw.note_dispatch()
+        rep = lw.report()
+        assert len(rep["long_holds"]) == 1
+        assert rep["long_holds"][0]["locks"] == ["t.guard"]
+
+    def test_allow_dispatch_exempt(self, witness):
+        g = lw.make_lock("t.serial", allow_dispatch=True)
+        with g:
+            lw.note_dispatch()
+        assert lw.report()["long_holds"] == []
+
+    def test_no_hold_no_long_hold(self, witness):
+        lw.note_dispatch()
+        assert lw.report()["long_holds"] == []
+
+    def test_held_by_thread_annotation(self, witness):
+        g = lw.make_lock("t.held")
+        ident = threading.get_ident()
+        with g:
+            assert lw.held_by_thread().get(ident) == ["t.held"]
+        assert ident not in lw.held_by_thread()
+
+    def test_condition_interop(self, witness):
+        cond = lw.make_condition("t.cond")
+        box = []
+
+        def consumer():
+            with cond:
+                while not box:
+                    cond.wait(timeout=5.0)
+
+        t = threading.Thread(target=consumer, name="t-cond-consumer")
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            box.append(1)
+            cond.notify()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+
+    def test_cross_thread_orders_merge(self, witness):
+        # the graph merges per-thread orders: thread 1 takes a->b,
+        # thread 2 takes b->a; neither deadlocks (they never overlap)
+        # but the union is the latent ABBA
+        a = lw.make_lock("t.x")
+        b = lw.make_lock("t.y")
+
+        def one_order(first, second):
+            with first:
+                with second:
+                    time.sleep(0.01)
+
+        t1 = threading.Thread(target=one_order, args=(a, b), name="t-ab")
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=one_order, args=(b, a), name="t-ba")
+        t2.start()
+        t2.join()
+        assert len(lw.report()["cycles"]) == 1
+
+    def test_executor_dispatch_is_witnessed(self, witness):
+        # executor._dispatch calls note_dispatch(): holding a witnessed
+        # lock across a real run must surface as a long hold
+        import numpy as np
+
+        import paddle_tpu as fluid
+
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [4])
+            y = fluid.layers.fc(input=x, size=3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        g = lw.make_lock("t.across_dispatch")
+        with g:
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[y])
+        holds = lw.report()["long_holds"]
+        assert any("t.across_dispatch" in h["locks"] for h in holds)
+
+
+# ---------------------------------------------------------------------------
+# C003 fix regressions: handler-reachable paths degrade, never block
+# ---------------------------------------------------------------------------
+
+
+class TestTimedAcquireDegrade(object):
+    def test_blackbox_record_drops_instead_of_blocking(self):
+        from paddle_tpu.observability import blackbox
+
+        assert blackbox._lock.acquire(timeout=1.0)
+        try:
+            t0 = time.monotonic()
+            blackbox.record("conclint_test_event")  # must time out + drop
+            elapsed = time.monotonic() - t0
+        finally:
+            blackbox._lock.release()
+        assert elapsed < 5.0
+        assert all(e["kind"] != "conclint_test_event"
+                   for e in blackbox.events())
+
+    def test_watchdog_unregister_degrades(self):
+        from paddle_tpu.observability import watchdog
+
+        assert watchdog._lock.acquire(timeout=1.0)
+        try:
+            t0 = time.monotonic()
+            watchdog.unregister_on_hang(lambda: None)
+            elapsed = time.monotonic() - t0
+        finally:
+            watchdog._lock.release()
+        assert elapsed < 5.0
+
+    def test_memory_track_degrades(self):
+        from paddle_tpu.observability import memory
+
+        assert memory._lock.acquire(timeout=1.0)
+        try:
+            t0 = time.monotonic()
+            memory.track("conclint-test", 128, kind="scratch")
+            elapsed = time.monotonic() - t0
+        finally:
+            memory._lock.release()
+        assert elapsed < 5.0
+        # the wedged-lock visit dropped the entry (advisory ledger)
+        assert all(h["name"] != "conclint-test"
+                   for h in memory.top_holders(k=100))
+
+
+# ---------------------------------------------------------------------------
+# the tree itself: the CI conclint gate
+# ---------------------------------------------------------------------------
+
+
+class TestTreeIsClean(object):
+    def test_package_lints_clean_at_info(self):
+        pkg = os.path.join(_REPO, "paddle_tpu")
+        diags = concurrency.lint_paths([pkg])
+        assert diags == [], "\n".join(str(d) for d in diags)
+
+    def test_rule_catalog_complete(self):
+        assert set(concurrency.RULES) == {
+            "C000", "C001", "C002", "C003", "C004", "C005", "C006"}
+        for rule, (slug, severity) in concurrency.RULES.items():
+            assert severity in ("info", "warning", "error"), rule

@@ -329,6 +329,24 @@ def warm(workdir):
         health = checker.health()
         assert health == {"server": "healthy", "decode": "healthy"}, \
             health
+        # -- lock-witness verdict (conclint stage: FLAGS_lock_witness=1) ----
+        from paddle_tpu.observability import lock_witness
+
+        if lock_witness.ENABLED:
+            wrep = lock_witness.report()
+            assert not wrep["degraded"], \
+                "lock witness report degraded (wedged internal lock)"
+            assert wrep["registered"], \
+                "witness armed but no framework lock registered through it"
+            assert not wrep["cycles"], (
+                "lock-order cycle(s) under the serving load: %r"
+                % wrep["cycles"])
+            assert not wrep["long_holds"], (
+                "lock(s) held across a device dispatch: %r"
+                % wrep["long_holds"])
+            print("frontend_smoke[warm]: lock witness clean "
+                  "(%d locks, %d order edges, 0 cycles, 0 long holds)"
+                  % (len(wrep["registered"]), len(wrep["edges"])))
         checker.close()
     finally:
         fe.close()

@@ -1,0 +1,77 @@
+"""locklint: the host-plane concurrency lint (C rules) from the CLI.
+
+The static half of the concurrency analysis pair
+(``paddle_tpu/analysis/concurrency.py`` is the engine,
+``observability/lock_witness.py`` the runtime twin): parses the named
+files/directories as ONE unit — lock identities, the acquisition-order
+graph and the signal-handler call graph all span modules — and prints
+every C-rule Diagnostic:
+
+    python tools/locklint.py paddle_tpu/                 # the whole tree
+    python tools/locklint.py paddle_tpu/serving/         # one subsystem
+    python tools/locklint.py paddle_tpu/ --fail-on=warning
+    python tools/locklint.py paddle_tpu/ --suppress C005
+
+Exits nonzero when any finding sits at/above ``--fail-on`` (default
+"error" — what CI's ``tools/run_ci.sh conclint`` stage enforces over the
+triaged tree). Intentional patterns are silenced in place with
+``# conclint: C00x reason=...`` — the reason string is mandatory (C000)
+so the source documents every waiver.
+"""
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="locklint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="+",
+                        help="python files or package directories")
+    parser.add_argument("--fail-on", default="error",
+                        choices=("info", "warning", "error"),
+                        help="exit nonzero when any finding is at/above "
+                             "this severity (default: error)")
+    parser.add_argument("--suppress", action="append", default=[],
+                        help="rule id or name to ignore globally "
+                             "(repeatable; prefer inline "
+                             "'# conclint: ... reason=...' waivers)")
+    parser.add_argument("--rules", action="store_true",
+                        help="print the C-rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    from paddle_tpu.analysis import concurrency
+    import paddle_tpu.analysis.diagnostics as diag_mod
+
+    if args.rules:
+        for rule in sorted(concurrency.RULES):
+            slug, sev = concurrency.RULES[rule]
+            print("%s  %-8s %s" % (rule, sev, slug))
+        return 0
+
+    files = concurrency.collect_files(args.paths)
+    if not files:
+        parser.error("no .py files under: %s" % ", ".join(args.paths))
+    diags = concurrency.lint_paths(args.paths, suppress=args.suppress)
+    print(diag_mod.format_diagnostics(
+        diags, header="== locklint: %d file(s) ==" % len(files)))
+    failing = diag_mod.at_or_above(diags, args.fail_on)
+    if failing:
+        print("locklint: %d finding(s) at/above --fail-on=%s"
+              % (len(failing), args.fail_on))
+        return 1
+    print("locklint: clean at --fail-on=%s (%d file(s))"
+          % (args.fail_on, len(files)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

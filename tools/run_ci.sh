@@ -3,8 +3,9 @@
 # repo): native C++ build + its unit tests, the Python suite on the
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
-# (native|python|lint|warm|metrics|forensics|chaos|shard|serve|decode|
-# servechaos|net|trace|elastic|dryrun|bench|perfgate) to run a subset.
+# (native|python|lint|conclint|warm|metrics|forensics|chaos|shard|serve|
+# decode|servechaos|net|trace|elastic|dryrun|bench|perfgate) to run a
+# subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -13,8 +14,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-ALL_STAGES=(native python lint warm metrics forensics chaos shard serve
-            decode servechaos net trace elastic dryrun bench perfgate)
+ALL_STAGES=(native python lint conclint warm metrics forensics chaos shard
+            serve decode servechaos net trace elastic dryrun bench perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -62,6 +63,34 @@ if want lint; then
   # but only error-severity findings (bad graphs) fail the stage
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python tools/plint.py --goldens --fail-on=error
+fi
+
+if want conclint; then
+  echo "== host-plane concurrency lint + witness-armed frontend smoke =="
+  # leg 1: the C-rule lint over the framework's OWN source — lock-order
+  # cycles, locks held across blocking calls, untimed acquires reachable
+  # from signal handlers, unnamed threads (docs/ANALYSIS.md, *Host-plane
+  # concurrency*); the tree must be clean (real fix or reasoned
+  # suppression) at error severity
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/locklint.py paddle_tpu/ --fail-on=error
+  # leg 2: the runtime twin — rerun the frontend smoke with the lock
+  # witness armed (FLAGS_lock_witness=1 wraps every framework lock at
+  # construction); the warm leg asserts zero lock-order cycles, zero
+  # dispatch-spanning holds, and the same 0-fresh-compiles gate, proving
+  # the witness itself perturbs nothing
+  cldir="$(mktemp -d)"
+  trap 'rm -rf "$cldir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$cldir/cache" FLAGS_telemetry=1 \
+    FLAGS_lock_witness=1 \
+    python tools/frontend_smoke.py cold "$cldir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FLAGS_exec_cache_dir="$cldir/cache" FLAGS_telemetry=1 \
+    FLAGS_lock_witness=1 \
+    python tools/frontend_smoke.py warm "$cldir"
+  rm -rf "$cldir"
+  trap - EXIT
 fi
 
 if want warm; then
