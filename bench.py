@@ -1071,6 +1071,32 @@ def main():
     print(json.dumps(out))
     sys.stdout.flush()
 
+    # BENCH_LEDGER=<path> (or =1 for benchmark/perf_ledger.jsonl) appends
+    # this capture as one trajectory point — every measured run lands in
+    # the same append-only file tools/perf_ledger.py diff gates. Strictly
+    # best-effort AFTER the JSON line is out: the capture contract ("bench
+    # always exits 0 with one parseable line") must survive a read-only
+    # checkout or a half-broken tools/ import.
+    ledger_env = os.environ.get("BENCH_LEDGER")
+    if ledger_env and models:
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import perf_ledger
+            ledger = (perf_ledger.DEFAULT_LEDGER if ledger_env == "1"
+                      else ledger_env)
+            good = {name: m for name, m in models.items()
+                    if isinstance(m, dict) and "error" not in m}
+            if good:
+                perf_ledger.append_entry(
+                    ledger, good,
+                    label=os.environ.get("BENCH_LEDGER_LABEL"),
+                    source="bench.py")
+                sys.stderr.write("bench: appended %d model(s) to %s\n"
+                                 % (len(good), ledger))
+        except Exception as e:
+            sys.stderr.write("bench: ledger append failed (%s)\n" % e)
+
 
 if __name__ == "__main__":
     if "--worker" in sys.argv[1:]:

@@ -193,6 +193,16 @@ _DEFS = {
     # environment (FLAGS_lock_witness=1) before import, or
     # lock_witness.enable() before the subsystems under test build.
     "lock_witness": (False, bool),
+    # training-step observatory (observability/step_profiler.py):
+    # phase-attributed per-step records (input wait / feed / compile /
+    # dispatch / device / fetch) for Executor.run / run_multi_step /
+    # ParallelExecutor, with achieved-FLOP/s and achieved-MFU joined from
+    # the hlo_cost_model fused-group table, an online median+MAD step-time
+    # regression detector that names the guilty phase, and a JSONL export
+    # (<metrics_path>.stepprof.jsonl) the perf ledger ingests. Module-bool
+    # guard, same contract as FLAGS_telemetry: off = one attribute read
+    # per step, zero allocations, zero fresh-compile delta.
+    "step_profile": (False, bool),
 }
 
 

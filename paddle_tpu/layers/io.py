@@ -8,9 +8,12 @@ runs ahead while the host prepares the next batch), so decorators are
 capability-preserving wrappers instead of graph reader ops.
 """
 
+import time
+
 from paddle_tpu import framework
 from paddle_tpu.core.types import VarType
 from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.observability import step_profiler as _stepprof
 
 __all__ = ["data", "py_reader", "double_buffer", "read_file", "batch",
            "shuffle", "random_data_generator", "open_recordio_file",
@@ -266,7 +269,16 @@ class PyReader(object):
         stage is on); raises EOFException at end, or the reader thread's
         exception if one died mid-stream."""
         pq = getattr(self, "_prefetch_q", None)
-        item = pq.get() if pq is not None else self.queue.pop()
+        if _stepprof.ENABLED:
+            # consumer-side starvation, measured at the source: this
+            # blocking get/pop is the training thread waiting on the
+            # input pipeline, banked as the next step's input_wait phase
+            t0 = time.monotonic()
+            item = pq.get() if pq is not None else self.queue.pop()
+            _stepprof.note_input_wait(time.monotonic() - t0,
+                                      site="py_reader")
+        else:
+            item = pq.get() if pq is not None else self.queue.pop()
         if item is None:
             if pq is not None:
                 # keep the sentinel: a second post-EOF next_feed() must

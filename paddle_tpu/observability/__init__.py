@@ -50,6 +50,19 @@ failures:
   deaths become rule **M001** diagnostics — never retried — whose
   black-box dump names the top holders and the predicted peak.
 
+The training plane adds phase-level attribution:
+
+* ``step_profiler`` — the training-step observatory
+  (``FLAGS_step_profile``): every step becomes a phase-attributed
+  record (input wait / feed / compile / dispatch / device / fetch /
+  host residual) joined against tools/hlo_cost_model.py's fused-group
+  roofline — achieved-FLOP/s, achieved-MFU, predicted-vs-achieved and
+  an input/host/compute/bandwidth-bound verdict per step — plus an
+  online median+MAD regression detector that names the guilty phase.
+  Ring + ``<metrics_path>.stepprof.jsonl``; ``tools/step_breakdown.py
+  --steps`` is the offline view, ``tools/perf_ledger.py`` the
+  append-only trajectory.
+
 The serving plane adds request-scoped attribution:
 
 * ``tracing`` — one trace per serving request (id minted by
@@ -72,6 +85,7 @@ from paddle_tpu.observability import explain  # noqa: F401
 from paddle_tpu.observability import memory  # noqa: F401
 from paddle_tpu.observability import metrics_registry  # noqa: F401
 from paddle_tpu.observability import nan_provenance  # noqa: F401
+from paddle_tpu.observability import step_profiler  # noqa: F401
 from paddle_tpu.observability import telemetry  # noqa: F401
 from paddle_tpu.observability import tracing  # noqa: F401
 from paddle_tpu.observability import watchdog  # noqa: F401

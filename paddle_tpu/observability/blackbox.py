@@ -285,6 +285,20 @@ def snapshot(reason="on_demand", stacks=False, extra=None,
                                     if sp["t1"] is None)}
                  for t in tracing._inflight.values()],
         [], lock_timeout)
+    # training-plane forensics: the last phase-attributed step records,
+    # plus which phase each in-flight step is stuck in RIGHT NOW (the
+    # "input wait 12.3s" answer a hang dump exists to give). inflight()
+    # reads a plain dict lock-free — safe even from the signal handler.
+    from paddle_tpu.observability import step_profiler
+
+    snap["step_profile"] = _read_locked(
+        step_profiler._lock,
+        lambda: [dict(r) for r in step_profiler._records][-_TAIL:],
+        [], lock_timeout)
+    try:
+        snap["step_inflight"] = step_profiler.inflight()
+    except Exception:
+        snap["step_inflight"] = []
     try:
         # fold the live explainer log back to lint diagnostics (PR 3) so
         # the dump names the rule behind a recompile storm; skipped in

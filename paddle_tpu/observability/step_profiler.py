@@ -1,0 +1,554 @@
+"""Training-step observatory: phase-attributed step timelines.
+
+Telemetry (``observability/telemetry.py``) prices a step as one wall
+number; this module says where the time went. Every profiled step is a
+record of phase spans —
+
+* ``input_wait`` — consumer-side reader/queue starvation, measured at
+  the source (``layers/io.py`` / ``reader/decorator.py`` call
+  :func:`note_input_wait`; a thread-local accumulator hands the wait to
+  the NEXT step that thread runs, so prefetch-thread waits are never
+  mis-billed to the training thread),
+* ``feed`` — host feed conversion + host->device transfer,
+* ``compile`` — executable lookup (cache hit = microseconds; a fresh
+  XLA trace shows up here instead of silently fattening the step),
+* ``dispatch`` — the jitted call itself (argument marshalling + XLA
+  enqueue; chaos' ``exec.dispatch`` faults land inside this bracket),
+* ``device`` — block_until_ready on the fetched arrays (annotated with
+  ``jax.profiler.TraceAnnotation`` when a trace session is live, so the
+  bracket shows up in the device timeline too),
+* ``fetch`` — device->host materialization to numpy,
+* ``host`` — the residual (record bookkeeping, scope writes, python).
+
+Roofline join: once per executable the step function is re-traced (off
+the timed path) and priced by tools/hlo_cost_model.py's fused-group
+table — per-step FLOPs, HBM bytes, roofline-predicted time, memory- vs
+compute-bound verdict. Each record then carries achieved-FLOP/s,
+achieved-MFU and achieved-vs-predicted, and classifies itself
+``input`` / ``host`` / ``compute`` / ``bandwidth`` bound.
+
+On top of the stream: a bounded ring exported as
+``<metrics_path>.stepprof.jsonl`` through ``telemetry.flush()``,
+metrics-registry surfaces (phase histograms, starvation + achieved-MFU
+gauges), and an online regression detector — rolling median + MAD per
+executable; excursions and sustained drifts emit black-box flight
+events naming the guilty phase.
+
+Overhead contract (FLAGS_step_profile, telemetry's discipline): OFF is
+one module-attribute read per step — zero allocations, zero fresh
+compiles, bit-identical results. ON costs one StepSpan + a handful of
+perf_counter calls per step; the cost-model trace is one-shot per
+executable and runs after the timed region.
+"""
+
+import collections
+import threading
+import time
+
+from paddle_tpu.observability import lock_witness
+from paddle_tpu.observability.metrics_registry import REGISTRY
+
+__all__ = [
+    "ENABLED", "enable", "reset", "begin", "finish", "records",
+    "inflight", "note_input_wait", "note_queue_wait", "cost_table",
+    "write_stepprof_jsonl", "StepSpan", "PHASES", "RING_CAP",
+    "device_annotation",
+]
+
+ENABLED = False
+
+RING_CAP = 2048
+
+# phase vocabulary — the record's "phases" dict only carries nonzero
+# entries, but consumers (step_breakdown, perf_ledger) treat this tuple
+# as the full axis
+PHASES = ("input_wait", "feed", "compile", "dispatch", "device", "fetch",
+          "host")
+
+# regression detector: rolling per-executable baseline
+_REG_WINDOW = 64     # samples in the rolling median/MAD window
+_REG_MIN = 8         # baseline size before the detector speaks
+_REG_K = 5.0         # MAD multiplier (5 sigma-equivalents) for excursions
+_REG_REL_FLOOR = 0.25  # minimum relative excess — sub-ms steps are noisy
+_DRIFT_N = 5         # consecutive excursions = sustained drift, rebase
+
+_lock = lock_witness.make_lock("observability.step_profiler")
+_records = collections.deque(maxlen=RING_CAP)
+_cost = {}           # fingerprint -> per-step cost join (None = tried, failed)
+_reg = {}            # fingerprint/origin -> regression baseline state
+_tls = threading.local()   # .input_wait: seconds banked for the next step
+# thread ident -> (origin, phase, t_phase, t_step): the in-flight step's
+# current bracket, read lock-free by watchdog/blackbox (single-key dict
+# ops are atomic under the GIL; a racy read is fine for forensics)
+_inflight = {}
+
+# same bucket ladder as telemetry's step histogram: phases span the same
+# 100us..100s range a step does
+_PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+                  50.0, 100.0)
+
+_phase_seconds = REGISTRY.histogram(
+    "paddle_tpu_step_phase_seconds",
+    "per-step wall seconds attributed to each phase", labels=("phase",),
+    buckets=_PHASE_BUCKETS)
+_achieved_mfu = REGISTRY.gauge(
+    "paddle_tpu_step_achieved_mfu",
+    "achieved MFU of the last profiled step (cost-model FLOPs / wall / "
+    "peak)")
+_starvation = REGISTRY.gauge(
+    "paddle_tpu_step_starvation_fraction",
+    "input-wait fraction of the last profiled step's wall")
+_regressions = REGISTRY.counter(
+    "paddle_tpu_step_regressions_total",
+    "step-time excursions/drifts flagged by the online detector",
+    labels=("kind", "phase"))
+_reader_wait = REGISTRY.counter(
+    "paddle_tpu_reader_wait_seconds_total",
+    "consumer-side seconds blocked waiting on reader queues",
+    labels=("site",))
+_queue_depth = REGISTRY.gauge(
+    "paddle_tpu_reader_queue_depth",
+    "items in the reader blocking queue after the last pop")
+
+
+def enable(on=True):
+    """Flip the observatory at runtime (tests, notebooks);
+    ``FLAGS_step_profile`` only sets the import-time default."""
+    global ENABLED
+    ENABLED = bool(on)
+    return ENABLED
+
+
+def reset():
+    """Drop the ring, the cost join and the regression baselines (test
+    isolation; the executors re-join costs one-shot per executable, so a
+    reset mid-run only re-prices on the next new executable)."""
+    with _lock:
+        _records.clear()
+        _cost.clear()
+        _reg.clear()
+    _inflight.clear()
+    _tls.input_wait = 0.0
+
+
+# -- reader-side starvation accounting ---------------------------------------
+
+def note_input_wait(seconds, site="py_reader"):
+    """Bank consumer-side reader wait against the CALLING thread's next
+    step. Called by layers/io.py / reader/decorator.py under the
+    ENABLED guard; monotonic durations, measured outside any lock."""
+    _reader_wait.inc(seconds, site=site)
+    _tls.input_wait = getattr(_tls, "input_wait", 0.0) + seconds
+
+
+def note_queue_wait(seconds, depth, site="reader.queue"):
+    """Queue-level pop accounting (BlockingQueue/NativeTensorQueue):
+    wait seconds per site plus the post-pop depth gauge. NOT banked
+    against a step — prefetch threads pop on their own clock; the
+    per-step claim happens at the consumer (:func:`note_input_wait`)."""
+    _reader_wait.inc(seconds, site=site)
+    _queue_depth.set(depth)
+
+
+# -- the per-step span -------------------------------------------------------
+
+class StepSpan(object):
+    """One step's open record. Executors hold one of these across the
+    step and bracket each phase with enter()/exit(); ``finish`` closes
+    it into the ring. Plain slots — the ON-path per-step cost is this
+    object plus a small dict."""
+
+    __slots__ = ("origin", "t0", "phases", "input_wait", "fingerprint",
+                 "_cur", "_t_cur", "_cost_cp", "_cost_avals")
+
+    def __init__(self, origin):
+        self.origin = origin
+        self.t0 = time.perf_counter()
+        self.phases = {}
+        self.input_wait = 0.0
+        self.fingerprint = None
+        self._cur = None
+        self._t_cur = 0.0
+        self._cost_cp = None
+        self._cost_avals = None
+
+    def enter(self, phase):
+        now = time.perf_counter()
+        self._cur = phase
+        self._t_cur = now
+        _inflight[threading.get_ident()] = (self.origin, phase, now,
+                                            self.t0)
+
+    def exit(self):
+        now = time.perf_counter()
+        cur = self._cur
+        if cur is not None:
+            self.phases[cur] = self.phases.get(cur, 0.0) + (now - self._t_cur)
+            self._cur = None
+            _inflight[threading.get_ident()] = (self.origin, "host", now,
+                                                self.t0)
+
+    def pre_dispatch(self, cp, state, feeds, key, program=None):
+        """Stamp the executable fingerprint and — one-shot per
+        executable — snapshot avals for the deferred cost-model join.
+        Must run BEFORE dispatch: the step call donates the mutable
+        state buffers, after which their shapes are gone."""
+        from paddle_tpu.observability import telemetry as _telemetry
+
+        self.fingerprint = _telemetry.executable_fingerprint(cp, program)
+        if getattr(cp, "_stepprof_cost_done", False):
+            return
+        cp._stepprof_cost_done = True
+        try:
+            import jax
+
+            aval = jax.ShapeDtypeStruct
+            self._cost_avals = (
+                {n: aval(state[n].shape, state[n].dtype)
+                 for n in cp.mutable_state},
+                {n: aval(state[n].shape, state[n].dtype)
+                 for n in cp.frozen_state},
+                {n: aval(v.shape, v.dtype) for n, v in feeds.items()},
+                aval(key.shape, key.dtype),
+            )
+            self._cost_cp = cp
+        except Exception:
+            self._cost_avals = None
+
+
+def begin(origin):
+    """Open a span for one step and claim the calling thread's banked
+    input wait. Executors call this as
+    ``sp = _stepprof.begin(...) if _stepprof.ENABLED else None`` — the
+    OFF path is the one attribute read."""
+    sp = StepSpan(origin)
+    banked = getattr(_tls, "input_wait", 0.0)
+    if banked:
+        sp.input_wait = banked
+        _tls.input_wait = 0.0
+    return sp
+
+
+class _NullAnnotation(object):
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_ANNOTATION = _NullAnnotation()
+
+
+def device_annotation(name="paddle_tpu.step.device"):
+    """The device-phase bracket's trace annotation: a real
+    ``jax.profiler.TraceAnnotation`` when profiler.start_profiler opened
+    a trace session (so the bracket lands in the device timeline), else
+    a shared no-op context."""
+    try:
+        from paddle_tpu import profiler as _profiler
+
+        if _profiler._state.get("jax_trace_dir"):
+            import jax
+
+            return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        pass
+    return _NULL_ANNOTATION
+
+
+# -- cost-model join ---------------------------------------------------------
+
+def _join_cost(sp, steps):
+    """Price the executable with the hlo_cost_model fused-group table
+    (one-shot per fingerprint; runs in ``finish``, off the timed path).
+    Stores PER-STEP numbers — multi-step scans divide by the scan
+    length so a 32-step dispatch prices like 32 single steps."""
+    fp = sp.fingerprint
+    cp, avals = sp._cost_cp, sp._cost_avals
+    sp._cost_cp = sp._cost_avals = None
+    if not fp or fp in _cost or cp is None or avals is None:
+        return
+    entry = None
+    try:
+        import jax
+
+        from paddle_tpu.observability import _cost_model
+
+        mod = _cost_model.load()
+        closed = jax.make_jaxpr(cp.jitted)(*avals)
+        jaxpr = closed.jaxpr
+        while (len(jaxpr.eqns) == 1
+               and jaxpr.eqns[0].primitive.name in ("pjit", "jit")):
+            inner = jaxpr.eqns[0].params.get("jaxpr")
+            if inner is None:
+                break
+            jaxpr = getattr(inner, "jaxpr", inner)
+        opt = mod.optimize_jaxpr(jaxpr)
+        groups = mod.analyze(opt)
+        flops = float(sum(g.flops for g in groups))
+        hbm = float(sum(g.bytes_total() for g in groups))
+        k = float(max(1, steps))
+        # roofline-predicted step time at nameplate peaks: each fused
+        # group pays max(compute, HBM) — the cost model's pricing rule
+        roof = sum(max(g.flops / mod.PEAK_FLOPS,
+                       g.bytes_total() / mod.HBM_BW) for g in groups)
+        roof_obs = sum(max(g.flops / mod.OBSERVED_PEAK_FLOPS,
+                           g.bytes_total() / mod.HBM_BW) for g in groups)
+        entry = {
+            "flops": flops / k,
+            "hbm_bytes": hbm / k,
+            "roofline_s": roof / k,
+            "roofline_observed_s": roof_obs / k,
+            "groups": len(groups),
+            "bound": ("hbm" if hbm / mod.HBM_BW > flops / mod.PEAK_FLOPS
+                      else "mxu"),
+            "nameplate_peak_flops": float(mod.PEAK_FLOPS),
+        }
+    except Exception:
+        entry = None
+    with _lock:
+        _cost.setdefault(fp, entry)
+
+
+def cost_table():
+    """The per-executable cost join (tests, step_breakdown)."""
+    with _lock:
+        return {k: (dict(v) if v else None) for k, v in _cost.items()}
+
+
+# -- regression detector -----------------------------------------------------
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _detect_regression(key, step_s, per_step_phases):
+    """Rolling median+MAD excursion/drift detector. Called under _lock.
+    Healthy steps extend the baseline; excursions do not (one slow step
+    must not drag the median up), but _DRIFT_N consecutive excursions
+    are accepted as a new regime: one 'drift' event, then rebase."""
+    st = _reg.get(key)
+    if st is None:
+        st = {"window": collections.deque(maxlen=_REG_WINDOW),
+              "phases": {}, "streak": 0}
+        _reg[key] = st
+    window = st["window"]
+    verdict = None
+    if len(window) >= _REG_MIN:
+        med = _median(window)
+        mad = _median([abs(x - med) for x in window])
+        thresh = med + max(_REG_K * 1.4826 * mad, _REG_REL_FLOOR * med)
+        if step_s > thresh:
+            # the guilty phase: largest absolute excess over its own
+            # rolling median
+            guilty, excess, guilty_s, guilty_med = "host", 0.0, 0.0, 0.0
+            for ph, cur in per_step_phases.items():
+                base = st["phases"].get(ph)
+                pmed = _median(base) if base else 0.0
+                if cur - pmed > excess:
+                    guilty, excess = ph, cur - pmed
+                    guilty_s, guilty_med = cur, pmed
+            st["streak"] += 1
+            kind = "drift" if st["streak"] >= _DRIFT_N else "excursion"
+            verdict = {
+                "kind": kind, "phase": guilty,
+                "step_s": step_s, "median_s": med, "threshold_s": thresh,
+                "phase_s": guilty_s, "phase_median_s": guilty_med,
+            }
+            if kind == "drift":
+                # sustained: accept the new regime so the detector does
+                # not alarm on every step forever
+                window.clear()
+                st["phases"].clear()
+                st["streak"] = 0
+                window.append(step_s)
+            return verdict
+    st["streak"] = 0
+    window.append(step_s)
+    for ph, cur in per_step_phases.items():
+        dq = st["phases"].get(ph)
+        if dq is None:
+            dq = st["phases"][ph] = collections.deque(maxlen=_REG_WINDOW)
+        dq.append(cur)
+    return verdict
+
+
+# -- closing a span ----------------------------------------------------------
+
+def finish(sp, steps=1, feeds=None, fetches=None, dispatch_only=False):
+    """Close a span into a phase-attributed record: residual-host
+    accounting, the cost-model join, achieved-MFU, boundedness verdict,
+    regression detection, ring append + metric writes. Runs entirely
+    after the step's timed region — ``feeds``/``fetches`` are passed as
+    containers (not pre-summed byte counts) so the wall clock stops on
+    the FIRST line here, before any accounting arithmetic."""
+    now = time.perf_counter()
+    if sp._cur is not None:
+        sp.exit()
+    _inflight.pop(threading.get_ident(), None)
+    steps = max(1, int(steps))
+    wall = now - sp.t0
+    feed_bytes = (sum(getattr(a, "nbytes", 0) for a in feeds.values())
+                  if feeds else 0)
+    fetch_bytes = (sum(getattr(f, "nbytes", 0) for f in fetches)
+                   if fetches else 0)
+    measured = sum(sp.phases.values())
+    host = max(0.0, wall - measured)
+    step_wall = wall + sp.input_wait
+    phases = dict(sp.phases)
+    phases["host"] = host
+    if sp.input_wait:
+        phases["input_wait"] = sp.input_wait
+    # coverage: every explicitly measured second (brackets + source-side
+    # input wait) over the step's full wall — the ≥0.95 CI gate
+    coverage = ((measured + sp.input_wait) / step_wall
+                if step_wall > 0 else 1.0)
+    starvation = sp.input_wait / step_wall if step_wall > 0 else 0.0
+    step_s = step_wall / steps
+
+    _join_cost(sp, steps)
+    cost = _cost.get(sp.fingerprint) if sp.fingerprint else None
+
+    rec = {
+        "ts": time.time(),
+        "origin": sp.origin,
+        "fingerprint": sp.fingerprint,
+        "steps": steps,
+        "wall_s": wall,
+        "step_s": step_s,
+        "phases": {p: v for p, v in phases.items() if v > 0.0},
+        "coverage": coverage,
+        "starvation_fraction": starvation,
+        "feed_bytes": int(feed_bytes),
+        "fetch_bytes": int(fetch_bytes),
+    }
+    if dispatch_only:
+        # async handles: the span measures host dispatch latency, not a
+        # step — excluded from MFU, starvation and the detector
+        rec["dispatch_only"] = True
+    achieved_mfu = None
+    if cost and step_s > 0 and not dispatch_only:
+        from paddle_tpu.observability import telemetry as _telemetry
+
+        achieved = cost["flops"] / step_s
+        # peak: flag override, then the chip table; on hardware the
+        # table misses (CPU proxy runs) fall back to the cost model's
+        # nameplate so MFU stays finite and comparable run-to-run
+        peak = _telemetry.peak_flops() or cost["nameplate_peak_flops"]
+        rec["flops_per_step"] = cost["flops"]
+        rec["hbm_bytes_per_step"] = cost["hbm_bytes"]
+        rec["achieved_flops_per_sec"] = achieved
+        rec["achieved_mfu"] = achieved_mfu = achieved / peak
+        rec["roofline_s"] = cost["roofline_s"]
+        rec["predicted_ratio"] = (step_s / cost["roofline_s"]
+                                  if cost["roofline_s"] > 0 else None)
+    rec["bound"] = _classify(phases, sp.input_wait, cost)
+
+    verdict = None
+    if not dispatch_only:
+        per_step_phases = {p: v / steps for p, v in phases.items()}
+        with _lock:
+            verdict = _detect_regression(sp.fingerprint or sp.origin,
+                                         step_s, per_step_phases)
+            if verdict:
+                rec["regression"] = dict(verdict)
+            _records.append(rec)
+    else:
+        with _lock:
+            _records.append(rec)
+
+    # metric writes outside the ring lock (each metric has its own)
+    for p, v in rec["phases"].items():
+        _phase_seconds.observe(v / steps, phase=p)
+    if not dispatch_only:
+        _starvation.set(starvation)
+        if achieved_mfu is not None:
+            _achieved_mfu.set(achieved_mfu)
+    if verdict:
+        _regressions.inc(1, kind=verdict["kind"], phase=verdict["phase"])
+        from paddle_tpu.observability import blackbox as _blackbox
+
+        # direct record() — regressions are rare and exactly what the
+        # flight recorder exists for, so they land even when blackbox's
+        # exception hooks are not armed. The verdict's own "kind"
+        # (spike/drift) must not collide with record()'s event kind.
+        fields = dict(verdict)
+        fields["regression"] = fields.pop("kind")
+        _blackbox.record(
+            "step_regression", origin=sp.origin,
+            fingerprint=(sp.fingerprint or "")[:16], **fields)
+    return rec
+
+
+def _classify(phases, input_wait, cost):
+    """The step's boundedness verdict: ``input`` when starvation
+    dominates, ``host`` when host-side phases outweigh device time,
+    else the cost model's compute/bandwidth call (``device`` when the
+    executable was never priced)."""
+    device_s = phases.get("device", 0.0)
+    host_s = sum(v for p, v in phases.items()
+                 if p not in ("device", "input_wait"))
+    if input_wait >= max(device_s, host_s) and input_wait > 0:
+        return "input"
+    if host_s > device_s:
+        return "host"
+    if cost:
+        return "compute" if cost["bound"] == "mxu" else "bandwidth"
+    return "device"
+
+
+# -- introspection + export --------------------------------------------------
+
+def records():
+    """Snapshot of the ring (oldest first)."""
+    with _lock:
+        return [dict(r) for r in _records]
+
+
+def inflight():
+    """The current in-flight step bracket per thread — the watchdog's
+    'which phase is stalled' answer. Lock-free reads of the _inflight
+    dict: safe from signal handlers and the watchdog thread."""
+    now = time.perf_counter()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, ent in list(_inflight.items()):
+        origin, phase, t_phase, t_step = ent
+        out.append({
+            "thread": names.get(tid, str(tid)),
+            "origin": origin,
+            "phase": phase,
+            "phase_age_s": round(now - t_phase, 3),
+            "step_age_s": round(now - t_step, 3),
+        })
+    return out
+
+
+def write_stepprof_jsonl(path, mode="w"):
+    """One JSON line per profiled step — the file
+    tools/step_breakdown.py --steps and tools/perf_ledger.py consume.
+    telemetry.flush() writes it as ``<metrics_path>.stepprof.jsonl``."""
+    import json
+
+    recs = records()
+    with open(path, mode) as f:
+        for r in recs:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+    return len(recs)
+
+
+def _init_from_flags():
+    from paddle_tpu import flags
+
+    try:
+        enable(flags.get("step_profile"))
+    except KeyError:  # pragma: no cover - flag table always has it
+        pass
+
+
+_init_from_flags()

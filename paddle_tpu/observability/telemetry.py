@@ -558,6 +558,11 @@ def flush(metrics_path=None):
 
     if tracing.completed():
         tracing.write_traces_jsonl(metrics_path + ".traces.jsonl")
+    from paddle_tpu.observability import step_profiler
+
+    if step_profiler.records():
+        step_profiler.write_stepprof_jsonl(
+            metrics_path + ".stepprof.jsonl")
     return metrics_path
 
 

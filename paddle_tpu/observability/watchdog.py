@@ -197,6 +197,17 @@ def _fire(stalled, waited, timeout):
             for a in stalled
         ],
     }
+    try:
+        # name the stalled PHASE, not just the thread: when the step
+        # observatory is on, each in-flight step's current bracket says
+        # whether the hang is input wait, dispatch, device compute, ...
+        from paddle_tpu.observability import step_profiler
+
+        phases = step_profiler.inflight() if step_profiler.ENABLED else []
+    except Exception:
+        phases = []
+    if phases:
+        report["stalled_phases"] = phases
     with _lock:
         _state["last_hang"] = report
         on_hang = _state["on_hang"]
@@ -213,10 +224,16 @@ def _fire(stalled, waited, timeout):
     report["dump_path"] = dump_path
     import logging
 
+    phase_note = ""
+    if report.get("stalled_phases"):
+        phase_note = "; phase: " + ", ".join(
+            "%s %.1fs" % (p["phase"], p["phase_age_s"])
+            for p in report["stalled_phases"])
     logging.getLogger("paddle_tpu.observability.watchdog").error(
-        "watchdog: no progress for %.1fs (timeout %.1fs); stalled: %s; "
+        "watchdog: no progress for %.1fs (timeout %.1fs); stalled: %s%s; "
         "black box: %s", waited, timeout,
-        ", ".join(s["tag"] for s in report["stalled"]), dump_path)
+        ", ".join(s["tag"] for s in report["stalled"]), phase_note,
+        dump_path)
     for cb in [on_hang] + extra_cbs:
         if cb is None:
             continue

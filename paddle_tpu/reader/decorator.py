@@ -8,7 +8,10 @@ zero-arg callables returning iterables of samples; combinators compose them
 import itertools
 import random
 import threading
+import time
 from queue import Queue
+
+from paddle_tpu.observability import step_profiler as _stepprof
 
 __all__ = [
     "map_readers",
@@ -24,6 +27,18 @@ __all__ = [
     "bucket_by_length",
     "Fake",
 ]
+
+
+def _timed_get(q, site):
+    """Consumer-side Queue.get with starvation accounting: when the
+    observatory is on, the blocking wait is banked against the calling
+    thread's next step (monotonic clock, measured outside any lock)."""
+    if _stepprof.ENABLED:
+        t0 = time.monotonic()
+        item = q.get()
+        _stepprof.note_input_wait(time.monotonic() - t0, site=site)
+        return item
+    return q.get()
 
 
 def map_readers(func, *readers):
@@ -102,7 +117,7 @@ def buffered(reader, size):
                              name="paddle-tpu-reader-buffered")
         t.start()
         while True:
-            e = q.get()
+            e = _timed_get(q, "buffered")
             if e is _End:
                 break
             yield e
@@ -155,7 +170,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         if order:
             buf, next_i = {}, 0
             while finished < process_num:
-                item = out_q.get()
+                item = _timed_get(out_q, "xmap")
                 if item is end_token:
                     finished += 1
                     continue
@@ -169,7 +184,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 next_i += 1
         else:
             while finished < process_num:
-                item = out_q.get()
+                item = _timed_get(out_q, "xmap")
                 if item is end_token:
                     finished += 1
                 else:
@@ -197,7 +212,7 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                              name="paddle-tpu-reader-fanin-%d" % i).start()
         finished = 0
         while finished < len(readers):
-            item = q.get()
+            item = _timed_get(q, "multiprocess")
             if item is end:
                 finished += 1
             else:

@@ -5,8 +5,10 @@ Reference parity: paddle/fluid/operators/reader/lod_tensor_blocking_queue.h
 semantics match (close = graceful EOF, kill = abort)."""
 
 import threading
+import time
 
 from paddle_tpu.observability import lock_witness
+from paddle_tpu.observability import step_profiler as _stepprof
 from collections import deque
 
 
@@ -39,14 +41,26 @@ class BlockingQueue(object):
 
     def pop(self, timeout=None):
         """Returns an item, or None on EOF."""
+        # starvation accounting (observatory satellite): the whole pop is
+        # timed with a monotonic clock and recorded AFTER the lock is
+        # released — the wait must never extend the hold the lock witness
+        # sees. Depth is read under the lock we already hold.
+        t0 = time.monotonic() if _stepprof.ENABLED else 0.0
+        item = None
+        depth = 0
         with self._not_empty:
-            while not self._q:
+            while True:
+                if self._q:
+                    item = self._q.popleft()
+                    depth = len(self._q)
+                    self._not_full.notify()
+                    break
                 if self._closed or self._killed:
-                    return None
+                    break
                 self._not_empty.wait(timeout=0.1)
-            item = self._q.popleft()
-            self._not_full.notify()
-            return item
+        if t0:
+            _stepprof.note_queue_wait(time.monotonic() - t0, depth)
+        return item
 
     def close(self):
         with self._mutex:
@@ -121,10 +135,17 @@ class NativeTensorQueue(object):
 
     def pop(self, timeout=None):
         timeout_ms = -1 if timeout is None else int(timeout * 1000)
+        t0 = time.monotonic() if _stepprof.ENABLED else 0.0
         try:
             blob = self._q.pop(timeout_ms=timeout_ms)
         except TimeoutError:
             return None
+        finally:
+            if t0:
+                # same starvation series as BlockingQueue.pop — the wait
+                # happened in C++, the depth read is a native call
+                _stepprof.note_queue_wait(time.monotonic() - t0,
+                                          self._q.size())
         if blob is None:
             return None
         return self._decode(blob)

@@ -1,0 +1,291 @@
+"""Training-step observatory: the observe-don't-perturb contract
+(OFF = silent, ON = bit-identical + zero fresh compiles), phase
+coverage, the roofline/MFU join, starvation banking, the regression
+detector naming the guilty phase, the bounded ring, and the
+perf-ledger round trip."""
+
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import unique_name
+from paddle_tpu.core import exec_cache
+from paddle_tpu.observability import step_profiler, telemetry
+from paddle_tpu.resilience import chaos
+
+
+@pytest.fixture(autouse=True)
+def _quiet_profiler():
+    """Profiler off + empty ring around every test; the process-global
+    executable registry is purged so a structurally identical program
+    from an earlier test can't hide a fresh compile from this one."""
+    import paddle_tpu.executor as executor_mod
+
+    executor_mod._shared_executables.clear()
+    telemetry.enable(False)
+    step_profiler.enable(False)
+    step_profiler.reset()
+    chaos.disable()
+    yield
+    step_profiler.enable(False)
+    step_profiler.reset()
+    chaos.disable()
+
+
+def _build_mlp():
+    unique_name.switch({})
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6])
+        hid = fluid.layers.fc(x, size=8, act="relu")
+        loss = fluid.layers.mean(fluid.layers.fc(hid, size=2))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(bs=3):
+    return {"x": np.arange(bs * 6, dtype="float32").reshape(bs, 6) / 10.0}
+
+
+def _leg(exe, main, startup, loss, singles=2, multi=8):
+    """One schedule on a SHARED Executor with the run counter rewound:
+    the step PRNG key folds the counter in, so identical counters replay
+    identical init and step keys — legs compare executable for
+    executable (the stepprof_smoke.py discipline, sized for pytest)."""
+    exe._run_counter = 0
+    exe.run(startup)
+    out = []
+    for _ in range(singles):
+        out.append(exe.run(main, feed=_feed(), fetch_list=[loss])[0])
+    out.append(
+        exe.run_multi_step(main, multi, feed=_feed(), fetch_list=[loss])[0])
+    return out
+
+
+# -- the overhead contract ---------------------------------------------------
+
+def test_off_is_silent_on_is_bit_identical_with_zero_fresh_compiles():
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    _leg(exe, main, startup, loss)  # discarded: stabilizes scope-name keys
+
+    off = _leg(exe, main, startup, loss)
+    assert step_profiler.records() == []
+    assert step_profiler.inflight() == []
+    compiles_off = exec_cache.stats()["fresh_compiles"]
+
+    step_profiler.enable(True)
+    step_profiler.reset()
+    try:
+        on = _leg(exe, main, startup, loss)
+    finally:
+        step_profiler.enable(False)
+    # the flag is deliberately NOT in core/fingerprint.TRACE_FLAGS:
+    # flipping it can never bust a cache key
+    assert exec_cache.stats()["fresh_compiles"] == compiles_off
+    for a, b in zip(off, on):
+        np.testing.assert_array_equal(a, b)
+    assert step_profiler.records(), "profiled leg left no step records"
+    assert step_profiler.inflight() == []
+
+
+# -- coverage + the roofline join --------------------------------------------
+
+def test_multi_step_record_covers_wall_and_joins_mfu():
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    step_profiler.enable(True)
+    try:
+        exe.run(startup)
+        exe.run_multi_step(main, 32, feed=_feed(), fetch_list=[loss])
+    finally:
+        step_profiler.enable(False)
+    recs = [r for r in step_profiler.records()
+            if not r.get("dispatch_only") and r["steps"] == 32]
+    assert len(recs) == 1
+    r = recs[0]
+    assert set(r["phases"]) <= set(step_profiler.PHASES)
+    assert r["phases"].get("dispatch", 0.0) > 0.0
+    assert r["phases"].get("device", 0.0) > 0.0
+    assert r["coverage"] >= 0.95, r
+    assert r["step_s"] == pytest.approx(r["wall_s"] / 32)
+    assert r["feed_bytes"] == _feed()["x"].nbytes
+    assert r["fetch_bytes"] > 0
+    # the one-shot cost join priced this executable: per-step FLOPs,
+    # achieved-FLOP/s, achieved-MFU, all finite and positive
+    assert r["flops_per_step"] > 0
+    assert r["achieved_flops_per_sec"] > 0
+    assert math.isfinite(r["achieved_mfu"]) and r["achieved_mfu"] > 0
+    assert r["bound"] in ("compute", "bandwidth", "input", "host", "device")
+    assert r["fingerprint"] in step_profiler.cost_table()
+
+
+def test_cost_join_is_one_shot_per_executable():
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    step_profiler.enable(True)
+    try:
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+    finally:
+        step_profiler.enable(False)
+    table = step_profiler.cost_table()
+    # recs[0] is the startup run (its own executable); the three train
+    # steps share ONE fingerprint and price identically off the single
+    # join
+    train = [r for r in step_profiler.records()
+             if not r.get("dispatch_only")][1:]
+    assert len(train) == 3
+    fps = {r["fingerprint"] for r in train}
+    assert len(fps) == 1 and fps <= set(table)
+    assert len({r["flops_per_step"] for r in train}) == 1
+    assert all(r["flops_per_step"] > 0 for r in train)
+
+
+# -- starvation banking ------------------------------------------------------
+
+def test_input_wait_banked_to_the_calling_threads_next_step():
+    step_profiler.enable(True)
+    try:
+        step_profiler.note_input_wait(0.05, site="test")
+        sp = step_profiler.begin("t")
+        assert sp.input_wait == pytest.approx(0.05)
+        rec = step_profiler.finish(sp)
+        assert rec["phases"]["input_wait"] == pytest.approx(0.05)
+        assert rec["starvation_fraction"] > 0.0
+        assert rec["bound"] == "input"
+        # claimed exactly once: the next step starts clean
+        assert step_profiler.begin("t").input_wait == 0.0
+    finally:
+        step_profiler.enable(False)
+
+
+# -- the regression detector -------------------------------------------------
+
+def test_detector_names_dispatch_on_injected_stall():
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    step_profiler.enable(True)
+    try:
+        exe.run(startup)
+        # baseline: enough identical steps for the rolling median+MAD
+        # window to open (the detector is silent below _REG_MIN samples)
+        for _ in range(step_profiler._REG_MIN + 2):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert not any(r.get("regression")
+                       for r in step_profiler.records())
+        # one injected 0.25s stall INSIDE the dispatch bracket
+        chaos.configure("slow@site=exec.dispatch,n=1,secs=0.25")
+        rec = None
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+        rec = [r for r in step_profiler.records()
+               if r.get("regression")][-1]
+    finally:
+        chaos.disable()
+        step_profiler.enable(False)
+    v = rec["regression"]
+    assert v["kind"] == "excursion"
+    assert v["phase"] == "dispatch", v
+    assert v["step_s"] > v["threshold_s"] > v["median_s"]
+    assert v["phase_s"] > 0.2
+
+
+def test_detector_rebases_after_sustained_drift():
+    key = "drift-test"
+    for _ in range(step_profiler._REG_MIN):
+        with step_profiler._lock:
+            step_profiler._detect_regression(key, 0.001, {"host": 0.001})
+    kinds = []
+    for _ in range(step_profiler._DRIFT_N + 1):
+        with step_profiler._lock:
+            v = step_profiler._detect_regression(key, 0.01, {"host": 0.01})
+        kinds.append(v["kind"] if v else None)
+    # excursions until the streak matures, ONE drift, then the rebased
+    # baseline accepts the new regime (the +1th sample is healthy)
+    assert kinds[:step_profiler._DRIFT_N - 1] == \
+        ["excursion"] * (step_profiler._DRIFT_N - 1)
+    assert kinds[step_profiler._DRIFT_N - 1] == "drift"
+    assert kinds[step_profiler._DRIFT_N] is None
+
+
+# -- the ring ----------------------------------------------------------------
+
+def test_ring_is_bounded_and_snapshots_oldest_first():
+    step_profiler.enable(True)
+    try:
+        for i in range(step_profiler.RING_CAP + 57):
+            sp = step_profiler.begin("ring-%d" % i)
+            step_profiler.finish(sp)
+    finally:
+        step_profiler.enable(False)
+    recs = step_profiler.records()
+    assert len(recs) == step_profiler.RING_CAP
+    assert recs[0]["origin"] == "ring-57"
+    assert recs[-1]["origin"] == "ring-%d" % (step_profiler.RING_CAP + 56)
+
+
+def test_inflight_exposes_open_bracket_and_clears_on_finish():
+    sp = step_profiler.begin("watchdog-target")
+    sp.enter("dispatch")
+    snap = step_profiler.inflight()
+    assert len(snap) == 1
+    assert snap[0]["origin"] == "watchdog-target"
+    assert snap[0]["phase"] == "dispatch"
+    step_profiler.finish(sp)
+    assert step_profiler.inflight() == []
+
+
+# -- the ledger round trip ---------------------------------------------------
+
+def test_jsonl_flush_and_perf_ledger_round_trip(tmp_path):
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    step_profiler.enable(True)
+    try:
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+    finally:
+        step_profiler.enable(False)
+    jsonl = tmp_path / "t.stepprof.jsonl"
+    n = step_profiler.write_stepprof_jsonl(str(jsonl))
+    assert n == len(step_profiler.records())
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert len(lines) == n
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import perf_ledger
+
+    entry = perf_ledger.summarize_stepprof(lines)
+    assert entry["records"] == 4  # startup + 3 train steps
+    assert entry["phase_coverage"] >= 0.9
+    assert entry["step_ms"]["p50"] > 0
+    assert entry["regressions"] == 0
+    assert math.isfinite(entry["achieved_mfu"])
+
+    ledger = tmp_path / "ledger.jsonl"
+    for label in ("a", "b"):
+        perf_ledger.append_entry(str(ledger), {"stepprof": entry},
+                                 label=label)
+    assert len(perf_ledger.read_ledger(str(ledger))) == 2
+    # identical trajectory points must gate clean (cmd_diff raises
+    # SystemExit(1) on regression, returns on clean)
+    perf_ledger.main(["diff", "--ledger", str(ledger)])
+
+    # a slowed newest entry must FAIL the relative gate
+    worse = dict(entry, step_ms={"p50": entry["step_ms"]["p50"] * 10,
+                                 "p95": entry["step_ms"]["p95"] * 10})
+    perf_ledger.append_entry(str(ledger), {"stepprof": worse}, label="c")
+    with pytest.raises(SystemExit) as ex:
+        perf_ledger.main(["diff", "--ledger", str(ledger)])
+    assert ex.value.code == 1

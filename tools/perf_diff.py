@@ -93,6 +93,15 @@ METRICS = {
     # coverage of the CLIENT-observed wall over real sockets — a drop
     # means some serving phase stopped being attributed
     "span_coverage": ("higher", "timing"),
+    # step observatory (tools/stepprof_smoke.py + the perf ledger):
+    # worst per-step phase coverage of the step wall (a drop means a
+    # training phase stopped being attributed), achieved-MFU from the
+    # cost-model join, input-starvation fraction, and the profiled-leg
+    # wall over the off-leg control (the overhead contract)
+    "phase_coverage": ("higher", "timing"),
+    "achieved_mfu": ("higher", "timing"),
+    "starvation_fraction": ("lower", "timing"),
+    "stepprof_overhead": ("lower", "timing"),
 }
 
 
@@ -124,6 +133,10 @@ def _bench_model_metrics(m):
     out["snapshot_seconds"] = m.get("snapshot_seconds")
     out["ttft_ms"] = m.get("ttft_ms")
     out["span_coverage"] = m.get("span_coverage")
+    out["phase_coverage"] = m.get("phase_coverage")
+    out["achieved_mfu"] = m.get("achieved_mfu")
+    out["starvation_fraction"] = m.get("starvation_fraction")
+    out["stepprof_overhead"] = m.get("stepprof_overhead")
     ec = m.get("exec_cache") or {}
     out["fresh_compiles"] = ec.get("fresh_compiles",
                                    m.get("fresh_compiles"))

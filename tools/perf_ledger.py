@@ -1,0 +1,273 @@
+"""Append-only perf trajectory: one ledger, every measured run.
+
+Before this tool the repo's performance story lived in scattered
+artifacts — ``BENCH_*.json`` one-shots, step-profile JSONLs a training
+run left behind — and comparing two PRs meant hunting both files down.
+The ledger subsumes that: every capture is APPENDED as one JSON line
+
+    {"record": "ledger", "ts": ..., "label": ..., "git": ...,
+     "models": {<model>: {<bench/stepprof capture fields>}, ...}}
+
+which is exactly the ``{"models": ...}`` shape ``tools/perf_diff.py``
+already parses (later lines win per model), so the whole trajectory file
+IS a valid perf_diff artifact: gate the newest entry against the
+checked-in budgets, or diff it against the previous entry, with the same
+deterministic-vs-banded discipline the perfgate uses. Every item-1
+kernel PR lands with a measured before/after by appending to the same
+file.
+
+Sources:
+
+* ``--stepprof <p>.stepprof.jsonl`` — a step-observatory snapshot
+  (FLAGS_step_profile=1); folded to one ``stepprof`` model entry
+  (step-time percentiles, worst phase coverage, achieved-MFU p50,
+  starvation fraction, regression count).
+* ``--bench BENCH_*.json`` — a bench.py capture; its model entries are
+  carried through verbatim.
+
+Usage:
+  python tools/perf_ledger.py append --ledger benchmark/perf_ledger.jsonl \
+      --stepprof /tmp/m.stepprof.jsonl --bench BENCH_CPU.json --label pr19
+  python tools/perf_ledger.py show --ledger benchmark/perf_ledger.jsonl
+  python tools/perf_ledger.py diff --ledger benchmark/perf_ledger.jsonl \
+      [--budgets benchmark/budgets.json] [--band 0.25]
+
+Exit codes (diff): 0 clean, 1 regression(s), 2 unusable ledger.
+"""
+
+import argparse
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import perf_diff  # noqa: E402  (tools/ is not a package)
+
+DEFAULT_LEDGER = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmark", "perf_ledger.jsonl")
+
+
+def _percentile(vals, q):
+    if not vals:
+        return None
+    vals = sorted(vals)
+    k = max(0, min(len(vals) - 1,
+                   int(math.ceil(q / 100.0 * len(vals))) - 1))
+    return vals[k]
+
+
+def summarize_stepprof(recs):
+    """Fold a step-observatory snapshot to one ledger model entry.
+    Field names match bench.py's capture vocabulary so perf_diff's
+    normalizer picks them up unchanged."""
+    timed = [r for r in recs if not r.get("dispatch_only")]
+    if not timed:
+        return None
+    per_step = [r["step_s"] for r in timed]
+    mfus = [r["achieved_mfu"] for r in timed
+            if r.get("achieved_mfu") is not None]
+    walls = [r.get("wall_s", 0.0) for r in timed]
+    waits = [(r.get("phases") or {}).get("input_wait", 0.0)
+             for r in timed]
+    total = sum(walls) + 0.0
+    entry = {
+        "metric": "stepprof",
+        "records": len(timed),
+        "steps": sum(int(r.get("steps", 1)) for r in timed),
+        "step_ms": {
+            "p50": round((_percentile(per_step, 50) or 0) * 1e3, 4),
+            "p95": round((_percentile(per_step, 95) or 0) * 1e3, 4),
+        },
+        "phase_coverage": round(min(r.get("coverage", 0.0)
+                                    for r in timed), 4),
+        "starvation_fraction": (round(sum(waits) / total, 4)
+                                if total > 0 else 0.0),
+        "regressions": sum(1 for r in timed if r.get("regression")),
+    }
+    if mfus:
+        entry["achieved_mfu"] = round(_percentile(mfus, 50), 8)
+    return entry
+
+
+def _load_jsonl(path, what):
+    if not os.path.exists(path):
+        sys.exit("perf_ledger: %s does not exist (%s)" % (path, what))
+    recs = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    pass
+    if not recs:
+        sys.exit("perf_ledger: %s carries no records (%s)" % (path, what))
+    return recs
+
+
+def _git_rev():
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or None
+    except Exception:
+        return None
+
+
+def append_entry(ledger, models, label=None, source=None):
+    """One trajectory point: append {"record": "ledger", ...} and return
+    it. The file is created on first append; the directory must exist."""
+    entry = {
+        "record": "ledger",
+        "ts": time.time(),
+        "label": label,
+        "git": _git_rev(),
+        "source": source,
+        "models": models,
+    }
+    with open(ledger, "a") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+    return entry
+
+
+def read_ledger(path):
+    return [r for r in _load_jsonl(path, "ledger")
+            if isinstance(r, dict) and r.get("record") == "ledger"
+            and isinstance(r.get("models"), dict)]
+
+
+def _entry_metrics(entry):
+    """{model: {metric: value}} of one ledger entry, through perf_diff's
+    normalizer — the same view the gate sees."""
+    out = {}
+    for name, m in entry["models"].items():
+        if isinstance(m, dict) and "error" not in m:
+            norm = perf_diff._bench_model_metrics(m)
+            if norm:
+                out[name] = norm
+    return out
+
+
+def cmd_append(args):
+    models = {}
+    if args.stepprof:
+        entry = summarize_stepprof(_load_jsonl(args.stepprof, "stepprof"))
+        if entry is None:
+            sys.exit("perf_ledger: %s carries no timed step records"
+                     % args.stepprof)
+        models["stepprof"] = entry
+    if args.bench:
+        for rec in _load_jsonl(args.bench, "bench"):
+            if isinstance(rec.get("models"), dict):
+                for name, m in rec["models"].items():
+                    if isinstance(m, dict) and "error" not in m:
+                        models[name] = m
+    if not models:
+        sys.exit("perf_ledger: nothing to append — pass --stepprof "
+                 "and/or --bench")
+    entry = append_entry(args.ledger, models, label=args.label,
+                         source=args.stepprof or args.bench)
+    print(json.dumps({"appended": sorted(models),
+                      "label": entry["label"], "git": entry["git"],
+                      "ledger": args.ledger,
+                      "entries": len(read_ledger(args.ledger))}))
+
+
+def cmd_show(args):
+    entries = read_ledger(args.ledger)
+    for e in entries:
+        for model, metrics in sorted(_entry_metrics(e).items()):
+            if args.model and model != args.model:
+                continue
+            for metric, val in sorted(metrics.items()):
+                if args.metric and metric != args.metric:
+                    continue
+                print("%s  %-10s %-12s %-22s %s"
+                      % (time.strftime("%Y-%m-%d %H:%M:%S",
+                                       time.localtime(e["ts"])),
+                         (e.get("label") or e.get("git") or "-")[:10],
+                         model, metric, val))
+
+
+def cmd_diff(args):
+    """Gate the newest ledger entry: against the previous entry that
+    shares a model (relative, banded) and/or the budgets file
+    (absolute) — perf_diff's compare(), perf_diff's exit codes."""
+    entries = read_ledger(args.ledger)
+    newest = _entry_metrics(entries[-1])
+    if not newest:
+        sys.exit(2)
+    results = []
+    # previous entry per model: the before/after every perf PR lands with
+    prev = {}
+    for e in entries[:-1]:
+        for model, metrics in _entry_metrics(e).items():
+            prev[model] = metrics  # later (still pre-newest) wins
+    prev = {m: v for m, v in prev.items() if m in newest}
+    if prev:
+        perf_diff.compare(newest, prev, args.band, "ledger", results)
+    if args.budgets:
+        try:
+            with open(args.budgets) as f:
+                budgets = json.load(f)
+        except (OSError, ValueError) as e:
+            print("perf_ledger: cannot read budgets %s (%s)"
+                  % (args.budgets, e))
+            raise SystemExit(2)
+        ref, band = perf_diff.budget_reference(budgets)
+        ref = {m: v for m, v in ref.items() if m in newest}
+        perf_diff.compare(newest, ref, band, "budget", results,
+                          require_all=True)
+    if not results:
+        print("perf_ledger: nothing to gate — one entry and no budgets "
+              "covering its models")
+        raise SystemExit(2)
+    failures = [r for r in results if not r["ok"]]
+    for r in results:
+        mark = "FAIL" if not r["ok"] else "ok  "
+        print("%s %-12s %-22s %-13s cand=%-14s %s=%-14s limit=%s"
+              % (mark, r["model"], r["metric"], r["kind"],
+                 r["candidate"], r["source"], r["reference"],
+                 r["effective_limit"]))
+    if failures:
+        print("perf_ledger: %d regression(s) vs the trajectory"
+              % len(failures))
+        raise SystemExit(1)
+    print("perf_ledger: clean (%d checks)" % len(results))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="append-only perf trajectory over bench/stepprof "
+                    "captures, gated by perf_diff")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_app = sub.add_parser("append", help="append one trajectory point")
+    p_app.add_argument("--ledger", default=DEFAULT_LEDGER)
+    p_app.add_argument("--stepprof", default=None,
+                       help="a <p>.stepprof.jsonl snapshot to fold in")
+    p_app.add_argument("--bench", default=None,
+                       help="a bench.py BENCH_*.json capture to fold in")
+    p_app.add_argument("--label", default=None,
+                       help="trajectory label (PR id, experiment name)")
+    p_show = sub.add_parser("show", help="print the trajectory")
+    p_show.add_argument("--ledger", default=DEFAULT_LEDGER)
+    p_show.add_argument("--model", default=None)
+    p_show.add_argument("--metric", default=None)
+    p_diff = sub.add_parser("diff", help="gate the newest entry")
+    p_diff.add_argument("--ledger", default=DEFAULT_LEDGER)
+    p_diff.add_argument("--budgets", default=None)
+    p_diff.add_argument("--band", type=float, default=0.25)
+    args = ap.parse_args(argv)
+    {"append": cmd_append, "show": cmd_show, "diff": cmd_diff}[args.cmd](
+        args)
+
+
+if __name__ == "__main__":
+    main()

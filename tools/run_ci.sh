@@ -4,8 +4,8 @@
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
 # (native|python|lint|conclint|warm|metrics|forensics|chaos|shard|serve|
-# decode|servechaos|net|trace|elastic|dryrun|bench|perfgate) to run a
-# subset.
+# decode|servechaos|net|trace|stepprof|elastic|dryrun|bench|perfgate) to
+# run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -15,7 +15,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ALL_STAGES=(native python lint conclint warm metrics forensics chaos shard
-            serve decode servechaos net trace elastic dryrun bench perfgate)
+            serve decode servechaos net trace stepprof elastic dryrun bench
+            perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -311,6 +312,28 @@ if want trace; then
     python tools/perf_diff.py "$tdir/trace.json" \
       --budgets benchmark/budgets.json --models trace
   rm -rf "$tdir"
+  trap - EXIT
+fi
+
+if want stepprof; then
+  echo "== step-observatory smoke (free when off, accountable when on) =="
+  # one process, two legs over the same seeded training job: the control
+  # leg (FLAGS_step_profile unset) banks every fetch and the timed walls;
+  # the profiled leg replays the identical schedule and must prove
+  # bit-identical fetches, ZERO fresh compiles, >=95% of every step wall
+  # attributed to named phases, a finite achieved-MFU join on every
+  # training record, and the offline round trip (write_stepprof_jsonl ->
+  # step_breakdown --steps -> perf_ledger append/show/diff). The capture
+  # (phase_coverage, fresh_compiles, achieved_mfu, stepprof_overhead)
+  # gates against the committed stepprof budgets.
+  spdir="$(mktemp -d)"
+  trap 'rm -rf "$spdir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/stepprof_smoke.py "$spdir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/perf_diff.py "$spdir/stepprof.json" \
+      --budgets benchmark/budgets.json --models stepprof
+  rm -rf "$spdir"
   trap - EXIT
 fi
 

@@ -22,6 +22,11 @@ Two independent views, printed as JSON lines:
    serving process left behind) — fleet TTFT / queue / prefill /
    decode split plus the top-N slowest requests by trace id
    (``tools/trace_view.py`` renders any one of them as a waterfall).
+   ``--steps PATH`` (a ``.stepprof.jsonl`` from FLAGS_step_profile=1)
+   is the training twin: per-step phase split (input wait / feed /
+   compile / dispatch / device / fetch / host), achieved-MFU
+   percentiles, starvation fraction, and the top-N slowest steps with
+   per-phase attribution and regression flags.
 3. ``--xprof`` — run the full step under ``jax.profiler.trace`` and
    aggregate XLA op self-times from the xplane.pb the profiler writes.
    The xplane wire format is decoded directly (a ~60-line generic
@@ -305,6 +310,105 @@ def _load_traces_jsonl(path):
     return recs
 
 
+def _load_stepprof_jsonl(path):
+    """Records from a step-profile JSONL, or a friendly exit — same
+    contract as the other loaders: a missing/empty snapshot means the
+    observatory was off or the path is wrong, not a crash."""
+    if not os.path.exists(path):
+        sys.exit(
+            "step_breakdown: %s does not exist.\nRun the training "
+            "workload with FLAGS_step_profile=1, FLAGS_telemetry=1 and "
+            "FLAGS_metrics_path=<p> (profiled steps land at "
+            "<p>.stepprof.jsonl), or pass that .stepprof.jsonl path "
+            "here." % path)
+    recs = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    pass
+    if not recs:
+        sys.exit(
+            "step_breakdown: %s is empty — the process profiled no step "
+            "(was FLAGS_step_profile=1? did any executor step complete?)"
+            % path)
+    return recs
+
+
+# phase axis of the step observatory's records (step_profiler.PHASES)
+_STEPPROF_PHASES = ("input_wait", "feed", "compile", "dispatch", "device",
+                    "fetch", "host")
+
+
+def _summarize_stepprof(recs, top=5):
+    """The per-step training view over a step-profile snapshot: where
+    did each step's wall go (phase split), achieved-MFU percentiles,
+    starvation fraction, and the top-N slowest steps with per-phase
+    attribution and regression flags — the training twin of
+    ``--requests``."""
+    timed = [r for r in recs if not r.get("dispatch_only")]
+    per_step = [r["step_s"] for r in timed]
+    total_wall = sum(r.get("wall_s", 0.0) for r in timed)
+    phase_totals = {p: 0.0 for p in _STEPPROF_PHASES}
+    for r in timed:
+        for p, v in (r.get("phases") or {}).items():
+            phase_totals[p] = phase_totals.get(p, 0.0) + v
+    total_input = phase_totals.get("input_wait", 0.0)
+    total_attr = total_wall + total_input  # wall excludes pre-step waits
+    mfus = [r["achieved_mfu"] for r in timed
+            if r.get("achieved_mfu") is not None]
+    bounds = {}
+    for r in timed:
+        b = r.get("bound", "unknown")
+        bounds[b] = bounds.get(b, 0) + 1
+    regressions = [r for r in timed if r.get("regression")]
+
+    def ms(v, nd=3):
+        return round(v * 1e3, nd) if v is not None else None
+
+    print(json.dumps({
+        "step_records": len(recs),
+        "steps": sum(int(r.get("steps", 1)) for r in timed),
+        "origins": sorted({r.get("origin") for r in timed}),
+        "step_ms": {"p50": ms(_percentile(per_step, 50)),
+                    "p95": ms(_percentile(per_step, 95)),
+                    "p99": ms(_percentile(per_step, 99))},
+        "phase_split": {
+            p: round(phase_totals.get(p, 0.0) / total_attr, 4)
+            for p in _STEPPROF_PHASES if total_attr > 0},
+        "coverage_min": (round(min(r.get("coverage", 0.0)
+                                   for r in timed), 4)
+                         if timed else None),
+        "starvation_fraction": (round(total_input / total_attr, 4)
+                                if total_attr > 0 else None),
+        "achieved_mfu": {
+            "p50": (round(_percentile(mfus, 50), 6) if mfus else None),
+            "p95": (round(_percentile(mfus, 95), 6) if mfus else None),
+        },
+        "bound": bounds,
+        "regressions": len(regressions),
+    }))
+    slowest = sorted(timed, key=lambda r: -r.get("step_s", 0.0))
+    for r in slowest[:max(0, int(top))]:
+        reg = r.get("regression")
+        print(json.dumps({
+            "slow_step": r.get("fingerprint", "")[:16] or r.get("origin"),
+            "origin": r.get("origin"),
+            "steps": r.get("steps", 1),
+            "step_ms": ms(r.get("step_s")),
+            "phases_ms": {p: ms(v) for p, v in
+                          (r.get("phases") or {}).items()},
+            "coverage": round(r.get("coverage", 0.0), 4),
+            "achieved_mfu": r.get("achieved_mfu"),
+            "predicted_ratio": r.get("predicted_ratio"),
+            "bound": r.get("bound"),
+            "regression": ({"kind": reg["kind"], "phase": reg["phase"]}
+                           if reg else None),
+        }))
+
+
 def _summarize_requests(recs, top=5):
     """The per-request serving view over a trace snapshot: where did
     each request's wall time go (queue wait / prefill / decode /
@@ -472,7 +576,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet50", "transformer"])
-    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--steps", default="10", metavar="N|PATH",
+                    help="model-run mode: number of timed steps. With a "
+                         "PATH to a step-profile JSONL "
+                         "(<FLAGS_metrics_path>.stepprof.jsonl): offline "
+                         "training view — phase split, achieved-MFU "
+                         "percentiles, starvation fraction, top-N "
+                         "slowest steps with regression flags")
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--xprof", action="store_true",
                     help="also capture + aggregate an xprof trace")
@@ -492,6 +602,15 @@ def main():
                          "TTFT/queue/prefill/decode split + top-N "
                          "slowest requests")
     args = ap.parse_args()
+
+    try:
+        args.steps = int(args.steps)
+    except ValueError:
+        # --steps <path.stepprof.jsonl>: the offline training view,
+        # symmetric to --requests
+        _summarize_stepprof(_load_stepprof_jsonl(args.steps),
+                            top=args.top)
+        return
 
     if args.requests:
         _summarize_requests(_load_traces_jsonl(args.requests),
