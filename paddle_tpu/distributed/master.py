@@ -28,8 +28,16 @@ from paddle_tpu.observability import lock_witness
 __all__ = [
     "Task", "MasterService", "MasterClient", "task_reader",
     "serve_json_lines", "close_json_server", "JsonConn",
-    "JsonLineClient", "ThrottledSnapshot",
+    "JsonLineClient", "ThrottledSnapshot", "AuthError",
 ]
+
+
+class AuthError(ValueError):
+    """Bad or missing bearer token on an authenticated JSON-lines
+    endpoint. A ``ValueError`` subclass on purpose: the resilience
+    classifier treats ValueError as permanent, so no retry shell in the
+    repo will ever spin on a credential failure — the caller fixes its
+    token or stays out."""
 
 
 # ---------------------------------------------------------------------------
@@ -57,7 +65,8 @@ class JsonConn(object):
 
 
 def serve_json_lines(dispatch, host="127.0.0.1", port=0, pass_conn=False,
-                     on_open=None, on_close=None):
+                     on_open=None, on_close=None, ssl_context=None,
+                     auth_token=None):
     """Start a threading TCP endpoint speaking newline-delimited JSON:
     every request line is parsed and handed to ``dispatch(dict) -> dict``
     (or ``dispatch(dict, conn)`` with ``pass_conn=True``); exceptions
@@ -91,7 +100,16 @@ def serve_json_lines(dispatch, host="127.0.0.1", port=0, pass_conn=False,
     otherwise): ``net.accept`` severs a just-accepted connection before
     any request is read; ``net.send`` fails a response write, severing
     the connection mid-(stream) — both exercise client reconnect /
-    typed-error paths, never a wedge."""
+    typed-error paths, never a wedge.
+
+    Transport security (both default off, wire bytes unchanged):
+    ``ssl_context`` (an ``ssl.SSLContext`` with a server cert loaded)
+    wraps every accepted connection in TLS before the first line is
+    read; ``auth_token`` requires every request line to carry a
+    matching ``"auth"`` bearer field — a bad or missing token answers
+    one typed :class:`AuthError` line and severs the connection, and
+    the ``auth`` field is always stripped before dispatch so services
+    never see (or log) credentials."""
 
     class Handler(socketserver.StreamRequestHandler):
         def setup(self):
@@ -166,6 +184,16 @@ def serve_json_lines(dispatch, host="127.0.0.1", port=0, pass_conn=False,
                         self.server.bytes_received += len(line)
                     try:
                         req = json.loads(line)
+                        if (isinstance(req, dict)
+                                and req.pop("auth", None) != auth_token
+                                and auth_token is not None):
+                            # one typed refusal, then sever: an
+                            # unauthenticated peer gets no second
+                            # request on this connection
+                            self._send({
+                                "ok": False, "etype": "AuthError",
+                                "error": "bad or missing auth token"})
+                            return
                         resp = (dispatch(req, self.ctx) if pass_conn
                                 else dispatch(req))
                     except Exception as e:  # noqa: BLE001
@@ -200,6 +228,16 @@ def serve_json_lines(dispatch, host="127.0.0.1", port=0, pass_conn=False,
     class Server(socketserver.ThreadingTCPServer):
         allow_reuse_address = True
         daemon_threads = True
+
+        def get_request(self):
+            # TLS wrap at accept time, before the handler thread reads
+            # a byte; a failed handshake is an OSError the accept loop
+            # already absorbs (the peer just sees a severed socket)
+            sock, addr = socketserver.ThreadingTCPServer.get_request(
+                self)
+            if ssl_context is not None:
+                sock = ssl_context.wrap_socket(sock, server_side=True)
+            return sock, addr
 
     server = Server((host, port), Handler)
     server._conn_mu = lock_witness.make_lock("distributed.jsonl.conn")
@@ -237,6 +275,15 @@ def close_json_server(server):
             pass
 
 
+def _parse_addr(one):
+    """One address spec -> (host, port). Accepts 'host:port' or a
+    (host, port) pair."""
+    if isinstance(one, str):
+        host, _, port = one.rpartition(":")
+        return (host or "127.0.0.1", int(port))
+    return (one[0], int(one[1]))
+
+
 class JsonLineClient(object):
     """Shared client shell for the JSON-lines protocol: one persistent
     socket, reconnect-and-retry-once across a service restart (the
@@ -244,19 +291,48 @@ class JsonLineClient(object):
     retried call is safe because every service speaking this protocol
     follows the snapshot/recover pattern: a restarted service answers
     with consistent state and unknown-id requests return a typed error
-    instead of corrupting."""
+    instead of corrupting.
+
+    ``addr`` may be a single 'host:port' / (host, port), a
+    comma-separated 'h:p,h:p' string, or a list of either — with more
+    than one address the client fails over: a connect that fails (or a
+    send on a severed socket) rotates to the next address, so the
+    existing reconnect-retry shells transparently reach a survivor
+    (e.g. a router replica) without new retry machinery.
+
+    ``ssl_context`` (client-mode ``ssl.SSLContext``) wraps the socket
+    in TLS; ``auth_token`` stamps every request line with the bearer
+    ``"auth"`` field an authenticated endpoint demands — a mismatch
+    surfaces as the typed, never-retried :class:`AuthError`."""
 
     #: metrics/blackbox origin for retry accounting; subclasses override
     origin = "JsonLineClient._call"
 
-    def __init__(self, addr, timeout_s=10.0):
+    def __init__(self, addr, timeout_s=10.0, ssl_context=None,
+                 auth_token=None):
         if isinstance(addr, str):
-            host, _, port = addr.rpartition(":")
-            addr = (host or "127.0.0.1", int(port))
-        self._addr = (addr[0], int(addr[1]))
+            self._addrs = [_parse_addr(a.strip())
+                           for a in addr.split(",") if a.strip()]
+        elif (isinstance(addr, (list, tuple)) and len(addr) == 2
+                and isinstance(addr[0], str)
+                and not isinstance(addr[1], (str, list, tuple))):
+            # a bare (host, port) pair, the historical form
+            self._addrs = [_parse_addr(addr)]
+        else:
+            self._addrs = [_parse_addr(a) for a in addr]
+        if not self._addrs:
+            raise ValueError("JsonLineClient needs at least one address")
+        self._addr_i = 0
         self._timeout_s = timeout_s
+        self._ssl_context = ssl_context
+        self._auth_token = auth_token
         self._sock = None
         self._rfile = None
+
+    @property
+    def _addr(self):
+        """The address currently targeted (rotates on failover)."""
+        return self._addrs[self._addr_i]
 
     def _chaos_site(self, req):
         """Chaos site to arm for this request (None = uninstrumented)."""
@@ -272,24 +348,45 @@ class JsonLineClient(object):
         return None
 
     def _connect(self):
-        if self._sock is None:
-            self._sock = socket.create_connection(
-                self._addr, timeout=self._timeout_s)
+        if self._sock is not None:
+            return
+        last = None
+        for _ in range(len(self._addrs)):
+            try:
+                sock = socket.create_connection(
+                    self._addr, timeout=self._timeout_s)
+            except OSError as exc:
+                # failover: rotate to the next configured address and
+                # let the connect loop (or the caller's retry shell)
+                # reach a survivor
+                last = exc
+                self._addr_i = (self._addr_i + 1) % len(self._addrs)
+                continue
             try:  # small-line protocol: never let Nagle sit on a frame
-                self._sock.setsockopt(
+                sock.setsockopt(
                     socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 pass
-            self._rfile = self._sock.makefile("rb")
+            if self._ssl_context is not None:
+                sock = self._ssl_context.wrap_socket(
+                    sock, server_hostname=self._addr[0])
+            self._sock = sock
+            self._rfile = sock.makefile("rb")
+            return
+        raise last
 
     def _send_line(self, req):
         """Connect (if needed) and write one framed request; a send
-        failure closes the socket so the next attempt reconnects."""
+        failure closes the socket and rotates the target address so the
+        next attempt reconnects (to the next replica, if any)."""
         self._connect()
+        if self._auth_token is not None and isinstance(req, dict):
+            req = dict(req, auth=self._auth_token)
         try:
             self._sock.sendall((json.dumps(req) + "\n").encode("utf-8"))
         except OSError:
             self.close()
+            self._addr_i = (self._addr_i + 1) % len(self._addrs)
             raise
 
     def _recv_line(self):
@@ -300,9 +397,11 @@ class JsonLineClient(object):
             line = self._rfile.readline()
         except OSError:
             self.close()
+            self._addr_i = (self._addr_i + 1) % len(self._addrs)
             raise
         if not line:
             self.close()
+            self._addr_i = (self._addr_i + 1) % len(self._addrs)
             raise ConnectionError(
                 "%s: service closed connection" % type(self).__name__)
         return json.loads(line)
@@ -326,7 +425,12 @@ class JsonLineClient(object):
                 if site:
                     _chaos.fault(site)
             self._send_line(req)
-            return self._recv_line()
+            resp = self._recv_line()
+            if (isinstance(resp, dict)
+                    and resp.get("etype") == "AuthError"):
+                self.close()
+                raise AuthError(resp.get("error", "auth rejected"))
+            return resp
 
         return _retry.call(once, origin=self.origin, retries=1)
 

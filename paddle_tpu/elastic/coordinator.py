@@ -88,10 +88,16 @@ class FleetCoordinator(object):
     over the shared JSON-lines TCP transport."""
 
     def __init__(self, lease_s=5.0, min_workers=1, snapshot_path=None,
-                 snapshot_interval_s=0.5, max_reshard_history=8):
+                 snapshot_interval_s=0.5, max_reshard_history=8,
+                 on_evict=None):
         self._lease_s = float(lease_s)
         self._min_workers = max(1, int(min_workers))
         self._max_reshard_history = max(1, int(max_reshard_history))
+        # on_evict(worker_ids, generation): fired from the watcher
+        # thread AFTER a lease-lapse sweep commits, outside the lock —
+        # the hook the serving router's failover hangs off (a slow or
+        # raising hook delays the next sweep, never membership)
+        self._on_evict = on_evict
         self._mu = lock_witness.make_rlock("elastic.coordinator")
         self._members = {}   # worker_id -> {rank, join, deadline, step, meta}
         self._generation = 0
@@ -181,7 +187,8 @@ class FleetCoordinator(object):
                 "ready": len(self._members) >= self._min_workers,
                 "min_workers": self._min_workers,
                 "members": {
-                    wid: {"rank": m["rank"], "step": m["step"]}
+                    wid: {"rank": m["rank"], "step": m["step"],
+                          "meta": dict(m["meta"])}
                     for wid, m in self._members.items()
                 },
                 # int keys in process; the JSON wire stringifies them and
@@ -249,6 +256,15 @@ class FleetCoordinator(object):
                 if blackbox.ENABLED:
                     blackbox.record("fleet_eviction", workers=expired,
                                     generation=self._generation)
+                if self._on_evict is not None:
+                    try:
+                        self._on_evict(list(expired), self._generation)
+                    except Exception:  # noqa: BLE001 - service hook
+                        import logging
+
+                        logging.getLogger(
+                            "paddle_tpu.elastic").exception(
+                            "fleet on_evict hook failed")
             if empty:
                 # re-check AND release the watcher slot under the lock:
                 # a register() that landed while the flush above ran must
@@ -308,9 +324,14 @@ class FleetCoordinator(object):
 
     # -- TCP front-end --------------------------------------------------------
 
-    def serve(self, host="127.0.0.1", port=0):
-        """Start the JSON-lines TCP endpoint; returns (host, port)."""
-        self._server, addr = serve_json_lines(self._dispatch, host, port)
+    def serve(self, host="127.0.0.1", port=0, ssl_context=None,
+              auth_token=None):
+        """Start the JSON-lines TCP endpoint; returns (host, port).
+        ``ssl_context``/``auth_token`` plumb straight to the substrate
+        (default off — the wire is unchanged unless armed)."""
+        self._server, addr = serve_json_lines(
+            self._dispatch, host, port, ssl_context=ssl_context,
+            auth_token=auth_token)
         return addr
 
     def _dispatch(self, req):

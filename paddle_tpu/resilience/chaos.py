@@ -56,7 +56,17 @@ wire sites in ``distributed/master.py``'s ``serve_json_lines``:
 read — the client must reconnect) and ``net.send`` (fail a response
 write mid-stream, severing the connection — arm the ``io`` kind; the
 client must retry a unary call / surface a typed StreamBrokenError on
-a broken stream, never hang).
+a broken stream, never hang). The router tier (``serving/router.py``)
+adds ``router.route`` (inside member selection for one admission —
+an ``io`` fault here must re-route under classified retry, and a
+``kill`` takes the router down mid-admission), ``migrate.ship``
+(before a migration's snapshot payload is shipped to the target
+frontend — a ``kill`` here is the mid-migration router death the
+failure matrix covers: the snapshot is still banked on disk, a
+restarted router re-runs the migration idempotently) and
+``migrate.restore`` (before the target is told to restore the shipped
+payload — an ``io`` fault must retry the restore RPC, never lose the
+stream).
 
 Determinism: each clause owns a ``random.Random`` seeded by
 ``(seed, clause index)``, advanced once per visit to its site — a fixed

@@ -77,7 +77,9 @@ def is_transient(exc):
     never     ProgramVerifyError, NaN/Inf trips (deterministic replays),
               RESOURCE_EXHAUSTED/OOM (deterministic allocator deaths —
               rule M001, observability/memory.py),
-              ValueError/TypeError/KeyError/AssertionError (user errors),
+              ValueError/TypeError/KeyError/AssertionError (user errors —
+              including ``distributed.master.AuthError``: a credential
+              rejection replays verbatim until the token changes),
               FileNotFoundError/PermissionError and kin, everything else
     """
     from paddle_tpu.observability.memory import is_oom

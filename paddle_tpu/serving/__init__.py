@@ -47,6 +47,16 @@ bounded set of warm executables. This package is that layer:
   disconnect-safe reclamation (a killed client's slot and KV pages
   return to the pool), and a client that re-raises the same typed
   errors with classified retry + reconnect across frontend restarts.
+* ``router.ServingRouter`` / ``router.RouterMember`` — the FLEET tier:
+  N frontends register with heartbeat leases behind one router
+  address; unary requests round-robin, streaming admissions ride
+  prefix-affinity consistent hashing (``prefix_hit_rate`` survives
+  scale-out), degraded members shed new admissions to healthy peers,
+  and live sessions MIGRATE between frontends — planned drain and
+  lease-lapse failover both restore a serialized decode snapshot on a
+  survivor and re-drive every client stream from exactly the last
+  delivered (rid, seq) chunk: bit-identical tokens, zero lost or
+  duplicated.
 
 ``docs/SERVING.md`` ("Batching server" / "Network front end") is the
 operator's guide.
@@ -89,6 +99,12 @@ from paddle_tpu.serving.server import (  # noqa: F401
     WaitTimeoutError,
 )
 from paddle_tpu.serving.frontend import ServingFrontend  # noqa: F401
+from paddle_tpu.serving import router  # noqa: F401
+from paddle_tpu.serving.router import (  # noqa: F401
+    ConsistentRing,
+    RouterMember,
+    ServingRouter,
+)
 from paddle_tpu.serving.snapshot import (  # noqa: F401
     DecodeSnapshotManager,
     SnapshotMismatchError,

@@ -41,9 +41,14 @@ import time
 
 import numpy as np
 
-from paddle_tpu.distributed.master import JsonLineClient
+from paddle_tpu.distributed.master import (
+    AuthError,
+    JsonLineClient,
+    _parse_addr,
+)
 from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.observability import watchdog as _watchdog
+from paddle_tpu.resilience.retry import TransientError
 from paddle_tpu.serving.degradation import DegradedError
 from paddle_tpu.serving.generation import (
     NoFreeGroupError,
@@ -59,7 +64,8 @@ from paddle_tpu.serving.server import (
 )
 
 __all__ = [
-    "ServingClient", "StreamBrokenError",
+    "ServingClient", "StreamBrokenError", "RedirectError",
+    "MigrationBusyError", "AuthError",
     "encode_array", "decode_array", "error_to_wire", "error_from_wire",
 ]
 
@@ -70,7 +76,30 @@ class StreamBrokenError(ServingError):
     pages reclaimed); re-issue the request — the client will NOT retry
     it silently, because a fresh generation under a stochastic sampler
     is a different stream and splicing the two would corrupt the
-    caller's sequence."""
+    caller's sequence. (The ONE sanctioned exception is the (rid, seq)
+    resume splice: when the server side migrated the live session —
+    identical (seed, slot, position) sampling keys, so the re-driven
+    tokens are bit-identical — ``generate(..., resume=True)`` re-attaches
+    and splices by absolute sequence position instead of raising.)"""
+
+
+class RedirectError(ServingError):
+    """The service answering is not the one that should: the typed
+    redirect carries the address to re-ask (a drained frontend pointing
+    at the router, a router replica pointing at the leader). The client
+    follows it once per request — a redirect loop surfaces the second
+    redirect as the error it is."""
+
+    def __init__(self, message="", addr=None):
+        super(RedirectError, self).__init__(message)
+        self.addr = addr
+
+
+class MigrationBusyError(ServingError, TransientError):
+    """A migration target refused a restore/admission because it is
+    still draining its own in-flight work (restores land only on a
+    quiesced session). Transient BY TYPE: the classified retry shell
+    backs off and re-asks — by then the target has drained."""
 
 
 def encode_array(arr):
@@ -100,6 +129,7 @@ _WIRE_ERRORS = {
         ServingError, QueueFullError, DeadlineExceededError,
         ServerClosedError, WaitTimeoutError, NoFreeSlotError,
         NoFreePageError, NoFreeGroupError, StreamBrokenError,
+        MigrationBusyError, AuthError,
     )
 }
 
@@ -110,6 +140,8 @@ def error_to_wire(exc):
     if isinstance(exc, DegradedError):
         wire["retry_after_s"] = exc.retry_after_s
         wire["state"] = exc.state
+    if isinstance(exc, RedirectError):
+        wire["addr"] = exc.addr
     return wire
 
 
@@ -123,6 +155,8 @@ def error_from_wire(msg):
         return DegradedError(
             text, state=msg.get("state", "brownout"),
             retry_after_s=float(msg.get("retry_after_s", 0.05)))
+    if etype == "RedirectError":
+        return RedirectError(text, addr=msg.get("addr"))
     cls = _WIRE_ERRORS.get(etype)
     if cls is not None:
         return cls(text)
@@ -185,11 +219,30 @@ class ServingClient(JsonLineClient):
 
     def _request(self, **req):
         """One RPC (reconnect-retry-once inherited); wire errors come
-        back as their original typed exceptions."""
+        back as their original typed exceptions. A typed
+        :class:`RedirectError` is followed ONCE: the client re-targets
+        the carried address (a drained frontend pointing at the router)
+        and re-asks; a second redirect surfaces as the error."""
         resp = self._call(**req)
         if not resp.get("ok", False):
-            raise error_from_wire(resp)
+            err = error_from_wire(resp)
+            if isinstance(err, RedirectError) and err.addr:
+                self._follow(err.addr)
+                resp = self._call(**req)
+                if not resp.get("ok", False):
+                    raise error_from_wire(resp)
+                return resp
+            raise err
         return resp
+
+    def _follow(self, addr):
+        """Re-target this client at ``addr`` (redirect/failover): the
+        address joins the rotation and becomes current."""
+        self.close()
+        parsed = _parse_addr(addr)
+        if parsed not in self._addrs:
+            self._addrs.append(parsed)
+        self._addr_i = self._addrs.index(parsed)
 
     def _retrying(self, fn, origin):
         """The classified-retry shell (``resilience.retry``): transient
@@ -242,7 +295,7 @@ class ServingClient(JsonLineClient):
     # -- streaming decode ----------------------------------------------------
 
     def generate(self, src, src_len=None, n=1, prefix_tokens=None,
-                 beam=False, len_penalty=None):
+                 beam=False, len_penalty=None, resume=False):
         """Stream one generation (``n > 1``: a best-of-N fork group via
         the session's ``admit_group``; ``prefix_tokens``: forced prefix
         riding the prefix cache). Returns a GENERATOR of event dicts,
@@ -278,7 +331,18 @@ class ServingClient(JsonLineClient):
         reclaims its slot/pages). Admission rejects raise typed errors
         at CALL time; a connection severed before the first event is
         retried under the classified policy, any later it raises
-        :class:`StreamBrokenError`."""
+        :class:`StreamBrokenError`.
+
+        ``resume=True`` (solo streams only): a sever after the stream
+        began does NOT raise — the client reconnects (rotating through
+        its configured addresses) and re-attaches by request id, then
+        SPLICES by the (rid, seq) the token chunks carry: events whose
+        absolute sequence positions were already delivered are trimmed,
+        so the caller sees no duplicated and no dropped tokens. This is
+        only sound against a server side that migrated/restored the
+        SAME generation (identical (seed, slot, position) sampling
+        keys — the router tier's contract); when re-attachment fails
+        the usual :class:`StreamBrokenError` surfaces."""
         req = {"method": "generate",
                "src": encode_array(
                    np.asarray(src, dtype="int64")),
@@ -309,10 +373,28 @@ class ServingClient(JsonLineClient):
             return first
 
         first = self._retrying(opened, origin="ServingClient.generate")
-        return self._stream_events(first)
+        return self._stream_events(first, resume=bool(resume))
 
-    def _stream_events(self, first):
+    def _reattach(self, rid):
+        """Resume plumbing: reconnect (rotating addresses) and re-open
+        the stream for ``rid`` via the frontend/router ``attach``
+        endpoint. Returns the first event of the re-driven stream."""
+
+        def opened():
+            self.close()  # force a fresh connect (rotates on failure)
+            self._send_line({"method": "attach", "id": int(rid)})
+            first = self._recv_line()
+            if not first.get("ok", False):
+                raise error_from_wire(first)
+            return first
+
+        return self._retrying(opened, origin="ServingClient.attach")
+
+    def _stream_events(self, first, resume=False):
         finished = False
+        rid = None        # solo request id (the resume handle)
+        next_seq = None   # next absolute trg position not yet delivered
+        admitted = False
         try:
             msg = first
             while True:
@@ -320,10 +402,52 @@ class ServingClient(JsonLineClient):
                     raise error_from_wire(msg)
                 ev = dict(msg)
                 ev.pop("ok", None)
-                if ev.get("event") == "tokens":
+                kind = ev.get("event")
+                if kind == "queued" and ev.get("id") is not None:
+                    rid = int(ev["id"])
+                if kind == "admitted":
+                    if admitted:
+                        # a re-driven backlog re-admission: the caller
+                        # already saw its admission — swallow
+                        msg = self._recv_line()
+                        continue
+                    admitted = True
+                    if ev.get("beam") is None:
+                        next_seq = int(ev["pos"]) + 1
+                if kind in ("tokens", "resumed") and (
+                        rid is not None
+                        and ev.get("seq") is not None
+                        and (next_seq is not None or kind == "resumed")):
+                    # splice by absolute position: trim what was
+                    # already delivered (a resumed stream replays from
+                    # its snapshot), refuse gaps (lost tokens)
+                    seq = int(ev["seq"])
+                    if next_seq is None:
+                        # resumed before any admission was seen (the
+                        # request was restored as LIVE elsewhere): the
+                        # replay itself is the basis — deliver it all
+                        next_seq = seq
+                    toks = [int(t) for t in ev.get("tokens") or ()]
+                    if seq > next_seq:
+                        raise StreamBrokenError(
+                            "stream resumed with a token gap (expected "
+                            "position %d, got %d)" % (next_seq, seq))
+                    keep = toks[next_seq - seq:]
+                    if kind == "resumed" or not keep:
+                        if keep:
+                            next_seq += len(keep)
+                            yield {"event": "tokens",
+                                   "member": int(ev.get("member", 0)),
+                                   "tokens": np.asarray(keep,
+                                                        dtype="int64")}
+                        msg = self._recv_line()
+                        continue
+                    next_seq += len(keep)
+                    ev["tokens"] = keep
+                if kind == "tokens":
                     ev["tokens"] = np.asarray(
                         [int(t) for t in ev["tokens"]], dtype="int64")
-                if ev.get("event") in ("end", "cancelled"):
+                if kind in ("end", "cancelled"):
                     finished = True
                 yield ev
                 if finished:
@@ -331,6 +455,18 @@ class ServingClient(JsonLineClient):
                 try:
                     msg = self._recv_line()
                 except (ConnectionError, EOFError, OSError) as exc:
+                    if resume and rid is not None:
+                        # the router/frontend contract: the same
+                        # generation was migrated and re-driven —
+                        # re-attach and splice instead of raising
+                        try:
+                            msg = self._reattach(rid)
+                        except Exception as exc2:  # noqa: BLE001
+                            finished = True
+                            raise StreamBrokenError(
+                                "stream severed and re-attach failed "
+                                "(%s after %s)" % (exc2, exc))
+                        continue
                     finished = True  # the connection is gone: no cancel
                     # the retry unit is the OPEN (before any event was
                     # consumed); once the stream began, every sever is
@@ -380,7 +516,7 @@ class ServingClient(JsonLineClient):
         self.close()
 
     def generate_full(self, src, src_len=None, n=1, prefix_tokens=None,
-                      on_event=None):
+                      on_event=None, resume=False):
         """Convenience: consume the whole stream and return the
         ``[n, max_length]`` int64 token matrix in member order —
         bos-led, eos-padded, bit-identical to the in-process
@@ -392,7 +528,8 @@ class ServingClient(JsonLineClient):
         token without re-implementing the reassembly."""
         rows = fill = None
         for ev in self.generate(src, src_len=src_len, n=n,
-                                prefix_tokens=prefix_tokens):
+                                prefix_tokens=prefix_tokens,
+                                resume=resume):
             if on_event is not None:
                 on_event(ev)
             kind = ev.get("event")

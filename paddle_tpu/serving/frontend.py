@@ -57,10 +57,12 @@ its single-threaded dispatch discipline); handler threads only move
 messages between that worker and their sockets.
 """
 
+import base64
 import json
 import os
 import queue
 import select
+import shutil
 import signal
 import socket
 import threading
@@ -80,6 +82,7 @@ from paddle_tpu.observability.metrics_registry import (
     SERVING_BUCKETS,
 )
 from paddle_tpu.serving.client import (
+    MigrationBusyError,
     decode_array,
     encode_array,
     error_from_wire,
@@ -177,6 +180,8 @@ class _DecodeWorker(object):
         self._cond = lock_witness.make_condition("serving.frontend.decode")
         self._incoming = deque()
         self._cancels = deque()
+        self._ops = deque()      # (fn, box, done) session ops (snapshot/
+        #                          restore) executed at a quiesce point
         self._stop = False
         self._drain = True
         self._slot_stream = {}   # slot -> (stream, member)
@@ -213,6 +218,40 @@ class _DecodeWorker(object):
             self._cond.notify_all()
         self._thread.join(timeout=timeout)
 
+    def call(self, fn, timeout=60.0):
+        """Run ``fn()`` ON the decode worker thread, between dispatches
+        (a quiesce point — the session is never mid-dispatch there).
+        This is how the snapshot/restore wire endpoints reach the
+        session without violating the one-owner-thread discipline."""
+        box = {}
+        done = threading.Event()
+        with self._cond:
+            if self._stop:
+                raise ServerClosedError("frontend is closed")
+            self._ops.append((fn, box, done))
+            self._cond.notify_all()
+        if not done.wait(timeout=timeout):
+            raise TimeoutError("decode worker op timed out")
+        if "exc" in box:
+            raise box["exc"]
+        return box["val"]
+
+    def _run_ops(self, ops):
+        for fn, box, done in ops:
+            try:
+                box["val"] = fn()
+            except BaseException as exc:  # noqa: BLE001 - re-raised in call
+                box["exc"] = exc
+            done.set()
+
+    def _fail_ops(self):
+        with self._cond:
+            ops = list(self._ops)
+            self._ops.clear()
+        for _fn, box, done in ops:
+            box["exc"] = ServerClosedError("frontend is closed")
+            done.set()
+
     # -- worker loop ---------------------------------------------------------
 
     def _loop(self):
@@ -220,6 +259,7 @@ class _DecodeWorker(object):
         while True:
             with self._cond:
                 while (not self._incoming and not self._cancels
+                        and not self._ops
                         and not self._stop and not s.active_slots
                         and not (s.pending_requests and s.free_slots)):
                     # the timeout re-checks capacity-deferred backlog
@@ -230,10 +270,16 @@ class _DecodeWorker(object):
                 self._incoming.clear()
                 cancels = list(self._cancels)
                 self._cancels.clear()
+                ops = list(self._ops)
+                self._ops.clear()
                 stop, drain = self._stop, self._drain
-            progressed = bool(incoming or cancels)
+            progressed = bool(incoming or cancels or ops)
             for stream in cancels:
                 self._teardown(stream)
+            # ops run at this quiesce point: after cancels (so a drain's
+            # "no live streams" check sees the teardowns) and before
+            # this pass's admissions/dispatch
+            self._run_ops(ops)
             for stream in incoming:
                 if stop:
                     stream.q.put(error_to_wire(
@@ -243,6 +289,7 @@ class _DecodeWorker(object):
                     self._admit(stream)
             if stop and not drain:
                 self._abort_all()
+                self._fail_ops()
                 return
             progressed |= self._admit_backlog()
             if s.active_slots:
@@ -260,6 +307,7 @@ class _DecodeWorker(object):
             if (stop and drain and not s.active_slots
                     and not s.pending_requests and not self._slot_stream
                     and not self._rid_stream and not self._beam_stream):
+                self._fail_ops()
                 return
             if not progressed:
                 # a whole pass moved nothing — the backlog is
@@ -335,7 +383,9 @@ class _DecodeWorker(object):
         tid = spec.get("trace_id")
         t_admit = time.time() if tid else 0.0
         try:
-            if spec.get("beam"):
+            if spec.get("attach") is not None:
+                self._attach_stream(stream)
+            elif spec.get("beam"):
                 # beam request: admit-or-reject into one lane (the
                 # beam's K x worst-case reservation never queues);
                 # per-dispatch survivor chunks stream from _step_once,
@@ -394,6 +444,62 @@ class _DecodeWorker(object):
             stream.done = True
             stream.q.put(error_to_wire(exc))
 
+    def _attach_stream(self, stream):
+        """Re-bind a wire stream to an EXISTING solo request by rid —
+        the router's failover/drain splice point. The first event is
+        ``resumed`` replaying the request's tokens from absolute
+        position 1 (trg index 0 is bos); the consumer trims against its
+        own ``next_seq``, which handles both a snapshot BEHIND the
+        delivered stream (overlap) and a drain snapshot AHEAD of the
+        relay (gap-fill) with one splice. Three states attach cleanly:
+        banked (finished headless — replay + end), live (track the slot
+        mid-flight), pending (wait for admission like a fresh enqueue).
+        """
+        s = self._s
+        rid = int(stream.spec["attach"])
+        if rid in s._results:
+            trg = s.take_result(rid)
+            toks = self._final_tokens(trg, 0)
+            stream.done = True
+            stream.q.put({
+                "ok": True, "event": "resumed", "id": rid, "seq": 1,
+                "bos": int(s._bos),
+                "bos": int(s._bos),
+                "bos": int(s._bos),
+                "tokens": [int(t) for t in toks], "finished": True,
+                "max_length": int(s._T), "eos": int(s._eos)})
+            stream.q.put({"ok": True, "event": "end", "id": rid})
+            return
+        slot = next((sl for sl, r in s._owner.items() if r == rid),
+                    None)
+        if slot is not None:
+            if slot in self._slot_stream:
+                raise ServingError(
+                    "request %d already has a live stream" % rid)
+            stream.rid = rid
+            self._track(stream, {slot: 0})
+            pos = s._live[slot]["pos"]
+            stream.q.put({
+                "ok": True, "event": "resumed", "id": rid, "seq": 1,
+                "tokens": [int(t)
+                           for t in s._live[slot]["trg"][1:pos + 1]],
+                "finished": False,
+                "max_length": int(s._T), "eos": int(s._eos)})
+            return
+        if rid in s.pending_requests:
+            pend = next((p for p in s._pending if p["id"] == rid), None)
+            if pend is not None:
+                stream.spec["prefix"] = pend.get("prefix")
+            stream.rid = rid
+            self._rid_stream[rid] = stream
+            stream.q.put({
+                "ok": True, "event": "resumed", "id": rid, "seq": 1,
+                "tokens": [], "finished": False,
+                "max_length": int(s._T), "eos": int(s._eos)})
+            return
+        raise ServingError("unknown request id %d (not banked, live or "
+                           "pending on this frontend)" % rid)
+
     def _trace_admitted(self, stream, t_admit, kind):
         """Direct admissions (fork groups, beam lanes) bypass the
         session queue, so their admit span and slot->trace binding are
@@ -429,6 +535,11 @@ class _DecodeWorker(object):
         tid = stream.spec.get("trace_id")
         if tid:
             ev["trace_id"] = tid
+        if stream.rid is not None:
+            # solo streams carry their rid for the router's splice/
+            # re-attach protocol (fork groups have no single rid and
+            # are not resumable)
+            ev["id"] = int(stream.rid)
         if stream.beam_lane is not None:
             ev["beam"] = int(stream.beam_lane)
             ev["beam_width"] = int(s.beam_width)
@@ -516,25 +627,39 @@ class _DecodeWorker(object):
                 if rid is not None:
                     s._trace_ids.pop(rid, None)
                 if len(toks) and not stream.cancelled.is_set():
-                    stream.q.put({
-                        "ok": True, "event": "tokens",
-                        "member": member,
-                        "tokens": [int(t) for t in toks]})
+                    ev = {"ok": True, "event": "tokens",
+                          "member": member,
+                          "tokens": [int(t) for t in toks]}
+                    if stream.rid is not None:
+                        # (rid, seq): seq is the ABSOLUTE trg position
+                        # of the chunk's first token — the router/
+                        # client splice key (trg[0] is bos, so the
+                        # first generated chunk of a prefixless
+                        # request carries seq=1)
+                        ev["id"] = int(stream.rid)
+                        ev["seq"] = int(prev + 1)
+                    stream.q.put(ev)
                 if not stream.live and not stream.done:
                     stream.done = True
                     if not stream.cancelled.is_set():
-                        stream.q.put({"ok": True, "event": "end"})
+                        end_ev = {"ok": True, "event": "end"}
+                        if stream.rid is not None:
+                            end_ev["id"] = int(stream.rid)
+                        stream.q.put(end_ev)
             else:
                 st = s._live.get(slot)
                 if st is None:
                     continue
                 new = st["pos"]
                 if new > prev and not stream.cancelled.is_set():
-                    stream.q.put({
-                        "ok": True, "event": "tokens",
-                        "member": member,
-                        "tokens": [int(t)
-                                   for t in st["trg"][prev + 1:new + 1]]})
+                    ev = {"ok": True, "event": "tokens",
+                          "member": member,
+                          "tokens": [int(t)
+                                     for t in st["trg"][prev + 1:new + 1]]}
+                    if stream.rid is not None:
+                        ev["id"] = int(stream.rid)
+                        ev["seq"] = int(prev + 1)
+                    stream.q.put(ev)
                 self._prev_pos[slot] = new
         # orphaned finishes (no stream — a restored process's backlog):
         # bank exactly like pump(), so take_result can claim them
@@ -623,17 +748,28 @@ class ServingFrontend(object):
         installed handler — install a ``DecodeSnapshotManager``'s
         handlers first and a preempted frontend banks its backlog and
         dies by the signal (the PR 13 discipline, now wire-deep).
+    snapshot_manager : serving.snapshot.DecodeSnapshotManager, optional
+        Arms the ``snapshot``/``restore``/``attach`` wire endpoints the
+        router tier's live-migration protocol uses (docs/SERVING.md
+        "Router tier"). Both endpoints execute ON the decode worker at
+        a quiesce point; ``restore`` refuses a non-quiesced session
+        with the typed retriable ``MigrationBusyError``.
+    ssl_context, auth_token :
+        Passed through to ``serve_json_lines`` — TLS and bearer auth on
+        the frontend's wire (default: both off, wire unchanged).
     """
 
     def __init__(self, server=None, session=None, host="127.0.0.1",
                  port=0, max_stream_backlog=64, stream_poll_s=0.05,
-                 install_signal_handlers=False):
+                 install_signal_handlers=False, snapshot_manager=None,
+                 ssl_context=None, auth_token=None):
         if server is None and session is None:
             raise ValueError(
                 "ServingFrontend needs a BatchingServer (predict), a "
                 "SlotDecodeSession (generate), or both")
         self._batching = server
         self._session = session
+        self._snap_mgr = snapshot_manager
         self._decode = (_DecodeWorker(session,
                                       max_backlog=max_stream_backlog)
                         if session is not None else None)
@@ -647,7 +783,8 @@ class ServingFrontend(object):
         self._prev_handlers = {}
         self._json_server, self.address = serve_json_lines(
             self._dispatch, host=host, port=port, pass_conn=True,
-            on_open=self._on_open, on_close=self._on_close)
+            on_open=self._on_open, on_close=self._on_close,
+            ssl_context=ssl_context, auth_token=auth_token)
         if install_signal_handlers:
             self._install_signal_handlers()
 
@@ -720,6 +857,12 @@ class ServingFrontend(object):
             return {"ok": True, "stats": self.stats()}
         if method == "take_result":
             return self._take_result(req)
+        if method == "attach":
+            return self._attach(req, conn)
+        if method == "snapshot":
+            return self._snapshot(req)
+        if method == "restore":
+            return self._restore(req)
         if method == "trace":
             # completed-trace lookup by id: ring-resident records only
             # (in-flight ids surface through blackbox dumps instead)
@@ -973,6 +1116,164 @@ class ServingFrontend(object):
         self._observe("take_result", "ok", t0)
         return resp
 
+    # -- migration endpoints (router tier) -----------------------------------
+
+    def _attach(self, req, conn):
+        """Streaming re-attach to an existing solo request by rid — the
+        router's failover/drain splice endpoint. The first event is
+        ``resumed`` replaying the request's tokens from absolute
+        position 1; after that the stream behaves exactly like
+        ``generate`` (the same consume loop, cancel/EOF polling and
+        teardown discipline)."""
+        t0 = time.monotonic()
+        outcome = "error"
+        stream = None
+        try:
+            if self._decode is None:
+                self._observe("attach", "error", t0)
+                yield error_to_wire(ServingError(
+                    "this frontend serves no decode session"))
+                return
+            if self._closed:
+                outcome = "closed"
+                self._observe("attach", "closed", t0)
+                yield error_to_wire(
+                    ServerClosedError("frontend is closed"))
+                return
+            spec = {"attach": int(req["id"]), "n": 1, "prefix": None,
+                    "beam": False, "trace_id": None}
+            stream = _Stream(spec)
+            conn.state.setdefault("streams", set()).add(stream)
+            with self._mu:
+                self._active_streams += 1
+            self._decode.submit(stream)
+            while True:
+                try:
+                    msg = stream.q.get(timeout=self._poll)
+                except queue.Empty:
+                    verdict = self._poll_conn(conn)
+                    if verdict == "cancel":
+                        self._decode.cancel(stream)
+                        outcome = "cancelled"
+                        yield {"ok": True, "event": "cancelled"}
+                        return
+                    if verdict == "eof":
+                        self._decode.cancel(stream)
+                        outcome = "disconnect"
+                        return
+                    continue
+                if not msg.get("ok", False):
+                    outcome = _outcome(error_from_wire(msg))
+                    yield msg
+                    return
+                yield msg
+                if msg.get("event") == "end":
+                    outcome = "ok"
+                    return
+        except GeneratorExit:
+            outcome = "disconnect"
+            if stream is not None:
+                self._decode.cancel(stream)
+            raise
+        finally:
+            if stream is not None:
+                streams = conn.state.get("streams")
+                if streams is not None:
+                    streams.discard(stream)
+                with self._mu:
+                    self._active_streams -= 1
+                self._observe("attach", outcome, t0)
+
+    def _snapshot(self, req):
+        """Quiesced synchronous snapshot with the payload returned ON
+        THE WIRE (base64 per file): the router's planned-drain path
+        ships it to the target frontend's ``restore``. Executes on the
+        decode worker between dispatches — never mid-dispatch."""
+        t0 = time.monotonic()
+        try:
+            if self._snap_mgr is None or self._decode is None:
+                raise ServingError(
+                    "this frontend has no snapshot manager")
+            path = self._decode.call(self._snap_mgr.save)
+            files = {}
+            for name in sorted(os.listdir(path)):
+                with open(os.path.join(path, name), "rb") as f:
+                    files[name] = base64.b64encode(
+                        f.read()).decode("ascii")
+            resp = {"ok": True, "dir": os.path.basename(path),
+                    "files": files}
+        except Exception as exc:  # noqa: BLE001 - typed to the wire
+            self._observe("snapshot", _outcome(exc), t0)
+            return error_to_wire(exc)
+        self._observe("snapshot", "ok", t0)
+        return resp
+
+    def _restore(self, req):
+        """Install a SHIPPED snapshot payload into this frontend's
+        session — the migration landing. Refuses unless the session is
+        fully quiesced (no live slots, no backlog, no tracked streams):
+        a restore is a whole-session replace, and landing one on live
+        work would destroy it AND break the (seed, slot, position)
+        sampling keys migrated streams rely on for bit-exactness. The
+        typed ``MigrationBusyError`` is transient BY TYPE, so the
+        router's classified retry simply re-asks after the target
+        drains."""
+        t0 = time.monotonic()
+        try:
+            mgr = self._snap_mgr
+            if mgr is None or self._decode is None:
+                raise ServingError(
+                    "this frontend has no snapshot manager")
+            dirname = os.path.basename(str(req.get("dir", "")))
+            if not dirname.startswith("checkpoint_"):
+                raise ServingError(
+                    "restore needs a checkpoint_<serial> dir name")
+            serial = int(dirname.rsplit("_", 1)[-1])
+            files = req.get("files") or {}
+
+            def _install():
+                w = self._decode
+                s = self._session
+                if (w._slot_stream or w._beam_stream or w._rid_stream
+                        or s.active_slots or s.pending_requests):
+                    raise MigrationBusyError(
+                        "restore target is not quiesced (live slots, "
+                        "backlog or tracked streams present) — drain "
+                        "first, then re-ask")
+                # join the in-flight async snapshot writer first: this
+                # frontend's own periodic save may still be writing a
+                # checkpoint whose step-derived serial COLLIDES with
+                # the shipped one (two members working the same load
+                # reach the same step counts), and installing into the
+                # directory it is writing tears both
+                mgr.wait()
+                step_dir = os.path.join(mgr.checkpoint_dir, dirname)
+                if os.path.isdir(step_dir):
+                    shutil.rmtree(step_dir)
+                os.makedirs(step_dir)
+                for name, b64 in files.items():
+                    fname = os.path.basename(str(name))
+                    with open(os.path.join(step_dir, fname), "wb") as f:
+                        f.write(base64.b64decode(b64))
+                manifest = mgr.restore(serial=serial)
+                if manifest is None:
+                    raise ServingError(
+                        "shipped snapshot %s failed verification"
+                        % dirname)
+                return {"ok": True, "serial": int(serial),
+                        "live": sorted(int(r)
+                                       for r in s._owner.values()),
+                        "pending": [int(r)
+                                    for r in s.pending_requests],
+                        "banked": sorted(int(r) for r in s._results)}
+
+            resp = self._decode.call(_install, timeout=120.0)
+        except Exception as exc:  # noqa: BLE001 - typed to the wire
+            self._observe("restore", _outcome(exc), t0)
+            return error_to_wire(exc)
+        self._observe("restore", "ok", t0)
+        return resp
+
     def _health(self):
         out = {}
         if self._batching is not None:
@@ -991,7 +1292,7 @@ class ServingFrontend(object):
             by_endpoint = {}
             for (endpoint, outcome), n in sorted(self._counts.items()):
                 by_endpoint.setdefault(endpoint, {})[outcome] = n
-            return {
+            out = {
                 "requests": by_endpoint,
                 "active_connections": self._conns,
                 "active_streams": self._active_streams,
@@ -999,6 +1300,25 @@ class ServingFrontend(object):
                 "bytes_received": self._io_seen[1],
                 "closed": self._closed,
             }
+        if self._session is not None:
+            # the decode-plane view the router polls: quiesce checks
+            # before a migration landing, pool conservation after every
+            # teardown, and the prefix-cache hit rate the affinity
+            # routing exists to preserve. Reads of the session from
+            # this (handler) thread are racy-by-design snapshots — the
+            # numbers are advisory; the authoritative quiesce check
+            # runs ON the worker inside ``restore``.
+            s = self._session
+            out["decode"] = {
+                "active_slots": len(s.active_slots),
+                "pending": len(s.pending_requests),
+                "free_slots": int(s.free_slots),
+                "results_banked": len(s._results),
+                "pool_conserved": bool(s.pool_conserved),
+                "health": s.health,
+                "prefix": s.prefix_cache_stats(),
+            }
+        return out
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -438,12 +438,13 @@ def test_committed_budgets_parse_and_cover_the_gate():
     assert budgets["models"], "budgets must cover at least one model"
     for model, entries in budgets["models"].items():
         assert "fresh_compiles" in entries, model
-        if model not in ("servechaos", "trace", "stepprof"):
+        if model not in ("servechaos", "router", "trace", "stepprof"):
             # every bench-leg model budgets its memory plan; the
-            # servechaos/trace/stepprof smoke captures have no
+            # servechaos/router/trace/stepprof smoke captures have no
             # memory_plan surface — their deterministic gate is
-            # fresh_compiles == 0 (in the RESTORED process / across the
-            # tracing-ON wire leg / across the profiled replay)
+            # fresh_compiles == 0 (in the RESTORED process / on the
+            # failover survivor / across the tracing-ON wire leg /
+            # across the profiled replay)
             assert "predicted_peak_bytes" in entries, model
         for metric, spec in entries.items():
             assert spec.get("why"), (

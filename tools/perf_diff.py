@@ -85,6 +85,14 @@ METRICS = {
     # serving resilience (tools/serve_chaos_smoke.py): wall seconds of
     # one synchronous decode snapshot in the restored warm process
     "snapshot_seconds": ("lower", "timing"),
+    # router fleet tier (tools/router_smoke.py): end-to-end seconds of
+    # one SIGKILL failover (sever detection -> banked snapshot read ->
+    # ship -> quiesced restore on the survivor), and the count of
+    # client streams the failover LOST (deterministic: the zero-loss
+    # contract — any nonzero means a re-driven stream gapped or a
+    # banked snapshot stopped covering the in-flight work)
+    "migration_seconds": ("lower", "timing"),
+    "lost_streams": ("lower", "deterministic"),
     # network front end (tools/frontend_smoke.py + bench.py frontend
     # leg): stream time-to-first-token over a real socket — the
     # latency_ms_* twins above carry the wire unary SLOs
@@ -131,6 +139,8 @@ def _bench_model_metrics(m):
     out["speculative_speedup"] = m.get("speculative_speedup")
     out["acceptance_rate"] = m.get("acceptance_rate")
     out["snapshot_seconds"] = m.get("snapshot_seconds")
+    out["migration_seconds"] = m.get("migration_seconds")
+    out["lost_streams"] = m.get("lost_streams")
     out["ttft_ms"] = m.get("ttft_ms")
     out["span_coverage"] = m.get("span_coverage")
     out["phase_coverage"] = m.get("phase_coverage")

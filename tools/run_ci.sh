@@ -4,8 +4,8 @@
 # 8-device virtual CPU mesh, the driver's multichip dryrun, and a CPU
 # proxy of the benchmark. Runs everything by default; pass stage names
 # (native|python|lint|conclint|warm|metrics|forensics|chaos|shard|serve|
-# decode|servechaos|net|trace|stepprof|elastic|dryrun|bench|perfgate) to
-# run a subset.
+# decode|servechaos|route|net|trace|stepprof|elastic|dryrun|bench|
+# perfgate) to run a subset.
 #
 #   tools/run_ci.sh                      # everything
 #   tools/run_ci.sh python               # just pytest
@@ -15,8 +15,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 ALL_STAGES=(native python lint conclint warm metrics forensics chaos shard
-            serve decode servechaos net trace stepprof elastic dryrun bench
-            perfgate)
+            serve decode servechaos route net trace stepprof elastic dryrun
+            bench perfgate)
 stages=("$@")
 [ ${#stages[@]} -eq 0 ] && stages=("${ALL_STAGES[@]}")
 for s in "${stages[@]}"; do
@@ -250,6 +250,29 @@ if want servechaos; then
     python tools/perf_diff.py "$scdir/servechaos.json" \
       --budgets benchmark/budgets.json --models servechaos
   rm -rf "$scdir"
+  trap - EXIT
+fi
+
+if want route; then
+  echo "== router fleet smoke (SIGKILL-a-frontend failover) =="
+  # an oracle subprocess decodes the whole request set and warms one
+  # shared exec cache; the parent then runs a ServingRouter over TWO
+  # frontend subprocesses, pins duplicate (src, prefix) pairs to one
+  # member via affinity hashing (prefix hits must survive the 2-member
+  # scale-out), and SIGKILLs one frontend with live slots on board —
+  # every concurrent stream must still complete through the router
+  # BIT-identical to the oracle (the victim's banked snapshot restores
+  # on the survivor, relays re-attach and splice at (rid, seq)) with
+  # ZERO lost streams and ZERO fresh compiles on the survivor. The
+  # capture gates against the committed router budgets.
+  rtdir="$(mktemp -d)"
+  trap 'rm -rf "$rtdir"' EXIT
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu FLAGS_telemetry=1 \
+    python tools/router_smoke.py "$rtdir"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/perf_diff.py "$rtdir/router.json" \
+      --budgets benchmark/budgets.json --models router
+  rm -rf "$rtdir"
   trap - EXIT
 fi
 
