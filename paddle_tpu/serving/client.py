@@ -373,16 +373,28 @@ class ServingClient(JsonLineClient):
             return first
 
         first = self._retrying(opened, origin="ServingClient.generate")
-        return self._stream_events(first, resume=bool(resume))
+        # the address the stream was BORN on: a bare (per-frontend)
+        # rid re-attached through a router needs it to name the
+        # namespace the rid was minted in (router handles are
+        # composite "wid:rid" strings and self-describe)
+        born_on = "%s:%d" % self._addr
+        return self._stream_events(first, resume=bool(resume),
+                                   origin=born_on)
 
-    def _reattach(self, rid):
+    def _reattach(self, rid, origin=None):
         """Resume plumbing: reconnect (rotating addresses) and re-open
         the stream for ``rid`` via the frontend/router ``attach``
-        endpoint. Returns the first event of the re-driven stream."""
+        endpoint. ``origin`` (the address the stream was born on)
+        rides along so a router can resolve a bare rid to the ONE
+        member that minted it. Returns the first event of the
+        re-driven stream."""
 
         def opened():
             self.close()  # force a fresh connect (rotates on failure)
-            self._send_line({"method": "attach", "id": int(rid)})
+            req = {"method": "attach", "id": rid}
+            if origin:
+                req["origin"] = origin
+            self._send_line(req)
             first = self._recv_line()
             if not first.get("ok", False):
                 raise error_from_wire(first)
@@ -390,7 +402,7 @@ class ServingClient(JsonLineClient):
 
         return self._retrying(opened, origin="ServingClient.attach")
 
-    def _stream_events(self, first, resume=False):
+    def _stream_events(self, first, resume=False, origin=None):
         finished = False
         rid = None        # solo request id (the resume handle)
         next_seq = None   # next absolute trg position not yet delivered
@@ -404,7 +416,10 @@ class ServingClient(JsonLineClient):
                 ev.pop("ok", None)
                 kind = ev.get("event")
                 if kind == "queued" and ev.get("id") is not None:
-                    rid = int(ev["id"])
+                    # opaque resume handle: an int from a frontend, a
+                    # composite "wid:rid" string from a router —
+                    # passed back VERBATIM on attach/take_result
+                    rid = ev["id"]
                 if kind == "admitted":
                     if admitted:
                         # a re-driven backlog re-admission: the caller
@@ -460,7 +475,7 @@ class ServingClient(JsonLineClient):
                         # generation was migrated and re-driven —
                         # re-attach and splice instead of raising
                         try:
-                            msg = self._reattach(rid)
+                            msg = self._reattach(rid, origin=origin)
                         except Exception as exc2:  # noqa: BLE001
                             finished = True
                             raise StreamBrokenError(
@@ -623,11 +638,15 @@ class ServingClient(JsonLineClient):
         session's result bank): a solo id yields its ``[T]`` token
         row; a BEAM claim id (from the beam ``admitted`` event) yields
         ``(tokens [K, T], scores [K])`` — the n-best of a beam whose
-        stream died before ``beam_end``. None if unknown/unfinished."""
+        stream died before ``beam_end``. None if unknown/unfinished.
+        The id is passed VERBATIM: a frontend's ids are ints, a
+        router's are composite ``"wid:rid"`` strings (the router
+        resolves them to the minting member)."""
+        rid = (request_id if isinstance(request_id, str)
+               else int(request_id))
 
         def once():
-            resp = self._request(method="take_result",
-                                 id=int(request_id))
+            resp = self._request(method="take_result", id=rid)
             tokens = resp.get("tokens")
             if tokens is None:
                 return None

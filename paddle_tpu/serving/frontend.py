@@ -451,7 +451,10 @@ class _DecodeWorker(object):
         position 1 (trg index 0 is bos); the consumer trims against its
         own ``next_seq``, which handles both a snapshot BEHIND the
         delivered stream (overlap) and a drain snapshot AHEAD of the
-        relay (gap-fill) with one splice. Three states attach cleanly:
+        relay (gap-fill) with one splice. Every ``resumed`` variant
+        carries ``bos`` — the router synthesizes a correct admission
+        from it when a stream failed over before its admission event
+        reached the client. Three states attach cleanly:
         banked (finished headless — replay + end), live (track the slot
         mid-flight), pending (wait for admission like a fresh enqueue).
         """
@@ -463,8 +466,6 @@ class _DecodeWorker(object):
             stream.done = True
             stream.q.put({
                 "ok": True, "event": "resumed", "id": rid, "seq": 1,
-                "bos": int(s._bos),
-                "bos": int(s._bos),
                 "bos": int(s._bos),
                 "tokens": [int(t) for t in toks], "finished": True,
                 "max_length": int(s._T), "eos": int(s._eos)})
@@ -481,6 +482,7 @@ class _DecodeWorker(object):
             pos = s._live[slot]["pos"]
             stream.q.put({
                 "ok": True, "event": "resumed", "id": rid, "seq": 1,
+                "bos": int(s._bos),
                 "tokens": [int(t)
                            for t in s._live[slot]["trg"][1:pos + 1]],
                 "finished": False,
@@ -494,6 +496,7 @@ class _DecodeWorker(object):
             self._rid_stream[rid] = stream
             stream.q.put({
                 "ok": True, "event": "resumed", "id": rid, "seq": 1,
+                "bos": int(s._bos),
                 "tokens": [], "finished": False,
                 "max_length": int(s._T), "eos": int(s._eos)})
             return

@@ -45,6 +45,21 @@ restore) terminates with a typed ``StreamBrokenError`` and counts on
 ``paddle_tpu_router_lost_streams_total`` — the metric the CI route
 stage gates at 0.
 
+Request handles: frontends mint rids PER MEMBER (every session counts
+from 0), so a bare rid names a different request on every member. The
+router therefore hands clients ROUTER-SCOPED composite handles —
+``"<worker_id>:<rid>"`` — on every relayed event that carries an id.
+The handle self-describes the minting member (it even survives a
+router restart, because members re-register under stable ids), and
+``take_result``/``attach`` resolve it to exactly that member, walking
+the migration chain when the member's sessions moved. A bare rid (a
+client that streamed from a frontend DIRECTLY and rotated to the
+router) resolves only through the client's ``origin`` address hint or
+an unambiguous migration record; when no unambiguous owner exists the
+router answers with a typed miss — it never probes the fleet with a
+bare number, which could consume or splice ANOTHER client's
+same-numbered request.
+
 Chaos sites: ``router.route`` (member selection — an ``io`` fault
 re-routes under classified retry), ``migrate.ship`` (before the
 snapshot payload ships — a ``kill`` is a mid-migration router death;
@@ -171,6 +186,18 @@ class ConsistentRing(object):
         return None
 
 
+def _parse_wire_rid(raw):
+    """``(wid, mrid)`` from a wire id. The router's composite
+    ``"wid:mrid"`` handles self-describe their minting member; a bare
+    integer (a rid minted by a frontend the client talked to DIRECTLY)
+    parses as ``(None, mrid)``. Raises TypeError/ValueError on junk."""
+    if isinstance(raw, str) and ":" in raw:
+        wid, _, tail = raw.rpartition(":")
+        if wid:
+            return wid, int(tail)
+    return None, int(raw)
+
+
 class _DownstreamGone(Exception):
     """The DOWNSTREAM client cancelled in-band or disconnected while a
     relay was waiting on its upstream."""
@@ -275,7 +302,10 @@ class ServingRouter(object):
         self._health = {}      # wid -> degradation state
         self._draining = set()  # wids held out of routing (drained, or
         #                         a migration landing in progress)
-        self._owners = {}      # rid -> wid (migrated ownership)
+        self._owners = {}      # (wid, mrid) -> wid: migration records
+        #                        — a restored rid's NEW owner, keyed by
+        #                        the namespace it was minted in (rids
+        #                        are per-member; bare numbers collide)
         self._failovers = {}   # wid -> Event (idempotence: first caller
         #                        runs, the rest wait)
         self._clients = {}     # wid -> (ServingClient, lock) unary pool
@@ -455,6 +485,52 @@ class ServingRouter(object):
         with self._mu:
             self._health[wid] = state or "brownout"
 
+    # -- request ownership ---------------------------------------------------
+
+    @staticmethod
+    def _compose_rid(wid, mrid):
+        """The router-scoped handle for member ``wid``'s rid ``mrid``
+        — what relayed events carry downstream in place of the bare
+        (per-member, collision-prone) rid."""
+        return "%s:%d" % (wid, int(mrid))
+
+    def _resolve_owner_locked(self, wid, mrid):
+        """Follow the migration chain from ``(wid, mrid)`` to the
+        member currently owning that rid (``wid`` itself when it never
+        migrated). Caller holds ``self._mu``."""
+        key = (wid, int(mrid))
+        seen = set()
+        while key in self._owners and key not in seen:
+            seen.add(key)
+            key = (self._owners[key], key[1])
+        return key[0]
+
+    def _forget_owner_locked(self, wid, mrid):
+        """Drop the migration chain for one finished/claimed rid.
+        Caller holds ``self._mu``."""
+        key = (wid, int(mrid))
+        while key in self._owners:
+            key = (self._owners.pop(key), key[1])
+
+    def _bare_rid_owner(self, mrid, members):
+        """Owner for a BARE rid (no wid on the handle, no origin
+        hint) — only answered when unambiguous: a unique migration
+        record for that rid number, or a fleet that has only ever
+        known ONE member (a single namespace). Anything else is None:
+        asking every member would pop/splice ANOTHER client's
+        same-numbered request, so ambiguity degrades to a typed miss,
+        never to wrong data."""
+        mrid = int(mrid)
+        with self._mu:
+            targets = {self._resolve_owner_locked(w, m)
+                       for (w, m) in self._owners if m == mrid}
+            known = set(self._known)
+        if len(targets) == 1:
+            return next(iter(targets))
+        if not targets and len(known) == 1 and known <= set(members):
+            return next(iter(known))
+        return None
+
     # -- dispatch ------------------------------------------------------------
 
     def _dispatch(self, req, conn):
@@ -536,21 +612,49 @@ class ServingRouter(object):
                              else ServingError("no frontend answered"))
 
     def _take_result(self, req):
-        rid = int(req.get("id", -1))
-        with self._mu:
-            owner = self._owners.get(rid)
+        """Claim a banked result THROUGH the router. A composite
+        ``"wid:mrid"`` handle (what this router's relayed streams
+        carry) resolves to its minting member through the migration
+        chain; a bare rid resolves only when unambiguous
+        (:meth:`_bare_rid_owner`). The resolved member — and ONLY that
+        member, failed over when unreachable — is asked: rids are
+        per-member namespaces, and ``take_result`` POPS, so probing
+        the fleet with a bare number could consume another client's
+        result."""
+        try:
+            wid0, mrid = _parse_wire_rid(req.get("id"))
+        except (TypeError, ValueError):
+            return error_to_wire(ServingError("take_result needs an id"))
         members = self._membership()
-        order = ([owner] if owner in members else []) + \
-            [w for w in members if w != owner]
-        for wid in order:
+        if wid0 is None:
+            wid0 = self._bare_rid_owner(mrid, members)
+            if wid0 is None:
+                return {"ok": True, "tokens": None}
+        deadline = time.monotonic() + self._migration_timeout_s
+        failed_over = set()
+        while time.monotonic() < deadline:
+            with self._mu:
+                owner = self._resolve_owner_locked(wid0, mrid)
+            if not self._member_listed(owner):
+                if owner in failed_over:
+                    break  # failover landed nothing new: unknown
+                failed_over.add(owner)
+                self._failover(owner)
+                continue  # re-resolve: the restore re-owned its rids
             try:
-                resp = self._unary(wid, method="take_result", id=rid)
-            except Exception:  # noqa: BLE001 - try the next member
+                resp = self._unary(owner, method="take_result",
+                                   id=mrid)
+            except Exception:  # noqa: BLE001 - dead owner: fail over
+                if owner in failed_over:
+                    break
+                failed_over.add(owner)
+                self._failover(owner)
                 continue
-            if resp.get("ok", False) and resp.get("tokens") is not None:
+            if (resp.get("ok", False)
+                    and resp.get("tokens") is not None):
                 with self._mu:
-                    self._owners.pop(rid, None)
-                return resp
+                    self._forget_owner_locked(wid0, mrid)
+            return resp
         return {"ok": True, "tokens": None}
 
     # -- streaming relay -----------------------------------------------------
@@ -587,18 +691,22 @@ class ServingRouter(object):
         return None
 
     def _relay_recv(self, upstream, conn):
-        """One upstream line. A read timeout is NOT a sever — a parked
-        backlog can sit silent far longer than the socket timeout — so
-        it only polls the downstream for a cancel/EOF and waits again;
-        EOF/transport errors surface as ConnectionError (the failover
-        trigger)."""
+        """One upstream line. The downstream is polled for an in-band
+        cancel/EOF BEFORE every blocking read — so a cancel propagates
+        within one event interval even while the upstream is actively
+        producing (an actively-streamed readline never times out), and
+        a silent upstream still gets the poll once per read timeout. A
+        read timeout is NOT a sever — a parked backlog can sit silent
+        far longer than the socket timeout — it just re-polls and
+        waits again; EOF/transport errors surface as ConnectionError
+        (the failover trigger)."""
         while True:
+            verdict = self._poll_downstream(conn)
+            if verdict:
+                raise _DownstreamGone(verdict)
             try:
                 line = upstream._rfile.readline()
             except (socket.timeout, TimeoutError):
-                verdict = self._poll_downstream(conn)
-                if verdict:
-                    raise _DownstreamGone(verdict)
                 continue
             except (OSError, ValueError) as exc:
                 raise ConnectionError("relay upstream severed: %s"
@@ -614,23 +722,25 @@ class ServingRouter(object):
         return wid in self._membership()
 
     def _attach_to(self, rid, last_wid):
-        """Find the CURRENT owner of ``rid`` and open an attach stream
-        on it. Runs the failover when the recorded owner is gone
-        (idempotently — concurrent relays wait on one migration).
-        Returns ``(client, wid, first_event)``; raises
-        :class:`StreamBrokenError` when the stream is genuinely lost."""
+        """Find the CURRENT owner of member rid ``rid`` minted in
+        ``last_wid``'s namespace — following the migration chain — and
+        open an attach stream on it. Runs the failover when the owner
+        is gone (idempotently — concurrent relays wait on one
+        migration). Returns ``(client, wid, first_event)``; raises
+        :class:`StreamBrokenError` when the stream is genuinely
+        lost."""
         deadline = time.monotonic() + self._migration_timeout_s
         fails = 0
         while time.monotonic() < deadline:
             with self._mu:
-                owner = self._owners.get(rid, last_wid)
+                owner = self._resolve_owner_locked(last_wid, rid)
             if owner is None:
                 break
             if not self._member_listed(owner):
                 self._failover(owner)
                 with self._mu:
-                    new = self._owners.get(rid)
-                if new is None or new == owner:
+                    new = self._resolve_owner_locked(last_wid, rid)
+                if new == owner:
                     break  # no landing took ownership: lost
                 continue
             client = None
@@ -683,7 +793,9 @@ class ServingRouter(object):
         tried = set() if _tried is None else _tried
         upstream = None
         wid = None
-        rid = None
+        rid = None       # MEMBER rid (the upstream attach handle)
+        rid_wid = None   # the member namespace ``rid`` was minted in
+        crid = None      # router-scoped composite handle, downstream
         next_seq = None
         admitted_fwd = False
         delivered = False
@@ -733,20 +845,27 @@ class ServingRouter(object):
                     yield msg
                     return
                 if kind == "queued" and msg.get("id") is not None:
-                    # NOTE: no ownership record here — rids are minted
-                    # per-member session (every member counts from 0),
-                    # so a bare-rid map entry could collide with another
-                    # member's same-numbered stream. ``_owners`` records
-                    # MIGRATED ownership only; a pre-migration sever
-                    # re-finds the stream via ``last_wid`` (the member
-                    # this relay was talking to), which is unambiguous.
+                    # rids are minted per-member session (every member
+                    # counts from 0), so the handle the client gets is
+                    # ROUTER-SCOPED: "wid:mrid". It self-describes the
+                    # minting member — take_result/attach resolve it
+                    # to exactly that member (through the migration
+                    # chain), never by probing the fleet with a bare
+                    # number that could name another client's request.
                     rid = int(msg["id"])
-                    yield msg
+                    if crid is None:
+                        rid_wid = wid
+                        crid = self._compose_rid(wid, rid)
+                    yield dict(msg, id=crid)
                 elif kind == "admitted":
                     if not admitted_fwd:
                         admitted_fwd = True
                         if msg.get("id") is not None:
                             rid = int(msg["id"])
+                            if crid is None:
+                                rid_wid = wid
+                                crid = self._compose_rid(wid, rid)
+                            msg = dict(msg, id=crid)
                         if (msg.get("beam") is None
                                 and msg.get("pos") is not None):
                             next_seq = int(msg["pos"]) + 1
@@ -771,7 +890,7 @@ class ServingRouter(object):
                                "max_length": int(
                                    msg.get("max_length", 0)),
                                "eos": int(msg.get("eos", 0)),
-                               "id": rid}
+                               "id": crid}
                     seq = int(msg["seq"])
                     toks = [int(t) for t in msg.get("tokens") or ()]
                     if next_seq is None:
@@ -789,22 +908,25 @@ class ServingRouter(object):
                     if keep:
                         out = {"ok": True, "event": "tokens",
                                "member": int(msg.get("member", 0)),
-                               "id": rid, "seq": next_seq,
+                               "id": crid, "seq": next_seq,
                                "tokens": keep}
                         next_seq += len(keep)
                         delivered = True
                         yield out
                     if kind == "resumed" and msg.get("finished"):
-                        yield {"ok": True, "event": "end", "id": rid}
+                        yield {"ok": True, "event": "end", "id": crid}
                         return
                 else:
                     if kind == "tokens":
                         delivered = True
-                    yield msg
+                    yield (dict(msg, id=crid)
+                           if (crid is not None
+                               and msg.get("id") is not None)
+                           else msg)
                     if kind in ("end", "cancelled"):
                         if rid is not None:
                             with self._mu:
-                                self._owners.pop(rid, None)
+                                self._forget_owner_locked(rid_wid, rid)
                         return
                 # advance: the ONE recv point — every sever funnels
                 # through the re-attach (or, pre-admission, a full
@@ -814,15 +936,30 @@ class ServingRouter(object):
                 except ConnectionError:
                     self._release_stream_client(wid, upstream)
                     upstream = None
-                    if rid is None and not delivered:
-                        # nothing reached the member (or the client):
-                        # re-route the WHOLE admission — safe, the
-                        # member's disconnect hook reclaimed whatever
-                        # was admitted
-                        tried.add(wid)
-                        sub = self._generate(req, conn, _tried=tried)
-                        for ev in sub:
-                            yield ev
+                    if rid is None:
+                        if not delivered:
+                            # nothing reached the member (or the
+                            # client): re-route the WHOLE admission —
+                            # safe, the member's disconnect hook
+                            # reclaimed whatever was admitted
+                            tried.add(wid)
+                            sub = self._generate(req, conn,
+                                                 _tried=tried)
+                            for ev in sub:
+                                yield ev
+                            return
+                        # delivered, but the stream carries no rid
+                        # (fork groups — the frontend attaches no id
+                        # to their events): there is no attach handle
+                        # to re-drive from. A typed, counted loss —
+                        # group streams are not resumable by design.
+                        with self._mu:
+                            self._n_lost += 1
+                        _lost_streams_total.inc()
+                        yield error_to_wire(StreamBrokenError(
+                            "stream severed after delivery and "
+                            "carries no request id (group streams "
+                            "are not resumable)"))
                         return
                     upstream, wid, msg = self._attach_to(rid, wid)
         except _DownstreamGone as gone:
@@ -834,7 +971,7 @@ class ServingRouter(object):
             if gone.verdict == "cancel":
                 if rid is not None:
                     with self._mu:
-                        self._owners.pop(rid, None)
+                        self._forget_owner_locked(rid_wid, rid)
                 yield {"ok": True, "event": "cancelled"}
             return
         except StreamBrokenError as exc:
@@ -849,72 +986,51 @@ class ServingRouter(object):
     def _attach(self, req, conn):
         """Router-level attach: a resume-capable client reconnecting to
         the router (or a replica) re-finds its stream wherever the
-        fleet moved it. Events relay verbatim — the CLIENT owns the
-        splice on this path — but the relay still tracks positions so
-        a second failover mid-attach splices correctly."""
+        fleet moved it. The handle must resolve to ONE member: a
+        composite ``"wid:mrid"`` id self-describes its minting member
+        (and survives a router restart — members re-register under
+        stable ids); a bare rid needs the client's ``origin`` hint
+        (the address of the frontend it was streaming from) or an
+        unambiguous record, because rids are per-member namespaces and
+        probing the fleet with a bare number could splice ANOTHER
+        client's same-numbered stream into this caller's. Events relay
+        under the caller's own handle — the CLIENT owns the splice on
+        this path — but the relay still tracks positions so a second
+        failover mid-attach splices correctly."""
+        handle = req.get("id")
         try:
-            rid = int(req.get("id", -1))
+            wid0, rid = _parse_wire_rid(handle)
         except (TypeError, ValueError):
             yield error_to_wire(ServingError("attach needs an id"))
             return
-        with self._mu:
-            last = self._owners.get(rid)
+        members = self._membership()
+        if wid0 is None:
+            origin = req.get("origin")
+            if origin:
+                # the client names the frontend it was DIRECTLY
+                # attached to — that member's namespace minted the rid
+                with self._mu:
+                    cands = [w for w, meta in self._known.items()
+                             if meta.get("addr") == str(origin)]
+                if len(cands) == 1:
+                    wid0 = cands[0]
+            if wid0 is None:
+                wid0 = self._bare_rid_owner(rid, members)
+        if wid0 is None:
+            with self._mu:
+                self._n_lost += 1
+            _lost_streams_total.inc()
+            yield error_to_wire(StreamBrokenError(
+                "attach %r: no member owns this rid unambiguously "
+                "(rids are per-member namespaces — re-attach with the "
+                "router's composite handle, or send the origin "
+                "frontend's address)" % (handle,)))
+            return
         upstream = None
         wid = None
         next_seq = None
         try:
-            if last is None:
-                # unknown rid: the stream never relayed through this
-                # router (a client that was attached DIRECTLY to a
-                # victim frontend, or a router restart). Probe every
-                # member — and when a member is unreachable, run its
-                # failover and re-probe: the victim's banked snapshot
-                # may be exactly where this rid lives.
-                deadline = (time.monotonic()
-                            + self._migration_timeout_s)
-                while upstream is None:
-                    with self._mu:
-                        owner = self._owners.get(rid)
-                    if owner is not None:
-                        # a failover below (or a concurrent one)
-                        # recorded the landing
-                        upstream, wid, msg = self._attach_to(
-                            rid, owner)
-                        break
-                    members = self._membership()
-                    unreachable = None
-                    for cand in members:
-                        client = None
-                        try:
-                            client = self._stream_client(cand)
-                            client._send_line(
-                                {"method": "attach", "id": rid})
-                            ev0 = client._recv_line()
-                        except (ConnectionError, EOFError, OSError,
-                                ValueError):
-                            if client is not None:
-                                self._release_stream_client(
-                                    cand, client)
-                            unreachable = cand
-                            continue
-                        if ev0.get("ok", False):
-                            upstream, wid, msg = client, cand, ev0
-                            break
-                        self._release_stream_client(cand, client)
-                    if upstream is not None:
-                        break
-                    if (unreachable is not None
-                            and time.monotonic() < deadline):
-                        self._failover(unreachable)
-                        continue
-                    with self._mu:
-                        self._n_lost += 1
-                    _lost_streams_total.inc()
-                    yield error_to_wire(StreamBrokenError(
-                        "no frontend owns request %d" % rid))
-                    return
-            else:
-                upstream, wid, msg = self._attach_to(rid, last)
+            upstream, wid, msg = self._attach_to(rid, wid0)
             while True:
                 kind = msg.get("event")
                 if not msg.get("ok", False):
@@ -925,31 +1041,35 @@ class ServingRouter(object):
                     seq = int(msg["seq"])
                     toks = [int(t) for t in msg.get("tokens") or ()]
                     if next_seq is None:
-                        # first replay goes through VERBATIM (the
-                        # client trims); later re-drives trim here
+                        # first replay goes through verbatim — under
+                        # the caller's OWN handle (the client trims);
+                        # later re-drives trim here
                         next_seq = seq + len(toks)
-                        yield msg
+                        yield (dict(msg, id=handle)
+                               if msg.get("id") is not None else msg)
                     else:
                         if seq > next_seq:
                             yield error_to_wire(StreamBrokenError(
                                 "re-driven stream %s has a token gap"
-                                % rid))
+                                % (handle,)))
                             return
                         keep = toks[next_seq - seq:]
                         if keep:
                             yield {"ok": True, "event": "tokens",
                                    "member": int(msg.get("member", 0)),
-                                   "id": rid, "seq": next_seq,
+                                   "id": handle, "seq": next_seq,
                                    "tokens": keep}
                             next_seq += len(keep)
                     if kind == "resumed" and msg.get("finished"):
-                        yield {"ok": True, "event": "end", "id": rid}
+                        yield {"ok": True, "event": "end",
+                               "id": handle}
                         return
                 else:
-                    yield msg
+                    yield (dict(msg, id=handle)
+                           if msg.get("id") is not None else msg)
                     if kind in ("end", "cancelled"):
                         with self._mu:
-                            self._owners.pop(rid, None)
+                            self._forget_owner_locked(wid0, rid)
                         return
                 try:
                     msg = self._relay_recv(upstream, conn)
@@ -1043,7 +1163,10 @@ class ServingRouter(object):
                                or ()])
                     with self._mu:
                         for rid in rids:
-                            self._owners[rid] = target
+                            # keyed by the namespace the rid was
+                            # minted in: later lookups chain
+                            # (victim, rid) -> target -> ...
+                            self._owners[(victim, rid)] = target
                         self._n_migrations += 1
                     _migrations_total.inc()
                     return resp
@@ -1141,21 +1264,31 @@ class ServingRouter(object):
         t0 = time.monotonic()
         with self._mu:
             self._draining.add(wid)
-        resp = _retry.call(
-            lambda: self._unary(wid, method="snapshot"),
-            origin="ServingRouter.snapshot")
-        if not resp.get("ok", False):
-            raise ServingError("drain: snapshot of %s failed: %s"
-                               % (wid, resp.get("error")))
-        payload = {"dir": resp["dir"], "files": resp["files"]}
-        target = self._pick_target(exclude={wid})
-        if target is None:
-            raise ServingError(
-                "drain: no surviving frontend to migrate onto")
-        restored = self._ship_and_restore(payload, target, victim=wid)
-        if restored is None:
-            raise ServingError(
-                "drain: migration to %s did not land in time" % target)
+        try:
+            resp = _retry.call(
+                lambda: self._unary(wid, method="snapshot"),
+                origin="ServingRouter.snapshot")
+            if not resp.get("ok", False):
+                raise ServingError("drain: snapshot of %s failed: %s"
+                                   % (wid, resp.get("error")))
+            payload = {"dir": resp["dir"], "files": resp["files"]}
+            target = self._pick_target(exclude={wid})
+            if target is None:
+                raise ServingError(
+                    "drain: no surviving frontend to migrate onto")
+            restored = self._ship_and_restore(payload, target,
+                                              victim=wid)
+            if restored is None:
+                raise ServingError(
+                    "drain: migration to %s did not land in time"
+                    % target)
+        except BaseException:
+            # a FAILED drain must not pin a healthy member out of
+            # routing forever — the pin becomes permanent only once
+            # the migration actually landed
+            with self._mu:
+                self._draining.discard(wid)
+            raise
         # membership first, then the sever: a relay that re-attaches
         # must neither route back to the victim nor race a half-
         # recorded owner map (the restore recorded owners above)

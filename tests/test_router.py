@@ -38,7 +38,8 @@ from paddle_tpu.distributed.master import (
 )
 from paddle_tpu.executor import global_scope
 from paddle_tpu.resilience import chaos
-from paddle_tpu.serving.client import ServingClient
+from paddle_tpu.serving.client import ServingClient, StreamBrokenError
+from paddle_tpu.serving.server import ServingError
 from paddle_tpu.serving.frontend import ServingFrontend
 from paddle_tpu.serving.generation import Sampler, SlotDecodeSession
 from paddle_tpu.serving.router import (
@@ -522,3 +523,197 @@ def test_client_resume_rotates_to_router_after_victim_death(
             cl.close()
             m1.close(leave=False)
             fe1.close()
+
+
+# ---------------------------------------------------------------------------
+# rid namespaces: per-member ids must never cross-resolve
+# ---------------------------------------------------------------------------
+
+def test_take_result_rid_collision_resolves_to_minting_member(
+        trained, tmp_path):
+    """Two frontends mint the SAME rid number for different requests
+    (rids are per-member namespaces counting from 0). The router's
+    composite "wid:rid" handle claims exactly the minting member's
+    result; a bare ambiguous rid is a typed miss (None) — it must
+    never pop another member's bank."""
+    src = trained["src"]
+    s1, s2, oracle = _paged(trained), _paged(trained), _paged(trained)
+    exp6 = oracle.generate(src[6][None, :], [SEQ])[0]
+    exp7 = oracle.generate(src[7][None, :], [SEQ])[0]
+    fe1, fe2 = ServingFrontend(session=s1), ServingFrontend(session=s2)
+    with fe1, fe2, ServingRouter(lease_s=5.0, health_poll_s=0) as r:
+        m1 = RouterMember(fe1, r.address)
+        m2 = RouterMember(fe2, r.address)
+        cl = ServingClient(r.address)
+        try:
+            rid1 = fe1._decode.call(lambda: s1.enqueue(src[6], SEQ))
+            rid2 = fe2._decode.call(lambda: s2.enqueue(src[7], SEQ))
+            # the collision premise: independent namespaces, same number
+            assert rid1 == rid2
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and not (
+                    rid1 in s1._results and rid2 in s2._results):
+                time.sleep(0.02)
+            assert rid1 in s1._results and rid2 in s2._results
+            # a BARE rid with two live members is ambiguous: typed
+            # miss, both banks untouched
+            assert cl.take_result(rid1) is None
+            assert rid1 in s1._results and rid2 in s2._results
+            # composite handles resolve to exactly their namespace
+            got1 = cl.take_result("%s:%d" % (m1.worker_id, rid1))
+            assert np.array_equal(got1, exp6)
+            assert rid2 in s2._results  # fe2's bank survived the claim
+            got2 = cl.take_result("%s:%d" % (m2.worker_id, rid2))
+            assert np.array_equal(got2, exp7)
+        finally:
+            cl.close()
+            m1.close()
+            m2.close()
+
+
+def test_drain_failure_rolls_back_routing_pin(trained, tmp_path):
+    """A drain that cannot land (here: no surviving target) raises its
+    typed error AND unpins the victim — one transient failure must not
+    remove a healthy frontend from routing forever."""
+    src = trained["src"]
+    s1, oracle = _paged(trained), _paged(trained)
+    fe1 = ServingFrontend(
+        session=s1, snapshot_manager=DecodeSnapshotManager(
+            s1, str(tmp_path / "snapA"), interval_steps=1))
+    with fe1, ServingRouter(lease_s=5.0, health_poll_s=0) as r:
+        m1 = RouterMember(fe1, r.address)
+        cl = ServingClient(r.address)
+        try:
+            with pytest.raises(ServingError):
+                cl._request(method="drain", worker_id=m1.worker_id)
+            st = r.stats()
+            assert st["frontends"][m1.worker_id]["draining"] is False
+            # the member still serves: the failed drain left no pin
+            got = cl.generate_full(src[1], src_len=5)
+            want = oracle.generate(src[1][None, :], [5])
+            assert np.array_equal(got[0], want[0])
+        finally:
+            cl.close()
+            m1.close()
+
+
+# ---------------------------------------------------------------------------
+# relay discipline: in-band cancel while the upstream is producing,
+# typed loss for rid-less (group) streams
+# ---------------------------------------------------------------------------
+
+def test_inband_cancel_propagates_while_upstream_producing(trained):
+    """The relay polls the downstream on EVERY event, so a mid-stream
+    cancel reaches the member while tokens are still flowing — the
+    generation is torn down instead of running to completion."""
+    src = trained["src"]
+    s1 = _paged(trained)
+    fe1 = ServingFrontend(session=s1)
+    with fe1, ServingRouter(lease_s=5.0, health_poll_s=0) as r:
+        m1 = RouterMember(fe1, r.address)
+        cl = ServingClient(r.address)
+        try:
+            chaos.configure("slow@site=serve.dispatch,p=1.0,secs=0.3")
+            gen = cl.generate(src[LONG_SRC], src_len=SEQ)
+            while next(gen)["event"] != "tokens":
+                pass
+            gen.close()  # sends the in-band cancel and drains the ack
+            chaos.disable()
+            # the frontend saw the teardown mid-flight: its generate
+            # stream must NOT have completed normally
+            deadline = time.monotonic() + 10.0
+            outcomes = {}
+            while time.monotonic() < deadline:
+                outcomes = fe1.stats()["requests"].get("generate", {})
+                if outcomes and not s1.active_slots:
+                    break
+                time.sleep(0.05)
+            assert outcomes.get("ok", 0) == 0, outcomes
+            assert not s1.active_slots
+            assert s1.pool_conserved
+        finally:
+            chaos.disable()
+            cl.close()
+            m1.close()
+
+
+def test_group_stream_sever_after_delivery_is_typed_loss(trained):
+    """Fork-group streams carry no rid (the frontend attaches no id to
+    their events), so a sever after delivery cannot re-attach: the
+    router must answer with a TYPED StreamBrokenError and count the
+    lost stream — never an untyped internal error."""
+    src = trained["src"]
+    s1 = _paged(trained)
+    fe1 = ServingFrontend(session=s1)
+    with ServingRouter(lease_s=5.0, health_poll_s=0) as r:
+        m1 = RouterMember(fe1, r.address)
+        cl = ServingClient(r.address)
+        try:
+            chaos.configure("slow@site=serve.dispatch,p=1.0,secs=0.3")
+            gen = cl.generate(src[LONG_SRC], src_len=SEQ, n=2)
+            while next(gen)["event"] != "tokens":
+                pass
+            # kill the member's server under the live relay
+            close_json_server(fe1._json_server)
+            fe1._json_server = None
+            with pytest.raises(StreamBrokenError):
+                for _ in gen:
+                    pass
+            chaos.disable()
+            assert r.stats()["lost_streams"] == 1
+        finally:
+            chaos.disable()
+            cl.close()
+            m1.close(leave=False)
+            fe1.close()
+
+
+# ---------------------------------------------------------------------------
+# resumed events carry bos (the router's synthesized-admission basis)
+# ---------------------------------------------------------------------------
+
+def test_resumed_events_carry_bos(trained):
+    """Every ``resumed`` variant must carry ``bos`` — the router
+    synthesizes an admission from it when a stream fails over before
+    its admission event reached the client; a missing field silently
+    corrupted non-zero-bos sessions' first prefix token."""
+    src = trained["src"]
+    s1 = _paged(trained)
+    fe1 = ServingFrontend(session=s1)
+    with fe1:
+        # banked: a headless request finishes into the result bank
+        rid = fe1._decode.call(lambda: s1.enqueue(src[6], SEQ))
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and rid not in s1._results:
+            time.sleep(0.02)
+        assert rid in s1._results
+        cl = ServingClient(fe1.address)
+        try:
+            cl._send_line({"method": "attach", "id": int(rid)})
+            first = cl._recv_line()
+            assert first["event"] == "resumed" and first["finished"]
+            assert first["bos"] == int(s1._bos)
+            assert cl._recv_line()["event"] == "end"
+            # live: attach to a mid-flight headless generation
+            chaos.configure("slow@site=serve.dispatch,p=1.0,secs=0.2")
+            rid2 = fe1._decode.call(lambda: s1.enqueue(src[5], SEQ))
+            deadline = time.monotonic() + 30.0
+            while (time.monotonic() < deadline
+                    and rid2 not in s1._owner.values()):
+                time.sleep(0.02)
+            assert rid2 in s1._owner.values()
+            cl2 = ServingClient(fe1.address)
+            cl2._send_line({"method": "attach", "id": int(rid2)})
+            first2 = cl2._recv_line()
+            assert first2["event"] == "resumed"
+            assert not first2["finished"]
+            assert first2["bos"] == int(s1._bos)
+            cl2.close()  # disconnect cancels the attached generation
+            chaos.disable()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and s1.active_slots:
+                time.sleep(0.02)
+            assert s1.pool_conserved
+        finally:
+            chaos.disable()
+            cl.close()
